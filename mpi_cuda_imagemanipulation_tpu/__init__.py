@@ -1,0 +1,41 @@
+"""mpi_cuda_imagemanipulation_tpu — a TPU-native image-manipulation framework.
+
+A brand-new JAX/XLA/Pallas framework with the capabilities of the MPI+CUDA
+reference (Dohruba/MPI-CUDA-ImageManipulation): per-pixel ops (grayscale,
+contrast) and stencil filters (emboss, Gaussian, Sobel, ...) over HWC uint8
+images, distributed by row-sharding a single image over a device mesh with
+`lax.ppermute` ghost-row halo exchange — replacing the reference's
+MPI_Scatter/MPI_Gather row blocks (reference kern.cpp:55,81-83;
+kernel.cu:137,223-225) and fixing its slice-seam and in-place-race bugs by
+construction.
+
+Public API:
+  - `ops`      : op registry + golden uint8-exact semantics
+  - `models`   : `Pipeline` (composable op graph, jit-compiled)
+  - `parallel` : mesh construction + sharded (halo-exchanged) execution
+  - `io`       : image load/save (PIL, plus native C++ codec when built)
+"""
+
+from mpi_cuda_imagemanipulation_tpu import io, models, ops, parallel, utils
+from mpi_cuda_imagemanipulation_tpu._version import __version__
+from mpi_cuda_imagemanipulation_tpu.io.image import load_image, save_image
+from mpi_cuda_imagemanipulation_tpu.models.pipeline import (
+    Pipeline,
+    reference_pipeline,
+)
+from mpi_cuda_imagemanipulation_tpu.ops.registry import make_op, make_pipeline_ops
+
+__all__ = [
+    "__version__",
+    "io",
+    "models",
+    "ops",
+    "parallel",
+    "utils",
+    "load_image",
+    "save_image",
+    "Pipeline",
+    "reference_pipeline",
+    "make_op",
+    "make_pipeline_ops",
+]

@@ -1,0 +1,4 @@
+from mpi_cuda_imagemanipulation_tpu.cli import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,213 @@
+"""CLI driver — the framework's L5 entry point.
+
+Replaces the reference's hardcoded main() (kern.cpp:17, kernel.cu:96 — fixed
+Windows input path kernel.cu:110, fixed output name :236, hardwired contrast
+3.5 :50 and filter choice :195) with real flags, per SURVEY.md §5's config
+audit. Every hardcoded knob in the reference is a flag here.
+
+Usage:
+  python -m mpi_cuda_imagemanipulation_tpu run --input in.png --output out.png
+      [--ops grayscale,contrast:3.5,emboss:3] [--impl xla|pallas]
+      [--shards N] [--device cpu|tpu] [--show-timing] [--json-metrics PATH|-]
+      [--profile-dir DIR]
+  python -m mpi_cuda_imagemanipulation_tpu bench [--configs ...]
+  python -m mpi_cuda_imagemanipulation_tpu info
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="mcim-tpu",
+        description="TPU-native image-manipulation pipeline",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    run = sub.add_parser("run", help="run a pipeline on one image")
+    run.add_argument("--input", required=True, help="input image path")
+    run.add_argument("--output", required=True, help="output image path")
+    run.add_argument(
+        "--ops",
+        default="grayscale,contrast:3.5,emboss:3",
+        help="comma-separated pipeline (default: the reference pipeline, "
+        "kernel.cu:192-195)",
+    )
+    run.add_argument(
+        "--impl",
+        choices=("xla", "pallas"),
+        default="xla",
+        help="compute backend for the op kernels",
+    )
+    run.add_argument(
+        "--shards",
+        type=int,
+        default=1,
+        help="row-shard the image over this many devices (mpirun -np analogue); "
+        "1 = single device",
+    )
+    run.add_argument(
+        "--device",
+        default=None,
+        help="JAX platform to use (e.g. tpu, cpu); default: JAX's default",
+    )
+    run.add_argument(
+        "--gray-output",
+        action="store_true",
+        help="write single-channel output instead of replicating gray to RGB "
+        "(the reference replicates: kernel.cu:210)",
+    )
+    run.add_argument("--show-timing", action="store_true", help="print timing")
+    run.add_argument(
+        "--json-metrics",
+        default=None,
+        help="write a JSON metrics line to this path ('-' = stdout)",
+    )
+    run.add_argument(
+        "--profile-dir",
+        default=None,
+        help="capture a jax.profiler trace (TensorBoard/Perfetto) to this dir",
+    )
+
+    bench = sub.add_parser("bench", help="run the benchmark suite")
+    bench.add_argument("--configs", default=None, help="subset, comma-separated")
+    bench.add_argument("--reps", type=int, default=10)
+    bench.add_argument("--device", default=None)
+    bench.add_argument("--impl", choices=("xla", "pallas", "both"), default="both")
+    bench.add_argument("--json-metrics", default=None)
+
+    sub.add_parser("info", help="print device/mesh/version info")
+    return p
+
+
+def _configure_platform(device: str | None) -> None:
+    # Must happen before the first jax import in this process.
+    if device:
+        os.environ["JAX_PLATFORMS"] = device
+
+
+def cmd_run(args: argparse.Namespace) -> int:
+    _configure_platform(args.device)
+    import jax
+    import numpy as np
+
+    from mpi_cuda_imagemanipulation_tpu.io.image import load_image, save_image
+    from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline
+    from mpi_cuda_imagemanipulation_tpu.parallel.mesh import (
+        distributed_init,
+        make_mesh,
+    )
+    from mpi_cuda_imagemanipulation_tpu.utils.log import (
+        emit_json_metrics,
+        get_logger,
+    )
+
+    log = get_logger()
+    distributed_init()
+    pipe = Pipeline.parse(args.ops)
+    needs_rgb_output = not args.gray_output
+
+    img = load_image(args.input)
+    log.info("loaded %s: %s", args.input, img.shape)
+
+    if args.shards > 1:
+        mesh = make_mesh(args.shards)
+        fn = pipe.sharded(mesh, backend=args.impl)
+    else:
+        fn = pipe.jit(backend=args.impl)
+
+    if args.profile_dir:
+        jax.profiler.start_trace(args.profile_dir)
+
+    t0 = time.perf_counter()
+    out = jax.block_until_ready(fn(img))
+    compile_and_run_s = time.perf_counter() - t0
+    steady_s = None
+    if args.show_timing or args.json_metrics:
+        # second run isolates steady-state latency from compile time
+        t0 = time.perf_counter()
+        out = jax.block_until_ready(fn(img))
+        steady_s = time.perf_counter() - t0
+
+    if args.profile_dir:
+        jax.profiler.stop_trace()
+        log.info("profile written to %s", args.profile_dir)
+
+    out = np.asarray(out)
+    if needs_rgb_output and out.ndim == 2:
+        out = np.broadcast_to(out[..., None], (*out.shape, 3)).copy()
+    save_image(args.output, out)
+    log.info("wrote %s: %s", args.output, out.shape)
+
+    mp = img.shape[0] * img.shape[1] / 1e6
+    if args.show_timing and steady_s is not None:
+        print(
+            f"pipeline [{pipe.name}] impl={args.impl} shards={args.shards}: "
+            f"first call (incl. compile) {compile_and_run_s * 1e3:.2f} ms, "
+            f"steady-state {steady_s * 1e3:.2f} ms "
+            f"({mp / steady_s:.1f} MP/s)"
+        )
+    if args.json_metrics:
+        emit_json_metrics(
+            {
+                "event": "run",
+                "ops": pipe.name,
+                "impl": args.impl,
+                "shards": args.shards,
+                "height": img.shape[0],
+                "width": img.shape[1],
+                "compile_and_run_s": compile_and_run_s,
+                "steady_s": steady_s,
+                "mp_per_s": mp / steady_s,
+            },
+            None if args.json_metrics == "-" else args.json_metrics,
+        )
+    return 0
+
+
+def cmd_bench(args: argparse.Namespace) -> int:
+    _configure_platform(args.device)
+    from mpi_cuda_imagemanipulation_tpu.bench_suite import run_suite
+
+    names = args.configs.split(",") if args.configs else None
+    run_suite(
+        names=names,
+        reps=args.reps,
+        impl=args.impl,
+        json_path=args.json_metrics,
+    )
+    return 0
+
+
+def cmd_info(args: argparse.Namespace) -> int:
+    import jax
+
+    from mpi_cuda_imagemanipulation_tpu._version import __version__
+    from mpi_cuda_imagemanipulation_tpu.ops.registry import REGISTRY
+
+    print(f"mpi_cuda_imagemanipulation_tpu {__version__}")
+    print(f"jax {jax.__version__} backend={jax.default_backend()}")
+    print(f"process {jax.process_index()}/{jax.process_count()}")
+    print(f"devices: {jax.devices()}")
+    print(f"ops: {', '.join(sorted(REGISTRY))}")
+    try:
+        from mpi_cuda_imagemanipulation_tpu.runtime import codec
+
+        print(f"native codec: {'available' if codec.available() else 'not built'}")
+    except Exception:
+        print("native codec: not built")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = _build_parser().parse_args(argv)
+    return {"run": cmd_run, "bench": cmd_bench, "info": cmd_info}[args.cmd](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,7 @@
+from mpi_cuda_imagemanipulation_tpu.io.image import (
+    load_image,
+    save_image,
+    synthetic_image,
+)
+
+__all__ = ["load_image", "save_image", "synthetic_image"]

@@ -1,0 +1,84 @@
+"""Image I/O: HWC uint8 numpy arrays at the framework boundary.
+
+Replaces the reference's OpenCV imread/imshow/imwrite layer (kern.cpp:33,89-92;
+kernel.cu:110,120-122,233-236) with PIL for the long-tail formats, plus a
+native C++ codec (runtime/) for PPM/PGM on the hot batch path when built.
+Interactive `imshow` has no headless-TPU equivalent and is intentionally
+replaced by file output (SURVEY.md §2.5).
+
+Convention: colour images are (H, W, 3) RGB uint8; grayscale are (H, W)
+uint8. (The reference works in OpenCV BGR order; ops are defined per-colour,
+so only the channel indices differ — see ops.registry.grayscale_u8.)
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+_NATIVE_EXTS = {".ppm", ".pgm"}
+
+
+def _native_codec():
+    """The C++ codec module, or None when the shared library isn't built."""
+    try:
+        from mpi_cuda_imagemanipulation_tpu.runtime import codec
+
+        return codec if codec.available() else None
+    except Exception:
+        return None
+
+
+def load_image(path: str | os.PathLike, *, grayscale: bool = False) -> np.ndarray:
+    """Load an image file to (H, W, 3) RGB uint8, or (H, W) if grayscale.
+
+    `grayscale=True` on a *colour* source always reduces with the framework's
+    golden grayscale op (identical results whether the native codec or PIL
+    decoded the file); a single-channel source is returned as stored.
+    """
+    ext = os.path.splitext(str(path))[1].lower()
+    native = _native_codec() if ext in _NATIVE_EXTS else None
+    if native is not None:
+        arr = native.read_image(str(path))
+    else:
+        from PIL import Image
+
+        with Image.open(path) as im:
+            if im.mode in ("L", "1", "I", "I;16", "F"):
+                arr = np.asarray(im.convert("L"), dtype=np.uint8)
+            else:
+                arr = np.asarray(im.convert("RGB"), dtype=np.uint8)
+    if grayscale and arr.ndim == 3:
+        import jax.numpy as jnp
+
+        from mpi_cuda_imagemanipulation_tpu.ops.registry import grayscale_u8
+
+        arr = np.asarray(grayscale_u8(jnp.asarray(arr)))
+    if not grayscale and arr.ndim == 2:
+        arr = np.broadcast_to(arr[..., None], (*arr.shape, 3)).copy()
+    return arr
+
+
+def save_image(path: str | os.PathLike, img: np.ndarray) -> None:
+    """Save (H, W) or (H, W, 3) uint8 to `path` (format from extension)."""
+    img = np.asarray(img)
+    if img.dtype != np.uint8:
+        raise TypeError(f"expected uint8 image, got {img.dtype}")
+    if img.ndim == 3 and img.shape[2] == 1:
+        img = img[..., 0]
+    ext = os.path.splitext(str(path))[1].lower()
+    native = _native_codec() if ext in _NATIVE_EXTS else None
+    if native is not None:
+        native.write_image(str(path), np.ascontiguousarray(img))
+        return
+    from PIL import Image
+
+    Image.fromarray(img).save(path)
+
+
+def synthetic_image(height: int, width: int, *, channels: int = 3, seed: int = 0) -> np.ndarray:
+    """Deterministic pseudo-random test/bench image (uint8)."""
+    rng = np.random.default_rng(seed)
+    shape = (height, width, channels) if channels > 1 else (height, width)
+    return rng.integers(0, 256, size=shape, dtype=np.uint8)

@@ -1,0 +1,6 @@
+from mpi_cuda_imagemanipulation_tpu.models.pipeline import (
+    Pipeline,
+    reference_pipeline,
+)
+
+__all__ = ["Pipeline", "reference_pipeline"]

@@ -1,0 +1,84 @@
+"""Pipeline — the framework's composable "model": an op graph over an image.
+
+The reference hardwires one pipeline (grayscale -> contrast 3.5 -> emboss 3x3,
+kernel.cu:192-195) as three sequential host-driven kernel launches with a
+device round-trip on either side (kernel.cu:163,202). Here a pipeline is a
+declarative op sequence compiled into ONE XLA program — scatter, compute and
+gather fuse into a single dispatch (SURVEY.md §3.4) — with three backends:
+
+  * ``backend='xla'``    : the golden jnp ops, fused by XLA (oracle + default)
+  * ``backend='pallas'`` : hand-tiled Pallas kernels for the hot stencils
+  * ``mesh=...``         : sharded over a ('rows',) device mesh with ppermute
+                           halo exchange (parallel.api)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from mpi_cuda_imagemanipulation_tpu.ops.registry import (
+    REFERENCE_PIPELINE_SPEC,
+    make_pipeline_ops,
+)
+from mpi_cuda_imagemanipulation_tpu.ops.spec import Op
+
+BACKENDS = ("xla", "pallas")
+
+
+@dataclasses.dataclass(frozen=True)
+class Pipeline:
+    ops: tuple[Op, ...]
+
+    @classmethod
+    def parse(cls, spec: str) -> "Pipeline":
+        return cls(ops=make_pipeline_ops(spec))
+
+    @property
+    def name(self) -> str:
+        return ",".join(op.name for op in self.ops)
+
+    @property
+    def max_halo(self) -> int:
+        return max((op.halo for op in self.ops), default=0)
+
+    # -- golden / XLA path ------------------------------------------------
+
+    def apply(self, img: jnp.ndarray) -> jnp.ndarray:
+        for op in self.ops:
+            img = op(img)
+        return img
+
+    def __call__(self, img: jnp.ndarray) -> jnp.ndarray:
+        return self.apply(img)
+
+    # -- compiled entry points -------------------------------------------
+
+    def jit(self, backend: str = "xla"):
+        """A jitted image -> image function on the current default device."""
+        if backend == "xla":
+            return jax.jit(self.apply)
+        if backend == "pallas":
+            from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
+                pipeline_pallas,
+            )
+
+            return jax.jit(partial(pipeline_pallas, self.ops))
+        raise ValueError(f"unknown backend {backend!r}; known: {BACKENDS}")
+
+    def sharded(self, mesh, backend: str = "xla"):
+        """A jitted function running this pipeline row-sharded over `mesh`
+        with ppermute ghost-row halo exchange (see parallel.api)."""
+        from mpi_cuda_imagemanipulation_tpu.parallel.api import sharded_pipeline
+
+        return sharded_pipeline(self, mesh, backend=backend)
+
+
+def reference_pipeline() -> Pipeline:
+    """The reference's exact pipeline: grayscale -> contrast 3.5 -> emboss 3x3
+    (kernel.cu:192-195, smallEmboss=true)."""
+    return Pipeline.parse(REFERENCE_PIPELINE_SPEC)

@@ -1,0 +1,47 @@
+"""Ops: golden uint8-exact semantics + filter bank + registry.
+
+The golden semantics (SURVEY.md §2.6) follow the reference's kernel.cu with
+its races/UB fixed; see `ops.spec` for the exact rules and provenance.
+"""
+
+from mpi_cuda_imagemanipulation_tpu.ops import filters
+from mpi_cuda_imagemanipulation_tpu.ops.registry import (
+    REFERENCE_PIPELINE_SPEC,
+    REGISTRY,
+    grayscale_u8,
+    make_contrast,
+    make_emboss,
+    make_gaussian,
+    make_op,
+    make_pipeline_ops,
+)
+from mpi_cuda_imagemanipulation_tpu.ops.spec import (
+    Op,
+    PointwiseOp,
+    StencilOp,
+    corr_valid,
+    pad2d,
+    rint_clip_u8,
+    separable_valid,
+    trunc_clip_u8,
+)
+
+__all__ = [
+    "filters",
+    "REFERENCE_PIPELINE_SPEC",
+    "REGISTRY",
+    "grayscale_u8",
+    "make_contrast",
+    "make_emboss",
+    "make_gaussian",
+    "make_op",
+    "make_pipeline_ops",
+    "Op",
+    "PointwiseOp",
+    "StencilOp",
+    "corr_valid",
+    "pad2d",
+    "rint_clip_u8",
+    "separable_valid",
+    "trunc_clip_u8",
+]

@@ -1,0 +1,229 @@
+"""Concrete op definitions and the name -> op factory registry.
+
+The registry is what the CLI and `Pipeline` parse: an op string is
+``name`` or ``name:arg`` (e.g. ``contrast:3.5``, ``emboss:5``, ``gaussian:7``),
+and a pipeline string is comma-separated op strings, e.g. the reference
+pipeline (kernel.cu:192-195) is ``grayscale,contrast:3.5,emboss:3``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+import numpy as np
+
+from mpi_cuda_imagemanipulation_tpu.ops import filters
+from mpi_cuda_imagemanipulation_tpu.ops.spec import (
+    F32,
+    U8,
+    U16,
+    Op,
+    PointwiseOp,
+    StencilOp,
+    trunc_clip_u8,
+)
+
+# --------------------------------------------------------------------------
+# Pointwise op bodies
+# --------------------------------------------------------------------------
+
+
+def grayscale_u8(img: jnp.ndarray) -> jnp.ndarray:
+    """Reference grayscale semantics (kernel.cu:39-42) on an RGB image.
+
+    Each weighted term is truncated to u8 *before* summing — the reference's
+    quirk, kept as golden per SURVEY.md §2.6. The reference reads BGR
+    (OpenCV) and weights B*0.11 + G*0.59 + R*0.3; our I/O layer produces RGB,
+    so the per-channel weights here are identical per colour, just reordered.
+    The sum of truncated terms is at most 28+150+76 = 254, so no overflow.
+    """
+    f = img.astype(F32)
+    r = (f[..., 0] * np.float32(0.3)).astype(U8)
+    g = (f[..., 1] * np.float32(0.59)).astype(U8)
+    b = (f[..., 2] * np.float32(0.11)).astype(U8)
+    return (r.astype(U16) + g.astype(U16) + b.astype(U16)).astype(U8)
+
+
+def make_contrast(factor: float) -> Callable[[jnp.ndarray], jnp.ndarray]:
+    """Reference contrast (kernel.cu:49-58): clamp(f*(p-128)+128), truncated.
+
+    All intermediate values are exactly representable in f32 for f = 3.5
+    (and any factor with a short binary fraction), so this is bit-exact
+    against the C float computation.
+    """
+    ff = np.float32(factor)
+
+    def contrast(img: jnp.ndarray) -> jnp.ndarray:
+        return trunc_clip_u8(ff * (img.astype(F32) - np.float32(128.0)) + np.float32(128.0))
+
+    return contrast
+
+
+def make_brightness(delta: float) -> Callable[[jnp.ndarray], jnp.ndarray]:
+    d = np.float32(delta)
+
+    def brightness(img: jnp.ndarray) -> jnp.ndarray:
+        return trunc_clip_u8(img.astype(F32) + d)
+
+    return brightness
+
+
+def invert_u8(img: jnp.ndarray) -> jnp.ndarray:
+    return jnp.uint8(255) - img
+
+
+def make_threshold(t: float) -> Callable[[jnp.ndarray], jnp.ndarray]:
+    tv = np.uint8(t)
+
+    def threshold(img: jnp.ndarray) -> jnp.ndarray:
+        return jnp.where(img >= tv, jnp.uint8(255), jnp.uint8(0))
+
+    return threshold
+
+
+def gray2rgb_u8(img: jnp.ndarray) -> jnp.ndarray:
+    """Channel-replicate, the reference's GRAY2BGR step (kernel.cu:210)."""
+    return jnp.broadcast_to(img[..., None], (*img.shape, 3))
+
+
+# --------------------------------------------------------------------------
+# Stencil op instances
+# --------------------------------------------------------------------------
+
+
+def make_emboss(size: int) -> StencilOp:
+    if size not in (3, 5):
+        raise ValueError(f"emboss size must be 3 or 5 (kernel.cu:66), got {size}")
+    k = filters.EMBOSS3 if size == 3 else filters.EMBOSS5
+    return StencilOp(
+        name=f"emboss{size}",
+        halo=(size - 1) // 2,
+        kernels=(k,),
+        edge_mode="interior",
+        quantize="trunc_clip",
+    )
+
+
+def make_gaussian(size: int) -> StencilOp:
+    if size not in (3, 5, 7):
+        raise ValueError(f"gaussian size must be 3, 5 or 7, got {size}")
+    k2, scale = filters.gaussian_2d(size)
+    return StencilOp(
+        name=f"gaussian{size}",
+        halo=(size - 1) // 2,
+        kernels=(k2,),
+        scale=scale,  # power of two — exact
+        separable=filters.binomial_1d(size),
+        edge_mode="reflect101",
+        quantize="rint_clip",
+    )
+
+
+def make_box(size: int) -> StencilOp:
+    k2, scale = filters.box_2d(size)
+    return StencilOp(
+        name=f"box{size}",
+        halo=(size - 1) // 2,
+        kernels=(k2,),
+        scale=scale,
+        separable=np.ones((size,), np.float32),
+        edge_mode="reflect101",
+        quantize="rint_clip",
+    )
+
+
+SOBEL = StencilOp(
+    name="sobel",
+    halo=1,
+    kernels=(filters.SOBEL_GX, filters.SOBEL_GY),
+    combine="magnitude",
+    edge_mode="reflect101",
+    quantize="rint_clip",
+)
+
+SHARPEN = StencilOp(
+    name="sharpen",
+    halo=1,
+    kernels=(filters.SHARPEN3,),
+    edge_mode="reflect101",
+    quantize="rint_clip",
+)
+
+# --------------------------------------------------------------------------
+# Registry
+# --------------------------------------------------------------------------
+
+_GRAYSCALE = PointwiseOp("grayscale", in_channels=3, out_channels=1, fn=grayscale_u8)
+_INVERT = PointwiseOp("invert", in_channels=0, out_channels=0, fn=invert_u8)
+_GRAY2RGB = PointwiseOp("gray2rgb", in_channels=1, out_channels=3, fn=gray2rgb_u8)
+
+
+def _float_arg(arg: str | None, default: float) -> float:
+    return default if arg is None else float(arg)
+
+
+def _int_arg(arg: str | None, default: int) -> int:
+    return default if arg is None else int(arg)
+
+
+# name -> factory(arg_str_or_None) -> Op
+REGISTRY: dict[str, Callable[[str | None], Op]] = {
+    "grayscale": lambda a: _GRAYSCALE,
+    "gray": lambda a: _GRAYSCALE,
+    "contrast": lambda a: PointwiseOp(
+        f"contrast{_float_arg(a, 3.5):g}",
+        in_channels=1,
+        out_channels=1,
+        fn=make_contrast(_float_arg(a, 3.5)),  # 3.5: kernel.cu:50
+    ),
+    "brightness": lambda a: PointwiseOp(
+        f"brightness{_float_arg(a, 0):g}",
+        in_channels=0,
+        out_channels=0,
+        fn=make_brightness(_float_arg(a, 0)),
+    ),
+    "invert": lambda a: _INVERT,
+    "threshold": lambda a: PointwiseOp(
+        f"threshold{_float_arg(a, 128):g}",
+        in_channels=1,
+        out_channels=1,
+        fn=make_threshold(_float_arg(a, 128)),
+    ),
+    "gray2rgb": lambda a: _GRAY2RGB,
+    "emboss": lambda a: make_emboss(_int_arg(a, 3)),  # smallEmboss=true: kernel.cu:195
+    "gaussian": lambda a: make_gaussian(_int_arg(a, 5)),
+    "box": lambda a: make_box(_int_arg(a, 3)),
+    "sobel": lambda a: SOBEL,
+    "sharpen": lambda a: SHARPEN,
+}
+
+
+def make_op(spec: str) -> Op:
+    """Parse ``name`` or ``name:arg`` into an op instance."""
+    name, _, arg = spec.strip().partition(":")
+    name = name.strip().lower()
+    if name not in REGISTRY:
+        raise ValueError(f"unknown op {name!r}; known: {sorted(REGISTRY)}")
+    return REGISTRY[name](arg.strip() or None if arg else None)
+
+
+def make_pipeline_ops(spec: str) -> tuple[Op, ...]:
+    """Parse a comma-separated pipeline string into op instances, validating
+    that channel counts chain (e.g. a stencil op must follow a 1-channel op)."""
+    ops = tuple(make_op(s) for s in spec.split(",") if s.strip())
+    chan = None  # unknown until first op with a fixed requirement
+    for op in ops:
+        if op.in_channels and chan and op.in_channels != chan:
+            raise ValueError(
+                f"op {op.name!r} expects {op.in_channels} channels but the "
+                f"previous op produces {chan}"
+            )
+        if op.out_channels:
+            chan = op.out_channels
+        elif op.in_channels:
+            chan = op.in_channels
+    return ops
+
+
+REFERENCE_PIPELINE_SPEC = "grayscale,contrast:3.5,emboss:3"

@@ -1,0 +1,252 @@
+"""Op specifications — the single source of truth for every image op.
+
+Each op is declared once as a small dataclass whose methods are pure,
+jnp-traceable tile functions. Three backends consume the *same* functions:
+
+  1. the golden/XLA full-image path (``op(img)``),
+  2. the Pallas tiled kernels (``ops/pallas_kernels.py``), and
+  3. the sharded shard_map runner with ppermute halo exchange
+     (``parallel/api.py``),
+
+so cross-backend bit-exactness is a structural property, not a coincidence:
+all stencil weights are integers (see ``ops/filters.py``), accumulated
+exactly in float32, with normalisation by a single multiply.
+
+Numeric semantics are fixed by SURVEY.md §2.6: the reference's ``kernel.cu``
+is golden — truncating per-term grayscale (kernel.cu:39-42), contrast 3.5
+with clamp (kernel.cu:49-58), interior-only emboss guard (kernel.cu:83) —
+with two deliberate, documented fixes:
+
+  * the reference's in-place emboss race (kernel.cu:86-91) is resolved to the
+    deterministic double-buffered reading (all neighbour reads see pre-update
+    values) — ops here are pure functions, so this holds by construction;
+  * the reference guard admits x == W-halo and y == H-halo whose
+    neighbourhoods index out of bounds (undefined behaviour in CUDA); we
+    shrink the interior to pixels whose full neighbourhood is in bounds.
+
+Grayscale weights are computed in float32 (the TPU-native dtype) rather than
+the reference's C double; per-term truncation can therefore differ by at most
+1 from the C-double result at exact-integer boundaries (verified against a
+float64 emulator in tests/test_golden.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+U8 = jnp.uint8
+U16 = jnp.uint16
+F32 = jnp.float32
+
+# --------------------------------------------------------------------------
+# Quantizers: f32 -> u8
+# --------------------------------------------------------------------------
+
+
+def trunc_clip_u8(x: jnp.ndarray) -> jnp.ndarray:
+    """C semantics of assigning a clamped float to uchar (kernel.cu:19-24,91):
+    clamp to [0, 255] then truncate toward zero."""
+    return jnp.clip(x, 0.0, 255.0).astype(U8)
+
+
+def rint_clip_u8(x: jnp.ndarray) -> jnp.ndarray:
+    """Round-to-nearest-even then clamp; used by the non-reference filter bank
+    (Gaussian/Sobel/box/sharpen) where no C golden semantics exist."""
+    return jnp.clip(jnp.rint(x), 0.0, 255.0).astype(U8)
+
+
+QUANTIZERS: dict[str, Callable[[jnp.ndarray], jnp.ndarray]] = {
+    "trunc_clip": trunc_clip_u8,
+    "rint_clip": rint_clip_u8,
+}
+
+# --------------------------------------------------------------------------
+# Core tile machinery (shared verbatim by all backends)
+# --------------------------------------------------------------------------
+
+
+def corr_valid(xpad: jnp.ndarray, weights: np.ndarray) -> jnp.ndarray:
+    """Valid-mode 2-D correlation via unrolled static shifts.
+
+    ``xpad`` is float32 of shape (H + kh - 1, W + kw - 1); ``weights`` is a
+    static (kh, kw) array indexed ``w[dy, dx]``. Returns float32 (H, W).
+    Unrolled shift-multiply-accumulate maps onto the TPU VPU (8x128 lanes)
+    and fuses under XLA; the same code runs inside Pallas kernels on VMEM
+    tiles. This replaces the CUDA per-thread gather loop (kernel.cu:84-90).
+    """
+    kh, kw = weights.shape
+    out_h = xpad.shape[0] - (kh - 1)
+    out_w = xpad.shape[1] - (kw - 1)
+    acc = None
+    for dy in range(kh):
+        for dx in range(kw):
+            w = float(weights[dy, dx])
+            if w == 0.0:
+                continue
+            win = xpad[dy : dy + out_h, dx : dx + out_w]
+            term = win if w == 1.0 else win * w
+            acc = term if acc is None else acc + term
+    if acc is None:
+        acc = jnp.zeros((out_h, out_w), F32)
+    return acc
+
+
+def separable_valid(xpad: jnp.ndarray, w1d: np.ndarray) -> jnp.ndarray:
+    """Valid-mode separable correlation: a (1,k) pass then a (k,1) pass.
+
+    With integer weights both passes accumulate exactly in f32, so the result
+    is bit-identical to the full 2-D outer-product correlation while reading
+    O(k) instead of O(k^2) terms per pixel.
+    """
+    row = np.asarray(w1d, dtype=np.float32).reshape(1, -1)
+    col = np.asarray(w1d, dtype=np.float32).reshape(-1, 1)
+    return corr_valid(corr_valid(xpad, row), col)
+
+
+_PAD_MODES = {
+    "interior": "constant",  # padding value irrelevant — masked by finalize
+    "zero": "constant",
+    "reflect101": "reflect",  # OpenCV BORDER_REFLECT_101 == numpy 'reflect'
+    "edge": "edge",
+}
+
+
+def pad2d(
+    xf: jnp.ndarray,
+    edge_mode: str,
+    top: int,
+    bottom: int,
+    left: int,
+    right: int,
+) -> jnp.ndarray:
+    """Pad a float32 (H, W) tile on each side per the op's edge mode.
+
+    The sharded runner uses asymmetric pads: sides that received ppermute
+    halo rows from a neighbour pad by 0; global-image edges pad per mode.
+    """
+    if (top, bottom, left, right) == (0, 0, 0, 0):
+        return xf
+    return jnp.pad(xf, ((top, bottom), (left, right)), mode=_PAD_MODES[edge_mode])
+
+
+# --------------------------------------------------------------------------
+# Op dataclasses
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class PointwiseOp:
+    """Per-pixel op: no neighbourhood, trivially shardable on any axis."""
+
+    name: str
+    in_channels: int  # 3, 1, or 0 (= any)
+    out_channels: int  # 3, 1, or 0 (= same as input)
+    fn: Callable[[jnp.ndarray], jnp.ndarray]  # u8 -> u8, jnp-traceable
+
+    halo: int = 0
+
+    def __call__(self, img: jnp.ndarray) -> jnp.ndarray:
+        _check_channels(self.name, self.in_channels, img)
+        return self.fn(img)
+
+
+@dataclasses.dataclass(frozen=True)
+class StencilOp:
+    """Neighbourhood op over a single-channel (grayscale) image.
+
+    kernels  : static correlation weight matrices, ``w[dy, dx]``.
+    separable: optional 1-D weight vector for a bit-identical fast path.
+    scale    : single post-accumulation multiply (1/norm; power of two for
+               Gaussians, so exact).
+    combine  : 'single' (one kernel) or 'magnitude' (sqrt(a0^2 + a1^2), for
+               Sobel).
+    edge_mode: 'interior' replicates the reference guard (kernel.cu:83) —
+               non-interior pixels pass through the input unchanged; the
+               others filter every pixel with the named border extension.
+    quantize : 'trunc_clip' (reference C semantics) or 'rint_clip'.
+    """
+
+    name: str
+    halo: int
+    kernels: tuple
+    scale: float = 1.0
+    separable: np.ndarray | None = None
+    combine: str = "single"
+    edge_mode: str = "interior"
+    quantize: str = "trunc_clip"
+
+    in_channels: int = 1
+    out_channels: int = 1
+
+    # -- tile functions (used by every backend) --
+
+    def valid(self, xpad: jnp.ndarray) -> jnp.ndarray:
+        """float32 (H+2h, W+2h) -> float32 (H, W): correlate + combine + scale."""
+        if self.separable is not None:
+            accs = [separable_valid(xpad, self.separable)]
+        else:
+            accs = [corr_valid(xpad, k) for k in self.kernels]
+        if self.combine == "single":
+            acc = accs[0]
+        elif self.combine == "magnitude":
+            acc = jnp.sqrt(accs[0] * accs[0] + accs[1] * accs[1])
+        else:  # pragma: no cover
+            raise ValueError(f"unknown combine {self.combine!r}")
+        if self.scale != 1.0:
+            acc = acc * np.float32(self.scale)
+        return acc
+
+    def finalize(
+        self,
+        acc: jnp.ndarray,
+        orig_u8: jnp.ndarray,
+        y0,
+        x0,
+        global_h: int,
+        global_w: int,
+    ) -> jnp.ndarray:
+        """Quantize and, for 'interior' mode, pass through non-interior pixels.
+
+        (y0, x0) are the tile's global offsets, so the interior mask follows
+        *global* image coordinates — this is what removes the reference's
+        per-slice seams (SURVEY.md §2.1): a sharded tile in the middle of the
+        image is entirely interior.
+        """
+        q = QUANTIZERS[self.quantize](acc)
+        if self.edge_mode != "interior":
+            return q
+        h, w = acc.shape
+        yy = y0 + lax.broadcasted_iota(jnp.int32, (h, w), 0)
+        xx = x0 + lax.broadcasted_iota(jnp.int32, (h, w), 1)
+        o = self.halo
+        # Reference guard (kernel.cu:83): x > o && x <= W-o (likewise y),
+        # intersected with the in-bounds requirement x <= W-1-o (the
+        # reference's x == W-o column reads out of bounds — UB we fix).
+        mask = (xx > o) & (xx <= global_w - 1 - o) & (yy > o) & (yy <= global_h - 1 - o)
+        return jnp.where(mask, q, orig_u8)
+
+    # -- full-image golden path --
+
+    def __call__(self, img: jnp.ndarray) -> jnp.ndarray:
+        _check_channels(self.name, self.in_channels, img)
+        h, w = img.shape
+        xpad = pad2d(
+            img.astype(F32), self.edge_mode, self.halo, self.halo, self.halo, self.halo
+        )
+        return self.finalize(self.valid(xpad), img, 0, 0, h, w)
+
+
+Op = PointwiseOp | StencilOp
+
+
+def _check_channels(name: str, want: int, img: jnp.ndarray) -> None:
+    got = img.shape[2] if img.ndim == 3 else 1
+    if want and got != want:
+        raise ValueError(
+            f"op {name!r} expects a {want}-channel image, got shape {img.shape}"
+        )

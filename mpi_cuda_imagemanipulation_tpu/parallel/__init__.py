@@ -1,0 +1,15 @@
+from mpi_cuda_imagemanipulation_tpu.parallel.mesh import (
+    ROWS,
+    distributed_init,
+    make_mesh,
+    replicated_sharding,
+    row_sharding,
+)
+
+__all__ = [
+    "ROWS",
+    "distributed_init",
+    "make_mesh",
+    "replicated_sharding",
+    "row_sharding",
+]

@@ -1,0 +1,69 @@
+"""Device mesh construction + multi-host process-group setup.
+
+Replaces the reference's MPI world management (MPI_Init/rank/size,
+kern.cpp:25-28; kernel.cu:104-107): process identity becomes
+`jax.process_index()`, and the communicator becomes a named 1-D
+`jax.sharding.Mesh` over the 'rows' axis — the image-height domain
+decomposition the reference implements with MPI_Scatter row blocks
+(SURVEY.md §2.3). Collectives ride ICI within a slice and DCN across hosts,
+inserted by XLA from sharding annotations rather than hand-written.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+ROWS = "rows"
+
+
+_distributed_initialized = False
+
+
+def distributed_init() -> None:
+    """Initialise the multi-host process group when launched as one process
+    per host (the `mpirun` analogue). No-op for single-process runs.
+
+    Must be called before any other JAX API (jax.distributed.initialize
+    refuses to run once the XLA backend exists), so the guard is a module
+    flag plus the coordinator env var — never a jax.* query.
+    """
+    global _distributed_initialized
+    if _distributed_initialized:
+        return
+    if os.environ.get("JAX_COORDINATOR_ADDRESS") or os.environ.get(
+        "COORDINATOR_ADDRESS"
+    ):
+        jax.distributed.initialize()
+        _distributed_initialized = True
+
+
+def make_mesh(n_shards: int | None = None, *, devices=None) -> Mesh:
+    """A 1-D mesh over `n_shards` devices on the ('rows',) axis.
+
+    `n_shards=None` uses every visible device — the analogue of
+    `mpirun -np <world>` with MPI_Comm_size (kernel.cu:107).
+    """
+    if devices is None:
+        devices = jax.devices()
+    if n_shards is None:
+        n_shards = len(devices)
+    if n_shards > len(devices):
+        raise ValueError(
+            f"requested {n_shards} shards but only {len(devices)} devices are visible"
+        )
+    return Mesh(np.asarray(devices[:n_shards]), (ROWS,))
+
+
+def row_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
+    """NamedSharding splitting axis 0 (image rows) over the mesh — the
+    declarative replacement for MPI_Scatter of contiguous row blocks
+    (kern.cpp:55, kernel.cu:137)."""
+    return NamedSharding(mesh, PartitionSpec(ROWS, *([None] * (ndim - 1))))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())

@@ -1,0 +1,12 @@
+"""Native runtime: C++ image codec + batch loader (ctypes bindings).
+
+The reference's runtime around the kernels is native C++ (OpenCV I/O, MPI,
+CUDA memory management — SURVEY.md §1 L2-L4). The TPU equivalents of L2/L3
+are XLA's allocator and collectives; the I/O layer keeps a native component:
+`runtime/native/` builds `libmcim_runtime.so` (PPM/PGM codec + threaded batch
+prefetcher), bound here via ctypes with a pure-Python fallback when unbuilt.
+"""
+
+from mpi_cuda_imagemanipulation_tpu.runtime import codec
+
+__all__ = ["codec"]

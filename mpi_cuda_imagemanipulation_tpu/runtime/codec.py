@@ -1,0 +1,99 @@
+"""ctypes binding for the native C++ codec (libmcim_runtime.so).
+
+Build with `python -m mpi_cuda_imagemanipulation_tpu.runtime.build` (uses the
+Makefile in runtime/native/). Falls back gracefully: `available()` returns
+False when the shared library hasn't been built, and callers use PIL.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+_LIB_NAME = "libmcim_runtime.so"
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
+_lib: ctypes.CDLL | None = None
+_load_failed = False
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    path = os.path.join(_NATIVE_DIR, _LIB_NAME)
+    if not os.path.exists(path):
+        _load_failed = True
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+        lib.mcim_read_header.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int),  # height
+            ctypes.POINTER(ctypes.c_int),  # width
+            ctypes.POINTER(ctypes.c_int),  # channels
+        ]
+        lib.mcim_read_header.restype = ctypes.c_int
+        lib.mcim_read_image.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_size_t,
+        ]
+        lib.mcim_read_image.restype = ctypes.c_int
+        lib.mcim_write_image.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_int,
+        ]
+        lib.mcim_write_image.restype = ctypes.c_int
+        _lib = lib
+    except OSError:
+        _load_failed = True
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def read_image(path: str) -> np.ndarray:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native codec not built")
+    h = ctypes.c_int()
+    w = ctypes.c_int()
+    c = ctypes.c_int()
+    rc = lib.mcim_read_header(path.encode(), ctypes.byref(h), ctypes.byref(w), ctypes.byref(c))
+    if rc != 0:
+        raise IOError(f"native codec failed to read header of {path} (rc={rc})")
+    shape = (h.value, w.value, c.value) if c.value > 1 else (h.value, w.value)
+    out = np.empty(shape, dtype=np.uint8)
+    rc = lib.mcim_read_image(
+        path.encode(),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        out.size,
+    )
+    if rc != 0:
+        raise IOError(f"native codec failed to read {path} (rc={rc})")
+    return out
+
+
+def write_image(path: str, img: np.ndarray) -> None:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native codec not built")
+    img = np.ascontiguousarray(img, dtype=np.uint8)
+    h, w = img.shape[:2]
+    c = img.shape[2] if img.ndim == 3 else 1
+    rc = lib.mcim_write_image(
+        path.encode(),
+        img.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        h,
+        w,
+        c,
+    )
+    if rc != 0:
+        raise IOError(f"native codec failed to write {path} (rc={rc})")

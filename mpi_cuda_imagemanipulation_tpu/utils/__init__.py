@@ -1,0 +1,4 @@
+from mpi_cuda_imagemanipulation_tpu.utils.log import emit_json_metrics, get_logger
+from mpi_cuda_imagemanipulation_tpu.utils.timing import BenchResult, benchmark
+
+__all__ = ["emit_json_metrics", "get_logger", "BenchResult", "benchmark"]

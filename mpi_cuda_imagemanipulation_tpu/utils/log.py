@@ -1,0 +1,36 @@
+"""Structured logging + JSON metrics output.
+
+Replaces the reference's bare stdout prints (kernel.cu:186-188,231-232) with
+a configurable logger and a machine-readable metrics record (SURVEY.md §5
+"metrics/logging" entry).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+
+_FORMAT = "%(asctime)s %(levelname)s %(name)s :: %(message)s"
+
+
+def get_logger(name: str = "mcim_tpu", level: int = logging.INFO) -> logging.Logger:
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(logging.Formatter(_FORMAT))
+        logger.addHandler(handler)
+        logger.setLevel(level)
+        logger.propagate = False
+    return logger
+
+
+def emit_json_metrics(record: dict, path: str | None = None) -> str:
+    """Serialise a metrics record to one JSON line; write to `path` or stdout."""
+    line = json.dumps(record, sort_keys=True)
+    if path:
+        with open(path, "a") as f:
+            f.write(line + "\n")
+    else:
+        print(line)
+    return line

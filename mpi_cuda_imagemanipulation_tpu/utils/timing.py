@@ -1,0 +1,79 @@
+"""Benchmark timing — the framework's replacement for the reference's two
+inconsistent std::chrono spans (SURVEY.md §2.5: kern.cpp:60,86-87 vs
+kernel.cu:190,226-227, which time different windows).
+
+Rules: compile excluded (explicit warmup), device-synchronised via
+`jax.block_until_ready`, medians over repeats, and a first-class
+megapixels/sec metric (the BASELINE.json unit).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import statistics
+import time
+from typing import Callable, Sequence
+
+import jax
+
+
+@dataclasses.dataclass(frozen=True)
+class BenchResult:
+    name: str
+    reps: int
+    wall_s: tuple[float, ...]  # per-rep synchronised wall times
+    megapixels: float  # image megapixels processed per rep
+    compile_s: float  # first (warmup) call, includes compile
+
+    @property
+    def median_s(self) -> float:
+        return statistics.median(self.wall_s)
+
+    @property
+    def min_s(self) -> float:
+        return min(self.wall_s)
+
+    @property
+    def mp_per_s(self) -> float:
+        return self.megapixels / self.median_s
+
+    def as_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "reps": self.reps,
+            "median_ms": self.median_s * 1e3,
+            "min_ms": self.min_s * 1e3,
+            "compile_s": self.compile_s,
+            "megapixels": self.megapixels,
+            "mp_per_s": self.mp_per_s,
+        }
+
+
+def benchmark(
+    fn: Callable,
+    args: Sequence,
+    *,
+    name: str = "bench",
+    megapixels: float,
+    warmup: int = 2,
+    reps: int = 10,
+) -> BenchResult:
+    """Time `fn(*args)` with compile excluded and device sync included."""
+    t0 = time.perf_counter()
+    out = fn(*args)
+    jax.block_until_ready(out)
+    compile_s = time.perf_counter() - t0
+    for _ in range(max(0, warmup - 1)):
+        jax.block_until_ready(fn(*args))
+    walls = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        walls.append(time.perf_counter() - t0)
+    return BenchResult(
+        name=name,
+        reps=reps,
+        wall_s=tuple(walls),
+        megapixels=megapixels,
+        compile_s=compile_s,
+    )

@@ -1,0 +1,77 @@
+"""Slow, loop-level float64 emulator of the reference kernel.cu semantics.
+
+This is the tests' independent oracle: it re-implements the C semantics
+(kernel.cu:31-94) directly from the survey's call-stack description — double
+arithmetic, per-term truncation, interior guard — without sharing any code
+with the framework. Races/UB are resolved the same way the framework's
+golden semantics resolve them (SURVEY.md §2.6): emboss reads pre-update
+values (double-buffered) and the interior excludes out-of-bounds
+neighbourhoods.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+EMBOSS3 = np.array([[-2, -1, 0], [-1, 1, 1], [0, 1, 2]], dtype=np.int64)
+EMBOSS5 = np.diag([4, 4, 1, -4, -4]).astype(np.int64)
+
+
+def grayscale_c(img_rgb: np.ndarray) -> np.ndarray:
+    """kernel.cu:39-42 in double precision, per-term truncation."""
+    f = img_rgb.astype(np.float64)
+    r = np.floor(f[..., 0] * 0.3).astype(np.uint16)
+    g = np.floor(f[..., 1] * 0.59).astype(np.uint16)
+    b = np.floor(f[..., 2] * 0.11).astype(np.uint16)
+    return (r + g + b).astype(np.uint8)
+
+
+def contrast_c(gray: np.ndarray, factor: float = 3.5) -> np.ndarray:
+    """kernel.cu:49-58: clamp(f*(p-128)+128) then float->uchar truncation."""
+    y = factor * (gray.astype(np.float64) - 128.0) + 128.0
+    return np.floor(np.clip(y, 0.0, 255.0)).astype(np.uint8)
+
+
+def emboss_c(gray: np.ndarray, size: int = 3) -> np.ndarray:
+    """kernel.cu:64-94 with explicit loops; filter applied transposed as the
+    reference does (filter[fx][fy] with fx = x displacement, kernel.cu:86-88);
+    non-interior pixels pass through; interior shrunk to in-bounds
+    neighbourhoods (the framework's UB fix)."""
+    filt = EMBOSS3 if size == 3 else EMBOSS5
+    o = (size - 1) // 2
+    h, w = gray.shape
+    out = gray.copy()
+    for y in range(h):
+        for x in range(w):
+            # reference guard (kernel.cu:83) ∩ in-bounds neighbourhood
+            if not (o < x <= w - 1 - o and o < y <= h - 1 - o):
+                continue
+            acc = 0.0
+            for fx in range(size):
+                for fy in range(size):
+                    acc += float(gray[y + fy - o, x + fx - o]) * filt[fx, fy]
+            out[y, x] = np.uint8(np.floor(np.clip(acc, 0.0, 255.0)))
+    return out
+
+
+def stencil_reflect101_c(
+    gray: np.ndarray,
+    weights: np.ndarray,
+    scale: float = 1.0,
+) -> np.ndarray:
+    """Loop-level correlation with reflect-101 borders + rint quantization,
+    for validating the non-reference filter bank (gaussian/box/sharpen)."""
+    k = weights.shape[0]
+    o = (k - 1) // 2
+    pad = np.pad(gray.astype(np.float64), o, mode="reflect")
+    h, w = gray.shape
+    out = np.zeros((h, w), dtype=np.uint8)
+    for y in range(h):
+        for x in range(w):
+            acc = 0.0
+            for dy in range(k):
+                for dx in range(k):
+                    acc += pad[y + dy, x + dx] * float(weights[dy, dx])
+            val = np.rint(acc * scale)
+            out[y, x] = np.uint8(np.clip(val, 0.0, 255.0))
+    return out

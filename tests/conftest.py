@@ -1,0 +1,18 @@
+"""Test harness config.
+
+Per SURVEY.md §4: tests run on CPU with 8 fake XLA host devices so the same
+shard_map + ppermute programs that target a TPU pod run in CI without
+hardware. Env vars must be set before the first jax import.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,16 @@
+"""Tiny 3x3 binary erosion helper (no scipy dependency)."""
+
+import numpy as np
+
+
+def erode3(mask: np.ndarray) -> np.ndarray:
+    """True where the full 3x3 neighbourhood is True (border = False)."""
+    out = np.zeros_like(mask, dtype=bool)
+    if mask.shape[0] < 3 or mask.shape[1] < 3:
+        return out
+    inner = np.ones(mask[1:-1, 1:-1].shape, dtype=bool)
+    for dy in range(3):
+        for dx in range(3):
+            inner &= mask[dy : dy + mask.shape[0] - 2, dx : dx + mask.shape[1] - 2]
+    out[1:-1, 1:-1] = inner
+    return out

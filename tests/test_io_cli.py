@@ -1,0 +1,104 @@
+"""I/O roundtrips and CLI end-to-end (SURVEY.md §4 integration strategy)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from mpi_cuda_imagemanipulation_tpu.io.image import (
+    load_image,
+    save_image,
+    synthetic_image,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.parametrize("ext", ["png", "ppm", "bmp"])
+def test_rgb_roundtrip(tmp_path, ext):
+    img = synthetic_image(20, 30, channels=3, seed=7)
+    p = tmp_path / f"img.{ext}"
+    save_image(p, img)
+    back = load_image(p)
+    np.testing.assert_array_equal(back, img)
+
+
+@pytest.mark.parametrize("ext", ["png", "pgm"])
+def test_gray_roundtrip(tmp_path, ext):
+    img = synthetic_image(20, 30, channels=1, seed=8)
+    p = tmp_path / f"img.{ext}"
+    save_image(p, img)
+    back = load_image(p, grayscale=True)
+    np.testing.assert_array_equal(back, img)
+
+
+def test_load_gray_as_rgb(tmp_path):
+    img = synthetic_image(10, 12, channels=1, seed=9)
+    p = tmp_path / "g.png"
+    save_image(p, img)
+    rgb = load_image(p)
+    assert rgb.shape == (10, 12, 3)
+    np.testing.assert_array_equal(rgb[..., 0], img)
+
+
+def _run_cli(*argv, env_extra=None):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    if env_extra:
+        env.update(env_extra)
+    return subprocess.run(
+        [sys.executable, "-m", "mpi_cuda_imagemanipulation_tpu", *argv],
+        capture_output=True,
+        text=True,
+        env=env,
+        cwd=REPO,
+    )
+
+
+def test_cli_run_reference_pipeline(tmp_path):
+    src = tmp_path / "in.png"
+    dst = tmp_path / "out.png"
+    save_image(src, synthetic_image(32, 48, channels=3, seed=10))
+    metrics = tmp_path / "metrics.json"
+    r = _run_cli(
+        "run",
+        "--input", str(src),
+        "--output", str(dst),
+        "--show-timing",
+        "--json-metrics", str(metrics),
+    )
+    assert r.returncode == 0, r.stderr
+    assert dst.exists()
+    out = load_image(dst)
+    assert out.shape == (32, 48, 3)
+    # RGB-replicated gray output (reference GRAY2BGR, kernel.cu:210)
+    np.testing.assert_array_equal(out[..., 0], out[..., 1])
+    rec = json.loads(metrics.read_text().strip())
+    assert rec["ops"] == "grayscale,contrast3.5,emboss3"
+    assert rec["mp_per_s"] > 0
+
+
+def test_cli_run_custom_ops_gray_output(tmp_path):
+    src = tmp_path / "in.png"
+    dst = tmp_path / "out.pgm"
+    save_image(src, synthetic_image(24, 36, channels=3, seed=11))
+    r = _run_cli(
+        "run",
+        "--input", str(src),
+        "--output", str(dst),
+        "--ops", "grayscale,gaussian:5,sobel",
+        "--gray-output",
+    )
+    assert r.returncode == 0, r.stderr
+    assert load_image(dst, grayscale=True).shape == (24, 36)
+
+
+def test_cli_info():
+    r = _run_cli("info")
+    assert r.returncode == 0, r.stderr
+    assert "mpi_cuda_imagemanipulation_tpu" in r.stdout
+    assert "ops:" in r.stdout
