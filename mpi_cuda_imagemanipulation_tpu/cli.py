@@ -86,9 +86,14 @@ def _build_parser() -> argparse.ArgumentParser:
 
 
 def _configure_platform(device: str | None) -> None:
-    # Must happen before the first jax import in this process.
     if device:
+        # The env var is only read at first jax import; this machine's
+        # sitecustomize (and any embedding app) may import jax at startup,
+        # so set the config directly as well — it wins either way.
         os.environ["JAX_PLATFORMS"] = device
+        import jax
+
+        jax.config.update("jax_platforms", device)
 
 
 def cmd_run(args: argparse.Namespace) -> int:
@@ -140,7 +145,9 @@ def cmd_run(args: argparse.Namespace) -> int:
 
     out = np.asarray(out)
     if needs_rgb_output and out.ndim == 2:
-        out = np.broadcast_to(out[..., None], (*out.shape, 3)).copy()
+        from mpi_cuda_imagemanipulation_tpu.io.image import gray_to_rgb
+
+        out = gray_to_rgb(out)
     save_image(args.output, out)
     log.info("wrote %s: %s", args.output, out.shape)
 

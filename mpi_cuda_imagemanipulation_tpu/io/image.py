@@ -56,8 +56,14 @@ def load_image(path: str | os.PathLike, *, grayscale: bool = False) -> np.ndarra
 
         arr = np.asarray(grayscale_u8(jnp.asarray(arr)))
     if not grayscale and arr.ndim == 2:
-        arr = np.broadcast_to(arr[..., None], (*arr.shape, 3)).copy()
+        arr = gray_to_rgb(arr)
     return arr
+
+
+def gray_to_rgb(img: np.ndarray) -> np.ndarray:
+    """Replicate a (H, W) gray image to (H, W, 3) — the reference's
+    GRAY2BGR output convention (kernel.cu:210)."""
+    return np.broadcast_to(img[..., None], (*img.shape, 3)).copy()
 
 
 def save_image(path: str | os.PathLike, img: np.ndarray) -> None:

@@ -74,6 +74,8 @@ def invert_u8(img: jnp.ndarray) -> jnp.ndarray:
 
 
 def make_threshold(t: float) -> Callable[[jnp.ndarray], jnp.ndarray]:
+    if not 0 <= t <= 255:
+        raise ValueError(f"threshold must be in [0, 255], got {t}")
     tv = np.uint8(t)
 
     def threshold(img: jnp.ndarray) -> jnp.ndarray:

@@ -1,0 +1,138 @@
+"""Sharded pipeline execution: shard_map over a ('rows',) mesh.
+
+Replaces the reference's entire distribution layer (SURVEY.md §2.3) with one
+compiled XLA program:
+
+  MPI_Scatter row blocks (kern.cpp:55)   -> in_specs P('rows', ...) sharding
+  (missing) ghost-row exchange           -> lax.ppermute halos (halo.py)
+  MPI_Gather (kern.cpp:81-83)            -> out_specs + jax.device_get
+  rows % size silently dropped (ku:117)  -> pad-to-multiple + crop (exact)
+  per-slice seams (kernel.cu:83)         -> global-coordinate interior masks
+
+Every op runs on its local tile with the op's *own* tile functions
+(ops/spec.py), so sharded output is bit-identical to the unsharded golden
+path — the seam/race detector invariant of SURVEY.md §4.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from mpi_cuda_imagemanipulation_tpu.ops.spec import (
+    F32,
+    PointwiseOp,
+    StencilOp,
+    pad2d,
+)
+from mpi_cuda_imagemanipulation_tpu.parallel.halo import exchange_halo
+from mpi_cuda_imagemanipulation_tpu.parallel.mesh import ROWS
+
+
+def _reflect101_index(g: jnp.ndarray, size: int) -> jnp.ndarray:
+    """Map any (possibly out-of-range) global row index to its reflect-101
+    source inside [0, size): ... 2 1 | 0 1 2 ... n-1 | n-2 n-3 ..."""
+    a = jnp.abs(g)
+    return (size - 1) - jnp.abs((size - 1) - a)
+
+
+def _fix_edge_rows(
+    ext: jnp.ndarray,
+    op: StencilOp,
+    y0: jnp.ndarray,
+    global_h: int,
+) -> jnp.ndarray:
+    """Overwrite ghost/padding rows whose global index falls outside the real
+    image with the op's edge extension.
+
+    Rows needing fixes are (a) ring-wrapped halos on the first/last shard and
+    (b) the pad-to-multiple rows at the global bottom. Sources are gathered
+    from within this shard's extended tile — feasibility is checked
+    statically in sharded_pipeline.
+    """
+    ext_h = ext.shape[0]
+    h = op.halo
+    g = y0 - h + lax.broadcasted_iota(jnp.int32, (ext_h, 1), 0)[:, 0]
+    outside = (g < 0) | (g >= global_h)
+    if op.edge_mode in ("interior", "zero"):
+        # zero out-of-image rows; 'interior' never reads them (masked), but
+        # zeroing keeps tile values identical to the golden zero-padded path.
+        return jnp.where(outside[:, None], jnp.zeros_like(ext), ext)
+    if op.edge_mode == "reflect101":
+        src_g = _reflect101_index(g, global_h)
+    elif op.edge_mode == "edge":
+        src_g = jnp.clip(g, 0, global_h - 1)
+    else:  # pragma: no cover
+        raise ValueError(f"unknown edge mode {op.edge_mode!r}")
+    src_local = jnp.clip(src_g - (y0 - h), 0, ext_h - 1)
+    gathered = jnp.take(ext, src_local, axis=0)
+    return jnp.where(outside[:, None], gathered, ext)
+
+
+def _apply_stencil(
+    op: StencilOp,
+    tile: jnp.ndarray,
+    y0: jnp.ndarray,
+    global_h: int,
+    global_w: int,
+    n_shards: int,
+) -> jnp.ndarray:
+    h = op.halo
+    ext = exchange_halo(tile, h, n_shards).astype(F32)
+    ext = _fix_edge_rows(ext, op, y0, global_h)
+    xpad = pad2d(ext, op.edge_mode, 0, 0, h, h)  # width halo is always local
+    acc = op.valid(xpad)
+    return op.finalize(acc, tile, y0, 0, global_h, global_w)
+
+
+def sharded_pipeline(pipe, mesh, backend: str = "xla"):
+    """Compile `pipe` to run row-sharded over `mesh` with halo exchange.
+
+    Returns a jitted (H, W[, 3]) uint8 -> uint8 function. Handles H not
+    divisible by the shard count by pad-to-multiple + crop (fixing the
+    reference's silent `rows / size` truncation, kernel.cu:117).
+    """
+    if backend not in ("xla", "pallas"):
+        raise ValueError(f"unknown backend {backend!r}")
+    n = mesh.shape[ROWS]
+    max_halo = pipe.max_halo
+
+    def run(img: jnp.ndarray) -> jnp.ndarray:
+        global_h, global_w = img.shape[0], img.shape[1]
+        padded_h = -(-global_h // n) * n
+        pad = padded_h - global_h
+        local_h = padded_h // n
+        # Static feasibility of local edge fixups (see parallel/api.py
+        # docstrings): every reflect/pad source row must live on-shard.
+        min_local = max(2 * pad + 1, pad + max_halo, max_halo)
+        if local_h < min_local:
+            raise ValueError(
+                f"image height {global_h} over {n} shards gives {local_h} "
+                f"rows/shard, below the minimum {min_local} for halo "
+                f"{max_halo} and padding {pad}; use fewer shards"
+            )
+        if pad:
+            img_p = jnp.pad(img, ((0, pad),) + ((0, 0),) * (img.ndim - 1))
+        else:
+            img_p = img
+
+        def tile_fn(tile):
+            y0 = lax.axis_index(ROWS) * local_h
+            for op in pipe.ops:
+                if isinstance(op, PointwiseOp):
+                    tile = op.fn(tile)
+                else:
+                    tile = _apply_stencil(op, tile, y0, global_h, global_w, n)
+            return tile
+
+        out_shape = jax.eval_shape(pipe.apply, img_p)
+        in_spec = P(ROWS, *([None] * (img.ndim - 1)))
+        out_spec = P(ROWS, *([None] * (len(out_shape.shape) - 1)))
+        out = jax.shard_map(
+            tile_fn, mesh=mesh, in_specs=in_spec, out_specs=out_spec
+        )(img_p)
+        return out[:global_h]
+
+    return jax.jit(run)

@@ -1,0 +1,40 @@
+"""Ghost-row halo exchange over the ('rows',) mesh axis via lax.ppermute.
+
+This is the component the reference *lacks* (SURVEY.md §2.3 last row): its
+MPI row-scatter runs stencils on each slice independently, producing visible
+seams every H/N rows (kernel.cu:83 guard skips slice-edge rows). Here every
+stencil tile is extended with real neighbour rows moved over ICI by two ring
+shifts — the same ring communication pattern ring-attention uses, applied to
+image rows — before the stencil runs, so the sharded result equals the
+unsharded result bit-exactly (the invariant tests/test_sharded.py asserts).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from mpi_cuda_imagemanipulation_tpu.parallel.mesh import ROWS
+
+
+def exchange_halo(tile: jnp.ndarray, halo: int, n_shards: int) -> jnp.ndarray:
+    """Return `tile` extended with `halo` ghost rows on top and bottom.
+
+    Two ring ppermutes over the 'rows' axis: the "down" ring carries each
+    shard's last rows to its south neighbour (becoming that neighbour's top
+    halo); the "up" ring carries first rows north. Rings are full
+    permutations (XLA requires a bijection), so shard 0's top halo and shard
+    n-1's bottom halo arrive wrapped from the opposite end of the image —
+    callers mask or overwrite them with the op's edge extension
+    (ops never read unfixed wrapped rows; see parallel.api._apply_stencil).
+    """
+    if halo == 0:
+        return tile
+    if n_shards == 1:
+        zeros = jnp.zeros((halo, *tile.shape[1:]), tile.dtype)
+        return jnp.concatenate([zeros, tile, zeros], axis=0)
+    down = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+    up = [(i, (i - 1) % n_shards) for i in range(n_shards)]
+    top = lax.ppermute(tile[-halo:], ROWS, down)
+    bottom = lax.ppermute(tile[:halo], ROWS, up)
+    return jnp.concatenate([top, tile, bottom], axis=0)

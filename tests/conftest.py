@@ -9,10 +9,19 @@ import os
 import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# This machine's sitecustomize force-registers the TPU plugin whenever
+# PALLAS_AXON_POOL_IPS is set, and overrides the platform choice via
+# jax.config.update("jax_platforms", "axon,cpu") at interpreter startup —
+# so clearing the env var here is too late; re-override the config below.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402  (env must be set first)
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
