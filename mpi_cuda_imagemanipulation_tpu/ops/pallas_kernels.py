@@ -1,0 +1,373 @@
+"""Pallas TPU kernels: fused pointwise+stencil pipeline groups, 2-D tiled.
+
+This is the framework's answer to kernel.cu's three separate `__global__`
+launches (grayscale :31, contrast :49, emboss :64 — each a full HBM
+round-trip on its own, kernel.cu:192-195): consecutive pointwise ops fuse
+*into* the following stencil's kernel, so one `pallas_call` reads uint8
+pixels from HBM once, applies the whole group in VMEM at f32, and writes
+uint8 once.
+
+Tiling model (the CUDA dim3-grid analogue, SURVEY.md §2.4): a 1-D grid over
+row blocks; each grid step reads three consecutive row blocks (prev/curr/
+next) per input plane so the stencil sees `halo` ghost rows without any
+dynamic indexing — the overlapping-block pattern. All image-edge extension
+(reflect101/edge/zero) is materialised by cheap XLA pads *outside* the
+kernel, so the kernel body is pure unrolled shift-multiply-accumulate on the
+VPU, bit-identical to the golden path (same tile functions from ops/spec.py,
+integer-exact accumulation).
+
+Colour images are decomposed into planar (H, W) channel arrays at the group
+boundary — (8,128)-lane-friendly, instead of HWC's 3-wide minor axis.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from mpi_cuda_imagemanipulation_tpu.ops.spec import (
+    F32,
+    U8,
+    PointwiseOp,
+    StencilOp,
+)
+
+# --------------------------------------------------------------------------
+# Pipeline grouping: [pointwise*, stencil?] units, one pallas_call each
+# --------------------------------------------------------------------------
+
+
+def group_ops(ops) -> list[tuple[list[PointwiseOp], StencilOp | None]]:
+    groups: list[tuple[list[PointwiseOp], StencilOp | None]] = []
+    pointwise: list[PointwiseOp] = []
+    for op in ops:
+        if isinstance(op, StencilOp):
+            groups.append((pointwise, op))
+            pointwise = []
+        else:
+            pointwise.append(op)
+    if pointwise:
+        groups.append((pointwise, None))
+    return groups
+
+
+def _apply_pointwise_planes(op: PointwiseOp, planes: list) -> list:
+    """Apply a pointwise op to the plane-decomposed state (f32 planes holding
+    exact u8 integer values — Mosaic has no unsigned<->float casts, so the
+    whole kernel body stays in f32)."""
+    from mpi_cuda_imagemanipulation_tpu.ops.registry import grayscale_core
+
+    if op.name == "grayscale":
+        assert len(planes) == 3, "grayscale needs 3 channel planes"
+        return [grayscale_core(*planes)]
+    if op.name == "gray2rgb":
+        assert len(planes) == 1
+        return [planes[0], planes[0], planes[0]]
+    if op.in_channels == 1 and len(planes) != 1:
+        raise ValueError(f"op {op.name!r} expects 1 channel, got {len(planes)}")
+    if op.core is None:  # pragma: no cover
+        raise NotImplementedError(f"op {op.name!r} has no f32 core function")
+    # elementwise ops act identically per plane
+    return [op.core(p) for p in planes]
+
+
+# --------------------------------------------------------------------------
+# Edge extension (XLA-side, outside the kernel)
+# --------------------------------------------------------------------------
+
+
+def _ext_rows(x: jnp.ndarray, h: int, mode: str | None, top: bool) -> jnp.ndarray:
+    if mode == "reflect101":
+        return x[1 : h + 1][::-1] if top else x[-h - 1 : -1][::-1]
+    if mode == "edge":
+        return jnp.repeat(x[:1] if top else x[-1:], h, axis=0)
+    return jnp.zeros((h, x.shape[1]), x.dtype)  # interior / zero / None
+
+
+def _ext_cols(x: jnp.ndarray, h: int, mode: str | None, left: bool) -> jnp.ndarray:
+    if mode == "reflect101":
+        return x[:, 1 : h + 1][:, ::-1] if left else x[:, -h - 1 : -1][:, ::-1]
+    if mode == "edge":
+        return jnp.repeat(x[:, :1] if left else x[:, -1:], h, axis=1)
+    return jnp.zeros((x.shape[0], h), x.dtype)
+
+
+def _prepare_plane(
+    plane: jnp.ndarray, h: int, mode: str | None, block_h: int, padded_h: int
+) -> jnp.ndarray:
+    """Lay out one channel plane for overlapping-block reads.
+
+    Returns rows = block_h + padded_h + block_h, cols = W + 2h:
+      [ zeros(block_h - h) | top edge-ext(h) | image (H) |
+        bottom edge-ext(h) | zeros(padded_h - H + block_h - h) ]
+    so that array-block k = image rows [(k-1)*block_h, k*block_h) and grid
+    step i reading blocks (i, i+1, i+2) sees image rows
+    [i*block_h - h, (i+1)*block_h + h) — the halo — with static indexing.
+    """
+    height = plane.shape[0]
+    if h > 0:
+        top = _ext_rows(plane, h, mode, top=True)
+        bottom = _ext_rows(plane, h, mode, top=False)
+        body = [top, plane, bottom]
+        left_pad = block_h - h
+        bottom_pad = (padded_h - height) + (block_h - h)
+    else:
+        body = [plane]
+        left_pad = block_h
+        bottom_pad = (padded_h - height) + block_h
+    rows = [jnp.zeros((left_pad, plane.shape[1]), plane.dtype), *body]
+    rows.append(jnp.zeros((bottom_pad, plane.shape[1]), plane.dtype))
+    out = jnp.concatenate(rows, axis=0)
+    if h > 0:
+        left = _ext_cols(out, h, mode, left=True)
+        right = _ext_cols(out, h, mode, left=False)
+        out = jnp.concatenate([left, out, right], axis=1)
+    return out
+
+
+# --------------------------------------------------------------------------
+# The fused group kernel
+# --------------------------------------------------------------------------
+
+
+def _group_kernel(
+    *refs,
+    pointwise: list[PointwiseOp],
+    stencil: StencilOp | None,
+    n_in: int,
+    n_out: int,
+    block_h: int,
+    halo: int,
+    global_h: int,
+    global_w: int,
+):
+    in_refs = refs[: 3 * n_in]
+    out_refs = refs[3 * n_in :]
+    h = halo
+
+    def u8_to_f32(x):
+        # Mosaic has no unsigned->float cast; bridge through int32.
+        return x.astype(jnp.int32).astype(F32)
+
+    def f32_to_u8(x):
+        return x.astype(jnp.int32).astype(U8)
+
+    planes = []
+    for p in range(n_in):
+        prev, curr, nxt = in_refs[3 * p : 3 * p + 3]
+        if h > 0:
+            ext = jnp.concatenate(
+                [u8_to_f32(prev[-h:]), u8_to_f32(curr[:]), u8_to_f32(nxt[:h])],
+                axis=0,
+            )
+        else:
+            ext = u8_to_f32(curr[:])
+        planes.append(ext)
+
+    for op in pointwise:
+        planes = _apply_pointwise_planes(op, planes)
+
+    if stencil is None:
+        assert len(planes) == n_out
+        for out_ref, plane in zip(out_refs, planes):
+            out_ref[:] = f32_to_u8(plane)
+        return
+
+    assert len(planes) == 1, "stencil ops take a single (grayscale) plane"
+    x = planes[0]  # f32 (exact u8 ints), (block_h + 2h, W + 2h)
+    acc = stencil.valid(x)  # (block_h, W)
+    y0 = pl.program_id(0) * block_h
+    orig = x[h : h + block_h, h : h + global_w] if h > 0 else x
+    out_refs[0][:] = f32_to_u8(
+        stencil.finalize_f32(acc, orig, y0, 0, global_h, global_w)
+    )
+
+
+# --------------------------------------------------------------------------
+# Group runner
+# --------------------------------------------------------------------------
+
+
+def _pick_block_h(width: int, n_in: int, halo: int) -> int:
+    """Row-block height: (8,128)-friendly, sized so the working set
+    (3 u8 in-blocks per plane + a few f32 temps) stays well under VMEM."""
+    budget = 6 * 1024 * 1024
+    per_row = width * (3 * n_in + 4 * 4)  # u8 in-blocks + ~4 f32 temps
+    bh = budget // max(per_row, 1)
+    bh = int(max(32, min(512, bh)))
+    return (bh // 32) * 32
+
+
+def run_group(
+    pointwise: list[PointwiseOp],
+    stencil: StencilOp | None,
+    planes: list[jnp.ndarray],
+    *,
+    interpret: bool | None = None,
+    block_h: int | None = None,
+) -> list[jnp.ndarray]:
+    """Execute one [pointwise*, stencil?] group as a single pallas_call."""
+    if stencil is not None and stencil.edge_mode == "zero":
+        raise NotImplementedError(
+            "zero-mode stencils would need post-pointwise padding in the "
+            "Pallas path; none exist in the registry"
+        )
+    height, width = planes[0].shape
+    h = stencil.halo if stencil is not None else 0
+    mode = stencil.edge_mode if stencil is not None else None
+    if stencil is not None and mode in ("reflect101",) and height <= h:
+        raise ValueError(f"image height {height} too small for halo {h}")
+
+    n_in = len(planes)
+    n_out = n_in
+    for op in pointwise:
+        if op.name == "grayscale":
+            n_out = 1
+        elif op.name == "gray2rgb":
+            n_out = 3
+    if stencil is not None:
+        n_out = 1
+
+    bh = block_h or _pick_block_h(width, n_in, h)
+    padded_h = -(-height // bh) * bh
+    grid = (padded_h // bh,)
+
+    prepared = [_prepare_plane(p, h, mode, bh, padded_h) for p in planes]
+    in_width = width + 2 * h
+
+    in_specs = []
+    for _ in range(n_in):
+        # prev / curr / next row blocks of the prepared plane
+        for off in (0, 1, 2):
+            in_specs.append(
+                pl.BlockSpec(
+                    (bh, in_width),
+                    partial(lambda i, o: (i + o, 0), o=off),
+                    memory_space=pltpu.VMEM,
+                )
+            )
+    out_specs = [
+        pl.BlockSpec((bh, width), lambda i: (i, 0), memory_space=pltpu.VMEM)
+        for _ in range(n_out)
+    ]
+    out_shapes = [jax.ShapeDtypeStruct((padded_h, width), U8) for _ in range(n_out)]
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    kernel = partial(
+        _group_kernel,
+        pointwise=pointwise,
+        stencil=stencil,
+        n_in=n_in,
+        n_out=n_out,
+        block_h=bh,
+        halo=h,
+        global_h=height,
+        global_w=width,
+    )
+    # each plane is passed three times — once per prev/curr/next spec
+    args = [p for p in prepared for _ in range(3)]
+    outs = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs if n_out > 1 else out_specs[0],
+        out_shape=out_shapes if n_out > 1 else out_shapes[0],
+        interpret=interpret,
+    )(*args)
+    outs = outs if isinstance(outs, (tuple, list)) else [outs]
+    return [o[:height] for o in outs]
+
+
+def stencil_tile_pallas(
+    op: StencilOp,
+    ext: jnp.ndarray,
+    *,
+    interpret: bool | None = None,
+    block_h: int | None = None,
+) -> jnp.ndarray:
+    """Stencil valid+quantize over a pre-extended tile (sharded path).
+
+    `ext` is (local_h + 2*halo, W) uint8 whose ghost rows were already
+    materialised by the caller (ppermute halo exchange + global-edge fixup,
+    parallel/api.py), so the kernel needs no edge logic of its own; the
+    interior mask (if any) is applied by the caller in XLA, since the tile's
+    global row offset is a traced value inside shard_map. Returns quantized
+    uint8 (local_h, W).
+    """
+    h = op.halo
+    local_h, width = ext.shape[0] - 2 * h, ext.shape[1]
+    bh = block_h or _pick_block_h(width, 1, h)
+    padded_h = -(-local_h // bh) * bh
+
+    # width extension per op mode (the W axis is never sharded)
+    if h > 0:
+        left = _ext_cols(ext, h, op.edge_mode, left=True)
+        right = _ext_cols(ext, h, op.edge_mode, left=False)
+        ext = jnp.concatenate([left, ext, right], axis=1)
+    # row layout for overlapping prev/curr/next blocks (top halo already
+    # present in ext, so the leading zero filler is block_h - h rows)
+    filler_top = jnp.zeros((bh - h, ext.shape[1]), ext.dtype)
+    filler_bottom = jnp.zeros(
+        ((padded_h - local_h) + (bh - h), ext.shape[1]), ext.dtype
+    )
+    prepared = jnp.concatenate([filler_top, ext, filler_bottom], axis=0)
+
+    def kernel(prev, curr, nxt, out_ref):
+        x = jnp.concatenate(
+            [
+                prev[-h:].astype(jnp.int32).astype(F32),
+                curr[:].astype(jnp.int32).astype(F32),
+                nxt[:h].astype(jnp.int32).astype(F32),
+            ],
+            axis=0,
+        )
+        from mpi_cuda_imagemanipulation_tpu.ops.spec import QUANTIZERS_F32
+
+        q = QUANTIZERS_F32[op.quantize](op.valid(x))
+        out_ref[:] = q.astype(jnp.int32).astype(U8)
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    in_specs = [
+        pl.BlockSpec(
+            (bh, ext.shape[1]),
+            partial(lambda i, o: (i + o, 0), o=off),
+            memory_space=pltpu.VMEM,
+        )
+        for off in (0, 1, 2)
+    ]
+    out = pl.pallas_call(
+        kernel,
+        grid=(padded_h // bh,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (bh, width), lambda i: (i, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((padded_h, width), U8),
+        interpret=interpret,
+    )(prepared, prepared, prepared)
+    return out[:local_h]
+
+
+def pipeline_pallas(ops, img: jnp.ndarray, *, interpret: bool | None = None):
+    """Run a full pipeline through fused Pallas group kernels.
+
+    Same uint8 semantics as the golden path (bit-exact — asserted by
+    tests/test_pallas.py); images are processed as planar channels.
+    """
+    if img.ndim == 3:
+        planes = [img[..., c] for c in range(img.shape[2])]
+    else:
+        planes = [img]
+    for pointwise, stencil in group_ops(ops):
+        planes = run_group(pointwise, stencil, planes, interpret=interpret)
+    if len(planes) == 1:
+        return planes[0]
+    return jnp.stack(planes, axis=-1)

@@ -17,11 +17,11 @@ from mpi_cuda_imagemanipulation_tpu.ops import filters
 from mpi_cuda_imagemanipulation_tpu.ops.spec import (
     F32,
     U8,
-    U16,
     Op,
     PointwiseOp,
     StencilOp,
-    trunc_clip_u8,
+    pointwise_from_core,
+    trunc_clip_f32,
 )
 
 # --------------------------------------------------------------------------
@@ -29,23 +29,37 @@ from mpi_cuda_imagemanipulation_tpu.ops.spec import (
 # --------------------------------------------------------------------------
 
 
-def grayscale_u8(img: jnp.ndarray) -> jnp.ndarray:
-    """Reference grayscale semantics (kernel.cu:39-42) on an RGB image.
+def grayscale_core(r: jnp.ndarray, g: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Reference grayscale semantics (kernel.cu:39-42) on f32 channel planes.
 
     Each weighted term is truncated to u8 *before* summing — the reference's
-    quirk, kept as golden per SURVEY.md §2.6. The reference reads BGR
-    (OpenCV) and weights B*0.11 + G*0.59 + R*0.3; our I/O layer produces RGB,
-    so the per-channel weights here are identical per colour, just reordered.
-    The sum of truncated terms is at most 28+150+76 = 254, so no overflow.
+    quirk, kept as golden per SURVEY.md §2.6. Truncation is jnp.floor in f32
+    (terms are non-negative) and the three floored terms sum exactly in f32
+    (max 28+150+76 = 254), so this is bit-identical to per-term u8 casts
+    while staying in the VPU-native dtype — the same code runs inside Pallas
+    kernels. The reference reads BGR (OpenCV) and weights B*0.11 + G*0.59 +
+    R*0.3; our I/O layer produces RGB, so the weights are identical per
+    colour, just reordered.
     """
-    f = img.astype(F32)
-    r = (f[..., 0] * np.float32(0.3)).astype(U8)
-    g = (f[..., 1] * np.float32(0.59)).astype(U8)
-    b = (f[..., 2] * np.float32(0.11)).astype(U8)
-    return (r.astype(U16) + g.astype(U16) + b.astype(U16)).astype(U8)
+    tr = jnp.floor(r * np.float32(0.3))
+    tg = jnp.floor(g * np.float32(0.59))
+    tb = jnp.floor(b * np.float32(0.11))
+    return tr + tg + tb
 
 
-def make_contrast(factor: float) -> Callable[[jnp.ndarray], jnp.ndarray]:
+def grayscale_from_planes(
+    r: jnp.ndarray, g: jnp.ndarray, b: jnp.ndarray
+) -> jnp.ndarray:
+    """u8-plane wrapper over grayscale_core."""
+    return grayscale_core(r.astype(F32), g.astype(F32), b.astype(F32)).astype(U8)
+
+
+def grayscale_u8(img: jnp.ndarray) -> jnp.ndarray:
+    """Golden grayscale on an (H, W, 3) RGB image; see grayscale_core."""
+    return grayscale_from_planes(img[..., 0], img[..., 1], img[..., 2])
+
+
+def make_contrast_core(factor: float) -> Callable[[jnp.ndarray], jnp.ndarray]:
     """Reference contrast (kernel.cu:49-58): clamp(f*(p-128)+128), truncated.
 
     All intermediate values are exactly representable in f32 for f = 3.5
@@ -54,32 +68,32 @@ def make_contrast(factor: float) -> Callable[[jnp.ndarray], jnp.ndarray]:
     """
     ff = np.float32(factor)
 
-    def contrast(img: jnp.ndarray) -> jnp.ndarray:
-        return trunc_clip_u8(ff * (img.astype(F32) - np.float32(128.0)) + np.float32(128.0))
+    def contrast(x: jnp.ndarray) -> jnp.ndarray:
+        return trunc_clip_f32(ff * (x - np.float32(128.0)) + np.float32(128.0))
 
     return contrast
 
 
-def make_brightness(delta: float) -> Callable[[jnp.ndarray], jnp.ndarray]:
+def make_brightness_core(delta: float) -> Callable[[jnp.ndarray], jnp.ndarray]:
     d = np.float32(delta)
 
-    def brightness(img: jnp.ndarray) -> jnp.ndarray:
-        return trunc_clip_u8(img.astype(F32) + d)
+    def brightness(x: jnp.ndarray) -> jnp.ndarray:
+        return trunc_clip_f32(x + d)
 
     return brightness
 
 
-def invert_u8(img: jnp.ndarray) -> jnp.ndarray:
-    return jnp.uint8(255) - img
+def invert_core(x: jnp.ndarray) -> jnp.ndarray:
+    return np.float32(255.0) - x
 
 
-def make_threshold(t: float) -> Callable[[jnp.ndarray], jnp.ndarray]:
+def make_threshold_core(t: float) -> Callable[[jnp.ndarray], jnp.ndarray]:
     if not 0 <= t <= 255:
         raise ValueError(f"threshold must be in [0, 255], got {t}")
-    tv = np.uint8(t)
+    tv = np.float32(np.uint8(t))  # match u8 truncation of the threshold arg
 
-    def threshold(img: jnp.ndarray) -> jnp.ndarray:
-        return jnp.where(img >= tv, jnp.uint8(255), jnp.uint8(0))
+    def threshold(x: jnp.ndarray) -> jnp.ndarray:
+        return jnp.where(x >= tv, np.float32(255.0), np.float32(0.0))
 
     return threshold
 
@@ -87,6 +101,13 @@ def make_threshold(t: float) -> Callable[[jnp.ndarray], jnp.ndarray]:
 def gray2rgb_u8(img: jnp.ndarray) -> jnp.ndarray:
     """Channel-replicate, the reference's GRAY2BGR step (kernel.cu:210)."""
     return jnp.broadcast_to(img[..., None], (*img.shape, 3))
+
+
+def make_contrast(factor: float) -> Callable[[jnp.ndarray], jnp.ndarray]:
+    """u8 -> u8 contrast function (see make_contrast_core)."""
+    return pointwise_from_core(
+        f"contrast{factor:g}", 1, 1, make_contrast_core(factor)
+    ).fn
 
 
 # --------------------------------------------------------------------------
@@ -157,7 +178,7 @@ SHARPEN = StencilOp(
 # --------------------------------------------------------------------------
 
 _GRAYSCALE = PointwiseOp("grayscale", in_channels=3, out_channels=1, fn=grayscale_u8)
-_INVERT = PointwiseOp("invert", in_channels=0, out_channels=0, fn=invert_u8)
+_INVERT = pointwise_from_core("invert", 0, 0, invert_core)
 _GRAY2RGB = PointwiseOp("gray2rgb", in_channels=1, out_channels=3, fn=gray2rgb_u8)
 
 
@@ -173,24 +194,24 @@ def _int_arg(arg: str | None, default: int) -> int:
 REGISTRY: dict[str, Callable[[str | None], Op]] = {
     "grayscale": lambda a: _GRAYSCALE,
     "gray": lambda a: _GRAYSCALE,
-    "contrast": lambda a: PointwiseOp(
+    "contrast": lambda a: pointwise_from_core(
         f"contrast{_float_arg(a, 3.5):g}",
-        in_channels=1,
-        out_channels=1,
-        fn=make_contrast(_float_arg(a, 3.5)),  # 3.5: kernel.cu:50
+        1,
+        1,
+        make_contrast_core(_float_arg(a, 3.5)),  # 3.5: kernel.cu:50
     ),
-    "brightness": lambda a: PointwiseOp(
+    "brightness": lambda a: pointwise_from_core(
         f"brightness{_float_arg(a, 0):g}",
-        in_channels=0,
-        out_channels=0,
-        fn=make_brightness(_float_arg(a, 0)),
+        0,
+        0,
+        make_brightness_core(_float_arg(a, 0)),
     ),
     "invert": lambda a: _INVERT,
-    "threshold": lambda a: PointwiseOp(
+    "threshold": lambda a: pointwise_from_core(
         f"threshold{_float_arg(a, 128):g}",
-        in_channels=1,
-        out_channels=1,
-        fn=make_threshold(_float_arg(a, 128)),
+        1,
+        1,
+        make_threshold_core(_float_arg(a, 128)),
     ),
     "gray2rgb": lambda a: _GRAY2RGB,
     "emboss": lambda a: make_emboss(_int_arg(a, 3)),  # smallEmboss=true: kernel.cu:195

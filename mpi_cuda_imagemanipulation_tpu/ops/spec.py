@@ -48,21 +48,31 @@ F32 = jnp.float32
 # --------------------------------------------------------------------------
 
 
-def trunc_clip_u8(x: jnp.ndarray) -> jnp.ndarray:
+def trunc_clip_f32(x: jnp.ndarray) -> jnp.ndarray:
     """C semantics of assigning a clamped float to uchar (kernel.cu:19-24,91):
-    clamp to [0, 255] then truncate toward zero."""
-    return jnp.clip(x, 0.0, 255.0).astype(U8)
+    clamp to [0, 255] then truncate toward zero — kept in f32 (exact u8
+    integer values) so the same code lowers inside Mosaic, where unsigned<->
+    float casts don't exist."""
+    return jnp.floor(jnp.clip(x, 0.0, 255.0))
+
+
+def rint_clip_f32(x: jnp.ndarray) -> jnp.ndarray:
+    """Round-to-nearest-even then clamp; used by the non-reference filter bank
+    (Gaussian/Sobel/box/sharpen) where no C golden semantics exist."""
+    return jnp.clip(jnp.rint(x), 0.0, 255.0)
+
+
+def trunc_clip_u8(x: jnp.ndarray) -> jnp.ndarray:
+    return trunc_clip_f32(x).astype(U8)
 
 
 def rint_clip_u8(x: jnp.ndarray) -> jnp.ndarray:
-    """Round-to-nearest-even then clamp; used by the non-reference filter bank
-    (Gaussian/Sobel/box/sharpen) where no C golden semantics exist."""
-    return jnp.clip(jnp.rint(x), 0.0, 255.0).astype(U8)
+    return rint_clip_f32(x).astype(U8)
 
 
-QUANTIZERS: dict[str, Callable[[jnp.ndarray], jnp.ndarray]] = {
-    "trunc_clip": trunc_clip_u8,
-    "rint_clip": rint_clip_u8,
+QUANTIZERS_F32: dict[str, Callable[[jnp.ndarray], jnp.ndarray]] = {
+    "trunc_clip": trunc_clip_f32,
+    "rint_clip": rint_clip_f32,
 }
 
 # --------------------------------------------------------------------------
@@ -141,18 +151,39 @@ def pad2d(
 
 @dataclasses.dataclass(frozen=True)
 class PointwiseOp:
-    """Per-pixel op: no neighbourhood, trivially shardable on any axis."""
+    """Per-pixel op: no neighbourhood, trivially shardable on any axis.
+
+    `core` is the op's single source of truth: an elementwise f32 -> f32
+    function over exact u8 integer values (output also exact integers in
+    [0, 255]). The u8 `fn` is derived by casting around `core`; Pallas
+    kernels call `core` directly on f32 tiles (no unsigned casts in Mosaic).
+    Channel-structure ops (grayscale, gray2rgb) carry core=None and are
+    handled by name at the plane level.
+    """
 
     name: str
     in_channels: int  # 3, 1, or 0 (= any)
     out_channels: int  # 3, 1, or 0 (= same as input)
     fn: Callable[[jnp.ndarray], jnp.ndarray]  # u8 -> u8, jnp-traceable
+    core: Callable[[jnp.ndarray], jnp.ndarray] | None = None  # f32 -> f32
 
     halo: int = 0
 
     def __call__(self, img: jnp.ndarray) -> jnp.ndarray:
         _check_channels(self.name, self.in_channels, img)
         return self.fn(img)
+
+
+def pointwise_from_core(
+    name: str, in_channels: int, out_channels: int, core: Callable
+) -> PointwiseOp:
+    """Build a PointwiseOp whose u8 path is cast -> core -> cast (lossless:
+    core maps exact u8 integers to exact u8 integers)."""
+
+    def fn(img: jnp.ndarray) -> jnp.ndarray:
+        return core(img.astype(F32)).astype(U8)
+
+    return PointwiseOp(name, in_channels, out_channels, fn=fn, core=core)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -201,6 +232,43 @@ class StencilOp:
             acc = acc * np.float32(self.scale)
         return acc
 
+    def finalize_f32(
+        self,
+        acc: jnp.ndarray,
+        orig_f32: jnp.ndarray,
+        y0,
+        x0,
+        global_h: int,
+        global_w: int,
+    ) -> jnp.ndarray:
+        """Quantize (staying in f32 — exact u8 integer values) and, for
+        'interior' mode, pass through non-interior pixels.
+
+        (y0, x0) are the tile's global offsets, so the interior mask follows
+        *global* image coordinates — this is what removes the reference's
+        per-slice seams (SURVEY.md §2.1): a sharded tile in the middle of the
+        image is entirely interior.
+        """
+        q = QUANTIZERS_F32[self.quantize](acc)
+        if self.edge_mode != "interior":
+            return q
+        mask = self.interior_mask(acc.shape, y0, x0, global_h, global_w)
+        return jnp.where(mask, q, orig_f32)
+
+    def interior_mask(self, shape, y0, x0, global_h: int, global_w: int):
+        """Reference guard (kernel.cu:83): x > o && x <= W-o (likewise y),
+        intersected with the in-bounds requirement x <= W-1-o (the
+        reference's x == W-o column reads out of bounds — UB we fix).
+        Global coordinates, so sharded tiles mask identically to the
+        full-image path."""
+        h, w = shape
+        yy = y0 + lax.broadcasted_iota(jnp.int32, (h, w), 0)
+        xx = x0 + lax.broadcasted_iota(jnp.int32, (h, w), 1)
+        o = self.halo
+        return (
+            (xx > o) & (xx <= global_w - 1 - o) & (yy > o) & (yy <= global_h - 1 - o)
+        )
+
     def finalize(
         self,
         acc: jnp.ndarray,
@@ -210,25 +278,9 @@ class StencilOp:
         global_h: int,
         global_w: int,
     ) -> jnp.ndarray:
-        """Quantize and, for 'interior' mode, pass through non-interior pixels.
-
-        (y0, x0) are the tile's global offsets, so the interior mask follows
-        *global* image coordinates — this is what removes the reference's
-        per-slice seams (SURVEY.md §2.1): a sharded tile in the middle of the
-        image is entirely interior.
-        """
-        q = QUANTIZERS[self.quantize](acc)
-        if self.edge_mode != "interior":
-            return q
-        h, w = acc.shape
-        yy = y0 + lax.broadcasted_iota(jnp.int32, (h, w), 0)
-        xx = x0 + lax.broadcasted_iota(jnp.int32, (h, w), 1)
-        o = self.halo
-        # Reference guard (kernel.cu:83): x > o && x <= W-o (likewise y),
-        # intersected with the in-bounds requirement x <= W-1-o (the
-        # reference's x == W-o column reads out of bounds — UB we fix).
-        mask = (xx > o) & (xx <= global_w - 1 - o) & (yy > o) & (yy <= global_h - 1 - o)
-        return jnp.where(mask, q, orig_u8)
+        return self.finalize_f32(
+            acc, orig_u8.astype(F32), y0, x0, global_h, global_w
+        ).astype(U8)
 
     # -- full-image golden path --
 

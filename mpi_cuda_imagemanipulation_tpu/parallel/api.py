@@ -78,8 +78,22 @@ def _apply_stencil(
     global_h: int,
     global_w: int,
     n_shards: int,
+    backend: str = "xla",
 ) -> jnp.ndarray:
     h = op.halo
+    if backend == "pallas":
+        from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
+            stencil_tile_pallas,
+        )
+
+        # fixup runs on uint8 (dtype-generic gather/where), keeping the
+        # Pallas kernel's HBM traffic pure-u8
+        ext = _fix_edge_rows(exchange_halo(tile, h, n_shards), op, y0, global_h)
+        q = stencil_tile_pallas(op, ext)
+        if op.edge_mode != "interior":
+            return q
+        mask = op.interior_mask(q.shape, y0, 0, global_h, global_w)
+        return jnp.where(mask, q, tile)
     ext = exchange_halo(tile, h, n_shards).astype(F32)
     ext = _fix_edge_rows(ext, op, y0, global_h)
     xpad = pad2d(ext, op.edge_mode, 0, 0, h, h)  # width halo is always local
@@ -124,14 +138,19 @@ def sharded_pipeline(pipe, mesh, backend: str = "xla"):
                 if isinstance(op, PointwiseOp):
                     tile = op.fn(tile)
                 else:
-                    tile = _apply_stencil(op, tile, y0, global_h, global_w, n)
+                    tile = _apply_stencil(
+                        op, tile, y0, global_h, global_w, n, backend=backend
+                    )
             return tile
 
         out_shape = jax.eval_shape(pipe.apply, img_p)
         in_spec = P(ROWS, *([None] * (img.ndim - 1)))
         out_spec = P(ROWS, *([None] * (len(out_shape.shape) - 1)))
+        # pallas_call outputs don't carry vma annotations, so the varying-
+        # manual-axes checker must be off for that backend only
         out = jax.shard_map(
-            tile_fn, mesh=mesh, in_specs=in_spec, out_specs=out_spec
+            tile_fn, mesh=mesh, in_specs=in_spec, out_specs=out_spec,
+            check_vma=(backend != "pallas"),
         )(img_p)
         return out[:global_h]
 

@@ -1,0 +1,95 @@
+"""Pallas-backend tests (interpret mode on CPU, SURVEY.md §4/§5 race-detection
+posture): every fused group kernel must be BIT-EXACT against the golden jnp
+path — same tile functions, same integer-exact accumulation."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_cuda_imagemanipulation_tpu.io.image import synthetic_image
+from mpi_cuda_imagemanipulation_tpu.models.pipeline import (
+    Pipeline,
+    reference_pipeline,
+)
+from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
+    group_ops,
+    pipeline_pallas,
+)
+
+
+def _assert_pallas_equals_golden(spec_or_pipe, img, block_h=None):
+    pipe = (
+        spec_or_pipe
+        if isinstance(spec_or_pipe, Pipeline)
+        else Pipeline.parse(spec_or_pipe)
+    )
+    golden = np.asarray(pipe(jnp.asarray(img)))
+    if block_h is None:
+        got = np.asarray(pipeline_pallas(pipe.ops, jnp.asarray(img), interpret=True))
+    else:
+        from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import run_group
+
+        planes = (
+            [jnp.asarray(img[..., c]) for c in range(3)]
+            if img.ndim == 3
+            else [jnp.asarray(img)]
+        )
+        for pw, st in group_ops(pipe.ops):
+            planes = run_group(pw, st, planes, interpret=True, block_h=block_h)
+        got = np.asarray(planes[0] if len(planes) == 1 else jnp.stack(planes, -1))
+    np.testing.assert_array_equal(got, golden)
+
+
+def test_group_split():
+    pipe = reference_pipeline()
+    groups = group_ops(pipe.ops)
+    assert len(groups) == 1  # gray + contrast fuse into the emboss kernel
+    pw, st = groups[0]
+    assert [op.name for op in pw] == ["grayscale", "contrast3.5"]
+    assert st.name == "emboss3"
+
+
+def test_reference_pipeline_pallas_bitexact():
+    img = synthetic_image(96, 128, channels=3, seed=30)
+    _assert_pallas_equals_golden(reference_pipeline(), img)
+
+
+@pytest.mark.parametrize(
+    "spec",
+    ["emboss:3", "emboss:5", "gaussian:3", "gaussian:5", "gaussian:7", "sobel",
+     "box:3", "sharpen"],
+)
+def test_stencils_pallas_bitexact(spec):
+    img = synthetic_image(72, 96, channels=1, seed=31)
+    _assert_pallas_equals_golden(spec, img)
+
+
+def test_pointwise_only_group():
+    img = synthetic_image(64, 80, channels=3, seed=32)
+    _assert_pallas_equals_golden("grayscale,contrast:2.0,invert", img)
+
+
+def test_rgb_passthrough_pointwise():
+    img = synthetic_image(48, 64, channels=3, seed=33)
+    _assert_pallas_equals_golden("invert,brightness:10", img)
+
+
+def test_multi_group_pipeline():
+    img = synthetic_image(80, 96, channels=3, seed=34)
+    _assert_pallas_equals_golden(
+        "grayscale,gaussian:5,sobel,threshold:64,gray2rgb", img
+    )
+
+
+@pytest.mark.parametrize("height", [61, 96, 33])
+def test_odd_sizes_and_small_blocks(height):
+    # block_h=32 forces multiple grid steps + bottom padding block
+    img = synthetic_image(height, 72, channels=3, seed=35)
+    _assert_pallas_equals_golden(reference_pipeline(), img, block_h=32)
+
+
+def test_pipeline_jit_pallas_backend():
+    img = synthetic_image(64, 96, channels=3, seed=36)
+    pipe = reference_pipeline()
+    got = np.asarray(pipe.jit(backend="pallas")(jnp.asarray(img)))
+    np.testing.assert_array_equal(got, np.asarray(pipe(jnp.asarray(img))))

@@ -83,6 +83,21 @@ def test_too_many_shards_raises():
         pipe.sharded(make_mesh(8))(jnp.asarray(img))
 
 
+@pytest.mark.parametrize(
+    "spec", ["grayscale,contrast:3.5,emboss:3", "gaussian:5", "sobel", "emboss:5"]
+)
+def test_sharded_pallas_backend_bitexact(spec):
+    # pallas kernels inside shard_map tiles (interpret mode on CPU)
+    img = synthetic_image(
+        131, 96, channels=3 if spec.startswith("grayscale") else 1, seed=28
+    )
+    pipe = Pipeline.parse(spec)
+    mesh = make_mesh(8)
+    golden = np.asarray(pipe(jnp.asarray(img)))
+    sharded = np.asarray(pipe.sharded(mesh, backend="pallas")(jnp.asarray(img)))
+    np.testing.assert_array_equal(sharded, golden)
+
+
 def test_sharded_is_actually_sharded():
     # The input placement should split rows over devices (scatter analogue).
     from mpi_cuda_imagemanipulation_tpu.parallel.mesh import row_sharding
