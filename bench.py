@@ -1,0 +1,44 @@
+#!/usr/bin/env python
+"""Driver benchmark entry point: prints ONE JSON line with the headline
+metric (BASELINE.json): megapixels/sec/chip on 8K 5x5 Gaussian.
+
+Runs the 8K 5x5 separable-Gaussian config through both backends (XLA-fused
+golden ops and the Pallas fused kernel) on the available TPU chip(s) and
+reports the best, relative to the estimated reference CUDA+MPI 4xV100
+number (derivation in BASELINE.md — the reference publishes no numbers).
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    from mpi_cuda_imagemanipulation_tpu.bench_suite import (
+        HEADLINE,
+        headline_record,
+        run_suite,
+    )
+
+    import jax
+
+    names = [HEADLINE]
+    if len(jax.devices()) > 1:
+        names.append(HEADLINE + "_sharded")
+    records = run_suite(
+        names=names,
+        impl="both",
+        printer=lambda s: print(s, file=sys.stderr),
+    )
+    rec = headline_record(records)
+    if rec is None:
+        print(json.dumps({"error": "no benchmark record produced"}))
+        return 1
+    print(json.dumps(rec))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -76,7 +76,6 @@ def _build_parser() -> argparse.ArgumentParser:
 
     bench = sub.add_parser("bench", help="run the benchmark suite")
     bench.add_argument("--configs", default=None, help="subset, comma-separated")
-    bench.add_argument("--reps", type=int, default=10)
     bench.add_argument("--device", default=None)
     bench.add_argument("--impl", choices=("xla", "pallas", "both"), default="both")
     bench.add_argument("--json-metrics", default=None)
@@ -184,7 +183,6 @@ def cmd_bench(args: argparse.Namespace) -> int:
     names = args.configs.split(",") if args.configs else None
     run_suite(
         names=names,
-        reps=args.reps,
         impl=args.impl,
         json_path=args.json_metrics,
     )

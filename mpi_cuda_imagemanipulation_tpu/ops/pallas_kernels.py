@@ -146,9 +146,10 @@ def _group_kernel(
     global_h: int,
     global_w: int,
 ):
-    in_refs = refs[: 3 * n_in]
-    out_refs = refs[3 * n_in :]
     h = halo
+    specs_per_plane = 3 if h > 0 else 1
+    in_refs = refs[: specs_per_plane * n_in]
+    out_refs = refs[specs_per_plane * n_in :]
 
     def u8_to_f32(x):
         # Mosaic has no unsigned->float cast; bridge through int32.
@@ -159,14 +160,14 @@ def _group_kernel(
 
     planes = []
     for p in range(n_in):
-        prev, curr, nxt = in_refs[3 * p : 3 * p + 3]
         if h > 0:
+            prev, curr, nxt = in_refs[3 * p : 3 * p + 3]
             ext = jnp.concatenate(
                 [u8_to_f32(prev[-h:]), u8_to_f32(curr[:]), u8_to_f32(nxt[:h])],
                 axis=0,
             )
         else:
-            ext = u8_to_f32(curr[:])
+            ext = u8_to_f32(in_refs[p][:])
         planes.append(ext)
 
     for op in pointwise:
@@ -240,10 +241,12 @@ def run_group(
     prepared = [_prepare_plane(p, h, mode, bh, padded_h) for p in planes]
     in_width = width + 2 * h
 
+    # stencil groups read prev/curr/next row blocks of each prepared plane;
+    # pointwise-only groups (h == 0) read each block exactly once
+    offsets = (0, 1, 2) if h > 0 else (1,)
     in_specs = []
     for _ in range(n_in):
-        # prev / curr / next row blocks of the prepared plane
-        for off in (0, 1, 2):
+        for off in offsets:
             in_specs.append(
                 pl.BlockSpec(
                     (bh, in_width),
@@ -271,8 +274,8 @@ def run_group(
         global_h=height,
         global_w=width,
     )
-    # each plane is passed three times — once per prev/curr/next spec
-    args = [p for p in prepared for _ in range(3)]
+    # each plane is passed once per spec (prev/curr/next for stencil groups)
+    args = [p for p in prepared for _ in range(len(offsets))]
     outs = pl.pallas_call(
         kernel,
         grid=grid,
