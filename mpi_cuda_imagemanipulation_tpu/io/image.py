@@ -83,6 +83,74 @@ def save_image(path: str | os.PathLike, img: np.ndarray) -> None:
     Image.fromarray(img).save(path)
 
 
+def batch_load(paths, *, n_threads: int = 4, on_error: str = "raise"):
+    """Yield (index, image) over `paths` in order, decoding ahead on worker
+    threads. Uses the native C++ prefetch loader when built and all inputs
+    are PPM/PGM; otherwise a Python thread pool with PIL.
+
+    Yields the same shapes as load_image (gray sources normalised to
+    (H, W, 3)) regardless of which decoder ran. `on_error='skip'` logs and
+    drops undecodable files instead of raising (failed indices are absent
+    from the stream)."""
+    if on_error not in ("raise", "skip"):
+        raise ValueError(f"on_error must be 'raise' or 'skip', got {on_error!r}")
+    paths = [str(p) for p in paths]
+
+    def _deliver(i, arr):
+        if arr.ndim == 2:
+            arr = gray_to_rgb(arr)
+        return i, arr
+
+    def _failed(path, exc):
+        if on_error == "raise":
+            raise exc
+        from mpi_cuda_imagemanipulation_tpu.utils.log import get_logger
+
+        # the exception text names the file when `path` is unknown (native)
+        get_logger().warning("skipping %s: %s", path or "input", exc)
+
+    native = _native_codec()
+    if native is not None and all(
+        os.path.splitext(p)[1].lower() in _NATIVE_EXTS for p in paths
+    ):
+        with native.BatchLoader(paths, n_threads=n_threads) as loader:
+            for _ in range(len(paths)):
+                try:
+                    i, arr = next(loader)
+                except StopIteration:
+                    break
+                except IOError as e:
+                    _failed(None, e)  # file named in the message
+                    continue
+                yield _deliver(i, arr)
+        return
+    from collections import deque
+    from concurrent.futures import ThreadPoolExecutor
+
+    max_ahead = 16  # bound decoded-image memory like the native loader
+    with ThreadPoolExecutor(max_workers=n_threads) as pool:
+        pending: deque = deque()
+        it = iter(enumerate(paths))
+        exhausted = False
+        while pending or not exhausted:
+            while not exhausted and len(pending) < max_ahead:
+                try:
+                    i, p = next(it)
+                except StopIteration:
+                    exhausted = True
+                    break
+                pending.append((i, pool.submit(load_image, p)))
+            if not pending:
+                break
+            i, fut = pending.popleft()
+            try:
+                arr = fut.result()
+            except Exception as e:
+                _failed(paths[i], e)
+                continue
+            yield _deliver(i, arr)
+
+
 def synthetic_image(height: int, width: int, *, channels: int = 3, seed: int = 0) -> np.ndarray:
     """Deterministic pseudo-random test/bench image (uint8)."""
     rng = np.random.default_rng(seed)

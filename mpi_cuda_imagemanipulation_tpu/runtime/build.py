@@ -1,0 +1,39 @@
+"""Build the native runtime: `python -m mpi_cuda_imagemanipulation_tpu.runtime.build`.
+
+Runs make in runtime/native/ (g++, no external deps). Idempotent; the
+framework works without it (PIL fallback), just slower on the batch path.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import sys
+
+NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
+
+
+def build(verbose: bool = True) -> bool:
+    """Build libmcim_runtime.so; returns True on success."""
+    if shutil.which("make") is None or shutil.which("g++") is None:
+        if verbose:
+            print("native build skipped: make/g++ not available", file=sys.stderr)
+        return False
+    proc = subprocess.run(
+        ["make", "-C", NATIVE_DIR],
+        capture_output=True,
+        text=True,
+    )
+    if proc.returncode != 0:
+        if verbose:
+            print(proc.stdout, file=sys.stderr)
+            print(proc.stderr, file=sys.stderr)
+        return False
+    if verbose:
+        print(f"built {os.path.join(NATIVE_DIR, 'libmcim_runtime.so')}")
+    return True
+
+
+if __name__ == "__main__":
+    sys.exit(0 if build() else 1)

@@ -49,6 +49,26 @@ def _load() -> ctypes.CDLL | None:
             ctypes.c_int,
         ]
         lib.mcim_write_image.restype = ctypes.c_int
+        lib.mcim_loader_create.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.c_int,
+            ctypes.c_int,
+        ]
+        lib.mcim_loader_create.restype = ctypes.c_int64
+        lib.mcim_loader_next.argtypes = [
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int),
+        ]
+        lib.mcim_loader_next.restype = ctypes.c_int
+        lib.mcim_loader_destroy.argtypes = [ctypes.c_int64]
+        lib.mcim_loader_destroy.restype = None
+        lib.mcim_version.argtypes = []
+        lib.mcim_version.restype = ctypes.c_int
         _lib = lib
     except OSError:
         _load_failed = True
@@ -79,6 +99,81 @@ def read_image(path: str) -> np.ndarray:
     if rc != 0:
         raise IOError(f"native codec failed to read {path} (rc={rc})")
     return out
+
+
+class BatchLoader:
+    """Ordered, multithreaded prefetching reader over a list of PPM/PGM files.
+
+    Worker threads decode up to 16 images ahead while the consumer (the
+    device pipeline) runs — host-side I/O overlapped with TPU compute, the
+    counterpart of the reference's host-device staging (kernel.cu:163,202).
+    Iterate to get (index, (H, W[, C]) uint8 array) in input order.
+    """
+
+    def __init__(self, paths: list[str], n_threads: int = 4):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native codec not built")
+        self._lib = lib
+        self._n = len(paths)
+        self._paths = [str(p) for p in paths]
+        arr = (ctypes.c_char_p * self._n)(*[p.encode() for p in self._paths])
+        self._handle = lib.mcim_loader_create(arr, self._n, int(n_threads))
+        if self._handle < 0:
+            raise RuntimeError("mcim_loader_create failed")
+        self._buf = np.empty(1 << 20, dtype=np.uint8)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        idx = ctypes.c_int()
+        h = ctypes.c_int()
+        w = ctypes.c_int()
+        c = ctypes.c_int()
+        while True:
+            rc = self._lib.mcim_loader_next(
+                self._handle,
+                self._buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                self._buf.size,
+                ctypes.byref(idx),
+                ctypes.byref(h),
+                ctypes.byref(w),
+                ctypes.byref(c),
+            )
+            if rc == 0:
+                raise StopIteration
+            if rc == -3:  # buffer too small: grow and retry
+                self._buf = np.empty(
+                    max(h.value * w.value * max(c.value, 1), 2 * self._buf.size),
+                    dtype=np.uint8,
+                )
+                continue
+            if rc < 0:
+                raise IOError(f"loader_next failed (rc={rc})")
+            break
+        if h.value == 0:
+            raise IOError(f"failed to decode {self._paths[idx.value]}")
+        n = h.value * w.value * c.value
+        shape = (h.value, w.value, c.value) if c.value > 1 else (h.value, w.value)
+        return idx.value, self._buf[:n].reshape(shape).copy()
+
+    def close(self) -> None:
+        if getattr(self, "_handle", None) is not None:
+            self._lib.mcim_loader_destroy(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 def write_image(path: str, img: np.ndarray) -> None:
