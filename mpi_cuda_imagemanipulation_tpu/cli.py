@@ -215,7 +215,9 @@ def cmd_batch(args: argparse.Namespace) -> int:
     distributed_init()
     paths = sorted(
         p
-        for p in globmod.glob(os.path.join(args.input_dir, args.glob))
+        for p in globmod.glob(
+            os.path.join(globmod.escape(args.input_dir), args.glob)
+        )
         if os.path.isfile(p)
     )
     if not paths:
@@ -235,8 +237,12 @@ def cmd_batch(args: argparse.Namespace) -> int:
         out = np.asarray(jax.block_until_ready(fn(img)))
         if not args.gray_output and out.ndim == 2:
             out = gray_to_rgb(out)
-        name = os.path.basename(paths[i])
-        save_image(os.path.join(args.output_dir, name), out)
+        # mirror the input's path relative to input-dir, so glob patterns
+        # spanning subdirectories can't collide on basenames
+        name = os.path.relpath(paths[i], args.input_dir)
+        dst = os.path.join(args.output_dir, name)
+        os.makedirs(os.path.dirname(dst) or ".", exist_ok=True)
+        save_image(dst, out)
         total_mp += img.shape[0] * img.shape[1] / 1e6
         done += 1
     wall = time.perf_counter() - t0
@@ -250,7 +256,8 @@ def cmd_batch(args: argparse.Namespace) -> int:
             f"{total_mp:.1f} MP in {wall:.2f}s ({total_mp / wall:.1f} MP/s "
             f"end-to-end incl. compile+I/O)"
         )
-    return 0
+    # partial failure (skipped inputs) is a nonzero exit for scripted callers
+    return 0 if done == len(paths) else 1
 
 
 def cmd_bench(args: argparse.Namespace) -> int:
@@ -288,12 +295,18 @@ def cmd_info(args: argparse.Namespace) -> int:
 
 def main(argv: list[str] | None = None) -> int:
     args = _build_parser().parse_args(argv)
-    return {
+    cmd = {
         "run": cmd_run,
         "batch": cmd_batch,
         "bench": cmd_bench,
         "info": cmd_info,
-    }[args.cmd](args)
+    }[args.cmd]
+    try:
+        return cmd(args)
+    except (ValueError, FileNotFoundError, NotImplementedError) as e:
+        # user-input errors get one clean line, not a traceback
+        print(f"error: {e}", file=sys.stderr)
+        return 2
 
 
 if __name__ == "__main__":

@@ -120,7 +120,6 @@ struct Loader {
   std::condition_variable cv_ready;   // consumer waits for next_deliver
   std::condition_variable cv_window;  // workers wait for the window to move
   std::atomic<bool> stop{false};
-  std::atomic<int> errors{0};
 
   void worker() {
     for (;;) {
@@ -139,10 +138,7 @@ struct Loader {
       bool ok = read_pnm(paths[idx].c_str(), &img);
       {
         std::lock_guard<std::mutex> lock(mu);
-        if (!ok) {
-          errors.fetch_add(1);
-          img = Image{};  // deliver an empty record; python raises
-        }
+        if (!ok) img = Image{};  // deliver an empty record; python raises
         ready.emplace(idx, std::move(img));
       }
       cv_ready.notify_all();

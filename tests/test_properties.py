@@ -1,0 +1,78 @@
+"""Property-based tests (SURVEY.md §7 step 5 'hardening'): random shapes and
+pipelines must preserve the cross-backend bit-exactness invariants that the
+example-based suites check pointwise."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+
+from mpi_cuda_imagemanipulation_tpu.io.image import synthetic_image
+from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline
+from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import pipeline_pallas
+from mpi_cuda_imagemanipulation_tpu.parallel.mesh import make_mesh
+
+PIPELINES = [
+    "grayscale,contrast:3.5,emboss:3",
+    "grayscale,emboss:5",
+    "grayscale,gaussian:3",
+    "grayscale,gaussian:7,threshold:99",
+    "grayscale,sobel,invert",
+    "grayscale,box:3,sharpen",
+    "invert,grayscale,brightness:-20,gaussian:5",
+]
+
+dims = st.tuples(
+    st.integers(min_value=9, max_value=80),  # height (>= 8 for reflect 7x7)
+    st.integers(min_value=9, max_value=100),  # width
+    st.integers(min_value=0, max_value=len(PIPELINES) - 1),
+    st.integers(min_value=0, max_value=2**31 - 1),
+)
+
+
+@settings(max_examples=20, deadline=None)
+@given(dims)
+def test_pallas_matches_golden_on_random_shapes(args):
+    h, w, pidx, seed = args
+    pipe = Pipeline.parse(PIPELINES[pidx])
+    img = jnp.asarray(synthetic_image(h, w, channels=3, seed=seed))
+    golden = np.asarray(pipe(img))
+    got = np.asarray(pipeline_pallas(pipe.ops, img, interpret=True))
+    np.testing.assert_array_equal(got, golden)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 fake devices")
+@settings(max_examples=12, deadline=None)
+@given(
+    st.tuples(
+        st.integers(min_value=60, max_value=200),
+        st.integers(min_value=9, max_value=80),
+        st.integers(min_value=0, max_value=len(PIPELINES) - 1),
+        st.sampled_from([2, 4, 8]),
+        st.integers(min_value=0, max_value=2**31 - 1),
+    )
+)
+def test_sharded_matches_golden_on_random_shapes(args):
+    h, w, pidx, n, seed = args
+    pipe = Pipeline.parse(PIPELINES[pidx])
+    img = jnp.asarray(synthetic_image(h, w, channels=3, seed=seed))
+    golden = np.asarray(pipe(img))
+    try:
+        got = np.asarray(pipe.sharded(make_mesh(n))(img))
+    except ValueError as e:
+        assert "use fewer shards" in str(e)  # statically infeasible split
+        return
+    np.testing.assert_array_equal(got, golden)
+
+
+@settings(max_examples=30, deadline=None)
+@given(st.integers(min_value=0, max_value=255), st.floats(0.1, 10.0))
+def test_contrast_saturation_property(p, factor):
+    from mpi_cuda_imagemanipulation_tpu.ops.registry import make_contrast
+
+    out = int(np.asarray(make_contrast(factor)(jnp.full((1, 1), p, jnp.uint8)))[0, 0])
+    exact = factor * (p - 128.0) + 128.0
+    assert out == int(np.floor(np.clip(np.float32(factor) * (p - 128.0) + 128.0, 0, 255)))
+    if 0.0 <= exact <= 255.0:
+        assert abs(out - exact) <= 1
