@@ -40,9 +40,10 @@ def _build_parser() -> argparse.ArgumentParser:
     )
     run.add_argument(
         "--impl",
-        choices=("xla", "pallas"),
-        default="xla",
-        help="compute backend for the op kernels",
+        choices=("auto", "xla", "pallas"),
+        default="auto",
+        help="compute backend for the op kernels (auto: per-group choice "
+        "between XLA fusion and Pallas kernels)",
     )
     run.add_argument(
         "--shards",
@@ -81,7 +82,7 @@ def _build_parser() -> argparse.ArgumentParser:
     batch.add_argument("--output-dir", required=True)
     batch.add_argument("--glob", default="*", help="input filename pattern")
     batch.add_argument("--ops", default="grayscale,contrast:3.5,emboss:3")
-    batch.add_argument("--impl", choices=("xla", "pallas"), default="xla")
+    batch.add_argument("--impl", choices=("auto", "xla", "pallas"), default="auto")
     batch.add_argument("--shards", type=int, default=1)
     batch.add_argument("--device", default=None)
     batch.add_argument(
@@ -93,7 +94,9 @@ def _build_parser() -> argparse.ArgumentParser:
     bench = sub.add_parser("bench", help="run the benchmark suite")
     bench.add_argument("--configs", default=None, help="subset, comma-separated")
     bench.add_argument("--device", default=None)
-    bench.add_argument("--impl", choices=("xla", "pallas", "both"), default="both")
+    bench.add_argument(
+        "--impl", choices=("xla", "pallas", "auto", "both"), default="both"
+    )
     bench.add_argument("--json-metrics", default=None)
 
     sub.add_parser("info", help="print device/mesh/version info")

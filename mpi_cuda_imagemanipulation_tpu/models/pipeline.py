@@ -27,7 +27,7 @@ from mpi_cuda_imagemanipulation_tpu.ops.registry import (
 )
 from mpi_cuda_imagemanipulation_tpu.ops.spec import Op
 
-BACKENDS = ("xla", "pallas")
+BACKENDS = ("xla", "pallas", "auto")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -68,6 +68,12 @@ class Pipeline:
             )
 
             return jax.jit(partial(pipeline_pallas, self.ops))
+        if backend == "auto":
+            from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
+                pipeline_auto,
+            )
+
+            return jax.jit(partial(pipeline_auto, self.ops))
         raise ValueError(f"unknown backend {backend!r}; known: {BACKENDS}")
 
     def sharded(self, mesh, backend: str = "xla"):

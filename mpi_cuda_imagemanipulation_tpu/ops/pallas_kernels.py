@@ -195,10 +195,16 @@ def _group_kernel(
 
 
 def _pick_block_h(width: int, n_in: int, halo: int) -> int:
-    """Row-block height: (8,128)-friendly, sized so the working set
-    (3 u8 in-blocks per plane + a few f32 temps) stays well under VMEM."""
-    budget = 6 * 1024 * 1024
-    per_row = width * (3 * n_in + 4 * 4)  # u8 in-blocks + ~4 f32 temps
+    """Row-block height maximising VMEM use without overflowing it.
+
+    Working set per row of block height (measured on v5e — bh=64 compiles
+    and is fastest for W≈7.7k, bh=128 overflows): u8 input blocks
+    (specs_per_plane per plane, double-buffered by the pipeline) plus ~3
+    live f32 temps of the extended tile.
+    """
+    budget = 10 * 1024 * 1024
+    specs_per_plane = 3 if halo > 0 else 1
+    per_row = width * (specs_per_plane * n_in * 2 + 4 * 3)
     bh = budget // max(per_row, 1)
     bh = int(max(32, min(512, bh)))
     return (bh // 32) * 32
@@ -217,6 +223,13 @@ def run_group(
         raise NotImplementedError(
             "zero-mode stencils would need post-pointwise padding in the "
             "Pallas path; none exist in the registry"
+        )
+    if stencil is not None and _channels_after(pointwise, len(planes)) != 1:
+        # same clean channel error the XLA path raises (the group kernel
+        # would otherwise fail an opaque plane assertion at trace time)
+        raise ValueError(
+            f"op {stencil.name!r} expects a 1-channel image, but the group "
+            f"feeding it produces {_channels_after(pointwise, len(planes))} channels"
         )
     height, width = planes[0].shape
     h = stencil.halo if stencil is not None else 0
@@ -374,3 +387,55 @@ def pipeline_pallas(ops, img: jnp.ndarray, *, interpret: bool | None = None):
     if len(planes) == 1:
         return planes[0]
     return jnp.stack(planes, axis=-1)
+
+
+def _channels_after(pointwise: list[PointwiseOp], n_ch: int) -> int:
+    for op in pointwise:
+        if op.out_channels:
+            n_ch = op.out_channels
+    return n_ch
+
+
+def use_pallas_for_stencil(stencil: StencilOp | None, group_in_channels: int) -> bool:
+    """Static backend choice, from v5e measurements (BASELINE.md).
+
+    XLA fuses a pointwise chain plus a halo-1 stencil into a single
+    HBM pass over the HWC image, which no split or planar re-read beats
+    (reference pipeline: 78 GP/s XLA vs 30 GP/s Pallas). Pallas wins once
+    the stencil re-reads enough neighbourhood — halo >= 2 (5x5 Gaussian:
+    47 GP/s Pallas vs 11 GP/s XLA) — or for a multi-kernel combine
+    (Sobel), unless the group drags a 3-channel prologue into planar form.
+
+    `group_in_channels` is the channel count *entering the group* (the
+    sharded runner has no fused prologue, so it passes 1). This single
+    helper is shared by pipeline_auto and parallel.api so the two auto
+    paths cannot drift.
+    """
+    if stencil is None:
+        return False
+    if stencil.halo >= 2:
+        return True
+    return group_in_channels == 1 and len(stencil.kernels) > 1
+
+
+def pipeline_auto(ops, img: jnp.ndarray, *, interpret: bool | None = None):
+    """Per-group backend selection: golden/XLA ops where XLA's fusion wins,
+    Pallas group kernels where the stencil working set favours them.
+    Bit-exact with both pure paths (they are bit-exact with each other)."""
+    state = img
+    for pointwise, stencil in group_ops(ops):
+        n_ch = state.shape[2] if state.ndim == 3 else 1
+        if use_pallas_for_stencil(stencil, n_ch):
+            planes = (
+                [state[..., c] for c in range(state.shape[2])]
+                if state.ndim == 3
+                else [state]
+            )
+            planes = run_group(pointwise, stencil, planes, interpret=interpret)
+            state = planes[0] if len(planes) == 1 else jnp.stack(planes, -1)
+        else:
+            for op in pointwise:
+                state = op(state)
+            if stencil is not None:
+                state = stencil(state)
+    return state

@@ -81,6 +81,14 @@ def _apply_stencil(
     backend: str = "xla",
 ) -> jnp.ndarray:
     h = op.halo
+    if backend == "auto":
+        from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
+            use_pallas_for_stencil,
+        )
+
+        # the sharded runner has no fused prologue: the stencil's tile is
+        # always single-channel, hence group_in_channels=1
+        backend = "pallas" if use_pallas_for_stencil(op, 1) else "xla"
     if backend == "pallas":
         from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
             stencil_tile_pallas,
@@ -108,10 +116,23 @@ def sharded_pipeline(pipe, mesh, backend: str = "xla"):
     divisible by the shard count by pad-to-multiple + crop (fixing the
     reference's silent `rows / size` truncation, kernel.cu:117).
     """
-    if backend not in ("xla", "pallas"):
+    if backend not in ("xla", "pallas", "auto"):
         raise ValueError(f"unknown backend {backend!r}")
     n = mesh.shape[ROWS]
     max_halo = pipe.max_halo
+    # Static per-op auto decisions, so the vma checker stays on whenever no
+    # Pallas tile can run (pallas_call outputs carry no vma annotations).
+    if backend == "auto":
+        from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
+            use_pallas_for_stencil,
+        )
+
+        any_pallas = any(
+            isinstance(op, StencilOp) and use_pallas_for_stencil(op, 1)
+            for op in pipe.ops
+        )
+    else:
+        any_pallas = backend == "pallas"
 
     def run(img: jnp.ndarray) -> jnp.ndarray:
         global_h, global_w = img.shape[0], img.shape[1]
@@ -146,11 +167,9 @@ def sharded_pipeline(pipe, mesh, backend: str = "xla"):
         out_shape = jax.eval_shape(pipe.apply, img_p)
         in_spec = P(ROWS, *([None] * (img.ndim - 1)))
         out_spec = P(ROWS, *([None] * (len(out_shape.shape) - 1)))
-        # pallas_call outputs don't carry vma annotations, so the varying-
-        # manual-axes checker must be off for that backend only
         out = jax.shard_map(
             tile_fn, mesh=mesh, in_specs=in_spec, out_specs=out_spec,
-            check_vma=(backend != "pallas"),
+            check_vma=not any_pallas,
         )(img_p)
         return out[:global_h]
 

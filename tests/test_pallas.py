@@ -88,6 +88,26 @@ def test_odd_sizes_and_small_blocks(height):
     _assert_pallas_equals_golden(reference_pipeline(), img, block_h=32)
 
 
+@pytest.mark.parametrize(
+    "spec",
+    [
+        "grayscale,contrast:3.5,emboss:3",  # all-XLA under auto (halo 1)
+        "grayscale,gaussian:5,sobel,gray2rgb",  # mixed: pallas gaussian+sobel
+        "gaussian:7",
+        "invert",
+    ],
+)
+def test_pipeline_auto_backend_bitexact(spec):
+    from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import pipeline_auto
+
+    channels = 3 if spec.startswith(("grayscale", "invert")) else 1
+    img = synthetic_image(67, 88, channels=channels, seed=37)
+    pipe = Pipeline.parse(spec)
+    golden = np.asarray(pipe(jnp.asarray(img)))
+    got = np.asarray(pipeline_auto(pipe.ops, jnp.asarray(img), interpret=True))
+    np.testing.assert_array_equal(got, golden)
+
+
 def test_pipeline_jit_pallas_backend():
     img = synthetic_image(64, 96, channels=3, seed=36)
     pipe = reference_pipeline()

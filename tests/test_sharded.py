@@ -83,6 +83,17 @@ def test_too_many_shards_raises():
         pipe.sharded(make_mesh(8))(jnp.asarray(img))
 
 
+@pytest.mark.parametrize("spec", ["grayscale,contrast:3.5,emboss:3", "gaussian:5"])
+def test_sharded_auto_backend_bitexact(spec):
+    img = synthetic_image(
+        131, 96, channels=3 if spec.startswith("grayscale") else 1, seed=29
+    )
+    pipe = Pipeline.parse(spec)
+    golden = np.asarray(pipe(jnp.asarray(img)))
+    sharded = np.asarray(pipe.sharded(make_mesh(8), backend="auto")(jnp.asarray(img)))
+    np.testing.assert_array_equal(sharded, golden)
+
+
 @pytest.mark.parametrize(
     "spec", ["grayscale,contrast:3.5,emboss:3", "gaussian:5", "sobel", "emboss:5"]
 )
