@@ -60,11 +60,9 @@ def _apply_pointwise_planes(op: PointwiseOp, planes: list) -> list:
     """Apply a pointwise op to the plane-decomposed state (f32 planes holding
     exact u8 integer values — Mosaic has no unsigned<->float casts, so the
     whole kernel body stays in f32)."""
-    from mpi_cuda_imagemanipulation_tpu.ops.registry import grayscale_core
-
-    if op.name == "grayscale":
-        assert len(planes) == 3, "grayscale needs 3 channel planes"
-        return [grayscale_core(*planes)]
+    if op.planes_core is not None:  # 3->1 channel-structure ops (grayscales)
+        assert len(planes) == 3, f"{op.name} needs 3 channel planes"
+        return [op.planes_core(*planes)]
     if op.name == "gray2rgb":
         assert len(planes) == 1
         return [planes[0], planes[0], planes[0]]
@@ -238,14 +236,7 @@ def run_group(
         raise ValueError(f"image height {height} too small for halo {h}")
 
     n_in = len(planes)
-    n_out = n_in
-    for op in pointwise:
-        if op.name == "grayscale":
-            n_out = 1
-        elif op.name == "gray2rgb":
-            n_out = 3
-    if stencil is not None:
-        n_out = 1
+    n_out = 1 if stencil is not None else _channels_after(pointwise, n_in)
 
     bh = block_h or _pick_block_h(width, n_in, h)
     padded_h = -(-height // bh) * bh

@@ -59,6 +59,30 @@ def grayscale_u8(img: jnp.ndarray) -> jnp.ndarray:
     return grayscale_from_planes(img[..., 0], img[..., 1], img[..., 2])
 
 
+def grayscale601_core(r: jnp.ndarray, g: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """OpenCV-parity Rec.601 grayscale — the *other* reference variant
+    (kern.cpp:73 cvtColor COLOR_BGR2GRAY), which rounds instead of
+    truncating (SURVEY.md §2.2 notes the two programs disagree).
+
+    Bit-exact to OpenCV's fixed-point formula: (R*4899 + G*9617 + B*1868 +
+    8192) >> 14. All intermediates < 2^22, exact in f32; >>14 is an exact
+    power-of-two multiply + floor.
+    """
+    acc = (
+        r * np.float32(4899.0)
+        + g * np.float32(9617.0)
+        + b * np.float32(1868.0)
+        + np.float32(8192.0)
+    )
+    return jnp.floor(acc * np.float32(1.0 / 16384.0))
+
+
+def grayscale601_u8(img: jnp.ndarray) -> jnp.ndarray:
+    return grayscale601_core(
+        img[..., 0].astype(F32), img[..., 1].astype(F32), img[..., 2].astype(F32)
+    ).astype(U8)
+
+
 def make_contrast_core(factor: float) -> Callable[[jnp.ndarray], jnp.ndarray]:
     """Reference contrast (kernel.cu:49-58): clamp(f*(p-128)+128), truncated.
 
@@ -128,6 +152,22 @@ def make_emboss(size: int) -> StencilOp:
     )
 
 
+def make_emboss101(size: int) -> StencilOp:
+    """The kern.cpp emboss variant (filter2D, kern.cpp:62-75): same filter
+    values but edges ARE filtered with OpenCV's default BORDER_REFLECT_101,
+    and results round to nearest even (cvRound) — SURVEY.md §2.2."""
+    if size not in (3, 5):
+        raise ValueError(f"emboss101 size must be 3 or 5, got {size}")
+    k = filters.EMBOSS3 if size == 3 else filters.EMBOSS5
+    return StencilOp(
+        name=f"emboss101_{size}",
+        halo=(size - 1) // 2,
+        kernels=(k,),
+        edge_mode="reflect101",
+        quantize="rint_clip",
+    )
+
+
 def make_gaussian(size: int) -> StencilOp:
     if size not in (3, 5, 7):
         raise ValueError(f"gaussian size must be 3, 5 or 7, got {size}")
@@ -177,7 +217,20 @@ SHARPEN = StencilOp(
 # Registry
 # --------------------------------------------------------------------------
 
-_GRAYSCALE = PointwiseOp("grayscale", in_channels=3, out_channels=1, fn=grayscale_u8)
+_GRAYSCALE = PointwiseOp(
+    "grayscale",
+    in_channels=3,
+    out_channels=1,
+    fn=grayscale_u8,
+    planes_core=grayscale_core,
+)
+_GRAYSCALE601 = PointwiseOp(
+    "grayscale601",
+    in_channels=3,
+    out_channels=1,
+    fn=grayscale601_u8,
+    planes_core=grayscale601_core,
+)
 _INVERT = pointwise_from_core("invert", 0, 0, invert_core)
 _GRAY2RGB = PointwiseOp("gray2rgb", in_channels=1, out_channels=3, fn=gray2rgb_u8)
 
@@ -194,6 +247,8 @@ def _int_arg(arg: str | None, default: int) -> int:
 REGISTRY: dict[str, Callable[[str | None], Op]] = {
     "grayscale": lambda a: _GRAYSCALE,
     "gray": lambda a: _GRAYSCALE,
+    "grayscale601": lambda a: _GRAYSCALE601,
+    "gray601": lambda a: _GRAYSCALE601,
     "contrast": lambda a: pointwise_from_core(
         f"contrast{_float_arg(a, 3.5):g}",
         1,
@@ -215,6 +270,7 @@ REGISTRY: dict[str, Callable[[str | None], Op]] = {
     ),
     "gray2rgb": lambda a: _GRAY2RGB,
     "emboss": lambda a: make_emboss(_int_arg(a, 3)),  # smallEmboss=true: kernel.cu:195
+    "emboss101": lambda a: make_emboss101(_int_arg(a, 3)),  # kern.cpp variant
     "gaussian": lambda a: make_gaussian(_int_arg(a, 5)),
     "box": lambda a: make_box(_int_arg(a, 3)),
     "sobel": lambda a: SOBEL,

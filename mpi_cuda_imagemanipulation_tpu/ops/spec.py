@@ -157,8 +157,8 @@ class PointwiseOp:
     function over exact u8 integer values (output also exact integers in
     [0, 255]). The u8 `fn` is derived by casting around `core`; Pallas
     kernels call `core` directly on f32 tiles (no unsigned casts in Mosaic).
-    Channel-structure ops (grayscale, gray2rgb) carry core=None and are
-    handled by name at the plane level.
+    3->1 channel-structure ops set `planes_core` instead (consumed by the
+    Pallas planar path); 1->3 replication (gray2rgb) is handled by name.
     """
 
     name: str
@@ -166,6 +166,9 @@ class PointwiseOp:
     out_channels: int  # 3, 1, or 0 (= same as input)
     fn: Callable[[jnp.ndarray], jnp.ndarray]  # u8 -> u8, jnp-traceable
     core: Callable[[jnp.ndarray], jnp.ndarray] | None = None  # f32 -> f32
+    # 3->1 channel-structure ops: (r, g, b) f32 planes -> f32 plane; used by
+    # the Pallas planar path (core handles the elementwise case)
+    planes_core: Callable | None = None
 
     halo: int = 0
 

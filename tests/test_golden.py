@@ -144,6 +144,24 @@ def test_sobel_vertical_edge():
     assert (out[:, :2] == 0).all() and (out[:, 6:] == 0).all()
 
 
+def test_grayscale601_matches_opencv_fixed_point(rgb):
+    # OpenCV's exact integer formula: (R*4899 + G*9617 + B*1868 + 8192) >> 14
+    ours = np.asarray(make_op("grayscale601")(jnp.asarray(rgb)))
+    r, g, b = (rgb[..., c].astype(np.int64) for c in range(3))
+    want = ((r * 4899 + g * 9617 + b * 1868 + 8192) >> 14).astype(np.uint8)
+    np.testing.assert_array_equal(ours, want)
+
+
+def test_emboss101_filters_edges(gray):
+    # kern.cpp variant: borders ARE filtered (reflect-101), unlike emboss
+    op = make_op("emboss101:3")
+    out = np.asarray(op(jnp.asarray(gray)))
+    from mpi_cuda_imagemanipulation_tpu.ops import filters
+
+    want = stencil_reflect101_c(gray, np.asarray(filters.EMBOSS3, dtype=np.int64))
+    np.testing.assert_array_equal(out, want)
+
+
 def test_pointwise_invert_threshold():
     g = np.array([[0, 100, 255]], dtype=np.uint8)
     assert np.asarray(make_op("invert")(jnp.asarray(g))).tolist() == [[255, 155, 0]]

@@ -57,7 +57,7 @@ def test_reference_pipeline_pallas_bitexact():
 @pytest.mark.parametrize(
     "spec",
     ["emboss:3", "emboss:5", "gaussian:3", "gaussian:5", "gaussian:7", "sobel",
-     "box:3", "sharpen"],
+     "box:3", "sharpen", "emboss101:3", "emboss101:5"],
 )
 def test_stencils_pallas_bitexact(spec):
     img = synthetic_image(72, 96, channels=1, seed=31)
@@ -67,6 +67,14 @@ def test_stencils_pallas_bitexact(spec):
 def test_pointwise_only_group():
     img = synthetic_image(64, 80, channels=3, seed=32)
     _assert_pallas_equals_golden("grayscale,contrast:2.0,invert", img)
+
+
+def test_grayscale601_group():
+    img = synthetic_image(56, 72, channels=3, seed=38)
+    _assert_pallas_equals_golden("grayscale601,gaussian:5", img)
+    # pointwise-only group with a 3->1 op (regression: n_out must follow
+    # out_channels, not op names)
+    _assert_pallas_equals_golden("grayscale601,invert", img)
 
 
 def test_rgb_passthrough_pointwise():
