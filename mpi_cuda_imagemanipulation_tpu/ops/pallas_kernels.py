@@ -177,14 +177,15 @@ def _group_kernel(
             out_ref[:] = f32_to_u8(plane)
         return
 
-    assert len(planes) == 1, "stencil ops take a single (grayscale) plane"
-    x = planes[0]  # f32 (exact u8 ints), (block_h + 2h, W + 2h)
-    acc = stencil.valid(x)  # (block_h, W)
+    # stencils filter each plane independently (colour images per channel)
+    assert len(planes) == n_out
     y0 = pl.program_id(0) * block_h
-    orig = x[h : h + block_h, h : h + global_w] if h > 0 else x
-    out_refs[0][:] = f32_to_u8(
-        stencil.finalize_f32(acc, orig, y0, 0, global_h, global_w)
-    )
+    for out_ref, x in zip(out_refs, planes):
+        acc = stencil.valid(x)  # (block_h, W)
+        orig = x[h : h + block_h, h : h + global_w] if h > 0 else x
+        out_ref[:] = f32_to_u8(
+            stencil.finalize_f32(acc, orig, y0, 0, global_h, global_w)
+        )
 
 
 # --------------------------------------------------------------------------
@@ -192,17 +193,19 @@ def _group_kernel(
 # --------------------------------------------------------------------------
 
 
-def _pick_block_h(width: int, n_in: int, halo: int) -> int:
+def _pick_block_h(width: int, n_in: int, n_out: int, halo: int) -> int:
     """Row-block height maximising VMEM use without overflowing it.
 
     Working set per row of block height (measured on v5e — bh=64 compiles
     and is fastest for W≈7.7k, bh=128 overflows): u8 input blocks
     (specs_per_plane per plane, double-buffered by the pipeline) plus ~3
-    live f32 temps of the extended tile.
+    live f32 temps per live plane — colour stencil groups keep all
+    max(n_in, n_out) extended channel planes resident at once.
     """
     budget = 10 * 1024 * 1024
     specs_per_plane = 3 if halo > 0 else 1
-    per_row = width * (specs_per_plane * n_in * 2 + 4 * 3)
+    n_live = max(n_in, n_out)
+    per_row = width * (specs_per_plane * n_in * 2 + 4 * 3 * n_live)
     bh = budget // max(per_row, 1)
     bh = int(max(32, min(512, bh)))
     return (bh // 32) * 32
@@ -222,13 +225,6 @@ def run_group(
             "zero-mode stencils would need post-pointwise padding in the "
             "Pallas path; none exist in the registry"
         )
-    if stencil is not None and _channels_after(pointwise, len(planes)) != 1:
-        # same clean channel error the XLA path raises (the group kernel
-        # would otherwise fail an opaque plane assertion at trace time)
-        raise ValueError(
-            f"op {stencil.name!r} expects a 1-channel image, but the group "
-            f"feeding it produces {_channels_after(pointwise, len(planes))} channels"
-        )
     height, width = planes[0].shape
     h = stencil.halo if stencil is not None else 0
     mode = stencil.edge_mode if stencil is not None else None
@@ -236,9 +232,9 @@ def run_group(
         raise ValueError(f"image height {height} too small for halo {h}")
 
     n_in = len(planes)
-    n_out = 1 if stencil is not None else _channels_after(pointwise, n_in)
+    n_out = _channels_after(pointwise, n_in)
 
-    bh = block_h or _pick_block_h(width, n_in, h)
+    bh = block_h or _pick_block_h(width, n_in, n_out, h)
     padded_h = -(-height // bh) * bh
     grid = (padded_h // bh,)
 
@@ -310,7 +306,7 @@ def stencil_tile_pallas(
     """
     h = op.halo
     local_h, width = ext.shape[0] - 2 * h, ext.shape[1]
-    bh = block_h or _pick_block_h(width, 1, h)
+    bh = block_h or _pick_block_h(width, 1, 1, h)
     padded_h = -(-local_h // bh) * bh
 
     # width extension per op mode (the W axis is never sharded)

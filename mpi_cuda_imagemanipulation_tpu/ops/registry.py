@@ -289,7 +289,9 @@ def make_op(spec: str) -> Op:
 
 def make_pipeline_ops(spec: str) -> tuple[Op, ...]:
     """Parse a comma-separated pipeline string into op instances, validating
-    that channel counts chain (e.g. a stencil op must follow a 1-channel op)."""
+    that channel counts chain (e.g. grayscale — a 3->1 op — cannot follow an
+    op that produces 1 channel; stencils accept any channel count and filter
+    colour images per channel)."""
     ops = tuple(make_op(s) for s in spec.split(",") if s.strip())
     chan = None  # unknown until first op with a fixed requirement
     for op in ops:

@@ -191,7 +191,7 @@ def pointwise_from_core(
 
 @dataclasses.dataclass(frozen=True)
 class StencilOp:
-    """Neighbourhood op over a single-channel (grayscale) image.
+    """Neighbourhood op over a (H, W) plane or per-channel over (H, W, C).
 
     kernels  : static correlation weight matrices, ``w[dy, dx]``.
     separable: optional 1-D weight vector for a bit-identical fast path.
@@ -214,8 +214,8 @@ class StencilOp:
     edge_mode: str = "interior"
     quantize: str = "trunc_clip"
 
-    in_channels: int = 1
-    out_channels: int = 1
+    in_channels: int = 0  # any; colour images filter per channel
+    out_channels: int = 0  # same as input
 
     # -- tile functions (used by every backend) --
 
@@ -289,6 +289,13 @@ class StencilOp:
 
     def __call__(self, img: jnp.ndarray) -> jnp.ndarray:
         _check_channels(self.name, self.in_channels, img)
+        if img.ndim == 3:  # colour: filter each channel plane independently
+            return jnp.stack(
+                [self._apply2d(img[..., c]) for c in range(img.shape[2])], axis=-1
+            )
+        return self._apply2d(img)
+
+    def _apply2d(self, img: jnp.ndarray) -> jnp.ndarray:
         h, w = img.shape
         xpad = pad2d(
             img.astype(F32), self.edge_mode, self.halo, self.halo, self.halo, self.halo

@@ -59,7 +59,8 @@ def _fix_edge_rows(
     if op.edge_mode in ("interior", "zero"):
         # zero out-of-image rows; 'interior' never reads them (masked), but
         # zeroing keeps tile values identical to the golden zero-padded path.
-        return jnp.where(outside[:, None], jnp.zeros_like(ext), ext)
+        outside_b = outside.reshape((-1,) + (1,) * (ext.ndim - 1))
+        return jnp.where(outside_b, jnp.zeros_like(ext), ext)
     if op.edge_mode == "reflect101":
         src_g = _reflect101_index(g, global_h)
     elif op.edge_mode == "edge":
@@ -68,7 +69,8 @@ def _fix_edge_rows(
         raise ValueError(f"unknown edge mode {op.edge_mode!r}")
     src_local = jnp.clip(src_g - (y0 - h), 0, ext_h - 1)
     gathered = jnp.take(ext, src_local, axis=0)
-    return jnp.where(outside[:, None], gathered, ext)
+    outside_b = outside.reshape((-1,) + (1,) * (ext.ndim - 1))
+    return jnp.where(outside_b, gathered, ext)
 
 
 def _apply_stencil(
@@ -86,25 +88,48 @@ def _apply_stencil(
             use_pallas_for_stencil,
         )
 
-        # the sharded runner has no fused prologue: the stencil's tile is
-        # always single-channel, hence group_in_channels=1
+        # the sharded runner has no fused prologue: the stencil kernel is
+        # always run per channel plane, hence group_in_channels=1
         backend = "pallas" if use_pallas_for_stencil(op, 1) else "xla"
+    # halo exchange + global-edge fixup once on the full tile (2-D or HWC) —
+    # on uint8 (dtype-generic gather/where), so colour images pay two
+    # ppermutes total, not two per channel, and Pallas HBM traffic stays u8
+    ext = _fix_edge_rows(exchange_halo(tile, h, n_shards), op, y0, global_h)
+    if tile.ndim == 3:  # colour: filter each channel plane independently
+        return jnp.stack(
+            [
+                _stencil_on_ext(
+                    op, ext[..., c], tile[..., c], y0, global_h, global_w, backend
+                )
+                for c in range(tile.shape[2])
+            ],
+            axis=-1,
+        )
+    return _stencil_on_ext(op, ext, tile, y0, global_h, global_w, backend)
+
+
+def _stencil_on_ext(
+    op: StencilOp,
+    ext: jnp.ndarray,
+    tile: jnp.ndarray,
+    y0: jnp.ndarray,
+    global_h: int,
+    global_w: int,
+    backend: str,
+) -> jnp.ndarray:
+    """Run one stencil over a single (local_h + 2h, W) pre-exchanged plane."""
+    h = op.halo
     if backend == "pallas":
         from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
             stencil_tile_pallas,
         )
 
-        # fixup runs on uint8 (dtype-generic gather/where), keeping the
-        # Pallas kernel's HBM traffic pure-u8
-        ext = _fix_edge_rows(exchange_halo(tile, h, n_shards), op, y0, global_h)
         q = stencil_tile_pallas(op, ext)
         if op.edge_mode != "interior":
             return q
         mask = op.interior_mask(q.shape, y0, 0, global_h, global_w)
         return jnp.where(mask, q, tile)
-    ext = exchange_halo(tile, h, n_shards).astype(F32)
-    ext = _fix_edge_rows(ext, op, y0, global_h)
-    xpad = pad2d(ext, op.edge_mode, 0, 0, h, h)  # width halo is always local
+    xpad = pad2d(ext.astype(F32), op.edge_mode, 0, 0, h, h)  # width halo is local
     acc = op.valid(xpad)
     return op.finalize(acc, tile, y0, 0, global_h, global_w)
 
