@@ -196,6 +196,37 @@ def make_box(size: int) -> StencilOp:
     )
 
 
+def make_morph(kind: str, size: int) -> StencilOp:
+    """Grayscale morphology: erode (window min) / dilate (window max) over a
+    size x size square structuring element. Square min/max is separable, so
+    the cost is O(size) shifts per pixel on every backend."""
+    if size < 3 or size % 2 == 0:
+        raise ValueError(f"{kind} size must be odd and >= 3, got {size}")
+    return StencilOp(
+        name=f"{kind}{size}",
+        halo=(size - 1) // 2,
+        kernels=(np.ones((size, size), np.float32),),
+        reduce="min" if kind == "erode" else "max",
+        edge_mode="edge",  # border-replicate: morphology identity outside
+        quantize="rint_clip",  # identity on the integer-valued min/max result
+    )
+
+
+def make_median(size: int) -> StencilOp:
+    if size != 3:
+        raise ValueError(
+            f"median supports size 3 (median-of-9 selection network), got {size}"
+        )
+    return StencilOp(
+        name="median3",
+        halo=1,
+        kernels=(np.ones((3, 3), np.float32),),
+        reduce="median",
+        edge_mode="reflect101",
+        quantize="rint_clip",
+    )
+
+
 SOBEL = StencilOp(
     name="sobel",
     halo=1,
@@ -275,6 +306,9 @@ REGISTRY: dict[str, Callable[[str | None], Op]] = {
     "box": lambda a: make_box(_int_arg(a, 3)),
     "sobel": lambda a: SOBEL,
     "sharpen": lambda a: SHARPEN,
+    "erode": lambda a: make_morph("erode", _int_arg(a, 3)),
+    "dilate": lambda a: make_morph("dilate", _int_arg(a, 3)),
+    "median": lambda a: make_median(_int_arg(a, 3)),
 }
 
 

@@ -118,6 +118,48 @@ def separable_valid(xpad: jnp.ndarray, w1d: np.ndarray) -> jnp.ndarray:
     return corr_valid(corr_valid(xpad, row), col)
 
 
+def window_reduce_1d(
+    xpad: jnp.ndarray, k: int, axis: int, fn: Callable
+) -> jnp.ndarray:
+    """Valid-mode sliding reduction (min/max) of width k along one axis,
+    via k-1 unrolled static shifts — the same VPU-friendly shape as
+    corr_valid, so it lowers identically inside Pallas kernels."""
+    out_len = xpad.shape[axis] - (k - 1)
+    acc = None
+    for d in range(k):
+        win = lax.slice_in_dim(xpad, d, d + out_len, axis=axis)
+        acc = win if acc is None else fn(acc, win)
+    return acc
+
+
+def _sort2(a: jnp.ndarray, b: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    return jnp.minimum(a, b), jnp.maximum(a, b)
+
+
+# Paeth's 19-exchange median-of-9 selection network: after these exchanges
+# p[4] holds the median. Pure min/max — elementwise, exact on u8-valued f32,
+# and lowers in Mosaic (no sort primitive needed).
+_MEDIAN9_EXCHANGES = (
+    (1, 2), (4, 5), (7, 8), (0, 1), (3, 4), (6, 7), (1, 2), (4, 5), (7, 8),
+    (0, 3), (5, 8), (4, 7), (3, 6), (1, 4), (2, 5), (4, 7), (4, 2), (6, 4),
+    (4, 2),
+)
+
+
+def median9_valid(xpad: jnp.ndarray) -> jnp.ndarray:
+    """Valid-mode 3x3 median via the median-of-9 selection network."""
+    out_h = xpad.shape[0] - 2
+    out_w = xpad.shape[1] - 2
+    p = [
+        xpad[dy : dy + out_h, dx : dx + out_w]
+        for dy in range(3)
+        for dx in range(3)
+    ]
+    for i, j in _MEDIAN9_EXCHANGES:
+        p[i], p[j] = _sort2(p[i], p[j])
+    return p[4]
+
+
 _PAD_MODES = {
     "interior": "constant",  # padding value irrelevant — masked by finalize
     "zero": "constant",
@@ -199,6 +241,11 @@ class StencilOp:
                Gaussians, so exact).
     combine  : 'single' (one kernel) or 'magnitude' (sqrt(a0^2 + a1^2), for
                Sobel).
+    reduce   : 'corr' (weighted-sum correlation, the default), 'min'/'max'
+               (morphological erode/dilate over a square window — computed
+               separably), or 'median' (3x3 rank filter via a selection
+               network). Non-'corr' modes use kernels[0].shape for the
+               window and ignore the weight values.
     edge_mode: 'interior' replicates the reference guard (kernel.cu:83) —
                non-interior pixels pass through the input unchanged; the
                others filter every pixel with the named border extension.
@@ -211,6 +258,7 @@ class StencilOp:
     scale: float = 1.0
     separable: np.ndarray | None = None
     combine: str = "single"
+    reduce: str = "corr"
     edge_mode: str = "interior"
     quantize: str = "trunc_clip"
 
@@ -221,6 +269,15 @@ class StencilOp:
 
     def valid(self, xpad: jnp.ndarray) -> jnp.ndarray:
         """float32 (H+2h, W+2h) -> float32 (H, W): correlate + combine + scale."""
+        if self.reduce in ("min", "max"):
+            fn = jnp.minimum if self.reduce == "min" else jnp.maximum
+            kh, kw = self.kernels[0].shape
+            # square-window min/max is separable: rows pass then columns pass
+            return window_reduce_1d(
+                window_reduce_1d(xpad, kw, 1, fn), kh, 0, fn
+            )
+        if self.reduce == "median":
+            return median9_valid(xpad)
         if self.separable is not None:
             accs = [separable_valid(xpad, self.separable)]
         else:
