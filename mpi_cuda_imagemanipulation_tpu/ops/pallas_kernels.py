@@ -49,6 +49,13 @@ def group_ops(ops) -> list[tuple[list[PointwiseOp], StencilOp | None]]:
         if isinstance(op, StencilOp):
             groups.append((pointwise, op))
             pointwise = []
+        elif not op.kernel_safe:
+            # LUT-style ops can't lower in Mosaic: flush the running group
+            # and emit the op as its own XLA-side group
+            if pointwise:
+                groups.append((pointwise, None))
+                pointwise = []
+            groups.append(([op], None))
         else:
             pointwise.append(op)
     if pointwise:
@@ -60,9 +67,10 @@ def _apply_pointwise_planes(op: PointwiseOp, planes: list) -> list:
     """Apply a pointwise op to the plane-decomposed state (f32 planes holding
     exact u8 integer values — Mosaic has no unsigned<->float casts, so the
     whole kernel body stays in f32)."""
-    if op.planes_core is not None:  # 3->1 channel-structure ops (grayscales)
+    if op.planes_core is not None:  # channel-structure ops (3->1 or 3->3)
         assert len(planes) == 3, f"{op.name} needs 3 channel planes"
-        return [op.planes_core(*planes)]
+        out = op.planes_core(*planes)
+        return list(out) if isinstance(out, (list, tuple)) else [out]
     if op.name == "gray2rgb":
         assert len(planes) == 1
         return [planes[0], planes[0], planes[0]]
@@ -220,6 +228,18 @@ def run_group(
     block_h: int | None = None,
 ) -> list[jnp.ndarray]:
     """Execute one [pointwise*, stencil?] group as a single pallas_call."""
+    if (
+        stencil is None
+        and len(pointwise) == 1
+        and not pointwise[0].kernel_safe
+    ):
+        # LUT-style op: runs as a plain XLA step on the plane-stacked image
+        op = pointwise[0]
+        state = planes[0] if len(planes) == 1 else jnp.stack(planes, axis=-1)
+        out = op.fn(state)
+        if out.ndim == 3:
+            return [out[..., c] for c in range(out.shape[2])]
+        return [out]
     if stencil is not None and stencil.edge_mode == "zero":
         raise NotImplementedError(
             "zero-mode stencils would need post-pointwise padding in the "

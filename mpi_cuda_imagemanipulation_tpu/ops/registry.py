@@ -122,6 +122,90 @@ def make_threshold_core(t: float) -> Callable[[jnp.ndarray], jnp.ndarray]:
     return threshold
 
 
+def make_gamma_lut(g: float) -> np.ndarray:
+    """256-entry gamma table computed on the host in float64 — exact and
+    backend-independent (f32 pow differs in ulps between CPU libm and the
+    TPU VPU, which would break the cross-backend bit-exactness guarantee)."""
+    if g <= 0:
+        raise ValueError(f"gamma must be > 0, got {g}")
+    v = np.arange(256, dtype=np.float64) / 255.0
+    return np.rint(255.0 * np.power(v, g)).astype(np.uint8)
+
+
+def make_lut_op(name: str, table: np.ndarray) -> PointwiseOp:
+    """Pointwise op applying a 256-entry u8 lookup table via gather.
+
+    kernel_safe=False: Mosaic has no general gather, so LUT ops run as XLA
+    steps between Pallas groups (group_ops splits around them); XLA lowers
+    the 256-entry take to a cheap dynamic-slice/select chain.
+    """
+    t = jnp.asarray(table)
+
+    def fn(img: jnp.ndarray) -> jnp.ndarray:
+        return jnp.take(t, img.astype(jnp.int32))
+
+    return PointwiseOp(name, 0, 0, fn=fn, kernel_safe=False)
+
+
+# Standard sepia tone matrix (as used by e.g. Microsoft/ImageMagick docs),
+# stored x1000 as integers: integer multiply-accumulate is exact in f32
+# (sums < 2**24), so the accumulation is immune to fma contraction and
+# reordering across backends; the single 0.001 scale is one exactly-rounded
+# op — deterministic everywhere. (Non-integer weights summed in f32 are NOT:
+# XLA's fma fusion changed rounding at exactly-.5 boundaries in testing.)
+SEPIA_MATRIX_X1000 = np.array(
+    [
+        [393, 769, 189],
+        [349, 686, 168],
+        [272, 534, 131],
+    ],
+    dtype=np.float32,
+)
+
+
+def sepia_planes_core(r: jnp.ndarray, g: jnp.ndarray, b: jnp.ndarray):
+    from mpi_cuda_imagemanipulation_tpu.ops.spec import rint_clip_f32
+
+    m = SEPIA_MATRIX_X1000
+    scale = np.float32(0.001)
+    return [
+        rint_clip_f32((r * m[i, 0] + g * m[i, 1] + b * m[i, 2]) * scale)
+        for i in range(3)
+    ]
+
+
+def sepia_u8(img: jnp.ndarray) -> jnp.ndarray:
+    planes = sepia_planes_core(
+        img[..., 0].astype(F32), img[..., 1].astype(F32), img[..., 2].astype(F32)
+    )
+    return jnp.stack([p.astype(U8) for p in planes], axis=-1)
+
+
+def make_posterize_core(bits: int) -> Callable[[jnp.ndarray], jnp.ndarray]:
+    """PIL-parity posterize: keep the top `bits` bits ((x >> s) << s), as an
+    exact f32 floor-multiply."""
+    if not 1 <= bits <= 8:
+        raise ValueError(f"posterize bits must be in [1, 8], got {bits}")
+    step = np.float32(float(2 ** (8 - bits)))
+
+    def posterize(x: jnp.ndarray) -> jnp.ndarray:
+        return jnp.floor(x / step) * step
+
+    return posterize
+
+
+def make_solarize_core(t: float) -> Callable[[jnp.ndarray], jnp.ndarray]:
+    """PIL-parity solarize: invert every pixel >= threshold."""
+    if not 0 <= t <= 255:
+        raise ValueError(f"solarize threshold must be in [0, 255], got {t}")
+    tv = np.float32(t)
+
+    def solarize(x: jnp.ndarray) -> jnp.ndarray:
+        return jnp.where(x >= tv, np.float32(255.0) - x, x)
+
+    return solarize
+
+
 def gray2rgb_u8(img: jnp.ndarray) -> jnp.ndarray:
     """Channel-replicate, the reference's GRAY2BGR step (kernel.cu:210)."""
     return jnp.broadcast_to(img[..., None], (*img.shape, 3))
@@ -264,6 +348,13 @@ _GRAYSCALE601 = PointwiseOp(
 )
 _INVERT = pointwise_from_core("invert", 0, 0, invert_core)
 _GRAY2RGB = PointwiseOp("gray2rgb", in_channels=1, out_channels=3, fn=gray2rgb_u8)
+_SEPIA = PointwiseOp(
+    "sepia",
+    in_channels=3,
+    out_channels=3,
+    fn=sepia_u8,
+    planes_core=sepia_planes_core,
+)
 
 
 def _float_arg(arg: str | None, default: float) -> float:
@@ -306,6 +397,16 @@ REGISTRY: dict[str, Callable[[str | None], Op]] = {
     "box": lambda a: make_box(_int_arg(a, 3)),
     "sobel": lambda a: SOBEL,
     "sharpen": lambda a: SHARPEN,
+    "gamma": lambda a: make_lut_op(
+        f"gamma{_float_arg(a, 1.0):g}", make_gamma_lut(_float_arg(a, 1.0))
+    ),
+    "sepia": lambda a: _SEPIA,
+    "posterize": lambda a: pointwise_from_core(
+        f"posterize{_int_arg(a, 4)}", 0, 0, make_posterize_core(_int_arg(a, 4))
+    ),
+    "solarize": lambda a: pointwise_from_core(
+        f"solarize{_float_arg(a, 128):g}", 0, 0, make_solarize_core(_float_arg(a, 128))
+    ),
     "erode": lambda a: make_morph("erode", _int_arg(a, 3)),
     "dilate": lambda a: make_morph("dilate", _int_arg(a, 3)),
     "median": lambda a: make_median(_int_arg(a, 3)),

@@ -208,9 +208,13 @@ class PointwiseOp:
     out_channels: int  # 3, 1, or 0 (= same as input)
     fn: Callable[[jnp.ndarray], jnp.ndarray]  # u8 -> u8, jnp-traceable
     core: Callable[[jnp.ndarray], jnp.ndarray] | None = None  # f32 -> f32
-    # 3->1 channel-structure ops: (r, g, b) f32 planes -> f32 plane; used by
+    # channel-structure ops: (r, g, b) f32 planes -> f32 plane or a
+    # list/tuple of planes (3->1 grayscales, 3->3 colour matrices); used by
     # the Pallas planar path (core handles the elementwise case)
     planes_core: Callable | None = None
+    # False for ops whose body cannot lower inside a Mosaic kernel (e.g.
+    # LUT ops built on gather); they run as XLA steps between Pallas groups
+    kernel_safe: bool = True
 
     halo: int = 0
 
