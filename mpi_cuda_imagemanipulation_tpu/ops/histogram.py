@@ -1,0 +1,131 @@
+"""Global-statistics ops: histogram, equalization, autocontrast, Otsu.
+
+The reference computes no image statistics whatsoever (its three kernels are
+all local, kernel.cu:31-94); these ops add the classic histogram toolkit,
+designed around the framework's sharded-execution invariant:
+
+every op is decomposed into an *additive* statistic plus a pointwise apply
+(see ``GlobalOp`` in ops/spec.py). The statistic is a 256-bin int32
+histogram — exact integer counts (f32 would lose exactness past 2^24
+pixels; an 8K frame already has 33M), summable across shards with one
+``lax.psum``. The LUT derived from it uses only f64-free f32 arithmetic on
+exact integers, so sharded and unsharded paths build bit-identical LUTs.
+
+All ops operate on single-channel (grayscale) images, like OpenCV's
+``equalizeHist``; run ``grayscale`` first for colour inputs.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from mpi_cuda_imagemanipulation_tpu.ops.spec import F32, U8, GlobalOp
+
+BINS = 256
+
+
+def histogram_stats(img: jnp.ndarray, valid: jnp.ndarray | None) -> jnp.ndarray:
+    """int32[256] pixel-value counts; `valid` (broadcastable to img, 0/1)
+    masks rows that are sharding padding, not image content."""
+    idx = img.astype(jnp.int32).ravel()
+    if valid is None:
+        weights = None
+    else:
+        weights = jnp.broadcast_to(valid.astype(jnp.int32), img.shape).ravel()
+    return jnp.bincount(idx, weights=weights, length=BINS).astype(jnp.int32)
+
+
+def _lut_apply(img: jnp.ndarray, lut_f32: jnp.ndarray) -> jnp.ndarray:
+    """Apply an f32[256] LUT holding exact u8 integer values."""
+    return jnp.take(lut_f32, img.astype(jnp.int32)).astype(U8)
+
+
+# --------------------------------------------------------------------------
+# Equalize (cv::equalizeHist semantics)
+# --------------------------------------------------------------------------
+
+
+def equalize_apply(img: jnp.ndarray, hist: jnp.ndarray) -> jnp.ndarray:
+    """lut[i] = round((cdf(i) - cdf_min) / (N - cdf_min) * 255), where
+    cdf_min is the CDF at the lowest occupied bin — OpenCV's equalizeHist
+    formula. Constant images (denominator 0) pass through unchanged."""
+    cdf = jnp.cumsum(hist)  # int32, exact
+    total = cdf[-1]
+    # cdf value at the first nonzero bin == min over occupied bins of cdf
+    cdf_min = jnp.min(jnp.where(hist > 0, cdf, total))
+    denom = (total - cdf_min).astype(F32)
+    scaled = (cdf - cdf_min).astype(F32) * (np.float32(255.0) / denom)
+    lut = jnp.clip(jnp.rint(scaled), 0.0, 255.0)
+    ident = jnp.arange(BINS, dtype=F32)
+    lut = jnp.where(denom > 0, lut, ident)
+    return _lut_apply(img, lut)
+
+
+EQUALIZE = GlobalOp(
+    "equalize", stats=histogram_stats, apply=equalize_apply
+)
+
+
+# --------------------------------------------------------------------------
+# Autocontrast (linear stretch of the occupied range to [0, 255])
+# --------------------------------------------------------------------------
+
+
+def autocontrast_apply(img: jnp.ndarray, hist: jnp.ndarray) -> jnp.ndarray:
+    occupied = hist > 0
+    bins = jnp.arange(BINS, dtype=jnp.int32)
+    lo = jnp.min(jnp.where(occupied, bins, BINS)).astype(F32)
+    hi = jnp.max(jnp.where(occupied, bins, -1)).astype(F32)
+    span = hi - lo
+    ident = jnp.arange(BINS, dtype=F32)
+    scaled = (ident - lo) * (np.float32(255.0) / span)
+    lut = jnp.clip(jnp.rint(scaled), 0.0, 255.0)
+    lut = jnp.where(span > 0, lut, ident)
+    return _lut_apply(img, lut)
+
+
+AUTOCONTRAST = GlobalOp(
+    "autocontrast", stats=histogram_stats, apply=autocontrast_apply
+)
+
+
+# --------------------------------------------------------------------------
+# Otsu threshold
+# --------------------------------------------------------------------------
+
+
+def otsu_threshold_from_hist(hist: jnp.ndarray) -> jnp.ndarray:
+    """Otsu's method: the threshold t maximising between-class variance
+    w0(t)·w1(t)·(mu0(t) - mu1(t))^2, pixels <= t in class 0. Class counts
+    use exact int32 cumulative sums (total pixels < 2^31); the weighted
+    moments would overflow int32 (255 · 33M for an 8K frame) and JAX
+    disables x64 by default, so they run in f32 — not bit-exact vs a big
+    integer, but *deterministic*: the sharded path psums the integer
+    histogram first and then evaluates this same replicated computation, so
+    sharded == unsharded exactly."""
+    h = hist.astype(jnp.int32)
+    bins = jnp.arange(BINS, dtype=jnp.int32)
+    w0 = jnp.cumsum(h)  # pixels <= t, exact
+    total = w0[-1]
+    # per-bin product already overflows int32 (count*bin <= 33M*255), so
+    # cast each factor first; f32 cumsum is deterministic (see above)
+    s0 = jnp.cumsum(h.astype(F32) * bins.astype(F32))
+    stotal = s0[-1]
+    w1 = total - w0
+    valid = (w0 > 0) & (w1 > 0)
+    mu0 = s0 / jnp.maximum(w0, 1).astype(F32)
+    mu1 = (stotal - s0) / jnp.maximum(w1, 1).astype(F32)
+    d = mu0 - mu1
+    between = w0.astype(F32) * w1.astype(F32) * d * d
+    between = jnp.where(valid, between, -1.0)
+    # jnp.argmax returns the FIRST maximising bin -> deterministic tie-break
+    return jnp.argmax(between).astype(jnp.int32)
+
+
+def otsu_apply(img: jnp.ndarray, hist: jnp.ndarray) -> jnp.ndarray:
+    t = otsu_threshold_from_hist(hist)
+    return jnp.where(img.astype(jnp.int32) > t, np.uint8(255), np.uint8(0)).astype(U8)
+
+
+OTSU = GlobalOp("otsu", stats=histogram_stats, apply=otsu_apply)

@@ -236,7 +236,7 @@ def run_group(
         # LUT-style op: runs as a plain XLA step on the plane-stacked image
         op = pointwise[0]
         state = planes[0] if len(planes) == 1 else jnp.stack(planes, axis=-1)
-        out = op.fn(state)
+        out = op(state)  # __call__, so channel validation matches other backends
         if out.ndim == 3:
             return [out[..., c] for c in range(out.shape[2])]
         return [out]

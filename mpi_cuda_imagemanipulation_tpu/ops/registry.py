@@ -13,7 +13,7 @@ from typing import Callable
 import jax.numpy as jnp
 import numpy as np
 
-from mpi_cuda_imagemanipulation_tpu.ops import filters
+from mpi_cuda_imagemanipulation_tpu.ops import filters, geometry, histogram
 from mpi_cuda_imagemanipulation_tpu.ops.spec import (
     F32,
     U8,
@@ -410,7 +410,65 @@ REGISTRY: dict[str, Callable[[str | None], Op]] = {
     "erode": lambda a: make_morph("erode", _int_arg(a, 3)),
     "dilate": lambda a: make_morph("dilate", _int_arg(a, 3)),
     "median": lambda a: make_median(_int_arg(a, 3)),
+    # geometric (ops/geometry.py) — beyond-parity; the reference has none
+    "fliph": lambda a: geometry.FLIP_H,
+    "mirror": lambda a: geometry.FLIP_H,
+    "flipv": lambda a: geometry.FLIP_V,
+    "flip": lambda a: geometry.FLIP_V,
+    "transpose": lambda a: geometry.TRANSPOSE,
+    "rot": lambda a: geometry.make_rot90(_int_arg(a, 90)),
+    "rot90": lambda a: geometry.ROT90,
+    "rot180": lambda a: geometry.ROT180,
+    "rot270": lambda a: geometry.ROT270,
+    "crop": lambda a: _parse_crop(a),
+    "pad": lambda a: _parse_pad(a),
+    "resize": lambda a: _parse_resize(a),
+    "scale": lambda a: _parse_scale(a),
+    # global-statistics (ops/histogram.py) — psum-combined histograms
+    "equalize": lambda a: histogram.EQUALIZE,
+    "autocontrast": lambda a: histogram.AUTOCONTRAST,
+    "otsu": lambda a: histogram.OTSU,
 }
+
+
+def _parse_crop(arg: str | None):
+    parts = (arg or "").split(":")
+    if len(parts) != 4:
+        raise ValueError("crop needs crop:y0:x0:height:width")
+    y0, x0, h, w = (int(p) for p in parts)
+    return geometry.make_crop(y0, x0, h, w)
+
+
+def _parse_pad(arg: str | None):
+    parts = (arg or "").split(":") if arg else []
+    if not parts or not parts[0]:
+        raise ValueError("pad needs pad:N or pad:N:mode")
+    n = int(parts[0])
+    mode = parts[1] if len(parts) > 1 else "zero"
+    return geometry.make_pad(n, mode)
+
+
+def _parse_size(size: str) -> tuple[int, int]:
+    h, _, w = size.lower().partition("x")
+    return int(h), int(w)
+
+
+def _parse_resize(arg: str | None):
+    parts = (arg or "").split(":")
+    if not parts or not parts[0]:
+        raise ValueError("resize needs resize:HxW or resize:HxW:nearest")
+    h, w = _parse_size(parts[0])
+    method = parts[1] if len(parts) > 1 else "bilinear"
+    return geometry.make_resize(h, w, method)
+
+
+def _parse_scale(arg: str | None):
+    parts = (arg or "").split(":")
+    if not parts or not parts[0]:
+        raise ValueError("scale needs scale:F or scale:F:nearest")
+    factor = float(parts[0])
+    method = parts[1] if len(parts) > 1 else "bilinear"
+    return geometry.make_scale(factor, method)
 
 
 def make_op(spec: str) -> Op:

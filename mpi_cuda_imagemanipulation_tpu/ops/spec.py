@@ -364,7 +364,77 @@ class StencilOp:
         return self.finalize(self.valid(xpad), img, 0, 0, h, w)
 
 
-Op = PointwiseOp | StencilOp
+@dataclasses.dataclass(frozen=True)
+class GeometricOp:
+    """Shape-changing data-movement op (flip / rotate / transpose / crop /
+    pad / resize).
+
+    The reference has no geometric ops at all; these extend the framework
+    beyond parity. `fn` is the single source of truth for every backend:
+    pure gathers + (for resize) a fixed two-tap lerp whose indices and
+    weights are precomputed host-side in float64 — so execution is exact
+    data movement plus deterministic f32 elementwise math, and the sharded
+    path (which runs the *same* `fn` under a sharding constraint, letting
+    XLA insert the collectives) is bit-identical to the golden path.
+
+    In the Pallas pipeline these run as their own XLA step between fused
+    group kernels (`kernel_safe=False`, like the LUT ops) — data movement
+    is XLA's job; Mosaic kernels keep static block shapes.
+    """
+
+    name: str
+    fn: Callable[[jnp.ndarray], jnp.ndarray]  # u8 -> u8, shape may change
+    in_channels: int = 0
+    out_channels: int = 0
+    halo: int = 0
+    kernel_safe: bool = False
+    core: Callable | None = None
+
+    def __call__(self, img: jnp.ndarray) -> jnp.ndarray:
+        _check_channels(self.name, self.in_channels, img)
+        return self.fn(img)
+
+
+@dataclasses.dataclass(frozen=True)
+class GlobalOp:
+    """Op whose per-pixel transform depends on a full-image statistic
+    (histogram equalization, autocontrast, Otsu threshold).
+
+    Split into two pure pieces so every backend composes them the same way:
+
+      stats(img, valid) -> int32[stat_size]   per-pixel contributions summed
+                                              over the image; `valid` masks
+                                              rows that are padding (sharded
+                                              pad-to-multiple rows must not
+                                              pollute the histogram)
+      apply(img, stats) -> u8 image           pointwise given the statistic
+
+    The decomposition is chosen to be *additive*: sharded execution computes
+    local masked stats and combines them with one `lax.psum` over the mesh
+    axis — integer counts, so the combined statistic (and therefore the
+    output) is bit-identical to the unsharded path. This is the framework's
+    MPI_Allreduce analogue; the reference has no reduction collective at
+    all (SURVEY.md §2.3 lists only Bcast/Scatter/Gather/Barrier).
+    """
+
+    name: str
+    stats: Callable  # (u8 img, valid mask or None) -> int32 vector
+    apply: Callable  # (u8 img, int32 stats) -> u8 img
+    in_channels: int = 1
+    out_channels: int = 0
+    halo: int = 0
+    kernel_safe: bool = False
+    core: Callable | None = None
+
+    def fn(self, img: jnp.ndarray) -> jnp.ndarray:
+        return self.apply(img, self.stats(img, None))
+
+    def __call__(self, img: jnp.ndarray) -> jnp.ndarray:
+        _check_channels(self.name, self.in_channels, img)
+        return self.fn(img)
+
+
+Op = PointwiseOp | StencilOp | GeometricOp | GlobalOp
 
 
 def _check_channels(name: str, want: int, img: jnp.ndarray) -> None:

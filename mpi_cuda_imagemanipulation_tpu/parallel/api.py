@@ -23,6 +23,8 @@ from jax.sharding import PartitionSpec as P
 
 from mpi_cuda_imagemanipulation_tpu.ops.spec import (
     F32,
+    GeometricOp,
+    GlobalOp,
     PointwiseOp,
     StencilOp,
     pad2d,
@@ -134,17 +136,99 @@ def _stencil_on_ext(
     return op.finalize(acc, tile, y0, 0, global_h, global_w)
 
 
+def _split_segments(ops):
+    """Partition an op sequence into shard_map segments separated by
+    geometric (shape-changing) steps.
+
+    Pointwise, stencil and global ops run *inside* shard_map on local tiles
+    (stencils with ppermute halos, global ops with a psum of their masked
+    statistics). Geometric ops are pure data movement with data-dependent
+    output shapes; they run between segments at the jit level under a
+    row-sharding constraint — the scaling-book recipe: annotate the
+    sharding, let XLA insert the collective permutes/gathers it needs.
+    """
+    segments: list[tuple[str, tuple]] = []
+    cur: list = []
+    for op in ops:
+        if isinstance(op, GeometricOp):
+            if cur:
+                segments.append(("shard_map", tuple(cur)))
+                cur = []
+            segments.append(("xla", (op,)))
+        else:
+            cur.append(op)
+    if cur:
+        segments.append(("shard_map", tuple(cur)))
+    return segments
+
+
+def _run_segment(ops, mesh, backend: str, any_pallas: bool, img: jnp.ndarray):
+    """One shard_map region: pad-to-multiple, halo-exchanged local compute,
+    crop. Fixes the reference's silent `rows / size` truncation
+    (kernel.cu:117) by padding and cropping instead of dropping rows."""
+    n = mesh.shape[ROWS]
+    max_halo = max((op.halo for op in ops), default=0)
+    global_h, global_w = img.shape[0], img.shape[1]
+    padded_h = -(-global_h // n) * n
+    pad = padded_h - global_h
+    local_h = padded_h // n
+    # Static feasibility of local edge fixups: every reflect/pad source row
+    # must live on-shard.
+    min_local = max(2 * pad + 1, pad + max_halo, max_halo)
+    if local_h < min_local:
+        raise ValueError(
+            f"image height {global_h} over {n} shards gives {local_h} "
+            f"rows/shard, below the minimum {min_local} for halo "
+            f"{max_halo} and padding {pad}; use fewer shards"
+        )
+    if pad:
+        img_p = jnp.pad(img, ((0, pad),) + ((0, 0),) * (img.ndim - 1))
+    else:
+        img_p = img
+
+    def tile_fn(tile):
+        y0 = lax.axis_index(ROWS) * local_h
+        for op in ops:
+            if isinstance(op, PointwiseOp):
+                tile = op.fn(tile)
+            elif isinstance(op, GlobalOp):
+                # additive statistic over valid (non-padding) rows, combined
+                # across shards with one psum — the MPI_Allreduce analogue
+                rows = y0 + lax.broadcasted_iota(jnp.int32, (tile.shape[0], 1), 0)
+                valid = (rows < global_h).reshape(
+                    (tile.shape[0],) + (1,) * (tile.ndim - 1)
+                )
+                stats = lax.psum(op.stats(tile, valid), ROWS)
+                tile = op.apply(tile, stats)
+            else:
+                tile = _apply_stencil(
+                    op, tile, y0, global_h, global_w, n, backend=backend
+                )
+        return tile
+
+    def seq(x):
+        for op in ops:
+            x = op(x)
+        return x
+
+    out_shape = jax.eval_shape(seq, img_p)
+    in_spec = P(ROWS, *([None] * (img.ndim - 1)))
+    out_spec = P(ROWS, *([None] * (len(out_shape.shape) - 1)))
+    out = jax.shard_map(
+        tile_fn, mesh=mesh, in_specs=in_spec, out_specs=out_spec,
+        check_vma=not any_pallas,
+    )(img_p)
+    return out[:global_h]
+
+
 def sharded_pipeline(pipe, mesh, backend: str = "xla"):
     """Compile `pipe` to run row-sharded over `mesh` with halo exchange.
 
-    Returns a jitted (H, W[, 3]) uint8 -> uint8 function. Handles H not
-    divisible by the shard count by pad-to-multiple + crop (fixing the
-    reference's silent `rows / size` truncation, kernel.cu:117).
+    Returns a jitted (H, W[, 3]) uint8 -> uint8 function, bit-identical to
+    the unsharded golden path (tests/test_sharded.py).
     """
     if backend not in ("xla", "pallas", "auto"):
         raise ValueError(f"unknown backend {backend!r}")
-    n = mesh.shape[ROWS]
-    max_halo = pipe.max_halo
     # Static per-op auto decisions, so the vma checker stays on whenever no
     # Pallas tile can run (pallas_call outputs carry no vma annotations).
     if backend == "auto":
@@ -158,44 +242,20 @@ def sharded_pipeline(pipe, mesh, backend: str = "xla"):
         )
     else:
         any_pallas = backend == "pallas"
+    segments = _split_segments(pipe.ops)
 
     def run(img: jnp.ndarray) -> jnp.ndarray:
-        global_h, global_w = img.shape[0], img.shape[1]
-        padded_h = -(-global_h // n) * n
-        pad = padded_h - global_h
-        local_h = padded_h // n
-        # Static feasibility of local edge fixups (see parallel/api.py
-        # docstrings): every reflect/pad source row must live on-shard.
-        min_local = max(2 * pad + 1, pad + max_halo, max_halo)
-        if local_h < min_local:
-            raise ValueError(
-                f"image height {global_h} over {n} shards gives {local_h} "
-                f"rows/shard, below the minimum {min_local} for halo "
-                f"{max_halo} and padding {pad}; use fewer shards"
-            )
-        if pad:
-            img_p = jnp.pad(img, ((0, pad),) + ((0, 0),) * (img.ndim - 1))
-        else:
-            img_p = img
+        from jax.sharding import NamedSharding
 
-        def tile_fn(tile):
-            y0 = lax.axis_index(ROWS) * local_h
-            for op in pipe.ops:
-                if isinstance(op, PointwiseOp):
-                    tile = op.fn(tile)
-                else:
-                    tile = _apply_stencil(
-                        op, tile, y0, global_h, global_w, n, backend=backend
-                    )
-            return tile
-
-        out_shape = jax.eval_shape(pipe.apply, img_p)
-        in_spec = P(ROWS, *([None] * (img.ndim - 1)))
-        out_spec = P(ROWS, *([None] * (len(out_shape.shape) - 1)))
-        out = jax.shard_map(
-            tile_fn, mesh=mesh, in_specs=in_spec, out_specs=out_spec,
-            check_vma=not any_pallas,
-        )(img_p)
-        return out[:global_h]
+        for kind, ops in segments:
+            if kind == "xla":
+                img = ops[0].fn(img)
+                img = lax.with_sharding_constraint(
+                    img,
+                    NamedSharding(mesh, P(ROWS, *([None] * (img.ndim - 1)))),
+                )
+            else:
+                img = _run_segment(ops, mesh, backend, any_pallas, img)
+        return img
 
     return jax.jit(run)

@@ -1,0 +1,199 @@
+"""Geometric ops: numpy oracles, registry parsing, backend and sharded
+bit-exactness. The reference has no geometric ops (beyond-parity surface);
+correctness is defined against numpy data movement and an independently
+written float32 two-tap resize oracle."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_cuda_imagemanipulation_tpu.io.image import synthetic_image
+from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline
+from mpi_cuda_imagemanipulation_tpu.ops import geometry
+from mpi_cuda_imagemanipulation_tpu.ops.registry import make_op
+from mpi_cuda_imagemanipulation_tpu.parallel.mesh import make_mesh
+
+
+def _taps(in_len: int, out_len: int):
+    centers = (np.arange(out_len, dtype=np.float64) + 0.5) * (in_len / out_len) - 0.5
+    lo = np.floor(centers)
+    w1 = np.rint((centers - lo) * 256.0)
+    return (
+        np.clip(lo, 0, in_len - 1).astype(np.int32),
+        np.clip(lo + 1, 0, in_len - 1).astype(np.int32),
+        w1,
+    )
+
+
+def _np_resize_bilinear(img: np.ndarray, th: int, tw: int) -> np.ndarray:
+    """Independent integer-exact oracle: 4-tap, 8-bit fixed-point weights
+    (the scheme ops/geometry.py uses so the whole sum is exact in f32),
+    evaluated here in plain int64 — no float arithmetic at all."""
+    if (th, tw) == img.shape[:2]:
+        return img.copy()
+    ylo, yhi, wy1 = _taps(img.shape[0], th)
+    xlo, xhi, wx1 = _taps(img.shape[1], tw)
+    x = img.astype(np.int64)
+    wy1 = wy1.astype(np.int64).reshape((th, 1) + (1,) * (img.ndim - 2))
+    wx1 = wx1.astype(np.int64).reshape((1, tw) + (1,) * (img.ndim - 2))
+    wy0, wx0 = 256 - wy1, 256 - wx1
+    acc = (
+        x[ylo][:, xlo] * wy0 * wx0
+        + x[ylo][:, xhi] * wy0 * wx1
+        + x[yhi][:, xlo] * wy1 * wx0
+        + x[yhi][:, xhi] * wy1 * wx1
+    )
+    # round-half-to-even of acc / 2^16, matching rint in the op
+    q = acc >> 16
+    rem = acc & 0xFFFF
+    round_up = (rem > 0x8000) | ((rem == 0x8000) & (q & 1 == 1))
+    return np.clip(q + round_up, 0, 255).astype(np.uint8)
+
+
+def _np_resize_nearest(img: np.ndarray, th: int, tw: int) -> np.ndarray:
+    ys = np.clip(
+        np.floor((np.arange(th) + 0.5) * (img.shape[0] / th)), 0, img.shape[0] - 1
+    ).astype(np.int32)
+    xs = np.clip(
+        np.floor((np.arange(tw) + 0.5) * (img.shape[1] / tw)), 0, img.shape[1] - 1
+    ).astype(np.int32)
+    return img[ys][:, xs]
+
+
+@pytest.mark.parametrize("channels", [1, 3])
+def test_flips_rots_transpose_vs_numpy(channels):
+    img = synthetic_image(37, 53, channels=channels, seed=40)
+    cases = {
+        "fliph": img[:, ::-1],
+        "flipv": img[::-1],
+        "transpose": np.swapaxes(img, 0, 1),
+        "rot90": np.rot90(img, k=-1, axes=(0, 1)),
+        "rot180": np.rot90(img, k=2, axes=(0, 1)),
+        "rot270": np.rot90(img, k=1, axes=(0, 1)),
+    }
+    for name, want in cases.items():
+        got = np.asarray(make_op(name)(jnp.asarray(img)))
+        np.testing.assert_array_equal(got, want, err_msg=name)
+
+
+def test_rot_by_angle_and_composition():
+    img = synthetic_image(20, 31, channels=3, seed=41)
+    j = jnp.asarray(img)
+    assert np.array_equal(make_op("rot:90")(j), make_op("rot90")(j))
+    # four quarter turns are the identity
+    out = j
+    for _ in range(4):
+        out = geometry.ROT90(out)
+    np.testing.assert_array_equal(np.asarray(out), img)
+    with pytest.raises(ValueError):
+        make_op("rot:45")
+
+
+def test_crop_and_pad():
+    img = synthetic_image(40, 50, channels=3, seed=42)
+    j = jnp.asarray(img)
+    got = np.asarray(make_op("crop:5:7:20:30")(j))
+    np.testing.assert_array_equal(got, img[5:25, 7:37])
+    with pytest.raises(ValueError):
+        make_op("crop:30:0:20:10")(j)  # exceeds height
+    with pytest.raises(ValueError):
+        make_op("crop:5")  # wrong arity
+
+    np.testing.assert_array_equal(
+        np.asarray(make_op("pad:4")(j)),
+        np.pad(img, ((4, 4), (4, 4), (0, 0))),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(make_op("pad:3:reflect101")(j)),
+        np.pad(img, ((3, 3), (3, 3), (0, 0)), mode="reflect"),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(make_op("pad:2:edge")(j)),
+        np.pad(img, ((2, 2), (2, 2), (0, 0)), mode="edge"),
+    )
+    # pad then crop back is the identity
+    np.testing.assert_array_equal(
+        np.asarray(make_op("crop:4:4:40:50")(make_op("pad:4")(j))), img
+    )
+
+
+@pytest.mark.parametrize("channels", [1, 3])
+@pytest.mark.parametrize(
+    "th,tw", [(20, 30), (80, 100), (41, 53), (37, 67), (40, 25)]
+)
+def test_resize_bilinear_vs_oracle(channels, th, tw):
+    img = synthetic_image(37, 53, channels=channels, seed=43)
+    got = np.asarray(make_op(f"resize:{th}x{tw}")(jnp.asarray(img)))
+    want = _np_resize_bilinear(img, th, tw)
+    assert got.shape[:2] == (th, tw)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_resize_identity_and_nearest():
+    img = synthetic_image(32, 48, channels=3, seed=44)
+    j = jnp.asarray(img)
+    np.testing.assert_array_equal(np.asarray(make_op("resize:32x48")(j)), img)
+    got = np.asarray(make_op("resize:17x23:nearest")(j))
+    np.testing.assert_array_equal(got, _np_resize_nearest(img, 17, 23))
+    # integer upscale by nearest is exact pixel replication
+    up = np.asarray(make_op("resize:64x96:nearest")(j))
+    np.testing.assert_array_equal(up, np.repeat(np.repeat(img, 2, 0), 2, 1))
+
+
+def test_scale_factor():
+    img = synthetic_image(40, 60, channels=1, seed=45)
+    j = jnp.asarray(img)
+    half = np.asarray(make_op("scale:0.5")(j))
+    assert half.shape == (20, 30)
+    np.testing.assert_array_equal(half, _np_resize_bilinear(img, 20, 30))
+    with pytest.raises(ValueError):
+        make_op("scale:-1")
+
+
+def test_registry_errors():
+    for bad in ("resize:", "resize:0x10", "pad:0", "scale:0.5:cubic",
+                "resize:10x10:lanczos"):
+        with pytest.raises(ValueError):
+            make_op(bad)
+
+
+@pytest.mark.parametrize(
+    "spec",
+    [
+        "grayscale,resize:96x64,gaussian:5",
+        "rot90,gaussian:3",
+        "grayscale,scale:0.5,sobel",
+        "fliph,emboss:3,flipv",
+        "transpose,brightness:30",
+    ],
+)
+def test_backends_bitexact_with_geometry(spec):
+    img = synthetic_image(72, 56, channels=3, seed=46)
+    pipe = Pipeline.parse(spec)
+    j = jnp.asarray(img)
+    golden = np.asarray(pipe(j))
+    for backend in ("xla", "pallas", "auto"):
+        got = np.asarray(pipe.jit(backend)(j))
+        np.testing.assert_array_equal(got, golden, err_msg=f"{spec} [{backend}]")
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 (fake CPU) devices")
+@pytest.mark.parametrize(
+    "spec",
+    [
+        "fliph",
+        "flipv",
+        "grayscale,resize:120x80,gaussian:5",
+        "rot180,emboss:3",
+        "grayscale,scale:2,sobel",
+        "pad:8:reflect101,gaussian:3,crop:8:8:133:64",
+    ],
+)
+def test_sharded_bitexact_with_geometry(spec):
+    img = synthetic_image(133, 64, channels=3, seed=47)
+    pipe = Pipeline.parse(spec)
+    mesh = make_mesh(8)
+    golden = np.asarray(pipe(jnp.asarray(img)))
+    sharded = np.asarray(pipe.sharded(mesh)(jnp.asarray(img)))
+    np.testing.assert_array_equal(sharded, golden, err_msg=spec)
