@@ -105,10 +105,10 @@ def _nearest_index(in_len: int, out_len: int) -> np.ndarray:
     return np.clip(np.floor(centers), 0, in_len - 1).astype(np.int32)
 
 
-def _resize_fn(out_h: int | None, out_w: int | None, method: str):
+def _resize_fn(out_h: int, out_w: int, method: str):
     def fn(img: jnp.ndarray) -> jnp.ndarray:
-        th = out_h or img.shape[0]
-        tw = out_w or img.shape[1]
+        th = out_h
+        tw = out_w
         if (th, tw) == img.shape[:2]:
             return img
         if method == "nearest":
