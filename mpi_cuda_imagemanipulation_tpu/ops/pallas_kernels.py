@@ -1,4 +1,4 @@
-"""Pallas TPU kernels: fused pointwise+stencil pipeline groups, 2-D tiled.
+"""Pallas TPU kernels: fused pointwise+stencil pipeline groups, streamed.
 
 This is the framework's answer to kernel.cu's three separate `__global__`
 launches (grayscale :31, contrast :49, emboss :64 — each a full HBM
@@ -8,13 +8,24 @@ pixels from HBM once, applies the whole group in VMEM at f32, and writes
 uint8 once.
 
 Tiling model (the CUDA dim3-grid analogue, SURVEY.md §2.4): a 1-D grid over
-row blocks; each grid step reads three consecutive row blocks (prev/curr/
-next) per input plane so the stencil sees `halo` ghost rows without any
-dynamic indexing — the overlapping-block pattern. All image-edge extension
-(reflect101/edge/zero) is materialised by cheap XLA pads *outside* the
-kernel, so the kernel body is pure unrolled shift-multiply-accumulate on the
-VPU, bit-identical to the golden path (same tile functions from ops/spec.py,
-integer-exact accumulation).
+row blocks, executed **sequentially** (TPU grids are sequential per core),
+which enables a streaming stencil: each grid step DMAs one (block_h, W)
+input block — exactly once, no overlapping halo reads — applies the fused
+pointwise chain and the stencil's *row pass*, and stashes the result in a
+VMEM scratch carried across steps. The *column pass* for output block j
+runs one step later (at grid step j+1), when its bottom halo rows are
+available from the freshly loaded block. Total HBM traffic is the
+information-theoretic minimum: one u8 read + one u8 write of the image.
+
+Image-edge extension happens *inside* the kernel on the f32 row-pass
+values (reflect101/edge/zero strips built from static single-row/column
+slices — Mosaic has no reverse primitive), so there is no XLA-side
+"prepare" copy of the image either. Separable stencils (Gaussian, box,
+erode/dilate) split into true row/column passes — O(k) work per pixel and
+a (block_h, W) f32 scratch; non-separable ones (emboss, Sobel, median)
+stream raw rows at width W + 2*halo and run their 2-D `valid` as the
+column pass. Bit-exactness with the golden path is structural: both call
+the same tile functions from ops/spec.py in the same order.
 
 Colour images are decomposed into planar (H, W) channel arrays at the group
 boundary — (8,128)-lane-friendly, instead of HWC's 3-wide minor axis.
@@ -35,6 +46,10 @@ from mpi_cuda_imagemanipulation_tpu.ops.spec import (
     U8,
     PointwiseOp,
     StencilOp,
+    QUANTIZERS_F32,
+    corr_valid,
+    median9_valid,
+    window_reduce_1d,
 )
 
 # --------------------------------------------------------------------------
@@ -82,118 +97,347 @@ def _apply_pointwise_planes(op: PointwiseOp, planes: list) -> list:
     return [op.core(p) for p in planes]
 
 
+def _u8_to_f32(x):
+    # Mosaic has no unsigned->float cast; bridge through int32.
+    return x.astype(jnp.int32).astype(F32)
+
+
+def _f32_to_u8(x):
+    return x.astype(jnp.int32).astype(U8)
+
+
 # --------------------------------------------------------------------------
-# Edge extension (XLA-side, outside the kernel)
+# In-kernel weighted sums and edge columns
+#
+# All slicing happens on the *source dtype* (u8 where possible — lane
+# shifts of packed u8 are ~4x cheaper than f32 on the VPU; measured 0.29 ->
+# 0.14 ms for the 8K 5-tap row pass) with per-term casts to f32. Symmetric
+# integer kernels regroup into (x_k + x_{K-1-k}) pairs — every intermediate
+# is an exact integer below 2^24 in f32, so regrouping is bit-exact.
+# Mosaic has no reverse primitive, so reflected strips are built from
+# static single-row/column slices (halo <= 3 keeps this trivial).
 # --------------------------------------------------------------------------
 
 
-def _ext_rows(x: jnp.ndarray, h: int, mode: str | None, top: bool) -> jnp.ndarray:
-    if mode == "reflect101":
-        return x[1 : h + 1][::-1] if top else x[-h - 1 : -1][::-1]
-    if mode == "edge":
-        return jnp.repeat(x[:1] if top else x[-1:], h, axis=0)
-    return jnp.zeros((h, x.shape[1]), x.dtype)  # interior / zero / None
+def _cast_f32(t: jnp.ndarray) -> jnp.ndarray:
+    return t if t.dtype == F32 else t.astype(jnp.int32).astype(F32)
 
 
-def _ext_cols(x: jnp.ndarray, h: int, mode: str | None, left: bool) -> jnp.ndarray:
-    if mode == "reflect101":
-        return x[:, 1 : h + 1][:, ::-1] if left else x[:, -h - 1 : -1][:, ::-1]
-    if mode == "edge":
-        return jnp.repeat(x[:, :1] if left else x[:, -1:], h, axis=1)
-    return jnp.zeros((x.shape[0], h), x.dtype)
-
-
-def _prepare_plane(
-    plane: jnp.ndarray, h: int, mode: str | None, block_h: int, padded_h: int
-) -> jnp.ndarray:
-    """Lay out one channel plane for overlapping-block reads.
-
-    Returns rows = block_h + padded_h + block_h, cols = W + 2h:
-      [ zeros(block_h - h) | top edge-ext(h) | image (H) |
-        bottom edge-ext(h) | zeros(padded_h - H + block_h - h) ]
-    so that array-block k = image rows [(k-1)*block_h, k*block_h) and grid
-    step i reading blocks (i, i+1, i+2) sees image rows
-    [i*block_h - h, (i+1)*block_h + h) — the halo — with static indexing.
-    """
-    height = plane.shape[0]
-    if h > 0:
-        top = _ext_rows(plane, h, mode, top=True)
-        bottom = _ext_rows(plane, h, mode, top=False)
-        body = [top, plane, bottom]
-        left_pad = block_h - h
-        bottom_pad = (padded_h - height) + (block_h - h)
+def _weighted_terms(w: np.ndarray, sl) -> jnp.ndarray:
+    """sum_k w[k] * sl(k), pairing mirror taps when the kernel is symmetric
+    with integer weights (exact — see module comment)."""
+    wi = [float(v) for v in np.asarray(w).reshape(-1)]
+    k = len(wi)
+    sym = wi == wi[::-1] and all(v == int(v) for v in wi)
+    terms = []
+    if sym:
+        for d in range(k // 2):
+            if wi[d] == 0.0:
+                continue
+            pair = _cast_f32(sl(d)) + _cast_f32(sl(k - 1 - d))
+            terms.append(pair if wi[d] == 1.0 else pair * np.float32(wi[d]))
+        if k % 2:
+            mid = _cast_f32(sl(k // 2))
+            if wi[k // 2] != 0.0:
+                terms.append(
+                    mid if wi[k // 2] == 1.0 else mid * np.float32(wi[k // 2])
+                )
     else:
-        body = [plane]
-        left_pad = block_h
-        bottom_pad = (padded_h - height) + block_h
-    rows = [jnp.zeros((left_pad, plane.shape[1]), plane.dtype), *body]
-    rows.append(jnp.zeros((bottom_pad, plane.shape[1]), plane.dtype))
-    out = jnp.concatenate(rows, axis=0)
-    if h > 0:
-        left = _ext_cols(out, h, mode, left=True)
-        right = _ext_cols(out, h, mode, left=False)
-        out = jnp.concatenate([left, out, right], axis=1)
-    return out
+        for d in range(k):
+            if wi[d] == 0.0:
+                continue
+            t = _cast_f32(sl(d))
+            terms.append(t if wi[d] == 1.0 else t * np.float32(wi[d]))
+    acc = terms[0]
+    for t in terms[1:]:
+        acc = acc + t
+    return acc
+
+
+def _src_col(c: int, size: int, mode: str | None) -> int | None:
+    """Edge-extension source index for a possibly out-of-range coordinate
+    (None = zero contribution)."""
+    if 0 <= c < size:
+        return c
+    if mode == "reflect101":
+        return -c if c < 0 else 2 * (size - 1) - c
+    if mode == "edge":
+        return min(max(c, 0), size - 1)
+    return None  # interior / zero
+
+
+def _row_corr(x: jnp.ndarray, w1d: np.ndarray, h: int, mode: str | None):
+    """Row pass of a separable correlation over a (rows, W) tile, edge
+    columns synthesised per the op's mode. Returns (rows, W) f32."""
+    W = x.shape[1]
+    wv = np.asarray(w1d, dtype=np.float32).reshape(-1)
+
+    def edge_col(j):
+        def sl(k):
+            c = _src_col(j + k - h, W, mode)
+            if c is None:
+                return jnp.zeros((x.shape[0], 1), x.dtype)
+            return x[:, c : c + 1]
+
+        return _weighted_terms(wv, sl)
+
+    if W - 2 * h <= 0:  # degenerate narrow tile: every column is an edge
+        return jnp.concatenate([edge_col(j) for j in range(W)], axis=1)
+    interior = _weighted_terms(
+        wv, lambda d: x[:, d : d + W - 2 * h]
+    )
+    left = [edge_col(j) for j in range(h)]
+    right = [edge_col(W - h + j) for j in range(h)]
+    return jnp.concatenate(left + [interior] + right, axis=1)
+
+
+def _row_reduce(x: jnp.ndarray, kw: int, h: int, mode: str | None, fn):
+    """Row pass of a sliding min/max. Windows are sliced in the source dtype
+    (cheap u8 shifts) and cast to f32 per window — Mosaic has no u8 min/max
+    — so the result is always f32 holding exact u8 integers."""
+    W = x.shape[1]
+
+    def edge_col(j):
+        cols = []
+        for k in range(kw):
+            c = _src_col(j + k - h, W, mode)
+            if c is not None:
+                cols.append(_cast_f32(x[:, c : c + 1]))
+        acc = cols[0]
+        for t in cols[1:]:
+            acc = fn(acc, t)
+        return acc
+
+    if W - 2 * h <= 0:
+        return jnp.concatenate([edge_col(j) for j in range(W)], axis=1)
+    interior = window_reduce_1d(x, kw, 1, fn)
+    left = [edge_col(j) for j in range(h)]
+    right = [edge_col(W - h + j) for j in range(h)]
+    return jnp.concatenate(left + [interior] + right, axis=1)
+
+
+def _row_identity_ext(x: jnp.ndarray, h: int, mode: str | None) -> jnp.ndarray:
+    """Width-extend raw rows to W + 2h (non-separable stencils), staying in
+    the source dtype."""
+    W = x.shape[1]
+
+    def col(c):
+        s = _src_col(c, W, mode)
+        if s is None:
+            return jnp.zeros((x.shape[0], 1), x.dtype)
+        return x[:, s : s + 1]
+
+    left = [col(t - h) for t in range(h)]
+    right = [col(W + t) for t in range(h)]
+    return jnp.concatenate(left + [x] + right, axis=1)
+
+
+def _top_strip(main: jnp.ndarray, h: int, mode: str | None) -> jnp.ndarray:
+    """Rows -h..-1 of the image, synthesised from the first block's rows.
+    Strip row p (p = 0..h-1) is image row -(h-p): reflect101 reads row h-p."""
+    if mode == "edge":
+        return jnp.concatenate([main[:1]] * h, axis=0)
+    if mode == "reflect101":
+        return jnp.concatenate([main[k : k + 1] for k in range(h, 0, -1)], axis=0)
+    return jnp.concatenate([jnp.zeros((1, main.shape[1]), main.dtype)] * h, axis=0)
 
 
 # --------------------------------------------------------------------------
-# The fused group kernel
+# Stencil row/column pass split
 # --------------------------------------------------------------------------
 
 
-def _group_kernel(
+def _split_passes(op: StencilOp, width: int):
+    """Return (row_pass, col_pass, rp_width, rp_needs_f32).
+
+    row_pass maps a raw (rows, W) tile (u8 or post-pointwise f32) to
+    (rows, rp_width), including the op's width-edge extension; col_pass maps
+    the row-extended (bh+2h, rp_width) stack to the final (bh, W)
+    accumulation — combine and scale included, composed in the same exact-
+    integer arithmetic as StencilOp.valid, so results are bit-identical.
+    rp_needs_f32 says whether the row-pass output carries non-u8 values
+    (separable sums); u8-valued passes keep u8 scratch — half the VMEM
+    traffic and cheap shifts.
+    """
+    h = op.halo
+    mode = op.edge_mode
+    if op.reduce in ("min", "max"):
+        fn = jnp.minimum if op.reduce == "min" else jnp.maximum
+        kh, kw = op.kernels[0].shape
+        return (
+            lambda x: _row_reduce(x, kw, h, mode, fn),
+            lambda ext: window_reduce_1d(ext, kh, 0, fn),
+            width,
+            False,
+        )
+    if op.separable is not None and op.edge_mode != "interior":
+        w1d = np.asarray(op.separable, dtype=np.float32).reshape(-1)
+
+        def col_pass(ext):
+            acc = _weighted_terms(
+                w1d, lambda d: ext[d : d + ext.shape[0] - 2 * h]
+            )
+            if op.scale != 1.0:
+                acc = acc * np.float32(op.scale)
+            return acc
+
+        return (lambda x: _row_corr(x, w1d, h, mode), col_pass, width, True)
+    # non-separable (or interior-mode, which needs raw rows for the
+    # pass-through): stream raw rows at full extended width
+    if op.reduce == "median":
+        return (
+            lambda x: _row_identity_ext(x, h, mode),
+            median9_valid,
+            width + 2 * h,
+            False,
+        )
+    return (
+        lambda x: _row_identity_ext(x, h, mode),
+        op.valid,
+        width + 2 * h,
+        False,
+    )
+
+
+# --------------------------------------------------------------------------
+# The streaming fused group kernel (full-image path)
+# --------------------------------------------------------------------------
+
+
+def _quantize_u8(stencil: StencilOp, acc: jnp.ndarray) -> jnp.ndarray:
+    return _f32_to_u8(QUANTIZERS_F32[stencil.quantize](acc))
+
+
+def _stream_kernel(
     *refs,
     pointwise: list[PointwiseOp],
-    stencil: StencilOp | None,
+    stencil: StencilOp,
     n_in: int,
     n_out: int,
     block_h: int,
-    halo: int,
+    nb: int,
     global_h: int,
     global_w: int,
+    rp_u8: bool,
 ):
-    h = halo
-    specs_per_plane = 3 if h > 0 else 1
-    in_refs = refs[: specs_per_plane * n_in]
-    out_refs = refs[specs_per_plane * n_in :]
+    h = stencil.halo
+    mode = stencil.edge_mode
+    row_pass, col_pass, rp_w, _ = _split_passes(stencil, global_w)
+    in_refs = refs[:n_in]
+    out_refs = refs[n_in : n_in + n_out]
+    scratch = refs[n_in + n_out :]  # (main, tail) per output plane
 
-    def u8_to_f32(x):
-        # Mosaic has no unsigned->float cast; bridge through int32.
-        return x.astype(jnp.int32).astype(F32)
+    i = pl.program_id(0)
+    j = i - 1  # output block index computed this step
 
-    def f32_to_u8(x):
-        return x.astype(jnp.int32).astype(U8)
+    if pointwise:
+        planes = [_u8_to_f32(r[:]) for r in in_refs]
+        for op in pointwise:
+            planes = _apply_pointwise_planes(op, planes)
+    else:
+        planes = [r[:] for r in in_refs]  # raw u8 — cheap shifts in row_pass
+    assert len(planes) == n_out
 
-    planes = []
-    for p in range(n_in):
-        if h > 0:
-            prev, curr, nxt = in_refs[3 * p : 3 * p + 3]
-            ext = jnp.concatenate(
-                [u8_to_f32(prev[-h:]), u8_to_f32(curr[:]), u8_to_f32(nxt[:h])],
-                axis=0,
-            )
-        else:
-            ext = u8_to_f32(in_refs[p][:])
-        planes.append(ext)
+    # Last-block geometry (static): r1 = in-block row of image row H-1.
+    # Rows past it (in-block and in the bottom strip) hold DMA garbage on
+    # the last block; the ones inside reach of a valid output's window —
+    # image rows H..H-1+h — are replaced by the op's edge extension, as
+    # selects on the pieces of the ext concat the kernel builds anyway.
+    r1 = (global_h - 1) - (nb - 1) * block_h
+    a = min(r1 + 1, block_h)  # real rows in the last block
+    nfix = min(h, block_h - a)  # garbage rows to fix inside the block
 
+    for p_idx, x in enumerate(planes):
+        main_ref, tail_ref = scratch[2 * p_idx], scratch[2 * p_idx + 1]
+        rp = row_pass(x)
+        if rp_u8 and rp.dtype != U8:
+            rp = _f32_to_u8(rp)  # exact u8 integers by construction
+
+        @pl.when(i >= 1)
+        def _(rp=rp, main_ref=main_ref, tail_ref=tail_ref, p_idx=p_idx):
+            main = main_ref[:]
+            top = jnp.where(j == 0, _top_strip(main, h, mode), tail_ref[:])
+
+            def bottom_src(g):
+                """Row-pass row holding the edge extension of image row g
+                (g >= H), sourced from this block at a static offset.
+
+                Rows whose extension source cannot be reached locally only
+                feed outputs past the image bottom (their window would need
+                g > H-1+h, which no valid output reads — shown in the
+                module comment), so clamping to any in-range row is safe."""
+                if mode == "reflect101":
+                    gp = 2 * (global_h - 1) - g
+                else:  # edge (zero/interior never fix)
+                    gp = global_h - 1
+                p = min(max(gp - (nb - 1) * block_h, -h), block_h - 1)
+                if p >= 0:
+                    return main[p : p + 1]
+                return top[h + p : h + p + 1]  # crosses into the halo strip
+
+            if mode == "interior":
+                # the interior mask passes through exactly the outputs whose
+                # windows could touch the garbage rows, so no fixes needed
+                pieces = [top, main, rp[:h]]
+            else:
+                pieces = [top, main[:a]]
+                if nfix:
+                    fix = jnp.concatenate(
+                        [bottom_src(global_h + t) for t in range(nfix)], axis=0
+                    )
+                    pieces.append(
+                        jnp.where(j == nb - 1, fix, main[a : a + nfix])
+                    )
+                if a + nfix < block_h:
+                    pieces.append(main[a + nfix :])
+                head = rp[:h]
+                if a < h and nb >= 2:
+                    # The ragged last block holds fewer real rows than the
+                    # halo, so the *penultimate* block's bottom strip (the
+                    # last block's head) also contains garbage rows
+                    # (head row t >= a is image row g = (nb-1)*bh + t >= H).
+                    # Their edge extension lives at static offsets: reflect
+                    # source g' = 2(H-1) - g is head row 2*r1 - t if that is
+                    # >= 0, else main row bh + (2*r1 - t).
+                    def pen_src(t):
+                        if t < a:
+                            return rp[t : t + 1]
+                        p = (2 * r1 - t) if mode == "reflect101" else r1
+                        if p >= 0:
+                            return rp[p : p + 1]
+                        return main[block_h + p : block_h + p + 1]
+
+                    pen = jnp.concatenate(
+                        [pen_src(t) for t in range(h)], axis=0
+                    )
+                    head = jnp.where(j == nb - 2, pen, head)
+                bot_last = jnp.concatenate(
+                    [bottom_src(nb * block_h + t) for t in range(h)], axis=0
+                )
+                pieces.append(jnp.where(j == nb - 1, bot_last, head))
+            ext = jnp.concatenate(pieces, axis=0)
+            q = _quantize_u8(stencil, col_pass(ext))
+            if mode == "interior":
+                orig = main[:, h : h + global_w] if rp_w != global_w else main
+                if orig.dtype != U8:
+                    orig = _f32_to_u8(orig)  # exact u8 integers
+                mask = stencil.interior_mask(
+                    (block_h, global_w), j * block_h, 0, global_h, global_w
+                )
+                q = jnp.where(mask, q, orig)
+            out_refs[p_idx][:] = q
+
+        tail_ref[:] = main_ref[block_h - h :]
+        main_ref[:] = rp
+
+
+def _pointwise_kernel(*refs, pointwise, n_in, n_out):
+    planes = [_u8_to_f32(r[:]) for r in refs[:n_in]]
     for op in pointwise:
         planes = _apply_pointwise_planes(op, planes)
-
-    if stencil is None:
-        assert len(planes) == n_out
-        for out_ref, plane in zip(out_refs, planes):
-            out_ref[:] = f32_to_u8(plane)
-        return
-
-    # stencils filter each plane independently (colour images per channel)
     assert len(planes) == n_out
-    y0 = pl.program_id(0) * block_h
-    for out_ref, x in zip(out_refs, planes):
-        acc = stencil.valid(x)  # (block_h, W)
-        orig = x[h : h + block_h, h : h + global_w] if h > 0 else x
-        out_ref[:] = f32_to_u8(
-            stencil.finalize_f32(acc, orig, y0, 0, global_h, global_w)
-        )
+    for out_ref, plane in zip(refs[n_in:], planes):
+        out_ref[:] = _f32_to_u8(plane)
 
 
 # --------------------------------------------------------------------------
@@ -201,19 +445,26 @@ def _group_kernel(
 # --------------------------------------------------------------------------
 
 
+# Mosaic's default scoped-VMEM limit is 16 MiB; v5e has 128 MiB of VMEM.
+# Raising the limit lets wide images keep useful block heights; the block-
+# height heuristic then targets a working set below this.
+_VMEM_LIMIT = 64 * 1024 * 1024
+_COMPILER_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT)
+
+
 def _pick_block_h(width: int, n_in: int, n_out: int, halo: int) -> int:
     """Row-block height maximising VMEM use without overflowing it.
 
-    Working set per row of block height (measured on v5e — bh=64 compiles
-    and is fastest for W≈7.7k, bh=128 overflows): u8 input blocks
-    (specs_per_plane per plane, double-buffered by the pipeline) plus ~3
-    live f32 temps per live plane — colour stencil groups keep all
-    max(n_in, n_out) extended channel planes resident at once.
-    """
-    budget = 10 * 1024 * 1024
-    specs_per_plane = 3 if halo > 0 else 1
+    Working-set estimate per row of block height: u8 input blocks (double-
+    buffered by the pipeline) + u8 output double-buffer + f32 row-pass
+    scratch + ~8 live f32 temps per plane while the kernel body runs
+    (concat copies, pointwise intermediates, accumulators). Calibrated on
+    v5e: the 8K gaussian5 kernel at bh=128 reports ~21 MB scoped use."""
+    budget = 3 * _VMEM_LIMIT // 4
     n_live = max(n_in, n_out)
-    per_row = width * (specs_per_plane * n_in * 2 + 4 * 3 * n_live)
+    # row-pass scratch rows are width + 2*halo wide for non-separable ops;
+    # folding the halo into every term over-reserves by a harmless epsilon
+    per_row = (width + 2 * halo) * (4 * n_in + 8 * n_out + 4 * 8 * n_live)
     bh = budget // max(per_row, 1)
     bh = int(max(32, min(512, bh)))
     return (bh // 32) * 32
@@ -253,57 +504,87 @@ def run_group(
 
     n_in = len(planes)
     n_out = _channels_after(pointwise, n_in)
-
     bh = block_h or _pick_block_h(width, n_in, n_out, h)
-    padded_h = -(-height // bh) * bh
-    grid = (padded_h // bh,)
-
-    prepared = [_prepare_plane(p, h, mode, bh, padded_h) for p in planes]
-    in_width = width + 2 * h
-
-    # stencil groups read prev/curr/next row blocks of each prepared plane;
-    # pointwise-only groups (h == 0) read each block exactly once
-    offsets = (0, 1, 2) if h > 0 else (1,)
-    in_specs = []
-    for _ in range(n_in):
-        for off in offsets:
-            in_specs.append(
-                pl.BlockSpec(
-                    (bh, in_width),
-                    partial(lambda i, o: (i + o, 0), o=off),
-                    memory_space=pltpu.VMEM,
-                )
-            )
-    out_specs = [
-        pl.BlockSpec((bh, width), lambda i: (i, 0), memory_space=pltpu.VMEM)
-        for _ in range(n_out)
-    ]
-    out_shapes = [jax.ShapeDtypeStruct((padded_h, width), U8) for _ in range(n_out)]
 
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
+    if stencil is None:
+        # plain streaming pointwise: one read, one write, ragged last block
+        # masked by Pallas
+        grid = (-(-height // bh),)
+        outs = pl.pallas_call(
+            partial(_pointwise_kernel, pointwise=pointwise, n_in=n_in, n_out=n_out),
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((bh, width), lambda i: (i, 0), memory_space=pltpu.VMEM)
+                for _ in range(n_in)
+            ],
+            out_specs=[
+                pl.BlockSpec((bh, width), lambda i: (i, 0), memory_space=pltpu.VMEM)
+                for _ in range(n_out)
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((height, width), U8) for _ in range(n_out)
+            ],
+            interpret=interpret,
+        )(*planes)
+        outs = outs if isinstance(outs, (tuple, list)) else [outs]
+        return list(outs)
+
+    if 2 * h > bh:  # streaming needs the halo to fit inside one block
+        raise ValueError(f"block_h {bh} too small for halo {h}")
+
+    nb = -(-height // bh)
+    _, _, rp_w, rp_needs_f32 = _split_passes(stencil, width)
+    # row-pass values that are exact u8 integers keep u8 scratch: half the
+    # VMEM traffic and 4x cheaper sublane shifts in the column pass
+    rp_u8 = not rp_needs_f32
+    rp_dtype = U8 if rp_u8 else F32
+    padded_h = nb * bh
+
     kernel = partial(
-        _group_kernel,
+        _stream_kernel,
         pointwise=pointwise,
         stencil=stencil,
         n_in=n_in,
         n_out=n_out,
         block_h=bh,
-        halo=h,
+        nb=nb,
         global_h=height,
         global_w=width,
+        rp_u8=rp_u8,
     )
-    # each plane is passed once per spec (prev/curr/next for stencil groups)
-    args = [p for p in prepared for _ in range(len(offsets))]
+    scratch_shapes = []
+    for _ in range(n_out):
+        scratch_shapes.append(pltpu.VMEM((bh, rp_w), rp_dtype))  # main
+        scratch_shapes.append(pltpu.VMEM((h, rp_w), rp_dtype))  # tail
     outs = pl.pallas_call(
         kernel,
-        grid=grid,
-        in_specs=in_specs,
-        out_specs=out_specs if n_out > 1 else out_specs[0],
-        out_shape=out_shapes if n_out > 1 else out_shapes[0],
+        grid=(nb + 1,),
+        in_specs=[
+            pl.BlockSpec(
+                (bh, width),
+                partial(lambda i, n: (jnp.minimum(i, n - 1), 0), n=nb),
+                memory_space=pltpu.VMEM,
+            )
+            for _ in range(n_in)
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (bh, width),
+                lambda i: (jnp.maximum(i - 1, 0), 0),
+                memory_space=pltpu.VMEM,
+            )
+            for _ in range(n_out)
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((padded_h, width), U8) for _ in range(n_out)
+        ],
+        scratch_shapes=scratch_shapes,
         interpret=interpret,
-    )(*args)
+        compiler_params=_COMPILER_PARAMS,
+    )(*planes)
     outs = outs if isinstance(outs, (tuple, list)) else [outs]
     return [o[:height] for o in outs]
 
@@ -319,7 +600,9 @@ def stencil_tile_pallas(
 
     `ext` is (local_h + 2*halo, W) uint8 whose ghost rows were already
     materialised by the caller (ppermute halo exchange + global-edge fixup,
-    parallel/api.py), so the kernel needs no edge logic of its own; the
+    parallel/api.py), so the kernel streams it directly: output block j
+    needs ext rows [j*bh, j*bh + bh + 2h), i.e. the previous input block's
+    row-pass (VMEM scratch) plus the first 2h rows of the current one. The
     interior mask (if any) is applied by the caller in XLA, since the tile's
     global row offset is a traced value inside shard_map. Returns quantized
     uint8 (local_h, W).
@@ -327,55 +610,48 @@ def stencil_tile_pallas(
     h = op.halo
     local_h, width = ext.shape[0] - 2 * h, ext.shape[1]
     bh = block_h or _pick_block_h(width, 1, 1, h)
-    padded_h = -(-local_h // bh) * bh
+    if 2 * h > bh:
+        raise ValueError(f"block_h {bh} too small for halo {h}")
+    row_pass, col_pass, rp_w, rp_needs_f32 = _split_passes(op, width)
+    rp_dtype = F32 if rp_needs_f32 else U8
+    nb_out = -(-local_h // bh)
+    nb_in = -(-(local_h + 2 * h) // bh)
 
-    # width extension per op mode (the W axis is never sharded)
-    if h > 0:
-        left = _ext_cols(ext, h, op.edge_mode, left=True)
-        right = _ext_cols(ext, h, op.edge_mode, left=False)
-        ext = jnp.concatenate([left, ext, right], axis=1)
-    # row layout for overlapping prev/curr/next blocks (top halo already
-    # present in ext, so the leading zero filler is block_h - h rows)
-    filler_top = jnp.zeros((bh - h, ext.shape[1]), ext.dtype)
-    filler_bottom = jnp.zeros(
-        ((padded_h - local_h) + (bh - h), ext.shape[1]), ext.dtype
-    )
-    prepared = jnp.concatenate([filler_top, ext, filler_bottom], axis=0)
+    def kernel(in_ref, out_ref, main_ref):
+        i = pl.program_id(0)
+        rp = row_pass(in_ref[:])
+        if rp.dtype != rp_dtype:
+            rp = _f32_to_u8(rp)  # exact u8 integers by construction
 
-    def kernel(prev, curr, nxt, out_ref):
-        x = jnp.concatenate(
-            [
-                prev[-h:].astype(jnp.int32).astype(F32),
-                curr[:].astype(jnp.int32).astype(F32),
-                nxt[:h].astype(jnp.int32).astype(F32),
-            ],
-            axis=0,
-        )
-        from mpi_cuda_imagemanipulation_tpu.ops.spec import QUANTIZERS_F32
+        @pl.when(i >= 1)
+        def _():
+            ext_rows = jnp.concatenate([main_ref[:], rp[: 2 * h]], axis=0)
+            out_ref[:] = _quantize_u8(op, col_pass(ext_rows))
 
-        q = QUANTIZERS_F32[op.quantize](op.valid(x))
-        out_ref[:] = q.astype(jnp.int32).astype(U8)
+        main_ref[:] = rp
 
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    in_specs = [
-        pl.BlockSpec(
-            (bh, ext.shape[1]),
-            partial(lambda i, o: (i + o, 0), o=off),
-            memory_space=pltpu.VMEM,
-        )
-        for off in (0, 1, 2)
-    ]
     out = pl.pallas_call(
         kernel,
-        grid=(padded_h // bh,),
-        in_specs=in_specs,
+        grid=(nb_out + 1,),
+        in_specs=[
+            pl.BlockSpec(
+                (bh, width),
+                partial(lambda i, n: (jnp.minimum(i, n - 1), 0), n=nb_in),
+                memory_space=pltpu.VMEM,
+            )
+        ],
         out_specs=pl.BlockSpec(
-            (bh, width), lambda i: (i, 0), memory_space=pltpu.VMEM
+            (bh, width),
+            lambda i: (jnp.maximum(i - 1, 0), 0),
+            memory_space=pltpu.VMEM,
         ),
-        out_shape=jax.ShapeDtypeStruct((padded_h, width), U8),
+        out_shape=jax.ShapeDtypeStruct((nb_out * bh, width), U8),
+        scratch_shapes=[pltpu.VMEM((bh, rp_w), rp_dtype)],
         interpret=interpret,
-    )(prepared, prepared, prepared)
+        compiler_params=_COMPILER_PARAMS,
+    )(ext)
     return out[:local_h]
 
 

@@ -83,11 +83,15 @@ QUANTIZERS_F32: dict[str, Callable[[jnp.ndarray], jnp.ndarray]] = {
 def corr_valid(xpad: jnp.ndarray, weights: np.ndarray) -> jnp.ndarray:
     """Valid-mode 2-D correlation via unrolled static shifts.
 
-    ``xpad`` is float32 of shape (H + kh - 1, W + kw - 1); ``weights`` is a
-    static (kh, kw) array indexed ``w[dy, dx]``. Returns float32 (H, W).
-    Unrolled shift-multiply-accumulate maps onto the TPU VPU (8x128 lanes)
-    and fuses under XLA; the same code runs inside Pallas kernels on VMEM
-    tiles. This replaces the CUDA per-thread gather loop (kernel.cu:84-90).
+    ``xpad`` is (H + kh - 1, W + kw - 1) float32, or uint8 holding the same
+    exact integer values — the Pallas streaming kernels slice the packed u8
+    data (lane shifts of u8 are ~4x cheaper than f32 on the VPU) and each
+    shifted window is cast to f32 here, so the arithmetic is identical
+    either way. ``weights`` is a static (kh, kw) array indexed ``w[dy, dx]``.
+    Returns float32 (H, W). Unrolled shift-multiply-accumulate maps onto the
+    TPU VPU (8x128 lanes) and fuses under XLA; the same code runs inside
+    Pallas kernels on VMEM tiles. This replaces the CUDA per-thread gather
+    loop (kernel.cu:84-90).
     """
     kh, kw = weights.shape
     out_h = xpad.shape[0] - (kh - 1)
@@ -99,6 +103,8 @@ def corr_valid(xpad: jnp.ndarray, weights: np.ndarray) -> jnp.ndarray:
             if w == 0.0:
                 continue
             win = xpad[dy : dy + out_h, dx : dx + out_w]
+            if win.dtype != F32:
+                win = win.astype(jnp.int32).astype(F32)
             term = win if w == 1.0 else win * w
             acc = term if acc is None else acc + term
     if acc is None:
@@ -123,11 +129,16 @@ def window_reduce_1d(
 ) -> jnp.ndarray:
     """Valid-mode sliding reduction (min/max) of width k along one axis,
     via k-1 unrolled static shifts — the same VPU-friendly shape as
-    corr_valid, so it lowers identically inside Pallas kernels."""
+    corr_valid, so it lowers identically inside Pallas kernels. u8 input is
+    shifted packed and cast per-window (Mosaic has no u8 min/max — and the
+    u8 lane shifts are the cheap part anyway); values are exact integers,
+    so the f32 reduction is bit-equivalent."""
     out_len = xpad.shape[axis] - (k - 1)
     acc = None
     for d in range(k):
         win = lax.slice_in_dim(xpad, d, d + out_len, axis=axis)
+        if win.dtype not in (F32, jnp.int32):
+            win = win.astype(jnp.int32).astype(F32)
         acc = win if acc is None else fn(acc, win)
     return acc
 
@@ -147,13 +158,17 @@ _MEDIAN9_EXCHANGES = (
 
 
 def median9_valid(xpad: jnp.ndarray) -> jnp.ndarray:
-    """Valid-mode 3x3 median via the median-of-9 selection network."""
+    """Valid-mode 3x3 median via the median-of-9 selection network.
+    u8 input is shifted packed, then cast per-window (see window_reduce_1d)."""
     out_h = xpad.shape[0] - 2
     out_w = xpad.shape[1] - 2
     p = [
         xpad[dy : dy + out_h, dx : dx + out_w]
         for dy in range(3)
         for dx in range(3)
+    ]
+    p = [
+        t if t.dtype == F32 else t.astype(jnp.int32).astype(F32) for t in p
     ]
     for i, j in _MEDIAN9_EXCHANGES:
         p[i], p[j] = _sort2(p[i], p[j])

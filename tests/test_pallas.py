@@ -121,3 +121,23 @@ def test_pipeline_jit_pallas_backend():
     pipe = reference_pipeline()
     got = np.asarray(pipe.jit(backend="pallas")(jnp.asarray(img)))
     np.testing.assert_array_equal(got, np.asarray(pipe(jnp.asarray(img))))
+
+
+@pytest.mark.parametrize(
+    "spec,height",
+    [
+        # ((H-1) % block_h) + 1 < halo: the ragged last block holds fewer
+        # real rows than the halo, so the penultimate block's bottom strip
+        # needs the in-kernel edge fix too (regression: it read DMA garbage)
+        ("gaussian:5", 65),
+        ("gaussian:7", 66),
+        ("erode:5", 65),
+        ("box:5", 97),
+        ("dilate:7", 66),
+        ("median:3", 96),  # halo 1: a < h impossible, control case
+        ("gaussian:5", 64),  # exact multiple control case
+    ],
+)
+def test_ragged_last_block_shorter_than_halo(spec, height):
+    img = synthetic_image(height, 140, channels=1, seed=41)
+    _assert_pallas_equals_golden(spec, img, block_h=32)
