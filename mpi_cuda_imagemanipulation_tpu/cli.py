@@ -95,6 +95,13 @@ def _build_parser() -> argparse.ArgumentParser:
         help="device dispatches kept in flight (overlaps compute with "
         "decode/encode; the reference instead round-trips per stage)",
     )
+    batch.add_argument(
+        "--stack",
+        type=int,
+        default=1,
+        help="vmap-stack up to N same-shape images into one device "
+        "dispatch (amortises per-call overhead; incompatible with --shards)",
+    )
     batch.add_argument("--gray-output", action="store_true")
     batch.add_argument("--show-timing", action="store_true")
 
@@ -234,8 +241,14 @@ def cmd_batch(args: argparse.Namespace) -> int:
         return 1
     os.makedirs(args.output_dir, exist_ok=True)
     pipe = Pipeline.parse(args.ops)
+    stack = max(1, args.stack)
     if args.shards > 1:
+        if stack > 1:
+            log.error("--stack and --shards are mutually exclusive")
+            return 1
         fn = pipe.sharded(make_mesh(args.shards), backend=args.impl)
+    elif stack > 1:
+        fn = pipe.batched(backend=args.impl)
     else:
         fn = pipe.jit(backend=args.impl)  # one jit: re-traces only per shape
 
@@ -244,12 +257,10 @@ def cmd_batch(args: argparse.Namespace) -> int:
     done = 0
     from collections import deque
 
-    inflight: deque = deque()  # (input index, async device result)
+    inflight: deque = deque()  # (input indices, async device result)
 
-    def drain_one():
+    def save_one(i, out):
         nonlocal done
-        i, out = inflight.popleft()
-        out = np.asarray(out)  # forces completion + transfer
         if not args.gray_output and out.ndim == 2:
             out = gray_to_rgb(out)
         # mirror the input's path relative to input-dir, so glob patterns
@@ -260,11 +271,49 @@ def cmd_batch(args: argparse.Namespace) -> int:
         save_image(dst, out)
         done += 1
 
-    for i, img in batch_load(paths, n_threads=args.threads, on_error="skip"):
-        inflight.append((i, fn(img)))  # async dispatch
-        total_mp += img.shape[0] * img.shape[1] / 1e6
+    def drain_one():
+        idxs, out = inflight.popleft()
+        out = np.asarray(out)  # forces completion + transfer
+        if stack == 1:
+            save_one(idxs[0], out)
+        else:
+            for k, i in enumerate(idxs):
+                save_one(i, out[k])
+
+    # same-shape images accumulate into a stack and ship as one dispatch;
+    # a shape change flushes the pending stack (stack == 1: ship per image)
+    pending: list[tuple[int, np.ndarray]] = []
+
+    def flush_pending():
+        nonlocal pending
+        if not pending:
+            return
+        idxs = [i for i, _ in pending]
+        if stack > 1:
+            imgs = [im for _, im in pending]
+            # pad a partial stack by repeating the last image so every
+            # dispatch for a given image shape reuses one compiled batch
+            # shape (a ragged trailing batch would force a recompile —
+            # the very overhead --stack amortises); padded outputs are
+            # dropped in drain_one, which iterates idxs only
+            imgs += [imgs[-1]] * (stack - len(imgs))
+            inflight.append((idxs, fn(np.stack(imgs, axis=0))))
+        else:
+            inflight.append((idxs, fn(pending[0][1])))
+        pending = []
         if len(inflight) >= max(1, args.window):
             drain_one()
+
+    for i, img in batch_load(paths, n_threads=args.threads, on_error="skip"):
+        if pending and (
+            len(pending) >= stack or pending[-1][1].shape != img.shape
+        ):
+            flush_pending()
+        pending.append((i, img))
+        total_mp += img.shape[0] * img.shape[1] / 1e6
+        if stack == 1:
+            flush_pending()
+    flush_pending()
     while inflight:
         drain_one()
     wall = time.perf_counter() - t0

@@ -58,23 +58,36 @@ class Pipeline:
 
     # -- compiled entry points -------------------------------------------
 
-    def jit(self, backend: str = "xla"):
-        """A jitted image -> image function on the current default device."""
+    def _callable(self, backend: str):
         if backend == "xla":
-            return jax.jit(self.apply)
+            return self.apply
         if backend == "pallas":
             from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
                 pipeline_pallas,
             )
 
-            return jax.jit(partial(pipeline_pallas, self.ops))
+            return partial(pipeline_pallas, self.ops)
         if backend == "auto":
             from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
                 pipeline_auto,
             )
 
-            return jax.jit(partial(pipeline_auto, self.ops))
+            return partial(pipeline_auto, self.ops)
         raise ValueError(f"unknown backend {backend!r}; known: {BACKENDS}")
+
+    def jit(self, backend: str = "xla"):
+        """A jitted image -> image function on the current default device."""
+        return jax.jit(self._callable(backend))
+
+    def batched(self, backend: str = "xla"):
+        """A jitted (N, H, W[, C]) -> (N, ...) batch function: one compiled
+        dispatch for a stack of same-shape images (`jax.vmap`; the Pallas
+        kernels batch through their vmap rule as an extra grid dimension).
+
+        The reference has no batch concept — one hardcoded image per
+        process launch (kernel.cu:110). Batching amortises dispatch
+        overhead, which dominates small images on remote-attached TPUs."""
+        return jax.jit(jax.vmap(self._callable(backend)))
 
     def sharded(self, mesh, backend: str = "xla"):
         """A jitted function running this pipeline row-sharded over `mesh`

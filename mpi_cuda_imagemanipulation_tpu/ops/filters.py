@@ -55,7 +55,24 @@ def gaussian_2d(size: int) -> tuple[np.ndarray, float]:
 SOBEL_GX = _w([[-1, 0, 1], [-2, 0, 2], [-1, 0, 1]])
 SOBEL_GY = SOBEL_GX.T.copy()
 
+PREWITT_GX = _w([[-1, 0, 1], [-1, 0, 1], [-1, 0, 1]])
+PREWITT_GY = PREWITT_GX.T.copy()
+
+SCHARR_GX = _w([[-3, 0, 3], [-10, 0, 10], [-3, 0, 3]])
+SCHARR_GY = SCHARR_GX.T.copy()
+
 SHARPEN3 = _w([[0, -1, 0], [-1, 5, -1], [0, -1, 0]])
+
+# 4- and 8-neighbour Laplacians (OpenCV/classic definitions)
+LAPLACIAN4 = _w([[0, 1, 0], [1, -4, 1], [0, 1, 0]])
+LAPLACIAN8 = _w([[1, 1, 1], [1, -8, 1], [1, 1, 1]])
+
+# Unsharp mask: identity*2 - gaussian, as one integer 5x5 kernel with a
+# power-of-two scale: 2*256*delta - binomial5x5, /256.
+_G5 = np.outer(binomial_1d(5), binomial_1d(5)).astype(np.float32)
+UNSHARP5 = (-_G5).copy()
+UNSHARP5[2, 2] += 2.0 * 256.0
+UNSHARP5_SCALE = 1.0 / 256.0
 
 
 def box_2d(size: int) -> tuple[np.ndarray, float]:

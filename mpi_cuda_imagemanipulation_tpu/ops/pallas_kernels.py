@@ -17,10 +17,12 @@ runs one step later (at grid step j+1), when its bottom halo rows are
 available from the freshly loaded block. Total HBM traffic is the
 information-theoretic minimum: one u8 read + one u8 write of the image.
 
-Image-edge extension happens *inside* the kernel on the f32 row-pass
-values (reflect101/edge/zero strips built from static single-row/column
-slices — Mosaic has no reverse primitive), so there is no XLA-side
-"prepare" copy of the image either. Separable stencils (Gaussian, box,
+Image-edge extension happens *inside* the kernel on the row-pass values
+(reflect101 and edge strips built from static single-row/column slices —
+Mosaic has no reverse primitive; 'interior' mode needs no real extension
+because its mask passes the affected outputs through; true zero-border
+stencils are rejected — none exist in the registry), so there is no
+XLA-side "prepare" copy of the image either. Separable stencils (Gaussian, box,
 erode/dilate) split into true row/column passes — O(k) work per pixel and
 a (block_h, W) f32 scratch; non-separable ones (emboss, Sobel, median)
 stream raw rows at width W + 2*halo and run their 2-D `valid` as the
@@ -47,7 +49,7 @@ from mpi_cuda_imagemanipulation_tpu.ops.spec import (
     PointwiseOp,
     StencilOp,
     QUANTIZERS_F32,
-    corr_valid,
+    exact_f32,
     median9_valid,
     window_reduce_1d,
 )
@@ -97,12 +99,8 @@ def _apply_pointwise_planes(op: PointwiseOp, planes: list) -> list:
     return [op.core(p) for p in planes]
 
 
-def _u8_to_f32(x):
-    # Mosaic has no unsigned->float cast; bridge through int32.
-    return x.astype(jnp.int32).astype(F32)
-
-
 def _f32_to_u8(x):
+    # the write-side counterpart of spec.exact_f32's u8->f32 bridge
     return x.astype(jnp.int32).astype(U8)
 
 
@@ -119,10 +117,6 @@ def _f32_to_u8(x):
 # --------------------------------------------------------------------------
 
 
-def _cast_f32(t: jnp.ndarray) -> jnp.ndarray:
-    return t if t.dtype == F32 else t.astype(jnp.int32).astype(F32)
-
-
 def _weighted_terms(w: np.ndarray, sl) -> jnp.ndarray:
     """sum_k w[k] * sl(k), pairing mirror taps when the kernel is symmetric
     with integer weights (exact — see module comment)."""
@@ -134,10 +128,10 @@ def _weighted_terms(w: np.ndarray, sl) -> jnp.ndarray:
         for d in range(k // 2):
             if wi[d] == 0.0:
                 continue
-            pair = _cast_f32(sl(d)) + _cast_f32(sl(k - 1 - d))
+            pair = exact_f32(sl(d)) + exact_f32(sl(k - 1 - d))
             terms.append(pair if wi[d] == 1.0 else pair * np.float32(wi[d]))
         if k % 2:
-            mid = _cast_f32(sl(k // 2))
+            mid = exact_f32(sl(k // 2))
             if wi[k // 2] != 0.0:
                 terms.append(
                     mid if wi[k // 2] == 1.0 else mid * np.float32(wi[k // 2])
@@ -146,7 +140,7 @@ def _weighted_terms(w: np.ndarray, sl) -> jnp.ndarray:
         for d in range(k):
             if wi[d] == 0.0:
                 continue
-            t = _cast_f32(sl(d))
+            t = exact_f32(sl(d))
             terms.append(t if wi[d] == 1.0 else t * np.float32(wi[d]))
     acc = terms[0]
     for t in terms[1:]:
@@ -202,7 +196,7 @@ def _row_reduce(x: jnp.ndarray, kw: int, h: int, mode: str | None, fn):
         for k in range(kw):
             c = _src_col(j + k - h, W, mode)
             if c is not None:
-                cols.append(_cast_f32(x[:, c : c + 1]))
+                cols.append(exact_f32(x[:, c : c + 1]))
         acc = cols[0]
         for t in cols[1:]:
             acc = fn(acc, t)
@@ -331,7 +325,7 @@ def _stream_kernel(
     j = i - 1  # output block index computed this step
 
     if pointwise:
-        planes = [_u8_to_f32(r[:]) for r in in_refs]
+        planes = [exact_f32(r[:]) for r in in_refs]
         for op in pointwise:
             planes = _apply_pointwise_planes(op, planes)
     else:
@@ -432,7 +426,7 @@ def _stream_kernel(
 
 
 def _pointwise_kernel(*refs, pointwise, n_in, n_out):
-    planes = [_u8_to_f32(r[:]) for r in refs[:n_in]]
+    planes = [exact_f32(r[:]) for r in refs[:n_in]]
     for op in pointwise:
         planes = _apply_pointwise_planes(op, planes)
     assert len(planes) == n_out
@@ -528,6 +522,7 @@ def run_group(
                 jax.ShapeDtypeStruct((height, width), U8) for _ in range(n_out)
             ],
             interpret=interpret,
+            compiler_params=_COMPILER_PARAMS,
         )(*planes)
         outs = outs if isinstance(outs, (tuple, list)) else [outs]
         return list(outs)

@@ -320,6 +320,24 @@ SOBEL = StencilOp(
     quantize="rint_clip",
 )
 
+PREWITT = StencilOp(
+    name="prewitt",
+    halo=1,
+    kernels=(filters.PREWITT_GX, filters.PREWITT_GY),
+    combine="magnitude",
+    edge_mode="reflect101",
+    quantize="rint_clip",
+)
+
+SCHARR = StencilOp(
+    name="scharr",
+    halo=1,
+    kernels=(filters.SCHARR_GX, filters.SCHARR_GY),
+    combine="magnitude",
+    edge_mode="reflect101",
+    quantize="rint_clip",
+)
+
 SHARPEN = StencilOp(
     name="sharpen",
     halo=1,
@@ -327,6 +345,63 @@ SHARPEN = StencilOp(
     edge_mode="reflect101",
     quantize="rint_clip",
 )
+
+UNSHARP = StencilOp(
+    name="unsharp",
+    halo=2,
+    kernels=(filters.UNSHARP5,),
+    scale=filters.UNSHARP5_SCALE,  # power of two — exact
+    edge_mode="reflect101",
+    quantize="rint_clip",
+)
+
+
+def make_laplacian(neighbours: int) -> StencilOp:
+    if neighbours not in (4, 8):
+        raise ValueError(f"laplacian connectivity must be 4 or 8, got {neighbours}")
+    k = filters.LAPLACIAN4 if neighbours == 4 else filters.LAPLACIAN8
+    return StencilOp(
+        name=f"laplacian{neighbours}",
+        halo=1,
+        kernels=(k,),
+        edge_mode="reflect101",
+        quantize="rint_clip",  # saturating u8, like filter2D -> CV_8U
+    )
+
+
+def make_filter(arg: str | None) -> StencilOp:
+    """Arbitrary odd-square correlation kernel — the framework's counterpart
+    to the reference's cv::filter2D with a hand-built Mat (kern.cpp:62-75).
+
+    Spec: ``filter:v1/v2/.../vK*K[:scale]`` with K in {3, 5, 7} inferred
+    from the value count; weights ``w[dy, dx]`` row-major. ``/`` separates
+    values inside pipeline strings (where ``,`` separates ops); standalone
+    specs may use ``,`` too. Integer weights (with any single post-scale)
+    keep the framework's cross-backend bit-exactness guarantee; non-integer
+    weights are deterministic per backend but may differ in the last ulp
+    before quantization.
+    """
+    if not arg:
+        raise ValueError("filter needs filter:v1/v2/...[:scale]")
+    parts = arg.split(":")
+    sep = "/" if "/" in parts[0] else ","
+    vals = [float(v) for v in parts[0].split(sep) if v.strip()]
+    size = int(round(len(vals) ** 0.5))
+    if size * size != len(vals) or size not in (3, 5, 7):
+        raise ValueError(
+            f"filter needs 9, 25 or 49 comma-separated values "
+            f"(3x3/5x5/7x7 row-major), got {len(vals)}"
+        )
+    scale = float(parts[1]) if len(parts) > 1 else 1.0
+    k = np.asarray(vals, dtype=np.float32).reshape(size, size)
+    return StencilOp(
+        name=f"filter{size}x{size}",
+        halo=(size - 1) // 2,
+        kernels=(k,),
+        scale=scale,
+        edge_mode="reflect101",  # filter2D's default border (kern.cpp:75)
+        quantize="rint_clip",
+    )
 
 # --------------------------------------------------------------------------
 # Registry
@@ -396,7 +471,12 @@ REGISTRY: dict[str, Callable[[str | None], Op]] = {
     "gaussian": lambda a: make_gaussian(_int_arg(a, 5)),
     "box": lambda a: make_box(_int_arg(a, 3)),
     "sobel": lambda a: SOBEL,
+    "prewitt": lambda a: PREWITT,
+    "scharr": lambda a: SCHARR,
     "sharpen": lambda a: SHARPEN,
+    "unsharp": lambda a: UNSHARP,
+    "laplacian": lambda a: make_laplacian(_int_arg(a, 4)),
+    "filter": make_filter,
     "gamma": lambda a: make_lut_op(
         f"gamma{_float_arg(a, 1.0):g}", make_gamma_lut(_float_arg(a, 1.0))
     ),

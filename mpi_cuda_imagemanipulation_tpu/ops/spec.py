@@ -80,6 +80,18 @@ QUANTIZERS_F32: dict[str, Callable[[jnp.ndarray], jnp.ndarray]] = {
 # --------------------------------------------------------------------------
 
 
+def exact_f32(t: jnp.ndarray) -> jnp.ndarray:
+    """Cast integer-valued data to f32 preserving exact values.
+
+    Mosaic has no unsigned<->float casts, so u8 bridges through int32 —
+    the single definition of that workaround; every tile function and
+    Pallas kernel routes through here. No-op on f32 input (the golden
+    path), so behaviour is identical across backends."""
+    if t.dtype == F32:
+        return t
+    return t.astype(jnp.int32).astype(F32)
+
+
 def corr_valid(xpad: jnp.ndarray, weights: np.ndarray) -> jnp.ndarray:
     """Valid-mode 2-D correlation via unrolled static shifts.
 
@@ -102,9 +114,7 @@ def corr_valid(xpad: jnp.ndarray, weights: np.ndarray) -> jnp.ndarray:
             w = float(weights[dy, dx])
             if w == 0.0:
                 continue
-            win = xpad[dy : dy + out_h, dx : dx + out_w]
-            if win.dtype != F32:
-                win = win.astype(jnp.int32).astype(F32)
+            win = exact_f32(xpad[dy : dy + out_h, dx : dx + out_w])
             term = win if w == 1.0 else win * w
             acc = term if acc is None else acc + term
     if acc is None:
@@ -136,9 +146,7 @@ def window_reduce_1d(
     out_len = xpad.shape[axis] - (k - 1)
     acc = None
     for d in range(k):
-        win = lax.slice_in_dim(xpad, d, d + out_len, axis=axis)
-        if win.dtype not in (F32, jnp.int32):
-            win = win.astype(jnp.int32).astype(F32)
+        win = exact_f32(lax.slice_in_dim(xpad, d, d + out_len, axis=axis))
         acc = win if acc is None else fn(acc, win)
     return acc
 
@@ -163,12 +171,9 @@ def median9_valid(xpad: jnp.ndarray) -> jnp.ndarray:
     out_h = xpad.shape[0] - 2
     out_w = xpad.shape[1] - 2
     p = [
-        xpad[dy : dy + out_h, dx : dx + out_w]
+        exact_f32(xpad[dy : dy + out_h, dx : dx + out_w])
         for dy in range(3)
         for dx in range(3)
-    ]
-    p = [
-        t if t.dtype == F32 else t.astype(jnp.int32).astype(F32) for t in p
     ]
     for i, j in _MEDIAN9_EXCHANGES:
         p[i], p[j] = _sort2(p[i], p[j])
