@@ -1,0 +1,109 @@
+"""Real-hardware validation + timing sweep (run manually on a TPU host).
+
+CI runs everything on CPU (interpret-mode Pallas, 8 fake devices); this
+script is the hardware half of the test strategy (SURVEY.md §4): it
+re-asserts cross-backend bit-exactness with *compiled* Mosaic kernels on
+the real chip, then times the headline configs with the N-scaling slope
+timer. Usage:
+
+    python tools/tpu_validate.py            # bit-exactness sweep
+    python tools/tpu_validate.py --bench    # + throughput table
+    python tools/tpu_validate.py --quick    # fewer shapes (fast smoke)
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SPECS = [
+    ("gaussian:5", 1),
+    ("gaussian:7", 1),
+    ("sobel", 1),
+    ("prewitt", 1),
+    ("scharr", 1),
+    ("laplacian:8", 1),
+    ("unsharp", 1),
+    ("filter:1/2/1/2/4/2/1/2/1:0.0625", 1),
+    ("emboss:3", 1),
+    ("emboss:5", 1),
+    ("emboss101:5", 1),
+    ("median", 1),
+    ("erode:5", 1),
+    ("dilate:3", 1),
+    ("box:7", 1),
+    ("sharpen", 1),
+    ("grayscale,contrast:3.5,emboss:3", 3),
+    ("gaussian:5", 3),
+    ("invert,gaussian:5,threshold:99", 1),
+    ("grayscale,gaussian:7", 3),
+]
+
+SHAPES = [(129, 517), (40, 300), (257, 1024), (96, 2048), (65, 140)]
+QUICK_SHAPES = [(129, 517), (65, 140)]
+
+
+def run_sweep(shapes) -> int:
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mpi_cuda_imagemanipulation_tpu.io.image import synthetic_image
+    from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import pipeline_pallas
+    from mpi_cuda_imagemanipulation_tpu.ops.registry import make_pipeline_ops
+
+    fails = 0
+    for spec, ch in SPECS:
+        for hw in shapes:
+            t0 = time.time()
+            img = jnp.asarray(synthetic_image(*hw, channels=ch, seed=3))
+            ops = make_pipeline_ops(spec)
+            golden = img
+            for op in ops:
+                golden = op(golden)
+            got = pipeline_pallas(ops, img)
+            ok = np.array_equal(np.asarray(got), np.asarray(golden))
+            if not ok:
+                d = np.abs(
+                    np.asarray(got).astype(int) - np.asarray(golden).astype(int)
+                )
+                print(
+                    f"FAIL {spec} ch{ch} {hw}: maxdiff {d.max()} "
+                    f"ndiff {np.count_nonzero(d)}",
+                    flush=True,
+                )
+                fails += 1
+            else:
+                print(
+                    f"ok   {spec:34s} ch{ch} {str(hw):12s} {time.time()-t0:5.1f}s",
+                    flush=True,
+                )
+    print("FAILS:", fails, flush=True)
+    return fails
+
+
+def run_bench() -> None:
+    from mpi_cuda_imagemanipulation_tpu.bench_suite import run_suite
+
+    run_suite(impl="both")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--bench", action="store_true")
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+    import jax
+
+    print("backend:", jax.default_backend(), jax.devices(), flush=True)
+    fails = run_sweep(QUICK_SHAPES if args.quick else SHAPES)
+    if args.bench:
+        run_bench()
+    return 1 if fails else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
