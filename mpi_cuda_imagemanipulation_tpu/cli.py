@@ -74,6 +74,19 @@ def _build_parser() -> argparse.ArgumentParser:
         default=None,
         help="capture a jax.profiler trace (TensorBoard/Perfetto) to this dir",
     )
+    run.add_argument(
+        "--block",
+        type=int,
+        default=None,
+        help="Pallas row-block height override (the reference's BLOCK_SIZE "
+        "knob, kernel.cu:13; default: auto-tuned to VMEM)",
+    )
+    run.add_argument(
+        "--show",
+        action="store_true",
+        help="open the result in the system image viewer (the reference's "
+        "imshow/waitKey, kernel.cu:233-235; no-op on headless hosts)",
+    )
 
     batch = sub.add_parser(
         "batch", help="run a pipeline over every image in a directory"
@@ -154,9 +167,11 @@ def cmd_run(args: argparse.Namespace) -> int:
 
     if args.shards > 1:
         mesh = make_mesh(args.shards)
+        if args.block:
+            log.warning("--block applies to single-device Pallas runs; ignored")
         fn = pipe.sharded(mesh, backend=args.impl)
     else:
-        fn = pipe.jit(backend=args.impl)
+        fn = pipe.jit(backend=args.impl, block_h=args.block)
 
     if args.profile_dir:
         jax.profiler.start_trace(args.profile_dir)
@@ -182,6 +197,13 @@ def cmd_run(args: argparse.Namespace) -> int:
         out = gray_to_rgb(out)
     save_image(args.output, out)
     log.info("wrote %s: %s", args.output, out.shape)
+    if args.show:
+        try:
+            from PIL import Image
+
+            Image.fromarray(out).show(title=args.output)
+        except Exception as e:  # headless host — keep the batch exit clean
+            log.warning("--show failed (headless?): %s", e)
 
     mp = img.shape[0] * img.shape[1] / 1e6
     if args.show_timing and steady_s is not None:

@@ -58,7 +58,7 @@ class Pipeline:
 
     # -- compiled entry points -------------------------------------------
 
-    def _callable(self, backend: str):
+    def _callable(self, backend: str, block_h: int | None = None):
         if backend == "xla":
             return self.apply
         if backend == "pallas":
@@ -66,18 +66,21 @@ class Pipeline:
                 pipeline_pallas,
             )
 
-            return partial(pipeline_pallas, self.ops)
+            return partial(pipeline_pallas, self.ops, block_h=block_h)
         if backend == "auto":
             from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
                 pipeline_auto,
             )
 
-            return partial(pipeline_auto, self.ops)
+            return partial(pipeline_auto, self.ops, block_h=block_h)
         raise ValueError(f"unknown backend {backend!r}; known: {BACKENDS}")
 
-    def jit(self, backend: str = "xla"):
-        """A jitted image -> image function on the current default device."""
-        return jax.jit(self._callable(backend))
+    def jit(self, backend: str = "xla", block_h: int | None = None):
+        """A jitted image -> image function on the current default device.
+
+        `block_h` overrides the Pallas row-block height (the reference's
+        BLOCK_SIZE knob, kernel.cu:13); None auto-tunes to VMEM."""
+        return jax.jit(self._callable(backend, block_h=block_h))
 
     def batched(self, backend: str = "xla"):
         """A jitted (N, H, W[, C]) -> (N, ...) batch function: one compiled

@@ -650,7 +650,13 @@ def stencil_tile_pallas(
     return out[:local_h]
 
 
-def pipeline_pallas(ops, img: jnp.ndarray, *, interpret: bool | None = None):
+def pipeline_pallas(
+    ops,
+    img: jnp.ndarray,
+    *,
+    interpret: bool | None = None,
+    block_h: int | None = None,
+):
     """Run a full pipeline through fused Pallas group kernels.
 
     Same uint8 semantics as the golden path (bit-exact — asserted by
@@ -661,7 +667,9 @@ def pipeline_pallas(ops, img: jnp.ndarray, *, interpret: bool | None = None):
     else:
         planes = [img]
     for pointwise, stencil in group_ops(ops):
-        planes = run_group(pointwise, stencil, planes, interpret=interpret)
+        planes = run_group(
+            pointwise, stencil, planes, interpret=interpret, block_h=block_h
+        )
     if len(planes) == 1:
         return planes[0]
     return jnp.stack(planes, axis=-1)
@@ -696,7 +704,13 @@ def use_pallas_for_stencil(stencil: StencilOp | None, group_in_channels: int) ->
     return group_in_channels == 1 and len(stencil.kernels) > 1
 
 
-def pipeline_auto(ops, img: jnp.ndarray, *, interpret: bool | None = None):
+def pipeline_auto(
+    ops,
+    img: jnp.ndarray,
+    *,
+    interpret: bool | None = None,
+    block_h: int | None = None,
+):
     """Per-group backend selection: golden/XLA ops where XLA's fusion wins,
     Pallas group kernels where the stencil working set favours them.
     Bit-exact with both pure paths (they are bit-exact with each other)."""
@@ -709,7 +723,9 @@ def pipeline_auto(ops, img: jnp.ndarray, *, interpret: bool | None = None):
                 if state.ndim == 3
                 else [state]
             )
-            planes = run_group(pointwise, stencil, planes, interpret=interpret)
+            planes = run_group(
+                pointwise, stencil, planes, interpret=interpret, block_h=block_h
+            )
             state = planes[0] if len(planes) == 1 else jnp.stack(planes, -1)
         else:
             for op in pointwise:
