@@ -41,9 +41,10 @@ class BenchConfig:
     width: int
     channels: int
     sharded: bool = False  # row-shard over every visible device
+    batch: int = 0  # >0: vmap-stack this many images per dispatch
 
 
-# BASELINE.json "configs", in order.
+# BASELINE.json "configs", in order, plus beyond-parity extras.
 CONFIGS: dict[str, BenchConfig] = {
     c.name: c
     for c in [
@@ -54,6 +55,14 @@ CONFIGS: dict[str, BenchConfig] = {
         BenchConfig("gaussian7_8k", "gaussian:7", 4320, 7680, 1),
         BenchConfig("reference_pipeline_4k", "grayscale,contrast:3.5,emboss:3", 2160, 3840, 3),
         BenchConfig("gaussian5_8k_sharded", "gaussian:5", 4320, 7680, 1, sharded=True),
+        BenchConfig(
+            "reference_1080p_batch8",
+            "grayscale,contrast:3.5,emboss:3",
+            1080, 1920, 3,
+            batch=8,  # dispatch amortisation via Pipeline.batched
+        ),
+        BenchConfig("median3_4k", "median:3", 2160, 3840, 1),
+        BenchConfig("erode5_4k", "erode:5", 2160, 3840, 1),
     ]
 }
 
@@ -61,17 +70,33 @@ CONFIGS: dict[str, BenchConfig] = {
 def run_config(
     cfg: BenchConfig, impl: str, *, n_hi: int = 60
 ) -> dict:
-    img = jnp.asarray(
-        synthetic_image(cfg.height, cfg.width, channels=cfg.channels, seed=99)
-    )
+    if cfg.batch:
+        import numpy as np
+
+        img = jnp.asarray(
+            np.stack(
+                [
+                    synthetic_image(
+                        cfg.height, cfg.width, channels=cfg.channels, seed=99 + k
+                    )
+                    for k in range(cfg.batch)
+                ]
+            )
+        )
+    else:
+        img = jnp.asarray(
+            synthetic_image(cfg.height, cfg.width, channels=cfg.channels, seed=99)
+        )
     pipe = Pipeline.parse(cfg.pipeline)
     n_chips = len(jax.devices()) if cfg.sharded else 1
     if cfg.sharded:
         fn = pipe.sharded(make_mesh(n_chips), backend=impl)
+    elif cfg.batch:
+        fn = pipe.batched(backend=impl)
     else:
         fn = pipe.jit(backend=impl)
     sec = device_throughput(fn, [img], n_hi=n_hi)
-    mp = cfg.height * cfg.width / 1e6
+    mp = cfg.height * cfg.width * max(1, cfg.batch) / 1e6
     return {
         "config": cfg.name,
         "pipeline": cfg.pipeline,

@@ -44,7 +44,14 @@ def test_uneven_height_not_truncated(n, height):
     _assert_sharded_equals_golden(reference_pipeline(), img, n)
 
 
-@pytest.mark.parametrize("spec", ["gaussian:5", "gaussian:7", "sobel", "box:3", "sharpen"])
+@pytest.mark.parametrize(
+    "spec",
+    [
+        "gaussian:5", "gaussian:7", "sobel", "box:3", "sharpen",
+        "prewitt", "scharr", "laplacian:8", "unsharp",
+        "filter:1/2/1/2/4/2/1/2/1:0.0625",
+    ],
+)
 def test_reflect_stencils_sharded_bitexact(spec):
     img = synthetic_image(133, 80, channels=1, seed=22)
     _assert_sharded_equals_golden(Pipeline.parse(spec), img, 8)
