@@ -16,32 +16,36 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
-def _tpu_responsive(timeout_s: float = 150.0) -> bool:
-    """Probe the TPU in a subprocess with a hard timeout.
+def _probe_accelerator(timeout_s: float = 150.0) -> str:
+    """Return the default backend platform ('tpu', 'cpu', ...) probed in a
+    subprocess with a hard timeout, or 'wedged' on hang/failure.
 
     The tunnelled chip on this machine can wedge in a way that makes any
     backend call block forever (observed after a Mosaic compiler crash);
-    probing in-process would hang the whole benchmark. A dead probe means
-    we fall back to CPU and say so in the record, rather than hanging the
-    driver."""
+    probing in-process would hang the whole benchmark."""
     try:
         proc = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
+            [sys.executable, "-c", "import jax; print(jax.default_backend())"],
             timeout=timeout_s,
             capture_output=True,
+            text=True,
         )
-        return proc.returncode == 0
+        if proc.returncode != 0:
+            return "wedged"
+        return proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else "wedged"
     except subprocess.TimeoutExpired:
-        return False
+        return "wedged"
 
 
 def main() -> int:
-    tpu_ok = os.environ.get("JAX_PLATFORMS", "") in ("", "axon", "tpu")
-    if tpu_ok and not _tpu_responsive():
+    platform = _probe_accelerator()
+    wedged = platform == "wedged"
+    if wedged:
         os.environ.pop("PALLAS_AXON_POOL_IPS", None)
         os.environ["JAX_PLATFORMS"] = "cpu"
-        tpu_ok = False
+        platform = "cpu"
         print("TPU unresponsive; falling back to CPU", file=sys.stderr)
+    on_tpu = platform in ("tpu", "axon")
 
     from mpi_cuda_imagemanipulation_tpu.bench_suite import (
         HEADLINE,
@@ -51,7 +55,7 @@ def main() -> int:
 
     import jax
 
-    if not tpu_ok:
+    if wedged:
         jax.config.update("jax_platforms", "cpu")
 
     names = [HEADLINE]
@@ -59,17 +63,20 @@ def main() -> int:
         names.append(HEADLINE + "_sharded")
     records = run_suite(
         names=names,
-        # CPU fallback: XLA only — interpret-mode Pallas on an 8K image
-        # would take longer than the driver's patience
-        impl="both" if tpu_ok else "xla",
+        # off-TPU (wedged fallback, or a CPU-only host): XLA only —
+        # interpret-mode Pallas on an 8K image would take longer than the
+        # driver's patience
+        impl="both" if on_tpu else "xla",
         printer=lambda s: print(s, file=sys.stderr),
     )
     rec = headline_record(records)
     if rec is None:
         print(json.dumps({"error": "no benchmark record produced"}))
         return 1
-    if not tpu_ok:
+    if wedged:
         rec["platform"] = "cpu-fallback (TPU tunnel unresponsive)"
+    elif not on_tpu:
+        rec["platform"] = platform
     print(json.dumps(rec))
     return 0
 

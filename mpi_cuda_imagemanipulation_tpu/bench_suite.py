@@ -67,9 +67,7 @@ CONFIGS: dict[str, BenchConfig] = {
 }
 
 
-def run_config(
-    cfg: BenchConfig, impl: str, *, n_hi: int = 60
-) -> dict:
+def run_config(cfg: BenchConfig, impl: str) -> dict:
     if cfg.batch:
         import numpy as np
 
@@ -95,7 +93,7 @@ def run_config(
         fn = pipe.batched(backend=impl)
     else:
         fn = pipe.jit(backend=impl)
-    sec = device_throughput(fn, [img], n_hi=n_hi)
+    sec = device_throughput(fn, [img])
     mp = cfg.height * cfg.width * max(1, cfg.batch) / 1e6
     return {
         "config": cfg.name,

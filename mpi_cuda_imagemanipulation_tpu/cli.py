@@ -171,6 +171,8 @@ def cmd_run(args: argparse.Namespace) -> int:
             log.warning("--block applies to single-device Pallas runs; ignored")
         fn = pipe.sharded(mesh, backend=args.impl)
     else:
+        if args.block and args.impl == "xla":
+            log.warning("--block only affects Pallas kernels; ignored for xla")
         fn = pipe.jit(backend=args.impl, block_h=args.block)
 
     if args.profile_dir:

@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 
 from mpi_cuda_imagemanipulation_tpu.ops.registry import (
+    REFERENCE_CPU_PIPELINE_SPEC,
     REFERENCE_PIPELINE_SPEC,
     make_pipeline_ops,
 )
@@ -104,3 +105,10 @@ def reference_pipeline() -> Pipeline:
     """The reference's exact pipeline: grayscale -> contrast 3.5 -> emboss 3x3
     (kernel.cu:192-195, smallEmboss=true)."""
     return Pipeline.parse(REFERENCE_PIPELINE_SPEC)
+
+
+def reference_cpu_pipeline() -> Pipeline:
+    """The reference's CPU/OpenCV program (kern.cpp:73-75): Rec.601
+    grayscale, contrast 3, reflect-101 emboss — the variant whose numeric
+    choices differ from kernel.cu's (SURVEY.md §2.2)."""
+    return Pipeline.parse(REFERENCE_CPU_PIPELINE_SPEC)

@@ -581,3 +581,9 @@ def make_pipeline_ops(spec: str) -> tuple[Op, ...]:
 
 
 REFERENCE_PIPELINE_SPEC = "grayscale,contrast:3.5,emboss:3"
+
+# The OTHER reference program (kern.cpp:73-75, the CPU/OpenCV variant):
+# Rec.601 rounded grayscale, contrast factor 3 (kern.cpp:74 — integer
+# result, so truncating vs rounding quantization cannot differ), and
+# filter2D emboss with reflect-101 borders. SURVEY.md §2.2/§2.6.
+REFERENCE_CPU_PIPELINE_SPEC = "grayscale601,contrast:3,emboss101:3"

@@ -75,3 +75,31 @@ def test_longer_pipeline_composes():
     out = np.asarray(pipe(jnp.asarray(rgb)))
     assert out.shape == (48, 64, 3)
     assert out.dtype == np.uint8
+
+
+def test_reference_cpu_pipeline_matches_opencv_semantics_oracle():
+    """kern.cpp program parity (kern.cpp:73-75): Rec.601 rounded grayscale,
+    contrast 3 (integer-exact), filter2D emboss with reflect-101 borders,
+    each step saturating to u8 — float64 loop oracle, no shared code."""
+    import numpy as np
+
+    from mpi_cuda_imagemanipulation_tpu.models.pipeline import (
+        reference_cpu_pipeline,
+    )
+
+    img = synthetic_image(47, 61, channels=3, seed=91)
+    f = img.astype(np.float64)
+    gray = np.floor(
+        (f[..., 0] * 4899 + f[..., 1] * 9617 + f[..., 2] * 1868 + 8192)
+        / 16384.0
+    )
+    con = np.clip(3.0 * (gray - 128.0) + 128.0, 0, 255)
+    k = np.array([[-2, -1, 0], [-1, 1, 1], [0, 1, 2]], np.float64)
+    pad = np.pad(con, 1, mode="reflect")
+    emb = np.zeros_like(con)
+    for dy in range(3):
+        for dx in range(3):
+            emb += k[dy, dx] * pad[dy : dy + con.shape[0], dx : dx + con.shape[1]]
+    expect = np.clip(np.rint(emb), 0, 255).astype(np.uint8)
+    got = np.asarray(reference_cpu_pipeline()(jnp.asarray(img)))
+    np.testing.assert_array_equal(got, expect)
