@@ -2,82 +2,220 @@
 """Driver benchmark entry point: prints ONE JSON line with the headline
 metric (BASELINE.json): megapixels/sec/chip on 8K 5x5 Gaussian.
 
-Runs the 8K 5x5 separable-Gaussian config through both backends (XLA-fused
-golden ops and the Pallas fused kernel) on the available TPU chip(s) and
-reports the best, relative to the estimated reference CUDA+MPI 4xV100
-number (derivation in BASELINE.md — the reference publishes no numbers).
+Hardened orchestrator (round-2 redesign, after round 1 lost its TPU number
+to a single wedged probe): this process never imports jax — the tunnelled
+TPU on this machine can wedge so that merely initializing its backend
+blocks forever. All device work happens in per-config subprocesses
+(`python -m mpi_cuda_imagemanipulation_tpu.bench_suite --config ... --impl
+...`, each printing one JSON record), so a Mosaic crash or tunnel wedge
+costs one config, not the suite. The TPU probe retries with backoff, is
+re-checked after any config failure, and the CPU fallback is a labelled
+last resort only after every probe attempt fails.
+
+The reference's analogue is its self-timing (kernel.cu:190,226-232); the
+vs_baseline denominator derivation is in BASELINE.md.
 """
+
+from __future__ import annotations
 
 import json
 import os
 import subprocess
 import sys
+import time
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+REPO = os.path.dirname(os.path.abspath(__file__))
+
+HEADLINE = "gaussian5_8k"  # mirrors bench_suite.HEADLINE (jax-free here)
+# mirrors bench_suite.REFERENCE_BASELINE_MP_S_PER_CHIP — duplicated because
+# importing bench_suite would initialize the (possibly wedged) TPU backend
+# in this process; tests/test_io_cli.py asserts the two stay equal.
+REFERENCE_BASELINE_MP_S_PER_CHIP = 1850.0
+
+# (timeout_s, sleep_before_s): three attempts spanning ~7 minutes worst
+# case. First compile over the tunnel is slow (~20-40 s), so even the
+# healthy path needs a generous first timeout.
+PROBE_SCHEDULE = ((90, 0), (120, 20), (180, 60))
+RETRY_PROBE_SCHEDULE = ((90, 0), (120, 30))
+CONFIG_TIMEOUT_S = 900
 
 
-def _probe_accelerator(timeout_s: float = 150.0) -> str:
-    """Return the default backend platform ('tpu', 'cpu', ...) probed in a
-    subprocess with a hard timeout, or 'wedged' on hang/failure.
+def _env_schedule(var: str, default):
+    """Override a probe schedule via e.g. MCIM_PROBE_SCHEDULE='10:0,20:5'
+    (timeout:sleep pairs) — used by tests and manual runs."""
+    raw = os.environ.get(var)
+    if not raw:
+        return default
+    return tuple(
+        (float(t), float(s)) for t, s in (item.split(":") for item in raw.split(","))
+    )
 
-    The tunnelled chip on this machine can wedge in a way that makes any
-    backend call block forever (observed after a Mosaic compiler crash);
-    probing in-process would hang the whole benchmark."""
+
+PROBE_SCHEDULE = _env_schedule("MCIM_PROBE_SCHEDULE", PROBE_SCHEDULE)
+RETRY_PROBE_SCHEDULE = _env_schedule("MCIM_RETRY_PROBE_SCHEDULE", RETRY_PROBE_SCHEDULE)
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _cpu_env() -> dict:
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # sitecustomize skips axon without it
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def _probe_once(timeout_s: float, env: dict | None = None):
+    """(platform, n_devices) via a tiny real computation in a subprocess, or
+    None on hang/failure. A real reduction matters: the backend can finish
+    initializing and still wedge at the first compute dispatch."""
+    code = (
+        "import jax, jax.numpy as jnp; "
+        "b = jax.default_backend(); n = len(jax.devices()); "
+        "s = float(jnp.sum(jnp.arange(64.0))); "
+        "print('PROBE_OK', b, n, flush=True)"
+    )
     try:
         proc = subprocess.run(
-            [sys.executable, "-c", "import jax; print(jax.default_backend())"],
+            [sys.executable, "-c", code],
             timeout=timeout_s,
             capture_output=True,
             text=True,
+            env=env,
         )
-        if proc.returncode != 0:
-            return "wedged"
-        return proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else "wedged"
     except subprocess.TimeoutExpired:
-        return "wedged"
+        return None
+    if proc.returncode != 0:
+        return None
+    for line in reversed(proc.stdout.strip().splitlines()):
+        parts = line.split()
+        if len(parts) == 3 and parts[0] == "PROBE_OK":
+            return parts[1], int(parts[2])
+    return None
+
+
+def _probe_with_backoff(schedule) -> tuple[str, int] | None:
+    for i, (timeout_s, sleep_s) in enumerate(schedule):
+        if sleep_s:
+            _log(f"probe: sleeping {sleep_s}s before retry")
+            time.sleep(sleep_s)
+        got = _probe_once(timeout_s)
+        if got is not None:
+            _log(f"probe: platform={got[0]} devices={got[1]}")
+            return got
+        _log(f"probe attempt {i + 1}/{len(schedule)} failed (timeout {timeout_s}s)")
+    return None
+
+
+def _run_config(name: str, impl: str, env: dict | None = None):
+    """One (config, impl) in an isolated subprocess -> (record, error)."""
+    cmd = [
+        sys.executable,
+        "-m",
+        "mpi_cuda_imagemanipulation_tpu.bench_suite",
+        "--config",
+        name,
+        "--impl",
+        impl,
+    ]
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            cmd,
+            timeout=CONFIG_TIMEOUT_S,
+            capture_output=True,
+            text=True,
+            env=env,
+            cwd=REPO,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"{name}/{impl}: timeout after {CONFIG_TIMEOUT_S}s"
+    if proc.returncode != 0:
+        tail = (proc.stderr or proc.stdout or "").strip()[-500:]
+        return None, f"{name}/{impl}: rc={proc.returncode}: {tail}"
+    for line in reversed(proc.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            _log(
+                f"bench {name}/{impl}: {rec['mp_per_s_per_chip']:.0f} MP/s/chip "
+                f"({time.time() - t0:.0f}s wall)"
+            )
+            return rec, None
+    return None, f"{name}/{impl}: no JSON record in output"
+
+
+def _headline(records: list[dict]) -> dict | None:
+    """Best MP/s/chip over the headline configs (mirrors
+    bench_suite.headline_record, kept jax-free here)."""
+    cands = [r for r in records if r["config"] in (HEADLINE, HEADLINE + "_sharded")]
+    if not cands:
+        return None
+    best = max(cands, key=lambda r: r["mp_per_s_per_chip"])
+    rec = {
+        "metric": "megapixels/sec/chip on 8K 5x5 Gaussian",
+        "value": round(best["mp_per_s_per_chip"], 1),
+        "unit": "MP/s/chip",
+        "vs_baseline": round(
+            best["mp_per_s_per_chip"] / REFERENCE_BASELINE_MP_S_PER_CHIP, 2
+        ),
+        "impl": best["impl"],
+        "chips": best["chips"],
+        "platform": best.get("platform"),
+    }
+    if "roofline_frac" in best:
+        rec["roofline_frac"] = round(best["roofline_frac"], 4)
+        rec["tpu_gen"] = best.get("tpu_gen")
+    return rec
 
 
 def main() -> int:
-    platform = _probe_accelerator()
-    wedged = platform == "wedged"
-    if wedged:
-        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        platform = "cpu"
-        print("TPU unresponsive; falling back to CPU", file=sys.stderr)
-    on_tpu = platform in ("tpu", "axon")
+    errors: list[str] = []
+    probed = _probe_with_backoff(PROBE_SCHEDULE)
+    on_tpu = probed is not None and probed[0] in ("tpu", "axon")
 
-    from mpi_cuda_imagemanipulation_tpu.bench_suite import (
-        HEADLINE,
-        headline_record,
-        run_suite,
-    )
+    records: list[dict] = []
+    if on_tpu:
+        n_dev = probed[1]
+        plan = [(HEADLINE, "pallas"), (HEADLINE, "xla")]
+        if n_dev > 1:
+            plan.append((HEADLINE + "_sharded", "pallas"))
+        for name, impl in plan:
+            rec, err = _run_config(name, impl)
+            if rec is None:
+                errors.append(err)
+                _log(f"bench failed: {err}; re-probing TPU")
+                # one backoff cycle + one retry: a transient wedge or a
+                # single Mosaic crash should not forfeit the config
+                if _probe_with_backoff(RETRY_PROBE_SCHEDULE) is not None:
+                    rec, err = _run_config(name, impl)
+                    if rec is None:
+                        errors.append(err)
+            if rec is not None:
+                records.append(rec)
 
-    import jax
-
-    if wedged:
-        jax.config.update("jax_platforms", "cpu")
-
-    names = [HEADLINE]
-    if len(jax.devices()) > 1:
-        names.append(HEADLINE + "_sharded")
-    records = run_suite(
-        names=names,
-        # off-TPU (wedged fallback, or a CPU-only host): XLA only —
-        # interpret-mode Pallas on an 8K image would take longer than the
-        # driver's patience
-        impl="both" if on_tpu else "xla",
-        printer=lambda s: print(s, file=sys.stderr),
-    )
-    rec = headline_record(records)
-    if rec is None:
-        print(json.dumps({"error": "no benchmark record produced"}))
-        return 1
-    if wedged:
+    if not records:
+        # last resort: labelled CPU number so the driver gets *a* record
+        _log("no TPU records; falling back to CPU (labelled)")
+        rec, err = _run_config(HEADLINE, "xla", env=_cpu_env())
+        if rec is None:
+            errors.append(err)
+            print(json.dumps({"error": "no benchmark record produced", "errors": errors}))
+            return 1
         rec["platform"] = "cpu-fallback (TPU tunnel unresponsive)"
-    elif not on_tpu:
-        rec["platform"] = platform
-    print(json.dumps(rec))
+        records.append(rec)
+
+    out = _headline(records)
+    if not on_tpu and records:
+        out["platform"] = records[0]["platform"]
+    if errors:
+        out["partial"] = True
+        out["errors"] = errors
+    print(json.dumps(out))
     return 0
 
 

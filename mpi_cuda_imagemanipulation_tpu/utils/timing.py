@@ -67,6 +67,7 @@ def device_throughput(
     n_lo: int = 40,
     n_hi: int = 160,
     trials: int = 5,
+    budget_s: float = 25.0,
 ) -> float:
     """Seconds per iteration of `fn(*args)` measured device-side.
 
@@ -81,6 +82,12 @@ def device_throughput(
     (observed 7x-too-fast readings on the tunneled chip), and min() keeps
     exactly those. n_lo is large enough that the delta dwarfs single-RTT
     jitter; n_hi grows further if the delta is still under ~30 ms.
+
+    `budget_s` caps total measured wall time: when the per-iteration cost is
+    already far above the RTT noise floor (e.g. a CPU-fallback run of an 8K
+    config at ~200 ms/iter), the full 5x(40+160) schedule would take many
+    minutes; instead the iteration counts shrink so the whole measurement
+    fits the budget while the slope delta still spans >= ~10x the noise.
     """
 
     def wall(n: int) -> float:
@@ -92,7 +99,15 @@ def device_throughput(
         return time.perf_counter() - t0
 
     _sync(fn(*args))  # compile + warm
-    wall(10)  # settle allocator/dispatch caches
+    est = wall(4) / 4  # settle allocator/dispatch caches + rough per-iter cost
+    if est * trials * (n_lo + n_hi) > budget_s:
+        # slow path: the delta target (>= 0.3 s of compute) dwarfs RTT jitter
+        # without needing large counts
+        n_lo = max(2, int(0.05 / est) + 1)
+        n_hi = n_lo + max(4, int(0.3 / est) + 1)
+        trials = min(trials, 3)
+        while est * trials * (n_lo + n_hi) > budget_s and trials > 1:
+            trials -= 1
     # grow n_hi until the measured delta clears the noise floor (~30 ms),
     # so sub-0.1ms kernels don't produce a zero/negative slope
     while n_hi < 4096:

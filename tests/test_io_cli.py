@@ -102,3 +102,67 @@ def test_cli_info():
     assert r.returncode == 0, r.stderr
     assert "mpi_cuda_imagemanipulation_tpu" in r.stdout
     assert "ops:" in r.stdout
+
+
+def test_bench_orchestrator_mirrors_suite_constants():
+    """bench.py stays jax-free (a wedged TPU backend must not block it), so
+    it duplicates two bench_suite values; assert they cannot drift."""
+    import importlib.util
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench_orchestrator", os.path.join(repo, "bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    from mpi_cuda_imagemanipulation_tpu import bench_suite
+
+    assert mod.HEADLINE == bench_suite.HEADLINE
+    assert (
+        mod.REFERENCE_BASELINE_MP_S_PER_CHIP
+        == bench_suite.REFERENCE_BASELINE_MP_S_PER_CHIP
+    )
+    # the orchestrator module must not import jax at module level
+    import ast
+
+    with open(os.path.join(repo, "bench.py")) as f:
+        tree = ast.parse(f.read())
+    top_imports = {
+        n.name if isinstance(node, ast.Import) else node.module
+        for node in tree.body
+        if isinstance(node, (ast.Import, ast.ImportFrom))
+        for n in (node.names if isinstance(node, ast.Import) else [node])
+    }
+    assert "jax" not in top_imports
+    assert not any(m.startswith("mpi_cuda_") for m in top_imports if m)
+
+
+def test_bench_worker_single_config_json():
+    """The per-config subprocess worker prints exactly one JSON record."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "mpi_cuda_imagemanipulation_tpu.bench_suite",
+            "--config",
+            "grayscale_1080p",
+            "--impl",
+            "xla",
+        ],
+        capture_output=True,
+        text=True,
+        env=env,
+        cwd=repo,
+        timeout=300,
+    )
+    assert r.returncode == 0, r.stderr
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["config"] == "grayscale_1080p"
+    assert rec["mp_per_s_per_chip"] > 0
+    # one fused group: 3 u8 input planes read + 1 u8 gray plane written
+    assert rec["hbm_bytes_model"] == (3 + 1) * 1080 * 1920
