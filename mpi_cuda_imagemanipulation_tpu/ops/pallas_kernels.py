@@ -50,7 +50,6 @@ from mpi_cuda_imagemanipulation_tpu.ops.spec import (
     StencilOp,
     QUANTIZERS_F32,
     exact_f32,
-    median9_valid,
     window_reduce_1d,
 )
 
@@ -142,6 +141,9 @@ def _weighted_terms(w: np.ndarray, sl) -> jnp.ndarray:
                 continue
             t = exact_f32(sl(d))
             terms.append(t if wi[d] == 1.0 else t * np.float32(wi[d]))
+    if not terms:  # all-zero weights: match corr_valid's zeros result
+        probe = exact_f32(sl(0))
+        return jnp.zeros(probe.shape, probe.dtype)
     acc = terms[0]
     for t in terms[1:]:
         acc = acc + t
@@ -255,7 +257,10 @@ def _split_passes(op: StencilOp, width: int):
     """
     h = op.halo
     mode = op.edge_mode
-    if op.reduce in ("min", "max"):
+    if op.reduce in ("min", "max") and op.edge_mode != "interior":
+        # interior mode falls through to the raw-rows branch below: its
+        # pass-through needs original pixels, not row-reduced values
+        # (advisor round-1 finding; no registry op hits it today)
         fn = jnp.minimum if op.reduce == "min" else jnp.maximum
         kh, kw = op.kernels[0].shape
         return (
@@ -277,14 +282,8 @@ def _split_passes(op: StencilOp, width: int):
 
         return (lambda x: _row_corr(x, w1d, h, mode), col_pass, width, True)
     # non-separable (or interior-mode, which needs raw rows for the
-    # pass-through): stream raw rows at full extended width
-    if op.reduce == "median":
-        return (
-            lambda x: _row_identity_ext(x, h, mode),
-            median9_valid,
-            width + 2 * h,
-            False,
-        )
+    # pass-through): stream raw rows at full extended width; op.valid
+    # dispatches median (selection network) and interior min/max itself
     return (
         lambda x: _row_identity_ext(x, h, mode),
         op.valid,

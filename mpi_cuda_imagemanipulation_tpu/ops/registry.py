@@ -297,14 +297,18 @@ def make_morph(kind: str, size: int) -> StencilOp:
 
 
 def make_median(size: int) -> StencilOp:
-    if size != 3:
+    """Rank filter: 3x3 via Paeth's 19-exchange median-of-9 network, 5x5 via
+    a median-pruned Batcher odd-even network (113 min/max exchanges on 25
+    wires) — see spec._MEDIAN_NETWORKS. Both are pure elementwise min/max,
+    so they lower in Mosaic and are exact on u8-valued f32."""
+    if size not in (3, 5):
         raise ValueError(
-            f"median supports size 3 (median-of-9 selection network), got {size}"
+            f"median supports sizes 3 and 5 (selection networks), got {size}"
         )
     return StencilOp(
-        name="median3",
-        halo=1,
-        kernels=(np.ones((3, 3), np.float32),),
+        name=f"median{size}",
+        halo=(size - 1) // 2,
+        kernels=(np.ones((size, size), np.float32),),
         reduce="median",
         edge_mode="reflect101",
         quantize="rint_clip",

@@ -165,19 +165,69 @@ _MEDIAN9_EXCHANGES = (
 )
 
 
-def median9_valid(xpad: jnp.ndarray) -> jnp.ndarray:
-    """Valid-mode 3x3 median via the median-of-9 selection network.
-    u8 input is shifted packed, then cast per-window (see window_reduce_1d)."""
-    out_h = xpad.shape[0] - 2
-    out_w = xpad.shape[1] - 2
+def _oddeven_merge_pairs(n: int) -> list[tuple[int, int]]:
+    """Batcher odd-even mergesort comparator pairs for arbitrary n (the
+    standard iterative clipped construction). Correct by the 0-1 principle;
+    additionally verified against numpy sort in tests."""
+    pairs: list[tuple[int, int]] = []
+    p = 1
+    while p < n:
+        k = p
+        while k >= 1:
+            for j in range(k % p, n - k, 2 * k):
+                for i in range(0, min(k, n - j - k)):
+                    if (i + j) // (p * 2) == (i + j + k) // (p * 2):
+                        pairs.append((i + j, i + j + k))
+            k //= 2
+        p *= 2
+    return pairs
+
+
+def _prune_to_median(pairs: list[tuple[int, int]], n: int) -> tuple:
+    """Drop comparators whose outputs never reach the median wire: walking
+    the network backwards from wire n//2, a comparator is live iff either of
+    its (in-place) output wires is needed downstream. 140 -> 113 comparators
+    for n=25."""
+    needed = {n // 2}
+    kept = []
+    for i, j in reversed(pairs):
+        if i in needed or j in needed:
+            kept.append((i, j))
+            needed.add(i)
+            needed.add(j)
+    return tuple(reversed(kept))
+
+
+# size -> (exchange network, median wire index). 3x3 keeps Paeth's
+# hand-crafted 19-exchange network (pruned Batcher needs 24); 5x5 uses the
+# pruned Batcher network (113 min/max exchanges on 25 wires).
+_MEDIAN_NETWORKS = {
+    3: (_MEDIAN9_EXCHANGES, 4),
+    5: (_prune_to_median(_oddeven_merge_pairs(25), 25), 12),
+}
+
+
+def median_valid(xpad: jnp.ndarray, size: int = 3) -> jnp.ndarray:
+    """Valid-mode size x size median via a min/max selection network.
+    u8 input is shifted packed, then cast per-window (see window_reduce_1d).
+    Pure elementwise min/max — exact on u8-valued f32 and lowers in Mosaic
+    (no sort primitive needed)."""
+    exchanges, mid = _MEDIAN_NETWORKS[size]
+    out_h = xpad.shape[0] - (size - 1)
+    out_w = xpad.shape[1] - (size - 1)
     p = [
         exact_f32(xpad[dy : dy + out_h, dx : dx + out_w])
-        for dy in range(3)
-        for dx in range(3)
+        for dy in range(size)
+        for dx in range(size)
     ]
-    for i, j in _MEDIAN9_EXCHANGES:
+    for i, j in exchanges:
         p[i], p[j] = _sort2(p[i], p[j])
-    return p[4]
+    return p[mid]
+
+
+def median9_valid(xpad: jnp.ndarray) -> jnp.ndarray:
+    """Back-compat alias: valid-mode 3x3 median."""
+    return median_valid(xpad, 3)
 
 
 _PAD_MODES = {
@@ -267,9 +317,9 @@ class StencilOp:
                Sobel).
     reduce   : 'corr' (weighted-sum correlation, the default), 'min'/'max'
                (morphological erode/dilate over a square window — computed
-               separably), or 'median' (3x3 rank filter via a selection
-               network). Non-'corr' modes use kernels[0].shape for the
-               window and ignore the weight values.
+               separably), or 'median' (3x3/5x5 rank filter via a min/max
+               selection network). Non-'corr' modes use kernels[0].shape
+               for the window and ignore the weight values.
     edge_mode: 'interior' replicates the reference guard (kernel.cu:83) —
                non-interior pixels pass through the input unchanged; the
                others filter every pixel with the named border extension.
@@ -301,7 +351,7 @@ class StencilOp:
                 window_reduce_1d(xpad, kw, 1, fn), kh, 0, fn
             )
         if self.reduce == "median":
-            return median9_valid(xpad)
+            return median_valid(xpad, self.kernels[0].shape[0])
         if self.separable is not None:
             accs = [separable_valid(xpad, self.separable)]
         else:

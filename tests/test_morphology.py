@@ -35,21 +35,37 @@ def test_morphology_matches_numpy(size, kind, name):
     np.testing.assert_array_equal(got, want)
 
 
-def test_median3_matches_numpy():
+@pytest.mark.parametrize("size", [3, 5])
+def test_median_matches_numpy(size):
     img = synthetic_image(53, 37, channels=1, seed=41)
-    got = np.asarray(make_op("median:3")(jnp.asarray(img)))
-    want = _np_rank_filter(img, 3, "median", "reflect")
+    got = np.asarray(make_op(f"median:{size}")(jnp.asarray(img)))
+    want = _np_rank_filter(img, size, "median", "reflect")
     np.testing.assert_array_equal(got, want)
 
 
 def test_median_rejects_unsupported_size():
     with pytest.raises(ValueError):
-        make_op("median:5")
+        make_op("median:7")
     with pytest.raises(ValueError):
         make_op("erode:4")
 
 
-@pytest.mark.parametrize("spec", ["erode:5", "dilate:3", "median:3"])
+def test_median_networks_select_true_median():
+    # the selection networks themselves (Paeth 19-exchange for 9, pruned
+    # Batcher odd-even for 25) vs numpy median over random u8 wire vectors
+    from mpi_cuda_imagemanipulation_tpu.ops.spec import _MEDIAN_NETWORKS
+
+    rng = np.random.default_rng(7)
+    for size, (exchanges, mid) in _MEDIAN_NETWORKS.items():
+        n = size * size
+        x = rng.integers(0, 256, size=(n, 5000)).astype(np.float32)
+        w = [x[i].copy() for i in range(n)]
+        for i, j in exchanges:
+            w[i], w[j] = np.minimum(w[i], w[j]), np.maximum(w[i], w[j])
+        np.testing.assert_array_equal(w[mid], np.median(x, axis=0))
+
+
+@pytest.mark.parametrize("spec", ["erode:5", "dilate:3", "median:3", "median:5"])
 def test_rank_ops_pallas_bitexact(spec):
     img = synthetic_image(64, 48, channels=1, seed=42)
     pipe = Pipeline.parse(spec)
@@ -59,7 +75,7 @@ def test_rank_ops_pallas_bitexact(spec):
 
 
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 (fake CPU) devices")
-@pytest.mark.parametrize("spec", ["erode:5", "dilate:7", "median:3"])
+@pytest.mark.parametrize("spec", ["erode:5", "dilate:7", "median:3", "median:5"])
 @pytest.mark.parametrize("height", [128, 131])
 def test_rank_ops_sharded_bitexact(spec, height):
     img = synthetic_image(height, 48, channels=1, seed=43)

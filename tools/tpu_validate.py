@@ -3,17 +3,22 @@
 CI runs everything on CPU (interpret-mode Pallas, 8 fake devices); this
 script is the hardware half of the test strategy (SURVEY.md §4): it
 re-asserts cross-backend bit-exactness with *compiled* Mosaic kernels on
-the real chip, then times the headline configs with the N-scaling slope
-timer. Usage:
+the real chip — the analogue of the reference's only existence proof for
+its CUDA kernels, which are compiled-or-nothing (kernel.cu:31-94) — then
+optionally times the headline configs with the N-scaling slope timer.
+Results are written as a JSON artifact (default VALIDATE.json) so a round
+record can be committed. Usage:
 
-    python tools/tpu_validate.py            # bit-exactness sweep
-    python tools/tpu_validate.py --bench    # + throughput table
-    python tools/tpu_validate.py --quick    # fewer shapes (fast smoke)
+    python tools/tpu_validate.py                   # bit-exactness sweep
+    python tools/tpu_validate.py --out VALIDATE_r02.json
+    python tools/tpu_validate.py --bench           # + throughput table
+    python tools/tpu_validate.py --quick           # fewer shapes (fast smoke)
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 import time
@@ -33,6 +38,7 @@ SPECS = [
     ("emboss:5", 1),
     ("emboss101:5", 1),
     ("median", 1),
+    ("median:5", 1),
     ("erode:5", 1),
     ("dilate:3", 1),
     ("box:7", 1),
@@ -43,44 +49,101 @@ SPECS = [
     ("grayscale,gaussian:7", 3),
 ]
 
+# Explicit block-height overrides: Mosaic grid semantics differ with the
+# block geometry (ragged last block, halo-in-block), so the --block knob is
+# validated compiled, not just in interpret mode (advisor round-1 finding).
+BLOCK_CASES = [
+    ("gaussian:5", 1, 64),
+    ("gaussian:5", 1, 224),
+    ("sobel", 1, 96),
+    ("grayscale,contrast:3.5,emboss:3", 3, 160),
+]
+
+# vmap-batched pipelines: the batch dim lowers as a Mosaic 'parallel' grid
+# dim with per-core scratch carry — only a compiled run proves it.
+BATCH_CASES = [
+    ("gaussian:5", 1, 4),
+    ("grayscale,contrast:3.5,emboss:3", 3, 3),
+    ("sobel", 1, 2),
+]
+
 SHAPES = [(129, 517), (40, 300), (257, 1024), (96, 2048), (65, 140)]
 QUICK_SHAPES = [(129, 517), (65, 140)]
 
 
-def run_sweep(shapes) -> int:
-    import jax.numpy as jnp
+def _check(results, name, spec, ch, hw, golden_fn, got_fn) -> bool:
     import numpy as np
+
+    t0 = time.time()
+    try:
+        golden = np.asarray(golden_fn())
+        got = np.asarray(got_fn())
+        ok = bool(np.array_equal(got, golden))
+        detail = ""
+        if not ok:
+            d = np.abs(got.astype(int) - golden.astype(int))
+            detail = f"maxdiff {d.max()} ndiff {np.count_nonzero(d)}"
+    except Exception as e:  # a Mosaic compile crash is a result, not an abort
+        ok, detail = False, f"{type(e).__name__}: {e}"
+    dt = time.time() - t0
+    results.append(
+        {"case": name, "spec": spec, "channels": ch, "shape": list(hw),
+         "ok": ok, "seconds": round(dt, 2), **({"detail": detail[:300]} if detail else {})}
+    )
+    status = "ok  " if ok else "FAIL"
+    print(f"{status} {name:8s} {spec:34s} ch{ch} {str(hw):12s} {dt:5.1f}s"
+          + (f"  {detail[:120]}" if detail else ""), flush=True)
+    return ok
+
+
+def run_sweep(shapes, results) -> int:
+    import jax
+    import jax.numpy as jnp
 
     from mpi_cuda_imagemanipulation_tpu.io.image import synthetic_image
     from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import pipeline_pallas
     from mpi_cuda_imagemanipulation_tpu.ops.registry import make_pipeline_ops
 
     fails = 0
+
+    def golden_of(ops, img):
+        out = img
+        for op in ops:
+            out = op(out)
+        return out
+
     for spec, ch in SPECS:
+        ops = make_pipeline_ops(spec)
         for hw in shapes:
-            t0 = time.time()
             img = jnp.asarray(synthetic_image(*hw, channels=ch, seed=3))
-            ops = make_pipeline_ops(spec)
-            golden = img
-            for op in ops:
-                golden = op(golden)
-            got = pipeline_pallas(ops, img)
-            ok = np.array_equal(np.asarray(got), np.asarray(golden))
-            if not ok:
-                d = np.abs(
-                    np.asarray(got).astype(int) - np.asarray(golden).astype(int)
-                )
-                print(
-                    f"FAIL {spec} ch{ch} {hw}: maxdiff {d.max()} "
-                    f"ndiff {np.count_nonzero(d)}",
-                    flush=True,
-                )
-                fails += 1
-            else:
-                print(
-                    f"ok   {spec:34s} ch{ch} {str(hw):12s} {time.time()-t0:5.1f}s",
-                    flush=True,
-                )
+            fails += not _check(
+                results, "compiled", spec, ch, hw,
+                lambda: golden_of(ops, img), lambda: pipeline_pallas(ops, img),
+            )
+
+    for spec, ch, bh in BLOCK_CASES:
+        ops = make_pipeline_ops(spec)
+        hw = shapes[0]
+        img = jnp.asarray(synthetic_image(*hw, channels=ch, seed=5))
+        fails += not _check(
+            results, f"block{bh}", spec, ch, hw,
+            lambda: golden_of(ops, img),
+            lambda: pipeline_pallas(ops, img, block_h=bh),
+        )
+
+    for spec, ch, n in BATCH_CASES:
+        ops = make_pipeline_ops(spec)
+        hw = shapes[-1] if len(shapes) > 1 else shapes[0]
+        imgs = jnp.stack(
+            [jnp.asarray(synthetic_image(*hw, channels=ch, seed=10 + i)) for i in range(n)]
+        )
+        batched = jax.vmap(lambda im: pipeline_pallas(ops, im))
+        fails += not _check(
+            results, f"batch{n}", spec, ch, hw,
+            lambda: jnp.stack([golden_of(ops, imgs[i]) for i in range(n)]),
+            lambda: batched(imgs),
+        )
+
     print("FAILS:", fails, flush=True)
     return fails
 
@@ -95,11 +158,29 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--bench", action="store_true")
     ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--out", default="VALIDATE.json", help="JSON artifact path")
     args = ap.parse_args()
     import jax
 
-    print("backend:", jax.default_backend(), jax.devices(), flush=True)
-    fails = run_sweep(QUICK_SHAPES if args.quick else SHAPES)
+    platform = jax.default_backend()
+    devices = [str(d) for d in jax.devices()]
+    print("backend:", platform, devices, flush=True)
+    results: list[dict] = []
+    t0 = time.time()
+    fails = run_sweep(QUICK_SHAPES if args.quick else SHAPES, results)
+    artifact = {
+        "platform": platform,
+        "devices": devices,
+        "interpret": False if platform == "tpu" else True,
+        "quick": bool(args.quick),
+        "total_cases": len(results),
+        "fails": fails,
+        "wall_seconds": round(time.time() - t0, 1),
+        "results": results,
+    }
+    with open(args.out, "w") as f:
+        json.dump(artifact, f, indent=1)
+    print(f"wrote {args.out}: {len(results)} cases, {fails} fails", flush=True)
     if args.bench:
         run_bench()
     return 1 if fails else 0
