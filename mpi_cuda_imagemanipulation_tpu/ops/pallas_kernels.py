@@ -445,19 +445,48 @@ _VMEM_LIMIT = 64 * 1024 * 1024
 _COMPILER_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT)
 
 
-def _pick_block_h(width: int, n_in: int, n_out: int, halo: int) -> int:
+def _live_f32_temps(stencil: StencilOp | None) -> int:
+    """Peak count of concurrently-live f32 block-sized temporaries the
+    kernel body creates per output plane.
+
+    Most ops fit the calibrated default of 8 (concat copies, pointwise
+    intermediates, accumulators), but wide-fan-in column passes hold more:
+    the median selection network keeps every window wire live (size^2), and
+    a non-separable correlation's live set scales with its *nonzero* tap
+    count (zero-weight taps are skipped; observed on v5e: 25-tap unsharp
+    and median:5 crash the Mosaic compile at bh=512, 5-nonzero-tap
+    emboss:5 is fine)."""
+    if stencil is None:
+        return 4
+    if stencil.reduce == "median":
+        return stencil.kernels[0].shape[0] ** 2 + 4
+    if stencil.reduce in ("min", "max"):
+        return 8
+    if stencil.separable is not None:
+        return 8
+    taps = sum(int(np.count_nonzero(k)) for k in stencil.kernels)
+    return max(8, taps + 4)
+
+
+def _pick_block_h(
+    width: int,
+    n_in: int,
+    n_out: int,
+    halo: int,
+    live_f32: int = 8,
+) -> int:
     """Row-block height maximising VMEM use without overflowing it.
 
     Working-set estimate per row of block height: u8 input blocks (double-
     buffered by the pipeline) + u8 output double-buffer + f32 row-pass
-    scratch + ~8 live f32 temps per plane while the kernel body runs
-    (concat copies, pointwise intermediates, accumulators). Calibrated on
-    v5e: the 8K gaussian5 kernel at bh=128 reports ~21 MB scoped use."""
+    scratch + `live_f32` live f32 temps per plane while the kernel body
+    runs (see _live_f32_temps). Calibrated on v5e: the 8K gaussian5 kernel
+    at bh=128 reports ~21 MB scoped use."""
     budget = 3 * _VMEM_LIMIT // 4
     n_live = max(n_in, n_out)
     # row-pass scratch rows are width + 2*halo wide for non-separable ops;
     # folding the halo into every term over-reserves by a harmless epsilon
-    per_row = (width + 2 * halo) * (4 * n_in + 8 * n_out + 4 * 8 * n_live)
+    per_row = (width + 2 * halo) * (4 * n_in + 8 * n_out + 4 * live_f32 * n_live)
     bh = budget // max(per_row, 1)
     bh = int(max(32, min(512, bh)))
     return (bh // 32) * 32
@@ -497,7 +526,7 @@ def run_group(
 
     n_in = len(planes)
     n_out = _channels_after(pointwise, n_in)
-    bh = block_h or _pick_block_h(width, n_in, n_out, h)
+    bh = block_h or _pick_block_h(width, n_in, n_out, h, _live_f32_temps(stencil))
 
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -603,7 +632,7 @@ def stencil_tile_pallas(
     """
     h = op.halo
     local_h, width = ext.shape[0] - 2 * h, ext.shape[1]
-    bh = block_h or _pick_block_h(width, 1, 1, h)
+    bh = block_h or _pick_block_h(width, 1, 1, h, _live_f32_temps(op))
     if 2 * h > bh:
         raise ValueError(f"block_h {bh} too small for halo {h}")
     row_pass, col_pass, rp_w, rp_needs_f32 = _split_passes(op, width)

@@ -225,9 +225,6 @@ def median_valid(xpad: jnp.ndarray, size: int = 3) -> jnp.ndarray:
     return p[mid]
 
 
-def median9_valid(xpad: jnp.ndarray) -> jnp.ndarray:
-    """Back-compat alias: valid-mode 3x3 median."""
-    return median_valid(xpad, 3)
 
 
 _PAD_MODES = {

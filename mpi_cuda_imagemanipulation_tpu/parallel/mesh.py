@@ -30,15 +30,32 @@ def distributed_init() -> None:
     Must be called before any other JAX API (jax.distributed.initialize
     refuses to run once the XLA backend exists), so the guard is a module
     flag plus the coordinator env var — never a jax.* query.
+
+    Config comes from JAX_COORDINATOR_ADDRESS (+ JAX_NUM_PROCESSES /
+    JAX_PROCESS_ID) when set — jax's own cluster auto-detection only knows
+    managed launchers (OMPI/SLURM/TPU pods), so plain `mpirun`-style manual
+    launches need the explicit triple. With only auto-detectable launchers
+    (OMPI's env present) the bare initialize() path still works.
     """
     global _distributed_initialized
     if _distributed_initialized:
         return
-    if os.environ.get("JAX_COORDINATOR_ADDRESS") or os.environ.get(
+    addr = os.environ.get("JAX_COORDINATOR_ADDRESS") or os.environ.get(
         "COORDINATOR_ADDRESS"
-    ):
-        jax.distributed.initialize()
-        _distributed_initialized = True
+    )
+    if not addr:
+        return
+    num = os.environ.get("JAX_NUM_PROCESSES")
+    pid = os.environ.get("JAX_PROCESS_ID")
+    if num is not None and pid is not None:
+        jax.distributed.initialize(
+            coordinator_address=addr,
+            num_processes=int(num),
+            process_id=int(pid),
+        )
+    else:  # managed launcher: let cluster auto-detection fill the rest
+        jax.distributed.initialize(coordinator_address=addr)
+    _distributed_initialized = True
 
 
 def make_mesh(n_shards: int | None = None, *, devices=None) -> Mesh:

@@ -1,0 +1,83 @@
+"""Worker for tests/test_multiprocess.py: one JAX process of a 2-process
+group, 4 fake CPU devices each (8 global). Runs the sharded reference
+pipeline over the global ('rows',) mesh and, on process 0, compares the
+allgathered result bit-exactly against the local unsharded golden.
+
+This is the true `mpirun -np 2` analogue of the reference
+(kern.cpp:25-28, kernel.cu:104-107): two OS processes, a real coordinator,
+cross-process collectives — the one layer the fake-device tests can't reach.
+"""
+
+import os
+import sys
+
+# platform env must be pinned before any jax import (see tests/conftest.py)
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = [
+    f
+    for f in os.environ.get("XLA_FLAGS", "").split()
+    if not f.startswith("--xla_force_host_platform_device_count")
+]
+_flags.append("--xla_force_host_platform_device_count=4")
+os.environ["XLA_FLAGS"] = " ".join(_flags)
+
+# the checkout next to us always wins over any installed copy
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mpi_cuda_imagemanipulation_tpu.parallel.mesh import (  # noqa: E402
+    distributed_init,
+    make_mesh,
+    row_sharding,
+)
+
+distributed_init()  # reads JAX_COORDINATOR_ADDRESS/JAX_NUM_PROCESSES/JAX_PROCESS_ID
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.experimental import multihost_utils  # noqa: E402
+
+from mpi_cuda_imagemanipulation_tpu.io.image import synthetic_image  # noqa: E402
+from mpi_cuda_imagemanipulation_tpu.models.pipeline import (  # noqa: E402
+    reference_pipeline,
+)
+
+
+def main() -> int:
+    jax.config.update("jax_platforms", "cpu")
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 8, jax.device_count()
+
+    mesh = make_mesh()  # all 8 global devices on ('rows',)
+    pipe = reference_pipeline()
+    img = synthetic_image(128, 96, channels=3, seed=21)
+
+    # every process holds the full (deterministic) image; the global array
+    # is assembled from each process's addressable row blocks — the
+    # MPI_Scatter analogue across real process boundaries
+    sharding = row_sharding(mesh, 3)
+    garr = jax.make_array_from_callback(
+        img.shape, sharding, lambda idx: img[idx]
+    )
+    out = pipe.sharded(mesh)(garr)
+    gathered = np.asarray(
+        multihost_utils.process_allgather(out, tiled=True)
+    )  # the MPI_Gather analogue (collective: both processes call it)
+
+    golden = np.asarray(pipe(jnp.asarray(img)))  # local, unsharded
+    if jax.process_index() == 0:
+        if not np.array_equal(gathered, golden):
+            diff = np.abs(gathered.astype(int) - golden.astype(int))
+            print(
+                f"MULTIPROC_MISMATCH maxdiff={diff.max()} "
+                f"ndiff={np.count_nonzero(diff)}",
+                flush=True,
+            )
+            return 1
+        print(f"MULTIPROC_OK shape={gathered.shape}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

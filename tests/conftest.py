@@ -24,4 +24,7 @@ import jax  # noqa: E402  (env must be set first)
 
 jax.config.update("jax_platforms", "cpu")
 
+# the checkout next to this conftest always wins over any installed copy —
+# a stale non-editable `pip install .` must never shadow the working tree
+# under test (the console script still comes from `pip install -e .`)
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

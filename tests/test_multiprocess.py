@@ -1,0 +1,79 @@
+"""True multi-process distribution test: 2 OS processes x 4 fake CPU
+devices, a real jax.distributed coordinator on localhost, cross-process
+collectives. Exercises the only layer the single-process 8-fake-device
+tests cannot: distributed_init (the MPI_Init analogue, kern.cpp:25-28)
+and collectives that actually cross a process boundary.
+
+Skips (not fails) when the coordinator cannot be set up — no free port,
+sandboxed sockets — but a bit-exactness mismatch is a hard failure.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_TIMEOUT_S = 300
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_sharded_pipeline_bitexact():
+    try:
+        port = _free_port()
+    except OSError as e:  # pragma: no cover
+        pytest.skip(f"no local port available: {e}")
+
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_mp_worker.py")
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+        env["JAX_NUM_PROCESSES"] = "2"
+        env["JAX_PROCESS_ID"] = str(pid)
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, worker],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+            )
+        )
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=_TIMEOUT_S)
+            outs.append((p.returncode, out, err))
+    except subprocess.TimeoutExpired:  # pragma: no cover
+        for p in procs:
+            p.kill()
+        pytest.skip("multi-process workers timed out (coordinator blocked?)")
+
+    rc0, out0, err0 = outs[0]
+    # infrastructure failures (coordinator refused, sockets sandboxed) skip;
+    # a computed mismatch must fail loudly
+    if any("MULTIPROC_MISMATCH" in o for _, o, _ in outs):
+        raise AssertionError(f"sharded != golden across processes:\n{out0}\n{err0}")
+    if rc0 != 0 or outs[1][0] != 0:
+        blob = "\n".join(e[-2000:] for _, _, e in outs)
+        if any(
+            key in blob
+            for key in (
+                "Connection refused",
+                "DEADLINE_EXCEEDED",
+                "UNAVAILABLE",
+                "Permission denied",
+                "barrier timed out",
+            )
+        ):
+            pytest.skip(f"coordinator infrastructure unavailable:\n{blob[-800:]}")
+        raise AssertionError(f"worker failed rc={rc0},{outs[1][0]}:\n{blob}")
+    assert "MULTIPROC_OK" in out0, out0 + err0

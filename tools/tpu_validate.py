@@ -23,6 +23,8 @@ import os
 import sys
 import time
 
+# the checkout above us always wins over any installed copy — a stale
+# non-editable install must never shadow the code being validated
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 SPECS = [
