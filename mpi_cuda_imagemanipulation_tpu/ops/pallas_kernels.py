@@ -106,9 +106,10 @@ def _f32_to_u8(x):
 # --------------------------------------------------------------------------
 # In-kernel weighted sums and edge columns
 #
-# All slicing happens on the *source dtype* (u8 where possible — lane
-# shifts of packed u8 are ~4x cheaper than f32 on the VPU; measured 0.29 ->
-# 0.14 ms for the 8K 5-tap row pass) with per-term casts to f32. Symmetric
+# Multi-tap passes convert their tile to f32 ONCE up front and slice the
+# f32 copy (one u8->i32->f32 pass for all taps) — round-2 A/B on v5e showed
+# per-tap converts cost at least as much as the f32 lane shifts they were
+# avoiding; do not "optimize" back to per-window casts. Symmetric
 # integer kernels regroup into (x_k + x_{K-1-k}) pairs — every intermediate
 # is an exact integer below 2^24 in f32, so regrouping is bit-exact.
 # Mosaic has no reverse primitive, so reflected strips are built from
@@ -164,8 +165,13 @@ def _src_col(c: int, size: int, mode: str | None) -> int | None:
 
 def _row_corr(x: jnp.ndarray, w1d: np.ndarray, h: int, mode: str | None):
     """Row pass of a separable correlation over a (rows, W) tile, edge
-    columns synthesised per the op's mode. Returns (rows, W) f32."""
+    columns synthesised per the op's mode. Returns (rows, W) f32.
+
+    The tile is converted to f32 once up front: one u8->i32->f32 pass
+    instead of one per tap (measured on v5e, the per-tap converts cost more
+    than the f32 lane shifts they were avoiding)."""
     W = x.shape[1]
+    x = exact_f32(x)
     wv = np.asarray(w1d, dtype=np.float32).reshape(-1)
 
     def edge_col(j):
@@ -188,17 +194,18 @@ def _row_corr(x: jnp.ndarray, w1d: np.ndarray, h: int, mode: str | None):
 
 
 def _row_reduce(x: jnp.ndarray, kw: int, h: int, mode: str | None, fn):
-    """Row pass of a sliding min/max. Windows are sliced in the source dtype
-    (cheap u8 shifts) and cast to f32 per window — Mosaic has no u8 min/max
-    — so the result is always f32 holding exact u8 integers."""
+    """Row pass of a sliding min/max. The tile is cast to f32 once (Mosaic
+    has no u8 min/max) and windows are sliced from the f32 copy — one
+    convert pass for all kw windows; the result holds exact u8 integers."""
     W = x.shape[1]
+    x = exact_f32(x)
 
     def edge_col(j):
         cols = []
         for k in range(kw):
             c = _src_col(j + k - h, W, mode)
             if c is not None:
-                cols.append(exact_f32(x[:, c : c + 1]))
+                cols.append(x[:, c : c + 1])
         acc = cols[0]
         for t in cols[1:]:
             acc = fn(acc, t)
@@ -675,6 +682,126 @@ def stencil_tile_pallas(
         interpret=interpret,
         compiler_params=_COMPILER_PARAMS,
     )(ext)
+    return out[:local_h]
+
+
+def stencil_tile_pallas_fused(
+    op: StencilOp,
+    tile: jnp.ndarray,
+    top: jnp.ndarray,
+    bottom: jnp.ndarray,
+    *,
+    interpret: bool | None = None,
+    block_h: int | None = None,
+) -> jnp.ndarray:
+    """Stencil over a sharded tile with its ghost strips as separate refs.
+
+    Unlike stencil_tile_pallas (which streams a caller-materialised
+    halo-extended copy of the tile — one extra HBM write + read of the whole
+    tile), this kernel streams the tile directly and consumes the two tiny
+    (halo, W) ghost strips in VMEM, so sharded HBM traffic matches the
+    unsharded streaming kernel: one u8 read + one u8 write of the tile.
+    `top`/`bottom` must already hold the correct ghost rows (ppermuted
+    neighbour rows, with the op's edge extension on global-image edges —
+    parallel/api._fix_edge_strips). The ragged last block's garbage rows are
+    patched from the bottom strip at static offsets: a valid output row
+    r < local_h reads row-passed rows <= r + halo <= local_h - 1 + halo,
+    i.e. at most `halo` strip rows; deeper reads feed only cropped outputs
+    (same safety argument as _stream_kernel's bottom_src).
+
+    Caller guarantees: no global pad rows inside the tile (pad-to-multiple
+    rows would need edge extension mid-tile, which is position-dependent —
+    those cases use the materialised-ext path), and local_h > halo.
+    """
+    h = op.halo
+    local_h, width = tile.shape
+    bh = block_h or _pick_block_h(width, 1, 1, h, _live_f32_temps(op))
+    if 2 * h > bh:
+        raise ValueError(f"block_h {bh} too small for halo {h}")
+    row_pass, col_pass, rp_w, rp_needs_f32 = _split_passes(op, width)
+    rp_dtype = F32 if rp_needs_f32 else U8
+    nb = -(-local_h // bh)
+    r1 = (local_h - 1) - (nb - 1) * bh
+    a = r1 + 1  # real rows in the last block (r1 < bh by construction)
+    nfix = min(h, bh - a)
+
+    def cast_rp(x):
+        return _f32_to_u8(x) if x.dtype != rp_dtype else x
+
+    def kernel(in_ref, top_ref, bot_ref, out_ref, main_ref, tail_ref):
+        i = pl.program_id(0)
+        j = i - 1
+        rp = cast_rp(row_pass(in_ref[:]))
+
+        @pl.when(i >= 1)
+        def _():
+            rp_top = cast_rp(row_pass(top_ref[:]))
+            rp_bot = cast_rp(row_pass(bot_ref[:]))
+            main = main_ref[:]
+            # ext rows [j*bh - h, j*bh): previous block's last h rows
+            topg = jnp.where(j == 0, rp_top, tail_ref[:])
+            pieces = [topg, main[:a]]
+            if nfix:  # ragged garbage rows inside the last block
+                pieces.append(
+                    jnp.where(j == nb - 1, rp_bot[:nfix], main[a : a + nfix])
+                )
+            if a + nfix < bh:
+                pieces.append(main[a + nfix :])
+            head = rp[:h]
+            if a < h and nb >= 2:
+                # the penultimate block's head strip crosses into the ragged
+                # block's garbage rows; their true values are strip rows t-a
+                pen = jnp.concatenate(
+                    [
+                        rp[t : t + 1] if t < a else rp_bot[t - a : t - a + 1]
+                        for t in range(h)
+                    ],
+                    axis=0,
+                )
+                head = jnp.where(j == nb - 2, pen, head)
+            # last block's head: tile row nb*bh + t = strip row (bh - a) + t;
+            # rows past the strip feed only cropped outputs (clamp is safe)
+            bot_last = jnp.concatenate(
+                [
+                    rp_bot[min(bh - a + t, h - 1) : min(bh - a + t, h - 1) + 1]
+                    for t in range(h)
+                ],
+                axis=0,
+            )
+            pieces.append(jnp.where(j == nb - 1, bot_last, head))
+            ext = jnp.concatenate(pieces, axis=0)  # (bh + 2h, rp_w)
+            out_ref[:] = _quantize_u8(op, col_pass(ext))
+
+        tail_ref[:] = main_ref[bh - h :]
+        main_ref[:] = rp
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    out = pl.pallas_call(
+        kernel,
+        grid=(nb + 1,),
+        in_specs=[
+            pl.BlockSpec(
+                (bh, width),
+                partial(lambda i, n: (jnp.minimum(i, n - 1), 0), n=nb),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec((h, width), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((h, width), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (bh, width),
+            lambda i: (jnp.maximum(i - 1, 0), 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((nb * bh, width), U8),
+        scratch_shapes=[
+            pltpu.VMEM((bh, rp_w), rp_dtype),
+            pltpu.VMEM((h, rp_w), rp_dtype),
+        ],
+        interpret=interpret,
+        compiler_params=_COMPILER_PARAMS,
+    )(tile, top, bottom)
     return out[:local_h]
 
 

@@ -96,10 +96,11 @@ def corr_valid(xpad: jnp.ndarray, weights: np.ndarray) -> jnp.ndarray:
     """Valid-mode 2-D correlation via unrolled static shifts.
 
     ``xpad`` is (H + kh - 1, W + kw - 1) float32, or uint8 holding the same
-    exact integer values — the Pallas streaming kernels slice the packed u8
-    data (lane shifts of u8 are ~4x cheaper than f32 on the VPU) and each
-    shifted window is cast to f32 here, so the arithmetic is identical
-    either way. ``weights`` is a static (kh, kw) array indexed ``w[dy, dx]``.
+    exact integer values — u8 input is cast to f32 once up front and every
+    tap window is sliced from the f32 copy (one convert pass for all taps;
+    measured on v5e this beats per-window converts), so the arithmetic is
+    identical either way. ``weights`` is a static (kh, kw) array indexed
+    ``w[dy, dx]``.
     Returns float32 (H, W). Unrolled shift-multiply-accumulate maps onto the
     TPU VPU (8x128 lanes) and fuses under XLA; the same code runs inside
     Pallas kernels on VMEM tiles. This replaces the CUDA per-thread gather
@@ -108,13 +109,17 @@ def corr_valid(xpad: jnp.ndarray, weights: np.ndarray) -> jnp.ndarray:
     kh, kw = weights.shape
     out_h = xpad.shape[0] - (kh - 1)
     out_w = xpad.shape[1] - (kw - 1)
+    # convert the whole tile once, then slice f32: one u8->i32->f32 pass
+    # instead of one per nonzero tap (the taps share the same data; on the
+    # VPU the per-tap converts dominated the shift cost)
+    xf = exact_f32(xpad)
     acc = None
     for dy in range(kh):
         for dx in range(kw):
             w = float(weights[dy, dx])
             if w == 0.0:
                 continue
-            win = exact_f32(xpad[dy : dy + out_h, dx : dx + out_w])
+            win = xf[dy : dy + out_h, dx : dx + out_w]
             term = win if w == 1.0 else win * w
             acc = term if acc is None else acc + term
     if acc is None:
@@ -140,13 +145,15 @@ def window_reduce_1d(
     """Valid-mode sliding reduction (min/max) of width k along one axis,
     via k-1 unrolled static shifts — the same VPU-friendly shape as
     corr_valid, so it lowers identically inside Pallas kernels. u8 input is
-    shifted packed and cast per-window (Mosaic has no u8 min/max — and the
-    u8 lane shifts are the cheap part anyway); values are exact integers,
-    so the f32 reduction is bit-equivalent."""
+    cast to f32 once up front and windows are sliced from the f32 copy
+    (Mosaic has no u8 min/max; measured on v5e, one whole-tile convert
+    beats per-window converts); values are exact integers, so the f32
+    reduction is bit-equivalent."""
     out_len = xpad.shape[axis] - (k - 1)
+    xf = exact_f32(xpad)  # one convert for all k windows (see corr_valid)
     acc = None
     for d in range(k):
-        win = exact_f32(lax.slice_in_dim(xpad, d, d + out_len, axis=axis))
+        win = lax.slice_in_dim(xf, d, d + out_len, axis=axis)
         acc = win if acc is None else fn(acc, win)
     return acc
 
@@ -209,14 +216,16 @@ _MEDIAN_NETWORKS = {
 
 def median_valid(xpad: jnp.ndarray, size: int = 3) -> jnp.ndarray:
     """Valid-mode size x size median via a min/max selection network.
-    u8 input is shifted packed, then cast per-window (see window_reduce_1d).
-    Pure elementwise min/max — exact on u8-valued f32 and lowers in Mosaic
-    (no sort primitive needed)."""
+    u8 input is cast to f32 once up front, then the size^2 window wires are
+    sliced from the f32 copy (see corr_valid). Pure elementwise min/max —
+    exact on u8-valued f32 and lowers in Mosaic (no sort primitive
+    needed)."""
     exchanges, mid = _MEDIAN_NETWORKS[size]
     out_h = xpad.shape[0] - (size - 1)
     out_w = xpad.shape[1] - (size - 1)
+    xf = exact_f32(xpad)  # one convert for all size^2 wires (see corr_valid)
     p = [
-        exact_f32(xpad[dy : dy + out_h, dx : dx + out_w])
+        xf[dy : dy + out_h, dx : dx + out_w]
         for dy in range(size)
         for dx in range(size)
     ]

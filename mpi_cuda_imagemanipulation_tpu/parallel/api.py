@@ -29,7 +29,10 @@ from mpi_cuda_imagemanipulation_tpu.ops.spec import (
     StencilOp,
     pad2d,
 )
-from mpi_cuda_imagemanipulation_tpu.parallel.halo import exchange_halo
+from mpi_cuda_imagemanipulation_tpu.parallel.halo import (
+    exchange_halo,
+    exchange_halo_strips,
+)
 from mpi_cuda_imagemanipulation_tpu.parallel.mesh import ROWS
 
 
@@ -75,6 +78,45 @@ def _fix_edge_rows(
     return jnp.where(outside_b, gathered, ext)
 
 
+def _fix_edge_strips(
+    top: jnp.ndarray,
+    bottom: jnp.ndarray,
+    tile: jnp.ndarray,
+    op: StencilOp,
+    y0: jnp.ndarray,
+    global_h: int,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Strip-level global-edge fixup for the fused-ghost path.
+
+    With no pad rows inside the tile (caller-gated) and local_h > halo, a
+    strip is either fully inside the image (middle shards — ppermuted rows
+    are already correct) or fully outside (first shard's top / last shard's
+    bottom), so the fix is a whole-strip select of the op's edge extension
+    synthesised from the tile's own static row slices.
+    """
+    h = op.halo
+    local_h = tile.shape[0]
+    mode = op.edge_mode
+    if mode in ("interior", "zero"):
+        synth_top = jnp.zeros_like(top)
+        synth_bot = jnp.zeros_like(bottom)
+    elif mode == "reflect101":
+        # global row -k reflects to row k; row H-1+k reflects to H-1-k
+        synth_top = jnp.flip(tile[1 : h + 1], axis=0)
+        synth_bot = jnp.flip(tile[local_h - 1 - h : local_h - 1], axis=0)
+    elif mode == "edge":
+        synth_top = jnp.broadcast_to(tile[:1], top.shape)
+        synth_bot = jnp.broadcast_to(tile[local_h - 1 :], bottom.shape)
+    else:  # pragma: no cover
+        raise ValueError(f"unknown edge mode {mode!r}")
+    is_first = y0 == 0
+    is_last = y0 + local_h == global_h
+    return (
+        jnp.where(is_first, synth_top, top),
+        jnp.where(is_last, synth_bot, bottom),
+    )
+
+
 def _apply_stencil(
     op: StencilOp,
     tile: jnp.ndarray,
@@ -93,6 +135,28 @@ def _apply_stencil(
         # the sharded runner has no fused prologue: the stencil kernel is
         # always run per channel plane, hence group_in_channels=1
         backend = "pallas" if use_pallas_for_stencil(op, 1) else "xla"
+    local_h = tile.shape[0]
+    # Fused-ghost fast path: the Pallas kernel streams the tile directly and
+    # takes the two (halo, W) strips as separate refs, so no halo-extended
+    # copy of the tile is ever materialised in HBM (the round-1 sharded
+    # path's ~2x traffic). Requires no pad rows inside the tile (pad-to-
+    # multiple puts image-edge extension mid-tile) and local_h > halo for
+    # the strip synthesis.
+    if backend == "pallas" and n_shards * local_h == global_h and local_h > h:
+        top, bottom = exchange_halo_strips(tile, h, n_shards)
+        top, bottom = _fix_edge_strips(top, bottom, tile, op, y0, global_h)
+        if tile.ndim == 3:
+            return jnp.stack(
+                [
+                    _stencil_fused_plane(
+                        op, tile[..., c], top[..., c], bottom[..., c],
+                        y0, global_h, global_w,
+                    )
+                    for c in range(tile.shape[2])
+                ],
+                axis=-1,
+            )
+        return _stencil_fused_plane(op, tile, top, bottom, y0, global_h, global_w)
     # halo exchange + global-edge fixup once on the full tile (2-D or HWC) —
     # on uint8 (dtype-generic gather/where), so colour images pay two
     # ppermutes total, not two per channel, and Pallas HBM traffic stays u8
@@ -108,6 +172,26 @@ def _apply_stencil(
             axis=-1,
         )
     return _stencil_on_ext(op, ext, tile, y0, global_h, global_w, backend)
+
+
+def _stencil_fused_plane(
+    op: StencilOp,
+    tile: jnp.ndarray,
+    top: jnp.ndarray,
+    bottom: jnp.ndarray,
+    y0: jnp.ndarray,
+    global_h: int,
+    global_w: int,
+) -> jnp.ndarray:
+    from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
+        stencil_tile_pallas_fused,
+    )
+
+    q = stencil_tile_pallas_fused(op, tile, top, bottom)
+    if op.edge_mode != "interior":
+        return q
+    mask = op.interior_mask(q.shape, y0, 0, global_h, global_w)
+    return jnp.where(mask, q, tile)
 
 
 def _stencil_on_ext(

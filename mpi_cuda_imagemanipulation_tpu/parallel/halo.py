@@ -17,24 +17,34 @@ from jax import lax
 from mpi_cuda_imagemanipulation_tpu.parallel.mesh import ROWS
 
 
-def exchange_halo(tile: jnp.ndarray, halo: int, n_shards: int) -> jnp.ndarray:
-    """Return `tile` extended with `halo` ghost rows on top and bottom.
+def exchange_halo_strips(
+    tile: jnp.ndarray, halo: int, n_shards: int
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Return the (top, bottom) ghost-row strips for `tile`, each (halo, ...).
 
     Two ring ppermutes over the 'rows' axis: the "down" ring carries each
     shard's last rows to its south neighbour (becoming that neighbour's top
     halo); the "up" ring carries first rows north. Rings are full
-    permutations (XLA requires a bijection), so shard 0's top halo and shard
-    n-1's bottom halo arrive wrapped from the opposite end of the image —
+    permutations (XLA requires a bijection), so shard 0's top strip and shard
+    n-1's bottom strip arrive wrapped from the opposite end of the image —
     callers mask or overwrite them with the op's edge extension
     (ops never read unfixed wrapped rows; see parallel.api._apply_stencil).
     """
-    if halo == 0:
-        return tile
     if n_shards == 1:
         zeros = jnp.zeros((halo, *tile.shape[1:]), tile.dtype)
-        return jnp.concatenate([zeros, tile, zeros], axis=0)
+        return zeros, zeros
     down = [(i, (i + 1) % n_shards) for i in range(n_shards)]
     up = [(i, (i - 1) % n_shards) for i in range(n_shards)]
     top = lax.ppermute(tile[-halo:], ROWS, down)
     bottom = lax.ppermute(tile[:halo], ROWS, up)
+    return top, bottom
+
+
+def exchange_halo(tile: jnp.ndarray, halo: int, n_shards: int) -> jnp.ndarray:
+    """Return `tile` extended with `halo` ghost rows on top and bottom
+    (see exchange_halo_strips; this materialises the concatenated tile for
+    the XLA stencil path)."""
+    if halo == 0:
+        return tile
+    top, bottom = exchange_halo_strips(tile, halo, n_shards)
     return jnp.concatenate([top, tile, bottom], axis=0)

@@ -53,6 +53,11 @@ def distributed_init() -> None:
             num_processes=int(num),
             process_id=int(pid),
         )
+    elif num is not None or pid is not None:
+        raise RuntimeError(
+            "set both JAX_NUM_PROCESSES and JAX_PROCESS_ID (or neither, "
+            "under a managed launcher like OMPI/SLURM) — only one is set"
+        )
     else:  # managed launcher: let cluster auto-detection fill the rest
         jax.distributed.initialize(coordinator_address=addr)
     _distributed_initialized = True

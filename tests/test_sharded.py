@@ -116,6 +116,60 @@ def test_sharded_pallas_backend_bitexact(spec):
     np.testing.assert_array_equal(sharded, golden)
 
 
+@pytest.mark.parametrize(
+    "spec",
+    [
+        "gaussian:5",        # separable, reflect101
+        "sobel",             # magnitude combine, reflect101
+        "emboss:3",          # interior mode (reference guard)
+        "emboss:5",          # interior, halo 2
+        "erode:5",           # min-reduce, edge mode
+        "median:5",          # selection network, reflect101
+        "grayscale,contrast:3.5,emboss:3",  # full reference pipeline
+    ],
+)
+@pytest.mark.parametrize("height", [128, 136])
+def test_sharded_fused_ghost_path_bitexact(spec, height):
+    # heights divisible by 8 with no pad rows take the fused-ghost Pallas
+    # kernel (stencil_tile_pallas_fused): tile streamed directly, ghost
+    # strips as separate refs — must equal the golden path bit-exactly,
+    # including ragged last blocks (136/8 = 17 rows/shard)
+    img = synthetic_image(
+        height, 96, channels=3 if spec.startswith("grayscale") else 1, seed=31
+    )
+    pipe = Pipeline.parse(spec)
+    golden = np.asarray(pipe(jnp.asarray(img)))
+    sharded = np.asarray(
+        pipe.sharded(make_mesh(8), backend="pallas")(jnp.asarray(img))
+    )
+    np.testing.assert_array_equal(sharded, golden, err_msg=f"{spec} h={height}")
+
+
+def test_fused_kernel_small_block_many_blocks():
+    # force several grid steps per shard (nb > 2) so the tail/main carry and
+    # the penultimate-block head fix all engage
+    from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
+        stencil_tile_pallas_fused,
+    )
+    from mpi_cuda_imagemanipulation_tpu.ops.registry import make_op
+
+    op = make_op("gaussian:5")
+    rng = np.random.default_rng(5)
+    tile = jnp.asarray(rng.integers(0, 256, (130, 64), np.uint8))
+    top = jnp.asarray(rng.integers(0, 256, (2, 64), np.uint8))
+    bottom = jnp.asarray(rng.integers(0, 256, (2, 64), np.uint8))
+    ext = jnp.concatenate([top, tile, bottom], axis=0).astype(jnp.float32)
+    golden = np.asarray(
+        op.finalize(op.valid(jnp.pad(ext, ((0, 0), (2, 2)), mode="reflect")),
+                    tile, 2, 0, 10**6, 64)
+    )
+    for bh in (32, 64, 96):
+        got = np.asarray(
+            stencil_tile_pallas_fused(op, tile, top, bottom, block_h=bh)
+        )
+        np.testing.assert_array_equal(got, golden[: tile.shape[0]], err_msg=f"bh={bh}")
+
+
 def test_sharded_is_actually_sharded():
     # The input placement should split rows over devices (scatter analogue).
     from mpi_cuda_imagemanipulation_tpu.parallel.mesh import row_sharding
