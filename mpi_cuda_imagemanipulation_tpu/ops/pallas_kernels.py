@@ -722,21 +722,29 @@ def stencil_tile_pallas_fused(
     rp_dtype = F32 if rp_needs_f32 else U8
     nb = -(-local_h // bh)
     r1 = (local_h - 1) - (nb - 1) * bh
-    a = r1 + 1  # real rows in the last block (r1 < bh by construction)
+    a = min(r1 + 1, bh)  # real rows in the last block
     nfix = min(h, bh - a)
 
     def cast_rp(x):
         return _f32_to_u8(x) if x.dtype != rp_dtype else x
 
-    def kernel(in_ref, top_ref, bot_ref, out_ref, main_ref, tail_ref):
+    def kernel(
+        in_ref, top_ref, bot_ref, out_ref, main_ref, tail_ref, tscr_ref, bscr_ref
+    ):
         i = pl.program_id(0)
         j = i - 1
         rp = cast_rp(row_pass(in_ref[:]))
 
+        @pl.when(i == 1)
+        def _():
+            # the strips never change across the grid: row-pass them once
+            tscr_ref[:] = cast_rp(row_pass(top_ref[:]))
+            bscr_ref[:] = cast_rp(row_pass(bot_ref[:]))
+
         @pl.when(i >= 1)
         def _():
-            rp_top = cast_rp(row_pass(top_ref[:]))
-            rp_bot = cast_rp(row_pass(bot_ref[:]))
+            rp_top = tscr_ref[:]
+            rp_bot = bscr_ref[:]
             main = main_ref[:]
             # ext rows [j*bh - h, j*bh): previous block's last h rows
             topg = jnp.where(j == 0, rp_top, tail_ref[:])
@@ -796,8 +804,10 @@ def stencil_tile_pallas_fused(
         ),
         out_shape=jax.ShapeDtypeStruct((nb * bh, width), U8),
         scratch_shapes=[
-            pltpu.VMEM((bh, rp_w), rp_dtype),
-            pltpu.VMEM((h, rp_w), rp_dtype),
+            pltpu.VMEM((bh, rp_w), rp_dtype),  # main: previous block's rp
+            pltpu.VMEM((h, rp_w), rp_dtype),  # tail: block-before's last h
+            pltpu.VMEM((h, rp_w), rp_dtype),  # top strip rp (set once)
+            pltpu.VMEM((h, rp_w), rp_dtype),  # bottom strip rp (set once)
         ],
         interpret=interpret,
         compiler_params=_COMPILER_PARAMS,

@@ -142,7 +142,12 @@ def _apply_stencil(
     # path's ~2x traffic). Requires no pad rows inside the tile (pad-to-
     # multiple puts image-edge extension mid-tile) and local_h > halo for
     # the strip synthesis.
-    if backend == "pallas" and n_shards * local_h == global_h and local_h > h:
+    if (
+        backend == "pallas"
+        and h >= 1  # halo-0 stencils (box:1) have no strips to exchange
+        and n_shards * local_h == global_h
+        and local_h > h
+    ):
         top, bottom = exchange_halo_strips(tile, h, n_shards)
         top, bottom = _fix_edge_strips(top, bottom, tile, op, y0, global_h)
         if tile.ndim == 3:

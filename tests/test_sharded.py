@@ -145,29 +145,58 @@ def test_sharded_fused_ghost_path_bitexact(spec, height):
     np.testing.assert_array_equal(sharded, golden, err_msg=f"{spec} h={height}")
 
 
-def test_fused_kernel_small_block_many_blocks():
-    # force several grid steps per shard (nb > 2) so the tail/main carry and
-    # the penultimate-block head fix all engage
+@pytest.mark.parametrize(
+    "spec,tile_h,bh",
+    [
+        ("gaussian:5", 130, 32),  # nb=5, ragged a=2=h
+        ("gaussian:5", 130, 64),  # nb=3, ragged a=2
+        ("gaussian:5", 130, 96),  # nb=2
+        ("gaussian:5", 129, 64),  # nb=3, a=1 < h=2: penultimate head fix
+        ("median:5", 129, 64),    # a < h with the selection-network col pass
+        ("gaussian:7", 130, 64),  # halo 3: a=2 < h=3
+        ("erode:5", 129, 64),     # a < h, min-reduce row pass
+    ],
+)
+def test_fused_kernel_ragged_geometries(spec, tile_h, bh):
+    # direct kernel test over ragged block geometries, including a < halo
+    # (the penultimate-block head fix, unreachable via the 8-shard suites'
+    # small tiles) — golden is the op over the strip-extended tile
     from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
         stencil_tile_pallas_fused,
     )
     from mpi_cuda_imagemanipulation_tpu.ops.registry import make_op
 
-    op = make_op("gaussian:5")
+    op = make_op(spec)
+    h = op.halo
     rng = np.random.default_rng(5)
-    tile = jnp.asarray(rng.integers(0, 256, (130, 64), np.uint8))
-    top = jnp.asarray(rng.integers(0, 256, (2, 64), np.uint8))
-    bottom = jnp.asarray(rng.integers(0, 256, (2, 64), np.uint8))
+    tile = jnp.asarray(rng.integers(0, 256, (tile_h, 64), np.uint8))
+    top = jnp.asarray(rng.integers(0, 256, (h, 64), np.uint8))
+    bottom = jnp.asarray(rng.integers(0, 256, (h, 64), np.uint8))
     ext = jnp.concatenate([top, tile, bottom], axis=0).astype(jnp.float32)
-    golden = np.asarray(
-        op.finalize(op.valid(jnp.pad(ext, ((0, 0), (2, 2)), mode="reflect")),
-                    tile, 2, 0, 10**6, 64)
+    pad_mode = {"reflect101": "reflect", "edge": "edge"}[op.edge_mode]
+    xpad = jnp.asarray(
+        np.pad(np.asarray(ext), ((0, 0), (h, h)), mode=pad_mode)
     )
-    for bh in (32, 64, 96):
-        got = np.asarray(
-            stencil_tile_pallas_fused(op, tile, top, bottom, block_h=bh)
-        )
-        np.testing.assert_array_equal(got, golden[: tile.shape[0]], err_msg=f"bh={bh}")
+    golden = np.asarray(
+        op.finalize(op.valid(xpad), tile, h, 0, 10**6, 64)
+    )
+    got = np.asarray(stencil_tile_pallas_fused(op, tile, top, bottom, block_h=bh))
+    np.testing.assert_array_equal(
+        got, golden[:tile_h], err_msg=f"{spec} h={tile_h} bh={bh}"
+    )
+
+
+def test_sharded_pallas_halo0_stencil():
+    # halo-0 stencils (box:1) must not take the fused-ghost path (there are
+    # no strips to exchange) — regression: the strips refactor once crashed
+    # on the empty tile[:0] slice here
+    img = synthetic_image(128, 96, channels=1, seed=33)
+    pipe = Pipeline.parse("box:1")
+    golden = np.asarray(pipe(jnp.asarray(img)))
+    sharded = np.asarray(
+        pipe.sharded(make_mesh(8), backend="pallas")(jnp.asarray(img))
+    )
+    np.testing.assert_array_equal(sharded, golden)
 
 
 def test_sharded_is_actually_sharded():

@@ -1,0 +1,99 @@
+"""Measure the chip's *achievable* streaming bandwidth ceilings (run on TPU).
+
+BASELINE.md's roofline fraction divides the streaming kernels' modeled HBM
+traffic by the v5e datasheet peak (819 GB/s). Observed throughput pins near
+~92 GB/s effective regardless of compute variant or block height, so the
+open question is what ceiling this chip/access pattern actually supports:
+
+  a) XLA device copy of the same u8 array        (upper bound, XLA's own DMA)
+  b) Pallas streaming copy, u8, several block_h  (our kernels' structure)
+  c) Pallas streaming copy, f32                  (is the cap byte-based?)
+  d) the headline gaussian5 kernel               (for the same-run contrast)
+
+Writes one JSON line per measurement; commit the results into BASELINE.md's
+analysis. Usage:  python tools/roofline_probe.py [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from mpi_cuda_imagemanipulation_tpu.io.image import synthetic_image
+    from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
+        _COMPILER_PARAMS,
+        pipeline_pallas,
+    )
+    from mpi_cuda_imagemanipulation_tpu.ops.registry import make_pipeline_ops
+    from mpi_cuda_imagemanipulation_tpu.utils.timing import device_throughput
+
+    H, W = 4320, 7680
+    img_u8 = jnp.asarray(synthetic_image(H, W, channels=1, seed=99))
+    img_f32 = img_u8.astype(jnp.float32)
+    print(f"backend: {jax.default_backend()}", flush=True)
+
+    def emit(rec):
+        print(json.dumps(rec), flush=True)
+
+    def copy_call(dtype, bh):
+        def copy_kernel(in_ref, out_ref):
+            out_ref[:] = in_ref[:]
+
+        return pl.pallas_call(
+            copy_kernel,
+            grid=(-(-H // bh),),
+            in_specs=[
+                pl.BlockSpec((bh, W), lambda i: (i, 0), memory_space=pltpu.VMEM)
+            ],
+            out_specs=pl.BlockSpec(
+                (bh, W), lambda i: (i, 0), memory_space=pltpu.VMEM
+            ),
+            out_shape=jax.ShapeDtypeStruct((H, W), dtype),
+            compiler_params=_COMPILER_PARAMS,
+        )
+
+    # a) XLA's own device copy (copy = x + 0 defeats aliasing elision)
+    for name, arr, bpe in (("xla_copy_u8", img_u8, 1), ("xla_copy_f32", img_f32, 4)):
+        f = jax.jit(lambda x: x + jnp.zeros((), x.dtype))
+        sec = device_throughput(f, [arr])
+        emit({"case": name, "ms": sec * 1e3, "gb_s": 2 * H * W * bpe / sec / 1e9})
+
+    # b/c) Pallas streaming copies
+    bhs = (128,) if args.quick else (64, 128, 256, 512)
+    for dtype, name, bpe in ((jnp.uint8, "pallas_copy_u8", 1), (jnp.float32, "pallas_copy_f32", 4)):
+        arr = img_u8 if dtype == jnp.uint8 else img_f32
+        for bh in bhs:
+            try:
+                f = jax.jit(copy_call(dtype, bh))
+                sec = device_throughput(f, [arr])
+                emit({"case": name, "block_h": bh, "ms": sec * 1e3,
+                      "gb_s": 2 * H * W * bpe / sec / 1e9})
+            except Exception as e:
+                emit({"case": name, "block_h": bh, "error": str(e)[:200]})
+
+    # d) the headline kernel in the same process/chip state
+    ops = make_pipeline_ops("gaussian:5")
+    f = jax.jit(lambda x: pipeline_pallas(ops, x))
+    sec = device_throughput(f, [img_u8])
+    emit({"case": "gaussian5_8k_pallas", "ms": sec * 1e3,
+          "mp_s": H * W / 1e6 / sec, "gb_s": 2 * H * W / sec / 1e9})
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -69,6 +69,16 @@ BATCH_CASES = [
     ("sobel", 1, 2),
 ]
 
+# sharded pipelines on a 1-device mesh: exercises the fused-ghost kernel
+# (stencil_tile_pallas_fused — tile streamed directly, ghost strips as
+# separate refs) compiled by Mosaic, which CI only runs in interpret mode.
+SHARDED_CASES = [
+    ("gaussian:5", 1),
+    ("grayscale,contrast:3.5,emboss:3", 3),
+    ("erode:5", 1),
+    ("median:5", 1),
+]
+
 SHAPES = [(129, 517), (40, 300), (257, 1024), (96, 2048), (65, 140)]
 QUICK_SHAPES = [(129, 517), (65, 140)]
 
@@ -144,6 +154,20 @@ def run_sweep(shapes, results) -> int:
             results, f"batch{n}", spec, ch, hw,
             lambda: jnp.stack([golden_of(ops, imgs[i]) for i in range(n)]),
             lambda: batched(imgs),
+        )
+
+    from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline
+    from mpi_cuda_imagemanipulation_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(1)
+    for spec, ch in SHARDED_CASES:
+        pipe = Pipeline.parse(spec)
+        hw = shapes[0]
+        img = jnp.asarray(synthetic_image(*hw, channels=ch, seed=21))
+        fails += not _check(
+            results, "sharded", spec, ch, hw,
+            lambda: golden_of(pipe.ops, img),
+            lambda: pipe.sharded(mesh, backend="pallas")(img),
         )
 
     print("FAILS:", fails, flush=True)
