@@ -32,7 +32,7 @@ HEADLINE = "gaussian5_8k"  # mirrors bench_suite.HEADLINE (jax-free here)
 # in this process; tests/test_io_cli.py asserts the two stay equal.
 REFERENCE_BASELINE_MP_S_PER_CHIP = 1850.0
 
-# (timeout_s, sleep_before_s): four attempts spanning ~17 minutes worst
+# (timeout_s, sleep_before_s): four attempts spanning ~19 minutes worst
 # case (observed round-2 wedges last an hour, so late attempts back off
 # hard). First compile over the tunnel is slow (~20-40 s), so even the
 # healthy path needs a generous first timeout.

@@ -308,6 +308,65 @@ def _quantize_u8(stencil: StencilOp, acc: jnp.ndarray) -> jnp.ndarray:
     return _f32_to_u8(QUANTIZERS_F32[stencil.quantize](acc))
 
 
+def _assemble_ext(
+    j,
+    top,
+    main,
+    rp,
+    beyond,
+    beyond_pen,
+    *,
+    nb: int,
+    bh: int,
+    h: int,
+    a: int,
+    nfix: int,
+    skip_fixes: bool = False,
+):
+    """Build the (bh + 2h, rp_w) column-pass input for output block j from
+    the streaming carry — the ONE copy of the ragged-last-block math shared
+    by _stream_kernel (full-image path, beyond-image rows synthesised from
+    the op's edge extension) and stencil_tile_pallas_fused (sharded path,
+    beyond-tile rows sourced from the ghost strip).
+
+    `top`/`main`/`rp` are the row-passed carries: block j-1's last h rows
+    (already j==0-selected by the caller), block j, and block j+1 (whose
+    first h rows are the head). `beyond(t)` returns the 1-row row-passed
+    value for tile row local_h + t (t >= 0) as seen at the LAST emit step
+    (j == nb-1); `beyond_pen(t)` the same row as seen one step earlier
+    (j == nb-2, where the garbage block's row pass lives in `rp`, not
+    `main`). Rows a source cannot reach feed only cropped outputs, so
+    clamping inside them is safe. With `skip_fixes` (interior mode on the
+    full-image path) garbage rows are left in place — the interior mask
+    passes exactly those outputs through. `a` is the number of real rows in
+    the last block, `nfix` how many garbage rows after them can reach a
+    valid output's window.
+    """
+    if skip_fixes:
+        return jnp.concatenate([top, main, rp[:h]], axis=0)
+    pieces = [top, main[:a]]
+    if nfix:  # garbage rows inside the last block
+        fix = jnp.concatenate([beyond(t) for t in range(nfix)], axis=0)
+        pieces.append(jnp.where(j == nb - 1, fix, main[a : a + nfix]))
+    if a + nfix < bh:
+        pieces.append(main[a + nfix :])
+    head = rp[:h]
+    if a < h and nb >= 2:
+        # the penultimate block's head strip crosses into the ragged last
+        # block's rows t >= a, whose true values are beyond rows t - a
+        pen = jnp.concatenate(
+            [rp[t : t + 1] if t < a else beyond_pen(t - a) for t in range(h)],
+            axis=0,
+        )
+        head = jnp.where(j == nb - 2, pen, head)
+    # the last block's head rows are tile rows nb*bh + t = beyond (bh-a) + t
+    bot_last = jnp.concatenate(
+        [beyond(bh - a + t) for t in range(h)], axis=0
+    )
+    pieces.append(jnp.where(j == nb - 1, bot_last, head))
+    return jnp.concatenate(pieces, axis=0)
+
+
 def _stream_kernel(
     *refs,
     pointwise: list[PointwiseOp],
@@ -358,64 +417,37 @@ def _stream_kernel(
             main = main_ref[:]
             top = jnp.where(j == 0, _top_strip(main, h, mode), tail_ref[:])
 
-            def bottom_src(g):
-                """Row-pass row holding the edge extension of image row g
-                (g >= H), sourced from this block at a static offset.
-
-                Rows whose extension source cannot be reached locally only
-                feed outputs past the image bottom (their window would need
-                g > H-1+h, which no valid output reads — shown in the
-                module comment), so clamping to any in-range row is safe."""
+            def beyond(t):
+                """Row-pass row holding the edge extension of image row
+                H + t, sourced from the last block (`main` at the final emit
+                step) at a static offset; may cross into the halo strip.
+                Unreachable sources are clamped — they feed only outputs
+                past the image bottom (see module comment)."""
                 if mode == "reflect101":
-                    gp = 2 * (global_h - 1) - g
+                    gp = 2 * (global_h - 1) - (global_h + t)
                 else:  # edge (zero/interior never fix)
                     gp = global_h - 1
                 p = min(max(gp - (nb - 1) * block_h, -h), block_h - 1)
                 if p >= 0:
                     return main[p : p + 1]
-                return top[h + p : h + p + 1]  # crosses into the halo strip
+                return top[h + p : h + p + 1]
 
-            if mode == "interior":
+            def beyond_pen(t):
+                """Same image row H + t one step earlier (j == nb-2), where
+                the ragged block's row pass lives in `rp` and block nb-2's
+                in `main`. Static offset: reflect source r1 - 1 - t."""
+                p = (r1 - 1 - t) if mode == "reflect101" else r1
+                if p >= 0:
+                    return rp[p : p + 1]
+                return main[block_h + p : block_h + p + 1]
+
+            ext = _assemble_ext(
+                j, top, main, rp, beyond, beyond_pen,
+                nb=nb, bh=block_h, h=h, a=a, nfix=nfix,
                 # the interior mask passes through exactly the outputs whose
                 # windows could touch the garbage rows, so no fixes needed
-                pieces = [top, main, rp[:h]]
-            else:
-                pieces = [top, main[:a]]
-                if nfix:
-                    fix = jnp.concatenate(
-                        [bottom_src(global_h + t) for t in range(nfix)], axis=0
-                    )
-                    pieces.append(
-                        jnp.where(j == nb - 1, fix, main[a : a + nfix])
-                    )
-                if a + nfix < block_h:
-                    pieces.append(main[a + nfix :])
-                head = rp[:h]
-                if a < h and nb >= 2:
-                    # The ragged last block holds fewer real rows than the
-                    # halo, so the *penultimate* block's bottom strip (the
-                    # last block's head) also contains garbage rows
-                    # (head row t >= a is image row g = (nb-1)*bh + t >= H).
-                    # Their edge extension lives at static offsets: reflect
-                    # source g' = 2(H-1) - g is head row 2*r1 - t if that is
-                    # >= 0, else main row bh + (2*r1 - t).
-                    def pen_src(t):
-                        if t < a:
-                            return rp[t : t + 1]
-                        p = (2 * r1 - t) if mode == "reflect101" else r1
-                        if p >= 0:
-                            return rp[p : p + 1]
-                        return main[block_h + p : block_h + p + 1]
-
-                    pen = jnp.concatenate(
-                        [pen_src(t) for t in range(h)], axis=0
-                    )
-                    head = jnp.where(j == nb - 2, pen, head)
-                bot_last = jnp.concatenate(
-                    [bottom_src(nb * block_h + t) for t in range(h)], axis=0
-                )
-                pieces.append(jnp.where(j == nb - 1, bot_last, head))
-            ext = jnp.concatenate(pieces, axis=0)
+                skip_fixes=mode == "interior",
+            )
             q = _quantize_u8(stencil, col_pass(ext))
             if mode == "interior":
                 orig = main[:, h : h + global_w] if rp_w != global_w else main
@@ -743,41 +775,21 @@ def stencil_tile_pallas_fused(
 
         @pl.when(i >= 1)
         def _():
-            rp_top = tscr_ref[:]
             rp_bot = bscr_ref[:]
             main = main_ref[:]
             # ext rows [j*bh - h, j*bh): previous block's last h rows
-            topg = jnp.where(j == 0, rp_top, tail_ref[:])
-            pieces = [topg, main[:a]]
-            if nfix:  # ragged garbage rows inside the last block
-                pieces.append(
-                    jnp.where(j == nb - 1, rp_bot[:nfix], main[a : a + nfix])
-                )
-            if a + nfix < bh:
-                pieces.append(main[a + nfix :])
-            head = rp[:h]
-            if a < h and nb >= 2:
-                # the penultimate block's head strip crosses into the ragged
-                # block's garbage rows; their true values are strip rows t-a
-                pen = jnp.concatenate(
-                    [
-                        rp[t : t + 1] if t < a else rp_bot[t - a : t - a + 1]
-                        for t in range(h)
-                    ],
-                    axis=0,
-                )
-                head = jnp.where(j == nb - 2, pen, head)
-            # last block's head: tile row nb*bh + t = strip row (bh - a) + t;
-            # rows past the strip feed only cropped outputs (clamp is safe)
-            bot_last = jnp.concatenate(
-                [
-                    rp_bot[min(bh - a + t, h - 1) : min(bh - a + t, h - 1) + 1]
-                    for t in range(h)
-                ],
-                axis=0,
+            top = jnp.where(j == 0, tscr_ref[:], tail_ref[:])
+
+            def beyond(t):
+                # tile row local_h + t is ghost-strip row t; rows past the
+                # strip feed only cropped outputs, so the clamp is safe
+                c = min(t, h - 1)
+                return rp_bot[c : c + 1]
+
+            ext = _assemble_ext(
+                j, top, main, rp, beyond, beyond,
+                nb=nb, bh=bh, h=h, a=a, nfix=nfix,
             )
-            pieces.append(jnp.where(j == nb - 1, bot_last, head))
-            ext = jnp.concatenate(pieces, axis=0)  # (bh + 2h, rp_w)
             out_ref[:] = _quantize_u8(op, col_pass(ext))
 
         tail_ref[:] = main_ref[bh - h :]
