@@ -220,8 +220,25 @@ def main() -> int:
     if errors:
         out["partial"] = True
         out["errors"] = errors
+    _append_history(out, records)
     print(json.dumps(out))
     return 0
+
+
+def _append_history(headline: dict, records: list[dict]) -> None:
+    """Append every run's records to BENCH_HISTORY.jsonl (committed), so a
+    tunnel wedge at the driver's round-end run cannot erase evidence of an
+    earlier healthy-window TPU measurement (the round-1 failure mode)."""
+    try:
+        entry = {
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "headline": headline,
+            "records": records,
+        }
+        with open(os.path.join(REPO, "BENCH_HISTORY.jsonl"), "a") as f:
+            f.write(json.dumps(entry) + "\n")
+    except OSError as e:  # never let bookkeeping break the bench record
+        _log(f"history append failed: {e}")
 
 
 if __name__ == "__main__":
