@@ -21,6 +21,8 @@ PIPELINES = [
     "grayscale,sobel,invert",
     "grayscale,box:3,sharpen",
     "invert,grayscale,brightness:-20,gaussian:5",
+    "grayscale,median:5",
+    "grayscale,median:3,erode:3",
 ]
 
 dims = st.tuples(
@@ -64,6 +66,26 @@ def test_sharded_matches_golden_on_random_shapes(args):
         assert "use fewer shards" in str(e)  # statically infeasible split
         return
     np.testing.assert_array_equal(got, golden)
+
+
+@settings(max_examples=25, deadline=None)
+@given(
+    st.integers(min_value=7, max_value=60),
+    st.integers(min_value=7, max_value=60),
+    st.sampled_from([3, 5]),
+    st.integers(min_value=0, max_value=2**31 - 1),
+)
+def test_median_matches_numpy_on_random_shapes(h, w, size, seed):
+    # independent oracle: numpy median over sliding windows, reflect border
+    from mpi_cuda_imagemanipulation_tpu.ops.registry import make_median
+
+    img = synthetic_image(h, w, channels=1, seed=seed)
+    ha = (size - 1) // 2
+    pad = np.pad(img, ha, mode="reflect")
+    win = np.lib.stride_tricks.sliding_window_view(pad, (size, size))
+    want = np.median(win.reshape(h, w, size * size), axis=-1).astype(np.uint8)
+    got = np.asarray(make_median(size)(jnp.asarray(img)))
+    np.testing.assert_array_equal(got, want)
 
 
 @settings(max_examples=30, deadline=None)
