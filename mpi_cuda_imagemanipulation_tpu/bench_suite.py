@@ -68,6 +68,10 @@ CONFIGS: dict[str, BenchConfig] = {
         ),
         BenchConfig("median3_4k", "median:3", 2160, 3840, 1),
         BenchConfig("erode5_4k", "erode:5", 2160, 3840, 1),
+        # batched headline: probes whether the ~92 GB/s effective cap is
+        # per-dispatch (vmap amortises grid setup / exposes more DMA
+        # parallelism) — see BASELINE.md round-2 analysis
+        BenchConfig("gaussian5_8k_batch2", "gaussian:5", 4320, 7680, 1, batch=2),
     ]
 }
 
