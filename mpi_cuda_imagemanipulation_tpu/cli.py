@@ -87,6 +87,25 @@ def _build_parser() -> argparse.ArgumentParser:
         help="open the result in the system image viewer (the reference's "
         "imshow/waitKey, kernel.cu:233-235; no-op on headless hosts)",
     )
+    def _positive_float(v: str) -> float:
+        f = float(v)
+        if f <= 0:
+            raise argparse.ArgumentTypeError(
+                f"--device-timeout must be positive, got {v}"
+            )
+        return f
+
+    run.add_argument(
+        "--device-timeout",
+        type=_positive_float,
+        default=None,
+        metavar="SECS",
+        help="run the device computation in a watchdog subprocess with this "
+        "wall-clock budget; a wedged accelerator backend then fails fast "
+        "with a clean error instead of hanging the process (failure-"
+        "detection posture, SURVEY.md §5 — the reference deadlocks its "
+        "peers on mid-collective failure, kernel.cu:150)",
+    )
 
     batch = sub.add_parser(
         "batch", help="run a pipeline over every image in a directory"
@@ -165,32 +184,64 @@ def cmd_run(args: argparse.Namespace) -> int:
     img = load_image(args.input)
     log.info("loaded %s: %s", args.input, img.shape)
 
-    if args.shards > 1:
-        mesh = make_mesh(args.shards)
-        if args.block:
-            log.warning("--block applies to single-device Pallas runs; ignored")
-        fn = pipe.sharded(mesh, backend=args.impl)
+    guarded = args.device_timeout is not None
+    if guarded:
+        from mpi_cuda_imagemanipulation_tpu.utils.guard import (
+            DeviceTimeoutError,
+            run_guarded,
+        )
+
+        if args.profile_dir:
+            log.warning(
+                "--profile-dir is not supported in guarded mode "
+                "(--device-timeout); ignored"
+            )
+        t0 = time.perf_counter()
+        try:
+            out = run_guarded(
+                args.ops,
+                np.asarray(img),
+                args.device_timeout,
+                impl=args.impl,
+                block_h=args.block,
+                shards=args.shards,
+            )
+        except DeviceTimeoutError as e:
+            log.error("%s", e)
+            return 4
+        compile_and_run_s = time.perf_counter() - t0
+        steady_s = None  # a one-shot subprocess has no warm second call
     else:
-        if args.block and args.impl == "xla":
-            log.warning("--block only affects Pallas kernels; ignored for xla")
-        fn = pipe.jit(backend=args.impl, block_h=args.block)
+        if args.shards > 1:
+            mesh = make_mesh(args.shards)
+            if args.block:
+                log.warning(
+                    "--block applies to single-device Pallas runs; ignored"
+                )
+            fn = pipe.sharded(mesh, backend=args.impl)
+        else:
+            if args.block and args.impl == "xla":
+                log.warning(
+                    "--block only affects Pallas kernels; ignored for xla"
+                )
+            fn = pipe.jit(backend=args.impl, block_h=args.block)
 
-    if args.profile_dir:
-        jax.profiler.start_trace(args.profile_dir)
+        if args.profile_dir:
+            jax.profiler.start_trace(args.profile_dir)
 
-    t0 = time.perf_counter()
-    out = jax.block_until_ready(fn(img))
-    compile_and_run_s = time.perf_counter() - t0
-    steady_s = None
-    if args.show_timing or args.json_metrics:
-        # second run isolates steady-state latency from compile time
         t0 = time.perf_counter()
         out = jax.block_until_ready(fn(img))
-        steady_s = time.perf_counter() - t0
+        compile_and_run_s = time.perf_counter() - t0
+        steady_s = None
+        if args.show_timing or args.json_metrics:
+            # second run isolates steady-state latency from compile time
+            t0 = time.perf_counter()
+            out = jax.block_until_ready(fn(img))
+            steady_s = time.perf_counter() - t0
 
-    if args.profile_dir:
-        jax.profiler.stop_trace()
-        log.info("profile written to %s", args.profile_dir)
+        if args.profile_dir:
+            jax.profiler.stop_trace()
+            log.info("profile written to %s", args.profile_dir)
 
     out = np.asarray(out)
     if needs_rgb_output and out.ndim == 2:
@@ -208,13 +259,20 @@ def cmd_run(args: argparse.Namespace) -> int:
             log.warning("--show failed (headless?): %s", e)
 
     mp = img.shape[0] * img.shape[1] / 1e6
-    if args.show_timing and steady_s is not None:
-        print(
-            f"pipeline [{pipe.name}] impl={args.impl} shards={args.shards}: "
-            f"first call (incl. compile) {compile_and_run_s * 1e3:.2f} ms, "
-            f"steady-state {steady_s * 1e3:.2f} ms "
-            f"({mp / steady_s:.1f} MP/s)"
-        )
+    if args.show_timing:
+        if steady_s is not None:
+            print(
+                f"pipeline [{pipe.name}] impl={args.impl} shards={args.shards}: "
+                f"first call (incl. compile) {compile_and_run_s * 1e3:.2f} ms, "
+                f"steady-state {steady_s * 1e3:.2f} ms "
+                f"({mp / steady_s:.1f} MP/s)"
+            )
+        else:
+            print(
+                f"pipeline [{pipe.name}] impl={args.impl} shards={args.shards} "
+                f"(guarded subprocess): {compile_and_run_s * 1e3:.2f} ms incl. "
+                f"compile + process spawn; steady-state timing unavailable"
+            )
     if args.json_metrics:
         emit_json_metrics(
             {
@@ -222,11 +280,12 @@ def cmd_run(args: argparse.Namespace) -> int:
                 "ops": pipe.name,
                 "impl": args.impl,
                 "shards": args.shards,
+                "guarded": guarded,
                 "height": img.shape[0],
                 "width": img.shape[1],
                 "compile_and_run_s": compile_and_run_s,
                 "steady_s": steady_s,
-                "mp_per_s": mp / steady_s,
+                "mp_per_s": mp / steady_s if steady_s else None,
             },
             None if args.json_metrics == "-" else args.json_metrics,
         )

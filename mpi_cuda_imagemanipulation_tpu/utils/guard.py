@@ -1,0 +1,91 @@
+"""Device-hang guard: run a pipeline in a watchdog subprocess.
+
+The framework's failure-detection posture is fail-fast (SURVEY.md §5 — the
+reference instead `return 1`s mid-collective and deadlocks its peers,
+kernel.cu:150). One failure mode fail-fast cannot catch in-process is a
+wedged accelerator backend: on a remote-attached TPU the first device call
+can block forever inside the runtime, beyond the reach of Python signal
+handlers. `run_guarded` executes the pipeline in a child process with a
+wall-clock budget, so the parent always regains control and can report a
+clean, actionable error (the same isolation strategy bench.py uses per
+config). Exposed on the CLI as `run --device-timeout SECS`.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+
+
+class DeviceTimeoutError(RuntimeError):
+    """The device computation exceeded its wall-clock budget."""
+
+
+_WORKER = """\
+import sys
+import numpy as np
+
+from mpi_cuda_imagemanipulation_tpu.parallel.mesh import distributed_init
+
+distributed_init()  # mpirun-analogue env (inherited) works guarded too
+
+from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline
+
+inp, outp, spec, impl, block, shards = sys.argv[1:7]
+img = np.load(inp)
+pipe = Pipeline.parse(spec)
+if int(shards) > 1:
+    from mpi_cuda_imagemanipulation_tpu.parallel.mesh import make_mesh
+
+    fn = pipe.sharded(make_mesh(int(shards)), backend=impl)
+else:
+    fn = pipe.jit(backend=impl, block_h=int(block) or None)
+np.save(outp, np.asarray(fn(img)))
+"""
+
+
+def run_guarded(
+    spec: str,
+    img: np.ndarray,
+    timeout_s: float,
+    *,
+    impl: str = "auto",
+    block_h: int | None = None,
+    shards: int = 1,
+) -> np.ndarray:
+    """Run `spec` over `img` in a subprocess with a wall-clock budget.
+
+    Raises DeviceTimeoutError when the budget is exceeded (wedged backend,
+    runaway compile) and RuntimeError on any child failure. The child
+    inherits the environment, so platform selection behaves exactly like an
+    in-process run.
+    """
+    if timeout_s <= 0:
+        raise ValueError(f"timeout_s must be positive, got {timeout_s}")
+    with tempfile.TemporaryDirectory(prefix="mcim_guard_") as td:
+        inp = os.path.join(td, "in.npy")
+        outp = os.path.join(td, "out.npy")
+        np.save(inp, np.asarray(img))
+        cmd = [
+            sys.executable, "-c", _WORKER,
+            inp, outp, spec, impl, str(block_h or 0), str(shards),
+        ]
+        try:
+            proc = subprocess.run(
+                cmd, timeout=timeout_s, capture_output=True, text=True
+            )
+        except subprocess.TimeoutExpired:
+            raise DeviceTimeoutError(
+                f"device computation exceeded {timeout_s:.0f}s — the "
+                "accelerator backend may be wedged (remote tunnel) or the "
+                "compile runaway; retry, raise --device-timeout, or run "
+                "with --device cpu"
+            ) from None
+        if proc.returncode != 0:
+            tail = (proc.stderr or proc.stdout or "").strip()[-800:]
+            raise RuntimeError(f"guarded run failed (rc={proc.returncode}): {tail}")
+        return np.load(outp)

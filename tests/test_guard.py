@@ -1,0 +1,77 @@
+"""Device-hang guard: subprocess watchdog semantics (utils/guard.py) and
+the CLI --device-timeout wiring. Failure-detection posture, SURVEY.md §5."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from mpi_cuda_imagemanipulation_tpu.io.image import synthetic_image
+from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline
+from mpi_cuda_imagemanipulation_tpu.utils.guard import (
+    DeviceTimeoutError,
+    run_guarded,
+)
+
+import jax.numpy as jnp
+
+
+def test_guarded_run_matches_inprocess():
+    img = synthetic_image(40, 56, channels=3, seed=61)
+    golden = np.asarray(
+        Pipeline.parse("grayscale,contrast:3.5,emboss:3")(jnp.asarray(img))
+    )
+    out = run_guarded("grayscale,contrast:3.5,emboss:3", img, 300.0)
+    np.testing.assert_array_equal(out, golden)
+
+
+def test_guarded_run_times_out():
+    img = synthetic_image(24, 24, channels=1, seed=62)
+    with pytest.raises(DeviceTimeoutError):
+        # budget far below interpreter startup: always trips, without
+        # needing an actually wedged device
+        run_guarded("invert", img, 0.05)
+
+
+def test_guarded_run_propagates_child_errors():
+    img = synthetic_image(24, 24, channels=1, seed=63)
+    with pytest.raises(RuntimeError, match="guarded run failed"):
+        run_guarded("definitely-not-an-op", img, 300.0)
+
+
+def test_cli_device_timeout_flag(tmp_path):
+    from PIL import Image
+
+    inp = tmp_path / "in.png"
+    outp = tmp_path / "out.png"
+    Image.fromarray(synthetic_image(32, 48, channels=3, seed=64)).save(inp)
+    env = dict(os.environ)
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "mpi_cuda_imagemanipulation_tpu", "run",
+            "--input", str(inp), "--output", str(outp),
+            "--device-timeout", "300",
+        ],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=310,
+    )
+    assert proc.returncode == 0, proc.stderr[-800:]
+    direct = tmp_path / "direct.png"
+    proc2 = subprocess.run(
+        [
+            sys.executable, "-m", "mpi_cuda_imagemanipulation_tpu", "run",
+            "--input", str(inp), "--output", str(direct),
+        ],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=310,
+    )
+    assert proc2.returncode == 0, proc2.stderr[-800:]
+    np.testing.assert_array_equal(
+        np.asarray(Image.open(outp)), np.asarray(Image.open(direct))
+    )
