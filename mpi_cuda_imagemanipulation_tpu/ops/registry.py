@@ -508,6 +508,7 @@ REGISTRY: dict[str, Callable[[str | None], Op]] = {
     "pad": lambda a: _parse_pad(a),
     "resize": lambda a: _parse_resize(a),
     "scale": lambda a: _parse_scale(a),
+    "rotate": lambda a: _parse_rotate(a),
     # global-statistics (ops/histogram.py) — psum-combined histograms
     "equalize": lambda a: histogram.EQUALIZE,
     "autocontrast": lambda a: histogram.AUTOCONTRAST,
@@ -544,6 +545,15 @@ def _parse_resize(arg: str | None):
     h, w = _parse_size(parts[0])
     method = parts[1] if len(parts) > 1 else "bilinear"
     return geometry.make_resize(h, w, method)
+
+
+def _parse_rotate(arg: str | None):
+    parts = (arg or "").split(":")
+    if not parts or not parts[0]:
+        raise ValueError("rotate needs rotate:DEGREES or rotate:DEGREES:nearest")
+    angle = float(parts[0])
+    method = parts[1] if len(parts) > 1 else "bilinear"
+    return geometry.make_rotate(angle, method)
 
 
 def _parse_scale(arg: str | None):

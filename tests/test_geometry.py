@@ -197,3 +197,92 @@ def test_sharded_bitexact_with_geometry(spec):
     golden = np.asarray(pipe(jnp.asarray(img)))
     sharded = np.asarray(pipe.sharded(mesh)(jnp.asarray(img)))
     np.testing.assert_array_equal(sharded, golden, err_msg=spec)
+
+
+# ---- arbitrary-angle rotation (cv2.warpAffine analogue) ----
+
+
+def test_rotate_quarter_turns_match_exact_ops():
+    from mpi_cuda_imagemanipulation_tpu.ops.registry import make_op
+
+    img = synthetic_image(33, 33, channels=1, seed=70)
+    # ccw-positive (PIL/OpenCV convention): rotate:90 == the ROT270 named op
+    np.testing.assert_array_equal(
+        np.asarray(make_op("rotate:90")(jnp.asarray(img))),
+        np.asarray(make_op("rot270")(jnp.asarray(img))),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(make_op("rotate:-90")(jnp.asarray(img))),
+        np.asarray(make_op("rot90")(jnp.asarray(img))),
+    )
+
+
+@pytest.mark.parametrize("hw", [(33, 33), (32, 48)])
+@pytest.mark.parametrize("method", ["bilinear", "nearest"])
+def test_rotate_180_and_identity(hw, method):
+    from mpi_cuda_imagemanipulation_tpu.ops.registry import make_op
+
+    img = synthetic_image(*hw, channels=3, seed=71)
+    np.testing.assert_array_equal(
+        np.asarray(make_op(f"rotate:180:{method}")(jnp.asarray(img))),
+        np.asarray(make_op("rot180")(jnp.asarray(img))),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(make_op(f"rotate:0:{method}")(jnp.asarray(img))), img
+    )
+
+
+def test_rotate_matches_pil_quarter_turn():
+    from PIL import Image
+
+    from mpi_cuda_imagemanipulation_tpu.ops.registry import make_op
+
+    img = synthetic_image(25, 25, channels=1, seed=72)
+    pil = np.asarray(Image.fromarray(img).rotate(90, resample=Image.NEAREST))
+    got = np.asarray(make_op("rotate:90:nearest")(jnp.asarray(img)))
+    np.testing.assert_array_equal(got, pil)
+
+
+def test_rotate_close_to_pil_bilinear():
+    from PIL import Image
+
+    from mpi_cuda_imagemanipulation_tpu.ops.registry import make_op
+
+    img = synthetic_image(41, 41, channels=1, seed=73)
+    pil = np.asarray(
+        Image.fromarray(img).rotate(30, resample=Image.BILINEAR)
+    ).astype(int)
+    got = np.asarray(make_op("rotate:30")(jnp.asarray(img))).astype(int)
+    # different border/rounding conventions: require close agreement on the
+    # interior (away from the constant-border corners)
+    interior = np.s_[12:-12, 12:-12]
+    assert np.abs(got[interior] - pil[interior]).mean() < 2.0
+
+
+def test_rotate_rejects_bad_method():
+    from mpi_cuda_imagemanipulation_tpu.ops.registry import make_op
+
+    with pytest.raises(ValueError):
+        make_op("rotate:30:cubic")
+    with pytest.raises(ValueError):
+        make_op("rotate")
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 (fake CPU) devices")
+@pytest.mark.parametrize("spec", ["rotate:30", "grayscale,rotate:-17:nearest,gaussian:3"])
+def test_rotate_sharded_bitexact(spec):
+    img = synthetic_image(133, 64, channels=3, seed=74)
+    pipe = Pipeline.parse(spec)
+    mesh = make_mesh(8)
+    golden = np.asarray(pipe(jnp.asarray(img)))
+    sharded = np.asarray(pipe.sharded(mesh)(jnp.asarray(img)))
+    np.testing.assert_array_equal(sharded, golden, err_msg=spec)
+
+
+def test_rotate_rejects_nonfinite_angle():
+    from mpi_cuda_imagemanipulation_tpu.ops.registry import make_op
+
+    with pytest.raises(ValueError):
+        make_op("rotate:nan")
+    with pytest.raises(ValueError):
+        make_op("rotate:inf")
