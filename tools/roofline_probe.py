@@ -86,7 +86,52 @@ def main() -> int:
             except Exception as e:
                 emit({"case": name, "block_h": bh, "error": str(e)[:200]})
 
-    # d) the headline kernel in the same process/chip state
+    # d) lagged copy through VMEM scratch: the streaming kernels' exact
+    # grid/dependency structure (out block j written at step j+1 from a
+    # scratch carried across steps) with zero stencil compute — isolates
+    # whether the carry structure itself, not the VPU work, sets the cap
+    def lagged_copy_call(bh):
+        nb = -(-H // bh)
+
+        def kernel(in_ref, out_ref, scr_ref):
+            i = pl.program_id(0)
+
+            @pl.when(i >= 1)
+            def _():
+                out_ref[:] = scr_ref[:]
+
+            scr_ref[:] = in_ref[:]
+
+        return pl.pallas_call(
+            kernel,
+            grid=(nb + 1,),
+            in_specs=[
+                pl.BlockSpec(
+                    (bh, W),
+                    lambda i, n=nb: (jnp.minimum(i, n - 1), 0),
+                    memory_space=pltpu.VMEM,
+                )
+            ],
+            out_specs=pl.BlockSpec(
+                (bh, W), lambda i: (jnp.maximum(i - 1, 0), 0),
+                memory_space=pltpu.VMEM,
+            ),
+            out_shape=jax.ShapeDtypeStruct((nb * bh, W), jnp.uint8),
+            scratch_shapes=[pltpu.VMEM((bh, W), jnp.uint8)],
+            compiler_params=_COMPILER_PARAMS,
+        )
+
+    for bh in bhs[:2]:
+        try:
+            f = jax.jit(lambda x, bh=bh: lagged_copy_call(bh)(x)[:H])
+            sec = device_throughput(f, [img_u8])
+            emit({"case": "pallas_lagged_copy_u8", "block_h": bh,
+                  "ms": sec * 1e3, "gb_s": 2 * H * W / sec / 1e9})
+        except Exception as e:
+            emit({"case": "pallas_lagged_copy_u8", "block_h": bh,
+                  "error": str(e)[:200]})
+
+    # e) the headline kernel in the same process/chip state
     ops = make_pipeline_ops("gaussian:5")
     f = jax.jit(lambda x: pipeline_pallas(ops, x))
     sec = device_throughput(f, [img_u8])
