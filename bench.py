@@ -182,7 +182,7 @@ def main() -> int:
     records: list[dict] = []
     if on_tpu:
         # the sharded config runs even on one chip: it exercises the
-        # fused-ghost shard_map path (stencil_tile_pallas_fused), which is
+        # fused-ghost shard_map path (run_group ghost mode), which is
         # the configuration that matters on a pod
         plan = [
             (HEADLINE, "pallas"),

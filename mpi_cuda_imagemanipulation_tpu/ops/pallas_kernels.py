@@ -324,10 +324,11 @@ def _assemble_ext(
     skip_fixes: bool = False,
 ):
     """Build the (bh + 2h, rp_w) column-pass input for output block j from
-    the streaming carry — the ONE copy of the ragged-last-block math shared
-    by _stream_kernel (full-image path, beyond-image rows synthesised from
-    the op's edge extension) and stencil_tile_pallas_fused (sharded path,
-    beyond-tile rows sourced from the ghost strip).
+    the streaming carry — the ONE copy of the ragged-last-block math,
+    shared by _stream_kernel's two modes: full-image (beyond-image rows
+    synthesised from the op's edge extension) and sharded ghost mode
+    (beyond-tile rows sourced from the bottom ghost strip; reached via
+    parallel/api._apply_group_fused -> run_group).
 
     `top`/`main`/`rp` are the row-passed carries: block j-1's last h rows
     (already j==0-selected by the caller), block j, and block j+1 (whose
@@ -378,84 +379,151 @@ def _stream_kernel(
     global_h: int,
     global_w: int,
     rp_u8: bool,
+    ghosts: bool = False,
+    image_h: int | None = None,
+    image_w: int | None = None,
 ):
+    """The fused [pointwise*, stencil] streaming kernel.
+
+    Full-image mode (`ghosts=False`): `global_h` is the image height and
+    rows beyond it are synthesised from the op's edge extension.
+    Sharded ghost mode (`ghosts=True`): the tile is one row-shard of height
+    `global_h` (local), refs carry two extra (halo, W) raw pre-pointwise
+    ghost strips per input plane plus a leading (1,) SMEM y0 scalar, and
+    beyond-tile rows come from the bottom strip; the interior mask then
+    follows global coordinates y0 + j*block_h against `image_h`/`image_w`.
+    """
     h = stencil.halo
     mode = stencil.edge_mode
     row_pass, col_pass, rp_w, _ = _split_passes(stencil, global_w)
-    in_refs = refs[:n_in]
-    out_refs = refs[n_in : n_in + n_out]
-    scratch = refs[n_in + n_out :]  # (main, tail) per output plane
+    if ghosts:
+        y0_ref = refs[0]
+        in_refs = refs[1 : 1 + n_in]
+        top_refs = refs[1 + n_in : 1 + 2 * n_in]
+        bot_refs = refs[1 + 2 * n_in : 1 + 3 * n_in]
+        out_refs = refs[1 + 3 * n_in : 1 + 3 * n_in + n_out]
+        scratch = refs[1 + 3 * n_in + n_out :]  # (main, tail, tscr, bscr)/plane
+        per_plane = 4
+    else:
+        in_refs = refs[:n_in]
+        out_refs = refs[n_in : n_in + n_out]
+        scratch = refs[n_in + n_out :]  # (main, tail) per output plane
+        per_plane = 2
 
     i = pl.program_id(0)
     j = i - 1  # output block index computed this step
 
-    if pointwise:
-        planes = [exact_f32(r[:]) for r in in_refs]
-        for op in pointwise:
-            planes = _apply_pointwise_planes(op, planes)
-    else:
-        planes = [r[:] for r in in_refs]  # raw u8 — cheap shifts in row_pass
-    assert len(planes) == n_out
+    def run_pointwise(rs):
+        if pointwise:
+            planes = [exact_f32(r[:]) for r in rs]
+            for op in pointwise:
+                planes = _apply_pointwise_planes(op, planes)
+        else:
+            planes = [r[:] for r in rs]  # raw u8 — cheap shifts in row_pass
+        assert len(planes) == n_out
+        return planes
 
-    # Last-block geometry (static): r1 = in-block row of image row H-1.
+    planes = run_pointwise(in_refs)
+
+    def cast_rp(x):
+        if rp_u8 and x.dtype != U8:
+            return _f32_to_u8(x)  # exact u8 integers by construction
+        return x
+
+    if ghosts:
+        # the strips never change across the grid: pointwise + row-pass
+        # them once into dedicated scratch at the first emit step
+        @pl.when(i == 1)
+        def _():
+            tops = run_pointwise(top_refs)
+            bots = run_pointwise(bot_refs)
+            for p_idx in range(n_out):
+                scratch[per_plane * p_idx + 2][:] = cast_rp(row_pass(tops[p_idx]))
+                scratch[per_plane * p_idx + 3][:] = cast_rp(row_pass(bots[p_idx]))
+
+    # Last-block geometry (static): r1 = in-block row of tile row H-1.
     # Rows past it (in-block and in the bottom strip) hold DMA garbage on
     # the last block; the ones inside reach of a valid output's window —
-    # image rows H..H-1+h — are replaced by the op's edge extension, as
-    # selects on the pieces of the ext concat the kernel builds anyway.
+    # tile rows H..H-1+h — are replaced by the op's edge extension (or, in
+    # ghost mode, by real neighbour rows from the bottom strip), as selects
+    # on the pieces of the ext concat the kernel builds anyway.
     r1 = (global_h - 1) - (nb - 1) * block_h
     a = min(r1 + 1, block_h)  # real rows in the last block
     nfix = min(h, block_h - a)  # garbage rows to fix inside the block
 
     for p_idx, x in enumerate(planes):
-        main_ref, tail_ref = scratch[2 * p_idx], scratch[2 * p_idx + 1]
-        rp = row_pass(x)
-        if rp_u8 and rp.dtype != U8:
-            rp = _f32_to_u8(rp)  # exact u8 integers by construction
+        main_ref = scratch[per_plane * p_idx]
+        tail_ref = scratch[per_plane * p_idx + 1]
+        rp = cast_rp(row_pass(x))
 
         @pl.when(i >= 1)
         def _(rp=rp, main_ref=main_ref, tail_ref=tail_ref, p_idx=p_idx):
             main = main_ref[:]
-            top = jnp.where(j == 0, _top_strip(main, h, mode), tail_ref[:])
+            if ghosts:
+                first_top = scratch[per_plane * p_idx + 2][:]
+                bscr = scratch[per_plane * p_idx + 3][:]
+            else:
+                first_top = _top_strip(main, h, mode)
+            top = jnp.where(j == 0, first_top, tail_ref[:])
 
-            def beyond(t):
-                """Row-pass row holding the edge extension of image row
-                H + t, sourced from the last block (`main` at the final emit
-                step) at a static offset; may cross into the halo strip.
-                Unreachable sources are clamped — they feed only outputs
-                past the image bottom (see module comment)."""
-                if mode == "reflect101":
-                    gp = 2 * (global_h - 1) - (global_h + t)
-                else:  # edge (zero/interior never fix)
-                    gp = global_h - 1
-                p = min(max(gp - (nb - 1) * block_h, -h), block_h - 1)
-                if p >= 0:
-                    return main[p : p + 1]
-                return top[h + p : h + p + 1]
+            if ghosts:
 
-            def beyond_pen(t):
-                """Same image row H + t one step earlier (j == nb-2), where
-                the ragged block's row pass lives in `rp` and block nb-2's
-                in `main`. Static offset: reflect source r1 - 1 - t."""
-                p = (r1 - 1 - t) if mode == "reflect101" else r1
-                if p >= 0:
-                    return rp[p : p + 1]
-                return main[block_h + p : block_h + p + 1]
+                def beyond(t, bscr=bscr):
+                    # tile row H + t is strip row t; rows past the strip
+                    # feed only cropped outputs, so the clamp is safe
+                    c = min(t, h - 1)
+                    return bscr[c : c + 1]
+
+                beyond_pen = beyond
+            else:
+
+                def beyond(t):
+                    """Row-pass row holding the edge extension of image row
+                    H + t, sourced from the last block (`main` at the final
+                    emit step) at a static offset; may cross into the halo
+                    strip. Unreachable sources are clamped — they feed only
+                    outputs past the image bottom (see module comment)."""
+                    if mode == "reflect101":
+                        gp = 2 * (global_h - 1) - (global_h + t)
+                    else:  # edge (zero/interior never fix)
+                        gp = global_h - 1
+                    p = min(max(gp - (nb - 1) * block_h, -h), block_h - 1)
+                    if p >= 0:
+                        return main[p : p + 1]
+                    return top[h + p : h + p + 1]
+
+                def beyond_pen(t):
+                    """Same image row H + t one step earlier (j == nb-2),
+                    where the ragged block's row pass lives in `rp` and
+                    block nb-2's in `main`. Static reflect source r1-1-t."""
+                    p = (r1 - 1 - t) if mode == "reflect101" else r1
+                    if p >= 0:
+                        return rp[p : p + 1]
+                    return main[block_h + p : block_h + p + 1]
 
             ext = _assemble_ext(
                 j, top, main, rp, beyond, beyond_pen,
                 nb=nb, bh=block_h, h=h, a=a, nfix=nfix,
-                # the interior mask passes through exactly the outputs whose
-                # windows could touch the garbage rows, so no fixes needed
-                skip_fixes=mode == "interior",
+                # full-image interior mode: the interior mask passes
+                # through exactly the outputs whose windows could touch the
+                # garbage rows, so no fixes needed. In ghost mode the
+                # beyond-tile rows are real data and must always be fixed.
+                skip_fixes=(mode == "interior" and not ghosts),
             )
             q = _quantize_u8(stencil, col_pass(ext))
             if mode == "interior":
                 orig = main[:, h : h + global_w] if rp_w != global_w else main
                 if orig.dtype != U8:
                     orig = _f32_to_u8(orig)  # exact u8 integers
-                mask = stencil.interior_mask(
-                    (block_h, global_w), j * block_h, 0, global_h, global_w
-                )
+                if ghosts:
+                    base = y0_ref[0] + j * block_h
+                    mask = stencil.interior_mask(
+                        (block_h, global_w), base, 0, image_h, image_w
+                    )
+                else:
+                    mask = stencil.interior_mask(
+                        (block_h, global_w), j * block_h, 0, global_h, global_w
+                    )
                 q = jnp.where(mask, q, orig)
             out_refs[p_idx][:] = q
 
@@ -538,8 +606,18 @@ def run_group(
     *,
     interpret: bool | None = None,
     block_h: int | None = None,
+    ghosts: tuple[list[jnp.ndarray], list[jnp.ndarray]] | None = None,
+    y0=None,
+    image_h: int | None = None,
+    image_w: int | None = None,
 ) -> list[jnp.ndarray]:
-    """Execute one [pointwise*, stencil?] group as a single pallas_call."""
+    """Execute one [pointwise*, stencil?] group as a single pallas_call.
+
+    `ghosts=(tops, bots)` switches the stencil kernel to sharded ghost mode
+    (see _stream_kernel): raw pre-pointwise (halo, W) strips per input
+    plane ride along as VMEM refs, `y0` (traced global row offset) and the
+    true `image_h`/`image_w` drive the interior mask. Requires a stencil.
+    """
     if (
         stencil is None
         and len(pointwise) == 1
@@ -616,22 +694,47 @@ def run_group(
         global_h=height,
         global_w=width,
         rp_u8=rp_u8,
+        ghosts=ghosts is not None,
+        image_h=image_h,
+        image_w=image_w,
     )
+    per_plane_scratch = 2 if ghosts is None else 4
     scratch_shapes = []
     for _ in range(n_out):
         scratch_shapes.append(pltpu.VMEM((bh, rp_w), rp_dtype))  # main
         scratch_shapes.append(pltpu.VMEM((h, rp_w), rp_dtype))  # tail
+        if per_plane_scratch == 4:
+            scratch_shapes.append(pltpu.VMEM((h, rp_w), rp_dtype))  # top rp
+            scratch_shapes.append(pltpu.VMEM((h, rp_w), rp_dtype))  # bot rp
+    in_specs = [
+        pl.BlockSpec(
+            (bh, width),
+            partial(lambda i, n: (jnp.minimum(i, n - 1), 0), n=nb),
+            memory_space=pltpu.VMEM,
+        )
+        for _ in range(n_in)
+    ]
+    args = list(planes)
+    if ghosts is not None:
+        tops, bots = ghosts
+        strip_spec = pl.BlockSpec(
+            (h, width), lambda i: (0, 0), memory_space=pltpu.VMEM
+        )
+        in_specs = (
+            [pl.BlockSpec(memory_space=pltpu.SMEM)]
+            + in_specs
+            + [strip_spec] * (2 * n_in)
+        )
+        args = (
+            [jnp.asarray(y0, jnp.int32).reshape(1)]
+            + args
+            + list(tops)
+            + list(bots)
+        )
     outs = pl.pallas_call(
         kernel,
         grid=(nb + 1,),
-        in_specs=[
-            pl.BlockSpec(
-                (bh, width),
-                partial(lambda i, n: (jnp.minimum(i, n - 1), 0), n=nb),
-                memory_space=pltpu.VMEM,
-            )
-            for _ in range(n_in)
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec(
                 (bh, width),
@@ -646,7 +749,7 @@ def run_group(
         scratch_shapes=scratch_shapes,
         interpret=interpret,
         compiler_params=_COMPILER_PARAMS,
-    )(*planes)
+    )(*args)
     outs = outs if isinstance(outs, (tuple, list)) else [outs]
     return [o[:height] for o in outs]
 
@@ -725,106 +828,34 @@ def stencil_tile_pallas_fused(
     *,
     interpret: bool | None = None,
     block_h: int | None = None,
+    y0=0,
+    image_h: int | None = None,
+    image_w: int | None = None,
 ) -> jnp.ndarray:
-    """Stencil over a sharded tile with its ghost strips as separate refs.
-
-    Unlike stencil_tile_pallas (which streams a caller-materialised
-    halo-extended copy of the tile — one extra HBM write + read of the whole
-    tile), this kernel streams the tile directly and consumes the two tiny
-    (halo, W) ghost strips in VMEM, so sharded HBM traffic matches the
+    """Stencil over a sharded tile with its ghost strips as separate refs —
+    a single-plane, no-pointwise wrapper over run_group's ghost mode (see
+    _stream_kernel). Streams the tile directly instead of a caller-
+    materialised halo-extended copy, so sharded HBM traffic matches the
     unsharded streaming kernel: one u8 read + one u8 write of the tile.
-    `top`/`bottom` must already hold the correct ghost rows (ppermuted
-    neighbour rows, with the op's edge extension on global-image edges —
-    parallel/api._fix_edge_strips). The ragged last block's garbage rows are
-    patched from the bottom strip at static offsets: a valid output row
-    r < local_h reads row-passed rows <= r + halo <= local_h - 1 + halo,
-    i.e. at most `halo` strip rows; deeper reads feed only cropped outputs
-    (same safety argument as _stream_kernel's bottom_src).
-
-    Caller guarantees: no global pad rows inside the tile (pad-to-multiple
-    rows would need edge extension mid-tile, which is position-dependent —
-    those cases use the materialised-ext path), and local_h > halo.
+    `top`/`bottom` must hold the correct ghost rows (ppermuted neighbour
+    rows, with the op's edge extension on global-image edges —
+    parallel/api._fix_edge_strips). Caller guarantees: no global pad rows
+    inside the tile and local_h > halo. Interior-mode ops additionally
+    need the traced global offset `y0` and true image dims for their mask.
     """
-    h = op.halo
-    local_h, width = tile.shape
-    bh = block_h or _pick_block_h(width, 1, 1, h, _live_f32_temps(op))
-    if 2 * h > bh:
-        raise ValueError(f"block_h {bh} too small for halo {h}")
-    row_pass, col_pass, rp_w, rp_needs_f32 = _split_passes(op, width)
-    rp_dtype = F32 if rp_needs_f32 else U8
-    nb = -(-local_h // bh)
-    r1 = (local_h - 1) - (nb - 1) * bh
-    a = min(r1 + 1, bh)  # real rows in the last block
-    nfix = min(h, bh - a)
-
-    def cast_rp(x):
-        return _f32_to_u8(x) if x.dtype != rp_dtype else x
-
-    def kernel(
-        in_ref, top_ref, bot_ref, out_ref, main_ref, tail_ref, tscr_ref, bscr_ref
-    ):
-        i = pl.program_id(0)
-        j = i - 1
-        rp = cast_rp(row_pass(in_ref[:]))
-
-        @pl.when(i == 1)
-        def _():
-            # the strips never change across the grid: row-pass them once
-            tscr_ref[:] = cast_rp(row_pass(top_ref[:]))
-            bscr_ref[:] = cast_rp(row_pass(bot_ref[:]))
-
-        @pl.when(i >= 1)
-        def _():
-            rp_bot = bscr_ref[:]
-            main = main_ref[:]
-            # ext rows [j*bh - h, j*bh): previous block's last h rows
-            top = jnp.where(j == 0, tscr_ref[:], tail_ref[:])
-
-            def beyond(t):
-                # tile row local_h + t is ghost-strip row t; rows past the
-                # strip feed only cropped outputs, so the clamp is safe
-                c = min(t, h - 1)
-                return rp_bot[c : c + 1]
-
-            ext = _assemble_ext(
-                j, top, main, rp, beyond, beyond,
-                nb=nb, bh=bh, h=h, a=a, nfix=nfix,
-            )
-            out_ref[:] = _quantize_u8(op, col_pass(ext))
-
-        tail_ref[:] = main_ref[bh - h :]
-        main_ref[:] = rp
-
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    out = pl.pallas_call(
-        kernel,
-        grid=(nb + 1,),
-        in_specs=[
-            pl.BlockSpec(
-                (bh, width),
-                partial(lambda i, n: (jnp.minimum(i, n - 1), 0), n=nb),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec((h, width), lambda i: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((h, width), lambda i: (0, 0), memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec(
-            (bh, width),
-            lambda i: (jnp.maximum(i - 1, 0), 0),
-            memory_space=pltpu.VMEM,
-        ),
-        out_shape=jax.ShapeDtypeStruct((nb * bh, width), U8),
-        scratch_shapes=[
-            pltpu.VMEM((bh, rp_w), rp_dtype),  # main: previous block's rp
-            pltpu.VMEM((h, rp_w), rp_dtype),  # tail: block-before's last h
-            pltpu.VMEM((h, rp_w), rp_dtype),  # top strip rp (set once)
-            pltpu.VMEM((h, rp_w), rp_dtype),  # bottom strip rp (set once)
-        ],
+    if op.edge_mode == "interior" and (image_h is None or image_w is None):
+        raise ValueError("interior-mode fused stencils need image_h/image_w")
+    return run_group(
+        [],
+        op,
+        [tile],
         interpret=interpret,
-        compiler_params=_COMPILER_PARAMS,
-    )(tile, top, bottom)
-    return out[:local_h]
+        block_h=block_h,
+        ghosts=([top], [bottom]),
+        y0=y0,
+        image_h=image_h,
+        image_w=image_w,
+    )[0]
 
 
 def pipeline_pallas(

@@ -117,6 +117,18 @@ def _fix_edge_strips(
     )
 
 
+def _resolve_backend(op: StencilOp, backend: str) -> str:
+    if backend != "auto":
+        return backend
+    from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
+        use_pallas_for_stencil,
+    )
+
+    # the sharded ext path runs the stencil kernel per channel plane,
+    # hence group_in_channels=1
+    return "pallas" if use_pallas_for_stencil(op, 1) else "xla"
+
+
 def _apply_stencil(
     op: StencilOp,
     tile: jnp.ndarray,
@@ -126,42 +138,11 @@ def _apply_stencil(
     n_shards: int,
     backend: str = "xla",
 ) -> jnp.ndarray:
+    """Materialised-ext stencil path (pad-to-multiple tiles, halo-0 ops,
+    and the XLA backend). The Pallas fast path is the fused-ghost group in
+    _apply_group_fused, selected by _run_segment's group walker."""
     h = op.halo
-    if backend == "auto":
-        from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
-            use_pallas_for_stencil,
-        )
-
-        # the sharded runner has no fused prologue: the stencil kernel is
-        # always run per channel plane, hence group_in_channels=1
-        backend = "pallas" if use_pallas_for_stencil(op, 1) else "xla"
-    local_h = tile.shape[0]
-    # Fused-ghost fast path: the Pallas kernel streams the tile directly and
-    # takes the two (halo, W) strips as separate refs, so no halo-extended
-    # copy of the tile is ever materialised in HBM (the round-1 sharded
-    # path's ~2x traffic). Requires no pad rows inside the tile (pad-to-
-    # multiple puts image-edge extension mid-tile) and local_h > halo for
-    # the strip synthesis.
-    if (
-        backend == "pallas"
-        and h >= 1  # halo-0 stencils (box:1) have no strips to exchange
-        and n_shards * local_h == global_h
-        and local_h > h
-    ):
-        top, bottom = exchange_halo_strips(tile, h, n_shards)
-        top, bottom = _fix_edge_strips(top, bottom, tile, op, y0, global_h)
-        if tile.ndim == 3:
-            return jnp.stack(
-                [
-                    _stencil_fused_plane(
-                        op, tile[..., c], top[..., c], bottom[..., c],
-                        y0, global_h, global_w,
-                    )
-                    for c in range(tile.shape[2])
-                ],
-                axis=-1,
-            )
-        return _stencil_fused_plane(op, tile, top, bottom, y0, global_h, global_w)
+    backend = _resolve_backend(op, backend)
     # halo exchange + global-edge fixup once on the full tile (2-D or HWC) —
     # on uint8 (dtype-generic gather/where), so colour images pay two
     # ppermutes total, not two per channel, and Pallas HBM traffic stays u8
@@ -179,24 +160,49 @@ def _apply_stencil(
     return _stencil_on_ext(op, ext, tile, y0, global_h, global_w, backend)
 
 
-def _stencil_fused_plane(
-    op: StencilOp,
+def _apply_group_fused(
+    pointwise,
+    stencil: StencilOp,
     tile: jnp.ndarray,
-    top: jnp.ndarray,
-    bottom: jnp.ndarray,
     y0: jnp.ndarray,
     global_h: int,
     global_w: int,
+    n_shards: int,
 ) -> jnp.ndarray:
-    from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
-        stencil_tile_pallas_fused,
-    )
+    """Run one [pointwise*, stencil] group as a single ghost-mode Pallas
+    call: the raw pre-pointwise tile streams through the kernel once, the
+    (halo, W) ghost strips (exchanged raw — pointwise ops are per-pixel, so
+    they commute with strip selection and are applied to the strips inside
+    the kernel) ride along in VMEM, and no intermediate pointwise output is
+    ever materialised in HBM.
+    """
+    from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import run_group
 
-    q = stencil_tile_pallas_fused(op, tile, top, bottom)
-    if op.edge_mode != "interior":
-        return q
-    mask = op.interior_mask(q.shape, y0, 0, global_h, global_w)
-    return jnp.where(mask, q, tile)
+    h = stencil.halo
+    top, bottom = exchange_halo_strips(tile, h, n_shards)
+    # Edge synthesis on the raw tile is exact for reflect101/edge (row
+    # selections commute with per-pixel ops). For interior mode the strip
+    # values on the first/last shard never reach an unmasked output, so the
+    # raw zeros are fine (mask passes those outputs through).
+    top, bottom = _fix_edge_strips(top, bottom, tile, stencil, y0, global_h)
+    if tile.ndim == 3:
+        planes = [tile[..., c] for c in range(tile.shape[2])]
+        tops = [top[..., c] for c in range(tile.shape[2])]
+        bots = [bottom[..., c] for c in range(tile.shape[2])]
+    else:
+        planes, tops, bots = [tile], [top], [bottom]
+    outs = run_group(
+        list(pointwise),
+        stencil,
+        planes,
+        ghosts=(tops, bots),
+        y0=y0,
+        image_h=global_h,
+        image_w=global_w,
+    )
+    if len(outs) == 1:
+        return outs[0]
+    return jnp.stack(outs, axis=-1)
 
 
 def _stencil_on_ext(
@@ -277,10 +283,27 @@ def _run_segment(ops, mesh, backend: str, any_pallas: bool, img: jnp.ndarray):
 
     def tile_fn(tile):
         y0 = lax.axis_index(ROWS) * local_h
+        # kernel-safe pointwise ops buffer until the next op decides their
+        # fate: fused into a ghost-mode Pallas stencil group (one HBM pass
+        # for the whole [pointwise*, stencil] chain) or flushed as XLA
+        # steps (which XLA fuses into one elementwise pass anyway)
+        pending: list[PointwiseOp] = []
+
+        def flush(t):
+            for p in pending:
+                t = p.fn(t)
+            pending.clear()
+            return t
+
         for op in ops:
             if isinstance(op, PointwiseOp):
-                tile = op.fn(tile)
+                if op.kernel_safe:
+                    pending.append(op)
+                else:
+                    tile = flush(tile)
+                    tile = op.fn(tile)
             elif isinstance(op, GlobalOp):
+                tile = flush(tile)
                 # additive statistic over valid (non-padding) rows, combined
                 # across shards with one psum — the MPI_Allreduce analogue
                 rows = y0 + lax.broadcasted_iota(jnp.int32, (tile.shape[0], 1), 0)
@@ -290,10 +313,41 @@ def _run_segment(ops, mesh, backend: str, any_pallas: bool, img: jnp.ndarray):
                 stats = lax.psum(op.stats(tile, valid), ROWS)
                 tile = op.apply(tile, stats)
             else:
-                tile = _apply_stencil(
-                    op, tile, y0, global_h, global_w, n, backend=backend
+                # Fused-ghost fast path: no pad rows inside the tile
+                # (pad-to-multiple needs position-dependent edge fixes),
+                # halo >= 1, a mode the streaming kernel supports, and
+                # enough local rows for strip synthesis. Auto mode judges
+                # the whole group (the buffered prologue's channel count
+                # matters: a 3-channel prologue forces planar form, where
+                # XLA measured faster for cheap halo-1 stencils).
+                if backend == "auto":
+                    from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
+                        use_pallas_for_stencil,
+                    )
+
+                    group_in = tile.shape[2] if tile.ndim == 3 else 1
+                    use_pallas = use_pallas_for_stencil(op, group_in)
+                else:
+                    use_pallas = backend == "pallas"
+                fusible = (
+                    use_pallas
+                    and op.halo >= 1
+                    and op.edge_mode != "zero"  # run_group rejects zero mode
+                    and n * local_h == global_h
+                    and local_h > op.halo
                 )
-        return tile
+                if fusible:
+                    group = list(pending)
+                    pending.clear()
+                    tile = _apply_group_fused(
+                        group, op, tile, y0, global_h, global_w, n
+                    )
+                else:
+                    tile = flush(tile)
+                    tile = _apply_stencil(
+                        op, tile, y0, global_h, global_w, n, backend=backend
+                    )
+        return flush(tile)
 
     def seq(x):
         for op in ops:

@@ -1,0 +1,37 @@
+"""The driver entry points (__graft_entry__.py) must keep working: entry()
+constructs without touching a backend, and dryrun_multichip survives in a
+fresh process (it mutates platform env vars, so it runs in a subprocess)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_entry_constructs():
+    sys.path.insert(0, _REPO)
+    import __graft_entry__ as g
+
+    fn, args = g.entry()
+    assert callable(fn) and len(args) == 1
+    assert args[0].shape == (512, 768, 3)
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_subprocess():
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            "import __graft_entry__ as g; g.dryrun_multichip(4)",
+        ],
+        cwd=_REPO,
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert proc.returncode == 0, (proc.stderr or proc.stdout)[-1500:]
+    assert "ok — sharded == golden" in proc.stdout

@@ -131,7 +131,7 @@ def test_sharded_pallas_backend_bitexact(spec):
 @pytest.mark.parametrize("height", [128, 136])
 def test_sharded_fused_ghost_path_bitexact(spec, height):
     # heights divisible by 8 with no pad rows take the fused-ghost Pallas
-    # kernel (stencil_tile_pallas_fused): tile streamed directly, ghost
+    # group (run_group ghost mode via _apply_group_fused): tile streamed, ghost
     # strips as separate refs — must equal the golden path bit-exactly,
     # including ragged last blocks (136/8 = 17 rows/shard)
     img = synthetic_image(

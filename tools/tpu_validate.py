@@ -70,7 +70,7 @@ BATCH_CASES = [
 ]
 
 # sharded pipelines on a 1-device mesh: exercises the fused-ghost kernel
-# (stencil_tile_pallas_fused — tile streamed directly, ghost strips as
+# (run_group ghost mode — tile streamed directly, ghost strips as
 # separate refs) compiled by Mosaic, which CI only runs in interpret mode.
 SHARDED_CASES = [
     ("gaussian:5", 1),
