@@ -145,6 +145,17 @@ def _build_parser() -> argparse.ArgumentParser:
     )
     bench.add_argument("--json-metrics", default=None)
 
+    diff = sub.add_parser(
+        "diff",
+        help="compare two images: max abs diff, differing pixels, PSNR "
+        "(the BASELINE.json parity metric); exit 0 iff bit-identical",
+    )
+    diff.add_argument("a", help="first image path")
+    diff.add_argument("b", help="second image path")
+    diff.add_argument(
+        "--json-metrics", default=None, help="write the record ('-' = stdout)"
+    )
+
     sub.add_parser("info", help="print device/mesh/version info")
     return p
 
@@ -427,6 +438,57 @@ def cmd_bench(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_diff(args: argparse.Namespace) -> int:
+    """Bit-exactness / PSNR comparison of two images — the verification
+    affordance the reference lacks entirely (its only check is visual
+    imshow, kern.cpp:89; PSNR is the BASELINE.json parity criterion)."""
+    import numpy as np
+
+    from mpi_cuda_imagemanipulation_tpu.io.image import load_image
+    from mpi_cuda_imagemanipulation_tpu.utils.log import emit_json_metrics
+
+    a = np.asarray(load_image(args.a)).astype(np.int64)
+    b = np.asarray(load_image(args.b)).astype(np.int64)
+    if a.shape != b.shape:
+        print(f"shape mismatch: {a.shape} vs {b.shape}")
+        if args.json_metrics:
+            emit_json_metrics(
+                {
+                    "event": "diff",
+                    "shape_a": list(a.shape),
+                    "shape_b": list(b.shape),
+                    "identical": False,
+                    "error": "shape mismatch",
+                },
+                None if args.json_metrics == "-" else args.json_metrics,
+            )
+        return 2
+    d = np.abs(a - b)
+    ndiff = int(np.count_nonzero(d))
+    mse = float((d.astype(np.float64) ** 2).mean())
+    psnr = float("inf") if mse == 0 else 10.0 * np.log10(255.0**2 / mse)
+    rec = {
+        "event": "diff",
+        "shape": list(a.shape),
+        "max_abs_diff": int(d.max()),
+        "differing_pixels": ndiff,
+        "total_pixels": int(d.size),
+        "mse": mse,
+        "psnr_db": psnr,
+        "identical": ndiff == 0,
+    }
+    print(
+        f"{'identical' if ndiff == 0 else 'DIFFERENT'}: maxdiff {rec['max_abs_diff']}, "
+        f"{ndiff}/{d.size} values differ, PSNR "
+        + ("inf" if mse == 0 else f"{psnr:.2f} dB")
+    )
+    if args.json_metrics:
+        emit_json_metrics(
+            rec, None if args.json_metrics == "-" else args.json_metrics
+        )
+    return 0 if ndiff == 0 else 1
+
+
 def cmd_info(args: argparse.Namespace) -> int:
     import jax
 
@@ -453,6 +515,7 @@ def main(argv: list[str] | None = None) -> int:
         "run": cmd_run,
         "batch": cmd_batch,
         "bench": cmd_bench,
+        "diff": cmd_diff,
         "info": cmd_info,
     }[args.cmd]
     try:

@@ -900,10 +900,12 @@ def use_pallas_for_stencil(stencil: StencilOp | None, group_in_channels: int) ->
     47 GP/s Pallas vs 11 GP/s XLA) — or for a multi-kernel combine
     (Sobel), unless the group drags a 3-channel prologue into planar form.
 
-    `group_in_channels` is the channel count *entering the group* (the
-    sharded runner has no fused prologue, so it passes 1). This single
-    helper is shared by pipeline_auto and parallel.api so the two auto
-    paths cannot drift.
+    `group_in_channels` is the channel count *entering the group*: the
+    sharded runner's fused ghost path passes its tile's real channel count
+    (parallel.api._run_segment), while its materialised-ext fallback runs
+    per plane and passes 1 (_resolve_backend). This single helper is
+    shared by pipeline_auto and parallel.api so the auto paths cannot
+    drift.
     """
     if stencil is None:
         return False

@@ -166,3 +166,25 @@ def test_bench_worker_single_config_json():
     assert rec["mp_per_s_per_chip"] > 0
     # one fused group: 3 u8 input planes read + 1 u8 gray plane written
     assert rec["hbm_bytes_model"] == (3 + 1) * 1080 * 1920
+
+
+def test_cli_diff(tmp_path):
+    from PIL import Image
+
+    from mpi_cuda_imagemanipulation_tpu.io.image import synthetic_image
+
+    a = synthetic_image(24, 32, channels=3, seed=81)
+    b = a.copy()
+    b[3, 4, 0] ^= 8
+    pa, pb = tmp_path / "a.png", tmp_path / "b.png"
+    Image.fromarray(a).save(pa)
+    Image.fromarray(b).save(pb)
+    same = _run_cli("diff", str(pa), str(pa))
+    assert same.returncode == 0 and "identical" in same.stdout, same.stdout
+    diff = _run_cli("diff", str(pa), str(pb), "--json-metrics", "-")
+    assert diff.returncode == 1 and "DIFFERENT" in diff.stdout, diff.stdout
+    assert '"differing_pixels": 1' in diff.stdout
+    Image.fromarray(a[:12]).save(pb)  # shape mismatch
+    mm = _run_cli("diff", str(pa), str(pb), "--json-metrics", "-")
+    assert mm.returncode == 2 and "shape mismatch" in mm.stdout
+    assert '"error": "shape mismatch"' in mm.stdout
