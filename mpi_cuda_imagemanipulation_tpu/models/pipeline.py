@@ -16,7 +16,6 @@ from __future__ import annotations
 
 import dataclasses
 from functools import partial
-from typing import Sequence
 
 import jax
 import jax.numpy as jnp
