@@ -11,7 +11,11 @@ Usage:
       [--shards N] [--device cpu|tpu] [--show-timing] [--json-metrics PATH|-]
       [--profile-dir DIR]
   python -m mpi_cuda_imagemanipulation_tpu bench [--configs ...]
-  python -m mpi_cuda_imagemanipulation_tpu info
+  python -m mpi_cuda_imagemanipulation_tpu info [--device cpu|tpu]
+
+`--device cpu` (or JAX_PLATFORMS=cpu in the env) stays pure-host even when a
+boot hook has force-registered an accelerator plugin whose first backend
+init could block on a wedged tunnel.
 """
 
 from __future__ import annotations
@@ -156,19 +160,26 @@ def _build_parser() -> argparse.ArgumentParser:
         "--json-metrics", default=None, help="write the record ('-' = stdout)"
     )
 
-    sub.add_parser("info", help="print device/mesh/version info")
+    info = sub.add_parser("info", help="print device/mesh/version info")
+    info.add_argument(
+        "--device",
+        default=None,
+        help="backend to report on (cpu|tpu); cpu never touches the TPU "
+        "plugin, so it works even when the chip/tunnel is wedged",
+    )
     return p
 
 
 def _configure_platform(device: str | None) -> None:
+    # Honor JAX_PLATFORMS from the environment when no --device was given
+    # (comma lists pass through verbatim): a user asking for cpu must never
+    # block on a wedged accelerator plugin.
+    if device is None:
+        device = os.environ.get("JAX_PLATFORMS") or None
     if device:
-        # The env var is only read at first jax import; this machine's
-        # sitecustomize (and any embedding app) may import jax at startup,
-        # so set the config directly as well — it wins either way.
-        os.environ["JAX_PLATFORMS"] = device
-        import jax
+        from mpi_cuda_imagemanipulation_tpu.utils.platform import claim_platform
 
-        jax.config.update("jax_platforms", device)
+        claim_platform(device)
 
 
 def cmd_run(args: argparse.Namespace) -> int:
@@ -490,6 +501,7 @@ def cmd_diff(args: argparse.Namespace) -> int:
 
 
 def cmd_info(args: argparse.Namespace) -> int:
+    _configure_platform(args.device)
     import jax
 
     from mpi_cuda_imagemanipulation_tpu._version import __version__

@@ -1,0 +1,57 @@
+"""Backend-claim helper: pick a JAX platform in a way that survives this
+machine's boot hook (and any embedding app).
+
+The threat model (observed on the tunnelled single-chip TPU this framework
+is developed against): a sitecustomize-style hook force-registers an
+accelerator plugin whenever ``PALLAS_AXON_POOL_IPS`` is set and overrides
+the platform choice via ``jax.config.update("jax_platforms", "axon,cpu")``
+at interpreter startup — which beats the ``JAX_PLATFORMS`` env var — and
+that plugin's first backend init can block *forever* on a wedged tunnel.
+A user (or test harness) asking for cpu must never touch it.
+
+One canonical recipe, shared by cli._configure_platform,
+__graft_entry__.dryrun_multichip and tests/conftest.py (review finding:
+three drifting copies previously existed).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def claim_platform(device: str, n_host_devices: int | None = None) -> None:
+    """Claim ``device`` ("cpu", "tpu", or a comma list) for this process.
+
+    - device == "cpu": also pops the accelerator-plugin trigger env var so
+      child processes (watchdog reruns, bench workers) never re-register
+      the plugin. Comma lists keep the trigger — a secondary platform is
+      explicitly wanted there.
+    - n_host_devices: set the XLA fake-host-device count (the
+      multi-chip-without-hardware test rig, SURVEY.md §4). Replaces any
+      previous count flag; only meaningful with cpu.
+
+    Safe to call before or after jax's first import; if backends were
+    already initialized under someone else's platform choice, the cache is
+    dropped so the next dispatch re-resolves under ours.
+    """
+    if device == "cpu":
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    if n_host_devices is not None:
+        flags = [
+            f
+            for f in os.environ.get("XLA_FLAGS", "").split()
+            if not f.startswith("--xla_force_host_platform_device_count")
+        ]
+        flags.append(f"--xla_force_host_platform_device_count={n_host_devices}")
+        os.environ["XLA_FLAGS"] = " ".join(flags)
+    os.environ["JAX_PLATFORMS"] = device
+
+    import jax
+
+    # config beats the env var, so re-assert the choice there; then drop
+    # any backend set cached under the previous choice (no-op when nothing
+    # initialized yet).
+    jax.config.update("jax_platforms", device)
+    import jax.extend.backend
+
+    jax.extend.backend.clear_backends()

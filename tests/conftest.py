@@ -8,23 +8,22 @@ hardware. Env vars must be set before the first jax import.
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-# This machine's sitecustomize force-registers the TPU plugin whenever
-# PALLAS_AXON_POOL_IPS is set, and overrides the platform choice via
-# jax.config.update("jax_platforms", "axon,cpu") at interpreter startup —
-# so clearing the env var here is too late; re-override the config below.
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-_flags = os.environ.get("XLA_FLAGS", "")
-if "--xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-
-import jax  # noqa: E402  (env must be set first)
-
-jax.config.update("jax_platforms", "cpu")
-
 # the checkout next to this conftest always wins over any installed copy —
 # a stale non-editable `pip install .` must never shadow the working tree
 # under test (the console script still comes from `pip install -e .`)
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mpi_cuda_imagemanipulation_tpu.utils.platform import claim_platform  # noqa: E402
+
+# claim cpu before anything initializes a backend (the boot-hook threat
+# model is documented in utils/platform.py); an explicit pre-set device
+# count (e.g. a 16-device sweep) is respected
+claim_platform(
+    "cpu",
+    n_host_devices=(
+        None
+        if "--xla_force_host_platform_device_count"
+        in os.environ.get("XLA_FLAGS", "")
+        else 8
+    ),
+)

@@ -1,0 +1,31 @@
+#!/bin/bash
+# Wait for a healthy TPU-tunnel window, then capture the round's pending
+# measurements back-to-back (serialized — concurrent clients and killed
+# mid-RPC processes are suspected wedge triggers on this relay):
+#   1. tools/roofline_probe.py  -> roofline_r02.out
+#   2. bench.py                 -> bench_manual.out (+ BENCH_HISTORY.jsonl)
+# Logs to tools/tpu_window.log. Safe to re-run; exits after one capture.
+set -u
+cd "$(dirname "$0")/.."
+LOG=tools/tpu_window.log
+log() { echo "$(date -u +%H:%M:%S) $*" >> "$LOG"; }
+
+log "watcher start pid=$$"
+for attempt in $(seq 1 120); do
+  if timeout 150 python -c "
+import jax, jax.numpy as jnp
+assert jax.default_backend() in ('tpu', 'axon')
+float(jnp.sum(jnp.arange(64.0)))
+print('HEALTHY')" >> "$LOG" 2>&1; then
+    log "healthy window found (attempt $attempt); running roofline probe"
+    timeout 2400 python tools/roofline_probe.py > roofline_r02.out 2>&1
+    log "roofline probe rc=$? ; running bench.py"
+    timeout 5400 python bench.py > bench_manual.out 2>&1
+    log "bench.py rc=$? ; done"
+    exit 0
+  fi
+  log "probe attempt $attempt failed; sleeping 180s"
+  sleep 180
+done
+log "gave up after 120 attempts"
+exit 1
