@@ -17,9 +17,29 @@ three drifting copies previously existed).
 from __future__ import annotations
 
 import os
+import sys
 
 
-def claim_platform(device: str, n_host_devices: int | None = None) -> None:
+def _backends_initialized() -> bool:
+    """Whether any JAX backend client already exists in this process
+    (private-API probe, deliberately fail-open: unknown jax internals are
+    treated as 'not initialized' rather than blocking the claim)."""
+    if "jax" not in sys.modules:
+        return False
+    try:
+        from jax._src import xla_bridge
+
+        return bool(xla_bridge._backends)
+    except Exception:
+        return False
+
+
+def claim_platform(
+    device: str,
+    n_host_devices: int | None = None,
+    *,
+    keep_existing_count: bool = False,
+) -> None:
     """Claim ``device`` ("cpu", "tpu", or a comma list) for this process.
 
     - device == "cpu": also pops the accelerator-plugin trigger env var so
@@ -29,21 +49,45 @@ def claim_platform(device: str, n_host_devices: int | None = None) -> None:
     - n_host_devices: set the XLA fake-host-device count (the
       multi-chip-without-hardware test rig, SURVEY.md §4). Replaces any
       previous count flag; only meaningful with cpu.
+    - keep_existing_count: treat n_host_devices as a default — an explicit
+      count already in XLA_FLAGS (e.g. a 16-device sweep run) wins. This
+      policy lives here so call sites can't drift (review finding).
 
     Safe to call before or after jax's first import; if backends were
     already initialized under someone else's platform choice, the cache is
-    dropped so the next dispatch re-resolves under ours.
+    dropped so the next dispatch re-resolves under ours. The one thing that
+    cannot change after first device use is the host-device *count* (XLA
+    parses XLA_FLAGS once per process) — requesting a count change then
+    raises RuntimeError instead of silently no-opping.
     """
     if device == "cpu":
         os.environ.pop("PALLAS_AXON_POOL_IPS", None)
     if n_host_devices is not None:
-        flags = [
-            f
-            for f in os.environ.get("XLA_FLAGS", "").split()
-            if not f.startswith("--xla_force_host_platform_device_count")
-        ]
-        flags.append(f"--xla_force_host_platform_device_count={n_host_devices}")
-        os.environ["XLA_FLAGS"] = " ".join(flags)
+        existing = os.environ.get("XLA_FLAGS", "")
+        count_flag = f"--xla_force_host_platform_device_count={n_host_devices}"
+        if not (
+            keep_existing_count
+            and "--xla_force_host_platform_device_count" in existing
+        ) and count_flag not in existing.split():
+            # XLA parses XLA_FLAGS once per process: a count change after
+            # any backend initialized would silently not take effect (and
+            # make_mesh would later see too few devices), so fail loudly
+            # here instead. clear_backends below cannot help — it drops
+            # jax's backend cache, not XLA's parsed flags.
+            if _backends_initialized():
+                raise RuntimeError(
+                    f"claim_platform(n_host_devices={n_host_devices}) called "
+                    "after a JAX backend was already initialized; XLA_FLAGS "
+                    "is parsed once per process, so the count cannot change "
+                    "anymore. Claim the platform before first device use."
+                )
+            flags = [
+                f
+                for f in existing.split()
+                if not f.startswith("--xla_force_host_platform_device_count")
+            ]
+            flags.append(count_flag)
+            os.environ["XLA_FLAGS"] = " ".join(flags)
     os.environ["JAX_PLATFORMS"] = device
 
     import jax

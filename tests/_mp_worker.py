@@ -11,19 +11,15 @@ cross-process collectives — the one layer the fake-device tests can't reach.
 import os
 import sys
 
-# platform env must be pinned before any jax import (see tests/conftest.py)
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = [
-    f
-    for f in os.environ.get("XLA_FLAGS", "").split()
-    if not f.startswith("--xla_force_host_platform_device_count")
-]
-_flags.append("--xla_force_host_platform_device_count=4")
-os.environ["XLA_FLAGS"] = " ".join(_flags)
-
 # the checkout next to us always wins over any installed copy
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# platform must be claimed before any backend init (and before
+# distributed_init, which refuses to run once a backend exists);
+# claim_platform only touches env + config, never a backend
+from mpi_cuda_imagemanipulation_tpu.utils.platform import claim_platform  # noqa: E402
+
+claim_platform("cpu", n_host_devices=4)
 
 from mpi_cuda_imagemanipulation_tpu.parallel.mesh import (  # noqa: E402
     distributed_init,
@@ -45,7 +41,6 @@ from mpi_cuda_imagemanipulation_tpu.models.pipeline import (  # noqa: E402
 
 
 def main() -> int:
-    jax.config.update("jax_platforms", "cpu")
     assert jax.process_count() == 2, jax.process_count()
     assert jax.device_count() == 8, jax.device_count()
 

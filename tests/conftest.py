@@ -18,12 +18,4 @@ from mpi_cuda_imagemanipulation_tpu.utils.platform import claim_platform  # noqa
 # claim cpu before anything initializes a backend (the boot-hook threat
 # model is documented in utils/platform.py); an explicit pre-set device
 # count (e.g. a 16-device sweep) is respected
-claim_platform(
-    "cpu",
-    n_host_devices=(
-        None
-        if "--xla_force_host_platform_device_count"
-        in os.environ.get("XLA_FLAGS", "")
-        else 8
-    ),
-)
+claim_platform("cpu", n_host_devices=8, keep_existing_count=True)

@@ -148,6 +148,28 @@ def test_configure_platform_overrides_boot_hook_config():
         jax.config.update("jax_platforms", before)
 
 
+def test_claim_platform_count_change_after_init_raises():
+    """XLA parses XLA_FLAGS once per process, so a host-device-count change
+    after backend init can never take effect — claim_platform must raise
+    instead of silently no-opping (this pytest process has an initialized
+    8-device cpu backend, which is exactly that scenario)."""
+    import jax
+    import pytest
+
+    from mpi_cuda_imagemanipulation_tpu.utils.platform import claim_platform
+
+    jax.devices()  # force backend init (this file's other tests subprocess)
+    flags_before = os.environ.get("XLA_FLAGS")
+    with pytest.raises(RuntimeError, match="parsed once per process"):
+        claim_platform("cpu", n_host_devices=99)
+    assert os.environ.get("XLA_FLAGS") == flags_before  # raised before mutating
+    # an explicit existing count wins under keep_existing_count: no-op, no raise
+    claim_platform("cpu", n_host_devices=99, keep_existing_count=True)
+    assert os.environ.get("XLA_FLAGS") == flags_before
+    # re-claiming the already-effective count is also fine
+    claim_platform("cpu", n_host_devices=8)
+
+
 def test_bench_orchestrator_mirrors_suite_constants():
     """bench.py stays jax-free (a wedged TPU backend must not block it), so
     it duplicates two bench_suite values; assert they cannot drift."""

@@ -1,0 +1,29 @@
+"""Smoke for tools/soak.py — the randomized differential fuzzer must keep
+generating valid registry-wide chains and agreeing across backends (a
+handful of fixed-seed trials; the long soak runs out-of-band)."""
+
+import os
+import random
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import soak  # noqa: E402
+
+from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline  # noqa: E402
+
+
+def test_random_chains_parse_and_track_channels():
+    rng = random.Random(7)
+    for _ in range(50):
+        spec = soak.random_chain(rng)
+        Pipeline.parse(spec)  # raises on channel-flow violations
+
+
+def test_soak_trials_pass():
+    rng = random.Random(3)
+    for _ in range(4):
+        bad = soak.run_trial(rng, trial_seed=rng.randint(0, 2**31 - 1),
+                             verbose=False)
+        assert bad is None, bad

@@ -1,0 +1,225 @@
+#!/usr/bin/env python
+"""Randomized differential soak: random op chains on random shapes, all
+backends must agree bit-exactly.
+
+The framework's correctness story rests on one invariant (docs/design.md):
+golden jnp ops, XLA-jitted pipelines, fused Pallas kernels and the
+ppermute-sharded runner produce *identical* uint8 images. The example- and
+property-based suites check that pointwise on fixed op lists; this tool
+drives it across the whole registry — random chains (channel-count aware),
+random parameters, pathological shapes (narrow, sub-halo, lane-boundary
+widths), random shard counts including non-dividing ones.
+
+Usage:
+  PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python tools/soak.py [--iters N] [--seconds S] [--seed K] [--verbose]
+
+Any mismatch prints one REPRO json line (spec, h, w, seed, backend) and the
+tool exits 1. Pure CPU — safe to run while the TPU tunnel is down.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mpi_cuda_imagemanipulation_tpu.utils.platform import claim_platform
+
+claim_platform("cpu", n_host_devices=8, keep_existing_count=True)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from mpi_cuda_imagemanipulation_tpu.io.image import synthetic_image  # noqa: E402
+from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline  # noqa: E402
+from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (  # noqa: E402
+    pipeline_pallas,
+)
+from mpi_cuda_imagemanipulation_tpu.parallel.mesh import make_mesh  # noqa: E402
+
+
+def _rand_filter(rng: random.Random) -> str:
+    k = rng.choice((3, 5))
+    vals = [str(rng.randint(-4, 4)) for _ in range(k * k)]
+    return "filter:" + "/".join(vals)
+
+
+# template builders; channel compatibility is derived from the op
+# instances themselves in random_chain (make_op), never annotated here
+_POOL = [
+    lambda r: "grayscale",
+    lambda r: "grayscale601",
+    lambda r: "sepia",
+    lambda r: "gray2rgb",
+    lambda r: f"contrast:{r.uniform(0.5, 6):.1f}",
+    lambda r: f"brightness:{r.randint(-80, 80)}",
+    lambda r: "invert",
+    lambda r: f"threshold:{r.randint(1, 254)}",
+    lambda r: f"gamma:{r.uniform(0.3, 4):.2f}",
+    lambda r: f"posterize:{r.randint(1, 8)}",
+    lambda r: f"solarize:{r.randint(1, 254)}",
+    lambda r: f"emboss:{r.choice((3, 5))}",
+    lambda r: f"emboss101:{r.choice((3, 5))}",
+    lambda r: f"gaussian:{r.choice((3, 5, 7))}",
+    lambda r: f"box:{r.choice((3, 5, 7))}",
+    lambda r: "sobel",
+    lambda r: "prewitt",
+    lambda r: "scharr",
+    lambda r: f"laplacian:{r.choice((4, 8))}",
+    lambda r: "sharpen",
+    lambda r: "unsharp",
+    _rand_filter,
+    lambda r: f"erode:{r.choice((3, 5, 7))}",
+    lambda r: f"dilate:{r.choice((3, 5, 7))}",
+    lambda r: f"median:{r.choice((3, 5))}",
+    lambda r: r.choice(("fliph", "flipv", "transpose")),
+    lambda r: f"rot:{r.choice((90, 180, 270))}",
+    lambda r: f"rotate:{r.uniform(-170, 170):.1f}"
+     + (":nearest" if r.random() < 0.5 else ""),
+    lambda r: f"pad:{r.randint(1, 6)}:{r.choice(('zero', 'edge', 'reflect101'))}",
+    lambda r: f"resize:{r.randint(10, 90)}x{r.randint(10, 90)}"
+     + (":nearest" if r.random() < 0.5 else ""),
+    lambda r: f"scale:{r.uniform(0.4, 2.2):.2f}"
+     + (":nearest" if r.random() < 0.5 else ""),
+    lambda r: "equalize",
+    lambda r: "autocontrast",
+    lambda r: "otsu",
+]
+
+
+def random_chain(rng: random.Random, max_len: int = 5) -> str:
+    """A registry-wide random chain, valid for a 3-channel input. Channel
+    compatibility comes from the op instances themselves (make_op), not a
+    hand-maintained table, so new registry ops soak automatically once
+    added to _POOL."""
+    from mpi_cuda_imagemanipulation_tpu.ops.registry import make_op
+
+    chan = 3
+    parts: list[str] = []
+    for _ in range(rng.randint(1, max_len)):
+        for _attempt in range(30):
+            build = rng.choice(_POOL)
+            spec = build(rng)
+            op = make_op(spec)
+            need = getattr(op, "in_channels", 0)
+            if need and need != chan:
+                continue
+            parts.append(spec)
+            out = getattr(op, "out_channels", 0)
+            chan = out or need or chan
+            break
+    return ",".join(parts) or "invert"
+
+
+def _crop_for(rng: random.Random, h: int, w: int) -> str:
+    ch = rng.randint(max(1, h // 2), h)
+    cw = rng.randint(max(1, w // 2), w)
+    return f"crop:{rng.randint(0, h - ch)}:{rng.randint(0, w - cw)}:{ch}:{cw}"
+
+
+def random_shape(rng: random.Random) -> tuple[int, int]:
+    kind = rng.random()
+    if kind < 0.25:  # tiny / sub-halo heights
+        return rng.randint(9, 24), rng.randint(9, 40)
+    if kind < 0.5:  # lane-boundary widths
+        return rng.randint(20, 90), rng.choice((127, 128, 129, 255, 256, 257))
+    if kind < 0.75:  # generic small
+        return rng.randint(25, 120), rng.randint(25, 160)
+    return rng.randint(120, 300), rng.randint(40, 120)  # tall, shardable
+
+
+def run_trial(rng: random.Random, trial_seed: int, verbose: bool) -> dict | None:
+    h, w = random_shape(rng)
+    spec = random_chain(rng)
+    if rng.random() < 0.2:  # crop needs in-bounds params for this shape
+        spec = _crop_for(rng, h, w) + "," + spec
+    img = jnp.asarray(synthetic_image(h, w, channels=3, seed=trial_seed))
+    pipe = Pipeline.parse(spec)
+
+    def repro(backend, detail=""):
+        return {
+            "spec": spec, "h": h, "w": w, "seed": trial_seed,
+            "backend": backend, "detail": detail[:300],
+        }
+
+    golden = np.asarray(pipe(img))
+    if verbose:
+        print(f"  {spec!r} ({h}x{w}) -> {golden.shape}", flush=True)
+
+    try:
+        got = np.asarray(pipe.jit("xla")(img))
+    except Exception as e:  # noqa: BLE001 — any crash is a finding
+        return repro("xla", f"raised {type(e).__name__}: {e}")
+    if not np.array_equal(got, golden):
+        return repro("xla", "mismatch")
+
+    try:
+        got = np.asarray(pipeline_pallas(pipe.ops, img, interpret=True))
+    except Exception as e:  # noqa: BLE001
+        return repro("pallas", f"raised {type(e).__name__}: {e}")
+    if not np.array_equal(got, golden):
+        return repro("pallas", "mismatch")
+
+    n_dev = len(jax.devices())
+    if n_dev >= 2:
+        shards = rng.choice([s for s in (2, 3, 5, n_dev) if s <= n_dev])
+        mesh = make_mesh(shards)
+        backend = rng.choice(("xla", "pallas", "auto"))
+        try:
+            got = np.asarray(pipe.sharded(mesh, backend=backend)(img))
+        except ValueError as e:
+            if "below the minimum" in str(e):
+                return None  # documented guard: image too short for N shards
+            return repro(f"sharded-{shards}-{backend}",
+                         f"raised {type(e).__name__}: {e}")
+        except Exception as e:  # noqa: BLE001
+            return repro(f"sharded-{shards}-{backend}",
+                         f"raised {type(e).__name__}: {e}")
+        if not np.array_equal(got, golden):
+            return repro(f"sharded-{shards}-{backend}", "mismatch")
+    return None
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=200)
+    ap.add_argument("--seconds", type=float, default=None,
+                    help="stop after this much wall time (overrides --iters)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args()
+
+    rng = random.Random(args.seed)
+    t0 = time.time()
+    failures = 0
+    i = 0
+    while True:
+        if args.seconds is not None:
+            if time.time() - t0 > args.seconds:
+                break
+        elif i >= args.iters:
+            break
+        trial_seed = rng.randint(0, 2**31 - 1)
+        bad = run_trial(rng, trial_seed, args.verbose)
+        if bad is not None:
+            failures += 1
+            print("REPRO " + json.dumps(bad), flush=True)
+        i += 1
+        if i % 25 == 0:
+            print(f"soak: {i} trials, {failures} failures, "
+                  f"{time.time() - t0:.0f}s", flush=True)
+    print(f"soak done: {i} trials, {failures} failures, "
+          f"{time.time() - t0:.0f}s", flush=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

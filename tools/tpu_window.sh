@@ -1,22 +1,38 @@
 #!/bin/bash
 # Wait for a healthy TPU-tunnel window, then capture the round's pending
-# measurements back-to-back (serialized — concurrent clients and killed
-# mid-RPC processes are suspected wedge triggers on this relay):
+# measurements back-to-back (serialized — concurrent clients are a
+# suspected wedge trigger on this relay):
 #   1. tools/roofline_probe.py  -> roofline_r02.out
 #   2. bench.py                 -> bench_manual.out (+ BENCH_HISTORY.jsonl)
 # Logs to tools/tpu_window.log. Safe to re-run; exits after one capture.
+#
+# Probe attempts are spaced 4 min apart and each probe distinguishes a
+# wedged tunnel (hang -> timeout kill) from an env pinned to cpu (exit 2,
+# watcher stops immediately with a diagnosis instead of burning the retry
+# budget). Timeout-killed probes are unavoidable for health checks; the
+# long spacing keeps mid-RPC kills rare.
 set -u
 cd "$(dirname "$0")/.."
 LOG=tools/tpu_window.log
 log() { echo "$(date -u +%H:%M:%S) $*" >> "$LOG"; }
 
+# the accelerator plugin must be reachable for this watcher to make sense;
+# a cpu pin inherited from a test/soak shell would probe cpu forever
+unset JAX_PLATFORMS
+
 log "watcher start pid=$$"
-for attempt in $(seq 1 120); do
-  if timeout 150 python -c "
+for attempt in $(seq 1 60); do
+  timeout 150 python -c "
+import sys
 import jax, jax.numpy as jnp
-assert jax.default_backend() in ('tpu', 'axon')
+if jax.default_backend() == 'cpu':
+    print('MISCONFIG: backend resolved to cpu (no accelerator plugin '
+          'registered in this env)', flush=True)
+    sys.exit(2)
 float(jnp.sum(jnp.arange(64.0)))
-print('HEALTHY')" >> "$LOG" 2>&1; then
+print('HEALTHY', flush=True)" >> "$LOG" 2>&1
+  rc=$?
+  if [ "$rc" -eq 0 ]; then
     log "healthy window found (attempt $attempt); running roofline probe"
     timeout 2400 python tools/roofline_probe.py > roofline_r02.out 2>&1
     log "roofline probe rc=$? ; running bench.py"
@@ -24,8 +40,12 @@ print('HEALTHY')" >> "$LOG" 2>&1; then
     log "bench.py rc=$? ; done"
     exit 0
   fi
-  log "probe attempt $attempt failed; sleeping 180s"
-  sleep 180
+  if [ "$rc" -eq 2 ]; then
+    log "environment pinned to cpu — fix the env and re-run; exiting"
+    exit 2
+  fi
+  log "probe attempt $attempt failed rc=$rc; sleeping 240s"
+  sleep 240
 done
-log "gave up after 120 attempts"
+log "gave up after 60 attempts"
 exit 1
