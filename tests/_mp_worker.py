@@ -46,6 +46,10 @@ def main() -> int:
 
     mesh = make_mesh()  # all 8 global devices on ('rows',)
     pipe = reference_pipeline()
+    # MCIM_MP_BACKEND selects the sharded execution path (xla | pallas |
+    # auto) so the ghost-fused Pallas kernels also get cross-process
+    # ppermute coverage, not just the single-process fake-device kind
+    backend = os.environ.get("MCIM_MP_BACKEND", "xla")
     img = synthetic_image(128, 96, channels=3, seed=21)
 
     # every process holds the full (deterministic) image; the global array
@@ -55,7 +59,7 @@ def main() -> int:
     garr = jax.make_array_from_callback(
         img.shape, sharding, lambda idx: img[idx]
     )
-    out = pipe.sharded(mesh)(garr)
+    out = pipe.sharded(mesh, backend=backend)(garr)
     gathered = np.asarray(
         multihost_utils.process_allgather(out, tiled=True)
     )  # the MPI_Gather analogue (collective: both processes call it)

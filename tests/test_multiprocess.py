@@ -25,7 +25,8 @@ def _free_port() -> int:
 
 
 @pytest.mark.slow
-def test_two_process_sharded_pipeline_bitexact():
+@pytest.mark.parametrize("backend", ["xla", "pallas"])
+def test_two_process_sharded_pipeline_bitexact(backend):
     try:
         port = _free_port()
     except OSError as e:  # pragma: no cover
@@ -38,6 +39,7 @@ def test_two_process_sharded_pipeline_bitexact():
         env["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
         env["JAX_NUM_PROCESSES"] = "2"
         env["JAX_PROCESS_ID"] = str(pid)
+        env["MCIM_MP_BACKEND"] = backend
         procs.append(
             subprocess.Popen(
                 [sys.executable, worker],

@@ -37,7 +37,17 @@ print('HEALTHY', flush=True)" >> "$LOG" 2>&1
     timeout 2400 python tools/roofline_probe.py > roofline_r02.out 2>&1
     log "roofline probe rc=$? ; running bench.py"
     timeout 5400 python bench.py > bench_manual.out 2>&1
-    log "bench.py rc=$? ; done"
+    log "bench.py rc=$? ; capturing headline profiler trace"
+    timeout 300 python -c "
+from mpi_cuda_imagemanipulation_tpu.io.image import synthetic_image, save_image
+save_image('/tmp/mcim_8k.pgm', synthetic_image(4320, 7680, channels=1, seed=5))" \
+      >> "$LOG" 2>&1
+    log "image save rc=$?"
+    timeout 900 python -m mpi_cuda_imagemanipulation_tpu run \
+      --input /tmp/mcim_8k.pgm --output /tmp/mcim_8k_out.pgm \
+      --ops gaussian:5 --impl pallas --profile-dir profile_r02 \
+      --show-timing >> "$LOG" 2>&1
+    log "profile capture rc=$? ; done"
     exit 0
   fi
   if [ "$rc" -eq 2 ]; then
