@@ -166,9 +166,15 @@ def test_claim_platform_count_change_after_init_raises():
     # an explicit existing count wins under keep_existing_count: no-op, no raise
     claim_platform("cpu", n_host_devices=99, keep_existing_count=True)
     assert os.environ.get("XLA_FLAGS") == flags_before
-    # re-claiming with the existing count kept is also fine (the effective
-    # count may be 8 or a sweep override like 16 — don't hardcode it)
-    claim_platform("cpu", n_host_devices=8, keep_existing_count=True)
+    # re-claiming the already-effective count (whatever it is — 8, or a
+    # sweep override like 16) must short-circuit without raising even
+    # without keep_existing_count
+    effective = next(
+        int(f.rsplit("=", 1)[1])
+        for f in (flags_before or "").split()
+        if f.startswith("--xla_force_host_platform_device_count")
+    )
+    claim_platform("cpu", n_host_devices=effective)
 
 
 def test_bench_orchestrator_mirrors_suite_constants():

@@ -173,6 +173,22 @@ def run_trial(
     if not np.array_equal(got, golden):
         return repro("pallas", "mismatch")
 
+    if rng.random() < 0.35:  # batched (vmap) path: per-image bit-equality
+        k = rng.randint(2, 3)
+        imgs = jnp.stack(
+            [jnp.asarray(synthetic_image(h, w, channels=3, seed=trial_seed + t))
+             for t in range(k)]
+        )
+        backend_b = rng.choice(("xla", "pallas"))
+        try:
+            outs = np.asarray(pipe.batched(backend_b)(imgs))
+        except Exception as e:  # noqa: BLE001
+            return repro(f"batched-{backend_b}",
+                         f"raised {type(e).__name__}: {e}")
+        for t in range(k):
+            if not np.array_equal(outs[t], np.asarray(pipe(imgs[t]))):
+                return repro(f"batched-{backend_b}", f"mismatch at image {t}")
+
     n_dev = len(jax.devices())
     if n_dev >= 2:
         shards = rng.choice([s for s in (2, 3, 5, n_dev) if s <= n_dev])
@@ -205,6 +221,54 @@ def run_trial(
     return None
 
 
+def run_repro(line: str) -> int:
+    """Re-run one REPRO json line deterministically: same spec, shape and
+    image seed, every backend (all shard counts), verbose verdicts."""
+    d = json.loads(line)
+    spec, h, w, seed = d["spec"], d["h"], d["w"], d["seed"]
+    img = jnp.asarray(synthetic_image(h, w, channels=3, seed=seed))
+    pipe = Pipeline.parse(spec)
+    golden = np.asarray(pipe(img))
+    print(f"repro {spec!r} ({h}x{w}, seed {seed}) -> {golden.shape}")
+    rc = 0
+
+    def check(name, fn, skip_on_min_guard=False):
+        nonlocal rc
+        try:
+            got = np.asarray(fn())
+        except ValueError as e:
+            if skip_on_min_guard and "below the minimum" in str(e):
+                print(f"  {name}: skipped (image too short)")
+                return
+            print(f"  {name}: RAISED ValueError: {str(e)[:200]}")
+            rc = 1
+            return
+        except Exception as e:  # noqa: BLE001
+            print(f"  {name}: RAISED {type(e).__name__}: {str(e)[:200]}")
+            rc = 1
+            return
+        ok = np.array_equal(got, golden)
+        print(f"  {name}: {'ok' if ok else 'MISMATCH'}")
+        rc |= 0 if ok else 1
+
+    check("xla", lambda: pipe.jit("xla")(img))
+    check("pallas", lambda: pipeline_pallas(pipe.ops, img, interpret=True))
+    imgs = jnp.stack([img, img])
+    for b in ("xla", "pallas"):
+        check(f"batched-{b}", lambda b=b: pipe.batched(b)(imgs)[0])
+    n_dev = len(jax.devices())
+    for shards in sorted({s for s in (2, 3, 5, n_dev) if s <= n_dev}):
+        for b in ("xla", "pallas", "auto"):
+            check(
+                f"sharded-{shards}-{b}",
+                lambda shards=shards, b=b: pipe.sharded(
+                    make_mesh(shards), backend=b
+                )(img),
+                skip_on_min_guard=True,
+            )
+    return rc
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--iters", type=int, default=200)
@@ -212,7 +276,12 @@ def main() -> int:
                     help="stop after this much wall time (overrides --iters)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--verbose", action="store_true")
+    ap.add_argument("--repro", default=None,
+                    help="re-run one REPRO json line instead of fuzzing")
     args = ap.parse_args()
+
+    if args.repro:
+        return run_repro(args.repro)
 
     rng = random.Random(args.seed)
     t0 = time.time()
