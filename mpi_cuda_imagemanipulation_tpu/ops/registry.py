@@ -98,6 +98,40 @@ def make_contrast_core(factor: float) -> Callable[[jnp.ndarray], jnp.ndarray]:
     return contrast
 
 
+def _contrast_rounding_free(factor: float) -> bool:
+    """Whether clamp(f*(p-128)+128) incurs zero f32 rounding for every
+    p in 0..255 (checked on the host against float64). When it does, the
+    computation is immune to fma contraction / reordering and the fast
+    in-kernel core is bit-exact on every backend (true for the reference's
+    3.5 and 3, and any factor with a short binary fraction). When it does
+    not (e.g. 4.3), eager per-op rounding and XLA's fused multiply-add can
+    differ in the last ulp, which the trunc quantizer amplifies to a full
+    uint8 step — those factors route to a LUT instead."""
+    ff = np.float64(np.float32(factor))
+    d = np.arange(256, dtype=np.float64) - 128.0
+    prod = ff * d
+    if not np.array_equal(prod.astype(np.float32).astype(np.float64), prod):
+        return False
+    s = prod + 128.0
+    return bool(np.array_equal(s.astype(np.float32).astype(np.float64), s))
+
+
+def make_contrast_lut(factor: float) -> np.ndarray:
+    """256-entry contrast table reproducing the eager golden computation
+    (per-op f32 rounding: mul, add, clip, trunc) on the host — the one
+    result every backend then agrees on via a gather.
+
+    Deliberately pure numpy, NOT the jnp core evaluated on arange(256):
+    op construction happens at pipeline-parse time, which must never
+    dispatch to a device (the default backend can be a wedged remote
+    tunnel, utils/platform.py). Agreement with the in-graph core is
+    asserted for all 256 inputs by tests/test_golden.py instead."""
+    ff = np.float32(factor)
+    d = np.arange(256, dtype=np.float32) - np.float32(128.0)
+    v = (ff * d).astype(np.float32) + np.float32(128.0)
+    return np.floor(np.clip(v.astype(np.float32), 0.0, 255.0)).astype(np.uint8)
+
+
 def make_brightness_core(delta: float) -> Callable[[jnp.ndarray], jnp.ndarray]:
     d = np.float32(delta)
 
@@ -132,7 +166,12 @@ def make_gamma_lut(g: float) -> np.ndarray:
     return np.rint(255.0 * np.power(v, g)).astype(np.uint8)
 
 
-def make_lut_op(name: str, table: np.ndarray) -> PointwiseOp:
+def make_lut_op(
+    name: str,
+    table: np.ndarray,
+    in_channels: int = 0,
+    out_channels: int = 0,
+) -> PointwiseOp:
     """Pointwise op applying a 256-entry u8 lookup table via gather.
 
     kernel_safe=False: Mosaic has no general gather, so LUT ops run as XLA
@@ -144,7 +183,7 @@ def make_lut_op(name: str, table: np.ndarray) -> PointwiseOp:
     def fn(img: jnp.ndarray) -> jnp.ndarray:
         return jnp.take(t, img.astype(jnp.int32))
 
-    return PointwiseOp(name, 0, 0, fn=fn, kernel_safe=False)
+    return PointwiseOp(name, in_channels, out_channels, fn=fn, kernel_safe=False)
 
 
 # Standard sepia tone matrix (as used by e.g. Microsoft/ImageMagick docs),
@@ -212,10 +251,8 @@ def gray2rgb_u8(img: jnp.ndarray) -> jnp.ndarray:
 
 
 def make_contrast(factor: float) -> Callable[[jnp.ndarray], jnp.ndarray]:
-    """u8 -> u8 contrast function (see make_contrast_core)."""
-    return pointwise_from_core(
-        f"contrast{factor:g}", 1, 1, make_contrast_core(factor)
-    ).fn
+    """u8 -> u8 contrast function (see _make_contrast for factor routing)."""
+    return _make_contrast(factor).fn
 
 
 # --------------------------------------------------------------------------
@@ -445,17 +482,25 @@ def _int_arg(arg: str | None, default: int) -> int:
 
 
 # name -> factory(arg_str_or_None) -> Op
+def _make_contrast(f: float) -> PointwiseOp:
+    """Reference contrast. Rounding-free factors (3.5, 3, any short binary
+    fraction) use the in-kernel f32 core — bit-exact everywhere and fusable
+    into Pallas groups; other factors use a host-built LUT so eager, jitted
+    XLA (fma contraction) and Pallas execution all agree bit-exactly
+    (found by tools/soak.py: contrast:4.3 differed between eager and jit
+    by one uint8 step at trunc boundaries)."""
+    name = f"contrast{f:g}"
+    if _contrast_rounding_free(f):
+        return pointwise_from_core(name, 1, 1, make_contrast_core(f))
+    return make_lut_op(name, make_contrast_lut(f), in_channels=1, out_channels=1)
+
+
 REGISTRY: dict[str, Callable[[str | None], Op]] = {
     "grayscale": lambda a: _GRAYSCALE,
     "gray": lambda a: _GRAYSCALE,
     "grayscale601": lambda a: _GRAYSCALE601,
     "gray601": lambda a: _GRAYSCALE601,
-    "contrast": lambda a: pointwise_from_core(
-        f"contrast{_float_arg(a, 3.5):g}",
-        1,
-        1,
-        make_contrast_core(_float_arg(a, 3.5)),  # 3.5: kernel.cu:50
-    ),
+    "contrast": lambda a: _make_contrast(_float_arg(a, 3.5)),  # 3.5: kernel.cu:50
     "brightness": lambda a: pointwise_from_core(
         f"brightness{_float_arg(a, 0):g}",
         0,

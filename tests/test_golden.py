@@ -69,6 +69,72 @@ def test_contrast_saturates():
     np.testing.assert_array_equal(out, [[0, 128, 255, 0, 255]])
 
 
+def test_contrast_factor_routing():
+    """Rounding-free factors (reference 3.5/3, dyadic fractions) keep the
+    fusable in-kernel core; others become host-LUT ops, because eager
+    per-op rounding and XLA fma contraction can then differ in the last
+    ulp and the trunc quantizer turns that into a full uint8 step (found
+    by the soak fuzzer on contrast:4.3)."""
+    from mpi_cuda_imagemanipulation_tpu.ops.registry import (
+        _contrast_rounding_free,
+        make_op,
+    )
+
+    for f in (3.5, 3.0, 2.0, 0.5, 1.25):
+        assert _contrast_rounding_free(f), f
+        assert make_op(f"contrast:{f}").kernel_safe, f
+    for f in (4.3, 0.6, 1.1, 2.7):
+        assert not _contrast_rounding_free(f), f
+        assert not make_op(f"contrast:{f}").kernel_safe, f
+
+    # The LUT is built host-side in numpy (op parsing must never dispatch
+    # to a device — the default backend can be a wedged tunnel); assert it
+    # agrees with the eager in-graph core on all 256 inputs so the two
+    # formula copies cannot drift
+    from mpi_cuda_imagemanipulation_tpu.ops.registry import (
+        make_contrast_core,
+        pointwise_from_core,
+    )
+
+    v = jnp.arange(256, dtype=jnp.uint8)
+    for f in (4.3, 0.6, 1.1, 2.7, 3.5, 3.0):
+        core_fn = pointwise_from_core(f"c{f:g}", 1, 1, make_contrast_core(f)).fn
+        np.testing.assert_array_equal(
+            np.asarray(make_op(f"contrast:{f}")(v)), np.asarray(core_fn(v)),
+            err_msg=f"LUT vs eager core disagree for factor {f}",
+        )
+
+
+def test_contrast_inexact_factor_agrees_eager_vs_jit():
+    """The soak-found divergence: for a non-rounding-free factor the eager
+    golden and the jitted pipeline must still agree bit-exactly (they did
+    not when the core computed f*(p-128)+128 in-graph: XLA contracted the
+    mul+add into an fma)."""
+    import jax
+
+    from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline
+
+    v = np.arange(256, dtype=np.uint8).reshape(16, 16)
+    for f in ("4.3", "0.6", "2.7"):
+        pipe = Pipeline.parse(f"contrast:{f}")
+        eager = np.asarray(pipe(jnp.asarray(v)))
+        jitted = np.asarray(jax.jit(pipe.apply)(jnp.asarray(v)))
+        np.testing.assert_array_equal(eager, jitted)
+        # the LUT must reproduce per-op f32 semantics (mul, add, clip,
+        # trunc — what eager produced before the routing change)
+        ff = np.float32(float(f))
+        ref = np.floor(
+            np.clip(
+                (ff * (v.astype(np.float32) - np.float32(128)))
+                .astype(np.float32)
+                + np.float32(128),
+                0.0,
+                255.0,
+            )
+        ).astype(np.uint8)
+        np.testing.assert_array_equal(eager, ref)
+
+
 @pytest.mark.parametrize("size", [3, 5])
 def test_emboss_bitexact_vs_c(gray, size):
     op = make_emboss(size)

@@ -232,8 +232,9 @@ def run_repro(line: str) -> int:
     print(f"repro {spec!r} ({h}x{w}, seed {seed}) -> {golden.shape}")
     rc = 0
 
-    def check(name, fn, skip_on_min_guard=False):
+    def check(name, fn, skip_on_min_guard=False, golden_override=None):
         nonlocal rc
+        expect = golden if golden_override is None else golden_override
         try:
             got = np.asarray(fn())
         except ValueError as e:
@@ -247,15 +248,26 @@ def run_repro(line: str) -> int:
             print(f"  {name}: RAISED {type(e).__name__}: {str(e)[:200]}")
             rc = 1
             return
-        ok = np.array_equal(got, golden)
+        ok = np.array_equal(got, expect)
         print(f"  {name}: {'ok' if ok else 'MISMATCH'}")
         rc |= 0 if ok else 1
 
     check("xla", lambda: pipe.jit("xla")(img))
     check("pallas", lambda: pipeline_pallas(pipe.ops, img, interpret=True))
-    imgs = jnp.stack([img, img])
+    # same batch construction as run_trial (k distinct images seeded
+    # trial_seed + t) so batched REPROs actually reproduce; k=3 supersets
+    # the fuzzer's k in {2, 3}, and every index is compared
+    imgs = jnp.stack(
+        [jnp.asarray(synthetic_image(h, w, channels=3, seed=seed + t))
+         for t in range(3)]
+    )
     for b in ("xla", "pallas"):
-        check(f"batched-{b}", lambda b=b: pipe.batched(b)(imgs)[0])
+        for t in range(3):
+            check(
+                f"batched-{b}[{t}]",
+                lambda b=b, t=t: pipe.batched(b)(imgs)[t],
+                golden_override=np.asarray(pipe(imgs[t])),
+            )
     n_dev = len(jax.devices())
     for shards in sorted({s for s in (2, 3, 5, n_dev) if s <= n_dev}):
         for b in ("xla", "pallas", "auto"):
