@@ -1,0 +1,220 @@
+#!/usr/bin/env python
+"""Prototype: packed-u32 streaming for u8 image kernels (A/B candidate).
+
+Round-2 roofline question (BASELINE.md): the streaming kernels pin at
+~92 GB/s effective on u8 tiles — is the cap *byte*-rate (nothing to do) or
+*element*-rate (then moving 4 pixels per 32-bit lane quadruples pixel
+throughput)? tools/roofline_probe.py's `pallas_copy_u32_packed` case
+answers that on hardware; this prototype holds the matching compute-side
+machinery so the A/B can run in the same healthy window:
+
+  - pack/unpack helpers: (H, W) u8 <-> (H, W/4) u32 (little-endian byte 0
+    = column 4j), unpack implemented with in-kernel i32 shifts/masks
+    (Mosaic-lowerable; no gather, no u8 loads),
+  - a packed grayscale+contrast pointwise kernel (3 packed planes in, one
+    packed plane out),
+  - a packed separable row-pass building the +/-h shifted columns from
+    word shifts + cross-word byte rotations (gaussian:5 row pass).
+
+Everything is validated bit-exact against the golden ops in interpret
+mode / on CPU (`python tools/packed_proto.py` runs the self-test); only
+the throughput question needs the chip.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mpi_cuda_imagemanipulation_tpu.utils.platform import claim_platform
+
+if __name__ == "__main__":
+    # the selftest is CPU/interpret-only by design (docstring above) and
+    # must never touch the possibly-wedged accelerator tunnel; the future
+    # on-chip A/B gets its own entry point rather than an env override
+    claim_platform("cpu")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+F32 = jnp.float32
+I32 = jnp.int32
+
+
+def pack_u8(img: jnp.ndarray) -> jnp.ndarray:
+    """(H, W) u8 -> (H, W//4) u32, byte k of word j = column 4j+k."""
+    H, W = img.shape
+    assert W % 4 == 0, "pad width to a multiple of 4 first"
+    return jax.lax.bitcast_convert_type(
+        img.reshape(H, W // 4, 4), jnp.uint32
+    )
+
+
+def unpack_u32(words: jnp.ndarray) -> jnp.ndarray:
+    """(H, Wp) u32 -> (H, 4*Wp) u8 (inverse of pack_u8)."""
+    H, Wp = words.shape
+    return jax.lax.bitcast_convert_type(words, jnp.uint8).reshape(H, 4 * Wp)
+
+
+def _lanes_i32(words: jnp.ndarray) -> list[jnp.ndarray]:
+    """Split packed u32 words into 4 i32 byte-lane arrays (values 0..255).
+
+    Pure shifts/masks on i32 — the ops Mosaic lowers natively (no u8
+    anywhere inside the kernel body). Lane k holds columns 4j+k.
+    """
+    w = words.astype(I32) if words.dtype != I32 else words
+    m = jnp.int32(0xFF)
+    return [
+        w & m,
+        (w >> 8) & m,
+        (w >> 16) & m,
+        (w >> 24) & m,
+    ]
+
+
+def _pack_lanes_i32(lanes: list[jnp.ndarray]) -> jnp.ndarray:
+    """Inverse of _lanes_i32: 4 i32 lane arrays (0..255) -> packed i32."""
+    l0, l1, l2, l3 = lanes
+    return l0 | (l1 << 8) | (l2 << 16) | (l3 << 24)
+
+
+def _shift_lanes(lanes: list[jnp.ndarray], d: int):
+    """Byte-lane view of the image shifted by d columns (d in [-3..3] per
+    word step is enough for halo<=3 after combining with word shifts).
+
+    Columns come from lane (k+d) mod 4 with a word shift when crossing a
+    word boundary; out-of-range columns replicate the edge — the prototype
+    only needs an edge-correct interior, the framework's real edge
+    synthesis stays in the existing kernels.
+    """
+    out = []
+    for k in range(4):
+        src_lane = (k + d) % 4
+        word_shift = (k + d) // 4  # -1, 0 or +1 for |d| <= 3
+        lane = lanes[src_lane]
+        if word_shift == 0:
+            out.append(lane)
+        elif word_shift > 0:
+            shifted = jnp.concatenate(
+                [lane[:, word_shift:], jnp.repeat(lane[:, -1:], word_shift, 1)],
+                axis=1,
+            )
+            out.append(shifted)
+        else:
+            shifted = jnp.concatenate(
+                [jnp.repeat(lane[:, :1], -word_shift, 1), lane[:, :word_shift]],
+                axis=1,
+            )
+            out.append(shifted)
+    return out
+
+
+def packed_gray_contrast_kernel(r_ref, g_ref, b_ref, out_ref):
+    """Reference grayscale + contrast 3.5 on packed u32 lanes, computed by
+    the registry's own core functions (grayscale_core / make_contrast_core
+    are the golden semantics' single source of truth and are built to run
+    inside Pallas kernels on f32 values) — only the lane packing differs
+    from the production kernels."""
+    from mpi_cuda_imagemanipulation_tpu.ops.registry import (
+        grayscale_core,
+        make_contrast_core,
+    )
+
+    contrast = make_contrast_core(3.5)
+    rl = _lanes_i32(r_ref[:])
+    gl = _lanes_i32(g_ref[:])
+    bl = _lanes_i32(b_ref[:])
+    outs = []
+    for k in range(4):
+        gray = grayscale_core(
+            rl[k].astype(F32), gl[k].astype(F32), bl[k].astype(F32)
+        )
+        outs.append(contrast(gray).astype(I32))
+    out_ref[:] = _pack_lanes_i32(outs)
+
+
+def packed_gray_contrast(r, g, b, *, interpret=False):
+    H, Wp = r.shape
+    call = pl.pallas_call(
+        packed_gray_contrast_kernel,
+        out_shape=jax.ShapeDtypeStruct((H, Wp), I32),
+        interpret=interpret,
+    )
+    return call(r.astype(I32), g.astype(I32), b.astype(I32))
+
+
+def packed_row_corr_interior(words, w1d, *, halo):
+    """Separable row pass on packed lanes (interior columns exact; edges
+    replicate). Returns 4 f32 lane arrays."""
+    lanes = _lanes_i32(words)
+    wv = np.asarray(w1d, np.float32).reshape(-1)
+    acc = None
+    for i, wgt in enumerate(wv):
+        d = i - halo
+        sh = _shift_lanes(lanes, d)
+        for k in range(4):
+            term = sh[k].astype(F32) * np.float32(wgt)
+            if acc is None:
+                acc = [None] * 4
+            acc[k] = term if acc[k] is None else acc[k] + term
+    return acc
+
+
+def _selftest() -> int:
+    from mpi_cuda_imagemanipulation_tpu.io.image import synthetic_image
+    from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline
+
+    rgb = np.asarray(synthetic_image(64, 256, channels=3, seed=3))
+    r8, g8, b8 = (jnp.asarray(rgb[..., c]) for c in range(3))
+
+    # pack/unpack round trip
+    packed = pack_u8(r8)
+    assert np.array_equal(np.asarray(unpack_u32(packed)), np.asarray(r8))
+
+    # lanes/pack round trip (i32 domain)
+    lanes = _lanes_i32(packed.astype(I32))
+    repacked = _pack_lanes_i32(lanes)
+    assert np.array_equal(
+        np.asarray(repacked.astype(jnp.uint32)), np.asarray(packed)
+    )
+
+    # packed grayscale+contrast vs the golden pipeline
+    pipe = Pipeline.parse("grayscale,contrast:3.5")
+    golden = np.asarray(pipe(jnp.asarray(rgb)))
+    out_packed = packed_gray_contrast(
+        pack_u8(r8), pack_u8(g8), pack_u8(b8), interpret=True
+    )
+    got = np.asarray(unpack_u32(out_packed.astype(jnp.uint32)))
+    assert np.array_equal(got, golden), (
+        f"packed gray+contrast mismatch: {np.abs(got.astype(int) - golden.astype(int)).max()}"
+    )
+
+    # packed gaussian:5 row pass (interior) vs direct correlation
+    from mpi_cuda_imagemanipulation_tpu.ops.registry import make_gaussian
+
+    op = make_gaussian(5)
+    w1d = np.asarray(op.separable, np.float32).reshape(-1)
+    acc_lanes = packed_row_corr_interior(pack_u8(r8).astype(I32), w1d, halo=2)
+    acc = np.zeros((64, 256), np.float32)
+    for k in range(4):
+        acc[:, k::4] = np.asarray(acc_lanes[k])
+    x = np.asarray(r8).astype(np.float32)
+    ref = np.zeros_like(x)
+    for i, wgt in enumerate(w1d):
+        d = i - 2
+        idx = np.clip(np.arange(256) + d, 0, 255)
+        ref += x[:, idx] * wgt
+    interior = slice(4, -4)
+    assert np.allclose(acc[:, interior], ref[:, interior]), "row-pass mismatch"
+
+    print("packed_proto selftest: all ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(_selftest())
