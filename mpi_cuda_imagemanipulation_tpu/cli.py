@@ -422,14 +422,21 @@ def cmd_batch(args: argparse.Namespace) -> int:
     while inflight:
         drain_one()
     wall = time.perf_counter() - t0
+    # adaptive precision: thumbnail batches should not round to "0.0 MP",
+    # large batches should stay in plain decimal (%.3g would go scientific)
+    def _fmt(v: float, unit: str) -> str:
+        return f"{v:.3g} {unit}" if v < 1 else f"{v:.1f} {unit}"
+
+    mp_s = _fmt(total_mp, "MP")
+    rate_s = _fmt(total_mp / wall, "MP/s")
     log.info(
-        "processed %d/%d images (%.1f MP) in %.2fs (%.1f MP/s end-to-end)",
-        done, len(paths), total_mp, wall, total_mp / wall,
+        "processed %d/%d images (%s) in %.2fs (%s end-to-end)",
+        done, len(paths), mp_s, wall, rate_s,
     )
     if args.show_timing:
         print(
             f"batch [{pipe.name}] impl={args.impl}: {done}/{len(paths)} images, "
-            f"{total_mp:.1f} MP in {wall:.2f}s ({total_mp / wall:.1f} MP/s "
+            f"{mp_s} in {wall:.2f}s ({rate_s} "
             f"end-to-end incl. compile+I/O)"
         )
     # partial failure (skipped inputs) is a nonzero exit for scripted callers
