@@ -171,6 +171,8 @@ def _headline(records: list[dict]) -> dict | None:
     if "roofline_frac" in best:
         rec["roofline_frac"] = round(best["roofline_frac"], 4)
         rec["tpu_gen"] = best.get("tpu_gen")
+    if "last_tpu_record" in best:
+        rec["last_tpu_record"] = best["last_tpu_record"]
     return rec
 
 
@@ -212,6 +214,12 @@ def main() -> int:
             print(json.dumps({"error": "no benchmark record produced", "errors": errors}))
             return 1
         rec["platform"] = "cpu-fallback (TPU tunnel unresponsive)"
+        last_tpu = _last_tpu_headline()
+        if last_tpu is not None:
+            # clearly-labelled pointer to the most recent healthy-window TPU
+            # measurement (committed in BENCH_HISTORY.jsonl) so a wedge at
+            # the round-end run doesn't hide that a hardware number exists
+            rec["last_tpu_record"] = last_tpu
         records.append(rec)
 
     out = _headline(records)
@@ -225,10 +233,43 @@ def main() -> int:
     return 0
 
 
+def _last_tpu_headline() -> dict | None:
+    """Most recent BENCH_HISTORY.jsonl headline measured on real TPU
+    hardware (impl pallas), as {ts, value, unit, vs_baseline, impl}."""
+    path = os.path.join(REPO, "BENCH_HISTORY.jsonl")
+    best = None
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    e = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                h = e.get("headline") or {}
+                # platform is the criterion; impl is informational (a TPU
+                # xla number from a window where Mosaic crashed still counts)
+                if h.get("platform") in ("tpu", "axon"):
+                    best = {
+                        "ts": e.get("ts"),
+                        "value": h.get("value"),
+                        "unit": h.get("unit"),
+                        "vs_baseline": h.get("vs_baseline"),
+                        "impl": h.get("impl"),
+                        "platform": h.get("platform"),
+                    }
+    except OSError:
+        return None
+    return best
+
+
 def _append_history(headline: dict, records: list[dict]) -> None:
     """Append every run's records to BENCH_HISTORY.jsonl (committed), so a
     tunnel wedge at the driver's round-end run cannot erase evidence of an
-    earlier healthy-window TPU measurement (the round-1 failure mode)."""
+    earlier healthy-window TPU measurement (the round-1 failure mode).
+    MCIM_NO_HISTORY (any non-empty value) disables the append — test runs
+    must not pollute the committed history (tests/conftest.py sets it)."""
+    if os.environ.get("MCIM_NO_HISTORY"):
+        return
     try:
         entry = {
             "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),

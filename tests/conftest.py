@@ -19,3 +19,7 @@ from mpi_cuda_imagemanipulation_tpu.utils.platform import claim_platform  # noqa
 # model is documented in utils/platform.py); an explicit pre-set device
 # count (e.g. a 16-device sweep) is respected
 claim_platform("cpu", n_host_devices=8, keep_existing_count=True)
+
+# any bench.py run spawned from a test must not append to the committed
+# BENCH_HISTORY.jsonl (bench.py _append_history honors this)
+os.environ["MCIM_NO_HISTORY"] = "1"
