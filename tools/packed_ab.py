@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""On-chip A/B: packed-u32 vs current u8 streaming for a pointwise group.
+
+tools/tpu_window.sh runs this automatically as the last step of a healthy
+TPU window (output lands in packed_ab.out); run it manually only when the
+watcher is not active — chip access must stay serialized:
+
+    python tools/packed_ab.py [--hw 2160,3840]
+
+Times three compiled variants of the reference pointwise prologue
+(grayscale + contrast 3.5) on the same chip, same process, interleaved:
+
+  a) production path: Pipeline.jit('pallas') on (H, W, 3) u8
+  b) production path: Pipeline.jit('xla')
+  c) packed path: pallas kernel on three (H, W/4) u32 planes
+     (tools/packed_proto.py), bit-exactness asserted before timing
+
+If (c) beats (a) by ~the lane factor, the u8 streaming cap is element-rate
+and a packed rewrite of the production kernels is justified (BASELINE.md
+round-2 roofline question); if they tie, the cap is byte-rate and the
+current kernels already saturate it. Prints one JSON line per variant.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--hw", default="2160,3840", help="H,W (W % 4 == 0)")
+    args = ap.parse_args()
+    H, W = (int(v) for v in args.hw.split(","))
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mpi_cuda_imagemanipulation_tpu.io.image import synthetic_image
+    from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline
+    from mpi_cuda_imagemanipulation_tpu.utils.timing import device_throughput
+    from tools.packed_proto import pack_u8, packed_gray_contrast, unpack_u32
+
+    backend_name = jax.default_backend()
+    print(f"backend: {backend_name}", flush=True)
+    rgb = jnp.asarray(synthetic_image(H, W, channels=3, seed=31))
+    pipe = Pipeline.parse("grayscale,contrast:3.5")
+    golden = np.asarray(pipe(rgb))
+
+    if backend_name == "cpu":
+        # compiled Mosaic doesn't exist on CPU; check bit-exactness in
+        # interpret mode and skip the (meaningless there) timing
+        from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
+            pipeline_pallas,
+        )
+
+        assert np.array_equal(
+            np.asarray(pipeline_pallas(pipe.ops, rgb, interpret=True)), golden
+        )
+        planes = [pack_u8(rgb[..., c]) for c in range(3)]
+        got = np.asarray(
+            unpack_u32(
+                packed_gray_contrast(*planes, interpret=True).astype(jnp.uint32)
+            )
+        )
+        assert np.array_equal(got, golden)
+        print("cpu validation ok (timing needs the chip)", flush=True)
+        return 0
+
+    def emit(name, sec, extra=None):
+        rec = {
+            "case": name,
+            "ms": sec * 1e3,
+            "mp_s": H * W / 1e6 / sec,
+            # one u8 read per input plane + one u8 write (packed moves the
+            # same bytes in 1/4 the elements)
+            "gb_s": 4 * H * W / sec / 1e9,
+        }
+        rec.update(extra or {})
+        print(json.dumps(rec), flush=True)
+
+    # a/b: production backends
+    for backend in ("pallas", "xla"):
+        fn = pipe.jit(backend)
+        got = np.asarray(fn(rgb))
+        assert np.array_equal(got, golden), f"{backend} mismatch"
+        emit(f"prod_{backend}", device_throughput(fn, [rgb]))
+
+    # c: packed path (pack once outside the timed region — a real pipeline
+    # would keep images packed end-to-end)
+    planes = [pack_u8(rgb[..., c]) for c in range(3)]
+    packed_fn = jax.jit(packed_gray_contrast)
+    got = np.asarray(unpack_u32(packed_fn(*planes).astype(jnp.uint32)))
+    assert np.array_equal(got, golden), "packed mismatch"
+    emit("packed_u32", device_throughput(packed_fn, list(planes)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -47,7 +47,9 @@ save_image('/tmp/mcim_8k.pgm', synthetic_image(4320, 7680, channels=1, seed=5))"
       --input /tmp/mcim_8k.pgm --output /tmp/mcim_8k_out.pgm \
       --ops gaussian:5 --impl pallas --profile-dir profile_r02 \
       --show-timing >> "$LOG" 2>&1
-    log "profile capture rc=$? ; done"
+    log "profile capture rc=$? ; running packed A/B"
+    timeout 900 python tools/packed_ab.py > packed_ab.out 2>&1
+    log "packed A/B rc=$? ; done"
     exit 0
   fi
   if [ "$rc" -eq 2 ]; then
