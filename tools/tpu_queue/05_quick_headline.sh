@@ -1,0 +1,8 @@
+#!/bin/bash
+# First-window fast capture: one TPU headline record into BENCH_HISTORY.jsonl.
+set -u
+cd "$(dirname "$0")/../.."
+. tools/tpu_queue/_lib.sh
+timeout 1200 python tools/quick_headline.py > quick_headline_r03.out 2>&1 || exit $?
+commit_artifacts "TPU window: same-round headline record (quick capture)" \
+  BENCH_HISTORY.jsonl quick_headline_r03.out

@@ -1,0 +1,7 @@
+#!/bin/bash
+# Roofline ceilings probe: XLA copy / Pallas u8 / f32 / packed-u32 / lagged.
+set -u
+cd "$(dirname "$0")/../.."
+. tools/tpu_queue/_lib.sh
+timeout 2400 python tools/roofline_probe.py > roofline_r03.out 2>&1 || exit $?
+commit_artifacts "TPU window: roofline probe results (round 3)" roofline_r03.out

@@ -1,0 +1,7 @@
+#!/bin/bash
+# Packed-u32 vs u8 production A/B (element-rate vs byte-rate question).
+set -u
+cd "$(dirname "$0")/../.."
+. tools/tpu_queue/_lib.sh
+timeout 1800 python tools/packed_ab.py > packed_ab_r03.out 2>&1 || exit $?
+commit_artifacts "TPU window: packed-u32 A/B results (round 3)" packed_ab_r03.out

@@ -1,0 +1,8 @@
+#!/bin/bash
+# Full bench.py campaign: headline pallas/xla + fused-ghost sharded config.
+set -u
+cd "$(dirname "$0")/../.."
+. tools/tpu_queue/_lib.sh
+timeout 5400 python bench.py > bench_r03_manual.out 2>&1 || exit $?
+commit_artifacts "TPU window: full bench campaign incl. sharded path (round 3)" \
+  BENCH_HISTORY.jsonl bench_r03_manual.out

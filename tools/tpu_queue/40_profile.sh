@@ -1,0 +1,8 @@
+#!/bin/bash
+# Profiler trace of the headline kernel + DMA-vs-compute summary.
+set -u
+cd "$(dirname "$0")/../.."
+. tools/tpu_queue/_lib.sh
+timeout 1800 python tools/profile_capture.py profile_r03 > profile_r03.out 2>&1 || exit $?
+commit_artifacts "TPU window: headline-kernel profiler trace summary" \
+  profile_r03.out profile_r03_summary.md profile_r03_summary.json

@@ -1,0 +1,8 @@
+#!/bin/bash
+# Hardware validation sweep (compiled Mosaic) incl. sharded + guarded cases.
+set -u
+cd "$(dirname "$0")/../.."
+. tools/tpu_queue/_lib.sh
+timeout 5400 python tools/tpu_validate.py --out VALIDATE_r03.json > validate_r03.out 2>&1 || exit $?
+commit_artifacts "TPU window: hardware validation sweep (round 3)" \
+  VALIDATE_r03.json validate_r03.out

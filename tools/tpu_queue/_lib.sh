@@ -1,0 +1,23 @@
+# Shared helper for queue steps (not a step itself: the watcher only runs
+# [0-9]*.sh). commit_artifacts commits EXACTLY the listed paths (pathspec
+# commit — never sweeps unrelated staged work into a watcher commit),
+# tolerates nothing-to-commit (re-captured identical artifact), and treats
+# a persistently failing commit as non-fatal: the measurement succeeded and
+# the artifacts are on disk, so burning another serialized chip campaign to
+# re-produce them would be strictly worse than picking them up in the next
+# manual commit.
+commit_artifacts() {
+  local msg="$1"
+  shift
+  for _i in 1 2 3; do
+    git add -- "$@" 2>/dev/null
+    if git diff --cached --quiet -- "$@" 2>/dev/null; then
+      echo "commit_artifacts: nothing new to commit for: $*"
+      return 0
+    fi
+    git commit -m "$msg" -- "$@" && return 0
+    sleep 5
+  done
+  echo "commit_artifacts: commit failed; artifacts left on disk: $*" >&2
+  return 0
+}
