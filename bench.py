@@ -206,6 +206,27 @@ def main() -> int:
                 records.append(rec)
 
     if not records:
+        # preferred fallback (VERDICT r2 directive #3): a TPU headline this
+        # round's watcher already measured and committed beats re-measuring
+        # on CPU — the round's artifact of record should be a hardware
+        # number whenever even one healthy window occurred all round
+        same = _same_round_tpu_headline()
+        if same is not None:
+            out = dict(same["headline"])
+            out["platform"] = (
+                f"{out.get('platform')} (same-round committed TPU record; "
+                "tunnel unresponsive at bench time)"
+            )
+            out["measured_ts"] = same["ts"]
+            if errors:
+                out["partial"] = True
+                out["errors"] = errors
+            _log(
+                "tunnel unresponsive; promoting same-round committed TPU "
+                f"record from {same['ts']}"
+            )
+            print(json.dumps(out))
+            return 0
         # last resort: labelled CPU number so the driver gets *a* record
         _log("no TPU records; falling back to CPU (labelled)")
         rec, err = _run_config(HEADLINE, "xla", env=_cpu_env())
@@ -233,11 +254,12 @@ def main() -> int:
     return 0
 
 
-def _last_tpu_headline() -> dict | None:
-    """Most recent BENCH_HISTORY.jsonl headline measured on real TPU
-    hardware (impl pallas), as {ts, value, unit, vs_baseline, impl}."""
-    path = os.path.join(REPO, "BENCH_HISTORY.jsonl")
-    best = None
+def _tpu_history_headlines(path: str | None = None):
+    """Yield (ts, headline) for every BENCH_HISTORY.jsonl entry whose
+    headline was measured on real TPU hardware. Platform is the criterion;
+    impl is informational (a TPU xla number from a window where Mosaic
+    crashed still counts)."""
+    path = path or os.path.join(REPO, "BENCH_HISTORY.jsonl")
     try:
         with open(path) as f:
             for line in f:
@@ -246,19 +268,47 @@ def _last_tpu_headline() -> dict | None:
                 except json.JSONDecodeError:
                     continue
                 h = e.get("headline") or {}
-                # platform is the criterion; impl is informational (a TPU
-                # xla number from a window where Mosaic crashed still counts)
                 if h.get("platform") in ("tpu", "axon"):
-                    best = {
-                        "ts": e.get("ts"),
-                        "value": h.get("value"),
-                        "unit": h.get("unit"),
-                        "vs_baseline": h.get("vs_baseline"),
-                        "impl": h.get("impl"),
-                        "platform": h.get("platform"),
-                    }
+                    yield e.get("ts"), h
+    except OSError:
+        return
+
+
+def _last_tpu_headline(path: str | None = None) -> dict | None:
+    """Most recent committed TPU headline, summarized for the
+    `last_tpu_record` pointer on CPU-fallback records."""
+    best = None
+    for ts, h in _tpu_history_headlines(path):
+        best = {
+            "ts": ts,
+            "value": h.get("value"),
+            "unit": h.get("unit"),
+            "vs_baseline": h.get("vs_baseline"),
+            "impl": h.get("impl"),
+            "platform": h.get("platform"),
+        }
+    return best
+
+
+def _same_round_tpu_headline(
+    path: str | None = None, round_start_path: str | None = None
+) -> dict | None:
+    """Most recent committed TPU headline measured THIS round, i.e. with a
+    timestamp >= the committed ROUND_START marker (both are
+    %Y-%m-%dT%H:%M:%SZ strings, so lexical comparison is chronological).
+    Returns {ts, headline} with the full headline record, or None."""
+    rs_path = round_start_path or os.path.join(REPO, "ROUND_START")
+    try:
+        with open(rs_path) as f:
+            round_start = f.read().strip()
     except OSError:
         return None
+    if not round_start:
+        return None
+    best = None
+    for ts, h in _tpu_history_headlines(path):
+        if ts and ts >= round_start:
+            best = {"ts": ts, "headline": h}
     return best
 
 

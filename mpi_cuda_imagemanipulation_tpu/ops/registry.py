@@ -177,11 +177,18 @@ def make_lut_op(
     kernel_safe=False: Mosaic has no general gather, so LUT ops run as XLA
     steps between Pallas groups (group_ops splits around them); XLA lowers
     the 256-entry take to a cheap dynamic-slice/select chain.
+
+    Construction is host-pure: the table stays a numpy array until the op
+    body runs, so Pipeline.parse never dispatches to a device even for LUT
+    ops (advisor round-2 finding: an eager jnp.asarray here initialized the
+    default backend at parse time, which can block forever on a wedged
+    accelerator tunnel). Under jit the asarray is constant-folded at trace
+    time; eager callers were going to dispatch on the very next line anyway.
     """
-    t = jnp.asarray(table)
+    table = np.asarray(table, dtype=np.uint8)
 
     def fn(img: jnp.ndarray) -> jnp.ndarray:
-        return jnp.take(t, img.astype(jnp.int32))
+        return jnp.take(jnp.asarray(table), img.astype(jnp.int32))
 
     return PointwiseOp(name, in_channels, out_channels, fn=fn, kernel_safe=False)
 

@@ -21,14 +21,22 @@ import sys
 
 
 def _backends_initialized() -> bool:
-    """Whether any JAX backend client already exists in this process
-    (private-API probe, deliberately fail-open: unknown jax internals are
-    treated as 'not initialized' rather than blocking the claim)."""
+    """Whether any JAX backend client already exists in this process.
+
+    Probes ``xla_bridge.backends_are_initialized()`` (the closest thing to
+    a supported API) and falls back to the private ``_backends`` dict. Both
+    are jax internals; ``tests/test_platform_claim.py`` asserts they exist
+    so a jax upgrade that removes them fails loudly instead of silently
+    disabling the count-change guard below (advisor round-2 finding: the
+    old fail-open probe would have turned the guard into a no-op exactly
+    when it was needed)."""
     if "jax" not in sys.modules:
         return False
     try:
         from jax._src import xla_bridge
 
+        if hasattr(xla_bridge, "backends_are_initialized"):
+            return bool(xla_bridge.backends_are_initialized())
         return bool(xla_bridge._backends)
     except Exception:
         return False

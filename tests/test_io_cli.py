@@ -197,8 +197,112 @@ def test_bench_last_tpu_headline_lookup():
     file-parse check)."""
     rec = _load_bench_module()._last_tpu_headline()
     assert rec is not None, "committed BENCH_HISTORY.jsonl lost its TPU entry"
-    assert rec["impl"] == "pallas" and rec["platform"] in ("tpu", "axon")
+    # platform is the criterion, impl is informational: an xla number from
+    # a window where Mosaic crashed still counts (advisor round-2 finding —
+    # asserting impl here would break on a legitimate future capture)
+    assert rec["platform"] in ("tpu", "axon")
     assert rec["value"] > 1000  # MP/s/chip — a real accelerator number
+
+
+def test_bench_same_round_tpu_headline(tmp_path):
+    """bench.py must prefer a same-round committed TPU record over a CPU
+    fallback (VERDICT r2 directive #3): entries at/after the ROUND_START
+    marker qualify, earlier ones don't."""
+    mod = _load_bench_module()
+    hist = tmp_path / "hist.jsonl"
+    marker = tmp_path / "ROUND_START"
+    old = {
+        "ts": "2026-07-29T10:00:00Z",
+        "headline": {"platform": "axon", "value": 47468.0, "impl": "pallas"},
+    }
+    new = {
+        "ts": "2026-07-30T18:00:00Z",
+        "headline": {"platform": "axon", "value": 50000.0, "impl": "pallas"},
+    }
+    cpu = {"ts": "2026-07-30T19:00:00Z", "headline": {"platform": "cpu", "value": 1.0}}
+    hist.write_text("\n".join(json.dumps(e) for e in (old, new, cpu)) + "\n")
+
+    marker.write_text("2026-07-30T17:17:31Z\n")
+    got = mod._same_round_tpu_headline(str(hist), str(marker))
+    assert got is not None and got["ts"] == new["ts"]
+    assert got["headline"]["value"] == 50000.0  # cpu entry never qualifies
+
+    marker.write_text("2026-07-31T00:00:00Z\n")  # round started after all entries
+    assert mod._same_round_tpu_headline(str(hist), str(marker)) is None
+
+    assert (
+        mod._same_round_tpu_headline(str(hist), str(tmp_path / "missing")) is None
+    )
+
+
+def test_bench_main_promotes_same_round_record(monkeypatch, capsys):
+    """With the tunnel down and a same-round TPU record committed, bench.py
+    main() must emit that record (labelled) instead of a CPU fallback."""
+    mod = _load_bench_module()
+    monkeypatch.setattr(mod, "_probe_with_backoff", lambda schedule: None)
+    monkeypatch.setattr(
+        mod,
+        "_same_round_tpu_headline",
+        lambda: {
+            "ts": "2026-07-30T18:00:00Z",
+            "headline": {
+                "metric": "megapixels/sec/chip on 8K 5x5 Gaussian",
+                "value": 50000.0,
+                "unit": "MP/s/chip",
+                "vs_baseline": 27.0,
+                "impl": "pallas",
+                "platform": "axon",
+            },
+        },
+    )
+    rc = mod.main()
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0
+    assert out["value"] == 50000.0
+    assert "same-round committed TPU record" in out["platform"]
+    assert out["measured_ts"] == "2026-07-30T18:00:00Z"
+
+
+def test_xla_bridge_probe_api_exists():
+    """utils.platform._backends_initialized probes jax internals and fails
+    open; if a jax upgrade removes BOTH probe points the count-change guard
+    silently disappears — this test makes that loss loud (advisor round-2
+    finding)."""
+    from jax._src import xla_bridge
+
+    assert hasattr(xla_bridge, "backends_are_initialized") or hasattr(
+        xla_bridge, "_backends"
+    )
+
+
+def test_lut_op_parse_is_host_pure():
+    """Pipeline.parse of LUT-routed ops (contrast:4.3, gamma) must not
+    initialize any JAX backend (advisor round-2 medium finding: an eager
+    jnp.asarray at op construction did a device-put at parse time, which
+    can block forever on a wedged accelerator tunnel)."""
+    code = (
+        "from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline; "
+        "Pipeline.parse('grayscale,contrast:4.3,gamma:2.2'); "
+        "import sys; "
+        "jax = sys.modules.get('jax'); "
+        "from jax._src import xla_bridge; "
+        "assert not xla_bridge.backends_are_initialized(), "
+        "'parse initialized a backend'; "
+        "print('PURE')"
+    )
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never touch the real tunnel here
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        env=env,
+    )
+    assert proc.returncode == 0 and "PURE" in proc.stdout, (
+        proc.stdout + proc.stderr
+    )
 
 
 def test_bench_orchestrator_mirrors_suite_constants():
