@@ -140,6 +140,12 @@ def _build_parser() -> argparse.ArgumentParser:
     )
     batch.add_argument("--gray-output", action="store_true")
     batch.add_argument("--show-timing", action="store_true")
+    batch.add_argument(
+        "--json-metrics",
+        default=None,
+        help="write a JSON metrics line (incl. the skipped-file list) to "
+        "this path ('-' = stdout)",
+    )
 
     bench = sub.add_parser("bench", help="run the benchmark suite")
     bench.add_argument("--configs", default=None, help="subset, comma-separated")
@@ -219,6 +225,7 @@ def cmd_run(args: argparse.Namespace) -> int:
                 "(--device-timeout); ignored"
             )
         t0 = time.perf_counter()
+        timings: dict = {}
         try:
             out = run_guarded(
                 args.ops,
@@ -227,12 +234,17 @@ def cmd_run(args: argparse.Namespace) -> int:
                 impl=args.impl,
                 block_h=args.block,
                 shards=args.shards,
+                timings=timings,
             )
         except DeviceTimeoutError as e:
             log.error("%s", e)
             return 4
-        compile_and_run_s = time.perf_counter() - t0
-        steady_s = None  # a one-shot subprocess has no warm second call
+        # the child reports device-synced windows; fall back to the outer
+        # wall (incl. process spawn) only if the sidecar went missing
+        compile_and_run_s = timings.get(
+            "compile_and_run_s", time.perf_counter() - t0
+        )
+        steady_s = timings.get("steady_s")
     else:
         if args.shards > 1:
             mesh = make_mesh(args.shards)
@@ -284,7 +296,8 @@ def cmd_run(args: argparse.Namespace) -> int:
     if args.show_timing:
         if steady_s is not None:
             print(
-                f"pipeline [{pipe.name}] impl={args.impl} shards={args.shards}: "
+                f"pipeline [{pipe.name}] impl={args.impl} shards={args.shards}"
+                f"{' (guarded)' if guarded else ''}: "
                 f"first call (incl. compile) {compile_and_run_s * 1e3:.2f} ms, "
                 f"steady-state {steady_s * 1e3:.2f} ms "
                 f"({mp / steady_s:.1f} MP/s)"
@@ -342,8 +355,10 @@ def cmd_batch(args: argparse.Namespace) -> int:
         if os.path.isfile(p)
     )
     if not paths:
+        # distinct exit code: an empty glob is a different scripting error
+        # than inputs that failed to decode (advisor/VERDICT r2 weak #5)
         log.error("no inputs match %s/%s", args.input_dir, args.glob)
-        return 1
+        return 3
     os.makedirs(args.output_dir, exist_ok=True)
     pipe = Pipeline.parse(args.ops)
     stack = max(1, args.stack)
@@ -409,7 +424,9 @@ def cmd_batch(args: argparse.Namespace) -> int:
         if len(inflight) >= max(1, args.window):
             drain_one()
 
+    seen: set[int] = set()
     for i, img in batch_load(paths, n_threads=args.threads, on_error="skip"):
+        seen.add(i)
         if pending and (
             len(pending) >= stack or pending[-1][1].shape != img.shape
         ):
@@ -439,7 +456,26 @@ def cmd_batch(args: argparse.Namespace) -> int:
             f"{mp_s} in {wall:.2f}s ({rate_s} "
             f"end-to-end incl. compile+I/O)"
         )
-    # partial failure (skipped inputs) is a nonzero exit for scripted callers
+    skipped = [paths[i] for i in range(len(paths)) if i not in seen]
+    if args.json_metrics:
+        from mpi_cuda_imagemanipulation_tpu.utils.log import emit_json_metrics
+
+        emit_json_metrics(
+            {
+                "event": "batch",
+                "ops": pipe.name,
+                "impl": args.impl,
+                "inputs": len(paths),
+                "processed": done,
+                "skipped": skipped,
+                "total_mp": total_mp,
+                "wall_s": wall,
+                "mp_per_s": total_mp / wall if wall > 0 else None,
+            },
+            None if args.json_metrics == "-" else args.json_metrics,
+        )
+    # partial failure (skipped inputs) is a nonzero exit for scripted
+    # callers — distinct from the no-inputs-matched exit (3) above
     return 0 if done == len(paths) else 1
 
 

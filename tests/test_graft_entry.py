@@ -31,7 +31,7 @@ def test_dryrun_multichip_subprocess():
         cwd=_REPO,
         capture_output=True,
         text=True,
-        timeout=600,
+        timeout=900,  # 10 families x {n,16} meshes + vmap case, 1-core host
     )
     assert proc.returncode == 0, (proc.stderr or proc.stdout)[-1500:]
     assert "ok — sharded == golden" in proc.stdout

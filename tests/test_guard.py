@@ -23,8 +23,15 @@ def test_guarded_run_matches_inprocess():
     golden = np.asarray(
         Pipeline.parse("grayscale,contrast:3.5,emboss:3")(jnp.asarray(img))
     )
-    out = run_guarded("grayscale,contrast:3.5,emboss:3", img, 300.0)
+    timings: dict = {}
+    out = run_guarded(
+        "grayscale,contrast:3.5,emboss:3", img, 300.0, timings=timings
+    )
     np.testing.assert_array_equal(out, golden)
+    # guarded mode must report both device-synced windows (VERDICT r2 weak
+    # #4: watchdog mode and steady-state timing have to combine)
+    assert timings["compile_and_run_s"] > 0
+    assert 0 < timings["steady_s"] <= timings["compile_and_run_s"]
 
 
 def test_guarded_run_times_out():
@@ -48,11 +55,13 @@ def test_cli_device_timeout_flag(tmp_path):
     outp = tmp_path / "out.png"
     Image.fromarray(synthetic_image(32, 48, channels=3, seed=64)).save(inp)
     env = dict(os.environ)
+    metrics = tmp_path / "metrics.json"
     proc = subprocess.run(
         [
             sys.executable, "-m", "mpi_cuda_imagemanipulation_tpu", "run",
             "--input", str(inp), "--output", str(outp),
             "--device-timeout", "300",
+            "--show-timing", "--json-metrics", str(metrics),
         ],
         env=env,
         capture_output=True,
@@ -60,6 +69,12 @@ def test_cli_device_timeout_flag(tmp_path):
         timeout=310,
     )
     assert proc.returncode == 0, proc.stderr[-800:]
+    # guarded runs report steady-state like unguarded ones
+    assert "steady-state" in proc.stdout and "(guarded)" in proc.stdout
+    import json
+
+    rec = json.loads(metrics.read_text())
+    assert rec["guarded"] is True and rec["steady_s"] > 0
     direct = tmp_path / "direct.png"
     proc2 = subprocess.run(
         [
