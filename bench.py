@@ -185,9 +185,13 @@ def main() -> int:
     if on_tpu:
         # the sharded config runs even on one chip: it exercises the
         # fused-ghost shard_map path (run_group ghost mode), which is
-        # the configuration that matters on a pod
+        # the configuration that matters on a pod. "packed" is the
+        # packed-u32 streaming variant (ops/packed_kernels.py) — the
+        # headline then reports whichever impl measures fastest, so the
+        # element-rate A/B rides every TPU bench run.
         plan = [
             (HEADLINE, "pallas"),
+            (HEADLINE, "packed"),
             (HEADLINE, "xla"),
             (HEADLINE + "_sharded", "pallas"),
         ]

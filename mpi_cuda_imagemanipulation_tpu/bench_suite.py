@@ -243,7 +243,11 @@ def main(argv: Sequence[str] | None = None) -> int:
 
     ap = argparse.ArgumentParser(prog="bench_suite")
     ap.add_argument("--config", required=True, choices=sorted(CONFIGS))
-    ap.add_argument("--impl", default="pallas", choices=("xla", "pallas", "auto"))
+    ap.add_argument(
+        "--impl",
+        default="pallas",
+        choices=("xla", "pallas", "packed", "auto"),
+    )
     args = ap.parse_args(argv)
     rec = run_config(CONFIGS[args.config], args.impl)
     print(json.dumps(rec), flush=True)

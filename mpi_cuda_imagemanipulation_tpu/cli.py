@@ -44,10 +44,11 @@ def _build_parser() -> argparse.ArgumentParser:
     )
     run.add_argument(
         "--impl",
-        choices=("auto", "xla", "pallas"),
+        choices=("auto", "xla", "pallas", "packed"),
         default="auto",
         help="compute backend for the op kernels (auto: per-group choice "
-        "between XLA fusion and Pallas kernels)",
+        "between XLA fusion and Pallas kernels; packed: Pallas with "
+        "packed-u32 streaming where eligible)",
     )
     run.add_argument(
         "--shards",
@@ -118,7 +119,9 @@ def _build_parser() -> argparse.ArgumentParser:
     batch.add_argument("--output-dir", required=True)
     batch.add_argument("--glob", default="*", help="input filename pattern")
     batch.add_argument("--ops", default="grayscale,contrast:3.5,emboss:3")
-    batch.add_argument("--impl", choices=("auto", "xla", "pallas"), default="auto")
+    batch.add_argument(
+        "--impl", choices=("auto", "xla", "pallas", "packed"), default="auto"
+    )
     batch.add_argument("--shards", type=int, default=1)
     batch.add_argument("--device", default=None)
     batch.add_argument(
@@ -151,7 +154,9 @@ def _build_parser() -> argparse.ArgumentParser:
     bench.add_argument("--configs", default=None, help="subset, comma-separated")
     bench.add_argument("--device", default=None)
     bench.add_argument(
-        "--impl", choices=("xla", "pallas", "auto", "both"), default="both"
+        "--impl",
+        choices=("xla", "pallas", "packed", "auto", "both"),
+        default="both",
     )
     bench.add_argument("--json-metrics", default=None)
 

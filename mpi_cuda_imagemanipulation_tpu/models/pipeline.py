@@ -27,7 +27,7 @@ from mpi_cuda_imagemanipulation_tpu.ops.registry import (
 )
 from mpi_cuda_imagemanipulation_tpu.ops.spec import Op
 
-BACKENDS = ("xla", "pallas", "auto")
+BACKENDS = ("xla", "pallas", "packed", "auto")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -67,6 +67,17 @@ class Pipeline:
             )
 
             return partial(pipeline_pallas, self.ops, block_h=block_h)
+        if backend == "packed":
+            # Pallas with packed-u32 streaming where eligible (per-group
+            # fallback to the u8 kernels keeps it always-correct; see
+            # ops/packed_kernels.py)
+            from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
+                pipeline_pallas,
+            )
+
+            return partial(
+                pipeline_pallas, self.ops, block_h=block_h, packed=True
+            )
         if backend == "auto":
             from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
                 pipeline_auto,

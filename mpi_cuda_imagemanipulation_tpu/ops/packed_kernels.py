@@ -1,0 +1,422 @@
+"""Packed-u32 streaming Pallas kernels — 4 pixels per 32-bit lane.
+
+The round-2 roofline analysis (BASELINE.md) pinned the u8 streaming kernels
+at ~92 GB/s effective against the v5e's 819 GB/s datasheet peak, invariant
+under block geometry and VPU work — consistent with an *element-rate* cap
+on the u8 load/store path rather than a byte-rate DMA ceiling. This module
+is the production exploitation of that hypothesis: HBM keeps the exact same
+bytes, but the kernels view each (H, W) u8 plane as an (H, W/4) i32 word
+array (one `lax.bitcast_convert_type`, no host work), moving 4 pixels per
+32-bit element; kernels unpack to byte lanes with i32 shifts/masks in VMEM
+(Mosaic-native ops — no u8 anywhere inside the kernel body, which also
+sidesteps Mosaic's missing unsigned<->float casts).
+
+Lane space: word j's byte k is image column 4j + k, so a plane becomes 4
+interleaved "lane" planes of width W/4 (lane k = columns k, k+4, ...). Two
+structural facts make the integration small and bit-exact:
+
+  * Pointwise math is elementwise, so the whole fused pointwise chain runs
+    unchanged on lane-concatenated (rows, W) f32 arrays — same core
+    functions from ops/spec.py, same values, different column order.
+  * The streaming kernel's vertical machinery — scratch carries, top
+    strips, the ragged-last-block beyond-row fixes (_assemble_ext), and
+    the separable COLUMN pass — is row-structured and lane-agnostic, so it
+    is reused verbatim from ops/pallas_kernels. Only the ROW pass needs
+    lane-space code: interior taps become lane rotations + word shifts,
+    and the op's width-edge extension is re-synthesised exactly for the
+    first/last `halo` global columns (halo <= 3 keeps every fix inside the
+    first/last word of one lane).
+
+Bit-exactness with the u8 path is structural: per output column the same
+weights are accumulated by the same `_weighted_terms` in the same order,
+the same column pass from `_split_passes` runs on the same row values, and
+the same quantizer applies — asserted across the registry by
+tests/test_packed.py.
+
+Scope (`packed_supported`): pointwise-only groups and single-kernel
+separable correlations (Gaussian, box — including the BASELINE.json
+headline, 8K gaussian:5) with reflect101/edge borders. Everything else
+(non-separable, min/max/median, interior/zero modes, LUT steps, W % 4 != 0)
+falls back to the u8 streaming path per group, so `packed=True` is always
+safe to request.
+
+Reference analogue: kernel.cu processes one pixel per CUDA thread
+(kernel.cu:33-38); the packed layout is the TPU-native inversion — one VPU
+lane processes four pixels per op.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
+    _COMPILER_PARAMS,
+    _apply_pointwise_planes,
+    _assemble_ext,
+    _channels_after,
+    _live_f32_temps,
+    _pick_block_h,
+    _split_passes,
+    _src_col,
+    _top_strip,
+    _weighted_terms,
+)
+from mpi_cuda_imagemanipulation_tpu.ops.spec import (
+    F32,
+    U8,
+    PointwiseOp,
+    QUANTIZERS_F32,
+    StencilOp,
+)
+
+I32 = jnp.int32
+
+
+# --------------------------------------------------------------------------
+# XLA-side views: u8 plane <-> i32 word plane (same bytes, no host work)
+# --------------------------------------------------------------------------
+
+
+def pack_words(plane: jnp.ndarray) -> jnp.ndarray:
+    """(H, W) u8 -> (H, W/4) i32; word j's byte k is column 4j + k."""
+    H, W = plane.shape
+    words = jax.lax.bitcast_convert_type(
+        plane.reshape(H, W // 4, 4), jnp.uint32
+    )
+    return jax.lax.bitcast_convert_type(words, I32)
+
+
+def unpack_words(words: jnp.ndarray, width: int) -> jnp.ndarray:
+    """(H, W/4) i32 -> (H, W) u8 (inverse of pack_words)."""
+    H = words.shape[0]
+    return jax.lax.bitcast_convert_type(words, U8).reshape(H, width)
+
+
+# --------------------------------------------------------------------------
+# In-kernel lane algebra (i32 shifts/masks only — Mosaic-native)
+# --------------------------------------------------------------------------
+
+
+def _lanes_f32(words: jnp.ndarray) -> list[jnp.ndarray]:
+    """Split (rows, Wp) i32 words into 4 f32 lane planes (values 0..255)."""
+    m = jnp.int32(0xFF)
+    return [
+        (words & m).astype(F32),
+        ((words >> 8) & m).astype(F32),
+        ((words >> 16) & m).astype(F32),
+        ((words >> 24) & m).astype(F32),
+    ]
+
+
+def _unpack_concat_f32(words: jnp.ndarray) -> jnp.ndarray:
+    """(rows, Wp) i32 -> lane-concat (rows, 4*Wp) f32: [lane0|lane1|lane2|lane3]."""
+    return jnp.concatenate(_lanes_f32(words), axis=1)
+
+
+def _pack_concat_i32(xc: jnp.ndarray) -> jnp.ndarray:
+    """Lane-concat (rows, W) f32 of exact u8 integers -> (rows, W/4) i32
+    words (the write-side inverse of _unpack_concat_f32)."""
+    Wp = xc.shape[1] // 4
+    l0, l1, l2, l3 = (
+        xc[:, k * Wp : (k + 1) * Wp].astype(I32) for k in range(4)
+    )
+    return l0 | (l1 << 8) | (l2 << 16) | (l3 << 24)
+
+
+def _row_corr_packed(
+    xc: jnp.ndarray, w1d: np.ndarray, h: int, mode: str | None
+) -> jnp.ndarray:
+    """Row pass of a separable correlation in lane space.
+
+    `xc` is lane-concat (rows, W) f32; returns lane-concat (rows, W) f32,
+    bit-identical per output column to pallas_kernels._row_corr: interior
+    taps come from lane rotation (k+d) mod 4 plus a word shift, whose
+    boundary-word replication only pollutes global columns < halo or
+    >= W - halo — exactly the columns the edge fix below overwrites with
+    the same clamped-source weighted sum _row_corr.edge_col computes.
+    """
+    W = xc.shape[1]
+    Wp = W // 4
+    lanes = [xc[:, k * Wp : (k + 1) * Wp] for k in range(4)]
+    wv = np.asarray(w1d, dtype=np.float32).reshape(-1)
+
+    def shifted(k: int, d: int) -> jnp.ndarray:
+        # lane view of global column offset d for output lane k
+        src = lanes[(k + d) % 4]
+        ws = (k + d) // 4  # word shift, in {-1, 0, 1} for |d| <= 3
+        if ws == 0:
+            return src
+        if ws > 0:
+            return jnp.concatenate(
+                [src[:, ws:]] + [src[:, -1:]] * ws, axis=1
+            )
+        return jnp.concatenate([src[:, :1]] * -ws + [src[:, :ws]], axis=1)
+
+    out_lanes = [
+        _weighted_terms(wv, lambda t, k=k: shifted(k, t - h)) for k in range(4)
+    ]
+
+    def edge_col(j: int) -> jnp.ndarray:
+        def sl(t: int) -> jnp.ndarray:
+            c = _src_col(j + t - h, W, mode)
+            if c is None:
+                return jnp.zeros((xc.shape[0], 1), xc.dtype)
+            return lanes[c % 4][:, c // 4 : c // 4 + 1]
+
+        return _weighted_terms(wv, sl)
+
+    # h <= 3 < 4: each fixed global column is the first (left) or last
+    # (right) word of its lane, so each fix is a 1-column rebuild
+    for j in range(h):
+        k = j % 4
+        out_lanes[k] = jnp.concatenate(
+            [edge_col(j), out_lanes[k][:, 1:]], axis=1
+        )
+    for j in range(W - h, W):
+        k = j % 4
+        out_lanes[k] = jnp.concatenate(
+            [out_lanes[k][:, :-1], edge_col(j)], axis=1
+        )
+    return jnp.concatenate(out_lanes, axis=1)
+
+
+# --------------------------------------------------------------------------
+# Eligibility
+# --------------------------------------------------------------------------
+
+
+def packed_supported(
+    pointwise: list[PointwiseOp], stencil: StencilOp | None, width: int
+) -> bool:
+    """Whether this [pointwise*, stencil?] group can run packed; callers
+    fall back to the u8 streaming path otherwise (see module docstring)."""
+    if width % 4 or width // 4 < 8:
+        return False
+    if any(not op.kernel_safe for op in pointwise):
+        return False
+    if stencil is None:
+        return bool(pointwise)
+    if stencil.separable is None or stencil.reduce != "corr":
+        return False
+    if stencil.combine != "single":
+        return False
+    if stencil.edge_mode not in ("reflect101", "edge"):
+        return False
+    if not 1 <= stencil.halo <= 3:
+        return False
+    if 2 * stencil.halo >= width // 4:
+        return False
+    return True
+
+
+# --------------------------------------------------------------------------
+# Kernels
+# --------------------------------------------------------------------------
+
+
+def _pointwise_kernel_packed(*refs, pointwise, n_in, n_out):
+    planes = [_unpack_concat_f32(r[:]) for r in refs[:n_in]]
+    for op in pointwise:
+        planes = _apply_pointwise_planes(op, planes)
+    assert len(planes) == n_out
+    for out_ref, plane in zip(refs[n_in:], planes):
+        out_ref[:] = _pack_concat_i32(plane)
+
+
+def _stream_kernel_packed(
+    *refs,
+    pointwise: list[PointwiseOp],
+    stencil: StencilOp,
+    n_in: int,
+    n_out: int,
+    block_h: int,
+    nb: int,
+    global_h: int,
+    global_w: int,
+):
+    """Packed twin of pallas_kernels._stream_kernel (full-image mode only;
+    the sharded ghost path keeps the u8 kernels). The vertical streaming
+    structure — one lagged column pass over row-passed carries, with the
+    ragged-last-block beyond-row fixes — is shared via _assemble_ext /
+    _top_strip; only the refs' word layout and the lane-space row pass
+    differ. Interior/zero modes are excluded by packed_supported, so there
+    is no mask branch."""
+    h = stencil.halo
+    mode = stencil.edge_mode
+    # the u8 path's column pass (weighted row sums + scale), verbatim: it
+    # only slices rows, so lane-concat columns flow through untouched
+    _, col_pass, _, _ = _split_passes(stencil, global_w)
+
+    in_refs = refs[:n_in]
+    out_refs = refs[n_in : n_in + n_out]
+    scratch = refs[n_in + n_out :]  # (main, tail) per output plane
+
+    i = pl.program_id(0)
+    j = i - 1  # output block index computed this step
+
+    planes = [_unpack_concat_f32(r[:]) for r in in_refs]
+    for op in pointwise:
+        planes = _apply_pointwise_planes(op, planes)
+    assert len(planes) == n_out
+
+    w1d = np.asarray(stencil.separable, dtype=np.float32).reshape(-1)
+
+    # last-block geometry (static) — see _stream_kernel
+    r1 = (global_h - 1) - (nb - 1) * block_h
+    a = min(r1 + 1, block_h)
+    nfix = min(h, block_h - a)
+
+    for p_idx, x in enumerate(planes):
+        main_ref = scratch[2 * p_idx]
+        tail_ref = scratch[2 * p_idx + 1]
+        rp = _row_corr_packed(x, w1d, h, mode)
+
+        @pl.when(i >= 1)
+        def _(rp=rp, main_ref=main_ref, tail_ref=tail_ref, p_idx=p_idx):
+            main = main_ref[:]
+            top = jnp.where(j == 0, _top_strip(main, h, mode), tail_ref[:])
+
+            def beyond(t):
+                # identical to _stream_kernel's full-image beyond(): the
+                # row-pass row holding the edge extension of image row
+                # H + t, sourced at a static offset from the last block
+                if mode == "reflect101":
+                    gp = 2 * (global_h - 1) - (global_h + t)
+                else:  # edge
+                    gp = global_h - 1
+                p = min(max(gp - (nb - 1) * block_h, -h), block_h - 1)
+                if p >= 0:
+                    return main[p : p + 1]
+                return top[h + p : h + p + 1]
+
+            def beyond_pen(t):
+                p = (r1 - 1 - t) if mode == "reflect101" else r1
+                if p >= 0:
+                    return rp[p : p + 1]
+                return main[block_h + p : block_h + p + 1]
+
+            ext = _assemble_ext(
+                j, top, main, rp, beyond, beyond_pen,
+                nb=nb, bh=block_h, h=h, a=a, nfix=nfix,
+            )
+            q = QUANTIZERS_F32[stencil.quantize](col_pass(ext))
+            out_refs[p_idx][:] = _pack_concat_i32(q)
+
+        tail_ref[:] = main_ref[block_h - h :]
+        main_ref[:] = rp
+
+
+# --------------------------------------------------------------------------
+# Group runner
+# --------------------------------------------------------------------------
+
+
+def run_group_packed(
+    pointwise: list[PointwiseOp],
+    stencil: StencilOp | None,
+    planes: list[jnp.ndarray],
+    *,
+    interpret: bool | None = None,
+    block_h: int | None = None,
+) -> list[jnp.ndarray]:
+    """Packed twin of pallas_kernels.run_group. Takes/returns u8 planes —
+    the i32 word views are bitcasts at the call boundary. Caller must have
+    checked packed_supported."""
+    height, width = planes[0].shape
+    Wp = width // 4
+    n_in = len(planes)
+    n_out = _channels_after(pointwise, n_in)
+    h = stencil.halo if stencil is not None else 0
+    if stencil is not None and height <= h:
+        raise ValueError(f"image height {height} too small for halo {h}")
+    # word blocks are Wp i32 columns = width bytes/row, same as the u8
+    # path's working set; reuse its VMEM heuristic unchanged
+    bh = block_h or _pick_block_h(
+        width, n_in, n_out, h, _live_f32_temps(stencil)
+    )
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    words = [pack_words(p) for p in planes]
+
+    if stencil is None:
+        grid = (-(-height // bh),)
+        outs = pl.pallas_call(
+            partial(
+                _pointwise_kernel_packed,
+                pointwise=pointwise,
+                n_in=n_in,
+                n_out=n_out,
+            ),
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((bh, Wp), lambda i: (i, 0), memory_space=pltpu.VMEM)
+                for _ in range(n_in)
+            ],
+            out_specs=[
+                pl.BlockSpec((bh, Wp), lambda i: (i, 0), memory_space=pltpu.VMEM)
+                for _ in range(n_out)
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((height, Wp), I32) for _ in range(n_out)
+            ],
+            interpret=interpret,
+            compiler_params=_COMPILER_PARAMS,
+        )(*words)
+        outs = outs if isinstance(outs, (tuple, list)) else [outs]
+        return [unpack_words(o, width) for o in outs]
+
+    if 2 * h > bh:
+        raise ValueError(f"block_h {bh} too small for halo {h}")
+
+    nb = -(-height // bh)
+    padded_h = nb * bh
+    kernel = partial(
+        _stream_kernel_packed,
+        pointwise=pointwise,
+        stencil=stencil,
+        n_in=n_in,
+        n_out=n_out,
+        block_h=bh,
+        nb=nb,
+        global_h=height,
+        global_w=width,
+    )
+    scratch_shapes = []
+    for _ in range(n_out):
+        scratch_shapes.append(pltpu.VMEM((bh, width), F32))  # main (lane-concat)
+        scratch_shapes.append(pltpu.VMEM((h, width), F32))  # tail
+    outs = pl.pallas_call(
+        kernel,
+        grid=(nb + 1,),
+        in_specs=[
+            pl.BlockSpec(
+                (bh, Wp),
+                partial(lambda i, n: (jnp.minimum(i, n - 1), 0), n=nb),
+                memory_space=pltpu.VMEM,
+            )
+            for _ in range(n_in)
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (bh, Wp),
+                lambda i: (jnp.maximum(i - 1, 0), 0),
+                memory_space=pltpu.VMEM,
+            )
+            for _ in range(n_out)
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((padded_h, Wp), I32) for _ in range(n_out)
+        ],
+        scratch_shapes=scratch_shapes,
+        interpret=interpret,
+        compiler_params=_COMPILER_PARAMS,
+    )(*words)
+    outs = outs if isinstance(outs, (tuple, list)) else [outs]
+    return [unpack_words(o[:height], width) for o in outs]

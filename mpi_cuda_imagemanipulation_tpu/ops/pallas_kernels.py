@@ -864,17 +864,38 @@ def pipeline_pallas(
     *,
     interpret: bool | None = None,
     block_h: int | None = None,
+    packed: bool = False,
 ):
     """Run a full pipeline through fused Pallas group kernels.
 
     Same uint8 semantics as the golden path (bit-exact — asserted by
     tests/test_pallas.py); images are processed as planar channels.
+    `packed=True` routes eligible groups through the packed-u32 streaming
+    kernels (ops/packed_kernels.py — 4 pixels per 32-bit lane; the
+    element-rate roofline exploitation), transparently falling back per
+    group where packing is unsupported, so results stay bit-exact either
+    way (tests/test_packed.py).
     """
     if img.ndim == 3:
         planes = [img[..., c] for c in range(img.shape[2])]
     else:
         planes = [img]
     for pointwise, stencil in group_ops(ops):
+        if packed:
+            from mpi_cuda_imagemanipulation_tpu.ops.packed_kernels import (
+                packed_supported,
+                run_group_packed,
+            )
+
+            if packed_supported(pointwise, stencil, planes[0].shape[1]):
+                planes = run_group_packed(
+                    pointwise,
+                    stencil,
+                    planes,
+                    interpret=interpret,
+                    block_h=block_h,
+                )
+                continue
         planes = run_group(
             pointwise, stencil, planes, interpret=interpret, block_h=block_h
         )

@@ -370,6 +370,12 @@ def sharded_pipeline(pipe, mesh, backend: str = "xla"):
     Returns a jitted (H, W[, 3]) uint8 -> uint8 function, bit-identical to
     the unsharded golden path (tests/test_sharded.py).
     """
+    if backend == "packed":
+        # the packed-u32 streaming kernels deliberately keep the u8 path
+        # for the sharded ghost mode (ops/packed_kernels.py docstring), so
+        # packed+sharded means the Pallas fused-ghost kernels — callers
+        # (CLI --impl packed --shards N, bench suite) must not crash
+        backend = "pallas"
     if backend not in ("xla", "pallas", "auto"):
         raise ValueError(f"unknown backend {backend!r}")
     # Static per-op auto decisions, so the vma checker stays on whenever no

@@ -1,0 +1,153 @@
+"""Packed-u32 streaming kernel tests (interpret mode on CPU).
+
+Every packed group must be BIT-EXACT against the golden jnp path — the
+packed layout only permutes column order inside the kernel; weights,
+accumulation order (_weighted_terms), the column pass and the quantizer are
+shared with the u8 path (ops/packed_kernels.py module docstring). These
+tests sweep eligible specs over ragged geometries (odd heights, block
+overrides, last block shorter than the halo) plus the fallback cases that
+must route back to the u8 kernels untouched.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_cuda_imagemanipulation_tpu.io.image import synthetic_image
+from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline
+from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
+    group_ops,
+    pipeline_pallas,
+)
+from mpi_cuda_imagemanipulation_tpu.ops.packed_kernels import (
+    pack_words,
+    packed_supported,
+    run_group_packed,
+    unpack_words,
+)
+
+
+def _assert_packed_equals_golden(spec, img, block_h=None):
+    pipe = Pipeline.parse(spec)
+    golden = np.asarray(pipe(jnp.asarray(img)))
+    got = np.asarray(
+        pipeline_pallas(
+            pipe.ops, jnp.asarray(img), interpret=True, block_h=block_h,
+            packed=True,
+        )
+    )
+    np.testing.assert_array_equal(got, golden)
+
+
+def test_pack_words_roundtrip():
+    img = jnp.asarray(synthetic_image(16, 64, channels=1, seed=1))
+    words = pack_words(img)
+    assert words.shape == (16, 16) and words.dtype == jnp.int32
+    np.testing.assert_array_equal(
+        np.asarray(unpack_words(words, 64)), np.asarray(img)
+    )
+
+
+@pytest.mark.parametrize(
+    "spec",
+    [
+        "gaussian:3",
+        "gaussian:5",
+        "gaussian:7",
+        "box:3",
+        "box:5",
+        "box:7",
+        "invert,gaussian:5",
+        "brightness:25,gaussian:3",
+        "grayscale,gaussian:5",
+        "grayscale,contrast:3.5",
+        "grayscale601,box:3",
+        "sepia",
+        "threshold:99,gaussian:5,invert",
+    ],
+)
+def test_packed_bitexact(spec):
+    ch = 3 if spec.startswith(("grayscale", "sepia")) else 1
+    img = synthetic_image(97, 384, channels=ch, seed=41)
+    _assert_packed_equals_golden(spec, img)
+
+
+@pytest.mark.parametrize("height", [33, 64, 65, 95, 129])
+def test_packed_ragged_heights(height):
+    # heights around block boundaries exercise the ragged-last-block
+    # beyond-row fixes (shared _assemble_ext machinery) in lane space
+    img = synthetic_image(height, 256, channels=1, seed=42)
+    _assert_packed_equals_golden("gaussian:5", img, block_h=32)
+
+
+@pytest.mark.parametrize("spec,height", [("gaussian:5", 33), ("gaussian:7", 34)])
+def test_packed_last_block_shorter_than_halo(spec, height):
+    img = synthetic_image(height, 128, channels=1, seed=43)
+    _assert_packed_equals_golden(spec, img, block_h=32)
+
+
+@pytest.mark.parametrize("block_h", [32, 64, 96])
+def test_packed_block_overrides(block_h):
+    img = synthetic_image(130, 512, channels=1, seed=44)
+    _assert_packed_equals_golden("gaussian:5", img, block_h=block_h)
+
+
+@pytest.mark.parametrize(
+    "spec,ch,hw",
+    [
+        ("sobel", 1, (50, 256)),  # non-separable -> u8 fallback
+        ("median:3", 1, (40, 128)),  # rank -> fallback
+        ("erode:5", 1, (40, 128)),  # min/max -> fallback
+        ("emboss:3", 1, (40, 128)),  # interior mode -> fallback
+        ("gaussian:5", 1, (60, 258)),  # W % 4 != 0 -> fallback
+        ("gaussian:5", 1, (60, 20)),  # W/4 < 8 -> fallback
+        ("grayscale,contrast:4.3", 3, (40, 128)),  # LUT step -> fallback
+        ("grayscale,contrast:3.5,emboss:3", 3, (96, 128)),  # reference
+    ],
+)
+def test_packed_flag_falls_back_bitexact(spec, ch, hw):
+    """packed=True must be safe for EVERY pipeline: ineligible groups route
+    to the u8 kernels and stay bit-exact."""
+    img = synthetic_image(*hw, channels=ch, seed=45)
+    _assert_packed_equals_golden(spec, img)
+
+
+def test_packed_supported_classification():
+    def groups(spec):
+        return group_ops(Pipeline.parse(spec).ops)
+
+    pw, st = groups("gaussian:5")[0]
+    assert packed_supported(pw, st, 512)
+    assert not packed_supported(pw, st, 510)  # W % 4
+    assert not packed_supported(pw, st, 28)  # W/4 < 8
+    pw, st = groups("sobel")[0]
+    assert not packed_supported(pw, st, 512)  # non-separable
+    pw, st = groups("emboss:3")[0]
+    assert not packed_supported(pw, st, 512)  # interior mode
+    pw, st = groups("grayscale,contrast:3.5")[0]
+    assert st is None and packed_supported(pw, st, 512)
+
+
+def test_packed_pipeline_backend_and_batched():
+    img3 = jnp.asarray(
+        np.stack(
+            [synthetic_image(49, 256, channels=1, seed=50 + k) for k in range(3)]
+        )
+    )
+    pipe = Pipeline.parse("gaussian:5")
+    golden = np.stack([np.asarray(pipe(img3[k])) for k in range(3)])
+    got = np.asarray(pipe.batched(backend="packed")(img3))
+    np.testing.assert_array_equal(got, golden)
+
+
+def test_run_group_packed_direct_multichannel():
+    # 3->3 pointwise chain into a separable stencil, channels planar
+    img = synthetic_image(66, 320, channels=3, seed=51)
+    pipe = Pipeline.parse("sepia,gaussian:3")
+    golden = np.asarray(pipe(jnp.asarray(img)))
+    planes = [jnp.asarray(img[..., c]) for c in range(3)]
+    for pw, st in group_ops(pipe.ops):
+        assert packed_supported(pw, st, 320)
+        planes = run_group_packed(pw, st, planes, interpret=True)
+    got = np.asarray(jnp.stack(planes, -1))
+    np.testing.assert_array_equal(got, golden)

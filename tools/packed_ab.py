@@ -84,20 +84,49 @@ def main() -> int:
         rec.update(extra or {})
         print(json.dumps(rec), flush=True)
 
-    # a/b: production backends
-    for backend in ("pallas", "xla"):
+    # a/b: production backends, incl. the packed-u32 production path
+    # (in-graph bitcast views; ops/packed_kernels.py)
+    for backend in ("pallas", "xla", "packed"):
         fn = pipe.jit(backend)
         got = np.asarray(fn(rgb))
         assert np.array_equal(got, golden), f"{backend} mismatch"
         emit(f"prod_{backend}", device_throughput(fn, [rgb]))
 
-    # c: packed path (pack once outside the timed region — a real pipeline
-    # would keep images packed end-to-end)
+    # c: prototype packed path (pack once outside the timed region — the
+    # zero-bitcast-cost bound for the packed production kernels)
     planes = [pack_u8(rgb[..., c]) for c in range(3)]
     packed_fn = jax.jit(packed_gray_contrast)
     got = np.asarray(unpack_u32(packed_fn(*planes).astype(jnp.uint32)))
     assert np.array_equal(got, golden), "packed mismatch"
     emit("packed_u32", device_throughput(packed_fn, list(planes)))
+
+    # d: the headline workload itself, production u8 vs production packed,
+    # same process, interleaved twice (the tunnel's cross-process variance
+    # is +-20-50%, so only same-process interleaved A/Bs are decisive)
+    Hh, Wh = 4320, 7680
+    gray8k = jnp.asarray(synthetic_image(Hh, Wh, channels=1, seed=7))
+    gpipe = Pipeline.parse("gaussian:5")
+    ggold = np.asarray(gpipe(gray8k))
+    fns = {}
+    for backend in ("pallas", "packed"):
+        fn = gpipe.jit(backend)
+        got = np.asarray(fn(gray8k))
+        assert np.array_equal(got, ggold), f"gaussian5 {backend} mismatch"
+        fns[backend] = fn
+    for rnd in (1, 2):
+        for backend, fn in fns.items():
+            sec = device_throughput(fn, [gray8k])
+            print(
+                json.dumps(
+                    {
+                        "case": f"g5_8k_{backend}_r{rnd}",
+                        "ms": sec * 1e3,
+                        "mp_s": Hh * Wh / 1e6 / sec,
+                        "gb_s": 2 * Hh * Wh / sec / 1e9,
+                    }
+                ),
+                flush=True,
+            )
     return 0
 
 
