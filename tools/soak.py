@@ -131,8 +131,13 @@ def random_shape(rng: random.Random) -> tuple[int, int]:
         return rng.randint(9, 24), rng.randint(9, 40)
     if kind < 0.5:  # lane-boundary widths
         return rng.randint(20, 90), rng.choice((127, 128, 129, 255, 256, 257))
-    if kind < 0.75:  # generic small
-        return rng.randint(25, 120), rng.randint(25, 160)
+    if kind < 0.75:  # generic small; half the time a word-aligned width so
+        # the packed-u32 path's eligible branch (W % 4 == 0, W/4 >= 8)
+        # soaks as often as its fallback
+        w = rng.randint(25, 160)
+        if rng.random() < 0.5:
+            w = max(32, w & ~3)
+        return rng.randint(25, 120), w
     return rng.randint(120, 300), rng.randint(40, 120)  # tall, shardable
 
 
@@ -172,6 +177,16 @@ def run_trial(
         return repro("pallas", f"raised {type(e).__name__}: {e}")
     if not np.array_equal(got, golden):
         return repro("pallas", "mismatch")
+
+    if rng.random() < 0.5:  # packed-u32 path (eligible groups + fallbacks)
+        try:
+            got = np.asarray(
+                pipeline_pallas(pipe.ops, img, interpret=True, packed=True)
+            )
+        except Exception as e:  # noqa: BLE001
+            return repro("packed", f"raised {type(e).__name__}: {e}")
+        if not np.array_equal(got, golden):
+            return repro("packed", "mismatch")
 
     if rng.random() < 0.35:  # batched (vmap) path: per-image bit-equality
         k = rng.randint(2, 3)
@@ -254,6 +269,10 @@ def run_repro(line: str) -> int:
 
     check("xla", lambda: pipe.jit("xla")(img))
     check("pallas", lambda: pipeline_pallas(pipe.ops, img, interpret=True))
+    check(
+        "packed",
+        lambda: pipeline_pallas(pipe.ops, img, interpret=True, packed=True),
+    )
     # same batch construction as run_trial (k distinct images seeded
     # trial_seed + t) so batched REPROs actually reproduce; k=3 supersets
     # the fuzzer's k in {2, 3}, and every index is compared
