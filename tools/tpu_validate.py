@@ -87,6 +87,19 @@ GUARDED_CASES = [
     ("gaussian:5", 1, "pallas"),
 ]
 
+# packed-u32 streaming kernels (ops/packed_kernels.py): CI runs them only
+# in interpret mode, so the compiled-Mosaic existence proof comes from
+# here. Shapes with W % 4 != 0 exercise the per-group u8 fallback under
+# the packed flag.
+PACKED_SPECS = [
+    ("gaussian:5", 1),
+    ("gaussian:7", 1),
+    ("box:5", 1),
+    ("grayscale,contrast:3.5", 3),
+    ("grayscale,gaussian:5", 3),
+    ("invert,gaussian:3,threshold:99", 1),
+]
+
 SHAPES = [(129, 517), (40, 300), (257, 1024), (96, 2048), (65, 140)]
 QUICK_SHAPES = [(129, 517), (65, 140)]
 
@@ -139,6 +152,16 @@ def run_sweep(shapes, results) -> int:
             fails += not _check(
                 results, "compiled", spec, ch, hw,
                 lambda: golden_of(ops, img), lambda: pipeline_pallas(ops, img),
+            )
+
+    for spec, ch in PACKED_SPECS:
+        ops = make_pipeline_ops(spec)
+        for hw in shapes:
+            img = jnp.asarray(synthetic_image(*hw, channels=ch, seed=4))
+            fails += not _check(
+                results, "packed", spec, ch, hw,
+                lambda: golden_of(ops, img),
+                lambda: pipeline_pallas(ops, img, packed=True),
             )
 
     for spec, ch, bh in BLOCK_CASES:
