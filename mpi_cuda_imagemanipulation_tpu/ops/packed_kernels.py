@@ -33,12 +33,12 @@ the same column pass from `_split_passes` runs on the same row values, and
 the same quantizer applies — asserted across the registry by
 tests/test_packed.py.
 
-Scope (`packed_supported`): pointwise-only groups and single-kernel
-separable correlations (Gaussian, box — including the BASELINE.json
-headline, 8K gaussian:5) with reflect101/edge borders. Everything else
-(non-separable, min/max/median, interior/zero modes, LUT steps, W % 4 != 0)
-falls back to the u8 streaming path per group, so `packed=True` is always
-safe to request.
+Scope (`packed_supported`): pointwise-only groups, single-kernel separable
+correlations (Gaussian, box — including the BASELINE.json headline, 8K
+gaussian:5) and square-window min/max morphology (erode/dilate), with
+reflect101/edge borders. Everything else (non-separable, median,
+interior/zero modes, LUT steps, W % 4 != 0) falls back to the u8 streaming
+path per group, so `packed=True` is always safe to request.
 
 Reference analogue: kernel.cu processes one pixel per CUDA thread
 (kernel.cu:33-38); the packed layout is the TPU-native inversion — one VPU
@@ -129,50 +129,35 @@ def _pack_concat_i32(xc: jnp.ndarray) -> jnp.ndarray:
     return l0 | (l1 << 8) | (l2 << 16) | (l3 << 24)
 
 
-def _row_corr_packed(
-    xc: jnp.ndarray, w1d: np.ndarray, h: int, mode: str | None
-) -> jnp.ndarray:
-    """Row pass of a separable correlation in lane space.
+def _split_lanes(xc: jnp.ndarray) -> list[jnp.ndarray]:
+    Wp = xc.shape[1] // 4
+    return [xc[:, k * Wp : (k + 1) * Wp] for k in range(4)]
 
-    `xc` is lane-concat (rows, W) f32; returns lane-concat (rows, W) f32,
-    bit-identical per output column to pallas_kernels._row_corr: interior
-    taps come from lane rotation (k+d) mod 4 plus a word shift, whose
-    boundary-word replication only pollutes global columns < halo or
-    >= W - halo — exactly the columns the edge fix below overwrites with
-    the same clamped-source weighted sum _row_corr.edge_col computes.
-    """
-    W = xc.shape[1]
-    Wp = W // 4
-    lanes = [xc[:, k * Wp : (k + 1) * Wp] for k in range(4)]
-    wv = np.asarray(w1d, dtype=np.float32).reshape(-1)
 
-    def shifted(k: int, d: int) -> jnp.ndarray:
-        # lane view of global column offset d for output lane k
-        src = lanes[(k + d) % 4]
-        ws = (k + d) // 4  # word shift, in {-1, 0, 1} for |d| <= 3
-        if ws == 0:
-            return src
-        if ws > 0:
-            return jnp.concatenate(
-                [src[:, ws:]] + [src[:, -1:]] * ws, axis=1
-            )
-        return jnp.concatenate([src[:, :1]] * -ws + [src[:, :ws]], axis=1)
+def _lane_shifted(lanes: list[jnp.ndarray], k: int, d: int) -> jnp.ndarray:
+    """Lane view of global column offset d for output lane k: source lane
+    (k+d) mod 4, word shift (k+d)//4 (in {-1, 0, 1} for |d| <= 3) with
+    boundary-word replication — which only pollutes global columns < halo
+    or >= W - halo, exactly the ones _apply_edge_fixes overwrites."""
+    src = lanes[(k + d) % 4]
+    ws = (k + d) // 4
+    if ws == 0:
+        return src
+    if ws > 0:
+        return jnp.concatenate([src[:, ws:]] + [src[:, -1:]] * ws, axis=1)
+    return jnp.concatenate([src[:, :1]] * -ws + [src[:, :ws]], axis=1)
 
-    out_lanes = [
-        _weighted_terms(wv, lambda t, k=k: shifted(k, t - h)) for k in range(4)
-    ]
 
-    def edge_col(j: int) -> jnp.ndarray:
-        def sl(t: int) -> jnp.ndarray:
-            c = _src_col(j + t - h, W, mode)
-            if c is None:
-                return jnp.zeros((xc.shape[0], 1), xc.dtype)
-            return lanes[c % 4][:, c // 4 : c // 4 + 1]
+def _lane_col(lanes: list[jnp.ndarray], c: int) -> jnp.ndarray:
+    """Global column c as a (rows, 1) slice of its lane."""
+    return lanes[c % 4][:, c // 4 : c // 4 + 1]
 
-        return _weighted_terms(wv, sl)
 
-    # h <= 3 < 4: each fixed global column is the first (left) or last
-    # (right) word of its lane, so each fix is a 1-column rebuild
+def _apply_edge_fixes(out_lanes, edge_col, h: int, W: int) -> jnp.ndarray:
+    """Overwrite the first/last h global columns with their exact
+    edge-synthesised values and return the lane-concat result. h <= 3 < 4:
+    each fixed column is the first (left) or last (right) word of its
+    lane, so each fix is a 1-column rebuild."""
     for j in range(h):
         k = j % 4
         out_lanes[k] = jnp.concatenate(
@@ -184,6 +169,66 @@ def _row_corr_packed(
             [out_lanes[k][:, :-1], edge_col(j)], axis=1
         )
     return jnp.concatenate(out_lanes, axis=1)
+
+
+def _row_corr_packed(
+    xc: jnp.ndarray, w1d: np.ndarray, h: int, mode: str | None
+) -> jnp.ndarray:
+    """Row pass of a separable correlation in lane space: `xc` is
+    lane-concat (rows, W) f32; returns lane-concat (rows, W) f32,
+    bit-identical per output column to pallas_kernels._row_corr (same
+    _weighted_terms, same clamped-source edge columns)."""
+    W = xc.shape[1]
+    lanes = _split_lanes(xc)
+    wv = np.asarray(w1d, dtype=np.float32).reshape(-1)
+
+    out_lanes = [
+        _weighted_terms(wv, lambda t, k=k: _lane_shifted(lanes, k, t - h))
+        for k in range(4)
+    ]
+
+    def edge_col(j: int) -> jnp.ndarray:
+        def sl(t: int) -> jnp.ndarray:
+            c = _src_col(j + t - h, W, mode)
+            if c is None:
+                return jnp.zeros((xc.shape[0], 1), xc.dtype)
+            return _lane_col(lanes, c)
+
+        return _weighted_terms(wv, sl)
+
+    return _apply_edge_fixes(out_lanes, edge_col, h, W)
+
+
+def _row_reduce_packed(
+    xc: jnp.ndarray, kw: int, h: int, mode: str | None, fn
+) -> jnp.ndarray:
+    """Row pass of a sliding min/max in lane space (erode/dilate), the
+    packed twin of pallas_kernels._row_reduce: same left-assoc fold order
+    over taps, same clamped-source edge columns."""
+    W = xc.shape[1]
+    lanes = _split_lanes(xc)
+
+    def fold(sl):
+        acc = None
+        for t in range(kw):
+            win = sl(t)
+            if win is None:
+                continue
+            acc = win if acc is None else fn(acc, win)
+        return acc
+
+    out_lanes = [
+        fold(lambda t, k=k: _lane_shifted(lanes, k, t - h)) for k in range(4)
+    ]
+
+    def edge_col(j: int) -> jnp.ndarray:
+        def sl(t: int):
+            c = _src_col(j + t - h, W, mode)
+            return None if c is None else _lane_col(lanes, c)
+
+        return fold(sl)
+
+    return _apply_edge_fixes(out_lanes, edge_col, h, W)
 
 
 # --------------------------------------------------------------------------
@@ -202,7 +247,9 @@ def packed_supported(
         return False
     if stencil is None:
         return bool(pointwise)
-    if stencil.separable is None or stencil.reduce != "corr":
+    if stencil.reduce in ("min", "max"):
+        pass  # square-window morphology row pass is separable by nature
+    elif stencil.separable is None or stencil.reduce != "corr":
         return False
     if stencil.combine != "single":
         return False
@@ -265,7 +312,13 @@ def _stream_kernel_packed(
         planes = _apply_pointwise_planes(op, planes)
     assert len(planes) == n_out
 
-    w1d = np.asarray(stencil.separable, dtype=np.float32).reshape(-1)
+    if stencil.reduce in ("min", "max"):
+        red_fn = jnp.minimum if stencil.reduce == "min" else jnp.maximum
+        kw = stencil.kernels[0].shape[1]
+        row_pass = partial(_row_reduce_packed, kw=kw, h=h, mode=mode, fn=red_fn)
+    else:
+        w1d = np.asarray(stencil.separable, dtype=np.float32).reshape(-1)
+        row_pass = partial(_row_corr_packed, w1d=w1d, h=h, mode=mode)
 
     # last-block geometry (static) — see _stream_kernel
     r1 = (global_h - 1) - (nb - 1) * block_h
@@ -275,7 +328,7 @@ def _stream_kernel_packed(
     for p_idx, x in enumerate(planes):
         main_ref = scratch[2 * p_idx]
         tail_ref = scratch[2 * p_idx + 1]
-        rp = _row_corr_packed(x, w1d, h, mode)
+        rp = row_pass(x)
 
         @pl.when(i >= 1)
         def _(rp=rp, main_ref=main_ref, tail_ref=tail_ref, p_idx=p_idx):

@@ -64,6 +64,11 @@ def test_pack_words_roundtrip():
         "grayscale601,box:3",
         "sepia",
         "threshold:99,gaussian:5,invert",
+        "erode:3",
+        "erode:5",
+        "erode:7",
+        "dilate:5",
+        "invert,dilate:3",
     ],
 )
 def test_packed_bitexact(spec):
@@ -97,7 +102,6 @@ def test_packed_block_overrides(block_h):
     [
         ("sobel", 1, (50, 256)),  # non-separable -> u8 fallback
         ("median:3", 1, (40, 128)),  # rank -> fallback
-        ("erode:5", 1, (40, 128)),  # min/max -> fallback
         ("emboss:3", 1, (40, 128)),  # interior mode -> fallback
         ("gaussian:5", 1, (60, 258)),  # W % 4 != 0 -> fallback
         ("gaussian:5", 1, (60, 20)),  # W/4 < 8 -> fallback
@@ -122,6 +126,10 @@ def test_packed_supported_classification():
     assert not packed_supported(pw, st, 28)  # W/4 < 8
     pw, st = groups("sobel")[0]
     assert not packed_supported(pw, st, 512)  # non-separable
+    pw, st = groups("erode:5")[0]
+    assert packed_supported(pw, st, 512)  # separable-by-nature morphology
+    pw, st = groups("median:3")[0]
+    assert not packed_supported(pw, st, 512)  # rank filter
     pw, st = groups("emboss:3")[0]
     assert not packed_supported(pw, st, 512)  # interior mode
     pw, st = groups("grayscale,contrast:3.5")[0]

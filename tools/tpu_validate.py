@@ -95,6 +95,8 @@ PACKED_SPECS = [
     ("gaussian:5", 1),
     ("gaussian:7", 1),
     ("box:5", 1),
+    ("erode:5", 1),
+    ("dilate:3", 1),
     ("grayscale,contrast:3.5", 3),
     ("grayscale,gaussian:5", 3),
     ("invert,gaussian:3,threshold:99", 1),
