@@ -33,12 +33,15 @@ the same column pass from `_split_passes` runs on the same row values, and
 the same quantizer applies — asserted across the registry by
 tests/test_packed.py.
 
-Scope (`packed_supported`): pointwise-only groups, single-kernel separable
-correlations (Gaussian, box — including the BASELINE.json headline, 8K
-gaussian:5) and square-window min/max morphology (erode/dilate), with
-reflect101/edge borders. Everything else (non-separable, median,
-interior/zero modes, LUT steps, W % 4 != 0) falls back to the u8 streaming
-path per group, so `packed=True` is always safe to request.
+Scope (`packed_supported`): pointwise-only groups and every
+reflect101/edge-bordered stencil with halo <= 3 — separable correlations
+(Gaussian, box — incl. the BASELINE.json headline, 8K gaussian:5),
+square-window min/max morphology (erode/dilate), non-separable
+correlations incl. magnitude combines (Sobel/Prewitt/Scharr, Laplacian,
+sharpen/unsharp, arbitrary `filter:`, emboss101), and the median networks.
+Only interior-mode ops (emboss, the reference guard), zero-mode, LUT/
+geometric/global steps and W % 4 != 0 images fall back to the u8 streaming
+path, per group, so `packed=True` is always safe to request.
 
 Reference analogue: kernel.cu processes one pixel per CUDA thread
 (kernel.cu:33-38); the packed layout is the TPU-native inversion — one VPU
@@ -70,6 +73,8 @@ from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
 from mpi_cuda_imagemanipulation_tpu.ops.spec import (
     F32,
     U8,
+    _MEDIAN_NETWORKS,
+    _sort2,
     PointwiseOp,
     QUANTIZERS_F32,
     StencilOp,
@@ -199,6 +204,110 @@ def _row_corr_packed(
     return _apply_edge_fixes(out_lanes, edge_col, h, W)
 
 
+def _combine_scale(stencil: StencilOp, accs: list[jnp.ndarray]) -> jnp.ndarray:
+    """Combine + scale exactly as StencilOp.valid does."""
+    if stencil.combine == "single":
+        acc = accs[0]
+    else:  # magnitude (Sobel class)
+        acc = jnp.sqrt(accs[0] * accs[0] + accs[1] * accs[1])
+    if stencil.scale != 1.0:
+        acc = acc * np.float32(stencil.scale)
+    return acc
+
+
+def _make_col2d_packed(stencil: StencilOp, W: int):
+    """Lane-space column pass for NON-separable stencils: the full 2-D
+    correlation (or the median selection network) over a raw lane-concat
+    ext block, with horizontal taps as lane shifts.
+
+    Bit-exactness with the u8 path is by construction: taps accumulate in
+    corr_valid's exact (dy-major, dx-minor) order with the same zero-weight
+    skips and w==1 fast path; median wires are built in median_valid's
+    dy-major order and run the same exchange network; combine/scale follow
+    StencilOp.valid. The boundary-word pollution of _lane_shifted only
+    reaches global columns < halo or >= W - halo, which the clamped-source
+    edge fix recomputes (same tap order) and overwrites.
+    """
+    h = stencil.halo
+    mode = stencil.edge_mode
+
+    def col_pass(ext: jnp.ndarray) -> jnp.ndarray:
+        rows = ext.shape[0] - 2 * h
+        lanes_ext = _split_lanes(ext)
+        bands = [[l[dy : dy + rows] for l in lanes_ext] for dy in range(2 * h + 1)]
+
+        if stencil.reduce == "median":
+            size = stencil.kernels[0].shape[0]
+            exchanges, mid = _MEDIAN_NETWORKS[size]
+
+            def median_of(wires):
+                p = list(wires)
+                for i, j in exchanges:
+                    p[i], p[j] = _sort2(p[i], p[j])
+                return p[mid]
+
+            def lane_out(k):
+                wires = [
+                    _lane_shifted(bands[dy], k, dx - h)
+                    for dy in range(size)
+                    for dx in range(size)
+                ]
+                return median_of(wires)
+
+            def edge_col(j):
+                wires = []
+                for dy in range(size):
+                    for dx in range(size):
+                        c = _src_col(j + dx - h, W, mode)
+                        wires.append(
+                            jnp.zeros((rows, 1), F32)
+                            if c is None
+                            else _lane_col(bands[dy], c)
+                        )
+                return median_of(wires)
+
+        else:  # 2-D correlation (+ optional magnitude combine)
+
+            def corr(k_or_j, is_edge):
+                accs = []
+                for kmat in stencil.kernels:
+                    kh, kw = kmat.shape
+                    acc = None
+                    for dy in range(kh):
+                        for dx in range(kw):
+                            w = float(kmat[dy, dx])
+                            if w == 0.0:
+                                continue
+                            if is_edge:
+                                c = _src_col(k_or_j + dx - h, W, mode)
+                                win = (
+                                    jnp.zeros((rows, 1), F32)
+                                    if c is None
+                                    else _lane_col(bands[dy], c)
+                                )
+                            else:
+                                win = _lane_shifted(bands[dy], k_or_j, dx - h)
+                            term = win if w == 1.0 else win * np.float32(w)
+                            acc = term if acc is None else acc + term
+                    if acc is None:
+                        shape = (rows, 1) if is_edge else (rows, W // 4)
+                        acc = jnp.zeros(shape, F32)
+                    accs.append(acc)
+                return _combine_scale(stencil, accs)
+
+            def lane_out(k):
+                return corr(k, False)
+
+            def edge_col(j):
+                return corr(j, True)
+
+        return _apply_edge_fixes(
+            [lane_out(k) for k in range(4)], edge_col, h, W
+        )
+
+    return col_pass
+
+
 def _row_reduce_packed(
     xc: jnp.ndarray, kw: int, h: int, mode: str | None, fn
 ) -> jnp.ndarray:
@@ -247,11 +356,9 @@ def packed_supported(
         return False
     if stencil is None:
         return bool(pointwise)
-    if stencil.reduce in ("min", "max"):
-        pass  # square-window morphology row pass is separable by nature
-    elif stencil.separable is None or stencil.reduce != "corr":
+    if stencil.reduce not in ("corr", "min", "max", "median"):
         return False
-    if stencil.combine != "single":
+    if stencil.combine not in ("single", "magnitude"):
         return False
     if stencil.edge_mode not in ("reflect101", "edge"):
         return False
@@ -296,9 +403,6 @@ def _stream_kernel_packed(
     is no mask branch."""
     h = stencil.halo
     mode = stencil.edge_mode
-    # the u8 path's column pass (weighted row sums + scale), verbatim: it
-    # only slices rows, so lane-concat columns flow through untouched
-    _, col_pass, _, _ = _split_passes(stencil, global_w)
 
     in_refs = refs[:n_in]
     out_refs = refs[n_in : n_in + n_out]
@@ -312,13 +416,23 @@ def _stream_kernel_packed(
         planes = _apply_pointwise_planes(op, planes)
     assert len(planes) == n_out
 
+    # Separable ops keep the u8 path's column pass verbatim (it only
+    # slices rows, so lane-concat columns flow through untouched) with a
+    # lane-space row pass; non-separable ops carry raw lane-concat rows
+    # and do the whole 2-D correlation / median network in the lane-space
+    # column pass.
     if stencil.reduce in ("min", "max"):
         red_fn = jnp.minimum if stencil.reduce == "min" else jnp.maximum
         kw = stencil.kernels[0].shape[1]
         row_pass = partial(_row_reduce_packed, kw=kw, h=h, mode=mode, fn=red_fn)
-    else:
+        _, col_pass, _, _ = _split_passes(stencil, global_w)
+    elif stencil.separable is not None:
         w1d = np.asarray(stencil.separable, dtype=np.float32).reshape(-1)
         row_pass = partial(_row_corr_packed, w1d=w1d, h=h, mode=mode)
+        _, col_pass, _, _ = _split_passes(stencil, global_w)
+    else:
+        row_pass = lambda x: x  # noqa: E731 — raw lane-concat carry
+        col_pass = _make_col2d_packed(stencil, global_w)
 
     # last-block geometry (static) — see _stream_kernel
     r1 = (global_h - 1) - (nb - 1) * block_h

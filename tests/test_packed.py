@@ -69,6 +69,18 @@ def test_pack_words_roundtrip():
         "erode:7",
         "dilate:5",
         "invert,dilate:3",
+        "sobel",
+        "prewitt",
+        "scharr",
+        "laplacian:8",
+        "sharpen",
+        "unsharp",
+        "emboss101:3",
+        "emboss101:5",
+        "median:3",
+        "median:5",
+        "filter:1/2/1/2/4/2/1/2/1:0.0625",
+        "grayscale,sobel",
     ],
 )
 def test_packed_bitexact(spec):
@@ -78,11 +90,13 @@ def test_packed_bitexact(spec):
 
 
 @pytest.mark.parametrize("height", [33, 64, 65, 95, 129])
-def test_packed_ragged_heights(height):
+@pytest.mark.parametrize("spec", ["gaussian:5", "sobel", "median:3"])
+def test_packed_ragged_heights(spec, height):
     # heights around block boundaries exercise the ragged-last-block
-    # beyond-row fixes (shared _assemble_ext machinery) in lane space
+    # beyond-row fixes (shared _assemble_ext machinery) in lane space,
+    # for all three row-pass kinds (separable, raw/non-separable, rank)
     img = synthetic_image(height, 256, channels=1, seed=42)
-    _assert_packed_equals_golden("gaussian:5", img, block_h=32)
+    _assert_packed_equals_golden(spec, img, block_h=32)
 
 
 @pytest.mark.parametrize("spec,height", [("gaussian:5", 33), ("gaussian:7", 34)])
@@ -100,8 +114,6 @@ def test_packed_block_overrides(block_h):
 @pytest.mark.parametrize(
     "spec,ch,hw",
     [
-        ("sobel", 1, (50, 256)),  # non-separable -> u8 fallback
-        ("median:3", 1, (40, 128)),  # rank -> fallback
         ("emboss:3", 1, (40, 128)),  # interior mode -> fallback
         ("gaussian:5", 1, (60, 258)),  # W % 4 != 0 -> fallback
         ("gaussian:5", 1, (60, 20)),  # W/4 < 8 -> fallback
@@ -125,11 +137,11 @@ def test_packed_supported_classification():
     assert not packed_supported(pw, st, 510)  # W % 4
     assert not packed_supported(pw, st, 28)  # W/4 < 8
     pw, st = groups("sobel")[0]
-    assert not packed_supported(pw, st, 512)  # non-separable
+    assert packed_supported(pw, st, 512)  # non-separable magnitude combine
     pw, st = groups("erode:5")[0]
     assert packed_supported(pw, st, 512)  # separable-by-nature morphology
     pw, st = groups("median:3")[0]
-    assert not packed_supported(pw, st, 512)  # rank filter
+    assert packed_supported(pw, st, 512)  # rank filter (lane-space network)
     pw, st = groups("emboss:3")[0]
     assert not packed_supported(pw, st, 512)  # interior mode
     pw, st = groups("grayscale,contrast:3.5")[0]

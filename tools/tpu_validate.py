@@ -97,6 +97,11 @@ PACKED_SPECS = [
     ("box:5", 1),
     ("erode:5", 1),
     ("dilate:3", 1),
+    ("sobel", 1),
+    ("unsharp", 1),
+    ("emboss101:5", 1),
+    ("median:3", 1),
+    ("median:5", 1),
     ("grayscale,contrast:3.5", 3),
     ("grayscale,gaussian:5", 3),
     ("invert,gaussian:3,threshold:99", 1),
