@@ -33,15 +33,16 @@ the same column pass from `_split_passes` runs on the same row values, and
 the same quantizer applies — asserted across the registry by
 tests/test_packed.py.
 
-Scope (`packed_supported`): pointwise-only groups and every
-reflect101/edge-bordered stencil with halo <= 3 — separable correlations
-(Gaussian, box — incl. the BASELINE.json headline, 8K gaussian:5),
-square-window min/max morphology (erode/dilate), non-separable
-correlations incl. magnitude combines (Sobel/Prewitt/Scharr, Laplacian,
-sharpen/unsharp, arbitrary `filter:`, emboss101), and the median networks.
-Only interior-mode ops (emboss, the reference guard), zero-mode, LUT/
-geometric/global steps and W % 4 != 0 images fall back to the u8 streaming
-path, per group, so `packed=True` is always safe to request.
+Scope (`packed_supported`): pointwise-only groups and every stencil with
+halo <= 3 except zero-mode — separable correlations (Gaussian, box —
+incl. the BASELINE.json headline, 8K gaussian:5), square-window min/max
+morphology (erode/dilate), non-separable correlations incl. magnitude
+combines (Sobel/Prewitt/Scharr, Laplacian, sharpen/unsharp, arbitrary
+`filter:`, emboss101), the median networks, and interior-mode ops (emboss
+— the reference pipeline runs fully packed) via a lane-space interior
+mask with orig passthrough. Only zero-mode, LUT/geometric/global steps
+and W % 4 != 0 images fall back to the u8 streaming path, per group, so
+`packed=True` is always safe to request.
 
 Reference analogue: kernel.cu processes one pixel per CUDA thread
 (kernel.cu:33-38); the packed layout is the TPU-native inversion — one VPU
@@ -204,6 +205,25 @@ def _row_corr_packed(
     return _apply_edge_fixes(out_lanes, edge_col, h, W)
 
 
+def _interior_mask_lanes(
+    stencil: StencilOp, rows: int, W: int, y0, global_h: int
+) -> jnp.ndarray:
+    """StencilOp.interior_mask in lane-concat layout: lane k's word m is
+    global column 4m + k, so each lane gets its own column iota; row
+    coordinates are global via the traced block offset y0."""
+    from jax import lax
+
+    o = stencil.halo
+    Wp = W // 4
+    yy = y0 + lax.broadcasted_iota(jnp.int32, (rows, Wp), 0)
+    row_ok = (yy > o) & (yy <= global_h - 1 - o)
+    masks = []
+    for k in range(4):
+        xx = 4 * lax.broadcasted_iota(jnp.int32, (rows, Wp), 1) + k
+        masks.append(row_ok & (xx > o) & (xx <= W - 1 - o))
+    return jnp.concatenate(masks, axis=1)
+
+
 def _combine_scale(stencil: StencilOp, accs: list[jnp.ndarray]) -> jnp.ndarray:
     """Combine + scale exactly as StencilOp.valid does."""
     if stencil.combine == "single":
@@ -360,7 +380,12 @@ def packed_supported(
         return False
     if stencil.combine not in ("single", "magnitude"):
         return False
-    if stencil.edge_mode not in ("reflect101", "edge"):
+    if stencil.edge_mode == "interior":
+        # supported via the non-separable path only: identity row pass
+        # keeps the raw rows the orig-passthrough mask needs
+        if stencil.separable is not None or stencil.reduce != "corr":
+            return False
+    elif stencil.edge_mode not in ("reflect101", "edge"):
         return False
     if not 1 <= stencil.halo <= 3:
         return False
@@ -471,8 +496,20 @@ def _stream_kernel_packed(
             ext = _assemble_ext(
                 j, top, main, rp, beyond, beyond_pen,
                 nb=nb, bh=block_h, h=h, a=a, nfix=nfix,
+                # interior mode: the mask passes through exactly the
+                # outputs whose windows could touch garbage rows (same
+                # reasoning as the u8 kernel's full-image interior path)
+                skip_fixes=(mode == "interior"),
             )
             q = QUANTIZERS_F32[stencil.quantize](col_pass(ext))
+            if mode == "interior":
+                # orig passthrough: `main` is the raw lane-concat carry
+                # (interior stencils are non-separable -> identity row
+                # pass), exactly the block being emitted
+                mask = _interior_mask_lanes(
+                    stencil, block_h, global_w, j * block_h, global_h
+                )
+                q = jnp.where(mask, q, main)
             out_refs[p_idx][:] = _pack_concat_i32(q)
 
         tail_ref[:] = main_ref[block_h - h :]

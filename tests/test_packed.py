@@ -81,6 +81,9 @@ def test_pack_words_roundtrip():
         "median:5",
         "filter:1/2/1/2/4/2/1/2/1:0.0625",
         "grayscale,sobel",
+        "emboss:3",
+        "emboss:5",
+        "grayscale,contrast:3.5,emboss:3",
     ],
 )
 def test_packed_bitexact(spec):
@@ -90,11 +93,14 @@ def test_packed_bitexact(spec):
 
 
 @pytest.mark.parametrize("height", [33, 64, 65, 95, 129])
-@pytest.mark.parametrize("spec", ["gaussian:5", "sobel", "median:3"])
+@pytest.mark.parametrize(
+    "spec", ["gaussian:5", "sobel", "median:3", "emboss:5"]
+)
 def test_packed_ragged_heights(spec, height):
     # heights around block boundaries exercise the ragged-last-block
     # beyond-row fixes (shared _assemble_ext machinery) in lane space,
-    # for all three row-pass kinds (separable, raw/non-separable, rank)
+    # for every row-pass kind (separable, raw/non-separable, rank,
+    # interior-masked)
     img = synthetic_image(height, 256, channels=1, seed=42)
     _assert_packed_equals_golden(spec, img, block_h=32)
 
@@ -114,11 +120,10 @@ def test_packed_block_overrides(block_h):
 @pytest.mark.parametrize(
     "spec,ch,hw",
     [
-        ("emboss:3", 1, (40, 128)),  # interior mode -> fallback
         ("gaussian:5", 1, (60, 258)),  # W % 4 != 0 -> fallback
         ("gaussian:5", 1, (60, 20)),  # W/4 < 8 -> fallback
         ("grayscale,contrast:4.3", 3, (40, 128)),  # LUT step -> fallback
-        ("grayscale,contrast:3.5,emboss:3", 3, (96, 128)),  # reference
+        ("rot:90,gaussian:5", 1, (64, 128)),  # geometric step -> fallback
     ],
 )
 def test_packed_flag_falls_back_bitexact(spec, ch, hw):
@@ -143,7 +148,7 @@ def test_packed_supported_classification():
     pw, st = groups("median:3")[0]
     assert packed_supported(pw, st, 512)  # rank filter (lane-space network)
     pw, st = groups("emboss:3")[0]
-    assert not packed_supported(pw, st, 512)  # interior mode
+    assert packed_supported(pw, st, 512)  # interior via lane-space mask
     pw, st = groups("grayscale,contrast:3.5")[0]
     assert st is None and packed_supported(pw, st, 512)
 

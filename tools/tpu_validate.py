@@ -102,7 +102,9 @@ PACKED_SPECS = [
     ("emboss101:5", 1),
     ("median:3", 1),
     ("median:5", 1),
+    ("emboss:5", 1),
     ("grayscale,contrast:3.5", 3),
+    ("grayscale,contrast:3.5,emboss:3", 3),
     ("grayscale,gaussian:5", 3),
     ("invert,gaussian:3,threshold:99", 1),
 ]
