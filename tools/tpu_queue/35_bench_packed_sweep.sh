@@ -1,0 +1,10 @@
+#!/bin/bash
+# Full-suite packed-impl sweep: packed numbers for every bench config
+# (bench.py only races packed on the headline).
+set -u
+cd "$(dirname "$0")/../.."
+. tools/tpu_queue/_lib.sh
+timeout 3600 python -m mpi_cuda_imagemanipulation_tpu bench --impl packed \
+  --json-metrics bench_packed_r03.jsonl > bench_packed_r03.out 2>&1 || exit $?
+commit_artifacts "TPU window: full packed-impl bench sweep (round 3)" \
+  bench_packed_r03.jsonl bench_packed_r03.out
