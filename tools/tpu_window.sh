@@ -18,9 +18,10 @@
 #
 # Chip access stays serialized: ALL on-chip work this round goes through
 # this queue (concurrent clients are a suspected wedge trigger; see
-# BASELINE.md's measurement notes and VERDICT.md round 2). Probe kills
-# (timeout 150) are unavoidable health checks; the 4-min spacing keeps
-# mid-RPC kills rare.
+# BASELINE.md's measurement notes and VERDICT.md round 2). Probe timeout
+# is 480s: cold backend init over the tunnel has taken up to ~10 min, and
+# a shorter timeout would kill a would-be-successful probe mid-RPC — the
+# suspected wedge trigger — exactly when the tunnel is trying to recover.
 set -u
 cd "$(dirname "$0")/.."
 LOG=tools/tpu_window.log
@@ -42,7 +43,7 @@ unset JAX_PLATFORMS
 
 # rc 0 = healthy, 2 = env pinned to cpu (fatal), else wedged
 probe() {
-  timeout 150 python -c "
+  timeout 480 python -c "
 import sys
 import jax, jax.numpy as jnp
 if jax.default_backend() == 'cpu':
