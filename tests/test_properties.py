@@ -44,6 +44,21 @@ def test_pallas_matches_golden_on_random_shapes(args):
     np.testing.assert_array_equal(got, golden)
 
 
+@settings(max_examples=20, deadline=None)
+@given(dims)
+def test_packed_matches_golden_on_random_shapes(args):
+    # random widths land on both the word-aligned packed kernels and the
+    # W % 4 fallback; both must stay bit-exact
+    h, w, pidx, seed = args
+    pipe = Pipeline.parse(PIPELINES[pidx])
+    img = jnp.asarray(synthetic_image(h, w, channels=3, seed=seed))
+    golden = np.asarray(pipe(img))
+    got = np.asarray(
+        pipeline_pallas(pipe.ops, img, interpret=True, packed=True)
+    )
+    np.testing.assert_array_equal(got, golden)
+
+
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 fake devices")
 @settings(max_examples=12, deadline=None)
 @given(
