@@ -418,27 +418,45 @@ def _stream_kernel_packed(
     nb: int,
     global_h: int,
     global_w: int,
+    ghosts: bool = False,
+    image_h: int | None = None,
 ):
-    """Packed twin of pallas_kernels._stream_kernel (full-image mode only;
-    the sharded ghost path keeps the u8 kernels). The vertical streaming
+    """Packed twin of pallas_kernels._stream_kernel. The vertical streaming
     structure — one lagged column pass over row-passed carries, with the
     ragged-last-block beyond-row fixes — is shared via _assemble_ext /
     _top_strip; only the refs' word layout and the lane-space row pass
-    differ. Interior/zero modes are excluded by packed_supported, so there
-    is no mask branch."""
+    differ. Sharded ghost mode mirrors the u8 kernel's: a leading SMEM y0
+    scalar plus two packed (halo, Wp) ghost-strip refs per input plane,
+    row-passed once into dedicated scratch at the first emit step;
+    beyond-tile rows come from the bottom strip, and the interior mask
+    follows global coordinates y0 + j*block_h against image_h."""
     h = stencil.halo
     mode = stencil.edge_mode
 
-    in_refs = refs[:n_in]
-    out_refs = refs[n_in : n_in + n_out]
-    scratch = refs[n_in + n_out :]  # (main, tail) per output plane
+    if ghosts:
+        y0_ref = refs[0]
+        in_refs = refs[1 : 1 + n_in]
+        top_refs = refs[1 + n_in : 1 + 2 * n_in]
+        bot_refs = refs[1 + 2 * n_in : 1 + 3 * n_in]
+        out_refs = refs[1 + 3 * n_in : 1 + 3 * n_in + n_out]
+        scratch = refs[1 + 3 * n_in + n_out :]  # (main, tail, tscr, bscr)/plane
+        per_plane = 4
+    else:
+        in_refs = refs[:n_in]
+        out_refs = refs[n_in : n_in + n_out]
+        scratch = refs[n_in + n_out :]  # (main, tail) per output plane
+        per_plane = 2
 
     i = pl.program_id(0)
     j = i - 1  # output block index computed this step
 
-    planes = [_unpack_concat_f32(r[:]) for r in in_refs]
-    for op in pointwise:
-        planes = _apply_pointwise_planes(op, planes)
+    def run_pointwise(rs):
+        planes = [_unpack_concat_f32(r[:]) for r in rs]
+        for op in pointwise:
+            planes = _apply_pointwise_planes(op, planes)
+        return planes
+
+    planes = run_pointwise(in_refs)
     assert len(planes) == n_out
 
     # Separable ops keep the u8 path's column pass verbatim (it only
@@ -459,55 +477,91 @@ def _stream_kernel_packed(
         row_pass = lambda x: x  # noqa: E731 — raw lane-concat carry
         col_pass = _make_col2d_packed(stencil, global_w)
 
+    if ghosts:
+        # the strips never change across the grid: pointwise + row-pass
+        # them once into dedicated scratch at the first emit step
+        @pl.when(i == 1)
+        def _():
+            tops = run_pointwise(top_refs)
+            bots = run_pointwise(bot_refs)
+            for p_idx in range(n_out):
+                scratch[per_plane * p_idx + 2][:] = row_pass(tops[p_idx])
+                scratch[per_plane * p_idx + 3][:] = row_pass(bots[p_idx])
+
     # last-block geometry (static) — see _stream_kernel
     r1 = (global_h - 1) - (nb - 1) * block_h
     a = min(r1 + 1, block_h)
     nfix = min(h, block_h - a)
 
     for p_idx, x in enumerate(planes):
-        main_ref = scratch[2 * p_idx]
-        tail_ref = scratch[2 * p_idx + 1]
+        main_ref = scratch[per_plane * p_idx]
+        tail_ref = scratch[per_plane * p_idx + 1]
         rp = row_pass(x)
 
         @pl.when(i >= 1)
         def _(rp=rp, main_ref=main_ref, tail_ref=tail_ref, p_idx=p_idx):
             main = main_ref[:]
-            top = jnp.where(j == 0, _top_strip(main, h, mode), tail_ref[:])
+            if ghosts:
+                first_top = scratch[per_plane * p_idx + 2][:]
+                bscr = scratch[per_plane * p_idx + 3][:]
+            else:
+                first_top = _top_strip(main, h, mode)
+            top = jnp.where(j == 0, first_top, tail_ref[:])
 
-            def beyond(t):
-                # identical to _stream_kernel's full-image beyond(): the
-                # row-pass row holding the edge extension of image row
-                # H + t, sourced at a static offset from the last block
-                if mode == "reflect101":
-                    gp = 2 * (global_h - 1) - (global_h + t)
-                else:  # edge
-                    gp = global_h - 1
-                p = min(max(gp - (nb - 1) * block_h, -h), block_h - 1)
-                if p >= 0:
-                    return main[p : p + 1]
-                return top[h + p : h + p + 1]
+            if ghosts:
 
-            def beyond_pen(t):
-                p = (r1 - 1 - t) if mode == "reflect101" else r1
-                if p >= 0:
-                    return rp[p : p + 1]
-                return main[block_h + p : block_h + p + 1]
+                def beyond(t, bscr=bscr):
+                    # tile row H + t is strip row t; rows past the strip
+                    # feed only cropped outputs, so the clamp is safe
+                    c = min(t, h - 1)
+                    return bscr[c : c + 1]
+
+                beyond_pen = beyond
+            else:
+
+                def beyond(t):
+                    # identical to _stream_kernel's full-image beyond():
+                    # the row-pass row holding the edge extension of image
+                    # row H + t, sourced at a static offset from the last
+                    # block
+                    if mode == "reflect101":
+                        gp = 2 * (global_h - 1) - (global_h + t)
+                    else:  # edge
+                        gp = global_h - 1
+                    p = min(max(gp - (nb - 1) * block_h, -h), block_h - 1)
+                    if p >= 0:
+                        return main[p : p + 1]
+                    return top[h + p : h + p + 1]
+
+                def beyond_pen(t):
+                    p = (r1 - 1 - t) if mode == "reflect101" else r1
+                    if p >= 0:
+                        return rp[p : p + 1]
+                    return main[block_h + p : block_h + p + 1]
 
             ext = _assemble_ext(
                 j, top, main, rp, beyond, beyond_pen,
                 nb=nb, bh=block_h, h=h, a=a, nfix=nfix,
-                # interior mode: the mask passes through exactly the
-                # outputs whose windows could touch garbage rows (same
-                # reasoning as the u8 kernel's full-image interior path)
-                skip_fixes=(mode == "interior"),
+                # full-image interior mode: the mask passes through exactly
+                # the outputs whose windows could touch garbage rows (same
+                # reasoning as the u8 kernel). In ghost mode the
+                # beyond-tile rows are real neighbour data — always fixed.
+                skip_fixes=(mode == "interior" and not ghosts),
             )
             q = QUANTIZERS_F32[stencil.quantize](col_pass(ext))
             if mode == "interior":
                 # orig passthrough: `main` is the raw lane-concat carry
                 # (interior stencils are non-separable -> identity row
                 # pass), exactly the block being emitted
+                base = (
+                    y0_ref[0] + j * block_h if ghosts else j * block_h
+                )
                 mask = _interior_mask_lanes(
-                    stencil, block_h, global_w, j * block_h, global_h
+                    stencil,
+                    block_h,
+                    global_w,
+                    base,
+                    image_h if ghosts else global_h,
                 )
                 q = jnp.where(mask, q, main)
             out_refs[p_idx][:] = _pack_concat_i32(q)
@@ -528,10 +582,16 @@ def run_group_packed(
     *,
     interpret: bool | None = None,
     block_h: int | None = None,
+    ghosts: tuple[list[jnp.ndarray], list[jnp.ndarray]] | None = None,
+    y0=None,
+    image_h: int | None = None,
 ) -> list[jnp.ndarray]:
     """Packed twin of pallas_kernels.run_group. Takes/returns u8 planes —
     the i32 word views are bitcasts at the call boundary. Caller must have
-    checked packed_supported."""
+    checked packed_supported. `ghosts=(tops, bots)` switches to sharded
+    ghost mode (raw pre-pointwise (halo, W) u8 strips per input plane,
+    packed at the boundary like the tiles; requires a stencil and `y0` +
+    `image_h` for interior masks)."""
     height, width = planes[0].shape
     Wp = width // 4
     n_in = len(planes)
@@ -591,22 +651,46 @@ def run_group_packed(
         nb=nb,
         global_h=height,
         global_w=width,
+        ghosts=ghosts is not None,
+        image_h=image_h,
     )
+    per_plane_scratch = 2 if ghosts is None else 4
     scratch_shapes = []
     for _ in range(n_out):
         scratch_shapes.append(pltpu.VMEM((bh, width), F32))  # main (lane-concat)
         scratch_shapes.append(pltpu.VMEM((h, width), F32))  # tail
+        if per_plane_scratch == 4:
+            scratch_shapes.append(pltpu.VMEM((h, width), F32))  # top rp
+            scratch_shapes.append(pltpu.VMEM((h, width), F32))  # bot rp
+    in_specs = [
+        pl.BlockSpec(
+            (bh, Wp),
+            partial(lambda i, n: (jnp.minimum(i, n - 1), 0), n=nb),
+            memory_space=pltpu.VMEM,
+        )
+        for _ in range(n_in)
+    ]
+    args = list(words)
+    if ghosts is not None:
+        tops, bots = ghosts
+        strip_spec = pl.BlockSpec(
+            (h, Wp), lambda i: (0, 0), memory_space=pltpu.VMEM
+        )
+        in_specs = (
+            [pl.BlockSpec(memory_space=pltpu.SMEM)]
+            + in_specs
+            + [strip_spec] * (2 * n_in)
+        )
+        args = (
+            [jnp.asarray(y0, jnp.int32).reshape(1)]
+            + args
+            + [pack_words(t) for t in tops]
+            + [pack_words(b) for b in bots]
+        )
     outs = pl.pallas_call(
         kernel,
         grid=(nb + 1,),
-        in_specs=[
-            pl.BlockSpec(
-                (bh, Wp),
-                partial(lambda i, n: (jnp.minimum(i, n - 1), 0), n=nb),
-                memory_space=pltpu.VMEM,
-            )
-            for _ in range(n_in)
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec(
                 (bh, Wp),
@@ -621,6 +705,6 @@ def run_group_packed(
         scratch_shapes=scratch_shapes,
         interpret=interpret,
         compiler_params=_COMPILER_PARAMS,
-    )(*words)
+    )(*args)
     outs = outs if isinstance(outs, (tuple, list)) else [outs]
     return [unpack_words(o[:height], width) for o in outs]

@@ -165,6 +165,34 @@ def test_packed_pipeline_backend_and_batched():
     np.testing.assert_array_equal(got, golden)
 
 
+@pytest.mark.skipif(
+    __import__("jax").device_count() < 8, reason="needs 8 fake devices"
+)
+@pytest.mark.parametrize(
+    "spec,ch,hw,n",
+    [
+        ("gaussian:5", 1, (200, 256), 8),  # separable ghost
+        ("sobel", 1, (200, 256), 4),  # non-separable ghost
+        ("grayscale,contrast:3.5,emboss:3", 3, (192, 128), 8),  # interior
+        ("erode:5", 1, (160, 128), 8),  # min/max ghost
+        ("median:5", 1, (160, 128), 4),  # rank ghost
+        ("gaussian:5", 1, (160, 130), 2),  # W%4!=0 -> u8 ghost fallback
+        ("sobel", 1, (197, 256), 4),  # pad rows -> materialised-ext path
+    ],
+)
+def test_packed_sharded_matches_golden(spec, ch, hw, n):
+    """backend='packed' sharded: ghost-mode packed kernels where eligible,
+    u8/materialised-ext fallbacks elsewhere — always bit-exact vs golden
+    (the seam invariant, now also for the lane-packed layout)."""
+    from mpi_cuda_imagemanipulation_tpu.parallel.mesh import make_mesh
+
+    img = jnp.asarray(synthetic_image(*hw, channels=ch, seed=9))
+    pipe = Pipeline.parse(spec)
+    golden = np.asarray(pipe(img))
+    got = np.asarray(pipe.sharded(make_mesh(n), backend="packed")(img))
+    np.testing.assert_array_equal(got, golden)
+
+
 def test_run_group_packed_direct_multichannel():
     # 3->3 pointwise chain into a separable stencil, channels planar
     img = synthetic_image(66, 320, channels=3, seed=51)
