@@ -207,7 +207,7 @@ def run_trial(
     n_dev = len(jax.devices())
     if n_dev >= 2:
         shards = rng.choice([s for s in (2, 3, 5, n_dev) if s <= n_dev])
-        backend = rng.choice(("xla", "pallas", "auto"))
+        backend = rng.choice(("xla", "pallas", "packed", "auto"))
         # small images reject large shard counts (documented min-rows-per-
         # shard guard); fall back toward 2 shards so pathological shapes
         # still get sharded coverage, and *count* trials that lose it so
@@ -289,7 +289,7 @@ def run_repro(line: str) -> int:
             )
     n_dev = len(jax.devices())
     for shards in sorted({s for s in (2, 3, 5, n_dev) if s <= n_dev}):
-        for b in ("xla", "pallas", "auto"):
+        for b in ("xla", "pallas", "packed", "auto"):
             check(
                 f"sharded-{shards}-{b}",
                 lambda shards=shards, b=b: pipe.sharded(
