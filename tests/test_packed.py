@@ -178,6 +178,7 @@ def test_packed_pipeline_backend_and_batched():
         ("median:5", 1, (160, 128), 4),  # rank ghost
         ("gaussian:5", 1, (160, 130), 2),  # W%4!=0 -> u8 ghost fallback
         ("sobel", 1, (197, 256), 4),  # pad rows -> materialised-ext path
+        ("grayscale,gaussian:5", 3, (200, 256), 8),  # 3->1 into separable
     ],
 )
 def test_packed_sharded_matches_golden(spec, ch, hw, n):
