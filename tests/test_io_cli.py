@@ -382,3 +382,29 @@ def test_cli_diff(tmp_path):
     mm = _run_cli("diff", str(pa), str(pb), "--json-metrics", "-")
     assert mm.returncode == 2 and "shape mismatch" in mm.stdout
     assert '"error": "shape mismatch"' in mm.stdout
+
+
+def test_profile_capture_summarize(tmp_path):
+    """The watcher's trace step depends on this stdlib perfetto parser;
+    keep its aggregation and DMA/compute split honest."""
+    import gzip
+
+    from tools.profile_capture import _load_trace_events, summarize
+
+    events = [
+        {"ph": "M", "pid": 1, "name": "process_name", "args": {"name": "python"}},
+        {"ph": "M", "pid": 2, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "X", "pid": 2, "tid": 1, "name": "fusion.123", "dur": 500.0},
+        {"ph": "X", "pid": 2, "tid": 2, "name": "dma.copy-start", "dur": 900.0},
+        {"ph": "X", "pid": 1, "tid": 1, "name": "PjitFunction", "dur": 100.0},
+    ]
+    sub = tmp_path / "plugins"
+    sub.mkdir()
+    with gzip.open(sub / "t.json.gz", "wt") as f:
+        json.dump({"traceEvents": events}, f)
+    got = summarize(_load_trace_events(str(tmp_path)))
+    assert got["device_dma_us"] == 900.0
+    assert got["device_compute_us"] == 500.0
+    assert any(t["name"] == "fusion.123" for t in got["top_events"])
+    assert got["processes"]["python"] == 100.0
