@@ -145,12 +145,92 @@ def main() -> int:
             emit({"case": "pallas_lagged_copy_u8", "block_h": bh,
                   "error": str(e)[:200]})
 
-    # e) the headline kernel in the same process/chip state
+    # e) the XLA-level u8<->u32 bitcast views the packed production path
+    # uses at group boundaries (ops/packed_kernels.pack_words): on TPU the
+    # tilings differ ((32,128) u8 vs (8,128) u32), so this may compile to
+    # a real copy — its cost decides whether packed pipelines should keep
+    # words end-to-end between groups
+    from mpi_cuda_imagemanipulation_tpu.ops.packed_kernels import (
+        pack_words,
+        unpack_words,
+    )
+
+    for name, f, arg in (
+        ("xla_pack_bitcast", jax.jit(pack_words), img_u8),
+        (
+            "xla_unpack_bitcast",
+            jax.jit(lambda w: unpack_words(w, W)),
+            jax.jit(pack_words)(img_u8),
+        ),
+    ):
+        try:
+            sec = device_throughput(f, [arg])
+            emit({"case": name, "ms": sec * 1e3,
+                  "gb_s": 2 * H * W / sec / 1e9})
+        except Exception as e:
+            emit({"case": name, "error": str(e)[:200]})
+
+    # f) in-kernel pltpu.bitcast (sublane repack, HBM stays u8): if the u8
+    # cap is the vector load/store path rather than the DMA, a kernel that
+    # loads u8 and stores u32 (or vice versa) isolates which direction pays
+    def bitcast_store_call(bh):
+        def kernel(in_ref, out_ref):
+            out_ref[:] = pltpu.bitcast(in_ref[:], jnp.uint32)
+
+        return pl.pallas_call(
+            kernel,
+            grid=(-(-H // bh),),
+            in_specs=[pl.BlockSpec((bh, W), lambda i: (i, 0),
+                                   memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec((bh // 4, W), lambda i: (i, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((H // 4, W), jnp.uint32),
+            compiler_params=_COMPILER_PARAMS,
+        )
+
+    def bitcast_load_call(bh):
+        def kernel(in_ref, out_ref):
+            out_ref[:] = pltpu.bitcast(in_ref[:], jnp.uint8)
+
+        return pl.pallas_call(
+            kernel,
+            grid=(-(-(H // 4) // bh),),
+            in_specs=[pl.BlockSpec((bh, W), lambda i: (i, 0),
+                                   memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec((4 * bh, W), lambda i: (i, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((H, W), jnp.uint8),
+            compiler_params=_COMPILER_PARAMS,
+        )
+
+    img_u32_rows = None
+    for name, make, arg_builder in (
+        ("pallas_u8load_u32store_bitcast", bitcast_store_call,
+         lambda: img_u8),
+        ("pallas_u32load_u8store_bitcast", bitcast_load_call,
+         lambda: jax.jit(lambda x: bitcast_store_call(128)(x))(img_u8)),
+    ):
+        for bh in (128,):
+            try:
+                arg = arg_builder()
+                f = jax.jit(make(bh))
+                sec = device_throughput(f, [arg])
+                emit({"case": name, "block_h": bh, "ms": sec * 1e3,
+                      "gb_s": 2 * H * W / sec / 1e9})
+            except Exception as e:
+                emit({"case": name, "block_h": bh, "error": str(e)[:200]})
+
+    # g) the headline kernel in the same process/chip state, u8 and packed
     ops = make_pipeline_ops("gaussian:5")
-    f = jax.jit(lambda x: pipeline_pallas(ops, x))
-    sec = device_throughput(f, [img_u8])
-    emit({"case": "gaussian5_8k_pallas", "ms": sec * 1e3,
-          "mp_s": H * W / 1e6 / sec, "gb_s": 2 * H * W / sec / 1e9})
+    for name, packed in (("gaussian5_8k_pallas", False),
+                         ("gaussian5_8k_packed", True)):
+        try:
+            f = jax.jit(lambda x, p=packed: pipeline_pallas(ops, x, packed=p))
+            sec = device_throughput(f, [img_u8])
+            emit({"case": name, "ms": sec * 1e3,
+                  "mp_s": H * W / 1e6 / sec, "gb_s": 2 * H * W / sec / 1e9})
+        except Exception as e:
+            emit({"case": name, "error": str(e)[:200]})
     return 0
 
 
