@@ -587,14 +587,51 @@ def run_group_packed(
     image_h: int | None = None,
 ) -> list[jnp.ndarray]:
     """Packed twin of pallas_kernels.run_group. Takes/returns u8 planes —
-    the i32 word views are bitcasts at the call boundary. Caller must have
-    checked packed_supported. `ghosts=(tops, bots)` switches to sharded
-    ghost mode (raw pre-pointwise (halo, W) u8 strips per input plane,
-    packed at the boundary like the tiles; requires a stencil and `y0` +
-    `image_h` for interior masks)."""
+    the i32 word views are bitcasts at the call boundary (see
+    run_group_packed_words to keep words across consecutive groups).
+    Caller must have checked packed_supported. `ghosts=(tops, bots)`
+    switches to sharded ghost mode (raw pre-pointwise (halo, W) u8 strips
+    per input plane, packed at the boundary like the tiles; requires a
+    stencil and `y0` + `image_h` for interior masks)."""
     height, width = planes[0].shape
+    gw = None
+    if ghosts is not None:
+        tops, bots = ghosts
+        gw = ([pack_words(t) for t in tops], [pack_words(b) for b in bots])
+    outs = run_group_packed_words(
+        pointwise,
+        stencil,
+        [pack_words(p) for p in planes],
+        height,
+        width,
+        interpret=interpret,
+        block_h=block_h,
+        ghosts=gw,
+        y0=y0,
+        image_h=image_h,
+    )
+    return [unpack_words(o, width) for o in outs]
+
+
+def run_group_packed_words(
+    pointwise: list[PointwiseOp],
+    stencil: StencilOp | None,
+    words: list[jnp.ndarray],
+    height: int,
+    width: int,
+    *,
+    interpret: bool | None = None,
+    block_h: int | None = None,
+    ghosts: tuple[list[jnp.ndarray], list[jnp.ndarray]] | None = None,
+    y0=None,
+    image_h: int | None = None,
+) -> list[jnp.ndarray]:
+    """Word-level packed group runner: takes and returns (H, W/4) i32 word
+    planes. On TPU the u8<->u32 view is a real copy (different tilings), so
+    pipeline_pallas keeps consecutive eligible groups in word form and only
+    converts at the run's ends."""
     Wp = width // 4
-    n_in = len(planes)
+    n_in = len(words)
     n_out = _channels_after(pointwise, n_in)
     h = stencil.halo if stencil is not None else 0
     if stencil is not None and height <= h:
@@ -606,8 +643,6 @@ def run_group_packed(
     )
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-
-    words = [pack_words(p) for p in planes]
 
     if stencil is None:
         grid = (-(-height // bh),)
@@ -634,7 +669,7 @@ def run_group_packed(
             compiler_params=_COMPILER_PARAMS,
         )(*words)
         outs = outs if isinstance(outs, (tuple, list)) else [outs]
-        return [unpack_words(o, width) for o in outs]
+        return list(outs)
 
     if 2 * h > bh:
         raise ValueError(f"block_h {bh} too small for halo {h}")
@@ -684,8 +719,8 @@ def run_group_packed(
         args = (
             [jnp.asarray(y0, jnp.int32).reshape(1)]
             + args
-            + [pack_words(t) for t in tops]
-            + [pack_words(b) for b in bots]
+            + list(tops)  # already word planes (packed by the wrapper)
+            + list(bots)
         )
     outs = pl.pallas_call(
         kernel,
@@ -707,4 +742,4 @@ def run_group_packed(
         compiler_params=_COMPILER_PARAMS,
     )(*args)
     outs = outs if isinstance(outs, (tuple, list)) else [outs]
-    return [unpack_words(o[:height], width) for o in outs]
+    return [o[:height] for o in outs]

@@ -880,25 +880,44 @@ def pipeline_pallas(
         planes = [img[..., c] for c in range(img.shape[2])]
     else:
         planes = [img]
-    for pointwise, stencil in group_ops(ops):
-        if packed:
-            from mpi_cuda_imagemanipulation_tpu.ops.packed_kernels import (
-                packed_supported,
-                run_group_packed,
-            )
+    if packed:
+        from mpi_cuda_imagemanipulation_tpu.ops.packed_kernels import (
+            pack_words,
+            packed_supported,
+            run_group_packed_words,
+            unpack_words,
+        )
 
-            if packed_supported(pointwise, stencil, planes[0].shape[1]):
-                planes = run_group_packed(
-                    pointwise,
-                    stencil,
-                    planes,
-                    interpret=interpret,
-                    block_h=block_h,
-                )
-                continue
+    words = None  # non-None: planes currently live as packed i32 words
+    height = width = None
+    for pointwise, stencil in group_ops(ops):
+        if words is None:
+            height, width = planes[0].shape
+        if packed and packed_supported(pointwise, stencil, width):
+            # consecutive eligible groups stay in word form — on TPU the
+            # u8<->u32 view is a real copy (different tilings), so the
+            # conversion is paid once per run of packed groups, not per
+            # group
+            if words is None:
+                words = [pack_words(p) for p in planes]
+            words = run_group_packed_words(
+                pointwise,
+                stencil,
+                words,
+                height,
+                width,
+                interpret=interpret,
+                block_h=block_h,
+            )
+            continue
+        if words is not None:
+            planes = [unpack_words(w, width) for w in words]
+            words = None
         planes = run_group(
             pointwise, stencil, planes, interpret=interpret, block_h=block_h
         )
+    if words is not None:
+        planes = [unpack_words(w, width) for w in words]
     if len(planes) == 1:
         return planes[0]
     return jnp.stack(planes, axis=-1)
