@@ -96,7 +96,7 @@ PACKED_SPECS = [
     ("gaussian:7", 1),
     ("box:5", 1),
     ("erode:5", 1),
-    ("dilate:3", 1),
+    ("dilate:7", 1),
     ("sobel", 1),
     ("unsharp", 1),
     ("emboss101:5", 1),
