@@ -194,6 +194,32 @@ def test_packed_sharded_matches_golden(spec, ch, hw, n):
     np.testing.assert_array_equal(got, golden)
 
 
+def test_run_group_packed_words_contract():
+    """The word-level runner (pipeline word-form carry) takes and returns
+    (H, W/4) i32 planes and matches the u8-boundary wrapper exactly —
+    incl. high-bit bytes (the i32 arithmetic >>24 must mask correctly)."""
+    from mpi_cuda_imagemanipulation_tpu.ops.packed_kernels import (
+        run_group_packed_words,
+    )
+
+    img = np.full((40, 128), 255, np.uint8)  # all-high bytes
+    img[::3, ::5] = 7
+    img = jnp.asarray(img)
+    pipe = Pipeline.parse("gaussian:5")
+    pw, st = group_ops(pipe.ops)[0]
+    via_wrapper = run_group_packed(pw, st, [img], interpret=True)[0]
+    words = run_group_packed_words(
+        pw, st, [pack_words(img)], 40, 128, interpret=True
+    )[0]
+    assert words.dtype == jnp.int32 and words.shape == (40, 32)
+    np.testing.assert_array_equal(
+        np.asarray(unpack_words(words, 128)), np.asarray(via_wrapper)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(via_wrapper), np.asarray(pipe(img))
+    )
+
+
 def test_run_group_packed_direct_multichannel():
     # 3->3 pointwise chain into a separable stencil, channels planar
     img = synthetic_image(66, 320, channels=3, seed=51)
