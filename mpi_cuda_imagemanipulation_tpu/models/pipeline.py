@@ -61,22 +61,19 @@ class Pipeline:
     def _callable(self, backend: str, block_h: int | None = None):
         if backend == "xla":
             return self.apply
-        if backend == "pallas":
-            from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
-                pipeline_pallas,
-            )
-
-            return partial(pipeline_pallas, self.ops, block_h=block_h)
-        if backend == "packed":
-            # Pallas with packed-u32 streaming where eligible (per-group
-            # fallback to the u8 kernels keeps it always-correct; see
-            # ops/packed_kernels.py)
+        if backend in ("pallas", "packed"):
+            # "packed" is Pallas with packed-u32 streaming where eligible
+            # (per-group fallback to the u8 kernels keeps it always-
+            # correct; see ops/packed_kernels.py)
             from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
                 pipeline_pallas,
             )
 
             return partial(
-                pipeline_pallas, self.ops, block_h=block_h, packed=True
+                pipeline_pallas,
+                self.ops,
+                block_h=block_h,
+                packed=backend == "packed",
             )
         if backend == "auto":
             from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (

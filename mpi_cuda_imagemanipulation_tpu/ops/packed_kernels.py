@@ -56,6 +56,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -211,8 +212,6 @@ def _interior_mask_lanes(
     """StencilOp.interior_mask in lane-concat layout: lane k's word m is
     global column 4m + k, so each lane gets its own column iota; row
     coordinates are global via the traced block offset y0."""
-    from jax import lax
-
     o = stencil.halo
     Wp = W // 4
     yy = y0 + lax.broadcasted_iota(jnp.int32, (rows, Wp), 0)

@@ -9,6 +9,7 @@ overrides, last block shorter than the halo) plus the fallback cases that
 must route back to the u8 kernels untouched.
 """
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -165,9 +166,7 @@ def test_packed_pipeline_backend_and_batched():
     np.testing.assert_array_equal(got, golden)
 
 
-@pytest.mark.skipif(
-    __import__("jax").device_count() < 8, reason="needs 8 fake devices"
-)
+@pytest.mark.skipif(jax.device_count() < 8, reason="needs 8 fake devices")
 @pytest.mark.parametrize(
     "spec,ch,hw,n",
     [

@@ -203,7 +203,6 @@ def main() -> int:
             compiler_params=_COMPILER_PARAMS,
         )
 
-    img_u32_rows = None
     for name, make, arg_builder in (
         ("pallas_u8load_u32store_bitcast", bitcast_store_call,
          lambda: img_u8),
