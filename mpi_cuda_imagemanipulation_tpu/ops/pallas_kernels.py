@@ -954,6 +954,18 @@ def use_pallas_for_stencil(stencil: StencilOp | None, group_in_channels: int) ->
     return group_in_channels == 1 and len(stencil.kernels) > 1
 
 
+def prefer_packed() -> bool:
+    """Whether the auto paths should route eligible Pallas groups through
+    the packed-u32 kernels (ops/packed_kernels.py). Off by default until
+    the on-chip A/B (BASELINE.md round 3 decision procedure) confirms the
+    element-rate win; flipping MCIM_PREFER_PACKED=1 then promotes packed
+    everywhere `auto` runs — CLI default, batch, sharded — without a code
+    change."""
+    import os
+
+    return os.environ.get("MCIM_PREFER_PACKED", "") not in ("", "0")
+
+
 def pipeline_auto(
     ops,
     img: jnp.ndarray,
@@ -962,9 +974,11 @@ def pipeline_auto(
     block_h: int | None = None,
 ):
     """Per-group backend selection: golden/XLA ops where XLA's fusion wins,
-    Pallas group kernels where the stencil working set favours them.
+    Pallas group kernels where the stencil working set favours them
+    (packed-u32 variants under MCIM_PREFER_PACKED — see prefer_packed).
     Bit-exact with both pure paths (they are bit-exact with each other)."""
     state = img
+    packed = prefer_packed()
     for pointwise, stencil in group_ops(ops):
         n_ch = state.shape[2] if state.ndim == 3 else 1
         if use_pallas_for_stencil(stencil, n_ch):
@@ -973,6 +987,24 @@ def pipeline_auto(
                 if state.ndim == 3
                 else [state]
             )
+            if packed:
+                from mpi_cuda_imagemanipulation_tpu.ops.packed_kernels import (
+                    packed_supported,
+                    run_group_packed,
+                )
+
+                if packed_supported(pointwise, stencil, planes[0].shape[1]):
+                    planes = run_group_packed(
+                        pointwise,
+                        stencil,
+                        planes,
+                        interpret=interpret,
+                        block_h=block_h,
+                    )
+                    state = (
+                        planes[0] if len(planes) == 1 else jnp.stack(planes, -1)
+                    )
+                    continue
             planes = run_group(
                 pointwise, stencil, planes, interpret=interpret, block_h=block_h
             )

@@ -361,11 +361,16 @@ def _run_segment(ops, mesh, backend: str, any_pallas: bool, img: jnp.ndarray):
                     and local_h > op.halo
                 )
                 if fusible:
+                    from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
+                        prefer_packed,
+                    )
+
                     group = list(pending)
                     pending.clear()
                     tile = _apply_group_fused(
                         group, op, tile, y0, global_h, global_w, n,
-                        packed=backend == "packed",
+                        packed=backend == "packed"
+                        or (backend == "auto" and prefer_packed()),
                     )
                 else:
                     tile = flush(tile)
