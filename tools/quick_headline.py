@@ -43,8 +43,11 @@ def main() -> int:
         return 3
 
     # pallas first (the committed baseline impl — worth having even if the
-    # window dies mid-step), then the packed-u32 candidate; the headline
-    # reports whichever measured fastest
+    # window dies mid-step), then the packed-u32 candidate. Each impl's
+    # record is appended to BENCH_HISTORY.jsonl IMMEDIATELY after its
+    # measurement (and the queue step commits whatever landed even when a
+    # later impl wedges), so a window only long enough for one compile
+    # still leaves a committed same-round TPU headline.
     records = []
     for impl in ("pallas", "packed"):
         try:
@@ -54,19 +57,18 @@ def main() -> int:
             continue
         records.append(rec)
         print(json.dumps(rec), flush=True)
+        entry = {
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "headline": headline_record(records),
+            "records": list(records),
+            "note": f"quick_headline (first-window fast capture, {impl})",
+        }
+        if not os.environ.get("MCIM_NO_HISTORY"):
+            with open(os.path.join(REPO, "BENCH_HISTORY.jsonl"), "a") as f:
+                f.write(json.dumps(entry) + "\n")
     if not records:
         return 4
-    headline = headline_record(records)
-    entry = {
-        "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-        "headline": headline,
-        "records": records,
-        "note": "quick_headline (first-window fast capture)",
-    }
-    if not os.environ.get("MCIM_NO_HISTORY"):
-        with open(os.path.join(REPO, "BENCH_HISTORY.jsonl"), "a") as f:
-            f.write(json.dumps(entry) + "\n")
-    print(json.dumps(headline), flush=True)
+    print(json.dumps(headline_record(records)), flush=True)
     return 0
 
 

@@ -1,8 +1,13 @@
 #!/bin/bash
 # First-window fast capture: one TPU headline record into BENCH_HISTORY.jsonl.
+# The history commit runs even when the python step fails partway (a wedge
+# after the first impl's measurement must not strand a committed-worthy
+# same-round record on disk); the step's own success still gates .done.
 set -u
 cd "$(dirname "$0")/../.."
 . tools/tpu_queue/_lib.sh
-timeout 1200 python tools/quick_headline.py > quick_headline_r03.out 2>&1 || exit $?
+timeout 2100 python tools/quick_headline.py > quick_headline_r03.out 2>&1
+rc=$?
 commit_artifacts "TPU window: same-round headline record (quick capture)" \
   BENCH_HISTORY.jsonl quick_headline_r03.out
+exit $rc
