@@ -263,6 +263,24 @@ def test_bench_main_promotes_same_round_record(monkeypatch, capsys):
     assert out["measured_ts"] == "2026-07-30T18:00:00Z"
 
 
+def test_bench_main_promotion_appends_no_history(monkeypatch, capsys):
+    """Re-emitting a committed record must not duplicate it in history."""
+    mod = _load_bench_module()
+    monkeypatch.setattr(mod, "_probe_with_backoff", lambda schedule: None)
+    monkeypatch.setattr(
+        mod,
+        "_same_round_tpu_headline",
+        lambda: {"ts": "2026-07-30T18:00:00Z", "headline": {"value": 1.0}},
+    )
+    appended = []
+    monkeypatch.setattr(
+        mod, "_append_history", lambda *a, **k: appended.append(a)
+    )
+    assert mod.main() == 0
+    capsys.readouterr()
+    assert appended == []
+
+
 def test_xla_bridge_probe_api_exists():
     """utils.platform._backends_initialized probes jax internals and fails
     open; if a jax upgrade removes BOTH probe points the count-change guard
