@@ -93,12 +93,22 @@ def main() -> int:
         emit(f"prod_{backend}", device_throughput(fn, [rgb]))
 
     # c: prototype packed path (pack once outside the timed region — the
-    # zero-bitcast-cost bound for the packed production kernels)
-    planes = [pack_u8(rgb[..., c]) for c in range(3)]
-    packed_fn = jax.jit(packed_gray_contrast)
-    got = np.asarray(unpack_u32(packed_fn(*planes).astype(jnp.uint32)))
-    assert np.array_equal(got, golden), "packed mismatch"
-    emit("packed_u32", device_throughput(packed_fn, list(planes)))
+    # zero-bitcast-cost bound for the packed production kernels). The
+    # prototype kernel is whole-image (no grid), so at large H,W it can
+    # exceed the scoped-VMEM stack on a real chip even though it
+    # interprets fine; it is only a bound, so a failure here must not
+    # abort the decisive interleaved 8K A/B below.
+    try:
+        planes = [pack_u8(rgb[..., c]) for c in range(3)]
+        packed_fn = jax.jit(packed_gray_contrast)
+        got = np.asarray(unpack_u32(packed_fn(*planes).astype(jnp.uint32)))
+        assert np.array_equal(got, golden), "packed mismatch"
+        emit("packed_u32", device_throughput(packed_fn, list(planes)))
+    except Exception as e:  # noqa: BLE001 — recorded, not fatal
+        print(
+            json.dumps({"case": "packed_u32", "error": str(e)[:300]}),
+            flush=True,
+        )
 
     # d: the headline workload itself, production u8 vs production packed,
     # same process, interleaved twice (the tunnel's cross-process variance
