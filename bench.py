@@ -171,6 +171,8 @@ def _headline(records: list[dict]) -> dict | None:
     if "roofline_frac" in best:
         rec["roofline_frac"] = round(best["roofline_frac"], 4)
         rec["tpu_gen"] = best.get("tpu_gen")
+    if "elem_ceiling_frac" in best:
+        rec["elem_ceiling_frac"] = round(best["elem_ceiling_frac"], 4)
     if "last_tpu_record" in best:
         rec["last_tpu_record"] = best["last_tpu_record"]
     return rec

@@ -37,6 +37,18 @@ HEADLINE = "gaussian5_8k"
 # the image per fused group; ops/pallas_kernels.py module comment).
 HBM_GB_S = {"v4": 1228.0, "v5e": 819.0, "v5p": 2765.0, "v6e": 1640.0}
 
+# Measured element-rate ceiling, giga-elements/s — the *achievable* roofline
+# denominator for u8 streaming on this chip, alongside the datasheet byte
+# roofline above. Round-3 probe (roofline_r03.out, real v5e): an f32 Pallas
+# streaming copy sustains 402.7 GB/s = 100.7 Ge/s while the u8 copy of the
+# same pixels caps at ~75 GB/s = 75 Ge/s in the same window — byte rate is
+# not the binding limit for u8 streams, element (load/store lane) rate is.
+# The headline u8 kernel itself sustained 94.9 Ge/s in round 1's healthy
+# window, i.e. ~95% of this ceiling. Only v5e has been measured; other gens
+# get no elem_ceiling_frac until a probe runs there (single-generation
+# calibration caveat, docs/measurement.md).
+ELEM_G_S_MEASURED = {"v5e": 100.7}
+
 
 @dataclasses.dataclass(frozen=True)
 class BenchConfig:
@@ -154,6 +166,14 @@ def run_config(cfg: BenchConfig, impl: str) -> dict:
         gen = _tpu_gen()
         rec["tpu_gen"] = gen
         rec["roofline_frac"] = gb_s / HBM_GB_S.get(gen, HBM_GB_S["v5e"])
+        # the traffic model counts u8 planes, so modeled bytes == modeled
+        # elements and gb_s doubles as giga-elements/s against the measured
+        # element-rate ceiling — but only for impls that stream u8 elements;
+        # the packed impl moves the same bytes as u32 words (1/4 the
+        # elements), so the equivalence breaks there and the field is
+        # omitted rather than overstated 4x
+        if gen in ELEM_G_S_MEASURED and impl != "packed":
+            rec["elem_ceiling_frac"] = gb_s / ELEM_G_S_MEASURED[gen]
     return rec
 
 
@@ -230,6 +250,8 @@ def headline_record(records: list[dict]) -> dict | None:
     if "roofline_frac" in best:
         rec["roofline_frac"] = round(best["roofline_frac"], 4)
         rec["tpu_gen"] = best.get("tpu_gen")
+    if "elem_ceiling_frac" in best:
+        rec["elem_ceiling_frac"] = round(best["elem_ceiling_frac"], 4)
     return rec
 
 

@@ -350,6 +350,32 @@ def test_bench_orchestrator_mirrors_suite_constants():
     assert not any(m.startswith("mpi_cuda_") for m in top_imports if m)
 
 
+def test_headline_record_carries_elem_ceiling_frac():
+    """TPU records gain the measured element-rate roofline (round-3 probe:
+    u8 streams are element-rate-capped, not byte-rate-capped), and the
+    headline promotion preserves it."""
+    from mpi_cuda_imagemanipulation_tpu import bench_suite
+
+    assert "v5e" in bench_suite.ELEM_G_S_MEASURED
+    rec = bench_suite.headline_record(
+        [
+            {
+                "config": "gaussian5_8k",
+                "impl": "pallas",
+                "chips": 1,
+                "platform": "tpu",
+                "mp_per_s_per_chip": 47468.2,
+                "roofline_frac": 0.1159,
+                "tpu_gen": "v5e",
+                "elem_ceiling_frac": 0.9427,
+            }
+        ]
+    )
+    assert rec is not None
+    assert rec["elem_ceiling_frac"] == 0.9427
+    assert rec["roofline_frac"] == 0.1159
+
+
 def test_bench_worker_single_config_json():
     """The per-config subprocess worker prints exactly one JSON record."""
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
