@@ -169,10 +169,18 @@ def run_config(cfg: BenchConfig, impl: str) -> dict:
         # the traffic model counts u8 planes, so modeled bytes == modeled
         # elements and gb_s doubles as giga-elements/s against the measured
         # element-rate ceiling — but only for impls that stream u8 elements;
-        # the packed impl moves the same bytes as u32 words (1/4 the
-        # elements), so the equivalence breaks there and the field is
-        # omitted rather than overstated 4x
-        if gen in ELEM_G_S_MEASURED and impl != "packed":
+        # the packed impl (and auto under MCIM_PREFER_PACKED, which routes
+        # eligible groups through the packed kernels) moves the same bytes
+        # as u32 words (1/4 the elements), so the equivalence breaks there
+        # and the field is omitted rather than overstated 4x
+        from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
+            prefer_packed,
+        )
+
+        streams_u8 = impl != "packed" and not (
+            impl == "auto" and prefer_packed()
+        )
+        if gen in ELEM_G_S_MEASURED and streams_u8:
             rec["elem_ceiling_frac"] = gb_s / ELEM_G_S_MEASURED[gen]
     return rec
 

@@ -27,6 +27,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
+    ap.add_argument(
+        "--rounds",
+        type=int,
+        default=3,
+        help="measure every case this many times in round-robin order and "
+        "report per-case bests — cross-case comparisons on the shared "
+        "tunneled chip are otherwise contaminated by multi-second "
+        "other-tenant load drifts (observed 4.7x swings between adjacent "
+        "single-shot cases in round 3's first window)",
+    )
     args = ap.parse_args()
 
     import jax
@@ -50,6 +60,15 @@ def main() -> int:
     def emit(rec):
         print(json.dumps(rec), flush=True)
 
+    # Registration: every case is built (compiled lazily on first call) up
+    # front, then ALL cases are measured --rounds times in round-robin
+    # order with per-case bests reported at the end. Keys starting with
+    # "_" are measurement parameters, not record fields.
+    cases: list[tuple[dict, object, list]] = []
+
+    def register(base, fn, fn_args):
+        cases.append((base, fn, fn_args))
+
     def copy_call(dtype, bh, width=None):
         w = W if width is None else width
 
@@ -72,8 +91,7 @@ def main() -> int:
     # a) XLA's own device copy (copy = x + 0 defeats aliasing elision)
     for name, arr, bpe in (("xla_copy_u8", img_u8, 1), ("xla_copy_f32", img_f32, 4)):
         f = jax.jit(lambda x: x + jnp.zeros((), x.dtype))
-        sec = device_throughput(f, [arr])
-        emit({"case": name, "ms": sec * 1e3, "gb_s": 2 * H * W * bpe / sec / 1e9})
+        register({"case": name, "_nbytes": 2 * H * W * bpe}, f, [arr])
 
     # packed view: the same bytes as img_u8 but 1/4 the elements — if the
     # u8 cap is element-rate (not byte-rate), the u32 copy moves the image
@@ -92,13 +110,8 @@ def main() -> int:
         arr = img_u32 if dtype == jnp.uint32 else (img_u8 if bpe == 1 else img_f32)
         nbytes = 2 * arr.size * arr.dtype.itemsize  # one read + one write
         for bh in bhs:
-            try:
-                f = jax.jit(copy_call(dtype, bh, width=arr.shape[1]))
-                sec = device_throughput(f, [arr])
-                emit({"case": name, "block_h": bh, "ms": sec * 1e3,
-                      "gb_s": nbytes / sec / 1e9})
-            except Exception as e:
-                emit({"case": name, "block_h": bh, "error": str(e)[:200]})
+            f = jax.jit(copy_call(dtype, bh, width=arr.shape[1]))
+            register({"case": name, "block_h": bh, "_nbytes": nbytes}, f, [arr])
 
     # d) lagged copy through VMEM scratch: the streaming kernels' exact
     # grid/dependency structure (out block j written at step j+1 from a
@@ -136,14 +149,12 @@ def main() -> int:
         )
 
     for bh in bhs[:2]:
-        try:
-            f = jax.jit(lambda x, bh=bh: lagged_copy_call(bh)(x)[:H])
-            sec = device_throughput(f, [img_u8])
-            emit({"case": "pallas_lagged_copy_u8", "block_h": bh,
-                  "ms": sec * 1e3, "gb_s": 2 * H * W / sec / 1e9})
-        except Exception as e:
-            emit({"case": "pallas_lagged_copy_u8", "block_h": bh,
-                  "error": str(e)[:200]})
+        f = jax.jit(lambda x, bh=bh: lagged_copy_call(bh)(x)[:H])
+        register(
+            {"case": "pallas_lagged_copy_u8", "block_h": bh,
+             "_nbytes": 2 * H * W},
+            f, [img_u8],
+        )
 
     # e) the XLA-level u8<->u32 bitcast views the packed production path
     # uses at group boundaries (ops/packed_kernels.pack_words): on TPU the
@@ -163,12 +174,7 @@ def main() -> int:
             jax.jit(pack_words)(img_u8),
         ),
     ):
-        try:
-            sec = device_throughput(f, [arg])
-            emit({"case": name, "ms": sec * 1e3,
-                  "gb_s": 2 * H * W / sec / 1e9})
-        except Exception as e:
-            emit({"case": name, "error": str(e)[:200]})
+        register({"case": name, "_nbytes": 2 * H * W}, f, [arg])
 
     # f) in-kernel pltpu.bitcast (sublane repack, HBM stays u8): if the u8
     # cap is the vector load/store path rather than the DMA, a kernel that
@@ -212,24 +218,57 @@ def main() -> int:
         for bh in (128,):
             try:
                 arg = arg_builder()
-                f = jax.jit(make(bh))
-                sec = device_throughput(f, [arg])
-                emit({"case": name, "block_h": bh, "ms": sec * 1e3,
-                      "gb_s": 2 * H * W / sec / 1e9})
             except Exception as e:
                 emit({"case": name, "block_h": bh, "error": str(e)[:200]})
+                continue
+            register(
+                {"case": name, "block_h": bh, "_nbytes": 2 * H * W},
+                jax.jit(make(bh)), [arg],
+            )
 
     # g) the headline kernel in the same process/chip state, u8 and packed
     ops = make_pipeline_ops("gaussian:5")
     for name, packed in (("gaussian5_8k_pallas", False),
                          ("gaussian5_8k_packed", True)):
-        try:
-            f = jax.jit(lambda x, p=packed: pipeline_pallas(ops, x, packed=p))
-            sec = device_throughput(f, [img_u8])
-            emit({"case": name, "ms": sec * 1e3,
-                  "mp_s": H * W / 1e6 / sec, "gb_s": 2 * H * W / sec / 1e9})
-        except Exception as e:
-            emit({"case": name, "error": str(e)[:200]})
+        f = jax.jit(lambda x, p=packed: pipeline_pallas(ops, x, packed=p))
+        register(
+            {"case": name, "_nbytes": 2 * H * W, "_mp": H * W},
+            f, [img_u8],
+        )
+
+    # measurement: round-robin over every registered case so each case
+    # samples the chip across the full probe duration; a case is skipped
+    # for the rest of the run only after two failures (a compile error is
+    # persistent, but a transient tunnel hiccup deserves a free retry next
+    # round — losing a case loses a cross-case comparison, the probe's
+    # whole point). Per-case best (min time — the right statistic under
+    # other-tenant contention, each sample already being a
+    # median-of-slopes) is emitted at the end.
+    best: dict[tuple, tuple[float, dict]] = {}
+    failures: dict[tuple, int] = {}
+    for rnd in range(1, max(1, args.rounds) + 1):
+        for base, fn, fn_args in cases:
+            key = (base["case"], base.get("block_h"))
+            if failures.get(key, 0) >= 2:
+                continue
+            pub = {k: v for k, v in base.items() if not k.startswith("_")}
+            try:
+                sec = device_throughput(fn, fn_args)
+            except Exception as e:
+                failures[key] = failures.get(key, 0) + 1
+                emit({**pub, "round": rnd, "error": str(e)[:200]})
+                continue
+            rec = {**pub, "round": rnd, "ms": sec * 1e3,
+                   "gb_s": base["_nbytes"] / sec / 1e9}
+            if "_mp" in base:
+                rec["mp_s"] = base["_mp"] / 1e6 / sec
+            emit(rec)
+            if key not in best or sec < best[key][0]:
+                best[key] = (sec, rec)
+    for sec, rec in best.values():
+        summary = {k: v for k, v in rec.items() if k != "round"}
+        summary["stat"] = f"best_of_{max(1, args.rounds)}_rounds"
+        emit(summary)
     return 0
 
 

@@ -1,0 +1,14 @@
+#!/bin/bash
+# Re-run the roofline probe in round-robin mode (3 rounds, per-case bests):
+# the first window's single-shot run showed 4.7x cross-case drift from
+# other-tenant load, which is exactly the axis the probe exists to compare.
+# Artifacts commit even on a timeout/wedge partway through — the streamed
+# per-round records already on disk are a window's worth of evidence.
+set -u
+cd "$(dirname "$0")/../.."
+. tools/tpu_queue/_lib.sh
+timeout 3600 python tools/roofline_probe.py --rounds 3 > roofline_rr_r03.out 2>&1
+rc=$?
+commit_artifacts "TPU window: round-robin roofline probe (per-case bests)" \
+  roofline_rr_r03.out
+exit $rc
