@@ -94,10 +94,10 @@ def main() -> int:
 
     # c: prototype packed path (pack once outside the timed region — the
     # zero-bitcast-cost bound for the packed production kernels). The
-    # prototype kernel is whole-image (no grid), so at large H,W it can
-    # exceed the scoped-VMEM stack on a real chip even though it
-    # interprets fine; it is only a bound, so a failure here must not
-    # abort the decisive interleaved 8K A/B below.
+    # kernel is row-block-gridded since the whole-image form OOMed scoped
+    # VMEM on a real v5e; a failure here is now a real signal, but it is
+    # still only a bound, so it must not abort the decisive interleaved
+    # 8K A/B below.
     try:
         planes = [pack_u8(rgb[..., c]) for c in range(3)]
         packed_fn = jax.jit(packed_gray_contrast)

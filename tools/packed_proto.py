@@ -138,10 +138,18 @@ def packed_gray_contrast_kernel(r_ref, g_ref, b_ref, out_ref):
     out_ref[:] = _pack_lanes_i32(outs)
 
 
-def packed_gray_contrast(r, g, b, *, interpret=False):
+def packed_gray_contrast(r, g, b, *, interpret=False, block_h=128):
+    """Row-blocked grid: the whole-image form OOMed the 16 MiB scoped-VMEM
+    stack on a real v5e at 2160x960 words (~101 MiB of f32 lane temps);
+    a (block_h, Wp) block keeps the temp footprint a few MiB."""
     H, Wp = r.shape
+    bh = min(block_h, H)
+    spec = pl.BlockSpec((bh, Wp), lambda i: (i, 0))
     call = pl.pallas_call(
         packed_gray_contrast_kernel,
+        grid=(-(-H // bh),),
+        in_specs=[spec, spec, spec],
+        out_specs=spec,
         out_shape=jax.ShapeDtypeStruct((H, Wp), I32),
         interpret=interpret,
     )
@@ -183,11 +191,15 @@ def _selftest() -> int:
         np.asarray(repacked.astype(jnp.uint32)), np.asarray(packed)
     )
 
-    # packed grayscale+contrast vs the golden pipeline
+    # packed grayscale+contrast vs the golden pipeline — block_h=24 forces
+    # a multi-step grid WITH a ragged trailing block (64 = 2*24 + 16), the
+    # row-blocked path the production-size TPU run takes (H=2160, bh=128
+    # is also ragged); the default whole-image degenerate case (bh=min(128,
+    # 64)=64, grid=1) is covered by packed_ab.py's cpu-validation path
     pipe = Pipeline.parse("grayscale,contrast:3.5")
     golden = np.asarray(pipe(jnp.asarray(rgb)))
     out_packed = packed_gray_contrast(
-        pack_u8(r8), pack_u8(g8), pack_u8(b8), interpret=True
+        pack_u8(r8), pack_u8(g8), pack_u8(b8), interpret=True, block_h=24
     )
     got = np.asarray(unpack_u32(out_packed.astype(jnp.uint32)))
     assert np.array_equal(got, golden), (
