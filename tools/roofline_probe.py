@@ -115,6 +115,28 @@ def main() -> int:
             f = jax.jit(copy_call(dtype, bh, width=arr.shape[1]))
             register({"case": name, "block_h": bh, "_nbytes": nbytes}, f, [arr])
 
+    # b2) discriminators for the first window's anomaly (roofline_r03.out:
+    # u32_packed copy hit ~120 GB/s while f32 hit ~403 GB/s — both 4-byte
+    # dtypes). Two confounds differ between those cases: element count
+    # (packed is 4x smaller, so fixed dispatch/DMA-ramp overhead weighs 4x
+    # more) and integer-vs-float dtype. Separate them:
+    #   - u32 copy at FULL element count (H x W u32): same elements as the
+    #     f32 case; if it matches f32's GB/s, int32 tiles stream fine and
+    #     the packed case was overhead-dominated.
+    #   - f32 copy at the PACKED shape (H x W/4): same size as the packed
+    #     case; if it also drops to ~120 GB/s, small-array overhead (not
+    #     dtype) explains the anomaly and the packed ceiling estimate must
+    #     come from larger inputs.
+    img_u32_full = img_u8.astype(jnp.uint32)
+    img_f32_small = img_f32[:, : W // 4]
+    for name, arr in (
+        ("pallas_copy_u32_fullelems", img_u32_full),
+        ("pallas_copy_f32_packedsize", img_f32_small),
+    ):
+        nbytes = 2 * arr.size * arr.dtype.itemsize
+        f = jax.jit(copy_call(arr.dtype, 128, width=arr.shape[1]))
+        register({"case": name, "block_h": 128, "_nbytes": nbytes}, f, [arr])
+
     # d) lagged copy through VMEM scratch: the streaming kernels' exact
     # grid/dependency structure (out block j written at step j+1 from a
     # scratch carried across steps) with zero stencil compute — isolates
