@@ -171,6 +171,43 @@ def _build_parser() -> argparse.ArgumentParser:
         "--json-metrics", default=None, help="write the record ('-' = stdout)"
     )
 
+    tune = sub.add_parser(
+        "autotune",
+        help="measure the fastest Pallas block height on the live backend "
+        "and record it in the calibration store (the measured replacement "
+        "for the reference's hand-tuned compile-time BLOCK_SIZE, "
+        "kernel.cu:13; see utils/calibration.py)",
+    )
+    tune.add_argument(
+        "--ops",
+        default="gaussian:5",
+        help="pipeline to tune against (default: the headline 5x5 Gaussian)",
+    )
+    tune.add_argument(
+        "--impl", choices=("pallas", "packed"), default="pallas"
+    )
+    tune.add_argument("--height", type=int, default=4320)
+    tune.add_argument("--width", type=int, default=7680)
+    tune.add_argument(
+        "--blocks",
+        default="64,128,192,256,384,512",
+        help="comma-separated candidate block heights; candidates above "
+        "the VMEM-safe heuristic are skipped",
+    )
+    tune.add_argument("--device", default=None)
+    tune.add_argument(
+        "--calib-file",
+        default=None,
+        help="calibration store path (default: $MCIM_CALIB_FILE or "
+        "./.mcim_calibration.json)",
+    )
+    tune.add_argument(
+        "--dry-run",
+        action="store_true",
+        help="measure and print, but do not write the calibration store",
+    )
+    tune.add_argument("--json-metrics", default=None)
+
     info = sub.add_parser("info", help="print device/mesh/version info")
     info.add_argument(
         "--device",
@@ -548,6 +585,131 @@ def cmd_diff(args: argparse.Namespace) -> int:
     return 0 if ndiff == 0 else 1
 
 
+def cmd_autotune(args: argparse.Namespace) -> int:
+    """Sweep candidate block heights on the live backend; record the best.
+
+    Runs with lookups disabled (MCIM_NO_CALIB) so an existing calibration
+    cannot steer the sweep it is about to overwrite.
+    """
+    _configure_platform(args.device)
+    # parse/validate ALL candidates before any expensive measurement: a
+    # malformed trailing token must not discard minutes of serialized
+    # chip-window work (review finding)
+    try:
+        candidates = [int(tok) for tok in args.blocks.split(",") if tok.strip()]
+    except ValueError:
+        raise ValueError(f"--blocks must be comma-separated ints: {args.blocks!r}")
+    if not candidates:
+        raise ValueError("--blocks is empty")
+    # the sweep must not leak env mutations: a caller's kill-switch or store
+    # path stays exactly as it was on return (review finding)
+    saved = {
+        k: os.environ.get(k) for k in ("MCIM_CALIB_FILE", "MCIM_NO_CALIB")
+    }
+    if args.calib_file:
+        os.environ["MCIM_CALIB_FILE"] = args.calib_file
+    os.environ["MCIM_NO_CALIB"] = "1"
+    try:
+        import jax
+
+        from mpi_cuda_imagemanipulation_tpu.io.image import synthetic_image
+        from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
+            _live_f32_temps,
+            _pick_block_h,
+            group_ops,
+            pipeline_pallas,
+        )
+        from mpi_cuda_imagemanipulation_tpu.ops.registry import (
+            make_pipeline_ops,
+        )
+        from mpi_cuda_imagemanipulation_tpu.utils import calibration
+        from mpi_cuda_imagemanipulation_tpu.utils.log import emit_json_metrics
+        from mpi_cuda_imagemanipulation_tpu.utils.timing import (
+            device_throughput,
+        )
+
+        ops = make_pipeline_ops(args.ops)
+        # the recorded calibration is applied through min(heuristic, calib),
+        # so any candidate above the heuristic cap for this sweep's config
+        # could never take effect at run time — measuring it would waste
+        # serialized chip time and could "win" a value the min rule then
+        # ignores (review finding). Cap = the tightest per-group heuristic.
+        cap = min(
+            _pick_block_h(
+                args.width,
+                1,
+                1,
+                stencil.halo if stencil is not None else 0,
+                _live_f32_temps(stencil),
+            )
+            for _pw, stencil in group_ops(ops)
+        )
+        img = jax.numpy.asarray(
+            synthetic_image(args.height, args.width, channels=1, seed=7)
+        )
+        kind = calibration.current_device_kind()
+        packed = args.impl == "packed"
+        results = []
+        for bh in candidates:
+            if bh < 32 or bh % 32:
+                print(f"block {bh}: skipped (must be a multiple of 32, >=32)")
+                continue
+            if bh > cap:
+                print(f"block {bh}: skipped (above the VMEM heuristic cap {cap})")
+                continue
+            fn = jax.jit(
+                lambda x, b=bh: pipeline_pallas(ops, x, block_h=b, packed=packed)
+            )
+            try:
+                sec = device_throughput(fn, [img])
+            except Exception as e:  # Mosaic OOM on too-tall blocks, etc.
+                print(f"block {bh}: failed ({str(e)[:120]})")
+                continue
+            mp_s = args.height * args.width / 1e6 / sec
+            results.append((sec, bh, mp_s))
+            print(f"block {bh}: {sec * 1e3:.3f} ms/iter  {mp_s:,.0f} MP/s")
+        if not results:
+            print("error: no candidate block height ran", file=sys.stderr)
+            return 1
+        sec, best_bh, mp_s = min(results)
+        rec = {
+            "event": "autotune",
+            "device_kind": kind,
+            "backend": jax.default_backend(),
+            "pipeline": args.ops,
+            "impl": args.impl,
+            "height": args.height,
+            "width": args.width,
+            "block_h": best_bh,
+            "ms_per_iter": sec * 1e3,
+            "mp_per_s": mp_s,
+        }
+        if args.dry_run:
+            print(f"best block {best_bh} (dry run; store not written)")
+        else:
+            path = calibration.record_block_h(
+                kind,
+                best_bh,
+                pipeline=args.ops,
+                impl=args.impl,
+                width=args.width,
+                mp_per_s=round(mp_s, 1),
+            )
+            rec["calib_file"] = path
+            print(f"best block {best_bh} -> {path} [{kind}]")
+        if args.json_metrics:
+            emit_json_metrics(
+                rec, None if args.json_metrics == "-" else args.json_metrics
+            )
+        return 0
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def cmd_info(args: argparse.Namespace) -> int:
     _configure_platform(args.device)
     import jax
@@ -576,6 +738,7 @@ def main(argv: list[str] | None = None) -> int:
         "batch": cmd_batch,
         "bench": cmd_bench,
         "diff": cmd_diff,
+        "autotune": cmd_autotune,
         "info": cmd_info,
     }[args.cmd]
     try:

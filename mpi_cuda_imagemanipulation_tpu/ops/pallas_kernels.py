@@ -52,6 +52,7 @@ from mpi_cuda_imagemanipulation_tpu.ops.spec import (
     exact_f32,
     window_reduce_1d,
 )
+from mpi_cuda_imagemanipulation_tpu.utils import calibration
 
 # --------------------------------------------------------------------------
 # Pipeline grouping: [pointwise*, stencil?] units, one pallas_call each
@@ -596,7 +597,15 @@ def _pick_block_h(
     per_row = (width + 2 * halo) * (4 * n_in + 8 * n_out + 4 * live_f32 * n_live)
     bh = budget // max(per_row, 1)
     bh = int(max(32, min(512, bh)))
-    return (bh // 32) * 32
+    bh = (bh // 32) * 32
+    # a measured `autotune` calibration may shrink (never grow) the block:
+    # min() keeps the VMEM working-set model authoritative for safety while
+    # letting on-device measurement pick the faster height within it
+    # (utils/calibration.py; disabled via MCIM_NO_CALIB for A/B tools)
+    calibrated = calibration.lookup_block_h()
+    if calibrated is not None:
+        bh = max(32, min(bh, (calibrated // 32) * 32))
+    return bh
 
 
 def run_group(

@@ -1,0 +1,131 @@
+"""Measured per-device-kind calibration store (the `autotune` subcommand).
+
+The streaming kernels' block-height heuristic (ops/pallas_kernels._pick_block_h)
+and the VMEM budget behind it are calibrated on TPU v5e — the one generation
+this framework has had silicon access to (see BASELINE.md's single-generation
+caveat). On any other generation the heuristic still produces a *safe* block
+height (the VMEM working-set model is conservative), but not necessarily the
+*fastest* one: round-2 on-chip sweeps moved the headline ±8% across block
+heights, and other gens have different VMEM sizes and DMA sweet spots.
+
+This module closes that gap with measurement instead of more constants:
+
+  ``mcim-tpu autotune`` sweeps block heights for a representative pipeline on
+  whatever backend is live, and records the fastest one here, keyed by the
+  device kind string (e.g. ``"TPU v5 lite"``). ``_pick_block_h`` then clamps
+  its heuristic to the calibrated value: ``min(heuristic, calibrated)``. The
+  min rule keeps the contract one-sided — a calibration can only *shrink* the
+  block below the VMEM-safe heuristic, never push it past the working-set
+  model into a Mosaic OOM, so a stale or cross-width calibration degrades
+  performance at worst, not correctness.
+
+The store is a single JSON file. Resolution order for its path:
+``$MCIM_CALIB_FILE`` if set, else ``.mcim_calibration.json`` in the current
+working directory (a cwd-local dotfile keeps the framework from writing
+outside the project tree; a deployment that wants a shared store points the
+env var somewhere durable). ``MCIM_NO_CALIB=1`` disables lookups entirely —
+measurement tools (tools/roofline_probe.py sweeps block heights explicitly)
+use it so a committed calibration can never contaminate an A/B.
+
+The reference has no analogue: its BLOCK_SIZE is a compile-time constant
+(kernel.cu:13) tuned by hand for one GPU.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+
+_ENV_FILE = "MCIM_CALIB_FILE"
+_ENV_DISABLE = "MCIM_NO_CALIB"
+_DEFAULT_NAME = ".mcim_calibration.json"
+
+# process-level cache: (path, mtime_ns) -> parsed dict. Lookup happens on
+# every pallas_call build, so re-reading the file each time would put disk
+# I/O on the trace path; the mtime key keeps a same-process autotune->run
+# sequence coherent without an explicit invalidation hook.
+_cache: dict = {"key": None, "data": None}
+
+
+def calib_path() -> str:
+    return os.environ.get(_ENV_FILE) or os.path.join(os.getcwd(), _DEFAULT_NAME)
+
+
+def _load() -> dict:
+    path = calib_path()
+    try:
+        st = os.stat(path)
+        key = (path, st.st_mtime_ns)
+    except OSError:
+        return {}
+    if _cache["key"] == key:
+        return _cache["data"]
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+        if not isinstance(data, dict):
+            data = {}
+    except (OSError, ValueError):
+        # a corrupt store must never break a run; autotune rewrites it whole
+        data = {}
+    _cache["key"] = key
+    _cache["data"] = data
+    return data
+
+
+def current_device_kind() -> str:
+    """Device-kind key for the live backend (initializes it if needed).
+
+    Callers sit on the run path (a dispatch is imminent), so touching the
+    backend here is safe — unlike pipeline *parse*, which must stay host-pure
+    (advisor round-2 finding on the contrast LUT).
+    """
+    import jax
+
+    return jax.devices()[0].device_kind
+
+
+def lookup_block_h(device_kind: str | None = None) -> int | None:
+    """Calibrated preferred block height for this device kind, if any."""
+    if os.environ.get(_ENV_DISABLE):
+        return None
+    entries = _load().get("device_kinds")
+    if not isinstance(entries, dict):
+        return None
+    if device_kind is None:
+        try:
+            device_kind = current_device_kind()
+        except Exception:
+            return None
+    rec = entries.get(device_kind)
+    if not isinstance(rec, dict):
+        return None
+    bh = rec.get("block_h")
+    if isinstance(bh, int) and 32 <= bh <= 4096:
+        return bh
+    return None
+
+
+def record_block_h(device_kind: str, block_h: int, **extra) -> str:
+    """Write/replace this device kind's calibration entry; returns the path.
+
+    Atomic (tmp file + rename) so a concurrent reader never sees a torn
+    JSON; other kinds' entries are preserved.
+    """
+    path = calib_path()
+    data = _load()
+    kinds = data.setdefault("device_kinds", {})
+    kinds[device_kind] = {"block_h": int(block_h), **extra}
+    d = os.path.dirname(path) or "."
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".mcim_calib_")
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            json.dump(data, f, indent=2, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    _cache["key"] = None  # force re-read (mtime granularity is ns, but be sure)
+    return path

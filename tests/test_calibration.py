@@ -1,0 +1,201 @@
+"""Calibration store + autotune subcommand (utils/calibration.py, cli.py).
+
+The store replaces the reference's hand-tuned compile-time BLOCK_SIZE
+(kernel.cu:13) with per-device-kind measurement; these tests cover the
+store's contract (round-trip, corruption, kill-switch, atomicity of intent)
+and the one-sided min rule in _pick_block_h — a calibration may shrink the
+block height below the VMEM-safe heuristic but can never push past it.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from mpi_cuda_imagemanipulation_tpu.cli import main
+from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import _pick_block_h
+from mpi_cuda_imagemanipulation_tpu.utils import calibration
+
+
+@pytest.fixture()
+def calib_file(tmp_path, monkeypatch):
+    path = tmp_path / "calib.json"
+    monkeypatch.setenv("MCIM_CALIB_FILE", str(path))
+    monkeypatch.delenv("MCIM_NO_CALIB", raising=False)
+    calibration._cache["key"] = None
+    yield path
+    calibration._cache["key"] = None
+
+
+def test_record_lookup_roundtrip(calib_file):
+    p = calibration.record_block_h("TPU v5 lite", 256, mp_per_s=47000.0)
+    assert p == str(calib_file)
+    assert calibration.lookup_block_h("TPU v5 lite") == 256
+    # other kinds are preserved on rewrite
+    calibration.record_block_h("cpu", 64)
+    assert calibration.lookup_block_h("TPU v5 lite") == 256
+    assert calibration.lookup_block_h("cpu") == 64
+    data = json.loads(calib_file.read_text())
+    assert data["device_kinds"]["TPU v5 lite"]["mp_per_s"] == 47000.0
+
+
+def test_lookup_missing_and_corrupt(calib_file):
+    assert calibration.lookup_block_h("cpu") is None  # no file yet
+    calib_file.write_text("{not json")
+    calibration._cache["key"] = None
+    assert calibration.lookup_block_h("cpu") is None  # corrupt -> ignored
+    # record over a corrupt store still works (rewrites whole)
+    calibration.record_block_h("cpu", 96)
+    assert calibration.lookup_block_h("cpu") == 96
+
+
+def test_kill_switch_and_bounds(calib_file, monkeypatch):
+    calibration.record_block_h("cpu", 128)
+    monkeypatch.setenv("MCIM_NO_CALIB", "1")
+    assert calibration.lookup_block_h("cpu") is None
+    monkeypatch.delenv("MCIM_NO_CALIB")
+    assert calibration.lookup_block_h("cpu") == 128
+    # out-of-range stored values are rejected, not clamped
+    calibration.record_block_h("cpu", 8)
+    assert calibration.lookup_block_h("cpu") is None
+
+
+def test_pick_block_h_min_rule(calib_file, monkeypatch):
+    # pin the kind: on a host with an accelerator visible, the live
+    # backend's device_kind would not be 'cpu' (review finding)
+    monkeypatch.setattr(calibration, "current_device_kind", lambda: "cpu")
+    # uncalibrated heuristic for a narrow image is large
+    base = _pick_block_h(1024, 1, 1, 2)
+    assert base >= 256
+    # a smaller calibrated height wins (device kind 'cpu' under the test rig)
+    calibration.record_block_h("cpu", 64)
+    assert _pick_block_h(1024, 1, 1, 2) == 64
+    # a LARGER calibrated height must NOT override the VMEM-safe heuristic:
+    # pick a wide image whose heuristic is small
+    calibration.record_block_h("cpu", 512)
+    wide = _pick_block_h(200_000, 3, 3, 2)
+    assert wide == _pick_block_h_uncalibrated(200_000)
+
+
+def _pick_block_h_uncalibrated(width):
+    import os
+
+    os.environ["MCIM_NO_CALIB"] = "1"
+    try:
+        return _pick_block_h(width, 3, 3, 2)
+    finally:
+        del os.environ["MCIM_NO_CALIB"]
+
+
+def test_autotune_cli_writes_store(calib_file, monkeypatch, capsys):
+    """End-to-end `autotune` on the CPU backend with a stubbed timer (the
+    real device_throughput runs hundreds of iterations; the CLI logic —
+    sweep, skip, best-pick, store write, JSON line — is what's under test).
+    """
+    from mpi_cuda_imagemanipulation_tpu.utils import timing
+
+    def fake_throughput(fn, fn_args, **kw):
+        out = fn(*fn_args)  # still executes the real kernel once
+        out.block_until_ready()
+        # deterministic: pretend taller blocks are slower so 32 wins
+        fake_throughput.calls += 1
+        return 0.001 * fake_throughput.calls
+
+    fake_throughput.calls = 0
+    monkeypatch.setattr(timing, "device_throughput", fake_throughput)
+    rc = main(
+        [
+            "autotune",
+            "--height", "64",
+            "--width", "256",
+            "--blocks", "32,48,64",  # 48 is skipped (not a multiple of 32)
+            "--device", "cpu",
+            "--json-metrics", "-",
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "skipped" in out
+    rec = json.loads([l for l in out.splitlines() if l.startswith("{")][-1])
+    assert rec["event"] == "autotune"
+    assert rec["block_h"] == 32  # first measured = fastest under the stub
+    assert rec["device_kind"] == "cpu"
+    calibration._cache["key"] = None
+    assert calibration.lookup_block_h("cpu") == 32
+
+
+def test_autotune_rejects_bad_blocks_before_measuring(calib_file, monkeypatch):
+    """A malformed token must fail fast, not after minutes of sweep."""
+    from mpi_cuda_imagemanipulation_tpu.utils import timing
+
+    calls = []
+    monkeypatch.setattr(
+        timing, "device_throughput", lambda *a, **k: calls.append(1) or 0.001
+    )
+    rc = main(
+        ["autotune", "--blocks", "64,abc", "--device", "cpu",
+         "--height", "64", "--width", "256"]
+    )
+    assert rc == 2  # clean user-input error from main()
+    assert calls == []  # nothing was measured
+    assert not calib_file.exists()
+
+
+def test_autotune_skips_candidates_above_heuristic_cap(calib_file, monkeypatch, capsys):
+    """Candidates the min rule could never apply are not measured: at width
+    200k the VMEM heuristic caps gaussian:5 at 32 rows, so 64 is skipped and
+    the sweep records a value that will actually take effect."""
+    from mpi_cuda_imagemanipulation_tpu.utils import timing
+
+    monkeypatch.setattr(timing, "device_throughput", lambda *a, **k: 0.001)
+    rc = main(
+        ["autotune", "--blocks", "32,64", "--device", "cpu",
+         "--height", "64", "--width", "200000", "--json-metrics", "-"]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "above the VMEM heuristic cap" in out
+    rec = json.loads([l for l in out.splitlines() if l.startswith("{")][-1])
+    assert rec["block_h"] == 32
+
+
+def test_autotune_restores_caller_env(calib_file, monkeypatch, tmp_path):
+    """The sweep's internal kill-switch and store-path overrides must not
+    leak: a caller's MCIM_NO_CALIB / MCIM_CALIB_FILE survive the call."""
+    from mpi_cuda_imagemanipulation_tpu.utils import timing
+
+    monkeypatch.setattr(timing, "device_throughput", lambda *a, **k: 0.001)
+    monkeypatch.setenv("MCIM_NO_CALIB", "1")
+    rc = main(
+        ["autotune", "--blocks", "32", "--device", "cpu",
+         "--height", "64", "--width", "256", "--dry-run",
+         "--calib-file", str(tmp_path / "other.json")]
+    )
+    assert rc == 0
+    import os
+
+    assert os.environ.get("MCIM_NO_CALIB") == "1"
+    assert os.environ.get("MCIM_CALIB_FILE") == str(calib_file)
+
+
+def test_autotune_cli_dry_run(calib_file, monkeypatch):
+    from mpi_cuda_imagemanipulation_tpu.utils import timing
+
+    monkeypatch.setattr(
+        timing,
+        "device_throughput",
+        lambda fn, fn_args, **kw: (fn(*fn_args).block_until_ready(), 0.001)[1],
+    )
+    rc = main(
+        [
+            "autotune",
+            "--height", "64",
+            "--width", "256",
+            "--blocks", "32",
+            "--device", "cpu",
+            "--dry-run",
+        ]
+    )
+    assert rc == 0
+    assert not calib_file.exists()

@@ -30,6 +30,10 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# fixed-configuration A/B: a committed autotune calibration must not steer
+# either side (utils/calibration.py kill-switch)
+os.environ.setdefault("MCIM_NO_CALIB", "1")
+
 
 def main() -> int:
     ap = argparse.ArgumentParser()

@@ -23,6 +23,10 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# this probe compares fixed kernel configurations; a committed autotune
+# calibration steering block heights would contaminate the cross-case story
+os.environ.setdefault("MCIM_NO_CALIB", "1")
+
 
 def main() -> int:
     ap = argparse.ArgumentParser()

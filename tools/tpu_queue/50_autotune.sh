@@ -1,0 +1,20 @@
+#!/bin/bash
+# On-chip block-height autotune: sweep the headline pipeline's block heights
+# on the real chip and commit the calibration store. Production paths
+# (bench.py, quick_headline, cli run) pick the calibrated height up
+# automatically via _pick_block_h's min rule, so a follow-up headline
+# capture (55_) records whatever the sweep buys.
+set -u
+cd "$(dirname "$0")/../.."
+. tools/tpu_queue/_lib.sh
+timeout 2400 python -m mpi_cuda_imagemanipulation_tpu autotune \
+  --json-metrics autotune_r03.jsonl > autotune_r03.out 2>&1
+rc=$?
+# a mid-sweep wedge may leave only the .out on disk; git commit -- <pathspec>
+# aborts wholesale on a never-existed path, so list only what materialised
+arts=(autotune_r03.out)
+[ -f autotune_r03.jsonl ] && arts+=(autotune_r03.jsonl)
+[ -f .mcim_calibration.json ] && arts+=(.mcim_calibration.json)
+commit_artifacts "TPU window: on-chip block-height autotune -> committed calibration" \
+  "${arts[@]}"
+exit $rc

@@ -1,0 +1,13 @@
+#!/bin/bash
+# Post-autotune headline capture: records the headline with the committed
+# calibration live. bench.py promotes the BEST same-round TPU record, so
+# this only moves the artifact of record if the calibrated block actually
+# beats the heuristic's.
+set -u
+cd "$(dirname "$0")/../.."
+. tools/tpu_queue/_lib.sh
+timeout 2100 python tools/quick_headline.py > quick_headline3_r03.out 2>&1
+rc=$?
+commit_artifacts "TPU window: post-autotune headline capture" \
+  BENCH_HISTORY.jsonl quick_headline3_r03.out
+exit $rc
