@@ -644,6 +644,13 @@ def cmd_autotune(args: argparse.Namespace) -> int:
             )
             for _pw, stencil in group_ops(ops)
         )
+        if cap not in candidates:
+            # the heuristic's own choice is always legal and is the baseline
+            # the calibration competes with — measure it even when every
+            # --blocks entry sits above the cap (review finding: otherwise a
+            # wide-image sweep could skip everything and burn the chip
+            # window for nothing)
+            candidates.append(cap)
         img = jax.numpy.asarray(
             synthetic_image(args.height, args.width, channels=1, seed=7)
         )
@@ -690,8 +697,8 @@ def cmd_autotune(args: argparse.Namespace) -> int:
             path = calibration.record_block_h(
                 kind,
                 best_bh,
-                pipeline=args.ops,
                 impl=args.impl,
+                pipeline=args.ops,
                 width=args.width,
                 mp_per_s=round(mp_s, 1),
             )

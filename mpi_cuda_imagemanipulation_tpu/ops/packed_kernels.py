@@ -638,7 +638,7 @@ def run_group_packed_words(
     # word blocks are Wp i32 columns = width bytes/row, same as the u8
     # path's working set; reuse its VMEM heuristic unchanged
     bh = block_h or _pick_block_h(
-        width, n_in, n_out, h, _live_f32_temps(stencil)
+        width, n_in, n_out, h, _live_f32_temps(stencil), impl="packed"
     )
     if interpret is None:
         interpret = jax.default_backend() != "tpu"

@@ -86,8 +86,15 @@ def current_device_kind() -> str:
     return jax.devices()[0].device_kind
 
 
-def lookup_block_h(device_kind: str | None = None) -> int | None:
-    """Calibrated preferred block height for this device kind, if any."""
+def lookup_block_h(
+    device_kind: str | None = None, impl: str = "pallas"
+) -> int | None:
+    """Calibrated preferred block height for (device kind, impl), if any.
+
+    Keyed per impl because the u8 and packed-u32 streaming kernels have
+    different per-block compute/VMEM profiles — a height tuned for one must
+    not silently steer the other (review finding).
+    """
     if os.environ.get(_ENV_DISABLE):
         return None
     entries = _load().get("device_kinds")
@@ -101,22 +108,31 @@ def lookup_block_h(device_kind: str | None = None) -> int | None:
     rec = entries.get(device_kind)
     if not isinstance(rec, dict):
         return None
+    rec = rec.get(impl)
+    if not isinstance(rec, dict):
+        return None
     bh = rec.get("block_h")
     if isinstance(bh, int) and 32 <= bh <= 4096:
         return bh
     return None
 
 
-def record_block_h(device_kind: str, block_h: int, **extra) -> str:
-    """Write/replace this device kind's calibration entry; returns the path.
+def record_block_h(
+    device_kind: str, block_h: int, impl: str = "pallas", **extra
+) -> str:
+    """Write/replace the (device kind, impl) calibration entry; returns the
+    store path.
 
     Atomic (tmp file + rename) so a concurrent reader never sees a torn
-    JSON; other kinds' entries are preserved.
+    JSON; other kinds' and impls' entries are preserved.
     """
     path = calib_path()
     data = _load()
     kinds = data.setdefault("device_kinds", {})
-    kinds[device_kind] = {"block_h": int(block_h), **extra}
+    kind_rec = kinds.setdefault(device_kind, {})
+    if not isinstance(kind_rec, dict):  # legacy/corrupt entry: replace
+        kind_rec = kinds[device_kind] = {}
+    kind_rec[impl] = {"block_h": int(block_h), **extra}
     d = os.path.dirname(path) or "."
     fd, tmp = tempfile.mkstemp(dir=d, prefix=".mcim_calib_")
     try:

@@ -37,7 +37,18 @@ def test_record_lookup_roundtrip(calib_file):
     assert calibration.lookup_block_h("TPU v5 lite") == 256
     assert calibration.lookup_block_h("cpu") == 64
     data = json.loads(calib_file.read_text())
-    assert data["device_kinds"]["TPU v5 lite"]["mp_per_s"] == 47000.0
+    assert data["device_kinds"]["TPU v5 lite"]["pallas"]["mp_per_s"] == 47000.0
+
+
+def test_per_impl_entries_are_independent(calib_file):
+    """A packed sweep must not clobber the pallas entry or steer the
+    unpacked path (review finding): entries are keyed (kind, impl)."""
+    calibration.record_block_h("TPU v5 lite", 128, impl="pallas")
+    calibration.record_block_h("TPU v5 lite", 64, impl="packed")
+    assert calibration.lookup_block_h("TPU v5 lite", impl="pallas") == 128
+    assert calibration.lookup_block_h("TPU v5 lite", impl="packed") == 64
+    # default lookup is the pallas entry
+    assert calibration.lookup_block_h("TPU v5 lite") == 128
 
 
 def test_lookup_missing_and_corrupt(calib_file):
@@ -158,6 +169,24 @@ def test_autotune_skips_candidates_above_heuristic_cap(calib_file, monkeypatch, 
     assert "above the VMEM heuristic cap" in out
     rec = json.loads([l for l in out.splitlines() if l.startswith("{")][-1])
     assert rec["block_h"] == 32
+
+
+def test_autotune_measures_cap_when_all_candidates_skip(
+    calib_file, monkeypatch, capsys
+):
+    """Every --blocks entry above the VMEM cap must not waste the window:
+    the heuristic's own (always-legal) height is measured instead."""
+    from mpi_cuda_imagemanipulation_tpu.utils import timing
+
+    monkeypatch.setattr(timing, "device_throughput", lambda *a, **k: 0.001)
+    rc = main(
+        ["autotune", "--blocks", "512", "--device", "cpu",
+         "--height", "64", "--width", "200000", "--json-metrics", "-"]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    rec = json.loads([l for l in out.splitlines() if l.startswith("{")][-1])
+    assert rec["block_h"] == 32  # the cap for gaussian:5 at width 200k
 
 
 def test_autotune_restores_caller_env(calib_file, monkeypatch, tmp_path):

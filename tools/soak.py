@@ -34,6 +34,11 @@ from mpi_cuda_imagemanipulation_tpu.utils.platform import claim_platform
 
 claim_platform("cpu", n_host_devices=8, keep_existing_count=True)
 
+# differential soak compares FIXED configurations; a committed autotune
+# calibration steering the bh=None trials would make REPRO lines depend on
+# hidden store state (review finding)
+os.environ.setdefault("MCIM_NO_CALIB", "1")
+
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
@@ -171,22 +176,36 @@ def run_trial(
     if not np.array_equal(got, golden):
         return repro("xla", "mismatch")
 
+    # random explicit block height: the autotune calibration path
+    # (utils/calibration.py) can shrink production blocks below the
+    # heuristic at any time, so bit-exactness must hold for EVERY legal
+    # height, not just the default (None = heuristic, weighted 2x)
+    bh = rng.choice((None, None, 32, 64, 96))
+
+    def bh_repro(backend, detail=""):
+        r = repro(backend, detail)
+        if bh is not None:
+            r["block_h"] = bh
+        return r
+
     try:
-        got = np.asarray(pipeline_pallas(pipe.ops, img, interpret=True))
+        got = np.asarray(pipeline_pallas(pipe.ops, img, interpret=True, block_h=bh))
     except Exception as e:  # noqa: BLE001
-        return repro("pallas", f"raised {type(e).__name__}: {e}")
+        return bh_repro("pallas", f"raised {type(e).__name__}: {e}")
     if not np.array_equal(got, golden):
-        return repro("pallas", "mismatch")
+        return bh_repro("pallas", "mismatch")
 
     if rng.random() < 0.5:  # packed-u32 path (eligible groups + fallbacks)
         try:
             got = np.asarray(
-                pipeline_pallas(pipe.ops, img, interpret=True, packed=True)
+                pipeline_pallas(
+                    pipe.ops, img, interpret=True, packed=True, block_h=bh
+                )
             )
         except Exception as e:  # noqa: BLE001
-            return repro("packed", f"raised {type(e).__name__}: {e}")
+            return bh_repro("packed", f"raised {type(e).__name__}: {e}")
         if not np.array_equal(got, golden):
-            return repro("packed", "mismatch")
+            return bh_repro("packed", "mismatch")
 
     if rng.random() < 0.35:  # batched (vmap) path: per-image bit-equality
         k = rng.randint(2, 3)
@@ -268,11 +287,22 @@ def run_repro(line: str) -> int:
         rc |= 0 if ok else 1
 
     check("xla", lambda: pipe.jit("xla")(img))
-    check("pallas", lambda: pipeline_pallas(pipe.ops, img, interpret=True))
-    check(
-        "packed",
-        lambda: pipeline_pallas(pipe.ops, img, interpret=True, packed=True),
-    )
+    # a REPRO from a block-height trial carries "block_h"; re-check both the
+    # recorded height and the default heuristic
+    for bh in dict.fromkeys((d.get("block_h"), None)):
+        tag = "" if bh is None else f"[bh={bh}]"
+        check(
+            f"pallas{tag}",
+            lambda bh=bh: pipeline_pallas(
+                pipe.ops, img, interpret=True, block_h=bh
+            ),
+        )
+        check(
+            f"packed{tag}",
+            lambda bh=bh: pipeline_pallas(
+                pipe.ops, img, interpret=True, packed=True, block_h=bh
+            ),
+        )
     # same batch construction as run_trial (k distinct images seeded
     # trial_seed + t) so batched REPROs actually reproduce; k=3 supersets
     # the fuzzer's k in {2, 3}, and every index is compared
