@@ -6,6 +6,14 @@
 # the artifacts are on disk, so burning another serialized chip campaign to
 # re-produce them would be strictly worse than picking them up in the next
 # manual commit.
+# Persistent XLA compilation cache, shared by every queue step: a step
+# retried after a mid-compile wedge (observed: 15_quick_headline2 burned a
+# whole 35-min try inside one 8K compile) reuses the executable from any
+# earlier attempt or window and gets to the measurement in seconds. The
+# cache keys on HLO + compile options, so it can never change results.
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-$(pwd)/tools/.jax_cache}"
+mkdir -p "$JAX_COMPILATION_CACHE_DIR"
+
 commit_artifacts() {
   local msg="$1"
   shift
