@@ -107,6 +107,48 @@ class Pipeline:
 
         return sharded_pipeline(self, mesh, backend=backend)
 
+    def data_parallel(self, mesh, backend: str = "xla"):
+        """A jitted (N, H, W[, C]) -> (N, ...) batch function with the
+        stack sharded over `mesh`'s first axis: each device runs the whole
+        pipeline on its slice of the images (SPMD data parallelism — zero
+        collectives, since images are independent; global-statistics ops
+        reduce per image under vmap, not across the batch).
+
+        This is the TPU-native analogue of launching the reference binary
+        once per GPU/node for throughput (kernel.cu has one hardcoded image
+        per process, kernel.cu:110), composing the `.batched` vmap with a
+        batch-axis sharding instead of a process manager. `.sharded` splits
+        ONE image's rows across devices (latency); `.data_parallel` splits
+        MANY images across devices (throughput). Per-image results are
+        bit-identical to `.jit` / `.batched` (asserted by
+        tests/test_batch_dp.py). N need not divide the device count: jit
+        batch-axis shardings require divisibility, so an uneven stack is
+        padded by repeating the last image (same scheme as the CLI's
+        partial-stack pad) and the padded outputs are sliced off — one
+        compiled shape per (N rounded up), never a ragged recompile."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        spec = PartitionSpec(mesh.axis_names[0])
+        sharding = NamedSharding(mesh, spec)
+        n_dev = mesh.devices.size
+        fn = jax.jit(
+            jax.vmap(self._callable(backend)),
+            in_shardings=sharding,
+            out_shardings=sharding,
+        )
+
+        def run(imgs):
+            n = imgs.shape[0]
+            pad = -n % n_dev
+            if pad:
+                imgs = jnp.concatenate(
+                    [imgs, jnp.repeat(imgs[-1:], pad, axis=0)], axis=0
+                )
+            out = fn(imgs)
+            return out[:n] if pad else out
+
+        return run
+
 
 def reference_pipeline() -> Pipeline:
     """The reference's exact pipeline: grayscale -> contrast 3.5 -> emboss 3x3
