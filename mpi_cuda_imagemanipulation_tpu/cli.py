@@ -52,10 +52,10 @@ def _build_parser() -> argparse.ArgumentParser:
     )
     run.add_argument(
         "--shards",
-        type=int,
-        default=1,
-        help="row-shard the image over this many devices (mpirun -np analogue); "
-        "1 = single device",
+        default="1",
+        help="shard the image over devices: N row-shards (mpirun -np "
+        "analogue), RxC tile-shards over a 2-D rows x cols mesh with "
+        "corner-carrying halo exchange (e.g. 2x4); 1 = single device",
     )
     run.add_argument(
         "--device",
@@ -122,7 +122,12 @@ def _build_parser() -> argparse.ArgumentParser:
     batch.add_argument(
         "--impl", choices=("auto", "xla", "pallas", "packed"), default="auto"
     )
-    batch.add_argument("--shards", type=int, default=1)
+    batch.add_argument(
+        "--shards",
+        default="1",
+        help="N row-shards per image, or RxC 2-D tile-shards (run --help); "
+        "with --stack the flat device count hosts the data-parallel stack",
+    )
     batch.add_argument("--device", default=None)
     batch.add_argument(
         "--threads", type=int, default=4, help="decode prefetch threads"
@@ -241,7 +246,7 @@ def cmd_run(args: argparse.Namespace) -> int:
     from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline
     from mpi_cuda_imagemanipulation_tpu.parallel.mesh import (
         distributed_init,
-        make_mesh,
+        mesh_from_shards,
     )
     from mpi_cuda_imagemanipulation_tpu.utils.log import (
         emit_json_metrics,
@@ -262,7 +267,18 @@ def cmd_run(args: argparse.Namespace) -> int:
             DeviceTimeoutError,
             run_guarded,
         )
+        from mpi_cuda_imagemanipulation_tpu.parallel.mesh import parse_shards
 
+        # validate the shards/backend combo BEFORE spawning the watchdog
+        # child: the 2-D runner computes tiles with XLA only, and surfacing
+        # that from the child would be an opaque RuntimeError traceback
+        # instead of main()'s clean one-line error (review finding)
+        _n_r, _n_c = parse_shards(args.shards)
+        if _n_c is not None and args.impl not in ("xla", "auto"):
+            raise ValueError(
+                "2-D sharding (--shards RxC) computes tiles with XLA; use "
+                f"--impl xla or auto (got {args.impl!r})"
+            )
         if args.profile_dir:
             log.warning(
                 "--profile-dir is not supported in guarded mode "
@@ -290,8 +306,8 @@ def cmd_run(args: argparse.Namespace) -> int:
         )
         steady_s = timings.get("steady_s")
     else:
-        if args.shards > 1:
-            mesh = make_mesh(args.shards)
+        mesh = mesh_from_shards(args.shards)
+        if mesh is not None:
             if args.block:
                 log.warning(
                     "--block applies to single-device Pallas runs; ignored"
@@ -386,6 +402,8 @@ def cmd_batch(args: argparse.Namespace) -> int:
     from mpi_cuda_imagemanipulation_tpu.parallel.mesh import (
         distributed_init,
         make_mesh,
+        make_mesh_2d,
+        parse_shards,
     )
     from mpi_cuda_imagemanipulation_tpu.utils.log import get_logger
 
@@ -406,16 +424,26 @@ def cmd_batch(args: argparse.Namespace) -> int:
     os.makedirs(args.output_dir, exist_ok=True)
     pipe = Pipeline.parse(args.ops)
     stack = max(1, args.stack)
-    if args.shards > 1 and stack > 1:
+    n_r, n_c = parse_shards(args.shards)
+    n_flat = n_r * (n_c or 1)
+    if stack > 1 and n_flat > 1:
         # data parallelism: the stack is sharded over the device mesh, each
         # device running the full pipeline on its slice of the images
         # (Pipeline.data_parallel — throughput counterpart of the
-        # row-sharded latency path)
-        fn = pipe.data_parallel(make_mesh(args.shards), backend=args.impl)
-    elif args.shards > 1:
-        fn = pipe.sharded(make_mesh(args.shards), backend=args.impl)
-    elif stack > 1:
+        # row-sharded latency path); a 2-D spec contributes its flat count
+        if stack % n_flat:
+            log.warning(
+                "--stack %d is not a multiple of %d devices: every "
+                "dispatch pads to %d images and discards the pad's compute; "
+                "round --stack to a mesh multiple to avoid the waste",
+                stack, n_flat, -(-stack // n_flat) * n_flat,
+            )
+        fn = pipe.data_parallel(make_mesh(n_flat), backend=args.impl)
+    elif stack > 1:  # incl. --shards 1 / 1x1: stacked dispatch, one device
         fn = pipe.batched(backend=args.impl)
+    elif n_flat > 1 or n_c is not None:
+        mesh = make_mesh_2d(n_r, n_c) if n_c is not None else make_mesh(n_r)
+        fn = pipe.sharded(mesh, backend=args.impl)
     else:
         fn = pipe.jit(backend=args.impl)  # one jit: re-traces only per shape
 

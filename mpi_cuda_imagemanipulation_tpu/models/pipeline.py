@@ -101,8 +101,26 @@ class Pipeline:
         return jax.jit(jax.vmap(self._callable(backend)))
 
     def sharded(self, mesh, backend: str = "xla"):
-        """A jitted function running this pipeline row-sharded over `mesh`
-        with ppermute ghost-row halo exchange (see parallel.api)."""
+        """A jitted function running this pipeline sharded over `mesh` with
+        ppermute ghost halo exchange.
+
+        A 1-D ('rows',) mesh row-shards the image (parallel.api — Pallas
+        fused-ghost fast path available); a 2-D ('rows', 'cols') mesh
+        tile-shards it with the two-phase corner-carrying exchange
+        (parallel.api2d — XLA tile compute; `backend` must be "xla" or
+        "auto" there)."""
+        if len(mesh.axis_names) == 2:
+            if backend not in ("xla", "auto"):
+                raise ValueError(
+                    "2-D sharding computes tiles with XLA (the fused-ghost "
+                    "Pallas kernel is full-width by design, parallel/api2d "
+                    f"docstring); got backend={backend!r}"
+                )
+            from mpi_cuda_imagemanipulation_tpu.parallel.api2d import (
+                sharded_pipeline_2d,
+            )
+
+            return sharded_pipeline_2d(self, mesh)
         from mpi_cuda_imagemanipulation_tpu.parallel.api import sharded_pipeline
 
         return sharded_pipeline(self, mesh, backend=backend)

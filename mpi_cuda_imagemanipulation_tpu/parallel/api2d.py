@@ -1,0 +1,238 @@
+"""2-D sharded pipeline execution: shard_map over a ('rows', 'cols') mesh.
+
+Extends the 1-D row decomposition (parallel/api.py — the reference's
+MPI_Scatter row blocks, SURVEY.md §2.3) to a full 2-D tile decomposition:
+the image is split over both mesh axes, every stencil tile is extended with
+ghost zones on all four sides, and corners arrive without any diagonal
+communication via the standard two-phase exchange — the vertical ppermute
+runs first, then the horizontal ppermute carries the *vertically extended*
+edge strips, so each tile's corner ghosts are its diagonal neighbour's data
+relayed through the shared row/column neighbour. Two ring hops per axis,
+exactly the collectives a 2-D jax mesh maps onto ICI.
+
+The compute per tile is the ops' own golden tile functions (ops/spec.py
+`valid`/`finalize` thread (y0, x0) global offsets and were 2-D-aware from
+the start), so 2-D sharded output is bit-identical to the unsharded golden
+path — the same invariant the 1-D runner carries
+(tests/test_sharded2d.py). Global-statistics ops psum over BOTH axes.
+
+Scope: the tile compute is XLA (fused elementwise + stencil per tile). The
+fused-ghost Pallas streaming kernel assumes full-width rows and is the 1-D
+path's specialty; a width-split tile would need horizontal ghost columns
+inside the kernel's lane dimension, which buys nothing at these tile sizes
+(see BASELINE.md's element-ceiling analysis — the kernels are I/O-bound, and
+a 2-D split only shrinks the per-chip tile).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from mpi_cuda_imagemanipulation_tpu.ops.spec import (
+    F32,
+    GlobalOp,
+    PointwiseOp,
+    StencilOp,
+)
+from mpi_cuda_imagemanipulation_tpu.parallel.api import _reflect101_index
+from mpi_cuda_imagemanipulation_tpu.parallel.mesh import COLS, ROWS
+
+
+def _exchange_axis(
+    tile: jnp.ndarray, halo: int, n: int, axis_name: str, axis: int
+) -> jnp.ndarray:
+    """Extend `tile` with `halo` ghost slices on both sides of `axis`,
+    moved from ring neighbours along mesh axis `axis_name`.
+
+    With n == 1 (or for shard 0 / n-1, whose ring partner wraps around the
+    image) the ghost content is not meaningful; every out-of-image slice is
+    overwritten by _fix_edge_axis before any op reads it.
+    """
+    if halo == 0:
+        return tile
+    idx = [slice(None)] * tile.ndim
+    if n == 1:
+        shape = list(tile.shape)
+        shape[axis] = halo
+        zeros = jnp.zeros(shape, tile.dtype)
+        return jnp.concatenate([zeros, tile, zeros], axis=axis)
+    idx[axis] = slice(-halo, None)
+    last = tile[tuple(idx)]
+    idx[axis] = slice(None, halo)
+    first = tile[tuple(idx)]
+    fwd = [(i, (i + 1) % n) for i in range(n)]
+    bwd = [(i, (i - 1) % n) for i in range(n)]
+    before = lax.ppermute(last, axis_name, fwd)  # neighbour's tail = my head ghost
+    after = lax.ppermute(first, axis_name, bwd)
+    return jnp.concatenate([before, tile, after], axis=axis)
+
+
+def _fix_edge_axis(
+    ext: jnp.ndarray,
+    op: StencilOp,
+    off: jnp.ndarray,
+    global_size: int,
+    axis: int,
+) -> jnp.ndarray:
+    """Overwrite ghost/padding slices along `axis` whose global index falls
+    outside the real image with the op's edge extension (the axis-general
+    form of parallel.api._fix_edge_rows; reflect-101 is separable per axis,
+    so applying the row fix before the column exchange and the column fix
+    after yields golden corner values)."""
+    ext_sz = ext.shape[axis]
+    h = op.halo
+    g = off - h + lax.iota(jnp.int32, ext_sz)
+    outside = (g < 0) | (g >= global_size)
+    bshape = [1] * ext.ndim
+    bshape[axis] = ext_sz
+    outside_b = outside.reshape(bshape)
+    if op.edge_mode in ("interior", "zero"):
+        return jnp.where(outside_b, jnp.zeros_like(ext), ext)
+    if op.edge_mode == "reflect101":
+        src_g = _reflect101_index(g, global_size)
+    elif op.edge_mode == "edge":
+        src_g = jnp.clip(g, 0, global_size - 1)
+    else:  # pragma: no cover
+        raise ValueError(f"unknown edge mode {op.edge_mode!r}")
+    src_local = jnp.clip(src_g - (off - h), 0, ext_sz - 1)
+    gathered = jnp.take(ext, src_local, axis=axis)
+    return jnp.where(outside_b, gathered, ext)
+
+
+def _apply_stencil_2d(
+    op: StencilOp,
+    tile: jnp.ndarray,
+    y0: jnp.ndarray,
+    x0: jnp.ndarray,
+    global_h: int,
+    global_w: int,
+    n_r: int,
+    n_c: int,
+) -> jnp.ndarray:
+    """Two-phase exchange + fixup, then the op's golden valid/finalize."""
+    h = op.halo
+    # phase 1: vertical ghosts + vertical edge fix (on the raw tile)
+    ext = _fix_edge_axis(
+        _exchange_axis(tile, h, n_r, ROWS, 0), op, y0, global_h, 0
+    )
+    # phase 2: horizontal ghosts carry the vertically-extended strips, so
+    # corner ghosts arrive via the shared neighbour; then horizontal fix
+    ext = _fix_edge_axis(
+        _exchange_axis(ext, h, n_c, COLS, 1), op, x0, global_w, 1
+    )
+    if tile.ndim == 3:
+        return jnp.stack(
+            [
+                op.finalize(
+                    op.valid(ext[..., c].astype(F32)),
+                    tile[..., c],
+                    y0,
+                    x0,
+                    global_h,
+                    global_w,
+                )
+                for c in range(tile.shape[2])
+            ],
+            axis=-1,
+        )
+    return op.finalize(op.valid(ext.astype(F32)), tile, y0, x0, global_h, global_w)
+
+
+def _min_local(pad: int, halo: int) -> int:
+    """Static feasibility of local edge fixups, per axis (same reasoning as
+    the 1-D runner): every reflect/pad source index must live on-tile."""
+    return max(2 * pad + 1, pad + halo, halo, 1)
+
+
+def _run_segment_2d(ops, mesh, img: jnp.ndarray):
+    n_r, n_c = mesh.shape[ROWS], mesh.shape[COLS]
+    max_halo = max((op.halo for op in ops), default=0)
+    global_h, global_w = img.shape[0], img.shape[1]
+    padded_h = -(-global_h // n_r) * n_r
+    padded_w = -(-global_w // n_c) * n_c
+    pad_h, pad_w = padded_h - global_h, padded_w - global_w
+    local_h, local_w = padded_h // n_r, padded_w // n_c
+    for size, pad, n, name in (
+        (local_h, pad_h, n_r, "rows"),
+        (local_w, pad_w, n_c, "cols"),
+    ):
+        if size < _min_local(pad, max_halo):
+            raise ValueError(
+                f"image {global_h}x{global_w} over a {n_r}x{n_c} mesh gives "
+                f"{size} {name}/shard, below the minimum "
+                f"{_min_local(pad, max_halo)} for halo {max_halo} and "
+                f"padding {pad}; use a smaller mesh"
+            )
+    if pad_h or pad_w:
+        img_p = jnp.pad(
+            img, ((0, pad_h), (0, pad_w)) + ((0, 0),) * (img.ndim - 2)
+        )
+    else:
+        img_p = img
+
+    def tile_fn(tile):
+        y0 = lax.axis_index(ROWS) * local_h
+        x0 = lax.axis_index(COLS) * local_w
+        for op in ops:
+            if isinstance(op, PointwiseOp):
+                tile = op.fn(tile)
+            elif isinstance(op, GlobalOp):
+                # additive statistic over valid (non-padding) pixels,
+                # combined across the WHOLE mesh with one two-axis psum
+                rows = y0 + lax.iota(jnp.int32, tile.shape[0])
+                cols = x0 + lax.iota(jnp.int32, tile.shape[1])
+                valid = (rows < global_h)[:, None] & (cols < global_w)[None, :]
+                valid = valid.reshape(valid.shape + (1,) * (tile.ndim - 2))
+                stats = lax.psum(op.stats(tile, valid), (ROWS, COLS))
+                tile = op.apply(tile, stats)
+            else:
+                tile = _apply_stencil_2d(
+                    op, tile, y0, x0, global_h, global_w, n_r, n_c
+                )
+        return tile
+
+    def seq(x):
+        for op in ops:
+            x = op(x)
+        return x
+
+    out_shape = jax.eval_shape(seq, img_p)
+    in_spec = P(ROWS, COLS, *([None] * (img.ndim - 2)))
+    out_spec = P(ROWS, COLS, *([None] * (len(out_shape.shape) - 2)))
+    out = jax.shard_map(
+        tile_fn, mesh=mesh, in_specs=in_spec, out_specs=out_spec
+    )(img_p)
+    return out[:global_h, :global_w]
+
+
+def sharded_pipeline_2d(pipe, mesh):
+    """Compile `pipe` to run tile-sharded over a ('rows', 'cols') mesh.
+
+    Returns a jitted (H, W[, 3]) uint8 -> uint8 function, bit-identical to
+    the unsharded golden path. Geometric (shape-changing) ops run between
+    shard_map segments at the jit level under a 2-D sharding constraint,
+    same recipe as the 1-D runner."""
+    from mpi_cuda_imagemanipulation_tpu.parallel.api import _split_segments
+
+    segments = _split_segments(pipe.ops)
+
+    def run(img: jnp.ndarray) -> jnp.ndarray:
+        from jax.sharding import NamedSharding
+
+        for kind, ops in segments:
+            if kind == "xla":
+                img = ops[0].fn(img)
+                img = lax.with_sharding_constraint(
+                    img,
+                    NamedSharding(
+                        mesh, P(ROWS, COLS, *([None] * (img.ndim - 2)))
+                    ),
+                )
+            else:
+                img = _run_segment_2d(ops, mesh, img)
+        return img
+
+    return jax.jit(run)

@@ -18,6 +18,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 ROWS = "rows"
+COLS = "cols"
 
 
 _distributed_initialized = False
@@ -78,6 +79,51 @@ def make_mesh(n_shards: int | None = None, *, devices=None) -> Mesh:
             f"requested {n_shards} shards but only {len(devices)} devices are visible"
         )
     return Mesh(np.asarray(devices[:n_shards]), (ROWS,))
+
+
+def make_mesh_2d(n_rows: int, n_cols: int, *, devices=None) -> Mesh:
+    """A 2-D mesh over n_rows x n_cols devices on ('rows', 'cols') axes —
+    the full tile decomposition (parallel/api2d.py). On real hardware, lay
+    the axes out so both ride ICI (a (4, 2) slice maps directly)."""
+    if devices is None:
+        devices = jax.devices()
+    need = n_rows * n_cols
+    if need > len(devices):
+        raise ValueError(
+            f"requested a {n_rows}x{n_cols} mesh but only {len(devices)} "
+            "devices are visible"
+        )
+    return Mesh(
+        np.asarray(devices[:need]).reshape(n_rows, n_cols), (ROWS, COLS)
+    )
+
+
+def parse_shards(spec) -> tuple[int, int | None]:
+    """Parse a CLI shard spec: '4' -> (4, None) (1-D row mesh), '2x4' ->
+    (2, 4) (2-D rows x cols mesh). Ints pass through as 1-D."""
+    if isinstance(spec, int):
+        return spec, None
+    s = str(spec).lower().strip()
+    if "x" in s:
+        r, _, c = s.partition("x")
+        n_r, n_c = int(r), int(c)
+        if n_r < 1 or n_c < 1:
+            raise ValueError(f"shard counts must be >= 1, got {spec!r}")
+        return n_r, n_c
+    n = int(s)
+    if n < 1:
+        raise ValueError(f"shard count must be >= 1, got {spec!r}")
+    return n, None
+
+
+def mesh_from_shards(spec) -> Mesh | None:
+    """Mesh for a CLI shard spec, or None when it means 'unsharded' ('1').
+    'RxC' builds a 2-D mesh even for '1x8'/'8x1' (explicit 2-D request);
+    a bare count builds the 1-D row mesh."""
+    n_r, n_c = parse_shards(spec)
+    if n_c is not None:
+        return make_mesh_2d(n_r, n_c)
+    return make_mesh(n_r) if n_r > 1 else None
 
 
 def row_sharding(mesh: Mesh, ndim: int) -> NamedSharding:

@@ -43,10 +43,11 @@ from mpi_cuda_imagemanipulation_tpu.utils.timing import _sync
 inp, outp, spec, impl, block, shards = sys.argv[1:7]
 img = np.load(inp)
 pipe = Pipeline.parse(spec)
-if int(shards) > 1:
-    from mpi_cuda_imagemanipulation_tpu.parallel.mesh import make_mesh
+from mpi_cuda_imagemanipulation_tpu.parallel.mesh import mesh_from_shards
 
-    fn = pipe.sharded(make_mesh(int(shards)), backend=impl)
+_mesh = mesh_from_shards(shards)
+if _mesh is not None:
+    fn = pipe.sharded(_mesh, backend=impl)
 else:
     fn = pipe.jit(backend=impl, block_h=int(block) or None)
 
@@ -76,7 +77,7 @@ def run_guarded(
     *,
     impl: str = "auto",
     block_h: int | None = None,
-    shards: int = 1,
+    shards: int | str = 1,
     timings: dict | None = None,
 ) -> np.ndarray:
     """Run `spec` over `img` in a subprocess with a wall-clock budget.

@@ -72,6 +72,29 @@ def test_data_parallel_output_is_sharded():
     assert shard_shapes == {(2, 48, 64, 3)}
 
 
+def test_cli_batch_stack_with_1x1_shards(tmp_path):
+    """--stack N --shards 1x1 means 'stacked dispatch, one device' and must
+    take the batched path, not feed a 4-D stack to the sharded runner
+    (review finding)."""
+    from PIL import Image
+
+    from mpi_cuda_imagemanipulation_tpu.cli import main
+
+    ind = tmp_path / "in"
+    outd = tmp_path / "out"
+    ind.mkdir()
+    for t in range(2):
+        Image.fromarray(
+            synthetic_image(40, 56, channels=3, seed=300 + t)
+        ).save(ind / f"im{t}.png")
+    rc = main(
+        ["batch", "--input-dir", str(ind), "--output-dir", str(outd),
+         "--stack", "2", "--shards", "1x1", "--device", "cpu"]
+    )
+    assert rc == 0
+    assert sorted(p.name for p in outd.iterdir()) == ["im0.png", "im1.png"]
+
+
 @needs_multidevice
 def test_cli_batch_data_parallel(tmp_path):
     """End-to-end `batch --stack 4 --shards 2` writes per-image outputs

@@ -1,0 +1,161 @@
+"""2-D ('rows' x 'cols') sharded execution (parallel/api2d.py).
+
+The invariant is the same as every other backend's: tile-sharded output is
+bit-identical to the unsharded golden path — including corner ghost zones
+(the two-phase exchange's whole point), global edges in both axes,
+pad-to-multiple in both axes, interior-mode seams, per-axis edge modes
+(reflect-101 / edge / interior), global statistics psum'd over both axes,
+and geometric ops between shard_map segments.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from mpi_cuda_imagemanipulation_tpu.io.image import synthetic_image
+from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline
+from mpi_cuda_imagemanipulation_tpu.parallel.mesh import make_mesh_2d
+
+needs_8dev = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-fake-device CPU rig"
+)
+
+
+def _img(h, w, channels=3, seed=7):
+    return np.asarray(synthetic_image(h, w, channels=channels, seed=seed))
+
+
+def _check(spec, h, w, mesh_shape=(2, 4), channels=3, seed=7):
+    pipe = Pipeline.parse(spec)
+    img = _img(h, w, channels=channels, seed=seed)
+    golden = np.asarray(pipe(img))
+    got = np.asarray(pipe.sharded(make_mesh_2d(*mesh_shape))(img))
+    assert got.shape == golden.shape
+    if not np.array_equal(got, golden):
+        d = np.argwhere(np.asarray(got) != golden)
+        raise AssertionError(
+            f"{spec} ({h}x{w}, mesh {mesh_shape}): {len(d)} pixels differ, "
+            f"first at {d[0]}"
+        )
+
+
+@needs_8dev
+@pytest.mark.parametrize("spec", [
+    "grayscale,contrast:3.5,emboss:3",  # reference pipeline, interior mode
+    "gaussian:5",                        # separable, reflect-101, halo 2
+    "sobel",                             # multi-kernel magnitude
+    "erode:5",                           # morphology, edge mode, halo 2
+    "median:3",                          # rank filter
+    "unsharp",                           # 5x5 non-separable
+])
+def test_2d_matches_golden(spec):
+    _check(spec, 64, 96)
+
+
+@needs_8dev
+@pytest.mark.parametrize("mesh_shape", [(2, 4), (4, 2), (8, 1), (1, 8), (2, 2)])
+def test_2d_mesh_geometries(mesh_shape):
+    _check("grayscale,gaussian:5,emboss:3", 72, 88, mesh_shape=mesh_shape)
+
+
+@needs_8dev
+@pytest.mark.parametrize("hw", [
+    (63, 95),   # pad 1 row + 1 col
+    (66, 98),   # pad 2 rows + 2 cols
+    (64, 96),   # exact multiples
+])
+def test_2d_pad_to_multiple(hw):
+    _check("gaussian:5", hw[0], hw[1])
+
+
+@needs_8dev
+def test_2d_corner_dependence():
+    """A 2-pass blur makes corner pixels of interior tiles depend on their
+    diagonal neighbour's data — wrong or zero corner ghosts cannot pass."""
+    _check("gaussian:5,gaussian:5", 64, 96)
+
+
+@needs_8dev
+def test_2d_global_stats_psum_both_axes():
+    _check("grayscale,equalize", 64, 96)
+    _check("grayscale,otsu", 57, 91)
+
+
+@needs_8dev
+def test_2d_geometric_between_segments():
+    _check("grayscale,rot180,gaussian:5", 64, 96)
+    _check("crop:3:5:48:80,gaussian:3", 64, 96)
+
+
+@needs_8dev
+def test_2d_gray_input():
+    _check("gaussian:5,sobel", 64, 96, channels=1)
+
+
+@needs_8dev
+def test_2d_too_small_rejected():
+    pipe = Pipeline.parse("gaussian:7")
+    img = _img(10, 96)
+    with pytest.raises(ValueError, match="below the minimum"):
+        pipe.sharded(make_mesh_2d(4, 2))(img)
+
+
+@needs_8dev
+def test_2d_rejects_pallas_backend():
+    with pytest.raises(ValueError, match="2-D sharding"):
+        Pipeline.parse("gaussian:5").sharded(make_mesh_2d(2, 4), backend="pallas")
+
+
+def test_parse_shards():
+    from mpi_cuda_imagemanipulation_tpu.parallel.mesh import parse_shards
+
+    assert parse_shards("4") == (4, None)
+    assert parse_shards(4) == (4, None)
+    assert parse_shards("2x4") == (2, 4)
+    assert parse_shards("2X4") == (2, 4)
+    with pytest.raises(ValueError):
+        parse_shards("0")
+    with pytest.raises(ValueError):
+        parse_shards("2x0")
+
+
+def test_cli_guarded_2d_pallas_fails_cleanly(tmp_path, capsys):
+    """--device-timeout + --shards RxC + --impl pallas must fail with the
+    clean one-line error BEFORE spawning the watchdog child (review
+    finding: the child's ValueError surfaced as an uncaught RuntimeError
+    traceback)."""
+    from PIL import Image
+
+    from mpi_cuda_imagemanipulation_tpu.cli import main
+
+    src = tmp_path / "in.png"
+    Image.fromarray(_img(40, 56, seed=5)).save(src)
+    rc = main(["run", "--input", str(src), "--output", str(tmp_path / "o.png"),
+               "--device", "cpu", "--impl", "pallas", "--shards", "2x4",
+               "--device-timeout", "60"])
+    assert rc == 2
+    assert "2-D sharding" in capsys.readouterr().err
+
+
+@needs_8dev
+def test_cli_run_2d_shards(tmp_path):
+    """End-to-end `run --shards 2x4 --impl xla` equals the unsharded CLI
+    output bit-for-bit."""
+    from PIL import Image
+
+    from mpi_cuda_imagemanipulation_tpu.cli import main
+
+    src = tmp_path / "in.png"
+    Image.fromarray(_img(60, 84, seed=31)).save(src)
+    a, b = tmp_path / "a.png", tmp_path / "b.png"
+    rc1 = main(["run", "--input", str(src), "--output", str(a),
+                "--device", "cpu", "--impl", "xla"])
+    rc2 = main(["run", "--input", str(src), "--output", str(b),
+                "--device", "cpu", "--impl", "xla", "--shards", "2x4"])
+    assert rc1 == 0 and rc2 == 0
+    assert np.array_equal(
+        np.asarray(Image.open(a)), np.asarray(Image.open(b))
+    )
