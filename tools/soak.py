@@ -223,6 +223,45 @@ def run_trial(
             if not np.array_equal(outs[t], np.asarray(pipe(imgs[t]))):
                 return repro(f"batched-{backend_b}", f"mismatch at image {t}")
 
+    if rng.random() < 0.3 and len(jax.devices()) >= 4:
+        # 2-D tile mesh (parallel/api2d): corner-carrying two-phase exchange
+        r, c = rng.choice(((2, 2), (2, 4), (4, 2), (2, 3)))
+        if r * c <= len(jax.devices()):
+            from mpi_cuda_imagemanipulation_tpu.parallel.mesh import (
+                make_mesh_2d,
+            )
+
+            try:
+                got = np.asarray(pipe.sharded(make_mesh_2d(r, c))(img))
+            except ValueError as e:
+                if "below the minimum" not in str(e):
+                    return repro(f"sharded2d-{r}x{c}",
+                                 f"raised ValueError: {e}")
+                got = None  # image too small for this mesh; skip silently
+            except Exception as e:  # noqa: BLE001
+                return repro(f"sharded2d-{r}x{c}",
+                             f"raised {type(e).__name__}: {e}")
+            if got is not None and not np.array_equal(got, golden):
+                return repro(f"sharded2d-{r}x{c}", "mismatch")
+
+    if rng.random() < 0.25 and len(jax.devices()) >= 2:
+        # data-parallel stack (Pipeline.data_parallel), uneven N included
+        k = rng.randint(2, 5)
+        dimgs = jnp.stack(
+            [jnp.asarray(synthetic_image(h, w, channels=3, seed=trial_seed + t))
+             for t in range(k)]
+        )
+        n_dp = rng.choice([s for s in (2, 4) if s <= len(jax.devices())])
+        from mpi_cuda_imagemanipulation_tpu.parallel.mesh import make_mesh as _mm
+
+        try:
+            douts = np.asarray(pipe.data_parallel(_mm(n_dp))(dimgs))
+        except Exception as e:  # noqa: BLE001
+            return repro(f"dp-{k}over{n_dp}", f"raised {type(e).__name__}: {e}")
+        for t in range(k):
+            if not np.array_equal(douts[t], np.asarray(pipe(dimgs[t]))):
+                return repro(f"dp-{k}over{n_dp}", f"mismatch at image {t}")
+
     n_dev = len(jax.devices())
     if n_dev >= 2:
         shards = rng.choice([s for s in (2, 3, 5, n_dev) if s <= n_dev])
