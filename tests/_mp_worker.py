@@ -44,18 +44,33 @@ def main() -> int:
     assert jax.process_count() == 2, jax.process_count()
     assert jax.device_count() == 8, jax.device_count()
 
-    mesh = make_mesh()  # all 8 global devices on ('rows',)
     pipe = reference_pipeline()
     # MCIM_MP_BACKEND selects the sharded execution path (xla | pallas |
     # auto) so the ghost-fused Pallas kernels also get cross-process
-    # ppermute coverage, not just the single-process fake-device kind
+    # ppermute coverage, not just the single-process fake-device kind.
+    # MCIM_MP_MESH=2d runs the 2-D tile runner instead: a (2, 4) mesh whose
+    # 'rows' axis spans the two processes, so the vertical ppermute (and the
+    # corner relay riding the second phase) crosses a real process boundary.
     backend = os.environ.get("MCIM_MP_BACKEND", "xla")
     img = synthetic_image(128, 96, channels=3, seed=21)
 
     # every process holds the full (deterministic) image; the global array
-    # is assembled from each process's addressable row blocks — the
+    # is assembled from each process's addressable blocks — the
     # MPI_Scatter analogue across real process boundaries
-    sharding = row_sharding(mesh, 3)
+    if os.environ.get("MCIM_MP_MESH") == "2d":
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from mpi_cuda_imagemanipulation_tpu.parallel.mesh import (
+            COLS,
+            ROWS,
+            make_mesh_2d,
+        )
+
+        mesh = make_mesh_2d(2, 4)
+        sharding = NamedSharding(mesh, PartitionSpec(ROWS, COLS, None))
+    else:
+        mesh = make_mesh()  # all 8 global devices on ('rows',)
+        sharding = row_sharding(mesh, 3)
     garr = jax.make_array_from_callback(
         img.shape, sharding, lambda idx: img[idx]
     )

@@ -25,7 +25,7 @@ def _free_port() -> int:
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("backend", ["xla", "pallas"])
+@pytest.mark.parametrize("backend", ["xla", "pallas", "2d-xla"])
 def test_two_process_sharded_pipeline_bitexact(backend):
     try:
         port = _free_port()
@@ -39,7 +39,14 @@ def test_two_process_sharded_pipeline_bitexact(backend):
         env["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
         env["JAX_NUM_PROCESSES"] = "2"
         env["JAX_PROCESS_ID"] = str(pid)
-        env["MCIM_MP_BACKEND"] = backend
+        if backend == "2d-xla":
+            # 2-D tile runner over a (2, 4) mesh whose rows axis spans the
+            # two processes (see tests/_mp_worker.py)
+            env["MCIM_MP_BACKEND"] = "xla"
+            env["MCIM_MP_MESH"] = "2d"
+        else:
+            env["MCIM_MP_BACKEND"] = backend
+            env.pop("MCIM_MP_MESH", None)
         procs.append(
             subprocess.Popen(
                 [sys.executable, worker],
