@@ -243,6 +243,65 @@ def test_bench_same_round_tpu_headline(tmp_path):
     )
 
 
+def test_bench_best_of_run_and_committed(tmp_path):
+    """A healthy-but-cold round-end run must not bury a warmer committed
+    same-round TPU record (window-noise guard): the better value wins, with
+    provenance; a fresh run that IS the best stands unmodified."""
+    mod = _load_bench_module()
+    hist = tmp_path / "hist.jsonl"
+    marker = tmp_path / "ROUND_START"
+    marker.write_text("2026-07-30T17:17:31Z\n")
+    hist.write_text(
+        json.dumps(
+            {
+                "ts": "2026-07-31T01:02:00Z",
+                "headline": {
+                    "platform": "tpu", "value": 37667.3,
+                    "unit": "MP/s/chip", "impl": "pallas",
+                },
+            }
+        )
+        + "\n"
+    )
+    cold = {"value": 14075.0, "unit": "MP/s/chip", "platform": "tpu"}
+    got = mod._best_of_run_and_committed(cold, [], str(hist), str(marker))
+    assert got["value"] == 37667.3
+    assert "window-noise guard" in got["source"]
+    assert got["measured_ts"] == "2026-07-31T01:02:00Z"
+    # errors from the fresh run survive on the promoted record
+    got = mod._best_of_run_and_committed(cold, ["x failed"], str(hist), str(marker))
+    assert got["partial"] is True and got["errors"] == ["x failed"]
+    # ...but a HISTORICAL run's failure flags must not leak onto a clean
+    # current run (review finding)
+    hist.write_text(
+        json.dumps(
+            {
+                "ts": "2026-07-31T01:02:00Z",
+                "headline": {
+                    "platform": "tpu", "value": 37667.3, "unit": "MP/s/chip",
+                    "impl": "pallas", "partial": True,
+                    "errors": ["old failure"], "source": "stale",
+                },
+            }
+        )
+        + "\n"
+    )
+    got = mod._best_of_run_and_committed(cold, [], str(hist), str(marker))
+    assert got["value"] == 37667.3
+    assert "partial" not in got and "errors" not in got
+    assert "window-noise guard" in got["source"]
+    # a fresh run that beats the committed record stands as-is
+    warm = {"value": 48000.0, "unit": "MP/s/chip", "platform": "tpu"}
+    assert mod._best_of_run_and_committed(warm, [], str(hist), str(marker)) is warm
+    # no committed record at all -> unchanged
+    assert (
+        mod._best_of_run_and_committed(
+            cold, [], str(tmp_path / "none.jsonl"), str(marker)
+        )
+        is cold
+    )
+
+
 def test_bench_main_promotes_same_round_record(monkeypatch, capsys):
     """With the tunnel down and a same-round TPU record committed, bench.py
     main() must emit that record (labelled) instead of a CPU fallback."""
