@@ -768,6 +768,20 @@ def cmd_info(args: argparse.Namespace) -> int:
         print(f"native codec: {'available' if codec.available() else 'not built'}")
     except Exception:
         print("native codec: not built")
+    from mpi_cuda_imagemanipulation_tpu.utils import calibration
+
+    entries = calibration._load().get("device_kinds") or {}
+    if entries:
+        pairs = ", ".join(
+            f"{kind}/{impl}: block_h={rec.get('block_h')}"
+            for kind, impls in sorted(entries.items())
+            if isinstance(impls, dict)
+            for impl, rec in sorted(impls.items())
+            if isinstance(rec, dict)
+        )
+        print(f"autotune calibration ({calibration.calib_path()}): {pairs}")
+    else:
+        print("autotune calibration: none (run `mcim-tpu autotune`)")
     return 0
 
 
