@@ -27,6 +27,24 @@ sys.path.insert(0, REPO)
 
 
 def main() -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--config",
+        default=None,
+        help="bench config to capture (default: the headline); e.g. "
+        "gaussian5_8k_sharded for the fused-ghost shard_map record "
+        "(VERDICT r2 directive #2)",
+    )
+    ap.add_argument(
+        "--impls",
+        default="pallas,packed",
+        help="comma-separated impls, measured in order (first = the one "
+        "worth having if the window dies mid-step)",
+    )
+    args = ap.parse_args()
+
     import jax
 
     from mpi_cuda_imagemanipulation_tpu.bench_suite import (
@@ -36,39 +54,57 @@ def main() -> int:
         run_config,
     )
 
+    cfg_name = args.config or HEADLINE
+    if cfg_name not in CONFIGS:
+        print(f"unknown config {cfg_name!r}", file=sys.stderr)
+        return 2
+
     backend = jax.default_backend()
     print(f"backend: {backend}", flush=True)
     if backend not in ("tpu", "axon"):
         print("not a TPU backend; refusing to record", file=sys.stderr)
         return 3
 
-    # pallas first (the committed baseline impl — worth having even if the
-    # window dies mid-step), then the packed-u32 candidate. Each impl's
-    # record is appended to BENCH_HISTORY.jsonl IMMEDIATELY after its
-    # measurement (and the queue step commits whatever landed even when a
-    # later impl wedges), so a window only long enough for one compile
-    # still leaves a committed same-round TPU headline.
+    # Each impl's record is appended to BENCH_HISTORY.jsonl IMMEDIATELY
+    # after its measurement (and the queue step commits whatever landed even
+    # when a later impl wedges), so a window only long enough for one
+    # compile still leaves a committed same-round TPU record.
+    impls = [s.strip() for s in args.impls.split(",") if s.strip()]
+    bad = [s for s in impls if s not in ("xla", "pallas", "packed", "auto")]
+    if bad or not impls:
+        print(f"unknown impls {bad or args.impls!r}", file=sys.stderr)
+        return 2
+
     records = []
-    for impl in ("pallas", "packed"):
+    for impl in impls:
         try:
-            rec = run_config(CONFIGS[HEADLINE], impl)
+            rec = run_config(CONFIGS[cfg_name], impl)
         except Exception as e:  # one impl crashing must not lose the other
             print(f"{impl} failed: {e}", file=sys.stderr)
             continue
         records.append(rec)
         print(json.dumps(rec), flush=True)
+        # headline_record qualifies the headline config AND its _sharded
+        # variant (on a pod the sharded run is the relevant headline); for
+        # any other config it is None and the entry carries records only.
+        # A sharded capture's headline competes in bench.py's same-round
+        # promotion best-by-value, so a slower sharded record never
+        # displaces a faster same-round unsharded one.
         entry = {
             "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-            "headline": headline_record(records),
             "records": list(records),
-            "note": f"quick_headline (first-window fast capture, {impl})",
+            "note": f"quick capture ({cfg_name}, {impl})",
         }
+        head = headline_record(records)
+        if head is not None:
+            entry["headline"] = head
         if not os.environ.get("MCIM_NO_HISTORY"):
             with open(os.path.join(REPO, "BENCH_HISTORY.jsonl"), "a") as f:
                 f.write(json.dumps(entry) + "\n")
     if not records:
         return 4
-    print(json.dumps(headline_record(records)), flush=True)
+    final = headline_record(records)
+    print(json.dumps(final if final is not None else records[-1]), flush=True)
     return 0
 
 

@@ -1,0 +1,18 @@
+#!/bin/bash
+# Minimal VERDICT-r2-directive-#2 artifact: ONE on-chip record of the
+# fused-ghost sharded config (gaussian5_8k_sharded, pallas first), captured
+# the quick_headline way so a short window suffices; xla second for the
+# same-window contrast. Per-impl incremental history appends + immediate
+# commit, same crash posture as step 15. The sharded config qualifies as a
+# headline (bench_suite.headline_record), but promotion is best-by-value,
+# so this record only becomes the round headline if it actually wins.
+set -u
+cd "$(dirname "$0")/../.."
+. tools/tpu_queue/_lib.sh
+timeout 2100 python tools/quick_headline.py \
+  --config gaussian5_8k_sharded --impls pallas,xla \
+  > quick_sharded_r03.out 2>&1
+rc=$?
+commit_artifacts "TPU window: sharded-config quick capture (fused-ghost on-chip record)" \
+  BENCH_HISTORY.jsonl quick_sharded_r03.out
+exit $rc
