@@ -109,6 +109,40 @@ def test_2d_rejects_pallas_backend():
         Pipeline.parse("gaussian:5").sharded(make_mesh_2d(2, 4), backend="pallas")
 
 
+@pytest.mark.parametrize("mode", ["reflect101", "edge", "zero"])
+@pytest.mark.parametrize("axis", [0, 1])
+def test_fix_edge_axis_matches_golden_pad(mode, axis):
+    """Unit-level check of the axis-general edge machinery: on a single
+    shard (no ppermute), exchange+fix along one axis must reproduce the
+    golden pad2d extension exactly, for every edge mode and both axes."""
+    import jax.numpy as jnp
+
+    from mpi_cuda_imagemanipulation_tpu.ops.spec import StencilOp, pad2d
+    from mpi_cuda_imagemanipulation_tpu.parallel.api2d import (
+        _exchange_axis,
+        _fix_edge_axis,
+    )
+
+    h = 2
+    op = StencilOp(
+        name="t", halo=h, kernels=(np.ones((5, 5), np.float32),),
+        edge_mode=mode, quantize="trunc_clip",
+    )
+    tile = jnp.asarray(
+        synthetic_image(11, 13, channels=1, seed=3).astype(np.float32)
+    )
+    axis_name = "rows" if axis == 0 else "cols"
+    got = _fix_edge_axis(
+        _exchange_axis(tile, h, 1, axis_name, axis),
+        op, jnp.int32(0), tile.shape[axis], axis,
+    )
+    pads = (h, h, 0, 0) if axis == 0 else (0, 0, h, h)
+    want = pad2d(tile, mode, *pads)
+    assert np.array_equal(np.asarray(got), np.asarray(want)), (
+        f"{mode}/axis{axis}: edge fix diverged from golden pad"
+    )
+
+
 def test_parse_shards():
     from mpi_cuda_imagemanipulation_tpu.parallel.mesh import parse_shards
 
