@@ -1,0 +1,296 @@
+#!/usr/bin/env python
+"""SWAR quarter-strip prototype for the headline 5x5 Gaussian (run on TPU).
+
+The round-3 first window established (BASELINE.md): u8 streams are
+element-rate-capped (~95 Ge/s measured vs ~400 GB/s f32 byte rate), the u8
+production kernel already sits at ~94% of that ceiling, and the existing
+packed-u32 path is 3.2x SLOWER — because it unpacks every word into 4 f32
+lane planes (ops/packed_kernels._lanes_f32), paying the same VPU element
+count as the u8 path plus shift/mask and lane-rotation overhead.
+
+This prototype tests the design that actually exploits the element-rate
+model, with two ingredients the production packed path lacks:
+
+1. **Quarter-strip (SoA) packing**: the row is split into 4 equal strips
+   and byte k of word j is strip k's pixel j — so a horizontal tap is a
+   plain word-column shift for all 4 strips simultaneously. No per-tap
+   byte-granular recombination across words (the production packed
+   layout interleaves adjacent pixels, forcing cross-lane byte algebra).
+2. **SWAR 16-bit fields**: words are split once into two u32 arrays
+   holding 2x16-bit fields each (bytes 0,2 and bytes 1,3). The whole
+   separable correlation runs as u32 mul/add on those fields — 2 pixels
+   per 32-bit element, half the VPU element count of f32-lane compute,
+   and exact: binomial taps keep every field < 2^16
+   (row max 255*16 = 4080; column max 4080*16 = 65,280), and the final
+   x 2^-8 + round-half-to-even is the integer identity
+   q = (s + 127 + (q0 & 1)) >> 8 with q0 = s >> 8 — asserted bit-exact
+   against the golden StencilOp on every run before anything is timed.
+
+Cases measured (each `device_throughput`, with the element-rate context):
+  swar_xla_prepacked    — whole-array jnp SWAR on pre-packed input
+                          (steady-state kernel bound; pack cost excluded)
+  swar_pallas_prepacked — row-block streaming Pallas variant of the same
+  swar_pack_cost        — the one-time quarter-strip pack + unpack round
+                          trip (what a packed pipeline would amortise)
+  gaussian5_8k_pallas   — the production u8 kernel, same process/chip state
+
+Usage: python tools/swar_proto.py [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# fixed-configuration probe: calibration must not steer the production
+# comparison case (utils/calibration.py kill-switch)
+os.environ.setdefault("MCIM_NO_CALIB", "1")
+
+TAPS = (1, 4, 6, 4, 1)  # binomial_1d(5); scale 1/256 total (ops/filters.py)
+H_ = 2  # halo
+
+
+def build_fns():
+    import jax
+    import jax.numpy as jnp
+
+    # python-int literals (not traced jnp constants: a pallas kernel body
+    # must not capture tracers); & / + with a uint32 array stays uint32
+    M_LO = 0x00FF00FF
+    M_B = 0x00010001
+    M_127 = 0x007F007F
+
+    def pack_quarters(xpad):
+        """(H+2h, W+2h) u8 reflect-padded plane -> (H+2h, Ws+2h) u32 words;
+        byte k of word j = quarter-strip k's padded pixel j. Each strip's
+        ext covers [k*Ws, k*Ws + Ws + 2h) of the padded row, so every
+        horizontal tap is word-local."""
+        Hp, Wp2 = xpad.shape
+        Ws = (Wp2 - 2 * H_) // 4
+        strips = [xpad[:, k * Ws : k * Ws + Ws + 2 * H_] for k in range(4)]
+        stacked = jnp.stack(strips, axis=-1)  # (Hp, Ws+2h, 4) u8
+        return jax.lax.bitcast_convert_type(stacked, jnp.uint32)
+
+    def unpack_quarters(words):
+        """(H, Ws) u32 -> (H, 4*Ws) u8 by reassembling the 4 quarter strips."""
+        b = jax.lax.bitcast_convert_type(words, jnp.uint8)  # (H, Ws, 4)
+        return jnp.concatenate([b[..., k] for k in range(4)], axis=1)
+
+    def swar_gaussian5_words(ext):
+        """(H+2h, Ws+2h) u32 ext words -> (H, Ws) u32 output words: the
+        composition of the shared row/column helpers (the Pallas carry
+        kernel uses the same two, so the variants cannot drift)."""
+        return _col_finalize(*_row_pass_fields(ext))
+
+    def swar_xla(ext_words):
+        return swar_gaussian5_words(ext_words)
+
+    def _row_pass_fields(ext_block):
+        """(bh, Ws+2h) u32 words -> two (bh, Ws) u32 field arrays (bytes
+        0,2 and 1,3 as 16-bit fields), row-correlated with the binomial
+        taps. Fields <= 4080."""
+        lo = ext_block & M_LO
+        hi = (ext_block >> 8) & M_LO
+
+        def row(a):
+            acc = a[:, 0 : a.shape[1] - 4] * jnp.uint32(TAPS[0])
+            for t in range(1, 5):
+                acc = acc + a[:, t : a.shape[1] - 4 + t] * jnp.uint32(TAPS[t])
+            return acc
+
+        return row(lo), row(hi)
+
+    def _col_finalize(lo_rows, hi_rows):
+        """(bh+2h, Ws) field arrays -> (bh, Ws) u32 output words: column
+        pass + x 2^-8 round-half-to-even + byte repack."""
+
+        def col(a):
+            acc = a[0 : a.shape[0] - 4, :] * jnp.uint32(TAPS[0])
+            for t in range(1, 5):
+                acc = acc + a[t : a.shape[0] - 4 + t, :] * jnp.uint32(TAPS[t])
+            return acc
+
+        def rnd(s):
+            b = (s >> 8) & M_B
+            return ((s + M_127 + b) >> 8) & M_LO
+
+        return rnd(col(lo_rows)) | (rnd(col(hi_rows)) << 8)
+
+    def make_swar_pallas(ext_shape, bh, *, interpret=False):
+        """Streaming SWAR kernel with the production scratch-carry
+        structure (ops/pallas_kernels.stencil_tile_pallas): input blocks of
+        `bh` ext rows stream in non-overlapping; the row-passed fields of
+        the previous block live in VMEM scratch, and output block i-1 is
+        the column pass over [scratch ; first 2h rows of block i]. Needs
+        bh | (ext_rows - 2h) and bh >= 2h."""
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        Hp, Wsp = ext_shape  # (H+2h, Ws+2h)
+        H = Hp - 2 * H_
+        Ws = Wsp - 2 * H_
+        assert H % bh == 0 and bh >= 2 * H_, (H, bh)
+        nb = H // bh
+        nb_in = -(-Hp // bh)  # last block holds the 2h-row bottom halo
+
+        def kernel(in_ref, out_ref, lo_ref, hi_ref):
+            i = pl.program_id(0)
+            rlo, rhi = _row_pass_fields(in_ref[:])
+
+            @pl.when(i >= 1)
+            def _():
+                lo_rows = jnp.concatenate([lo_ref[:], rlo[: 2 * H_]], axis=0)
+                hi_rows = jnp.concatenate([hi_ref[:], rhi[: 2 * H_]], axis=0)
+                out_ref[:] = _col_finalize(lo_rows, hi_rows)
+
+            lo_ref[:] = rlo
+            hi_ref[:] = rhi
+
+        return pl.pallas_call(
+            kernel,
+            grid=(nb + 1,),
+            in_specs=[
+                pl.BlockSpec(
+                    (bh, Wsp),
+                    lambda i: (jnp.minimum(i, nb_in - 1), 0),
+                    memory_space=pltpu.VMEM,
+                )
+            ],
+            out_specs=pl.BlockSpec(
+                (bh, Ws),
+                lambda i: (jnp.maximum(i - 1, 0), 0),
+                memory_space=pltpu.VMEM,
+            ),
+            out_shape=jax.ShapeDtypeStruct((nb * bh, Ws), jnp.uint32),
+            scratch_shapes=[
+                pltpu.VMEM((bh, Ws), jnp.uint32),
+                pltpu.VMEM((bh, Ws), jnp.uint32),
+            ],
+            interpret=interpret,
+        )
+
+    return pack_quarters, unpack_quarters, swar_xla, make_swar_pallas
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--height", type=int, default=4320)
+    ap.add_argument("--width", type=int, default=7680)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mpi_cuda_imagemanipulation_tpu.io.image import synthetic_image
+    from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline
+    from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
+        pipeline_pallas,
+    )
+    from mpi_cuda_imagemanipulation_tpu.ops.registry import make_pipeline_ops
+    from mpi_cuda_imagemanipulation_tpu.ops.spec import pad2d
+    from mpi_cuda_imagemanipulation_tpu.utils.timing import device_throughput
+
+    pack_quarters, unpack_quarters, swar_xla, make_swar_pallas = build_fns()
+
+    H, W = args.height, args.width
+    assert W % 4 == 0
+    Ws = W // 4
+    print(f"backend: {jax.default_backend()}", flush=True)
+
+    def emit(rec):
+        print(json.dumps(rec), flush=True)
+
+    # ---- bit-exactness gate (small image) BEFORE any timing ----
+    pipe = Pipeline.parse("gaussian:5")
+    for th, tw, seed in ((48, 64, 1), (37, 128, 2), (130, 256, 3)):
+        img = jnp.asarray(synthetic_image(th, tw, channels=1, seed=seed))
+        golden = np.asarray(pipe(img))
+        xpad = pad2d(img.astype(jnp.float32), "reflect101", H_, H_, H_, H_)
+        ext = pack_quarters(xpad.astype(jnp.uint8))
+        outw = jax.jit(swar_xla)(ext)
+        got = np.asarray(unpack_quarters(outw))
+        if not np.array_equal(got, golden):
+            d = np.argwhere(got != golden)
+            print(
+                f"SWAR MISMATCH at {th}x{tw}: {len(d)} pixels, first {d[0]} "
+                f"got {got[tuple(d[0])]} want {golden[tuple(d[0])]}",
+                file=sys.stderr,
+            )
+            return 1
+    # the streaming kernel's carry structure, in interpret mode
+    timg = jnp.asarray(synthetic_image(48, 64, channels=1, seed=4))
+    tgold = np.asarray(pipe(timg))
+    tpad = jnp.asarray(np.pad(np.asarray(timg), H_, mode="reflect"))
+    text = pack_quarters(tpad)
+    toutw = make_swar_pallas(text.shape, 16, interpret=True)(text)
+    tgot = np.asarray(unpack_quarters(toutw[:48]))
+    if not np.array_equal(tgot, tgold):
+        print("SWAR pallas (carry) MISMATCH at 48x64", file=sys.stderr)
+        return 1
+    print("bit-exactness gate: SWAR == golden on 3 shapes + carry kernel", flush=True)
+
+    if jax.default_backend() not in ("tpu", "axon"):
+        print("self-test passed; timing needs the chip — exiting", flush=True)
+        return 0
+
+    # ---- timing ----
+    img = jnp.asarray(synthetic_image(H, W, channels=1, seed=99))
+    xpad_u8 = jnp.asarray(
+        np.pad(np.asarray(img), H_, mode="reflect")  # reflect101 == np reflect
+    )
+    ext = jax.jit(pack_quarters)(xpad_u8)
+    ext.block_until_ready()
+    mp = H * W / 1e6
+
+    cases = [
+        ("swar_xla_prepacked", jax.jit(swar_xla), [ext]),
+    ]
+    for bh in (120, 240, 480):
+        if H % bh:
+            continue
+        f = jax.jit(lambda x, b=bh: make_swar_pallas(x.shape, b)(x)[:H, :])
+        cases.append((f"swar_pallas_prepacked_bh{bh}", f, [ext]))
+    cases += [
+        (
+            "swar_pack_cost",
+            jax.jit(lambda x: unpack_quarters(pack_quarters(x))),
+            [xpad_u8],
+        ),
+        (
+            "gaussian5_8k_pallas",
+            jax.jit(
+                lambda x: pipeline_pallas(make_pipeline_ops("gaussian:5"), x)
+            ),
+            [img],
+        ),
+    ]
+    rounds = 1 if args.quick else 3
+    best: dict = {}
+    for rnd in range(1, rounds + 1):
+        for name, fn, fa in cases:
+            try:
+                sec = device_throughput(fn, fa)
+            except Exception as e:
+                emit({"case": name, "round": rnd, "error": str(e)[:200]})
+                continue
+            rec = {
+                "case": name, "round": rnd, "ms": sec * 1e3,
+                "mp_s": mp / sec,
+            }
+            emit(rec)
+            if name not in best or sec < best[name][0]:
+                best[name] = (sec, rec)
+    for name, (sec, rec) in best.items():
+        emit({**{k: v for k, v in rec.items() if k != "round"},
+              "stat": f"best_of_{rounds}"})
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,14 @@
+#!/bin/bash
+# SWAR quarter-strip prototype timing: the element-rate exploitation design
+# that the measured-slow packed-f32-lane path lacked (see tools/swar_proto.py
+# docstring). Bit-exactness gates run before any timing; 3-round per-case
+# bests like the roofline probe. If swar_pallas beats the production u8
+# kernel (~0.7 ms best window), promote the design into ops/ next.
+set -u
+cd "$(dirname "$0")/../.."
+. tools/tpu_queue/_lib.sh
+timeout 2700 python tools/swar_proto.py > swar_proto_r03.out 2>&1
+rc=$?
+commit_artifacts "TPU window: SWAR quarter-strip prototype timings" \
+  swar_proto_r03.out
+exit $rc
