@@ -262,6 +262,28 @@ def main() -> int:
             jax.jit(lambda x: unpack_quarters(pack_quarters(x))),
             [xpad_u8],
         ),
+    ]
+    if H % 240 == 0:
+        # what a SINGLE-op production pipeline would pay: pad + pack, the
+        # best streaming kernel, unpack — decides whether SWAR wins
+        # stand-alone or only amortised across packed op chains
+        cases.append(
+            (
+                "swar_end_to_end",
+                jax.jit(
+                    lambda x: unpack_quarters(
+                        make_swar_pallas(
+                            (x.shape[0] + 2 * H_, x.shape[1] // 4 + 2 * H_),
+                            240,
+                        )(pack_quarters(jnp.pad(x, H_, mode="reflect")))[
+                            : x.shape[0], :
+                        ]
+                    )
+                ),
+                [img],
+            )
+        )
+    cases += [
         (
             "gaussian5_8k_pallas",
             jax.jit(
