@@ -46,10 +46,6 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# fixed-configuration probe: calibration must not steer the production
-# comparison case (utils/calibration.py kill-switch)
-os.environ.setdefault("MCIM_NO_CALIB", "1")
-
 TAPS = (1, 4, 6, 4, 1)  # binomial_1d(5); scale 1/256 total (ops/filters.py)
 H_ = 2  # halo
 
@@ -189,6 +185,22 @@ def main() -> int:
     ap.add_argument("--height", type=int, default=4320)
     ap.add_argument("--width", type=int, default=7680)
     args = ap.parse_args()
+    # fixed-configuration probe: calibration must not steer the production
+    # comparison case. Set inside main (not at import: tpu_validate and the
+    # pytest gates import this module, and a module-level setdefault would
+    # leak into their process env — review finding), restored on exit.
+    saved_calib = os.environ.get("MCIM_NO_CALIB")
+    os.environ["MCIM_NO_CALIB"] = "1"
+    try:
+        return _main(args)
+    finally:
+        if saved_calib is None:
+            os.environ.pop("MCIM_NO_CALIB", None)
+        else:
+            os.environ["MCIM_NO_CALIB"] = saved_calib
+
+
+def _main(args) -> int:
 
     import jax
     import jax.numpy as jnp

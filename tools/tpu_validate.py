@@ -210,6 +210,51 @@ def run_sweep(shapes, results) -> int:
             lambda: pipe.sharded(mesh, backend="pallas")(img),
         )
 
+    # 2-D tile runner (parallel/api2d) on a 1x1 device mesh: both
+    # ppermute-free exchange paths + axis-general edge fixups get a
+    # compiled silicon run without a pod (same rationale as the 1-D
+    # make_mesh(1) cases above)
+    from mpi_cuda_imagemanipulation_tpu.parallel.mesh import make_mesh_2d
+
+    mesh2 = make_mesh_2d(1, 1)
+    for spec, ch in SHARDED_CASES:
+        pipe = Pipeline.parse(spec)
+        hw = shapes[0]
+        img = jnp.asarray(synthetic_image(*hw, channels=ch, seed=29))
+        fails += not _check(
+            results, "sharded2d", spec, ch, hw,
+            lambda: golden_of(pipe.ops, img),
+            lambda: pipe.sharded(mesh2)(img),
+        )
+
+    # SWAR quarter-strip carry kernel (tools/swar_proto.py), compiled: the
+    # Mosaic lowering of the u32 field algebra gets a hardware record even
+    # before the timing step runs
+    import importlib.util as _ilu
+
+    _spec = _ilu.spec_from_file_location(
+        "swar_proto",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "swar_proto.py"),
+    )
+    _swar = _ilu.module_from_spec(_spec)
+    _spec.loader.exec_module(_swar)
+    _pack, _unpack, _, _mk = _swar.build_fns()
+    import numpy as _np
+
+    _interp = jax.default_backend() not in ("tpu", "axon")
+    for sh, sbh in ((129, 32), (96, 48)):
+        simg = jnp.asarray(synthetic_image(sh, 128, channels=1, seed=31))
+        spipe = Pipeline.parse("gaussian:5")
+        spad = jnp.asarray(
+            _np.pad(_np.asarray(simg), _swar.H_, mode="reflect")
+        )
+        sext = _pack(spad)
+        fails += not _check(
+            results, f"swar_bh{sbh}", "gaussian:5", 1, (sh, 128),
+            lambda: golden_of(spipe.ops, simg),
+            lambda: _unpack(_mk(sext.shape, sbh, interpret=_interp)(sext)[:sh]),
+        )
+
     from mpi_cuda_imagemanipulation_tpu.utils.guard import run_guarded
 
     for spec, ch, impl in GUARDED_CASES:
