@@ -23,3 +23,15 @@ claim_platform("cpu", n_host_devices=8, keep_existing_count=True)
 # any bench.py run spawned from a test must not append to the committed
 # BENCH_HISTORY.jsonl (bench.py _append_history honors this)
 os.environ["MCIM_NO_HISTORY"] = "1"
+
+# share the persistent XLA compilation cache (tools/tpu_queue/_lib.sh):
+# CPU executables cache too, cutting repeat full-suite wall time — keyed
+# on HLO + compile options, so cached runs cannot change results
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools",
+        ".jax_cache",
+    ),
+)
