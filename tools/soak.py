@@ -366,6 +366,29 @@ def run_repro(line: str) -> int:
                 )(img),
                 skip_on_min_guard=True,
             )
+    # the paths the fuzzer samples randomly get deterministic repro
+    # coverage too: every 2-D mesh geometry it draws, and a DP stack
+    if n_dev >= 4:
+        from mpi_cuda_imagemanipulation_tpu.parallel.mesh import make_mesh_2d
+
+        for r, c in ((2, 2), (2, 3), (2, 4), (4, 2)):
+            if r * c <= n_dev:
+                check(
+                    f"sharded2d-{r}x{c}",
+                    lambda r=r, c=c: pipe.sharded(make_mesh_2d(r, c))(img),
+                    skip_on_min_guard=True,
+                )
+    if n_dev >= 2:
+        imgs_dp = jnp.stack(
+            [jnp.asarray(synthetic_image(h, w, channels=3, seed=seed + t))
+             for t in range(3)]
+        )
+        for t in range(3):
+            check(
+                f"dp[{t}]",
+                lambda t=t: pipe.data_parallel(make_mesh(2))(imgs_dp)[t],
+                golden_override=np.asarray(pipe(imgs_dp[t])),
+            )
     return rc
 
 
