@@ -156,6 +156,22 @@ def test_parse_shards():
         parse_shards("2x0")
 
 
+@needs_8dev
+def test_mesh_from_shards():
+    from mpi_cuda_imagemanipulation_tpu.parallel.mesh import mesh_from_shards
+
+    assert mesh_from_shards("1") is None  # bare 1 = unsharded
+    assert mesh_from_shards(1) is None
+    m = mesh_from_shards("4")
+    assert m.axis_names == ("rows",) and m.devices.size == 4
+    m2 = mesh_from_shards("2x4")
+    assert m2.axis_names == ("rows", "cols") and m2.shape["cols"] == 4
+    # an explicit RxC is a 2-D request even when a dim is 1
+    m18 = mesh_from_shards("1x8")
+    assert m18.axis_names == ("rows", "cols") and m18.devices.size == 8
+    assert mesh_from_shards("1x1").devices.size == 1
+
+
 def test_cli_guarded_2d_pallas_fails_cleanly(tmp_path, capsys):
     """--device-timeout + --shards RxC + --impl pallas must fail with the
     clean one-line error BEFORE spawning the watchdog child (review
