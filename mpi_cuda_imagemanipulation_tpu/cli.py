@@ -770,7 +770,7 @@ def cmd_info(args: argparse.Namespace) -> int:
         print("native codec: not built")
     from mpi_cuda_imagemanipulation_tpu.utils import calibration
 
-    entries = calibration._load().get("device_kinds") or {}
+    entries = calibration.entries()
     if entries:
         pairs = ", ".join(
             f"{kind}/{impl}: block_h={rec.get('block_h')}"

@@ -43,39 +43,55 @@ def _reflect101_index(g: jnp.ndarray, size: int) -> jnp.ndarray:
     return (size - 1) - jnp.abs((size - 1) - a)
 
 
+def _fix_edge_axis(
+    ext: jnp.ndarray,
+    op: StencilOp,
+    off: jnp.ndarray,
+    global_size: int,
+    axis: int,
+) -> jnp.ndarray:
+    """Overwrite ghost/padding slices along `axis` whose global index falls
+    outside the real image with the op's edge extension.
+
+    Slices needing fixes are (a) ring-wrapped halos on the first/last shard
+    and (b) the pad-to-multiple slices at the global end. Sources are
+    gathered from within this shard's extended tile — feasibility is
+    checked statically by the segment runners. Axis-general: the 1-D row
+    runner fixes axis 0; the 2-D tile runner (parallel/api2d) applies it
+    per axis (reflect-101 is separable, so row fix before the column
+    exchange plus column fix after yields golden corner values).
+    """
+    ext_sz = ext.shape[axis]
+    h = op.halo
+    g = off - h + lax.iota(jnp.int32, ext_sz)
+    outside = (g < 0) | (g >= global_size)
+    bshape = [1] * ext.ndim
+    bshape[axis] = ext_sz
+    outside_b = outside.reshape(bshape)
+    if op.edge_mode in ("interior", "zero"):
+        # zero out-of-image slices; 'interior' never reads them (masked),
+        # but zeroing keeps tile values identical to the golden zero-padded
+        # path.
+        return jnp.where(outside_b, jnp.zeros_like(ext), ext)
+    if op.edge_mode == "reflect101":
+        src_g = _reflect101_index(g, global_size)
+    elif op.edge_mode == "edge":
+        src_g = jnp.clip(g, 0, global_size - 1)
+    else:  # pragma: no cover
+        raise ValueError(f"unknown edge mode {op.edge_mode!r}")
+    src_local = jnp.clip(src_g - (off - h), 0, ext_sz - 1)
+    gathered = jnp.take(ext, src_local, axis=axis)
+    return jnp.where(outside_b, gathered, ext)
+
+
 def _fix_edge_rows(
     ext: jnp.ndarray,
     op: StencilOp,
     y0: jnp.ndarray,
     global_h: int,
 ) -> jnp.ndarray:
-    """Overwrite ghost/padding rows whose global index falls outside the real
-    image with the op's edge extension.
-
-    Rows needing fixes are (a) ring-wrapped halos on the first/last shard and
-    (b) the pad-to-multiple rows at the global bottom. Sources are gathered
-    from within this shard's extended tile — feasibility is checked
-    statically in sharded_pipeline.
-    """
-    ext_h = ext.shape[0]
-    h = op.halo
-    g = y0 - h + lax.broadcasted_iota(jnp.int32, (ext_h, 1), 0)[:, 0]
-    outside = (g < 0) | (g >= global_h)
-    if op.edge_mode in ("interior", "zero"):
-        # zero out-of-image rows; 'interior' never reads them (masked), but
-        # zeroing keeps tile values identical to the golden zero-padded path.
-        outside_b = outside.reshape((-1,) + (1,) * (ext.ndim - 1))
-        return jnp.where(outside_b, jnp.zeros_like(ext), ext)
-    if op.edge_mode == "reflect101":
-        src_g = _reflect101_index(g, global_h)
-    elif op.edge_mode == "edge":
-        src_g = jnp.clip(g, 0, global_h - 1)
-    else:  # pragma: no cover
-        raise ValueError(f"unknown edge mode {op.edge_mode!r}")
-    src_local = jnp.clip(src_g - (y0 - h), 0, ext_h - 1)
-    gathered = jnp.take(ext, src_local, axis=0)
-    outside_b = outside.reshape((-1,) + (1,) * (ext.ndim - 1))
-    return jnp.where(outside_b, gathered, ext)
+    """Row-axis form of _fix_edge_axis (the 1-D runner's call shape)."""
+    return _fix_edge_axis(ext, op, y0, global_h, 0)
 
 
 def _fix_edge_strips(

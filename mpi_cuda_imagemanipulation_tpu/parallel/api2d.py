@@ -37,69 +37,9 @@ from mpi_cuda_imagemanipulation_tpu.ops.spec import (
     PointwiseOp,
     StencilOp,
 )
-from mpi_cuda_imagemanipulation_tpu.parallel.api import _reflect101_index
+from mpi_cuda_imagemanipulation_tpu.parallel.api import _fix_edge_axis
+from mpi_cuda_imagemanipulation_tpu.parallel.halo import exchange_halo
 from mpi_cuda_imagemanipulation_tpu.parallel.mesh import COLS, ROWS
-
-
-def _exchange_axis(
-    tile: jnp.ndarray, halo: int, n: int, axis_name: str, axis: int
-) -> jnp.ndarray:
-    """Extend `tile` with `halo` ghost slices on both sides of `axis`,
-    moved from ring neighbours along mesh axis `axis_name`.
-
-    With n == 1 (or for shard 0 / n-1, whose ring partner wraps around the
-    image) the ghost content is not meaningful; every out-of-image slice is
-    overwritten by _fix_edge_axis before any op reads it.
-    """
-    if halo == 0:
-        return tile
-    idx = [slice(None)] * tile.ndim
-    if n == 1:
-        shape = list(tile.shape)
-        shape[axis] = halo
-        zeros = jnp.zeros(shape, tile.dtype)
-        return jnp.concatenate([zeros, tile, zeros], axis=axis)
-    idx[axis] = slice(-halo, None)
-    last = tile[tuple(idx)]
-    idx[axis] = slice(None, halo)
-    first = tile[tuple(idx)]
-    fwd = [(i, (i + 1) % n) for i in range(n)]
-    bwd = [(i, (i - 1) % n) for i in range(n)]
-    before = lax.ppermute(last, axis_name, fwd)  # neighbour's tail = my head ghost
-    after = lax.ppermute(first, axis_name, bwd)
-    return jnp.concatenate([before, tile, after], axis=axis)
-
-
-def _fix_edge_axis(
-    ext: jnp.ndarray,
-    op: StencilOp,
-    off: jnp.ndarray,
-    global_size: int,
-    axis: int,
-) -> jnp.ndarray:
-    """Overwrite ghost/padding slices along `axis` whose global index falls
-    outside the real image with the op's edge extension (the axis-general
-    form of parallel.api._fix_edge_rows; reflect-101 is separable per axis,
-    so applying the row fix before the column exchange and the column fix
-    after yields golden corner values)."""
-    ext_sz = ext.shape[axis]
-    h = op.halo
-    g = off - h + lax.iota(jnp.int32, ext_sz)
-    outside = (g < 0) | (g >= global_size)
-    bshape = [1] * ext.ndim
-    bshape[axis] = ext_sz
-    outside_b = outside.reshape(bshape)
-    if op.edge_mode in ("interior", "zero"):
-        return jnp.where(outside_b, jnp.zeros_like(ext), ext)
-    if op.edge_mode == "reflect101":
-        src_g = _reflect101_index(g, global_size)
-    elif op.edge_mode == "edge":
-        src_g = jnp.clip(g, 0, global_size - 1)
-    else:  # pragma: no cover
-        raise ValueError(f"unknown edge mode {op.edge_mode!r}")
-    src_local = jnp.clip(src_g - (off - h), 0, ext_sz - 1)
-    gathered = jnp.take(ext, src_local, axis=axis)
-    return jnp.where(outside_b, gathered, ext)
 
 
 def _apply_stencil_2d(
@@ -116,12 +56,14 @@ def _apply_stencil_2d(
     h = op.halo
     # phase 1: vertical ghosts + vertical edge fix (on the raw tile)
     ext = _fix_edge_axis(
-        _exchange_axis(tile, h, n_r, ROWS, 0), op, y0, global_h, 0
+        exchange_halo(tile, h, n_r, axis_name=ROWS, axis=0),
+        op, y0, global_h, 0,
     )
     # phase 2: horizontal ghosts carry the vertically-extended strips, so
     # corner ghosts arrive via the shared neighbour; then horizontal fix
     ext = _fix_edge_axis(
-        _exchange_axis(ext, h, n_c, COLS, 1), op, x0, global_w, 1
+        exchange_halo(ext, h, n_c, axis_name=COLS, axis=1),
+        op, x0, global_w, 1,
     )
     if tile.ndim == 3:
         return jnp.stack(

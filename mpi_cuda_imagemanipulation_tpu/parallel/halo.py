@@ -18,33 +18,58 @@ from mpi_cuda_imagemanipulation_tpu.parallel.mesh import ROWS
 
 
 def exchange_halo_strips(
-    tile: jnp.ndarray, halo: int, n_shards: int
+    tile: jnp.ndarray,
+    halo: int,
+    n_shards: int,
+    *,
+    axis_name: str = ROWS,
+    axis: int = 0,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Return the (top, bottom) ghost-row strips for `tile`, each (halo, ...).
+    """Return the (before, after) ghost strips for `tile` along `axis`,
+    each `halo` slices thick.
 
-    Two ring ppermutes over the 'rows' axis: the "down" ring carries each
-    shard's last rows to its south neighbour (becoming that neighbour's top
-    halo); the "up" ring carries first rows north. Rings are full
-    permutations (XLA requires a bijection), so shard 0's top strip and shard
-    n-1's bottom strip arrive wrapped from the opposite end of the image —
-    callers mask or overwrite them with the op's edge extension
-    (ops never read unfixed wrapped rows; see parallel.api._apply_stencil).
+    Two ring ppermutes over the mesh axis `axis_name`: the "down" ring
+    carries each shard's last slices to its successor (becoming that
+    neighbour's leading halo); the "up" ring carries first slices back.
+    Rings are full permutations (XLA requires a bijection), so shard 0's
+    leading strip and shard n-1's trailing strip arrive wrapped from the
+    opposite end of the image — callers mask or overwrite them with the
+    op's edge extension (ops never read unfixed wrapped slices; see
+    parallel.api._apply_stencil / parallel.api2d._fix side). With
+    n_shards == 1 the strips are zeros, overwritten the same way.
+
+    Defaults cover the 1-D 'rows' decomposition; the 2-D tile runner
+    (parallel/api2d) calls it per axis.
     """
     if n_shards == 1:
-        zeros = jnp.zeros((halo, *tile.shape[1:]), tile.dtype)
+        shape = list(tile.shape)
+        shape[axis] = halo
+        zeros = jnp.zeros(shape, tile.dtype)
         return zeros, zeros
+    idx = [slice(None)] * tile.ndim
     down = [(i, (i + 1) % n_shards) for i in range(n_shards)]
     up = [(i, (i - 1) % n_shards) for i in range(n_shards)]
-    top = lax.ppermute(tile[-halo:], ROWS, down)
-    bottom = lax.ppermute(tile[:halo], ROWS, up)
-    return top, bottom
+    idx[axis] = slice(-halo, None)
+    before = lax.ppermute(tile[tuple(idx)], axis_name, down)
+    idx[axis] = slice(None, halo)
+    after = lax.ppermute(tile[tuple(idx)], axis_name, up)
+    return before, after
 
 
-def exchange_halo(tile: jnp.ndarray, halo: int, n_shards: int) -> jnp.ndarray:
-    """Return `tile` extended with `halo` ghost rows on top and bottom
-    (see exchange_halo_strips; this materialises the concatenated tile for
-    the XLA stencil path)."""
+def exchange_halo(
+    tile: jnp.ndarray,
+    halo: int,
+    n_shards: int,
+    *,
+    axis_name: str = ROWS,
+    axis: int = 0,
+) -> jnp.ndarray:
+    """Return `tile` extended with `halo` ghost slices on both sides of
+    `axis` (see exchange_halo_strips; this materialises the concatenated
+    tile for the XLA stencil paths)."""
     if halo == 0:
         return tile
-    top, bottom = exchange_halo_strips(tile, halo, n_shards)
-    return jnp.concatenate([top, tile, bottom], axis=0)
+    before, after = exchange_halo_strips(
+        tile, halo, n_shards, axis_name=axis_name, axis=axis
+    )
+    return jnp.concatenate([before, tile, after], axis=axis)

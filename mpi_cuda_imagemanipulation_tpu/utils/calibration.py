@@ -74,6 +74,13 @@ def _load() -> dict:
     return data
 
 
+def entries() -> dict:
+    """All calibration entries, `{device_kind: {impl: record}}` — the
+    read-only view `mcim-tpu info` reports. Empty dict when no store."""
+    e = _load().get("device_kinds")
+    return e if isinstance(e, dict) else {}
+
+
 def current_device_kind() -> str:
     """Device-kind key for the live backend (initializes it if needed).
 
@@ -97,15 +104,12 @@ def lookup_block_h(
     """
     if os.environ.get(_ENV_DISABLE):
         return None
-    entries = _load().get("device_kinds")
-    if not isinstance(entries, dict):
-        return None
     if device_kind is None:
         try:
             device_kind = current_device_kind()
         except Exception:
             return None
-    rec = entries.get(device_kind)
+    rec = entries().get(device_kind)
     if not isinstance(rec, dict):
         return None
     rec = rec.get(impl)

@@ -118,10 +118,8 @@ def test_fix_edge_axis_matches_golden_pad(mode, axis):
     import jax.numpy as jnp
 
     from mpi_cuda_imagemanipulation_tpu.ops.spec import StencilOp, pad2d
-    from mpi_cuda_imagemanipulation_tpu.parallel.api2d import (
-        _exchange_axis,
-        _fix_edge_axis,
-    )
+    from mpi_cuda_imagemanipulation_tpu.parallel.api import _fix_edge_axis
+    from mpi_cuda_imagemanipulation_tpu.parallel.halo import exchange_halo
 
     h = 2
     op = StencilOp(
@@ -133,7 +131,7 @@ def test_fix_edge_axis_matches_golden_pad(mode, axis):
     )
     axis_name = "rows" if axis == 0 else "cols"
     got = _fix_edge_axis(
-        _exchange_axis(tile, h, 1, axis_name, axis),
+        exchange_halo(tile, h, 1, axis_name=axis_name, axis=axis),
         op, jnp.int32(0), tile.shape[axis], axis,
     )
     pads = (h, h, 0, 0) if axis == 0 else (0, 0, h, h)
