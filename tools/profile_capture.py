@@ -10,7 +10,7 @@ or kills it independently of the packed-u32 A/B. This script:
      ~30 steady-state iterations,
   3. parses the Perfetto/Chrome trace JSON (stdlib gzip+json — no
      tensorboard_plugin_profile in this image) and writes
-     profile_r03_summary.md + .json: per-track top events by total
+     {OUTDIR}_summary.md + .json: per-track top events by total
      duration, plus a device-time split over DMA/copy-shaped vs
      compute-shaped event names.
 
@@ -97,6 +97,8 @@ def summarize(events: list[dict]) -> dict:
 
 def main() -> int:
     out_dir = sys.argv[1] if len(sys.argv) > 1 else "profile_r03"
+    summary_json = f"{out_dir}_summary.json"
+    summary_md = f"{out_dir}_summary.md"
     import jax
     import jax.numpy as jnp
 
@@ -115,7 +117,7 @@ def main() -> int:
     pipe = Pipeline.parse("gaussian:5")
     combined: dict = {}
     lines = [
-        "# Headline-kernel profiler trace summary (round 3)",
+        f"# Headline-kernel profiler trace summary ({out_dir})",
         "",
         f"8K 5x5 Gaussian, 30 iterations each on `{backend}` — u8 streaming "
         "(production headline) AND the packed-u32 variant, so the trace "
@@ -167,11 +169,11 @@ def main() -> int:
         # write after EVERY variant: a later variant wedging (and the step
         # timeout killing the process) must not lose an earlier variant's
         # completed measurement
-        with open("profile_r03_summary.json", "w") as f:
+        with open(summary_json, "w") as f:
             json.dump(combined, f, indent=1)
-        with open("profile_r03_summary.md", "w") as f:
+        with open(summary_md, "w") as f:
             f.write("\n".join(lines) + "\n")
-        print(f"wrote profile_r03_summary.{{md,json}} ({variant})", flush=True)
+        print(f"wrote {summary_md} / {summary_json} ({variant})", flush=True)
     # the u8 headline trace is the round's required artifact; packed is
     # best-effort diagnosis
     return 0 if "error" not in combined["pallas"] else 1

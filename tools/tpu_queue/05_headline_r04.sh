@@ -1,0 +1,20 @@
+#!/bin/bash
+# Round-4 insurance capture: the cheapest measurement that makes this
+# round's artifact of record a hardware number — headline config, pallas
+# then packed, history appended + committed per impl. Runs FIRST so even a
+# window too short for the decisive bundle (10_/12_/14_) leaves a
+# same-round TPU headline for bench.py promotion.
+# Wall-time budget (VERDICT r3 #8): ~1-3 min warm (8K gaussian pallas +
+# packed executables are in tools/.jax_cache from the round-3 window;
+# measurement itself is ~10 s/impl). Cold compile over the tunnel: up to
+# ~10 min — the 1800s timeout covers a cold window without burning the
+# watcher's whole pass on a wedge.
+set -u
+cd "$(dirname "$0")/../.."
+. tools/tpu_queue/_lib.sh
+timeout 1800 python tools/quick_headline.py --impls pallas,packed \
+  > quick_headline_r04.out 2>&1
+rc=$?
+commit_artifacts "TPU window: round-4 headline insurance capture" \
+  BENCH_HISTORY.jsonl quick_headline_r04.out
+exit $rc

@@ -1,0 +1,20 @@
+#!/bin/bash
+# THE decisive experiment (VERDICT r3 priority #1): same-process
+# interleaved A/B of production pallas vs xla vs packed on the 4K pointwise
+# group and the 8K headline stencil (2 interleaved rounds). Also the
+# datum that must explain the 01:03Z prod_xla>prod_pallas anomaly —
+# all three production variants run in ONE process minutes apart.
+# Partial output is a window's worth of evidence, so the .out commits
+# even on a timeout/wedge partway through (round-3 lesson: the lone
+# packed_ab fragment was the round's most-cited artifact).
+# Wall-time budget: ~4-6 min warm (prod 4K pallas/xla/packed + 8K
+# pallas/packed executables cached from earlier windows; proto packed_u32
+# kernel is the only likely cold compile, ~60-90 s). Cold: ~12-15 min.
+set -u
+cd "$(dirname "$0")/../.."
+. tools/tpu_queue/_lib.sh
+timeout 1500 python tools/packed_ab.py > packed_ab_r04.out 2>&1
+rc=$?
+commit_artifacts "TPU window: interleaved packed-u32 A/B (round 4)" \
+  packed_ab_r04.out
+exit $rc

@@ -1,0 +1,17 @@
+#!/bin/bash
+# SWAR quarter-strip prototype timing (VERDICT r3 priority #1, second leg):
+# the element-rate exploitation design the packed-f32-lane path lacked.
+# Predictions pre-registered in BASELINE.md (2-4x if element-rate-bound).
+# Bit-exactness gates run before any timing; 3-round per-case bests.
+# If swar_pallas beats the production u8 kernel, promote into ops/ next.
+# Wall-time budget: ~6-8 min warm (carry-kernel compiles are small but
+# none are cached from round 3 — this tool never got a window). The .out
+# streams per-round records, so it commits even on a mid-run wedge.
+set -u
+cd "$(dirname "$0")/../.."
+. tools/tpu_queue/_lib.sh
+timeout 2400 python tools/swar_proto.py > swar_proto_r04.out 2>&1
+rc=$?
+commit_artifacts "TPU window: SWAR quarter-strip prototype timings (round 4)" \
+  swar_proto_r04.out
+exit $rc

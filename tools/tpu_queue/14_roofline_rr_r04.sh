@@ -1,0 +1,16 @@
+#!/bin/bash
+# Round-robin roofline probe (VERDICT r3 priority #1, third leg): 3 rounds,
+# per-case bests, with the pre-registered u32-anomaly discriminators —
+# re-bases ELEM_G_S_MEASURED with per-case bests instead of the round-3
+# single-shot sample whose adjacent cases drifted 4.7x.
+# Wall-time budget: ~8-10 min warm (copy-probe kernels are tiny; most of
+# the time is the 3x round-robin measurement itself). Streams per-round
+# records; commits whatever landed on a mid-run wedge.
+set -u
+cd "$(dirname "$0")/../.."
+. tools/tpu_queue/_lib.sh
+timeout 2700 python tools/roofline_probe.py --rounds 3 > roofline_rr_r04.out 2>&1
+rc=$?
+commit_artifacts "TPU window: round-robin roofline probe (round 4)" \
+  roofline_rr_r04.out
+exit $rc

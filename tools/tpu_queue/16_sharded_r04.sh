@@ -1,0 +1,17 @@
+#!/bin/bash
+# First-ever on-chip record of the fused-ghost sharded config (VERDICT r3
+# priority #3; round-2 directive #2, two rounds overdue): target per-chip
+# parity +-10% with unsharded, proving parallel/api.py's traffic model on
+# silicon. Quick-capture style so a short window suffices; pallas first.
+# Wall-time budget: ~3-5 min warm (the mesh(1) sharded executable is NOT
+# in the cache — first sharded compile on the tunnel may add ~2-4 min).
+set -u
+cd "$(dirname "$0")/../.."
+. tools/tpu_queue/_lib.sh
+timeout 1800 python tools/quick_headline.py \
+  --config gaussian5_8k_sharded --impls pallas,xla \
+  > quick_sharded_r04.out 2>&1
+rc=$?
+commit_artifacts "TPU window: sharded-config on-chip record (round 4)" \
+  BENCH_HISTORY.jsonl quick_sharded_r04.out
+exit $rc

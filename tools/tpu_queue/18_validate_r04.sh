@@ -1,0 +1,18 @@
+#!/bin/bash
+# Hardware validation sweep (VERDICT r3 priority #4): registry-wide
+# compiled-Mosaic correctness incl. packed production kernels, mesh(1) +
+# 2-D(1x1) sharded, guarded-mode and compiled-SWAR cases — the silicon
+# correctness record for 744 LoC of packed kernels.
+# Wall-time budget: ~15-25 min warm (dominated by per-case compiles the
+# cache has never seen; re-tries after a wedge resume from the cache and
+# drop to ~5 min). Longest step — deliberately behind the decisive bundle.
+set -u
+cd "$(dirname "$0")/../.."
+. tools/tpu_queue/_lib.sh
+timeout 3600 python tools/tpu_validate.py --out VALIDATE_r04.json \
+  > validate_r04.out 2>&1
+rc=$?
+arts=(validate_r04.out)
+[ -f VALIDATE_r04.json ] && arts+=(VALIDATE_r04.json)
+commit_artifacts "TPU window: hardware validation sweep (round 4)" "${arts[@]}"
+exit $rc

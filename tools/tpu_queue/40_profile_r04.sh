@@ -1,0 +1,18 @@
+#!/bin/bash
+# Profiler trace, u8 AND packed variants (VERDICT r3 priority #5; round-2
+# directive #4): the DMA-wait vs compute vs overhead breakdown that
+# attributes the packed slowdown independently of more A/Bs.
+# Wall-time budget: ~4-6 min warm (kernels cached after 05_/10_; tracing
+# adds seconds). profile_capture.py writes summaries after every variant,
+# so a later wedge cannot strand a completed trace.
+set -u
+cd "$(dirname "$0")/../.."
+. tools/tpu_queue/_lib.sh
+timeout 2400 python tools/profile_capture.py profile_r04 > profile_r04.out 2>&1
+rc=$?
+arts=(profile_r04.out)
+[ -f profile_r04_summary.md ] && arts+=(profile_r04_summary.md)
+[ -f profile_r04_summary.json ] && arts+=(profile_r04_summary.json)
+commit_artifacts "TPU window: headline-kernel profiler trace (round 4)" \
+  "${arts[@]}"
+exit $rc

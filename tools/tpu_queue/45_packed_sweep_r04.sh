@@ -1,0 +1,16 @@
+#!/bin/bash
+# Full-suite packed-impl sweep: packed numbers for every bench config
+# (bench.py only races packed on the headline).
+# Wall-time budget: ~10-15 min (one compile per config shape; several are
+# cold for the packed impl). Partial .jsonl/.out commit on a wedge.
+set -u
+cd "$(dirname "$0")/../.."
+. tools/tpu_queue/_lib.sh
+timeout 3000 python -m mpi_cuda_imagemanipulation_tpu bench --impl packed \
+  --json-metrics bench_packed_r04.jsonl > bench_packed_r04.out 2>&1
+rc=$?
+arts=(bench_packed_r04.out)
+[ -f bench_packed_r04.jsonl ] && arts+=(bench_packed_r04.jsonl)
+commit_artifacts "TPU window: full packed-impl bench sweep (round 4)" \
+  "${arts[@]}"
+exit $rc

@@ -1,0 +1,19 @@
+#!/bin/bash
+# On-chip block-height autotune (VERDICT r3 priority #6): sweep the
+# headline pipeline's block heights and commit the calibration store, so
+# the store finally holds a measured entry and 55_ records the headline
+# with calibration live.
+# Wall-time budget: ~8-12 min (one compile per candidate block height;
+# none cached — the sweep has never run on chip).
+set -u
+cd "$(dirname "$0")/../.."
+. tools/tpu_queue/_lib.sh
+timeout 2400 python -m mpi_cuda_imagemanipulation_tpu autotune \
+  --json-metrics autotune_r04.jsonl > autotune_r04.out 2>&1
+rc=$?
+arts=(autotune_r04.out)
+[ -f autotune_r04.jsonl ] && arts+=(autotune_r04.jsonl)
+[ -f .mcim_calibration.json ] && arts+=(.mcim_calibration.json)
+commit_artifacts "TPU window: on-chip block-height autotune (round 4)" \
+  "${arts[@]}"
+exit $rc

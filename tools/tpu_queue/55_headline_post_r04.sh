@@ -1,0 +1,14 @@
+#!/bin/bash
+# Post-autotune headline capture: records the headline with the committed
+# calibration live. bench.py promotes the best same-round TPU record, so
+# this only moves the artifact of record if calibration actually wins.
+# Wall-time budget: ~1-3 min warm (+ one compile if the calibrated block
+# height differs from the heuristic's — that compile IS the point).
+set -u
+cd "$(dirname "$0")/../.."
+. tools/tpu_queue/_lib.sh
+timeout 1800 python tools/quick_headline.py > quick_headline_post_r04.out 2>&1
+rc=$?
+commit_artifacts "TPU window: post-autotune headline capture (round 4)" \
+  BENCH_HISTORY.jsonl quick_headline_post_r04.out
+exit $rc

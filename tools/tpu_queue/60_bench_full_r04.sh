@@ -1,0 +1,14 @@
+#!/bin/bash
+# Full bench.py campaign: the exact program the driver runs at round end,
+# executed mid-round so BENCH_HISTORY holds a complete same-round suite
+# table even if the round-end window is wedged.
+# Wall-time budget: ~6-10 min warm (headline pallas/packed/xla + sharded;
+# all cached after 05_/10_/16_).
+set -u
+cd "$(dirname "$0")/../.."
+. tools/tpu_queue/_lib.sh
+timeout 3600 python bench.py > bench_r04_manual.out 2>&1
+rc=$?
+commit_artifacts "TPU window: full bench campaign (round 4)" \
+  BENCH_HISTORY.jsonl bench_r04_manual.out
+exit $rc

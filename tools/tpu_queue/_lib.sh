@@ -18,7 +18,14 @@ commit_artifacts() {
   local msg="$1"
   shift
   for _i in 1 2 3; do
-    git add -- "$@" 2>/dev/null
+    # a failed add (e.g. the driver session holding .git/index.lock while
+    # it commits its own artifacts) must retry, not fall through to the
+    # nothing-staged check and masquerade as "nothing new to commit"
+    if ! git add -- "$@"; then
+      echo "commit_artifacts: git add failed (try $_i); retrying" >&2
+      sleep 5
+      continue
+    fi
     if git diff --cached --quiet -- "$@" 2>/dev/null; then
       echo "commit_artifacts: nothing new to commit for: $*"
       return 0
