@@ -68,6 +68,27 @@ def _log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def git_head_sha() -> str | None:
+    """Short SHA of HEAD, or None outside a usable git checkout. Stamped
+    into every history entry so a promoted committed record is attributable
+    to the code that measured it (advisor round-3 finding: without commit
+    identity, best-of-round promotion can mask a mid-round perf
+    regression)."""
+    try:
+        proc = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True,
+            text=True,
+            cwd=REPO,
+            timeout=10,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if proc.returncode != 0:
+        return None
+    return proc.stdout.strip() or None
+
+
 def _cpu_env() -> dict:
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)  # sitecustomize skips axon without it
@@ -234,6 +255,9 @@ def main() -> int:
                     "at bench time"
                 ),
             )
+            spread = _same_round_tpu_spread()
+            if spread:
+                out["spread"] = spread
             _log(
                 "tunnel unresponsive; promoting same-round committed TPU "
                 f"record from {same['ts']}"
@@ -262,9 +286,20 @@ def main() -> int:
     if errors:
         out["partial"] = True
         out["errors"] = errors
-    _append_history(out, records)
+    appended = _append_history(out, records)
     if on_tpu:
+        fresh = out.get("value")
         out = _best_of_run_and_committed(out, errors)
+        # same-round sighting spread; the fresh measurement is one of the n
+        # sightings either via the entry just appended or, when the append
+        # was disabled/failed, via the extra argument
+        spread = _same_round_tpu_spread(
+            extra=None
+            if appended
+            else (fresh, time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
+        )
+        if spread:
+            out["spread"] = spread
     print(json.dumps(out))
     return 0
 
@@ -283,7 +318,7 @@ def _best_of_run_and_committed(
     same = _same_round_tpu_headline(path, round_start_path)
     if same is None or same["headline"].get("value", 0) <= out.get("value", 0):
         return out
-    return _promote_committed(
+    promoted = _promote_committed(
         same,
         errors,
         source=(
@@ -292,6 +327,11 @@ def _best_of_run_and_committed(
             "window-noise guard)"
         ),
     )
+    # the fresh HEAD measurement stays visible as a first-class field, not
+    # just prose in `source` (advisor round-3 finding: promotion must not
+    # hide a mid-round regression — the reader sees both numbers)
+    promoted["fresh_value"] = out.get("value")
+    return promoted
 
 
 def _promote_committed(
@@ -309,6 +349,14 @@ def _promote_committed(
         if k not in ("partial", "errors", "source", "measured_ts")
     }
     h["measured_ts"] = same["ts"]
+    # provenance SHAs: the commit whose code produced the promoted
+    # measurement vs the HEAD this bench run executed at — unequal SHAs
+    # flag that the promoted number may not reflect current code
+    if same.get("git_sha"):
+        h["measured_git_sha"] = same["git_sha"]
+    head = git_head_sha()
+    if head:
+        h["head_git_sha"] = head
     if platform_note:
         h["platform"] = f"{h.get('platform')} ({platform_note})"
     if source:
@@ -320,10 +368,11 @@ def _promote_committed(
 
 
 def _tpu_history_headlines(path: str | None = None):
-    """Yield (ts, headline) for every BENCH_HISTORY.jsonl entry whose
-    headline was measured on real TPU hardware. Platform is the criterion;
-    impl is informational (a TPU xla number from a window where Mosaic
-    crashed still counts)."""
+    """Yield (ts, headline, git_sha) for every BENCH_HISTORY.jsonl entry
+    whose headline was measured on real TPU hardware. Platform is the
+    criterion; impl is informational (a TPU xla number from a window where
+    Mosaic crashed still counts). git_sha is None for entries predating the
+    stamping (round <= 3)."""
     path = path or os.path.join(REPO, "BENCH_HISTORY.jsonl")
     try:
         with open(path) as f:
@@ -334,7 +383,7 @@ def _tpu_history_headlines(path: str | None = None):
                     continue
                 h = e.get("headline") or {}
                 if h.get("platform") in ("tpu", "axon"):
-                    yield e.get("ts"), h
+                    yield e.get("ts"), h, e.get("git_sha")
     except OSError:
         return
 
@@ -343,7 +392,7 @@ def _last_tpu_headline(path: str | None = None) -> dict | None:
     """Most recent committed TPU headline, summarized for the
     `last_tpu_record` pointer on CPU-fallback records."""
     best = None
-    for ts, h in _tpu_history_headlines(path):
+    for ts, h, sha in _tpu_history_headlines(path):
         best = {
             "ts": ts,
             "value": h.get("value"),
@@ -352,6 +401,8 @@ def _last_tpu_headline(path: str | None = None) -> dict | None:
             "impl": h.get("impl"),
             "platform": h.get("platform"),
         }
+        if sha:
+            best["git_sha"] = sha
     return best
 
 
@@ -367,41 +418,118 @@ def _same_round_tpu_headline(
     first window measured the identical compiled kernel at 14,075 then
     37,667 MP/s minutes apart), the metric is peak capability, and a
     later noisy window must not bury an earlier healthy one.
-    Returns {ts, headline} with the full headline record, or None."""
-    rs_path = round_start_path or os.path.join(REPO, "ROUND_START")
-    try:
-        with open(rs_path) as f:
-            round_start = f.read().strip()
-    except OSError:
-        return None
+    Returns {ts, headline, git_sha} with the full headline record, or
+    None."""
+    round_start = _read_round_start(round_start_path)
     if not round_start:
         return None
     best = None
-    for ts, h in _tpu_history_headlines(path):
+    for ts, h, sha in _tpu_history_headlines(path):
         if ts and ts >= round_start:
             if best is None or h.get("value", 0) > best["headline"].get("value", 0):
-                best = {"ts": ts, "headline": h}
+                best = {"ts": ts, "headline": h, "git_sha": sha}
     return best
 
 
-def _append_history(headline: dict, records: list[dict]) -> None:
+def _read_round_start(round_start_path: str | None = None) -> str | None:
+    rs_path = round_start_path or os.path.join(REPO, "ROUND_START")
+    try:
+        with open(rs_path) as f:
+            return f.read().strip() or None
+    except OSError:
+        return None
+
+
+def _count_windows(timestamps: list[str], gap_s: float = 900.0) -> int:
+    """Cluster %Y-%m-%dT%H:%M:%SZ timestamps into 'windows': sightings more
+    than gap_s apart came from distinct healthy-tunnel windows (observed
+    windows are minutes long, wedges are hours)."""
+    import calendar
+
+    times = []
+    for ts in timestamps:
+        try:
+            # timegm, not mktime: the Z timestamps are UTC, and local-time
+            # parsing would distort gaps across a DST transition
+            times.append(
+                calendar.timegm(time.strptime(ts, "%Y-%m-%dT%H:%M:%SZ"))
+            )
+        except (TypeError, ValueError):
+            continue
+    times.sort()
+    n = 0
+    last = None
+    for t in times:
+        if last is None or t - last > gap_s:
+            n += 1
+        last = t
+    return n
+
+
+def _same_round_tpu_spread(
+    path: str | None = None,
+    round_start_path: str | None = None,
+    extra: tuple[float, str] | None = None,
+) -> dict | None:
+    """Variance summary {n, n_windows, best, median, min} over ALL committed
+    same-round TPU headline sightings (VERDICT r3 weak #1 / directive #2:
+    the best-of-round promotion is a ratchet unless the headline of record
+    carries the spread it was chosen from).
+
+    `extra` is a (value, ts) sighting NOT in the history file — the fresh
+    run when its append was disabled (MCIM_NO_HISTORY) or failed — so the
+    emitted spread can never contradict its own headline."""
+    round_start = _read_round_start(round_start_path)
+    if not round_start:
+        return None
+    vals, tss = [], []
+    for ts, h, _sha in _tpu_history_headlines(path):
+        v = h.get("value")
+        if ts and ts >= round_start and isinstance(v, (int, float)):
+            vals.append(float(v))
+            tss.append(ts)
+    if extra is not None and isinstance(extra[0], (int, float)):
+        vals.append(float(extra[0]))
+        tss.append(extra[1])
+    if not vals:
+        return None
+    vals.sort()
+    n = len(vals)
+    median = vals[n // 2] if n % 2 else (vals[n // 2 - 1] + vals[n // 2]) / 2
+    return {
+        "n": n,
+        "n_windows": _count_windows(tss),
+        "best": vals[-1],
+        "median": round(median, 1),
+        "min": vals[0],
+    }
+
+
+def _append_history(headline: dict, records: list[dict]) -> bool:
     """Append every run's records to BENCH_HISTORY.jsonl (committed), so a
     tunnel wedge at the driver's round-end run cannot erase evidence of an
     earlier healthy-window TPU measurement (the round-1 failure mode).
     MCIM_NO_HISTORY (any non-empty value) disables the append — test runs
-    must not pollute the committed history (tests/conftest.py sets it)."""
+    must not pollute the committed history (tests/conftest.py sets it).
+    Returns True iff the entry was written (the spread computation needs to
+    know whether the fresh run is already a committed sighting)."""
     if os.environ.get("MCIM_NO_HISTORY"):
-        return
+        return False
     try:
         entry = {
             "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
             "headline": headline,
             "records": records,
         }
+        sha = git_head_sha()
+        if sha:
+            entry["git_sha"] = sha
         with open(os.path.join(REPO, "BENCH_HISTORY.jsonl"), "a") as f:
             f.write(json.dumps(entry) + "\n")
+        return True
     except OSError as e:  # never let bookkeeping break the bench record
         _log(f"history append failed: {e}")
+        return False
 
 
 if __name__ == "__main__":

@@ -213,6 +213,13 @@ def _build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="measure and print, but do not write the calibration store",
     )
+    tune.add_argument(
+        "--allow-interpret",
+        action="store_true",
+        help="permit the sweep on a non-TPU backend, where Pallas runs in "
+        "INTERPRET mode — the recorded height is meaningless for real "
+        "hardware (CPU tests/dev only; refused otherwise)",
+    )
     tune.add_argument("--json-metrics", default=None)
 
     info = sub.add_parser("info", help="print device/mesh/version info")
@@ -661,6 +668,20 @@ def cmd_autotune(args: argparse.Namespace) -> int:
             device_throughput,
         )
 
+        backend = jax.default_backend()
+        if backend not in ("tpu", "axon") and not args.allow_interpret:
+            # pipeline_pallas defaults to interpret=True off-TPU, so the
+            # sweep would time the Pallas INTERPRETER and record a
+            # meaningless height that then clamps real runs on this device
+            # kind via the min rule (advisor round-3 finding)
+            print(
+                f"error: refusing to autotune on backend {backend!r} — the "
+                "sweep would time Pallas interpret mode and record a "
+                "meaningless block height; pass --allow-interpret to "
+                "override (CPU tests/dev only)",
+                file=sys.stderr,
+            )
+            return 3
         ops = make_pipeline_ops(args.ops)
         # the recorded calibration is applied through min(heuristic, calib),
         # so any candidate above the heuristic cap for this sweep's config

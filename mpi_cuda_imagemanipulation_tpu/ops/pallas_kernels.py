@@ -603,7 +603,7 @@ def _pick_block_h(
     # min() keeps the VMEM working-set model authoritative for safety while
     # letting on-device measurement pick the faster height within it
     # (utils/calibration.py; disabled via MCIM_NO_CALIB for A/B tools)
-    calibrated = calibration.lookup_block_h(impl=impl)
+    calibrated = calibration.lookup_block_h(impl=impl, width=width)
     if calibrated is not None:
         bh = max(32, min(bh, (calibrated // 32) * 32))
     return bh

@@ -106,11 +106,23 @@ def parse_shards(spec) -> tuple[int, int | None]:
     s = str(spec).lower().strip()
     if "x" in s:
         r, _, c = s.partition("x")
-        n_r, n_c = int(r), int(c)
+        try:
+            n_r, n_c = int(r), int(c)
+        except ValueError:
+            raise ValueError(
+                f"invalid --shards spec {spec!r}: expected N (1-D row mesh) "
+                "or RxC (2-D rows x cols mesh), e.g. '8' or '2x4'"
+            ) from None
         if n_r < 1 or n_c < 1:
             raise ValueError(f"shard counts must be >= 1, got {spec!r}")
         return n_r, n_c
-    n = int(s)
+    try:
+        n = int(s)
+    except ValueError:
+        raise ValueError(
+            f"invalid --shards spec {spec!r}: expected N (1-D row mesh) "
+            "or RxC (2-D rows x cols mesh), e.g. '8' or '2x4'"
+        ) from None
     if n < 1:
         raise ValueError(f"shard count must be >= 1, got {spec!r}")
     return n, None

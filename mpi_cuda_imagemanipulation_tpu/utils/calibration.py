@@ -94,13 +94,23 @@ def current_device_kind() -> str:
 
 
 def lookup_block_h(
-    device_kind: str | None = None, impl: str = "pallas"
+    device_kind: str | None = None,
+    impl: str = "pallas",
+    width: int | None = None,
 ) -> int | None:
     """Calibrated preferred block height for (device kind, impl), if any.
 
     Keyed per impl because the u8 and packed-u32 streaming kernels have
     different per-block compute/VMEM profiles — a height tuned for one must
     not silently steer the other (review finding).
+
+    When the caller supplies the run's image ``width`` and the entry
+    recorded the width it was swept at, the calibration only applies within
+    a factor of two of that width: block height trades off against row
+    length, so an 8K-headline sweep must not clamp a narrow 1080p run whose
+    heuristic wanted a much taller block (advisor round-3 finding — safe
+    under the min rule, but a silent perf regression). Entries without a
+    recorded width (legacy stores) apply unconditionally.
     """
     if os.environ.get(_ENV_DISABLE):
         return None
@@ -114,6 +124,14 @@ def lookup_block_h(
         return None
     rec = rec.get(impl)
     if not isinstance(rec, dict):
+        return None
+    rec_w = rec.get("width")
+    if (
+        width is not None
+        and isinstance(rec_w, (int, float))
+        and rec_w > 0
+        and not (rec_w / 2 <= width <= rec_w * 2)
+    ):
         return None
     bh = rec.get("block_h")
     if isinstance(bh, int) and 32 <= bh <= 4096:

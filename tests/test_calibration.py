@@ -122,6 +122,7 @@ def test_autotune_cli_writes_store(calib_file, monkeypatch, capsys):
             "--width", "256",
             "--blocks", "32,48,64",  # 48 is skipped (not a multiple of 32)
             "--device", "cpu",
+            "--allow-interpret",
             "--json-metrics", "-",
         ]
     )
@@ -162,6 +163,7 @@ def test_autotune_skips_candidates_above_heuristic_cap(calib_file, monkeypatch, 
     monkeypatch.setattr(timing, "device_throughput", lambda *a, **k: 0.001)
     rc = main(
         ["autotune", "--blocks", "32,64", "--device", "cpu",
+         "--allow-interpret",
          "--height", "64", "--width", "200000", "--json-metrics", "-"]
     )
     assert rc == 0
@@ -181,6 +183,7 @@ def test_autotune_measures_cap_when_all_candidates_skip(
     monkeypatch.setattr(timing, "device_throughput", lambda *a, **k: 0.001)
     rc = main(
         ["autotune", "--blocks", "512", "--device", "cpu",
+         "--allow-interpret",
          "--height", "64", "--width", "200000", "--json-metrics", "-"]
     )
     assert rc == 0
@@ -198,6 +201,7 @@ def test_autotune_restores_caller_env(calib_file, monkeypatch, tmp_path):
     monkeypatch.setenv("MCIM_NO_CALIB", "1")
     rc = main(
         ["autotune", "--blocks", "32", "--device", "cpu",
+         "--allow-interpret",
          "--height", "64", "--width", "256", "--dry-run",
          "--calib-file", str(tmp_path / "other.json")]
     )
@@ -206,6 +210,56 @@ def test_autotune_restores_caller_env(calib_file, monkeypatch, tmp_path):
 
     assert os.environ.get("MCIM_NO_CALIB") == "1"
     assert os.environ.get("MCIM_CALIB_FILE") == str(calib_file)
+
+
+def test_autotune_refuses_non_tpu_backend(calib_file, monkeypatch):
+    """Off-TPU, pipeline_pallas runs in interpret mode, so a sweep would
+    record a meaningless block height under that device kind and the min
+    rule would then steer real runs with it (advisor round-3 finding):
+    refused without --allow-interpret, nothing measured, no store write."""
+    from mpi_cuda_imagemanipulation_tpu.utils import timing
+
+    calls = []
+    monkeypatch.setattr(
+        timing, "device_throughput", lambda *a, **k: calls.append(1) or 0.001
+    )
+    rc = main(
+        ["autotune", "--blocks", "32", "--device", "cpu",
+         "--height", "64", "--width", "256"]
+    )
+    assert rc == 3
+    assert calls == []
+    assert not calib_file.exists()
+
+
+def test_lookup_width_bucket(calib_file):
+    """A calibration swept at one width must not steer runs at a very
+    different width (advisor round-3 finding): entries recording their
+    sweep width only apply within a factor of two of it; width-less
+    (legacy) entries apply unconditionally."""
+    calibration.record_block_h("TPU v5 lite", 64, width=7680)
+    # in-bucket widths apply
+    assert calibration.lookup_block_h("TPU v5 lite", width=7680) == 64
+    assert calibration.lookup_block_h("TPU v5 lite", width=3840) == 64
+    assert calibration.lookup_block_h("TPU v5 lite", width=15360) == 64
+    # far-off widths do not
+    assert calibration.lookup_block_h("TPU v5 lite", width=1920) is None
+    assert calibration.lookup_block_h("TPU v5 lite", width=40000) is None
+    # a caller that provides no width gets the entry (back-compat)
+    assert calibration.lookup_block_h("TPU v5 lite") == 64
+    # legacy entry without width: applies at any width
+    calibration.record_block_h("cpu", 96)
+    assert calibration.lookup_block_h("cpu", width=1024) == 96
+
+
+def test_pick_block_h_ignores_cross_width_calibration(calib_file, monkeypatch):
+    """The run path itself (ops/pallas_kernels._pick_block_h) passes its
+    width through: an 8K-swept entry clamps 8K runs but not 1080p runs."""
+    monkeypatch.setattr(calibration, "current_device_kind", lambda: "cpu")
+    calibration.record_block_h("cpu", 64, width=7680)
+    assert _pick_block_h(7680, 1, 1, 2) == 64
+    narrow = _pick_block_h(1920, 1, 1, 2)
+    assert narrow > 64  # the heuristic's taller choice survives
 
 
 def test_autotune_cli_dry_run(calib_file, monkeypatch):
@@ -223,6 +277,7 @@ def test_autotune_cli_dry_run(calib_file, monkeypatch):
             "--width", "256",
             "--blocks", "32",
             "--device", "cpu",
+            "--allow-interpret",
             "--dry-run",
         ]
     )

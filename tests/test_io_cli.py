@@ -307,6 +307,10 @@ def test_bench_main_promotes_same_round_record(monkeypatch, capsys):
     main() must emit that record (labelled) instead of a CPU fallback."""
     mod = _load_bench_module()
     monkeypatch.setattr(mod, "_probe_with_backoff", lambda schedule: None)
+    # isolate from live repo state: main() also computes the spread from
+    # the real BENCH_HISTORY.jsonl/ROUND_START by default (review finding)
+    monkeypatch.setattr(mod, "_same_round_tpu_spread", lambda *a, **k: None)
+    monkeypatch.setattr(mod, "git_head_sha", lambda: "testhead")
     monkeypatch.setattr(
         mod,
         "_same_round_tpu_headline",
@@ -334,6 +338,8 @@ def test_bench_main_promotion_appends_no_history(monkeypatch, capsys):
     """Re-emitting a committed record must not duplicate it in history."""
     mod = _load_bench_module()
     monkeypatch.setattr(mod, "_probe_with_backoff", lambda schedule: None)
+    monkeypatch.setattr(mod, "_same_round_tpu_spread", lambda *a, **k: None)
+    monkeypatch.setattr(mod, "git_head_sha", lambda: "testhead")
     monkeypatch.setattr(
         mod,
         "_same_round_tpu_headline",
@@ -346,6 +352,127 @@ def test_bench_main_promotion_appends_no_history(monkeypatch, capsys):
     assert mod.main() == 0
     capsys.readouterr()
     assert appended == []
+
+
+def test_bench_same_round_tpu_spread(tmp_path):
+    """The headline of record must carry the spread of same-round TPU
+    sightings it was chosen from (VERDICT r3 directive #2): n, distinct
+    windows, best/median/min; CPU entries and prior-round entries excluded."""
+    mod = _load_bench_module()
+    hist = tmp_path / "hist.jsonl"
+    marker = tmp_path / "ROUND_START"
+    marker.write_text("2026-07-31T00:00:00Z\n")
+    entries = [
+        # prior round — excluded
+        {"ts": "2026-07-30T10:00:00Z",
+         "headline": {"platform": "tpu", "value": 99999.0}},
+        # window A: two sightings two minutes apart
+        {"ts": "2026-07-31T01:01:00Z",
+         "headline": {"platform": "tpu", "value": 14075.0}},
+        {"ts": "2026-07-31T01:03:00Z",
+         "headline": {"platform": "axon", "value": 37667.0}},
+        # CPU fallback — excluded
+        {"ts": "2026-07-31T02:00:00Z",
+         "headline": {"platform": "cpu", "value": 1.0}},
+        # window B: > 15 min after window A
+        {"ts": "2026-07-31T05:00:00Z",
+         "headline": {"platform": "tpu", "value": 21000.0}},
+    ]
+    hist.write_text("\n".join(json.dumps(e) for e in entries) + "\n")
+    got = mod._same_round_tpu_spread(str(hist), str(marker))
+    assert got == {
+        "n": 3,
+        "n_windows": 2,
+        "best": 37667.0,
+        "median": 21000.0,
+        "min": 14075.0,
+    }
+    # an uncommitted fresh sighting (append disabled/failed) folds in via
+    # `extra`, so the emitted spread can never contradict its own headline
+    got = mod._same_round_tpu_spread(
+        str(hist), str(marker), extra=(40000.0, "2026-07-31T06:00:00Z")
+    )
+    assert got["n"] == 4 and got["best"] == 40000.0 and got["n_windows"] == 3
+    # no same-round sightings -> None (not a zero-filled dict)
+    marker.write_text("2026-08-01T00:00:00Z\n")
+    assert mod._same_round_tpu_spread(str(hist), str(marker)) is None
+    # ...unless the fresh uncommitted sighting exists
+    got = mod._same_round_tpu_spread(
+        str(hist), str(marker), extra=(40000.0, "2026-08-01T06:00:00Z")
+    )
+    assert got == {
+        "n": 1, "n_windows": 1,
+        "best": 40000.0, "median": 40000.0, "min": 40000.0,
+    }
+    # missing marker -> None
+    assert (
+        mod._same_round_tpu_spread(str(hist), str(tmp_path / "nope")) is None
+    )
+
+
+def test_bench_count_windows():
+    mod = _load_bench_module()
+    assert mod._count_windows([]) == 0
+    assert mod._count_windows(["2026-07-31T01:00:00Z"]) == 1
+    # 2 min apart = one window; 16 min gap = a second window; junk ignored
+    assert (
+        mod._count_windows(
+            [
+                "2026-07-31T01:02:00Z",
+                "2026-07-31T01:00:00Z",
+                "2026-07-31T01:18:30Z",
+                "not-a-timestamp",
+            ]
+        )
+        == 2
+    )
+
+
+def test_bench_history_stamps_git_sha(tmp_path, monkeypatch):
+    """Every appended history entry carries the HEAD SHA so promoted
+    records are attributable to the code that measured them (advisor r3
+    medium finding)."""
+    mod = _load_bench_module()
+    monkeypatch.delenv("MCIM_NO_HISTORY", raising=False)
+    monkeypatch.setattr(mod, "REPO", str(tmp_path))
+    monkeypatch.setattr(mod, "git_head_sha", lambda: "abc1234")
+    mod._append_history({"value": 1.0}, [])
+    entry = json.loads((tmp_path / "BENCH_HISTORY.jsonl").read_text())
+    assert entry["git_sha"] == "abc1234"
+    # the real helper resolves an actual SHA in this checkout
+    sha = mod.git_head_sha()
+    assert sha is None or (len(sha) >= 7 and all(c in "0123456789abcdef" for c in sha))
+
+
+def test_bench_promotion_carries_sha_and_fresh_value(tmp_path):
+    """Promotion surfaces BOTH values (fresh_value field) and both commit
+    identities (measured_git_sha vs head_git_sha) so a mid-round regression
+    stays visible instead of being masked by the best-of-round ratchet."""
+    mod = _load_bench_module()
+    hist = tmp_path / "hist.jsonl"
+    marker = tmp_path / "ROUND_START"
+    marker.write_text("2026-07-30T17:17:31Z\n")
+    hist.write_text(
+        json.dumps(
+            {
+                "ts": "2026-07-31T01:02:00Z",
+                "git_sha": "feedbee",
+                "headline": {
+                    "platform": "tpu", "value": 37667.3,
+                    "unit": "MP/s/chip", "impl": "pallas",
+                },
+            }
+        )
+        + "\n"
+    )
+    cold = {"value": 14075.0, "unit": "MP/s/chip", "platform": "tpu"}
+    got = mod._best_of_run_and_committed(cold, [], str(hist), str(marker))
+    assert got["value"] == 37667.3
+    assert got["fresh_value"] == 14075.0
+    assert got["measured_git_sha"] == "feedbee"
+    # head_git_sha present when running inside the repo checkout
+    if mod.git_head_sha() is not None:
+        assert got["head_git_sha"] == mod.git_head_sha()
 
 
 def test_xla_bridge_probe_api_exists():

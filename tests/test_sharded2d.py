@@ -152,6 +152,11 @@ def test_parse_shards():
         parse_shards("0")
     with pytest.raises(ValueError):
         parse_shards("2x0")
+    # malformed specs get a curated message naming the flag and accepted
+    # forms, not a raw int() traceback (advisor round-3 finding)
+    for bad in ("2x", "ax4", "x", "2x4x8", "abc", ""):
+        with pytest.raises(ValueError, match="--shards"):
+            parse_shards(bad)
 
 
 @needs_8dev

@@ -95,6 +95,13 @@ def main() -> int:
             "records": list(records),
             "note": f"quick capture ({cfg_name}, {impl})",
         }
+        # commit identity for promotion provenance (advisor r3 finding);
+        # bench.py is jax-free so the import is safe here
+        from bench import git_head_sha
+
+        sha = git_head_sha()
+        if sha:
+            entry["git_sha"] = sha
         head = headline_record(records)
         if head is not None:
             entry["headline"] = head
