@@ -222,6 +222,7 @@ def main() -> int:
         # element-rate A/B rides every TPU bench run.
         plan = [
             (HEADLINE, "pallas"),
+            (HEADLINE, "swar"),
             (HEADLINE, "packed"),
             (HEADLINE, "xla"),
             (HEADLINE + "_sharded", "pallas"),

@@ -177,7 +177,7 @@ def run_config(cfg: BenchConfig, impl: str) -> dict:
             prefer_packed,
         )
 
-        streams_u8 = impl != "packed" and not (
+        streams_u8 = impl not in ("packed", "swar") and not (
             impl == "auto" and prefer_packed()
         )
         if gen in ELEM_G_S_MEASURED and streams_u8:
@@ -276,7 +276,7 @@ def main(argv: Sequence[str] | None = None) -> int:
     ap.add_argument(
         "--impl",
         default="pallas",
-        choices=("xla", "pallas", "packed", "auto"),
+        choices=("xla", "pallas", "packed", "swar", "auto"),
     )
     args = ap.parse_args(argv)
     rec = run_config(CONFIGS[args.config], args.impl)

@@ -44,7 +44,7 @@ def _build_parser() -> argparse.ArgumentParser:
     )
     run.add_argument(
         "--impl",
-        choices=("auto", "xla", "pallas", "packed"),
+        choices=("auto", "xla", "pallas", "packed", "swar"),
         default="auto",
         help="compute backend for the op kernels (auto: per-group choice "
         "between XLA fusion and Pallas kernels; packed: Pallas with "
@@ -120,7 +120,7 @@ def _build_parser() -> argparse.ArgumentParser:
     batch.add_argument("--glob", default="*", help="input filename pattern")
     batch.add_argument("--ops", default="grayscale,contrast:3.5,emboss:3")
     batch.add_argument(
-        "--impl", choices=("auto", "xla", "pallas", "packed"), default="auto"
+        "--impl", choices=("auto", "xla", "pallas", "packed", "swar"), default="auto"
     )
     batch.add_argument(
         "--shards",
@@ -162,7 +162,7 @@ def _build_parser() -> argparse.ArgumentParser:
     bench.add_argument("--device", default=None)
     bench.add_argument(
         "--impl",
-        choices=("xla", "pallas", "packed", "auto", "both"),
+        choices=("xla", "pallas", "packed", "swar", "auto", "both"),
         default="both",
     )
     bench.add_argument("--json-metrics", default=None)
@@ -191,7 +191,7 @@ def _build_parser() -> argparse.ArgumentParser:
         help="pipeline to tune against (default: the headline 5x5 Gaussian)",
     )
     tune.add_argument(
-        "--impl", choices=("pallas", "packed"), default="pallas"
+        "--impl", choices=("pallas", "packed", "swar"), default="pallas"
     )
     tune.add_argument("--height", type=int, default=4320)
     tune.add_argument("--width", type=int, default=7680)
@@ -688,16 +688,44 @@ def cmd_autotune(args: argparse.Namespace) -> int:
         # could never take effect at run time — measuring it would waste
         # serialized chip time and could "win" a value the min rule then
         # ignores (review finding). Cap = the tightest per-group heuristic.
-        cap = min(
-            _pick_block_h(
-                args.width,
-                1,
-                1,
-                stencil.halo if stencil is not None else 0,
-                _live_f32_temps(stencil),
+        swar = args.impl == "swar"
+        if swar:
+            from mpi_cuda_imagemanipulation_tpu.ops.swar_kernels import (
+                _pick_swar_block_h,
+                pipeline_swar,
+                swar_eligible,
             )
-            for _pw, stencil in group_ops(ops)
-        )
+
+            # shape-inclusive eligibility: an ineligible --width would
+            # silently sweep the pallas FALLBACK and record its timing as a
+            # swar calibration (review finding)
+            halos = [
+                op.halo
+                for op in ops
+                if swar_eligible(op, (args.height, args.width))
+            ]
+            if not halos:
+                print(
+                    f"error: no swar-eligible op in --ops {args.ops!r} at "
+                    f"{args.height}x{args.width} (need W % 4 == 0; see "
+                    "ops/swar_kernels.py eligibility)",
+                    file=sys.stderr,
+                )
+                return 2
+            cap = _pick_swar_block_h(args.width // 4, max(halos))
+            step = 8  # swar blocks are ext-row multiples of 8, not 32
+        else:
+            cap = min(
+                _pick_block_h(
+                    args.width,
+                    1,
+                    1,
+                    stencil.halo if stencil is not None else 0,
+                    _live_f32_temps(stencil),
+                )
+                for _pw, stencil in group_ops(ops)
+            )
+            step = 32
         if cap not in candidates:
             # the heuristic's own choice is always legal and is the baseline
             # the calibration competes with — measure it even when every
@@ -712,15 +740,18 @@ def cmd_autotune(args: argparse.Namespace) -> int:
         packed = args.impl == "packed"
         results = []
         for bh in candidates:
-            if bh < 32 or bh % 32:
-                print(f"block {bh}: skipped (must be a multiple of 32, >=32)")
+            if bh < step or bh % step:
+                print(f"block {bh}: skipped (must be a multiple of {step}, >={step})")
                 continue
             if bh > cap:
                 print(f"block {bh}: skipped (above the VMEM heuristic cap {cap})")
                 continue
-            fn = jax.jit(
-                lambda x, b=bh: pipeline_pallas(ops, x, block_h=b, packed=packed)
-            )
+            if swar:
+                fn = jax.jit(lambda x, b=bh: pipeline_swar(ops, x, block_h=b))
+            else:
+                fn = jax.jit(
+                    lambda x, b=bh: pipeline_pallas(ops, x, block_h=b, packed=packed)
+                )
             try:
                 sec = device_throughput(fn, [img])
             except Exception as e:  # Mosaic OOM on too-tall blocks, etc.

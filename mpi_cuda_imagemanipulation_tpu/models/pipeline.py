@@ -27,7 +27,7 @@ from mpi_cuda_imagemanipulation_tpu.ops.registry import (
 )
 from mpi_cuda_imagemanipulation_tpu.ops.spec import Op
 
-BACKENDS = ("xla", "pallas", "packed", "auto")
+BACKENDS = ("xla", "pallas", "packed", "swar", "auto")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -75,6 +75,15 @@ class Pipeline:
                 block_h=block_h,
                 packed=backend == "packed",
             )
+        if backend == "swar":
+            # quarter-strip 16-bit-field streaming for eligible binomial
+            # stencils, per-op u8-kernel fallback otherwise — explicit
+            # opt-in until the on-chip A/B promotes it (ops/swar_kernels.py)
+            from mpi_cuda_imagemanipulation_tpu.ops.swar_kernels import (
+                pipeline_swar,
+            )
+
+            return partial(pipeline_swar, self.ops, block_h=block_h)
         if backend == "auto":
             from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
                 pipeline_auto,
@@ -132,6 +141,13 @@ class Pipeline:
             )
 
             return sharded_pipeline_2d(self, mesh)
+        if backend == "swar":
+            raise ValueError(
+                "the swar backend is single-device for now (the fused-ghost "
+                "sharded runner streams full-width u8 rows; quarter-strip "
+                "words would need their own ghost layout) — shard with "
+                "backend='pallas'/'auto' or run swar unsharded"
+            )
         from mpi_cuda_imagemanipulation_tpu.parallel.api import sharded_pipeline
 
         return sharded_pipeline(self, mesh, backend=backend)

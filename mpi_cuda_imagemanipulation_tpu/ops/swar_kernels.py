@@ -1,0 +1,325 @@
+"""Production SWAR quarter-strip streaming backend (``impl='swar'``).
+
+Promotion of the tools/swar_proto.py design into the framework, gated
+behind an explicit backend choice (it joins ``auto`` routing only after an
+on-chip win; see BASELINE.md round-4 pre-registered predictions).
+
+Why this exists (the round-3 roofline result, BASELINE.md): u8 streaming on
+v5e is element-rate-capped (~95-100 Ge/s) at ~1/4 of the f32 byte rate, and
+the u8 production kernels already sit at ~94% of that ceiling — so the only
+way past it is fewer, wider elements. The first packed attempt
+(ops/packed_kernels.py) moves u32 words but unpacks every word into four
+f32 lane planes in-kernel, paying the full element count *plus* shift
+overhead; it measured 3.2x slower. SWAR is the design that actually banks
+the element saving:
+
+1. **Quarter-strip (SoA) packing**: the padded row is split into 4 equal
+   strips; byte k of word j is strip k's pixel j. A horizontal stencil tap
+   is then a plain word-column shift for all four strips at once — no
+   cross-lane byte algebra (the packed layout's fatal cost).
+2. **16-bit SWAR fields**: each word splits once into two u32 arrays
+   holding 2x16-bit fields (bytes 0,2 and 1,3). The whole separable
+   correlation runs as u32 mul/add on those fields — 2 pixels per 32-bit
+   element, half the VPU element count of f32 compute — and stays exact:
+   for integer taps with sum S, row accumulators are <= 255*S and column
+   accumulators <= 255*S^2, so S^2 <= 257 (S <= 16) guarantees no field
+   overflow. The final x S^-2 with round-half-to-even is the integer
+   identity q = (s + (S^2/2 - 1) + (q0 & 1)) >> k with q0 = s >> k,
+   k = log2(S^2) — bit-identical to the golden ``rint_clip`` quantize
+   (clipping is vacuous: the weighted mean of u8 values is in [0, 255]).
+
+Eligibility (``swar_eligible``): single-plane u8 (H, W) with W % 4 == 0,
+StencilOp with ``reduce='corr'``, ``combine='single'``, an integer
+non-negative separable vector whose sum S is a power of two with
+2 <= S <= 16, ``scale == 1/S^2``, ``quantize='rint_clip'``, and a real
+border extension (not the reference's ``interior`` guard). In the registry
+that is exactly the binomial Gaussians 3 and 5 (gaussian:7 has S = 64:
+its column pass would overflow 16-bit fields). Ineligible ops fall back to
+the u8 streaming kernels per op, so ``impl='swar'`` is always-correct —
+the same contract as ``impl='packed'`` (ops/packed_kernels.py).
+
+The streaming kernel reuses the production scratch-carry structure
+(ops/pallas_kernels.stencil_tile_pallas): ext-row blocks stream in
+non-overlapping, the row-passed fields of the previous block live in VMEM
+scratch, and output block i-1 is the column pass over
+[scratch ; first 2h rows of block i]. Reference analogue: the CUDA 5x5
+stencil path (kernel.cu:64-94), minus its in-place race and missing halo.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from mpi_cuda_imagemanipulation_tpu.ops.spec import (
+    _PAD_MODES,
+    Op,
+    StencilOp,
+)
+from mpi_cuda_imagemanipulation_tpu.utils import calibration
+
+# field masks as python ints (a pallas kernel body must not capture traced
+# constants); & / + / * with a uint32 array stays uint32
+_M_LO = 0x00FF00FF  # bytes 0,2 as 16-bit fields
+_M_B = 0x00010001  # LSB of each field
+
+
+def swar_eligible(op: Op, plane_shape: tuple[int, ...] | None = None) -> bool:
+    """True iff `op` (on an optional (H, W) u8 plane shape) can run on the
+    SWAR path. See module docstring for the exact conditions."""
+    if not isinstance(op, StencilOp):
+        return False
+    if op.reduce != "corr" or op.combine != "single":
+        return False
+    if op.quantize != "rint_clip":
+        return False
+    if op.edge_mode == "interior" or op.edge_mode not in _PAD_MODES:
+        return False
+    taps = op.separable
+    if taps is None:
+        return False
+    t = np.asarray(taps)
+    if not np.all(t == np.floor(t)) or np.any(t < 0):
+        return False
+    s = int(t.sum())
+    if s < 2 or s > 16 or (s & (s - 1)):
+        return False
+    if abs(op.scale * s * s - 1.0) > 1e-12:
+        return False
+    if op.halo != (len(t) - 1) // 2:
+        return False
+    if plane_shape is not None:
+        if len(plane_shape) != 2:
+            return False
+        h_img, w_img = plane_shape
+        if w_img % 4 or w_img // 4 < 2 * op.halo + 1 or h_img <= op.halo:
+            return False
+    return True
+
+
+def _taps_shift(op: StencilOp) -> tuple[tuple[int, ...], int]:
+    """(integer taps, k) with 2^k = S^2 — the field arithmetic constants."""
+    t = tuple(int(v) for v in np.asarray(op.separable))
+    s = sum(t)
+    k = int(s * s).bit_length() - 1
+    return t, k
+
+
+def pack_quarters(xpad: jnp.ndarray, halo: int) -> jnp.ndarray:
+    """(H+2h, W+2h) u8 padded plane -> (H+2h, W/4+2h) u32 quarter-strip
+    words: byte k of word j is strip k's padded pixel j. Each strip's ext
+    covers [k*Ws, k*Ws + Ws + 2h) of the padded row, so every horizontal
+    tap is word-local."""
+    hp, wp2 = xpad.shape
+    ws = (wp2 - 2 * halo) // 4
+    strips = [xpad[:, k * ws : k * ws + ws + 2 * halo] for k in range(4)]
+    stacked = jnp.stack(strips, axis=-1)  # (Hp, Ws+2h, 4) u8
+    return jax.lax.bitcast_convert_type(stacked, jnp.uint32)
+
+
+def unpack_quarters(words: jnp.ndarray) -> jnp.ndarray:
+    """(H, Ws) u32 -> (H, 4*Ws) u8 by reassembling the quarter strips."""
+    b = jax.lax.bitcast_convert_type(words, jnp.uint8)  # (H, Ws, 4)
+    return jnp.concatenate([b[..., k] for k in range(4)], axis=1)
+
+
+def _row_pass_fields(ext_block: jnp.ndarray, taps: tuple[int, ...]):
+    """(bh, Ws+2h) u32 words -> two (bh, Ws) u32 field arrays (bytes 0,2
+    and 1,3 as 16-bit fields), row-correlated with `taps`."""
+    n = len(taps)
+    lo = ext_block & _M_LO
+    hi = (ext_block >> 8) & _M_LO
+
+    def row(a):
+        w = a.shape[1] - (n - 1)
+        acc = a[:, 0:w] * jnp.uint32(taps[0])
+        for t in range(1, n):
+            acc = acc + a[:, t : w + t] * jnp.uint32(taps[t])
+        return acc
+
+    return row(lo), row(hi)
+
+
+def _col_finalize(lo_rows, hi_rows, taps: tuple[int, ...], k: int):
+    """(bh+2h, Ws) field arrays -> (bh, Ws) u32 output words: column pass +
+    x 2^-k round-half-to-even + byte repack."""
+    n = len(taps)
+    half = (1 << (k - 1)) - 1
+    m_half = (half << 16) | half
+
+    def col(a):
+        hgt = a.shape[0] - (n - 1)
+        acc = a[0:hgt, :] * jnp.uint32(taps[0])
+        for t in range(1, n):
+            acc = acc + a[t : hgt + t, :] * jnp.uint32(taps[t])
+        return acc
+
+    def rnd(s):
+        b = (s >> k) & _M_B
+        return ((s + m_half + b) >> k) & _M_LO
+
+    return rnd(col(lo_rows)) | (rnd(col(hi_rows)) << 8)
+
+
+def _pick_swar_block_h(ws: int, halo: int) -> int:
+    """VMEM-safe ext-row block height for the carry kernel.
+
+    Working set per ext row: u32 input block (double-buffered) + two field
+    scratch blocks + output block (double-buffered) + ~6 live u32 temps
+    while the body runs — all Ws-wide words. Budget mirrors the u8 kernels'
+    3/4 of the 64 MiB scoped-VMEM limit (ops/pallas_kernels.py)."""
+    from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import _VMEM_LIMIT
+
+    budget = 3 * _VMEM_LIMIT // 4
+    per_row = 4 * (ws + 2 * halo) * 2 + 4 * ws * (2 + 2 + 6)
+    bh = budget // max(per_row, 1)
+    bh = int(max(2 * halo, min(512, bh)))
+    bh = max(8, (bh // 8) * 8)
+    calibrated = calibration.lookup_block_h(impl="swar", width=4 * ws)
+    if calibrated is not None:
+        bh = max(2 * halo, max(8, min(bh, (calibrated // 8) * 8)))
+    return bh
+
+
+def make_swar_stencil(
+    ext_shape: tuple[int, int],
+    taps: tuple[int, ...],
+    k: int,
+    bh: int,
+    *,
+    interpret: bool = False,
+):
+    """Streaming SWAR kernel over quarter-strip words with the production
+    scratch-carry structure. `ext_shape` = (H+2h, Ws+2h) words; returns a
+    function ext_words -> (ceil(H/bh)*bh, Ws) u32 (caller crops [:H]).
+
+    Ragged heights are fine: out rows >= H are garbage (OOB-padded input
+    blocks / duplicated tail rows via the clamped index maps) and the
+    caller crops — every real out row r reads ext rows [r, r+2h], which
+    live in the scratch block and the next block's first 2h rows by
+    construction."""
+    halo = (len(taps) - 1) // 2
+    hp, wsp = ext_shape
+    height = hp - 2 * halo
+    ws = wsp - 2 * halo
+    if bh < 2 * halo:
+        raise ValueError(f"block_h {bh} < 2*halo {2 * halo}")
+    nb = -(-height // bh)
+    nb_in = -(-hp // bh)  # last block holds the bottom halo rows
+
+    def kernel(in_ref, out_ref, lo_ref, hi_ref):
+        i = pl.program_id(0)
+        rlo, rhi = _row_pass_fields(in_ref[:], taps)
+
+        @pl.when(i >= 1)
+        def _():
+            lo_rows = jnp.concatenate([lo_ref[:], rlo[: 2 * halo]], axis=0)
+            hi_rows = jnp.concatenate([hi_ref[:], rhi[: 2 * halo]], axis=0)
+            out_ref[:] = _col_finalize(lo_rows, hi_rows, taps, k)
+
+        lo_ref[:] = rlo
+        hi_ref[:] = rhi
+
+    from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
+        _COMPILER_PARAMS,
+    )
+
+    return pl.pallas_call(
+        kernel,
+        grid=(nb + 1,),
+        in_specs=[
+            pl.BlockSpec(
+                (bh, wsp),
+                lambda i: (jnp.minimum(i, nb_in - 1), 0),
+                memory_space=pltpu.VMEM,
+            )
+        ],
+        out_specs=pl.BlockSpec(
+            (bh, ws),
+            lambda i: (jnp.maximum(i - 1, 0), 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((nb * bh, ws), jnp.uint32),
+        scratch_shapes=[
+            pltpu.VMEM((bh, ws), jnp.uint32),
+            pltpu.VMEM((bh, ws), jnp.uint32),
+        ],
+        compiler_params=_COMPILER_PARAMS,
+        interpret=interpret,
+    )
+
+
+def swar_stencil(
+    op: StencilOp,
+    img: jnp.ndarray,
+    *,
+    block_h: int | None = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """One eligible StencilOp on a (H, W) u8 plane via the SWAR path."""
+    taps, k = _taps_shift(op)
+    halo = op.halo
+    height, width = img.shape
+    ws = width // 4
+    xpad = jnp.pad(
+        img, ((halo, halo), (halo, halo)), mode=_PAD_MODES[op.edge_mode]
+    )
+    ext = pack_quarters(xpad, halo)
+    bh = block_h or _pick_swar_block_h(ws, halo)
+    outw = make_swar_stencil(
+        ext.shape, taps, k, bh, interpret=interpret
+    )(ext)
+    return unpack_quarters(outw[:height])
+
+
+def pipeline_swar(
+    ops: tuple[Op, ...],
+    img: jnp.ndarray,
+    *,
+    interpret: bool | None = None,
+    block_h: int | None = None,
+) -> jnp.ndarray:
+    """Run a pipeline with eligible stencils on the SWAR path and every
+    other op on the u8 streaming kernels (fallback keeps the backend
+    always-correct, the ``impl='packed'`` contract).
+
+    Fallback granularity is maximal runs, not single ops: consecutive
+    ineligible ops go to pipeline_pallas as ONE call so its group fusion
+    (pointwise chains folded into stencil streams) is preserved — per-op
+    fallback would pay an extra HBM read+write per op (review finding)."""
+    from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
+        pipeline_pallas,
+    )
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    pending: list[Op] = []
+
+    def flush(im):
+        if pending:
+            im = pipeline_pallas(
+                tuple(pending), im, interpret=interpret, block_h=block_h
+            )
+            pending.clear()
+        return im
+
+    for op in ops:
+        if swar_eligible(op):
+            # op-qualifies; the shape gate needs the ACTUAL input to this
+            # op, so flush the pending run first
+            img = flush(img)
+            if img.dtype == jnp.uint8 and swar_eligible(
+                op, tuple(img.shape)
+            ):
+                img = swar_stencil(
+                    op, img, block_h=block_h, interpret=interpret
+                )
+                continue
+        pending.append(op)
+    return flush(img)

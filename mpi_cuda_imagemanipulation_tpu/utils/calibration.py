@@ -134,7 +134,10 @@ def lookup_block_h(
     ):
         return None
     bh = rec.get("block_h")
-    if isinstance(bh, int) and 32 <= bh <= 4096:
+    # lower bound 8, not 32: swar blocks are ext-row multiples of 8
+    # (ops/swar_kernels._pick_swar_block_h); each impl's picker enforces
+    # its own stricter minimum via the min rule
+    if isinstance(bh, int) and 8 <= bh <= 4096:
         return bh
     return None
 

@@ -67,8 +67,9 @@ def test_kill_switch_and_bounds(calib_file, monkeypatch):
     assert calibration.lookup_block_h("cpu") is None
     monkeypatch.delenv("MCIM_NO_CALIB")
     assert calibration.lookup_block_h("cpu") == 128
-    # out-of-range stored values are rejected, not clamped
-    calibration.record_block_h("cpu", 8)
+    # out-of-range stored values are rejected, not clamped (lower bound is
+    # 8 — the swar ext-row granularity; see lookup_block_h)
+    calibration.record_block_h("cpu", 4)
     assert calibration.lookup_block_h("cpu") is None
 
 

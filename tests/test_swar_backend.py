@@ -1,0 +1,242 @@
+"""Production SWAR backend (ops/swar_kernels.py, impl='swar').
+
+Bit-exactness vs the golden jnp path is the whole contract: the SWAR
+16-bit-field integer arithmetic must reproduce StencilOp.valid + rint_clip
+exactly (the identity argued in the module docstring), on every shape
+class the streaming carry kernel distinguishes (block-aligned, ragged,
+tail-only), with per-op fallback keeping arbitrary pipelines correct.
+Runs in Pallas interpret mode on CPU like the other kernel suites.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_cuda_imagemanipulation_tpu.io.image import synthetic_image
+from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline
+from mpi_cuda_imagemanipulation_tpu.ops.registry import make_pipeline_ops
+from mpi_cuda_imagemanipulation_tpu.ops.swar_kernels import (
+    pack_quarters,
+    pipeline_swar,
+    swar_eligible,
+    unpack_quarters,
+)
+
+
+def _golden(spec: str, img):
+    return np.asarray(Pipeline.parse(spec)(img))
+
+
+def _swar(spec: str, img, **kw):
+    return np.asarray(
+        pipeline_swar(make_pipeline_ops(spec), img, interpret=True, **kw)
+    )
+
+
+def test_eligibility_matrix():
+    """Exactly the binomial Gaussians 3 and 5 qualify; everything else in
+    the registry falls back (gaussian:7 overflows 16-bit fields: S=64)."""
+    elig = {
+        spec: swar_eligible(make_pipeline_ops(spec)[0], (64, 64))
+        for spec in (
+            "gaussian:3",
+            "gaussian:5",
+            "gaussian:7",
+            "box:3",
+            "emboss:3",
+            "emboss101:3",
+            "median:3",
+            "erode:5",
+            "sobel",
+            "sharpen",
+            "grayscale",
+        )
+    }
+    assert elig == {
+        "gaussian:3": True,
+        "gaussian:5": True,
+        "gaussian:7": False,
+        "box:3": False,
+        "emboss:3": False,  # interior edge mode + trunc_clip
+        "emboss101:3": False,  # non-separable signed kernel
+        "median:3": False,
+        "erode:5": False,
+        "sobel": False,
+        "sharpen": False,
+        "grayscale": False,  # pointwise
+    }
+
+
+def test_eligibility_shape_gates():
+    op = make_pipeline_ops("gaussian:5")[0]
+    assert swar_eligible(op, (64, 64))
+    assert not swar_eligible(op, (64, 66))  # W % 4 != 0
+    assert not swar_eligible(op, (64, 12))  # Ws < 2h+1
+    assert not swar_eligible(op, (2, 64))  # H <= halo
+    assert not swar_eligible(op, (64, 64, 3))  # not a single plane
+
+
+def test_pack_unpack_roundtrip():
+    img = jnp.asarray(synthetic_image(24, 64, channels=1, seed=5))
+    xpad = jnp.pad(img, 2, mode="reflect")
+    words = pack_quarters(xpad, 2)
+    assert words.dtype == jnp.uint32
+    assert words.shape == (28, 16 + 4)
+    # interior reassembles exactly (packing is strip-of-padded layout, so
+    # round-trip through the padded plane's strips)
+    strips = np.asarray(
+        jnp.concatenate(
+            [xpad[:, k * 16 : k * 16 + 16] for k in range(4)], axis=1
+        )
+    )
+    got = np.asarray(unpack_quarters(words[:, :16]))
+    np.testing.assert_array_equal(got, strips)
+
+
+@pytest.mark.parametrize("spec", ["gaussian:3", "gaussian:5"])
+@pytest.mark.parametrize(
+    "shape,seed",
+    [((48, 64), 1), ((37, 128), 2), ((130, 256), 3), ((8, 64), 4)],
+)
+def test_swar_bit_exact_vs_golden(spec, shape, seed):
+    img = jnp.asarray(synthetic_image(*shape, channels=1, seed=seed))
+    np.testing.assert_array_equal(_swar(spec, img), _golden(spec, img))
+
+
+@pytest.mark.parametrize("bh", [8, 16, 24, 48])
+def test_swar_ragged_block_heights(bh):
+    """The carry kernel's clamped-index tail: garbage rows land only at
+    r >= H and are cropped, for block heights that do and do not divide
+    the ext height."""
+    img = jnp.asarray(synthetic_image(37, 64, channels=1, seed=6))
+    np.testing.assert_array_equal(
+        _swar("gaussian:5", img, block_h=bh), _golden("gaussian:5", img)
+    )
+
+
+def test_swar_fallback_keeps_pipelines_correct():
+    """Ineligible ops run on the u8 streaming kernels per op: mixed and
+    fully-ineligible pipelines stay bit-exact."""
+    rgb = jnp.asarray(synthetic_image(40, 64, channels=3, seed=7))
+    for spec in (
+        "grayscale,gaussian:5",  # pointwise fallback, then SWAR stage
+        "grayscale,contrast:3.5,emboss:3",  # reference pipeline: no SWAR op
+    ):
+        np.testing.assert_array_equal(_swar(spec, rgb), _golden(spec, rgb))
+    # W % 4 != 0: the gaussian itself falls back
+    odd = jnp.asarray(synthetic_image(40, 66, channels=1, seed=8))
+    np.testing.assert_array_equal(
+        _swar("gaussian:5", odd), _golden("gaussian:5", odd)
+    )
+    # gaussian:7 (S=64, would overflow): falls back, still exact
+    img = jnp.asarray(synthetic_image(40, 64, channels=1, seed=9))
+    np.testing.assert_array_equal(
+        _swar("gaussian:7", img), _golden("gaussian:7", img)
+    )
+
+
+def test_pipeline_backend_swar():
+    """Pipeline.jit(backend='swar') is routed and bit-exact; sharded
+    rejects swar with a clear error."""
+    img = jnp.asarray(synthetic_image(48, 64, channels=1, seed=10))
+    fn = Pipeline.parse("gaussian:5").jit(backend="swar")
+    np.testing.assert_array_equal(
+        np.asarray(fn(img)), _golden("gaussian:5", img)
+    )
+    from mpi_cuda_imagemanipulation_tpu.parallel.mesh import make_mesh
+
+    with pytest.raises(ValueError, match="swar backend is single-device"):
+        Pipeline.parse("gaussian:5").sharded(make_mesh(2), backend="swar")
+
+
+def test_cli_run_impl_swar(tmp_path):
+    """End-to-end CLI: --impl swar output equals --impl xla output."""
+    from mpi_cuda_imagemanipulation_tpu.cli import main
+    from mpi_cuda_imagemanipulation_tpu.io.image import save_image
+
+    img = synthetic_image(40, 64, channels=1, seed=11)
+    inp = tmp_path / "in.png"
+    save_image(inp, img)
+    out_swar = tmp_path / "swar.png"
+    out_xla = tmp_path / "xla.png"
+    for impl, out in (("swar", out_swar), ("xla", out_xla)):
+        rc = main(
+            [
+                "run",
+                "--input", str(inp),
+                "--output", str(out),
+                "--ops", "gaussian:5",
+                "--impl", impl,
+                "--gray-output",
+            ]
+        )
+        assert rc == 0
+    from mpi_cuda_imagemanipulation_tpu.io.image import load_image
+
+    np.testing.assert_array_equal(
+        load_image(out_swar, grayscale=True), load_image(out_xla, grayscale=True)
+    )
+
+
+def test_autotune_swar_impl(tmp_path, monkeypatch):
+    """The autotune sweep accepts --impl swar (step-8 candidates) and
+    records a swar-keyed entry the swar block picker then honors."""
+    from mpi_cuda_imagemanipulation_tpu.cli import main
+    from mpi_cuda_imagemanipulation_tpu.utils import calibration, timing
+
+    calib = tmp_path / "calib.json"
+    monkeypatch.setenv("MCIM_CALIB_FILE", str(calib))
+    monkeypatch.delenv("MCIM_NO_CALIB", raising=False)
+    calibration._cache["key"] = None
+    monkeypatch.setattr(
+        timing,
+        "device_throughput",
+        lambda fn, fa, **kw: (fn(*fa).block_until_ready(), 0.001)[1],
+    )
+    rc = main(
+        [
+            "autotune",
+            "--impl", "swar",
+            "--blocks", "16,20",  # 20 skipped (not a multiple of 8)
+            "--height", "64",
+            "--width", "256",
+            "--device", "cpu",
+            "--allow-interpret",
+        ]
+    )
+    assert rc == 0
+    calibration._cache["key"] = None
+    assert calibration.lookup_block_h("cpu", impl="swar", width=256) == 16
+    # pallas lookups are untouched
+    assert calibration.lookup_block_h("cpu", impl="pallas") is None
+
+
+def test_autotune_swar_rejects_ineligible_shape(tmp_path, monkeypatch):
+    """A width the SWAR path cannot take (W % 4 != 0) must fail fast, not
+    sweep the pallas fallback and record its timing as a swar calibration
+    (review finding)."""
+    from mpi_cuda_imagemanipulation_tpu.cli import main
+    from mpi_cuda_imagemanipulation_tpu.utils import timing
+
+    calib = tmp_path / "calib.json"
+    monkeypatch.setenv("MCIM_CALIB_FILE", str(calib))
+    calls = []
+    monkeypatch.setattr(
+        timing, "device_throughput", lambda *a, **k: calls.append(1) or 0.001
+    )
+    rc = main(
+        [
+            "autotune",
+            "--impl", "swar",
+            "--blocks", "16",
+            "--height", "64",
+            "--width", "258",
+            "--device", "cpu",
+            "--allow-interpret",
+        ]
+    )
+    assert rc == 2
+    assert calls == []
+    assert not calib.exists()

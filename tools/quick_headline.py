@@ -70,7 +70,7 @@ def main() -> int:
     # when a later impl wedges), so a window only long enough for one
     # compile still leaves a committed same-round TPU record.
     impls = [s.strip() for s in args.impls.split(",") if s.strip()]
-    bad = [s for s in impls if s not in ("xla", "pallas", "packed", "auto")]
+    bad = [s for s in impls if s not in ("xla", "pallas", "packed", "swar", "auto")]
     if bad or not impls:
         print(f"unknown impls {bad or args.impls!r}", file=sys.stderr)
         return 2

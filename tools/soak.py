@@ -207,6 +207,47 @@ def run_trial(
         if not np.array_equal(got, golden):
             return bh_repro("packed", "mismatch")
 
+    if rng.random() < 0.4:  # swar path (eligible stencils + run fallback)
+        from mpi_cuda_imagemanipulation_tpu.ops.swar_kernels import (
+            pipeline_swar,
+        )
+
+        # the random 3-channel pipeline mostly exercises the FALLBACK
+        # (swar needs a single u8 plane with W % 4 == 0), so first run a
+        # dedicated plane trial that hits the SWAR kernel itself on
+        # fuzzed shapes/heights (review finding)
+        w4 = w - (w % 4)
+        if w4 >= 24 and h >= 8:
+            sbh = rng.choice((None, 8, 16, 24, 32, 64))
+            gimg = jnp.asarray(
+                synthetic_image(h, w4, channels=1, seed=trial_seed + 77)
+            )
+            gspec = rng.choice(
+                ("gaussian:3", "gaussian:5", "gaussian:3,gaussian:5")
+            )
+            gpipe = Pipeline.parse(gspec)
+            try:
+                got = np.asarray(
+                    pipeline_swar(gpipe.ops, gimg, interpret=True, block_h=sbh)
+                )
+            except Exception as e:  # noqa: BLE001
+                return repro(
+                    "swar-plane", f"{gspec} bh={sbh}: raised "
+                    f"{type(e).__name__}: {e}"
+                )
+            if not np.array_equal(got, np.asarray(gpipe(gimg))):
+                return repro("swar-plane", f"{gspec} bh={sbh}: mismatch")
+        # the mixed random pipeline still runs through pipeline_swar: its
+        # run-fallback + shape gates must stay bit-exact on any chain
+        try:
+            got = np.asarray(
+                pipeline_swar(pipe.ops, img, interpret=True, block_h=bh)
+            )
+        except Exception as e:  # noqa: BLE001
+            return bh_repro("swar", f"raised {type(e).__name__}: {e}")
+        if not np.array_equal(got, golden):
+            return bh_repro("swar", "mismatch")
+
     if rng.random() < 0.35:  # batched (vmap) path: per-image bit-equality
         k = rng.randint(2, 3)
         imgs = jnp.stack(

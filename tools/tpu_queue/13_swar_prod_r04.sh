@@ -1,0 +1,17 @@
+#!/bin/bash
+# Production SWAR headline capture (after the prototype timing in 12_):
+# the packaged impl='swar' path (ops/swar_kernels.py) on the headline
+# config, recorded to history. Promotion is best-by-value, so this only
+# moves the artifact of record if SWAR actually wins on silicon — and if
+# the 12_ prototype prediction (2-4x) holds, THIS record is the round's
+# >=2x production headline, same window.
+# Wall-time budget: ~2-4 min (one fresh compile of the swar kernel + pack).
+set -u
+cd "$(dirname "$0")/../.."
+. tools/tpu_queue/_lib.sh
+timeout 1800 python tools/quick_headline.py --impls swar,pallas \
+  > quick_swar_r04.out 2>&1
+rc=$?
+commit_artifacts "TPU window: production swar-impl headline capture (round 4)" \
+  BENCH_HISTORY.jsonl quick_swar_r04.out
+exit $rc
