@@ -255,6 +255,26 @@ def run_sweep(shapes, results) -> int:
             lambda: _unpack(_mk(sext.shape, sbh, interpret=_interp)(sext)[:sh]),
         )
 
+    # production swar backend (ops/swar_kernels.py): compiled Mosaic record
+    # for the packaged pipeline path — eligible stencils, a chain staying
+    # on the swar path, and the run-fallback mix
+    from mpi_cuda_imagemanipulation_tpu.ops.swar_kernels import pipeline_swar
+
+    for spec, ch, seed in (
+        ("gaussian:5", 1, 41),
+        ("gaussian:3", 1, 42),
+        ("gaussian:3,gaussian:5", 1, 43),
+        ("grayscale,gaussian:5", 3, 44),
+    ):
+        pipe = Pipeline.parse(spec)
+        hw = (130, 256)
+        simg2 = jnp.asarray(synthetic_image(*hw, channels=ch, seed=seed))
+        fails += not _check(
+            results, "swar_prod", spec, ch, hw,
+            lambda: golden_of(pipe.ops, simg2),
+            lambda: pipeline_swar(pipe.ops, simg2, interpret=_interp),
+        )
+
     from mpi_cuda_imagemanipulation_tpu.utils.guard import run_guarded
 
     for spec, ch, impl in GUARDED_CASES:
