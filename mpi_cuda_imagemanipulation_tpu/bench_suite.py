@@ -175,10 +175,11 @@ def run_config(cfg: BenchConfig, impl: str) -> dict:
         # and the field is omitted rather than overstated 4x
         from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
             prefer_packed,
+            prefer_swar,
         )
 
         streams_u8 = impl not in ("packed", "swar") and not (
-            impl == "auto" and prefer_packed()
+            impl == "auto" and (prefer_packed() or prefer_swar())
         )
         if gen in ELEM_G_S_MEASURED and streams_u8:
             rec["elem_ceiling_frac"] = gb_s / ELEM_G_S_MEASURED[gen]

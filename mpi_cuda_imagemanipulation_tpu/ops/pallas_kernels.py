@@ -976,6 +976,22 @@ def prefer_packed() -> bool:
     return os.environ.get("MCIM_PREFER_PACKED", "") not in ("", "0")
 
 
+def prefer_swar() -> bool:
+    """Same promotion switch for the SWAR quarter-strip backend
+    (ops/swar_kernels.py): MCIM_PREFER_SWAR=1 routes bare eligible
+    stencil groups through it on the SINGLE-DEVICE auto paths (CLI
+    default, batch), once the on-chip prototype + production captures
+    (queue steps 12/13, BASELINE.md round-4 predictions) confirm the
+    2-4x element-rate win. The sharded fused-ghost runner keeps u8
+    streaming regardless — its ghost rows are full-width u8 by design,
+    and quarter-strip words would need their own ghost layout (the same
+    reason Pipeline.sharded rejects backend='swar'); sharded_pipeline
+    logs this when the flag is set."""
+    import os
+
+    return os.environ.get("MCIM_PREFER_SWAR", "") not in ("", "0")
+
+
 def pipeline_auto(
     ops,
     img: jnp.ndarray,
@@ -989,6 +1005,7 @@ def pipeline_auto(
     Bit-exact with both pure paths (they are bit-exact with each other)."""
     state = img
     packed = prefer_packed()
+    swar = prefer_swar()
     for pointwise, stencil in group_ops(ops):
         n_ch = state.shape[2] if state.ndim == 3 else 1
         if use_pallas_for_stencil(stencil, n_ch):
@@ -997,6 +1014,22 @@ def pipeline_auto(
                 if state.ndim == 3
                 else [state]
             )
+            if swar and not pointwise and len(planes) == 1:
+                from mpi_cuda_imagemanipulation_tpu.ops.swar_kernels import (
+                    swar_eligible,
+                    swar_stencil,
+                )
+
+                if state.dtype == jnp.uint8 and swar_eligible(
+                    stencil, tuple(planes[0].shape)
+                ):
+                    state = swar_stencil(
+                        stencil,
+                        planes[0],
+                        block_h=block_h,
+                        interpret=interpret,
+                    )
+                    continue
             if packed:
                 from mpi_cuda_imagemanipulation_tpu.ops.packed_kernels import (
                     packed_supported,

@@ -259,9 +259,15 @@ def swar_stencil(
     img: jnp.ndarray,
     *,
     block_h: int | None = None,
-    interpret: bool = False,
+    interpret: bool | None = None,
 ) -> jnp.ndarray:
-    """One eligible StencilOp on a (H, W) u8 plane via the SWAR path."""
+    """One eligible StencilOp on a (H, W) u8 plane via the SWAR path.
+
+    `interpret=None` resolves like every other kernel entry point
+    (compiled on TPU, interpreter elsewhere), so callers pass their own
+    `interpret` straight through."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
     taps, k = _taps_shift(op)
     halo = op.halo
     height, width = img.shape

@@ -422,8 +422,22 @@ def sharded_pipeline(pipe, mesh, backend: str = "xla"):
     # Pallas tile can run (pallas_call outputs carry no vma annotations).
     if backend == "auto":
         from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
+            prefer_swar,
             use_pallas_for_stencil,
         )
+
+        if prefer_swar():
+            # the ghost rows this runner exchanges are full-width u8;
+            # quarter-strip words would need their own ghost layout, so
+            # the SWAR promotion flag does not apply here — say so
+            # instead of silently ignoring it (review finding)
+            from mpi_cuda_imagemanipulation_tpu.utils.log import get_logger
+
+            get_logger().info(
+                "MCIM_PREFER_SWAR does not apply to the sharded runner "
+                "(full-width u8 ghost rows; see prefer_swar docstring) — "
+                "shards stay on u8 streaming"
+            )
 
         any_pallas = any(
             isinstance(op, StencilOp) and use_pallas_for_stencil(op, 1)

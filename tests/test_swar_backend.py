@@ -151,6 +151,41 @@ def test_pipeline_backend_swar():
         Pipeline.parse("gaussian:5").sharded(make_mesh(2), backend="swar")
 
 
+def test_prefer_swar_promotes_auto_routing(monkeypatch):
+    """MCIM_PREFER_SWAR=1 routes bare eligible stencil groups through the
+    SWAR kernel under `auto` (the post-win promotion switch, mirroring
+    MCIM_PREFER_PACKED), bit-exact; without the flag auto never calls it."""
+    from mpi_cuda_imagemanipulation_tpu.ops import pallas_kernels, swar_kernels
+
+    calls = []
+    real = swar_kernels.swar_stencil
+
+    def counting(*a, **k):
+        calls.append(1)
+        return real(*a, **k)
+
+    monkeypatch.setattr(swar_kernels, "swar_stencil", counting)
+    img = jnp.asarray(synthetic_image(48, 64, channels=1, seed=12))
+    golden = _golden("gaussian:5", img)
+    ops = make_pipeline_ops("gaussian:5")
+
+    monkeypatch.delenv("MCIM_PREFER_SWAR", raising=False)
+    out = np.asarray(pallas_kernels.pipeline_auto(ops, img, interpret=True))
+    np.testing.assert_array_equal(out, golden)
+    assert calls == []
+
+    monkeypatch.setenv("MCIM_PREFER_SWAR", "1")
+    out = np.asarray(pallas_kernels.pipeline_auto(ops, img, interpret=True))
+    np.testing.assert_array_equal(out, golden)
+    assert calls == [1]
+
+    # ineligible under the flag (W % 4 != 0): auto falls through, stays exact
+    odd = jnp.asarray(synthetic_image(48, 66, channels=1, seed=13))
+    out = np.asarray(pallas_kernels.pipeline_auto(ops, odd, interpret=True))
+    np.testing.assert_array_equal(out, _golden("gaussian:5", odd))
+    assert calls == [1]
+
+
 def test_cli_run_impl_swar(tmp_path):
     """End-to-end CLI: --impl swar output equals --impl xla output."""
     from mpi_cuda_imagemanipulation_tpu.cli import main
