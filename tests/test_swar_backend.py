@@ -151,6 +151,21 @@ def test_pipeline_backend_swar():
         Pipeline.parse("gaussian:5").sharded(make_mesh(2), backend="swar")
 
 
+def test_batched_swar_vmap():
+    """Pipeline.batched(backend='swar'): the quarter-strip pallas_call
+    batches through the vmap rule (extra grid dim), per-image bit-equal."""
+    imgs = jnp.stack(
+        [
+            jnp.asarray(synthetic_image(48, 64, channels=1, seed=s))
+            for s in (21, 22)
+        ]
+    )
+    pipe = Pipeline.parse("gaussian:5")
+    out = np.asarray(pipe.batched(backend="swar")(imgs))
+    gold = np.stack([np.asarray(pipe(imgs[i])) for i in range(2)])
+    np.testing.assert_array_equal(out, gold)
+
+
 def test_prefer_swar_promotes_auto_routing(monkeypatch):
     """MCIM_PREFER_SWAR=1 routes bare eligible stencil groups through the
     SWAR kernel under `auto` (the post-win promotion switch, mirroring
