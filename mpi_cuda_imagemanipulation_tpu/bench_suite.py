@@ -22,6 +22,7 @@ from mpi_cuda_imagemanipulation_tpu.io.image import synthetic_image
 from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline
 from mpi_cuda_imagemanipulation_tpu.parallel.mesh import make_mesh
 from mpi_cuda_imagemanipulation_tpu.utils.log import emit_json_metrics, get_logger
+from mpi_cuda_imagemanipulation_tpu.utils.platform import is_tpu_backend
 from mpi_cuda_imagemanipulation_tpu.utils.timing import device_throughput
 
 # Estimated reference performance on its own headline config (BASELINE.md
@@ -145,7 +146,7 @@ def run_config(cfg: BenchConfig, impl: str) -> dict:
     sec = device_throughput(fn, [img])
     mp = cfg.height * cfg.width * max(1, cfg.batch) / 1e6
     platform = jax.default_backend()
-    on_tpu = platform in ("tpu", "axon")
+    on_tpu = is_tpu_backend()
     hbm_bytes = modeled_hbm_bytes(cfg)
     gb_s = hbm_bytes / sec / n_chips / 1e9
     rec = {

@@ -668,8 +668,12 @@ def cmd_autotune(args: argparse.Namespace) -> int:
             device_throughput,
         )
 
+        from mpi_cuda_imagemanipulation_tpu.utils.platform import (
+            is_tpu_backend,
+        )
+
         backend = jax.default_backend()
-        if backend not in ("tpu", "axon") and not args.allow_interpret:
+        if not is_tpu_backend() and not args.allow_interpret:
             # pipeline_pallas defaults to interpret=True off-TPU, so the
             # sweep would time the Pallas INTERPRETER and record a
             # meaningless height that then clamps real runs on this device

@@ -60,6 +60,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from mpi_cuda_imagemanipulation_tpu.utils.platform import is_tpu_backend
 from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
     _COMPILER_PARAMS,
     _apply_pointwise_planes,
@@ -641,7 +642,7 @@ def run_group_packed_words(
         width, n_in, n_out, h, _live_f32_temps(stencil), impl="packed"
     )
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        interpret = not is_tpu_backend()
 
     if stencil is None:
         grid = (-(-height // bh),)

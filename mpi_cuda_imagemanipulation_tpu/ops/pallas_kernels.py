@@ -53,6 +53,7 @@ from mpi_cuda_imagemanipulation_tpu.ops.spec import (
     window_reduce_1d,
 )
 from mpi_cuda_imagemanipulation_tpu.utils import calibration
+from mpi_cuda_imagemanipulation_tpu.utils.platform import is_tpu_backend
 
 # --------------------------------------------------------------------------
 # Pipeline grouping: [pointwise*, stencil?] units, one pallas_call each
@@ -656,7 +657,7 @@ def run_group(
     bh = block_h or _pick_block_h(width, n_in, n_out, h, _live_f32_temps(stencil))
 
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        interpret = not is_tpu_backend()
 
     if stencil is None:
         # plain streaming pointwise: one read, one write, ragged last block
@@ -806,7 +807,7 @@ def stencil_tile_pallas(
         main_ref[:] = rp
 
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        interpret = not is_tpu_backend()
     out = pl.pallas_call(
         kernel,
         grid=(nb_out + 1,),

@@ -62,6 +62,7 @@ from mpi_cuda_imagemanipulation_tpu.ops.spec import (
     StencilOp,
 )
 from mpi_cuda_imagemanipulation_tpu.utils import calibration
+from mpi_cuda_imagemanipulation_tpu.utils.platform import is_tpu_backend
 
 # field masks as python ints (a pallas kernel body must not capture traced
 # constants); & / + / * with a uint32 array stays uint32
@@ -91,7 +92,11 @@ def swar_eligible(op: Op, plane_shape: tuple[int, ...] | None = None) -> bool:
         return False
     if abs(op.scale * s * s - 1.0) > 1e-12:
         return False
-    if op.halo != (len(t) - 1) // 2:
+    # exact form (not (n-1)//2): make_swar_stencil assumes n - 1 == 2*halo,
+    # and this also rejects even-length tap vectors, which would otherwise
+    # pass a truncating check and crash in-kernel instead of falling back
+    # (advisor round-4 finding)
+    if len(t) - 1 != 2 * op.halo:
         return False
     if plane_shape is not None:
         if len(plane_shape) != 2:
@@ -267,7 +272,7 @@ def swar_stencil(
     (compiled on TPU, interpreter elsewhere), so callers pass their own
     `interpret` straight through."""
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        interpret = not is_tpu_backend()
     taps, k = _taps_shift(op)
     halo = op.halo
     height, width = img.shape
@@ -297,20 +302,26 @@ def pipeline_swar(
     Fallback granularity is maximal runs, not single ops: consecutive
     ineligible ops go to pipeline_pallas as ONE call so its group fusion
     (pointwise chains folded into stencil streams) is preserved — per-op
-    fallback would pay an extra HBM read+write per op (review finding)."""
+    fallback would pay an extra HBM read+write per op (review finding).
+
+    An explicit ``block_h`` applies to the SWAR kernels only; fallback
+    flushes let the u8 path's own heuristic pick (advisor round-4
+    finding: swar-granularity heights — multiples of 8, as low as 8 —
+    would otherwise silently shape the u8 kernels, which are tuned at
+    multiples of 32)."""
     from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
         pipeline_pallas,
     )
 
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        interpret = not is_tpu_backend()
 
     pending: list[Op] = []
 
     def flush(im):
         if pending:
             im = pipeline_pallas(
-                tuple(pending), im, interpret=interpret, block_h=block_h
+                tuple(pending), im, interpret=interpret, block_h=None
             )
             pending.clear()
         return im

@@ -108,7 +108,9 @@ def main() -> int:
 
     backend = jax.default_backend()
     print(f"backend: {backend}", flush=True)
-    if backend not in ("tpu", "axon"):
+    from mpi_cuda_imagemanipulation_tpu.utils.platform import is_tpu_backend
+
+    if not is_tpu_backend():
         print("not a TPU backend; refusing (trace would be host-only)",
               file=sys.stderr)
         return 3

@@ -241,7 +241,9 @@ def run_sweep(shapes, results) -> int:
     _pack, _unpack, _, _mk = _swar.build_fns()
     import numpy as _np
 
-    _interp = jax.default_backend() not in ("tpu", "axon")
+    from mpi_cuda_imagemanipulation_tpu.utils.platform import is_tpu_backend
+
+    _interp = not is_tpu_backend()
     for sh, sbh in ((129, 32), (96, 48)):
         simg = jnp.asarray(synthetic_image(sh, 128, channels=1, seed=31))
         spipe = Pipeline.parse("gaussian:5")
