@@ -1,0 +1,23 @@
+#!/bin/bash
+# Round-5 MINIMAL DECISIVE SUBSET (VERDICT r4 next-round #1): the two
+# measurements that close the round's stated objective, sized to fit a
+# ~5-minute window — (a) the insurance headline (pallas) so the round's
+# artifact of record is a hardware number, and (b) the production SWAR
+# headline, whose ratio against (a) IS the SWAR-vs-u8 decision
+# (pre-registered prediction: 2-4x if the element-rate ceiling is real;
+# ~1x shelves SWAR — BASELINE.md round-3 pre-registration).
+# quick_headline.py appends each impl's record to BENCH_HISTORY.jsonl
+# IMMEDIATELY after its measurement, so a window that dies between the
+# two still leaves the pallas insurance record committed.
+# Budget: ~2-4 min warm (both executables cached from round-3 windows /
+# the shared compile cache), ~10 min cold. The 900s timeout keeps this
+# step from eating a short window that the full bundle (05-14) needs.
+set -u
+cd "$(dirname "$0")/../.."
+. tools/tpu_queue/_lib.sh
+timeout 900 python tools/quick_headline.py --impls pallas,swar \
+  > artifacts/minimal_decisive_r05.out 2>&1
+rc=$?
+commit_artifacts "TPU window: round-5 minimal decisive capture (pallas + swar headline)" \
+  BENCH_HISTORY.jsonl artifacts/minimal_decisive_r05.out
+exit $rc

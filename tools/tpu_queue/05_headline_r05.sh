@@ -13,8 +13,8 @@ set -u
 cd "$(dirname "$0")/../.."
 . tools/tpu_queue/_lib.sh
 timeout 1800 python tools/quick_headline.py --impls pallas,packed \
-  > quick_headline_r04.out 2>&1
+  > artifacts/quick_headline_r05.out 2>&1
 rc=$?
 commit_artifacts "TPU window: round-4 headline insurance capture" \
-  BENCH_HISTORY.jsonl quick_headline_r04.out
+  BENCH_HISTORY.jsonl artifacts/quick_headline_r05.out
 exit $rc

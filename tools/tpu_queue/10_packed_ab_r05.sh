@@ -13,8 +13,8 @@
 set -u
 cd "$(dirname "$0")/../.."
 . tools/tpu_queue/_lib.sh
-timeout 1500 python tools/packed_ab.py > packed_ab_r04.out 2>&1
+timeout 1500 python tools/packed_ab.py > artifacts/packed_ab_r05.out 2>&1
 rc=$?
 commit_artifacts "TPU window: interleaved packed-u32 A/B (round 4)" \
-  packed_ab_r04.out
+  artifacts/packed_ab_r05.out
 exit $rc

@@ -10,8 +10,8 @@
 set -u
 cd "$(dirname "$0")/../.."
 . tools/tpu_queue/_lib.sh
-timeout 2400 python tools/swar_proto.py > swar_proto_r04.out 2>&1
+timeout 2400 python tools/swar_proto.py > artifacts/swar_proto_r05.out 2>&1
 rc=$?
 commit_artifacts "TPU window: SWAR quarter-strip prototype timings (round 4)" \
-  swar_proto_r04.out
+  artifacts/swar_proto_r05.out
 exit $rc

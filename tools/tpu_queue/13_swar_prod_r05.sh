@@ -10,8 +10,8 @@ set -u
 cd "$(dirname "$0")/../.."
 . tools/tpu_queue/_lib.sh
 timeout 1800 python tools/quick_headline.py --impls swar,pallas \
-  > quick_swar_r04.out 2>&1
+  > artifacts/quick_swar_r05.out 2>&1
 rc=$?
 commit_artifacts "TPU window: production swar-impl headline capture (round 4)" \
-  BENCH_HISTORY.jsonl quick_swar_r04.out
+  BENCH_HISTORY.jsonl artifacts/quick_swar_r05.out
 exit $rc

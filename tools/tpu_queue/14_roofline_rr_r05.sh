@@ -9,8 +9,8 @@
 set -u
 cd "$(dirname "$0")/../.."
 . tools/tpu_queue/_lib.sh
-timeout 2700 python tools/roofline_probe.py --rounds 3 > roofline_rr_r04.out 2>&1
+timeout 2700 python tools/roofline_probe.py --rounds 3 > artifacts/roofline_rr_r05.out 2>&1
 rc=$?
 commit_artifacts "TPU window: round-robin roofline probe (round 4)" \
-  roofline_rr_r04.out
+  artifacts/roofline_rr_r05.out
 exit $rc

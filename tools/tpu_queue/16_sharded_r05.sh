@@ -10,8 +10,8 @@ cd "$(dirname "$0")/../.."
 . tools/tpu_queue/_lib.sh
 timeout 1800 python tools/quick_headline.py \
   --config gaussian5_8k_sharded --impls pallas,xla \
-  > quick_sharded_r04.out 2>&1
+  > artifacts/quick_sharded_r05.out 2>&1
 rc=$?
 commit_artifacts "TPU window: sharded-config on-chip record (round 4)" \
-  BENCH_HISTORY.jsonl quick_sharded_r04.out
+  BENCH_HISTORY.jsonl artifacts/quick_sharded_r05.out
 exit $rc

@@ -9,10 +9,10 @@
 set -u
 cd "$(dirname "$0")/../.."
 . tools/tpu_queue/_lib.sh
-timeout 3600 python tools/tpu_validate.py --out VALIDATE_r04.json \
-  > validate_r04.out 2>&1
+timeout 3600 python tools/tpu_validate.py --out VALIDATE_r05.json \
+  > artifacts/validate_r05.out 2>&1
 rc=$?
-arts=(validate_r04.out)
-[ -f VALIDATE_r04.json ] && arts+=(VALIDATE_r04.json)
+arts=(artifacts/validate_r05.out)
+[ -f VALIDATE_r05.json ] && arts+=(VALIDATE_r05.json)
 commit_artifacts "TPU window: hardware validation sweep (round 4)" "${arts[@]}"
 exit $rc

@@ -8,11 +8,11 @@
 set -u
 cd "$(dirname "$0")/../.."
 . tools/tpu_queue/_lib.sh
-timeout 2400 python tools/profile_capture.py profile_r04 > profile_r04.out 2>&1
+timeout 2400 python tools/profile_capture.py artifacts/profile_r05 > artifacts/profile_r05.out 2>&1
 rc=$?
-arts=(profile_r04.out)
-[ -f profile_r04_summary.md ] && arts+=(profile_r04_summary.md)
-[ -f profile_r04_summary.json ] && arts+=(profile_r04_summary.json)
+arts=(artifacts/profile_r05.out)
+[ -f artifacts/profile_r05_summary.md ] && arts+=(artifacts/profile_r05_summary.md)
+[ -f artifacts/profile_r05_summary.json ] && arts+=(artifacts/profile_r05_summary.json)
 commit_artifacts "TPU window: headline-kernel profiler trace (round 4)" \
   "${arts[@]}"
 exit $rc

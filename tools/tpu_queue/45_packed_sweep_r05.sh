@@ -7,10 +7,10 @@ set -u
 cd "$(dirname "$0")/../.."
 . tools/tpu_queue/_lib.sh
 timeout 3000 python -m mpi_cuda_imagemanipulation_tpu bench --impl packed \
-  --json-metrics bench_packed_r04.jsonl > bench_packed_r04.out 2>&1
+  --json-metrics artifacts/bench_packed_r05.jsonl > artifacts/bench_packed_r05.out 2>&1
 rc=$?
-arts=(bench_packed_r04.out)
-[ -f bench_packed_r04.jsonl ] && arts+=(bench_packed_r04.jsonl)
+arts=(artifacts/bench_packed_r05.out)
+[ -f artifacts/bench_packed_r05.jsonl ] && arts+=(artifacts/bench_packed_r05.jsonl)
 commit_artifacts "TPU window: full packed-impl bench sweep (round 4)" \
   "${arts[@]}"
 exit $rc

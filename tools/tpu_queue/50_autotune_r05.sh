@@ -9,10 +9,10 @@ set -u
 cd "$(dirname "$0")/../.."
 . tools/tpu_queue/_lib.sh
 timeout 2400 python -m mpi_cuda_imagemanipulation_tpu autotune \
-  --json-metrics autotune_r04.jsonl > autotune_r04.out 2>&1
+  --json-metrics artifacts/autotune_r05.jsonl > artifacts/autotune_r05.out 2>&1
 rc=$?
-arts=(autotune_r04.out)
-[ -f autotune_r04.jsonl ] && arts+=(autotune_r04.jsonl)
+arts=(artifacts/autotune_r05.out)
+[ -f artifacts/autotune_r05.jsonl ] && arts+=(artifacts/autotune_r05.jsonl)
 [ -f .mcim_calibration.json ] && arts+=(.mcim_calibration.json)
 commit_artifacts "TPU window: on-chip block-height autotune (round 4)" \
   "${arts[@]}"

@@ -7,8 +7,8 @@
 set -u
 cd "$(dirname "$0")/../.."
 . tools/tpu_queue/_lib.sh
-timeout 1800 python tools/quick_headline.py > quick_headline_post_r04.out 2>&1
+timeout 1800 python tools/quick_headline.py > artifacts/quick_headline_post_r05.out 2>&1
 rc=$?
 commit_artifacts "TPU window: post-autotune headline capture (round 4)" \
-  BENCH_HISTORY.jsonl quick_headline_post_r04.out
+  BENCH_HISTORY.jsonl artifacts/quick_headline_post_r05.out
 exit $rc

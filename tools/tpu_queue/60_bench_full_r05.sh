@@ -7,8 +7,8 @@
 set -u
 cd "$(dirname "$0")/../.."
 . tools/tpu_queue/_lib.sh
-timeout 3600 python bench.py > bench_r04_manual.out 2>&1
+timeout 3600 python bench.py > artifacts/bench_r05_manual.out 2>&1
 rc=$?
 commit_artifacts "TPU window: full bench campaign (round 4)" \
-  BENCH_HISTORY.jsonl bench_r04_manual.out
+  BENCH_HISTORY.jsonl artifacts/bench_r05_manual.out
 exit $rc

@@ -13,6 +13,7 @@
 # cache keys on HLO + compile options, so it can never change results.
 export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-$(pwd)/tools/.jax_cache}"
 mkdir -p "$JAX_COMPILATION_CACHE_DIR"
+mkdir -p artifacts
 
 commit_artifacts() {
   local msg="$1"
