@@ -696,6 +696,8 @@ def cmd_autotune(args: argparse.Namespace) -> int:
         if swar:
             from mpi_cuda_imagemanipulation_tpu.ops.swar_kernels import (
                 _pick_swar_block_h,
+                _swar_mode,
+                _taps_shift,
                 pipeline_swar,
                 swar_eligible,
             )
@@ -703,12 +705,12 @@ def cmd_autotune(args: argparse.Namespace) -> int:
             # shape-inclusive eligibility: an ineligible --width would
             # silently sweep the pallas FALLBACK and record its timing as a
             # swar calibration (review finding)
-            halos = [
-                op.halo
+            eligible = [
+                op
                 for op in ops
                 if swar_eligible(op, (args.height, args.width))
             ]
-            if not halos:
+            if not eligible:
                 print(
                     f"error: no swar-eligible op in --ops {args.ops!r} at "
                     f"{args.height}x{args.width} (need W % 4 == 0; see "
@@ -716,7 +718,15 @@ def cmd_autotune(args: argparse.Namespace) -> int:
                     file=sys.stderr,
                 )
                 return 2
-            cap = _pick_swar_block_h(args.width // 4, max(halos))
+            # per-op mode: wide-mode column lanes have a ~3x larger live
+            # set, so a narrow-mode cap would admit candidates the wide
+            # kernel's VMEM budget can never run (review finding)
+            cap = min(
+                _pick_swar_block_h(
+                    args.width // 4, op.halo, _swar_mode(_taps_shift(op)[0])
+                )
+                for op in eligible
+            )
             step = 8  # swar blocks are ext-row multiples of 8, not 32
         else:
             cap = min(

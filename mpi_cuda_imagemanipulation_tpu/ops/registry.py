@@ -8,6 +8,7 @@ pipeline (kernel.cu:192-195) is ``grayscale,contrast:3.5,emboss:3``.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Callable
 
 import jax.numpy as jnp
@@ -141,8 +142,19 @@ def make_brightness_core(delta: float) -> Callable[[jnp.ndarray], jnp.ndarray]:
     return brightness
 
 
+def make_brightness_lut(delta: float) -> np.ndarray:
+    """Host replay of the brightness core's f32 ops (pure numpy — see
+    make_contrast_lut for why no jnp at op-construction time)."""
+    v = np.arange(256, dtype=np.float32) + np.float32(delta)
+    return np.floor(np.clip(v, 0.0, 255.0)).astype(np.uint8)
+
+
 def invert_core(x: jnp.ndarray) -> jnp.ndarray:
     return np.float32(255.0) - x
+
+
+def invert_lut() -> np.ndarray:
+    return (255 - np.arange(256)).astype(np.uint8)
 
 
 def make_threshold_core(t: float) -> Callable[[jnp.ndarray], jnp.ndarray]:
@@ -312,6 +324,13 @@ def make_gaussian(size: int) -> StencilOp:
 
 
 def make_box(size: int) -> StencilOp:
+    # even sizes are ill-defined under the symmetric-halo tile machinery
+    # (halo = (size-1)//2 under-pads, silently shrinking the golden output
+    # and breaking the tiled kernels) — reject like make_morph/make_median.
+    # size 1 stays legal: the degenerate halo-0 box is the identity and is
+    # used as the halo-0 stencil regression case (tests/test_sharded.py)
+    if size < 1 or size % 2 == 0:
+        raise ValueError(f"box size must be odd and >= 1, got {size}")
     k2, scale = filters.box_2d(size)
     return StencilOp(
         name=f"box{size}",
@@ -469,7 +488,7 @@ _GRAYSCALE601 = PointwiseOp(
     fn=grayscale601_u8,
     planes_core=grayscale601_core,
 )
-_INVERT = pointwise_from_core("invert", 0, 0, invert_core)
+_INVERT = pointwise_from_core("invert", 0, 0, invert_core, lut_host=invert_lut)
 _GRAY2RGB = PointwiseOp("gray2rgb", in_channels=1, out_channels=3, fn=gray2rgb_u8)
 _SEPIA = PointwiseOp(
     "sepia",
@@ -498,7 +517,13 @@ def _make_contrast(f: float) -> PointwiseOp:
     by one uint8 step at trunc boundaries)."""
     name = f"contrast{f:g}"
     if _contrast_rounding_free(f):
-        return pointwise_from_core(name, 1, 1, make_contrast_core(f))
+        # lut_host == the eager golden table (asserted equal to the core
+        # on all 256 inputs by tests/test_golden.py) — lets the SWAR
+        # backend fuse contrast into stencil streams exactly
+        return pointwise_from_core(
+            name, 1, 1, make_contrast_core(f),
+            lut_host=partial(make_contrast_lut, f),
+        )
     return make_lut_op(name, make_contrast_lut(f), in_channels=1, out_channels=1)
 
 
@@ -513,6 +538,7 @@ REGISTRY: dict[str, Callable[[str | None], Op]] = {
         0,
         0,
         make_brightness_core(_float_arg(a, 0)),
+        lut_host=partial(make_brightness_lut, _float_arg(a, 0)),
     ),
     "invert": lambda a: _INVERT,
     "threshold": lambda a: pointwise_from_core(

@@ -292,6 +292,14 @@ class PointwiseOp:
     # LUT ops built on gather); they run as XLA steps between Pallas groups
     kernel_safe: bool = True
 
+    # optional host-side (pure numpy, never dispatches to a device) builder
+    # of the op's exact 256-entry u8 -> u8 table. An elementwise u8 op IS
+    # its LUT, so this is a complete behavioural spec: the SWAR backend
+    # fits its in-kernel integer form against it and fuses the op into a
+    # stencil stream only when the fit reproduces every entry
+    # (ops/swar_kernels._fit_affine_u8). None = not fusable there.
+    lut_host: Callable[[], "np.ndarray"] | None = None
+
     halo: int = 0
 
     def __call__(self, img: jnp.ndarray) -> jnp.ndarray:
@@ -300,7 +308,11 @@ class PointwiseOp:
 
 
 def pointwise_from_core(
-    name: str, in_channels: int, out_channels: int, core: Callable
+    name: str,
+    in_channels: int,
+    out_channels: int,
+    core: Callable,
+    lut_host: Callable | None = None,
 ) -> PointwiseOp:
     """Build a PointwiseOp whose u8 path is cast -> core -> cast (lossless:
     core maps exact u8 integers to exact u8 integers)."""
@@ -308,7 +320,9 @@ def pointwise_from_core(
     def fn(img: jnp.ndarray) -> jnp.ndarray:
         return core(img.astype(F32)).astype(U8)
 
-    return PointwiseOp(name, in_channels, out_channels, fn=fn, core=core)
+    return PointwiseOp(
+        name, in_channels, out_channels, fn=fn, core=core, lut_host=lut_host
+    )
 
 
 @dataclasses.dataclass(frozen=True)

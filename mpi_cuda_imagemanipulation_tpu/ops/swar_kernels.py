@@ -18,25 +18,40 @@ the element saving:
    is then a plain word-column shift for all four strips at once — no
    cross-lane byte algebra (the packed layout's fatal cost).
 2. **16-bit SWAR fields**: each word splits once into two u32 arrays
-   holding 2x16-bit fields (bytes 0,2 and 1,3). The whole separable
-   correlation runs as u32 mul/add on those fields — 2 pixels per 32-bit
-   element, half the VPU element count of f32 compute — and stays exact:
-   for integer taps with sum S, row accumulators are <= 255*S and column
-   accumulators <= 255*S^2, so S^2 <= 257 (S <= 16) guarantees no field
-   overflow. The final x S^-2 with round-half-to-even is the integer
-   identity q = (s + (S^2/2 - 1) + (q0 & 1)) >> k with q0 = s >> k,
-   k = log2(S^2) — bit-identical to the golden ``rint_clip`` quantize
-   (clipping is vacuous: the weighted mean of u8 values is in [0, 255]).
+   holding 2x16-bit fields (bytes 0,2 and 1,3). The separable correlation
+   runs as integer mul/add on those fields — 2 pixels per 32-bit element,
+   half the VPU element count of f32 compute — exactly: for integer taps
+   with sum S, row accumulators are <= 255*S, so S <= 128 keeps every
+   field (and its i32 view) in range. The column pass then runs in one of
+   two modes (``_swar_mode``):
+
+   * **narrow** (S a power of two, S <= 16 — the binomial Gaussians 3/5):
+     column accumulators <= 255*S^2 <= 65280 stay inside the 16-bit
+     fields, and the final x S^-2 with round-half-to-even is the integer
+     identity q = (s + (S^2/2 - 1) + (q0 & 1)) >> k with q0 = s >> k,
+     k = log2(S^2) — bit-identical to the golden ``rint_clip`` quantize
+     (clipping is vacuous: a weighted mean of u8 values is in [0, 255]).
+   * **wide** (everything else — gaussian:7 with S = 64, whose column
+     sums overflow 16-bit fields, and the box family, whose S^2 is not a
+     power of two): the row-passed fields widen to one pixel per i32
+     lane for the column pass (row-pass element saving kept; column pass
+     at full element count), and quantization REPLAYS the golden float
+     ops on the exact integer sums — ``f32(s) * np.float32(scale)`` then
+     ``rint_clip`` — so it is bit-exact by construction for ANY scale,
+     power of two or not. Exactness needs the column sums representable
+     in f32: 255*S^2 < 2^24 (S <= 128 satisfies it). The i32 shift/mask/
+     convert idiom mirrors ops/packed_kernels.py's Mosaic-native lane
+     algebra.
 
 Eligibility (``swar_eligible``): single-plane u8 (H, W) with W % 4 == 0,
 StencilOp with ``reduce='corr'``, ``combine='single'``, an integer
-non-negative separable vector whose sum S is a power of two with
-2 <= S <= 16, ``scale == 1/S^2``, ``quantize='rint_clip'``, and a real
-border extension (not the reference's ``interior`` guard). In the registry
-that is exactly the binomial Gaussians 3 and 5 (gaussian:7 has S = 64:
-its column pass would overflow 16-bit fields). Ineligible ops fall back to
-the u8 streaming kernels per op, so ``impl='swar'`` is always-correct —
-the same contract as ``impl='packed'`` (ops/packed_kernels.py).
+non-negative odd-length separable vector with sum 2 <= S <= 128,
+``scale == 1/S^2``, ``quantize='rint_clip'``, and a real border extension
+(not the reference's ``interior`` guard). In the registry that is the
+binomial Gaussians 3/5/7 and the odd box filters. Ineligible ops fall
+back to the u8 streaming kernels per op, so ``impl='swar'`` is
+always-correct — the same contract as ``impl='packed'``
+(ops/packed_kernels.py).
 
 The streaming kernel reuses the production scratch-carry structure
 (ops/pallas_kernels.stencil_tile_pallas): ext-row blocks stream in
@@ -58,8 +73,11 @@ from jax.experimental.pallas import tpu as pltpu
 
 from mpi_cuda_imagemanipulation_tpu.ops.spec import (
     _PAD_MODES,
+    F32,
     Op,
+    PointwiseOp,
     StencilOp,
+    rint_clip_f32,
 )
 from mpi_cuda_imagemanipulation_tpu.utils import calibration
 from mpi_cuda_imagemanipulation_tpu.utils.platform import is_tpu_backend
@@ -88,7 +106,11 @@ def swar_eligible(op: Op, plane_shape: tuple[int, ...] | None = None) -> bool:
     if not np.all(t == np.floor(t)) or np.any(t < 0):
         return False
     s = int(t.sum())
-    if s < 2 or s > 16 or (s & (s - 1)):
+    # S <= 128: row-pass fields <= 255*128 = 32640 fit 16 bits with the
+    # sign bit clear (so the wide mode's i32 view of the carried fields
+    # is exact), and wide-mode column sums 255*S^2 < 2^24 stay exactly
+    # representable in f32 for the golden-replay quantize
+    if s < 2 or s > 128:
         return False
     if abs(op.scale * s * s - 1.0) > 1e-12:
         return False
@@ -115,6 +137,151 @@ def _taps_shift(op: StencilOp) -> tuple[tuple[int, ...], int]:
     return t, k
 
 
+def _swar_mode(taps: tuple[int, ...]) -> str:
+    """'narrow' (16-bit-field column pass + shift normalisation) when S is
+    a power of two <= 16; 'wide' (per-pixel i32 column pass + golden f32
+    quantize) otherwise. See module docstring."""
+    s = sum(taps)
+    return "narrow" if s <= 16 and not (s & (s - 1)) else "wide"
+
+
+# --------------------------------------------------------------------------
+# Pointwise fusion: fitted affine u8 steps applied inside the stream
+#
+# An elementwise u8 op IS its 256-entry LUT; ops that publish a host-side
+# LUT (PointwiseOp.lut_host) are fitted to the integer form
+#
+#     q(p) = min(max(A*x - C, 0) >> m, 255),  x = p  or  255 - p
+#
+# and fused into the SWAR stencil stream — before the row pass (pre-chain,
+# on the unpacked 16-bit fields) or after the quantize (post-chain) —
+# exactly when the fit reproduces EVERY LUT entry, so fusion is bit-exact
+# by checked construction, never by assumption. In the registry this
+# covers contrast (rounding-free factors: 3.5 = (7p - 640) >> 1),
+# brightness and invert — the chains the reference pipeline composes
+# around its stencils (kernel.cu:192-195). A fused pointwise costs a few
+# VPU ops per field instead of its own HBM read+write pass.
+# --------------------------------------------------------------------------
+
+
+def _fit_affine_u8(lut_bytes: bytes) -> tuple[bool, int, int, int] | None:
+    """Fit (neg, A, C, m) reproducing the 256-entry u8 LUT exactly, or
+    None. Bounds keep every intermediate under 2^15 per 16-bit field:
+    A <= 128 (so A*255 <= 32640 with the sign bit clear) and
+    A*255 + max(-C, 0) <= 32767 (additive steps stay in range)."""
+    lut = np.frombuffer(lut_bytes, dtype=np.uint8).astype(np.int64)
+    p = np.arange(256, dtype=np.int64)
+    interior = np.nonzero((lut > 0) & (lut < 255))[0]
+    if interior.size < 2:
+        return None  # constant/step tables are not usefully affine
+    p1, p2 = int(interior[0]), int(interior[-1])
+    for neg in (False, True):
+        x = 255 - p if neg else p
+        dx = int(x[p2]) - int(x[p1])
+        if dx == 0:
+            continue
+        dl = int(lut[p2]) - int(lut[p1])
+        for m in range(9):
+            a_est = (dl << m) / dx
+            A = int(round(a_est))
+            if A < 1 or A > 128:
+                continue
+            # C from the anchor: (A*x[p1] - C) >> m == lut[p1] leaves
+            # exactly 2^m integer candidates
+            base = A * int(x[p1]) - (int(lut[p1]) << m)
+            for C in range(base - (1 << m) + 1, base + 1):
+                if abs(C) > 32767 or A * 255 + max(-C, 0) > 32767:
+                    continue
+                t = np.maximum(A * x - C, 0)
+                if np.array_equal(np.minimum(t >> m, 255), lut):
+                    return (bool(neg), A, int(C), m)
+    return None
+
+
+_FIT_CACHE: dict[bytes, tuple | None] = {}
+
+
+def swar_fusable(op: Op) -> tuple[bool, int, int, int] | None:
+    """The fitted in-field form of an elementwise pointwise op, or None
+    when the op cannot fuse into a SWAR stream (no host LUT, channel
+    structure, or no exact affine fit)."""
+    if not isinstance(op, PointwiseOp) or not op.kernel_safe:
+        return None
+    if op.lut_host is None or op.core is None:
+        return None
+    if op.in_channels not in (0, 1) or op.out_channels not in (0, 1):
+        return None
+    lut = np.asarray(op.lut_host(), dtype=np.uint8)
+    if lut.shape != (256,):
+        return None
+    key = lut.tobytes()
+    if key not in _FIT_CACHE:
+        _FIT_CACHE[key] = _fit_affine_u8(key)
+    return _FIT_CACHE[key]
+
+
+def _dt_const(F: jnp.ndarray, v: int):
+    """Dtype-matched scalar with the u32 bit pattern `v` (an i32 view
+    wraps to the same bits; add/sub/mul/bitwise are bit-identical in
+    two's complement, which is what the field tricks rely on)."""
+    if v >= 1 << 31 and F.dtype == jnp.int32:
+        v -= 1 << 32
+    return F.dtype.type(v)
+
+
+def _apply_affine_fields(F: jnp.ndarray, chain) -> jnp.ndarray:
+    """Apply fitted (neg, A, C, m) steps to two 16-bit fields per 32-bit
+    element, each field holding a u8 value; returns fields holding the
+    mapped u8 values.
+
+    Per-field compare/select uses the classic SWAR sign-probe: with both
+    operands < 2^15, (a | 0x8000) - b keeps fields independent (the
+    injected bit absorbs any borrow) and its 0x8000 bit reads a >= b.
+    The fitter's bounds guarantee the < 2^15 invariant at every step.
+    Dtype-generic (u32 narrow mode / i32 wide mode): the i32 wraparound
+    bit patterns are identical, and the one arithmetic-shift smear (on
+    the sign-probe extraction) is masked off."""
+    if not chain:
+        return F
+    M255 = _dt_const(F, _M_LO)
+    H = _dt_const(F, 0x80008000)
+    B1 = _dt_const(F, _M_B)
+    F15 = _dt_const(F, 0x7FFF7FFF)
+    for neg, A, C, m in chain:
+        if neg:
+            F = M255 - F  # per-field 255 - v: borrow-free (v <= 255)
+        T = F * _dt_const(F, A)  # <= 32640 per field
+        if C > 0:
+            D = (T | H) - _dt_const(F, C * 0x00010001)
+            ge = ((D & H) >> 15) & B1  # 1 per field where T >= C
+            mask = ge * _dt_const(F, 0xFFFF)
+            T = D & F15 & mask  # T - C where T >= C, else 0
+        elif C < 0:
+            T = T + _dt_const(F, (-C) * 0x00010001)  # <= 32767 per field
+        if m:
+            T = (T >> m) & _dt_const(F, (0xFFFF >> m) * 0x00010001)
+        # clamp to 255
+        D = (T | H) - _dt_const(F, 256 * 0x00010001)
+        ge = ((D & H) >> 15) & B1
+        mask = ge * _dt_const(F, 0xFFFF)
+        F = (T & ~mask) | (M255 & mask)
+    return F
+
+
+def _apply_affine_lanes(x: jnp.ndarray, chain) -> jnp.ndarray:
+    """Single-value-per-lane (i32 values 0..255) version of the chain —
+    the wide-mode column lanes need no field tricks, just the plain
+    integer form the fitter verified: min(max(A*x - C, 0) >> m, 255)."""
+    for neg, A, C, m in chain:
+        if neg:
+            x = jnp.int32(255) - x
+        t = jnp.maximum(x * jnp.int32(A) - jnp.int32(C), jnp.int32(0))
+        if m:
+            t = t >> m
+        x = jnp.minimum(t, jnp.int32(255))
+    return x
+
+
 def pack_quarters(xpad: jnp.ndarray, halo: int) -> jnp.ndarray:
     """(H+2h, W+2h) u8 padded plane -> (H+2h, W/4+2h) u32 quarter-strip
     words: byte k of word j is strip k's padded pixel j. Each strip's ext
@@ -133,26 +300,38 @@ def unpack_quarters(words: jnp.ndarray) -> jnp.ndarray:
     return jnp.concatenate([b[..., k] for k in range(4)], axis=1)
 
 
-def _row_pass_fields(ext_block: jnp.ndarray, taps: tuple[int, ...]):
-    """(bh, Ws+2h) u32 words -> two (bh, Ws) u32 field arrays (bytes 0,2
-    and 1,3 as 16-bit fields), row-correlated with `taps`."""
+def _row_pass_fields(
+    ext_block: jnp.ndarray, taps: tuple[int, ...], pre_chain: tuple = ()
+):
+    """(bh, Ws+2h) words -> two (bh, Ws) field arrays (bytes 0,2 and 1,3
+    as 16-bit fields), row-correlated with `taps`. Dtype-generic: u32 in
+    narrow mode, i32 in wide mode (the byte masks make the extraction
+    identical under either shift semantics; weights match the input
+    dtype so no promotion happens). `pre_chain` steps (fused pointwise
+    prefix ops) map the u8 field values before the correlation."""
     n = len(taps)
-    lo = ext_block & _M_LO
-    hi = (ext_block >> 8) & _M_LO
+    w8 = ext_block.dtype.type
+    lo = ext_block & w8(_M_LO)
+    hi = (ext_block >> w8(8)) & w8(_M_LO)
+    if pre_chain:
+        lo = _apply_affine_fields(lo, pre_chain)
+        hi = _apply_affine_fields(hi, pre_chain)
 
     def row(a):
         w = a.shape[1] - (n - 1)
-        acc = a[:, 0:w] * jnp.uint32(taps[0])
+        acc = a[:, 0:w] * w8(taps[0])
         for t in range(1, n):
-            acc = acc + a[:, t : w + t] * jnp.uint32(taps[t])
+            acc = acc + a[:, t : w + t] * w8(taps[t])
         return acc
 
     return row(lo), row(hi)
 
 
-def _col_finalize(lo_rows, hi_rows, taps: tuple[int, ...], k: int):
+def _col_finalize(
+    lo_rows, hi_rows, taps: tuple[int, ...], k: int, post_chain: tuple = ()
+):
     """(bh+2h, Ws) field arrays -> (bh, Ws) u32 output words: column pass +
-    x 2^-k round-half-to-even + byte repack."""
+    x 2^-k round-half-to-even + fused pointwise suffix + byte repack."""
     n = len(taps)
     half = (1 << (k - 1)) - 1
     m_half = (half << 16) | half
@@ -166,28 +345,79 @@ def _col_finalize(lo_rows, hi_rows, taps: tuple[int, ...], k: int):
 
     def rnd(s):
         b = (s >> k) & _M_B
-        return ((s + m_half + b) >> k) & _M_LO
+        q = ((s + m_half + b) >> k) & _M_LO
+        return _apply_affine_fields(q, post_chain)
 
     return rnd(col(lo_rows)) | (rnd(col(hi_rows)) << 8)
 
 
-def _pick_swar_block_h(ws: int, halo: int) -> int:
+def _col_finalize_wide(
+    lo_rows,
+    hi_rows,
+    taps: tuple[int, ...],
+    scale: float,
+    post_chain: tuple = (),
+):
+    """Wide-mode column pass: (bh+2h, Ws) i32 packed-field arrays ->
+    (bh, Ws) i32 output words.
+
+    Each 16-bit field widens to its own i32 lane BEFORE accumulation (the
+    narrow mode's packed column sums would overflow for S > 16), then
+    quantization replays the golden float ops on the exact integer sums —
+    ``f32(s) * np.float32(scale)``, ``rint``, clip — which is bit-exact
+    against StencilOp.valid + rint_clip for any scale, including the box
+    family's non-power-of-two 1/S^2 (same float sequence on the same
+    values). Sums <= 255*S^2 < 2^24 are exact in f32 (swar_eligible)."""
+    n = len(taps)
+    m16 = jnp.int32(0xFFFF)
+
+    def col(a):
+        hgt = a.shape[0] - (n - 1)
+        acc = a[0:hgt, :] * jnp.int32(taps[0])
+        for t in range(1, n):
+            acc = acc + a[t : hgt + t, :] * jnp.int32(taps[t])
+        return acc
+
+    def q(a):  # exact integer sums -> quantized bytes (golden replay)
+        b = rint_clip_f32(a.astype(F32) * np.float32(scale)).astype(
+            jnp.int32
+        )
+        return _apply_affine_lanes(b, post_chain)
+
+    # field layout (pack_quarters): lo = bytes 0,2; hi = bytes 1,3 —
+    # low field = the even byte, high field = the odd+2 byte
+    b0 = q(col(lo_rows & m16))
+    b2 = q(col((lo_rows >> 16) & m16))
+    b1 = q(col(hi_rows & m16))
+    b3 = q(col((hi_rows >> 16) & m16))
+    return b0 | (b1 << 8) | (b2 << 16) | (b3 << 24)
+
+
+def _pick_swar_block_h(ws: int, halo: int, mode: str = "narrow") -> int:
     """VMEM-safe ext-row block height for the carry kernel.
 
     Working set per ext row: u32 input block (double-buffered) + two field
     scratch blocks + output block (double-buffered) + ~6 live u32 temps
-    while the body runs — all Ws-wide words. Budget mirrors the u8 kernels'
-    3/4 of the 64 MiB scoped-VMEM limit (ops/pallas_kernels.py)."""
+    while the body runs — all Ws-wide words; wide mode adds the per-pixel
+    widened column lanes (+ their f32 copies), ~12 more live temps.
+    Budget mirrors the u8 kernels' 3/4 of the 64 MiB scoped-VMEM limit
+    (ops/pallas_kernels.py)."""
     from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import _VMEM_LIMIT
 
     budget = 3 * _VMEM_LIMIT // 4
-    per_row = 4 * (ws + 2 * halo) * 2 + 4 * ws * (2 + 2 + 6)
+    live = 6 if mode == "narrow" else 18
+    per_row = 4 * (ws + 2 * halo) * 2 + 4 * ws * (2 + 2 + live)
     bh = budget // max(per_row, 1)
     bh = int(max(2 * halo, min(512, bh)))
-    bh = max(8, (bh // 8) * 8)
+    # round to a multiple of 8 UP where rounding down would violate the
+    # kernel's bh >= 2*halo precondition (reachable since wide mode admits
+    # halos > 4 — review finding); the VMEM estimate is conservative
+    # enough that +7 rows never matters
+    min8 = -(-2 * halo // 8) * 8
+    bh = max(8, min8, (bh // 8) * 8)
     calibrated = calibration.lookup_block_h(impl="swar", width=4 * ws)
     if calibrated is not None:
-        bh = max(2 * halo, max(8, min(bh, (calibrated // 8) * 8)))
+        bh = max(min8, 8, min(bh, (calibrated // 8) * 8))
     return bh
 
 
@@ -197,11 +427,17 @@ def make_swar_stencil(
     k: int,
     bh: int,
     *,
+    mode: str = "narrow",
+    scale: float = 0.0,
+    pre_chain: tuple = (),
+    post_chain: tuple = (),
     interpret: bool = False,
 ):
     """Streaming SWAR kernel over quarter-strip words with the production
     scratch-carry structure. `ext_shape` = (H+2h, Ws+2h) words; returns a
-    function ext_words -> (ceil(H/bh)*bh, Ws) u32 (caller crops [:H]).
+    function ext_words -> (ceil(H/bh)*bh, Ws) words (caller crops [:H]).
+    Word dtype is u32 in narrow mode, i32 in wide mode (`_swar_mode`;
+    `scale` is the op's 1/S^2, used by the wide quantize only).
 
     Ragged heights are fine: out rows >= H are garbage (OOB-padded input
     blocks / duplicated tail rows via the clamped index maps) and the
@@ -216,16 +452,24 @@ def make_swar_stencil(
         raise ValueError(f"block_h {bh} < 2*halo {2 * halo}")
     nb = -(-height // bh)
     nb_in = -(-hp // bh)  # last block holds the bottom halo rows
+    dtype = jnp.uint32 if mode == "narrow" else jnp.int32
 
     def kernel(in_ref, out_ref, lo_ref, hi_ref):
         i = pl.program_id(0)
-        rlo, rhi = _row_pass_fields(in_ref[:], taps)
+        rlo, rhi = _row_pass_fields(in_ref[:], taps, pre_chain)
 
         @pl.when(i >= 1)
         def _():
             lo_rows = jnp.concatenate([lo_ref[:], rlo[: 2 * halo]], axis=0)
             hi_rows = jnp.concatenate([hi_ref[:], rhi[: 2 * halo]], axis=0)
-            out_ref[:] = _col_finalize(lo_rows, hi_rows, taps, k)
+            if mode == "narrow":
+                out_ref[:] = _col_finalize(
+                    lo_rows, hi_rows, taps, k, post_chain
+                )
+            else:
+                out_ref[:] = _col_finalize_wide(
+                    lo_rows, hi_rows, taps, scale, post_chain
+                )
 
         lo_ref[:] = rlo
         hi_ref[:] = rhi
@@ -249,10 +493,10 @@ def make_swar_stencil(
             lambda i: (jnp.maximum(i - 1, 0), 0),
             memory_space=pltpu.VMEM,
         ),
-        out_shape=jax.ShapeDtypeStruct((nb * bh, ws), jnp.uint32),
+        out_shape=jax.ShapeDtypeStruct((nb * bh, ws), dtype),
         scratch_shapes=[
-            pltpu.VMEM((bh, ws), jnp.uint32),
-            pltpu.VMEM((bh, ws), jnp.uint32),
+            pltpu.VMEM((bh, ws), dtype),
+            pltpu.VMEM((bh, ws), dtype),
         ],
         compiler_params=_COMPILER_PARAMS,
         interpret=interpret,
@@ -263,17 +507,25 @@ def swar_stencil(
     op: StencilOp,
     img: jnp.ndarray,
     *,
+    pre_ops: tuple = (),
+    post_ops: tuple = (),
     block_h: int | None = None,
     interpret: bool | None = None,
 ) -> jnp.ndarray:
-    """One eligible StencilOp on a (H, W) u8 plane via the SWAR path.
+    """One eligible StencilOp on a (H, W) u8 plane via the SWAR path,
+    with optional fused pointwise prefix/suffix ops (each must satisfy
+    ``swar_fusable``; their fitted chains run inside the same kernel, so
+    the whole group costs one HBM read + one write).
 
     `interpret=None` resolves like every other kernel entry point
     (compiled on TPU, interpreter elsewhere), so callers pass their own
     `interpret` straight through."""
     if interpret is None:
         interpret = not is_tpu_backend()
+    pre_chain = tuple(_require_fusable(o) for o in pre_ops)
+    post_chain = tuple(_require_fusable(o) for o in post_ops)
     taps, k = _taps_shift(op)
+    mode = _swar_mode(taps)
     halo = op.halo
     height, width = img.shape
     ws = width // 4
@@ -281,11 +533,25 @@ def swar_stencil(
         img, ((halo, halo), (halo, halo)), mode=_PAD_MODES[op.edge_mode]
     )
     ext = pack_quarters(xpad, halo)
-    bh = block_h or _pick_swar_block_h(ws, halo)
+    if mode == "wide":
+        # free same-width view: the wide kernel runs Mosaic-native i32
+        # lane algebra end-to-end (all byte values, so no sign surprises)
+        ext = jax.lax.bitcast_convert_type(ext, jnp.int32)
+    bh = block_h or _pick_swar_block_h(ws, halo, mode)
     outw = make_swar_stencil(
-        ext.shape, taps, k, bh, interpret=interpret
+        ext.shape, taps, k, bh, mode=mode, scale=float(op.scale),
+        pre_chain=pre_chain, post_chain=post_chain, interpret=interpret,
     )(ext)
+    if mode == "wide":
+        outw = jax.lax.bitcast_convert_type(outw, jnp.uint32)
     return unpack_quarters(outw[:height])
+
+
+def _require_fusable(op: Op) -> tuple[bool, int, int, int]:
+    fit = swar_fusable(op)
+    if fit is None:
+        raise ValueError(f"op {op.name!r} is not SWAR-fusable")
+    return fit
 
 
 def pipeline_swar(
@@ -326,17 +592,75 @@ def pipeline_swar(
             pending.clear()
         return im
 
-    for op in ops:
-        if swar_eligible(op):
-            # op-qualifies; the shape gate needs the ACTUAL input to this
-            # op, so flush the pending run first
-            img = flush(img)
-            if img.dtype == jnp.uint8 and swar_eligible(
-                op, tuple(img.shape)
+    def fusable(o):
+        return swar_fusable(o) is not None
+
+    n = len(ops)
+    i = 0
+    while i < n:
+        # try to form a fused group starting here: [pre*] stencil [post*]
+        j = i
+        pre: list[Op] = []
+        while j < n and fusable(ops[j]):
+            pre.append(ops[j])
+            j += 1
+        if j < n and swar_eligible(ops[j]):
+            st = ops[j]
+            j += 1
+            # a trailing fusable run becomes this group's post-chain
+            # UNLESS another eligible stencil follows it — then it serves
+            # as that group's pre-chain instead (same cost either way;
+            # pre keeps groups maximal when chains sit between stencils)
+            k2 = j
+            run: list[Op] = []
+            while k2 < n and fusable(ops[k2]):
+                run.append(ops[k2])
+                k2 += 1
+            post: list[Op] = []
+            if not (k2 < n and swar_eligible(ops[k2])):
+                post = run
+                j = k2
+            # pre-chain + zero padding don't commute (golden pads AFTER
+            # the pointwise ops with literal zeros; the fused kernel would
+            # map the pad zeros through the chain) unless the composed
+            # chain fixes 0 — reflect101/edge pads are image values, so
+            # they always commute with elementwise maps
+            pre_ok = not pre or st.edge_mode != "zero" or _chain_fixes_zero(
+                pre
+            )
+            img = flush(img)  # shape gate needs the ACTUAL input
+            if (
+                pre_ok
+                and img.dtype == jnp.uint8
+                and img.ndim == 2
+                and swar_eligible(st, tuple(img.shape))
             ):
                 img = swar_stencil(
-                    op, img, block_h=block_h, interpret=interpret
+                    st,
+                    img,
+                    pre_ops=tuple(pre),
+                    post_ops=tuple(post),
+                    block_h=block_h,
+                    interpret=interpret,
                 )
-                continue
-        pending.append(op)
+            else:
+                # whole group falls back as one run (keeps u8 group fusion)
+                pending.extend(pre)
+                pending.append(st)
+                pending.extend(post)
+            i = j
+            continue
+        # no eligible stencil follows this position: ops[i] joins the
+        # fallback run (a later iteration re-tries from i+1)
+        pending.append(ops[i])
+        i += 1
     return flush(img)
+
+
+def _chain_fixes_zero(pre_ops) -> bool:
+    """Whether the composed pointwise prefix maps pixel value 0 to 0 (the
+    condition for fusing under a zero-padded stencil)."""
+    v = 0
+    for o in pre_ops:
+        v = int(np.asarray(o.lut_host(), dtype=np.uint8)[v])
+    return v == 0

@@ -36,8 +36,8 @@ def _swar(spec: str, img, **kw):
 
 
 def test_eligibility_matrix():
-    """Exactly the binomial Gaussians 3 and 5 qualify; everything else in
-    the registry falls back (gaussian:7 overflows 16-bit fields: S=64)."""
+    """The binomial Gaussians 3/5 (narrow mode), gaussian:7 and the odd
+    box filters (wide mode) qualify; everything else falls back."""
     elig = {
         spec: swar_eligible(make_pipeline_ops(spec)[0], (64, 64))
         for spec in (
@@ -45,6 +45,7 @@ def test_eligibility_matrix():
             "gaussian:5",
             "gaussian:7",
             "box:3",
+            "box:5",
             "emboss:3",
             "emboss101:3",
             "median:3",
@@ -57,8 +58,9 @@ def test_eligibility_matrix():
     assert elig == {
         "gaussian:3": True,
         "gaussian:5": True,
-        "gaussian:7": False,
-        "box:3": False,
+        "gaussian:7": True,  # wide mode (S=64 overflows 16-bit columns)
+        "box:3": True,  # wide mode (S^2 = 9 is not a power of two)
+        "box:5": True,
         "emboss:3": False,  # interior edge mode + trunc_clip
         "emboss101:3": False,  # non-separable signed kernel
         "median:3": False,
@@ -67,6 +69,23 @@ def test_eligibility_matrix():
         "sharpen": False,
         "grayscale": False,  # pointwise
     }
+
+
+def test_swar_mode_selection():
+    from mpi_cuda_imagemanipulation_tpu.ops.swar_kernels import (
+        _swar_mode,
+        _taps_shift,
+    )
+
+    for spec, want in (
+        ("gaussian:3", "narrow"),
+        ("gaussian:5", "narrow"),
+        ("gaussian:7", "wide"),
+        ("box:3", "wide"),
+        ("box:7", "wide"),
+    ):
+        taps, _ = _taps_shift(make_pipeline_ops(spec)[0])
+        assert _swar_mode(taps) == want, spec
 
 
 def test_eligibility_shape_gates():
@@ -95,7 +114,9 @@ def test_pack_unpack_roundtrip():
     np.testing.assert_array_equal(got, strips)
 
 
-@pytest.mark.parametrize("spec", ["gaussian:3", "gaussian:5"])
+@pytest.mark.parametrize(
+    "spec", ["gaussian:3", "gaussian:5", "gaussian:7", "box:3", "box:5"]
+)
 @pytest.mark.parametrize(
     "shape,seed",
     [((48, 64), 1), ((37, 128), 2), ((130, 256), 3), ((8, 64), 4)],
@@ -105,14 +126,15 @@ def test_swar_bit_exact_vs_golden(spec, shape, seed):
     np.testing.assert_array_equal(_swar(spec, img), _golden(spec, img))
 
 
+@pytest.mark.parametrize("spec", ["gaussian:5", "gaussian:7", "box:3"])
 @pytest.mark.parametrize("bh", [8, 16, 24, 48])
-def test_swar_ragged_block_heights(bh):
+def test_swar_ragged_block_heights(spec, bh):
     """The carry kernel's clamped-index tail: garbage rows land only at
     r >= H and are cropped, for block heights that do and do not divide
-    the ext height."""
+    the ext height — in both column modes."""
     img = jnp.asarray(synthetic_image(37, 64, channels=1, seed=6))
     np.testing.assert_array_equal(
-        _swar("gaussian:5", img, block_h=bh), _golden("gaussian:5", img)
+        _swar(spec, img, block_h=bh), _golden(spec, img)
     )
 
 
@@ -130,11 +152,126 @@ def test_swar_fallback_keeps_pipelines_correct():
     np.testing.assert_array_equal(
         _swar("gaussian:5", odd), _golden("gaussian:5", odd)
     )
-    # gaussian:7 (S=64, would overflow): falls back, still exact
-    img = jnp.asarray(synthetic_image(40, 64, channels=1, seed=9))
-    np.testing.assert_array_equal(
-        _swar("gaussian:7", img), _golden("gaussian:7", img)
+    # S > 128 (the field/f32-exactness cap): ineligible, falls back. No
+    # registry op has S > 128 at practical sizes, so build one: a 3-tap
+    # integer vector summing to 255.
+    from mpi_cuda_imagemanipulation_tpu.ops.spec import StencilOp
+
+    t255 = np.array([1.0, 253.0, 1.0], np.float32)
+    big_s = StencilOp(
+        name="bigsum",
+        halo=1,
+        kernels=(np.outer(t255, t255),),
+        scale=1.0 / (255.0 * 255.0),
+        separable=t255,
+        edge_mode="reflect101",
+        quantize="rint_clip",
     )
+    assert not swar_eligible(big_s, (40, 64))
+    img = jnp.asarray(synthetic_image(40, 64, channels=1, seed=9))
+    got = np.asarray(pipeline_swar((big_s,), img, interpret=True))
+    np.testing.assert_array_equal(got, np.asarray(big_s(img)))
+
+
+def test_affine_fit_matrix():
+    """The fitter covers exactly the affine-representable registry ops."""
+    from mpi_cuda_imagemanipulation_tpu.ops.swar_kernels import swar_fusable
+
+    fits = {
+        spec: swar_fusable(make_pipeline_ops(spec)[0]) is not None
+        for spec in (
+            "contrast:3.5",
+            "contrast:3",
+            "contrast:2.5",
+            "brightness:50",
+            "brightness:-30.5",
+            "invert",
+            "threshold:128",  # step function: no affine form
+            "contrast:4.3",  # LUT-routed (not rounding-free): no core
+            "posterize:4",  # bit mask, not affine
+            "grayscale",  # channel-structure op
+        )
+    }
+    assert fits == {
+        "contrast:3.5": True,
+        "contrast:3": True,
+        "contrast:2.5": True,
+        "brightness:50": True,
+        "brightness:-30.5": True,
+        "invert": True,
+        "threshold:128": False,
+        "contrast:4.3": False,
+        "posterize:4": False,
+        "grayscale": False,
+    }
+    # the specific reference-contrast fit: clip((7p - 640) >> 1)
+    assert swar_fusable(make_pipeline_ops("contrast:3.5")[0]) == (
+        False, 7, 640, 1,
+    )
+
+
+@pytest.mark.parametrize(
+    "spec",
+    [
+        "contrast:3.5,gaussian:5",  # narrow-mode pre-chain
+        "contrast:3.5,gaussian:7",  # wide-mode pre-chain
+        "brightness:50,invert,gaussian:5",  # two-step pre-chain
+        "gaussian:5,contrast:3.5",  # narrow-mode post-chain
+        "gaussian:7,invert,brightness:-20",  # wide-mode post-chain
+        "contrast:3,gaussian:3,invert",  # pre + post on one stencil
+        # a chain between two stencils fuses as the second one's pre
+        "contrast:3.5,gaussian:5,brightness:10,box:3,invert",
+    ],
+)
+@pytest.mark.parametrize("shape,seed", [((48, 64), 1), ((37, 128), 2)])
+def test_fused_pointwise_chains_bit_exact(spec, shape, seed):
+    img = jnp.asarray(synthetic_image(*shape, channels=1, seed=seed))
+    np.testing.assert_array_equal(_swar(spec, img), _golden(spec, img))
+
+
+def test_fusion_actually_fuses(monkeypatch):
+    """The fused pipeline must not fall back: a fully-fusable spec makes
+    ZERO pipeline_pallas calls (everything runs inside the SWAR kernels),
+    and a chain between two stencils joins one of them."""
+    calls = []
+    from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
+        pipeline_pallas as real,
+    )
+
+    def counting(ops, im, **kw):
+        calls.append(tuple(o.name for o in ops))
+        return real(ops, im, **kw)
+
+    # pipeline_swar imports pipeline_pallas inside the function body, so
+    # patching the source module intercepts every fallback flush
+    from mpi_cuda_imagemanipulation_tpu.ops import pallas_kernels
+
+    monkeypatch.setattr(pallas_kernels, "pipeline_pallas", counting)
+
+    img = jnp.asarray(synthetic_image(40, 64, channels=1, seed=14))
+    spec = "contrast:3.5,gaussian:5,invert"
+    out = np.asarray(
+        pipeline_swar(make_pipeline_ops(spec), img, interpret=True)
+    )
+    np.testing.assert_array_equal(out, _golden(spec, img))
+    assert calls == [], f"unexpected fallback runs: {calls}"
+
+    # unfittable suffix falls back, but the fused part still avoids it
+    calls.clear()
+    spec = "contrast:3.5,gaussian:5,threshold:100"
+    out = np.asarray(
+        pipeline_swar(make_pipeline_ops(spec), img, interpret=True)
+    )
+    np.testing.assert_array_equal(out, _golden(spec, img))
+    assert calls == [("threshold100",)]
+
+
+def test_fusion_skipped_on_colour_input():
+    """Fusable ops on a 3-channel image cannot take the single-plane SWAR
+    path; the whole group falls back and stays exact."""
+    rgb = jnp.asarray(synthetic_image(40, 64, channels=3, seed=15))
+    spec = "brightness:10,gaussian:5"
+    np.testing.assert_array_equal(_swar(spec, rgb), _golden(spec, rgb))
 
 
 def test_pipeline_backend_swar():
