@@ -141,13 +141,6 @@ class Pipeline:
             )
 
             return sharded_pipeline_2d(self, mesh)
-        if backend == "swar":
-            raise ValueError(
-                "the swar backend is single-device for now (the fused-ghost "
-                "sharded runner streams full-width u8 rows; quarter-strip "
-                "words would need their own ghost layout) — shard with "
-                "backend='pallas'/'auto' or run swar unsharded"
-            )
         from mpi_cuda_imagemanipulation_tpu.parallel.api import sharded_pipeline
 
         return sharded_pipeline(self, mesh, backend=backend)

@@ -979,15 +979,14 @@ def prefer_packed() -> bool:
 
 def prefer_swar() -> bool:
     """Same promotion switch for the SWAR quarter-strip backend
-    (ops/swar_kernels.py): MCIM_PREFER_SWAR=1 routes bare eligible
-    stencil groups through it on the SINGLE-DEVICE auto paths (CLI
-    default, batch), once the on-chip prototype + production captures
-    (queue steps 12/13, BASELINE.md round-4 predictions) confirm the
-    2-4x element-rate win. The sharded fused-ghost runner keeps u8
-    streaming regardless — its ghost rows are full-width u8 by design,
-    and quarter-strip words would need their own ghost layout (the same
-    reason Pipeline.sharded rejects backend='swar'); sharded_pipeline
-    logs this when the flag is set."""
+    (ops/swar_kernels.py): MCIM_PREFER_SWAR=1 routes eligible stencil
+    groups through it on every auto path — CLI default, batch, AND the
+    row-sharded runner, where eligible groups take the quarter-strip
+    ghost path (parallel/api.py, VERDICT r4 #3) — once the on-chip
+    prototype + production captures (BASELINE.md round-4 predictions)
+    confirm the 2-4x element-rate win. The sharded runner snapshots this
+    flag once at build time (sharded_pipeline), so a mid-session env
+    change never splits routing across retraces."""
     import os
 
     return os.environ.get("MCIM_PREFER_SWAR", "") not in ("", "0")

@@ -509,6 +509,7 @@ def swar_stencil(
     *,
     pre_ops: tuple = (),
     post_ops: tuple = (),
+    ghosts: tuple[jnp.ndarray, jnp.ndarray] | None = None,
     block_h: int | None = None,
     interpret: bool | None = None,
 ) -> jnp.ndarray:
@@ -516,6 +517,14 @@ def swar_stencil(
     with optional fused pointwise prefix/suffix ops (each must satisfy
     ``swar_fusable``; their fitted chains run inside the same kernel, so
     the whole group costs one HBM read + one write).
+
+    `ghosts` = (top, bottom) (halo, W) u8 strips supplied by the sharded
+    runner (ppermute-exchanged + edge-synthesised, parallel/api.py): they
+    replace the vertical self-padding, making this the quarter-strip
+    ghost mode — the shard's tile streams through the same kernel as the
+    unsharded path (the pack pass exists in both, so per-chip traffic
+    matches unsharded SWAR). Strips are raw pixels; the pre-chain applies
+    to them inside the kernel exactly as it does on-tile.
 
     `interpret=None` resolves like every other kernel entry point
     (compiled on TPU, interpreter elsewhere), so callers pass their own
@@ -529,9 +538,18 @@ def swar_stencil(
     halo = op.halo
     height, width = img.shape
     ws = width // 4
-    xpad = jnp.pad(
-        img, ((halo, halo), (halo, halo)), mode=_PAD_MODES[op.edge_mode]
-    )
+    if ghosts is not None:
+        top, bottom = ghosts
+        xv = jnp.concatenate([top, img, bottom], axis=0)
+        # horizontal padding only — the vertical extension came from the
+        # mesh neighbours (or edge synthesis at the global boundary)
+        xpad = jnp.pad(
+            xv, ((0, 0), (halo, halo)), mode=_PAD_MODES[op.edge_mode]
+        )
+    else:
+        xpad = jnp.pad(
+            img, ((halo, halo), (halo, halo)), mode=_PAD_MODES[op.edge_mode]
+        )
     ext = pack_quarters(xpad, halo)
     if mode == "wide":
         # free same-width view: the wide kernel runs Mosaic-native i32

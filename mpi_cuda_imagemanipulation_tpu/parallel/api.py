@@ -133,6 +133,12 @@ def _fix_edge_strips(
     )
 
 
+def _prefer_swar() -> bool:
+    from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import prefer_swar
+
+    return prefer_swar()
+
+
 def _resolve_backend(op: StencilOp, backend: str) -> str:
     if backend != "auto":
         return backend
@@ -159,10 +165,10 @@ def _apply_stencil(
     _apply_group_fused, selected by _run_segment's group walker."""
     h = op.halo
     backend = _resolve_backend(op, backend)
-    if backend == "packed":
-        # the materialised-ext fallback has no packed variant (it exists
-        # for pad rows / tiny tiles where throughput is moot); use the u8
-        # Pallas tile kernel
+    if backend in ("packed", "swar"):
+        # the materialised-ext fallback has no packed/swar variant (it
+        # exists for pad rows / tiny tiles where throughput is moot); use
+        # the u8 Pallas tile kernel
         backend = "pallas"
     # halo exchange + global-edge fixup once on the full tile (2-D or HWC) —
     # on uint8 (dtype-generic gather/where), so colour images pay two
@@ -179,6 +185,58 @@ def _apply_stencil(
             axis=-1,
         )
     return _stencil_on_ext(op, ext, tile, y0, global_h, global_w, backend)
+
+
+def _swar_group_ok(pointwise, op: StencilOp, tile, n: int, local_h: int,
+                   global_h: int) -> bool:
+    """Whether one [pointwise*, stencil] group can take the quarter-strip
+    SWAR ghost path on this tile: single u8 plane the op is shape-eligible
+    on, no pad rows inside the tile (strip edge synthesis is whole-strip),
+    every buffered pointwise fits an exact affine chain, and (zero mode
+    only) the composed chain fixes 0 so chain and padding commute."""
+    from mpi_cuda_imagemanipulation_tpu.ops.swar_kernels import (
+        _chain_fixes_zero,
+        swar_eligible,
+        swar_fusable,
+    )
+
+    return (
+        tile.ndim == 2
+        and n * local_h == global_h
+        and local_h > op.halo
+        and swar_eligible(op, (local_h, tile.shape[1]))
+        and all(swar_fusable(p) is not None for p in pointwise)
+        and (op.edge_mode != "zero" or _chain_fixes_zero(pointwise))
+    )
+
+
+def _apply_group_swar(
+    pointwise,
+    stencil: StencilOp,
+    tile: jnp.ndarray,
+    y0: jnp.ndarray,
+    global_h: int,
+    n_shards: int,
+    post=(),
+) -> jnp.ndarray:
+    """Run one [pointwise*, stencil, pointwise*] group as a single
+    quarter-strip SWAR kernel (ops/swar_kernels.py ghost mode): ghost
+    strips are exchanged raw — per-pixel chains commute with strip
+    selection — and the fitted pointwise chains run inside the kernel, so
+    the sharded tile streams exactly like the unsharded SWAR path,
+    suffix chains included. Caller gates with _swar_group_ok."""
+    from mpi_cuda_imagemanipulation_tpu.ops.swar_kernels import swar_stencil
+
+    h = stencil.halo
+    top, bottom = exchange_halo_strips(tile, h, n_shards)
+    top, bottom = _fix_edge_strips(top, bottom, tile, stencil, y0, global_h)
+    return swar_stencil(
+        stencil,
+        tile,
+        pre_ops=tuple(pointwise),
+        post_ops=tuple(post),
+        ghosts=(top, bottom),
+    )
 
 
 def _apply_group_fused(
@@ -297,7 +355,14 @@ def _split_segments(ops):
     return segments
 
 
-def _run_segment(ops, mesh, backend: str, any_pallas: bool, img: jnp.ndarray):
+def _run_segment(
+    ops,
+    mesh,
+    backend: str,
+    any_pallas: bool,
+    img: jnp.ndarray,
+    try_swar: bool = False,
+):
     """One shard_map region: pad-to-multiple, halo-exchanged local compute,
     crop. Fixes the reference's silent `rows / size` truncation
     (kernel.cu:117) by padding and cropping instead of dropping rows."""
@@ -335,7 +400,10 @@ def _run_segment(ops, mesh, backend: str, any_pallas: bool, img: jnp.ndarray):
             pending.clear()
             return t
 
-        for op in ops:
+        i = 0
+        while i < len(ops):
+            op = ops[i]
+            i += 1
             if isinstance(op, PointwiseOp):
                 if op.kernel_safe:
                     pending.append(op)
@@ -353,6 +421,45 @@ def _run_segment(ops, mesh, backend: str, any_pallas: bool, img: jnp.ndarray):
                 stats = lax.psum(op.stats(tile, valid), ROWS)
                 tile = op.apply(tile, stats)
             else:
+                # Quarter-strip SWAR ghost path (backend='swar', or 'auto'
+                # under the MCIM_PREFER_SWAR promotion switch, snapshotted
+                # at build time): a single-chip SWAR win carries to
+                # multi-chip unchanged (VERDICT r4 #3). Ineligible groups
+                # fall through to the u8 paths below, the same per-op
+                # fallback contract as the unsharded pipeline_swar.
+                if try_swar:
+                    if _swar_group_ok(
+                        pending, op, tile, n, local_h, global_h
+                    ):
+                        group = list(pending)
+                        pending.clear()
+                        # a trailing fusable run becomes this group's
+                        # post-chain unless another eligible stencil
+                        # follows it (then it serves as that group's
+                        # pre-chain) — same policy as pipeline_swar
+                        from mpi_cuda_imagemanipulation_tpu.ops.swar_kernels import (
+                            swar_eligible,
+                            swar_fusable,
+                        )
+
+                        j = i
+                        run = []
+                        while j < len(ops) and (
+                            isinstance(ops[j], PointwiseOp)
+                            and swar_fusable(ops[j]) is not None
+                        ):
+                            run.append(ops[j])
+                            j += 1
+                        post: list = []
+                        if not (
+                            j < len(ops) and swar_eligible(ops[j])
+                        ):
+                            post = run
+                            i = j
+                        tile = _apply_group_swar(
+                            group, op, tile, y0, global_h, n, post=post
+                        )
+                        continue
                 # Fused-ghost fast path: no pad rows inside the tile
                 # (pad-to-multiple needs position-dependent edge fixes),
                 # halo >= 1, a mode the streaming kernel supports, and
@@ -368,7 +475,7 @@ def _run_segment(ops, mesh, backend: str, any_pallas: bool, img: jnp.ndarray):
                     group_in = tile.shape[2] if tile.ndim == 3 else 1
                     use_pallas = use_pallas_for_stencil(op, group_in)
                 else:
-                    use_pallas = backend in ("pallas", "packed")
+                    use_pallas = backend in ("pallas", "packed", "swar")
                 fusible = (
                     use_pallas
                     and op.halo >= 1
@@ -416,35 +523,29 @@ def sharded_pipeline(pipe, mesh, backend: str = "xla"):
     Returns a jitted (H, W[, 3]) uint8 -> uint8 function, bit-identical to
     the unsharded golden path (tests/test_sharded.py).
     """
-    if backend not in ("xla", "pallas", "packed", "auto"):
+    if backend not in ("xla", "pallas", "packed", "swar", "auto"):
         raise ValueError(f"unknown backend {backend!r}")
+    # The MCIM_PREFER_SWAR promotion switch is snapshotted ONCE here:
+    # routing and the vma-checker decision below must agree, and a
+    # mid-session env change between build and a retrace must not split
+    # them (review finding).
+    try_swar = backend == "swar" or (backend == "auto" and _prefer_swar())
     # Static per-op auto decisions, so the vma checker stays on whenever no
     # Pallas tile can run (pallas_call outputs carry no vma annotations).
     if backend == "auto":
         from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
-            prefer_swar,
             use_pallas_for_stencil,
         )
 
-        if prefer_swar():
-            # the ghost rows this runner exchanges are full-width u8;
-            # quarter-strip words would need their own ghost layout, so
-            # the SWAR promotion flag does not apply here — say so
-            # instead of silently ignoring it (review finding)
-            from mpi_cuda_imagemanipulation_tpu.utils.log import get_logger
-
-            get_logger().info(
-                "MCIM_PREFER_SWAR does not apply to the sharded runner "
-                "(full-width u8 ghost rows; see prefer_swar docstring) — "
-                "shards stay on u8 streaming"
-            )
-
-        any_pallas = any(
+        # under try_swar, eligible groups take the quarter-strip SWAR
+        # ghost path inside _run_segment (a single-chip SWAR win carries
+        # to multi-chip); the swar kernels are pallas_calls too
+        any_pallas = try_swar or any(
             isinstance(op, StencilOp) and use_pallas_for_stencil(op, 1)
             for op in pipe.ops
         )
     else:
-        any_pallas = backend in ("pallas", "packed")
+        any_pallas = backend in ("pallas", "packed", "swar")
     segments = _split_segments(pipe.ops)
 
     def run(img: jnp.ndarray) -> jnp.ndarray:
@@ -458,7 +559,9 @@ def sharded_pipeline(pipe, mesh, backend: str = "xla"):
                     NamedSharding(mesh, P(ROWS, *([None] * (img.ndim - 1)))),
                 )
             else:
-                img = _run_segment(ops, mesh, backend, any_pallas, img)
+                img = _run_segment(
+                    ops, mesh, backend, any_pallas, img, try_swar=try_swar
+                )
         return img
 
     return jax.jit(run)

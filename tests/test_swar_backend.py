@@ -275,17 +275,110 @@ def test_fusion_skipped_on_colour_input():
 
 
 def test_pipeline_backend_swar():
-    """Pipeline.jit(backend='swar') is routed and bit-exact; sharded
-    rejects swar with a clear error."""
+    """Pipeline.jit(backend='swar') is routed and bit-exact."""
     img = jnp.asarray(synthetic_image(48, 64, channels=1, seed=10))
     fn = Pipeline.parse("gaussian:5").jit(backend="swar")
     np.testing.assert_array_equal(
         np.asarray(fn(img)), _golden("gaussian:5", img)
     )
+
+
+@pytest.mark.parametrize("n", [2, 8])
+@pytest.mark.parametrize(
+    "spec",
+    [
+        "gaussian:5",  # narrow mode
+        "gaussian:7",  # wide mode
+        "box:3",
+        "contrast:3.5,gaussian:5",  # fused prefix chain
+        "brightness:20,invert,gaussian:7",
+        "grayscale,contrast:3.5,gaussian:5",  # 3->1 prologue falls back,
+        # then the contrast+gaussian group takes the swar ghost path
+        "gaussian:5,brightness:20",  # fused suffix (post-chain)
+        "contrast:3.5,gaussian:7,invert",  # wide mode, pre + post chains
+        "gaussian:5,threshold:100",  # unfittable suffix flushes as XLA
+    ],
+)
+def test_sharded_swar_bit_exact(spec, n):
+    """backend='swar' sharded == unsharded golden on row meshes — the
+    quarter-strip ghost path (VERDICT r4 #3)."""
     from mpi_cuda_imagemanipulation_tpu.parallel.mesh import make_mesh
 
-    with pytest.raises(ValueError, match="swar backend is single-device"):
-        Pipeline.parse("gaussian:5").sharded(make_mesh(2), backend="swar")
+    channels = 3 if "grayscale" in spec else 1
+    img = jnp.asarray(
+        synthetic_image(16 * n, 64, channels=channels, seed=16)
+    )
+    pipe = Pipeline.parse(spec)
+    got = np.asarray(pipe.sharded(make_mesh(n), backend="swar")(img))
+    np.testing.assert_array_equal(got, np.asarray(pipe(img)))
+
+
+def test_sharded_swar_engages(monkeypatch):
+    """The sharded swar backend must actually run the quarter-strip ghost
+    kernel (not silently fall back to u8 streaming) on an eligible group."""
+    from mpi_cuda_imagemanipulation_tpu.ops import swar_kernels
+    from mpi_cuda_imagemanipulation_tpu.parallel import api
+    from mpi_cuda_imagemanipulation_tpu.parallel.mesh import make_mesh
+
+    calls = []
+    real = swar_kernels.swar_stencil
+
+    def counting(*a, **kw):
+        calls.append(
+            (
+                kw.get("ghosts") is not None,
+                len(kw.get("pre_ops", ())),
+                len(kw.get("post_ops", ())),
+            )
+        )
+        return real(*a, **kw)
+
+    # parallel/api imports swar_stencil inside _apply_group_swar, so patch
+    # the source module
+    monkeypatch.setattr(swar_kernels, "swar_stencil", counting)
+    img = jnp.asarray(synthetic_image(64, 64, channels=1, seed=17))
+    pipe = Pipeline.parse("contrast:3.5,gaussian:5,invert")
+    got = np.asarray(pipe.sharded(make_mesh(4), backend="swar")(img))
+    np.testing.assert_array_equal(got, np.asarray(pipe(img)))
+    # ghost mode engaged, with the contrast prefix AND invert suffix fused
+    assert calls == [(True, 1, 1)], f"swar ghost path did not engage: {calls}"
+
+    # pad rows (height not divisible): the group must fall back, stay exact
+    calls.clear()
+    img2 = jnp.asarray(synthetic_image(66, 64, channels=1, seed=18))
+    got2 = np.asarray(pipe.sharded(make_mesh(4), backend="swar")(img2))
+    np.testing.assert_array_equal(got2, np.asarray(pipe(img2)))
+    assert calls == []
+
+
+def test_sharded_auto_prefer_swar(monkeypatch):
+    """MCIM_PREFER_SWAR=1 routes eligible groups through the swar ghost
+    path under backend='auto' too — the single-chip promotion switch now
+    carries to the sharded runner (VERDICT r4 #3)."""
+    from mpi_cuda_imagemanipulation_tpu.ops import swar_kernels
+    from mpi_cuda_imagemanipulation_tpu.parallel.mesh import make_mesh
+
+    calls = []
+    real = swar_kernels.swar_stencil
+
+    def counting(*a, **kw):
+        calls.append(1)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(swar_kernels, "swar_stencil", counting)
+    img = jnp.asarray(synthetic_image(64, 64, channels=1, seed=19))
+    pipe = Pipeline.parse("gaussian:5")
+    golden = np.asarray(pipe(img))
+
+    monkeypatch.delenv("MCIM_PREFER_SWAR", raising=False)
+    got = np.asarray(pipe.sharded(make_mesh(4), backend="auto")(img))
+    np.testing.assert_array_equal(got, golden)
+    assert calls == []
+
+    monkeypatch.setenv("MCIM_PREFER_SWAR", "1")
+    got = np.asarray(pipe.sharded(make_mesh(4), backend="auto")(img))
+    np.testing.assert_array_equal(got, golden)
+    assert calls == [1]
 
 
 def test_batched_swar_vmap():
