@@ -699,6 +699,7 @@ def cmd_autotune(args: argparse.Namespace) -> int:
                 _swar_mode,
                 _taps_shift,
                 pipeline_swar,
+                swar_any_eligible,
                 swar_eligible,
             )
 
@@ -708,7 +709,7 @@ def cmd_autotune(args: argparse.Namespace) -> int:
             eligible = [
                 op
                 for op in ops
-                if swar_eligible(op, (args.height, args.width))
+                if swar_any_eligible(op, (args.height, args.width))
             ]
             if not eligible:
                 print(
@@ -718,13 +719,17 @@ def cmd_autotune(args: argparse.Namespace) -> int:
                     file=sys.stderr,
                 )
                 return 2
+
             # per-op mode: wide-mode column lanes have a ~3x larger live
             # set, so a narrow-mode cap would admit candidates the wide
             # kernel's VMEM budget can never run (review finding)
+            def _mode_of(op):
+                if swar_eligible(op):
+                    return _swar_mode(_taps_shift(op)[0])
+                return "corr2d"
+
             cap = min(
-                _pick_swar_block_h(
-                    args.width // 4, op.halo, _swar_mode(_taps_shift(op)[0])
-                )
+                _pick_swar_block_h(args.width // 4, op.halo, _mode_of(op))
                 for op in eligible
             )
             step = 8  # swar blocks are ext-row multiples of 8, not 32

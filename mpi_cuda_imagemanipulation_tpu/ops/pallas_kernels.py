@@ -1008,28 +1008,45 @@ def pipeline_auto(
     swar = prefer_swar()
     for pointwise, stencil in group_ops(ops):
         n_ch = state.shape[2] if state.ndim == 3 else 1
+        # The SWAR promotion switch is checked BEFORE the u8-Pallas gate:
+        # use_pallas_for_stencil rejects cheap halo-1 stencils (XLA wins
+        # there for u8), but the corr2d SWAR family is mostly halo-1
+        # (emboss:3, sharpen, laplacians) and the whole point of the
+        # promotion is to route them off the u8 paths — and the sharded
+        # auto runner already checks try_swar first (review finding:
+        # nesting this under the u8 gate made single- and multi-chip
+        # auto routing disagree).
+        if swar and state.ndim == 2 and state.dtype == jnp.uint8:
+            from mpi_cuda_imagemanipulation_tpu.ops.swar_kernels import (
+                _chain_fixes_zero,
+                swar_any_eligible,
+                swar_fusable,
+                swar_stencil,
+            )
+
+            if (
+                stencil is not None
+                and swar_any_eligible(stencil, tuple(state.shape))
+                and all(swar_fusable(p) is not None for p in pointwise)
+                and (
+                    stencil.edge_mode != "zero"
+                    or _chain_fixes_zero(pointwise)
+                )
+            ):
+                state = swar_stencil(
+                    stencil,
+                    state,
+                    pre_ops=tuple(pointwise),
+                    block_h=block_h,
+                    interpret=interpret,
+                )
+                continue
         if use_pallas_for_stencil(stencil, n_ch):
             planes = (
                 [state[..., c] for c in range(state.shape[2])]
                 if state.ndim == 3
                 else [state]
             )
-            if swar and not pointwise and len(planes) == 1:
-                from mpi_cuda_imagemanipulation_tpu.ops.swar_kernels import (
-                    swar_eligible,
-                    swar_stencil,
-                )
-
-                if state.dtype == jnp.uint8 and swar_eligible(
-                    stencil, tuple(planes[0].shape)
-                ):
-                    state = swar_stencil(
-                        stencil,
-                        planes[0],
-                        block_h=block_h,
-                        interpret=interpret,
-                    )
-                    continue
             if packed:
                 from mpi_cuda_imagemanipulation_tpu.ops.packed_kernels import (
                     packed_supported,

@@ -43,22 +43,36 @@ the element saving:
      convert idiom mirrors ops/packed_kernels.py's Mosaic-native lane
      algebra.
 
-Eligibility (``swar_eligible``): single-plane u8 (H, W) with W % 4 == 0,
-StencilOp with ``reduce='corr'``, ``combine='single'``, an integer
-non-negative odd-length separable vector with sum 2 <= S <= 128,
-``scale == 1/S^2``, ``quantize='rint_clip'``, and a real border extension
-(not the reference's ``interior`` guard). In the registry that is the
-binomial Gaussians 3/5/7 and the odd box filters. Ineligible ops fall
-back to the u8 streaming kernels per op, so ``impl='swar'`` is
-always-correct — the same contract as ``impl='packed'``
-(ops/packed_kernels.py).
+Separable eligibility (``swar_eligible``): single-plane u8 (H, W) with
+W % 4 == 0, StencilOp with ``reduce='corr'``, ``combine='single'``, an
+integer non-negative odd-length separable vector with sum 2 <= S <= 128,
+``scale == 1/S^2``, ``quantize='rint_clip'``, and a real border extension.
+In the registry that is the binomial Gaussians 3/5/7 and the odd box
+filters.
 
-The streaming kernel reuses the production scratch-carry structure
+A third kernel covers the non-separable integer family
+(``swar_corr2d_eligible`` / ``make_swar_corr2d``): odd-square signed
+integer kernels with scale 1.0 and sum|w| <= 128 — the emboss family
+(INCLUDING the reference's interior-guard emboss:3/5, whose golden
+passthrough masks run in quarter-strip space), sharpen, and the
+laplacians. Signed taps accumulate as (bias + positives) - negatives over
+a +255*sum(|w<0|) bias so packed fields never go negative; quantize is
+clip(acc - bias) — exact, since integer sums make trunc and rint the
+identity. With the pointwise fusion above, the reference pipeline's
+contrast:3.5 -> emboss:3 tail (kernel.cu:192-195) runs as ONE
+quarter-strip kernel.
+
+Ineligible ops fall back to the u8 streaming kernels per op, so
+``impl='swar'`` is always-correct — the same contract as
+``impl='packed'`` (ops/packed_kernels.py).
+
+The streaming kernels reuse the production scratch-carry structure
 (ops/pallas_kernels.stencil_tile_pallas): ext-row blocks stream in
-non-overlapping, the row-passed fields of the previous block live in VMEM
-scratch, and output block i-1 is the column pass over
-[scratch ; first 2h rows of block i]. Reference analogue: the CUDA 5x5
-stencil path (kernel.cu:64-94), minus its in-place race and missing halo.
+non-overlapping, the (row-passed, or for corr2d raw pre-chained) fields
+of the previous block live in VMEM scratch, and output block i-1 is the
+finalize pass over [scratch ; first 2h rows of block i]. Reference
+analogue: the CUDA stencil paths (kernel.cu:64-94), minus the in-place
+race and missing halo.
 """
 
 from __future__ import annotations
@@ -120,12 +134,8 @@ def swar_eligible(op: Op, plane_shape: tuple[int, ...] | None = None) -> bool:
     # (advisor round-4 finding)
     if len(t) - 1 != 2 * op.halo:
         return False
-    if plane_shape is not None:
-        if len(plane_shape) != 2:
-            return False
-        h_img, w_img = plane_shape
-        if w_img % 4 or w_img // 4 < 2 * op.halo + 1 or h_img <= op.halo:
-            return False
+    if plane_shape is not None and not _shape_ok(op, plane_shape):
+        return False
     return True
 
 
@@ -229,42 +239,49 @@ def _dt_const(F: jnp.ndarray, v: int):
     return F.dtype.type(v)
 
 
+def _field_sat_sub(T: jnp.ndarray, c: int) -> jnp.ndarray:
+    """Per-16-bit-field max(T - c, 0) on packed field arrays.
+
+    The classic SWAR sign-probe: with both operands < 2^15, (T | 0x8000)
+    - c keeps fields independent (the injected bit absorbs any borrow)
+    and its 0x8000 bit reads T >= c. Dtype-generic (u32 / i32: wraparound
+    bit patterns are identical; the one arithmetic-shift smear on the
+    probe extraction is masked off)."""
+    H = _dt_const(T, 0x80008000)
+    D = (T | H) - _dt_const(T, c * 0x00010001)
+    ge = ((D & H) >> 15) & _dt_const(T, _M_B)  # 1 per field where T >= c
+    mask = ge * _dt_const(T, 0xFFFF)
+    return D & _dt_const(T, 0x7FFF7FFF) & mask
+
+
+def _field_min255(T: jnp.ndarray) -> jnp.ndarray:
+    """Per-16-bit-field min(T, 255) (same sign-probe; T < 2^15)."""
+    H = _dt_const(T, 0x80008000)
+    D = (T | H) - _dt_const(T, 256 * 0x00010001)
+    ge = ((D & H) >> 15) & _dt_const(T, _M_B)
+    mask = ge * _dt_const(T, 0xFFFF)
+    return (T & ~mask) | (_dt_const(T, _M_LO) & mask)
+
+
 def _apply_affine_fields(F: jnp.ndarray, chain) -> jnp.ndarray:
     """Apply fitted (neg, A, C, m) steps to two 16-bit fields per 32-bit
     element, each field holding a u8 value; returns fields holding the
-    mapped u8 values.
-
-    Per-field compare/select uses the classic SWAR sign-probe: with both
-    operands < 2^15, (a | 0x8000) - b keeps fields independent (the
-    injected bit absorbs any borrow) and its 0x8000 bit reads a >= b.
-    The fitter's bounds guarantee the < 2^15 invariant at every step.
-    Dtype-generic (u32 narrow mode / i32 wide mode): the i32 wraparound
-    bit patterns are identical, and the one arithmetic-shift smear (on
-    the sign-probe extraction) is masked off."""
+    mapped u8 values. The fitter's bounds guarantee the < 2^15 invariant
+    the sign-probe helpers need at every step."""
     if not chain:
         return F
     M255 = _dt_const(F, _M_LO)
-    H = _dt_const(F, 0x80008000)
-    B1 = _dt_const(F, _M_B)
-    F15 = _dt_const(F, 0x7FFF7FFF)
     for neg, A, C, m in chain:
         if neg:
             F = M255 - F  # per-field 255 - v: borrow-free (v <= 255)
         T = F * _dt_const(F, A)  # <= 32640 per field
         if C > 0:
-            D = (T | H) - _dt_const(F, C * 0x00010001)
-            ge = ((D & H) >> 15) & B1  # 1 per field where T >= C
-            mask = ge * _dt_const(F, 0xFFFF)
-            T = D & F15 & mask  # T - C where T >= C, else 0
+            T = _field_sat_sub(T, C)
         elif C < 0:
             T = T + _dt_const(F, (-C) * 0x00010001)  # <= 32767 per field
         if m:
             T = (T >> m) & _dt_const(F, (0xFFFF >> m) * 0x00010001)
-        # clamp to 255
-        D = (T | H) - _dt_const(F, 256 * 0x00010001)
-        ge = ((D & H) >> 15) & B1
-        mask = ge * _dt_const(F, 0xFFFF)
-        F = (T & ~mask) | (M255 & mask)
+        F = _field_min255(T)
     return F
 
 
@@ -503,6 +520,229 @@ def make_swar_stencil(
     )
 
 
+def _shape_ok(op: StencilOp, plane_shape) -> bool:
+    """The common (H, W) plane gate: single u8 plane, W a multiple of 4
+    wide enough that every horizontal tap is word-local, H past the
+    halo."""
+    if len(plane_shape) != 2:
+        return False
+    h_img, w_img = plane_shape
+    return not (
+        w_img % 4 or w_img // 4 < 2 * op.halo + 1 or h_img <= op.halo
+    )
+
+
+def _corr2d_weights(op: StencilOp) -> tuple[tuple[int, ...], ...]:
+    w = np.asarray(op.kernels[0])
+    return tuple(tuple(int(v) for v in row) for row in w)
+
+
+def swar_corr2d_eligible(
+    op: Op, plane_shape: tuple[int, ...] | None = None
+) -> bool:
+    """Whether `op` can run on the SWAR 2-D correlation path: a single
+    odd-square integer kernel (signed weights welcome — the kernel
+    accumulates positive and negative taps separately over a +255*sum(|w<0|)
+    bias so packed fields never go negative), scale exactly 1.0 (both
+    quantizers are the identity-then-clip on integer sums), sum|w| <= 128
+    so biased accumulators stay under the sign-probe helpers' 2^15 bound.
+    Covers the emboss family (incl. the reference's interior-guard
+    emboss:3/5, kernel.cu:64-94 — golden passthrough masks run in
+    quarter-strip space), sharpen, and the laplacians."""
+    if not isinstance(op, StencilOp):
+        return False
+    if op.reduce != "corr" or op.combine != "single":
+        return False
+    if len(op.kernels) != 1:
+        return False
+    if op.quantize not in ("trunc_clip", "rint_clip"):
+        return False
+    if op.scale != 1.0:
+        return False
+    if op.edge_mode not in _PAD_MODES:  # includes 'interior'
+        return False
+    w = np.asarray(op.kernels[0])
+    if w.ndim != 2 or w.shape[0] != w.shape[1] or w.shape[0] % 2 == 0:
+        return False
+    if w.shape[0] != 2 * op.halo + 1 or op.halo < 1:
+        return False
+    if not np.all(w == np.floor(w)):
+        return False
+    if int(np.abs(w).sum()) > 128 or not np.any(w):
+        return False
+    if plane_shape is not None and not _shape_ok(op, plane_shape):
+        return False
+    return True
+
+
+def swar_any_eligible(
+    op: Op, plane_shape: tuple[int, ...] | None = None
+) -> bool:
+    """Combined predicate: the separable path OR the 2-D correlation
+    path can take this op (used by the pipeline walkers)."""
+    return swar_eligible(op, plane_shape) or swar_corr2d_eligible(
+        op, plane_shape
+    )
+
+
+def make_swar_corr2d(
+    ext_shape: tuple[int, int],
+    weights: tuple[tuple[int, ...], ...],
+    bh: int,
+    *,
+    interior: bool,
+    global_h: int,
+    pre_chain: tuple = (),
+    post_chain: tuple = (),
+    sharded_y0: bool = False,
+    interpret: bool = False,
+):
+    """Streaming SWAR kernel for a non-separable integer 2-D correlation
+    over quarter-strip words (scale 1.0; covers the reference emboss,
+    kernel.cu:64-94, minus its in-place race).
+
+    Same scratch-carry structure as the separable kernel, but the VMEM
+    scratch holds the RAW (pre-chained) unpacked fields of the previous
+    ext block — the 2-D correlation has no row/column factorisation, so
+    all taps apply in the finalize step over [scratch ; next 2h rows].
+    Signed weights: positive and negative taps accumulate separately and
+    combine as (bias + P) - N with bias = 255*sum(|w<0|), which keeps
+    every packed field non-negative (no cross-field borrow) and <= 2^15.
+    Quantize is clip(acc - bias, 0, 255) — exact: integer sums make both
+    trunc and rint the identity.
+
+    `interior` replays the reference guard (kernel.cu:83): output fields
+    outside the interior select the (pre-chained) centre pixel instead.
+    The x-side masks live in quarter-strip space — only strips 0 and 3
+    contain global edge columns. `sharded_y0` prepends a (1,) SMEM scalar
+    carrying the tile's global row offset so the masks follow global
+    coordinates, exactly like the u8 ghost kernels.
+    """
+    n = len(weights)
+    halo = (n - 1) // 2
+    hp, wsp = ext_shape
+    height = hp - 2 * halo
+    ws = wsp - 2 * halo
+    if bh < 2 * halo:
+        raise ValueError(f"block_h {bh} < 2*halo {2 * halo}")
+    nb = -(-height // bh)
+    nb_in = -(-hp // bh)
+    bias = 255 * sum(-w for row in weights for w in row if w < 0)
+    o = halo  # the reference guard's offset
+
+    def corr(F):
+        """(bh+2h, wsp) fields -> (bh, ws) biased accumulators."""
+        w8 = F.dtype.type
+        P = None
+        N = None
+        for dy, row in enumerate(weights):
+            for dx, w in enumerate(row):
+                if w == 0:
+                    continue
+                win = F[dy : dy + bh, dx : dx + ws]
+                if w > 0:
+                    term = win if w == 1 else win * w8(w)
+                    P = term if P is None else P + term
+                else:
+                    term = win if w == -1 else win * w8(-w)
+                    N = term if N is None else N + term
+        acc = _dt_const(F, bias * 0x00010001)
+        if P is not None:
+            acc = acc + P
+        if N is not None:
+            acc = acc - N  # >= 0 per field by the bias bound
+        return acc
+
+    def finalize(lo_rows, hi_rows, i, y0):
+        qlo = _field_min255(_field_sat_sub(corr(lo_rows), bias))
+        qhi = _field_min255(_field_sat_sub(corr(hi_rows), bias))
+        if interior:
+            yy = (
+                y0
+                + (i - 1) * bh
+                + jax.lax.broadcasted_iota(jnp.int32, (bh, ws), 0)
+            )
+            yc = (yy > o) & (yy <= global_h - 1 - o)
+            jl = jax.lax.broadcasted_iota(jnp.int32, (bh, ws), 1)
+            # global x per field: strip k covers x in [k*ws*... only
+            # strips 0 (x = j) and 3 (x = 3*W/4 + j) hold edge columns
+            xc0 = jl > o
+            xc3 = jl < ws - o
+            w8 = lo_rows.dtype.type
+
+            def m(cond_f0, cond_f1):
+                return (cond_f0.astype(lo_rows.dtype) * w8(0xFFFF)) | (
+                    (cond_f1.astype(lo_rows.dtype) * w8(0xFFFF)) << 16
+                )
+
+            m_lo = m(yc & xc0, yc)  # fields: strip0, strip2
+            m_hi = m(yc, yc & xc3)  # fields: strip1, strip3
+            c_lo = lo_rows[halo : halo + bh, halo : halo + ws]
+            c_hi = hi_rows[halo : halo + bh, halo : halo + ws]
+            qlo = (qlo & m_lo) | (c_lo & ~m_lo)
+            qhi = (qhi & m_hi) | (c_hi & ~m_hi)
+        if post_chain:
+            qlo = _apply_affine_fields(qlo, post_chain)
+            qhi = _apply_affine_fields(qhi, post_chain)
+        return qlo | (qhi << 8)
+
+    def kernel(*refs):
+        if sharded_y0:
+            y0_ref, in_ref, out_ref, lo_ref, hi_ref = refs
+            y0 = y0_ref[0]
+        else:
+            in_ref, out_ref, lo_ref, hi_ref = refs
+            y0 = jnp.int32(0)
+        i = pl.program_id(0)
+        ext = in_ref[:]
+        w8 = ext.dtype.type
+        lo = ext & w8(_M_LO)
+        hi = (ext >> w8(8)) & w8(_M_LO)
+        if pre_chain:
+            lo = _apply_affine_fields(lo, pre_chain)
+            hi = _apply_affine_fields(hi, pre_chain)
+
+        @pl.when(i >= 1)
+        def _():
+            lo_rows = jnp.concatenate([lo_ref[:], lo[: 2 * halo]], axis=0)
+            hi_rows = jnp.concatenate([hi_ref[:], hi[: 2 * halo]], axis=0)
+            out_ref[:] = finalize(lo_rows, hi_rows, i, y0)
+
+        lo_ref[:] = lo
+        hi_ref[:] = hi
+
+    from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
+        _COMPILER_PARAMS,
+    )
+
+    in_specs = [
+        pl.BlockSpec(
+            (bh, wsp),
+            lambda i: (jnp.minimum(i, nb_in - 1), 0),
+            memory_space=pltpu.VMEM,
+        )
+    ]
+    if sharded_y0:
+        in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] + in_specs
+    return pl.pallas_call(
+        kernel,
+        grid=(nb + 1,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (bh, ws),
+            lambda i: (jnp.maximum(i - 1, 0), 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((nb * bh, ws), jnp.uint32),
+        scratch_shapes=[
+            pltpu.VMEM((bh, wsp), jnp.uint32),
+            pltpu.VMEM((bh, wsp), jnp.uint32),
+        ],
+        compiler_params=_COMPILER_PARAMS,
+        interpret=interpret,
+    )
+
+
 def swar_stencil(
     op: StencilOp,
     img: jnp.ndarray,
@@ -510,13 +750,17 @@ def swar_stencil(
     pre_ops: tuple = (),
     post_ops: tuple = (),
     ghosts: tuple[jnp.ndarray, jnp.ndarray] | None = None,
+    y0=None,
+    global_h: int | None = None,
     block_h: int | None = None,
     interpret: bool | None = None,
 ) -> jnp.ndarray:
-    """One eligible StencilOp on a (H, W) u8 plane via the SWAR path,
-    with optional fused pointwise prefix/suffix ops (each must satisfy
-    ``swar_fusable``; their fitted chains run inside the same kernel, so
-    the whole group costs one HBM read + one write).
+    """One eligible StencilOp on a (H, W) u8 plane via the SWAR path —
+    the separable kernel when ``swar_eligible``, else the 2-D correlation
+    kernel (caller guarantees ``swar_corr2d_eligible``) — with optional
+    fused pointwise prefix/suffix ops (each must satisfy ``swar_fusable``;
+    their fitted chains run inside the same kernel, so the whole group
+    costs one HBM read + one write).
 
     `ghosts` = (top, bottom) (halo, W) u8 strips supplied by the sharded
     runner (ppermute-exchanged + edge-synthesised, parallel/api.py): they
@@ -524,7 +768,9 @@ def swar_stencil(
     ghost mode — the shard's tile streams through the same kernel as the
     unsharded path (the pack pass exists in both, so per-chip traffic
     matches unsharded SWAR). Strips are raw pixels; the pre-chain applies
-    to them inside the kernel exactly as it does on-tile.
+    to them inside the kernel exactly as it does on-tile. Sharded
+    interior-mode ops additionally pass `y0` (traced global row offset)
+    and `global_h` so the guard masks follow global coordinates.
 
     `interpret=None` resolves like every other kernel entry point
     (compiled on TPU, interpreter elsewhere), so callers pass their own
@@ -533,8 +779,6 @@ def swar_stencil(
         interpret = not is_tpu_backend()
     pre_chain = tuple(_require_fusable(o) for o in pre_ops)
     post_chain = tuple(_require_fusable(o) for o in post_ops)
-    taps, k = _taps_shift(op)
-    mode = _swar_mode(taps)
     halo = op.halo
     height, width = img.shape
     ws = width // 4
@@ -551,6 +795,30 @@ def swar_stencil(
             img, ((halo, halo), (halo, halo)), mode=_PAD_MODES[op.edge_mode]
         )
     ext = pack_quarters(xpad, halo)
+
+    if not swar_eligible(op):
+        # 2-D correlation path (emboss family / sharpen / laplacian)
+        bh = block_h or _pick_swar_block_h(ws, halo, "corr2d")
+        sharded_y0 = y0 is not None
+        fn = make_swar_corr2d(
+            ext.shape,
+            _corr2d_weights(op),
+            bh,
+            interior=op.edge_mode == "interior",
+            global_h=global_h if global_h is not None else height,
+            pre_chain=pre_chain,
+            post_chain=post_chain,
+            sharded_y0=sharded_y0,
+            interpret=interpret,
+        )
+        if sharded_y0:
+            outw = fn(jnp.asarray(y0, jnp.int32).reshape(1), ext)
+        else:
+            outw = fn(ext)
+        return unpack_quarters(outw[:height])
+
+    taps, k = _taps_shift(op)
+    mode = _swar_mode(taps)
     if mode == "wide":
         # free same-width view: the wide kernel runs Mosaic-native i32
         # lane algebra end-to-end (all byte values, so no sign surprises)
@@ -622,7 +890,7 @@ def pipeline_swar(
         while j < n and fusable(ops[j]):
             pre.append(ops[j])
             j += 1
-        if j < n and swar_eligible(ops[j]):
+        if j < n and swar_any_eligible(ops[j]):
             st = ops[j]
             j += 1
             # a trailing fusable run becomes this group's post-chain
@@ -635,7 +903,7 @@ def pipeline_swar(
                 run.append(ops[k2])
                 k2 += 1
             post: list[Op] = []
-            if not (k2 < n and swar_eligible(ops[k2])):
+            if not (k2 < n and swar_any_eligible(ops[k2])):
                 post = run
                 j = k2
             # pre-chain + zero padding don't commute (golden pads AFTER
@@ -651,7 +919,7 @@ def pipeline_swar(
                 pre_ok
                 and img.dtype == jnp.uint8
                 and img.ndim == 2
-                and swar_eligible(st, tuple(img.shape))
+                and swar_any_eligible(st, tuple(img.shape))
             ):
                 img = swar_stencil(
                     st,

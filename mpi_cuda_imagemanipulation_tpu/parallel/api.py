@@ -196,7 +196,7 @@ def _swar_group_ok(pointwise, op: StencilOp, tile, n: int, local_h: int,
     only) the composed chain fixes 0 so chain and padding commute."""
     from mpi_cuda_imagemanipulation_tpu.ops.swar_kernels import (
         _chain_fixes_zero,
-        swar_eligible,
+        swar_any_eligible,
         swar_fusable,
     )
 
@@ -204,7 +204,7 @@ def _swar_group_ok(pointwise, op: StencilOp, tile, n: int, local_h: int,
         tile.ndim == 2
         and n * local_h == global_h
         and local_h > op.halo
-        and swar_eligible(op, (local_h, tile.shape[1]))
+        and swar_any_eligible(op, (local_h, tile.shape[1]))
         and all(swar_fusable(p) is not None for p in pointwise)
         and (op.edge_mode != "zero" or _chain_fixes_zero(pointwise))
     )
@@ -236,6 +236,10 @@ def _apply_group_swar(
         pre_ops=tuple(pointwise),
         post_ops=tuple(post),
         ghosts=(top, bottom),
+        # interior-guard corr2d masks follow global coordinates (the
+        # seam-removal property, spec.interior_mask); harmless otherwise
+        y0=y0,
+        global_h=global_h,
     )
 
 
@@ -438,7 +442,7 @@ def _run_segment(
                         # follows it (then it serves as that group's
                         # pre-chain) — same policy as pipeline_swar
                         from mpi_cuda_imagemanipulation_tpu.ops.swar_kernels import (
-                            swar_eligible,
+                            swar_any_eligible,
                             swar_fusable,
                         )
 
@@ -452,7 +456,7 @@ def _run_segment(
                             j += 1
                         post: list = []
                         if not (
-                            j < len(ops) and swar_eligible(ops[j])
+                            j < len(ops) and swar_any_eligible(ops[j])
                         ):
                             post = run
                             i = j

@@ -173,6 +173,125 @@ def test_swar_fallback_keeps_pipelines_correct():
     np.testing.assert_array_equal(got, np.asarray(big_s(img)))
 
 
+def test_corr2d_eligibility_matrix():
+    """The non-separable integer family (scale 1.0, sum|w| <= 128) takes
+    the 2-D correlation kernel; magnitude combines and scaled kernels
+    don't."""
+    from mpi_cuda_imagemanipulation_tpu.ops.swar_kernels import (
+        swar_corr2d_eligible,
+    )
+
+    elig = {
+        spec: swar_corr2d_eligible(make_pipeline_ops(spec)[0], (64, 64))
+        for spec in (
+            "emboss:3",
+            "emboss:5",
+            "emboss101:3",
+            "emboss101:5",
+            "sharpen",
+            "laplacian:4",
+            "laplacian:8",
+            "unsharp",  # scale 1/256
+            "sobel",  # magnitude combine
+            "gaussian:5",  # separable path takes it instead
+            "median:3",
+        )
+    }
+    assert elig == {
+        "emboss:3": True,  # interior guard supported in-kernel
+        "emboss:5": True,
+        "emboss101:3": True,
+        "emboss101:5": True,
+        "sharpen": True,
+        "laplacian:4": True,
+        "laplacian:8": True,
+        "unsharp": False,
+        "sobel": False,
+        "gaussian:5": False,  # scale 1/256 != 1.0 (separable path takes it)
+        "median:3": False,
+    }
+
+
+@pytest.mark.parametrize(
+    "spec",
+    [
+        "emboss:3",  # reference op: interior guard + trunc_clip
+        "emboss:5",
+        "emboss101:3",  # reflect101 + rint_clip
+        "sharpen",
+        "laplacian:8",
+        "contrast:3.5,emboss:3",  # the reference tail as ONE kernel
+        "emboss101:3,invert",  # post-chain on corr2d
+        "brightness:10,emboss:5,invert",  # pre + post around interior mode
+    ],
+)
+@pytest.mark.parametrize(
+    "shape,seed",
+    [((48, 64), 1), ((37, 128), 2), ((8, 64), 4), ((130, 256), 3)],
+)
+def test_corr2d_bit_exact_vs_golden(spec, shape, seed):
+    img = jnp.asarray(synthetic_image(*shape, channels=1, seed=seed))
+    np.testing.assert_array_equal(_swar(spec, img), _golden(spec, img))
+
+
+@pytest.mark.parametrize("bh", [8, 16, 48])
+def test_corr2d_ragged_block_heights(bh):
+    img = jnp.asarray(synthetic_image(37, 64, channels=1, seed=6))
+    np.testing.assert_array_equal(
+        _swar("emboss:3", img, block_h=bh), _golden("emboss:3", img)
+    )
+
+
+def test_reference_pipeline_on_swar_path(monkeypatch):
+    """The FULL reference pipeline (grayscale, contrast:3.5, emboss:3 —
+    kernel.cu:192-195): grayscale falls back (3->1 channel structure),
+    then contrast+emboss run as ONE fused quarter-strip kernel, with no
+    other fallback runs."""
+    from mpi_cuda_imagemanipulation_tpu.ops import pallas_kernels
+
+    calls = []
+    real = pallas_kernels.pipeline_pallas
+
+    def counting(ops, im, **kw):
+        calls.append(tuple(o.name for o in ops))
+        return real(ops, im, **kw)
+
+    monkeypatch.setattr(pallas_kernels, "pipeline_pallas", counting)
+    rgb = jnp.asarray(synthetic_image(40, 64, channels=3, seed=21))
+    spec = "grayscale,contrast:3.5,emboss:3"
+    got = np.asarray(
+        pipeline_swar(make_pipeline_ops(spec), rgb, interpret=True)
+    )
+    np.testing.assert_array_equal(got, _golden(spec, rgb))
+    assert calls == [("grayscale",)], calls
+
+
+@pytest.mark.parametrize("n", [2, 8])
+@pytest.mark.parametrize(
+    "spec",
+    [
+        "emboss:3",  # interior guard masks must follow GLOBAL coords
+        "contrast:3.5,emboss:3",
+        "grayscale,contrast:3.5,emboss:3",
+        "emboss101:5",
+    ],
+)
+def test_sharded_corr2d_bit_exact(spec, n):
+    """Sharded corr2d == golden — for interior mode this is the seam
+    test: a mid-image shard is fully interior and must filter its
+    boundary rows using ghost strips, not pass them through (the
+    reference's per-slice seam bug, SURVEY.md §2.1)."""
+    from mpi_cuda_imagemanipulation_tpu.parallel.mesh import make_mesh
+
+    channels = 3 if "grayscale" in spec else 1
+    img = jnp.asarray(
+        synthetic_image(16 * n, 64, channels=channels, seed=22)
+    )
+    pipe = Pipeline.parse(spec)
+    got = np.asarray(pipe.sharded(make_mesh(n), backend="swar")(img))
+    np.testing.assert_array_equal(got, np.asarray(pipe(img)))
+
+
 def test_affine_fit_matrix():
     """The fitter covers exactly the affine-representable registry ops."""
     from mpi_cuda_imagemanipulation_tpu.ops.swar_kernels import swar_fusable
@@ -429,6 +548,16 @@ def test_prefer_swar_promotes_auto_routing(monkeypatch):
     out = np.asarray(pallas_kernels.pipeline_auto(ops, odd, interpret=True))
     np.testing.assert_array_equal(out, _golden("gaussian:5", odd))
     assert calls == [1]
+
+    # the halo-1 corr2d family routes under auto too — the promotion
+    # switch must not sit behind the u8-Pallas gate, which rejects cheap
+    # halo-1 stencils (review finding: single- and multi-chip auto
+    # routing disagreed); the fused chain rides along
+    spec = "contrast:3.5,emboss:3"
+    ref_ops = make_pipeline_ops(spec)
+    out = np.asarray(pallas_kernels.pipeline_auto(ref_ops, img, interpret=True))
+    np.testing.assert_array_equal(out, _golden(spec, img))
+    assert calls == [1, 1]
 
 
 def test_cli_run_impl_swar(tmp_path):

@@ -210,6 +210,21 @@ def run_sweep(shapes, results) -> int:
             lambda: pipe.sharded(mesh, backend="pallas")(img),
         )
 
+    # quarter-strip SWAR ghost path on the 1-device mesh: compiles the
+    # sharded swar kernels (separable + corr2d + fused chain) with Mosaic
+    for spec, ch, sseed in (
+        ("contrast:3.5,gaussian:5", 1, 61),
+        ("grayscale,contrast:3.5,emboss:3", 3, 62),
+    ):
+        pipe = Pipeline.parse(spec)
+        hw = (128, 256)
+        img = jnp.asarray(synthetic_image(*hw, channels=ch, seed=sseed))
+        fails += not _check(
+            results, "sharded_swar", spec, ch, hw,
+            lambda: golden_of(pipe.ops, img),
+            lambda: pipe.sharded(mesh, backend="swar")(img),
+        )
+
     # 2-D tile runner (parallel/api2d) on a 1x1 device mesh: both
     # ppermute-free exchange paths + axis-general edge fixups get a
     # compiled silicon run without a pod (same rationale as the 1-D
@@ -267,6 +282,17 @@ def run_sweep(shapes, results) -> int:
         ("gaussian:3", 1, 42),
         ("gaussian:3,gaussian:5", 1, 43),
         ("grayscale,gaussian:5", 3, 44),
+        # round-5 additions: wide column mode (gaussian:7 S=64, box:3
+        # non-power-of-two), fused affine chains (pre and post), and the
+        # corr2d kernel — incl. the FULL reference pipeline, whose
+        # contrast+emboss tail is one quarter-strip kernel
+        ("gaussian:7", 1, 45),
+        ("box:3", 1, 46),
+        ("contrast:3.5,gaussian:5", 1, 47),
+        ("gaussian:7,invert", 1, 48),
+        ("emboss:3", 1, 49),
+        ("emboss101:5", 1, 50),
+        ("grayscale,contrast:3.5,emboss:3", 3, 51),
     ):
         pipe = Pipeline.parse(spec)
         hw = (130, 256)
