@@ -237,6 +237,9 @@ def main() -> int:
             (HEADLINE, "packed"),
             (HEADLINE, "xla"),
             (HEADLINE + "_sharded", "pallas"),
+            # the sharded swar ghost path (round 5): a SWAR win must
+            # show up sharded too, per-chip parity with unsharded swar
+            (HEADLINE + "_sharded", "swar"),
         ]
         for name, impl in plan:
             rec, err = _run_config(name, impl)

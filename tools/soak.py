@@ -223,7 +223,26 @@ def run_trial(
                 synthetic_image(h, w4, channels=1, seed=trial_seed + 77)
             )
             gspec = rng.choice(
-                ("gaussian:3", "gaussian:5", "gaussian:3,gaussian:5")
+                (
+                    "gaussian:3",
+                    "gaussian:5",
+                    "gaussian:3,gaussian:5",
+                    # round-5 widening: wide column mode
+                    "gaussian:7",
+                    "box:3",
+                    "box:5",
+                    # fused affine chains (pre / post / both)
+                    "contrast:3.5,gaussian:5",
+                    "gaussian:5,invert",
+                    "brightness:20,gaussian:7,invert",
+                    # corr2d kernel (incl. the reference interior guard)
+                    "emboss:3",
+                    "emboss:5",
+                    "emboss101:3",
+                    "sharpen",
+                    "laplacian:8",
+                    "contrast:3.5,emboss:3",
+                )
             )
             gpipe = Pipeline.parse(gspec)
             try:
@@ -306,7 +325,7 @@ def run_trial(
     n_dev = len(jax.devices())
     if n_dev >= 2:
         shards = rng.choice([s for s in (2, 3, 5, n_dev) if s <= n_dev])
-        backend = rng.choice(("xla", "pallas", "packed", "auto"))
+        backend = rng.choice(("xla", "pallas", "packed", "auto", "swar"))
         # small images reject large shard counts (documented min-rows-per-
         # shard guard); fall back toward 2 shards so pathological shapes
         # still get sharded coverage, and *count* trials that lose it so
