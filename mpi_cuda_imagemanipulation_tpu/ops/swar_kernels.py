@@ -62,6 +62,19 @@ identity. With the pointwise fusion above, the reference pipeline's
 contrast:3.5 -> emboss:3 tail (kernel.cu:192-195) runs as ONE
 quarter-strip kernel.
 
+A fourth kernel (``swar_corr2d_wide_eligible`` / ``make_swar_corr2d_wide``)
+takes the REST of the correlation class: integer odd-square kernel(s)
+with 255*sum(|w|) < 2^24, any scale, 'single' OR 'magnitude' combine,
+either quantizer. The carried fields widen to one pixel per i32 lane in
+the finalize step, accumulate SIGNED natively (no bias trick), and the
+combine + scale + quantize replay the golden float sequence on the exact
+integer sums — so sqrt gradient magnitudes (sobel/prewitt/scharr),
+unsharp's 1/256 scale, and arbitrary integer custom filters are all
+bit-exact. I/O stays packed; only finalize compute runs at full element
+count. Net coverage: every correlation-class stencil in the registry
+runs on the SWAR path; only rank/morphology (median/erode/dilate) and
+gather-based LUT ops remain on the u8 kernels.
+
 Ineligible ops fall back to the u8 streaming kernels per op, so
 ``impl='swar'`` is always-correct — the same contract as
 ``impl='packed'`` (ops/packed_kernels.py).
@@ -422,7 +435,7 @@ def _pick_swar_block_h(ws: int, halo: int, mode: str = "narrow") -> int:
     from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import _VMEM_LIMIT
 
     budget = 3 * _VMEM_LIMIT // 4
-    live = 6 if mode == "narrow" else 18
+    live = {"narrow": 6, "wide": 18, "corr2d": 18, "corr2d_wide": 22}[mode]
     per_row = 4 * (ws + 2 * halo) * 2 + 4 * ws * (2 + 2 + live)
     bh = budget // max(per_row, 1)
     bh = int(max(2 * halo, min(512, bh)))
@@ -532,6 +545,17 @@ def _shape_ok(op: StencilOp, plane_shape) -> bool:
     )
 
 
+def _kernel_geom_ok(w: "np.ndarray", halo: int) -> bool:
+    """Shared corr2d kernel-geometry gate: odd square matching the op's
+    halo, integer weights (both corr2d eligibility predicates use this —
+    review finding: two drifting copies existed)."""
+    if w.ndim != 2 or w.shape[0] != w.shape[1] or w.shape[0] % 2 == 0:
+        return False
+    if w.shape[0] != 2 * halo + 1:
+        return False
+    return bool(np.all(w == np.floor(w)))
+
+
 def _corr2d_weights(op: StencilOp) -> tuple[tuple[int, ...], ...]:
     w = np.asarray(op.kernels[0])
     return tuple(tuple(int(v) for v in row) for row in w)
@@ -562,11 +586,7 @@ def swar_corr2d_eligible(
     if op.edge_mode not in _PAD_MODES:  # includes 'interior'
         return False
     w = np.asarray(op.kernels[0])
-    if w.ndim != 2 or w.shape[0] != w.shape[1] or w.shape[0] % 2 == 0:
-        return False
-    if w.shape[0] != 2 * op.halo + 1 or op.halo < 1:
-        return False
-    if not np.all(w == np.floor(w)):
+    if op.halo < 1 or not _kernel_geom_ok(w, op.halo):
         return False
     if int(np.abs(w).sum()) > 128 or not np.any(w):
         return False
@@ -575,13 +595,203 @@ def swar_corr2d_eligible(
     return True
 
 
+def swar_corr2d_wide_eligible(
+    op: Op, plane_shape: tuple[int, ...] | None = None
+) -> bool:
+    """Whether `op` can run on the WIDE 2-D correlation path: integer
+    odd-square kernel(s) with 255*sum(|w|) < 2^24 (exact in f32 and in
+    range for i32 lanes), any scale, 'single' or 'magnitude' combine,
+    either quantizer. The correlation runs at one pixel per i32 lane
+    with native signed accumulation (no bias trick needed) and the
+    combine + scale + quantize REPLAY the golden float ops on the exact
+    integer sums — bit-exact for sqrt magnitudes and arbitrary scales
+    alike. Covers sobel/prewitt/scharr, unsharp, and integer custom
+    filters; I/O still moves packed u32 words."""
+    if not isinstance(op, StencilOp):
+        return False
+    if op.reduce != "corr":
+        return False
+    if op.combine not in ("single", "magnitude"):
+        return False
+    if op.combine == "magnitude" and len(op.kernels) != 2:
+        return False
+    if op.combine == "single" and len(op.kernels) != 1:
+        return False
+    from mpi_cuda_imagemanipulation_tpu.ops.spec import QUANTIZERS_F32
+
+    if op.quantize not in QUANTIZERS_F32:
+        return False
+    if op.edge_mode not in _PAD_MODES:
+        return False
+    if op.halo < 1:
+        return False
+    for k in op.kernels:
+        w = np.asarray(k)
+        if not _kernel_geom_ok(w, op.halo):
+            return False
+        if 255 * int(np.abs(w).sum()) >= 1 << 24 or not np.any(w):
+            return False
+    if plane_shape is not None and not _shape_ok(op, plane_shape):
+        return False
+    return True
+
+
 def swar_any_eligible(
     op: Op, plane_shape: tuple[int, ...] | None = None
 ) -> bool:
-    """Combined predicate: the separable path OR the 2-D correlation
-    path can take this op (used by the pipeline walkers)."""
-    return swar_eligible(op, plane_shape) or swar_corr2d_eligible(
-        op, plane_shape
+    """Combined predicate: any of the three SWAR kernels (separable,
+    packed-field corr2d, wide-lane corr2d) can take this op (used by
+    the pipeline walkers)."""
+    return (
+        swar_eligible(op, plane_shape)
+        or swar_corr2d_eligible(op, plane_shape)
+        or swar_corr2d_wide_eligible(op, plane_shape)
+    )
+
+
+def make_swar_corr2d_wide(
+    ext_shape: tuple[int, int],
+    kernels: tuple[tuple[tuple[int, ...], ...], ...],
+    bh: int,
+    *,
+    combine: str,
+    scale: float,
+    quantize: str,
+    interior: bool,
+    global_h: int,
+    pre_chain: tuple = (),
+    post_chain: tuple = (),
+    sharded_y0: bool = False,
+    interpret: bool = False,
+):
+    """Wide-lane 2-D correlation kernel: packed u32 words stream in, the
+    carried fields widen to one pixel per i32 lane in the finalize step,
+    and the correlation accumulates SIGNED in i32 (no bias trick — each
+    lane is its own value). combine/scale/quantize replay the golden
+    float sequence on the exact integer sums (see
+    swar_corr2d_wide_eligible), so sqrt-magnitude gradient ops and
+    arbitrary scales stay bit-exact. I/O element saving is kept (words);
+    finalize compute runs at full element count like the separable wide
+    column mode."""
+    from mpi_cuda_imagemanipulation_tpu.ops.spec import QUANTIZERS_F32
+
+    n = len(kernels[0])
+    halo = (n - 1) // 2
+    hp, wsp = ext_shape
+    height = hp - 2 * halo
+    ws = wsp - 2 * halo
+    if bh < 2 * halo:
+        raise ValueError(f"block_h {bh} < 2*halo {2 * halo}")
+    nb = -(-height // bh)
+    nb_in = -(-hp // bh)
+    o = halo
+    quant = QUANTIZERS_F32[quantize]
+
+    def corr(lane, weights):
+        """(bh+2h, wsp) i32 lane -> (bh, ws) signed i32 sums."""
+        acc = None
+        for dy, row in enumerate(weights):
+            for dx, w in enumerate(row):
+                if w == 0:
+                    continue
+                win = lane[dy : dy + bh, dx : dx + ws]
+                term = win if w == 1 else win * jnp.int32(w)
+                acc = term if acc is None else acc + term
+        return acc if acc is not None else jnp.zeros((bh, ws), jnp.int32)
+
+    def q_lane(lane, i, y0, strip):
+        """One widened (bh+2h, wsp) i32 lane -> quantized (bh, ws) i32."""
+        accs = [corr(lane, k) for k in kernels]
+        if combine == "single":
+            acc = accs[0].astype(F32)
+        else:  # magnitude — replay spec.StencilOp.valid exactly
+            a0 = accs[0].astype(F32)
+            a1 = accs[1].astype(F32)
+            acc = jnp.sqrt(a0 * a0 + a1 * a1)
+        if scale != 1.0:
+            acc = acc * np.float32(scale)
+        q = quant(acc).astype(jnp.int32)
+        if interior:
+            yy = (
+                y0
+                + (i - 1) * bh
+                + jax.lax.broadcasted_iota(jnp.int32, (bh, ws), 0)
+            )
+            yc = (yy > o) & (yy <= global_h - 1 - o)
+            jl = jax.lax.broadcasted_iota(jnp.int32, (bh, ws), 1)
+            cond = yc
+            if strip == 0:
+                cond = cond & (jl > o)
+            elif strip == 3:
+                cond = cond & (jl < ws - o)
+            centre = lane[halo : halo + bh, halo : halo + ws]
+            q = jnp.where(cond, q, centre)
+        return _apply_affine_lanes(q, post_chain)
+
+    def kernel(*refs):
+        if sharded_y0:
+            y0_ref, in_ref, out_ref, lo_ref, hi_ref = refs
+            y0 = y0_ref[0]
+        else:
+            in_ref, out_ref, lo_ref, hi_ref = refs
+            y0 = jnp.int32(0)
+        i = pl.program_id(0)
+        ext = in_ref[:]
+        w8 = ext.dtype.type
+        lo = ext & w8(_M_LO)
+        hi = (ext >> w8(8)) & w8(_M_LO)
+        if pre_chain:
+            lo = _apply_affine_fields(lo, pre_chain)
+            hi = _apply_affine_fields(hi, pre_chain)
+
+        @pl.when(i >= 1)
+        def _():
+            lo_rows = jnp.concatenate([lo_ref[:], lo[: 2 * halo]], axis=0)
+            hi_rows = jnp.concatenate([hi_ref[:], hi[: 2 * halo]], axis=0)
+            m16 = jnp.int32(0xFFFF)
+            # widen: byte k of each word is strip k's pixel (pack_quarters)
+            lo_i = lo_rows.astype(jnp.int32)
+            hi_i = hi_rows.astype(jnp.int32)
+            b0 = q_lane(lo_i & m16, i, y0, 0)  # strip 0
+            b2 = q_lane((lo_i >> 16) & m16, i, y0, 2)  # strip 2
+            b1 = q_lane(hi_i & m16, i, y0, 1)  # strip 1
+            b3 = q_lane((hi_i >> 16) & m16, i, y0, 3)  # strip 3
+            # stays i32 end-to-end (packed_kernels' Mosaic-native idiom);
+            # the caller bitcasts the word array back to u32
+            out_ref[:] = b0 | (b1 << 8) | (b2 << 16) | (b3 << 24)
+
+        lo_ref[:] = lo
+        hi_ref[:] = hi
+
+    from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
+        _COMPILER_PARAMS,
+    )
+
+    in_specs = [
+        pl.BlockSpec(
+            (bh, wsp),
+            lambda i: (jnp.minimum(i, nb_in - 1), 0),
+            memory_space=pltpu.VMEM,
+        )
+    ]
+    if sharded_y0:
+        in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] + in_specs
+    return pl.pallas_call(
+        kernel,
+        grid=(nb + 1,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (bh, ws),
+            lambda i: (jnp.maximum(i - 1, 0), 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((nb * bh, ws), jnp.int32),
+        scratch_shapes=[
+            pltpu.VMEM((bh, wsp), jnp.uint32),
+            pltpu.VMEM((bh, wsp), jnp.uint32),
+        ],
+        compiler_params=_COMPILER_PARAMS,
+        interpret=interpret,
     )
 
 
@@ -756,8 +966,10 @@ def swar_stencil(
     interpret: bool | None = None,
 ) -> jnp.ndarray:
     """One eligible StencilOp on a (H, W) u8 plane via the SWAR path —
-    the separable kernel when ``swar_eligible``, else the 2-D correlation
-    kernel (caller guarantees ``swar_corr2d_eligible``) — with optional
+    the separable kernel when ``swar_eligible``, else one of the two 2-D
+    correlation kernels — packed-field where ``swar_corr2d_eligible``,
+    wide-lane otherwise (caller guarantees ``swar_any_eligible``) — with
+    optional
     fused pointwise prefix/suffix ops (each must satisfy ``swar_fusable``;
     their fitted chains run inside the same kernel, so the whole group
     costs one HBM read + one write).
@@ -797,20 +1009,47 @@ def swar_stencil(
     ext = pack_quarters(xpad, halo)
 
     if not swar_eligible(op):
-        # 2-D correlation path (emboss family / sharpen / laplacian)
-        bh = block_h or _pick_swar_block_h(ws, halo, "corr2d")
+        # 2-D correlation paths: packed-field kernel where the bias trick
+        # fits (emboss family / sharpen / laplacian), wide-lane kernel
+        # for the rest (gradient magnitudes, unsharp, custom filters)
         sharded_y0 = y0 is not None
-        fn = make_swar_corr2d(
-            ext.shape,
-            _corr2d_weights(op),
-            bh,
-            interior=op.edge_mode == "interior",
-            global_h=global_h if global_h is not None else height,
-            pre_chain=pre_chain,
-            post_chain=post_chain,
-            sharded_y0=sharded_y0,
-            interpret=interpret,
-        )
+        if swar_corr2d_eligible(op):
+            bh = block_h or _pick_swar_block_h(ws, halo, "corr2d")
+            fn = make_swar_corr2d(
+                ext.shape,
+                _corr2d_weights(op),
+                bh,
+                interior=op.edge_mode == "interior",
+                global_h=global_h if global_h is not None else height,
+                pre_chain=pre_chain,
+                post_chain=post_chain,
+                sharded_y0=sharded_y0,
+                interpret=interpret,
+            )
+        else:
+            bh = block_h or _pick_swar_block_h(ws, halo, "corr2d_wide")
+            kernels = tuple(
+                tuple(tuple(int(v) for v in row) for row in np.asarray(k))
+                for k in op.kernels
+            )
+            wide = make_swar_corr2d_wide(
+                ext.shape,
+                kernels,
+                bh,
+                combine=op.combine,
+                scale=float(op.scale),
+                quantize=op.quantize,
+                interior=op.edge_mode == "interior",
+                global_h=global_h if global_h is not None else height,
+                pre_chain=pre_chain,
+                post_chain=post_chain,
+                sharded_y0=sharded_y0,
+                interpret=interpret,
+            )
+
+            def fn(*a):
+                return jax.lax.bitcast_convert_type(wide(*a), jnp.uint32)
+
         if sharded_y0:
             outw = fn(jnp.asarray(y0, jnp.int32).reshape(1), ext)
         else:

@@ -292,6 +292,68 @@ def test_sharded_corr2d_bit_exact(spec, n):
     np.testing.assert_array_equal(got, np.asarray(pipe(img)))
 
 
+def test_corr2d_wide_eligibility_matrix():
+    """The wide-lane corr2d class takes everything corr-shaped the other
+    two kernels can't: gradient magnitudes, scaled kernels, custom
+    integer filters. Rank/morphology stay out."""
+    from mpi_cuda_imagemanipulation_tpu.ops.swar_kernels import (
+        swar_corr2d_wide_eligible,
+    )
+
+    elig = {
+        spec: swar_corr2d_wide_eligible(
+            make_pipeline_ops(spec)[0], (64, 64)
+        )
+        for spec in (
+            "sobel",
+            "prewitt",
+            "scharr",
+            "unsharp",
+            "filter:0/-1/0/-1/5/-1/0/-1/0",
+            "median:3",
+            "erode:5",
+        )
+    }
+    assert elig == {
+        "sobel": True,
+        "prewitt": True,
+        "scharr": True,
+        "unsharp": True,
+        "filter:0/-1/0/-1/5/-1/0/-1/0": True,
+        "median:3": False,
+        "erode:5": False,
+    }
+
+
+@pytest.mark.parametrize(
+    "spec",
+    [
+        "sobel",  # magnitude combine: sqrt replay
+        "scharr",
+        "unsharp",  # scale 1/256, sum|w| = 696 (past the bias bound)
+        "contrast:3.5,sobel",  # pre-chain into a magnitude op
+        "unsharp,invert",  # post-chain
+        "filter:1/2/1/2/4/2/1/2/1:0.0625",  # custom kernel, custom scale
+    ],
+)
+@pytest.mark.parametrize(
+    "shape,seed", [((48, 64), 1), ((37, 128), 2), ((8, 64), 4)]
+)
+def test_corr2d_wide_bit_exact_vs_golden(spec, shape, seed):
+    img = jnp.asarray(synthetic_image(*shape, channels=1, seed=seed))
+    np.testing.assert_array_equal(_swar(spec, img), _golden(spec, img))
+
+
+@pytest.mark.parametrize("spec", ["sobel", "unsharp", "contrast:3.5,sobel"])
+def test_sharded_corr2d_wide_bit_exact(spec):
+    from mpi_cuda_imagemanipulation_tpu.parallel.mesh import make_mesh
+
+    img = jnp.asarray(synthetic_image(64, 64, channels=1, seed=24))
+    pipe = Pipeline.parse(spec)
+    got = np.asarray(pipe.sharded(make_mesh(4), backend="swar")(img))
+    np.testing.assert_array_equal(got, np.asarray(pipe(img)))
+
+
 def test_affine_fit_matrix():
     """The fitter covers exactly the affine-representable registry ops."""
     from mpi_cuda_imagemanipulation_tpu.ops.swar_kernels import swar_fusable
