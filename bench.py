@@ -227,14 +227,13 @@ def main() -> int:
     if on_tpu:
         # the sharded config runs even on one chip: it exercises the
         # fused-ghost shard_map path (run_group ghost mode), which is
-        # the configuration that matters on a pod. "packed" is the
-        # packed-u32 streaming variant (ops/packed_kernels.py) — the
-        # headline then reports whichever impl measures fastest, so the
-        # element-rate A/B rides every TPU bench run.
+        # the configuration that matters on a pod. The headline reports
+        # whichever impl measures fastest, so the u8-vs-wide A/B rides
+        # every TPU bench run ("packed" was demoted round 5 after losing
+        # its A/B 4.1x — tools/packed_kernels.py).
         plan = [
             (HEADLINE, "pallas"),
             (HEADLINE, "swar"),
-            (HEADLINE, "packed"),
             (HEADLINE, "xla"),
             (HEADLINE + "_sharded", "pallas"),
             # the sharded swar ghost path (round 5): a SWAR win must

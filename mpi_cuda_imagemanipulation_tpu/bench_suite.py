@@ -169,18 +169,20 @@ def run_config(cfg: BenchConfig, impl: str) -> dict:
         rec["roofline_frac"] = gb_s / HBM_GB_S.get(gen, HBM_GB_S["v5e"])
         # the traffic model counts u8 planes, so modeled bytes == modeled
         # elements and gb_s doubles as giga-elements/s against the measured
-        # element-rate ceiling — but only for impls that stream u8 elements;
-        # the packed impl (and auto under MCIM_PREFER_PACKED, which routes
-        # eligible groups through the packed kernels) moves the same bytes
-        # as u32 words (1/4 the elements), so the equivalence breaks there
-        # and the field is omitted rather than overstated 4x
+        # element-rate ceiling — but only for impls that stream u8
+        # elements; the swar impl (and auto under MCIM_PREFER_SWAR) moves
+        # the same bytes as u32 words (1/2 the elements), so the
+        # equivalence breaks there and the field is omitted rather than
+        # overstated. (The round-5 roofline RR probe measured u8 copy at
+        # ~550 GB/s, so the "element ceiling" is a property of the compute
+        # kernels, not the HBM path — the field is kept as the measured
+        # same-kernel-class reference point.)
         from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
-            prefer_packed,
             prefer_swar,
         )
 
-        streams_u8 = impl not in ("packed", "swar") and not (
-            impl == "auto" and (prefer_packed() or prefer_swar())
+        streams_u8 = impl != "swar" and not (
+            impl == "auto" and prefer_swar()
         )
         if gen in ELEM_G_S_MEASURED and streams_u8:
             rec["elem_ceiling_frac"] = gb_s / ELEM_G_S_MEASURED[gen]
@@ -289,7 +291,7 @@ def main(argv: Sequence[str] | None = None) -> int:
     ap.add_argument(
         "--impl",
         default="pallas",
-        choices=("xla", "pallas", "packed", "swar", "auto"),
+        choices=("xla", "pallas", "swar", "auto"),
     )
     args = ap.parse_args(argv)
     rec = run_config(CONFIGS[args.config], args.impl)

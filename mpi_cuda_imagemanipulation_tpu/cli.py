@@ -44,11 +44,10 @@ def _build_parser() -> argparse.ArgumentParser:
     )
     run.add_argument(
         "--impl",
-        choices=("auto", "xla", "pallas", "packed", "swar"),
+        choices=("auto", "xla", "pallas", "swar"),
         default="auto",
-        help="compute backend for the op kernels (auto: per-group choice "
-        "between XLA fusion and Pallas kernels; packed: Pallas with "
-        "packed-u32 streaming where eligible)",
+        help="compute backend for the op kernels (auto: measured per-group "
+        "choice between XLA fusion and Pallas kernels)",
     )
     run.add_argument(
         "--shards",
@@ -120,7 +119,7 @@ def _build_parser() -> argparse.ArgumentParser:
     batch.add_argument("--glob", default="*", help="input filename pattern")
     batch.add_argument("--ops", default="grayscale,contrast:3.5,emboss:3")
     batch.add_argument(
-        "--impl", choices=("auto", "xla", "pallas", "packed", "swar"), default="auto"
+        "--impl", choices=("auto", "xla", "pallas", "swar"), default="auto"
     )
     batch.add_argument(
         "--shards",
@@ -162,7 +161,7 @@ def _build_parser() -> argparse.ArgumentParser:
     bench.add_argument("--device", default=None)
     bench.add_argument(
         "--impl",
-        choices=("xla", "pallas", "packed", "swar", "auto", "both"),
+        choices=("xla", "pallas", "swar", "auto", "both"),
         default="both",
     )
     bench.add_argument("--json-metrics", default=None)
@@ -191,7 +190,7 @@ def _build_parser() -> argparse.ArgumentParser:
         help="pipeline to tune against (default: the headline 5x5 Gaussian)",
     )
     tune.add_argument(
-        "--impl", choices=("pallas", "packed", "swar"), default="pallas"
+        "--impl", choices=("pallas", "swar"), default="pallas"
     )
     tune.add_argument("--height", type=int, default=4320)
     tune.add_argument("--width", type=int, default=7680)
@@ -756,7 +755,6 @@ def cmd_autotune(args: argparse.Namespace) -> int:
             synthetic_image(args.height, args.width, channels=1, seed=7)
         )
         kind = calibration.current_device_kind()
-        packed = args.impl == "packed"
         results = []
         for bh in candidates:
             if bh < step or bh % step:
@@ -769,7 +767,7 @@ def cmd_autotune(args: argparse.Namespace) -> int:
                 fn = jax.jit(lambda x, b=bh: pipeline_swar(ops, x, block_h=b))
             else:
                 fn = jax.jit(
-                    lambda x, b=bh: pipeline_pallas(ops, x, block_h=b, packed=packed)
+                    lambda x, b=bh: pipeline_pallas(ops, x, block_h=b)
                 )
             try:
                 sec = device_throughput(fn, [img])

@@ -27,7 +27,7 @@ from mpi_cuda_imagemanipulation_tpu.ops.registry import (
 )
 from mpi_cuda_imagemanipulation_tpu.ops.spec import Op
 
-BACKENDS = ("xla", "pallas", "packed", "swar", "auto")
+BACKENDS = ("xla", "pallas", "swar", "auto")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -61,24 +61,17 @@ class Pipeline:
     def _callable(self, backend: str, block_h: int | None = None):
         if backend == "xla":
             return self.apply
-        if backend in ("pallas", "packed"):
-            # "packed" is Pallas with packed-u32 streaming where eligible
-            # (per-group fallback to the u8 kernels keeps it always-
-            # correct; see ops/packed_kernels.py)
+        if backend == "pallas":
             from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
                 pipeline_pallas,
             )
 
-            return partial(
-                pipeline_pallas,
-                self.ops,
-                block_h=block_h,
-                packed=backend == "packed",
-            )
+            return partial(pipeline_pallas, self.ops, block_h=block_h)
         if backend == "swar":
             # quarter-strip 16-bit-field streaming for eligible binomial
             # stencils, per-op u8-kernel fallback otherwise — explicit
-            # opt-in until the on-chip A/B promotes it (ops/swar_kernels.py)
+            # opt-in (the round-5 on-chip A/B measured it 0.83x the u8
+            # kernels, so auto never picks it; ops/swar_kernels.py)
             from mpi_cuda_imagemanipulation_tpu.ops.swar_kernels import (
                 pipeline_swar,
             )

@@ -875,60 +875,23 @@ def pipeline_pallas(
     *,
     interpret: bool | None = None,
     block_h: int | None = None,
-    packed: bool = False,
 ):
     """Run a full pipeline through fused Pallas group kernels.
 
     Same uint8 semantics as the golden path (bit-exact — asserted by
     tests/test_pallas.py); images are processed as planar channels.
-    `packed=True` routes eligible groups through the packed-u32 streaming
-    kernels (ops/packed_kernels.py — 4 pixels per 32-bit lane; the
-    element-rate roofline exploitation), transparently falling back per
-    group where packing is unsupported, so results stay bit-exact either
-    way (tests/test_packed.py).
+    (The former `packed=True` wide-word routing was demoted to
+    tools/packed_kernels.py after the round-5 on-chip A/B measured it
+    4.1x slower than this path — see that module's docstring.)
     """
     if img.ndim == 3:
         planes = [img[..., c] for c in range(img.shape[2])]
     else:
         planes = [img]
-    if packed:
-        from mpi_cuda_imagemanipulation_tpu.ops.packed_kernels import (
-            pack_words,
-            packed_supported,
-            run_group_packed_words,
-            unpack_words,
-        )
-
-    words = None  # non-None: planes currently live as packed i32 words
-    height = width = None
     for pointwise, stencil in group_ops(ops):
-        if words is None:
-            height, width = planes[0].shape
-        if packed and packed_supported(pointwise, stencil, width):
-            # consecutive eligible groups stay in word form — on TPU the
-            # u8<->u32 view is a real copy (different tilings), so the
-            # conversion is paid once per run of packed groups, not per
-            # group
-            if words is None:
-                words = [pack_words(p) for p in planes]
-            words = run_group_packed_words(
-                pointwise,
-                stencil,
-                words,
-                height,
-                width,
-                interpret=interpret,
-                block_h=block_h,
-            )
-            continue
-        if words is not None:
-            planes = [unpack_words(w, width) for w in words]
-            words = None
         planes = run_group(
             pointwise, stencil, planes, interpret=interpret, block_h=block_h
         )
-    if words is not None:
-        planes = [unpack_words(w, width) for w in words]
     if len(planes) == 1:
         return planes[0]
     return jnp.stack(planes, axis=-1)
@@ -965,27 +928,18 @@ def use_pallas_for_stencil(stencil: StencilOp | None, group_in_channels: int) ->
     return group_in_channels == 1 and len(stencil.kernels) > 1
 
 
-def prefer_packed() -> bool:
-    """Whether the auto paths should route eligible Pallas groups through
-    the packed-u32 kernels (ops/packed_kernels.py). Off by default until
-    the on-chip A/B (BASELINE.md round 3 decision procedure) confirms the
-    element-rate win; flipping MCIM_PREFER_PACKED=1 then promotes packed
-    everywhere `auto` runs — CLI default, batch, sharded — without a code
-    change."""
-    import os
-
-    return os.environ.get("MCIM_PREFER_PACKED", "") not in ("", "0")
-
-
 def prefer_swar() -> bool:
-    """Same promotion switch for the SWAR quarter-strip backend
+    """Promotion switch for the SWAR quarter-strip backend
     (ops/swar_kernels.py): MCIM_PREFER_SWAR=1 routes eligible stencil
     groups through it on every auto path — CLI default, batch, AND the
     row-sharded runner, where eligible groups take the quarter-strip
-    ghost path (parallel/api.py, VERDICT r4 #3) — once the on-chip
-    prototype + production captures (BASELINE.md round-4 predictions)
-    confirm the 2-4x element-rate win. The sharded runner snapshots this
-    flag once at build time (sharded_pipeline), so a mid-session env
+    ghost path (parallel/api.py, VERDICT r4 #3). Off by default, and the
+    round-5 on-chip capture (BENCH_HISTORY 2026-08-01) measured the
+    production SWAR headline at 0.83x the u8 streaming kernel — the
+    pre-registered 2-4x prediction did not hold (the element-rate-cap
+    premise was itself falsified the same window), so the switch stays
+    off; it remains for A/B reproduction. The sharded runner snapshots
+    this flag once at build time (sharded_pipeline), so a mid-session env
     change never splits routing across retraces."""
     import os
 
@@ -1000,11 +954,13 @@ def pipeline_auto(
     block_h: int | None = None,
 ):
     """Per-group backend selection: golden/XLA ops where XLA's fusion wins,
-    Pallas group kernels where the stencil working set favours them
-    (packed-u32 variants under MCIM_PREFER_PACKED — see prefer_packed).
-    Bit-exact with both pure paths (they are bit-exact with each other)."""
+    Pallas group kernels where the stencil working set favours them.
+    Both branch choices are measured on-chip (use_pallas_for_stencil
+    docstring; re-confirmed round 5: 73.3 GP/s XLA vs 33.9 GP/s Pallas on
+    the reference pipeline, 44.1 GP/s Pallas vs 11.4 GP/s XLA on the 8K
+    gaussian:5). Bit-exact with both pure paths (they are bit-exact with
+    each other)."""
     state = img
-    packed = prefer_packed()
     swar = prefer_swar()
     for pointwise, stencil in group_ops(ops):
         n_ch = state.shape[2] if state.ndim == 3 else 1
@@ -1047,24 +1003,6 @@ def pipeline_auto(
                 if state.ndim == 3
                 else [state]
             )
-            if packed:
-                from mpi_cuda_imagemanipulation_tpu.ops.packed_kernels import (
-                    packed_supported,
-                    run_group_packed,
-                )
-
-                if packed_supported(pointwise, stencil, planes[0].shape[1]):
-                    planes = run_group_packed(
-                        pointwise,
-                        stencil,
-                        planes,
-                        interpret=interpret,
-                        block_h=block_h,
-                    )
-                    state = (
-                        planes[0] if len(planes) == 1 else jnp.stack(planes, -1)
-                    )
-                    continue
             planes = run_group(
                 pointwise, stencil, planes, interpret=interpret, block_h=block_h
             )

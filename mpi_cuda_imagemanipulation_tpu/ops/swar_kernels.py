@@ -8,7 +8,7 @@ Why this exists (the round-3 roofline result, BASELINE.md): u8 streaming on
 v5e is element-rate-capped (~95-100 Ge/s) at ~1/4 of the f32 byte rate, and
 the u8 production kernels already sit at ~94% of that ceiling — so the only
 way past it is fewer, wider elements. The first packed attempt
-(ops/packed_kernels.py) moves u32 words but unpacks every word into four
+(tools/packed_kernels.py, demoted round 5) moves u32 words but unpacks every word into four
 f32 lane planes in-kernel, paying the full element count *plus* shift
 overhead; it measured 3.2x slower. SWAR is the design that actually banks
 the element saving:
@@ -40,7 +40,7 @@ the element saving:
      ``rint_clip`` — so it is bit-exact by construction for ANY scale,
      power of two or not. Exactness needs the column sums representable
      in f32: 255*S^2 < 2^24 (S <= 128 satisfies it). The i32 shift/mask/
-     convert idiom mirrors ops/packed_kernels.py's Mosaic-native lane
+     convert idiom mirrors tools/packed_kernels.py's Mosaic-native lane
      algebra.
 
 Separable eligibility (``swar_eligible``): single-plane u8 (H, W) with
@@ -77,7 +77,7 @@ gather-based LUT ops remain on the u8 kernels.
 
 Ineligible ops fall back to the u8 streaming kernels per op, so
 ``impl='swar'`` is always-correct — the same contract as
-``impl='packed'`` (ops/packed_kernels.py).
+``impl='packed'`` (tools/packed_kernels.py, demoted round 5).
 
 The streaming kernels reuse the production scratch-carry structure
 (ops/pallas_kernels.stencil_tile_pallas): ext-row blocks stream in

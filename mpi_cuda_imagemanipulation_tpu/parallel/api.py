@@ -165,10 +165,10 @@ def _apply_stencil(
     _apply_group_fused, selected by _run_segment's group walker."""
     h = op.halo
     backend = _resolve_backend(op, backend)
-    if backend in ("packed", "swar"):
-        # the materialised-ext fallback has no packed/swar variant (it
-        # exists for pad rows / tiny tiles where throughput is moot); use
-        # the u8 Pallas tile kernel
+    if backend == "swar":
+        # the materialised-ext fallback has no swar variant (it exists for
+        # pad rows / tiny tiles where throughput is moot); use the u8
+        # Pallas tile kernel
         backend = "pallas"
     # halo exchange + global-edge fixup once on the full tile (2-D or HWC) —
     # on uint8 (dtype-generic gather/where), so colour images pay two
@@ -251,16 +251,13 @@ def _apply_group_fused(
     global_h: int,
     global_w: int,
     n_shards: int,
-    packed: bool = False,
 ) -> jnp.ndarray:
     """Run one [pointwise*, stencil] group as a single ghost-mode Pallas
     call: the raw pre-pointwise tile streams through the kernel once, the
     (halo, W) ghost strips (exchanged raw — pointwise ops are per-pixel, so
     they commute with strip selection and are applied to the strips inside
     the kernel) ride along in VMEM, and no intermediate pointwise output is
-    ever materialised in HBM. With `packed` (and an eligible group), the
-    packed-u32 ghost-mode kernel runs instead, so sharded tiles stream 4
-    pixels per 32-bit lane like the unsharded packed path.
+    ever materialised in HBM.
     """
     from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import run_group
 
@@ -277,22 +274,6 @@ def _apply_group_fused(
         bots = [bottom[..., c] for c in range(tile.shape[2])]
     else:
         planes, tops, bots = [tile], [top], [bottom]
-    if packed:
-        from mpi_cuda_imagemanipulation_tpu.ops.packed_kernels import (
-            packed_supported,
-            run_group_packed,
-        )
-
-        if packed_supported(list(pointwise), stencil, global_w):
-            outs = run_group_packed(
-                list(pointwise),
-                stencil,
-                planes,
-                ghosts=(tops, bots),
-                y0=y0,
-                image_h=global_h,
-            )
-            return outs[0] if len(outs) == 1 else jnp.stack(outs, axis=-1)
     outs = run_group(
         list(pointwise),
         stencil,
@@ -479,7 +460,7 @@ def _run_segment(
                     group_in = tile.shape[2] if tile.ndim == 3 else 1
                     use_pallas = use_pallas_for_stencil(op, group_in)
                 else:
-                    use_pallas = backend in ("pallas", "packed", "swar")
+                    use_pallas = backend in ("pallas", "swar")
                 fusible = (
                     use_pallas
                     and op.halo >= 1
@@ -488,16 +469,10 @@ def _run_segment(
                     and local_h > op.halo
                 )
                 if fusible:
-                    from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
-                        prefer_packed,
-                    )
-
                     group = list(pending)
                     pending.clear()
                     tile = _apply_group_fused(
-                        group, op, tile, y0, global_h, global_w, n,
-                        packed=backend == "packed"
-                        or (backend == "auto" and prefer_packed()),
+                        group, op, tile, y0, global_h, global_w, n
                     )
                 else:
                     tile = flush(tile)
@@ -527,7 +502,7 @@ def sharded_pipeline(pipe, mesh, backend: str = "xla"):
     Returns a jitted (H, W[, 3]) uint8 -> uint8 function, bit-identical to
     the unsharded golden path (tests/test_sharded.py).
     """
-    if backend not in ("xla", "pallas", "packed", "swar", "auto"):
+    if backend not in ("xla", "pallas", "swar", "auto"):
         raise ValueError(f"unknown backend {backend!r}")
     # The MCIM_PREFER_SWAR promotion switch is snapshotted ONCE here:
     # routing and the vma-checker decision below must agree, and a
@@ -549,7 +524,7 @@ def sharded_pipeline(pipe, mesh, backend: str = "xla"):
             for op in pipe.ops
         )
     else:
-        any_pallas = backend in ("pallas", "packed", "swar")
+        any_pallas = backend in ("pallas", "swar")
     segments = _split_segments(pipe.ops)
 
     def run(img: jnp.ndarray) -> jnp.ndarray:

@@ -100,7 +100,7 @@ def lookup_block_h(
 ) -> int | None:
     """Calibrated preferred block height for (device kind, impl), if any.
 
-    Keyed per impl because the u8 and packed-u32 streaming kernels have
+    Keyed per impl because the u8 and wide-word streaming kernels have
     different per-block compute/VMEM profiles — a height tuned for one must
     not silently steer the other (review finding).
 

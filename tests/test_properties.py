@@ -47,15 +47,16 @@ def test_pallas_matches_golden_on_random_shapes(args):
 @settings(max_examples=20, deadline=None)
 @given(dims)
 def test_packed_matches_golden_on_random_shapes(args):
+    # regression net for the DEMOTED packed module (tools/packed_kernels):
     # random widths land on both the word-aligned packed kernels and the
-    # W % 4 fallback; both must stay bit-exact
+    # W % 4 fallback; both must stay bit-exact in interpret mode
+    from tools.packed_kernels import pipeline_packed
+
     h, w, pidx, seed = args
     pipe = Pipeline.parse(PIPELINES[pidx])
     img = jnp.asarray(synthetic_image(h, w, channels=3, seed=seed))
     golden = np.asarray(pipe(img))
-    got = np.asarray(
-        pipeline_pallas(pipe.ops, img, interpret=True, packed=True)
-    )
+    got = np.asarray(pipeline_packed(pipe.ops, img, interpret=True))
     np.testing.assert_array_equal(got, golden)
 
 

@@ -579,8 +579,9 @@ def test_batched_swar_vmap():
 
 def test_prefer_swar_promotes_auto_routing(monkeypatch):
     """MCIM_PREFER_SWAR=1 routes bare eligible stencil groups through the
-    SWAR kernel under `auto` (the post-win promotion switch, mirroring
-    MCIM_PREFER_PACKED), bit-exact; without the flag auto never calls it."""
+    SWAR kernel under `auto` (the A/B promotion switch — kept off in
+    production since the round-5 capture measured SWAR 0.83x the u8
+    kernels), bit-exact; without the flag auto never calls it."""
     from mpi_cuda_imagemanipulation_tpu.ops import pallas_kernels, swar_kernels
 
     calls = []

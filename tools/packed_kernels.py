@@ -1,5 +1,32 @@
 """Packed-u32 streaming Pallas kernels — 4 pixels per 32-bit lane.
 
+DEMOTED from the production surface (round 5). The on-chip interleaved A/B
+this design was waiting on (artifacts/packed_ab_r05.out, 2026-08-01)
+adjudicated against it decisively:
+
+  * 8K gaussian:5 (headline): 11,340 MP/s packed vs 46,248 MP/s u8
+    streaming — 4.1x SLOWER (two interleaved rounds, same process).
+  * reference pipeline: 11,172 MP/s packed vs 33,863 MP/s u8 Pallas vs
+    73,329 MP/s XLA.
+  * The element-rate-cap hypothesis that motivated the design was
+    falsified the same window: Pallas u8 copy kernels sustain ~550 GB/s
+    (artifacts/roofline_rr_r05.out), so the u8 path was never
+    element-capped — the packed unpack-to-f32-lanes inner loop just adds
+    VPU work on the same element count.
+  * The compiled validation sweep (artifacts/validate_r05.out) found the
+    packed kernels MISCOMPARE on planes narrower than one 128-lane tile
+    (W/4 < 128, e.g. 40x300 / 65x140: maxdiff up to 127) — the lane
+    rotations assume a full lane tile; interpret mode (where all packed
+    tests ran) does not model Mosaic's lane layout and hid it.
+
+The module is kept under tools/ as the measured record of the design and
+for the archival A/B tools (tools/packed_ab.py, tools/packed_proto.py);
+`pipeline_packed` below preserves a runnable entry for the interpret-mode
+regression tests (tests/test_packed.py). It is no longer reachable from
+any production path: the `--impl packed` choice, the MCIM_PREFER_PACKED
+promotion switch, the packed sharded ghost mode, and the bench plan entry
+were all removed with this demotion.
+
 The round-2 roofline analysis (BASELINE.md) pinned the u8 streaming kernels
 at ~92 GB/s effective against the v5e's 819 GB/s datasheet peak, invariant
 under block geometry and VPU work — consistent with an *element-rate* cap
@@ -743,3 +770,46 @@ def run_group_packed_words(
     )(*args)
     outs = outs if isinstance(outs, (tuple, list)) else [outs]
     return [o[:height] for o in outs]
+
+
+def pipeline_packed(ops, img, *, interpret=None, block_h=None):
+    """Archival pipeline runner for the demoted packed backend: the word-
+    carrying group loop that used to live inside pipeline_pallas
+    (packed=True), preserved so tests/test_packed.py and the A/B tools can
+    still drive the kernels end-to-end. Groups `packed_supported` rejects
+    fall back to the u8 streaming path, exactly as production did."""
+    from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
+        group_ops,
+        run_group,
+    )
+
+    if img.ndim == 3:
+        planes = [img[..., c] for c in range(img.shape[2])]
+    else:
+        planes = [img]
+    words = None  # non-None: planes currently live as packed i32 words
+    height = width = None
+    for pointwise, stencil in group_ops(ops):
+        if words is None:
+            height, width = planes[0].shape
+        if packed_supported(pointwise, stencil, width):
+            # consecutive eligible groups stay in word form (the u8<->u32
+            # view is a real copy on TPU — different tilings)
+            if words is None:
+                words = [pack_words(p) for p in planes]
+            words = run_group_packed_words(
+                pointwise, stencil, words, height, width,
+                interpret=interpret, block_h=block_h,
+            )
+            continue
+        if words is not None:
+            planes = [unpack_words(w, width) for w in words]
+            words = None
+        planes = run_group(
+            pointwise, stencil, planes, interpret=interpret, block_h=block_h
+        )
+    if words is not None:
+        planes = [unpack_words(w, width) for w in words]
+    if len(planes) == 1:
+        return planes[0]
+    return jnp.stack(planes, axis=-1)

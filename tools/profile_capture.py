@@ -3,7 +3,7 @@
 
 VERDICT r2 missing #4: the roofline argument (BASELINE.md) rests on modeled
 HBM traffic; a DMA-wait vs compute breakdown from a real trace corroborates
-or kills it independently of the packed-u32 A/B. This script:
+or kills it independently of the wide-word A/B. This script:
 
   1. compiles the headline pipeline (8K 5x5 Gaussian, Pallas),
   2. records `jax.profiler.trace(..., create_perfetto_trace=True)` around
@@ -122,13 +122,13 @@ def main() -> int:
         f"# Headline-kernel profiler trace summary ({out_dir})",
         "",
         f"8K 5x5 Gaussian, 30 iterations each on `{backend}` — u8 streaming "
-        "(production headline) AND the packed-u32 variant, so the trace "
-        "attributes where the packed path's time goes (DMA wait vs the "
-        "in-kernel unpack/lane-shift compute), not just the u8 baseline's.",
+        "(production headline) AND the SWAR quarter-strip variant, so the "
+        "trace attributes where the wide path's time goes (DMA wait vs the "
+        "in-kernel field compute), not just the u8 baseline's.",
     ]
-    # the packed variant's failure must not cost the window the u8 trace:
+    # one variant's failure must not cost the window the u8 trace:
     # trace variants independently, summarize whatever succeeded
-    for variant in ("pallas", "packed"):
+    for variant in ("pallas", "swar"):
         vdir = out_dir if variant == "pallas" else f"{out_dir}_{variant}"
         try:
             fn = pipe.jit(backend=variant)
@@ -176,7 +176,7 @@ def main() -> int:
         with open(summary_md, "w") as f:
             f.write("\n".join(lines) + "\n")
         print(f"wrote {summary_md} / {summary_json} ({variant})", flush=True)
-    # the u8 headline trace is the round's required artifact; packed is
+    # the u8 headline trace is the round's required artifact; swar is
     # best-effort diagnosis
     return 0 if "error" not in combined["pallas"] else 1
 

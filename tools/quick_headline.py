@@ -39,7 +39,7 @@ def main() -> int:
     )
     ap.add_argument(
         "--impls",
-        default="pallas,packed",
+        default="pallas",
         help="comma-separated impls, measured in order (first = the one "
         "worth having if the window dies mid-step)",
     )
@@ -72,7 +72,7 @@ def main() -> int:
     # when a later impl wedges), so a window only long enough for one
     # compile still leaves a committed same-round TPU record.
     impls = [s.strip() for s in args.impls.split(",") if s.strip()]
-    bad = [s for s in impls if s not in ("xla", "pallas", "packed", "swar", "auto")]
+    bad = [s for s in impls if s not in ("xla", "pallas", "swar", "auto")]
     if bad or not impls:
         print(f"unknown impls {bad or args.impls!r}", file=sys.stderr)
         return 2

@@ -184,15 +184,12 @@ def main() -> int:
             f, [img_u8],
         )
 
-    # e) the XLA-level u8<->u32 bitcast views the packed production path
-    # uses at group boundaries (ops/packed_kernels.pack_words): on TPU the
-    # tilings differ ((32,128) u8 vs (8,128) u32), so this may compile to
-    # a real copy — its cost decides whether packed pipelines should keep
-    # words end-to-end between groups
-    from mpi_cuda_imagemanipulation_tpu.ops.packed_kernels import (
-        pack_words,
-        unpack_words,
-    )
+    # e) the XLA-level u8<->u32 bitcast views the (now-demoted) packed
+    # path used at group boundaries (tools/packed_kernels.pack_words): on
+    # TPU the tilings differ ((32,128) u8 vs (8,128) u32), so this may
+    # compile to a real copy — its cost decides whether wide-word
+    # pipelines should keep words end-to-end between groups
+    from tools.packed_kernels import pack_words, unpack_words
 
     for name, f, arg in (
         ("xla_pack_bitcast", jax.jit(pack_words), img_u8),
@@ -254,11 +251,14 @@ def main() -> int:
                 jax.jit(make(bh)), [arg],
             )
 
-    # g) the headline kernel in the same process/chip state, u8 and packed
+    # g) the headline kernel in the same process/chip state, u8 and the
+    # archived packed variant (tools/packed_kernels.pipeline_packed)
+    from tools.packed_kernels import pipeline_packed
+
     ops = make_pipeline_ops("gaussian:5")
-    for name, packed in (("gaussian5_8k_pallas", False),
-                         ("gaussian5_8k_packed", True)):
-        f = jax.jit(lambda x, p=packed: pipeline_pallas(ops, x, packed=p))
+    for name, runner in (("gaussian5_8k_pallas", pipeline_pallas),
+                         ("gaussian5_8k_packed", pipeline_packed)):
+        f = jax.jit(lambda x, r=runner: r(ops, x))
         register(
             {"case": name, "_nbytes": 2 * H * W, "_mp": H * W},
             f, [img_u8],

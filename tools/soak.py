@@ -195,12 +195,12 @@ def run_trial(
     if not np.array_equal(got, golden):
         return bh_repro("pallas", "mismatch")
 
-    if rng.random() < 0.5:  # packed-u32 path (eligible groups + fallbacks)
+    if rng.random() < 0.5:  # archived packed path (tools/packed_kernels)
+        from tools.packed_kernels import pipeline_packed
+
         try:
             got = np.asarray(
-                pipeline_pallas(
-                    pipe.ops, img, interpret=True, packed=True, block_h=bh
-                )
+                pipeline_packed(pipe.ops, img, interpret=True, block_h=bh)
             )
         except Exception as e:  # noqa: BLE001
             return bh_repro("packed", f"raised {type(e).__name__}: {e}")
@@ -325,7 +325,7 @@ def run_trial(
     n_dev = len(jax.devices())
     if n_dev >= 2:
         shards = rng.choice([s for s in (2, 3, 5, n_dev) if s <= n_dev])
-        backend = rng.choice(("xla", "pallas", "packed", "auto", "swar"))
+        backend = rng.choice(("xla", "pallas", "auto", "swar"))
         # small images reject large shard counts (documented min-rows-per-
         # shard guard); fall back toward 2 shards so pathological shapes
         # still get sharded coverage, and *count* trials that lose it so
@@ -357,6 +357,7 @@ def run_trial(
 def run_repro(line: str) -> int:
     """Re-run one REPRO json line deterministically: same spec, shape and
     image seed, every backend (all shard counts), verbose verdicts."""
+    from tools.packed_kernels import pipeline_packed as _pipeline_packed
     d = json.loads(line)
     spec, h, w, seed = d["spec"], d["h"], d["w"], d["seed"]
     img = jnp.asarray(synthetic_image(h, w, channels=3, seed=seed))
@@ -398,8 +399,8 @@ def run_repro(line: str) -> int:
         )
         check(
             f"packed{tag}",
-            lambda bh=bh: pipeline_pallas(
-                pipe.ops, img, interpret=True, packed=True, block_h=bh
+            lambda bh=bh: _pipeline_packed(
+                pipe.ops, img, interpret=True, block_h=bh
             ),
         )
     # same batch construction as run_trial (k distinct images seeded
@@ -418,7 +419,7 @@ def run_repro(line: str) -> int:
             )
     n_dev = len(jax.devices())
     for shards in sorted({s for s in (2, 3, 5, n_dev) if s <= n_dev}):
-        for b in ("xla", "pallas", "packed", "auto"):
+        for b in ("xla", "pallas", "auto", "swar"):
             check(
                 f"sharded-{shards}-{b}",
                 lambda shards=shards, b=b: pipe.sharded(

@@ -5,7 +5,7 @@ The round-3 first window established (BASELINE.md): u8 streams are
 element-rate-capped (~95 Ge/s measured vs ~400 GB/s f32 byte rate), the u8
 production kernel already sits at ~94% of that ceiling, and the existing
 packed-u32 path is 3.2x SLOWER — because it unpacks every word into 4 f32
-lane planes (ops/packed_kernels._lanes_f32), paying the same VPU element
+lane planes (tools/packed_kernels._lanes_f32, demoted round 5), paying the same VPU element
 count as the u8 path plus shift/mask and lane-rotation overhead.
 
 This prototype tests the design that actually exploits the element-rate

@@ -1,8 +1,9 @@
 #!/bin/bash
 # Hardware validation sweep (VERDICT r3 priority #4): registry-wide
-# compiled-Mosaic correctness incl. packed production kernels, mesh(1) +
-# 2-D(1x1) sharded, guarded-mode and compiled-SWAR cases — the silicon
-# correctness record for 744 LoC of packed kernels.
+# compiled-Mosaic correctness incl. the archived packed kernels (known
+# narrow-plane miscompares recorded as xfail — see tools/packed_kernels
+# docstring), mesh(1) + 2-D(1x1) sharded, guarded-mode and compiled-SWAR
+# cases.
 # Wall-time budget: ~15-25 min warm (dominated by per-case compiles the
 # cache has never seen; re-tries after a wedge resume from the cache and
 # drop to ~5 min). Longest step — deliberately behind the decisive bundle.

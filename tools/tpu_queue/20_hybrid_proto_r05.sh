@@ -1,0 +1,18 @@
+#!/bin/bash
+# Hybrid SWAR end-to-end candidates (BASELINE.md round-5 "where the next
+# perf win actually is"): pack -> field compute -> unpack as ONE jitted
+# XLA program (and an XLA-pack + Pallas-compute variant), measured against
+# the production u8 kernel in the same process. The window that closed the
+# SWAR-vs-u8 production decision saw hybrid_xla_nounpack at 0.422 ms vs
+# pallas 0.604 ms same-process; this step captures the complete, committed
+# comparison (incl. the full e2e case the first look lost to an output
+# truncation). Budget: ~3-5 min warm (compute executables cached), ~8 cold.
+set -u
+cd "$(dirname "$0")/../.."
+. tools/tpu_queue/_lib.sh
+timeout 1200 python tools/hybrid_proto.py \
+  > artifacts/hybrid_proto_r05.out 2>&1
+rc=$?
+commit_artifacts "TPU window: hybrid pack/compute/unpack split-design measurements" \
+  artifacts/hybrid_proto_r05.out
+exit $rc

@@ -1,7 +1,7 @@
 #!/bin/bash
-# Profiler trace, u8 AND packed variants (VERDICT r3 priority #5; round-2
+# Profiler trace, u8 AND swar variants (VERDICT r3 priority #5; round-2
 # directive #4): the DMA-wait vs compute vs overhead breakdown that
-# attributes the packed slowdown independently of more A/Bs.
+# attributes the swar slowdown independently of more A/Bs.
 # Wall-time budget: ~4-6 min warm (kernels cached after 05_/10_; tracing
 # adds seconds). profile_capture.py writes summaries after every variant,
 # so a later wedge cannot strand a completed trace.

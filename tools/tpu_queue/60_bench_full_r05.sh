@@ -2,7 +2,7 @@
 # Full bench.py campaign: the exact program the driver runs at round end,
 # executed mid-round so BENCH_HISTORY holds a complete same-round suite
 # table even if the round-end window is wedged.
-# Wall-time budget: ~6-10 min warm (headline pallas/packed/xla + sharded;
+# Wall-time budget: ~6-10 min warm (headline pallas/swar/xla + sharded;
 # all cached after 05_/10_/16_).
 set -u
 cd "$(dirname "$0")/../.."

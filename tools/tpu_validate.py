@@ -87,10 +87,11 @@ GUARDED_CASES = [
     ("gaussian:5", 1, "pallas"),
 ]
 
-# packed-u32 streaming kernels (ops/packed_kernels.py): CI runs them only
-# in interpret mode, so the compiled-Mosaic existence proof comes from
-# here. Shapes with W % 4 != 0 exercise the per-group u8 fallback under
-# the packed flag.
+# packed-u32 streaming kernels (tools/packed_kernels.py — DEMOTED round 5
+# after this sweep found compiled-mode miscompares on planes narrower than
+# one 128-lane tile, validate_r05.out): kept in the sweep as the archived
+# module's compiled regression record. Shapes with W % 4 != 0 exercise the
+# per-group u8 fallback under the packed flag.
 PACKED_SPECS = [
     ("gaussian:5", 1),
     ("gaussian:7", 1),
@@ -163,15 +164,32 @@ def run_sweep(shapes, results) -> int:
                 lambda: golden_of(ops, img), lambda: pipeline_pallas(ops, img),
             )
 
+    from tools.packed_kernels import pipeline_packed
+
     for spec, ch in PACKED_SPECS:
         ops = make_pipeline_ops(spec)
         for hw in shapes:
             img = jnp.asarray(synthetic_image(*hw, channels=ch, seed=4))
-            fails += not _check(
+            ok = _check(
                 results, "packed", spec, ch, hw,
                 lambda: golden_of(ops, img),
-                lambda: pipeline_pallas(ops, img, packed=True),
+                lambda: pipeline_packed(ops, img),
             )
+            if (
+                not ok
+                and hw[1] // 4 < 128
+                and results[-1].get("detail", "").startswith("maxdiff")
+            ):
+                # KNOWN compiled-mode miscompare on planes narrower than
+                # one 128-lane tile (validate_r05.out; the finding that
+                # demoted the backend) — recorded in the artifact as the
+                # archived module's known defect, not counted as a sweep
+                # failure, so the gate stays meaningful for everything
+                # still in production. Only the miscompare signature is
+                # excused: a compile crash on these shapes still counts.
+                results[-1]["status"] = "xfail-lane-tile"
+                continue
+            fails += not ok
 
     for spec, ch, bh in BLOCK_CASES:
         ops = make_pipeline_ops(spec)
