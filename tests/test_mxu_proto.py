@@ -1,0 +1,71 @@
+"""MXU banded-matmul math under pytest (tools/mxu_proto.py).
+
+The prototype runs its own bit-exactness gates before timing on-chip; this
+mirrors them in the suite so a registry/spec change that breaks the MXU
+identities (bf16 exactness of u8 values x binomial taps, f32 accumulation
+bounds, the 64a+b bf16 split of the 12-bit row sums, the banded-block
+geometry incl. ragged widths/heights) is caught on every test run, not
+only when the tool next reaches silicon.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from mpi_cuda_imagemanipulation_tpu.io.image import synthetic_image
+from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline
+
+_TOOLS = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"
+)
+
+
+@pytest.fixture(scope="module")
+def make_gaussian5():
+    spec = importlib.util.spec_from_file_location(
+        "mxu_proto", os.path.join(_TOOLS, "mxu_proto.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.build_fns()
+
+
+def _golden(img):
+    return np.asarray(Pipeline.parse("gaussian:5")(img))
+
+
+@pytest.mark.parametrize("variant", ["f32", "bf16split"])
+@pytest.mark.parametrize(
+    "hw_seed",
+    [
+        (48, 64, 1),  # both axes below one block
+        (37, 200, 2),  # ragged width, ragged height
+        (130, 384, 3),  # width a block multiple, height ragged
+        (128, 128, 4),  # exactly one block each axis
+    ],
+)
+def test_mxu_gaussian5_bit_exact(make_gaussian5, variant, hw_seed):
+    h, w, seed = hw_seed
+    img = jnp.asarray(synthetic_image(h, w, channels=1, seed=seed))
+    got = np.asarray(jax.jit(make_gaussian5(variant))(img))
+    assert np.array_equal(got, _golden(img))
+
+
+def test_bf16_split_exact_for_all_row_sums():
+    """Every reachable row-pass sum (0..4080) splits into 64a+b with both
+    halves bf16-exact, so the split column pass is exact by linearity."""
+    s = np.arange(0, 4081, dtype=np.float32)
+    a = np.floor(s / 64.0)
+    b = s - a * 64.0
+    # bf16 round-trips integers up to 256 exactly (8-bit significand)
+    assert a.max() <= 63 and b.max() <= 63
+    a16 = jnp.asarray(a, jnp.bfloat16).astype(jnp.float32)
+    b16 = jnp.asarray(b, jnp.bfloat16).astype(jnp.float32)
+    assert np.array_equal(np.asarray(a16) * 64.0 + np.asarray(b16), s)

@@ -206,3 +206,40 @@ def test_run_group_packed_direct_multichannel():
         planes = run_group_packed(pw, st, planes, interpret=True)
     got = np.asarray(jnp.stack(planes, -1))
     np.testing.assert_array_equal(got, golden)
+
+
+@pytest.mark.parametrize("spec", ["gaussian:5", "sobel"])
+def test_run_group_packed_ghost_mode_two_tile_stitch(spec):
+    """Direct coverage for the archived ghost-mode branches (the sharded
+    runner no longer calls them after the demotion): split an image into
+    two row tiles, hand each its real neighbour strips as ghosts, and the
+    stitched output must equal the golden whole-image result."""
+    h, w = 96, 256
+    img = jnp.asarray(synthetic_image(h, w, channels=1, seed=77))
+    pipe = Pipeline.parse(spec)
+    golden = np.asarray(pipe(img))
+    pw, st = group_ops(pipe.ops)[0]
+    halo = st.halo
+    half = h // 2
+    tiles = [img[:half], img[half:]]
+    # neighbour strips come from the adjacent tile; global edges replay
+    # the op's own edge extension (reflect101), exactly as the sharded
+    # runner's edge synthesis does
+    ref = np.asarray(img)
+    top0 = ref[1 : 1 + halo][::-1]  # reflect101 above row 0
+    bot1 = ref[h - 1 - halo : h - 1][::-1]  # reflect101 below row h-1
+    ghosts = [
+        (jnp.asarray(top0), img[half : half + halo]),
+        (img[half - halo : half], jnp.asarray(bot1)),
+    ]
+    outs = []
+    for k, (tile, (top, bot)) in enumerate(zip(tiles, ghosts)):
+        out = run_group_packed(
+            pw, st, [tile],
+            ghosts=([top], [bot]),
+            y0=jnp.int32(k * half),
+            image_h=h,
+            interpret=True,
+        )[0]
+        outs.append(np.asarray(out))
+    np.testing.assert_array_equal(np.concatenate(outs, axis=0), golden)

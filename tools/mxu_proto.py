@@ -1,0 +1,246 @@
+#!/usr/bin/env python
+"""MXU banded-matmul prototype for the headline 5x5 Gaussian.
+
+Round-5 roofline data (artifacts/roofline_rr_r05.out) killed the
+element-rate-ceiling theory: Pallas u8 copy kernels sustain ~550 GB/s, so
+the production u8 compute kernel (~91 GB/s effective, 45.9k MP/s) is
+VPU-COMPUTE-bound — the separable 5x5 costs 10 u16 multiply-adds per
+pixel on the VPU (~460 G MAC/s sustained). The v5e's idle resource is the
+MXU (~197 TFLOP/s bf16): this prototype reformulates each separable pass
+as a blocked-banded matmul so the taps contract on the MXU instead.
+
+Formulation (row pass; column pass is the mirror):
+
+    out[h, B*j + n] = sum_k in_pad[h, B*j + n + k] * t[k],  k in [0, 5)
+
+With block width B=128, gather In_ext[j] = in_pad[:, B*j : B*j + B+4]
+(static slices) and build the banded tap matrix C[i, n] = t[i - n + 2]
+(shape (B+4, B)); then out_block_j = In_ext[j] @ C — an einsum
+'bhk,kn->bhn' with M=H, K=B+4, N=B=128: real MXU shapes. FLOPs are
+(B+4)/5 ~ 26x the arithmetic minimum, but the MXU has ~430x the VPU's
+MAC rate, so the roofline still clears the VPU path by an order of
+magnitude if utilisation holds.
+
+Exactness (the non-negotiable): u8 values (<= 255) and binomial taps
+(<= 6) are exactly representable in bf16, and jnp.einsum with
+preferred_element_type=f32 accumulates exactly (every partial product is
+an integer <= 255*6 < 2^11, every row sum <= 4080 < 2^24). The COLUMN
+pass input is the row-pass sums (<= 4080, 12 bits — NOT bf16-exact), so
+two variants:
+
+  mxu_f32col    — column einsum in f32 (exact directly; MXU f32 rate is
+                  lower but K=132 is tiny)
+  mxu_bf16split — tmp = 64*a + b with a, b in [0, 63] (both bf16-exact);
+                  colsum(tmp) = 64*colsum(a) + colsum(b): two bf16
+                  matmuls, recombined in f32. Integer-exact by linearity.
+
+The final quantize replays the golden op on the exact integer sums:
+s / 256 is exact in f32 (s <= 65280, power-of-two divisor), jnp.rint is
+round-half-to-even — identical to the golden rint_clip quantizer.
+Both variants are asserted bit-exact against the golden StencilOp on
+three shapes before anything is timed (the same gate discipline as
+tools/swar_proto.py / tools/hybrid_proto.py).
+
+Usage: python tools/mxu_proto.py [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+TAPS = (1, 4, 6, 4, 1)  # binomial_1d(5), scale 1/256 (ops/filters.py)
+H_ = 2  # halo
+B = 128  # block width (one MXU/lane tile)
+
+
+def build_fns():
+    import jax.numpy as jnp
+    import numpy as np
+
+    n_taps = len(TAPS)
+    # banded tap matrix: C[i, n] = t[i - n + H_] for the valid band
+    C = np.zeros((B + 2 * H_, B), np.float32)
+    for n in range(B):
+        for k in range(n_taps):
+            C[n + k, n] = TAPS[k]
+    C_bf16 = jnp.asarray(C, jnp.bfloat16)
+    C_f32 = jnp.asarray(C, jnp.float32)
+
+    def _band_blocks(xp, axis):
+        """Static sliding blocks of width B+2h along `axis` with stride B:
+        (nb, ..., B+2h) stacked on a new leading axis. `xp` must already
+        carry the 2h halo at both ends of `axis`."""
+        n = (xp.shape[axis] - 2 * H_) // B
+        slices = []
+        for j in range(n):
+            idx = [slice(None)] * xp.ndim
+            idx[axis] = slice(j * B, j * B + B + 2 * H_)
+            slices.append(xp[tuple(idx)])
+        return jnp.stack(slices, axis=0)
+
+    def row_pass(xpad_core):
+        """(H, Wp+2h) bf16 (reflect-padded width) -> (H, Wb) f32 row sums
+        (Wb = padded-to-block width; cols past the real width are garbage
+        the caller crops)."""
+        ext = _band_blocks(xpad_core, axis=1)  # (nb, H, B+2h) bf16
+        out = jnp.einsum(
+            "jhk,kn->hjn", ext, C_bf16,
+            preferred_element_type=jnp.float32,
+        )
+        return out.reshape(out.shape[0], -1)  # (H, nb*B)
+
+    def col_pass_f32(tmp_pad):
+        """(Hp+2h, W) f32 row sums (reflect-padded height, block-padded)
+        -> (Hb, W) f32 column sums via an f32 MXU einsum."""
+        ext = _band_blocks(tmp_pad, axis=0)  # (nb, B+2h, W) f32
+        out = jnp.einsum(
+            "jkw,km->jmw", ext, C_f32,
+            preferred_element_type=jnp.float32,
+        )
+        return out.reshape(-1, out.shape[-1])  # (nb*B, W)
+
+    def col_pass_bf16split(tmp_pad):
+        """Same contraction with bf16 inputs: tmp = 64*a + b, a,b <= 63
+        exactly representable in bf16; exact by linearity."""
+        a = jnp.floor(tmp_pad * (1.0 / 64.0))
+        b = tmp_pad - a * 64.0
+        ea = _band_blocks(a.astype(jnp.bfloat16), axis=0)
+        eb = _band_blocks(b.astype(jnp.bfloat16), axis=0)
+        oa = jnp.einsum("jkw,km->jmw", ea, C_bf16,
+                        preferred_element_type=jnp.float32)
+        ob = jnp.einsum("jkw,km->jmw", eb, C_bf16,
+                        preferred_element_type=jnp.float32)
+        out = oa * 64.0 + ob
+        return out.reshape(-1, out.shape[-1])
+
+    def make_gaussian5(col_variant):
+        col = {"f32": col_pass_f32, "bf16split": col_pass_bf16split}[
+            col_variant
+        ]
+
+        def f(img):
+            Hh, Ww = img.shape
+            xpad = jnp.pad(img, H_, mode="reflect")  # reflect101 == np pad
+            # width: keep the halo, block-pad the core region
+            core = xpad.astype(jnp.bfloat16)
+            wpad = (-Ww) % B
+            if wpad:
+                core = jnp.pad(core, ((0, 0), (0, wpad)))
+            tmp = row_pass(core)  # (H+2h, Wb) f32, halo rows intact
+            hpad = (-Hh) % B
+            if hpad:
+                tmp = jnp.pad(tmp, ((0, hpad), (0, 0)))
+            s = col(tmp)[:Hh, :Ww]  # exact integer column sums
+            q = jnp.rint(s * (1.0 / 256.0))  # round-half-even, exact
+            return jnp.clip(q, 0.0, 255.0).astype(jnp.uint8)
+
+        return f
+
+    return make_gaussian5
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--height", type=int, default=4320)
+    ap.add_argument("--width", type=int, default=7680)
+    args = ap.parse_args()
+    saved_calib = os.environ.get("MCIM_NO_CALIB")
+    os.environ["MCIM_NO_CALIB"] = "1"
+    try:
+        return _main(args)
+    finally:
+        if saved_calib is None:
+            os.environ.pop("MCIM_NO_CALIB", None)
+        else:
+            os.environ["MCIM_NO_CALIB"] = saved_calib
+
+
+def _main(args) -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mpi_cuda_imagemanipulation_tpu.io.image import synthetic_image
+    from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline
+    from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
+        pipeline_pallas,
+    )
+    from mpi_cuda_imagemanipulation_tpu.ops.registry import make_pipeline_ops
+    from mpi_cuda_imagemanipulation_tpu.utils.platform import is_tpu_backend
+    from mpi_cuda_imagemanipulation_tpu.utils.timing import device_throughput
+
+    make_gaussian5 = build_fns()
+
+    print(f"backend: {jax.default_backend()}", flush=True)
+
+    def emit(rec):
+        print(json.dumps(rec), flush=True)
+
+    # ---- bit-exactness gate BEFORE any timing ----
+    pipe = Pipeline.parse("gaussian:5")
+    for variant in ("f32", "bf16split"):
+        fn = jax.jit(make_gaussian5(variant))
+        for th, tw, seed in ((48, 64, 1), (37, 200, 2), (130, 384, 3)):
+            img = jnp.asarray(synthetic_image(th, tw, channels=1, seed=seed))
+            golden = np.asarray(pipe(img))
+            got = np.asarray(fn(img))
+            if not np.array_equal(got, golden):
+                d = np.argwhere(got != golden)
+                print(
+                    f"MXU {variant} MISMATCH at {th}x{tw}: {len(d)} px, "
+                    f"first {d[0]} got {got[tuple(d[0])]} "
+                    f"want {golden[tuple(d[0])]}",
+                    file=sys.stderr,
+                )
+                return 1
+    print("bit-exactness gate: MXU f32 + bf16split == golden on 3 shapes",
+          flush=True)
+
+    if not is_tpu_backend():
+        print("self-test passed; timing needs the chip — exiting", flush=True)
+        return 0
+
+    # ---- timing ----
+    H, W = args.height, args.width
+    img = jnp.asarray(synthetic_image(H, W, channels=1, seed=99))
+    mp = H * W / 1e6
+
+    cases = [
+        ("mxu_f32col", jax.jit(make_gaussian5("f32")), [img]),
+        ("mxu_bf16split", jax.jit(make_gaussian5("bf16split")), [img]),
+        (
+            "gaussian5_8k_pallas",
+            jax.jit(
+                lambda x: pipeline_pallas(make_pipeline_ops("gaussian:5"), x)
+            ),
+            [img],
+        ),
+    ]
+    rounds = 1 if args.quick else 3
+    best: dict = {}
+    for rnd in range(1, rounds + 1):
+        for name, fn, fa in cases:
+            try:
+                sec = device_throughput(fn, fa)
+            except Exception as e:
+                emit({"case": name, "round": rnd, "error": str(e)[:200]})
+                continue
+            rec = {"case": name, "round": rnd, "ms": sec * 1e3,
+                   "mp_s": mp / sec}
+            emit(rec)
+            if name not in best or sec < best[name][0]:
+                best[name] = (sec, rec)
+    for name, (sec, rec) in best.items():
+        emit({**{k: v for k, v in rec.items() if k != "round"},
+              "stat": f"best_of_{rounds}"})
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,17 @@
+#!/bin/bash
+# MXU banded-matmul prototype (BASELINE.md round 5): the roofline RR probe
+# proved the u8 headline kernel is VPU-compute-bound (91 GB/s effective vs
+# ~550 GB/s streaming), so the idle MXU is the remaining order-of-magnitude
+# resource. tools/mxu_proto.py times the blocked-banded bf16/f32 einsum
+# formulation of the 8K gaussian:5 (both column-pass variants) against the
+# production u8 kernel, same process — bit-exactness gated before timing.
+# Budget: ~3-5 min warm, ~8-10 min cold (two fresh 8K einsum compiles).
+set -u
+cd "$(dirname "$0")/../.."
+. tools/tpu_queue/_lib.sh
+timeout 1200 python tools/mxu_proto.py \
+  > artifacts/mxu_proto_r05.out 2>&1
+rc=$?
+commit_artifacts "TPU window: MXU banded-matmul gaussian prototype measurements" \
+  artifacts/mxu_proto_r05.out
+exit $rc
