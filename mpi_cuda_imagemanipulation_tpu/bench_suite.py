@@ -256,16 +256,21 @@ def headline_record(records: list[dict]) -> dict | None:
         "chips": best["chips"],
         "platform": best.get("platform"),
     }
-    # measured-ceiling fraction leads (VERDICT r4 #7): it rests on the
-    # roofline probe's measured element-rate ceiling for this chip
-    # generation, while vs_baseline divides by a first-principles ESTIMATE
-    # of the reference's hardware (BASELINE.md) — lead with the number
-    # that doesn't require trusting the estimate
+    # measured-ceiling fraction leads (VERDICT r4 #7): it rests on a
+    # measured same-chip reference rate, while vs_baseline divides by a
+    # first-principles ESTIMATE of the reference's hardware (BASELINE.md)
+    # — lead with the number that doesn't require trusting the estimate.
+    # Round-5 re-basing: the roofline RR probe measured u8 COPY kernels at
+    # ~550 GB/s, so this is NOT a hardware element-rate wall — it is the
+    # best observed u8 compute-kernel-class rate (the kernels are
+    # VPU-compute-bound; BASELINE.md round-5 section), kept as the
+    # same-class measured reference point
     if "elem_ceiling_frac" in best:
         rec["ceiling_frac"] = round(best["elem_ceiling_frac"], 4)
         rec["ceiling_basis"] = (
-            "measured u8 element-rate ceiling (roofline probe; "
-            "bench_suite.ELEM_G_S_MEASURED)"
+            "measured u8 compute-kernel element rate (roofline probe; "
+            "bench_suite.ELEM_G_S_MEASURED — a kernel-class reference, "
+            "not a hardware wall: u8 copy measures ~550 GB/s)"
         )
     rec["vs_baseline"] = round(
         best["mp_per_s_per_chip"] / REFERENCE_BASELINE_MP_S_PER_CHIP, 2

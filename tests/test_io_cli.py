@@ -243,6 +243,32 @@ def test_bench_same_round_tpu_headline(tmp_path):
     )
 
 
+def test_bench_spread_filters_to_headline_impl(tmp_path):
+    """The same-round spread must not mix deliberately-slower A/B impls
+    into the promoted headline's variance stats (round 5: xla at 11.4k
+    committed beside pallas at 45k would fake a 4x 'variance'). Entries
+    without an impl field still count (pre-stamping history)."""
+    mod = _load_bench_module()
+    hist = tmp_path / "hist.jsonl"
+    marker = tmp_path / "ROUND_START"
+    marker.write_text("2026-08-01T00:00:00Z\n")
+    entries = [
+        {"ts": "2026-08-01T08:30:00Z",
+         "headline": {"platform": "tpu", "value": 44000.0, "impl": "pallas"}},
+        {"ts": "2026-08-01T08:31:00Z",
+         "headline": {"platform": "tpu", "value": 46000.0, "impl": "pallas"}},
+        {"ts": "2026-08-01T08:39:00Z",
+         "headline": {"platform": "tpu", "value": 11400.0, "impl": "xla"}},
+        {"ts": "2026-08-01T08:29:00Z",
+         "headline": {"platform": "tpu", "value": 45000.0}},  # pre-stamping
+    ]
+    hist.write_text("\n".join(json.dumps(e) for e in entries) + "\n")
+    got = mod._same_round_tpu_spread(str(hist), str(marker), impl="pallas")
+    assert got["n"] == 3 and got["min"] == 44000.0 and got["best"] == 46000.0
+    # without the filter all four sightings count (the old behavior)
+    assert mod._same_round_tpu_spread(str(hist), str(marker))["n"] == 4
+
+
 def test_bench_best_of_run_and_committed(tmp_path):
     """A healthy-but-cold round-end run must not bury a warmer committed
     same-round TPU record (window-noise guard): the better value wins, with
