@@ -38,16 +38,17 @@ HEADLINE = "gaussian5_8k"
 # the image per fused group; ops/pallas_kernels.py module comment).
 HBM_GB_S = {"v4": 1228.0, "v5e": 819.0, "v5p": 2765.0, "v6e": 1640.0}
 
-# Measured element-rate ceiling, giga-elements/s — the *achievable* roofline
-# denominator for u8 streaming on this chip, alongside the datasheet byte
-# roofline above. Round-3 probe (roofline_r03.out, real v5e): an f32 Pallas
-# streaming copy sustains 402.7 GB/s = 100.7 Ge/s while the u8 copy of the
-# same pixels caps at ~75 GB/s = 75 Ge/s in the same window — byte rate is
-# not the binding limit for u8 streams, element (load/store lane) rate is.
-# The headline u8 kernel itself sustained 94.9 Ge/s in round 1's healthy
-# window, i.e. ~95% of this ceiling. Only v5e has been measured; other gens
-# get no elem_ceiling_frac until a probe runs there (single-generation
-# calibration caveat, docs/measurement.md).
+# Measured u8 compute-kernel-class element rate, giga-elements/s — a
+# same-chip reference denominator alongside the datasheet byte roofline
+# above. History: the round-3 probe read it as a hardware element-rate
+# ceiling; the round-5 round-robin probe FALSIFIED that (u8 copy kernels
+# sustain ~550 GB/s — artifacts/roofline_rr_r05.out), so this figure is
+# the best observed rate of the u8 compute-kernel class (the kernels are
+# VPU-compute-bound, not load/store-capped; BASELINE.md round-5 section).
+# Kept as the kernel-class reference point for elem_ceiling_frac. Only
+# v5e has been measured; other gens get no elem_ceiling_frac until a
+# probe runs there (single-generation calibration caveat,
+# docs/measurement.md).
 ELEM_G_S_MEASURED = {"v5e": 100.7}
 
 
@@ -169,7 +170,7 @@ def run_config(cfg: BenchConfig, impl: str) -> dict:
         rec["roofline_frac"] = gb_s / HBM_GB_S.get(gen, HBM_GB_S["v5e"])
         # the traffic model counts u8 planes, so modeled bytes == modeled
         # elements and gb_s doubles as giga-elements/s against the measured
-        # element-rate ceiling — but only for impls that stream u8
+        # kernel-class element rate — but only for impls that stream u8
         # elements; the swar impl (and auto under MCIM_PREFER_SWAR) moves
         # the same bytes as u32 words (1/2 the elements), so the
         # equivalence breaks there and the field is omitted rather than

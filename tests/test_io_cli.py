@@ -571,9 +571,9 @@ def test_bench_orchestrator_mirrors_suite_constants():
 
 
 def test_headline_record_carries_elem_ceiling_frac():
-    """TPU records gain the measured element-rate roofline (round-3 probe:
-    u8 streams are element-rate-capped, not byte-rate-capped), and the
-    headline promotion preserves it."""
+    """TPU records gain the measured kernel-class element-rate fraction
+    (round-3 probe, re-based round 5: a same-class reference point, not a
+    hardware wall), and the headline promotion preserves it."""
     from mpi_cuda_imagemanipulation_tpu import bench_suite
 
     assert "v5e" in bench_suite.ELEM_G_S_MEASURED

@@ -1,15 +1,18 @@
 #!/usr/bin/env python
 """SWAR quarter-strip prototype for the headline 5x5 Gaussian (run on TPU).
 
-The round-3 first window established (BASELINE.md): u8 streams are
-element-rate-capped (~95 Ge/s measured vs ~400 GB/s f32 byte rate), the u8
-production kernel already sits at ~94% of that ceiling, and the existing
-packed-u32 path is 3.2x SLOWER — because it unpacks every word into 4 f32
-lane planes (tools/packed_kernels._lanes_f32, demoted round 5), paying the same VPU element
-count as the u8 path plus shift/mask and lane-rotation overhead.
+HISTORICAL NOTE (round 5): this prototype was designed against the
+round-3 element-rate-cap hypothesis, which the round-5 round-robin probe
+FALSIFIED (u8 copy kernels sustain ~550 GB/s; the compute kernels are
+VPU-bound — BASELINE.md round-5 section). Its measurements remain the
+record of why: the SWAR *compute* is 3.1x faster per element
+(swar_xla_prepacked), the end-to-end production impl is 0.83x (pack and
+unpack boundary costs), and the packed-u32 path is 3-4x slower (f32 lane
+unpack pays the full element count plus overhead;
+tools/packed_kernels._lanes_f32, demoted round 5).
 
-This prototype tests the design that actually exploits the element-rate
-model, with two ingredients the production packed path lacks:
+The original design rationale, with two ingredients the production
+packed path lacked:
 
 1. **Quarter-strip (SoA) packing**: the row is split into 4 equal strips
    and byte k of word j is strip k's pixel j — so a horizontal tap is a
