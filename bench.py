@@ -244,6 +244,11 @@ def main() -> int:
             # the sharded swar ghost path (round 5): a SWAR win must
             # show up sharded too, per-chip parity with unsharded swar
             (HEADLINE + "_sharded", "swar"),
+            # the reference's OWN benchmark pipeline as a first-class
+            # record (round-5 A/B measured auto->XLA at 73.3k MP/s vs
+            # 33.9k Pallas there — the routing win should be on the
+            # committed record, not only in an A/B artifact)
+            ("reference_pipeline_4k", "auto"),
         ]
         for name, impl in plan:
             rec, err = _run_config(name, impl)
@@ -259,7 +264,14 @@ def main() -> int:
             if rec is not None:
                 records.append(rec)
 
-    if not records:
+    # the fallback gate keys on HEADLINE-family records specifically:
+    # _headline() filters to them, so a run where only a non-headline
+    # config (reference_pipeline_4k) survived must still fall back or
+    # main() would hand a None headline to the partial-marking code
+    # (review finding)
+    if not any(
+        r.get("config") in (HEADLINE, HEADLINE + "_sharded") for r in records
+    ):
         # preferred fallback (VERDICT r2 directive #3): a TPU headline this
         # round's watcher already measured and committed beats re-measuring
         # on CPU — the round's artifact of record should be a hardware

@@ -360,6 +360,53 @@ def test_bench_main_promotes_same_round_record(monkeypatch, capsys):
     assert out["measured_ts"] == "2026-07-30T18:00:00Z"
 
 
+def test_bench_main_non_headline_survivor_still_falls_back(
+    monkeypatch, capsys
+):
+    """If only a non-headline plan config (reference_pipeline_4k) survives
+    a TPU run, main() must take the committed-record fallback rather than
+    hand _headline()'s None to the partial-marking code (review finding on
+    the round-5 plan addition)."""
+    mod = _load_bench_module()
+    probes = iter([("tpu", "ok")])
+    monkeypatch.setattr(
+        mod, "_probe_with_backoff", lambda schedule: next(probes, None)
+    )
+    monkeypatch.setattr(mod, "_same_round_tpu_spread", lambda *a, **k: None)
+    monkeypatch.setattr(mod, "git_head_sha", lambda: "testhead")
+
+    def fake_run_config(name, impl, env=None):
+        if name == "reference_pipeline_4k":
+            return (
+                {"config": name, "impl": impl, "platform": "tpu",
+                 "mp_per_s_per_chip": 70000.0},
+                None,
+            )
+        return None, f"{name}/{impl}: wedged"
+
+    monkeypatch.setattr(mod, "_run_config", fake_run_config)
+    monkeypatch.setattr(
+        mod,
+        "_same_round_tpu_headline",
+        lambda: {
+            "ts": "2026-08-01T08:31:00Z",
+            "headline": {
+                "metric": "megapixels/sec/chip on 8K 5x5 Gaussian",
+                "value": 45376.9,
+                "unit": "MP/s/chip",
+                "vs_baseline": 24.5,
+                "impl": "pallas",
+                "platform": "tpu",
+            },
+        },
+    )
+    rc = mod.main()
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0
+    assert out["value"] == 45376.9
+    assert "same-round committed TPU record" in out["platform"]
+
+
 def test_bench_main_promotion_appends_no_history(monkeypatch, capsys):
     """Re-emitting a committed record must not duplicate it in history."""
     mod = _load_bench_module()
