@@ -11,9 +11,9 @@ set -u
 cd "$(dirname "$0")/../.."
 . tools/tpu_queue/_lib.sh
 timeout 3600 python tools/tpu_validate.py --out VALIDATE_r05.json \
-  > artifacts/validate_r05.out 2>&1
+  > artifacts/validate_r05b.out 2>&1
 rc=$?
-arts=(artifacts/validate_r05.out)
+arts=(artifacts/validate_r05b.out)
 [ -f VALIDATE_r05.json ] && arts+=(VALIDATE_r05.json)
 commit_artifacts "TPU window: hardware validation sweep (round 4)" "${arts[@]}"
 exit $rc
