@@ -293,6 +293,12 @@ def main() -> int:
                 "tunnel unresponsive; promoting same-round committed TPU "
                 f"record from {same['ts']}"
             )
+            # the append-only 'every run's records' contract: a surviving
+            # non-headline record (e.g. reference_pipeline_4k) must reach
+            # BENCH_HISTORY.jsonl even though the headline is promoted
+            # from history (ADVICE r5 finding 1)
+            if records:
+                _append_history(out, records)
             print(json.dumps(out))
             return 0
         # last resort: labelled CPU number so the driver gets *a* record
@@ -320,14 +326,20 @@ def main() -> int:
     appended = _append_history(out, records)
     if on_tpu:
         fresh = out.get("value")
+        fresh_impl = out.get("impl")
         out = _best_of_run_and_committed(out, errors)
         # same-round sighting spread; the fresh measurement is one of the n
         # sightings either via the entry just appended or, when the append
-        # was disabled/failed, via the extra argument
+        # was disabled/failed, via the extra argument (carrying its impl so
+        # the spread's impl filter applies to it too)
         spread = _same_round_tpu_spread(
             extra=None
             if appended
-            else (fresh, time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())),
+            else (
+                fresh,
+                time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                fresh_impl,
+            ),
             impl=out.get("impl"),
         )
         if spread:
@@ -501,7 +513,7 @@ def _count_windows(timestamps: list[str], gap_s: float = 900.0) -> int:
 def _same_round_tpu_spread(
     path: str | None = None,
     round_start_path: str | None = None,
-    extra: tuple[float, str] | None = None,
+    extra: tuple[float, str, str | None] | None = None,
     impl: str | None = None,
 ) -> dict | None:
     """Variance summary {n, n_windows, best, median, min} over committed
@@ -516,9 +528,12 @@ def _same_round_tpu_spread(
     variance. Sightings without an impl field still count — old entries
     predate the stamping.
 
-    `extra` is a (value, ts) sighting NOT in the history file — the fresh
-    run when its append was disabled (MCIM_NO_HISTORY) or failed — so the
-    emitted spread can never contradict its own headline."""
+    `extra` is a (value, ts, impl) sighting NOT in the history file — the
+    fresh run when its append was disabled (MCIM_NO_HISTORY) or failed —
+    so the emitted spread can never contradict its own headline. It passes
+    the same impl filter as committed sightings: a fresh run of a
+    deliberately-slower impl must not contaminate a promoted headline's
+    min/median (ADVICE r5 finding 2)."""
     round_start = _read_round_start(round_start_path)
     if not round_start:
         return None
@@ -530,7 +545,11 @@ def _same_round_tpu_spread(
         if ts and ts >= round_start and isinstance(v, (int, float)):
             vals.append(float(v))
             tss.append(ts)
-    if extra is not None and isinstance(extra[0], (int, float)):
+    if (
+        extra is not None
+        and isinstance(extra[0], (int, float))
+        and (impl is None or extra[2] in (None, impl))
+    ):
         vals.append(float(extra[0]))
         tss.append(extra[1])
     if not vals:

@@ -13,14 +13,22 @@ The headline metric (BASELINE.json): megapixels/sec/chip on 8K 5x5 Gaussian.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Callable, Sequence
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 from mpi_cuda_imagemanipulation_tpu.io.image import synthetic_image
 from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline
-from mpi_cuda_imagemanipulation_tpu.parallel.mesh import make_mesh
+from mpi_cuda_imagemanipulation_tpu.ops.spec import StencilOp
+from mpi_cuda_imagemanipulation_tpu.parallel.halo import exchange_halo_strips
+from mpi_cuda_imagemanipulation_tpu.parallel.mesh import (
+    ROWS,
+    make_mesh,
+    shard_map_compat,
+)
 from mpi_cuda_imagemanipulation_tpu.utils.log import emit_json_metrics, get_logger
 from mpi_cuda_imagemanipulation_tpu.utils.platform import is_tpu_backend
 from mpi_cuda_imagemanipulation_tpu.utils.timing import device_throughput
@@ -61,6 +69,7 @@ class BenchConfig:
     channels: int
     sharded: bool = False  # row-shard over every visible device
     batch: int = 0  # >0: vmap-stack this many images per dispatch
+    halo_mode: str = "serial"  # sharded halo execution (parallel.api.HALO_MODES)
 
 
 # BASELINE.json "configs", in order, plus beyond-parity extras.
@@ -74,6 +83,14 @@ CONFIGS: dict[str, BenchConfig] = {
         BenchConfig("gaussian7_8k", "gaussian:7", 4320, 7680, 1),
         BenchConfig("reference_pipeline_4k", "grayscale,contrast:3.5,emboss:3", 2160, 3840, 3),
         BenchConfig("gaussian5_8k_sharded", "gaussian:5", 4320, 7680, 1, sharded=True),
+        # overlap lane: same workload with the interior-first overlapped
+        # halo execution (hide ICI ppermute latency behind interior
+        # compute) — the serial-vs-overlap comparison also rides every
+        # sharded record as `halo_ab` when enabled (see _halo_ab)
+        BenchConfig(
+            "gaussian5_8k_sharded_overlap", "gaussian:5", 4320, 7680, 1,
+            sharded=True, halo_mode="overlap",
+        ),
         BenchConfig(
             "reference_1080p_batch8",
             "grayscale,contrast:3.5,emboss:3",
@@ -113,12 +130,112 @@ def modeled_hbm_bytes(cfg: BenchConfig) -> int:
 
 
 def _tpu_gen() -> str:
-    import os
-
     return os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
 
 
-def run_config(cfg: BenchConfig, impl: str) -> dict:
+def _halo_ab_enabled() -> bool:
+    """Whether sharded configs run the serial-vs-overlap halo A/B and the
+    per-group comms breakdown. MCIM_HALO_AB=1 forces it on, =0 off;
+    default: only on real TPU hardware (the extra compiles are worth chip
+    minutes, not CPU test minutes)."""
+    v = os.environ.get("MCIM_HALO_AB", "")
+    if v == "1":
+        return True
+    if v == "0":
+        return False
+    return is_tpu_backend()
+
+
+def _comms_only_fn(mesh, halo: int, ndim: int):
+    """A jitted program that performs ONLY one stencil group's ghost-strip
+    exchange (two ring ppermutes of (halo, W[, C]) strips) — the comms
+    denominator for the per-group breakdown."""
+    n = mesh.shape[ROWS]
+
+    def tile_fn(tile):
+        top, bottom = exchange_halo_strips(tile, halo, n)
+        return top + bottom  # consume both so neither transfer is dropped
+
+    spec = P(ROWS, *([None] * (ndim - 1)))
+    return jax.jit(
+        shard_map_compat(
+            tile_fn, mesh=mesh, in_specs=spec, out_specs=spec,
+            check_vma=False,
+        )
+    )
+
+
+def _halo_ab(cfg: BenchConfig, pipe: Pipeline, mesh, img, impl: str) -> dict | None:
+    """Serial-vs-overlap A/B plus per-group comms/compute breakdown for a
+    sharded config.
+
+    Per stencil group: `comms_ms` times the group's ghost exchange alone;
+    `serial_ms` times the group's sharded serial execution standalone
+    (pointwise prologue included), so `compute_ms_est = serial_ms -
+    comms_ms`. Pipeline-level: `comms_hidden_frac` = the fraction of total
+    exchange time the overlap restructuring removed from the critical
+    path, clipped to [0, 1] — the tools/tpu_queue A/B's headline alongside
+    MP/s."""
+    from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
+        _channels_after,
+        group_ops,
+    )
+
+    stencils = [
+        op for op in pipe.ops if isinstance(op, StencilOp) and op.halo >= 1
+    ]
+    if not stencils:
+        return None
+    ab: dict = {}
+    for mode in ("serial", "overlap"):
+        fn = pipe.sharded(mesh, backend=impl, halo_mode=mode)
+        ab[f"{mode}_ms"] = device_throughput(fn, [img]) * 1e3
+    per_group = []
+    comms_total = 0.0
+    n_ch = cfg.channels
+    for gidx, (pointwise, stencil) in enumerate(group_ops(pipe.ops)):
+        in_ch = n_ch
+        n_ch = _channels_after(pointwise, n_ch)
+        if stencil is None or stencil.halo < 1:
+            continue
+        gimg = jnp.asarray(
+            synthetic_image(cfg.height, cfg.width, channels=in_ch, seed=17)
+        )
+        comms_ms = (
+            device_throughput(_comms_only_fn(mesh, stencil.halo, gimg.ndim), [gimg])
+            * 1e3
+        )
+        comms_total += comms_ms
+        entry = {
+            "group": gidx,
+            "ops": [op.name for op in pointwise] + [stencil.name],
+            "halo": stencil.halo,
+            "comms_ms": comms_ms,
+        }
+        if len(stencils) <= 3:  # bound the extra compiles per config
+            gpipe = Pipeline(ops=tuple(pointwise) + (stencil,))
+            gserial = (
+                device_throughput(
+                    gpipe.sharded(mesh, backend=impl, halo_mode="serial"),
+                    [gimg],
+                )
+                * 1e3
+            )
+            entry["serial_ms"] = gserial
+            entry["compute_ms_est"] = gserial - comms_ms
+        per_group.append(entry)
+    ab["per_group"] = per_group
+    ab["comms_ms_total"] = comms_total
+    ab["compute_ms_est"] = ab["serial_ms"] - comms_total
+    if comms_total > 0:
+        ab["comms_hidden_frac"] = max(
+            0.0,
+            min(1.0, (ab["serial_ms"] - ab["overlap_ms"]) / comms_total),
+        )
+    return ab
+
+
+def run_config(cfg: BenchConfig, impl: str, *, n_shards: int | None = None) -> dict:
     if cfg.batch:
         import numpy as np
 
@@ -137,9 +254,12 @@ def run_config(cfg: BenchConfig, impl: str) -> dict:
             synthetic_image(cfg.height, cfg.width, channels=cfg.channels, seed=99)
         )
     pipe = Pipeline.parse(cfg.pipeline)
-    n_chips = len(jax.devices()) if cfg.sharded else 1
+    n_chips = 1
+    mesh = None
     if cfg.sharded:
-        fn = pipe.sharded(make_mesh(n_chips), backend=impl)
+        n_chips = n_shards or len(jax.devices())
+        mesh = make_mesh(n_chips)
+        fn = pipe.sharded(mesh, backend=impl, halo_mode=cfg.halo_mode)
     elif cfg.batch:
         fn = pipe.batched(backend=impl)
     else:
@@ -164,6 +284,12 @@ def run_config(cfg: BenchConfig, impl: str) -> dict:
         "hbm_bytes_model": hbm_bytes,
         "hbm_gb_s_model": gb_s,
     }
+    if cfg.sharded:
+        rec["halo_mode"] = cfg.halo_mode
+        if _halo_ab_enabled():
+            ab = _halo_ab(cfg, pipe, mesh, img, impl)
+            if ab:
+                rec["halo_ab"] = ab
     if on_tpu:
         gen = _tpu_gen()
         rec["tpu_gen"] = gen
@@ -196,6 +322,7 @@ def run_suite(
     impl: str = "both",
     json_path: str | None = None,
     printer: Callable[[str], None] = print,
+    halo_mode: str | None = None,
 ) -> list[dict]:
     log = get_logger()
     impls = ("xla", "pallas") if impl == "both" else (impl,)
@@ -208,6 +335,11 @@ def run_suite(
         selected = [CONFIGS[n] for n in names]
     else:
         selected = list(CONFIGS.values())
+    if halo_mode is not None:  # CLI override for A/B runs
+        selected = [
+            dataclasses.replace(c, halo_mode=halo_mode) if c.sharded else c
+            for c in selected
+        ]
     records = []
     printer(
         f"{'config':26s} {'impl':7s} {'chips':>5s} {'ms/iter':>9s} "
@@ -299,8 +431,24 @@ def main(argv: Sequence[str] | None = None) -> int:
         default="pallas",
         choices=("xla", "pallas", "swar", "auto"),
     )
+    ap.add_argument(
+        "--halo-mode",
+        default=None,
+        choices=("serial", "overlap"),
+        help="override the config's sharded halo execution mode",
+    )
+    ap.add_argument(
+        "--shards",
+        type=int,
+        default=None,
+        help="mesh size for sharded configs (default: every visible "
+        "device) — the serial-vs-overlap A/B sweeps this",
+    )
     args = ap.parse_args(argv)
-    rec = run_config(CONFIGS[args.config], args.impl)
+    cfg = CONFIGS[args.config]
+    if args.halo_mode is not None and cfg.sharded:
+        cfg = dataclasses.replace(cfg, halo_mode=args.halo_mode)
+    rec = run_config(cfg, args.impl, n_shards=args.shards)
     print(json.dumps(rec), flush=True)
     return 0
 

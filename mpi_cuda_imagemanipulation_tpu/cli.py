@@ -62,6 +62,15 @@ def _build_parser() -> argparse.ArgumentParser:
         help="JAX platform to use (e.g. tpu, cpu); default: JAX's default",
     )
     run.add_argument(
+        "--halo-mode",
+        choices=("serial", "overlap"),
+        default="serial",
+        help="sharded halo execution: 'serial' gates every stencil group "
+        "on its ghost-strip ppermutes; 'overlap' computes interior rows "
+        "while the ICI transfers are in flight and prefetches the next "
+        "group's exchange (bit-identical output; no-op without --shards)",
+    )
+    run.add_argument(
         "--gray-output",
         action="store_true",
         help="write single-channel output instead of replicating gray to RGB "
@@ -129,6 +138,12 @@ def _build_parser() -> argparse.ArgumentParser:
     )
     batch.add_argument("--device", default=None)
     batch.add_argument(
+        "--halo-mode",
+        choices=("serial", "overlap"),
+        default="serial",
+        help="sharded halo execution (see `run --help`)",
+    )
+    batch.add_argument(
         "--threads", type=int, default=4, help="decode prefetch threads"
     )
     batch.add_argument(
@@ -163,6 +178,13 @@ def _build_parser() -> argparse.ArgumentParser:
         "--impl",
         choices=("xla", "pallas", "swar", "auto", "both"),
         default="both",
+    )
+    bench.add_argument(
+        "--halo-mode",
+        choices=("serial", "overlap"),
+        default=None,
+        help="override the sharded configs' halo execution mode "
+        "(default: each config's own setting)",
     )
     bench.add_argument("--json-metrics", default=None)
 
@@ -300,6 +322,7 @@ def cmd_run(args: argparse.Namespace) -> int:
                 impl=args.impl,
                 block_h=args.block,
                 shards=args.shards,
+                halo_mode=args.halo_mode,
                 timings=timings,
             )
         except DeviceTimeoutError as e:
@@ -318,7 +341,9 @@ def cmd_run(args: argparse.Namespace) -> int:
                 log.warning(
                     "--block applies to single-device Pallas runs; ignored"
                 )
-            fn = pipe.sharded(mesh, backend=args.impl)
+            fn = pipe.sharded(
+                mesh, backend=args.impl, halo_mode=args.halo_mode
+            )
         else:
             if args.block and args.impl == "xla":
                 log.warning(
@@ -381,6 +406,7 @@ def cmd_run(args: argparse.Namespace) -> int:
                 "ops": pipe.name,
                 "impl": args.impl,
                 "shards": args.shards,
+                "halo_mode": args.halo_mode,
                 "guarded": guarded,
                 "height": img.shape[0],
                 "width": img.shape[1],
@@ -449,7 +475,7 @@ def cmd_batch(args: argparse.Namespace) -> int:
         fn = pipe.batched(backend=args.impl)
     elif n_flat > 1 or n_c is not None:
         mesh = make_mesh_2d(n_r, n_c) if n_c is not None else make_mesh(n_r)
-        fn = pipe.sharded(mesh, backend=args.impl)
+        fn = pipe.sharded(mesh, backend=args.impl, halo_mode=args.halo_mode)
     else:
         fn = pipe.jit(backend=args.impl)  # one jit: re-traces only per shape
 
@@ -569,6 +595,7 @@ def cmd_bench(args: argparse.Namespace) -> int:
         names=names,
         impl=args.impl,
         json_path=args.json_metrics,
+        halo_mode=args.halo_mode,
     )
     return 0
 

@@ -102,7 +102,7 @@ class Pipeline:
         overhead, which dominates small images on remote-attached TPUs."""
         return jax.jit(jax.vmap(self._callable(backend)))
 
-    def sharded(self, mesh, backend: str = "xla"):
+    def sharded(self, mesh, backend: str = "xla", halo_mode: str = "serial"):
         """A jitted function running this pipeline sharded over `mesh` with
         ppermute ghost halo exchange.
 
@@ -110,7 +110,14 @@ class Pipeline:
         fused-ghost fast path available); a 2-D ('rows', 'cols') mesh
         tile-shards it with the two-phase corner-carrying exchange
         (parallel.api2d — XLA tile compute; `backend` must be "xla" or
-        "auto" there)."""
+        "auto" there).
+
+        `halo_mode='overlap'` selects the interior-first overlapped halo
+        execution (parallel.api.HALO_MODES): eligible stencil groups
+        compute interior rows while the ICI ghost-strip ppermutes are in
+        flight, and multi-group pipelines prefetch the next group's
+        exchange from the previous group's boundary outputs. Bit-identical
+        output either way — the knob only changes execution structure."""
         if len(mesh.axis_names) == 2:
             if backend not in ("xla", "auto"):
                 raise ValueError(
@@ -133,10 +140,12 @@ class Pipeline:
                 sharded_pipeline_2d,
             )
 
-            return sharded_pipeline_2d(self, mesh)
+            return sharded_pipeline_2d(self, mesh, halo_mode=halo_mode)
         from mpi_cuda_imagemanipulation_tpu.parallel.api import sharded_pipeline
 
-        return sharded_pipeline(self, mesh, backend=backend)
+        return sharded_pipeline(
+            self, mesh, backend=backend, halo_mode=halo_mode
+        )
 
     def data_parallel(self, mesh, backend: str = "xla"):
         """A jitted (N, H, W[, C]) -> (N, ...) batch function with the

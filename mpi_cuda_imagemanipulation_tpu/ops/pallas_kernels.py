@@ -551,7 +551,10 @@ def _pointwise_kernel(*refs, pointwise, n_in, n_out):
 # Raising the limit lets wide images keep useful block heights; the block-
 # height heuristic then targets a working set below this.
 _VMEM_LIMIT = 64 * 1024 * 1024
-_COMPILER_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT)
+# older jax names the dataclass TPUCompilerParams
+_COMPILER_PARAMS = getattr(
+    pltpu, "CompilerParams", getattr(pltpu, "TPUCompilerParams", None)
+)(vmem_limit_bytes=_VMEM_LIMIT)
 
 
 def _live_f32_temps(stencil: StencilOp | None) -> int:

@@ -262,6 +262,31 @@ def pad2d(
     return jnp.pad(xf, ((top, bottom), (left, right)), mode=_PAD_MODES[edge_mode])
 
 
+def edge_slices(
+    x: jnp.ndarray, k: int, axis: int = 0
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(first k, last k) static slices of `x` along `axis`.
+
+    The overlapped-halo runners (parallel/api, parallel/api2d) build every
+    boundary strip and prefetch source from these, so the slicing
+    convention (and hence the ppermute payload) is defined once."""
+    idx = [slice(None)] * x.ndim
+    idx[axis] = slice(None, k)
+    first = x[tuple(idx)]
+    idx[axis] = slice(x.shape[axis] - k, None)
+    return first, x[tuple(idx)]
+
+
+def interior_slice(x: jnp.ndarray, k: int, axis: int = 0) -> jnp.ndarray:
+    """`x` with `k` slices shaved off both ends of `axis` — the region a
+    halo-`k` stencil can produce from `x` alone, with no ghost data. The
+    interior-first overlap path computes exactly this slice while the
+    ppermute ghost strips are in flight."""
+    idx = [slice(None)] * x.ndim
+    idx[axis] = slice(k, x.shape[axis] - k)
+    return x[tuple(idx)]
+
+
 # --------------------------------------------------------------------------
 # Op dataclasses
 # --------------------------------------------------------------------------

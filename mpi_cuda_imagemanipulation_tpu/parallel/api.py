@@ -27,13 +27,24 @@ from mpi_cuda_imagemanipulation_tpu.ops.spec import (
     GlobalOp,
     PointwiseOp,
     StencilOp,
+    edge_slices,
+    interior_slice,
     pad2d,
 )
 from mpi_cuda_imagemanipulation_tpu.parallel.halo import (
+    exchange_edge_strips,
     exchange_halo,
     exchange_halo_strips,
 )
-from mpi_cuda_imagemanipulation_tpu.parallel.mesh import ROWS
+from mpi_cuda_imagemanipulation_tpu.parallel.mesh import ROWS, shard_map_compat
+
+# Halo execution modes for the sharded stencil runners. 'serial' exchanges
+# ghost strips and only then runs each stencil group (every group gates on
+# two ring ppermutes); 'overlap' restructures the dataflow so interior rows
+# — which need no ghost data — compute while the strips are in flight, and
+# the next group's exchange is issued from the previous group's boundary
+# outputs (cross-group prefetch). Output is bit-identical either way.
+HALO_MODES = ("serial", "overlap")
 
 
 def _reflect101_index(g: jnp.ndarray, size: int) -> jnp.ndarray:
@@ -185,6 +196,134 @@ def _apply_stencil(
             axis=-1,
         )
     return _stencil_on_ext(op, ext, tile, y0, global_h, global_w, backend)
+
+
+def _overlap_ok(op, n: int, local_h: int, global_h: int) -> bool:
+    """Whether one stencil group can take the interior-first overlap path:
+    a real halo (halo-0 groups have no exchange to hide), no pad rows
+    inside the tile (strip-level edge synthesis is whole-strip — the same
+    gate as the fused-ghost path), and a non-empty interior. Static, so
+    the walker and the cross-group prefetch lookahead always agree."""
+    return (
+        isinstance(op, StencilOp)
+        and op.halo >= 1
+        and n * local_h == global_h
+        and local_h > 2 * op.halo
+    )
+
+
+def _piece_edge_rows(pieces, k: int):
+    """First/last `k` rows of a stitched (top, interior, bottom) piece
+    list WITHOUT concatenating the tile first: slices are taken from the
+    individual pieces, so the next group's ppermute payload depends only
+    on the pieces that actually contain edge rows — for k <= halo just
+    the boundary strips — never on the whole interior computation. This
+    is what lets the cross-group prefetch ppermute issue as soon as the
+    previous group's boundary rows are final."""
+    first, need = [], k
+    for p in pieces:
+        take = min(need, p.shape[0])
+        if take:
+            first.append(p[:take])
+            need -= take
+        if not need:
+            break
+    last, need = [], k
+    for p in reversed(pieces):
+        take = min(need, p.shape[0])
+        if take:
+            last.insert(0, p[p.shape[0] - take :])
+            need -= take
+        if not need:
+            break
+
+    def cat(xs):
+        return xs[0] if len(xs) == 1 else jnp.concatenate(xs, axis=0)
+
+    return cat(first), cat(last)
+
+
+def _next_stencil_group(ops, i: int):
+    """(next stencil op, intervening pointwise chain) looking forward from
+    ops[i], or (None, []) when anything but a PointwiseOp intervenes (a
+    GlobalOp's psum is itself a sync point, so prefetching past one buys
+    nothing; geometric ops end the segment)."""
+    chain: list = []
+    for op in ops[i:]:
+        if isinstance(op, PointwiseOp):
+            chain.append(op)
+        elif isinstance(op, StencilOp):
+            return op, chain
+        else:
+            return None, []
+    return None, []
+
+
+def _apply_stencil_overlap(
+    op: StencilOp,
+    tile: jnp.ndarray,
+    strips: tuple[jnp.ndarray, jnp.ndarray],
+    y0: jnp.ndarray,
+    global_h: int,
+    global_w: int,
+    backend: str,
+    gi: int,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Interior-first execution of one stencil group.
+
+    The interior rows — everything a halo-h stencil can produce from the
+    local tile alone — are computed with NO data dependence on the
+    ppermuted ghost strips, so XLA's scheduler can run them while the ICI
+    transfers are in flight; only the two h-row boundary strips wait for
+    `strips` to land. Stitching top/interior/bottom with per-slice global
+    row offsets reproduces the serial path's windows exactly, so output
+    stays bit-identical (the invariant tests/test_sharded.py asserts over
+    both halo modes).
+
+    Returns the (top, interior, bottom) pieces unconcatenated so the
+    caller can slice the next group's prefetch payload from the boundary
+    pieces alone (_piece_edge_rows). Named scopes tag the interior and
+    boundary computations per group; tests/test_halo_overlap.py asserts
+    from the lowered module that `halo_overlap_interior_g<gi>` has no
+    path from any collective-permute of group >= gi.
+    """
+    h = op.halo
+    local_h = tile.shape[0]
+    backend = _resolve_backend(op, backend)
+    if backend == "swar":
+        backend = "pallas"  # same mapping as the materialised-ext path
+    top, bottom = _fix_edge_strips(strips[0], strips[1], tile, op, y0, global_h)
+
+    def run(ext, orig, yoff, be):
+        if ext.ndim == 3:  # colour: filter each channel plane independently
+            return jnp.stack(
+                [
+                    _stencil_on_ext(
+                        op, ext[..., c], orig[..., c], yoff, global_h,
+                        global_w, be,
+                    )
+                    for c in range(ext.shape[2])
+                ],
+                axis=-1,
+            )
+        return _stencil_on_ext(op, ext, orig, yoff, global_h, global_w, be)
+
+    with jax.named_scope(f"halo_overlap_interior_g{gi}"):
+        interior = run(tile, interior_slice(tile, h), y0 + h, backend)
+    # boundary strips: h output rows each, from (3h, W) extended bands —
+    # XLA compute (a Pallas launch for h rows costs more than it saves)
+    with jax.named_scope(f"halo_overlap_boundary_g{gi}"):
+        head, tail = edge_slices(tile, 2 * h)
+        top_out = run(
+            jnp.concatenate([top, head], axis=0), tile[:h], y0, "xla"
+        )
+        bottom_out = run(
+            jnp.concatenate([tail, bottom], axis=0),
+            tile[local_h - h :],
+            y0 + local_h - h,
+            "xla",
+        )
+    return top_out, interior, bottom_out
 
 
 def _swar_group_ok(pointwise, op: StencilOp, tile, n: int, local_h: int,
@@ -347,6 +486,7 @@ def _run_segment(
     any_pallas: bool,
     img: jnp.ndarray,
     try_swar: bool = False,
+    halo_mode: str = "serial",
 ):
     """One shard_map region: pad-to-multiple, halo-exchanged local compute,
     crop. Fixes the reference's silent `rows / size` truncation
@@ -386,6 +526,12 @@ def _run_segment(
             return t
 
         i = 0
+        gi = 0  # stencil-group index (overlap scoping + prefetch pairing)
+        # ghost strips already in flight for the next overlap group:
+        # (top, bottom, halo) issued from the previous group's boundary
+        # outputs (cross-group prefetch — the ICI rings stay busy while
+        # this group's interior computes)
+        prefetch = None
         while i < len(ops):
             op = ops[i]
             i += 1
@@ -406,6 +552,42 @@ def _run_segment(
                 stats = lax.psum(op.stats(tile, valid), ROWS)
                 tile = op.apply(tile, stats)
             else:
+                # Interior-first overlapped halo path: eligible stencil
+                # groups compute their interior while the ghost strips are
+                # in flight; boundary strips stitch once they land. Takes
+                # priority over the swar/fused serial paths — the knob is
+                # an explicit execution-structure request.
+                if halo_mode == "overlap" and _overlap_ok(
+                    op, n, local_h, global_h
+                ):
+                    tile = flush(tile)
+                    if prefetch is not None and prefetch[2] == op.halo:
+                        strips = (prefetch[0], prefetch[1])
+                    else:
+                        with jax.named_scope(f"halo_exchange_g{gi}"):
+                            strips = exchange_halo_strips(tile, op.halo, n)
+                    prefetch = None
+                    pieces = _apply_stencil_overlap(
+                        op, tile, strips, y0, global_h, global_w, backend, gi
+                    )
+                    nxt, chain = _next_stencil_group(ops, i)
+                    if nxt is not None and _overlap_ok(
+                        nxt, n, local_h, global_h
+                    ):
+                        # issue the NEXT group's exchange now, from this
+                        # group's boundary pieces (pointwise chains commute
+                        # with row slicing, so applying them to the edge
+                        # rows alone matches slicing the post-chain tile)
+                        first, last = _piece_edge_rows(pieces, nxt.halo)
+                        for p in chain:
+                            first, last = p.fn(first), p.fn(last)
+                        with jax.named_scope(f"halo_exchange_g{gi + 1}"):
+                            pre = exchange_edge_strips(first, last, n)
+                        prefetch = (pre[0], pre[1], nxt.halo)
+                    tile = jnp.concatenate(pieces, axis=0)
+                    gi += 1
+                    continue
+                gi += 1
                 # Quarter-strip SWAR ghost path (backend='swar', or 'auto'
                 # under the MCIM_PREFER_SWAR promotion switch, snapshotted
                 # at build time): a single-chip SWAR win carries to
@@ -489,21 +671,33 @@ def _run_segment(
     out_shape = jax.eval_shape(seq, img_p)
     in_spec = P(ROWS, *([None] * (img.ndim - 1)))
     out_spec = P(ROWS, *([None] * (len(out_shape.shape) - 1)))
-    out = jax.shard_map(
+    out = shard_map_compat(
         tile_fn, mesh=mesh, in_specs=in_spec, out_specs=out_spec,
         check_vma=not any_pallas,
     )(img_p)
     return out[:global_h]
 
 
-def sharded_pipeline(pipe, mesh, backend: str = "xla"):
+def sharded_pipeline(
+    pipe, mesh, backend: str = "xla", halo_mode: str = "serial"
+):
     """Compile `pipe` to run row-sharded over `mesh` with halo exchange.
 
     Returns a jitted (H, W[, 3]) uint8 -> uint8 function, bit-identical to
     the unsharded golden path (tests/test_sharded.py).
+
+    `halo_mode='overlap'` restructures each eligible stencil group so the
+    interior rows compute while the ppermute ghost strips are in flight
+    (see HALO_MODES); groups the overlap gate rejects (halo 0, pad rows,
+    sub-2*halo tiles) fall back to the serial paths, so the output
+    contract is unchanged.
     """
     if backend not in ("xla", "pallas", "swar", "auto"):
         raise ValueError(f"unknown backend {backend!r}")
+    if halo_mode not in HALO_MODES:
+        raise ValueError(
+            f"unknown halo_mode {halo_mode!r}; known: {HALO_MODES}"
+        )
     # The MCIM_PREFER_SWAR promotion switch is snapshotted ONCE here:
     # routing and the vma-checker decision below must agree, and a
     # mid-session env change between build and a retrace must not split
@@ -539,7 +733,8 @@ def sharded_pipeline(pipe, mesh, backend: str = "xla"):
                 )
             else:
                 img = _run_segment(
-                    ops, mesh, backend, any_pallas, img, try_swar=try_swar
+                    ops, mesh, backend, any_pallas, img,
+                    try_swar=try_swar, halo_mode=halo_mode,
                 )
         return img
 

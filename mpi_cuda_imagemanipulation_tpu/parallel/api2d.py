@@ -37,9 +37,13 @@ from mpi_cuda_imagemanipulation_tpu.ops.spec import (
     PointwiseOp,
     StencilOp,
 )
-from mpi_cuda_imagemanipulation_tpu.parallel.api import _fix_edge_axis
+from mpi_cuda_imagemanipulation_tpu.parallel.api import HALO_MODES, _fix_edge_axis
 from mpi_cuda_imagemanipulation_tpu.parallel.halo import exchange_halo
-from mpi_cuda_imagemanipulation_tpu.parallel.mesh import COLS, ROWS
+from mpi_cuda_imagemanipulation_tpu.parallel.mesh import (
+    COLS,
+    ROWS,
+    shard_map_compat,
+)
 
 
 def _apply_stencil_2d(
@@ -83,13 +87,97 @@ def _apply_stencil_2d(
     return op.finalize(op.valid(ext.astype(F32)), tile, y0, x0, global_h, global_w)
 
 
+def _overlap_ok_2d(
+    op, pad_h: int, pad_w: int, local_h: int, local_w: int
+) -> bool:
+    """2-D interior-first gate: a real halo, no pad rows/cols inside the
+    tile, and a non-empty interior along both axes (same reasoning as the
+    1-D _overlap_ok, applied per axis)."""
+    return (
+        isinstance(op, StencilOp)
+        and op.halo >= 1
+        and pad_h == 0
+        and pad_w == 0
+        and local_h > 2 * op.halo
+        and local_w > 2 * op.halo
+    )
+
+
+def _apply_stencil_2d_overlap(
+    op: StencilOp,
+    tile: jnp.ndarray,
+    y0: jnp.ndarray,
+    x0: jnp.ndarray,
+    global_h: int,
+    global_w: int,
+    n_r: int,
+    n_c: int,
+    gi: int,
+) -> jnp.ndarray:
+    """Interior-first execution of one stencil on a 2-D tile.
+
+    The (local_h - 2h) x (local_w - 2h) interior computes from the raw
+    tile with no data dependence on either exchange phase, so it runs
+    while all four ring ppermutes are in flight. The h-thick frame —
+    full-width top/bottom bands (whose corners use the two-phase
+    corner-carrying ghosts) and the left/right middle bands — computes
+    from the fully extended tile once the ghosts land. Every band's valid
+    windows slice the same values the serial path's whole-tile valid
+    sees, so the stitched output is bit-identical."""
+    h = op.halo
+    local_h, local_w = tile.shape[0], tile.shape[1]
+    with jax.named_scope(f"halo_exchange_g{gi}"):
+        vext = exchange_halo(tile, h, n_r, axis_name=ROWS, axis=0)
+    vext = _fix_edge_axis(vext, op, y0, global_h, 0)
+    with jax.named_scope(f"halo_exchange_g{gi}"):
+        ext = exchange_halo(vext, h, n_c, axis_name=COLS, axis=1)
+    ext = _fix_edge_axis(ext, op, x0, global_w, 1)
+
+    def plane(extp, tilep):
+        def band(rows, cols, orig, yb, xb):
+            acc = op.valid(extp[rows, cols].astype(F32))
+            return op.finalize(acc, orig, yb, xb, global_h, global_w)
+
+        with jax.named_scope(f"halo_overlap_interior_g{gi}"):
+            acc = op.valid(tilep.astype(F32))
+            interior = op.finalize(
+                acc, tilep[h:-h, h:-h], y0 + h, x0 + h, global_h, global_w
+            )
+        with jax.named_scope(f"halo_overlap_boundary_g{gi}"):
+            # ext row r holds input row r - h (likewise columns)
+            top = band(
+                slice(0, 3 * h), slice(None), tilep[:h], y0, x0
+            )
+            bottom = band(
+                slice(local_h - h, local_h + 2 * h), slice(None),
+                tilep[local_h - h :], y0 + local_h - h, x0,
+            )
+            left = band(
+                slice(h, local_h + h), slice(0, 3 * h),
+                tilep[h:-h, :h], y0 + h, x0,
+            )
+            right = band(
+                slice(h, local_h + h), slice(local_w - h, local_w + 2 * h),
+                tilep[h:-h, local_w - h :], y0 + h, x0 + local_w - h,
+            )
+        mid = jnp.concatenate([left, interior, right], axis=1)
+        return jnp.concatenate([top, mid, bottom], axis=0)
+
+    if tile.ndim == 3:
+        return jnp.stack(
+            [plane(ext[..., c], tile[..., c]) for c in range(tile.shape[2])],
+            axis=-1,
+        )
+    return plane(ext, tile)
+
+
 def _min_local(pad: int, halo: int) -> int:
     """Static feasibility of local edge fixups, per axis (same reasoning as
     the 1-D runner): every reflect/pad source index must live on-tile."""
     return max(2 * pad + 1, pad + halo, halo, 1)
 
 
-def _run_segment_2d(ops, mesh, img: jnp.ndarray):
+def _run_segment_2d(ops, mesh, img: jnp.ndarray, halo_mode: str = "serial"):
     n_r, n_c = mesh.shape[ROWS], mesh.shape[COLS]
     max_halo = max((op.halo for op in ops), default=0)
     global_h, global_w = img.shape[0], img.shape[1]
@@ -118,6 +206,7 @@ def _run_segment_2d(ops, mesh, img: jnp.ndarray):
     def tile_fn(tile):
         y0 = lax.axis_index(ROWS) * local_h
         x0 = lax.axis_index(COLS) * local_w
+        gi = 0
         for op in ops:
             if isinstance(op, PointwiseOp):
                 tile = op.fn(tile)
@@ -131,9 +220,17 @@ def _run_segment_2d(ops, mesh, img: jnp.ndarray):
                 stats = lax.psum(op.stats(tile, valid), (ROWS, COLS))
                 tile = op.apply(tile, stats)
             else:
-                tile = _apply_stencil_2d(
-                    op, tile, y0, x0, global_h, global_w, n_r, n_c
-                )
+                if halo_mode == "overlap" and _overlap_ok_2d(
+                    op, pad_h, pad_w, local_h, local_w
+                ):
+                    tile = _apply_stencil_2d_overlap(
+                        op, tile, y0, x0, global_h, global_w, n_r, n_c, gi
+                    )
+                else:
+                    tile = _apply_stencil_2d(
+                        op, tile, y0, x0, global_h, global_w, n_r, n_c
+                    )
+                gi += 1
         return tile
 
     def seq(x):
@@ -144,21 +241,28 @@ def _run_segment_2d(ops, mesh, img: jnp.ndarray):
     out_shape = jax.eval_shape(seq, img_p)
     in_spec = P(ROWS, COLS, *([None] * (img.ndim - 2)))
     out_spec = P(ROWS, COLS, *([None] * (len(out_shape.shape) - 2)))
-    out = jax.shard_map(
+    out = shard_map_compat(
         tile_fn, mesh=mesh, in_specs=in_spec, out_specs=out_spec
     )(img_p)
     return out[:global_h, :global_w]
 
 
-def sharded_pipeline_2d(pipe, mesh):
+def sharded_pipeline_2d(pipe, mesh, halo_mode: str = "serial"):
     """Compile `pipe` to run tile-sharded over a ('rows', 'cols') mesh.
 
     Returns a jitted (H, W[, 3]) uint8 -> uint8 function, bit-identical to
     the unsharded golden path. Geometric (shape-changing) ops run between
     shard_map segments at the jit level under a 2-D sharding constraint,
-    same recipe as the 1-D runner."""
+    same recipe as the 1-D runner. `halo_mode='overlap'` computes each
+    eligible stencil's interior while the four ring ppermutes are in
+    flight (_apply_stencil_2d_overlap); ineligible stencils (pad
+    rows/cols, halo 0, tiny tiles) stay serial, output unchanged."""
     from mpi_cuda_imagemanipulation_tpu.parallel.api import _split_segments
 
+    if halo_mode not in HALO_MODES:
+        raise ValueError(
+            f"unknown halo_mode {halo_mode!r}; known: {HALO_MODES}"
+        )
     segments = _split_segments(pipe.ops)
 
     def run(img: jnp.ndarray) -> jnp.ndarray:
@@ -174,7 +278,7 @@ def sharded_pipeline_2d(pipe, mesh):
                     ),
                 )
             else:
-                img = _run_segment_2d(ops, mesh, img)
+                img = _run_segment_2d(ops, mesh, img, halo_mode=halo_mode)
         return img
 
     return jax.jit(run)

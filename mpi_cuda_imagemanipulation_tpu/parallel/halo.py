@@ -17,6 +17,34 @@ from jax import lax
 from mpi_cuda_imagemanipulation_tpu.parallel.mesh import ROWS
 
 
+def exchange_edge_strips(
+    first: jnp.ndarray,
+    last: jnp.ndarray,
+    n_shards: int,
+    *,
+    axis_name: str = ROWS,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Ring-exchange pre-sliced edge strips: `first`/`last` are each
+    shard's leading/trailing `halo` slices along the exchanged axis,
+    already cut out by the caller.
+
+    This is the primitive under exchange_halo_strips, exposed so the
+    overlapped-halo pipeline can ppermute a *derived* strip — e.g. the
+    next stencil group's edge rows assembled from the previous group's
+    boundary outputs (cross-group prefetch) — without the exchange being
+    data-dependent on a full materialised tile. Ring wrap semantics are
+    identical to exchange_halo_strips: callers overwrite wrapped strips
+    with the op's edge extension before use.
+    """
+    if n_shards == 1:
+        return jnp.zeros_like(last), jnp.zeros_like(first)
+    down = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+    up = [(i, (i - 1) % n_shards) for i in range(n_shards)]
+    before = lax.ppermute(last, axis_name, down)
+    after = lax.ppermute(first, axis_name, up)
+    return before, after
+
+
 def exchange_halo_strips(
     tile: jnp.ndarray,
     halo: int,
@@ -41,19 +69,12 @@ def exchange_halo_strips(
     Defaults cover the 1-D 'rows' decomposition; the 2-D tile runner
     (parallel/api2d) calls it per axis.
     """
-    if n_shards == 1:
-        shape = list(tile.shape)
-        shape[axis] = halo
-        zeros = jnp.zeros(shape, tile.dtype)
-        return zeros, zeros
     idx = [slice(None)] * tile.ndim
-    down = [(i, (i + 1) % n_shards) for i in range(n_shards)]
-    up = [(i, (i - 1) % n_shards) for i in range(n_shards)]
-    idx[axis] = slice(-halo, None)
-    before = lax.ppermute(tile[tuple(idx)], axis_name, down)
     idx[axis] = slice(None, halo)
-    after = lax.ppermute(tile[tuple(idx)], axis_name, up)
-    return before, after
+    first = tile[tuple(idx)]
+    idx[axis] = slice(tile.shape[axis] - halo, None)
+    last = tile[tuple(idx)]
+    return exchange_edge_strips(first, last, n_shards, axis_name=axis_name)
 
 
 def exchange_halo(

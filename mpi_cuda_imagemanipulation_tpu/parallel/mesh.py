@@ -64,6 +64,27 @@ def distributed_init() -> None:
     _distributed_initialized = True
 
 
+def shard_map_compat(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """`jax.shard_map` across jax versions.
+
+    Newer jax exposes the stable `jax.shard_map` (replication checking via
+    `check_vma`); older versions only have the experimental entry point,
+    where the same checker is named `check_rep`. Both runners route through
+    here so the sharded path works on either.
+    """
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )
+
+
 def make_mesh(n_shards: int | None = None, *, devices=None) -> Mesh:
     """A 1-D mesh over `n_shards` devices on the ('rows',) axis.
 

@@ -40,14 +40,14 @@ distributed_init()  # mpirun-analogue env (inherited) works guarded too
 from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline
 from mpi_cuda_imagemanipulation_tpu.utils.timing import _sync
 
-inp, outp, spec, impl, block, shards = sys.argv[1:7]
+inp, outp, spec, impl, block, shards, halo_mode = sys.argv[1:8]
 img = np.load(inp)
 pipe = Pipeline.parse(spec)
 from mpi_cuda_imagemanipulation_tpu.parallel.mesh import mesh_from_shards
 
 _mesh = mesh_from_shards(shards)
 if _mesh is not None:
-    fn = pipe.sharded(_mesh, backend=impl)
+    fn = pipe.sharded(_mesh, backend=impl, halo_mode=halo_mode)
 else:
     fn = pipe.jit(backend=impl, block_h=int(block) or None)
 
@@ -78,6 +78,7 @@ def run_guarded(
     impl: str = "auto",
     block_h: int | None = None,
     shards: int | str = 1,
+    halo_mode: str = "serial",
     timings: dict | None = None,
 ) -> np.ndarray:
     """Run `spec` over `img` in a subprocess with a wall-clock budget.
@@ -100,6 +101,7 @@ def run_guarded(
         cmd = [
             sys.executable, "-c", _WORKER,
             inp, outp, spec, impl, str(block_h or 0), str(shards),
+            halo_mode,
         ]
         try:
             proc = subprocess.run(

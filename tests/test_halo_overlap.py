@@ -1,0 +1,509 @@
+"""Overlapped-halo execution structure tests (parallel/api halo_mode).
+
+Bit-exactness of halo_mode='overlap' is asserted alongside 'serial' in
+tests/test_sharded.py / test_sharded2d.py; this file asserts the part
+bit-exactness cannot see — the *dataflow structure* that makes the overlap
+real. From the lowered module of a sharded overlap program (SSA def-use
+graph over the StableHLO text, named scopes resolved through location
+aliases) we check that:
+
+  * interior stencil compute of group g has NO path from group >= g's
+    collective-permutes (so XLA may schedule it while those transfers are
+    in flight — interior compute never gates on its own exchange);
+  * boundary compute of group g DOES depend on group g's
+    collective-permutes (positive control: the parser sees real edges);
+  * with cross-group prefetch, group g+1's collective-permutes do not
+    depend on group g's interior (the ICI rings stay busy across groups).
+
+Plus unit tests for the strip-exchange/slicing building blocks and the
+bench-suite A/B record structure.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from mpi_cuda_imagemanipulation_tpu.io.image import synthetic_image  # noqa: E402
+from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline  # noqa: E402
+from mpi_cuda_imagemanipulation_tpu.parallel.mesh import (  # noqa: E402
+    make_mesh,
+    make_mesh_2d,
+)
+
+needs_8dev = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-fake-device CPU rig"
+)
+
+
+# --------------------------------------------------------------------------
+# Lowered-module dependence analysis
+# --------------------------------------------------------------------------
+
+_DEF_RE = re.compile(r"^\s*(%[\w.]+)(?::\d+)?\s*=\s*(.*)$")
+_VAL_RE = re.compile(r"%[\w.]+")
+_LOC_RE = re.compile(r"loc\((#loc\d*)\)\s*$")
+_LOC_ALIAS_RE = re.compile(r"^(#loc\d*)\s*=\s*loc\((.*)\)\s*$")
+_FUNC_RE = re.compile(r"^\s*func\.func\s+(?:public\s+|private\s+)?@([\w.$-]+)")
+_RET_RE = re.compile(r"^\s*(?:func\.)?return\b(.*)$")
+_CALL_RE = re.compile(r"\bcall\s+@([\w.$-]+)")
+
+
+class _Module:
+    """Interprocedural SSA def-use graph of one lowered StableHLO module's
+    text, with each op's fully resolved source-location string (named
+    scopes included).
+
+    SSA names repeat across the module's many `func.func`s, so every value
+    is qualified by its enclosing function. Calls add two edge kinds: the
+    call result depends on the caller-side arguments AND on a synthetic
+    `ret::<callee>` node, which depends on the callee's returned values —
+    so a collective-permute anywhere in a callee taints its callers, while
+    taint never leaks between unrelated callers (callee block arguments
+    are def-less dead ends)."""
+
+    def __init__(self, asm: str):
+        self.defs: dict[str, list[str]] = {}  # value -> dependencies
+        self.kind: dict[str, str] = {}  # value -> op mnemonic text
+        self.loc: dict[str, str] = {}  # value -> loc alias (raw)
+        aliases: dict[str, str] = {}
+        fn = ""
+        for line in asm.splitlines():
+            s = line.strip()
+            alias = _LOC_ALIAS_RE.match(s)
+            if alias:
+                aliases[alias.group(1)] = alias.group(2)
+                continue
+            fm = _FUNC_RE.match(line)
+            if fm:
+                fn = fm.group(1)
+                continue
+            rm = _RET_RE.match(line)
+            if rm:
+                self.defs.setdefault(f"ret::{fn}", []).extend(
+                    f"{fn}::{v.split('#')[0]}"
+                    for v in _VAL_RE.findall(rm.group(1))
+                )
+                continue
+            m = _DEF_RE.match(line)
+            if not m:
+                continue
+            result = f"{fn}::{m.group(1)}"
+            rhs = m.group(2)
+            # dependencies = every %value on the RHS (types/attrs have no %)
+            operands = [
+                f"{fn}::{v.split('#')[0]}" for v in _VAL_RE.findall(rhs)
+            ]
+            cm = _CALL_RE.search(rhs)
+            if cm:
+                operands.append(f"ret::{cm.group(1)}")
+            self.defs[result] = operands
+            self.kind[result] = rhs.split("(")[0].strip().strip('"')
+            locm = _LOC_RE.search(line)
+            if locm:
+                self.loc[result] = locm.group(1)
+        # resolve loc aliases transitively into flat strings
+        self._loc_str: dict[str, str] = {}
+        for alias, raw in aliases.items():
+            s = raw
+            for _ in range(12):  # nested fused/callsite locs
+                expanded = re.sub(
+                    r"#loc\d*", lambda m: aliases.get(m.group(0), ""), s
+                )
+                if expanded == s:
+                    break
+                s = expanded
+            self._loc_str[alias] = s
+
+    def loc_of(self, value: str) -> str:
+        return self._loc_str.get(self.loc.get(value, ""), "")
+
+    def values_where(self, kind: str | None = None, loc_substr: str | None = None):
+        out = []
+        for v in self.defs:
+            if kind is not None and kind not in self.kind.get(v, ""):
+                continue
+            if loc_substr is not None and not re.search(
+                loc_substr, self.loc_of(v)
+            ):
+                continue
+            out.append(v)
+        return out
+
+    def transitive_operands(self, roots) -> set[str]:
+        seen: set[str] = set()
+        stack = list(roots)
+        while stack:
+            v = stack.pop()
+            for o in self.defs.get(v, []):
+                if o not in seen:
+                    seen.add(o)
+                    stack.append(o)
+        return seen
+
+
+def _lowered_asm(fn, img) -> str:
+    ir = fn.lower(img).compiler_ir(dialect="stablehlo")
+    return ir.operation.get_asm(enable_debug_info=True)
+
+
+def _module_for(spec: str, halo_mode: str = "overlap", mesh=None, hw=(128, 96),
+                channels=1):
+    img = jnp.asarray(synthetic_image(*hw, channels=channels, seed=9))
+    pipe = Pipeline.parse(spec)
+    fn = pipe.sharded(mesh if mesh is not None else make_mesh(8),
+                      halo_mode=halo_mode)
+    return _Module(_lowered_asm(fn, img))
+
+
+def _cp_values_by_group(mod: _Module) -> dict[int, list[str]]:
+    groups: dict[int, list[str]] = {}
+    for v in mod.values_where(kind="stablehlo.collective_permute"):
+        m = re.search(r"halo_exchange_g(\d+)", mod.loc_of(v))
+        assert m, f"collective-permute {v} outside a halo_exchange scope"
+        groups.setdefault(int(m.group(1)), []).append(v)
+    return groups
+
+
+@needs_8dev
+def test_interior_independent_of_ppermute_single_group():
+    """THE overlap assertion: in the compiled module of a one-group overlap
+    pipeline, the interior stencil computation has no data dependence on
+    any collective-permute — XLA is free to run it while the ghost strips
+    are on the wire."""
+    mod = _module_for("gaussian:5")
+    cps = _cp_values_by_group(mod)
+    assert cps, "no collective-permute found (mesh not exercised?)"
+    interior = mod.values_where(loc_substr=r"halo_overlap_interior_g0")
+    assert interior, "interior scope missing from lowering"
+    deps = mod.transitive_operands(interior) | set(interior)
+    for g, vals in cps.items():
+        assert not deps.intersection(vals), (
+            f"interior compute depends on group-{g} collective-permute"
+        )
+    # positive control — the parser must see real edges: the boundary
+    # strips DO wait for the exchange
+    boundary = mod.values_where(loc_substr=r"halo_overlap_boundary_g0")
+    assert boundary
+    bdeps = mod.transitive_operands(boundary)
+    assert bdeps.intersection(cps[0]), (
+        "boundary compute shows no dependence on its exchange — parser "
+        "or scoping broken"
+    )
+
+
+@needs_8dev
+def test_interior_independent_of_own_group_ppermute_multi_group():
+    """Two-group pipeline with cross-group prefetch: each group's interior
+    is independent of its OWN exchange (and every later one); group 1's
+    exchange is independent of group 0's interior, so the ICI rings go
+    busy while group 0's interior computes."""
+    mod = _module_for("gaussian:5,gaussian:5")
+    cps = _cp_values_by_group(mod)
+    assert set(cps) == {0, 1}, f"expected 2 exchange groups, got {sorted(cps)}"
+    for g in (0, 1):
+        interior = mod.values_where(loc_substr=rf"halo_overlap_interior_g{g}\b")
+        assert interior, f"interior scope g{g} missing"
+        deps = mod.transitive_operands(interior) | set(interior)
+        for g2, vals in cps.items():
+            if g2 >= g:
+                assert not deps.intersection(vals), (
+                    f"interior g{g} depends on exchange g{g2}"
+                )
+    # prefetch: group 1's ppermutes must not wait on group 0's interior
+    pre_deps = mod.transitive_operands(cps[1])
+    interior0 = set(mod.values_where(loc_substr=r"halo_overlap_interior_g0\b"))
+    assert not pre_deps.intersection(interior0), (
+        "group 1's prefetched exchange depends on group 0's interior"
+    )
+
+
+@needs_8dev
+def test_interior_independent_of_ppermute_2d():
+    """2-D tile runner: the interior computes from the raw tile with no
+    dependence on either exchange phase's collective-permutes."""
+    mod = _module_for("gaussian:5", mesh=make_mesh_2d(2, 4), hw=(64, 96),
+                      channels=3)
+    cps = [v for vals in _cp_values_by_group(mod).values() for v in vals]
+    assert len(cps) >= 4, "2-D two-phase exchange should emit >= 4 ppermutes"
+    interior = mod.values_where(loc_substr=r"halo_overlap_interior_g0")
+    assert interior
+    deps = mod.transitive_operands(interior) | set(interior)
+    assert not deps.intersection(cps)
+    boundary = mod.values_where(loc_substr=r"halo_overlap_boundary_g0")
+    assert mod.transitive_operands(boundary).intersection(cps)
+
+
+# --- compiled (optimized) HLO variant of the same assertion -------------
+#
+# The StableHLO tests above check the structure jax emits; these check the
+# structure that SURVIVES XLA's optimizer — fusion could in principle glue
+# interior and boundary ops into one computation that consumes the
+# collective-permute results. The parse is exact, not conservative:
+# dependence through fusions/calls follows each parameter to the call
+# site's positional operand, so co-fused-but-independent values don't
+# false-positive.
+
+_HLO_INSTR_RE = re.compile(r"^\s+(ROOT\s+)?(%[\w.\-]+)\s*=\s*\S+\s+([\w\-]+)\((.*)$")
+_HLO_CALLS_RE = re.compile(
+    r"(?:calls=|to_apply=|body=|condition=|select=|scatter=)(%[\w.\-]+)"
+)
+
+
+def _parse_hlo(txt: str) -> dict:
+    comps: dict = {}
+    cur = None
+    for line in txt.splitlines():
+        if not line.startswith(" ") and line.rstrip().endswith("{"):
+            toks = line.split()
+            name = toks[1] if toks[0] == "ENTRY" else toks[0]
+            cur = name.split("(")[0]
+            comps[cur] = {"instrs": {}, "params": [], "root": None}
+            continue
+        m = _HLO_INSTR_RE.match(line)
+        if m is None or cur is None:
+            continue
+        is_root, name, op, rest = (
+            bool(m.group(1)), m.group(2), m.group(3), m.group(4),
+        )
+        onm = re.search(r'op_name="([^"]*)"', rest)
+        comps[cur]["instrs"][name] = {
+            "op": op,
+            "toks": re.findall(r"%[\w.\-]+", rest),
+            "calls": _HLO_CALLS_RE.findall(rest),
+            "op_name": onm.group(1) if onm else "",
+        }
+        if op == "parameter":
+            idx = int(rest.split(")")[0])
+            params = comps[cur]["params"]
+            while len(params) <= idx:
+                params.append(None)
+            params[idx] = name
+        if is_root:
+            comps[cur]["root"] = name
+    return comps
+
+
+def _hlo_reaching(comps: dict, start, target_op: str) -> list:
+    """All (comp, instr) of kind `target_op` reachable from `start` through
+    operand edges, call/fusion roots, and parameter -> call-site-operand
+    links (exact positional mapping)."""
+    callers: dict = {}
+    for c, d in comps.items():
+        for i, info in d["instrs"].items():
+            for callee in info["calls"]:
+                callers.setdefault(callee, []).append((c, i))
+    seen, hits = set(), []
+    stack = [start]
+    while stack:
+        node = stack.pop()
+        if node in seen:
+            continue
+        seen.add(node)
+        c, i = node
+        info = comps[c]["instrs"].get(i)
+        if info is None:
+            continue
+        if info["op"] == target_op:
+            hits.append(node)
+            continue
+        if info["op"] == "parameter":
+            idx = comps[c]["params"].index(i)
+            for caller, site in callers.get(c, []):
+                site_ops = [
+                    t
+                    for t in comps[caller]["instrs"][site]["toks"]
+                    if t in comps[caller]["instrs"]
+                ]
+                if idx < len(site_ops):
+                    stack.append((caller, site_ops[idx]))
+            continue
+        for t in info["toks"]:
+            if t in comps[c]["instrs"]:
+                stack.append((c, t))
+        for callee in info["calls"]:
+            if callee in comps and comps[callee]["root"]:
+                stack.append((callee, comps[callee]["root"]))
+    return hits
+
+
+def _scope_group(op_name: str, scope: str) -> int | None:
+    m = re.search(scope + r"(\d+)", op_name)
+    return int(m.group(1)) if m else None
+
+
+@needs_8dev
+@pytest.mark.parametrize(
+    "spec,channels",
+    [
+        ("gaussian:5", 1),
+        ("gaussian:5,gaussian:5", 1),
+        ("grayscale,contrast:3.5,emboss:3", 3),
+    ],
+)
+def test_compiled_hlo_interior_independent_of_ppermute(spec, channels):
+    """The acceptance assertion, on the COMPILED module text: after XLA
+    optimization, no instruction tagged halo_overlap_interior_g<k> depends
+    on a collective-permute of exchange group >= k (group k's interior may
+    depend on group k-1's exchange — its input tile does)."""
+    img = jnp.asarray(synthetic_image(128, 96, channels=channels, seed=9))
+    fn = Pipeline.parse(spec).sharded(make_mesh(8), halo_mode="overlap")
+    comps = _parse_hlo(fn.lower(img).compile().as_text())
+    n_interior = n_cp = 0
+    boundary_sees_cp = False
+    for c, d in comps.items():
+        for i, info in d["instrs"].items():
+            if info["op"] == "collective-permute":
+                n_cp += 1
+            if _scope_group(info["op_name"], "halo_overlap_boundary_g") is not None:
+                boundary_sees_cp = boundary_sees_cp or bool(
+                    _hlo_reaching(comps, (c, i), "collective-permute")
+                )
+            g = _scope_group(info["op_name"], "halo_overlap_interior_g")
+            if g is None:
+                continue
+            n_interior += 1
+            for cc, ci in _hlo_reaching(comps, (c, i), "collective-permute"):
+                cg = _scope_group(
+                    comps[cc]["instrs"][ci]["op_name"], "halo_exchange_g"
+                )
+                assert cg is not None and cg < g, (
+                    f"interior g{g} instr {i} depends on collective-permute "
+                    f"{ci} (exchange group {cg})"
+                )
+    assert n_cp >= 2, "no collective-permute survived compilation?"
+    assert n_interior > 0, "interior scope lost in compiled metadata"
+    assert boundary_sees_cp, (
+        "boundary never reaches a collective-permute — parser or scoping "
+        "broken (positive control)"
+    )
+
+
+@needs_8dev
+def test_serial_mode_has_no_overlap_scopes():
+    """halo_mode='serial' must lower the unchanged serial structure — no
+    overlap scopes, and the stencil output does depend on the exchange."""
+    mod = _module_for("gaussian:5", halo_mode="serial")
+    assert not mod.values_where(loc_substr=r"halo_overlap_interior")
+    assert mod.values_where(kind="stablehlo.collective_permute")
+
+
+# --------------------------------------------------------------------------
+# Building-block unit tests
+# --------------------------------------------------------------------------
+
+
+def test_edge_and_interior_slices():
+    from mpi_cuda_imagemanipulation_tpu.ops.spec import (
+        edge_slices,
+        interior_slice,
+    )
+
+    x = jnp.arange(24).reshape(6, 4)
+    first, last = edge_slices(x, 2)
+    np.testing.assert_array_equal(np.asarray(first), np.asarray(x[:2]))
+    np.testing.assert_array_equal(np.asarray(last), np.asarray(x[4:]))
+    np.testing.assert_array_equal(
+        np.asarray(interior_slice(x, 2)), np.asarray(x[2:4])
+    )
+    f1, l1 = edge_slices(x, 1, axis=1)
+    np.testing.assert_array_equal(np.asarray(f1), np.asarray(x[:, :1]))
+    np.testing.assert_array_equal(np.asarray(l1), np.asarray(x[:, 3:]))
+
+
+def test_piece_edge_rows():
+    from mpi_cuda_imagemanipulation_tpu.parallel.api import _piece_edge_rows
+
+    top = jnp.zeros((1, 4)) + 1
+    mid = jnp.zeros((5, 4)) + 2
+    bot = jnp.zeros((1, 4)) + 3
+    # k <= boundary thickness: edge rows come from the boundary pieces only
+    first, last = _piece_edge_rows([top, mid, bot], 1)
+    assert float(first[0, 0]) == 1 and float(last[0, 0]) == 3
+    # k spills into the interior piece
+    first, last = _piece_edge_rows([top, mid, bot], 3)
+    whole = np.asarray(jnp.concatenate([top, mid, bot], axis=0))
+    np.testing.assert_array_equal(np.asarray(first), whole[:3])
+    np.testing.assert_array_equal(np.asarray(last), whole[-3:])
+
+
+@needs_8dev
+def test_exchange_edge_strips_matches_tile_slicing():
+    """The pre-sliced strip exchange (the prefetch primitive) must be
+    byte-identical to exchange_halo_strips on the same tile."""
+    from jax.sharding import PartitionSpec as P
+
+    from mpi_cuda_imagemanipulation_tpu.parallel.halo import (
+        exchange_edge_strips,
+        exchange_halo_strips,
+    )
+    from mpi_cuda_imagemanipulation_tpu.parallel.mesh import (
+        ROWS,
+        shard_map_compat,
+    )
+
+    mesh = make_mesh(8)
+    img = jnp.asarray(synthetic_image(64, 32, channels=1, seed=11))
+
+    def via_tile(tile):
+        t, b = exchange_halo_strips(tile, 2, 8)
+        return jnp.concatenate([t, b], axis=0)
+
+    def via_strips(tile):
+        t, b = exchange_edge_strips(tile[:2], tile[-2:], 8)
+        return jnp.concatenate([t, b], axis=0)
+
+    outs = []
+    for f in (via_tile, via_strips):
+        fn = jax.jit(
+            shard_map_compat(
+                f, mesh=mesh, in_specs=P(ROWS, None),
+                out_specs=P(ROWS, None), check_vma=False,
+            )
+        )
+        outs.append(np.asarray(fn(img)))
+    np.testing.assert_array_equal(outs[0], outs[1])
+
+
+@needs_8dev
+def test_bench_halo_ab_record_structure(monkeypatch):
+    """The sharded bench A/B emits serial/overlap timings, a per-group
+    comms/compute breakdown and comms_hidden_frac (timings stubbed — this
+    asserts structure and arithmetic, not hardware numbers)."""
+    from mpi_cuda_imagemanipulation_tpu import bench_suite as bs
+
+    fake = {"n": 0}
+
+    def fake_throughput(fn, args, **kw):
+        fake["n"] += 1
+        return 0.010 if fake["n"] % 2 else 0.008  # seconds
+
+    monkeypatch.setattr(bs, "device_throughput", fake_throughput)
+    monkeypatch.setenv("MCIM_HALO_AB", "1")
+    cfg = bs.BenchConfig("t", "gaussian:5", 64, 96, 1, sharded=True)
+    rec = bs.run_config(cfg, "xla")
+    assert rec["halo_mode"] == "serial"
+    ab = rec["halo_ab"]
+    assert set(ab) >= {
+        "serial_ms", "overlap_ms", "per_group", "comms_ms_total",
+        "compute_ms_est", "comms_hidden_frac",
+    }
+    assert len(ab["per_group"]) == 1
+    g = ab["per_group"][0]
+    assert g["ops"] == ["gaussian5"] and g["halo"] == 2
+    assert g["comms_ms"] > 0 and "compute_ms_est" in g
+    assert 0.0 <= ab["comms_hidden_frac"] <= 1.0
+
+
+@needs_8dev
+def test_bench_overlap_config_registered():
+    from mpi_cuda_imagemanipulation_tpu import bench_suite as bs
+
+    cfg = bs.CONFIGS["gaussian5_8k_sharded_overlap"]
+    assert cfg.sharded and cfg.halo_mode == "overlap"
+    assert bs.CONFIGS["gaussian5_8k_sharded"].halo_mode == "serial"

@@ -269,6 +269,84 @@ def test_bench_spread_filters_to_headline_impl(tmp_path):
     assert mod._same_round_tpu_spread(str(hist), str(marker))["n"] == 4
 
 
+def test_bench_spread_extra_respects_impl_filter(tmp_path):
+    """The `extra` fresh sighting passes the same impl filter as committed
+    sightings: a fresh run of a deliberately-slower impl must not fake
+    variance on a promoted headline of a different impl (ADVICE r5
+    finding 2)."""
+    mod = _load_bench_module()
+    hist = tmp_path / "hist.jsonl"
+    marker = tmp_path / "ROUND_START"
+    marker.write_text("2026-08-01T00:00:00Z\n")
+    hist.write_text(
+        json.dumps(
+            {"ts": "2026-08-01T08:30:00Z",
+             "headline": {"platform": "tpu", "value": 44000.0,
+                          "impl": "pallas"}}
+        )
+        + "\n"
+    )
+    # fresh xla sighting vs a pallas headline: excluded
+    got = mod._same_round_tpu_spread(
+        str(hist), str(marker),
+        extra=(11400.0, "2026-08-01T09:00:00Z", "xla"), impl="pallas",
+    )
+    assert got["n"] == 1 and got["min"] == 44000.0
+    # same impl: included
+    got = mod._same_round_tpu_spread(
+        str(hist), str(marker),
+        extra=(46000.0, "2026-08-01T09:00:00Z", "pallas"), impl="pallas",
+    )
+    assert got["n"] == 2 and got["best"] == 46000.0
+    # impl-less fresh sighting still counts (pre-stamping convention)
+    got = mod._same_round_tpu_spread(
+        str(hist), str(marker),
+        extra=(46000.0, "2026-08-01T09:00:00Z", None), impl="pallas",
+    )
+    assert got["n"] == 2
+
+
+def test_bench_promotion_appends_surviving_records(monkeypatch, capsys):
+    """The same-round-promotion early return must still append the run's
+    surviving measured records to history — the append-only 'every run's
+    records' contract (ADVICE r5 finding 1)."""
+    mod = _load_bench_module()
+    probes = iter([("tpu", "ok")])
+    monkeypatch.setattr(
+        mod, "_probe_with_backoff", lambda schedule: next(probes, None)
+    )
+    monkeypatch.setattr(mod, "_same_round_tpu_spread", lambda *a, **k: None)
+    monkeypatch.setattr(mod, "git_head_sha", lambda: "testhead")
+
+    def fake_run_config(name, impl, env=None):
+        if name == "reference_pipeline_4k":
+            return (
+                {"config": name, "impl": impl, "platform": "tpu",
+                 "mp_per_s_per_chip": 70000.0},
+                None,
+            )
+        return None, f"{name}/{impl}: wedged"
+
+    monkeypatch.setattr(mod, "_run_config", fake_run_config)
+    monkeypatch.setattr(
+        mod,
+        "_same_round_tpu_headline",
+        lambda: {
+            "ts": "2026-08-01T08:31:00Z",
+            "headline": {"value": 45376.9, "unit": "MP/s/chip",
+                         "impl": "pallas", "platform": "tpu"},
+        },
+    )
+    appended = []
+    monkeypatch.setattr(
+        mod, "_append_history", lambda out, recs: appended.append(recs)
+    )
+    assert mod.main() == 0
+    capsys.readouterr()
+    assert len(appended) == 1
+    assert [r["config"] for r in appended[0]] == ["reference_pipeline_4k"]
+
+
 def test_bench_best_of_run_and_committed(tmp_path):
     """A healthy-but-cold round-end run must not bury a warmer committed
     same-round TPU record (window-noise guard): the better value wins, with

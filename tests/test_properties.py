@@ -6,7 +6,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from hypothesis import given, settings, strategies as st
+
+# hypothesis is an optional dev dependency; environments without it
+# (e.g. minimal containers) skip the property suite instead of erroring
+# at collection
+hypothesis = pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from mpi_cuda_imagemanipulation_tpu.io.image import synthetic_image
 from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline

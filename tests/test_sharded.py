@@ -22,28 +22,38 @@ pytestmark = pytest.mark.skipif(
 )
 
 
-def _assert_sharded_equals_golden(pipe, img, n):
+HALO_MODES = ("serial", "overlap")
+
+
+def _assert_sharded_equals_golden(pipe, img, n, halo_mode="serial"):
     mesh = make_mesh(n)
     golden = np.asarray(pipe(jnp.asarray(img)))
-    sharded = np.asarray(pipe.sharded(mesh)(jnp.asarray(img)))
+    sharded = np.asarray(
+        pipe.sharded(mesh, halo_mode=halo_mode)(jnp.asarray(img))
+    )
     np.testing.assert_array_equal(sharded, golden)
 
 
+@pytest.mark.parametrize("halo_mode", HALO_MODES)
 @pytest.mark.parametrize("n", [1, 2, 4, 8])
-def test_reference_pipeline_sharded_bitexact(n):
+def test_reference_pipeline_sharded_bitexact(n, halo_mode):
     img = synthetic_image(128, 96, channels=3, seed=20)
-    _assert_sharded_equals_golden(reference_pipeline(), img, n)
+    _assert_sharded_equals_golden(reference_pipeline(), img, n, halo_mode)
 
 
+@pytest.mark.parametrize("halo_mode", HALO_MODES)
 @pytest.mark.parametrize("n", [4, 8])
 @pytest.mark.parametrize("height", [131, 101])
-def test_uneven_height_not_truncated(n, height):
+def test_uneven_height_not_truncated(n, height, halo_mode):
     # The reference silently drops rows % size rows (kernel.cu:117); we pad
     # and crop, so every row survives and matches the unsharded result.
+    # (Pad rows gate the overlap path out per group — the knob must still
+    # produce bit-identical output via the serial fallback.)
     img = synthetic_image(height, 64, channels=3, seed=21)
-    _assert_sharded_equals_golden(reference_pipeline(), img, n)
+    _assert_sharded_equals_golden(reference_pipeline(), img, n, halo_mode)
 
 
+@pytest.mark.parametrize("halo_mode", HALO_MODES)
 @pytest.mark.parametrize(
     "spec",
     [
@@ -52,9 +62,9 @@ def test_uneven_height_not_truncated(n, height):
         "filter:1/2/1/2/4/2/1/2/1:0.0625",
     ],
 )
-def test_reflect_stencils_sharded_bitexact(spec):
+def test_reflect_stencils_sharded_bitexact(spec, halo_mode):
     img = synthetic_image(133, 80, channels=1, seed=22)
-    _assert_sharded_equals_golden(Pipeline.parse(spec), img, 8)
+    _assert_sharded_equals_golden(Pipeline.parse(spec), img, 8, halo_mode)
 
 
 @pytest.mark.parametrize("size", [3, 5])
@@ -72,10 +82,55 @@ def test_emboss_sharded_no_seams(size):
     np.testing.assert_array_equal(sharded, golden)
 
 
-def test_long_mixed_pipeline_sharded():
+@pytest.mark.parametrize("halo_mode", HALO_MODES)
+def test_long_mixed_pipeline_sharded(halo_mode):
+    # multi-group: under overlap, group k+1's exchange prefetches from
+    # group k's boundary outputs across the intervening pointwise chain
     img = synthetic_image(136, 72, channels=3, seed=24)
     pipe = Pipeline.parse("grayscale,gaussian:5,sobel,threshold:100,gray2rgb")
-    _assert_sharded_equals_golden(pipe, img, 8)
+    _assert_sharded_equals_golden(pipe, img, 8, halo_mode)
+
+
+@pytest.mark.parametrize(
+    "spec",
+    [
+        "gaussian:5,gaussian:5",   # equal-halo prefetch
+        "gaussian:7,emboss:3",     # shrinking halo across groups
+        "emboss:3,gaussian:7",     # growing halo: prefetch needs interior rows
+        "grayscale,equalize,gaussian:5",  # GlobalOp breaks the prefetch chain
+        "erode:5,dilate:3",        # edge-mode morphology pair
+    ],
+)
+def test_overlap_multi_group_bitexact(spec):
+    img = synthetic_image(128, 80, channels=3, seed=35)
+    _assert_sharded_equals_golden(Pipeline.parse(spec), img, 8, "overlap")
+
+
+def test_overlap_rejects_unknown_mode():
+    pipe = Pipeline.parse("gaussian:5")
+    with pytest.raises(ValueError, match="halo_mode"):
+        pipe.sharded(make_mesh(8), halo_mode="pipelined")
+
+
+def test_cli_run_halo_mode_overlap(tmp_path):
+    """`run --shards 8 --halo-mode overlap` writes the same bytes as the
+    serial sharded run (the CLI threading of the knob, end to end)."""
+    from PIL import Image
+
+    from mpi_cuda_imagemanipulation_tpu.cli import main
+
+    src = tmp_path / "in.png"
+    Image.fromarray(synthetic_image(64, 48, channels=3, seed=40)).save(src)
+    outs = {}
+    for mode in ("serial", "overlap"):
+        dst = tmp_path / f"{mode}.png"
+        rc = main([
+            "run", "--input", str(src), "--output", str(dst),
+            "--device", "cpu", "--shards", "8", "--halo-mode", mode,
+        ])
+        assert rc == 0
+        outs[mode] = np.asarray(Image.open(dst))
+    np.testing.assert_array_equal(outs["serial"], outs["overlap"])
 
 
 def test_pointwise_only_pipeline_sharded():
@@ -90,14 +145,38 @@ def test_too_many_shards_raises():
         pipe.sharded(make_mesh(8))(jnp.asarray(img))
 
 
+@pytest.mark.parametrize("halo_mode", HALO_MODES)
 @pytest.mark.parametrize("spec", ["grayscale,contrast:3.5,emboss:3", "gaussian:5"])
-def test_sharded_auto_backend_bitexact(spec):
+def test_sharded_auto_backend_bitexact(spec, halo_mode):
     img = synthetic_image(
         131, 96, channels=3 if spec.startswith("grayscale") else 1, seed=29
     )
     pipe = Pipeline.parse(spec)
     golden = np.asarray(pipe(jnp.asarray(img)))
-    sharded = np.asarray(pipe.sharded(make_mesh(8), backend="auto")(jnp.asarray(img)))
+    sharded = np.asarray(
+        pipe.sharded(make_mesh(8), backend="auto", halo_mode=halo_mode)(
+            jnp.asarray(img)
+        )
+    )
+    np.testing.assert_array_equal(sharded, golden)
+
+
+@pytest.mark.parametrize(
+    "spec", ["gaussian:5", "emboss:5", "grayscale,contrast:3.5,emboss:3"]
+)
+def test_sharded_pallas_overlap_bitexact(spec):
+    # overlap with the Pallas backend: the interior runs the u8 tile
+    # kernel on the raw tile (no ghost refs), boundary strips run XLA
+    img = synthetic_image(
+        128, 96, channels=3 if spec.startswith("grayscale") else 1, seed=30
+    )
+    pipe = Pipeline.parse(spec)
+    golden = np.asarray(pipe(jnp.asarray(img)))
+    sharded = np.asarray(
+        pipe.sharded(make_mesh(8), backend="pallas", halo_mode="overlap")(
+            jnp.asarray(img)
+        )
+    )
     np.testing.assert_array_equal(sharded, golden)
 
 

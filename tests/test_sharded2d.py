@@ -28,21 +28,28 @@ def _img(h, w, channels=3, seed=7):
     return np.asarray(synthetic_image(h, w, channels=channels, seed=seed))
 
 
-def _check(spec, h, w, mesh_shape=(2, 4), channels=3, seed=7):
+HALO_MODES = ("serial", "overlap")
+
+
+def _check(spec, h, w, mesh_shape=(2, 4), channels=3, seed=7,
+           halo_mode="serial"):
     pipe = Pipeline.parse(spec)
     img = _img(h, w, channels=channels, seed=seed)
     golden = np.asarray(pipe(img))
-    got = np.asarray(pipe.sharded(make_mesh_2d(*mesh_shape))(img))
+    got = np.asarray(
+        pipe.sharded(make_mesh_2d(*mesh_shape), halo_mode=halo_mode)(img)
+    )
     assert got.shape == golden.shape
     if not np.array_equal(got, golden):
         d = np.argwhere(np.asarray(got) != golden)
         raise AssertionError(
-            f"{spec} ({h}x{w}, mesh {mesh_shape}): {len(d)} pixels differ, "
-            f"first at {d[0]}"
+            f"{spec} ({h}x{w}, mesh {mesh_shape}, {halo_mode}): "
+            f"{len(d)} pixels differ, first at {d[0]}"
         )
 
 
 @needs_8dev
+@pytest.mark.parametrize("halo_mode", HALO_MODES)
 @pytest.mark.parametrize("spec", [
     "grayscale,contrast:3.5,emboss:3",  # reference pipeline, interior mode
     "gaussian:5",                        # separable, reflect-101, halo 2
@@ -51,31 +58,36 @@ def _check(spec, h, w, mesh_shape=(2, 4), channels=3, seed=7):
     "median:3",                          # rank filter
     "unsharp",                           # 5x5 non-separable
 ])
-def test_2d_matches_golden(spec):
-    _check(spec, 64, 96)
+def test_2d_matches_golden(spec, halo_mode):
+    _check(spec, 64, 96, halo_mode=halo_mode)
 
 
 @needs_8dev
+@pytest.mark.parametrize("halo_mode", HALO_MODES)
 @pytest.mark.parametrize("mesh_shape", [(2, 4), (4, 2), (8, 1), (1, 8), (2, 2)])
-def test_2d_mesh_geometries(mesh_shape):
-    _check("grayscale,gaussian:5,emboss:3", 72, 88, mesh_shape=mesh_shape)
+def test_2d_mesh_geometries(mesh_shape, halo_mode):
+    _check("grayscale,gaussian:5,emboss:3", 72, 88, mesh_shape=mesh_shape,
+           halo_mode=halo_mode)
 
 
 @needs_8dev
+@pytest.mark.parametrize("halo_mode", HALO_MODES)
 @pytest.mark.parametrize("hw", [
-    (63, 95),   # pad 1 row + 1 col
+    (63, 95),   # pad 1 row + 1 col (overlap falls back to serial here)
     (66, 98),   # pad 2 rows + 2 cols
     (64, 96),   # exact multiples
 ])
-def test_2d_pad_to_multiple(hw):
-    _check("gaussian:5", hw[0], hw[1])
+def test_2d_pad_to_multiple(hw, halo_mode):
+    _check("gaussian:5", hw[0], hw[1], halo_mode=halo_mode)
 
 
 @needs_8dev
-def test_2d_corner_dependence():
+@pytest.mark.parametrize("halo_mode", HALO_MODES)
+def test_2d_corner_dependence(halo_mode):
     """A 2-pass blur makes corner pixels of interior tiles depend on their
-    diagonal neighbour's data — wrong or zero corner ghosts cannot pass."""
-    _check("gaussian:5,gaussian:5", 64, 96)
+    diagonal neighbour's data — wrong or zero corner ghosts cannot pass
+    (under overlap the corners live in the full-width boundary bands)."""
+    _check("gaussian:5,gaussian:5", 64, 96, halo_mode=halo_mode)
 
 
 @needs_8dev

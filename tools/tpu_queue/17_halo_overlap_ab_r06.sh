@@ -1,0 +1,33 @@
+#!/bin/bash
+# Sharded serial-vs-overlap halo A/B (round 6): per mesh size, how much of
+# the ring-ppermute ghost-exchange latency does the interior-first
+# overlapped execution (parallel/api.py halo_mode=overlap) hide behind
+# interior compute? Two records per mesh size:
+#   1. the serial lane with MCIM_HALO_AB=1 — carries serial_ms/overlap_ms,
+#      the per-group comms/compute breakdown and comms_hidden_frac
+#      (bench_suite._halo_ab) alongside MP/s;
+#   2. the overlap lane as its own first-class MP/s record (A/B re-timing
+#      suppressed — the pair above already has both numbers).
+# Single-chip (shards=1) rides along as the zero-comms control: ghost
+# strips are zeros there, so serial==overlap within noise bounds the
+# measurement floor. Budget: ~4-8 min warm per mesh size (sharded 8K
+# executables cached from 16_sharded_r05; the overlap executables are new
+# compiles on the first window).
+set -u
+cd "$(dirname "$0")/../.."
+. tools/tpu_queue/_lib.sh
+out=artifacts/halo_overlap_ab_r06.out
+: > "$out"
+ndev=$(timeout 120 python -c 'import jax; print(len(jax.devices()))' 2>/dev/null || echo 1)
+for shards in 1 2 4 8; do
+  [ "$shards" -gt "$ndev" ] && break
+  echo "=== mesh size $shards ===" >> "$out"
+  MCIM_HALO_AB=1 timeout 1200 python -m mpi_cuda_imagemanipulation_tpu.bench_suite \
+    --config gaussian5_8k_sharded --impl pallas --shards "$shards" \
+    >> "$out" 2>&1
+  MCIM_HALO_AB=0 timeout 900 python -m mpi_cuda_imagemanipulation_tpu.bench_suite \
+    --config gaussian5_8k_sharded_overlap --impl pallas --shards "$shards" \
+    >> "$out" 2>&1
+done
+commit_artifacts "TPU window: sharded serial-vs-overlap halo A/B (round 6)" "$out"
+exit 0

@@ -15,5 +15,5 @@ timeout 3600 python tools/tpu_validate.py --out VALIDATE_r05.json \
 rc=$?
 arts=(artifacts/validate_r05b.out)
 [ -f VALIDATE_r05.json ] && arts+=(VALIDATE_r05.json)
-commit_artifacts "TPU window: hardware validation sweep (round 4)" "${arts[@]}"
+commit_artifacts "TPU window: hardware validation sweep (round 5 re-run)" "${arts[@]}"
 exit $rc

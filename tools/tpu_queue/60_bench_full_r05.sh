@@ -9,6 +9,6 @@ cd "$(dirname "$0")/../.."
 . tools/tpu_queue/_lib.sh
 timeout 3600 python bench.py > artifacts/bench_r05_manual.out 2>&1
 rc=$?
-commit_artifacts "TPU window: full bench campaign (round 4)" \
+commit_artifacts "TPU window: full bench campaign (round 5)" \
   BENCH_HISTORY.jsonl artifacts/bench_r05_manual.out
 exit $rc
