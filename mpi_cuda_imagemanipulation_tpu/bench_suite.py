@@ -316,6 +316,101 @@ def run_config(cfg: BenchConfig, impl: str, *, n_shards: int | None = None) -> d
     return rec
 
 
+SERVE_LOADGEN = "serve_loadgen"
+
+
+def serve_loadgen_params() -> dict:
+    """The serving-lane knobs, sized to the backend: CPU keeps the sweep
+    small enough for tests/dev; real hardware gets serving-sized buckets
+    and offered loads (override points for tools/tpu_queue via env:
+    MCIM_SERVE_RPS as a comma list, MCIM_SERVE_DURATION_S)."""
+    on_tpu = is_tpu_backend()
+    params = {
+        "ops": "grayscale,contrast:3.5,emboss:3",
+        "buckets": ((512, 512), (1024, 1024), (2048, 2048))
+        if on_tpu
+        else ((64, 64), (128, 128)),
+        "max_batch": 8,
+        "max_delay_ms": 4.0,
+        "queue_depth": 256,
+        # the sweep should cross saturation: the last rate must exceed the
+        # single-dispatch service rate so queueing (and hence coalescing)
+        # actually shows up in the occupancy column
+        "offered_rps": (64.0, 256.0, 1024.0) if on_tpu else (50.0, 200.0, 800.0),
+        "duration_s": 4.0 if on_tpu else 1.5,
+        "n_images": 48,
+    }
+    rps_env = os.environ.get("MCIM_SERVE_RPS")
+    if rps_env:
+        params["offered_rps"] = tuple(
+            float(t) for t in rps_env.split(",") if t.strip()
+        )
+    dur_env = os.environ.get("MCIM_SERVE_DURATION_S")
+    if dur_env:
+        params["duration_s"] = float(dur_env)
+    return params
+
+
+def run_serve_loadgen(
+    *,
+    json_path: str | None = None,
+    printer: Callable[[str], None] = print,
+) -> dict:
+    """The online-serving bench lane: stand up a ServeApp, sweep open-loop
+    offered load, report throughput vs latency percentiles plus the
+    batch-occupancy curve (serve/loadgen.py). One record, `sweep` inside."""
+    from mpi_cuda_imagemanipulation_tpu.serve import loadgen
+    from mpi_cuda_imagemanipulation_tpu.serve.server import ServeApp, ServeConfig
+
+    p = serve_loadgen_params()
+    app = ServeApp(
+        ServeConfig(
+            ops=p["ops"],
+            buckets=p["buckets"],
+            max_batch=p["max_batch"],
+            max_delay_ms=p["max_delay_ms"],
+            queue_depth=p["queue_depth"],
+            channels=(3,),
+        )
+    ).start()
+    try:
+        sweep = loadgen.sweep(
+            app,
+            offered_rps=p["offered_rps"],
+            duration_s=p["duration_s"],
+            n_images=p["n_images"],
+        )
+    finally:
+        app.stop(drain=True)
+    rec = {
+        "config": SERVE_LOADGEN,
+        "pipeline": p["ops"],
+        "impl": "xla",
+        "platform": jax.default_backend(),
+        "buckets": [f"{h}x{w}" for h, w in p["buckets"]],
+        "max_batch": p["max_batch"],
+        "max_delay_ms": p["max_delay_ms"],
+        "queue_depth": p["queue_depth"],
+        "cache": app.cache.stats(),
+        "sweep": sweep,
+    }
+    printer(
+        f"{'offered rps':>11s} {'achieved':>9s} {'shed%':>6s} {'occup':>6s} "
+        f"{'p50 ms':>8s} {'p95 ms':>8s} {'p99 ms':>8s}"
+    )
+    for s in sweep:
+        printer(
+            f"{s['offered_rps']:11.0f} {s['achieved_rps']:9.1f} "
+            f"{s['shed_frac'] * 100:5.1f}% {s.get('mean_batch_occupancy') or 0:6.2f} "
+            f"{s.get('e2e_p50_ms', float('nan')):8.2f} "
+            f"{s.get('e2e_p95_ms', float('nan')):8.2f} "
+            f"{s.get('e2e_p99_ms', float('nan')):8.2f}"
+        )
+    if json_path:
+        emit_json_metrics(rec, None if json_path == "-" else json_path)
+    return rec
+
+
 def run_suite(
     names: Sequence[str] | None = None,
     *,
@@ -326,11 +421,22 @@ def run_suite(
 ) -> list[dict]:
     log = get_logger()
     impls = ("xla", "pallas") if impl == "both" else (impl,)
+    records: list[dict] = []
+    if names and SERVE_LOADGEN in names:
+        # the serving lane is not a BenchConfig (it measures a queueing
+        # system, not one executable) — run it on the side and keep going
+        names = [n for n in names if n != SERVE_LOADGEN]
+        records.append(
+            run_serve_loadgen(json_path=json_path, printer=printer)
+        )
+        if not names:
+            return records
     if names:
         unknown = [n for n in names if n not in CONFIGS]
         if unknown:
             raise ValueError(
-                f"unknown bench config(s) {unknown}; known: {sorted(CONFIGS)}"
+                f"unknown bench config(s) {unknown}; known: "
+                f"{sorted(CONFIGS) + [SERVE_LOADGEN]}"
             )
         selected = [CONFIGS[n] for n in names]
     else:
@@ -340,7 +446,6 @@ def run_suite(
             dataclasses.replace(c, halo_mode=halo_mode) if c.sharded else c
             for c in selected
         ]
-    records = []
     printer(
         f"{'config':26s} {'impl':7s} {'chips':>5s} {'ms/iter':>9s} "
         f"{'MP/s':>10s} {'MP/s/chip':>10s} {'roofline':>9s}"
@@ -425,7 +530,11 @@ def main(argv: Sequence[str] | None = None) -> int:
     import json
 
     ap = argparse.ArgumentParser(prog="bench_suite")
-    ap.add_argument("--config", required=True, choices=sorted(CONFIGS))
+    ap.add_argument(
+        "--config",
+        required=True,
+        choices=sorted(CONFIGS) + [SERVE_LOADGEN],
+    )
     ap.add_argument(
         "--impl",
         default="pallas",
@@ -445,10 +554,13 @@ def main(argv: Sequence[str] | None = None) -> int:
         "device) — the serial-vs-overlap A/B sweeps this",
     )
     args = ap.parse_args(argv)
-    cfg = CONFIGS[args.config]
-    if args.halo_mode is not None and cfg.sharded:
-        cfg = dataclasses.replace(cfg, halo_mode=args.halo_mode)
-    rec = run_config(cfg, args.impl, n_shards=args.shards)
+    if args.config == SERVE_LOADGEN:
+        rec = run_serve_loadgen(printer=lambda s: None)
+    else:
+        cfg = CONFIGS[args.config]
+        if args.halo_mode is not None and cfg.sharded:
+            cfg = dataclasses.replace(cfg, halo_mode=args.halo_mode)
+        rec = run_config(cfg, args.impl, n_shards=args.shards)
     print(json.dumps(rec), flush=True)
     return 0
 

@@ -10,6 +10,8 @@ Usage:
       [--ops grayscale,contrast:3.5,emboss:3] [--impl xla|pallas]
       [--shards N] [--device cpu|tpu] [--show-timing] [--json-metrics PATH|-]
       [--profile-dir DIR]
+  python -m mpi_cuda_imagemanipulation_tpu serve [--ops ...] [--buckets ...]
+      [--max-batch N] [--max-delay-ms MS] [--queue-depth N] [--port P]
   python -m mpi_cuda_imagemanipulation_tpu bench [--configs ...]
   python -m mpi_cuda_imagemanipulation_tpu info [--device cpu|tpu]
 
@@ -169,6 +171,82 @@ def _build_parser() -> argparse.ArgumentParser:
         default=None,
         help="write a JSON metrics line (incl. the skipped-file list) to "
         "this path ('-' = stdout)",
+    )
+
+    srv = sub.add_parser(
+        "serve",
+        help="online micro-batching server: POST /v1/process (image bytes "
+        "in, PNG out), GET /healthz, GET /stats — bounded queue, shape-"
+        "bucketed pre-warmed compile cache, bit-identical to per-request "
+        "`run` output (serve/)",
+    )
+    srv.add_argument("--ops", default="grayscale,contrast:3.5,emboss:3")
+    srv.add_argument(
+        "--impl",
+        choices=("auto", "xla"),
+        default="xla",
+        help="serving computes with XLA fusion (the bucket-padded executor "
+        "rebuilds each op's border at the dynamic true shape, which the "
+        "Pallas streaming kernels' static in-kernel edge extension cannot "
+        "do); 'auto' is an accepted alias for xla",
+    )
+    srv.add_argument(
+        "--shards",
+        type=int,
+        default=1,
+        help="data-parallel serving over N devices: each dispatch's stack "
+        "shards over the mesh batch axis (batch sizes are rounded to "
+        "mesh multiples); 1 = single device",
+    )
+    srv.add_argument(
+        "--buckets",
+        default="512,1024,2048,4096",
+        help="comma-separated shape buckets, N (square) or RxC; requests "
+        "pad up to the smallest fitting bucket so every executable is "
+        "compiled at startup — larger images are rejected, never traced",
+    )
+    srv.add_argument(
+        "--max-batch",
+        type=int,
+        default=8,
+        help="requests coalesced per dispatch (must be a multiple of "
+        "--shards)",
+    )
+    srv.add_argument(
+        "--max-delay-ms",
+        type=float,
+        default=5.0,
+        help="longest a request waits for batch-mates before a partial "
+        "dispatch ships (the latency cost ceiling of coalescing)",
+    )
+    srv.add_argument(
+        "--queue-depth",
+        type=int,
+        default=64,
+        help="admission bound: submissions beyond this many queued "
+        "requests are shed with the 'overloaded' status (HTTP 429) "
+        "instead of buffering without bound",
+    )
+    srv.add_argument(
+        "--deadline-ms",
+        type=float,
+        default=None,
+        help="per-request deadline; requests that expire while queued are "
+        "answered 'deadline_expired' (HTTP 504) and never take a device "
+        "slot",
+    )
+    srv.add_argument(
+        "--channels",
+        default="1,3",
+        help="channel counts to pre-compile (and admit), comma-separated",
+    )
+    srv.add_argument("--host", default="", help="bind address")
+    srv.add_argument("--port", type=int, default=8000)
+    srv.add_argument("--device", default=None)
+    srv.add_argument(
+        "--json-metrics",
+        default=None,
+        help="write the shutdown stats record to this path ('-' = stdout)",
     )
 
     bench = sub.add_parser("bench", help="run the benchmark suite")
@@ -465,8 +543,9 @@ def cmd_batch(args: argparse.Namespace) -> int:
         # row-sharded latency path); a 2-D spec contributes its flat count
         if stack % n_flat:
             log.warning(
-                "--stack %d is not a multiple of %d devices: every "
-                "dispatch pads to %d images and discards the pad's compute; "
+                "--stack %d is not a multiple of %d devices: full mid-"
+                "stream dispatches pad to %d images and discard the pad's "
+                "compute (the trailing partial stack ships right-sized); "
                 "round --stack to a mesh multiple to avoid the waste",
                 stack, n_flat, -(-stack // n_flat) * n_flat,
             )
@@ -510,21 +589,30 @@ def cmd_batch(args: argparse.Namespace) -> int:
     # same-shape images accumulate into a stack and ship as one dispatch;
     # a shape change flushes the pending stack (stack == 1: ship per image)
     pending: list[tuple[int, np.ndarray]] = []
+    from mpi_cuda_imagemanipulation_tpu.serve.bucketing import pad_stack
 
-    def flush_pending():
+    def flush_pending(final: bool = False):
         nonlocal pending
         if not pending:
             return
         idxs = [i for i, _ in pending]
         if stack > 1:
             imgs = [im for _, im in pending]
-            # pad a partial stack by repeating the last image so every
-            # dispatch for a given image shape reuses one compiled batch
-            # shape (a ragged trailing batch would force a recompile —
-            # the very overhead --stack amortises); padded outputs are
-            # dropped in drain_one, which iterates idxs only
-            imgs += [imgs[-1]] * (stack - len(imgs))
-            inflight.append((idxs, fn(np.stack(imgs, axis=0))))
+            if final and len(imgs) < stack:
+                # the TRAILING partial stack ships right-sized: one
+                # tail-shaped compile beats padding to --stack and
+                # discarding the pad's compute (the data-parallel runner
+                # still pads internally, but only to a mesh multiple)
+                inflight.append((idxs, fn(np.stack(imgs, axis=0))))
+            else:
+                # mid-stream partial (shape-change flush): pad by
+                # repeating the last image so every dispatch for a given
+                # image shape reuses one compiled batch shape — the shape
+                # may recur, and a ragged batch would recompile each time
+                # (serve/bucketing.pad_stack — shared with the serving
+                # scheduler); padded outputs are dropped in drain_one,
+                # which iterates idxs only
+                inflight.append((idxs, fn(pad_stack(imgs, stack))))
         else:
             inflight.append((idxs, fn(pending[0][1])))
         pending = []
@@ -542,7 +630,7 @@ def cmd_batch(args: argparse.Namespace) -> int:
         total_mp += img.shape[0] * img.shape[1] / 1e6
         if stack == 1:
             flush_pending()
-    flush_pending()
+    flush_pending(final=True)
     while inflight:
         drain_one()
     wall = time.perf_counter() - t0
@@ -584,6 +672,67 @@ def cmd_batch(args: argparse.Namespace) -> int:
     # partial failure (skipped inputs) is a nonzero exit for scripted
     # callers — distinct from the no-inputs-matched exit (3) above
     return 0 if done == len(paths) else 1
+
+
+def cmd_serve(args: argparse.Namespace) -> int:
+    """Online serving: warm the shape-bucket compile cache, start the
+    micro-batching scheduler, serve HTTP until interrupted, then print the
+    metrics summary (the north star's "heavy traffic" front door)."""
+    _configure_platform(args.device)
+    from mpi_cuda_imagemanipulation_tpu.serve.bucketing import parse_buckets
+    from mpi_cuda_imagemanipulation_tpu.serve.server import (
+        ServeApp,
+        ServeConfig,
+        make_http_server,
+    )
+    from mpi_cuda_imagemanipulation_tpu.utils.log import (
+        emit_json_metrics,
+        get_logger,
+    )
+
+    log = get_logger()
+    try:
+        channels = tuple(
+            sorted({int(c) for c in args.channels.split(",") if c.strip()})
+        )
+    except ValueError:
+        raise ValueError(f"--channels must be comma-separated ints: {args.channels!r}")
+    if not channels or not set(channels) <= {1, 3}:
+        raise ValueError(f"--channels entries must be 1 and/or 3, got {channels}")
+    cfg = ServeConfig(
+        ops=args.ops,
+        buckets=parse_buckets(args.buckets),
+        max_batch=args.max_batch,
+        max_delay_ms=args.max_delay_ms,
+        queue_depth=args.queue_depth,
+        channels=channels,
+        shards=args.shards,
+        backend="xla" if args.impl == "auto" else args.impl,
+        default_deadline_ms=args.deadline_ms,
+    )
+    app = ServeApp(cfg).start()
+    httpd = make_http_server(app, args.host, args.port)
+    log.info(
+        "serving [%s] on %s:%d (buckets %s, max_batch %d, max_delay %.1fms, "
+        "queue_depth %d, shards %d) — POST /v1/process, GET /healthz, "
+        "GET /stats",
+        app.pipe.name, args.host or "0.0.0.0", httpd.server_address[1],
+        args.buckets, args.max_batch, args.max_delay_ms, args.queue_depth,
+        args.shards,
+    )
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        log.info("interrupt: draining and shutting down")
+    finally:
+        httpd.server_close()
+        app.stop(drain=True)
+        if args.json_metrics:
+            emit_json_metrics(
+                {"event": "serve", **app.stats()},
+                None if args.json_metrics == "-" else args.json_metrics,
+            )
+    return 0
 
 
 def cmd_bench(args: argparse.Namespace) -> int:
@@ -886,6 +1035,7 @@ def main(argv: list[str] | None = None) -> int:
     cmd = {
         "run": cmd_run,
         "batch": cmd_batch,
+        "serve": cmd_serve,
         "bench": cmd_bench,
         "diff": cmd_diff,
         "autotune": cmd_autotune,

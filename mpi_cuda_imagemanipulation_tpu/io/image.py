@@ -83,6 +83,37 @@ def save_image(path: str | os.PathLike, img: np.ndarray) -> None:
     Image.fromarray(img).save(path)
 
 
+def decode_image_bytes(data: bytes) -> np.ndarray:
+    """Decode an in-memory image (any PIL-readable format) with the same
+    normalisation as `load_image`: (H, W, 3) RGB uint8, or (H, W) uint8 for
+    single-channel sources. The serving HTTP front end's request codec."""
+    import io as _io
+
+    from PIL import Image
+
+    with Image.open(_io.BytesIO(data)) as im:
+        if im.mode in ("L", "1", "I", "I;16", "F"):
+            return np.asarray(im.convert("L"), dtype=np.uint8)
+        return np.asarray(im.convert("RGB"), dtype=np.uint8)
+
+
+def encode_image_bytes(img: np.ndarray, format: str = "PNG") -> bytes:
+    """Encode (H, W) or (H, W, 3) uint8 to image bytes (the serving
+    response codec; PNG keeps the bit-exactness contract lossless)."""
+    import io as _io
+
+    from PIL import Image
+
+    img = np.asarray(img)
+    if img.dtype != np.uint8:
+        raise TypeError(f"expected uint8 image, got {img.dtype}")
+    if img.ndim == 3 and img.shape[2] == 1:
+        img = img[..., 0]
+    buf = _io.BytesIO()
+    Image.fromarray(img).save(buf, format=format)
+    return buf.getvalue()
+
+
 def batch_load(paths, *, n_threads: int = 4, on_error: str = "raise"):
     """Yield (index, image) over `paths` in order, decoding ahead on worker
     threads. Uses the native C++ prefetch loader when built and all inputs

@@ -189,6 +189,33 @@ class Pipeline:
 
         return run
 
+    def serving(
+        self,
+        bucket_h: int,
+        bucket_w: int,
+        channels: int,
+        batch: int,
+        *,
+        backend: str = "xla",
+        mesh=None,
+        on_trace=None,
+    ):
+        """The online-serving executable for one shape-bucket cell: a jitted
+        (imgs[B, Hb, Wb(,C)], true_h[B], true_w[B]) -> out[B, ...] function
+        where requests are padded up to the bucket but compute BIT-IDENTICAL
+        results to the per-request `.jit` path (the padded executor rebuilds
+        each op's border extension at the dynamic true shape —
+        serve/padded.py). This is the cache-warm hook `serve/cache.py`
+        pre-compiles per (pipeline, bucket, batch) at server startup so no
+        live request ever pays a trace. With `mesh`, the batch axis shards
+        over it (the `.data_parallel` layout)."""
+        from mpi_cuda_imagemanipulation_tpu.serve.padded import make_serving_fn
+
+        return make_serving_fn(
+            self, bucket_h, bucket_w, channels, batch,
+            backend=backend, mesh=mesh, on_trace=on_trace,
+        )
+
 
 def reference_pipeline() -> Pipeline:
     """The reference's exact pipeline: grayscale -> contrast 3.5 -> emboss 3x3

@@ -1,0 +1,41 @@
+"""Online serving subsystem — the framework's front door for live traffic.
+
+Every other entry point (`run`/`batch`/`bench`) is offline: a fixed file
+list, then exit. `serve/` turns the same compiled throughput machinery into
+an online service:
+
+  * `scheduler.py`  — micro-batching scheduler: a bounded admission queue
+                      feeding coalesced same-bucket stacked dispatches under
+                      a max_batch / max_delay_ms policy.
+  * `bucketing.py`  — shape buckets + stack padding (shared with the batch
+                      CLI's partial-stack handling).
+  * `padded.py`     — the bucket-padded executor: requests padded up to a
+                      bucket shape compute BIT-IDENTICAL outputs to the
+                      per-request golden path (dynamic true-shape extension
+                      + masks), so bucketing is purely an execution detail.
+  * `cache.py`      — shape-bucket compile cache, pre-warmed at startup so
+                      no user request ever pays a jit trace.
+  * `metrics.py`    — queue depth, batch occupancy, queue-wait/device time,
+                      p50/p95/p99 end-to-end latency (`/stats`, shutdown
+                      summary).
+  * `server.py`     — stdlib ThreadingHTTPServer front end (POST
+                      /v1/process, GET /healthz, GET /stats) plus the
+                      in-process `Client` used by tests and the load
+                      generator.
+  * `loadgen.py`    — open-loop offered-load sweep (bench_suite lane).
+"""
+
+from mpi_cuda_imagemanipulation_tpu.serve.scheduler import (  # noqa: F401
+    STATUS_DEADLINE,
+    STATUS_OK,
+    STATUS_OVERLOADED,
+    DeadlineExceeded,
+    Overloaded,
+    RequestRejected,
+    ServeError,
+)
+from mpi_cuda_imagemanipulation_tpu.serve.server import (  # noqa: F401
+    Client,
+    ServeApp,
+    ServeConfig,
+)

@@ -1,0 +1,126 @@
+"""Open-loop load generator — throughput vs latency under offered load.
+
+Open-loop means arrivals are scheduled by the offered rate alone, never
+gated on completions (a closed loop self-throttles and hides queueing
+collapse — the coordinated-omission trap). `Client.submit` is non-blocking
+by construction, so one thread fires requests on the arrival clock and the
+handles are collected afterwards; shed requests resolve instantly and count
+against goodput.
+
+`sweep()` is the bench_suite `serve_loadgen` lane: per offered rate it
+reports achieved throughput, p50/p95/p99 end-to-end latency, mean batch
+occupancy and shed fraction — the saturation curve that sizes
+`--max-batch`/`--queue-depth` for a deployment.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from mpi_cuda_imagemanipulation_tpu.io.image import synthetic_image
+from mpi_cuda_imagemanipulation_tpu.serve.server import Client, ServeApp
+from mpi_cuda_imagemanipulation_tpu.utils.timing import percentiles
+
+PERCENTILES = (50, 95, 99)
+
+
+def mixed_shapes(
+    buckets, n: int, *, channels: int = 3, seed: int = 0, min_dim: int = 8
+) -> list[np.ndarray]:
+    """Deterministic request mix: for each bucket, one exact-fit image plus
+    off-bucket sizes that exercise the padding path."""
+    rng = np.random.default_rng(seed)
+    shapes: list[tuple[int, int]] = []
+    for bh, bw in buckets:
+        shapes.append((bh, bw))
+        shapes.append((max(min_dim, bh - 7), max(min_dim, bw - 13)))
+        shapes.append((max(min_dim, (bh * 3) // 4), max(min_dim, (bw * 2) // 3)))
+    out = []
+    for i in range(n):
+        h, w = shapes[int(rng.integers(len(shapes)))]
+        out.append(
+            synthetic_image(h, w, channels=channels, seed=int(rng.integers(1 << 31)))
+        )
+    return out
+
+
+def run_offered_load(
+    client: Client,
+    images: list[np.ndarray],
+    offered_rps: float,
+    duration_s: float,
+    *,
+    clock=time.monotonic,
+    sleep=time.sleep,
+) -> dict:
+    """Fire requests open-loop at `offered_rps` for `duration_s`; block for
+    stragglers; return the per-rate record."""
+    period = 1.0 / offered_rps
+    t0 = clock()
+    handles = []
+    i = 0
+    while True:
+        due = t0 + i * period
+        now = clock()
+        if due - t0 >= duration_s:
+            break
+        if due > now:
+            sleep(due - now)
+        handles.append(client.submit(images[i % len(images)]))
+        i += 1
+    for h in handles:
+        h.done.wait()
+    wall = clock() - t0
+    ok = [h for h in handles if h.status == "ok"]
+    shed = sum(1 for h in handles if h.status == "overloaded")
+    lat = [h.t_done - h.t_submit for h in ok]
+    rec = {
+        "offered_rps": offered_rps,
+        "submitted": len(handles),
+        "completed": len(ok),
+        "shed": shed,
+        "shed_frac": shed / len(handles) if handles else 0.0,
+        "achieved_rps": len(ok) / wall if wall > 0 else 0.0,
+        "wall_s": wall,
+    }
+    if lat:
+        p = percentiles(lat, PERCENTILES)
+        rec.update({f"e2e_p{int(q)}_ms": p[q] * 1e3 for q in PERCENTILES})
+    return rec
+
+
+def sweep(
+    app: ServeApp,
+    *,
+    offered_rps: tuple[float, ...],
+    duration_s: float = 2.0,
+    n_images: int = 64,
+    channels: int = 3,
+    seed: int = 7,
+) -> list[dict]:
+    """The offered-load sweep over a STARTED app. Dispatch metrics (batch
+    occupancy) are read as per-rate deltas of the app-wide counters."""
+    from mpi_cuda_imagemanipulation_tpu.serve.padded import min_true_dim
+
+    client = Client(app)
+    images = mixed_shapes(
+        app.cache.buckets,
+        n_images,
+        channels=channels,
+        seed=seed,
+        min_dim=min_true_dim(app.pipe),
+    )
+    records = []
+    for rps in offered_rps:
+        before = app.metrics.snapshot()
+        rec = run_offered_load(client, images, rps, duration_s)
+        after = app.metrics.snapshot()
+        d_real = (after["dispatches"] or 0) - (before["dispatches"] or 0)
+        if d_real:
+            done = after["completed"] - before["completed"]
+            rec["mean_batch_occupancy"] = done / d_real
+        rec["dispatches"] = d_real
+        records.append(rec)
+    return records

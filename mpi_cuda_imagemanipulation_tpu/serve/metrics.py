@@ -1,0 +1,138 @@
+r"""Serving metrics — queue depth, batch occupancy, latency percentiles.
+
+Counters + bounded reservoirs behind one lock; `snapshot()` is the /stats
+payload and `summary_line()` the shutdown report. Latency percentiles come
+from `utils.timing.percentiles` — the same quantile definition the bench
+suite uses, so offline and online reports are comparable. Sample
+reservoirs keep the most recent `sample_cap` observations (a serving
+process must not grow memory with request count — admission control
+bounds the queue, this bounds the accounting).
+
+Per-request timeline (all device-synchronised wall clocks):
+
+    submit --queue_wait--> dispatch --[batch device time]--> done
+      \__________________ e2e latency _________________________/
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+from mpi_cuda_imagemanipulation_tpu.utils.timing import percentiles
+
+PERCENTILES = (50, 95, 99)
+
+
+class ServeMetrics:
+    def __init__(self, sample_cap: int = 65536):
+        self._lock = threading.Lock()
+        self.submitted = 0
+        self.completed = 0
+        self.shed_overloaded = 0
+        self.rejected = 0  # malformed / too-large / too-small requests
+        self.deadline_expired = 0
+        self.errors = 0
+        self.dispatches = 0
+        self.batch_slots = 0  # compiled slots dispatched (incl. pad)
+        self.batch_real = 0  # real requests dispatched
+        self.queued = 0  # current admission-queue depth (gauge)
+        self.queued_peak = 0
+        self.queue_wait_s: deque = deque(maxlen=sample_cap)
+        self.device_s: deque = deque(maxlen=sample_cap)  # per dispatch
+        self.e2e_s: deque = deque(maxlen=sample_cap)
+
+    # -- recording ---------------------------------------------------------
+
+    def on_submit(self) -> None:
+        with self._lock:
+            self.submitted += 1
+
+    def on_admit(self) -> None:
+        with self._lock:
+            self.queued += 1
+            self.queued_peak = max(self.queued_peak, self.queued)
+
+    def on_shed(self) -> None:
+        with self._lock:
+            self.shed_overloaded += 1
+
+    def on_reject(self) -> None:
+        with self._lock:
+            self.rejected += 1
+
+    def on_deadline(self, queue_wait_s: float) -> None:
+        with self._lock:
+            self.deadline_expired += 1
+            self.queued -= 1
+            self.queue_wait_s.append(queue_wait_s)
+
+    def on_dispatch(self, n_real: int, n_slots: int, device_s: float) -> None:
+        with self._lock:
+            self.dispatches += 1
+            self.batch_real += n_real
+            self.batch_slots += n_slots
+            self.device_s.append(device_s)
+
+    def on_complete(self, queue_wait_s: float, e2e_s: float) -> None:
+        with self._lock:
+            self.completed += 1
+            self.queued -= 1
+            self.queue_wait_s.append(queue_wait_s)
+            self.e2e_s.append(e2e_s)
+
+    def on_error(self, n: int = 1) -> None:
+        with self._lock:
+            self.errors += n
+            self.queued -= n
+
+    # -- reporting ---------------------------------------------------------
+
+    @staticmethod
+    def _pcts(samples) -> dict[str, float] | None:
+        if not samples:
+            return None
+        got = percentiles(samples, PERCENTILES)
+        return {f"p{int(q)}_ms": got[q] * 1e3 for q in PERCENTILES}
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            mean_occupancy = (
+                self.batch_real / self.dispatches if self.dispatches else None
+            )
+            return {
+                "submitted": self.submitted,
+                "completed": self.completed,
+                "shed_overloaded": self.shed_overloaded,
+                "rejected": self.rejected,
+                "deadline_expired": self.deadline_expired,
+                "errors": self.errors,
+                "queued": self.queued,
+                "queued_peak": self.queued_peak,
+                "dispatches": self.dispatches,
+                "mean_batch_occupancy": mean_occupancy,
+                "batch_fill_frac": (
+                    self.batch_real / self.batch_slots if self.batch_slots else None
+                ),
+                "queue_wait": self._pcts(self.queue_wait_s),
+                "device_per_dispatch": self._pcts(self.device_s),
+                "e2e_latency": self._pcts(self.e2e_s),
+            }
+
+    def summary_line(self) -> str:
+        s = self.snapshot()
+        lat = s["e2e_latency"] or {}
+        occ = s["mean_batch_occupancy"]
+        return (
+            f"served {s['completed']}/{s['submitted']} "
+            f"(shed {s['shed_overloaded']}, rejected {s['rejected']}, "
+            f"deadline {s['deadline_expired']}, errors {s['errors']}) in "
+            f"{s['dispatches']} dispatches"
+            + (f" (mean occupancy {occ:.2f})" if occ else "")
+            + (
+                f"; e2e p50/p95/p99 = {lat['p50_ms']:.1f}/"
+                f"{lat['p95_ms']:.1f}/{lat['p99_ms']:.1f} ms"
+                if lat
+                else ""
+            )
+        )

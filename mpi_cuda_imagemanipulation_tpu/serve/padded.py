@@ -1,0 +1,210 @@
+"""Bucket-padded execution — bit-identical to the per-request golden path.
+
+The compile cache (serve/cache.py) wants one executable per shape bucket, so
+a request image is zero-padded up to the bucket and its TRUE shape rides
+along as two dynamic int32 scalars. Naively running `Pipeline.apply` on the
+padded array would change the numbers near the true border: reflect-101 /
+edge extension would read pad garbage instead of the virtual border, the
+'interior' guard would treat true-edge pixels as interior (the guard sees
+the bucket edge, not the image edge), and global statistics would count pad
+pixels. This module re-applies each op with the true border reconstructed:
+
+  * StencilOp — the (Hb+2h, Wb+2h) padded window array is built by a gather
+    whose row/col index maps implement the op's edge mode *at the dynamic
+    true border* (reflect101: r >= th -> 2*th-2-r; edge: clamp to th-1;
+    zero: mask outside [0, th)). For every output pixel inside the true
+    region the gathered neighbourhood is exactly what `pad2d` hands the
+    unpadded op, so `op.valid` produces identical f32 accumulations.
+    `op.finalize` already takes global (h, w) as traced values — the
+    interior mask follows the TRUE shape, precisely the property that lets
+    sharded tiles mask in global coordinates (ops/spec.py).
+  * GlobalOp — the additive statistic is computed under a (row < th) &
+    (col < tw) validity mask, the same mechanism the sharded runner uses
+    for its pad-to-multiple rows; identical integer histogram => identical
+    LUT => identical output.
+  * PointwiseOp — elementwise; pad lanes compute garbage that the response
+    crop drops.
+
+Induction over the op chain: each op's true region depends only on the
+previous op's true region (the gathers index into [0, th) x [0, tw) for
+every window that a true-region output reads), so garbage never propagates
+inward and the cropped output equals the unpadded pipeline bit for bit —
+asserted against `Pipeline.jit` in tests/test_serve.py.
+
+Constraint: reflect-101 needs true_dim >= halo + 1 — the same bound
+`jnp.pad(mode='reflect')` imposes on the unpadded golden path — and the
+admission layer rejects smaller requests up front (scheduler.min_dim).
+GeometricOps (shape-changing gathers) are not servable: the response shape
+would diverge from the bucket; the cache refuses such pipelines at startup.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline
+from mpi_cuda_imagemanipulation_tpu.ops.spec import (
+    F32,
+    GeometricOp,
+    GlobalOp,
+    PointwiseOp,
+    StencilOp,
+    _check_channels,
+)
+
+
+class UnservablePipeline(ValueError):
+    """Raised at server startup for pipelines the padded executor cannot
+    serve bit-exactly (currently: any GeometricOp — shape-changing)."""
+
+
+def check_servable(pipe: Pipeline) -> None:
+    for op in pipe.ops:
+        if isinstance(op, GeometricOp):
+            raise UnservablePipeline(
+                f"op {op.name!r} changes the image shape; shape-changing "
+                "(geometric) ops cannot run under bucket padding — serve a "
+                "pipeline without them"
+            )
+
+
+def accepts_channels(pipe: Pipeline, ch: int) -> bool:
+    """Whether the pipeline's channel chain admits a `ch`-channel input
+    (in_channels/out_channels of 0 mean 'any'/'same') — the warmup grid and
+    the admission layer both consult this, so a grayscale-first pipeline
+    never compiles or admits a 1-channel cell it would reject at trace."""
+    for op in pipe.ops:
+        if op.in_channels and op.in_channels != ch:
+            return False
+        ch = op.out_channels or ch
+    return True
+
+
+def min_true_dim(pipe: Pipeline) -> int:
+    """Smallest servable image dimension: reflect-101 extension (and the
+    golden path's own jnp.pad) needs dim >= halo + 1 for every stencil."""
+    return pipe.max_halo + 1
+
+
+def _ext_ids(n_ext: int, halo: int, true_n, bucket_n: int, edge_mode: str):
+    """Row/col index map of length `n_ext` = bucket_n + 2*halo: position j
+    holds the TRUE-image index whose value belongs at virtual coordinate
+    r = j - halo under the op's edge mode, with the border at the dynamic
+    true extent `true_n` (traced scalar). Indices beyond the region any
+    true-output window reads are clamped garbage — deterministic, unread."""
+    r = jnp.arange(n_ext, dtype=jnp.int32) - halo
+    if edge_mode == "reflect101":
+        idx = jnp.where(r < 0, -r, jnp.where(r >= true_n, 2 * true_n - 2 - r, r))
+    elif edge_mode == "edge":
+        idx = jnp.minimum(r, true_n - 1)
+    else:  # constant family ('interior'/'zero'): clamp; zero masks after
+        idx = jnp.minimum(r, true_n - 1)
+    idx = jnp.maximum(idx, 0)
+    return jnp.minimum(idx, bucket_n - 1)  # safety for the unread tail
+
+
+def _stencil_plane(op: StencilOp, x: jnp.ndarray, th, tw) -> jnp.ndarray:
+    h = op.halo
+    bh, bw = x.shape
+    xf = x.astype(F32)  # same cast as StencilOp._apply2d
+    rid = _ext_ids(bh + 2 * h, h, th, bh, op.edge_mode)
+    cid = _ext_ids(bw + 2 * h, h, tw, bw, op.edge_mode)
+    xpad = xf[rid[:, None], cid[None, :]]
+    if op.edge_mode == "zero":
+        rr = jnp.arange(bh + 2 * h, dtype=jnp.int32) - h
+        cc = jnp.arange(bw + 2 * h, dtype=jnp.int32) - h
+        inside = ((rr >= 0) & (rr < th))[:, None] & ((cc >= 0) & (cc < tw))[None, :]
+        xpad = jnp.where(inside, xpad, jnp.float32(0.0))
+    acc = op.valid(xpad)
+    # dynamic global extent: the interior guard masks in TRUE coordinates
+    return op.finalize(acc, x, 0, 0, th, tw)
+
+
+def _apply_stencil(op: StencilOp, x: jnp.ndarray, th, tw) -> jnp.ndarray:
+    _check_channels(op.name, op.in_channels, x)  # same gate as op.__call__
+    if x.ndim == 3:
+        return jnp.stack(
+            [_stencil_plane(op, x[..., c], th, tw) for c in range(x.shape[2])],
+            axis=-1,
+        )
+    return _stencil_plane(op, x, th, tw)
+
+
+def _apply_global(op: GlobalOp, x: jnp.ndarray, th, tw) -> jnp.ndarray:
+    _check_channels(op.name, op.in_channels, x)  # same gate as op.__call__
+    bh, bw = x.shape[:2]
+    valid = (jnp.arange(bh, dtype=jnp.int32)[:, None] < th) & (
+        jnp.arange(bw, dtype=jnp.int32)[None, :] < tw
+    )
+    if x.ndim == 3:
+        valid = valid[..., None]
+    return op.apply(x, op.stats(x, valid))
+
+
+def padded_apply(pipe: Pipeline, x: jnp.ndarray, th, tw) -> jnp.ndarray:
+    """The pipeline over one bucket-shaped u8 image with dynamic true shape
+    (th, tw). Output is bucket-shaped; only [:th, :tw] is meaningful."""
+    for op in pipe.ops:
+        if isinstance(op, StencilOp):
+            x = _apply_stencil(op, x, th, tw)
+        elif isinstance(op, GlobalOp):
+            x = _apply_global(op, x, th, tw)
+        elif isinstance(op, PointwiseOp):
+            x = op(x)
+        else:  # pragma: no cover - check_servable refuses these up front
+            raise UnservablePipeline(f"op {op.name!r} is not servable")
+    return x
+
+
+def make_serving_fn(
+    pipe: Pipeline,
+    bucket_h: int,
+    bucket_w: int,
+    channels: int,
+    batch: int,
+    *,
+    backend: str = "xla",
+    mesh=None,
+    on_trace: Callable[[], None] | None = None,
+):
+    """The jitted serving executable for one (bucket, channels, batch) cell:
+
+        fn(imgs_u8[B, Hb, Wb(, C)], true_h_i32[B], true_w_i32[B]) -> out[B, ...]
+
+    True shapes are dynamic inputs, so every request shape that rounds to
+    this bucket reuses the one trace. With `mesh`, inputs/outputs shard
+    along the batch axis (SPMD data parallelism, like Pipeline.data_parallel
+    — `batch` must divide by the mesh size, which serve/bucketing's
+    batch_buckets guarantees). `on_trace` fires at trace time — the compile
+    cache counts traces with it to prove warmup covered the shape grid.
+
+    The padded executor is built from the golden jnp tile functions and is
+    fused by XLA; `backend` documents that contract ('xla' only — the Pallas
+    streaming kernels extend edges at the *bucket* border by design, which
+    is exactly what padding must not do)."""
+    if backend != "xla":
+        raise ValueError(
+            f"serving computes with the XLA backend (got {backend!r}); "
+            "see make_serving_fn docstring"
+        )
+    check_servable(pipe)
+    if mesh is not None and batch % mesh.devices.size:
+        raise ValueError(
+            f"batch {batch} does not divide over the {mesh.devices.size}-device mesh"
+        )
+    del bucket_h, bucket_w, channels, batch  # keyed by the caller's shapes
+
+    def batched(imgs, th, tw):
+        if on_trace is not None:
+            on_trace()  # python side effect => fires once per (re)trace
+        return jax.vmap(lambda i, h, w: padded_apply(pipe, i, h, w))(imgs, th, tw)
+
+    if mesh is None:
+        return jax.jit(batched)
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    s = NamedSharding(mesh, PartitionSpec(mesh.axis_names[0]))
+    return jax.jit(batched, in_shardings=(s, s, s), out_shardings=s)

@@ -1,0 +1,359 @@
+"""Micro-batching scheduler: bounded admission, same-bucket coalescing.
+
+One thread owns the device: it pulls admitted requests out of per-bucket
+FIFO queues and ships them as stacked dispatches through the pre-warmed
+compile cache. Dispatch policy (the classic micro-batching tradeoff):
+
+  * a bucket with `max_batch` waiting requests dispatches immediately
+    (full stack — best amortisation);
+  * otherwise the bucket whose OLDEST request has waited `max_delay_ms`
+    dispatches with whatever it has (bounded added latency);
+  * the scheduler sleeps exactly until the nearest such deadline — no
+    polling.
+
+Admission control happens at submit time, on the caller's thread:
+
+  * malformed requests (wrong channel count, dims above every bucket or
+    below the pipeline's reflect bound) are REJECTED outright;
+  * beyond `queue_depth` total queued requests the scheduler SHEDS with the
+    distinct `overloaded` status — callers get an immediate, explicit
+    signal (the HTTP front end maps it to 429) instead of unbounded
+    buffering, which under sustained overload is just an OOM with extra
+    steps;
+  * admitted requests carry an optional deadline; ones that expire while
+    queued are answered `deadline_expired` at pop time and never waste a
+    device slot.
+
+Bit-exactness note: a dispatch pads each image to the bucket and the stack
+to a compiled batch size (serve/bucketing), runs the serving executable
+(serve/padded — true shapes ride along), then crops each response back to
+its true shape. The pad slots repeat the last image and are dropped.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import OrderedDict, deque
+
+import numpy as np
+
+from mpi_cuda_imagemanipulation_tpu.serve import bucketing
+from mpi_cuda_imagemanipulation_tpu.serve.cache import CompileCache
+from mpi_cuda_imagemanipulation_tpu.serve.metrics import ServeMetrics
+from mpi_cuda_imagemanipulation_tpu.utils.log import get_logger
+
+STATUS_OK = "ok"
+STATUS_OVERLOADED = "overloaded"
+STATUS_REJECTED = "rejected"
+STATUS_DEADLINE = "deadline_expired"
+STATUS_ERROR = "error"
+STATUS_SHUTDOWN = "shutdown"
+
+
+class ServeError(Exception):
+    status = STATUS_ERROR
+
+
+class Overloaded(ServeError):
+    """Shed by admission control: queue at --queue-depth."""
+
+    status = STATUS_OVERLOADED
+
+
+class RequestRejected(ServeError):
+    """Malformed request: bad channels, or dims outside the servable range."""
+
+    status = STATUS_REJECTED
+
+
+class DeadlineExceeded(ServeError):
+    status = STATUS_DEADLINE
+
+
+@dataclasses.dataclass
+class Request:
+    img: np.ndarray
+    true_h: int
+    true_w: int
+    bucket: tuple[int, int, int]  # (bucket_h, bucket_w, channels)
+    t_submit: float
+    deadline: float | None  # absolute monotonic seconds, or None
+    done: threading.Event = dataclasses.field(default_factory=threading.Event)
+    status: str = STATUS_OK
+    result: np.ndarray | None = None
+    error: str | None = None
+    t_dispatch: float | None = None
+    t_done: float | None = None
+
+    def wait(self, timeout: float | None = None) -> np.ndarray:
+        """Block for the response; raise the status-matching ServeError on
+        anything but success."""
+        if not self.done.wait(timeout):
+            raise TimeoutError("request still in flight")
+        if self.status == STATUS_OK:
+            assert self.result is not None
+            return self.result
+        exc = {
+            STATUS_OVERLOADED: Overloaded,
+            STATUS_REJECTED: RequestRejected,
+            STATUS_DEADLINE: DeadlineExceeded,
+        }.get(self.status, ServeError)
+        raise exc(self.error or self.status)
+
+
+class MicroBatchScheduler:
+    def __init__(
+        self,
+        cache: CompileCache,
+        *,
+        max_batch: int,
+        max_delay_ms: float,
+        queue_depth: int,
+        metrics: ServeMetrics | None = None,
+        clock=time.monotonic,
+    ):
+        if max_batch > max(cache.batch_buckets):
+            raise ValueError(
+                f"max_batch {max_batch} exceeds the largest compiled batch "
+                f"bucket {max(cache.batch_buckets)}"
+            )
+        self.cache = cache
+        self.max_batch = max_batch
+        self.max_delay_s = max_delay_ms / 1e3
+        self.queue_depth = queue_depth
+        self.metrics = metrics or ServeMetrics()
+        self.min_dim = _min_dim(cache)
+        self._clock = clock
+        self._cond = threading.Condition()
+        # bucket key -> FIFO of Requests; OrderedDict so the aged-bucket
+        # scan is deterministic under equal deadlines
+        self._pending: OrderedDict[tuple[int, int, int], deque] = OrderedDict()
+        self._queued = 0
+        self._running = False
+        self._thread: threading.Thread | None = None
+        self._log = get_logger()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        with self._cond:
+            if self._running:
+                return
+            self._running = True
+        self._thread = threading.Thread(
+            target=self._loop, name="mcim-serve-scheduler", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self, *, drain: bool = True, timeout: float = 30.0) -> None:
+        """Stop the dispatch loop. `drain=True` ships everything already
+        admitted first; `drain=False` answers queued requests `shutdown`."""
+        with self._cond:
+            if not self._running:
+                return
+            self._running = False
+            self._drain_on_stop = drain
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    # -- admission ---------------------------------------------------------
+
+    def submit(
+        self, img: np.ndarray, *, deadline_ms: float | None = None
+    ) -> Request:
+        """Admit one image; returns a Request whose `.wait()` yields the
+        response. Never blocks: over-depth submissions fail immediately
+        with `overloaded` (the Request is returned already-resolved, so
+        open-loop callers can fire-and-collect)."""
+        now = self._clock()
+        self.metrics.on_submit()
+        img = np.asarray(img)
+        req = Request(
+            img=img,
+            true_h=img.shape[0] if img.ndim >= 2 else 0,
+            true_w=img.shape[1] if img.ndim >= 2 else 0,
+            bucket=(0, 0, 0),
+            t_submit=now,
+            deadline=now + deadline_ms / 1e3 if deadline_ms is not None else None,
+        )
+        problem = self._validate(img)
+        if problem is not None:
+            self.metrics.on_reject()
+            return self._resolve(req, STATUS_REJECTED, problem)
+        ch = img.shape[2] if img.ndim == 3 else 1
+        bh, bw = bucketing.pick_bucket(
+            img.shape[0], img.shape[1], self.cache.buckets
+        )
+        req.bucket = (bh, bw, ch)
+        with self._cond:
+            if not self._running:
+                return self._resolve(req, STATUS_SHUTDOWN, "scheduler stopped")
+            if self._queued >= self.queue_depth:
+                self.metrics.on_shed()
+                return self._resolve(
+                    req,
+                    STATUS_OVERLOADED,
+                    f"queue at capacity ({self.queue_depth})",
+                )
+            self._pending.setdefault(req.bucket, deque()).append(req)
+            self._queued += 1
+            self.metrics.on_admit()
+            self._cond.notify_all()
+        return req
+
+    def _validate(self, img: np.ndarray) -> str | None:
+        if img.dtype != np.uint8 or img.ndim not in (2, 3):
+            return f"expected a (H, W[, C]) uint8 image, got {img.dtype} ndim={img.ndim}"
+        ch = img.shape[2] if img.ndim == 3 else 1
+        if ch not in self.cache.channels:
+            return (
+                f"{ch}-channel images are not served (configured: "
+                f"{self.cache.channels})"
+            )
+        h, w = img.shape[:2]
+        if min(h, w) < self.min_dim:
+            return (
+                f"image {h}x{w} is below the pipeline's minimum servable "
+                f"dimension {self.min_dim} (stencil border extension)"
+            )
+        if bucketing.pick_bucket(h, w, self.cache.buckets) is None:
+            big = self.cache.buckets[-1]
+            return f"image {h}x{w} exceeds the largest bucket {big[0]}x{big[1]}"
+        return None
+
+    @staticmethod
+    def _resolve(req: Request, status: str, error: str | None) -> Request:
+        req.status = status
+        req.error = error
+        req.t_done = time.monotonic()
+        req.done.set()
+        return req
+
+    # -- dispatch loop -----------------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            batch: list[Request] | None = None
+            with self._cond:
+                while True:
+                    if not self._running:
+                        break
+                    batch = self._pop_dispatchable()
+                    if batch is not None:
+                        break
+                    self._cond.wait(timeout=self._sleep_s())
+                if not self._running and batch is None:
+                    leftovers: list[Request] = []
+                    for q in self._pending.values():
+                        leftovers.extend(q)
+                        self._queued -= len(q)
+                    self._pending.clear()
+                    drain = getattr(self, "_drain_on_stop", True)
+                    if not drain:
+                        for r in leftovers:
+                            self.metrics.on_error()
+                            self._resolve(r, STATUS_SHUTDOWN, "server stopped")
+                        return
+                    # drain: ship what was admitted, bucket by bucket
+                    for r in leftovers:
+                        self._pending.setdefault(r.bucket, deque()).append(r)
+                        self._queued += 1
+                    if not self._pending:
+                        return
+                    key = next(iter(self._pending))
+                    batch = self._pop_bucket(key)
+            if batch:
+                self._dispatch(batch)
+            with self._cond:
+                if not self._running and not self._pending:
+                    return
+
+    def _sleep_s(self) -> float | None:
+        """Seconds until the oldest queued request hits max_delay (None =
+        sleep until notified). Called under the lock."""
+        heads = [q[0].t_submit for q in self._pending.values() if q]
+        if not heads:
+            return None
+        due = min(heads) + self.max_delay_s
+        return max(due - self._clock(), 0.0)
+
+    def _pop_dispatchable(self) -> list[Request] | None:
+        """Under the lock: a full bucket, else the most-overdue aged bucket."""
+        now = self._clock()
+        aged_key = None
+        aged_t = None
+        for key, q in self._pending.items():
+            if not q:
+                continue
+            if len(q) >= self.max_batch:
+                return self._pop_bucket(key)
+            if now - q[0].t_submit >= self.max_delay_s and (
+                aged_t is None or q[0].t_submit < aged_t
+            ):
+                aged_key, aged_t = key, q[0].t_submit
+        if aged_key is not None:
+            return self._pop_bucket(aged_key)
+        return None
+
+    def _pop_bucket(self, key: tuple[int, int, int]) -> list[Request]:
+        q = self._pending[key]
+        batch = [q.popleft() for _ in range(min(len(q), self.max_batch))]
+        if not q:
+            del self._pending[key]
+        self._queued -= len(batch)
+        return batch
+
+    def _dispatch(self, batch: list[Request]) -> None:
+        now = self._clock()
+        live: list[Request] = []
+        for r in batch:
+            if r.deadline is not None and now > r.deadline:
+                self.metrics.on_deadline(now - r.t_submit)
+                self._resolve(r, STATUS_DEADLINE, "expired while queued")
+            else:
+                live.append(r)
+        if not live:
+            return
+        bh, bw, ch = live[0].bucket
+        nb = bucketing.pick_batch_bucket(len(live), self.cache.batch_buckets)
+        try:
+            fn = self.cache.get(bh, bw, ch, nb)
+            imgs = bucketing.pad_stack(
+                [bucketing.pad_to_bucket(r.img, bh, bw) for r in live], nb
+            )
+            th = np.asarray(
+                [r.true_h for r in live] + [live[-1].true_h] * (nb - len(live)),
+                dtype=np.int32,
+            )
+            tw = np.asarray(
+                [r.true_w for r in live] + [live[-1].true_w] * (nb - len(live)),
+                dtype=np.int32,
+            )
+            for r in live:
+                r.t_dispatch = now
+            t0 = self._clock()
+            out = np.asarray(fn(imgs, th, tw))  # forces completion + transfer
+            device_s = self._clock() - t0
+        except Exception as e:  # answer the batch, keep the loop alive
+            self._log.exception("dispatch failed for bucket %s", live[0].bucket)
+            self.metrics.on_error(len(live))
+            for r in live:
+                self._resolve(r, STATUS_ERROR, f"{type(e).__name__}: {e}")
+            return
+        self.metrics.on_dispatch(len(live), nb, device_s)
+        t_done = self._clock()
+        for k, r in enumerate(live):
+            r.result = out[k, : r.true_h, : r.true_w, ...]
+            r.t_done = t_done
+            r.status = STATUS_OK
+            self.metrics.on_complete(now - r.t_submit, t_done - r.t_submit)
+            r.done.set()
+
+
+def _min_dim(cache: CompileCache) -> int:
+    from mpi_cuda_imagemanipulation_tpu.serve.padded import min_true_dim
+
+    return min_true_dim(cache.pipe)

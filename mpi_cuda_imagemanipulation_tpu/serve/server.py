@@ -1,0 +1,232 @@
+"""Serving front ends: ServeApp (wiring), in-process Client, HTTP server.
+
+`ServeApp` assembles the subsystem from a `ServeConfig`: parse the
+pipeline, pre-warm the shape-bucket compile cache, start the scheduler.
+Two front doors share it:
+
+  * `Client` — in-process, zero-copy: numpy image in, numpy image out.
+    Used by tests and the load generator (serve/loadgen.py).
+  * `make_http_server` — a stdlib `ThreadingHTTPServer`:
+        POST /v1/process   PNG (or any PIL-decodable) bytes in, PNG out
+        GET  /healthz      liveness
+        GET  /stats        metrics snapshot (serve/metrics.py schema)
+    Status mapping: 200 ok · 400 rejected (undecodable/out-of-range) ·
+    429 overloaded (shed — Retry-After included) · 503 shutting down ·
+    504 deadline_expired · 500 error.
+
+Threading model: HTTP handler threads and Client callers only touch the
+bounded admission queue; the single scheduler thread owns the device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline
+from mpi_cuda_imagemanipulation_tpu.serve import bucketing
+from mpi_cuda_imagemanipulation_tpu.serve.cache import CompileCache
+from mpi_cuda_imagemanipulation_tpu.serve.metrics import ServeMetrics
+from mpi_cuda_imagemanipulation_tpu.serve.scheduler import (
+    STATUS_DEADLINE,
+    STATUS_OVERLOADED,
+    STATUS_REJECTED,
+    STATUS_SHUTDOWN,
+    MicroBatchScheduler,
+    Request,
+)
+from mpi_cuda_imagemanipulation_tpu.utils.log import get_logger
+
+_HTTP_STATUS = {
+    STATUS_REJECTED: 400,
+    STATUS_OVERLOADED: 429,
+    STATUS_SHUTDOWN: 503,
+    STATUS_DEADLINE: 504,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    ops: str = "grayscale,contrast:3.5,emboss:3"
+    buckets: tuple[tuple[int, int], ...] = bucketing.DEFAULT_BUCKETS
+    max_batch: int = 8
+    max_delay_ms: float = 5.0
+    queue_depth: int = 64
+    channels: tuple[int, ...] = (1, 3)
+    shards: int = 1
+    backend: str = "xla"
+    default_deadline_ms: float | None = None
+
+
+class ServeApp:
+    """The wired subsystem. `start()` pays every compile up front
+    (cache.warmup) before the first request can arrive."""
+
+    def __init__(self, config: ServeConfig):
+        self.config = config
+        self.pipe = Pipeline.parse(config.ops)
+        mesh = None
+        if config.shards > 1:
+            from mpi_cuda_imagemanipulation_tpu.parallel.mesh import make_mesh
+
+            mesh = make_mesh(config.shards)
+        self.metrics = ServeMetrics()
+        from mpi_cuda_imagemanipulation_tpu.serve.padded import accepts_channels
+
+        channels = tuple(
+            ch for ch in config.channels if accepts_channels(self.pipe, ch)
+        )
+        if not channels:
+            raise ValueError(
+                f"pipeline {self.pipe.name!r} accepts none of the configured "
+                f"channel counts {config.channels}"
+            )
+        self.cache = CompileCache(
+            self.pipe,
+            config.buckets,
+            bucketing.batch_buckets(config.max_batch, config.shards),
+            channels=channels,
+            backend=config.backend,
+            mesh=mesh,
+        )
+        self.scheduler = MicroBatchScheduler(
+            self.cache,
+            max_batch=config.max_batch,
+            max_delay_ms=config.max_delay_ms,
+            queue_depth=config.queue_depth,
+            metrics=self.metrics,
+        )
+        self._log = get_logger()
+
+    def start(self) -> "ServeApp":
+        warm_s = self.cache.warmup()
+        self._log.info(
+            "compile cache warm: %d executables in %.1fs (%s buckets x "
+            "channels %s x batches %s)",
+            len(self.cache._fns), warm_s,
+            "/".join(f"{h}x{w}" for h, w in self.cache.buckets),
+            list(self.cache.channels), list(self.cache.batch_buckets),
+        )
+        self.scheduler.start()
+        return self
+
+    def stop(self, *, drain: bool = True) -> None:
+        self.scheduler.stop(drain=drain)
+        self._log.info("serve shutdown: %s", self.metrics.summary_line())
+
+    def stats(self) -> dict:
+        return {
+            "pipeline": self.pipe.name,
+            "buckets": [f"{h}x{w}" for h, w in self.cache.buckets],
+            "batch_buckets": list(self.cache.batch_buckets),
+            "max_batch": self.config.max_batch,
+            "max_delay_ms": self.config.max_delay_ms,
+            "queue_depth": self.config.queue_depth,
+            "shards": self.config.shards,
+            "cache": self.cache.stats(),
+            **self.metrics.snapshot(),
+        }
+
+
+class Client:
+    """In-process client over the scheduler — the test/loadgen front end."""
+
+    def __init__(self, app: ServeApp):
+        self._app = app
+
+    def submit(
+        self, img: np.ndarray, *, deadline_ms: float | None = None
+    ) -> Request:
+        """Non-blocking: returns the Request handle (open-loop callers
+        fire-and-collect; `.wait()` blocks for the response)."""
+        if deadline_ms is None:
+            deadline_ms = self._app.config.default_deadline_ms
+        return self._app.scheduler.submit(img, deadline_ms=deadline_ms)
+
+    def process(
+        self,
+        img: np.ndarray,
+        *,
+        deadline_ms: float | None = None,
+        timeout: float | None = 60.0,
+    ) -> np.ndarray:
+        """Blocking round-trip; raises Overloaded / RequestRejected /
+        DeadlineExceeded / ServeError on non-ok statuses."""
+        return self.submit(img, deadline_ms=deadline_ms).wait(timeout)
+
+
+def _make_handler(app: ServeApp):
+    log = get_logger()
+
+    class Handler(BaseHTTPRequestHandler):
+        # threaded server + per-request work => keep socket errors quiet
+        def log_message(self, fmt, *args):  # route through our logger
+            log.debug("http: " + fmt, *args)
+
+        def _send_json(self, code: int, payload: dict, extra=()) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in extra:
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 (stdlib casing)
+            if self.path == "/healthz":
+                self._send_json(200, {"status": "ok"})
+            elif self.path == "/stats":
+                self._send_json(200, app.stats())
+            else:
+                self._send_json(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):  # noqa: N802
+            if self.path != "/v1/process":
+                self._send_json(404, {"error": f"no route {self.path}"})
+                return
+            from mpi_cuda_imagemanipulation_tpu.io.image import (
+                decode_image_bytes,
+                encode_image_bytes,
+            )
+
+            try:
+                n = int(self.headers.get("Content-Length", "0"))
+                data = self.rfile.read(n)
+                img = decode_image_bytes(data)
+            except Exception as e:
+                # count as submitted+rejected so the accounting invariant
+                # (submitted == resolved + queued) holds for HTTP traffic too
+                app.metrics.on_submit()
+                app.metrics.on_reject()
+                self._send_json(400, {"error": f"undecodable image: {e}"})
+                return
+            req = app.scheduler.submit(
+                img, deadline_ms=app.config.default_deadline_ms
+            )
+            req.done.wait()
+            if req.status == "ok":
+                png = encode_image_bytes(req.result)
+                self.send_response(200)
+                self.send_header("Content-Type", "image/png")
+                self.send_header("Content-Length", str(len(png)))
+                self.end_headers()
+                self.wfile.write(png)
+                return
+            code = _HTTP_STATUS.get(req.status, 500)
+            extra = [("Retry-After", "1")] if code == 429 else []
+            self._send_json(
+                code, {"status": req.status, "error": req.error}, extra
+            )
+
+    return Handler
+
+
+def make_http_server(app: ServeApp, host: str = "", port: int = 8000):
+    """A ThreadingHTTPServer bound to (host, port); port 0 picks a free one
+    (the bound port is `server.server_address[1]`). Caller owns
+    serve_forever()/shutdown()."""
+    return ThreadingHTTPServer((host, port), _make_handler(app))

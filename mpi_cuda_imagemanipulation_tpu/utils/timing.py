@@ -10,11 +10,38 @@ megapixels/sec metric (the BASELINE.json unit).
 from __future__ import annotations
 
 import dataclasses
+import math
 import statistics
 import time
-from typing import Callable, Sequence
+from typing import Callable, Iterable, Sequence
 
 import jax
+
+
+def percentiles(
+    samples: Iterable[float], qs: Sequence[float] = (50, 95, 99)
+) -> dict[float, float]:
+    """Percentiles of `samples` by sorted-rank linear interpolation (numpy's
+    default 'linear' method), as a {q: value} dict.
+
+    One definition shared by the serving metrics (serve/metrics.py p50/p95/
+    p99 latency) and the bench suite's load-generator lane, so the two never
+    report subtly different quantile conventions. Raises on an empty sample
+    set — a caller with nothing measured should say so, not report NaNs.
+    """
+    xs = sorted(samples)
+    if not xs:
+        raise ValueError("percentiles() needs at least one sample")
+    out: dict[float, float] = {}
+    for q in qs:
+        if not 0 <= q <= 100:
+            raise ValueError(f"percentile out of range [0, 100]: {q}")
+        rank = (len(xs) - 1) * (q / 100.0)
+        lo = math.floor(rank)
+        hi = math.ceil(rank)
+        frac = rank - lo
+        out[q] = xs[lo] + (xs[hi] - xs[lo]) * frac
+    return out
 
 
 @dataclasses.dataclass(frozen=True)

@@ -751,6 +751,83 @@ def test_bench_worker_single_config_json():
     assert rec["hbm_bytes_model"] == (3 + 1) * 1080 * 1920
 
 
+def test_cli_batch_empty_glob_exit_3(tmp_path):
+    """An empty glob is a scripting error distinct from decode failures:
+    exit 3, no output dir side effects."""
+    (tmp_path / "in").mkdir()
+    r = _run_cli(
+        "batch",
+        "--input-dir", str(tmp_path / "in"),
+        "--output-dir", str(tmp_path / "out"),
+        "--glob", "*.png",
+    )
+    assert r.returncode == 3, r.stderr
+    assert not (tmp_path / "out").exists()
+
+
+def _golden_reference_outputs(imgs):
+    import jax
+
+    from mpi_cuda_imagemanipulation_tpu.io.image import gray_to_rgb
+    from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline
+
+    fn = Pipeline.parse("grayscale,contrast:3.5,emboss:3").jit()
+    out = {}
+    for name, img in imgs.items():
+        g = np.asarray(jax.block_until_ready(fn(img)))
+        out[name] = gray_to_rgb(g) if g.ndim == 2 else g
+    return out
+
+
+def test_cli_batch_partial_tail_right_sized(tmp_path):
+    """3 same-shape images with --stack 2: the trailing partial stack ships
+    right-sized (no pad waste) and every output is bit-identical to the
+    per-image golden path."""
+    src = tmp_path / "in"
+    src.mkdir()
+    imgs = {
+        f"{k}.png": synthetic_image(20, 24, channels=3, seed=40 + k)
+        for k in range(3)
+    }
+    for name, img in imgs.items():
+        save_image(src / name, img)
+    r = _run_cli(
+        "batch",
+        "--input-dir", str(src),
+        "--output-dir", str(tmp_path / "out"),
+        "--stack", "2",
+    )
+    assert r.returncode == 0, r.stderr
+    for name, want in _golden_reference_outputs(imgs).items():
+        got = load_image(tmp_path / "out" / name)
+        np.testing.assert_array_equal(got, want, err_msg=name)
+
+
+def test_cli_batch_mixed_shape_flush_ordering(tmp_path):
+    """Shape changes force mid-stream flushes (padded, so the shape's one
+    compiled batch is reused); every input still maps to its own correct
+    output regardless of flush boundaries."""
+    src = tmp_path / "in"
+    src.mkdir()
+    shapes = [(20, 24), (20, 24), (16, 30), (20, 24), (16, 30), (16, 30), (20, 24)]
+    imgs = {}
+    for k, (h, w) in enumerate(shapes):
+        name = f"{k}.png"
+        imgs[name] = synthetic_image(h, w, channels=3, seed=60 + k)
+        save_image(src / name, imgs[name])
+    r = _run_cli(
+        "batch",
+        "--input-dir", str(src),
+        "--output-dir", str(tmp_path / "out"),
+        "--stack", "3",
+        "--window", "2",
+    )
+    assert r.returncode == 0, r.stderr
+    for name, want in _golden_reference_outputs(imgs).items():
+        got = load_image(tmp_path / "out" / name)
+        np.testing.assert_array_equal(got, want, err_msg=name)
+
+
 def test_cli_diff(tmp_path):
     from PIL import Image
 

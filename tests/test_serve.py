@@ -1,0 +1,369 @@
+"""Online serving subsystem (serve/) — the ISSUE-2 acceptance suite.
+
+The load-bearing invariants:
+  1. bucket padding is bit-invisible: under concurrent mixed-shape load
+     every response equals the per-request `Pipeline.jit` golden output;
+  2. coalescing works: mean batch occupancy > 1 under offered load;
+  3. admission control: submissions beyond --queue-depth shed with the
+     distinct `overloaded` status — never block, never buffer unboundedly;
+  4. the compile cache covers the shape grid: zero jit traces after warmup
+     (counted from inside the traced body, so a retrace cannot hide).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from mpi_cuda_imagemanipulation_tpu.io.image import synthetic_image
+from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline
+from mpi_cuda_imagemanipulation_tpu.serve import bucketing
+from mpi_cuda_imagemanipulation_tpu.serve.padded import (
+    UnservablePipeline,
+    accepts_channels,
+    check_servable,
+    min_true_dim,
+)
+from mpi_cuda_imagemanipulation_tpu.serve.scheduler import (
+    STATUS_OK,
+    STATUS_OVERLOADED,
+    DeadlineExceeded,
+    Overloaded,
+    RequestRejected,
+)
+from mpi_cuda_imagemanipulation_tpu.serve.server import (
+    Client,
+    ServeApp,
+    ServeConfig,
+)
+
+REFERENCE_OPS = "grayscale,contrast:3.5,emboss:3"
+
+
+def _app(**over) -> ServeApp:
+    cfg = ServeConfig(
+        **{
+            "ops": REFERENCE_OPS,
+            "buckets": ((48, 48), (96, 96)),
+            "max_batch": 4,
+            "max_delay_ms": 10.0,
+            "queue_depth": 64,
+            "channels": (1, 3),
+            **over,
+        }
+    )
+    return ServeApp(cfg).start()
+
+
+# --------------------------------------------------------------------------
+# bucketing helpers
+# --------------------------------------------------------------------------
+
+
+def test_parse_buckets():
+    assert bucketing.parse_buckets("512,1024x2048") == ((512, 512), (1024, 2048))
+    assert bucketing.parse_buckets("64") == ((64, 64),)
+    with pytest.raises(ValueError):
+        bucketing.parse_buckets("abc")
+    with pytest.raises(ValueError):
+        bucketing.parse_buckets("")
+
+
+def test_pick_bucket_smallest_fit_and_overflow():
+    buckets = bucketing.parse_buckets("64,128,96x256")
+    assert bucketing.pick_bucket(50, 60, buckets) == (64, 64)
+    assert bucketing.pick_bucket(65, 65, buckets) == (128, 128)
+    assert bucketing.pick_bucket(90, 200, buckets) == (96, 256)
+    assert bucketing.pick_bucket(300, 300, buckets) is None
+
+
+def test_batch_buckets_shard_multiples():
+    assert bucketing.batch_buckets(8) == (1, 2, 4, 8)
+    assert bucketing.batch_buckets(8, shards=2) == (2, 4, 8)
+    assert bucketing.batch_buckets(6) == (1, 2, 4, 6)
+    with pytest.raises(ValueError):
+        bucketing.batch_buckets(6, shards=4)  # not a multiple
+    assert bucketing.pick_batch_bucket(3, (1, 2, 4, 8)) == 4
+
+
+def test_pad_helpers():
+    img = synthetic_image(5, 7, channels=3, seed=1)
+    padded = bucketing.pad_to_bucket(img, 8, 8)
+    assert padded.shape == (8, 8, 3)
+    np.testing.assert_array_equal(padded[:5, :7], img)
+    stack = bucketing.pad_stack([img, img], 4)
+    assert stack.shape == (4, 5, 7, 3)
+    with pytest.raises(ValueError):
+        bucketing.pad_to_bucket(img, 4, 8)
+
+
+# --------------------------------------------------------------------------
+# padded executor: bit-exactness per op family (direct, no scheduler)
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "spec",
+    [
+        REFERENCE_OPS,  # interior-mode stencil + pointwise chain
+        "gaussian:5,sobel",  # reflect101, magnitude combine
+        "erode:5",  # edge mode, min reduce
+        "median:3",  # median network
+        "grayscale,equalize",  # global statistic (masked histogram)
+        "grayscale,contrast:4.3,gamma:2.2",  # LUT pointwise ops
+    ],
+)
+@pytest.mark.parametrize("shape", [(33, 47), (17, 64), (64, 64)])
+def test_padded_bit_identical_to_golden(spec, shape):
+    pipe = Pipeline.parse(spec)
+    h, w = shape
+    img = synthetic_image(h, w, channels=3, seed=h * w)
+    golden = np.asarray(pipe.jit()(img))
+    fn = pipe.serving(64, 64, 3, 2)
+    stack = bucketing.pad_stack([bucketing.pad_to_bucket(img, 64, 64)], 2)
+    th = np.asarray([h, h], np.int32)
+    tw = np.asarray([w, w], np.int32)
+    out = np.asarray(fn(stack, th, tw))[0, :h, :w, ...]
+    assert out.shape == golden.shape
+    np.testing.assert_array_equal(out, golden)
+
+
+def test_geometric_pipelines_are_unservable():
+    with pytest.raises(UnservablePipeline):
+        check_servable(Pipeline.parse("fliph"))
+    check_servable(Pipeline.parse(REFERENCE_OPS))  # no raise
+
+
+def test_accepts_channels_follows_the_chain():
+    assert accepts_channels(Pipeline.parse("grayscale"), 3)
+    assert not accepts_channels(Pipeline.parse("grayscale"), 1)
+    assert accepts_channels(Pipeline.parse("gaussian:3"), 1)
+    assert accepts_channels(Pipeline.parse("gaussian:3"), 3)
+    # 3->1 then 1-channel-only global op chains
+    assert accepts_channels(Pipeline.parse("grayscale,equalize"), 3)
+
+
+# --------------------------------------------------------------------------
+# acceptance: concurrent mixed-shape load == golden, occupancy, no traces
+# --------------------------------------------------------------------------
+
+
+def test_serve_concurrent_mixed_shapes_bit_identical_and_warm():
+    app = _app()
+    try:
+        client = Client(app)
+        pipe = Pipeline.parse(REFERENCE_OPS)
+        jfn = pipe.jit()
+        shapes = [(33, 47), (48, 48), (17, 90), (96, 96), (40, 40), (5, 60)]
+        results: list[tuple[np.ndarray, np.ndarray]] = []
+        errs: list[Exception] = []
+        lock = threading.Lock()
+
+        def worker(seed: int):
+            try:
+                h, w = shapes[seed % len(shapes)]
+                img = synthetic_image(h, w, channels=3, seed=seed)
+                out = client.process(img, timeout=120)
+                with lock:
+                    results.append((img, out))
+            except Exception as e:  # pragma: no cover - failure reporting
+                with lock:
+                    errs.append(e)
+
+        threads = [
+            threading.Thread(target=worker, args=(k,)) for k in range(24)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        assert not errs, errs
+        assert len(results) == 24
+        for img, out in results:
+            np.testing.assert_array_equal(out, np.asarray(jfn(img)))
+        # acceptance: offered load coalesced into stacked dispatches
+        m = app.metrics.snapshot()
+        assert m["completed"] == 24
+        assert m["mean_batch_occupancy"] > 1
+        # acceptance: the warmed grid absorbed every request shape
+        assert app.cache.traces_since_warmup == 0
+        assert app.cache.misses == 0
+        assert app.cache.hits == m["dispatches"]
+    finally:
+        app.stop()
+
+
+def test_serve_sharded_data_parallel_bit_identical():
+    """Dispatch stacks shard over a 2-device mesh (the 8 fake cpu devices)
+    and stay bit-identical; batch buckets are mesh multiples."""
+    app = _app(
+        ops="gaussian:5,sobel", buckets=((64, 64),), shards=2, max_batch=4
+    )
+    try:
+        assert app.cache.batch_buckets == (2, 4)
+        client = Client(app)
+        jfn = Pipeline.parse("gaussian:5,sobel").jit()
+        reqs = []
+        for k in range(10):
+            img = synthetic_image(
+                40 + k % 7, 50 + k % 5, channels=3 if k % 2 else 1, seed=k
+            )
+            reqs.append((img, client.submit(img)))
+        for img, r in reqs:
+            np.testing.assert_array_equal(r.wait(120), np.asarray(jfn(img)))
+        assert app.cache.traces_since_warmup == 0
+    finally:
+        app.stop()
+
+
+# --------------------------------------------------------------------------
+# acceptance: admission control / graceful degradation
+# --------------------------------------------------------------------------
+
+
+def test_overload_sheds_with_distinct_status_never_blocks():
+    # long delay + big max_batch: admitted requests SIT until the delay
+    # expires, so a burst larger than queue_depth must shed the excess
+    app = _app(queue_depth=4, max_batch=64, max_delay_ms=250.0)
+    try:
+        client = Client(app)
+        img = synthetic_image(20, 20, channels=3, seed=0)
+        reqs = [client.submit(img) for _ in range(12)]
+        shed = [r for r in reqs if r.status == STATUS_OVERLOADED]
+        # shed requests resolve IMMEDIATELY (submit never blocks)
+        assert len(shed) == 8
+        for r in shed:
+            assert r.done.is_set()
+            with pytest.raises(Overloaded):
+                r.wait(0)
+        # the admitted ones complete once the delay fires
+        done = [r.wait(120) for r in reqs if r.status != STATUS_OVERLOADED]
+        assert len(done) == 4
+        m = app.metrics.snapshot()
+        assert m["shed_overloaded"] == 8 and m["completed"] == 4
+        assert m["queued"] == 0
+    finally:
+        app.stop()
+
+
+def test_reject_out_of_range_requests():
+    app = _app(buckets=((48, 48),))
+    try:
+        client = Client(app)
+        with pytest.raises(RequestRejected):  # larger than every bucket
+            client.process(synthetic_image(100, 100, channels=3, seed=1))
+        with pytest.raises(RequestRejected):  # below the stencil bound
+            client.process(synthetic_image(1, 30, channels=3, seed=1))
+        with pytest.raises(RequestRejected):  # wrong dtype
+            client.process(np.zeros((20, 20, 3), np.float32))
+        # channel count the grayscale-first pipeline cannot take
+        with pytest.raises(RequestRejected):
+            client.process(synthetic_image(20, 20, channels=1, seed=1))
+        assert app.metrics.snapshot()["rejected"] == 4
+    finally:
+        app.stop()
+
+
+def test_deadline_expired_while_queued():
+    app = _app(max_batch=64, max_delay_ms=150.0, queue_depth=8)
+    try:
+        client = Client(app)
+        img = synthetic_image(20, 20, channels=3, seed=3)
+        # deadline far shorter than the coalescing delay: expires queued
+        r = client.submit(img, deadline_ms=1.0)
+        with pytest.raises(DeadlineExceeded):
+            r.wait(120)
+        assert app.metrics.snapshot()["deadline_expired"] == 1
+    finally:
+        app.stop()
+
+
+def test_stop_drains_admitted_requests():
+    app = _app(max_batch=64, max_delay_ms=10_000.0, queue_depth=8)
+    client = Client(app)
+    img = synthetic_image(20, 20, channels=3, seed=4)
+    reqs = [client.submit(img) for _ in range(3)]
+    app.stop(drain=True)  # delay never fired; drain must ship them
+    for r in reqs:
+        assert r.status == STATUS_OK
+        assert r.result is not None
+
+
+def test_min_true_dim_matches_max_halo():
+    pipe = Pipeline.parse("gaussian:7")
+    assert min_true_dim(pipe) == pipe.max_halo + 1
+
+
+# --------------------------------------------------------------------------
+# loadgen (open loop) — smoke over a tiny sweep
+# --------------------------------------------------------------------------
+
+
+def test_loadgen_open_loop_sweep_smoke():
+    from mpi_cuda_imagemanipulation_tpu.serve import loadgen
+
+    app = _app(buckets=((32, 32), (64, 64)), max_delay_ms=3.0)
+    try:
+        recs = loadgen.sweep(
+            app, offered_rps=(150.0,), duration_s=0.5, n_images=16
+        )
+        (rec,) = recs
+        assert rec["submitted"] > 0
+        assert rec["completed"] + rec["shed"] <= rec["submitted"]
+        if rec["completed"]:
+            assert rec["e2e_p50_ms"] <= rec["e2e_p99_ms"]
+        assert app.cache.traces_since_warmup == 0
+    finally:
+        app.stop()
+
+
+# --------------------------------------------------------------------------
+# HTTP front end
+# --------------------------------------------------------------------------
+
+
+def test_http_roundtrip_health_stats_and_shed():
+    import json
+    import urllib.error
+    import urllib.request
+
+    from mpi_cuda_imagemanipulation_tpu.io.image import (
+        decode_image_bytes,
+        encode_image_bytes,
+    )
+    from mpi_cuda_imagemanipulation_tpu.serve.server import make_http_server
+
+    app = _app(buckets=((48, 48),))
+    httpd = make_http_server(app, "127.0.0.1", 0)
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{port}"
+    try:
+        with urllib.request.urlopen(f"{base}/healthz", timeout=30) as r:
+            assert json.loads(r.read())["status"] == "ok"
+        img = synthetic_image(30, 40, channels=3, seed=9)
+        req = urllib.request.Request(
+            f"{base}/v1/process", data=encode_image_bytes(img), method="POST"
+        )
+        with urllib.request.urlopen(req, timeout=60) as r:
+            assert r.headers["Content-Type"] == "image/png"
+            out = decode_image_bytes(r.read())
+        jfn = Pipeline.parse(REFERENCE_OPS).jit()
+        np.testing.assert_array_equal(out, np.asarray(jfn(img)))
+        # undecodable body -> 400, still counted
+        bad = urllib.request.Request(
+            f"{base}/v1/process", data=b"not an image", method="POST"
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(bad, timeout=30)
+        assert ei.value.code == 400
+        with urllib.request.urlopen(f"{base}/stats", timeout=30) as r:
+            stats = json.loads(r.read())
+        assert stats["completed"] >= 1 and stats["rejected"] >= 1
+        assert stats["cache"]["traces_since_warmup"] == 0
+        assert stats["pipeline"] == "grayscale,contrast3.5,emboss3"
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        app.stop()

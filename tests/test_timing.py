@@ -1,0 +1,27 @@
+"""utils.timing.percentiles — the one quantile definition shared by the
+serving metrics and the bench suite (ISSUE-2 satellite)."""
+
+import numpy as np
+import pytest
+
+from mpi_cuda_imagemanipulation_tpu.utils.timing import percentiles
+
+
+def test_percentiles_match_numpy_linear():
+    rng = np.random.default_rng(5)
+    xs = rng.normal(size=257).tolist()
+    got = percentiles(xs, (50, 95, 99))
+    for q in (50, 95, 99):
+        assert got[q] == pytest.approx(float(np.percentile(xs, q)), rel=1e-12)
+
+
+def test_percentiles_edge_cases():
+    assert percentiles([3.0], (50, 95, 99)) == {50: 3.0, 95: 3.0, 99: 3.0}
+    got = percentiles([1.0, 2.0], (0, 50, 100))
+    assert got == {0: 1.0, 50: 1.5, 100: 2.0}
+    # order-independent (sorted internally)
+    assert percentiles([5.0, 1.0, 3.0], (50,))[50] == 3.0
+    with pytest.raises(ValueError):
+        percentiles([], (50,))
+    with pytest.raises(ValueError):
+        percentiles([1.0], (101,))

@@ -1,0 +1,22 @@
+#!/bin/bash
+# Online-serving load sweep (round 6): the serve_loadgen bench lane on real
+# hardware — open-loop offered-load sweep against a warmed ServeApp
+# (serve/loadgen.py): achieved throughput vs p50/p95/p99 e2e latency, shed
+# fraction and the batch-occupancy curve per offered rate, plus the compile
+# cache counters proving zero post-warmup traces under live traffic.
+# Knobs: the lane sizes itself for TPU (512/1024/2048 buckets, 4 s per
+# rate); MCIM_SERVE_RPS / MCIM_SERVE_DURATION_S override the sweep. The
+# offered rates below are chosen to cross saturation of one chip on the
+# reference pipeline (~1-4 ms/dispatch warm), so the occupancy curve and
+# the shed knee are both visible. Budget: ~2-4 min warm (the serving
+# executables are new compiles on the first window: ~6-10 min cold).
+set -u
+cd "$(dirname "$0")/../.."
+. tools/tpu_queue/_lib.sh
+out=artifacts/serve_loadgen_r06.out
+: > "$out"
+MCIM_SERVE_RPS="${MCIM_SERVE_RPS:-64,256,1024}" \
+  timeout 1800 python -m mpi_cuda_imagemanipulation_tpu.bench_suite \
+  --config serve_loadgen >> "$out" 2>&1
+commit_artifacts "TPU window: online-serving offered-load sweep (round 6)" "$out"
+exit 0
