@@ -339,6 +339,10 @@ def serve_loadgen_params() -> dict:
         "offered_rps": (64.0, 256.0, 1024.0) if on_tpu else (50.0, 200.0, 800.0),
         "duration_s": 4.0 if on_tpu else 1.5,
         "n_images": 48,
+        # availability lane: inject this transient dispatch-failure rate
+        # (serve.dispatch failpoint) so the sweep reports success/retried/
+        # shed fractions under faults; 0 = fault-free latency sweep
+        "fault_rate": 0.0,
     }
     rps_env = os.environ.get("MCIM_SERVE_RPS")
     if rps_env:
@@ -348,6 +352,9 @@ def serve_loadgen_params() -> dict:
     dur_env = os.environ.get("MCIM_SERVE_DURATION_S")
     if dur_env:
         params["duration_s"] = float(dur_env)
+    fault_env = os.environ.get("MCIM_SERVE_FAULT_RATE")
+    if fault_env:
+        params["fault_rate"] = float(fault_env)
     return params
 
 
@@ -355,14 +362,20 @@ def run_serve_loadgen(
     *,
     json_path: str | None = None,
     printer: Callable[[str], None] = print,
+    fault_rate: float | None = None,
 ) -> dict:
     """The online-serving bench lane: stand up a ServeApp, sweep open-loop
     offered load, report throughput vs latency percentiles plus the
-    batch-occupancy curve (serve/loadgen.py). One record, `sweep` inside."""
+    batch-occupancy curve (serve/loadgen.py). With `fault_rate` (or
+    MCIM_SERVE_FAULT_RATE) the sweep runs with that injected transient
+    dispatch-failure rate and the table gains availability columns
+    (success %, retried %). One record, `sweep` inside."""
     from mpi_cuda_imagemanipulation_tpu.serve import loadgen
     from mpi_cuda_imagemanipulation_tpu.serve.server import ServeApp, ServeConfig
 
     p = serve_loadgen_params()
+    if fault_rate is not None:
+        p["fault_rate"] = fault_rate
     app = ServeApp(
         ServeConfig(
             ops=p["ops"],
@@ -379,6 +392,7 @@ def run_serve_loadgen(
             offered_rps=p["offered_rps"],
             duration_s=p["duration_s"],
             n_images=p["n_images"],
+            fault_rate=p["fault_rate"],
         )
     finally:
         app.stop(drain=True)
@@ -391,17 +405,22 @@ def run_serve_loadgen(
         "max_batch": p["max_batch"],
         "max_delay_ms": p["max_delay_ms"],
         "queue_depth": p["queue_depth"],
+        "fault_rate": p["fault_rate"],
         "cache": app.cache.stats(),
         "sweep": sweep,
     }
     printer(
-        f"{'offered rps':>11s} {'achieved':>9s} {'shed%':>6s} {'occup':>6s} "
+        f"{'offered rps':>11s} {'achieved':>9s} {'ok%':>6s} {'shed%':>6s} "
+        f"{'retry%':>6s} {'occup':>6s} "
         f"{'p50 ms':>8s} {'p95 ms':>8s} {'p99 ms':>8s}"
     )
     for s in sweep:
         printer(
             f"{s['offered_rps']:11.0f} {s['achieved_rps']:9.1f} "
-            f"{s['shed_frac'] * 100:5.1f}% {s.get('mean_batch_occupancy') or 0:6.2f} "
+            f"{s['ok_frac'] * 100:5.1f}% "
+            f"{s['shed_frac'] * 100:5.1f}% "
+            f"{s.get('retried_frac', 0.0) * 100:5.1f}% "
+            f"{s.get('mean_batch_occupancy') or 0:6.2f} "
             f"{s.get('e2e_p50_ms', float('nan')):8.2f} "
             f"{s.get('e2e_p95_ms', float('nan')):8.2f} "
             f"{s.get('e2e_p99_ms', float('nan')):8.2f}"
@@ -553,9 +572,20 @@ def main(argv: Sequence[str] | None = None) -> int:
         help="mesh size for sharded configs (default: every visible "
         "device) — the serial-vs-overlap A/B sweeps this",
     )
+    ap.add_argument(
+        "--fault-rate",
+        type=float,
+        default=None,
+        help="serve_loadgen only: inject this transient dispatch-failure "
+        "rate (serve.dispatch failpoint) so the sweep reports "
+        "availability (success/retried/shed fractions) alongside the "
+        "latency percentiles; env MCIM_SERVE_FAULT_RATE works too",
+    )
     args = ap.parse_args(argv)
     if args.config == SERVE_LOADGEN:
-        rec = run_serve_loadgen(printer=lambda s: None)
+        rec = run_serve_loadgen(
+            printer=lambda s: None, fault_rate=args.fault_rate
+        )
     else:
         cfg = CONFIGS[args.config]
         if args.halo_mode is not None and cfg.sharded:

@@ -28,6 +28,32 @@ import sys
 import time
 
 
+def _add_failpoint_flags(sp: argparse.ArgumentParser) -> None:
+    sp.add_argument(
+        "--failpoints",
+        default=None,
+        metavar="SPEC",
+        help="arm deterministic fault injection, e.g. "
+        "'serve.dispatch=0.1,io.decode=first:2' (sites/modes: "
+        "resilience/failpoints.py; env MCIM_FAILPOINTS works too). For "
+        "testing the recovery paths — never set in production",
+    )
+    sp.add_argument(
+        "--failpoint-seed",
+        type=int,
+        default=0,
+        help="seed for probabilistic failpoint modes (deterministic "
+        "fail/pass sequence per site)",
+    )
+
+
+def _arm_failpoints(args: argparse.Namespace) -> None:
+    if getattr(args, "failpoints", None):
+        from mpi_cuda_imagemanipulation_tpu.resilience import failpoints
+
+        failpoints.configure(args.failpoints, seed=args.failpoint_seed)
+
+
 def _build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="mcim-tpu",
@@ -121,6 +147,7 @@ def _build_parser() -> argparse.ArgumentParser:
         "detection posture, SURVEY.md §5 — the reference deadlocks its "
         "peers on mid-collective failure, kernel.cu:150)",
     )
+    _add_failpoint_flags(run)
 
     batch = sub.add_parser(
         "batch", help="run a pipeline over every image in a directory"
@@ -172,6 +199,26 @@ def _build_parser() -> argparse.ArgumentParser:
         help="write a JSON metrics line (incl. the skipped-file list) to "
         "this path ('-' = stdout)",
     )
+    batch.add_argument(
+        "--resume",
+        action="store_true",
+        help="skip inputs already journaled ok (content-hash-verified) from "
+        "a previous run over this output dir — a batch killed mid-way "
+        "finishes by re-running only failures and never-reached inputs",
+    )
+    batch.add_argument(
+        "--journal",
+        default=None,
+        metavar="PATH",
+        help="batch journal path (append-only JSONL of per-input outcomes; "
+        "default: <output-dir>/.mcim_batch_journal.jsonl)",
+    )
+    batch.add_argument(
+        "--no-journal",
+        action="store_true",
+        help="disable the journal (no crash-resume for this run)",
+    )
+    _add_failpoint_flags(batch)
 
     srv = sub.add_parser(
         "serve",
@@ -248,6 +295,37 @@ def _build_parser() -> argparse.ArgumentParser:
         default=None,
         help="write the shutdown stats record to this path ('-' = stdout)",
     )
+    srv.add_argument(
+        "--retry-attempts",
+        type=int,
+        default=3,
+        help="dispatch attempts per micro-batch (1 = no retry); transient "
+        "device/compile failures back off exponentially with jitter",
+    )
+    srv.add_argument(
+        "--breaker-threshold",
+        type=int,
+        default=5,
+        help="consecutive dispatch failures that trip a bucket's circuit "
+        "breaker open (its traffic then degrades to the golden "
+        "per-request path until a half-open probe succeeds)",
+    )
+    srv.add_argument(
+        "--breaker-reset-s",
+        type=float,
+        default=30.0,
+        help="quiet seconds an open breaker waits before admitting a "
+        "half-open probe dispatch",
+    )
+    srv.add_argument(
+        "--drain-deadline-s",
+        type=float,
+        default=30.0,
+        help="SIGTERM graceful-drain budget: admission stops immediately, "
+        "queued + in-flight work gets this long to flush before the "
+        "scheduler is stopped",
+    )
+    _add_failpoint_flags(srv)
 
     bench = sub.add_parser("bench", help="run the benchmark suite")
     bench.add_argument("--configs", default=None, help="subset, comma-separated")
@@ -345,6 +423,7 @@ def _configure_platform(device: str | None) -> None:
 
 def cmd_run(args: argparse.Namespace) -> int:
     _configure_platform(args.device)
+    _arm_failpoints(args)
     import jax
     import numpy as np
 
@@ -499,6 +578,7 @@ def cmd_run(args: argparse.Namespace) -> int:
 
 def cmd_batch(args: argparse.Namespace) -> int:
     _configure_platform(args.device)
+    _arm_failpoints(args)
     import glob as globmod
 
     import numpy as np
@@ -514,6 +594,12 @@ def cmd_batch(args: argparse.Namespace) -> int:
         make_mesh,
         make_mesh_2d,
         parse_shards,
+    )
+    from mpi_cuda_imagemanipulation_tpu.resilience import failpoints
+    from mpi_cuda_imagemanipulation_tpu.resilience.journal import (
+        DEFAULT_NAME as JOURNAL_DEFAULT_NAME,
+        BatchJournal,
+        content_digest,
     )
     from mpi_cuda_imagemanipulation_tpu.utils.log import get_logger
 
@@ -532,6 +618,48 @@ def cmd_batch(args: argparse.Namespace) -> int:
         log.error("no inputs match %s/%s", args.input_dir, args.glob)
         return 3
     os.makedirs(args.output_dir, exist_ok=True)
+    # mirror the input's path relative to input-dir, so glob patterns
+    # spanning subdirectories can't collide on basenames
+    rels = [os.path.relpath(p, args.input_dir) for p in paths]
+
+    # -- journal / resume (resilience/journal.py) --------------------------
+    journal = None
+    if not args.no_journal:
+        journal = BatchJournal(
+            args.journal
+            or os.path.join(args.output_dir, JOURNAL_DEFAULT_NAME)
+        )
+    _digests: dict[int, str | None] = {}
+
+    def _digest(i: int) -> str | None:
+        if i not in _digests:
+            try:
+                _digests[i] = content_digest(paths[i])
+            except OSError:
+                _digests[i] = None
+        return _digests[i]
+
+    resumed: set[int] = set()
+    if args.resume:
+        if journal is None:
+            raise ValueError("--resume needs the journal (drop --no-journal)")
+        prior = journal.load()
+        for i, rel in enumerate(rels):
+            rec = prior.get(rel)
+            # trust only ok records whose digest still matches the input's
+            # current bytes — an edited input is reprocessed, never stale
+            if (
+                rec
+                and rec.get("status") == "ok"
+                and rec.get("digest")
+                and rec.get("digest") == _digest(i)
+            ):
+                resumed.add(i)
+        log.info(
+            "resume: %d/%d inputs already journaled ok, %d to (re)run",
+            len(resumed), len(paths), len(paths) - len(resumed),
+        )
+    failed: dict[int, str] = {}  # index -> error (decode or compute)
     pipe = Pipeline.parse(args.ops)
     stack = max(1, args.stack)
     n_r, n_c = parse_shards(args.shards)
@@ -565,21 +693,34 @@ def cmd_batch(args: argparse.Namespace) -> int:
 
     inflight: deque = deque()  # (input indices, async device result)
 
+    def record_failed(idxs, e) -> None:
+        # a failed dispatch/save fails ONLY its own inputs (with a journal
+        # line each) — the run continues; the summary exit goes nonzero
+        msg = f"{type(e).__name__}: {e}"
+        for i in idxs:
+            failed[i] = msg
+            log.error("failed %s: %s", rels[i], msg)
+            if journal is not None:
+                journal.record_failed(rels[i], _digest(i), msg)
+
     def save_one(i, out):
         nonlocal done
         if not args.gray_output and out.ndim == 2:
             out = gray_to_rgb(out)
-        # mirror the input's path relative to input-dir, so glob patterns
-        # spanning subdirectories can't collide on basenames
-        name = os.path.relpath(paths[i], args.input_dir)
-        dst = os.path.join(args.output_dir, name)
+        dst = os.path.join(args.output_dir, rels[i])
         os.makedirs(os.path.dirname(dst) or ".", exist_ok=True)
         save_image(dst, out)
+        if journal is not None:
+            journal.record_ok(rels[i], _digest(i), rels[i])
         done += 1
 
     def drain_one():
         idxs, out = inflight.popleft()
-        out = np.asarray(out)  # forces completion + transfer
+        try:
+            out = np.asarray(out)  # forces completion + transfer
+        except Exception as e:  # device-side failure surfaces here
+            record_failed(idxs, e)
+            return
         if stack == 1:
             save_one(idxs[0], out)
         else:
@@ -590,6 +731,14 @@ def cmd_batch(args: argparse.Namespace) -> int:
     # a shape change flushes the pending stack (stack == 1: ship per image)
     pending: list[tuple[int, np.ndarray]] = []
     from mpi_cuda_imagemanipulation_tpu.serve.bucketing import pad_stack
+
+    def _ship(idxs, make_input):
+        # host-side dispatch failures (incl. armed halo.exchange
+        # failpoints) surface at call time; fail those inputs, keep going
+        try:
+            inflight.append((idxs, fn(make_input())))
+        except Exception as e:
+            record_failed(idxs, e)
 
     def flush_pending(final: bool = False):
         nonlocal pending
@@ -603,7 +752,7 @@ def cmd_batch(args: argparse.Namespace) -> int:
                 # tail-shaped compile beats padding to --stack and
                 # discarding the pad's compute (the data-parallel runner
                 # still pads internally, but only to a mesh multiple)
-                inflight.append((idxs, fn(np.stack(imgs, axis=0))))
+                _ship(idxs, lambda: np.stack(imgs, axis=0))
             else:
                 # mid-stream partial (shape-change flush): pad by
                 # repeating the last image so every dispatch for a given
@@ -612,15 +761,23 @@ def cmd_batch(args: argparse.Namespace) -> int:
                 # (serve/bucketing.pad_stack — shared with the serving
                 # scheduler); padded outputs are dropped in drain_one,
                 # which iterates idxs only
-                inflight.append((idxs, fn(pad_stack(imgs, stack))))
+                _ship(idxs, lambda: pad_stack(imgs, stack))
         else:
-            inflight.append((idxs, fn(pending[0][1])))
+            img0 = pending[0][1]
+            _ship(idxs, lambda: img0)
         pending = []
         if len(inflight) >= max(1, args.window):
             drain_one()
 
+    # resume: only un-journaled (or stale/failed) inputs are decoded at all
+    work_idx = [i for i in range(len(paths)) if i not in resumed]
+    work_paths = [paths[i] for i in work_idx]
     seen: set[int] = set()
-    for i, img in batch_load(paths, n_threads=args.threads, on_error="skip"):
+    for j, img in batch_load(work_paths, n_threads=args.threads, on_error="skip"):
+        i = work_idx[j]
+        # preemption/kill simulation point for the --resume tests: an armed
+        # batch.interrupt failpoint aborts the run here, mid-stream
+        failpoints.maybe_fail("batch.interrupt", index=i, path=paths[i])
         seen.add(i)
         if pending and (
             len(pending) >= stack or pending[-1][1].shape != img.shape
@@ -633,6 +790,14 @@ def cmd_batch(args: argparse.Namespace) -> int:
     flush_pending(final=True)
     while inflight:
         drain_one()
+    # decode failures: batch_load skipped them (logged); give them journal
+    # lines so --resume re-attempts exactly these
+    for j, p in enumerate(work_paths):
+        i = work_idx[j]
+        if i not in seen and i not in failed:
+            failed[i] = "decode failed (skipped)"
+            if journal is not None:
+                journal.record_failed(rels[i], _digest(i), failed[i])
     wall = time.perf_counter() - t0
     # adaptive precision: thumbnail batches should not round to "0.0 MP",
     # large batches should stay in plain decimal (%.3g would go scientific)
@@ -642,8 +807,11 @@ def cmd_batch(args: argparse.Namespace) -> int:
     mp_s = _fmt(total_mp, "MP")
     rate_s = _fmt(total_mp / wall, "MP/s")
     log.info(
-        "processed %d/%d images (%s) in %.2fs (%s end-to-end)",
+        "processed %d/%d images (%s) in %.2fs (%s end-to-end)%s",
         done, len(paths), mp_s, wall, rate_s,
+        f" [{len(resumed)} resumed, {len(failed)} failed]"
+        if resumed or failed
+        else "",
     )
     if args.show_timing:
         print(
@@ -651,7 +819,11 @@ def cmd_batch(args: argparse.Namespace) -> int:
             f"{mp_s} in {wall:.2f}s ({rate_s} "
             f"end-to-end incl. compile+I/O)"
         )
-    skipped = [paths[i] for i in range(len(paths)) if i not in seen]
+    skipped = [
+        paths[i]
+        for i in range(len(paths))
+        if i not in seen and i not in resumed
+    ]
     if args.json_metrics:
         from mpi_cuda_imagemanipulation_tpu.utils.log import emit_json_metrics
 
@@ -662,28 +834,36 @@ def cmd_batch(args: argparse.Namespace) -> int:
                 "impl": args.impl,
                 "inputs": len(paths),
                 "processed": done,
+                "resumed": len(resumed),
                 "skipped": skipped,
+                "failed": {rels[i]: msg for i, msg in sorted(failed.items())},
+                "journal": journal.path if journal is not None else None,
                 "total_mp": total_mp,
                 "wall_s": wall,
                 "mp_per_s": total_mp / wall if wall > 0 else None,
             },
             None if args.json_metrics == "-" else args.json_metrics,
         )
-    # partial failure (skipped inputs) is a nonzero exit for scripted
-    # callers — distinct from the no-inputs-matched exit (3) above
-    return 0 if done == len(paths) else 1
+    # partial failure (skipped/failed inputs) is a nonzero exit for
+    # scripted callers — distinct from the no-inputs-matched exit (3) above
+    return 0 if done + len(resumed) == len(paths) else 1
 
 
 def cmd_serve(args: argparse.Namespace) -> int:
     """Online serving: warm the shape-bucket compile cache, start the
-    micro-batching scheduler, serve HTTP until interrupted, then print the
-    metrics summary (the north star's "heavy traffic" front door)."""
+    micro-batching scheduler, serve HTTP until SIGTERM/SIGINT, then drain
+    gracefully — admission stops, queued + in-flight work flushes under
+    --drain-deadline-s — and print the metrics summary (the north star's
+    "heavy traffic" front door)."""
     _configure_platform(args.device)
+    _arm_failpoints(args)
+    import signal
+    import threading
+
     from mpi_cuda_imagemanipulation_tpu.serve.bucketing import parse_buckets
     from mpi_cuda_imagemanipulation_tpu.serve.server import (
-        ServeApp,
         ServeConfig,
-        make_http_server,
+        Server,
     )
     from mpi_cuda_imagemanipulation_tpu.utils.log import (
         emit_json_metrics,
@@ -709,27 +889,44 @@ def cmd_serve(args: argparse.Namespace) -> int:
         shards=args.shards,
         backend="xla" if args.impl == "auto" else args.impl,
         default_deadline_ms=args.deadline_ms,
+        retry_attempts=args.retry_attempts,
+        breaker_threshold=args.breaker_threshold,
+        breaker_reset_s=args.breaker_reset_s,
     )
-    app = ServeApp(cfg).start()
-    httpd = make_http_server(app, args.host, args.port)
-    log.info(
-        "serving [%s] on %s:%d (buckets %s, max_batch %d, max_delay %.1fms, "
-        "queue_depth %d, shards %d) — POST /v1/process, GET /healthz, "
-        "GET /stats",
-        app.pipe.name, args.host or "0.0.0.0", httpd.server_address[1],
-        args.buckets, args.max_batch, args.max_delay_ms, args.queue_depth,
-        args.shards,
-    )
+    stop_evt = threading.Event()
+
+    def _on_signal(signum, frame):
+        log.info(
+            "signal %s: graceful drain (deadline %.0fs)",
+            signal.Signals(signum).name, args.drain_deadline_s,
+        )
+        stop_evt.set()
+
+    prev_handlers = {
+        s: signal.signal(s, _on_signal)
+        for s in (signal.SIGTERM, signal.SIGINT)
+    }
+    srv = Server(cfg, args.host, args.port)
     try:
-        httpd.serve_forever()
+        srv.start()
+        log.info(
+            "serving [%s] on %s:%d (buckets %s, max_batch %d, max_delay "
+            "%.1fms, queue_depth %d, shards %d) — POST /v1/process, "
+            "GET /healthz, GET /stats",
+            srv.app.pipe.name, args.host or "0.0.0.0", srv.address[1],
+            args.buckets, args.max_batch, args.max_delay_ms,
+            args.queue_depth, args.shards,
+        )
+        stop_evt.wait()
     except KeyboardInterrupt:
         log.info("interrupt: draining and shutting down")
     finally:
-        httpd.server_close()
-        app.stop(drain=True)
+        for s, h in prev_handlers.items():
+            signal.signal(s, h)
+        srv.close(drain=True, deadline_s=args.drain_deadline_s)
         if args.json_metrics:
             emit_json_metrics(
-                {"event": "serve", **app.stats()},
+                {"event": "serve", **srv.app.stats()},
                 None if args.json_metrics == "-" else args.json_metrics,
             )
     return 0

@@ -37,6 +37,9 @@ def load_image(path: str | os.PathLike, *, grayscale: bool = False) -> np.ndarra
     golden grayscale op (identical results whether the native codec or PIL
     decoded the file); a single-channel source is returned as stored.
     """
+    from mpi_cuda_imagemanipulation_tpu.resilience import failpoints
+
+    failpoints.maybe_fail("io.decode", path=str(path))
     ext = os.path.splitext(str(path))[1].lower()
     native = _native_codec() if ext in _NATIVE_EXTS else None
     if native is not None:
@@ -91,6 +94,9 @@ def decode_image_bytes(data: bytes) -> np.ndarray:
 
     from PIL import Image
 
+    from mpi_cuda_imagemanipulation_tpu.resilience import failpoints
+
+    failpoints.maybe_fail("io.decode", n_bytes=len(data))
     with Image.open(_io.BytesIO(data)) as im:
         if im.mode in ("L", "1", "I", "I;16", "F"):
             return np.asarray(im.convert("L"), dtype=np.uint8)

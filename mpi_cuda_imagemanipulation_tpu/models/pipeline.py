@@ -140,12 +140,32 @@ class Pipeline:
                 sharded_pipeline_2d,
             )
 
-            return sharded_pipeline_2d(self, mesh, halo_mode=halo_mode)
-        from mpi_cuda_imagemanipulation_tpu.parallel.api import sharded_pipeline
+            fn = sharded_pipeline_2d(self, mesh, halo_mode=halo_mode)
+        else:
+            from mpi_cuda_imagemanipulation_tpu.parallel.api import (
+                sharded_pipeline,
+            )
 
-        return sharded_pipeline(
-            self, mesh, backend=backend, halo_mode=halo_mode
-        )
+            fn = sharded_pipeline(
+                self, mesh, backend=backend, halo_mode=halo_mode
+            )
+
+        def run(img, _fn=fn):
+            # failpoint at halo-exchange entry (resilience/failpoints.py):
+            # host-side, before the sharded program launches, so an armed
+            # `halo.exchange` site simulates a mid-collective rank failure
+            # without wedging the other shards (the reference's actual
+            # failure mode, kernel.cu:150)
+            from mpi_cuda_imagemanipulation_tpu.resilience import failpoints
+
+            failpoints.maybe_fail("halo.exchange", mesh_shape=mesh.shape)
+            return _fn(img)
+
+        # keep the jitted function's AOT surface reachable (the halo
+        # overlap tests lower the sharded program to inspect its module)
+        run.lower = getattr(fn, "lower", None)
+        run.__wrapped__ = fn
+        return run
 
     def data_parallel(self, mesh, backend: str = "xla"):
         """A jitted (N, H, W[, C]) -> (N, ...) batch function with the

@@ -1,0 +1,41 @@
+"""Fault-tolerance subsystem (ISSUE 3).
+
+The reference MPI/CUDA programs have zero error handling — a failed rank
+deadlocks its peers (kernel.cu:150) and a bad input aborts the job. This
+package gives the reproduction the recovery machinery a serving system
+needs, each piece independently testable on CPU:
+
+  * `failpoints`  — deterministic, seedable fault injection at named sites
+                    (io decode, cache warm, padded dispatch, halo entry),
+                    activated by env/CLI so every recovery path below can
+                    be exercised in tier-1 without real hardware faults;
+  * `retry`       — bounded exponential backoff with deterministic jitter;
+  * `breaker`     — per-key circuit breakers (closed → open → half-open);
+  * `health`      — the serving lifecycle state machine
+                    (starting → serving ⇄ degraded → draining → stopped)
+                    that drives /healthz and SIGTERM graceful drain;
+  * `journal`     — the append-only batch journal behind `batch --resume`.
+
+Wiring lives in serve/scheduler.py (retry + breaker + poison quarantine +
+golden-path degradation), serve/server.py (Server context manager, health
+endpoints, drain), and cli.py (batch journal/resume, failpoint flags).
+"""
+
+from mpi_cuda_imagemanipulation_tpu.resilience.breaker import (  # noqa: F401
+    BreakerBoard,
+    CircuitBreaker,
+)
+from mpi_cuda_imagemanipulation_tpu.resilience.failpoints import (  # noqa: F401
+    FailpointError,
+    maybe_fail,
+)
+from mpi_cuda_imagemanipulation_tpu.resilience.health import (  # noqa: F401
+    HealthState,
+)
+from mpi_cuda_imagemanipulation_tpu.resilience.journal import (  # noqa: F401
+    BatchJournal,
+)
+from mpi_cuda_imagemanipulation_tpu.resilience.retry import (  # noqa: F401
+    RetryPolicy,
+    call_with_retry,
+)

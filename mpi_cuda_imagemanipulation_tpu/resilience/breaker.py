@@ -1,0 +1,148 @@
+"""Circuit breakers — stop hammering a failing path, probe for recovery.
+
+`CircuitBreaker` is the classic three-state machine:
+
+    closed     normal operation; `failure_threshold` CONSECUTIVE failures
+               trip it open (any success resets the streak);
+    open       calls are refused (`allow()` is False) for `reset_timeout_s`
+               — the failing resource gets quiet time instead of a retry
+               storm, and the scheduler falls back to the golden path;
+    half-open  after the timeout ONE probe call is admitted: success
+               closes the breaker, failure re-opens it for another window.
+
+`BreakerBoard` keys independent breakers by an arbitrary hashable (the
+serving scheduler uses the shape bucket, so one poisoned bucket cannot
+black out the others) and reports whether any member is open — the signal
+that drives the health state machine's serving ⇄ degraded edge.
+
+Everything is lock-protected and takes an injectable clock, so tests step
+time explicitly.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+
+class CircuitBreaker:
+    def __init__(
+        self,
+        *,
+        failure_threshold: int = 5,
+        reset_timeout_s: float = 30.0,
+        clock=time.monotonic,
+    ):
+        if failure_threshold < 1:
+            raise ValueError(
+                f"failure_threshold must be >= 1, got {failure_threshold}"
+            )
+        self.failure_threshold = failure_threshold
+        self.reset_timeout_s = reset_timeout_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._consecutive_failures = 0
+        self._opened_at: float | None = None
+        self._probe_in_flight = False
+        self.open_events = 0  # cumulative trips (metrics)
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            self._maybe_half_open()
+            return self._state
+
+    def _maybe_half_open(self) -> None:
+        # under lock: open -> half_open once the quiet window has elapsed
+        if (
+            self._state == OPEN
+            and self._opened_at is not None
+            and self._clock() - self._opened_at >= self.reset_timeout_s
+        ):
+            self._state = HALF_OPEN
+            self._probe_in_flight = False
+
+    def allow(self) -> bool:
+        """May the caller attempt the protected operation right now?
+        Half-open admits exactly one probe until its outcome is reported."""
+        with self._lock:
+            self._maybe_half_open()
+            if self._state == CLOSED:
+                return True
+            if self._state == HALF_OPEN and not self._probe_in_flight:
+                self._probe_in_flight = True
+                return True
+            return False
+
+    def on_success(self) -> None:
+        with self._lock:
+            self._state = CLOSED
+            self._consecutive_failures = 0
+            self._opened_at = None
+            self._probe_in_flight = False
+
+    def on_failure(self) -> None:
+        with self._lock:
+            self._maybe_half_open()
+            if self._state == HALF_OPEN:
+                # failed probe: straight back to open for another window
+                self._state = OPEN
+                self._opened_at = self._clock()
+                self._probe_in_flight = False
+                self.open_events += 1
+                return
+            self._consecutive_failures += 1
+            if (
+                self._state == CLOSED
+                and self._consecutive_failures >= self.failure_threshold
+            ):
+                self._state = OPEN
+                self._opened_at = self._clock()
+                self.open_events += 1
+
+
+class BreakerBoard:
+    """Independent per-key breakers sharing one configuration."""
+
+    def __init__(
+        self,
+        *,
+        failure_threshold: int = 5,
+        reset_timeout_s: float = 30.0,
+        clock=time.monotonic,
+    ):
+        self._kw = dict(
+            failure_threshold=failure_threshold,
+            reset_timeout_s=reset_timeout_s,
+            clock=clock,
+        )
+        self._lock = threading.Lock()
+        self._breakers: dict = {}
+
+    def get(self, key) -> CircuitBreaker:
+        with self._lock:
+            b = self._breakers.get(key)
+            if b is None:
+                b = self._breakers[key] = CircuitBreaker(**self._kw)
+            return b
+
+    def any_open(self) -> bool:
+        with self._lock:
+            breakers = list(self._breakers.values())
+        return any(b.state != CLOSED for b in breakers)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            breakers = list(self._breakers.items())
+        return {
+            "open_events": sum(b.open_events for _, b in breakers),
+            "by_key": {
+                str(k): {"state": b.state, "open_events": b.open_events}
+                for k, b in breakers
+            },
+        }

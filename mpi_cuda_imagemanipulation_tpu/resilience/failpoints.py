@@ -1,0 +1,186 @@
+"""Deterministic failpoint injection — testable faults at named sites.
+
+A failpoint is a named place in the code (`maybe_fail("serve.dispatch")`)
+that normally does nothing. When armed — via the `MCIM_FAILPOINTS` env
+var, a CLI `--failpoints` flag, or `configure()` from a test — the site
+raises `FailpointError` according to its spec, so every recovery path
+(retry, breaker, quarantine, journal resume) can be exercised on CPU in
+tier-1 without real hardware faults.
+
+Spec grammar (comma-separated `site=mode` pairs):
+
+    serve.dispatch=0.1        10% of calls fail (seeded PRNG, so a given
+                              (seed, site) yields one deterministic
+                              fail/pass sequence regardless of timing)
+    cache.warm=once           only the first call fails
+    io.decode=first:3         the first 3 calls fail, later ones pass
+    batch.interrupt=after:5   every call after the 5th fails (simulates a
+                              mid-run kill/preemption for --resume tests)
+    serve.dispatch=always     every call fails
+
+Tests can also `install(site, decider)` a predicate over the call's
+keyword context (e.g. fail only when a poison request is in the batch).
+
+Determinism: each armed site owns a `random.Random(seed ^ crc32(site))`
+and a call counter behind one lock, so the Nth call to a site always gets
+the same decision for a given seed — independent of thread interleaving
+across *different* sites. The disarmed fast path is a single module-level
+flag check (no lock), so production code pays ~nothing.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import zlib
+
+# The catalog of sites the codebase actually calls (docs/design.md
+# "Failure model & recovery"). `configure` rejects names outside it so a
+# typo'd spec fails loudly instead of silently injecting nothing.
+KNOWN_SITES = (
+    "io.decode",        # io/image.py: decode_image_bytes / load_image
+    "cache.warm",       # serve/cache.py: per-cell warmup compile
+    "serve.dispatch",   # serve/scheduler.py: padded executor dispatch
+    "halo.exchange",    # models/pipeline.py: sharded pipeline entry
+    "batch.interrupt",  # cli.py cmd_batch: per-input loop head
+)
+
+ENV_SPEC = "MCIM_FAILPOINTS"
+ENV_SEED = "MCIM_FAILPOINT_SEED"
+
+
+class FailpointError(RuntimeError):
+    """An injected fault. Transient by definition — the retry layer treats
+    it like any other dispatch failure."""
+
+    def __init__(self, site: str, n_call: int):
+        super().__init__(f"injected failpoint {site!r} (call #{n_call})")
+        self.site = site
+        self.n_call = n_call
+
+
+class _Site:
+    """One armed site: decider + deterministic PRNG + call counter."""
+
+    def __init__(self, name: str, decider, seed: int):
+        self.name = name
+        self.decider = decider
+        self.rng = random.Random(seed ^ zlib.crc32(name.encode()))
+        self.calls = 0
+        self.fired = 0
+
+
+_lock = threading.Lock()
+_sites: dict[str, _Site] = {}
+_active = False  # lock-free fast-path flag; only flipped under _lock
+
+
+def _parse_mode(site: str, mode: str):
+    """Mode string -> decider(site_state, ctx) -> bool."""
+    mode = mode.strip().lower()
+    if mode == "always":
+        return lambda s, ctx: True
+    if mode == "once":
+        return lambda s, ctx: s.calls == 1
+    if mode.startswith("first:"):
+        n = int(mode.split(":", 1)[1])
+        return lambda s, ctx: s.calls <= n
+    if mode.startswith("after:"):
+        n = int(mode.split(":", 1)[1])
+        return lambda s, ctx: s.calls > n
+    try:
+        p = float(mode)
+    except ValueError:
+        raise ValueError(
+            f"failpoint {site!r}: unknown mode {mode!r} (want a probability, "
+            "'always', 'once', 'first:N' or 'after:N')"
+        ) from None
+    if not 0.0 <= p <= 1.0:
+        raise ValueError(f"failpoint {site!r}: probability {p} outside [0, 1]")
+    return lambda s, ctx: s.rng.random() < p
+
+
+def configure(spec: str | None, *, seed: int = 0) -> None:
+    """Arm failpoints from a spec string; `None`/empty clears everything."""
+    new: dict[str, _Site] = {}
+    if spec:
+        for tok in spec.split(","):
+            tok = tok.strip()
+            if not tok:
+                continue
+            site, sep, mode = tok.partition("=")
+            site = site.strip()
+            if not sep:
+                raise ValueError(f"failpoint token {tok!r}: expected site=mode")
+            if site not in KNOWN_SITES:
+                raise ValueError(
+                    f"unknown failpoint site {site!r}; known: {KNOWN_SITES}"
+                )
+            new[site] = _Site(site, _parse_mode(site, mode), seed)
+    global _active
+    with _lock:
+        _sites.clear()
+        _sites.update(new)
+        _active = bool(_sites)
+
+
+def configure_from_env(env=os.environ) -> None:
+    """Arm from MCIM_FAILPOINTS / MCIM_FAILPOINT_SEED (no-op when unset —
+    an already-armed in-process configuration is left alone)."""
+    spec = env.get(ENV_SPEC)
+    if spec:
+        configure(spec, seed=int(env.get(ENV_SEED, "0")))
+
+
+def install(site: str, decider) -> None:
+    """Arm one site with a predicate over the call's keyword context:
+    `decider(ctx: dict) -> bool`. Test hook for data-dependent faults
+    (e.g. fail only when a poison request rides in the batch)."""
+    if site not in KNOWN_SITES:
+        raise ValueError(f"unknown failpoint site {site!r}; known: {KNOWN_SITES}")
+    global _active
+    with _lock:
+        _sites[site] = _Site(site, lambda s, ctx, d=decider: d(ctx), seed=0)
+        _active = True
+
+
+def clear() -> None:
+    configure(None)
+
+
+def is_active() -> bool:
+    return _active
+
+
+def maybe_fail(site: str, **ctx) -> None:
+    """The injection point. Disarmed: one flag check. Armed: count the
+    call, ask the site's decider, raise FailpointError on a hit."""
+    if not _active:
+        return
+    with _lock:
+        s = _sites.get(site)
+        if s is None:
+            return
+        s.calls += 1
+        hit = s.decider(s, ctx)
+        if hit:
+            s.fired += 1
+            n = s.calls
+    if hit:
+        raise FailpointError(site, n)
+
+
+def counts() -> dict[str, dict[str, int]]:
+    """Per-site call/fire counters (test + /stats introspection)."""
+    with _lock:
+        return {
+            name: {"calls": s.calls, "fired": s.fired}
+            for name, s in _sites.items()
+        }
+
+
+# Arm from the environment at import: the CLI subcommands and the serving
+# stack all import this module before doing work, so `MCIM_FAILPOINTS=...`
+# on any entry point just works. Tests use configure()/clear() directly.
+configure_from_env()

@@ -21,16 +21,26 @@ an online service:
   * `server.py`     — stdlib ThreadingHTTPServer front end (POST
                       /v1/process, GET /healthz, GET /stats) plus the
                       in-process `Client` used by tests and the load
-                      generator.
-  * `loadgen.py`    — open-loop offered-load sweep (bench_suite lane).
+                      generator, and the context-manager `Server` that
+                      guarantees socket/scheduler release on every exit.
+  * `loadgen.py`    — open-loop offered-load sweep (bench_suite lane),
+                      with a fault_rate knob for availability runs.
+
+Fault tolerance (PR 3, resilience/): dispatch runs under a retrying
+executor with per-bucket circuit breakers, poison requests quarantine solo
+instead of failing their micro-batch, open breakers degrade traffic to the
+golden per-request path, and /healthz reports the health state machine
+(starting/serving/degraded/draining/stopped).
 """
 
 from mpi_cuda_imagemanipulation_tpu.serve.scheduler import (  # noqa: F401
     STATUS_DEADLINE,
     STATUS_OK,
     STATUS_OVERLOADED,
+    STATUS_QUARANTINED,
     DeadlineExceeded,
     Overloaded,
+    Quarantined,
     RequestRejected,
     ServeError,
 )
@@ -38,4 +48,5 @@ from mpi_cuda_imagemanipulation_tpu.serve.server import (  # noqa: F401
     Client,
     ServeApp,
     ServeConfig,
+    Server,
 )

@@ -22,6 +22,11 @@ import time
 import numpy as np
 
 from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline
+from mpi_cuda_imagemanipulation_tpu.resilience import failpoints
+from mpi_cuda_imagemanipulation_tpu.resilience.retry import (
+    RetryPolicy,
+    call_with_retry,
+)
 from mpi_cuda_imagemanipulation_tpu.serve.padded import check_servable
 
 Key = tuple[int, int, int, int]  # (bucket_h, bucket_w, channels, batch)
@@ -52,6 +57,11 @@ class CompileCache:
         self.hits = 0
         self.misses = 0
         self.warmup_s: float | None = None
+        # transient compile failures at warmup (wedged backend coming up,
+        # injected cache.warm failpoint) retry with backoff instead of
+        # killing the server before it ever admits a request
+        self.warm_retry_policy = RetryPolicy(max_attempts=3, base_delay_s=0.05)
+        self.warm_retries = 0
 
     def _on_trace(self) -> None:
         self.traces += 1
@@ -67,6 +77,7 @@ class CompileCache:
 
     def _compile_one(self, key: Key) -> None:
         bh, bw, ch, nb = key
+        failpoints.maybe_fail("cache.warm", key=key)
         fn = self._build(key)
         shape = (nb, bh, bw, ch) if ch > 1 else (nb, bh, bw)
         imgs = np.zeros(shape, dtype=np.uint8)
@@ -84,10 +95,25 @@ class CompileCache:
                     for nb in self.batch_buckets:
                         key = (bh, bw, ch, nb)
                         if key not in self._fns:
-                            self._compile_one(key)
+                            call_with_retry(
+                                lambda k=key: self._compile_one(k),
+                                policy=self.warm_retry_policy,
+                                on_retry=lambda a, e, d: self._on_warm_retry(
+                                    key, a, e
+                                ),
+                            )
             self.traces_at_warmup = self.traces
         self.warmup_s = time.perf_counter() - t0
         return self.warmup_s
+
+    def _on_warm_retry(self, key: Key, attempt: int, exc: Exception) -> None:
+        self.warm_retries += 1
+        from mpi_cuda_imagemanipulation_tpu.utils.log import get_logger
+
+        get_logger().warning(
+            "warmup compile for %s failed (%s), retry %d",
+            key, type(exc).__name__, attempt,
+        )
 
     @property
     def traces_since_warmup(self) -> int:
@@ -112,4 +138,5 @@ class CompileCache:
             "hits": self.hits,
             "misses": self.misses,
             "warmup_s": self.warmup_s,
+            "warm_retries": self.warm_retries,
         }

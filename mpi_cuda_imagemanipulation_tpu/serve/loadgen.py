@@ -11,6 +11,11 @@ against goodput.
 reports achieved throughput, p50/p95/p99 end-to-end latency, mean batch
 occupancy and shed fraction — the saturation curve that sizes
 `--max-batch`/`--queue-depth` for a deployment.
+
+`fault_rate` arms the `serve.dispatch` failpoint for the sweep so the lane
+also reports AVAILABILITY under injected transient faults: success %,
+shed %, retried %, quarantined — the numbers that size `--retry-attempts`
+and the breaker knobs the way the latency curve sizes the batching ones.
 """
 
 from __future__ import annotations
@@ -20,6 +25,7 @@ import time
 import numpy as np
 
 from mpi_cuda_imagemanipulation_tpu.io.image import synthetic_image
+from mpi_cuda_imagemanipulation_tpu.resilience import failpoints
 from mpi_cuda_imagemanipulation_tpu.serve.server import Client, ServeApp
 from mpi_cuda_imagemanipulation_tpu.utils.timing import percentiles
 
@@ -75,13 +81,19 @@ def run_offered_load(
     wall = clock() - t0
     ok = [h for h in handles if h.status == "ok"]
     shed = sum(1 for h in handles if h.status == "overloaded")
+    quarantined = sum(1 for h in handles if h.status == "quarantined")
     lat = [h.t_done - h.t_submit for h in ok]
+    n = len(handles)
     rec = {
         "offered_rps": offered_rps,
-        "submitted": len(handles),
+        "submitted": n,
         "completed": len(ok),
         "shed": shed,
-        "shed_frac": shed / len(handles) if handles else 0.0,
+        "shed_frac": shed / n if n else 0.0,
+        "quarantined": quarantined,
+        # availability: the fraction of offered load that got a good
+        # answer (shed is an explicit no, quarantined/error a failure)
+        "ok_frac": len(ok) / n if n else 0.0,
         "achieved_rps": len(ok) / wall if wall > 0 else 0.0,
         "wall_s": wall,
     }
@@ -99,9 +111,14 @@ def sweep(
     n_images: int = 64,
     channels: int = 3,
     seed: int = 7,
+    fault_rate: float = 0.0,
+    fault_seed: int = 7,
 ) -> list[dict]:
     """The offered-load sweep over a STARTED app. Dispatch metrics (batch
-    occupancy) are read as per-rate deltas of the app-wide counters."""
+    occupancy, retries) are read as per-rate deltas of the app-wide
+    counters. `fault_rate > 0` arms the `serve.dispatch` failpoint for the
+    whole sweep (cleared on exit), so the lane measures availability under
+    injected transient dispatch failures."""
     from mpi_cuda_imagemanipulation_tpu.serve.padded import min_true_dim
 
     client = Client(app)
@@ -112,15 +129,30 @@ def sweep(
         seed=seed,
         min_dim=min_true_dim(app.pipe),
     )
+    if fault_rate > 0.0:
+        failpoints.configure(
+            f"serve.dispatch={fault_rate}", seed=fault_seed
+        )
     records = []
-    for rps in offered_rps:
-        before = app.metrics.snapshot()
-        rec = run_offered_load(client, images, rps, duration_s)
-        after = app.metrics.snapshot()
-        d_real = (after["dispatches"] or 0) - (before["dispatches"] or 0)
-        if d_real:
-            done = after["completed"] - before["completed"]
-            rec["mean_batch_occupancy"] = done / d_real
-        rec["dispatches"] = d_real
-        records.append(rec)
+    try:
+        for rps in offered_rps:
+            before = app.metrics.snapshot()
+            rec = run_offered_load(client, images, rps, duration_s)
+            after = app.metrics.snapshot()
+            d_real = (after["dispatches"] or 0) - (before["dispatches"] or 0)
+            if d_real:
+                done = after["completed"] - before["completed"]
+                rec["mean_batch_occupancy"] = done / d_real
+            rec["dispatches"] = d_real
+            rec["retried"] = after["retries"] - before["retries"]
+            rec["retried_frac"] = (
+                rec["retried"] / rec["submitted"] if rec["submitted"] else 0.0
+            )
+            rec["degraded"] = after["degraded"] - before["degraded"]
+            if fault_rate > 0.0:
+                rec["fault_rate"] = fault_rate
+            records.append(rec)
+    finally:
+        if fault_rate > 0.0:
+            failpoints.clear()
     return records

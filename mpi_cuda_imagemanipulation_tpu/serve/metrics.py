@@ -33,6 +33,9 @@ class ServeMetrics:
         self.rejected = 0  # malformed / too-large / too-small requests
         self.deadline_expired = 0
         self.errors = 0
+        self.retries = 0  # dispatch attempts re-run by the retry executor
+        self.quarantined = 0  # poison requests failed solo after bisection
+        self.degraded = 0  # requests served via the golden fallback
         self.dispatches = 0
         self.batch_slots = 0  # compiled slots dispatched (incl. pad)
         self.batch_real = 0  # real requests dispatched
@@ -86,6 +89,21 @@ class ServeMetrics:
             self.errors += n
             self.queued -= n
 
+    def on_retry(self) -> None:
+        with self._lock:
+            self.retries += 1
+
+    def on_quarantine(self, n: int = 1) -> None:
+        with self._lock:
+            self.quarantined += n
+            self.queued -= n
+
+    def on_degraded(self, n: int = 1) -> None:
+        # the request ALSO counts through on_complete (it succeeded); this
+        # only tags how many went via the fallback path
+        with self._lock:
+            self.degraded += n
+
     # -- reporting ---------------------------------------------------------
 
     @staticmethod
@@ -107,6 +125,9 @@ class ServeMetrics:
                 "rejected": self.rejected,
                 "deadline_expired": self.deadline_expired,
                 "errors": self.errors,
+                "retries": self.retries,
+                "quarantined": self.quarantined,
+                "degraded": self.degraded,
                 "queued": self.queued,
                 "queued_peak": self.queued_peak,
                 "dispatches": self.dispatches,
@@ -126,7 +147,9 @@ class ServeMetrics:
         return (
             f"served {s['completed']}/{s['submitted']} "
             f"(shed {s['shed_overloaded']}, rejected {s['rejected']}, "
-            f"deadline {s['deadline_expired']}, errors {s['errors']}) in "
+            f"deadline {s['deadline_expired']}, errors {s['errors']}, "
+            f"retries {s['retries']}, quarantined {s['quarantined']}, "
+            f"degraded {s['degraded']}) in "
             f"{s['dispatches']} dispatches"
             + (f" (mean occupancy {occ:.2f})" if occ else "")
             + (

@@ -28,17 +28,38 @@ Bit-exactness note: a dispatch pads each image to the bucket and the stack
 to a compiled batch size (serve/bucketing), runs the serving executable
 (serve/padded — true shapes ride along), then crops each response back to
 its true shape. The pad slots repeat the last image and are dropped.
+
+Fault tolerance (resilience/): each dispatch runs under a retrying
+executor (exponential backoff + jitter) behind a per-bucket circuit
+breaker. A batch that still fails after retries is bisected — every
+member re-dispatched solo — so one poison request is quarantined with the
+distinct `quarantined` status instead of failing its whole micro-batch.
+While a bucket's breaker is open its traffic runs the golden per-request
+fallback (bit-identical, just slower) and the health state machine reports
+`degraded`; half-open probes restore the fast path when it recovers.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import random
 import threading
 import time
 from collections import OrderedDict, deque
 
 import numpy as np
 
+from mpi_cuda_imagemanipulation_tpu.resilience import failpoints
+from mpi_cuda_imagemanipulation_tpu.resilience.breaker import BreakerBoard
+from mpi_cuda_imagemanipulation_tpu.resilience.health import (
+    DEGRADED,
+    SERVING,
+    HealthState,
+)
+from mpi_cuda_imagemanipulation_tpu.resilience.retry import (
+    RetryPolicy,
+    call_with_retry,
+)
 from mpi_cuda_imagemanipulation_tpu.serve import bucketing
 from mpi_cuda_imagemanipulation_tpu.serve.cache import CompileCache
 from mpi_cuda_imagemanipulation_tpu.serve.metrics import ServeMetrics
@@ -50,6 +71,7 @@ STATUS_REJECTED = "rejected"
 STATUS_DEADLINE = "deadline_expired"
 STATUS_ERROR = "error"
 STATUS_SHUTDOWN = "shutdown"
+STATUS_QUARANTINED = "quarantined"
 
 
 class ServeError(Exception):
@@ -70,6 +92,13 @@ class RequestRejected(ServeError):
 
 class DeadlineExceeded(ServeError):
     status = STATUS_DEADLINE
+
+
+class Quarantined(ServeError):
+    """A poison request: it failed alone (after batch bisection + retries),
+    so the failure is attributed to this request, not its batch-mates."""
+
+    status = STATUS_QUARANTINED
 
 
 @dataclasses.dataclass
@@ -99,6 +128,7 @@ class Request:
             STATUS_OVERLOADED: Overloaded,
             STATUS_REJECTED: RequestRejected,
             STATUS_DEADLINE: DeadlineExceeded,
+            STATUS_QUARANTINED: Quarantined,
         }.get(self.status, ServeError)
         raise exc(self.error or self.status)
 
@@ -113,6 +143,11 @@ class MicroBatchScheduler:
         queue_depth: int,
         metrics: ServeMetrics | None = None,
         clock=time.monotonic,
+        retry_policy: RetryPolicy | None = None,
+        breakers: BreakerBoard | None = None,
+        health: HealthState | None = None,
+        fallback=None,
+        retry_seed: int = 0,
     ):
         if max_batch > max(cache.batch_buckets):
             raise ValueError(
@@ -125,6 +160,14 @@ class MicroBatchScheduler:
         self.queue_depth = queue_depth
         self.metrics = metrics or ServeMetrics()
         self.min_dim = _min_dim(cache)
+        # -- fault tolerance (resilience/): retry + breaker + fallback ------
+        self.retry_policy = retry_policy or RetryPolicy()
+        self.breakers = breakers or BreakerBoard()
+        self.health = health  # None: no state machine attached (tests)
+        # fallback(img: np.ndarray) -> np.ndarray — the golden per-request
+        # path a bucket degrades to while its breaker is open
+        self.fallback = fallback
+        self._retry_rng = random.Random(retry_seed)
         self._clock = clock
         self._cond = threading.Condition()
         # bucket key -> FIFO of Requests; OrderedDict so the aged-bucket
@@ -317,40 +360,151 @@ class MicroBatchScheduler:
                 live.append(r)
         if not live:
             return
+        bucket = live[0].bucket
+        breaker = self.breakers.get(bucket)
+        if not breaker.allow():
+            # breaker open (and no half-open probe slot): golden fallback
+            self._dispatch_degraded(live)
+            return
+        try:
+            out, nb, device_s = call_with_retry(
+                lambda: self._run_batch(live),
+                policy=self.retry_policy,
+                rng=self._retry_rng,
+                on_retry=lambda a, e, d: self._note_retry(bucket, a, e, d),
+            )
+        except Exception as e:  # retries exhausted: fail the path, not the loop
+            breaker.on_failure()
+            self._update_health()
+            self._log.warning(
+                "dispatch failed after %d attempts for bucket %s: %s",
+                self.retry_policy.max_attempts, bucket, e,
+            )
+            if len(live) == 1:
+                self.metrics.on_quarantine()
+                self._resolve(
+                    live[0], STATUS_QUARANTINED, f"{type(e).__name__}: {e}"
+                )
+            else:
+                # poison isolation: re-dispatch every member solo so one bad
+                # request cannot fail its batch-mates
+                self._bisect_solo(live)
+            return
+        breaker.on_success()
+        self._update_health()
+        self._complete(live, out, nb, device_s)
+
+    def _run_batch(self, live: list[Request]):
+        """One padded-executor dispatch attempt (the retry unit)."""
+        failpoints.maybe_fail("serve.dispatch", requests=live)
         bh, bw, ch = live[0].bucket
         nb = bucketing.pick_batch_bucket(len(live), self.cache.batch_buckets)
-        try:
-            fn = self.cache.get(bh, bw, ch, nb)
-            imgs = bucketing.pad_stack(
-                [bucketing.pad_to_bucket(r.img, bh, bw) for r in live], nb
-            )
-            th = np.asarray(
-                [r.true_h for r in live] + [live[-1].true_h] * (nb - len(live)),
-                dtype=np.int32,
-            )
-            tw = np.asarray(
-                [r.true_w for r in live] + [live[-1].true_w] * (nb - len(live)),
-                dtype=np.int32,
-            )
-            for r in live:
-                r.t_dispatch = now
-            t0 = self._clock()
-            out = np.asarray(fn(imgs, th, tw))  # forces completion + transfer
-            device_s = self._clock() - t0
-        except Exception as e:  # answer the batch, keep the loop alive
-            self._log.exception("dispatch failed for bucket %s", live[0].bucket)
-            self.metrics.on_error(len(live))
-            for r in live:
-                self._resolve(r, STATUS_ERROR, f"{type(e).__name__}: {e}")
-            return
+        fn = self.cache.get(bh, bw, ch, nb)
+        imgs = bucketing.pad_stack(
+            [bucketing.pad_to_bucket(r.img, bh, bw) for r in live], nb
+        )
+        th = np.asarray(
+            [r.true_h for r in live] + [live[-1].true_h] * (nb - len(live)),
+            dtype=np.int32,
+        )
+        tw = np.asarray(
+            [r.true_w for r in live] + [live[-1].true_w] * (nb - len(live)),
+            dtype=np.int32,
+        )
+        now = self._clock()
+        for r in live:
+            r.t_dispatch = now
+        t0 = self._clock()
+        out = np.asarray(fn(imgs, th, tw))  # forces completion + transfer
+        return out, nb, self._clock() - t0
+
+    def _complete(self, live, out, nb, device_s) -> None:
         self.metrics.on_dispatch(len(live), nb, device_s)
         t_done = self._clock()
         for k, r in enumerate(live):
             r.result = out[k, : r.true_h, : r.true_w, ...]
             r.t_done = t_done
             r.status = STATUS_OK
-            self.metrics.on_complete(now - r.t_submit, t_done - r.t_submit)
+            self.metrics.on_complete(
+                (r.t_dispatch or r.t_submit) - r.t_submit,
+                t_done - r.t_submit,
+            )
             r.done.set()
+
+    def _note_retry(self, bucket, attempt, exc, delay_s) -> None:
+        self.metrics.on_retry()
+        self._log.info(
+            "retrying bucket %s after %s (attempt %d, backoff %.1fms)",
+            bucket, type(exc).__name__, attempt, delay_s * 1e3,
+        )
+
+    def _bisect_solo(self, live: list[Request]) -> None:
+        """Failed-batch isolation: each member gets its own retried solo
+        dispatch. Survivors complete normally; the poison fails alone with
+        the distinct `quarantined` status."""
+        bucket = live[0].bucket
+        breaker = self.breakers.get(bucket)
+        for r in live:
+            try:
+                out, nb, device_s = call_with_retry(
+                    lambda r=r: self._run_batch([r]),
+                    policy=self.retry_policy,
+                    rng=self._retry_rng,
+                    on_retry=lambda a, e, d: self._note_retry(bucket, a, e, d),
+                )
+            except Exception as e:
+                self.metrics.on_quarantine()
+                self._resolve(
+                    r, STATUS_QUARANTINED, f"{type(e).__name__}: {e}"
+                )
+            else:
+                # the path works without the poison: healthy signal
+                breaker.on_success()
+                self._complete([r], out, nb, device_s)
+        self._update_health()
+
+    def _dispatch_degraded(self, live: list[Request]) -> None:
+        """Open-breaker path: serve each request through the golden
+        per-request fallback (bit-identical output, no micro-batching)."""
+        if self.fallback is None:
+            self.metrics.on_error(len(live))
+            for r in live:
+                self._resolve(
+                    r, STATUS_ERROR,
+                    f"circuit open for bucket {r.bucket} and no fallback",
+                )
+            return
+        for r in live:
+            r.t_dispatch = self._clock()
+            try:
+                out = np.asarray(self.fallback(r.img))
+            except Exception as e:
+                self.metrics.on_quarantine()
+                self._resolve(
+                    r, STATUS_QUARANTINED, f"{type(e).__name__}: {e}"
+                )
+                continue
+            t_done = self._clock()
+            r.result = out
+            r.t_done = t_done
+            r.status = STATUS_OK
+            self.metrics.on_degraded()
+            self.metrics.on_complete(
+                r.t_dispatch - r.t_submit, t_done - r.t_submit
+            )
+            r.done.set()
+
+    def _update_health(self) -> None:
+        """Drive the serving <-> degraded edge off the breaker board."""
+        if self.health is None:
+            return
+        state = self.health.state
+        if state == SERVING and self.breakers.any_open():
+            self._log.warning("dispatch breaker open: health -> degraded")
+            self.health.to(DEGRADED)
+        elif state == DEGRADED and not self.breakers.any_open():
+            self._log.info("breakers recovered: health -> serving")
+            self.health.to(SERVING)
 
 
 def _min_dim(cache: CompileCache) -> int:

@@ -6,13 +6,23 @@ Two front doors share it:
 
   * `Client` — in-process, zero-copy: numpy image in, numpy image out.
     Used by tests and the load generator (serve/loadgen.py).
-  * `make_http_server` — a stdlib `ThreadingHTTPServer`:
+  * `Server` — context-manager ownership of app + HTTP listener: the
+    socket and the scheduler thread are released on EVERY exit path
+    (exception mid-startup included), so repeated runs can't EADDRINUSE.
         POST /v1/process   PNG (or any PIL-decodable) bytes in, PNG out
-        GET  /healthz      liveness
+        GET  /healthz      health state machine (resilience/health.py):
+                           200 serving/degraded · 503 otherwise
         GET  /stats        metrics snapshot (serve/metrics.py schema)
     Status mapping: 200 ok · 400 rejected (undecodable/out-of-range) ·
+    422 quarantined (poison request — failed solo after batch bisection) ·
     429 overloaded (shed — Retry-After included) · 503 shutting down ·
     504 deadline_expired · 500 error.
+
+Fault tolerance: ServeApp owns the HealthState machine and a per-bucket
+BreakerBoard; dispatch runs under the retrying executor and degrades to
+the golden per-request path while a bucket's breaker is open
+(serve/scheduler.py). `Server.drain()` is the SIGTERM path: stop
+admission, flush in-flight under a deadline, then stop.
 
 Threading model: HTTP handler threads and Client callers only touch the
 bounded admission queue; the single scheduler thread owns the device.
@@ -22,17 +32,28 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
 from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline
+from mpi_cuda_imagemanipulation_tpu.resilience.breaker import BreakerBoard
+from mpi_cuda_imagemanipulation_tpu.resilience.health import (
+    DRAINING,
+    SERVING,
+    STARTING,
+    STOPPED,
+    HealthState,
+)
+from mpi_cuda_imagemanipulation_tpu.resilience.retry import RetryPolicy
 from mpi_cuda_imagemanipulation_tpu.serve import bucketing
 from mpi_cuda_imagemanipulation_tpu.serve.cache import CompileCache
 from mpi_cuda_imagemanipulation_tpu.serve.metrics import ServeMetrics
 from mpi_cuda_imagemanipulation_tpu.serve.scheduler import (
     STATUS_DEADLINE,
     STATUS_OVERLOADED,
+    STATUS_QUARANTINED,
     STATUS_REJECTED,
     STATUS_SHUTDOWN,
     MicroBatchScheduler,
@@ -42,6 +63,7 @@ from mpi_cuda_imagemanipulation_tpu.utils.log import get_logger
 
 _HTTP_STATUS = {
     STATUS_REJECTED: 400,
+    STATUS_QUARANTINED: 422,
     STATUS_OVERLOADED: 429,
     STATUS_SHUTDOWN: 503,
     STATUS_DEADLINE: 504,
@@ -59,6 +81,12 @@ class ServeConfig:
     shards: int = 1
     backend: str = "xla"
     default_deadline_ms: float | None = None
+    # -- fault tolerance (resilience/) ------------------------------------
+    retry_attempts: int = 3  # per dispatch, incl. the first try
+    retry_base_delay_ms: float = 5.0
+    breaker_threshold: int = 5  # consecutive failures to trip a bucket open
+    breaker_reset_s: float = 30.0  # quiet window before a half-open probe
+    degrade_to_golden: bool = True  # open breaker -> per-request fallback
 
 
 class ServeApp:
@@ -92,12 +120,32 @@ class ServeApp:
             backend=config.backend,
             mesh=mesh,
         )
+        self.health = HealthState()
+        self.breakers = BreakerBoard(
+            failure_threshold=config.breaker_threshold,
+            reset_timeout_s=config.breaker_reset_s,
+        )
+        # degraded mode: the golden per-request path (bit-identical to the
+        # padded executor by the serving contract; traces per novel shape,
+        # which is acceptable for a fallback that only runs breaker-open)
+        self._fallback_jit = self.pipe.jit() if config.degrade_to_golden else None
         self.scheduler = MicroBatchScheduler(
             self.cache,
             max_batch=config.max_batch,
             max_delay_ms=config.max_delay_ms,
             queue_depth=config.queue_depth,
             metrics=self.metrics,
+            retry_policy=RetryPolicy(
+                max_attempts=config.retry_attempts,
+                base_delay_s=config.retry_base_delay_ms / 1e3,
+            ),
+            breakers=self.breakers,
+            health=self.health,
+            fallback=(
+                (lambda img: np.asarray(self._fallback_jit(img)))
+                if self._fallback_jit is not None
+                else None
+            ),
         )
         self._log = get_logger()
 
@@ -111,10 +159,19 @@ class ServeApp:
             list(self.cache.channels), list(self.cache.batch_buckets),
         )
         self.scheduler.start()
+        self.health.to(SERVING)
         return self
 
-    def stop(self, *, drain: bool = True) -> None:
-        self.scheduler.stop(drain=drain)
+    def stop(self, *, drain: bool = True, deadline_s: float = 30.0) -> None:
+        """Idempotent shutdown: health -> draining (admission continues to
+        be refused by the stopping scheduler), flush under `deadline_s`
+        when draining, then health -> stopped."""
+        if self.health.state == STOPPED:
+            return
+        if self.health.state not in (STARTING,):
+            self.health.to(DRAINING)
+        self.scheduler.stop(drain=drain, timeout=deadline_s)
+        self.health.to(STOPPED)
         self._log.info("serve shutdown: %s", self.metrics.summary_line())
 
     def stats(self) -> dict:
@@ -126,6 +183,8 @@ class ServeApp:
             "max_delay_ms": self.config.max_delay_ms,
             "queue_depth": self.config.queue_depth,
             "shards": self.config.shards,
+            "health": self.health.to_dict(),
+            "breakers": self.breakers.snapshot(),
             "cache": self.cache.stats(),
             **self.metrics.snapshot(),
         }
@@ -178,7 +237,11 @@ def _make_handler(app: ServeApp):
 
         def do_GET(self):  # noqa: N802 (stdlib casing)
             if self.path == "/healthz":
-                self._send_json(200, {"status": "ok"})
+                # the health state machine, not a static "ok": 200 while
+                # admitting (serving/degraded), 503 starting/draining/stopped
+                self._send_json(
+                    app.health.http_code(), app.health.to_dict()
+                )
             elif self.path == "/stats":
                 self._send_json(200, app.stats())
             else:
@@ -228,5 +291,82 @@ def _make_handler(app: ServeApp):
 def make_http_server(app: ServeApp, host: str = "", port: int = 8000):
     """A ThreadingHTTPServer bound to (host, port); port 0 picks a free one
     (the bound port is `server.server_address[1]`). Caller owns
-    serve_forever()/shutdown()."""
+    serve_forever()/shutdown(). Prefer `Server`, which guarantees release
+    on exception paths."""
     return ThreadingHTTPServer((host, port), _make_handler(app))
+
+
+class Server:
+    """The full serving stack as a context manager.
+
+    Ordering matters for clean failure: the compile-cache warmup (the slow,
+    failure-prone part) runs BEFORE the socket binds, and any exception on
+    the way up tears down whatever did come up — so a crashed startup never
+    leaks the listener socket or the scheduler thread, and an immediate
+    re-run on the same port cannot hit EADDRINUSE.
+
+        with Server(cfg, port=0) as srv:
+            ... srv.address, srv.app ...
+        # socket closed + scheduler stopped on ANY exit, exception included
+
+    `drain(deadline_s)` is the SIGTERM path: health -> draining, admission
+    refused, in-flight + queued work flushed under the deadline, listener
+    closed, health -> stopped.
+    """
+
+    def __init__(self, config: ServeConfig, host: str = "", port: int = 0):
+        self.app = ServeApp(config)
+        self.host = host
+        self.port = port
+        self.httpd: ThreadingHTTPServer | None = None
+        self._http_thread: threading.Thread | None = None
+        self._closed = False
+        self._log = get_logger()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "Server":
+        try:
+            self.app.start()  # warmup + scheduler; no socket yet
+            self.httpd = make_http_server(self.app, self.host, self.port)
+            self._http_thread = threading.Thread(
+                target=self.httpd.serve_forever,
+                name="mcim-serve-http",
+                daemon=True,
+            )
+            self._http_thread.start()
+        except BaseException:
+            self.close(drain=False)
+            raise
+        return self
+
+    @property
+    def address(self) -> tuple[str, int]:
+        assert self.httpd is not None, "Server not started"
+        host, port = self.httpd.server_address[:2]
+        return (host, port)
+
+    def drain(self, deadline_s: float = 30.0) -> None:
+        """Graceful SIGTERM shutdown: flush everything admitted, bounded."""
+        self.close(drain=True, deadline_s=deadline_s)
+
+    def close(self, *, drain: bool = True, deadline_s: float = 30.0) -> None:
+        """Idempotent teardown of listener + scheduler, every exit path."""
+        if self._closed:
+            return
+        self._closed = True
+        if self.httpd is not None:
+            try:
+                self.httpd.shutdown()  # stops serve_forever; no new conns
+            except Exception:
+                pass
+            self.httpd.server_close()  # releases the listener socket
+        if self._http_thread is not None:
+            self._http_thread.join(timeout=10.0)
+        self.app.stop(drain=drain, deadline_s=deadline_s)
+
+    def __enter__(self) -> "Server":
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()

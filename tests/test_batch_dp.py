@@ -92,7 +92,10 @@ def test_cli_batch_stack_with_1x1_shards(tmp_path):
          "--stack", "2", "--shards", "1x1", "--device", "cpu"]
     )
     assert rc == 0
-    assert sorted(p.name for p in outd.iterdir()) == ["im0.png", "im1.png"]
+    # ignore the dot-hidden batch journal (PR 3, resilience/journal.py)
+    assert sorted(
+        p.name for p in outd.iterdir() if not p.name.startswith(".")
+    ) == ["im0.png", "im1.png"]
 
 
 @needs_multidevice

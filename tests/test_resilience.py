@@ -1,0 +1,756 @@
+"""Fault-tolerant execution layer (resilience/) — the ISSUE-3 suite.
+
+The load-bearing invariants:
+  1. failpoints are deterministic and seedable, so every recovery path is
+     reproducible on CPU;
+  2. under injected transient dispatch failures EVERY request resolves
+     (success / quarantined / shed — none hang) and successful outputs
+     stay bit-identical to the golden path;
+  3. a poison request fails ALONE (quarantined after batch bisection);
+     its batch-mates still succeed;
+  4. an open breaker degrades traffic to the golden fallback (still
+     bit-identical) and /health reports `degraded`; a half-open probe
+     restores the fast path;
+  5. a `cmd_batch` run killed mid-way completes via `--resume` without
+     reprocessing journaled inputs (content-hash-verified);
+  6. scheduler stop under in-flight load resolves every queued request —
+     drain ships them, no-drain rejects with the distinct status.
+"""
+
+import json
+import os
+import random
+import threading
+import time
+import urllib.request
+import zlib
+
+import numpy as np
+import pytest
+
+from mpi_cuda_imagemanipulation_tpu.io.image import (
+    load_image,
+    save_image,
+    synthetic_image,
+)
+from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline
+from mpi_cuda_imagemanipulation_tpu.resilience import failpoints
+from mpi_cuda_imagemanipulation_tpu.resilience.breaker import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    BreakerBoard,
+    CircuitBreaker,
+)
+from mpi_cuda_imagemanipulation_tpu.resilience.failpoints import FailpointError
+from mpi_cuda_imagemanipulation_tpu.resilience.health import (
+    DEGRADED,
+    DRAINING,
+    SERVING,
+    STARTING,
+    STOPPED,
+    HealthState,
+)
+from mpi_cuda_imagemanipulation_tpu.resilience.journal import (
+    BatchJournal,
+    content_digest,
+)
+from mpi_cuda_imagemanipulation_tpu.resilience.retry import (
+    RetryPolicy,
+    call_with_retry,
+)
+from mpi_cuda_imagemanipulation_tpu.serve.scheduler import (
+    STATUS_OK,
+    STATUS_QUARANTINED,
+    STATUS_SHUTDOWN,
+    Quarantined,
+    ServeError,
+)
+from mpi_cuda_imagemanipulation_tpu.serve.server import (
+    Client,
+    ServeApp,
+    ServeConfig,
+    Server,
+)
+
+REFERENCE_OPS = "grayscale,contrast:3.5,emboss:3"
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.clear()
+    yield
+    failpoints.clear()
+
+
+def _app(**over) -> ServeApp:
+    cfg = ServeConfig(
+        **{
+            "ops": REFERENCE_OPS,
+            "buckets": ((48, 48),),
+            "max_batch": 4,
+            "max_delay_ms": 10.0,
+            "queue_depth": 64,
+            "channels": (3,),
+            "retry_base_delay_ms": 1.0,
+            **over,
+        }
+    )
+    return ServeApp(cfg).start()
+
+
+def _seed_failing_first(site: str, rate: float) -> int:
+    """A seed whose FIRST draw for `site` at `rate` injects a failure, so
+    retry counters are provably exercised without flaking on how many
+    draws a timing-dependent run consumes."""
+    for seed in range(1000):
+        rng = random.Random(seed ^ zlib.crc32(site.encode()))
+        if rng.random() < rate:
+            return seed
+    raise AssertionError("no seed found")  # pragma: no cover
+
+
+# --------------------------------------------------------------------------
+# failpoints: deterministic, seedable, validated
+# --------------------------------------------------------------------------
+
+
+def test_failpoint_spec_validation():
+    with pytest.raises(ValueError):
+        failpoints.configure("nope.site=0.5")
+    with pytest.raises(ValueError):
+        failpoints.configure("serve.dispatch=wat")
+    with pytest.raises(ValueError):
+        failpoints.configure("serve.dispatch=1.5")
+    with pytest.raises(ValueError):
+        failpoints.configure("serve.dispatch")  # no '=mode'
+    assert not failpoints.is_active()
+
+
+def test_failpoint_probability_is_deterministic_per_seed():
+    def run(seed):
+        failpoints.configure("serve.dispatch=0.5", seed=seed)
+        out = []
+        for _ in range(32):
+            try:
+                failpoints.maybe_fail("serve.dispatch")
+                out.append(0)
+            except FailpointError:
+                out.append(1)
+        return out
+
+    a, b, c = run(3), run(3), run(4)
+    assert a == b
+    assert a != c  # different seed, different sequence
+    assert 0 < sum(a) < 32  # actually mixed at p=0.5
+
+
+def test_failpoint_modes_once_first_after_always():
+    failpoints.configure("io.decode=once")
+    with pytest.raises(FailpointError):
+        failpoints.maybe_fail("io.decode")
+    failpoints.maybe_fail("io.decode")  # second call passes
+
+    failpoints.configure("io.decode=first:2")
+    for _ in range(2):
+        with pytest.raises(FailpointError):
+            failpoints.maybe_fail("io.decode")
+    failpoints.maybe_fail("io.decode")
+
+    failpoints.configure("batch.interrupt=after:2")
+    failpoints.maybe_fail("batch.interrupt")
+    failpoints.maybe_fail("batch.interrupt")
+    with pytest.raises(FailpointError):
+        failpoints.maybe_fail("batch.interrupt")
+
+    failpoints.configure("cache.warm=always")
+    with pytest.raises(FailpointError):
+        failpoints.maybe_fail("cache.warm")
+    assert failpoints.counts()["cache.warm"]["fired"] == 1
+
+    failpoints.clear()
+    failpoints.maybe_fail("cache.warm")  # disarmed: no-op
+
+
+def test_failpoint_sites_are_wired():
+    """The catalog sites actually fire where docs/design.md says they do."""
+    from mpi_cuda_imagemanipulation_tpu.io.image import decode_image_bytes
+    from mpi_cuda_imagemanipulation_tpu.parallel.mesh import make_mesh
+
+    failpoints.configure("io.decode=always")
+    with pytest.raises(FailpointError):
+        decode_image_bytes(b"anything")
+    with pytest.raises(FailpointError):
+        load_image("/nonexistent.png")  # failpoint fires before open
+
+    failpoints.configure("halo.exchange=always")
+    fn = Pipeline.parse("gaussian:3").sharded(make_mesh(8))
+    with pytest.raises(FailpointError):
+        fn(synthetic_image(64, 48, channels=1, seed=0))
+
+
+# --------------------------------------------------------------------------
+# retry: bounded, deterministic backoff
+# --------------------------------------------------------------------------
+
+
+def test_retry_succeeds_after_transient_failures():
+    calls = {"n": 0}
+    delays = []
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise FailpointError("serve.dispatch", calls["n"])
+        return "done"
+
+    policy = RetryPolicy(
+        max_attempts=3, base_delay_s=0.01, multiplier=2.0, jitter_frac=0.0
+    )
+    got = call_with_retry(
+        flaky, policy=policy, sleep=delays.append, rng=random.Random(0)
+    )
+    assert got == "done" and calls["n"] == 3
+    assert delays == [0.01, 0.02]  # exact: jitter disabled
+
+
+def test_retry_exhaustion_and_non_retryable():
+    def always(e):
+        def f():
+            raise e
+
+        return f
+
+    policy = RetryPolicy(max_attempts=3, base_delay_s=0.0, jitter_frac=0.0)
+    with pytest.raises(FailpointError):
+        call_with_retry(
+            always(FailpointError("s", 1)), policy=policy, sleep=lambda s: None
+        )
+    with pytest.raises(KeyError):  # non_retryable propagates on attempt 1
+        call_with_retry(
+            always(KeyError("k")),
+            policy=policy,
+            non_retryable=(KeyError,),
+            sleep=lambda s: None,
+        )
+
+
+def test_retry_jitter_bounded_and_seeded():
+    policy = RetryPolicy(
+        max_attempts=5, base_delay_s=0.1, multiplier=1.0, jitter_frac=0.2
+    )
+    a = [policy.delay_s(i, random.Random(7)) for i in range(1, 5)]
+    b = [policy.delay_s(i, random.Random(7)) for i in range(1, 5)]
+    assert a == b  # seeded rng -> deterministic schedule
+    for d in a:
+        assert 0.08 <= d <= 0.12
+
+
+# --------------------------------------------------------------------------
+# circuit breaker: closed -> open -> half-open -> closed
+# --------------------------------------------------------------------------
+
+
+def test_breaker_lifecycle_with_fake_clock():
+    t = {"now": 0.0}
+    b = CircuitBreaker(
+        failure_threshold=2, reset_timeout_s=10.0, clock=lambda: t["now"]
+    )
+    assert b.state == CLOSED and b.allow()
+    b.on_failure()
+    assert b.state == CLOSED  # one failure, threshold 2
+    b.on_success()
+    b.on_failure()
+    assert b.state == CLOSED  # success reset the streak
+    b.on_failure()
+    b.on_failure()
+    assert b.state == OPEN and b.open_events == 1
+    assert not b.allow()
+    t["now"] = 10.0  # quiet window elapsed
+    assert b.state == HALF_OPEN
+    assert b.allow()  # the one probe slot
+    assert not b.allow()  # second caller refused while probe in flight
+    b.on_failure()  # failed probe: straight back to open
+    assert b.state == OPEN and b.open_events == 2
+    t["now"] = 20.0
+    assert b.allow()
+    b.on_success()
+    assert b.state == CLOSED and b.allow()
+
+
+def test_breaker_board_keys_are_independent():
+    t = {"now": 0.0}
+    board = BreakerBoard(
+        failure_threshold=1, reset_timeout_s=60.0, clock=lambda: t["now"]
+    )
+    board.get("a").on_failure()
+    assert board.get("a").state == OPEN
+    assert board.get("b").state == CLOSED  # other key untouched
+    assert board.any_open()
+    snap = board.snapshot()
+    assert snap["open_events"] == 1 and snap["by_key"]["a"]["state"] == OPEN
+
+
+# --------------------------------------------------------------------------
+# health state machine
+# --------------------------------------------------------------------------
+
+
+def test_health_transitions_and_http_codes():
+    h = HealthState()
+    assert h.state == STARTING and h.http_code() == 503
+    with pytest.raises(ValueError):
+        h.to(DEGRADED)  # starting cannot degrade
+    h.to(SERVING)
+    assert h.http_code() == 200 and h.is_admitting()
+    h.to(DEGRADED)
+    assert h.http_code() == 200 and h.is_admitting()  # keep routing traffic
+    h.to(SERVING)  # recovery edge
+    h.to(DRAINING)
+    assert h.http_code() == 503 and not h.is_admitting()
+    with pytest.raises(ValueError):
+        h.to(SERVING)  # draining is one-way
+    h.to(STOPPED)
+    h.to(STOPPED)  # self-transition is a no-op
+    assert h.to_dict()["state"] == STOPPED
+
+
+# --------------------------------------------------------------------------
+# journal
+# --------------------------------------------------------------------------
+
+
+def test_journal_roundtrip_last_wins_and_torn_line(tmp_path):
+    j = BatchJournal(tmp_path / "j.jsonl")
+    assert j.load() == {}
+    j.record_failed("a.png", "d1", "boom")
+    j.record_ok("a.png", "d1", "a.png")
+    j.record_ok("b.png", "d2", "b.png")
+    with open(j.path, "a") as f:
+        f.write('{"input": "c.png", "status": "o')  # torn mid-append kill
+    got = j.load()
+    assert got["a.png"]["status"] == "ok"  # later line wins
+    assert got["b.png"]["digest"] == "d2"
+    assert "c.png" not in got  # torn line skipped, not fatal
+
+    p = tmp_path / "x.bin"
+    p.write_bytes(b"hello")
+    j.record_ok("x.bin", content_digest(p), "x.bin")
+    assert j.completed("x.bin", p)
+    p.write_bytes(b"edited")  # content changed -> must reprocess
+    assert not j.completed("x.bin", p)
+
+
+# --------------------------------------------------------------------------
+# acceptance: transient dispatch failures under concurrent mixed-shape load
+# --------------------------------------------------------------------------
+
+
+def test_injected_transient_failures_all_resolve_bit_identical():
+    """THE acceptance test: 10% transient dispatch failure rate, concurrent
+    mixed-shape load — every request resolves (none hang), successes are
+    bit-identical to the golden path, and the retry path provably ran."""
+    seed = _seed_failing_first("serve.dispatch", 0.10)
+    failpoints.configure("serve.dispatch=0.10", seed=seed)
+    app = _app(buckets=((48, 48), (96, 96)), max_delay_ms=5.0)
+    try:
+        client = Client(app)
+        jfn = Pipeline.parse(REFERENCE_OPS).jit()
+        shapes = [(33, 47), (48, 48), (17, 90), (96, 96), (40, 40), (5, 60)]
+        results = []
+        lock = threading.Lock()
+
+        def worker(k: int):
+            h, w = shapes[k % len(shapes)]
+            img = synthetic_image(h, w, channels=3, seed=k)
+            req = client.submit(img)
+            done = req.done.wait(120)
+            with lock:
+                results.append((img, req, done))
+
+        threads = [
+            threading.Thread(target=worker, args=(k,)) for k in range(24)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        assert len(results) == 24
+        # invariant 1: NOTHING hangs — every request resolved to a status
+        assert all(done for _, _, done in results)
+        statuses = {r.status for _, r, _ in results}
+        assert statuses <= {STATUS_OK, STATUS_QUARANTINED}
+        # invariant 2: whatever succeeded is bit-identical to golden
+        n_ok = 0
+        for img, r, _ in results:
+            if r.status == STATUS_OK:
+                n_ok += 1
+                np.testing.assert_array_equal(r.result, np.asarray(jfn(img)))
+        assert n_ok > 0
+        m = app.metrics.snapshot()
+        # the seeded first-draw failure guarantees the retry executor ran
+        assert m["retries"] >= 1
+        # accounting closes: every submission resolved somewhere
+        assert (
+            m["completed"] + m["quarantined"] + m["errors"]
+            + m["shed_overloaded"] + m["rejected"] + m["deadline_expired"]
+            == m["submitted"]
+        )
+        assert m["queued"] == 0
+    finally:
+        app.stop()
+
+
+def test_poison_request_quarantined_alone_batchmates_succeed():
+    """A batch containing one poison request fails; bisection re-runs the
+    members solo, so the poison gets `quarantined` and the rest succeed."""
+    POISON_H = 13
+
+    failpoints.install(
+        "serve.dispatch",
+        lambda ctx: any(r.true_h == POISON_H for r in ctx["requests"]),
+    )
+    app = _app(max_batch=4, max_delay_ms=40.0)
+    try:
+        client = Client(app)
+        jfn = Pipeline.parse(REFERENCE_OPS).jit()
+        imgs = [
+            synthetic_image(20, 30, channels=3, seed=1),
+            synthetic_image(POISON_H, 30, channels=3, seed=2),  # the poison
+            synthetic_image(21, 31, channels=3, seed=3),
+            synthetic_image(22, 32, channels=3, seed=4),
+        ]
+        reqs = [client.submit(im) for im in imgs]  # same bucket: coalesce
+        for r in reqs:
+            assert r.done.wait(120)
+        assert reqs[1].status == STATUS_QUARANTINED
+        with pytest.raises(Quarantined):
+            reqs[1].wait(0)
+        for k in (0, 2, 3):
+            assert reqs[k].status == STATUS_OK, reqs[k].error
+            np.testing.assert_array_equal(
+                reqs[k].result, np.asarray(jfn(imgs[k]))
+            )
+        m = app.metrics.snapshot()
+        assert m["quarantined"] == 1 and m["completed"] == 3
+    finally:
+        app.stop()
+
+
+def test_breaker_opens_degrades_to_golden_then_recovers():
+    """Hard dispatch failure trips the bucket breaker; traffic degrades to
+    the golden per-request fallback (bit-identical, health=degraded); once
+    the fault clears, the half-open probe restores the fast path."""
+    failpoints.configure("serve.dispatch=always")
+    app = _app(
+        max_batch=2,
+        max_delay_ms=2.0,
+        retry_attempts=2,
+        breaker_threshold=1,
+        breaker_reset_s=0.5,
+    )
+    try:
+        client = Client(app)
+        jfn = Pipeline.parse(REFERENCE_OPS).jit()
+        img = synthetic_image(20, 30, channels=3, seed=5)
+        # first request: fast path fails through retries -> quarantined solo
+        with pytest.raises(Quarantined):
+            client.process(img, timeout=120)
+        assert app.breakers.any_open()
+        assert app.health.state == DEGRADED
+        # while open: requests run the golden fallback — still bit-identical
+        out = client.process(img, timeout=120)
+        np.testing.assert_array_equal(out, np.asarray(jfn(img)))
+        m = app.metrics.snapshot()
+        assert m["degraded"] >= 1
+        assert app.breakers.snapshot()["open_events"] >= 1
+        # fault clears; after the quiet window a half-open probe succeeds
+        failpoints.clear()
+        time.sleep(0.6)
+        out = client.process(img, timeout=120)
+        np.testing.assert_array_equal(out, np.asarray(jfn(img)))
+        deadline = time.monotonic() + 10
+        while app.health.state != SERVING and time.monotonic() < deadline:
+            client.process(img, timeout=120)
+            time.sleep(0.01)
+        assert app.health.state == SERVING
+        assert not app.breakers.any_open()
+    finally:
+        app.stop()
+
+
+def test_cache_warm_retries_transient_compile_failure():
+    failpoints.configure("cache.warm=first:1")
+    app = _app(buckets=((32, 32),), max_batch=2)
+    try:
+        assert app.cache.warm_retries == 1
+        assert app.cache.stats()["warm_retries"] == 1
+        # the server still came up serving and bit-exact
+        client = Client(app)
+        img = synthetic_image(20, 20, channels=3, seed=6)
+        jfn = Pipeline.parse(REFERENCE_OPS).jit()
+        np.testing.assert_array_equal(
+            client.process(img, timeout=120), np.asarray(jfn(img))
+        )
+    finally:
+        app.stop()
+
+
+# --------------------------------------------------------------------------
+# scheduler shutdown under in-flight load (satellite)
+# --------------------------------------------------------------------------
+
+
+def test_stop_drain_true_resolves_every_queued_request():
+    # huge delay: everything sits queued until stop() drains it
+    app = _app(max_batch=64, max_delay_ms=60_000.0, queue_depth=32)
+    client = Client(app)
+    reqs = [
+        client.submit(synthetic_image(20 + k % 3, 24, channels=3, seed=k))
+        for k in range(10)
+    ]
+    assert all(not r.done.is_set() for r in reqs)  # genuinely in flight
+    app.stop(drain=True)
+    for r in reqs:
+        assert r.done.is_set()  # stop() returned => everything resolved
+        assert r.status == STATUS_OK
+        assert r.result is not None
+
+
+def test_stop_drain_false_rejects_with_distinct_status():
+    app = _app(max_batch=64, max_delay_ms=60_000.0, queue_depth=32)
+    client = Client(app)
+    reqs = [
+        client.submit(synthetic_image(20, 24, channels=3, seed=k))
+        for k in range(6)
+    ]
+    app.stop(drain=False)
+    for r in reqs:
+        assert r.done.is_set()
+        assert r.status == STATUS_SHUTDOWN
+        with pytest.raises(ServeError):
+            r.wait(0)
+    # post-stop submissions are refused immediately, never queued
+    late = client.submit(synthetic_image(20, 24, channels=3, seed=99))
+    assert late.done.is_set() and late.status == STATUS_SHUTDOWN
+
+
+# --------------------------------------------------------------------------
+# Server context manager: socket + scheduler released on all paths
+# --------------------------------------------------------------------------
+
+
+def _tiny_cfg() -> ServeConfig:
+    return ServeConfig(
+        ops=REFERENCE_OPS,
+        buckets=((32, 32),),
+        max_batch=2,
+        max_delay_ms=3.0,
+        channels=(3,),
+    )
+
+
+def test_server_context_manager_releases_socket_on_exception(tmp_path):
+    port = None
+    with pytest.raises(RuntimeError, match="boom"):
+        with Server(_tiny_cfg(), "127.0.0.1", 0) as srv:
+            port = srv.address[1]
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=30
+            ) as r:
+                assert json.loads(r.read())["state"] == "serving"
+            raise RuntimeError("boom")
+    assert port is not None
+    # exception path released everything: rebind the SAME port immediately
+    with Server(_tiny_cfg(), "127.0.0.1", port) as srv2:
+        assert srv2.address[1] == port
+        img = synthetic_image(20, 20, channels=3, seed=7)
+        out = Client(srv2.app).process(img, timeout=120)
+        jfn = Pipeline.parse(REFERENCE_OPS).jit()
+        np.testing.assert_array_equal(out, np.asarray(jfn(img)))
+    assert srv2.app.health.state == STOPPED
+    srv2.close()  # idempotent
+
+
+def test_server_drain_is_graceful():
+    srv = Server(_tiny_cfg(), "127.0.0.1", 0).start()
+    try:
+        client = Client(srv.app)
+        reqs = [
+            client.submit(synthetic_image(20, 20, channels=3, seed=k))
+            for k in range(4)
+        ]
+        srv.drain(deadline_s=30.0)  # SIGTERM path
+        for r in reqs:
+            assert r.done.is_set() and r.status == STATUS_OK
+        assert srv.app.health.state == STOPPED
+        tr = [t for t in srv.app.health.transitions]
+        assert (SERVING, DRAINING) in tr or (DEGRADED, DRAINING) in tr
+    finally:
+        srv.close()
+
+
+# --------------------------------------------------------------------------
+# cmd_batch: corrupt input, journal, --resume (satellite + acceptance)
+# --------------------------------------------------------------------------
+
+
+def _golden(img):
+    import jax
+
+    from mpi_cuda_imagemanipulation_tpu.io.image import gray_to_rgb
+
+    fn = Pipeline.parse(REFERENCE_OPS).jit()
+    g = np.asarray(jax.block_until_ready(fn(img)))
+    return gray_to_rgb(g) if g.ndim == 2 else g
+
+
+def test_cmd_batch_corrupt_input_continues_nonzero_exit(tmp_path):
+    from mpi_cuda_imagemanipulation_tpu import cli
+
+    src = tmp_path / "in"
+    src.mkdir()
+    imgs = {}
+    for k in range(3):
+        name = f"{k}.png"
+        imgs[name] = synthetic_image(20, 24, channels=3, seed=10 + k)
+        save_image(src / name, imgs[name])
+    (src / "bad.png").write_bytes(b"this is not an image")
+    metrics = tmp_path / "m.jsonl"
+    rc = cli.main(
+        [
+            "batch",
+            "--input-dir", str(src),
+            "--output-dir", str(tmp_path / "out"),
+            "--json-metrics", str(metrics),
+        ]
+    )
+    assert rc == 1  # partial failure, not an abort
+    for name, img in imgs.items():  # every good input still processed
+        np.testing.assert_array_equal(
+            load_image(tmp_path / "out" / name), _golden(img), err_msg=name
+        )
+    rec = json.loads(metrics.read_text().strip())
+    assert rec["processed"] == 3
+    assert "bad.png" in rec["failed"]
+    # the journal carries the failure for a later --resume to re-attempt
+    j = BatchJournal(tmp_path / "out" / ".mcim_batch_journal.jsonl")
+    got = j.load()
+    assert got["bad.png"]["status"] == "failed"
+    assert sum(1 for r in got.values() if r["status"] == "ok") == 3
+
+
+def test_cmd_batch_killed_midway_resumes_without_reprocessing(tmp_path):
+    """THE journal/resume acceptance: a run killed mid-way (batch.interrupt
+    failpoint = preemption) finishes under --resume, skipping journaled
+    outputs (their mtimes prove no reprocessing) bit-identically."""
+    from mpi_cuda_imagemanipulation_tpu import cli
+
+    src = tmp_path / "in"
+    src.mkdir()
+    imgs = {}
+    for k in range(6):
+        name = f"{k}.png"
+        imgs[name] = synthetic_image(20, 24, channels=3, seed=20 + k)
+        save_image(src / name, imgs[name])
+    out = tmp_path / "out"
+    base = [
+        "batch",
+        "--input-dir", str(src),
+        "--output-dir", str(out),
+        "--window", "1",  # save as we go: the "crash" leaves real outputs
+    ]
+    # run 1: killed after 3 inputs (failpoint simulates preemption/SIGKILL)
+    with pytest.raises(FailpointError):
+        cli.main(base + ["--failpoints", "batch.interrupt=after:3"])
+    failpoints.clear()  # the dead process's armed failpoints die with it
+    j = BatchJournal(out / ".mcim_batch_journal.jsonl")
+    done_before = {
+        rel: rec for rel, rec in j.load().items() if rec["status"] == "ok"
+    }
+    assert 0 < len(done_before) < 6  # genuinely mid-way
+    mtimes = {rel: os.stat(out / rel).st_mtime_ns for rel in done_before}
+    time.sleep(0.05)  # make any rewrite visible in mtime_ns
+    # run 2: --resume completes the batch
+    metrics = tmp_path / "m.jsonl"
+    rc = cli.main(base + ["--resume", "--json-metrics", str(metrics)])
+    assert rc == 0
+    for name, img in imgs.items():  # all six outputs, bit-identical
+        np.testing.assert_array_equal(
+            load_image(out / name), _golden(img), err_msg=name
+        )
+    # journaled outputs were NOT reprocessed (files untouched)
+    for rel, t in mtimes.items():
+        assert os.stat(out / rel).st_mtime_ns == t, f"{rel} was reprocessed"
+    rec = json.loads(metrics.read_text().strip())
+    assert rec["resumed"] == len(done_before)
+    assert rec["processed"] == 6 - len(done_before)
+    # journal now shows every input ok
+    assert sum(1 for r in j.load().values() if r["status"] == "ok") == 6
+
+
+def test_cmd_batch_resume_reprocesses_edited_input(tmp_path):
+    """--resume trusts the journal only while the input's content hash
+    matches: an input edited after the crash is re-run, never stale."""
+    from mpi_cuda_imagemanipulation_tpu import cli
+
+    src = tmp_path / "in"
+    src.mkdir()
+    a = synthetic_image(20, 24, channels=3, seed=31)
+    save_image(src / "a.png", a)
+    out = tmp_path / "out"
+    base = [
+        "batch", "--input-dir", str(src), "--output-dir", str(out)
+    ]
+    assert cli.main(base) == 0
+    b = synthetic_image(20, 24, channels=3, seed=32)  # edit the input
+    save_image(src / "a.png", b)
+    assert cli.main(base + ["--resume"]) == 0
+    np.testing.assert_array_equal(load_image(out / "a.png"), _golden(b))
+
+
+# --------------------------------------------------------------------------
+# loadgen availability lane (satellite)
+# --------------------------------------------------------------------------
+
+
+def test_loadgen_fault_rate_reports_availability():
+    from mpi_cuda_imagemanipulation_tpu.serve import loadgen
+
+    app = _app(buckets=((32, 32), (64, 64)), max_delay_ms=3.0)
+    try:
+        recs = loadgen.sweep(
+            app,
+            offered_rps=(150.0,),
+            duration_s=0.5,
+            n_images=16,
+            fault_rate=0.2,
+            fault_seed=_seed_failing_first("serve.dispatch", 0.2),
+        )
+        (rec,) = recs
+        assert rec["fault_rate"] == 0.2
+        assert rec["submitted"] > 0
+        assert 0.0 <= rec["ok_frac"] <= 1.0
+        assert rec["retried"] >= 1  # seeded first-draw failure -> retry ran
+        assert rec["retried_frac"] >= 0.0
+        # availability accounting closes: ok + shed + quarantined <= n
+        assert (
+            rec["completed"] + rec["shed"] + rec["quarantined"]
+            <= rec["submitted"]
+        )
+        assert not failpoints.is_active()  # sweep cleans up after itself
+    finally:
+        app.stop()
+
+
+def test_serve_stats_exposes_resilience_state():
+    app = _app(buckets=((32, 32),), max_batch=2)
+    try:
+        s = app.stats()
+        assert s["health"]["state"] == SERVING
+        assert s["breakers"]["open_events"] == 0
+        for key in ("retries", "quarantined", "degraded"):
+            assert s[key] == 0
+        assert s["cache"]["warm_retries"] == 0
+    finally:
+        app.stop()

@@ -341,7 +341,9 @@ def test_http_roundtrip_health_stats_and_shed():
     base = f"http://127.0.0.1:{port}"
     try:
         with urllib.request.urlopen(f"{base}/healthz", timeout=30) as r:
-            assert json.loads(r.read())["status"] == "ok"
+            # PR 3: /healthz reports the health state machine, not a
+            # static ok (resilience/health.py)
+            assert json.loads(r.read())["state"] == "serving"
         img = synthetic_image(30, 40, channels=3, seed=9)
         req = urllib.request.Request(
             f"{base}/v1/process", data=encode_image_bytes(img), method="POST"
