@@ -40,18 +40,43 @@ HEADLINE = "gaussian5_8k"  # mirrors bench_suite.HEADLINE (jax-free here)
 # in this process; tests/test_io_cli.py asserts the two stay equal.
 REFERENCE_BASELINE_MP_S_PER_CHIP = 1850.0
 
-# (timeout_s, sleep_before_s): four attempts spanning ~19 minutes worst
-# case (observed round-2 wedges last an hour, so late attempts back off
-# hard). First compile over the tunnel is slow (~20-40 s), so even the
-# healthy path needs a generous first timeout.
-PROBE_SCHEDULE = ((90, 0), (120, 20), (180, 60), (180, 480))
-RETRY_PROBE_SCHEDULE = ((90, 0), (120, 30))
 CONFIG_TIMEOUT_S = 900
+
+
+def _cpu_only_env(env=None) -> bool:
+    """True when JAX_PLATFORMS pins this process to cpu (every entry) —
+    there is no TPU to wait for, so probe backoff is pure wasted wall."""
+    environ = os.environ if env is None else env
+    plats = (environ.get("JAX_PLATFORMS") or "").strip().lower()
+    return bool(plats) and all(
+        p.strip() == "cpu" for p in plats.split(",") if p.strip()
+    )
+
+
+def _default_probe_schedule(env=None):
+    """(timeout_s, sleep_before_s) attempts. On a possibly-wedged TPU:
+    four attempts spanning ~19 minutes worst case (observed round-2 wedges
+    last an hour, so late attempts back off hard; first compile over the
+    tunnel is slow, ~20-40 s, so even the healthy path needs a generous
+    first timeout). CPU-only rounds (JAX_PLATFORMS=cpu) fail fast with a
+    single attempt instead of burning the backoff tail before a committed
+    record can promote."""
+    if _cpu_only_env(env):
+        return ((90, 0),)
+    return ((90, 0), (120, 20), (180, 60), (180, 480))
+
+
+def _default_retry_probe_schedule(env=None):
+    if _cpu_only_env(env):
+        return ((90, 0),)
+    return ((90, 0), (120, 30))
 
 
 def _env_schedule(var: str, default):
     """Override a probe schedule via e.g. MCIM_PROBE_SCHEDULE='10:0,20:5'
-    (timeout:sleep pairs) — used by tests and manual runs."""
+    (timeout:sleep pairs) — attempts AND sleeps are the schedule's length
+    and entries, so both are configurable here. Used by tests, manual runs
+    and CPU-only drivers that want something other than the defaults."""
     raw = os.environ.get(var)
     if not raw:
         return default
@@ -60,8 +85,10 @@ def _env_schedule(var: str, default):
     )
 
 
-PROBE_SCHEDULE = _env_schedule("MCIM_PROBE_SCHEDULE", PROBE_SCHEDULE)
-RETRY_PROBE_SCHEDULE = _env_schedule("MCIM_RETRY_PROBE_SCHEDULE", RETRY_PROBE_SCHEDULE)
+PROBE_SCHEDULE = _env_schedule("MCIM_PROBE_SCHEDULE", _default_probe_schedule())
+RETRY_PROBE_SCHEDULE = _env_schedule(
+    "MCIM_RETRY_PROBE_SCHEDULE", _default_retry_probe_schedule()
+)
 
 
 def _log(msg: str) -> None:

@@ -317,6 +317,222 @@ def run_config(cfg: BenchConfig, impl: str, *, n_shards: int | None = None) -> d
 
 
 SERVE_LOADGEN = "serve_loadgen"
+ENGINE_AB = "engine_ab"
+
+
+def engine_ab_params() -> dict:
+    """The engine A/B lane knobs, sized to the backend. The corpus is
+    synthetic-slow-decode: real PNG bytes decoded per image plus a fixed
+    host delay (models the long-tail codecs and filesystems a production
+    batch actually pays), so the serial lane's device-idle fraction is
+    substantial and the overlap win is measurable even where compute is
+    fast. Env overrides for tools/tpu_queue and tests:
+    MCIM_ENGINE_AB_IMAGES / _DECODE_MS / _ENCODE_MS / _INFLIGHT."""
+    on_tpu = is_tpu_backend()
+    params = {
+        "ops": "grayscale,contrast:3.5,emboss:3",
+        "n_images": 32 if on_tpu else 12,
+        "height": 1080 if on_tpu else 96,
+        "width": 1920 if on_tpu else 128,
+        "channels": 3,
+        "decode_ms": 8.0 if on_tpu else 20.0,
+        "encode_ms": 4.0 if on_tpu else 10.0,
+        "inflight": 2,
+        "io_threads": 4,
+        "decode_threads": 4,
+    }
+    for env, key, cast in (
+        ("MCIM_ENGINE_AB_IMAGES", "n_images", int),
+        ("MCIM_ENGINE_AB_DECODE_MS", "decode_ms", float),
+        ("MCIM_ENGINE_AB_ENCODE_MS", "encode_ms", float),
+        ("MCIM_ENGINE_AB_INFLIGHT", "inflight", int),
+    ):
+        raw = os.environ.get(env)
+        if raw:
+            params[key] = cast(raw)
+    return params
+
+
+def run_engine_ab(
+    *,
+    json_path: str | None = None,
+    printer: Callable[[str], None] = print,
+    inflight: int | None = None,
+) -> dict:
+    """Serial-vs-overlapped end-to-end A/B over the async execution engine
+    (engine/core.py), mirroring the `halo_ab` pattern: same inputs, same
+    compiled pipeline, two execution structures.
+
+      * serial lane:     decode → dispatch → force → encode, one image at
+                         a time (the device idles through every host phase
+                         — the reference's per-launch round-trip shape);
+      * overlapped lane: decode prefetch pool → engine (`inflight`
+                         dispatches outstanding, in-order completion,
+                         encode worker pool).
+
+    Reports e2e images/sec per lane, the measured speedup, and each lane's
+    device-idle fraction — overlap is proven when the engine's idle
+    fraction drops strictly below serial while outputs stay bit-identical."""
+    import time as _time
+    from collections import deque
+    from concurrent.futures import ThreadPoolExecutor
+
+    import numpy as np
+
+    from mpi_cuda_imagemanipulation_tpu.engine import Engine, EngineMetrics
+    from mpi_cuda_imagemanipulation_tpu.io.image import (
+        decode_image_bytes,
+        encode_image_bytes,
+    )
+
+    p = engine_ab_params()
+    if inflight is not None:
+        p["inflight"] = inflight
+    decode_s = p["decode_ms"] / 1e3
+    encode_s = p["encode_ms"] / 1e3
+    imgs = [
+        synthetic_image(
+            p["height"], p["width"], channels=p["channels"], seed=31 + k
+        )
+        for k in range(p["n_images"])
+    ]
+    blobs = [encode_image_bytes(im) for im in imgs]  # the on-"disk" corpus
+
+    def decode(blob) -> np.ndarray:
+        img = decode_image_bytes(blob)
+        _time.sleep(decode_s)  # synthetic slow-decode tail
+        return img
+
+    def encode(out: np.ndarray) -> bytes:
+        data = encode_image_bytes(out)
+        _time.sleep(encode_s)  # synthetic slow-encode/write tail
+        return data
+
+    pipe = Pipeline.parse(p["ops"])
+    fn = pipe.jit(backend="xla", donate=True)
+    jax.block_until_ready(fn(imgs[0]))  # compile outside both timed lanes
+
+    # -- serial lane -------------------------------------------------------
+    serial_out: dict[int, np.ndarray] = {}
+    busy = 0.0
+    t0 = _time.perf_counter()
+    for k, blob in enumerate(blobs):
+        img = decode(blob)
+        tb = _time.perf_counter()
+        out = np.asarray(fn(img))  # forces completion inline
+        busy += _time.perf_counter() - tb
+        serial_out[k] = out
+        encode(out)
+    serial_wall = _time.perf_counter() - t0
+    serial_idle = max(0.0, 1.0 - busy / serial_wall)
+
+    # -- overlapped lane ---------------------------------------------------
+    overlap_out: dict[int, np.ndarray] = {}
+    errors: list = []
+
+    def _on_done(k, out, info):
+        arr = np.asarray(out)
+        overlap_out[k] = arr
+        encode(arr)
+
+    metrics = EngineMetrics()
+    engine = Engine(
+        inflight=p["inflight"],
+        io_threads=p["io_threads"],
+        stage=jax.device_put,
+        metrics=metrics,
+        name="engine-ab",
+    )
+    t0 = _time.perf_counter()
+    with ThreadPoolExecutor(p["decode_threads"]) as pool:
+        pending: deque = deque()
+        max_ahead = 2 * p["decode_threads"]
+        it = iter(enumerate(blobs))
+        exhausted = False
+        while pending or not exhausted:
+            while not exhausted and len(pending) < max_ahead:
+                try:
+                    k, blob = next(it)
+                except StopIteration:
+                    exhausted = True
+                    break
+                pending.append((k, pool.submit(decode, blob)))
+            if not pending:
+                break
+            k, fut = pending.popleft()
+            img = fut.result()
+            engine.submit(
+                k,
+                lambda img=img: img,
+                fn,
+                on_done=_on_done,
+                on_error=lambda k, e: errors.append((k, e)),
+            )
+        engine.close()
+    overlap_wall = _time.perf_counter() - t0
+    overlap_idle = metrics.device_idle_frac()
+
+    if errors:
+        raise RuntimeError(f"engine_ab overlapped lane failed: {errors[:3]}")
+    bit_identical = len(overlap_out) == len(serial_out) and all(
+        np.array_equal(serial_out[k], overlap_out[k]) for k in serial_out
+    )
+    n = p["n_images"]
+    rec = {
+        "config": ENGINE_AB,
+        "pipeline": p["ops"],
+        "impl": "xla",
+        "platform": jax.default_backend(),
+        "n_images": n,
+        "height": p["height"],
+        "width": p["width"],
+        "decode_ms": p["decode_ms"],
+        "encode_ms": p["encode_ms"],
+        "inflight": p["inflight"],
+        "io_threads": p["io_threads"],
+        "decode_threads": p["decode_threads"],
+        "serial": {
+            "wall_s": serial_wall,
+            "images_per_s": n / serial_wall,
+            "device_idle_frac": serial_idle,
+        },
+        "overlap": {
+            "wall_s": overlap_wall,
+            "images_per_s": n / overlap_wall,
+            "device_idle_frac": overlap_idle,
+            "inflight_peak": metrics.snapshot()["inflight_peak"],
+        },
+        "speedup": serial_wall / overlap_wall if overlap_wall > 0 else None,
+        # the overlap headline: how much of the serial lane's device-idle
+        # time the engine removed from the critical path
+        "overlap_won": (
+            overlap_idle is not None and overlap_idle < serial_idle
+        ),
+        "bit_identical": bit_identical,
+    }
+    printer(
+        f"{'lane':10s} {'wall s':>8s} {'img/s':>8s} {'dev idle':>9s}"
+    )
+    printer(
+        f"{'serial':10s} {serial_wall:8.2f} {n / serial_wall:8.1f} "
+        f"{serial_idle * 100:8.1f}%"
+    )
+    printer(
+        f"{'overlap':10s} {overlap_wall:8.2f} {n / overlap_wall:8.1f} "
+        + (
+            f"{overlap_idle * 100:8.1f}%"
+            if overlap_idle is not None
+            else f"{'-':>9s}"
+        )
+    )
+    printer(
+        f"speedup {rec['speedup']:.2f}x, inflight {p['inflight']} "
+        f"(peak {rec['overlap']['inflight_peak']}), "
+        f"bit_identical={bit_identical}"
+    )
+    if json_path:
+        emit_json_metrics(rec, None if json_path == "-" else json_path)
+    return rec
 
 
 def serve_loadgen_params() -> dict:
@@ -450,12 +666,19 @@ def run_suite(
         )
         if not names:
             return records
+    if names and ENGINE_AB in names:
+        # likewise the engine lane: it measures the e2e decode/dispatch/
+        # encode pipeline, not one executable
+        names = [n for n in names if n != ENGINE_AB]
+        records.append(run_engine_ab(json_path=json_path, printer=printer))
+        if not names:
+            return records
     if names:
         unknown = [n for n in names if n not in CONFIGS]
         if unknown:
             raise ValueError(
                 f"unknown bench config(s) {unknown}; known: "
-                f"{sorted(CONFIGS) + [SERVE_LOADGEN]}"
+                f"{sorted(CONFIGS) + [ENGINE_AB, SERVE_LOADGEN]}"
             )
         selected = [CONFIGS[n] for n in names]
     else:
@@ -552,7 +775,7 @@ def main(argv: Sequence[str] | None = None) -> int:
     ap.add_argument(
         "--config",
         required=True,
-        choices=sorted(CONFIGS) + [SERVE_LOADGEN],
+        choices=sorted(CONFIGS) + [ENGINE_AB, SERVE_LOADGEN],
     )
     ap.add_argument(
         "--impl",
@@ -581,11 +804,20 @@ def main(argv: Sequence[str] | None = None) -> int:
         "availability (success/retried/shed fractions) alongside the "
         "latency percentiles; env MCIM_SERVE_FAULT_RATE works too",
     )
+    ap.add_argument(
+        "--inflight",
+        type=int,
+        default=None,
+        help="engine_ab only: overlapped-lane dispatch depth "
+        "(env MCIM_ENGINE_AB_INFLIGHT works too)",
+    )
     args = ap.parse_args(argv)
     if args.config == SERVE_LOADGEN:
         rec = run_serve_loadgen(
             printer=lambda s: None, fault_rate=args.fault_rate
         )
+    elif args.config == ENGINE_AB:
+        rec = run_engine_ab(printer=lambda s: None, inflight=args.inflight)
     else:
         cfg = CONFIGS[args.config]
         if args.halo_mode is not None and cfg.sharded:

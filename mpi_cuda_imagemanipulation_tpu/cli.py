@@ -176,11 +176,26 @@ def _build_parser() -> argparse.ArgumentParser:
         "--threads", type=int, default=4, help="decode prefetch threads"
     )
     batch.add_argument(
+        "--inflight",
+        type=int,
+        default=None,
+        help="device dispatches kept outstanding through the async engine "
+        "(engine/core.py): >= 2 double-buffers, so the device computes "
+        "batch N while the host decodes N+1 and encodes N-1 (the "
+        "reference instead round-trips per stage); default 2",
+    )
+    batch.add_argument(
         "--window",
         type=int,
+        default=None,
+        help=argparse.SUPPRESS,  # deprecated alias for --inflight
+    )
+    batch.add_argument(
+        "--io-threads",
+        type=int,
         default=4,
-        help="device dispatches kept in flight (overlaps compute with "
-        "decode/encode; the reference instead round-trips per stage)",
+        help="encode/write worker threads draining completed dispatches "
+        "(the engine's output pool; decode prefetch is --threads)",
     )
     batch.add_argument(
         "--stack",
@@ -316,6 +331,22 @@ def _build_parser() -> argparse.ArgumentParser:
         default=30.0,
         help="quiet seconds an open breaker waits before admitting a "
         "half-open probe dispatch",
+    )
+    srv.add_argument(
+        "--inflight",
+        type=int,
+        default=2,
+        help="micro-batch dispatches kept outstanding through the async "
+        "engine (engine/core.py): >= 2 keeps the device busy while "
+        "results transfer back and responses encode; 1 = serial "
+        "dispatch-then-drain",
+    )
+    srv.add_argument(
+        "--io-threads",
+        type=int,
+        default=4,
+        help="completion worker threads cropping results and resolving "
+        "responses (the engine's output pool)",
     )
     srv.add_argument(
         "--drain-deadline-s",
@@ -664,6 +695,7 @@ def cmd_batch(args: argparse.Namespace) -> int:
     stack = max(1, args.stack)
     n_r, n_c = parse_shards(args.shards)
     n_flat = n_r * (n_c or 1)
+    stage = None  # H2D pre-staging hook; only for single-device dispatches
     if stack > 1 and n_flat > 1:
         # data parallelism: the stack is sharded over the device mesh, each
         # device running the full pipeline on its slice of the images
@@ -679,26 +711,51 @@ def cmd_batch(args: argparse.Namespace) -> int:
             )
         fn = pipe.data_parallel(make_mesh(n_flat), backend=args.impl)
     elif stack > 1:  # incl. --shards 1 / 1x1: stacked dispatch, one device
-        fn = pipe.batched(backend=args.impl)
+        # donated inputs: each dispatch's staged buffer recycles into its
+        # output, so steady state runs without per-batch HBM allocation
+        fn = pipe.batched(backend=args.impl, donate=True)
     elif n_flat > 1 or n_c is not None:
         mesh = make_mesh_2d(n_r, n_c) if n_c is not None else make_mesh(n_r)
         fn = pipe.sharded(mesh, backend=args.impl, halo_mode=args.halo_mode)
     else:
-        fn = pipe.jit(backend=args.impl)  # one jit: re-traces only per shape
+        # one jit: re-traces only per shape; donation as above
+        fn = pipe.jit(backend=args.impl, donate=True)
+    if stack == 1 and n_flat == 1 and n_c is None or stack > 1 and n_flat == 1:
+        import jax
+
+        # async H2D staging: the input upload is already in flight when the
+        # dispatch enqueues (sharded/data-parallel callables place their
+        # own inputs, so those paths skip it)
+        stage = jax.device_put
 
     t0 = time.perf_counter()
     total_mp = 0.0
     done = 0
-    from collections import deque
+    import threading
 
-    inflight: deque = deque()  # (input indices, async device result)
+    from mpi_cuda_imagemanipulation_tpu.engine import Engine, EngineMetrics
+
+    # --inflight governs the async engine's dispatch depth (>= 2 overlaps
+    # host decode/encode with device compute); --window is the deprecated
+    # alias from before the engine existed
+    if args.inflight is not None:
+        inflight_depth = args.inflight
+    elif args.window is not None:
+        log.warning("--window is deprecated; use --inflight")
+        inflight_depth = args.window
+    else:
+        inflight_depth = 2
+    inflight_depth = max(1, inflight_depth)
+    state_lock = threading.Lock()  # guards done/failed across engine workers
 
     def record_failed(idxs, e) -> None:
         # a failed dispatch/save fails ONLY its own inputs (with a journal
         # line each) — the run continues; the summary exit goes nonzero
         msg = f"{type(e).__name__}: {e}"
+        with state_lock:
+            for i in idxs:
+                failed[i] = msg
         for i in idxs:
-            failed[i] = msg
             log.error("failed %s: %s", rels[i], msg)
             if journal is not None:
                 journal.record_failed(rels[i], _digest(i), msg)
@@ -711,21 +768,33 @@ def cmd_batch(args: argparse.Namespace) -> int:
         os.makedirs(os.path.dirname(dst) or ".", exist_ok=True)
         save_image(dst, out)
         if journal is not None:
+            # journaled ONLY here, after the output file exists: a run
+            # killed with this batch still in flight re-runs it on
+            # --resume — no lost outputs, and no duplicates because the
+            # resumed run skips exactly the journaled-ok inputs
             journal.record_ok(rels[i], _digest(i), rels[i])
-        done += 1
+        with state_lock:
+            done += 1
 
-    def drain_one():
-        idxs, out = inflight.popleft()
-        try:
-            out = np.asarray(out)  # forces completion + transfer
-        except Exception as e:  # device-side failure surfaces here
-            record_failed(idxs, e)
-            return
-        if stack == 1:
-            save_one(idxs[0], out)
-        else:
-            for k, i in enumerate(idxs):
-                save_one(i, out[k])
+    def on_done(idxs, out, info):
+        # engine encode/write worker: a save failure fails only its input
+        for k, i in enumerate(idxs):
+            try:
+                save_one(i, out[k] if stack > 1 else out)
+            except Exception as e:
+                record_failed([i], e)
+
+    def on_error(idxs, e):
+        # device-side failure surfaced at completion (force/D2H)
+        record_failed(list(idxs), e)
+
+    engine = Engine(
+        inflight=inflight_depth,
+        io_threads=max(1, args.io_threads),
+        stage=stage,
+        metrics=EngineMetrics(),
+        name="batch",
+    )
 
     # same-shape images accumulate into a stack and ship as one dispatch;
     # a shape change flushes the pending stack (stack == 1: ship per image)
@@ -734,9 +803,14 @@ def cmd_batch(args: argparse.Namespace) -> int:
 
     def _ship(idxs, make_input):
         # host-side dispatch failures (incl. armed halo.exchange
-        # failpoints) surface at call time; fail those inputs, keep going
+        # failpoints) surface at submit time; fail those inputs, keep going.
+        # submit blocks while --inflight dispatches are outstanding — the
+        # backpressure that keeps decode from racing ahead of the device
         try:
-            inflight.append((idxs, fn(make_input())))
+            engine.submit(
+                tuple(idxs), make_input, fn,
+                on_done=on_done, on_error=on_error,
+            )
         except Exception as e:
             record_failed(idxs, e)
 
@@ -759,37 +833,46 @@ def cmd_batch(args: argparse.Namespace) -> int:
                 # image shape reuses one compiled batch shape — the shape
                 # may recur, and a ragged batch would recompile each time
                 # (serve/bucketing.pad_stack — shared with the serving
-                # scheduler); padded outputs are dropped in drain_one,
+                # scheduler); padded outputs are dropped in on_done,
                 # which iterates idxs only
                 _ship(idxs, lambda: pad_stack(imgs, stack))
         else:
             img0 = pending[0][1]
             _ship(idxs, lambda: img0)
         pending = []
-        if len(inflight) >= max(1, args.window):
-            drain_one()
 
     # resume: only un-journaled (or stale/failed) inputs are decoded at all
     work_idx = [i for i in range(len(paths)) if i not in resumed]
     work_paths = [paths[i] for i in work_idx]
     seen: set[int] = set()
-    for j, img in batch_load(work_paths, n_threads=args.threads, on_error="skip"):
-        i = work_idx[j]
-        # preemption/kill simulation point for the --resume tests: an armed
-        # batch.interrupt failpoint aborts the run here, mid-stream
-        failpoints.maybe_fail("batch.interrupt", index=i, path=paths[i])
-        seen.add(i)
-        if pending and (
-            len(pending) >= stack or pending[-1][1].shape != img.shape
+    try:
+        for j, img, dig in batch_load(
+            work_paths,
+            n_threads=args.threads,
+            on_error="skip",
+            with_digests=True,  # hashed on the decode worker, not here
         ):
-            flush_pending()
-        pending.append((i, img))
-        total_mp += img.shape[0] * img.shape[1] / 1e6
-        if stack == 1:
-            flush_pending()
-    flush_pending(final=True)
-    while inflight:
-        drain_one()
+            i = work_idx[j]
+            _digests.setdefault(i, dig)
+            # preemption/kill simulation point for the --resume tests: an
+            # armed batch.interrupt failpoint aborts the run here, mid-stream
+            failpoints.maybe_fail("batch.interrupt", index=i, path=paths[i])
+            seen.add(i)
+            if pending and (
+                len(pending) >= stack or pending[-1][1].shape != img.shape
+            ):
+                flush_pending()
+            pending.append((i, img))
+            total_mp += img.shape[0] * img.shape[1] / 1e6
+            if stack == 1:
+                flush_pending()
+        flush_pending(final=True)
+    finally:
+        # drain every dispatched batch (outputs written, journal lines
+        # appended) even when an interrupt/failpoint is propagating: the
+        # work that finished must be resumable, the work that didn't must
+        # look never-started
+        engine.close()
     # decode failures: batch_load skipped them (logged); give them journal
     # lines so --resume re-attempts exactly these
     for j, p in enumerate(work_paths):
@@ -799,6 +882,7 @@ def cmd_batch(args: argparse.Namespace) -> int:
             if journal is not None:
                 journal.record_failed(rels[i], _digest(i), failed[i])
     wall = time.perf_counter() - t0
+    eng = engine.metrics.snapshot()
     # adaptive precision: thumbnail batches should not round to "0.0 MP",
     # large batches should stay in plain decimal (%.3g would go scientific)
     def _fmt(v: float, unit: str) -> str:
@@ -813,11 +897,21 @@ def cmd_batch(args: argparse.Namespace) -> int:
         if resumed or failed
         else "",
     )
+    if eng["submitted"]:
+        log.info("%s", engine.metrics.summary_line())
     if args.show_timing:
+        idle = eng["device_idle_frac"]
         print(
             f"batch [{pipe.name}] impl={args.impl}: {done}/{len(paths)} images, "
             f"{mp_s} in {wall:.2f}s ({rate_s} "
-            f"end-to-end incl. compile+I/O)"
+            f"end-to-end incl. compile+I/O; inflight {inflight_depth}, "
+            f"peak {eng['inflight_peak']}"
+            + (
+                f", device idle {idle * 100:.0f}%"
+                if idle is not None
+                else ""
+            )
+            + ")"
         )
     skipped = [
         paths[i]
@@ -841,6 +935,9 @@ def cmd_batch(args: argparse.Namespace) -> int:
                 "total_mp": total_mp,
                 "wall_s": wall,
                 "mp_per_s": total_mp / wall if wall > 0 else None,
+                "inflight": inflight_depth,
+                "io_threads": args.io_threads,
+                "engine": eng,
             },
             None if args.json_metrics == "-" else args.json_metrics,
         )
@@ -892,6 +989,8 @@ def cmd_serve(args: argparse.Namespace) -> int:
         retry_attempts=args.retry_attempts,
         breaker_threshold=args.breaker_threshold,
         breaker_reset_s=args.breaker_reset_s,
+        inflight=args.inflight,
+        io_threads=args.io_threads,
     )
     stop_evt = threading.Event()
 

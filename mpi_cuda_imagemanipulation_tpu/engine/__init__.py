@@ -1,0 +1,18 @@
+"""Asynchronous double-buffered execution engine (docs/design.md
+"Execution engine & overlap"): bounded in-flight dispatch, in-order
+completion draining, encode/write worker pool — shared by `batch
+--inflight` (cli.py) and the serving scheduler (serve/scheduler.py)."""
+
+from mpi_cuda_imagemanipulation_tpu.engine.core import (
+    DEFAULT_INFLIGHT,
+    DEFAULT_IO_THREADS,
+    Engine,
+)
+from mpi_cuda_imagemanipulation_tpu.engine.metrics import EngineMetrics
+
+__all__ = [
+    "DEFAULT_INFLIGHT",
+    "DEFAULT_IO_THREADS",
+    "Engine",
+    "EngineMetrics",
+]

@@ -1,0 +1,332 @@
+"""Bounded-depth asynchronous execution engine — keep the device busy
+while the host decodes, transfers, and encodes.
+
+The serial offline/serving loops run decode → dispatch → force → encode
+with the device idle during every host phase (the same structure as the
+reference's per-launch MPI scatter/compute/gather round-trip,
+kernel.cu:163,202). JAX dispatch is asynchronous: a jitted call returns a
+future-like device array immediately, so the fix is structural, not a new
+kernel — software-pipeline the stages over consecutive work items:
+
+    caller thread            completion thread         encode pool
+    ─────────────            ─────────────────         ───────────
+    make_input (host build)
+    stage      (H2D ahead)   ┌───────────────┐
+    run        (async enq) ─►│ bounded FIFO  │─► force (D2H, in
+                 ▲           │ (≤ inflight)  │   submission order)
+                 │           └───────────────┘      │
+                 └── blocks when full ◄─────────────┴─► on_done(key, out)
+                     (backpressure)                     [≤ io_threads,
+                                                         bounded backlog]
+
+Invariants:
+
+  * **Bounded everywhere.** At most ``inflight`` dispatches are
+    outstanding: a dispatch slot is reserved before the computation
+    enqueues and released when its result is forced, so acquiring it
+    blocks the caller — the backpressure that keeps host decode from
+    racing ahead of the device. The encode pool's backlog is capped by a
+    semaphore so a slow writer stalls the completion thread rather than
+    buffering results without bound.
+  * **Completion in submission order.** The FIFO is drained in order:
+    results are forced (and handed to the pool) exactly in submission
+    order even though the device pipeline is deep. ``on_done`` callbacks
+    for *different* items may interleave across pool workers
+    (``io_threads=1`` serializes them); items are independent by contract.
+  * **Results are bit-identical to the serial loop** — the engine changes
+    *when* work happens, never *what* runs: same callable, same inputs.
+  * **Failure is per-item.** A force (D2H) failure routes that one
+    submission to ``on_error`` on the completion thread (where callers run
+    their retry/quarantine machinery — serve/scheduler.py) and the
+    pipeline keeps draining; an ``on_done`` failure (encode/write) routes
+    to ``on_error`` on the pool worker. The armed ``engine.complete``
+    failpoint (resilience/failpoints.py) injects exactly this class of
+    fault for the tier-1 recovery tests.
+
+Donation note: pair the engine with ``Pipeline.jit(donate=True)`` /
+``Pipeline.batched(donate=True)`` so each dispatch's input buffer is
+recycled into its output and steady state runs without per-batch HBM
+allocation. Safe here by construction — every ``make_input`` builds (or
+stages) a fresh buffer per submission; never donate a buffer you intend
+to read again.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from mpi_cuda_imagemanipulation_tpu.engine.metrics import EngineMetrics
+from mpi_cuda_imagemanipulation_tpu.resilience import failpoints
+from mpi_cuda_imagemanipulation_tpu.utils.log import get_logger
+
+DEFAULT_INFLIGHT = 2
+DEFAULT_IO_THREADS = 4
+
+_SENTINEL = object()
+
+
+@dataclass
+class _InFlight:
+    key: Any
+    out: Any  # un-forced device result (JAX async dispatch future)
+    on_done: Callable[[Any, Any, dict], None]
+    on_error: Callable[[Any, BaseException], None]
+    info: dict = field(default_factory=dict)
+
+
+class Engine:
+    """The shared async pipeline behind ``batch --inflight`` and the
+    serving scheduler. One instance owns one completion thread and one
+    encode pool; ``submit`` is single-producer by convention (the batch
+    loop / the scheduler thread), completions fan out to the pool."""
+
+    def __init__(
+        self,
+        *,
+        inflight: int = DEFAULT_INFLIGHT,
+        io_threads: int = DEFAULT_IO_THREADS,
+        stage: Callable[[Any], Any] | None = None,
+        metrics: EngineMetrics | None = None,
+        name: str = "engine",
+    ):
+        if inflight < 1:
+            raise ValueError(f"inflight must be >= 1, got {inflight}")
+        if io_threads < 1:
+            raise ValueError(f"io_threads must be >= 1, got {io_threads}")
+        self.inflight = inflight
+        self.io_threads = io_threads
+        # H2D staging hook (e.g. jax.device_put): runs on the caller thread
+        # ahead of dispatch so the transfer is already in flight when the
+        # computation enqueues. None = inputs go up with the dispatch
+        # (sharded/data-parallel callables place their own inputs).
+        self._stage = stage
+        self.metrics = metrics or EngineMetrics()
+        self.name = name
+        # the in-flight bound: a dispatch slot is reserved BEFORE the
+        # computation enqueues and released once its result is forced, so
+        # at most `inflight` dispatches are ever outstanding on the device
+        # (the completion FIFO itself never exceeds that)
+        self._slots = threading.BoundedSemaphore(inflight)
+        self._q: queue.Queue = queue.Queue()
+        self._pool: ThreadPoolExecutor | None = None
+        # encode backlog bound: a slow writer blocks the completion thread
+        # (and transitively the submitter) instead of buffering results
+        self._encode_slots = threading.BoundedSemaphore(
+            max(2 * io_threads, inflight)
+        )
+        self._outstanding = 0  # submitted, not yet fully resolved
+        self._cond = threading.Condition()
+        self._thread: threading.Thread | None = None
+        self._closed = False
+        self._log = get_logger()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def _ensure_started(self) -> None:
+        with self._cond:
+            if self._closed:
+                raise RuntimeError(f"{self.name} is closed")
+            if self._thread is None:
+                self._pool = ThreadPoolExecutor(
+                    max_workers=self.io_threads,
+                    thread_name_prefix=f"mcim-{self.name}-io",
+                )
+                self._thread = threading.Thread(
+                    target=self._completion_loop,
+                    name=f"mcim-{self.name}-complete",
+                    daemon=True,
+                )
+                self._thread.start()
+
+    def flush(self, timeout: float | None = None) -> bool:
+        """Block until every submitted item has fully resolved (on_done or
+        on_error returned). True on drained, False on timeout."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while self._outstanding > 0:
+                remaining = (
+                    None if deadline is None else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._cond.wait(timeout=remaining)
+        return True
+
+    def close(self, timeout: float | None = None) -> None:
+        """Drain, then stop the completion thread and the encode pool.
+        Idempotent; safe to call with work in flight (it finishes first)."""
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+        drained = self.flush(timeout)
+        if self._thread is not None:
+            self._q.put(_SENTINEL)
+            self._thread.join(timeout=timeout)
+        if self._pool is not None:
+            # a timed-out drain must not hang interpreter exit on a wedged
+            # writer; the pool threads are abandoned (daemonic teardown)
+            self._pool.shutdown(wait=drained)
+        if not drained:
+            self._log.warning(
+                "%s: close timed out with %d submissions unresolved",
+                self.name, self._outstanding,
+            )
+
+    def __enter__(self) -> "Engine":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()
+
+    # -- dispatch stage (caller thread) ------------------------------------
+
+    def submit(
+        self,
+        key: Any,
+        make_input: Callable[[], Any],
+        run: Callable[[Any], Any],
+        *,
+        on_done: Callable[[Any, Any, dict], None],
+        on_error: Callable[[Any, BaseException], None],
+    ) -> None:
+        """Build + stage + asynchronously dispatch one work item.
+
+        ``make_input()`` and ``run(staged_input)`` execute on the calling
+        thread — ``run`` must only *enqueue* (a jitted call under JAX async
+        dispatch); its exceptions (host-side dispatch failures, armed
+        failpoints) propagate to the caller, which still owns retry policy
+        at this stage. After a successful enqueue the item is handed to the
+        completion thread; blocks while ``inflight`` items are outstanding.
+
+        ``on_done(key, host_out, info)`` runs on the encode pool;
+        ``on_error(key, exc)`` runs on the completion thread (force
+        failures) or the pool worker (``on_done`` failures). ``info``
+        carries the item's stage timings (seconds): build/h2d/enqueue at
+        submit, queue_wait/force stamped at completion."""
+        self._ensure_started()
+        info: dict = {}
+        t0 = time.perf_counter()
+        x = make_input()
+        t1 = time.perf_counter()
+        if self._stage is not None:
+            # H2D can start NOW even when every dispatch slot is taken —
+            # the upload overlaps the in-flight compute
+            x = self._stage(x)
+        t2 = time.perf_counter()
+        # backpressure: all `inflight` slots taken means the device already
+        # has that many dispatches outstanding — stall the producer here,
+        # before it enqueues (and before it decodes further upstream)
+        self._slots.acquire()
+        try:
+            out = run(x)
+        except BaseException:
+            self._slots.release()
+            raise
+        t3 = time.perf_counter()
+        info["build_s"] = t1 - t0
+        info["h2d_s"] = t2 - t1
+        info["enqueue_s"] = t3 - t2
+        info["t_dispatch"] = t3
+        self.metrics.on_stage("build", info["build_s"])
+        self.metrics.on_stage("h2d", info["h2d_s"])
+        self.metrics.on_stage("enqueue", info["enqueue_s"])
+        with self._cond:
+            self._outstanding += 1
+        self.metrics.on_submit(t3)
+        self._q.put(_InFlight(key, out, on_done, on_error, info))
+
+    # -- completion stage (own thread) -------------------------------------
+
+    def _completion_loop(self) -> None:
+        while True:
+            idle_from = (
+                time.perf_counter()
+                if self.metrics.unforced() == 0
+                else None
+            )
+            item = self._q.get()
+            if item is _SENTINEL:
+                return
+            if idle_from is not None and self.metrics.submitted > 0:
+                # nothing was enqueued on the device while we waited: that
+                # whole wait is device-idle time (the serial loop's decode/
+                # encode stalls show up exactly here)
+                self.metrics.on_idle(time.perf_counter() - idle_from)
+            self._complete_one(item)
+
+    def _complete_one(self, item: _InFlight) -> None:
+        t0 = time.perf_counter()
+        item.info["queue_wait_s"] = t0 - item.info["t_dispatch"]
+        try:
+            # injected completion-stage fault (D2H/transfer class) — the
+            # recovery paths behind it are the caller's on_error machinery
+            failpoints.maybe_fail("engine.complete", key=item.key)
+            host = self._force(item.out)
+        except Exception as e:
+            self.metrics.on_forced()
+            self._slots.release()
+            self.metrics.on_failed(time.perf_counter())
+            self._resolve_error(item, e)
+            return
+        t1 = time.perf_counter()
+        item.info["force_s"] = t1 - t0
+        self.metrics.on_forced()
+        self._slots.release()
+        self.metrics.on_stage("force", item.info["force_s"])
+        self._encode_slots.acquire()
+        assert self._pool is not None
+        try:
+            self._pool.submit(self._encode_one, item, host)
+        except BaseException:
+            self._encode_slots.release()
+            raise
+
+    @staticmethod
+    def _force(out):
+        """Block for the device result and bring it to host memory (D2H).
+        `jax.device_get` walks pytrees and passes numpy through, so `run`
+        may return device arrays, tuples of them, or host arrays."""
+        import jax
+
+        return jax.device_get(out)
+
+    # -- encode stage (worker pool) ----------------------------------------
+
+    def _encode_one(self, item: _InFlight, host) -> None:
+        t0 = time.perf_counter()
+        try:
+            item.on_done(item.key, host, item.info)
+        except Exception as e:
+            self.metrics.on_failed(time.perf_counter())
+            self._resolve_error(item, e)
+            return
+        finally:
+            self._encode_slots.release()
+            self.metrics.on_stage("encode", time.perf_counter() - t0)
+        self.metrics.on_complete(time.perf_counter())
+        self._mark_resolved()
+
+    def _resolve_error(self, item: _InFlight, exc: BaseException) -> None:
+        try:
+            item.on_error(item.key, exc)
+        except Exception:
+            self._log.exception(
+                "%s: on_error handler failed for %r", self.name, item.key
+            )
+        finally:
+            self._mark_resolved()
+
+    def _mark_resolved(self) -> None:
+        with self._cond:
+            self._outstanding -= 1
+            self._cond.notify_all()

@@ -1,0 +1,144 @@
+"""Engine instrumentation — per-stage latencies, in-flight depth, device idle.
+
+The numbers that tell you whether the overlap is real:
+
+  * ``device_idle_frac`` — fraction of the engine's active window (first
+    dispatch → last completion) the device spent with NOTHING enqueued.
+    The serial loop's idle fraction is ≈ (decode + encode) / total; a
+    working double-buffered engine drives it toward 0. Measured on the
+    completion thread: any wait for a new item that starts with zero
+    unforced dispatches outstanding is, by definition, device idle.
+  * ``inflight`` depth — outstanding (dispatched, not yet forced) batches,
+    sampled at every submit; the peak proves the pipeline actually kept
+    ``--inflight`` batches in the air rather than degenerating to serial.
+  * stage latencies — host input build (``build``), H2D staging (``h2d``),
+    async enqueue (``enqueue``), completion force = D2H + device wait
+    (``force``), encode/write worker (``encode``) — percentiles via
+    `utils.timing.percentiles` (the same quantile definition the serving
+    metrics and the bench suite use).
+
+Counters + bounded reservoirs behind one lock, `snapshot()` for /stats and
+the batch summary — same conventions as serve/metrics.ServeMetrics.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+from mpi_cuda_imagemanipulation_tpu.utils.timing import percentiles
+
+PERCENTILES = (50, 95, 99)
+
+STAGES = ("build", "h2d", "enqueue", "force", "encode")
+
+
+class EngineMetrics:
+    def __init__(self, sample_cap: int = 65536):
+        self._lock = threading.Lock()
+        self.submitted = 0  # batches submitted to the engine
+        self.completed = 0  # batches whose on_done finished
+        self.failed = 0  # batches routed to on_error
+        self.inflight = 0  # gauge: dispatched, not yet forced
+        self.inflight_peak = 0
+        self.idle_s = 0.0  # device-idle seconds inside the active window
+        self.t_first_dispatch: float | None = None
+        self.t_last_complete: float | None = None
+        self._stage: dict[str, deque] = {
+            s: deque(maxlen=sample_cap) for s in STAGES
+        }
+        self._depth: deque = deque(maxlen=sample_cap)
+
+    # -- recording ---------------------------------------------------------
+
+    def on_submit(self, now: float) -> None:
+        with self._lock:
+            self.submitted += 1
+            self.inflight += 1
+            self.inflight_peak = max(self.inflight_peak, self.inflight)
+            self._depth.append(self.inflight)
+            if self.t_first_dispatch is None:
+                self.t_first_dispatch = now
+
+    def on_forced(self) -> None:
+        with self._lock:
+            self.inflight -= 1
+
+    def unforced(self) -> int:
+        """Dispatched-but-not-forced count (the completion thread's idle
+        predicate: waiting while this is 0 means the device has nothing)."""
+        with self._lock:
+            return self.inflight
+
+    def on_idle(self, seconds: float) -> None:
+        with self._lock:
+            self.idle_s += seconds
+
+    def on_complete(self, now: float) -> None:
+        with self._lock:
+            self.completed += 1
+            self.t_last_complete = now
+
+    def on_failed(self, now: float) -> None:
+        with self._lock:
+            self.failed += 1
+            self.t_last_complete = now
+
+    def on_stage(self, stage: str, seconds: float) -> None:
+        with self._lock:
+            self._stage[stage].append(seconds)
+
+    # -- reporting ---------------------------------------------------------
+
+    @staticmethod
+    def _pcts(samples) -> dict[str, float] | None:
+        if not samples:
+            return None
+        got = percentiles(samples, PERCENTILES)
+        return {f"p{int(q)}_ms": got[q] * 1e3 for q in PERCENTILES}
+
+    def active_window_s(self) -> float | None:
+        with self._lock:
+            if self.t_first_dispatch is None or self.t_last_complete is None:
+                return None
+            return max(self.t_last_complete - self.t_first_dispatch, 0.0)
+
+    def device_idle_frac(self) -> float | None:
+        window = self.active_window_s()
+        if not window:
+            return None
+        with self._lock:
+            return min(max(self.idle_s / window, 0.0), 1.0)
+
+    def snapshot(self) -> dict:
+        idle = self.device_idle_frac()
+        with self._lock:
+            mean_depth = (
+                sum(self._depth) / len(self._depth) if self._depth else None
+            )
+            return {
+                "submitted": self.submitted,
+                "completed": self.completed,
+                "failed": self.failed,
+                "inflight": self.inflight,
+                "inflight_peak": self.inflight_peak,
+                "inflight_mean": mean_depth,
+                "device_idle_frac": idle,
+                "idle_s": self.idle_s,
+                "stages": {s: self._pcts(self._stage[s]) for s in STAGES},
+            }
+
+    def summary_line(self) -> str:
+        s = self.snapshot()
+        idle = s["device_idle_frac"]
+        forced = s["stages"]["force"] or {}
+        return (
+            f"engine: {s['completed']}/{s['submitted']} batches "
+            f"({s['failed']} failed), inflight peak {s['inflight_peak']}"
+            + (f", device idle {idle * 100:.0f}%" if idle is not None else "")
+            + (
+                f", force p50 {forced['p50_ms']:.1f} ms"
+                if forced
+                else ""
+            )
+        )

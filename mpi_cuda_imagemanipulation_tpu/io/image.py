@@ -120,7 +120,13 @@ def encode_image_bytes(img: np.ndarray, format: str = "PNG") -> bytes:
     return buf.getvalue()
 
 
-def batch_load(paths, *, n_threads: int = 4, on_error: str = "raise"):
+def batch_load(
+    paths,
+    *,
+    n_threads: int = 4,
+    on_error: str = "raise",
+    with_digests: bool = False,
+):
     """Yield (index, image) over `paths` in order, decoding ahead on worker
     threads. Uses the native C++ prefetch loader when built and all inputs
     are PPM/PGM; otherwise a Python thread pool with PIL.
@@ -128,15 +134,33 @@ def batch_load(paths, *, n_threads: int = 4, on_error: str = "raise"):
     Yields the same shapes as load_image (gray sources normalised to
     (H, W, 3)) regardless of which decoder ran. `on_error='skip'` logs and
     drops undecodable files instead of raising (failed indices are absent
-    from the stream)."""
+    from the stream).
+
+    `with_digests=True` yields (index, image, sha256-hex) with the content
+    digest hashed on the DECODE worker alongside the decode itself — the
+    journaling path (cli.py cmd_batch) then never hashes on the dispatch
+    thread, so a large input cannot stall the device feed. (The native
+    loader owns its decode threads, so on that path the hash runs on the
+    consumer thread — still ahead of dispatch, and cheap next to decode.)"""
     if on_error not in ("raise", "skip"):
         raise ValueError(f"on_error must be 'raise' or 'skip', got {on_error!r}")
     paths = [str(p) for p in paths]
 
-    def _deliver(i, arr):
+    def _digest(path: str) -> str:
+        from mpi_cuda_imagemanipulation_tpu.resilience.journal import (
+            content_digest,
+        )
+
+        return content_digest(path)
+
+    def _load_one(path: str):
+        arr = load_image(path)
+        return (arr, _digest(path)) if with_digests else arr
+
+    def _deliver(i, arr, digest=None):
         if arr.ndim == 2:
             arr = gray_to_rgb(arr)
-        return i, arr
+        return (i, arr, digest) if with_digests else (i, arr)
 
     def _failed(path, exc):
         if on_error == "raise":
@@ -159,7 +183,9 @@ def batch_load(paths, *, n_threads: int = 4, on_error: str = "raise"):
                 except IOError as e:
                     _failed(None, e)  # file named in the message
                     continue
-                yield _deliver(i, arr)
+                yield _deliver(
+                    i, arr, _digest(paths[i]) if with_digests else None
+                )
         return
     from collections import deque
     from concurrent.futures import ThreadPoolExecutor
@@ -176,16 +202,20 @@ def batch_load(paths, *, n_threads: int = 4, on_error: str = "raise"):
                 except StopIteration:
                     exhausted = True
                     break
-                pending.append((i, pool.submit(load_image, p)))
+                pending.append((i, pool.submit(_load_one, p)))
             if not pending:
                 break
             i, fut = pending.popleft()
             try:
-                arr = fut.result()
+                got = fut.result()
             except Exception as e:
                 _failed(paths[i], e)
                 continue
-            yield _deliver(i, arr)
+            if with_digests:
+                arr, digest = got
+                yield _deliver(i, arr, digest)
+            else:
+                yield _deliver(i, got)
 
 
 def synthetic_image(height: int, width: int, *, channels: int = 3, seed: int = 0) -> np.ndarray:

@@ -29,6 +29,19 @@ from mpi_cuda_imagemanipulation_tpu.ops.spec import Op
 
 BACKENDS = ("xla", "pallas", "swar", "auto")
 
+def _silence_unused_donation_warning() -> None:
+    """Donation here is opportunistic: shape-changing pipelines (e.g.
+    grayscale 3ch→1ch) can't reuse the input buffer and XLA says so with a
+    once-per-compile UserWarning. That's expected, not actionable — the
+    engine donates whenever it's safe and lets XLA take it when it fits.
+    Registered per donating-jit construction (not once): test harnesses
+    reset the filter list between tests."""
+    import warnings
+
+    warnings.filterwarnings(
+        "ignore", message="Some donated buffers were not usable"
+    )
+
 
 @dataclasses.dataclass(frozen=True)
 class Pipeline:
@@ -85,21 +98,44 @@ class Pipeline:
             return partial(pipeline_auto, self.ops, block_h=block_h)
         raise ValueError(f"unknown backend {backend!r}; known: {BACKENDS}")
 
-    def jit(self, backend: str = "xla", block_h: int | None = None):
+    def jit(
+        self,
+        backend: str = "xla",
+        block_h: int | None = None,
+        *,
+        donate: bool = False,
+    ):
         """A jitted image -> image function on the current default device.
 
         `block_h` overrides the Pallas row-block height (the reference's
-        BLOCK_SIZE knob, kernel.cu:13); None auto-tunes to VMEM."""
+        BLOCK_SIZE knob, kernel.cu:13); None auto-tunes to VMEM.
+
+        `donate=True` donates the input buffer to the computation
+        (`donate_argnums`) so same-shape u8→u8 pipelines recycle it into
+        the output and steady-state batch loops run without per-dispatch
+        HBM allocation (the engine's contract, engine/core.py). Only safe
+        when every call's input is fresh — a donated device buffer is
+        invalidated; host numpy inputs are unaffected (each call uploads a
+        new buffer). Results are bit-identical either way."""
+        if donate:
+            _silence_unused_donation_warning()
+            return jax.jit(
+                self._callable(backend, block_h=block_h), donate_argnums=0
+            )
         return jax.jit(self._callable(backend, block_h=block_h))
 
-    def batched(self, backend: str = "xla"):
+    def batched(self, backend: str = "xla", *, donate: bool = False):
         """A jitted (N, H, W[, C]) -> (N, ...) batch function: one compiled
         dispatch for a stack of same-shape images (`jax.vmap`; the Pallas
         kernels batch through their vmap rule as an extra grid dimension).
 
         The reference has no batch concept — one hardcoded image per
         process launch (kernel.cu:110). Batching amortises dispatch
-        overhead, which dominates small images on remote-attached TPUs."""
+        overhead, which dominates small images on remote-attached TPUs.
+        `donate` as in `.jit`."""
+        if donate:
+            _silence_unused_donation_warning()
+            return jax.jit(jax.vmap(self._callable(backend)), donate_argnums=0)
         return jax.jit(jax.vmap(self._callable(backend)))
 
     def sharded(self, mesh, backend: str = "xla", halo_mode: str = "serial"):

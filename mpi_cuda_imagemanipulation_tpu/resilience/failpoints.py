@@ -44,6 +44,11 @@ KNOWN_SITES = (
     "serve.dispatch",   # serve/scheduler.py: padded executor dispatch
     "halo.exchange",    # models/pipeline.py: sharded pipeline entry
     "batch.interrupt",  # cli.py cmd_batch: per-input loop head
+    "engine.complete",  # engine/core.py completion stage (and the serving
+                        # scheduler's synchronous fallback attempt): a
+                        # dispatch that enqueued fine but fails at
+                        # force/D2H time — the failure class async
+                        # execution exposes that the serial loop cannot
 )
 
 ENV_SPEC = "MCIM_FAILPOINTS"

@@ -29,6 +29,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import threading
 import time
 
 DEFAULT_NAME = ".mcim_batch_journal.jsonl"
@@ -45,6 +46,10 @@ def content_digest(path: str | os.PathLike) -> str:
 class BatchJournal:
     def __init__(self, path: str | os.PathLike):
         self.path = str(path)
+        # appends may come from the engine's encode workers concurrently
+        # (cli.py cmd_batch); the torn-line repair + write must not
+        # interleave between threads of one process
+        self._lock = threading.Lock()
 
     def load(self) -> dict[str, dict]:
         """input-relpath -> last record. Tolerates a missing file and a
@@ -70,7 +75,7 @@ class BatchJournal:
     def _append(self, rec: dict) -> None:
         line = json.dumps(rec, sort_keys=True)
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
-        with open(self.path, "a+", encoding="utf-8") as f:
+        with self._lock, open(self.path, "a+", encoding="utf-8") as f:
             # a torn line from a mid-write kill must only lose ITSELF: if
             # the file doesn't end in a newline, terminate the torn line
             # first so this record starts fresh and stays parseable

@@ -37,6 +37,19 @@ distinct `quarantined` status instead of failing its whole micro-batch.
 While a bucket's breaker is open its traffic runs the golden per-request
 fallback (bit-identical, just slower) and the health state machine reports
 `degraded`; half-open probes restore the fast path when it recovers.
+
+Async execution (engine/): the scheduler thread only ENQUEUES dispatches
+(JAX async dispatch returns immediately) and moves on to coalescing the
+next micro-batch, keeping `inflight` batches outstanding; the engine's
+completion thread drains results in submission order (D2H) and its worker
+pool crops + resolves responses. The serial alternative — `np.asarray`
+inside the dispatch loop — left the device idle during every crop/resolve
+and capped the pipeline at one batch in flight. Failure composition is
+unchanged: enqueue-time errors (incl. the `serve.dispatch` failpoint)
+retry exactly as before on the scheduler thread; completion-time errors
+(D2H, the `engine.complete` failpoint) re-run the batch through the
+synchronous retry unit and fall through to the same bisect/quarantine/
+breaker machinery.
 """
 
 from __future__ import annotations
@@ -49,6 +62,7 @@ from collections import OrderedDict, deque
 
 import numpy as np
 
+from mpi_cuda_imagemanipulation_tpu.engine import Engine, EngineMetrics
 from mpi_cuda_imagemanipulation_tpu.resilience import failpoints
 from mpi_cuda_imagemanipulation_tpu.resilience.breaker import BreakerBoard
 from mpi_cuda_imagemanipulation_tpu.resilience.health import (
@@ -148,6 +162,8 @@ class MicroBatchScheduler:
         health: HealthState | None = None,
         fallback=None,
         retry_seed: int = 0,
+        inflight: int = 2,
+        io_threads: int = 4,
     ):
         if max_batch > max(cache.batch_buckets):
             raise ValueError(
@@ -169,6 +185,10 @@ class MicroBatchScheduler:
         self.fallback = fallback
         self._retry_rng = random.Random(retry_seed)
         self._clock = clock
+        # -- async execution engine (engine/): bounded in-flight dispatch --
+        self._inflight = max(1, inflight)
+        self._io_threads = max(1, io_threads)
+        self.engine: Engine | None = None
         self._cond = threading.Condition()
         # bucket key -> FIFO of Requests; OrderedDict so the aged-bucket
         # scan is deterministic under equal deadlines
@@ -185,6 +205,13 @@ class MicroBatchScheduler:
             if self._running:
                 return
             self._running = True
+        if self.engine is None or self.engine.closed:
+            self.engine = Engine(
+                inflight=self._inflight,
+                io_threads=self._io_threads,
+                metrics=EngineMetrics(),
+                name="serve",
+            )
         self._thread = threading.Thread(
             target=self._loop, name="mcim-serve-scheduler", daemon=True
         )
@@ -192,7 +219,9 @@ class MicroBatchScheduler:
 
     def stop(self, *, drain: bool = True, timeout: float = 30.0) -> None:
         """Stop the dispatch loop. `drain=True` ships everything already
-        admitted first; `drain=False` answers queued requests `shutdown`."""
+        admitted first; `drain=False` answers queued requests `shutdown`.
+        In-flight engine batches complete either way (they already own
+        device work — finishing them is strictly cheaper than dropping)."""
         with self._cond:
             if not self._running:
                 return
@@ -201,6 +230,8 @@ class MicroBatchScheduler:
             self._cond.notify_all()
         if self._thread is not None:
             self._thread.join(timeout)
+        if self.engine is not None:
+            self.engine.close(timeout)
 
     # -- admission ---------------------------------------------------------
 
@@ -278,6 +309,15 @@ class MicroBatchScheduler:
     # -- dispatch loop -----------------------------------------------------
 
     def _loop(self) -> None:
+        try:
+            self._loop_body()
+        finally:
+            # every dispatched batch must resolve before the loop thread
+            # dies — stop()'s join is the caller's completion barrier
+            if self.engine is not None:
+                self.engine.flush()
+
+    def _loop_body(self) -> None:
         while True:
             batch: list[Request] | None = None
             with self._cond:
@@ -366,6 +406,26 @@ class MicroBatchScheduler:
             # breaker open (and no half-open probe slot): golden fallback
             self._dispatch_degraded(live)
             return
+        if self.engine is None:
+            # engine not started (direct-driven tests): serial fallback
+            self._dispatch_sync(live, bucket, breaker)
+            return
+        # async fast path: enqueue only — the engine's completion thread
+        # forces + resolves while this thread coalesces the next batch.
+        # Enqueue-time failures (incl. the serve.dispatch failpoint) are
+        # host-side and retry here, exactly like the serial path did.
+        try:
+            call_with_retry(
+                lambda: self._enqueue_batch(live),
+                policy=self.retry_policy,
+                rng=self._retry_rng,
+                on_retry=lambda a, e, d: self._note_retry(bucket, a, e, d),
+            )
+        except Exception as e:
+            self._fail_batch(live, bucket, breaker, e)
+
+    def _dispatch_sync(self, live, bucket, breaker) -> None:
+        """The serial dispatch unit (pre-engine behavior): force inline."""
         try:
             out, nb, device_s = call_with_retry(
                 lambda: self._run_batch(live),
@@ -374,29 +434,33 @@ class MicroBatchScheduler:
                 on_retry=lambda a, e, d: self._note_retry(bucket, a, e, d),
             )
         except Exception as e:  # retries exhausted: fail the path, not the loop
-            breaker.on_failure()
-            self._update_health()
-            self._log.warning(
-                "dispatch failed after %d attempts for bucket %s: %s",
-                self.retry_policy.max_attempts, bucket, e,
-            )
-            if len(live) == 1:
-                self.metrics.on_quarantine()
-                self._resolve(
-                    live[0], STATUS_QUARANTINED, f"{type(e).__name__}: {e}"
-                )
-            else:
-                # poison isolation: re-dispatch every member solo so one bad
-                # request cannot fail its batch-mates
-                self._bisect_solo(live)
+            self._fail_batch(live, bucket, breaker, e)
             return
         breaker.on_success()
         self._update_health()
         self._complete(live, out, nb, device_s)
 
-    def _run_batch(self, live: list[Request]):
-        """One padded-executor dispatch attempt (the retry unit)."""
-        failpoints.maybe_fail("serve.dispatch", requests=live)
+    def _fail_batch(self, live, bucket, breaker, e) -> None:
+        """Retries exhausted for a whole batch: feed the breaker, then
+        quarantine (solo) or bisect (grouped)."""
+        breaker.on_failure()
+        self._update_health()
+        self._log.warning(
+            "dispatch failed after %d attempts for bucket %s: %s",
+            self.retry_policy.max_attempts, bucket, e,
+        )
+        if len(live) == 1:
+            self.metrics.on_quarantine()
+            self._resolve(
+                live[0], STATUS_QUARANTINED, f"{type(e).__name__}: {e}"
+            )
+        else:
+            # poison isolation: re-dispatch every member solo so one bad
+            # request cannot fail its batch-mates
+            self._bisect_solo(live)
+
+    def _prepare_batch(self, live: list[Request]):
+        """(fn, host inputs, batch bucket) for one dispatch attempt."""
         bh, bw, ch = live[0].bucket
         nb = bucketing.pick_batch_bucket(len(live), self.cache.batch_buckets)
         fn = self.cache.get(bh, bw, ch, nb)
@@ -411,11 +475,74 @@ class MicroBatchScheduler:
             [r.true_w for r in live] + [live[-1].true_w] * (nb - len(live)),
             dtype=np.int32,
         )
+        return fn, (imgs, th, tw), nb
+
+    def _enqueue_batch(self, live: list[Request]) -> None:
+        """One async dispatch attempt: build + enqueue, never force."""
+        failpoints.maybe_fail("serve.dispatch", requests=live)
+        fn, inputs, nb = self._prepare_batch(live)
+        now = self._clock()
+        for r in live:
+            r.t_dispatch = now
+        assert self.engine is not None
+        self.engine.submit(
+            (tuple(live), nb),
+            lambda: inputs,
+            lambda a: fn(*a),  # async enqueue: returns un-forced device out
+            on_done=self._on_engine_done,
+            on_error=self._on_engine_error,
+        )
+
+    def _on_engine_done(self, key, out, info) -> None:
+        """Engine worker pool: the batch's host result landed — crop and
+        resolve each member, report breaker success."""
+        live, nb = key
+        live = list(live)
+        breaker = self.breakers.get(live[0].bucket)
+        breaker.on_success()
+        self._update_health()
+        self._complete(live, np.asarray(out), nb, info.get("force_s", 0.0))
+
+    def _on_engine_error(self, key, exc) -> None:
+        """Completion-stage failure (D2H / engine.complete failpoint): the
+        async fast path lost this batch's result after a clean enqueue.
+        Re-run it through the synchronous retry unit on this (engine
+        completion) thread — the scheduler thread keeps coalescing and the
+        engine keeps draining behind us; exhaustion falls through to the
+        same bisect/quarantine/breaker machinery as always."""
+        live, nb = key
+        live = list(live)
+        bucket = live[0].bucket
+        breaker = self.breakers.get(bucket)
+        self._note_retry(bucket, 1, exc, 0.0)  # the lost async attempt
+        try:
+            out, nb2, device_s = call_with_retry(
+                lambda: self._run_batch(live),
+                policy=self.retry_policy,
+                rng=self._retry_rng,
+                on_retry=lambda a, e, d: self._note_retry(bucket, a, e, d),
+            )
+        except Exception as e:
+            self._fail_batch(live, bucket, breaker, e)
+            return
+        breaker.on_success()
+        self._update_health()
+        self._complete(live, out, nb2, device_s)
+
+    def _run_batch(self, live: list[Request]):
+        """One synchronous padded-executor dispatch attempt (the retry
+        unit for the serial path, bisection, and completion-failure
+        re-runs)."""
+        failpoints.maybe_fail("serve.dispatch", requests=live)
+        fn, (imgs, th, tw), nb = self._prepare_batch(live)
         now = self._clock()
         for r in live:
             r.t_dispatch = now
         t0 = self._clock()
         out = np.asarray(fn(imgs, th, tw))  # forces completion + transfer
+        # completion-stage failpoint fires on the sync path too, so an
+        # `always`-armed site drives the full quarantine pipeline
+        failpoints.maybe_fail("engine.complete", requests=live)
         return out, nb, self._clock() - t0
 
     def _complete(self, live, out, nb, device_s) -> None:

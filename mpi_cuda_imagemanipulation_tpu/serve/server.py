@@ -81,6 +81,9 @@ class ServeConfig:
     shards: int = 1
     backend: str = "xla"
     default_deadline_ms: float | None = None
+    # -- async execution engine (engine/) ----------------------------------
+    inflight: int = 2  # micro-batch dispatches kept outstanding
+    io_threads: int = 4  # completion/crop worker pool size
     # -- fault tolerance (resilience/) ------------------------------------
     retry_attempts: int = 3  # per dispatch, incl. the first try
     retry_base_delay_ms: float = 5.0
@@ -146,6 +149,8 @@ class ServeApp:
                 if self._fallback_jit is not None
                 else None
             ),
+            inflight=config.inflight,
+            io_threads=config.io_threads,
         )
         self._log = get_logger()
 
@@ -183,9 +188,15 @@ class ServeApp:
             "max_delay_ms": self.config.max_delay_ms,
             "queue_depth": self.config.queue_depth,
             "shards": self.config.shards,
+            "inflight": self.config.inflight,
             "health": self.health.to_dict(),
             "breakers": self.breakers.snapshot(),
             "cache": self.cache.stats(),
+            "engine": (
+                self.scheduler.engine.metrics.snapshot()
+                if self.scheduler.engine is not None
+                else None
+            ),
             **self.metrics.snapshot(),
         }
 

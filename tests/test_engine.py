@@ -1,0 +1,535 @@
+"""Async double-buffered execution engine (engine/) — the ISSUE-4 suite.
+
+The load-bearing invariants:
+  1. the engine changes WHEN work happens, never WHAT runs: outputs are
+     bit-identical to the serial golden path under mixed shapes and deep
+     pipelines (out-of-order device completion cannot reorder results —
+     the completion FIFO forces in submission order);
+  2. the in-flight bound is real: at most `inflight` dispatches are ever
+     outstanding (backpressure blocks the producer, it never buffers);
+  3. the `engine_ab` lane measures true overlap on the CPU smoke: e2e
+     images/sec >= 1.2x serial on a synthetic slow-decode corpus with the
+     device-idle fraction strictly below the serial lane's — outputs
+     bit-identical;
+  4. a `batch --inflight 2` run killed mid-flight resumes via `--resume`
+     with no duplicated and no lost outputs (a batch is journaled only at
+     completion);
+  5. the `engine.complete` failpoint drives the serving retry/quarantine
+     machinery through the engine: transient completion faults retry to
+     success, persistent ones quarantine — bit-identical successes.
+"""
+
+import json
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bench
+from mpi_cuda_imagemanipulation_tpu.bench_suite import run_engine_ab
+from mpi_cuda_imagemanipulation_tpu.engine import Engine, EngineMetrics
+from mpi_cuda_imagemanipulation_tpu.io.image import (
+    batch_load,
+    load_image,
+    save_image,
+    synthetic_image,
+)
+from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline
+from mpi_cuda_imagemanipulation_tpu.resilience import failpoints
+from mpi_cuda_imagemanipulation_tpu.resilience.failpoints import FailpointError
+from mpi_cuda_imagemanipulation_tpu.resilience.journal import (
+    BatchJournal,
+    content_digest,
+)
+from mpi_cuda_imagemanipulation_tpu.serve.scheduler import Quarantined
+from mpi_cuda_imagemanipulation_tpu.serve.server import (
+    Client,
+    ServeApp,
+    ServeConfig,
+)
+
+REFERENCE_OPS = "grayscale,contrast:3.5,emboss:3"
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.clear()
+    yield
+    failpoints.clear()
+
+
+def _golden(img: np.ndarray, ops: str = REFERENCE_OPS) -> np.ndarray:
+    from mpi_cuda_imagemanipulation_tpu.io.image import gray_to_rgb
+
+    fn = Pipeline.parse(ops).jit()
+    g = np.asarray(jax.block_until_ready(fn(img)))
+    return gray_to_rgb(g) if g.ndim == 2 else g
+
+
+# --------------------------------------------------------------------------
+# engine core: bit-exactness, ordering, bounds, lifecycle
+# --------------------------------------------------------------------------
+
+
+def test_engine_bit_exact_mixed_shapes_forced_in_order():
+    """Mixed shapes force per-shape retraces and wildly different device
+    times; the engine must still force results in submission order and
+    match the golden path bit for bit."""
+    pipe = Pipeline.parse("gaussian:3,sobel")
+    fn = pipe.jit()
+    shapes = [(24, 32), (17, 41), (24, 32), (9, 33), (64, 48), (17, 41)]
+    imgs = [
+        synthetic_image(h, w, channels=1, seed=k)
+        for k, (h, w) in enumerate(shapes * 2)
+    ]
+    results: dict[int, np.ndarray] = {}
+    order: list[int] = []
+    errors: list = []
+
+    def on_done(k, out, info):
+        results[k] = np.asarray(out)
+        order.append(k)
+        assert info["force_s"] >= 0.0
+
+    # io_threads=1 serializes on_done, so `order` observes the completion
+    # FIFO directly
+    with Engine(
+        inflight=3, io_threads=1, stage=jax.device_put, name="t-order"
+    ) as eng:
+        for k, img in enumerate(imgs):
+            eng.submit(
+                k, lambda img=img: img, fn,
+                on_done=on_done,
+                on_error=lambda k, e: errors.append((k, e)),
+            )
+    assert not errors, errors
+    assert order == list(range(len(imgs)))  # forced in submission order
+    for k, img in enumerate(imgs):
+        np.testing.assert_array_equal(
+            results[k], np.asarray(jax.block_until_ready(fn(img))),
+            err_msg=f"image {k}",
+        )
+
+
+def test_engine_inflight_bound_and_backpressure():
+    f = jax.jit(lambda x: jnp.tanh(x @ x))
+    x = np.ones((300, 300), np.float32)
+    done = []
+    with Engine(inflight=2, io_threads=2, name="t-bound") as eng:
+        for k in range(10):
+            eng.submit(
+                k, lambda: x, f,
+                on_done=lambda k, out, info: done.append(k),
+                on_error=lambda k, e: pytest.fail(f"{k}: {e}"),
+            )
+    snap = eng.metrics.snapshot()
+    assert snap["submitted"] == 10
+    assert snap["completed"] == 10
+    assert snap["failed"] == 0
+    # the structural bound: slots are reserved before enqueue
+    assert 1 <= snap["inflight_peak"] <= 2
+    assert snap["inflight"] == 0
+    assert sorted(done) == list(range(10))
+
+
+def test_engine_submit_after_close_raises_and_close_is_idempotent():
+    eng = Engine(inflight=1, io_threads=1, name="t-closed")
+    eng.close()
+    eng.close()  # idempotent
+    with pytest.raises(RuntimeError):
+        eng.submit(
+            0, lambda: 1, lambda x: x,
+            on_done=lambda *a: None, on_error=lambda *a: None,
+        )
+
+
+def test_engine_error_routing_force_and_encode():
+    """A force failure resolves via on_error without stalling the drain;
+    an on_done (encode) failure also routes to on_error — per-item."""
+    f = jax.jit(lambda x: x + 1)
+    x = np.zeros((4, 4), np.uint8)
+    oks, errs = [], []
+    failpoints.configure("engine.complete=first:1")
+    with Engine(inflight=2, io_threads=1, name="t-err") as eng:
+        for k in range(4):
+            eng.submit(
+                k, lambda: x, f,
+                on_done=lambda k, out, info: oks.append(k),
+                on_error=lambda k, e: errs.append(k),
+            )
+    assert errs == [0]  # only the injected completion fault
+    assert sorted(oks) == [1, 2, 3]
+    failpoints.clear()
+    # encode-stage failure: on_done raises -> on_error, engine keeps going
+    oks, errs = [], []
+
+    def bad_then_good(k, out, info):
+        if k == 0:
+            raise IOError("disk full")
+        oks.append(k)
+
+    with Engine(inflight=2, io_threads=1, name="t-err2") as eng:
+        for k in range(3):
+            eng.submit(
+                k, lambda: x, f,
+                on_done=bad_then_good,
+                on_error=lambda k, e: errs.append((k, type(e).__name__)),
+            )
+    assert errs == [(0, "OSError")]
+    assert sorted(oks) == [1, 2]
+
+
+def test_engine_metrics_snapshot_and_summary():
+    m = EngineMetrics()
+    assert m.device_idle_frac() is None  # nothing ran
+    f = jax.jit(lambda x: x * 2)
+    x = np.ones((8, 8), np.uint8)
+    with Engine(inflight=2, io_threads=1, metrics=m, name="t-m") as eng:
+        for k in range(5):
+            eng.submit(
+                k, lambda: x, f,
+                on_done=lambda *a: None,
+                on_error=lambda k, e: pytest.fail(str(e)),
+            )
+    s = m.snapshot()
+    for stage in ("build", "h2d", "enqueue", "force", "encode"):
+        assert s["stages"][stage] is not None
+        assert set(s["stages"][stage]) == {"p50_ms", "p95_ms", "p99_ms"}
+    assert s["device_idle_frac"] is None or 0.0 <= s["device_idle_frac"] <= 1.0
+    assert "engine:" in m.summary_line()
+
+
+# --------------------------------------------------------------------------
+# acceptance: the engine_ab lane measures real overlap on the CPU smoke
+# --------------------------------------------------------------------------
+
+
+def test_engine_ab_overlap_speedup_and_bit_identical(monkeypatch):
+    """THE perf acceptance (CPU tier-1 smoke): with inflight=2 the
+    overlapped lane is >= 1.2x serial e2e images/sec on the synthetic
+    slow-decode corpus, its device-idle fraction is strictly below the
+    serial lane's, and outputs are bit-identical."""
+    monkeypatch.setenv("MCIM_ENGINE_AB_IMAGES", "10")
+    monkeypatch.setenv("MCIM_ENGINE_AB_DECODE_MS", "25")
+    monkeypatch.setenv("MCIM_ENGINE_AB_ENCODE_MS", "10")
+    json_path = os.environ.get("MCIM_ENGINE_AB_JSON")  # CI failure artifact
+    rec = run_engine_ab(
+        printer=lambda s: None, inflight=2, json_path=json_path
+    )
+    assert rec["bit_identical"]
+    assert rec["inflight"] == 2
+    assert rec["overlap"]["inflight_peak"] <= 2
+    assert rec["speedup"] >= 1.2, rec
+    assert rec["overlap_won"]
+    assert rec["overlap"]["device_idle_frac"] < rec["serial"]["device_idle_frac"]
+
+
+# --------------------------------------------------------------------------
+# batch CLI on the engine: bit-exactness, metrics, kill-mid-flight resume
+# --------------------------------------------------------------------------
+
+
+def test_cmd_batch_inflight_bit_identical_with_engine_metrics(tmp_path):
+    from mpi_cuda_imagemanipulation_tpu import cli
+
+    src = tmp_path / "in"
+    src.mkdir()
+    imgs = {}
+    for k in range(7):  # mixed shapes: forces mid-stream flushes too
+        name = f"{k}.png"
+        imgs[name] = synthetic_image(20 + k % 3, 24 + k % 2, channels=3, seed=k)
+        save_image(src / name, imgs[name])
+    metrics = tmp_path / "m.jsonl"
+    rc = cli.main(
+        [
+            "batch",
+            "--input-dir", str(src),
+            "--output-dir", str(tmp_path / "out"),
+            "--inflight", "2",
+            "--io-threads", "2",
+            "--json-metrics", str(metrics),
+        ]
+    )
+    assert rc == 0
+    for name, img in imgs.items():
+        np.testing.assert_array_equal(
+            load_image(tmp_path / "out" / name), _golden(img), err_msg=name
+        )
+    rec = json.loads(metrics.read_text().strip())
+    assert rec["inflight"] == 2
+    assert rec["io_threads"] == 2
+    eng = rec["engine"]
+    assert eng["submitted"] == 7  # stack=1: one dispatch per image
+    assert eng["completed"] == 7
+    assert eng["failed"] == 0
+    assert 1 <= eng["inflight_peak"] <= 2
+    assert eng["stages"]["force"] is not None
+
+
+def test_cmd_batch_window_is_deprecated_alias(tmp_path):
+    from mpi_cuda_imagemanipulation_tpu import cli
+
+    src = tmp_path / "in"
+    src.mkdir()
+    img = synthetic_image(20, 24, channels=3, seed=3)
+    save_image(src / "a.png", img)
+    rc = cli.main(
+        [
+            "batch",
+            "--input-dir", str(src),
+            "--output-dir", str(tmp_path / "out"),
+            "--window", "1",
+        ]
+    )
+    assert rc == 0
+    np.testing.assert_array_equal(
+        load_image(tmp_path / "out" / "a.png"), _golden(img)
+    )
+
+
+def test_cmd_batch_killed_mid_flight_resumes_no_dup_no_loss(tmp_path):
+    """Kill with --inflight 2 batches in the air: the engine drains what
+    was dispatched (journaled only at completion), the resumed run redoes
+    ONLY the rest — every output present exactly once, bit-identical,
+    journaled outputs untouched on disk."""
+    from mpi_cuda_imagemanipulation_tpu import cli
+
+    src = tmp_path / "in"
+    src.mkdir()
+    imgs = {}
+    for k in range(8):
+        name = f"{k}.png"
+        imgs[name] = synthetic_image(20, 24, channels=3, seed=40 + k)
+        save_image(src / name, imgs[name])
+    out = tmp_path / "out"
+    base = [
+        "batch",
+        "--input-dir", str(src),
+        "--output-dir", str(out),
+        "--inflight", "2",
+    ]
+    with pytest.raises(FailpointError):
+        cli.main(base + ["--failpoints", "batch.interrupt=after:4"])
+    failpoints.clear()
+    j = BatchJournal(out / ".mcim_batch_journal.jsonl")
+    done_before = {
+        rel: rec for rel, rec in j.load().items() if rec["status"] == "ok"
+    }
+    # the interrupt fired on input 5; everything dispatched before it was
+    # drained by the engine on the way down — journaled AND on disk
+    assert 0 < len(done_before) < 8
+    for rel in done_before:
+        assert (out / rel).exists()
+    mtimes = {rel: os.stat(out / rel).st_mtime_ns for rel in done_before}
+    time.sleep(0.05)
+    metrics = tmp_path / "m.jsonl"
+    rc = cli.main(base + ["--resume", "--json-metrics", str(metrics)])
+    assert rc == 0
+    for name, img in imgs.items():  # no losses
+        np.testing.assert_array_equal(
+            load_image(out / name), _golden(img), err_msg=name
+        )
+    for rel, t in mtimes.items():  # no duplicated work
+        assert os.stat(out / rel).st_mtime_ns == t, f"{rel} was reprocessed"
+    rec = json.loads(metrics.read_text().strip())
+    assert rec["resumed"] == len(done_before)
+    assert rec["processed"] == 8 - len(done_before)
+    assert sum(1 for r in j.load().values() if r["status"] == "ok") == 8
+
+
+# --------------------------------------------------------------------------
+# decode-side digests (journaling off the dispatch path)
+# --------------------------------------------------------------------------
+
+
+def test_batch_load_with_digests(tmp_path):
+    paths = []
+    for k in range(3):
+        p = tmp_path / f"{k}.png"
+        save_image(p, synthetic_image(10 + k, 12, channels=3, seed=k))
+        paths.append(str(p))
+    got = list(batch_load(paths, n_threads=2, with_digests=True))
+    assert [i for i, _, _ in got] == [0, 1, 2]
+    for i, arr, dig in got:
+        assert arr.ndim == 3
+        assert dig == content_digest(paths[i])
+    # default shape unchanged: 2-tuples without the flag
+    plain = list(batch_load(paths, n_threads=2))
+    assert [len(t) for t in plain] == [2, 2, 2]
+
+
+# --------------------------------------------------------------------------
+# donation (steady-state without per-batch alloc) stays bit-identical
+# --------------------------------------------------------------------------
+
+
+def test_pipeline_jit_donate_bit_identical():
+    # same-shape u8->u8 (donation usable) and shape-changing (donation
+    # silently unused) pipelines both stay bit-identical
+    for ops, channels in (
+        ("contrast:3.5,emboss:3", 1),
+        (REFERENCE_OPS, 3),
+    ):
+        img = synthetic_image(20, 24, channels=channels, seed=9)
+        pipe = Pipeline.parse(ops)
+        a = np.asarray(jax.block_until_ready(pipe.jit()(img)))
+        dfn = pipe.jit(donate=True)
+        for _ in range(3):  # repeated dispatches recycle buffers
+            b = np.asarray(jax.block_until_ready(dfn(img)))
+            np.testing.assert_array_equal(a, b, err_msg=ops)
+
+
+def test_pipeline_batched_donate_bit_identical():
+    stack = np.stack(
+        [synthetic_image(16, 20, channels=1, seed=k) for k in range(3)]
+    )
+    pipe = Pipeline.parse("contrast:2,emboss:3")
+    a = np.asarray(jax.block_until_ready(pipe.batched()(stack)))
+    b = np.asarray(jax.block_until_ready(pipe.batched(donate=True)(stack)))
+    np.testing.assert_array_equal(a, b)
+
+
+# --------------------------------------------------------------------------
+# serving: engine.complete failpoint exercises retry/quarantine through
+# the engine; /stats exposes the engine section
+# --------------------------------------------------------------------------
+
+
+def _app(**over) -> ServeApp:
+    cfg = ServeConfig(
+        **{
+            "ops": REFERENCE_OPS,
+            "buckets": ((48, 48),),
+            "max_batch": 4,
+            "max_delay_ms": 5.0,
+            "queue_depth": 64,
+            "channels": (3,),
+            "retry_base_delay_ms": 1.0,
+            **over,
+        }
+    )
+    return ServeApp(cfg).start()
+
+
+def test_serve_engine_complete_transient_retries_to_success():
+    failpoints.configure("engine.complete=once")
+    app = _app()
+    try:
+        client = Client(app)
+        img = synthetic_image(20, 30, channels=3, seed=11)
+        out = client.process(img, timeout=120)
+        jfn = Pipeline.parse(REFERENCE_OPS).jit()
+        np.testing.assert_array_equal(out, np.asarray(jfn(img)))
+        m = app.metrics.snapshot()
+        assert m["completed"] == 1
+        # the lost async completion counts as a retry (observability)
+        assert m["retries"] >= 1
+        assert m["quarantined"] == 0
+    finally:
+        app.stop()
+
+
+def test_serve_engine_complete_persistent_quarantines():
+    failpoints.configure("engine.complete=always")
+    app = _app(retry_attempts=2)
+    try:
+        client = Client(app)
+        img = synthetic_image(20, 30, channels=3, seed=12)
+        with pytest.raises(Quarantined):
+            client.process(img, timeout=120)
+        m = app.metrics.snapshot()
+        assert m["quarantined"] == 1
+        assert m["queued"] == 0  # accounting closes
+    finally:
+        app.stop()
+
+
+def test_serve_stats_expose_engine_and_inflight():
+    app = _app(inflight=2, io_threads=2)
+    try:
+        client = Client(app)
+        img = synthetic_image(20, 30, channels=3, seed=13)
+        jfn = Pipeline.parse(REFERENCE_OPS).jit()
+        np.testing.assert_array_equal(
+            client.process(img, timeout=120), np.asarray(jfn(img))
+        )
+        s = app.stats()
+        assert s["inflight"] == 2
+        eng = s["engine"]
+        assert eng is not None
+        assert eng["submitted"] >= 1
+        assert eng["completed"] >= 1
+        assert eng["inflight_peak"] >= 1
+    finally:
+        app.stop()
+
+
+def test_serve_concurrent_load_through_engine_bit_identical():
+    """Sustained concurrent mixed-shape load with inflight=2: every
+    response bit-identical, accounting closed, zero post-warm traces."""
+    app = _app(inflight=2, max_delay_ms=3.0, buckets=((48, 48), (96, 96)))
+    try:
+        client = Client(app)
+        jfn = Pipeline.parse(REFERENCE_OPS).jit()
+        shapes = [(33, 47), (48, 48), (17, 90), (40, 40)]
+        results, errs = [], []
+        lock = threading.Lock()
+
+        def worker(k):
+            try:
+                h, w = shapes[k % len(shapes)]
+                img = synthetic_image(h, w, channels=3, seed=k)
+                out = client.process(img, timeout=120)
+                with lock:
+                    results.append((img, out))
+            except Exception as e:  # pragma: no cover
+                with lock:
+                    errs.append(e)
+
+        threads = [
+            threading.Thread(target=worker, args=(k,)) for k in range(16)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        assert not errs, errs
+        assert len(results) == 16
+        for img, out in results:
+            np.testing.assert_array_equal(out, np.asarray(jfn(img)))
+        m = app.metrics.snapshot()
+        assert m["completed"] == 16
+        assert m["queued"] == 0
+        assert app.cache.traces_since_warmup == 0
+    finally:
+        app.stop()
+
+
+# --------------------------------------------------------------------------
+# bench.py probe schedule (satellite): CPU-only rounds fail fast
+# --------------------------------------------------------------------------
+
+
+def test_probe_schedule_cpu_only_fails_fast():
+    assert bench._default_probe_schedule({"JAX_PLATFORMS": "cpu"}) == ((90, 0),)
+    assert bench._default_retry_probe_schedule({"JAX_PLATFORMS": "CPU"}) == (
+        (90, 0),
+    )
+    # a TPU (or unset) environment keeps the full backoff tail
+    assert len(bench._default_probe_schedule({})) == 4
+    assert len(bench._default_probe_schedule({"JAX_PLATFORMS": "tpu,cpu"})) == 4
+    assert len(bench._default_retry_probe_schedule({})) == 2
+
+
+def test_probe_schedule_env_override(monkeypatch):
+    monkeypatch.setenv("MCIM_PROBE_SCHEDULE", "10:0,20:5")
+    assert bench._env_schedule("MCIM_PROBE_SCHEDULE", ()) == (
+        (10.0, 0.0),
+        (20.0, 5.0),
+    )
+    monkeypatch.delenv("MCIM_PROBE_SCHEDULE")
+    assert bench._env_schedule("MCIM_PROBE_SCHEDULE", ((1, 2),)) == ((1, 2),)

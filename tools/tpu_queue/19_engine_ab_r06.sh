@@ -1,0 +1,27 @@
+#!/bin/bash
+# Async execution-engine A/B (round 6): serial vs overlapped end-to-end
+# pipeline on real hardware (bench_suite --config engine_ab). The lane
+# runs the same compiled reference pipeline over a synthetic slow-decode
+# corpus two ways — decode→dispatch→force→encode serially, then through
+# the engine (inflight dispatches outstanding, in-order completion drain,
+# encode worker pool) — and reports e2e images/sec per lane, the speedup,
+# and each lane's device-idle fraction. On TPU the decisive question the
+# CPU smoke cannot answer: how much of the host decode/transfer/encode
+# path the async dispatch + donated-buffer steady state actually hides
+# behind real device compute (and whether inflight=2 suffices or deeper
+# helps — the sweep below covers 1/2/4).
+# Knobs: MCIM_ENGINE_AB_IMAGES/_DECODE_MS/_ENCODE_MS size the corpus
+# (defaults: 32 images at 1080p, 8 ms decode + 4 ms encode tails).
+# Budget: ~2-4 min (one serving-free compile per lane).
+set -u
+cd "$(dirname "$0")/../.."
+. tools/tpu_queue/_lib.sh
+out=artifacts/engine_ab_r06.out
+: > "$out"
+for depth in 1 2 4; do
+  echo "=== inflight $depth ===" >> "$out"
+  timeout 900 python -m mpi_cuda_imagemanipulation_tpu.bench_suite \
+    --config engine_ab --inflight "$depth" >> "$out" 2>&1
+done
+commit_artifacts "TPU window: async engine serial-vs-overlap A/B (round 6)" "$out"
+exit 0
