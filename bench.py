@@ -116,6 +116,36 @@ def git_head_sha() -> str | None:
     return proc.stdout.strip() or None
 
 
+# a promoted committed record measured this many commits behind HEAD gets
+# a loud staleness warning: the round-5 headline was measured 9 commits
+# before HEAD and nothing flagged it (ISSUE r6 satellite)
+STALENESS_WARN_COMMITS = 5
+
+
+def git_commits_between(measured_sha: str, head_sha: str) -> int | None:
+    """Commit distance `measured_sha..head_sha` (how many commits HEAD is
+    ahead of the commit that produced a measurement), or None when git
+    cannot answer (shallow clone, unknown SHA, no repo)."""
+    if measured_sha == head_sha:
+        return 0
+    try:
+        proc = subprocess.run(
+            ["git", "rev-list", "--count", f"{measured_sha}..{head_sha}"],
+            capture_output=True,
+            text=True,
+            cwd=REPO,
+            timeout=10,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if proc.returncode != 0:
+        return None
+    try:
+        return int(proc.stdout.strip())
+    except ValueError:
+        return None
+
+
 def _cpu_env() -> dict:
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)  # sitecustomize skips axon without it
@@ -265,6 +295,12 @@ def main() -> int:
         # its A/B 4.1x — tools/packed_kernels.py).
         plan = [
             (HEADLINE, "pallas"),
+            # the round-6 promotion: the MXU banded-matmul backend rides
+            # every TPU bench run as a headline candidate, so a win is
+            # cashed on the committed record (the headline reports
+            # whichever impl measures fastest — same contract the SWAR
+            # and packed A/Bs ran under)
+            (HEADLINE, "mxu"),
             (HEADLINE, "swar"),
             (HEADLINE, "xla"),
             (HEADLINE + "_sharded", "pallas"),
@@ -428,6 +464,23 @@ def _promote_committed(
     head = git_head_sha()
     if head:
         h["head_git_sha"] = head
+    # staleness accounting: a promoted number is only as current as the
+    # commit that measured it — emit the distance and warn loudly past the
+    # threshold (the round-5 headline was 9 commits stale, silently)
+    if same.get("git_sha") and head:
+        staleness = git_commits_between(same["git_sha"], head)
+        if staleness is not None:
+            h["staleness_commits"] = staleness
+            if staleness > STALENESS_WARN_COMMITS:
+                h["staleness_warning"] = (
+                    f"promoted record measured {staleness} commits behind "
+                    f"HEAD (threshold {STALENESS_WARN_COMMITS}); re-measure "
+                    "on the next healthy window"
+                )
+                _log(
+                    f"WARNING: promoted headline is {staleness} commits "
+                    f"stale (measured at {same['git_sha']}, HEAD {head})"
+                )
     if platform_note:
         h["platform"] = f"{h.get('platform')} ({platform_note})"
     if source:

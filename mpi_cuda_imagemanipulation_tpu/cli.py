@@ -72,10 +72,12 @@ def _build_parser() -> argparse.ArgumentParser:
     )
     run.add_argument(
         "--impl",
-        choices=("auto", "xla", "pallas", "swar"),
+        choices=("auto", "xla", "pallas", "swar", "mxu"),
         default="auto",
         help="compute backend for the op kernels (auto: measured per-group "
-        "choice between XLA fusion and Pallas kernels)",
+        "choice between XLA fusion, Pallas kernels, and — behind a "
+        "calibration win — the MXU banded-matmul path; mxu: force the "
+        "banded-matmul stencil contraction, golden fallback per op)",
     )
     run.add_argument(
         "--shards",
@@ -157,7 +159,9 @@ def _build_parser() -> argparse.ArgumentParser:
     batch.add_argument("--glob", default="*", help="input filename pattern")
     batch.add_argument("--ops", default="grayscale,contrast:3.5,emboss:3")
     batch.add_argument(
-        "--impl", choices=("auto", "xla", "pallas", "swar"), default="auto"
+        "--impl",
+        choices=("auto", "xla", "pallas", "swar", "mxu"),
+        default="auto",
     )
     batch.add_argument(
         "--shards",
@@ -245,12 +249,14 @@ def _build_parser() -> argparse.ArgumentParser:
     srv.add_argument("--ops", default="grayscale,contrast:3.5,emboss:3")
     srv.add_argument(
         "--impl",
-        choices=("auto", "xla"),
+        choices=("auto", "xla", "mxu"),
         default="xla",
         help="serving computes with XLA fusion (the bucket-padded executor "
         "rebuilds each op's border at the dynamic true shape, which the "
         "Pallas streaming kernels' static in-kernel edge extension cannot "
-        "do); 'auto' is an accepted alias for xla",
+        "do); 'mxu' contracts eligible stencil families on the matrix "
+        "unit inside the same padded executor (bit-identical; "
+        "ops/mxu_kernels.py); 'auto' is an accepted alias for xla",
     )
     srv.add_argument(
         "--shards",
@@ -363,7 +369,7 @@ def _build_parser() -> argparse.ArgumentParser:
     bench.add_argument("--device", default=None)
     bench.add_argument(
         "--impl",
-        choices=("xla", "pallas", "swar", "auto", "both"),
+        choices=("xla", "pallas", "swar", "mxu", "auto", "both"),
         default="both",
     )
     bench.add_argument(
@@ -400,6 +406,17 @@ def _build_parser() -> argparse.ArgumentParser:
     )
     tune.add_argument(
         "--impl", choices=("pallas", "swar"), default="pallas"
+    )
+    tune.add_argument(
+        "--dimension",
+        choices=("block", "backend"),
+        default="block",
+        help="what to calibrate: 'block' sweeps Pallas row-block heights "
+        "(--impl/--blocks apply); 'backend' measures VPU (pallas) vs MXU "
+        "banded vs hybrid per eligible stencil family in --ops and "
+        "records the winner per device kind — `--impl auto` then routes "
+        "a family to the MXU only behind such a recorded win "
+        "(ops/mxu_kernels.py, utils/calibration.py)",
     )
     tune.add_argument("--height", type=int, default=4320)
     tune.add_argument("--width", type=int, default=7680)
@@ -1158,6 +1175,8 @@ def cmd_autotune(args: argparse.Namespace) -> int:
             )
             return 3
         ops = make_pipeline_ops(args.ops)
+        if args.dimension == "backend":
+            return _autotune_backend(args, ops)
         # the recorded calibration is applied through min(heuristic, calib),
         # so any candidate above the heuristic cap for this sweep's config
         # could never take effect at run time — measuring it would waste
@@ -1291,6 +1310,111 @@ def cmd_autotune(args: argparse.Namespace) -> int:
                 os.environ[k] = v
 
 
+def _autotune_backend(args: argparse.Namespace, ops) -> int:
+    """The VPU-vs-MXU autotune dimension (`--dimension backend`): for each
+    MXU-eligible stencil family in --ops, measure the VPU streaming
+    kernel against the MXU banded and hybrid formulations on the live
+    backend and record the winner per (device kind, family, width) in the
+    calibration store. `backend='auto'` routes a family to the MXU ONLY
+    behind such a recorded win (ops/mxu_kernels.use_mxu_for_stencil), so
+    this sweep is what actually cashes the roofline headroom in
+    production. Runs under the caller's MCIM_NO_CALIB=1 env, so an
+    existing store cannot steer the sweep it is about to overwrite."""
+    import jax
+
+    from mpi_cuda_imagemanipulation_tpu.io.image import synthetic_image
+    from mpi_cuda_imagemanipulation_tpu.ops.mxu_kernels import (
+        mxu_family,
+        pipeline_mxu,
+    )
+    from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
+        pipeline_pallas,
+    )
+    from mpi_cuda_imagemanipulation_tpu.utils import calibration
+    from mpi_cuda_imagemanipulation_tpu.utils.log import emit_json_metrics
+    from mpi_cuda_imagemanipulation_tpu.utils.timing import device_throughput
+
+    fams: dict = {}  # family -> representative stencil op, first wins
+    for op in ops:
+        fam = mxu_family(op)
+        if fam is not None and fam not in fams:
+            fams[fam] = op
+    if not fams:
+        print(
+            f"error: no MXU-eligible stencil family in --ops {args.ops!r} "
+            "(ops/mxu_kernels.mxu_eligible)",
+            file=sys.stderr,
+        )
+        return 2
+    img = jax.numpy.asarray(
+        synthetic_image(args.height, args.width, channels=1, seed=7)
+    )
+    kind = calibration.current_device_kind()
+    mp = args.height * args.width / 1e6
+    records = []
+    for fam, op in fams.items():
+        lanes = {
+            "vpu": jax.jit(lambda x, o=(op,): pipeline_pallas(o, x)),
+            "mxu": jax.jit(
+                lambda x, o=(op,): pipeline_mxu(o, x, mode="banded")
+            ),
+            "hybrid": jax.jit(
+                lambda x, o=(op,): pipeline_mxu(o, x, mode="hybrid")
+            ),
+        }
+        timed: dict = {}
+        for lane, fn in lanes.items():
+            try:
+                timed[lane] = device_throughput(fn, [img])
+            except Exception as e:  # one lane failing must not kill the sweep
+                print(f"{fam}/{lane}: failed ({str(e)[:120]})")
+        if not timed:
+            print(f"{fam}: no lane ran; skipped")
+            continue
+        choice = min(timed, key=timed.get)
+        lane_mp = {k: round(mp / v, 1) for k, v in timed.items()}
+        for lane in ("vpu", "mxu", "hybrid"):
+            if lane in timed:
+                mark = " <- winner" if lane == choice else ""
+                print(
+                    f"{fam:10s} {lane:7s} {timed[lane] * 1e3:8.3f} ms/iter"
+                    f"  {lane_mp[lane]:>10,.0f} MP/s{mark}"
+                )
+        rec = {
+            "family": fam,
+            "op": op.name,
+            "choice": choice,
+            "width": args.width,
+            "mp_per_s": lane_mp,
+        }
+        if not args.dry_run:
+            rec["calib_file"] = calibration.record_backend_choice(
+                kind, fam, choice,
+                op=op.name, width=args.width, mp_per_s=lane_mp,
+            )
+        records.append(rec)
+    if not records:
+        print("error: no family measured", file=sys.stderr)
+        return 1
+    out = {
+        "event": "autotune_backend",
+        "device_kind": kind,
+        "backend": jax.default_backend(),
+        "pipeline": args.ops,
+        "height": args.height,
+        "width": args.width,
+        "families": records,
+        "dry_run": bool(args.dry_run),
+    }
+    if args.dry_run:
+        print("dry run; calibration store not written")
+    if args.json_metrics:
+        emit_json_metrics(
+            out, None if args.json_metrics == "-" else args.json_metrics
+        )
+    return 0
+
+
 def cmd_info(args: argparse.Namespace) -> int:
     _configure_platform(args.device)
     import jax
@@ -1313,14 +1437,26 @@ def cmd_info(args: argparse.Namespace) -> int:
 
     entries = calibration.entries()
     if entries:
-        pairs = ", ".join(
-            f"{kind}/{impl}: block_h={rec.get('block_h')}"
-            for kind, impls in sorted(entries.items())
-            if isinstance(impls, dict)
-            for impl, rec in sorted(impls.items())
-            if isinstance(rec, dict)
+        parts = []
+        for kind, impls in sorted(entries.items()):
+            if not isinstance(impls, dict):
+                continue
+            for impl, rec in sorted(impls.items()):
+                if not isinstance(rec, dict):
+                    continue
+                if impl == "backend_choice":
+                    # the VPU-vs-MXU autotune dimension (family -> choice)
+                    parts.extend(
+                        f"{kind}/backend:{fam}={ent.get('choice')}"
+                        for fam, ent in sorted(rec.items())
+                        if isinstance(ent, dict)
+                    )
+                else:
+                    parts.append(f"{kind}/{impl}: block_h={rec.get('block_h')}")
+        print(
+            f"autotune calibration ({calibration.calib_path()}): "
+            + ", ".join(parts)
         )
-        print(f"autotune calibration ({calibration.calib_path()}): {pairs}")
     else:
         print("autotune calibration: none (run `mcim-tpu autotune`)")
     return 0

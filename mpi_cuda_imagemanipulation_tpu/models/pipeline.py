@@ -27,7 +27,7 @@ from mpi_cuda_imagemanipulation_tpu.ops.registry import (
 )
 from mpi_cuda_imagemanipulation_tpu.ops.spec import Op
 
-BACKENDS = ("xla", "pallas", "swar", "auto")
+BACKENDS = ("xla", "pallas", "swar", "mxu", "auto")
 
 def _silence_unused_donation_warning() -> None:
     """Donation here is opportunistic: shape-changing pipelines (e.g.
@@ -90,6 +90,17 @@ class Pipeline:
             )
 
             return partial(pipeline_swar, self.ops, block_h=block_h)
+        if backend == "mxu":
+            # banded-matmul stencil contraction on the matrix unit for the
+            # eligible correlation families, per-op golden fallback
+            # otherwise; pure XLA, so pointwise prefixes fuse into the
+            # same launch (ops/mxu_kernels.py). `auto` joins only behind
+            # a measured per-device-kind calibration win.
+            from mpi_cuda_imagemanipulation_tpu.ops.mxu_kernels import (
+                pipeline_mxu,
+            )
+
+            return partial(pipeline_mxu, self.ops, block_h=block_h)
         if backend == "auto":
             from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
                 pipeline_auto,
@@ -264,7 +275,10 @@ class Pipeline:
         serve/padded.py). This is the cache-warm hook `serve/cache.py`
         pre-compiles per (pipeline, bucket, batch) at server startup so no
         live request ever pays a trace. With `mesh`, the batch axis shards
-        over it (the `.data_parallel` layout)."""
+        over it (the `.data_parallel` layout). `backend='mxu'` keeps the
+        same executor but contracts eligible stencils on the matrix unit
+        (a drop-in for op.valid — bit-identical; ops/mxu_kernels.py);
+        'auto' follows the calibration-gated MXU routing."""
         from mpi_cuda_imagemanipulation_tpu.serve.padded import make_serving_fn
 
         return make_serving_fn(

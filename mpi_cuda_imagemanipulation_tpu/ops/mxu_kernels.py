@@ -1,0 +1,488 @@
+"""Production MXU banded-matmul stencil backend (``impl='mxu'``).
+
+Promotion of the tools/mxu_proto.py design into the framework (round 6).
+Why this exists (the round-5 roofline result, BASELINE.md): the u8 copy
+probe measured 552-658 GB/s, falsifying the element-rate-ceiling theory —
+the headline 5x5 Gaussian (45.4k MP/s/chip) is VPU-COMPUTE-bound at ~11%
+of the HBM roofline with the MXU (~197 TFLOP/s bf16 on v5e) idle. This
+backend reformulates the correlation-class stencils as blocked banded
+matmuls so the taps contract on the MXU instead of the VPU, mirroring the
+systolic/tensor-core retargeting literature (PAPERS.md: "A Versatile
+Software Systolic Execution Model for GPU Memory-Bound Kernels",
+"SparStencil").
+
+Formulation (separable row pass; the column pass is the mirror):
+
+    out[h, B*j + n] = sum_k in_pad[h, B*j + n + k] * t[k],   k in [0, 2h]
+
+With block width B=128, gather In_ext[j] = in_pad[:, B*j : B*j + B + 2h]
+(static slices) and build the banded tap matrix C[i, n] = t[i - n] on the
+valid band (shape (B + 2h, B)); then out_block_j = In_ext[j] @ C — an
+einsum with M=H, K=B+2h, N=B=128: real MXU shapes. FLOPs are
+(B+2h)/(2h+1) ~ 26x the arithmetic minimum for a 5-tap kernel, but the
+MXU has ~430x the VPU's sustained MAC rate.
+
+Exactness (the non-negotiable — every backend must be bit-exact against
+the golden ops/spec.py path):
+
+  * u8 pixel values (<= 255) and small integer taps are exactly
+    representable in bf16 (8-bit significand: all integers <= 256, and any
+    integer whose odd part is < 256 — checked per kernel at eligibility
+    time via an ml_dtypes round-trip).
+  * jnp.einsum with preferred_element_type=f32 accumulates exactly: every
+    partial product and every partial sum is an integer bounded by
+    255 * sum|w| < 2^24, so f32 addition is exact regardless of order.
+  * The SEPARABLE column pass consumes the row-pass sums (<= 255*S, up to
+    14 bits — NOT bf16-exact beyond 256), so it runs as the proven 64a+b
+    split: tmp = 64*a + b with a = floor(tmp/64) and b = tmp - 64a; for
+    tap sum S <= 64 both halves are <= 255 (bf16-exact) and
+    colsum(tmp) = 64*colsum(a) + colsum(b) — integer-exact by linearity.
+    (An f32-einsum column variant is kept for the A/B lane: exact
+    directly, lower MXU rate.)
+  * NON-SEPARABLE integer kernels (emboss/emboss101, sharpen, laplacians,
+    unsharp, custom integer `filter`) contract in ONE einsum: kh
+    row-shifted views of the width-blocked tile joint-contract over
+    (row offset, band position) against C2[dy, i, n] = w[dy, i - n].
+    Inputs are raw u8 values (bf16-exact), so no split is needed.
+  * combine='magnitude' (sobel/prewitt/scharr) and any post `scale`
+    REPLAY the golden float ops on the exact integer accumulations
+    (jnp.sqrt(a0*a0 + a1*a1), acc * np.float32(scale)) — identical inputs
+    + identical op sequence = identical f32 results, the same argument
+    the SWAR wide mode rests on (ops/swar_kernels.py).
+  * Quantization and interior-guard masking reuse StencilOp.finalize on
+    the exact accumulations, so the final u8 is golden by construction.
+
+The ``hybrid`` sub-mode splits the work across units inside ONE fused XLA
+launch: the cheap u8 row pass runs on the VPU (the golden corr_valid's
+exact shift-multiply-accumulate — O(k) adds over integers) and only the
+column pass contracts on the MXU (halving the banded FLOPs); pointwise
+prefixes always run on the VPU and fuse into the same program under jit.
+Both modes are bit-exact; the mxu_ab bench lane measures vpu vs mxu vs
+hybrid per silicon window.
+
+Eligibility (``mxu_eligible``): ``reduce='corr'`` StencilOps whose
+kernels are bf16-exact integers with 255 * sum|w| < 2^24, combine
+'single' or 'magnitude', any edge mode / quantizer (the backend operates
+on the caller's pre-extended tile and replays the golden finalize). The
+separable banded path additionally needs non-negative integer taps with
+sum S <= 64 (the 64a+b bound — all registry separables qualify);
+separable ops outside that bound fall to the one-einsum 2-D path. Rank /
+morphology ops (median, erode, dilate) have no linear identity and fall
+back per op to the VPU paths — ``impl='mxu'`` is always-correct, the
+same contract as ``impl='swar'``.
+
+``backend='auto'`` routes a stencil group here only when (a) the op
+family is eligible, (b) the live backend is a real TPU (platforms
+without an MXU always take the VPU/XLA paths, bit-exactly), and (c) the
+calibration store records a measured per-device-kind win for the family
+(``mcim-tpu autotune --dimension backend``; utils/calibration.py) — or
+the MCIM_PREFER_MXU=1 A/B switch is set (TPU-only, like
+MCIM_PREFER_SWAR).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from mpi_cuda_imagemanipulation_tpu.ops.spec import (
+    F32,
+    Op,
+    StencilOp,
+    corr_valid,
+    exact_f32,
+    pad2d,
+)
+from mpi_cuda_imagemanipulation_tpu.utils import calibration
+from mpi_cuda_imagemanipulation_tpu.utils.platform import is_tpu_backend
+
+B = 128  # one MXU / lane tile: the banded-matmul block width
+_SPLIT = 64.0  # the 64a+b column-split radix (both halves <= 255: bf16-exact)
+_F32_EXACT = 1 << 24  # integers below this are exact in f32
+
+MXU_MODES = ("banded", "hybrid")
+MXU_COL_VARIANTS = ("bf16split", "f32")
+
+
+def mxu_mode() -> str:
+    """Execution mode: 'banded' (both separable passes on the MXU) or
+    'hybrid' (row pass on the VPU, column pass on the MXU) — env
+    MCIM_MXU_MODE, default banded."""
+    m = os.environ.get("MCIM_MXU_MODE", "") or "banded"
+    if m not in MXU_MODES:
+        raise ValueError(f"MCIM_MXU_MODE={m!r}; known: {MXU_MODES}")
+    return m
+
+
+def mxu_col_variant() -> str:
+    """Column-pass arithmetic: 'bf16split' (the proven 64a+b split — the
+    production default) or 'f32' (direct f32 einsum, kept for the A/B
+    lane) — env MCIM_MXU_COL."""
+    v = os.environ.get("MCIM_MXU_COL", "") or "bf16split"
+    if v not in MXU_COL_VARIANTS:
+        raise ValueError(f"MCIM_MXU_COL={v!r}; known: {MXU_COL_VARIANTS}")
+    return v
+
+
+def prefer_mxu() -> bool:
+    """A/B promotion switch (mirrors prefer_swar): MCIM_PREFER_MXU=1
+    routes eligible stencil groups through the MXU path on every auto
+    path without a calibration entry. Honored only on real TPU backends —
+    auto must never route to the MXU on platforms that lack one."""
+    return os.environ.get("MCIM_PREFER_MXU", "") not in ("", "0")
+
+
+# --------------------------------------------------------------------------
+# Eligibility
+# --------------------------------------------------------------------------
+
+
+def _bf16_exact(a: np.ndarray) -> bool:
+    """Whether every value round-trips bf16 exactly (host-pure)."""
+    try:
+        import ml_dtypes
+
+        af = np.asarray(a, np.float64)
+        return bool(np.array_equal(af.astype(ml_dtypes.bfloat16).astype(np.float64), af))
+    except ImportError:  # pragma: no cover - ml_dtypes ships with jax
+        # integers are bf16-exact iff their odd part fits 8 significand bits
+        af = np.abs(np.asarray(a, np.int64)).reshape(-1)
+        for v in af:
+            v = int(v)
+            while v and v % 2 == 0:
+                v //= 2
+            if v >= 256:
+                return False
+        return True
+
+
+def _int_kernels_ok(op: StencilOp) -> bool:
+    for k in op.kernels:
+        ka = np.asarray(k, np.float64)
+        if not np.array_equal(ka, np.round(ka)):
+            return False
+        if not _bf16_exact(ka):
+            return False
+        if 255.0 * float(np.abs(ka).sum()) >= _F32_EXACT:
+            return False
+    return True
+
+
+def _sep_taps(op: StencilOp) -> tuple[float, ...] | None:
+    """The op's separable taps when the 64a+b banded path applies: integer,
+    non-negative, bf16-exact, length 2*halo + 1, sum S in [1, 64] (so the
+    split halves a = floor(s/64) <= 255*S/64 <= 255 stay bf16-exact).
+    Every registry separable (binomial Gaussians, odd boxes) qualifies;
+    anything else falls to the one-einsum 2-D path."""
+    t = op.separable
+    if t is None:
+        return None
+    ta = np.asarray(t, np.float64).reshape(-1)
+    if not np.array_equal(ta, np.round(ta)) or np.any(ta < 0):
+        return None
+    if len(ta) - 1 != 2 * op.halo:
+        return None
+    s = float(ta.sum())
+    if s < 1 or s > _SPLIT:
+        return None
+    if not _bf16_exact(ta):
+        return None
+    return tuple(float(v) for v in ta)
+
+
+def mxu_eligible(op: Op) -> bool:
+    """True iff `op` has a proven MXU banded-matmul identity (module
+    docstring). This is the registry/spec-level gate every router
+    (pipeline_mxu, auto, sharded, serving) consults — `auto` can never
+    select the MXU for an op family outside it."""
+    if not isinstance(op, StencilOp):
+        return False
+    if op.reduce != "corr":
+        return False
+    if op.combine not in ("single", "magnitude"):
+        return False
+    if 2 * op.halo >= B:
+        return False
+    # the band geometry assumes square (2h+1)-kernels — true for every
+    # registry op; reject anything else instead of miscomputing
+    k = 2 * op.halo + 1
+    if any(tuple(kk.shape) != (k, k) for kk in op.kernels):
+        return False
+    return _int_kernels_ok(op)
+
+
+def mxu_family(op: Op) -> str | None:
+    """Calibration key for the op's MXU formulation class: 'sepK' (banded
+    separable, K taps), 'gradKxK' (magnitude combine), 'corrKxK' (one-shot
+    2-D einsum). None for ineligible ops."""
+    if not mxu_eligible(op):
+        return None
+    k = int(op.kernels[0].shape[0])
+    if op.combine == "magnitude":
+        return f"grad{k}x{k}"
+    if _sep_taps(op) is not None:
+        return f"sep{k}"
+    return f"corr{k}x{k}"
+
+
+# --------------------------------------------------------------------------
+# Banded tap matrices (host-built, cached per weights)
+# --------------------------------------------------------------------------
+
+_band_cache: dict = {}
+
+
+def _band_np(taps: tuple, h: int) -> np.ndarray:
+    """(B + 2h, B) banded matrix with C[n + i, n] = taps[i]."""
+    key = ("1d", taps, h)
+    got = _band_cache.get(key)
+    if got is None:
+        C = np.zeros((B + 2 * h, B), np.float32)
+        for n in range(B):
+            for i, t in enumerate(taps):
+                C[n + i, n] = t
+        got = _band_cache[key] = C
+    return got
+
+
+def _band2_np(w2d: np.ndarray, h: int) -> np.ndarray:
+    """(kh, B + 2h, B) per-row-offset banded matrices for the one-einsum
+    2-D path: C2[d, n + i, n] = w2d[d, i]."""
+    wa = np.asarray(w2d, np.float32)
+    key = ("2d", wa.tobytes(), wa.shape, h)
+    got = _band_cache.get(key)
+    if got is None:
+        kh, kw = wa.shape
+        C2 = np.zeros((kh, B + 2 * h, B), np.float32)
+        for d in range(kh):
+            for n in range(B):
+                for i in range(kw):
+                    C2[d, n + i, n] = wa[d, i]
+        got = _band_cache[key] = C2
+    return got
+
+
+def _band_blocks(xp: jnp.ndarray, axis: int, h: int) -> jnp.ndarray:
+    """Static sliding blocks of width B + 2h along `axis` with stride B,
+    stacked on a new leading axis; `xp` must carry the 2h halo at both
+    ends of `axis` and a block-multiple core."""
+    n = (xp.shape[axis] - 2 * h) // B
+    slices = []
+    for j in range(n):
+        idx = [slice(None)] * xp.ndim
+        idx[axis] = slice(j * B, j * B + B + 2 * h)
+        slices.append(xp[tuple(idx)])
+    return jnp.stack(slices, axis=0)
+
+
+# --------------------------------------------------------------------------
+# Exact banded passes
+# --------------------------------------------------------------------------
+
+
+def _row_pass_banded(rows: jnp.ndarray, taps: tuple, h: int) -> jnp.ndarray:
+    """(R, Wc + 2h) exact u8-integer f32 -> (R, Wc) f32 row sums (Wc a
+    block multiple). bf16 inputs are exact (values <= 255); the f32
+    accumulation is exact (integer partial sums < 2^24)."""
+    C = jnp.asarray(_band_np(taps, h), jnp.bfloat16)
+    ext = _band_blocks(rows.astype(jnp.bfloat16), 1, h)  # (nb, R, B+2h)
+    out = jnp.einsum("jrk,kn->rjn", ext, C, preferred_element_type=F32)
+    return out.reshape(out.shape[0], -1)
+
+
+def _col_pass_banded(
+    tmp: jnp.ndarray, taps: tuple, h: int, variant: str
+) -> jnp.ndarray:
+    """(Rc + 2h, W) f32 exact-integer row sums -> (Rc, W) column sums
+    (Rc a block multiple). 'bf16split': tmp = 64a + b, both halves
+    bf16-exact, recombined in f32 — integer-exact by linearity. 'f32':
+    direct f32 einsum (exact; lower MXU rate, kept for the A/B lane)."""
+    if variant == "f32":
+        C = jnp.asarray(_band_np(taps, h), F32)
+        ext = _band_blocks(tmp, 0, h)  # (nb, B+2h, W)
+        out = jnp.einsum("jkw,km->jmw", ext, C, preferred_element_type=F32)
+        return out.reshape(-1, out.shape[-1])
+    C = jnp.asarray(_band_np(taps, h), jnp.bfloat16)
+    a = jnp.floor(tmp * np.float32(1.0 / _SPLIT))
+    b = tmp - a * np.float32(_SPLIT)
+    ea = _band_blocks(a.astype(jnp.bfloat16), 0, h)
+    eb = _band_blocks(b.astype(jnp.bfloat16), 0, h)
+    oa = jnp.einsum("jkw,km->jmw", ea, C, preferred_element_type=F32)
+    ob = jnp.einsum("jkw,km->jmw", eb, C, preferred_element_type=F32)
+    out = oa * np.float32(_SPLIT) + ob
+    return out.reshape(-1, out.shape[-1])
+
+
+def _sep_valid_mxu(
+    xpad: jnp.ndarray, taps: tuple, h: int, *, mode: str, col_variant: str
+) -> jnp.ndarray:
+    """Separable valid-mode correlation via banded matmuls — bit-identical
+    to spec.separable_valid (both compute the same exact integers)."""
+    hh = xpad.shape[0] - 2 * h
+    ww = xpad.shape[1] - 2 * h
+    xf = exact_f32(xpad)
+    if mode == "hybrid":
+        # row pass on the VPU: the golden exact integer row correlation;
+        # output width is already ww, so no width block-padding at all
+        tmp = corr_valid(xf, np.asarray(taps, np.float32).reshape(1, -1))
+    else:
+        wpad = (-ww) % B
+        core = xf if wpad == 0 else jnp.pad(xf, ((0, 0), (0, wpad)))
+        tmp = _row_pass_banded(core, taps, h)  # (hh + 2h, ww + wpad)
+    hpad = (-hh) % B
+    if hpad:
+        tmp = jnp.pad(tmp, ((0, hpad), (0, 0)))
+    out = _col_pass_banded(tmp, taps, h, col_variant)
+    return out[:hh, :ww]
+
+
+def _corr2d_valid_mxu(xpad: jnp.ndarray, w2d: np.ndarray, h: int) -> jnp.ndarray:
+    """Valid 2-D integer correlation as ONE banded einsum: kh row-shifted
+    views of the width-blocked tile joint-contract over (row offset,
+    band position). Raw u8 values are bf16-exact, so no split is needed;
+    the f32 accumulation of integer products is exact (module docstring)."""
+    kh, kw = w2d.shape
+    hh = xpad.shape[0] - (kh - 1)
+    ww = xpad.shape[1] - (kw - 1)
+    xf = exact_f32(xpad)
+    wpad = (-ww) % B
+    if wpad:
+        xf = jnp.pad(xf, ((0, 0), (0, wpad)))
+    xb = xf.astype(jnp.bfloat16)
+    views = jnp.stack([xb[d : d + hh] for d in range(kh)], axis=0)
+    ext = _band_blocks(views, 2, h)  # (nb, kh, hh, B + 2h)
+    C2 = jnp.asarray(_band2_np(w2d, h), jnp.bfloat16)
+    out = jnp.einsum("jdhk,dkn->hjn", ext, C2, preferred_element_type=F32)
+    return out.reshape(hh, -1)[:, :ww]
+
+
+def mxu_valid(
+    op: StencilOp,
+    xpad: jnp.ndarray,
+    *,
+    mode: str | None = None,
+    col_variant: str | None = None,
+) -> jnp.ndarray:
+    """Drop-in for StencilOp.valid on an eligible op: float32
+    (H + 2h, W + 2h) -> float32 (H, W) accumulation, bit-identical to the
+    golden path (exact integer sums + replayed combine/scale). This is
+    the single primitive every MXU route shares — the full-image
+    pipeline, the sharded materialised-ext path, and the serving
+    bucket-padded executor all call it on their own pre-extended tiles,
+    so the edge-extension machinery is never duplicated."""
+    if not mxu_eligible(op):
+        raise ValueError(f"op {op.name!r} has no MXU formulation")
+    mode = mode or mxu_mode()
+    col_variant = col_variant or mxu_col_variant()
+    h = op.halo
+    taps = _sep_taps(op)
+    if taps is not None and op.combine == "single":
+        accs = [
+            _sep_valid_mxu(xpad, taps, h, mode=mode, col_variant=col_variant)
+        ]
+    else:
+        accs = [
+            _corr2d_valid_mxu(xpad, np.asarray(k, np.float32), h)
+            for k in op.kernels
+        ]
+    if op.combine == "single":
+        acc = accs[0]
+    elif op.combine == "magnitude":
+        # replay the golden combine on the exact integer accumulations
+        acc = jnp.sqrt(accs[0] * accs[0] + accs[1] * accs[1])
+    else:  # pragma: no cover - mxu_eligible rejects other combines
+        raise ValueError(f"unknown combine {op.combine!r}")
+    if op.scale != 1.0:
+        acc = acc * np.float32(op.scale)
+    return acc
+
+
+# --------------------------------------------------------------------------
+# Op / pipeline entry points
+# --------------------------------------------------------------------------
+
+
+def mxu_stencil(
+    op: StencilOp,
+    img: jnp.ndarray,
+    *,
+    mode: str | None = None,
+    col_variant: str | None = None,
+) -> jnp.ndarray:
+    """One eligible stencil over a u8 image (per channel plane), bit-exact
+    against ``op(img)``: golden pad2d edge extension, banded-matmul
+    accumulation, golden finalize (quantize + interior mask)."""
+
+    def plane(x: jnp.ndarray) -> jnp.ndarray:
+        hh, ww = x.shape
+        h = op.halo
+        xpad = pad2d(exact_f32(x), op.edge_mode, h, h, h, h)
+        acc = mxu_valid(op, xpad, mode=mode, col_variant=col_variant)
+        return op.finalize(acc, x, 0, 0, hh, ww)
+
+    if img.ndim == 3:
+        return jnp.stack(
+            [plane(img[..., c]) for c in range(img.shape[2])], axis=-1
+        )
+    return plane(img)
+
+
+def pipeline_mxu(
+    ops,
+    img: jnp.ndarray,
+    *,
+    mode: str | None = None,
+    interpret: bool | None = None,
+    block_h: int | None = None,
+):
+    """Run a full pipeline with eligible stencils on the MXU banded path
+    and everything else on its golden op (per-op fallback — always
+    correct, the same contract as pipeline_swar). The whole chain is one
+    XLA program under jit, so pointwise prefixes run on the VPU and fuse
+    into the same launch as the MXU contraction — the hybrid
+    pointwise/stencil split happens by construction.
+
+    `interpret`/`block_h` are accepted for backend-API parity and
+    ignored: the MXU path is pure XLA (no Pallas kernel to interpret, no
+    row-block knob)."""
+    del interpret, block_h
+    mode = mode or mxu_mode()
+    state = img
+    for op in ops:
+        if isinstance(op, StencilOp) and mxu_eligible(op):
+            state = mxu_stencil(op, state, mode=mode)
+        else:
+            state = op(state)
+    return state
+
+
+# --------------------------------------------------------------------------
+# Auto routing
+# --------------------------------------------------------------------------
+
+
+def use_mxu_for_stencil(op: Op, width: int | None = None) -> str | None:
+    """Auto-routing decision for one stencil group: the MXU mode to run
+    ('banded'/'hybrid') or None to stay on the VPU/XLA paths.
+
+    Routes only when ALL of: the op family has a proven identity
+    (mxu_eligible), the live backend is a real TPU (no-MXU platforms
+    always fall through, bit-exactly), and either MCIM_PREFER_MXU=1 (the
+    A/B switch) or the calibration store records a measured win for
+    (op family, device kind, width window) — `mcim-tpu autotune
+    --dimension backend`. Shared by pipeline_auto, the sharded runner and
+    the serving executor so the auto paths cannot drift."""
+    if not isinstance(op, StencilOp) or not mxu_eligible(op):
+        return None
+    if not is_tpu_backend():
+        return None
+    if prefer_mxu():
+        return mxu_mode()
+    choice = calibration.lookup_backend_choice(mxu_family(op), width=width)
+    if choice == "mxu":
+        return "banded"
+    if choice == "hybrid":
+        return "hybrid"
+    return None

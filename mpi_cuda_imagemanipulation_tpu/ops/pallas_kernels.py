@@ -967,6 +967,24 @@ def pipeline_auto(
     swar = prefer_swar()
     for pointwise, stencil in group_ops(ops):
         n_ch = state.shape[2] if state.ndim == 3 else 1
+        # MXU banded-matmul routing (round-6 promotion): checked first —
+        # it only fires behind a measured per-device-kind calibration win
+        # (or the MCIM_PREFER_MXU A/B switch) and never off-TPU, so the
+        # default auto behaviour is unchanged (ops/mxu_kernels.py). The
+        # pointwise prologue runs on the VPU via its golden fn and fuses
+        # into the same XLA launch as the MXU contraction.
+        if stencil is not None:
+            from mpi_cuda_imagemanipulation_tpu.ops.mxu_kernels import (
+                mxu_stencil,
+                use_mxu_for_stencil,
+            )
+
+            mxu_mode_choice = use_mxu_for_stencil(stencil, state.shape[1])
+            if mxu_mode_choice is not None:
+                for op in pointwise:
+                    state = op(state)
+                state = mxu_stencil(stencil, state, mode=mxu_mode_choice)
+                continue
         # The SWAR promotion switch is checked BEFORE the u8-Pallas gate:
         # use_pallas_for_stencil rejects cheap halo-1 stencils (XLA wins
         # there for u8), but the corr2d SWAR family is mostly halo-1

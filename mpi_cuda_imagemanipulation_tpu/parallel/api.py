@@ -150,13 +150,28 @@ def _prefer_swar() -> bool:
     return prefer_swar()
 
 
-def _resolve_backend(op: StencilOp, backend: str) -> str:
+def _resolve_backend(op: StencilOp, backend: str, width: int | None = None) -> str:
+    if backend == "mxu":
+        # explicit MXU backend: eligible ops take the banded-matmul path,
+        # everything else falls back to the u8 Pallas tile kernel — the
+        # same per-op always-correct contract as impl='swar'
+        from mpi_cuda_imagemanipulation_tpu.ops.mxu_kernels import mxu_eligible
+
+        return "mxu" if mxu_eligible(op) else "pallas"
     if backend != "auto":
         return backend
+    from mpi_cuda_imagemanipulation_tpu.ops.mxu_kernels import (
+        use_mxu_for_stencil,
+    )
     from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
         use_pallas_for_stencil,
     )
 
+    # MXU routing first (mirrors pipeline_auto): fires only behind a
+    # measured per-device-kind calibration win or MCIM_PREFER_MXU, and
+    # never off-TPU
+    if use_mxu_for_stencil(op, width) is not None:
+        return "mxu"
     # the sharded ext path runs the stencil kernel per channel plane,
     # hence group_in_channels=1
     return "pallas" if use_pallas_for_stencil(op, 1) else "xla"
@@ -175,7 +190,7 @@ def _apply_stencil(
     and the XLA backend). The Pallas fast path is the fused-ghost group in
     _apply_group_fused, selected by _run_segment's group walker."""
     h = op.halo
-    backend = _resolve_backend(op, backend)
+    backend = _resolve_backend(op, backend, global_w)
     if backend == "swar":
         # the materialised-ext fallback has no swar variant (it exists for
         # pad rows / tiny tiles where throughput is moot); use the u8
@@ -289,7 +304,7 @@ def _apply_stencil_overlap(
     """
     h = op.halo
     local_h = tile.shape[0]
-    backend = _resolve_backend(op, backend)
+    backend = _resolve_backend(op, backend, global_w)
     if backend == "swar":
         backend = "pallas"  # same mapping as the materialised-ext path
     top, bottom = _fix_edge_strips(strips[0], strips[1], tile, op, y0, global_h)
@@ -438,6 +453,16 @@ def _stencil_on_ext(
 ) -> jnp.ndarray:
     """Run one stencil over a single (local_h + 2h, W) pre-exchanged plane."""
     h = op.halo
+    if backend == "mxu":
+        # banded-matmul accumulation on the (row-exchanged) tile: pad the
+        # width per the op's edge mode (row halo is already materialised),
+        # contract on the MXU, replay the golden finalize at global
+        # coordinates — bit-identical to the XLA branch below
+        from mpi_cuda_imagemanipulation_tpu.ops.mxu_kernels import mxu_valid
+
+        xpad = pad2d(ext.astype(F32), op.edge_mode, 0, 0, h, h)
+        acc = mxu_valid(op, xpad)
+        return op.finalize(acc, tile, y0, 0, global_h, global_w)
     if backend == "pallas":
         from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
             stencil_tile_pallas,
@@ -635,12 +660,22 @@ def _run_segment(
                 # matters: a 3-channel prologue forces planar form, where
                 # XLA measured faster for cheap halo-1 stencils).
                 if backend == "auto":
+                    from mpi_cuda_imagemanipulation_tpu.ops.mxu_kernels import (
+                        use_mxu_for_stencil,
+                    )
                     from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
                         use_pallas_for_stencil,
                     )
 
                     group_in = tile.shape[2] if tile.ndim == 3 else 1
                     use_pallas = use_pallas_for_stencil(op, group_in)
+                    if use_mxu_for_stencil(op, global_w) is not None:
+                        # calibration-won MXU group: skip the fused-ghost
+                        # Pallas path so the materialised-ext runner below
+                        # resolves auto -> mxu (the flushed pointwise
+                        # prologue stays XLA and fuses into the same
+                        # program as the banded contraction)
+                        use_pallas = False
                 else:
                     use_pallas = backend in ("pallas", "swar")
                 fusible = (
@@ -692,7 +727,7 @@ def sharded_pipeline(
     sub-2*halo tiles) fall back to the serial paths, so the output
     contract is unchanged.
     """
-    if backend not in ("xla", "pallas", "swar", "auto"):
+    if backend not in ("xla", "pallas", "swar", "mxu", "auto"):
         raise ValueError(f"unknown backend {backend!r}")
     if halo_mode not in HALO_MODES:
         raise ValueError(
@@ -715,6 +750,17 @@ def sharded_pipeline(
         # to multi-chip); the swar kernels are pallas_calls too
         any_pallas = try_swar or any(
             isinstance(op, StencilOp) and use_pallas_for_stencil(op, 1)
+            for op in pipe.ops
+        )
+    elif backend == "mxu":
+        # the MXU path itself is pure XLA (vma checker can stay on), but
+        # ineligible stencils fall back to the u8 Pallas tile kernel
+        from mpi_cuda_imagemanipulation_tpu.ops.mxu_kernels import (
+            mxu_eligible,
+        )
+
+        any_pallas = any(
+            isinstance(op, StencilOp) and not mxu_eligible(op)
             for op in pipe.ops
         )
     else:

@@ -106,7 +106,9 @@ def _ext_ids(n_ext: int, halo: int, true_n, bucket_n: int, edge_mode: str):
     return jnp.minimum(idx, bucket_n - 1)  # safety for the unread tail
 
 
-def _stencil_plane(op: StencilOp, x: jnp.ndarray, th, tw) -> jnp.ndarray:
+def _stencil_plane(
+    op: StencilOp, x: jnp.ndarray, th, tw, backend: str = "xla"
+) -> jnp.ndarray:
     h = op.halo
     bh, bw = x.shape
     xf = x.astype(F32)  # same cast as StencilOp._apply2d
@@ -118,19 +120,53 @@ def _stencil_plane(op: StencilOp, x: jnp.ndarray, th, tw) -> jnp.ndarray:
         cc = jnp.arange(bw + 2 * h, dtype=jnp.int32) - h
         inside = ((rr >= 0) & (rr < th))[:, None] & ((cc >= 0) & (cc < tw))[None, :]
         xpad = jnp.where(inside, xpad, jnp.float32(0.0))
-    acc = op.valid(xpad)
+    if backend == "mxu":
+        # the banded-matmul path is a drop-in for op.valid on the SAME
+        # gathered window array (static bucket shape, dynamic true border
+        # realised in the data), so it serves exactly what the Pallas
+        # streaming kernels cannot: bit-identical bucket-padded compute
+        # with the tap contraction on the MXU (ops/mxu_kernels.py)
+        from mpi_cuda_imagemanipulation_tpu.ops.mxu_kernels import mxu_valid
+
+        acc = mxu_valid(op, xpad)
+    else:
+        acc = op.valid(xpad)
     # dynamic global extent: the interior guard masks in TRUE coordinates
     return op.finalize(acc, x, 0, 0, th, tw)
 
 
-def _apply_stencil(op: StencilOp, x: jnp.ndarray, th, tw) -> jnp.ndarray:
+def _stencil_backend(op: StencilOp, backend: str, bucket_w: int) -> str:
+    """Per-op serving backend: 'mxu' routes eligible families to the
+    banded-matmul contraction (golden fallback otherwise); 'auto' follows
+    the shared calibration-gated routing decision (never off-TPU)."""
+    if backend == "mxu":
+        from mpi_cuda_imagemanipulation_tpu.ops.mxu_kernels import mxu_eligible
+
+        return "mxu" if mxu_eligible(op) else "xla"
+    if backend == "auto":
+        from mpi_cuda_imagemanipulation_tpu.ops.mxu_kernels import (
+            use_mxu_for_stencil,
+        )
+
+        if use_mxu_for_stencil(op, bucket_w) is not None:
+            return "mxu"
+    return "xla"
+
+
+def _apply_stencil(
+    op: StencilOp, x: jnp.ndarray, th, tw, backend: str = "xla"
+) -> jnp.ndarray:
     _check_channels(op.name, op.in_channels, x)  # same gate as op.__call__
+    be = _stencil_backend(op, backend, x.shape[1])
     if x.ndim == 3:
         return jnp.stack(
-            [_stencil_plane(op, x[..., c], th, tw) for c in range(x.shape[2])],
+            [
+                _stencil_plane(op, x[..., c], th, tw, be)
+                for c in range(x.shape[2])
+            ],
             axis=-1,
         )
-    return _stencil_plane(op, x, th, tw)
+    return _stencil_plane(op, x, th, tw, be)
 
 
 def _apply_global(op: GlobalOp, x: jnp.ndarray, th, tw) -> jnp.ndarray:
@@ -144,12 +180,14 @@ def _apply_global(op: GlobalOp, x: jnp.ndarray, th, tw) -> jnp.ndarray:
     return op.apply(x, op.stats(x, valid))
 
 
-def padded_apply(pipe: Pipeline, x: jnp.ndarray, th, tw) -> jnp.ndarray:
+def padded_apply(
+    pipe: Pipeline, x: jnp.ndarray, th, tw, backend: str = "xla"
+) -> jnp.ndarray:
     """The pipeline over one bucket-shaped u8 image with dynamic true shape
     (th, tw). Output is bucket-shaped; only [:th, :tw] is meaningful."""
     for op in pipe.ops:
         if isinstance(op, StencilOp):
-            x = _apply_stencil(op, x, th, tw)
+            x = _apply_stencil(op, x, th, tw, backend)
         elif isinstance(op, GlobalOp):
             x = _apply_global(op, x, th, tw)
         elif isinstance(op, PointwiseOp):
@@ -182,13 +220,17 @@ def make_serving_fn(
     cache counts traces with it to prove warmup covered the shape grid.
 
     The padded executor is built from the golden jnp tile functions and is
-    fused by XLA; `backend` documents that contract ('xla' only — the Pallas
-    streaming kernels extend edges at the *bucket* border by design, which
-    is exactly what padding must not do)."""
-    if backend != "xla":
+    fused by XLA. `backend` selects the stencil accumulation: 'xla' (the
+    golden op.valid), 'mxu' (banded-matmul contraction on the matrix unit
+    for eligible families — bit-identical, since it is a drop-in for
+    op.valid on the same gathered window array), or 'auto' (the shared
+    calibration-gated MXU routing). The Pallas streaming kernels remain
+    unservable by design: they extend edges at the *bucket* border, which
+    is exactly what padding must not do."""
+    if backend not in ("xla", "mxu", "auto"):
         raise ValueError(
-            f"serving computes with the XLA backend (got {backend!r}); "
-            "see make_serving_fn docstring"
+            f"serving computes with the XLA or MXU backends (got "
+            f"{backend!r}); see make_serving_fn docstring"
         )
     check_servable(pipe)
     if mesh is not None and batch % mesh.devices.size:
@@ -200,7 +242,9 @@ def make_serving_fn(
     def batched(imgs, th, tw):
         if on_trace is not None:
             on_trace()  # python side effect => fires once per (re)trace
-        return jax.vmap(lambda i, h, w: padded_apply(pipe, i, h, w))(imgs, th, tw)
+        return jax.vmap(
+            lambda i, h, w: padded_apply(pipe, i, h, w, backend)
+        )(imgs, th, tw)
 
     if mesh is None:
         return jax.jit(batched)

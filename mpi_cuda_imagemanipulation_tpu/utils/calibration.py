@@ -151,13 +151,96 @@ def record_block_h(
     Atomic (tmp file + rename) so a concurrent reader never sees a torn
     JSON; other kinds' and impls' entries are preserved.
     """
-    path = calib_path()
+    data, kind_rec = _kind_record(device_kind)
+    kind_rec[impl] = {"block_h": int(block_h), **extra}
+    return _write_store(data)
+
+
+# --------------------------------------------------------------------------
+# Backend-choice calibration (the VPU-vs-MXU autotune dimension)
+#
+# `mcim-tpu autotune --dimension backend` measures the VPU (Pallas
+# streaming), MXU banded and hybrid formulations of each eligible stencil
+# family on the live chip and records the winner here, keyed by device
+# kind and op family (ops/mxu_kernels.mxu_family). `backend='auto'`
+# routes a stencil group to the MXU ONLY behind such a measured win (or
+# the MCIM_PREFER_MXU A/B switch) — and never off-TPU, so a platform
+# without an MXU always takes the VPU/XLA paths. The same width window
+# rule as block heights applies: a choice swept at 8K must not steer a
+# 1080p run (block-vs-row-length tradeoffs differ; factor-of-two window).
+# --------------------------------------------------------------------------
+
+_BACKEND_KEY = "backend_choice"
+BACKEND_CHOICES = ("vpu", "mxu", "hybrid")
+
+
+def lookup_backend_choice(
+    family: str | None,
+    device_kind: str | None = None,
+    width: int | None = None,
+) -> str | None:
+    """Calibrated backend for (op family, device kind), if any: 'vpu',
+    'mxu' or 'hybrid'. None when no (valid, width-compatible) entry
+    exists or MCIM_NO_CALIB is set — callers then keep their default
+    (VPU/XLA) routing."""
+    if family is None or os.environ.get(_ENV_DISABLE):
+        return None
+    if device_kind is None:
+        try:
+            device_kind = current_device_kind()
+        except Exception:
+            return None
+    rec = entries().get(device_kind)
+    if not isinstance(rec, dict):
+        return None
+    table = rec.get(_BACKEND_KEY)
+    if not isinstance(table, dict):
+        return None
+    ent = table.get(family)
+    if not isinstance(ent, dict):
+        return None
+    rec_w = ent.get("width")
+    if (
+        width is not None
+        and isinstance(rec_w, (int, float))
+        and rec_w > 0
+        and not (rec_w / 2 <= width <= rec_w * 2)
+    ):
+        return None
+    choice = ent.get("choice")
+    return choice if choice in BACKEND_CHOICES else None
+
+
+def record_backend_choice(
+    device_kind: str, family: str, choice: str, **extra
+) -> str:
+    """Write/replace the (device kind, op family) backend choice; returns
+    the store path. Same atomic-write contract as record_block_h."""
+    if choice not in BACKEND_CHOICES:
+        raise ValueError(
+            f"unknown backend choice {choice!r}; known: {BACKEND_CHOICES}"
+        )
+    data, kind_rec = _kind_record(device_kind)
+    table = kind_rec.setdefault(_BACKEND_KEY, {})
+    if not isinstance(table, dict):  # legacy/corrupt entry: replace
+        table = kind_rec[_BACKEND_KEY] = {}
+    table[family] = {"choice": choice, **extra}
+    return _write_store(data)
+
+
+def _kind_record(device_kind: str) -> tuple[dict, dict]:
+    """(whole store, mutable per-device-kind record) — the caller mutates
+    the record and hands the store back to _write_store."""
     data = _load()
     kinds = data.setdefault("device_kinds", {})
     kind_rec = kinds.setdefault(device_kind, {})
     if not isinstance(kind_rec, dict):  # legacy/corrupt entry: replace
         kind_rec = kinds[device_kind] = {}
-    kind_rec[impl] = {"block_h": int(block_h), **extra}
+    return data, kind_rec
+
+
+def _write_store(data: dict) -> str:
+    path = calib_path()
     d = os.path.dirname(path) or "."
     fd, tmp = tempfile.mkstemp(dir=d, prefix=".mcim_calib_")
     try:

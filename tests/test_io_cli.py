@@ -626,6 +626,53 @@ def test_bench_promotion_carries_sha_and_fresh_value(tmp_path):
         assert got["head_git_sha"] == mod.git_head_sha()
 
 
+def test_bench_promotion_staleness_commits(tmp_path, monkeypatch):
+    """A promoted committed record carries staleness_commits (the distance
+    from the commit that measured it to HEAD) and warns loudly past the
+    threshold — the round-5 headline was measured 9 commits before HEAD
+    and nothing flagged it (ISSUE r6 satellite)."""
+    mod = _load_bench_module()
+    monkeypatch.setattr(mod, "git_head_sha", lambda: "headsha")
+    monkeypatch.setattr(mod, "git_commits_between", lambda a, b: 9)
+    same = {
+        "ts": "2026-07-31T01:02:00Z",
+        "git_sha": "feedbee",
+        "headline": {"platform": "tpu", "value": 37667.3,
+                     "unit": "MP/s/chip", "impl": "pallas"},
+    }
+    got = mod._promote_committed(same, [])
+    assert got["staleness_commits"] == 9
+    assert "9 commits behind" in got["staleness_warning"]
+    # at/below the threshold: the count is emitted, no warning attached
+    monkeypatch.setattr(
+        mod, "git_commits_between",
+        lambda a, b: mod.STALENESS_WARN_COMMITS,
+    )
+    got = mod._promote_committed(same, [])
+    assert got["staleness_commits"] == mod.STALENESS_WARN_COMMITS
+    assert "staleness_warning" not in got
+    # git unable to answer (shallow clone / unknown SHA): field omitted
+    monkeypatch.setattr(mod, "git_commits_between", lambda a, b: None)
+    got = mod._promote_committed(same, [])
+    assert "staleness_commits" not in got
+    # entries predating the SHA stamping: no measured sha, no field
+    got = mod._promote_committed(
+        {"ts": same["ts"], "headline": dict(same["headline"])}, []
+    )
+    assert "staleness_commits" not in got
+
+
+def test_bench_git_commits_between(monkeypatch):
+    """The distance helper: 0 for identical SHAs without spawning git, a
+    real count inside this checkout, None for garbage input."""
+    mod = _load_bench_module()
+    assert mod.git_commits_between("abc", "abc") == 0
+    head = mod.git_head_sha()
+    if head is not None:
+        assert mod.git_commits_between(head, head) == 0
+        assert mod.git_commits_between("not-a-sha", head) is None
+
+
 def test_xla_bridge_probe_api_exists():
     """utils.platform._backends_initialized probes jax internals and fails
     open; if a jax upgrade removes BOTH probe points the count-change guard

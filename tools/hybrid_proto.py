@@ -1,6 +1,12 @@
 #!/usr/bin/env python
 """Hybrid SWAR end-to-end candidates for the headline 5x5 Gaussian.
 
+SUPERSEDED (round 6): the split-design question graduated into the
+production MXU backend's ``hybrid`` mode (VPU row pass + MXU column pass,
+one fused launch — ops/mxu_kernels.py), measured by ``bench_suite
+--config mxu_ab`` (tools/tpu_queue/23_mxu_prod_r06.sh). Kept for
+historical re-runs of the SWAR pack/compute split.
+
 Round-5 window data (artifacts/swar_proto_r05.out, roofline_rr_r05.out):
 
   swar_xla_prepacked       0.230 ms   (144k MP/s — compute alone)

@@ -1,6 +1,12 @@
 #!/usr/bin/env python
 """MXU banded-matmul prototype for the headline 5x5 Gaussian.
 
+SUPERSEDED (round 6): this design graduated into the production backend
+``ops/mxu_kernels.py`` (``impl='mxu'``, auto routing, sharded + serving
+wiring) with the same identities pytest-gated in tests/test_mxu_backend.py;
+the production A/B lane is ``bench_suite --config mxu_ab``
+(tools/tpu_queue/23_mxu_prod_r06.sh). Kept for historical re-runs.
+
 Round-5 roofline data (artifacts/roofline_rr_r05.out) killed the
 element-rate-ceiling theory: Pallas u8 copy kernels sustain ~550 GB/s, so
 the production u8 compute kernel (~91 GB/s effective, 45.9k MP/s) is

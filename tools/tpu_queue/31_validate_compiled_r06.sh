@@ -1,0 +1,25 @@
+#!/bin/bash
+# Targeted compiled-validation lane (round 6): the wide-backend sweep —
+# SWAR quarter-strip AND the promoted MXU banded-matmul backend — as a
+# SHORT step at the front of the window, before the long full sweep
+# (30_*). Closes the compiled-validation hole the round-5 window exposed:
+# the compiled-only miscompare class (the one that demoted the packed
+# backend) must be caught by the queue, not discovered on silicon by
+# accident after a long sweep wedges mid-run. Covers: sharded SWAR ghost
+# kernels, the SWAR proto carry kernel, the full swar_prod matrix, the
+# MXU backend in both modes (banded + hybrid) and both column-pass
+# variants across ragged shapes, sharded MXU on mesh(1), and the serving
+# bucket-padded executor with the MXU contraction at a dynamic true
+# shape.
+# Budget: ~3-6 min warm, ~10-15 min cold.
+set -u
+cd "$(dirname "$0")/../.."
+. tools/tpu_queue/_lib.sh
+timeout 1800 python tools/tpu_validate.py --lane mxu_swar \
+  --out VALIDATE_MXU_r06.json > artifacts/validate_mxu_r06.out 2>&1
+rc=$?
+arts=(artifacts/validate_mxu_r06.out)
+[ -f VALIDATE_MXU_r06.json ] && arts+=(VALIDATE_MXU_r06.json)
+commit_artifacts "TPU window: compiled wide-backend validation lane (round 6)" \
+  "${arts[@]}"
+exit $rc

@@ -113,6 +113,23 @@ PACKED_SPECS = [
 SHAPES = [(129, 517), (40, 300), (257, 1024), (96, 2048), (65, 140)]
 QUICK_SHAPES = [(129, 517), (65, 140)]
 
+# MXU banded-matmul backend (ops/mxu_kernels.py, round 6): one spec per
+# routed formulation class plus chains with per-op fallbacks. Shapes come
+# from the sweep's shape list (ragged widths/heights, sub-block planes).
+MXU_SPECS = [
+    ("gaussian:5", 1, 101),  # sep5, the headline (64a+b split)
+    ("gaussian:7", 1, 102),  # sep7, S=64 — the split's boundary case
+    ("box:5", 1, 103),  # non-power-of-two scale replay
+    ("emboss:5", 1, 104),  # corr5x5, interior guard
+    ("emboss101:5", 1, 105),  # corr5x5, reflect101 + rint
+    ("sobel", 1, 106),  # grad3x3 magnitude replay
+    ("scharr", 1, 107),  # grad3x3, squares past 2^24 (fma replay)
+    ("unsharp", 1, 108),  # corr5x5, 476-weight bf16-exactness case
+    ("grayscale,contrast:3.5,emboss:3", 3, 109),  # VPU prefix + MXU body
+    ("invert,gaussian:5,threshold:99", 1, 110),  # pre+post pointwise
+    ("median:3,gaussian:5", 1, 111),  # per-op fallback mix
+]
+
 # Known compiled-mode miscompares of the ARCHIVED packed backend on planes
 # narrower than one 128-lane tile, exactly as the round-5 hardware sweep
 # recorded them (artifacts/validate_r05.out — the finding that demoted the
@@ -251,21 +268,6 @@ def run_sweep(shapes, results) -> int:
             lambda: pipe.sharded(mesh, backend="pallas")(img),
         )
 
-    # quarter-strip SWAR ghost path on the 1-device mesh: compiles the
-    # sharded swar kernels (separable + corr2d + fused chain) with Mosaic
-    for spec, ch, sseed in (
-        ("contrast:3.5,gaussian:5", 1, 61),
-        ("grayscale,contrast:3.5,emboss:3", 3, 62),
-    ):
-        pipe = Pipeline.parse(spec)
-        hw = (128, 256)
-        img = jnp.asarray(synthetic_image(*hw, channels=ch, seed=sseed))
-        fails += not _check(
-            results, "sharded_swar", spec, ch, hw,
-            lambda: golden_of(pipe.ops, img),
-            lambda: pipe.sharded(mesh, backend="swar")(img),
-        )
-
     # 2-D tile runner (parallel/api2d) on a 1x1 device mesh: both
     # ppermute-free exchange paths + axis-general edge fixups get a
     # compiled silicon run without a pod (same rationale as the 1-D
@@ -283,6 +285,81 @@ def run_sweep(shapes, results) -> int:
             lambda: pipe.sharded(mesh2)(img),
         )
 
+    fails += run_wide_backends_sweep(shapes, results)
+
+    from mpi_cuda_imagemanipulation_tpu.utils.guard import run_guarded
+
+    for spec, ch, impl in GUARDED_CASES:
+        pipe = Pipeline.parse(spec)
+        hw = shapes[0]
+        img_np = synthetic_image(*hw, channels=ch, seed=23)
+        timings: dict = {}
+        fails += not _check(
+            results, "guarded", spec, ch, hw,
+            lambda: golden_of(pipe.ops, jnp.asarray(img_np)),
+            lambda: run_guarded(
+                spec, img_np, 900.0, impl=impl, timings=timings
+            ),
+        )
+        if timings:
+            results[-1]["steady_ms"] = round(
+                timings.get("steady_s", 0.0) * 1e3, 3
+            )
+            print(
+                f"     guarded timings: compile+run "
+                f"{timings.get('compile_and_run_s', 0):.2f}s, steady "
+                f"{timings.get('steady_s', 0) * 1e3:.2f}ms",
+                flush=True,
+            )
+
+    print("FAILS:", fails, flush=True)
+    return fails
+
+
+def run_wide_backends_sweep(shapes, results) -> int:
+    """Compiled-mode sweep of the promoted wide backends — SWAR
+    quarter-strip AND the MXU banded-matmul path (round 6) — runnable as
+    its own queue lane (`--lane mxu_swar`,
+    tools/tpu_queue/31_validate_compiled_r06.sh) so the compiled-only
+    miscompare class that demoted the packed backend (and wedged the
+    round-5 sweep mid-run) is caught by a short targeted step early in a
+    window rather than on silicon by accident. On TPU every case runs the
+    real Mosaic/XLA lowering; off-TPU the Pallas pieces interpret and the
+    MXU einsums still compile (they are pure XLA)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mpi_cuda_imagemanipulation_tpu.io.image import synthetic_image
+    from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline
+    from mpi_cuda_imagemanipulation_tpu.parallel.mesh import make_mesh
+    from mpi_cuda_imagemanipulation_tpu.utils.platform import is_tpu_backend
+
+    fails = 0
+
+    def golden_of(ops, img):
+        out = img
+        for op in ops:
+            out = op(out)
+        return out
+
+    mesh = make_mesh(1)
+    _interp = not is_tpu_backend()
+
+    # quarter-strip SWAR ghost path on the 1-device mesh: compiles the
+    # sharded swar kernels (separable + corr2d + fused chain) with Mosaic
+    for spec, ch, sseed in (
+        ("contrast:3.5,gaussian:5", 1, 61),
+        ("grayscale,contrast:3.5,emboss:3", 3, 62),
+    ):
+        pipe = Pipeline.parse(spec)
+        hw = (128, 256)
+        img = jnp.asarray(synthetic_image(*hw, channels=ch, seed=sseed))
+        fails += not _check(
+            results, "sharded_swar", spec, ch, hw,
+            lambda: golden_of(pipe.ops, img),
+            lambda: pipe.sharded(mesh, backend="swar")(img),
+        )
+
     # SWAR quarter-strip carry kernel (tools/swar_proto.py), compiled: the
     # Mosaic lowering of the u32 field algebra gets a hardware record even
     # before the timing step runs
@@ -297,9 +374,6 @@ def run_sweep(shapes, results) -> int:
     _pack, _unpack, _, _mk = _swar.build_fns()
     import numpy as _np
 
-    from mpi_cuda_imagemanipulation_tpu.utils.platform import is_tpu_backend
-
-    _interp = not is_tpu_backend()
     for sh, sbh in ((129, 32), (96, 48)):
         simg = jnp.asarray(synthetic_image(sh, 128, channels=1, seed=31))
         spipe = Pipeline.parse("gaussian:5")
@@ -344,32 +418,74 @@ def run_sweep(shapes, results) -> int:
             lambda: pipeline_swar(pipe.ops, simg2, interpret=_interp),
         )
 
-    from mpi_cuda_imagemanipulation_tpu.utils.guard import run_guarded
+    # production MXU banded-matmul backend (ops/mxu_kernels.py, round 6):
+    # every routed formulation class — separable banded (64a+b split),
+    # one-einsum corr2d, magnitude combine — in both execution modes,
+    # over ragged shapes incl. sub-block planes. The bf16 MXU lowering is
+    # exactly what interpret-free CPU runs cannot prove.
+    from mpi_cuda_imagemanipulation_tpu.ops.mxu_kernels import pipeline_mxu
 
-    for spec, ch, impl in GUARDED_CASES:
+    for spec, ch, seed in MXU_SPECS:
+        pipe = Pipeline.parse(spec)
+        for hw in shapes[:3]:
+            mimg = jnp.asarray(synthetic_image(*hw, channels=ch, seed=seed))
+            for mode in ("banded", "hybrid"):
+                fails += not _check(
+                    results, f"mxu_{mode}", spec, ch, hw,
+                    lambda: golden_of(pipe.ops, mimg),
+                    lambda: jax.jit(
+                        lambda x: pipeline_mxu(pipe.ops, x, mode=mode)
+                    )(mimg),
+                )
+
+    # f32 column-pass variant (the A/B alternative to the 64a+b split)
+    saved_col = os.environ.get("MCIM_MXU_COL")
+    os.environ["MCIM_MXU_COL"] = "f32"
+    try:
+        for spec in ("gaussian:5", "gaussian:7"):
+            pipe = Pipeline.parse(spec)
+            hw = shapes[0]
+            fimg = jnp.asarray(synthetic_image(*hw, channels=1, seed=71))
+            fails += not _check(
+                results, "mxu_f32col", spec, 1, hw,
+                lambda: golden_of(pipe.ops, fimg),
+                lambda: jax.jit(lambda x: pipeline_mxu(pipe.ops, x))(fimg),
+            )
+    finally:
+        if saved_col is None:
+            os.environ.pop("MCIM_MXU_COL", None)
+        else:
+            os.environ["MCIM_MXU_COL"] = saved_col
+
+    # sharded MXU on the 1-device mesh (materialised-ext + banded einsum,
+    # global-coordinate finalize) and the serving bucket-padded executor
+    # with the MXU contraction at a ragged dynamic true shape
+    for spec, ch in (("gaussian:5", 1), ("grayscale,contrast:3.5,emboss:3", 3)):
         pipe = Pipeline.parse(spec)
         hw = shapes[0]
-        img_np = synthetic_image(*hw, channels=ch, seed=23)
-        timings: dict = {}
+        img = jnp.asarray(synthetic_image(*hw, channels=ch, seed=81))
         fails += not _check(
-            results, "guarded", spec, ch, hw,
-            lambda: golden_of(pipe.ops, jnp.asarray(img_np)),
-            lambda: run_guarded(
-                spec, img_np, 900.0, impl=impl, timings=timings
-            ),
+            results, "sharded_mxu", spec, ch, hw,
+            lambda: golden_of(pipe.ops, img),
+            lambda: pipe.sharded(mesh, backend="mxu")(img),
         )
-        if timings:
-            results[-1]["steady_ms"] = round(
-                timings.get("steady_s", 0.0) * 1e3, 3
-            )
-            print(
-                f"     guarded timings: compile+run "
-                f"{timings.get('compile_and_run_s', 0):.2f}s, steady "
-                f"{timings.get('steady_s', 0) * 1e3:.2f}ms",
-                flush=True,
-            )
 
-    print("FAILS:", fails, flush=True)
+    spipe = Pipeline.parse("gaussian:5")
+    th, tw = 113, 201
+    timg = _np.zeros((1, 128, 256), _np.uint8)
+    true_img = synthetic_image(th, tw, channels=1, seed=91)
+    timg[0, :th, :tw] = true_img
+    serve_fn = spipe.serving(128, 256, 1, 1, backend="mxu")
+    fails += not _check(
+        results, "serve_mxu", "gaussian:5", 1, (th, tw),
+        lambda: golden_of(spipe.ops, jnp.asarray(true_img)),
+        lambda: serve_fn(
+            jnp.asarray(timg),
+            jnp.asarray([th], jnp.int32),
+            jnp.asarray([tw], jnp.int32),
+        )[0, :th, :tw],
+    )
+
     return fails
 
 
@@ -383,6 +499,16 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--bench", action="store_true")
     ap.add_argument("--quick", action="store_true")
+    ap.add_argument(
+        "--lane",
+        choices=("all", "mxu_swar"),
+        default="all",
+        help="'mxu_swar' runs only the wide-backend compiled sweep (the "
+        "SWAR quarter-strip + MXU banded-matmul lanes) — a short "
+        "targeted step for the front of a chip window, so compiled-only "
+        "miscompares in the promoted backends are caught before the "
+        "long full sweep (tools/tpu_queue/31_validate_compiled_r06.sh)",
+    )
     ap.add_argument("--out", default="VALIDATE.json", help="JSON artifact path")
     args = ap.parse_args()
     import jax
@@ -392,12 +518,18 @@ def main() -> int:
     print("backend:", platform, devices, flush=True)
     results: list[dict] = []
     t0 = time.time()
-    fails = run_sweep(QUICK_SHAPES if args.quick else SHAPES, results)
+    shapes = QUICK_SHAPES if args.quick else SHAPES
+    if args.lane == "mxu_swar":
+        fails = run_wide_backends_sweep(shapes, results)
+        print("FAILS:", fails, flush=True)
+    else:
+        fails = run_sweep(shapes, results)
     artifact = {
         "platform": platform,
         "devices": devices,
         "interpret": False if platform == "tpu" else True,
         "quick": bool(args.quick),
+        "lane": args.lane,
         "total_cases": len(results),
         "fails": fails,
         "wall_seconds": round(time.time() - t0, 1),
