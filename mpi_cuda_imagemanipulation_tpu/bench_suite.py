@@ -725,12 +725,22 @@ def run_serve_loadgen(
     MCIM_SERVE_FAULT_RATE) the sweep runs with that injected transient
     dispatch-failure rate and the table gains availability columns
     (success %, retried %). One record, `sweep` inside."""
+    from mpi_cuda_imagemanipulation_tpu.obs import trace as obs_trace
     from mpi_cuda_imagemanipulation_tpu.serve import loadgen
     from mpi_cuda_imagemanipulation_tpu.serve.server import ServeApp, ServeConfig
 
     p = serve_loadgen_params()
     if fault_rate is not None:
         p["fault_rate"] = fault_rate
+    # MCIM_TRACE_OUT: run the sweep traced (sample from MCIM_TRACE_SAMPLE,
+    # default every request) and export the span timeline — per-rate
+    # records then carry slowest_traces/failed_traces ids to pull p99
+    # outliers up by id (serve/loadgen.py; the CI obs smoke lane uses this)
+    trace_out = os.environ.get("MCIM_TRACE_OUT")
+    if trace_out:
+        obs_trace.configure(
+            sample=float(os.environ.get(obs_trace.ENV_SAMPLE, "1.0"))
+        )
     app = ServeApp(
         ServeConfig(
             ops=p["ops"],
@@ -764,6 +774,9 @@ def run_serve_loadgen(
         "cache": app.cache.stats(),
         "sweep": sweep,
     }
+    if trace_out:
+        rec["trace_out"] = trace_out
+        rec["trace_events"] = obs_trace.export(trace_out)
     printer(
         f"{'offered rps':>11s} {'achieved':>9s} {'ok%':>6s} {'shed%':>6s} "
         f"{'retry%':>6s} {'occup':>6s} "

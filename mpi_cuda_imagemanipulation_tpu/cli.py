@@ -9,9 +9,10 @@ Usage:
   python -m mpi_cuda_imagemanipulation_tpu run --input in.png --output out.png
       [--ops grayscale,contrast:3.5,emboss:3] [--impl xla|pallas]
       [--shards N] [--device cpu|tpu] [--show-timing] [--json-metrics PATH|-]
-      [--profile-dir DIR]
+      [--profile-dir DIR] [--trace-out T.json] [--trace-sample F]
   python -m mpi_cuda_imagemanipulation_tpu serve [--ops ...] [--buckets ...]
       [--max-batch N] [--max-delay-ms MS] [--queue-depth N] [--port P]
+      [--trace-out T.json] [--trace-sample F]   # GET /metrics is built in
   python -m mpi_cuda_imagemanipulation_tpu bench [--configs ...]
   python -m mpi_cuda_imagemanipulation_tpu info [--device cpu|tpu]
 
@@ -52,6 +53,48 @@ def _arm_failpoints(args: argparse.Namespace) -> None:
         from mpi_cuda_imagemanipulation_tpu.resilience import failpoints
 
         failpoints.configure(args.failpoints, seed=args.failpoint_seed)
+
+
+def _add_trace_flags(sp: argparse.ArgumentParser) -> None:
+    sp.add_argument(
+        "--trace-out",
+        default=None,
+        metavar="PATH",
+        help="write request-scoped trace spans as Chrome/Perfetto trace-"
+        "event JSON to this path at exit (obs/trace.py; load in "
+        "ui.perfetto.dev, or merge with a jax.profiler device trace "
+        "via tools/profile_capture.py --merge-host-trace)",
+    )
+    sp.add_argument(
+        "--trace-sample",
+        type=float,
+        default=None,
+        metavar="FRAC",
+        help="trace this fraction of requests/dispatches (deterministic "
+        "every-k-th sampling; default 1.0 with --trace-out). Sampled-out "
+        "work pays one flag check — cheap enough to leave on in "
+        "production (env MCIM_TRACE_SAMPLE arms tracing too)",
+    )
+
+
+def _configure_tracing(args: argparse.Namespace) -> bool:
+    """Arm the obs tracer from --trace-out/--trace-sample (or the
+    MCIM_TRACE_SAMPLE env). Returns True when armed."""
+    from mpi_cuda_imagemanipulation_tpu.obs import trace as obs_trace
+
+    sample = getattr(args, "trace_sample", None)
+    if getattr(args, "trace_out", None) or sample is not None:
+        obs_trace.configure(sample=1.0 if sample is None else sample)
+        return True
+    return obs_trace.configure_from_env() is not None
+
+
+def _export_trace(args: argparse.Namespace, log) -> None:
+    if getattr(args, "trace_out", None):
+        from mpi_cuda_imagemanipulation_tpu.obs import trace as obs_trace
+
+        n = obs_trace.export(args.trace_out)
+        log.info("trace: %d events -> %s", n, args.trace_out)
 
 
 def _build_parser() -> argparse.ArgumentParser:
@@ -150,6 +193,7 @@ def _build_parser() -> argparse.ArgumentParser:
         "peers on mid-collective failure, kernel.cu:150)",
     )
     _add_failpoint_flags(run)
+    _add_trace_flags(run)
 
     batch = sub.add_parser(
         "batch", help="run a pipeline over every image in a directory"
@@ -237,7 +281,17 @@ def _build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="disable the journal (no crash-resume for this run)",
     )
+    batch.add_argument(
+        "--metrics-out",
+        default=None,
+        metavar="PATH",
+        help="write a Prometheus text-exposition snapshot of the batch "
+        "registry (engine stages, inflight, per-outcome input counts) "
+        "at exit — the offline counterpart of the serving GET /metrics "
+        "(obs/metrics.py)",
+    )
     _add_failpoint_flags(batch)
+    _add_trace_flags(batch)
 
     srv = sub.add_parser(
         "serve",
@@ -363,6 +417,7 @@ def _build_parser() -> argparse.ArgumentParser:
         "scheduler is stopped",
     )
     _add_failpoint_flags(srv)
+    _add_trace_flags(srv)
 
     bench = sub.add_parser("bench", help="run the benchmark suite")
     bench.add_argument("--configs", default=None, help="subset, comma-separated")
@@ -472,11 +527,13 @@ def _configure_platform(device: str | None) -> None:
 def cmd_run(args: argparse.Namespace) -> int:
     _configure_platform(args.device)
     _arm_failpoints(args)
+    _configure_tracing(args)
     import jax
     import numpy as np
 
     from mpi_cuda_imagemanipulation_tpu.io.image import load_image, save_image
     from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline
+    from mpi_cuda_imagemanipulation_tpu.obs import trace as obs_trace
     from mpi_cuda_imagemanipulation_tpu.parallel.mesh import (
         distributed_init,
         mesh_from_shards,
@@ -491,7 +548,14 @@ def cmd_run(args: argparse.Namespace) -> int:
     pipe = Pipeline.parse(args.ops)
     needs_rgb_output = not args.gray_output
 
-    img = load_image(args.input)
+    # one trace for the whole run: load → compute (compile + steady) →
+    # save each get a span, so --trace-out answers "where did the
+    # invocation's wall time go" on one timeline
+    root = obs_trace.start_trace(
+        "run", ops=pipe.name, impl=args.impl, shards=str(args.shards)
+    )
+    with obs_trace.span("run.load", parent=root.context(), path=args.input):
+        img = load_image(args.input)
     log.info("loaded %s: %s", args.input, img.shape)
 
     guarded = args.device_timeout is not None
@@ -532,6 +596,9 @@ def cmd_run(args: argparse.Namespace) -> int:
             )
         except DeviceTimeoutError as e:
             log.error("%s", e)
+            root.set(error="DeviceTimeoutError")
+            root.end()
+            _export_trace(args, log)
             return 4
         # the child reports device-synced windows; fall back to the outer
         # wall (incl. process spawn) only if the sidecar went missing
@@ -560,13 +627,15 @@ def cmd_run(args: argparse.Namespace) -> int:
             jax.profiler.start_trace(args.profile_dir)
 
         t0 = time.perf_counter()
-        out = jax.block_until_ready(fn(img))
+        with obs_trace.span("run.compile_and_run", parent=root.context()):
+            out = jax.block_until_ready(fn(img))
         compile_and_run_s = time.perf_counter() - t0
         steady_s = None
         if args.show_timing or args.json_metrics:
             # second run isolates steady-state latency from compile time
             t0 = time.perf_counter()
-            out = jax.block_until_ready(fn(img))
+            with obs_trace.span("run.steady", parent=root.context()):
+                out = jax.block_until_ready(fn(img))
             steady_s = time.perf_counter() - t0
 
         if args.profile_dir:
@@ -578,7 +647,8 @@ def cmd_run(args: argparse.Namespace) -> int:
         from mpi_cuda_imagemanipulation_tpu.io.image import gray_to_rgb
 
         out = gray_to_rgb(out)
-    save_image(args.output, out)
+    with obs_trace.span("run.save", parent=root.context(), path=args.output):
+        save_image(args.output, out)
     log.info("wrote %s: %s", args.output, out.shape)
     if args.show:
         try:
@@ -621,12 +691,15 @@ def cmd_run(args: argparse.Namespace) -> int:
             },
             None if args.json_metrics == "-" else args.json_metrics,
         )
+    root.end()
+    _export_trace(args, log)
     return 0
 
 
 def cmd_batch(args: argparse.Namespace) -> int:
     _configure_platform(args.device)
     _arm_failpoints(args)
+    _configure_tracing(args)
     import glob as globmod
 
     import numpy as np
@@ -751,6 +824,19 @@ def cmd_batch(args: argparse.Namespace) -> int:
     import threading
 
     from mpi_cuda_imagemanipulation_tpu.engine import Engine, EngineMetrics
+    from mpi_cuda_imagemanipulation_tpu.obs import trace as obs_trace
+    from mpi_cuda_imagemanipulation_tpu.obs.metrics import Registry
+
+    # one registry for the run: engine stage/inflight families plus the
+    # per-outcome input counter below; --metrics-out snapshots it as
+    # Prometheus text at exit (the offline GET /metrics)
+    registry = Registry()
+    inputs_total = registry.counter(
+        "mcim_batch_inputs_total",
+        "Batch inputs by outcome (ok/failed/resumed).",
+        labels=("outcome",),
+    )
+    inputs_total.inc(len(resumed), outcome="resumed")
 
     # --inflight governs the async engine's dispatch depth (>= 2 overlaps
     # host decode/encode with device compute); --window is the deprecated
@@ -772,6 +858,7 @@ def cmd_batch(args: argparse.Namespace) -> int:
         with state_lock:
             for i in idxs:
                 failed[i] = msg
+        inputs_total.inc(len(idxs), outcome="failed")
         for i in idxs:
             log.error("failed %s: %s", rels[i], msg)
             if journal is not None:
@@ -790,6 +877,7 @@ def cmd_batch(args: argparse.Namespace) -> int:
             # --resume — no lost outputs, and no duplicates because the
             # resumed run skips exactly the journaled-ok inputs
             journal.record_ok(rels[i], _digest(i), rels[i])
+        inputs_total.inc(outcome="ok")
         with state_lock:
             done += 1
 
@@ -809,7 +897,7 @@ def cmd_batch(args: argparse.Namespace) -> int:
         inflight=inflight_depth,
         io_threads=max(1, args.io_threads),
         stage=stage,
-        metrics=EngineMetrics(),
+        metrics=EngineMetrics(registry=registry),
         name="batch",
     )
 
@@ -822,12 +910,19 @@ def cmd_batch(args: argparse.Namespace) -> int:
         # host-side dispatch failures (incl. armed halo.exchange
         # failpoints) surface at submit time; fail those inputs, keep going.
         # submit blocks while --inflight dispatches are outstanding — the
-        # backpressure that keeps decode from racing ahead of the device
+        # backpressure that keeps decode from racing ahead of the device.
+        # Each dispatch is its own trace: build/h2d/enqueue happen under
+        # this root on the caller thread, force/encode under it on the
+        # engine's threads (context rides the work item)
+        root = obs_trace.start_trace(
+            "batch.dispatch", n=len(idxs), first=rels[idxs[0]]
+        )
         try:
-            engine.submit(
-                tuple(idxs), make_input, fn,
-                on_done=on_done, on_error=on_error,
-            )
+            with root:
+                engine.submit(
+                    tuple(idxs), make_input, fn,
+                    on_done=on_done, on_error=on_error,
+                )
         except Exception as e:
             record_failed(idxs, e)
 
@@ -958,6 +1053,13 @@ def cmd_batch(args: argparse.Namespace) -> int:
             },
             None if args.json_metrics == "-" else args.json_metrics,
         )
+    if args.metrics_out:
+        # the offline GET /metrics: one Prometheus text snapshot of the
+        # run's registry (engine stage/inflight families + input outcomes)
+        with open(args.metrics_out, "w") as f:
+            f.write(registry.render())
+        log.info("metrics snapshot -> %s", args.metrics_out)
+    _export_trace(args, log)
     # partial failure (skipped/failed inputs) is a nonzero exit for
     # scripted callers — distinct from the no-inputs-matched exit (3) above
     return 0 if done + len(resumed) == len(paths) else 1
@@ -971,6 +1073,7 @@ def cmd_serve(args: argparse.Namespace) -> int:
     "heavy traffic" front door)."""
     _configure_platform(args.device)
     _arm_failpoints(args)
+    _configure_tracing(args)
     import signal
     import threading
 
@@ -1045,6 +1148,7 @@ def cmd_serve(args: argparse.Namespace) -> int:
                 {"event": "serve", **srv.app.stats()},
                 None if args.json_metrics == "-" else args.json_metrics,
             )
+        _export_trace(args, log)
     return 0
 
 

@@ -61,6 +61,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable
 
 from mpi_cuda_imagemanipulation_tpu.engine.metrics import EngineMetrics
+from mpi_cuda_imagemanipulation_tpu.obs import trace as obs_trace
 from mpi_cuda_imagemanipulation_tpu.resilience import failpoints
 from mpi_cuda_imagemanipulation_tpu.utils.log import get_logger
 
@@ -237,6 +238,10 @@ class Engine:
         info["h2d_s"] = t2 - t1
         info["enqueue_s"] = t3 - t2
         info["t_dispatch"] = t3
+        # trace parentage hops threads with the item: the caller's active
+        # span (the serving dispatch span / a batch root) anchors the
+        # completion thread's force span and the pool's encode span
+        info["trace"] = obs_trace.current_context()
         self.metrics.on_stage("build", info["build_s"])
         self.metrics.on_stage("h2d", info["h2d_s"])
         self.metrics.on_stage("enqueue", info["enqueue_s"])
@@ -267,17 +272,23 @@ class Engine:
     def _complete_one(self, item: _InFlight) -> None:
         t0 = time.perf_counter()
         item.info["queue_wait_s"] = t0 - item.info["t_dispatch"]
+        fspan = obs_trace.span(
+            "engine.force", parent=item.info.get("trace")
+        )
         try:
             # injected completion-stage fault (D2H/transfer class) — the
             # recovery paths behind it are the caller's on_error machinery
             failpoints.maybe_fail("engine.complete", key=item.key)
             host = self._force(item.out)
         except Exception as e:
+            fspan.set(error=type(e).__name__)
+            fspan.end()
             self.metrics.on_forced()
             self._slots.release()
             self.metrics.on_failed(time.perf_counter())
             self._resolve_error(item, e)
             return
+        fspan.end()
         t1 = time.perf_counter()
         item.info["force_s"] = t1 - t0
         self.metrics.on_forced()
@@ -305,7 +316,12 @@ class Engine:
     def _encode_one(self, item: _InFlight, host) -> None:
         t0 = time.perf_counter()
         try:
-            item.on_done(item.key, host, item.info)
+            # entered (not just timed) so the caller's on_done — response
+            # crop/resolve, file encode/write — nests under engine.encode
+            with obs_trace.span(
+                "engine.encode", parent=item.info.get("trace")
+            ):
+                item.on_done(item.key, host, item.info)
         except Exception as e:
             self.metrics.on_failed(time.perf_counter())
             self._resolve_error(item, e)

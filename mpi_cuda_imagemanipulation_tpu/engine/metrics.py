@@ -17,8 +17,11 @@ The numbers that tell you whether the overlap is real:
     `utils.timing.percentiles` (the same quantile definition the serving
     metrics and the bench suite use).
 
-Counters + bounded reservoirs behind one lock, `snapshot()` for /stats and
-the batch summary — same conventions as serve/metrics.ServeMetrics.
+Since the obs/ fabric landed, storage is an `obs.Registry`
+(`mcim_engine_*` families, stage as a label on one histogram): the
+serving scheduler passes its app's registry so `/metrics` exposes engine
+and serving quantities in one scrape, and `snapshot()` — the `/stats`
+engine section and batch summary — is a view over the same objects.
 """
 
 from __future__ import annotations
@@ -26,7 +29,7 @@ from __future__ import annotations
 import threading
 from collections import deque
 
-from mpi_cuda_imagemanipulation_tpu.utils.timing import percentiles
+from mpi_cuda_imagemanipulation_tpu.obs.metrics import Registry
 
 PERCENTILES = (50, 95, 99)
 
@@ -34,68 +37,98 @@ STAGES = ("build", "h2d", "enqueue", "force", "encode")
 
 
 class EngineMetrics:
-    def __init__(self, sample_cap: int = 65536):
+    def __init__(self, registry: Registry | None = None,
+                 sample_cap: int = 65536):
+        self.registry = registry or Registry()
+        r = self.registry
         self._lock = threading.Lock()
-        self.submitted = 0  # batches submitted to the engine
-        self.completed = 0  # batches whose on_done finished
-        self.failed = 0  # batches routed to on_error
-        self.inflight = 0  # gauge: dispatched, not yet forced
-        self.inflight_peak = 0
-        self.idle_s = 0.0  # device-idle seconds inside the active window
+        self._submitted = r.counter(
+            "mcim_engine_submitted_total", "Batches submitted to the engine."
+        )
+        self._completed = r.counter(
+            "mcim_engine_completed_total", "Batches whose on_done finished."
+        )
+        self._failed = r.counter(
+            "mcim_engine_failed_total", "Batches routed to on_error."
+        )
+        self._inflight = r.gauge(
+            "mcim_engine_inflight",
+            "Dispatched-but-not-yet-forced batches (gauge).",
+        )
+        self._inflight_peak = r.gauge(
+            "mcim_engine_inflight_peak", "High-water in-flight depth."
+        )
+        self._idle = r.counter(
+            "mcim_engine_device_idle_seconds_total",
+            "Device-idle seconds inside the engine's active window.",
+        )
+        self._stage = r.histogram(
+            "mcim_engine_stage_seconds",
+            "Per-stage engine latency (build/h2d/enqueue/force/encode).",
+            labels=("stage",),
+            sample_cap=sample_cap,
+        )
         self.t_first_dispatch: float | None = None
         self.t_last_complete: float | None = None
-        self._stage: dict[str, deque] = {
-            s: deque(maxlen=sample_cap) for s in STAGES
-        }
         self._depth: deque = deque(maxlen=sample_cap)
+
+    # -- registry-backed readers -------------------------------------------
+
+    @property
+    def submitted(self) -> int:
+        return int(self._submitted.value())
+
+    @property
+    def inflight(self) -> int:
+        return int(self._inflight.value())
+
+    @property
+    def inflight_peak(self) -> int:
+        return int(self._inflight_peak.value())
+
+    @property
+    def idle_s(self) -> float:
+        return self._idle.value()
 
     # -- recording ---------------------------------------------------------
 
     def on_submit(self, now: float) -> None:
         with self._lock:
-            self.submitted += 1
-            self.inflight += 1
-            self.inflight_peak = max(self.inflight_peak, self.inflight)
-            self._depth.append(self.inflight)
+            self._submitted.inc()
+            self._inflight.inc()
+            depth = self._inflight.value()
+            self._inflight_peak.set_max(depth)
+            self._depth.append(depth)
             if self.t_first_dispatch is None:
                 self.t_first_dispatch = now
 
     def on_forced(self) -> None:
         with self._lock:
-            self.inflight -= 1
+            self._inflight.dec()
 
     def unforced(self) -> int:
         """Dispatched-but-not-forced count (the completion thread's idle
         predicate: waiting while this is 0 means the device has nothing)."""
         with self._lock:
-            return self.inflight
+            return int(self._inflight.value())
 
     def on_idle(self, seconds: float) -> None:
-        with self._lock:
-            self.idle_s += seconds
+        self._idle.inc(seconds)
 
     def on_complete(self, now: float) -> None:
         with self._lock:
-            self.completed += 1
+            self._completed.inc()
             self.t_last_complete = now
 
     def on_failed(self, now: float) -> None:
         with self._lock:
-            self.failed += 1
+            self._failed.inc()
             self.t_last_complete = now
 
     def on_stage(self, stage: str, seconds: float) -> None:
-        with self._lock:
-            self._stage[stage].append(seconds)
+        self._stage.observe(seconds, stage=stage)
 
     # -- reporting ---------------------------------------------------------
-
-    @staticmethod
-    def _pcts(samples) -> dict[str, float] | None:
-        if not samples:
-            return None
-        got = percentiles(samples, PERCENTILES)
-        return {f"p{int(q)}_ms": got[q] * 1e3 for q in PERCENTILES}
 
     def active_window_s(self) -> float | None:
         with self._lock:
@@ -107,8 +140,7 @@ class EngineMetrics:
         window = self.active_window_s()
         if not window:
             return None
-        with self._lock:
-            return min(max(self.idle_s / window, 0.0), 1.0)
+        return min(max(self._idle.value() / window, 0.0), 1.0)
 
     def snapshot(self) -> dict:
         idle = self.device_idle_frac()
@@ -116,17 +148,20 @@ class EngineMetrics:
             mean_depth = (
                 sum(self._depth) / len(self._depth) if self._depth else None
             )
-            return {
-                "submitted": self.submitted,
-                "completed": self.completed,
-                "failed": self.failed,
-                "inflight": self.inflight,
-                "inflight_peak": self.inflight_peak,
-                "inflight_mean": mean_depth,
-                "device_idle_frac": idle,
-                "idle_s": self.idle_s,
-                "stages": {s: self._pcts(self._stage[s]) for s in STAGES},
-            }
+        return {
+            "submitted": int(self._submitted.value()),
+            "completed": int(self._completed.value()),
+            "failed": int(self._failed.value()),
+            "inflight": int(self._inflight.value()),
+            "inflight_peak": int(self._inflight_peak.value()),
+            "inflight_mean": mean_depth,
+            "device_idle_frac": idle,
+            "idle_s": self._idle.value(),
+            "stages": {
+                s: self._stage.percentiles_ms(PERCENTILES, stage=s)
+                for s in STAGES
+            },
+        }
 
     def summary_line(self) -> str:
         s = self.snapshot()

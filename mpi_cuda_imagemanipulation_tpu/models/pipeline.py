@@ -197,16 +197,26 @@ class Pipeline:
                 self, mesh, backend=backend, halo_mode=halo_mode
             )
 
+        mesh_desc = str(dict(mesh.shape))  # hoisted: no per-call build
+
         def run(img, _fn=fn):
             # failpoint at halo-exchange entry (resilience/failpoints.py):
             # host-side, before the sharded program launches, so an armed
             # `halo.exchange` site simulates a mid-collective rank failure
             # without wedging the other shards (the reference's actual
             # failure mode, kernel.cu:150)
+            from mpi_cuda_imagemanipulation_tpu.obs import trace as obs_trace
             from mpi_cuda_imagemanipulation_tpu.resilience import failpoints
 
-            failpoints.maybe_fail("halo.exchange", mesh_shape=mesh.shape)
-            return _fn(img)
+            # the host-side enqueue of the sharded halo program as a span
+            # (obs/trace.py): under an engine dispatch or a traced run this
+            # nests below the caller's span; untraced it is the shared
+            # no-op
+            with obs_trace.span(
+                "sharded.dispatch", mesh=mesh_desc, halo_mode=halo_mode
+            ):
+                failpoints.maybe_fail("halo.exchange", mesh_shape=mesh.shape)
+                return _fn(img)
 
         # keep the jitted function's AOT surface reachable (the halo
         # overlap tests lower the sharded program to inspect its module)

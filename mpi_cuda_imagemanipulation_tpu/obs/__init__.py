@@ -1,0 +1,59 @@
+"""Observability fabric — tracing + metrics, one substrate for every
+subsystem (docs/design.md "Observability").
+
+  * `obs/trace.py`   — request-scoped spans with cross-thread context
+                       propagation, deterministic sampling, Chrome/
+                       Perfetto trace-event export (`--trace-out`).
+  * `obs/metrics.py` — counters/gauges/histograms in one named registry
+                       with Prometheus text exposition (`GET /metrics`,
+                       `--metrics-out`); `/stats` is a view over the same
+                       objects, so the two cannot drift.
+  * `obs/profile.py` — Perfetto/Chrome trace parsing and the host-span /
+                       jax.profiler device-trace merge (one timeline for
+                       host stalls vs DMA vs compute; the capture tool
+                       `tools/profile_capture.py` is a shim over this).
+
+The serving scheduler, the async engine, the resilience retry/bisect
+path, the sharded halo dispatch and the batch CLI all report through
+here — it is the substrate later fabric/streaming work reports through.
+"""
+
+from mpi_cuda_imagemanipulation_tpu.obs import trace  # noqa: F401
+from mpi_cuda_imagemanipulation_tpu.obs.metrics import (  # noqa: F401
+    CONTENT_TYPE,
+    DEFAULT_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    parse_exposition,
+)
+from mpi_cuda_imagemanipulation_tpu.obs.trace import (  # noqa: F401
+    NOOP_SPAN,
+    SpanContext,
+    Tracer,
+    current_context,
+    current_trace_id,
+    event,
+    span,
+    start_trace,
+)
+
+__all__ = [
+    "CONTENT_TYPE",
+    "DEFAULT_BUCKETS",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "NOOP_SPAN",
+    "Registry",
+    "SpanContext",
+    "Tracer",
+    "current_context",
+    "current_trace_id",
+    "event",
+    "parse_exposition",
+    "span",
+    "start_trace",
+    "trace",
+]

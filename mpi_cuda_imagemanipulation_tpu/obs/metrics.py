@@ -1,0 +1,401 @@
+"""Metrics registry — counters/gauges/histograms, one naming scheme,
+Prometheus text exposition.
+
+Before this module the repo had three disconnected metric islands
+(`serve/metrics.py`, `engine/metrics.py`, ad-hoc dicts in the bench
+lanes), each with its own counters and reporting conventions. Here there
+is ONE registry per process (or per ServeApp — the registry is an
+instance, so tests compose freely): every subsystem registers its metrics
+into it, `render()` emits Prometheus text exposition format 0.0.4 for
+`GET /metrics` / `--metrics-out`, and `/stats` is a *view* over the same
+objects — the two can never drift.
+
+Naming scheme (docs/design.md "Observability"):
+
+    mcim_<subsystem>_<what>[_total|_seconds]{label="value"}
+
+  * prefix `mcim_`; subsystem in {serve, engine, cache, breaker, health,
+    batch};
+  * counters end `_total` and only go up; durations are SECONDS with a
+    `_seconds` suffix (never ms — the exposition consumer rescales);
+  * statuses/stages/buckets are LABELS, not name suffixes, so one family
+    aggregates across them.
+
+Histograms keep both the Prometheus cumulative buckets AND a bounded
+reservoir of recent samples — the buckets feed scraping, the reservoir
+feeds the exact p50/p95/p99 the `/stats` payload and shutdown summaries
+always reported (`utils.timing.percentiles`, the same quantile definition
+the bench suite uses). A serving process must not grow memory with request
+count: the reservoir is a `deque(maxlen=sample_cap)` and label
+cardinality is bounded by the callers (buckets and statuses are finite
+sets by construction).
+
+`parse_exposition()` is the matching parser — tests and the CI smoke lane
+use it to assert `/metrics` actually parses as exposition text.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+from mpi_cuda_imagemanipulation_tpu.utils.timing import percentiles
+
+# latency-in-seconds buckets: 1 ms .. 10 s, roughly log-spaced — covers
+# both CPU-smoke and real-chip serving latencies
+DEFAULT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+PERCENTILES = (50, 95, 99)
+
+
+def _escape_label(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_value(v: float) -> str:
+    if v == float("inf"):
+        return "+Inf"
+    f = float(v)
+    return str(int(f)) if f.is_integer() else repr(f)
+
+
+def _label_str(names: tuple[str, ...], values: tuple[str, ...],
+               extra: tuple[tuple[str, str], ...] = ()) -> str:
+    pairs = [
+        f'{n}="{_escape_label(str(v))}"' for n, v in zip(names, values)
+    ] + [f'{n}="{_escape_label(str(v))}"' for n, v in extra]
+    return "{" + ",".join(pairs) + "}" if pairs else ""
+
+
+class _Metric:
+    """Shared labeled-value storage: {label-values-tuple: float}."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str, labels: tuple[str, ...] = ()):
+        self.name = name
+        self.help = help
+        self.label_names = tuple(labels)
+        self._lock = threading.Lock()
+        self._values: dict[tuple[str, ...], float] = {}
+
+    def _key(self, labels: dict) -> tuple[str, ...]:
+        if set(labels) != set(self.label_names):
+            raise ValueError(
+                f"{self.name}: expected labels {self.label_names}, "
+                f"got {tuple(labels)}"
+            )
+        return tuple(str(labels[n]) for n in self.label_names)
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._values.get(self._key(labels), 0.0)
+
+    def values(self) -> dict[tuple[str, ...], float]:
+        with self._lock:
+            return dict(self._values)
+
+    def render(self) -> list[str]:
+        lines = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} {self.kind}",
+        ]
+        with self._lock:
+            items = sorted(self._values.items())
+        if not items and not self.label_names:
+            items = [((), 0.0)]
+        for key, v in items:
+            lines.append(
+                f"{self.name}{_label_str(self.label_names, key)} "
+                f"{_fmt_value(v)}"
+            )
+        return lines
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, n: float = 1, **labels) -> None:
+        if n < 0:
+            raise ValueError(f"{self.name}: counters only go up (inc {n})")
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + n
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, name, help, labels=(), fn=None):
+        super().__init__(name, help, labels)
+        # callback gauge: `fn()` -> value (unlabeled) or {labels: value};
+        # evaluated at render/value time so the scrape always sees the
+        # live state (breaker boards, health machine, cache stats)
+        self._fn = fn
+
+    def set(self, v: float, **labels) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = float(v)
+
+    def inc(self, n: float = 1, **labels) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + n
+
+    def dec(self, n: float = 1, **labels) -> None:
+        self.inc(-n, **labels)
+
+    def set_max(self, v: float, **labels) -> None:
+        """Monotone high-water update (peak gauges), atomic under the
+        metric lock."""
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = max(self._values.get(key, 0.0), float(v))
+
+    def _eval_fn(self) -> None:
+        if self._fn is None:
+            return
+        got = self._fn()
+        with self._lock:
+            if isinstance(got, dict):
+                self._values = {
+                    (k,) if isinstance(k, str) else tuple(map(str, k)): float(v)
+                    for k, v in got.items()
+                }
+            else:
+                self._values = {(): float(got)}
+
+    def value(self, **labels) -> float:
+        self._eval_fn()
+        return super().value(**labels)
+
+    def values(self) -> dict[tuple[str, ...], float]:
+        self._eval_fn()
+        return super().values()
+
+    def render(self) -> list[str]:
+        self._eval_fn()
+        return super().render()
+
+
+class Histogram:
+    """Prometheus histogram + bounded percentile reservoir.
+
+    One instance carries every label combination (like Counter/Gauge);
+    each combination owns cumulative bucket counts, sum, count, and a
+    recent-sample deque for exact percentiles."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str, labels: tuple[str, ...] = (),
+                 buckets: tuple[float, ...] = DEFAULT_BUCKETS,
+                 sample_cap: int = 65536):
+        self.name = name
+        self.help = help
+        self.label_names = tuple(labels)
+        self.buckets = tuple(sorted(buckets))
+        self.sample_cap = sample_cap
+        self._lock = threading.Lock()
+        # key -> [bucket_counts list, sum, count, reservoir deque]
+        self._series: dict[tuple[str, ...], list] = {}
+
+    def _key(self, labels: dict) -> tuple[str, ...]:
+        if set(labels) != set(self.label_names):
+            raise ValueError(
+                f"{self.name}: expected labels {self.label_names}, "
+                f"got {tuple(labels)}"
+            )
+        return tuple(str(labels[n]) for n in self.label_names)
+
+    def _cell(self, key):
+        s = self._series.get(key)
+        if s is None:
+            s = self._series[key] = [
+                [0] * len(self.buckets), 0.0, 0,
+                deque(maxlen=self.sample_cap),
+            ]
+        return s
+
+    def observe(self, v: float, **labels) -> None:
+        key = self._key(labels)
+        with self._lock:
+            counts, _sum, _n, reservoir = self._cell(key)
+            for i, ub in enumerate(self.buckets):
+                if v <= ub:
+                    counts[i] += 1
+            s = self._series[key]
+            s[1] = _sum + v
+            s[2] = _n + 1
+            reservoir.append(v)
+
+    def count(self, **labels) -> int:
+        with self._lock:
+            s = self._series.get(self._key(labels))
+            return s[2] if s else 0
+
+    def sum(self, **labels) -> float:
+        with self._lock:
+            s = self._series.get(self._key(labels))
+            return s[1] if s else 0.0
+
+    def samples(self, **labels) -> list[float]:
+        with self._lock:
+            s = self._series.get(self._key(labels))
+            return list(s[3]) if s else []
+
+    def percentiles_ms(self, qs=PERCENTILES, **labels) -> dict | None:
+        """`{"p50_ms": ...}` over the recent reservoir — the exact
+        percentile view /stats and the shutdown summaries report
+        (same definition as the bench suite: utils.timing.percentiles)."""
+        xs = self.samples(**labels)
+        if not xs:
+            return None
+        got = percentiles(xs, qs)
+        return {f"p{int(q)}_ms": got[q] * 1e3 for q in qs}
+
+    def render(self) -> list[str]:
+        lines = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} histogram",
+        ]
+        with self._lock:
+            series = {
+                k: (list(s[0]), s[1], s[2]) for k, s in self._series.items()
+            }
+        for key in sorted(series):
+            counts, total, n = series[key]
+            for i, ub in enumerate(self.buckets):
+                ls = _label_str(
+                    self.label_names, key, (("le", _fmt_value(ub)),)
+                )
+                lines.append(f"{self.name}_bucket{ls} {counts[i]}")
+            inf_ls = _label_str(self.label_names, key, (("le", "+Inf"),))
+            lines.append(f"{self.name}_bucket{inf_ls} {n}")
+            plain = _label_str(self.label_names, key)
+            lines.append(f"{self.name}_sum{plain} {repr(float(total))}")
+            lines.append(f"{self.name}_count{plain} {n}")
+        return lines
+
+
+class Registry:
+    """One process's (or one ServeApp's) metric namespace. Registering an
+    existing name returns the existing metric — subsystems that share a
+    registry share the family (that is the point)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, object] = {}
+
+    def _register(self, cls, name, help, labels, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if not isinstance(m, cls) or m.label_names != tuple(labels):
+                    raise ValueError(
+                        f"metric {name!r} already registered with a "
+                        f"different type/labels"
+                    )
+                return m
+            m = self._metrics[name] = cls(name, help, labels, **kw)
+            return m
+
+    def counter(self, name: str, help: str,
+                labels: tuple[str, ...] = ()) -> Counter:
+        return self._register(Counter, name, help, labels)
+
+    def gauge(self, name: str, help: str, labels: tuple[str, ...] = (),
+              fn=None) -> Gauge:
+        return self._register(Gauge, name, help, labels, fn=fn)
+
+    def histogram(self, name: str, help: str,
+                  labels: tuple[str, ...] = (),
+                  buckets: tuple[float, ...] = DEFAULT_BUCKETS,
+                  sample_cap: int = 65536) -> Histogram:
+        return self._register(
+            Histogram, name, help, labels, buckets=buckets,
+            sample_cap=sample_cap,
+        )
+
+    def get(self, name: str):
+        with self._lock:
+            return self._metrics.get(name)
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._metrics)
+
+    def render(self) -> str:
+        """Prometheus text exposition format 0.0.4 (the `GET /metrics`
+        body / `--metrics-out` snapshot)."""
+        with self._lock:
+            metrics = [self._metrics[n] for n in sorted(self._metrics)]
+        lines: list[str] = []
+        for m in metrics:
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def parse_exposition(text: str) -> dict[str, dict]:
+    """Parse Prometheus text exposition into
+    `{family: {"type": str, "help": str, "samples": {(name, labelstr): value}}}`.
+    Raises ValueError on malformed lines — the CI smoke lane's
+    "/metrics parses" assertion."""
+    families: dict[str, dict] = {}
+
+    def fam(name: str) -> dict:
+        return families.setdefault(
+            name, {"type": "untyped", "help": "", "samples": {}}
+        )
+
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            name, _, help_text = rest.partition(" ")
+            fam(name)["help"] = help_text
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, kind = rest.partition(" ")
+            if kind not in ("counter", "gauge", "histogram", "summary",
+                            "untyped"):
+                raise ValueError(f"line {lineno}: bad TYPE {kind!r}")
+            fam(name)["type"] = kind
+            continue
+        if line.startswith("#"):
+            continue
+        # sample line: name[{labels}] value
+        if "{" in line:
+            name, _, rest = line.partition("{")
+            labels, sep, val_part = rest.rpartition("} ")
+            if not sep:
+                raise ValueError(f"line {lineno}: unterminated labels")
+            labelstr = labels
+            value_str = val_part.strip().split()[0]
+        else:
+            parts = line.split()
+            if len(parts) < 2:
+                raise ValueError(f"line {lineno}: expected 'name value'")
+            name, value_str = parts[0], parts[1]
+            labelstr = ""
+        try:
+            value = float(value_str)
+        except ValueError:
+            raise ValueError(
+                f"line {lineno}: unparsable value {value_str!r}"
+            ) from None
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[: -len(suffix)] in families:
+                base = name[: -len(suffix)]
+                break
+        fam(base)["samples"][(name, labelstr)] = value
+    return families

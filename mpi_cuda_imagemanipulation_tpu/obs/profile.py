@@ -1,0 +1,179 @@
+"""Perfetto/Chrome trace parsing + host-span/device-trace merging.
+
+Graduation of `tools/profile_capture.py`'s offline parser (the tool stays
+as a thin capture shim): parse a `jax.profiler` Perfetto trace, summarize
+per-track time with a DMA-vs-compute split, and — the piece the roofline
+program needs online — merge an `obs.trace` host-span file onto the SAME
+timeline, so host stalls, DMA waits and device compute are one picture.
+
+The two traces have different time bases (`jax.profiler` stamps its own
+epoch; obs spans are relative to the tracer's start), so `merge_traces`
+re-bases both to zero and keeps them on distinct pids — alignment is
+structural (both cover the same run window), which is exactly what the
+per-stage overlap question needs: "was the device idle while the host
+coalesced/encoded" is a within-track question on each side, answered side
+by side. Event-level cross-clock sync is out of scope and not required
+for it.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+from collections import defaultdict
+
+# event names that are DMA/copy-shaped on XLA device tracks — the split's
+# classifier (inherited from tools/profile_capture.py round 3)
+DMA_MARKERS = ("dma", "copy", "memcpy", "transfer", "infeed", "outfeed")
+
+HOST_PID = 1_000_001  # merged-trace pid for the obs host spans
+
+
+def load_device_trace(path: str) -> list[dict]:
+    """Trace events from a jax.profiler output directory (newest
+    `*.json.gz` Perfetto file under it) or from a plain `.json`/`.json.gz`
+    trace file. Returns [] when nothing is found."""
+    if os.path.isdir(path):
+        paths = sorted(
+            glob.glob(os.path.join(path, "**", "*.json.gz"), recursive=True),
+            key=os.path.getmtime,
+        )
+        if not paths:
+            return []
+        path = paths[-1]
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        data = json.load(f)
+    return data.get("traceEvents", data) if isinstance(data, dict) else data
+
+
+def load_host_trace(path: str) -> list[dict]:
+    """Trace events from an `obs.trace` export (`--trace-out` JSON)."""
+    with open(path) as f:
+        data = json.load(f)
+    return data.get("traceEvents", data) if isinstance(data, dict) else data
+
+
+def _ts_base(events: list[dict]) -> float:
+    stamps = [float(e["ts"]) for e in events if "ts" in e and e.get("ph") != "M"]
+    return min(stamps) if stamps else 0.0
+
+
+def merge_traces(host_events: list[dict],
+                 device_events: list[dict]) -> list[dict]:
+    """One Chrome trace-event list with the obs host spans and the device
+    trace side by side: both re-based to ts=0, host events forced onto
+    the reserved `HOST_PID` process (named "mcim-host") so the tracks
+    never collide with the profiler's pids."""
+    out: list[dict] = []
+    hbase = _ts_base(host_events)
+    for e in host_events:
+        e = dict(e)
+        e["pid"] = HOST_PID
+        if "ts" in e and e.get("ph") != "M":
+            e["ts"] = float(e["ts"]) - hbase
+        out.append(e)
+    if not any(
+        e.get("ph") == "M" and e.get("name") == "process_name"
+        and e.get("pid") == HOST_PID
+        for e in out
+    ):
+        out.insert(0, {
+            "ph": "M", "name": "process_name", "pid": HOST_PID, "tid": 0,
+            "args": {"name": "mcim-host"},
+        })
+    dbase = _ts_base(device_events)
+    for e in device_events:
+        e = dict(e)
+        if "ts" in e and e.get("ph") != "M":
+            e["ts"] = float(e["ts"]) - dbase
+        out.append(e)
+    return out
+
+
+def summarize(events: list[dict], *, top_n: int = 40) -> dict:
+    """Per-process top events by total duration + the device-side
+    DMA-vs-compute split (the roofline corroboration table)."""
+    pid_name: dict = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pid_name[e.get("pid")] = e.get("args", {}).get("name", "")
+    agg: dict = defaultdict(lambda: [0.0, 0])  # (proc, name) -> [us, count]
+    proc_total: dict = defaultdict(float)
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        dur = float(e.get("dur", 0.0))
+        proc = pid_name.get(e.get("pid"), str(e.get("pid")))
+        key = (proc, e.get("name", "?"))
+        agg[key][0] += dur
+        agg[key][1] += 1
+        proc_total[proc] += dur
+    top = sorted(agg.items(), key=lambda kv: -kv[1][0])[:top_n]
+    # device-side DMA vs compute split: XLA device tracks are the
+    # processes that are neither the python host thread nor our own
+    # merged-in host-span track
+    device_procs = {
+        p for p in proc_total
+        if not p.lower().startswith(("python", "/host", "mcim-host"))
+    }
+    dma_us = comp_us = 0.0
+    for (proc, name), (us, _n) in agg.items():
+        if proc not in device_procs:
+            continue
+        if any(m in name.lower() for m in DMA_MARKERS):
+            dma_us += us
+        else:
+            comp_us += us
+    return {
+        "processes": {p: round(v, 1) for p, v in sorted(proc_total.items())},
+        "device_dma_us": round(dma_us, 1),
+        "device_compute_us": round(comp_us, 1),
+        "top_events": [
+            {
+                "process": proc,
+                "name": name,
+                "total_us": round(us, 1),
+                "count": n,
+            }
+            for (proc, name), (us, n) in top
+        ],
+    }
+
+
+def summary_table(summary: dict) -> list[str]:
+    """The markdown top-events table for a summary dict (shared by the
+    capture tool and the merged-trace report)."""
+    lines = [
+        "| process | event | total us | count |",
+        "|---|---|---|---|",
+    ]
+    for t in summary.get("top_events", []):
+        lines.append(
+            f"| {t['process']} | {t['name'][:60]} | "
+            f"{t['total_us']} | {t['count']} |"
+        )
+    return lines
+
+
+def merge_and_summarize(host_path: str, device_path: str,
+                        merged_out: str | None = None) -> dict:
+    """The `--merge-host-trace` unit: load both traces, merge onto one
+    timeline (optionally writing the combined Perfetto JSON), and return
+    one summary whose table interleaves host spans with device tracks."""
+    host = load_host_trace(host_path)
+    device = load_device_trace(device_path)
+    merged = merge_traces(host, device)
+    if merged_out:
+        with open(merged_out, "w") as f:
+            json.dump(
+                {"traceEvents": merged, "displayTimeUnit": "ms"}, f
+            )
+    summary = summarize(merged)
+    summary["host_events"] = sum(1 for e in host if e.get("ph") != "M")
+    summary["device_events"] = sum(1 for e in device if e.get("ph") != "M")
+    if merged_out:
+        summary["merged_trace"] = merged_out
+    return summary

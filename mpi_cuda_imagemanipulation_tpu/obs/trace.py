@@ -1,0 +1,365 @@
+"""Request-scoped trace spans — one timeline from admission to encode.
+
+The reference's only telemetry was bare stdout prints (kernel.cu:186-188);
+the serving/engine/resilience stack needs to answer "where did request X
+spend its 40 ms" across scheduler → coalesce → dispatch → retry/bisect →
+D2H → encode. A span is a named wall-clock interval on one thread; spans
+form a tree per *trace* (one trace per serving request / batch dispatch /
+CLI run), and every retry attempt or breaker transition is an instant
+event on the owning trace.
+
+Design constraints, in order:
+
+  * **Disarmed cost ≈ zero.** `span()`/`event()` check one module flag and
+    return a shared no-op singleton — no allocation, no lock, no clock
+    read. Sampled-out traces behave identically: the root decision is made
+    once per trace, and every descendant call sees `sampled=False` and
+    gets the same singleton back. Tracing is safe to leave compiled in on
+    the dispatch hot path.
+  * **Thread-safe, cross-thread parentage.** The serving pipeline hops
+    threads (caller → scheduler → engine completion → encode pool), so
+    parentage is carried explicitly: a `SpanContext` is a value (trace_id,
+    span_id, sampled) that travels with the work item, and `span(name,
+    parent=ctx)` re-anchors on any thread. Same-thread nesting rides a
+    `contextvars.ContextVar` so `with span(...)` blocks compose without
+    plumbing. Completed spans append to one bounded deque under a lock.
+  * **Traces start only on purpose.** `span()` with no resolvable parent
+    is a no-op, never an implicit new trace — only `start_trace()` (the
+    per-request/per-run root) makes the sampling decision. A missing
+    parent therefore degrades to "not traced", not to trace spam.
+
+Export is Chrome/Perfetto trace-event JSON (`ph:"X"` duration events,
+`ph:"i"` instants, metadata names), loadable in `ui.perfetto.dev` directly
+and mergeable onto a `jax.profiler` device trace via obs/profile.py so
+host stalls, DMA and compute land on one picture.
+
+Timestamps use `time.perf_counter()` relative to the tracer's start, in
+microseconds — the Chrome trace unit. Sampling is deterministic (every
+k-th trace at rate 1/k), so a traced A/B re-run selects the same requests.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import math
+import os
+import threading
+import time
+from collections import deque
+from typing import NamedTuple
+
+
+class SpanContext(NamedTuple):
+    """The value that carries parentage across threads: put it on the work
+    item at submit, pass it as `parent=` where the work resumes."""
+
+    trace_id: str
+    span_id: int
+    sampled: bool
+
+
+NOT_SAMPLED = SpanContext("", 0, False)
+
+_current: contextvars.ContextVar[SpanContext | None] = contextvars.ContextVar(
+    "mcim_obs_span", default=None
+)
+
+
+class _NoopSpan:
+    """The shared do-nothing span: every disarmed/sampled-out call returns
+    THIS object (tests assert identity — that is the no-allocation
+    guarantee on the hot path)."""
+
+    __slots__ = ()
+    trace_id = ""
+    span_id = 0
+
+    def context(self) -> SpanContext:
+        return NOT_SAMPLED
+
+    def set(self, **args) -> None:
+        pass
+
+    def end(self) -> None:
+        pass
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        return False
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class Span:
+    """One live span. `end()` (or context-manager exit) records it; `set()`
+    attaches attributes; `context()` is the handle children parent to.
+    A Span may be ended from a different thread than the one that opened
+    it (the retroactive queue-wait pattern: open at submit, end at pop)."""
+
+    __slots__ = (
+        "_tracer", "name", "trace_id", "span_id", "parent_id",
+        "t0", "tid", "args", "_token", "_ended",
+    )
+
+    def __init__(self, tracer: "Tracer", name: str, trace_id: str,
+                 span_id: int, parent_id: int, args: dict):
+        self._tracer = tracer
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.args = args
+        self.tid = threading.get_ident()
+        self._token = None
+        self._ended = False
+        self.t0 = time.perf_counter()
+
+    def context(self) -> SpanContext:
+        return SpanContext(self.trace_id, self.span_id, True)
+
+    def set(self, **args) -> None:
+        self.args.update(args)
+
+    def end(self) -> None:
+        if self._ended:
+            return
+        self._ended = True
+        self._tracer._record(self, time.perf_counter())
+
+    def __enter__(self) -> "Span":
+        self._token = _current.set(self.context())
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if self._token is not None:
+            _current.reset(self._token)
+            self._token = None
+        if exc_type is not None:
+            self.args.setdefault("error", exc_type.__name__)
+        self.end()
+        return False
+
+
+class Tracer:
+    """Span collector: bounded event buffer behind one lock, deterministic
+    trace-level sampling, Chrome trace-event export."""
+
+    def __init__(self, *, sample: float = 1.0, max_events: int = 200_000):
+        if not 0.0 <= sample <= 1.0:
+            raise ValueError(f"sample must be in [0, 1], got {sample}")
+        self.sample = sample
+        self._lock = threading.Lock()
+        self._events: deque = deque(maxlen=max_events)
+        self._thread_names: dict[int, str] = {}
+        self._next_span = 0
+        self._n_traces = 0
+        self._n_sampled = 0
+        self.t0 = time.perf_counter()
+        # run-unique trace-id prefix so merged multi-process traces never
+        # collide (pid + coarse start time)
+        self._prefix = f"{os.getpid():x}{int(time.time()) & 0xffffff:x}"
+
+    # -- span creation -----------------------------------------------------
+
+    def _new_span(self, name: str, trace_id: str, parent_id: int,
+                  args: dict) -> Span:
+        with self._lock:
+            self._next_span += 1
+            sid = self._next_span
+            tid = threading.get_ident()
+            if tid not in self._thread_names:
+                self._thread_names[tid] = threading.current_thread().name
+        return Span(self, name, trace_id, sid, parent_id, args)
+
+    def start_trace(self, name: str, **args) -> Span:
+        """Root span of a NEW trace — the only call that makes a sampling
+        decision. Deterministic: at rate f, trace n is kept iff
+        floor(n*f) > floor((n-1)*f), i.e. evenly every 1/f traces."""
+        with self._lock:
+            self._n_traces += 1
+            n = self._n_traces
+            take = math.floor(n * self.sample) > math.floor(
+                (n - 1) * self.sample
+            )
+            if take:
+                self._n_sampled += 1
+        if not take:
+            return NOOP_SPAN
+        trace_id = f"{self._prefix}-{n:x}"
+        span = self._new_span(name, trace_id, 0, args)
+        span.args.setdefault("trace_id", trace_id)
+        return span
+
+    def span(self, name: str, parent: SpanContext | None = None, **args):
+        """Child span. `parent=None` uses the calling thread's current
+        span; no resolvable sampled parent → the shared no-op (a span
+        never implicitly starts a trace)."""
+        if parent is None:
+            parent = _current.get()
+        if parent is None or not parent.sampled:
+            return NOOP_SPAN
+        return self._new_span(name, parent.trace_id, parent.span_id, args)
+
+    def event(self, name: str, parent: SpanContext | None = None,
+              **args) -> None:
+        """Instant event on the parent's trace (retry attempts, breaker
+        transitions). Same no-op rule as `span`."""
+        if parent is None:
+            parent = _current.get()
+        if parent is None or not parent.sampled:
+            return
+        ts = (time.perf_counter() - self.t0) * 1e6
+        tid = threading.get_ident()
+        args.setdefault("trace_id", parent.trace_id)
+        args.setdefault("parent_id", parent.span_id)
+        with self._lock:
+            if tid not in self._thread_names:
+                self._thread_names[tid] = threading.current_thread().name
+            self._events.append({
+                "ph": "i", "s": "t", "name": name, "ts": ts,
+                "tid": tid, "args": args,
+            })
+
+    def _record(self, span: Span, t1: float) -> None:
+        ts = (span.t0 - self.t0) * 1e6
+        args = span.args
+        args.setdefault("trace_id", span.trace_id)
+        args["span_id"] = span.span_id
+        if span.parent_id:
+            args.setdefault("parent_id", span.parent_id)
+        with self._lock:
+            self._events.append({
+                "ph": "X", "name": span.name, "ts": ts,
+                "dur": max((t1 - span.t0) * 1e6, 0.0),
+                "tid": span.tid, "args": args,
+            })
+
+    # -- reporting ---------------------------------------------------------
+
+    def counts(self) -> dict:
+        with self._lock:
+            return {
+                "traces": self._n_traces,
+                "sampled": self._n_sampled,
+                "events": len(self._events),
+                "sample": self.sample,
+            }
+
+    def drain(self) -> list[dict]:
+        """Pop every buffered raw event (tests / incremental export)."""
+        with self._lock:
+            out = list(self._events)
+            self._events.clear()
+        return out
+
+    def chrome_events(self, *, pid: int | None = None,
+                      process_name: str = "mcim-host") -> list[dict]:
+        """The buffered spans as Chrome trace-event dicts (non-draining),
+        with process/thread metadata prepended."""
+        pid = os.getpid() if pid is None else pid
+        with self._lock:
+            events = [dict(e) for e in self._events]
+            names = dict(self._thread_names)
+        meta: list[dict] = [{
+            "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+            "args": {"name": process_name},
+        }]
+        for tid, tname in sorted(names.items()):
+            meta.append({
+                "ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
+                "args": {"name": tname},
+            })
+        for e in events:
+            e["pid"] = pid
+        return meta + events
+
+    def export(self, path: str) -> int:
+        """Write the Chrome trace JSON (`{"traceEvents": [...]}`); returns
+        the number of events written. Load in ui.perfetto.dev, or merge
+        with a jax.profiler device trace via obs/profile.py."""
+        events = self.chrome_events()
+        with open(path, "w") as f:
+            json.dump(
+                {"traceEvents": events, "displayTimeUnit": "ms"}, f
+            )
+        return len(events)
+
+
+# -- module-level default tracer (the CLI/server wiring surface) -----------
+
+ENV_SAMPLE = "MCIM_TRACE_SAMPLE"
+
+_tracer: Tracer | None = None
+_enabled = False  # lock-free fast-path flag, flipped only by (de)configure
+
+
+def configure(*, sample: float = 1.0, max_events: int = 200_000) -> Tracer:
+    """Arm the process-wide tracer (idempotent per call: a fresh buffer).
+    `--trace-sample` < 1 keeps tracing cheap enough to leave on."""
+    global _tracer, _enabled
+    _tracer = Tracer(sample=sample, max_events=max_events)
+    _enabled = True
+    return _tracer
+
+
+def configure_from_env(env=os.environ) -> Tracer | None:
+    """Arm iff MCIM_TRACE_SAMPLE is set (a fraction; 1 = every trace)."""
+    raw = env.get(ENV_SAMPLE)
+    if raw:
+        return configure(sample=float(raw))
+    return None
+
+
+def disable() -> None:
+    global _tracer, _enabled
+    _enabled = False
+    _tracer = None
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def get_tracer() -> Tracer | None:
+    return _tracer
+
+
+def start_trace(name: str, **args):
+    if not _enabled:
+        return NOOP_SPAN
+    return _tracer.start_trace(name, **args)
+
+
+def span(name: str, parent: SpanContext | None = None, **args):
+    if not _enabled:
+        return NOOP_SPAN
+    return _tracer.span(name, parent=parent, **args)
+
+
+def event(name: str, parent: SpanContext | None = None, **args) -> None:
+    if not _enabled:
+        return
+    _tracer.event(name, parent=parent, **args)
+
+
+def current_context() -> SpanContext | None:
+    """The calling thread's active span context (None outside any span).
+    Capture at submit time, hand to the thread that resumes the work."""
+    return _current.get()
+
+
+def current_trace_id() -> str:
+    """The active trace id or "" — the log-line join key (utils/log.py)."""
+    ctx = _current.get()
+    return ctx.trace_id if ctx is not None and ctx.sampled else ""
+
+
+def export(path: str) -> int:
+    """Export the default tracer's buffer; 0 when tracing is disarmed."""
+    if _tracer is None:
+        return 0
+    return _tracer.export(path)

@@ -31,6 +31,9 @@ DEGRADED = "degraded"
 DRAINING = "draining"
 STOPPED = "stopped"
 
+# every state, in lifecycle order — the /metrics health gauge's label set
+STATES = (STARTING, SERVING, DEGRADED, DRAINING, STOPPED)
+
 _TRANSITIONS: dict[str, tuple[str, ...]] = {
     STARTING: (SERVING, STOPPED),
     SERVING: (DEGRADED, DRAINING, STOPPED),

@@ -16,15 +16,18 @@ an online service:
   * `cache.py`      — shape-bucket compile cache, pre-warmed at startup so
                       no user request ever pays a jit trace.
   * `metrics.py`    — queue depth, batch occupancy, queue-wait/device time,
-                      p50/p95/p99 end-to-end latency (`/stats`, shutdown
-                      summary).
+                      p50/p95/p99 end-to-end latency — a facade over the
+                      app's obs/ registry (`/stats` is a view over it,
+                      `GET /metrics` the Prometheus exposition of it).
   * `server.py`     — stdlib ThreadingHTTPServer front end (POST
-                      /v1/process, GET /healthz, GET /stats) plus the
-                      in-process `Client` used by tests and the load
-                      generator, and the context-manager `Server` that
-                      guarantees socket/scheduler release on every exit.
+                      /v1/process, GET /healthz, GET /stats, GET
+                      /metrics) plus the in-process `Client` used by
+                      tests and the load generator, and the
+                      context-manager `Server` that guarantees
+                      socket/scheduler release on every exit.
   * `loadgen.py`    — open-loop offered-load sweep (bench_suite lane),
-                      with a fault_rate knob for availability runs.
+                      with a fault_rate knob for availability runs and
+                      per-request trace ids for tail attribution.
 
 Fault tolerance (PR 3, resilience/): dispatch runs under a retrying
 executor with per-bucket circuit breakers, poison requests quarantine solo

@@ -56,6 +56,10 @@ class CompileCache:
         self.traces_at_warmup = 0
         self.hits = 0
         self.misses = 0
+        # per-shape-bucket hit split ("HxW" -> count): the /metrics
+        # mcim_cache_hits family — the signal replica bucket affinity
+        # (ROADMAP item 1) will route on
+        self.hits_by_bucket: dict[str, int] = {}
         self.warmup_s: float | None = None
         # transient compile failures at warmup (wedged backend coming up,
         # injected cache.warm failpoint) retry with backoff instead of
@@ -121,22 +125,28 @@ class CompileCache:
 
     def get(self, bucket_h: int, bucket_w: int, channels: int, batch: int):
         key = (bucket_h, bucket_w, channels, batch)
+        bucket = f"{bucket_h}x{bucket_w}"
         with self._lock:
             fn = self._fns.get(key)
             if fn is not None:
                 self.hits += 1
+                self.hits_by_bucket[bucket] = (
+                    self.hits_by_bucket.get(bucket, 0) + 1
+                )
                 return fn
             # off-grid key: serviceable, but a scheduler bug — count it
             self.misses += 1
             return self._build(key)
 
     def stats(self) -> dict:
-        return {
-            "compiled": len(self._fns),
-            "traces": self.traces,
-            "traces_since_warmup": self.traces_since_warmup,
-            "hits": self.hits,
-            "misses": self.misses,
-            "warmup_s": self.warmup_s,
-            "warm_retries": self.warm_retries,
-        }
+        with self._lock:
+            return {
+                "compiled": len(self._fns),
+                "traces": self.traces,
+                "traces_since_warmup": self.traces_since_warmup,
+                "hits": self.hits,
+                "misses": self.misses,
+                "hits_by_bucket": dict(self.hits_by_bucket),
+                "warmup_s": self.warmup_s,
+                "warm_retries": self.warm_retries,
+            }

@@ -16,6 +16,11 @@ occupancy and shed fraction — the saturation curve that sizes
 also reports AVAILABILITY under injected transient faults: success %,
 shed %, retried %, quarantined — the numbers that size `--retry-attempts`
 and the breaker knobs the way the latency curve sizes the batching ones.
+
+With tracing armed (obs/trace.py, e.g. MCIM_TRACE_SAMPLE=1) every request
+carries a trace id and each per-rate record names its slowest completions
+(`slowest_traces`) and failures (`failed_traces`) by id — the p99 outlier
+is pulled up by id in the `--trace-out` file, not found by eyeballing.
 """
 
 from __future__ import annotations
@@ -100,6 +105,30 @@ def run_offered_load(
     if lat:
         p = percentiles(lat, PERCENTILES)
         rec.update({f"e2e_p{int(q)}_ms": p[q] * 1e3 for q in PERCENTILES})
+        # tail attribution (obs/trace.py): when tracing is armed each
+        # request carried a trace id — record the slowest completions so
+        # a p99 outlier can be pulled up BY ID in the --trace-out file
+        # instead of eyeballing the whole timeline
+        slowest = sorted(
+            (h for h in ok if h.trace_id),
+            key=lambda h: h.t_done - h.t_submit,
+            reverse=True,
+        )[:3]
+        if slowest:
+            rec["slowest_traces"] = [
+                {
+                    "trace_id": h.trace_id,
+                    "e2e_ms": (h.t_done - h.t_submit) * 1e3,
+                }
+                for h in slowest
+            ]
+        failed_ids = [
+            {"trace_id": h.trace_id, "status": h.status}
+            for h in handles
+            if h.trace_id and h.status not in ("ok", "overloaded")
+        ]
+        if failed_ids:
+            rec["failed_traces"] = failed_ids[:10]
     return rec
 
 

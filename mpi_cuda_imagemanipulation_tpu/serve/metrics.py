@@ -1,12 +1,17 @@
 r"""Serving metrics — queue depth, batch occupancy, latency percentiles.
 
-Counters + bounded reservoirs behind one lock; `snapshot()` is the /stats
-payload and `summary_line()` the shutdown report. Latency percentiles come
-from `utils.timing.percentiles` — the same quantile definition the bench
-suite uses, so offline and online reports are comparable. Sample
-reservoirs keep the most recent `sample_cap` observations (a serving
-process must not grow memory with request count — admission control
-bounds the queue, this bounds the accounting).
+Since the obs/ fabric landed this class is a thin recording facade over
+an `obs.Registry`: every quantity lives in ONE named metric family
+(`mcim_serve_*`, docs/design.md "Observability" naming scheme), the
+Prometheus `GET /metrics` exposition renders the same objects, and
+`snapshot()` — the `/stats` payload and shutdown report — is a *view*
+over the registry, so the two endpoints cannot drift. Latency
+percentiles come from the histograms' bounded reservoirs via
+`utils.timing.percentiles` — the same quantile definition the bench
+suite uses, so offline and online reports are comparable; the reservoir
+keeps the most recent `sample_cap` observations (a serving process must
+not grow memory with request count — admission control bounds the queue,
+this bounds the accounting).
 
 Per-request timeline (all device-synchronised wall clocks):
 
@@ -17,128 +22,177 @@ Per-request timeline (all device-synchronised wall clocks):
 from __future__ import annotations
 
 import threading
-from collections import deque
 
-from mpi_cuda_imagemanipulation_tpu.utils.timing import percentiles
+from mpi_cuda_imagemanipulation_tpu.obs.metrics import Registry
 
 PERCENTILES = (50, 95, 99)
 
+# terminal request statuses, the label set of mcim_serve_requests_total
+STATUSES = (
+    "ok", "overloaded", "rejected", "deadline_expired", "error",
+    "quarantined",
+)
+
 
 class ServeMetrics:
-    def __init__(self, sample_cap: int = 65536):
+    def __init__(self, registry: Registry | None = None,
+                 sample_cap: int = 65536):
+        self.registry = registry or Registry()
+        r = self.registry
+        # one lock serialises multi-metric updates (e.g. queue depth +
+        # its peak) so snapshots never see a torn pair
         self._lock = threading.Lock()
-        self.submitted = 0
-        self.completed = 0
-        self.shed_overloaded = 0
-        self.rejected = 0  # malformed / too-large / too-small requests
-        self.deadline_expired = 0
-        self.errors = 0
-        self.retries = 0  # dispatch attempts re-run by the retry executor
-        self.quarantined = 0  # poison requests failed solo after bisection
-        self.degraded = 0  # requests served via the golden fallback
-        self.dispatches = 0
-        self.batch_slots = 0  # compiled slots dispatched (incl. pad)
-        self.batch_real = 0  # real requests dispatched
-        self.queued = 0  # current admission-queue depth (gauge)
-        self.queued_peak = 0
-        self.queue_wait_s: deque = deque(maxlen=sample_cap)
-        self.device_s: deque = deque(maxlen=sample_cap)  # per dispatch
-        self.e2e_s: deque = deque(maxlen=sample_cap)
+        self._submitted = r.counter(
+            "mcim_serve_submitted_total", "Requests submitted for admission."
+        )
+        self._requests = r.counter(
+            "mcim_serve_requests_total",
+            "Requests resolved, by terminal status.",
+            labels=("status",),
+        )
+        self._retries = r.counter(
+            "mcim_serve_retries_total",
+            "Dispatch attempts re-run by the retry executor.",
+        )
+        self._degraded = r.counter(
+            "mcim_serve_degraded_total",
+            "Requests served via the golden fallback (breaker open).",
+        )
+        self._dispatches = r.counter(
+            "mcim_serve_dispatches_total", "Micro-batch dispatches."
+        )
+        self._batch_slots = r.counter(
+            "mcim_serve_batch_slots_total",
+            "Compiled batch slots dispatched (incl. pad).",
+        )
+        self._batch_real = r.counter(
+            "mcim_serve_batch_real_total", "Real requests dispatched."
+        )
+        self._queued = r.gauge(
+            "mcim_serve_queue_depth", "Current admission-queue depth."
+        )
+        self._queued_peak = r.gauge(
+            "mcim_serve_queue_depth_peak",
+            "High-water admission-queue depth.",
+        )
+        self._queue_wait = r.histogram(
+            "mcim_serve_queue_wait_seconds",
+            "Admission-to-dispatch wait per request.",
+            sample_cap=sample_cap,
+        )
+        self._device = r.histogram(
+            "mcim_serve_device_seconds",
+            "Device time per micro-batch dispatch.",
+            sample_cap=sample_cap,
+        )
+        self._e2e = r.histogram(
+            "mcim_serve_e2e_latency_seconds",
+            "Submit-to-done latency per completed request.",
+            sample_cap=sample_cap,
+        )
+
+    # -- registry-backed readers (back-compat attribute surface) -----------
+
+    @property
+    def submitted(self) -> int:
+        return int(self._submitted.value())
+
+    @property
+    def completed(self) -> int:
+        return int(self._requests.value(status="ok"))
+
+    @property
+    def retries(self) -> int:
+        return int(self._retries.value())
+
+    @property
+    def queued(self) -> int:
+        return int(self._queued.value())
 
     # -- recording ---------------------------------------------------------
 
     def on_submit(self) -> None:
-        with self._lock:
-            self.submitted += 1
+        self._submitted.inc()
 
     def on_admit(self) -> None:
         with self._lock:
-            self.queued += 1
-            self.queued_peak = max(self.queued_peak, self.queued)
+            self._queued.inc()
+            self._queued_peak.set_max(self._queued.value())
 
     def on_shed(self) -> None:
-        with self._lock:
-            self.shed_overloaded += 1
+        self._requests.inc(status="overloaded")
 
     def on_reject(self) -> None:
-        with self._lock:
-            self.rejected += 1
+        self._requests.inc(status="rejected")
 
     def on_deadline(self, queue_wait_s: float) -> None:
         with self._lock:
-            self.deadline_expired += 1
-            self.queued -= 1
-            self.queue_wait_s.append(queue_wait_s)
+            self._requests.inc(status="deadline_expired")
+            self._queued.dec()
+        self._queue_wait.observe(queue_wait_s)
 
     def on_dispatch(self, n_real: int, n_slots: int, device_s: float) -> None:
-        with self._lock:
-            self.dispatches += 1
-            self.batch_real += n_real
-            self.batch_slots += n_slots
-            self.device_s.append(device_s)
+        self._dispatches.inc()
+        self._batch_real.inc(n_real)
+        self._batch_slots.inc(n_slots)
+        self._device.observe(device_s)
 
     def on_complete(self, queue_wait_s: float, e2e_s: float) -> None:
         with self._lock:
-            self.completed += 1
-            self.queued -= 1
-            self.queue_wait_s.append(queue_wait_s)
-            self.e2e_s.append(e2e_s)
+            self._requests.inc(status="ok")
+            self._queued.dec()
+        self._queue_wait.observe(queue_wait_s)
+        self._e2e.observe(e2e_s)
 
     def on_error(self, n: int = 1) -> None:
         with self._lock:
-            self.errors += n
-            self.queued -= n
+            self._requests.inc(n, status="error")
+            self._queued.dec(n)
 
     def on_retry(self) -> None:
-        with self._lock:
-            self.retries += 1
+        self._retries.inc()
 
     def on_quarantine(self, n: int = 1) -> None:
         with self._lock:
-            self.quarantined += n
-            self.queued -= n
+            self._requests.inc(n, status="quarantined")
+            self._queued.dec(n)
 
     def on_degraded(self, n: int = 1) -> None:
         # the request ALSO counts through on_complete (it succeeded); this
         # only tags how many went via the fallback path
-        with self._lock:
-            self.degraded += n
+        self._degraded.inc(n)
 
     # -- reporting ---------------------------------------------------------
 
-    @staticmethod
-    def _pcts(samples) -> dict[str, float] | None:
-        if not samples:
-            return None
-        got = percentiles(samples, PERCENTILES)
-        return {f"p{int(q)}_ms": got[q] * 1e3 for q in PERCENTILES}
-
     def snapshot(self) -> dict:
-        with self._lock:
-            mean_occupancy = (
-                self.batch_real / self.dispatches if self.dispatches else None
-            )
-            return {
-                "submitted": self.submitted,
-                "completed": self.completed,
-                "shed_overloaded": self.shed_overloaded,
-                "rejected": self.rejected,
-                "deadline_expired": self.deadline_expired,
-                "errors": self.errors,
-                "retries": self.retries,
-                "quarantined": self.quarantined,
-                "degraded": self.degraded,
-                "queued": self.queued,
-                "queued_peak": self.queued_peak,
-                "dispatches": self.dispatches,
-                "mean_batch_occupancy": mean_occupancy,
-                "batch_fill_frac": (
-                    self.batch_real / self.batch_slots if self.batch_slots else None
-                ),
-                "queue_wait": self._pcts(self.queue_wait_s),
-                "device_per_dispatch": self._pcts(self.device_s),
-                "e2e_latency": self._pcts(self.e2e_s),
-            }
+        dispatches = int(self._dispatches.value())
+        batch_real = int(self._batch_real.value())
+        batch_slots = int(self._batch_slots.value())
+        return {
+            "submitted": int(self._submitted.value()),
+            "completed": int(self._requests.value(status="ok")),
+            "shed_overloaded": int(self._requests.value(status="overloaded")),
+            "rejected": int(self._requests.value(status="rejected")),
+            "deadline_expired": int(
+                self._requests.value(status="deadline_expired")
+            ),
+            "errors": int(self._requests.value(status="error")),
+            "retries": int(self._retries.value()),
+            "quarantined": int(self._requests.value(status="quarantined")),
+            "degraded": int(self._degraded.value()),
+            "queued": int(self._queued.value()),
+            "queued_peak": int(self._queued_peak.value()),
+            "dispatches": dispatches,
+            "mean_batch_occupancy": (
+                batch_real / dispatches if dispatches else None
+            ),
+            "batch_fill_frac": (
+                batch_real / batch_slots if batch_slots else None
+            ),
+            "queue_wait": self._queue_wait.percentiles_ms(PERCENTILES),
+            "device_per_dispatch": self._device.percentiles_ms(PERCENTILES),
+            "e2e_latency": self._e2e.percentiles_ms(PERCENTILES),
+        }
 
     def summary_line(self) -> str:
         s = self.snapshot()

@@ -63,8 +63,12 @@ from collections import OrderedDict, deque
 import numpy as np
 
 from mpi_cuda_imagemanipulation_tpu.engine import Engine, EngineMetrics
+from mpi_cuda_imagemanipulation_tpu.obs import trace as obs_trace
 from mpi_cuda_imagemanipulation_tpu.resilience import failpoints
-from mpi_cuda_imagemanipulation_tpu.resilience.breaker import BreakerBoard
+from mpi_cuda_imagemanipulation_tpu.resilience.breaker import (
+    CLOSED,
+    BreakerBoard,
+)
 from mpi_cuda_imagemanipulation_tpu.resilience.health import (
     DEGRADED,
     SERVING,
@@ -129,6 +133,16 @@ class Request:
     error: str | None = None
     t_dispatch: float | None = None
     t_done: float | None = None
+    # -- observability (obs/trace.py): the request's root span + id -------
+    # trace is the live root Span handle (the shared no-op when tracing is
+    # disarmed or this request sampled out); trace_id is "" then — the
+    # join key for log lines, /metrics outliers and X-Trace-Id headers
+    trace: object = obs_trace.NOOP_SPAN
+    trace_id: str = ""
+    coalesce_span: object = obs_trace.NOOP_SPAN
+
+    def trace_ctx(self) -> obs_trace.SpanContext:
+        return self.trace.context()
 
     def wait(self, timeout: float | None = None) -> np.ndarray:
         """Block for the response; raise the status-matching ServeError on
@@ -206,10 +220,12 @@ class MicroBatchScheduler:
                 return
             self._running = True
         if self.engine is None or self.engine.closed:
+            # the engine shares the serving registry, so /metrics exposes
+            # serve + engine families in one scrape (no second island)
             self.engine = Engine(
                 inflight=self._inflight,
                 io_threads=self._io_threads,
-                metrics=EngineMetrics(),
+                metrics=EngineMetrics(registry=self.metrics.registry),
                 name="serve",
             )
         self._thread = threading.Thread(
@@ -253,20 +269,33 @@ class MicroBatchScheduler:
             t_submit=now,
             deadline=now + deadline_ms / 1e3 if deadline_ms is not None else None,
         )
+        # root span: one trace per request, made HERE (the only sampling
+        # decision on this request's path — everything downstream anchors
+        # to it or no-ops)
+        root = obs_trace.start_trace(
+            "serve.request", h=req.true_h, w=req.true_w
+        )
+        req.trace = root
+        req.trace_id = root.trace_id
+        enq = obs_trace.span("serve.enqueue", parent=root.context())
         problem = self._validate(img)
         if problem is not None:
             self.metrics.on_reject()
+            enq.end()
             return self._resolve(req, STATUS_REJECTED, problem)
         ch = img.shape[2] if img.ndim == 3 else 1
         bh, bw = bucketing.pick_bucket(
             img.shape[0], img.shape[1], self.cache.buckets
         )
         req.bucket = (bh, bw, ch)
+        enq.set(bucket=f"{bh}x{bw}x{ch}")
         with self._cond:
             if not self._running:
+                enq.end()
                 return self._resolve(req, STATUS_SHUTDOWN, "scheduler stopped")
             if self._queued >= self.queue_depth:
                 self.metrics.on_shed()
+                enq.end()
                 return self._resolve(
                     req,
                     STATUS_OVERLOADED,
@@ -276,6 +305,13 @@ class MicroBatchScheduler:
             self._queued += 1
             self.metrics.on_admit()
             self._cond.notify_all()
+        enq.end()
+        # the coalesce span is opened on the caller's thread and ended on
+        # the scheduler thread when the batch pops — its duration IS the
+        # micro-batching queue wait on the timeline
+        req.coalesce_span = obs_trace.span(
+            "serve.coalesce", parent=root.context()
+        )
         return req
 
     def _validate(self, img: np.ndarray) -> str | None:
@@ -303,6 +339,9 @@ class MicroBatchScheduler:
         req.status = status
         req.error = error
         req.t_done = time.monotonic()
+        req.coalesce_span.end()
+        req.trace.set(status=status)
+        req.trace.end()
         req.done.set()
         return req
 
@@ -389,10 +428,26 @@ class MicroBatchScheduler:
         self._queued -= len(batch)
         return batch
 
+    @staticmethod
+    def _trace_parent(live: list[Request]) -> obs_trace.SpanContext | None:
+        """The batch's trace anchor: the calling thread's active span if
+        any, else the first sampled member's root. A batch mixes traced
+        and untraced requests — the span rides the first traced one, the
+        rest get their own membership events."""
+        cur = obs_trace.current_context()
+        if cur is not None and cur.sampled:
+            return cur
+        for r in live:
+            ctx = r.trace_ctx()
+            if ctx.sampled:
+                return ctx
+        return None
+
     def _dispatch(self, batch: list[Request]) -> None:
         now = self._clock()
         live: list[Request] = []
         for r in batch:
+            r.coalesce_span.end()  # popped: the micro-batching wait is over
             if r.deadline is not None and now > r.deadline:
                 self.metrics.on_deadline(now - r.t_submit)
                 self._resolve(r, STATUS_DEADLINE, "expired while queued")
@@ -404,25 +459,41 @@ class MicroBatchScheduler:
         breaker = self.breakers.get(bucket)
         if not breaker.allow():
             # breaker open (and no half-open probe slot): golden fallback
-            self._dispatch_degraded(live)
+            with obs_trace.span(
+                "serve.degraded", parent=self._trace_parent(live),
+                bucket=str(bucket), n=len(live),
+            ):
+                self._dispatch_degraded(live)
             return
-        if self.engine is None:
-            # engine not started (direct-driven tests): serial fallback
-            self._dispatch_sync(live, bucket, breaker)
-            return
-        # async fast path: enqueue only — the engine's completion thread
-        # forces + resolves while this thread coalesces the next batch.
-        # Enqueue-time failures (incl. the serve.dispatch failpoint) are
-        # host-side and retry here, exactly like the serial path did.
-        try:
-            call_with_retry(
-                lambda: self._enqueue_batch(live),
-                policy=self.retry_policy,
-                rng=self._retry_rng,
-                on_retry=lambda a, e, d: self._note_retry(bucket, a, e, d),
-            )
-        except Exception as e:
-            self._fail_batch(live, bucket, breaker, e)
+        with obs_trace.span(
+            "serve.dispatch", parent=self._trace_parent(live),
+            bucket=str(bucket), n=len(live),
+        ) as dspan:
+            if len(live) > 1 and dspan is not obs_trace.NOOP_SPAN:
+                # batch-mates of the anchoring trace stay joinable by id
+                dspan.set(
+                    batch_traces=[r.trace_id for r in live if r.trace_id]
+                )
+            if self.engine is None:
+                # engine not started (direct-driven tests): serial fallback
+                self._dispatch_sync(live, bucket, breaker)
+                return
+            # async fast path: enqueue only — the engine's completion
+            # thread forces + resolves while this thread coalesces the next
+            # batch. Enqueue-time failures (incl. the serve.dispatch
+            # failpoint) are host-side and retry here, exactly like the
+            # serial path did.
+            try:
+                call_with_retry(
+                    lambda: self._enqueue_batch(live),
+                    policy=self.retry_policy,
+                    rng=self._retry_rng,
+                    on_retry=lambda a, e, d: self._note_retry(
+                        bucket, a, e, d, live=live
+                    ),
+                )
+            except Exception as e:
+                self._fail_batch(live, bucket, breaker, e)
 
     def _dispatch_sync(self, live, bucket, breaker) -> None:
         """The serial dispatch unit (pre-engine behavior): force inline."""
@@ -431,7 +502,9 @@ class MicroBatchScheduler:
                 lambda: self._run_batch(live),
                 policy=self.retry_policy,
                 rng=self._retry_rng,
-                on_retry=lambda a, e, d: self._note_retry(bucket, a, e, d),
+                on_retry=lambda a, e, d: self._note_retry(
+                    bucket, a, e, d, live=live
+                ),
             )
         except Exception as e:  # retries exhausted: fail the path, not the loop
             self._fail_batch(live, bucket, breaker, e)
@@ -444,6 +517,14 @@ class MicroBatchScheduler:
         """Retries exhausted for a whole batch: feed the breaker, then
         quarantine (solo) or bisect (grouped)."""
         breaker.on_failure()
+        if breaker.state != CLOSED:
+            # breaker transition/holding state is an event on the trace —
+            # a p99 outlier pulled up by id shows WHY it degraded
+            for r in live:
+                obs_trace.event(
+                    "breaker.not_closed", parent=r.trace_ctx(),
+                    bucket=str(bucket), state=breaker.state,
+                )
         self._update_health()
         self._log.warning(
             "dispatch failed after %d attempts for bucket %s: %s",
@@ -451,6 +532,10 @@ class MicroBatchScheduler:
         )
         if len(live) == 1:
             self.metrics.on_quarantine()
+            obs_trace.event(
+                "serve.quarantine", parent=live[0].trace_ctx(),
+                error=type(e).__name__,
+            )
             self._resolve(
                 live[0], STATUS_QUARANTINED, f"{type(e).__name__}: {e}"
             )
@@ -514,13 +599,16 @@ class MicroBatchScheduler:
         live = list(live)
         bucket = live[0].bucket
         breaker = self.breakers.get(bucket)
-        self._note_retry(bucket, 1, exc, 0.0)  # the lost async attempt
+        # the lost async attempt
+        self._note_retry(bucket, 1, exc, 0.0, live=live)
         try:
             out, nb2, device_s = call_with_retry(
                 lambda: self._run_batch(live),
                 policy=self.retry_policy,
                 rng=self._retry_rng,
-                on_retry=lambda a, e, d: self._note_retry(bucket, a, e, d),
+                on_retry=lambda a, e, d: self._note_retry(
+                    bucket, a, e, d, live=live
+                ),
             )
         except Exception as e:
             self._fail_batch(live, bucket, breaker, e)
@@ -533,17 +621,23 @@ class MicroBatchScheduler:
         """One synchronous padded-executor dispatch attempt (the retry
         unit for the serial path, bisection, and completion-failure
         re-runs)."""
-        failpoints.maybe_fail("serve.dispatch", requests=live)
-        fn, (imgs, th, tw), nb = self._prepare_batch(live)
-        now = self._clock()
-        for r in live:
-            r.t_dispatch = now
-        t0 = self._clock()
-        out = np.asarray(fn(imgs, th, tw))  # forces completion + transfer
-        # completion-stage failpoint fires on the sync path too, so an
-        # `always`-armed site drives the full quarantine pipeline
-        failpoints.maybe_fail("engine.complete", requests=live)
-        return out, nb, self._clock() - t0
+        parent = obs_trace.current_context()
+        with obs_trace.span(
+            "serve.attempt",
+            parent=parent if parent else self._trace_parent(live),
+            n=len(live),
+        ):
+            failpoints.maybe_fail("serve.dispatch", requests=live)
+            fn, (imgs, th, tw), nb = self._prepare_batch(live)
+            now = self._clock()
+            for r in live:
+                r.t_dispatch = now
+            t0 = self._clock()
+            out = np.asarray(fn(imgs, th, tw))  # forces completion + transfer
+            # completion-stage failpoint fires on the sync path too, so an
+            # `always`-armed site drives the full quarantine pipeline
+            failpoints.maybe_fail("engine.complete", requests=live)
+            return out, nb, self._clock() - t0
 
     def _complete(self, live, out, nb, device_s) -> None:
         self.metrics.on_dispatch(len(live), nb, device_s)
@@ -556,10 +650,19 @@ class MicroBatchScheduler:
                 (r.t_dispatch or r.t_submit) - r.t_submit,
                 t_done - r.t_submit,
             )
+            r.trace.set(status=STATUS_OK)
+            r.trace.end()
             r.done.set()
 
-    def _note_retry(self, bucket, attempt, exc, delay_s) -> None:
+    def _note_retry(self, bucket, attempt, exc, delay_s, live=()) -> None:
         self.metrics.on_retry()
+        for r in live:
+            # retry attempts are events on the request's trace, so a p99
+            # outlier pulled up by id shows its whole recovery history
+            obs_trace.event(
+                "serve.retry", parent=r.trace_ctx(), attempt=attempt,
+                error=type(exc).__name__, backoff_ms=delay_s * 1e3,
+            )
         self._log.info(
             "retrying bucket %s after %s (attempt %d, backoff %.1fms)",
             bucket, type(exc).__name__, attempt, delay_s * 1e3,
@@ -572,22 +675,31 @@ class MicroBatchScheduler:
         bucket = live[0].bucket
         breaker = self.breakers.get(bucket)
         for r in live:
-            try:
-                out, nb, device_s = call_with_retry(
-                    lambda r=r: self._run_batch([r]),
-                    policy=self.retry_policy,
-                    rng=self._retry_rng,
-                    on_retry=lambda a, e, d: self._note_retry(bucket, a, e, d),
-                )
-            except Exception as e:
-                self.metrics.on_quarantine()
-                self._resolve(
-                    r, STATUS_QUARANTINED, f"{type(e).__name__}: {e}"
-                )
-            else:
-                # the path works without the poison: healthy signal
-                breaker.on_success()
-                self._complete([r], out, nb, device_s)
+            with obs_trace.span(
+                "serve.bisect", parent=r.trace_ctx(), bucket=str(bucket)
+            ):
+                try:
+                    out, nb, device_s = call_with_retry(
+                        lambda r=r: self._run_batch([r]),
+                        policy=self.retry_policy,
+                        rng=self._retry_rng,
+                        on_retry=lambda a, e, d: self._note_retry(
+                            bucket, a, e, d, live=(r,)
+                        ),
+                    )
+                except Exception as e:
+                    self.metrics.on_quarantine()
+                    obs_trace.event(
+                        "serve.quarantine", parent=r.trace_ctx(),
+                        error=type(e).__name__,
+                    )
+                    self._resolve(
+                        r, STATUS_QUARANTINED, f"{type(e).__name__}: {e}"
+                    )
+                    continue
+            # the path works without the poison: healthy signal
+            breaker.on_success()
+            self._complete([r], out, nb, device_s)
         self._update_health()
 
     def _dispatch_degraded(self, live: list[Request]) -> None:
@@ -619,6 +731,8 @@ class MicroBatchScheduler:
             self.metrics.on_complete(
                 r.t_dispatch - r.t_submit, t_done - r.t_submit
             )
+            r.trace.set(status=STATUS_OK, degraded=True)
+            r.trace.end()
             r.done.set()
 
     def _update_health(self) -> None:

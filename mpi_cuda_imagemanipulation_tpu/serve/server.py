@@ -10,9 +10,14 @@ Two front doors share it:
     socket and the scheduler thread are released on EVERY exit path
     (exception mid-startup included), so repeated runs can't EADDRINUSE.
         POST /v1/process   PNG (or any PIL-decodable) bytes in, PNG out
+                           (X-Trace-Id response header when traced)
         GET  /healthz      health state machine (resilience/health.py):
                            200 serving/degraded · 503 otherwise
-        GET  /stats        metrics snapshot (serve/metrics.py schema)
+        GET  /stats        metrics snapshot — a JSON view over the app's
+                           obs registry (serve/metrics.py schema)
+        GET  /metrics      Prometheus text exposition over the SAME
+                           registry (serving + engine + health/breaker/
+                           cache families; obs/metrics.py)
     Status mapping: 200 ok · 400 rejected (undecodable/out-of-range) ·
     422 quarantined (poison request — failed solo after batch bisection) ·
     429 overloaded (shed — Retry-After included) · 503 shutting down ·
@@ -38,7 +43,12 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as np
 
 from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline
-from mpi_cuda_imagemanipulation_tpu.resilience.breaker import BreakerBoard
+from mpi_cuda_imagemanipulation_tpu.obs import metrics as obs_metrics
+from mpi_cuda_imagemanipulation_tpu.obs.metrics import Registry
+from mpi_cuda_imagemanipulation_tpu.resilience.breaker import (
+    CLOSED,
+    BreakerBoard,
+)
 from mpi_cuda_imagemanipulation_tpu.resilience.health import (
     DRAINING,
     SERVING,
@@ -104,7 +114,12 @@ class ServeApp:
             from mpi_cuda_imagemanipulation_tpu.parallel.mesh import make_mesh
 
             mesh = make_mesh(config.shards)
-        self.metrics = ServeMetrics()
+        # ONE registry per app: serving counters, engine metrics (the
+        # scheduler's engine registers into it), and the callback gauges
+        # below all render through the same `GET /metrics` scrape, and
+        # `/stats` reads the same objects — the two cannot drift
+        self.registry = Registry()
+        self.metrics = ServeMetrics(registry=self.registry)
         from mpi_cuda_imagemanipulation_tpu.serve.padded import accepts_channels
 
         channels = tuple(
@@ -152,7 +167,70 @@ class ServeApp:
             inflight=config.inflight,
             io_threads=config.io_threads,
         )
+        self._register_state_gauges()
         self._log = get_logger()
+
+    def _register_state_gauges(self) -> None:
+        """Callback gauges over live subsystem state — evaluated at scrape
+        time, so /metrics always reports the current health/breaker/cache
+        picture without anything pushing updates."""
+        from mpi_cuda_imagemanipulation_tpu.resilience.health import STATES
+
+        r = self.registry
+        r.gauge(
+            "mcim_health_state",
+            "Health state machine: 1 for the current state, 0 otherwise.",
+            labels=("state",),
+            fn=lambda: {
+                (s,): 1.0 if s == self.health.state else 0.0 for s in STATES
+            },
+        )
+        r.gauge(
+            "mcim_breaker_not_closed",
+            "Per-bucket circuit breaker: 1 when open/half-open (traffic "
+            "degraded), 0 when closed.",
+            labels=("bucket",),
+            fn=lambda: {
+                (str(k),): 0.0 if st["state"] == CLOSED else 1.0
+                for k, st in self.breakers.snapshot()["by_key"].items()
+            },
+        )
+        r.gauge(
+            "mcim_breaker_open_events",
+            "Cumulative breaker trips across all buckets.",
+            fn=lambda: float(self.breakers.snapshot()["open_events"]),
+        )
+        # compile-cache families, incl. the per-bucket hit split (sticky
+        # shape-bucket affinity — ROADMAP item 1 — routes on exactly this)
+        r.gauge(
+            "mcim_cache_compiled",
+            "Executables in the shape-bucket compile cache.",
+            fn=lambda: float(self.cache.stats()["compiled"]),
+        )
+        r.gauge(
+            "mcim_cache_traces_since_warmup",
+            "Jit traces after warmup (0 under any admitted load).",
+            fn=lambda: float(self.cache.stats()["traces_since_warmup"]),
+        )
+        r.gauge(
+            "mcim_cache_hits",
+            "Compile-cache hits per shape bucket.",
+            labels=("bucket",),
+            fn=lambda: {
+                (b,): float(n)
+                for b, n in self.cache.stats()["hits_by_bucket"].items()
+            },
+        )
+        r.gauge(
+            "mcim_cache_misses",
+            "Compile-cache misses (off-grid keys — a scheduler bug).",
+            fn=lambda: float(self.cache.stats()["misses"]),
+        )
+
+    def render_metrics(self) -> str:
+        """The `GET /metrics` body: Prometheus text exposition over the
+        app's registry (serving + engine + health/breaker/cache gauges)."""
+        return self.registry.render()
 
     def start(self) -> "ServeApp":
         warm_s = self.cache.warmup()
@@ -255,6 +333,15 @@ def _make_handler(app: ServeApp):
                 )
             elif self.path == "/stats":
                 self._send_json(200, app.stats())
+            elif self.path == "/metrics":
+                # Prometheus text exposition over the app registry — the
+                # same objects /stats reads, so the two cannot disagree
+                body = app.render_metrics().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", obs_metrics.CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             else:
                 self._send_json(404, {"error": f"no route {self.path}"})
 
@@ -282,18 +369,32 @@ def _make_handler(app: ServeApp):
                 img, deadline_ms=app.config.default_deadline_ms
             )
             req.done.wait()
+            # the trace id rides the response either way, so a slow or
+            # failed request is joinable with its --trace-out spans and
+            # [trace] log lines by the CALLER, not just server-side
+            trace_hdr = (
+                [("X-Trace-Id", req.trace_id)] if req.trace_id else []
+            )
             if req.status == "ok":
                 png = encode_image_bytes(req.result)
                 self.send_response(200)
                 self.send_header("Content-Type", "image/png")
                 self.send_header("Content-Length", str(len(png)))
+                for k, v in trace_hdr:
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(png)
                 return
             code = _HTTP_STATUS.get(req.status, 500)
             extra = [("Retry-After", "1")] if code == 429 else []
             self._send_json(
-                code, {"status": req.status, "error": req.error}, extra
+                code,
+                {
+                    "status": req.status,
+                    "error": req.error,
+                    **({"trace_id": req.trace_id} if req.trace_id else {}),
+                },
+                extra + trace_hdr,
             )
 
     return Handler

@@ -3,26 +3,70 @@
 Replaces the reference's bare stdout prints (kernel.cu:186-188,231-232) with
 a configurable logger and a machine-readable metrics record (SURVEY.md §5
 "metrics/logging" entry).
+
+Verbosity comes from the `MCIM_LOG_LEVEL` env var (name or number:
+`DEBUG`, `INFO`, `WARNING`, `ERROR`, `CRITICAL`, or `10`..`50`; default
+INFO), read at `get_logger()` time so `MCIM_LOG_LEVEL=DEBUG` on any entry
+point just works.
+
+`get_logger()` returns a `logging.LoggerAdapter` that prefixes each
+message with the calling thread's active trace id (`[<trace_id>]`,
+obs/trace.py) when one exists — log lines are joinable with `--trace-out`
+spans and `X-Trace-Id` response headers by grep. The adapter resolves the
+id per call, so one shared logger serves every thread correctly.
 """
 
 from __future__ import annotations
 
 import json
 import logging
+import os
 import sys
 
 _FORMAT = "%(asctime)s %(levelname)s %(name)s :: %(message)s"
 
+ENV_LEVEL = "MCIM_LOG_LEVEL"
 
-def get_logger(name: str = "mcim_tpu", level: int = logging.INFO) -> logging.Logger:
+
+def _level_from_env(default: int = logging.INFO) -> int:
+    raw = os.environ.get(ENV_LEVEL, "").strip()
+    if not raw:
+        return default
+    if raw.isdigit():
+        return int(raw)
+    level = logging.getLevelName(raw.upper())
+    return level if isinstance(level, int) else default
+
+
+class TraceAdapter(logging.LoggerAdapter):
+    """Prefixes messages with the active obs trace id — the log/trace
+    join key. No-allocation when untraced (the common case): the id
+    lookup is one contextvar read."""
+
+    def process(self, msg, kwargs):
+        from mpi_cuda_imagemanipulation_tpu.obs.trace import current_trace_id
+
+        tid = current_trace_id()
+        if tid:
+            msg = f"[{tid}] {msg}"
+        return msg, kwargs
+
+
+def get_logger(
+    name: str = "mcim_tpu", level: int | None = None
+) -> logging.LoggerAdapter:
+    """The shared logger, trace-aware. `level` overrides MCIM_LOG_LEVEL;
+    both override the INFO default. Idempotent handler setup."""
     logger = logging.getLogger(name)
     if not logger.handlers:
         handler = logging.StreamHandler(sys.stderr)
         handler.setFormatter(logging.Formatter(_FORMAT))
         logger.addHandler(handler)
-        logger.setLevel(level)
+        logger.setLevel(level if level is not None else _level_from_env())
         logger.propagate = False
-    return logger
+    elif level is not None:
+        logger.setLevel(level)
+    return TraceAdapter(logger, {})
 
 
 def emit_json_metrics(record: dict, path: str | None = None) -> str:

@@ -1,0 +1,658 @@
+"""Observability fabric (obs/) — the ISSUE-6 acceptance suite.
+
+The load-bearing invariants:
+  1. spans opened concurrently on different threads interleave without
+     corruption (unique ids, closed parentage, no lost events);
+  2. parent/child nesting survives the serving retry -> bisect path: a
+     poison request's trace shows dispatch -> retry events -> bisect ->
+     quarantine with correct parentage, and its batch-mates' traces show
+     their own completions;
+  3. a sampled-out (or disarmed) request costs no allocation on the hot
+     path — every call returns the SAME shared no-op span object and the
+     buffer stays empty;
+  4. `/stats` and `/metrics` agree on every shared quantity (one
+     registry, no drift), and fault-rate loadgen sweeps report
+     retry/quarantine counts matching the registry counters;
+  5. the acceptance trace: one request under an injected transient
+     `serve.dispatch` failure yields a single trace with enqueue,
+     coalesce, dispatch, the retry event, completion (engine.force) and
+     encode spans, parentage closed.
+"""
+
+import json
+import logging
+import threading
+
+import numpy as np
+import pytest
+
+from mpi_cuda_imagemanipulation_tpu.io.image import synthetic_image
+from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline
+from mpi_cuda_imagemanipulation_tpu.obs import metrics as obs_metrics
+from mpi_cuda_imagemanipulation_tpu.obs import profile as obs_profile
+from mpi_cuda_imagemanipulation_tpu.obs import trace as obs_trace
+from mpi_cuda_imagemanipulation_tpu.obs.metrics import (
+    Registry,
+    parse_exposition,
+)
+from mpi_cuda_imagemanipulation_tpu.resilience import failpoints
+from mpi_cuda_imagemanipulation_tpu.serve.scheduler import (
+    STATUS_OK,
+    STATUS_QUARANTINED,
+)
+from mpi_cuda_imagemanipulation_tpu.serve.server import (
+    Client,
+    ServeApp,
+    ServeConfig,
+)
+
+OPS = "grayscale,contrast:3.5,emboss:3"
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    """Every test starts and ends with tracing disarmed and failpoints
+    clear — the module-level tracer is process-global state."""
+    obs_trace.disable()
+    failpoints.clear()
+    yield
+    obs_trace.disable()
+    failpoints.clear()
+
+
+def _app(**over) -> ServeApp:
+    cfg = ServeConfig(
+        **{
+            "ops": OPS,
+            "buckets": ((48, 48), (96, 96)),
+            "max_batch": 4,
+            "max_delay_ms": 10.0,
+            "queue_depth": 64,
+            "channels": (3,),
+            **over,
+        }
+    )
+    return ServeApp(cfg).start()
+
+
+def _spans_by_trace(tracer) -> dict[str, list[dict]]:
+    out: dict[str, list[dict]] = {}
+    for e in tracer.chrome_events():
+        tid = e.get("args", {}).get("trace_id")
+        if tid:
+            out.setdefault(tid, []).append(e)
+    return out
+
+
+def _assert_parentage_closed(events: list[dict]) -> None:
+    ids = {e["args"]["span_id"] for e in events if e["ph"] == "X"}
+    for e in events:
+        pid = e["args"].get("parent_id")
+        if pid:
+            assert pid in ids, f"{e['name']}: parent {pid} not in trace"
+
+
+# --------------------------------------------------------------------------
+# tracer core
+# --------------------------------------------------------------------------
+
+
+def test_span_nesting_and_parentage():
+    t = obs_trace.Tracer(sample=1.0)
+    root = t.start_trace("root", kind="test")
+    with root:
+        with t.span("child") as c:
+            with t.span("grandchild") as g:
+                assert g.parent_id == c.span_id
+        assert c.parent_id == root.span_id
+    evs = [e for e in t.chrome_events() if e["ph"] == "X"]
+    by_name = {e["name"]: e for e in evs}
+    assert set(by_name) == {"root", "child", "grandchild"}
+    assert by_name["child"]["args"]["parent_id"] == root.span_id
+    assert all(
+        e["args"]["trace_id"] == root.trace_id for e in evs
+    )
+    _assert_parentage_closed(evs)
+
+
+def test_cross_thread_parentage_via_context():
+    """The serving pattern: capture a SpanContext on one thread, open a
+    child with it on another."""
+    t = obs_trace.Tracer(sample=1.0)
+    root = t.start_trace("root")
+    ctx = root.context()
+    done = threading.Event()
+
+    def worker():
+        s = t.span("remote", parent=ctx)
+        s.end()
+        done.set()
+
+    threading.Thread(target=worker).start()
+    assert done.wait(10)
+    root.end()
+    evs = [e for e in t.chrome_events() if e["ph"] == "X"]
+    remote = next(e for e in evs if e["name"] == "remote")
+    assert remote["args"]["parent_id"] == root.span_id
+    assert remote["args"]["trace_id"] == root.trace_id
+
+
+def test_concurrent_spans_no_corruption():
+    """Invariant 1: N threads x M spans interleaving on one tracer — all
+    recorded, span ids unique, every span's parent is its own root."""
+    t = obs_trace.Tracer(sample=1.0)
+    N, M = 8, 50
+    roots = [t.start_trace(f"root{i}") for i in range(N)]
+
+    def worker(i):
+        ctx = roots[i].context()
+        for k in range(M):
+            with t.span(f"w{i}.outer", parent=ctx):
+                t.span(f"w{i}.inner{k}").end()
+                t.event(f"w{i}.tick", k=k)
+
+    threads = [
+        threading.Thread(target=worker, args=(i,)) for i in range(N)
+    ]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(30)
+    for r in roots:
+        r.end()
+    evs = t.chrome_events()
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert len(xs) == N * (2 * M) + N  # outer+inner per iteration + roots
+    assert len([e for e in evs if e["ph"] == "i"]) == N * M
+    span_ids = [e["args"]["span_id"] for e in xs]
+    assert len(span_ids) == len(set(span_ids)), "span ids collided"
+    for i, r in enumerate(roots):
+        mine = [
+            e for e in xs if e["args"]["trace_id"] == r.trace_id
+        ]
+        assert len(mine) == 2 * M + 1
+        _assert_parentage_closed(mine)
+        # inner spans parent to their outer span, outers to the root
+        for e in mine:
+            if ".outer" in e["name"]:
+                assert e["args"]["parent_id"] == r.span_id
+
+
+def test_sampled_out_costs_no_allocation():
+    """Invariant 3: every sampled-out/disarmed call returns the SAME
+    shared no-op object and buffers nothing."""
+    t = obs_trace.Tracer(sample=0.0)
+    r1 = t.start_trace("a")
+    r2 = t.start_trace("b")
+    assert r1 is obs_trace.NOOP_SPAN and r2 is obs_trace.NOOP_SPAN
+    assert t.span("child", parent=r1.context()) is obs_trace.NOOP_SPAN
+    t.event("ev", parent=r1.context())
+    assert t.counts()["events"] == 0
+    assert t.counts()["sampled"] == 0
+    # module-level disarmed path: identity too, and no tracer at all
+    assert obs_trace.span("x") is obs_trace.NOOP_SPAN
+    assert obs_trace.start_trace("x") is obs_trace.NOOP_SPAN
+    assert obs_trace.export("/dev/null") == 0
+    # a span with NO resolvable parent never implicitly starts a trace
+    t2 = obs_trace.Tracer(sample=1.0)
+    assert t2.span("orphan") is obs_trace.NOOP_SPAN
+    assert t2.counts()["events"] == 0
+
+
+def test_sampling_deterministic_every_kth():
+    t = obs_trace.Tracer(sample=0.25)
+    kept = [
+        t.start_trace(f"t{i}") is not obs_trace.NOOP_SPAN
+        for i in range(20)
+    ]
+    assert sum(kept) == 5
+    # evenly spaced, same decision sequence every run
+    assert kept == [
+        (i + 1) % 4 == 0 for i in range(20)
+    ]
+
+
+def test_export_chrome_trace_format(tmp_path):
+    t = obs_trace.Tracer(sample=1.0)
+    with t.start_trace("root"):
+        t.event("tick")
+    path = tmp_path / "trace.json"
+    n = t.export(str(path))
+    data = json.loads(path.read_text())
+    assert "traceEvents" in data and len(data["traceEvents"]) == n
+    phases = {e["ph"] for e in data["traceEvents"]}
+    assert phases == {"M", "X", "i"}
+    # metadata names the process for Perfetto's track grouping
+    assert any(
+        e["ph"] == "M" and e["name"] == "process_name"
+        for e in data["traceEvents"]
+    )
+    for e in data["traceEvents"]:
+        if e["ph"] == "X":
+            assert e["ts"] >= 0 and e["dur"] >= 0
+
+
+# --------------------------------------------------------------------------
+# metrics registry + exposition
+# --------------------------------------------------------------------------
+
+
+def test_registry_render_parses_as_exposition():
+    r = Registry()
+    c = r.counter("mcim_test_total", "A counter.", labels=("status",))
+    c.inc(status="ok")
+    c.inc(2, status="bad")
+    g = r.gauge("mcim_test_depth", "A gauge.")
+    g.set(3)
+    h = r.histogram(
+        "mcim_test_seconds", "A histogram.", buckets=(0.1, 1.0)
+    )
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = r.render()
+    fams = parse_exposition(text)
+    assert fams["mcim_test_total"]["type"] == "counter"
+    assert fams["mcim_test_total"]["samples"][
+        ("mcim_test_total", 'status="ok"')
+    ] == 1.0
+    assert fams["mcim_test_depth"]["samples"][("mcim_test_depth", "")] == 3.0
+    hs = fams["mcim_test_seconds"]["samples"]
+    # cumulative buckets + +Inf + sum/count (the exposition contract)
+    assert hs[("mcim_test_seconds_bucket", 'le="0.1"')] == 1.0
+    assert hs[("mcim_test_seconds_bucket", 'le="1"')] == 2.0
+    assert hs[("mcim_test_seconds_bucket", 'le="+Inf"')] == 3.0
+    assert hs[("mcim_test_seconds_count", "")] == 3.0
+    assert abs(hs[("mcim_test_seconds_sum", "")] - 5.55) < 1e-9
+    # percentile view reads the same reservoir
+    p = h.percentiles_ms((50,))
+    assert abs(p["p50_ms"] - 500.0) < 1e-6
+
+
+def test_registry_rejects_conflicting_reregistration():
+    r = Registry()
+    r.counter("mcim_x_total", "x")
+    assert r.counter("mcim_x_total", "x") is r.get("mcim_x_total")
+    with pytest.raises(ValueError):
+        r.gauge("mcim_x_total", "now a gauge?")
+    with pytest.raises(ValueError):
+        r.counter("mcim_x_total", "x", labels=("other",))
+    with pytest.raises(ValueError):
+        r.counter("mcim_x_total", "x").inc(-1)
+
+
+def test_callback_gauge_reads_live_state():
+    r = Registry()
+    state = {"v": 1.0}
+    r.gauge("mcim_live", "live", fn=lambda: state["v"])
+    assert 'mcim_live 1' in r.render()
+    state["v"] = 7.0
+    assert 'mcim_live 7' in r.render()
+    r.gauge(
+        "mcim_live_labeled", "live labeled", labels=("k",),
+        fn=lambda: {("a",): 1.0, ("b",): 2.0},
+    )
+    fams = parse_exposition(r.render())
+    assert fams["mcim_live_labeled"]["samples"][
+        ("mcim_live_labeled", 'k="b"')
+    ] == 2.0
+
+
+# --------------------------------------------------------------------------
+# serving integration: the acceptance trace + /stats vs /metrics
+# --------------------------------------------------------------------------
+
+
+def test_traced_request_under_transient_failure_single_trace():
+    """Invariant 5 (the ISSUE acceptance criterion): one request, one
+    injected transient dispatch failure -> ONE trace holding the whole
+    story with closed parentage."""
+    tracer = obs_trace.configure(sample=1.0)
+    failpoints.configure("serve.dispatch=once")
+    app = _app()
+    try:
+        client = Client(app)
+        img = synthetic_image(40, 40, channels=3, seed=3)
+        req = client.submit(img)
+        out = req.wait(120)
+        assert req.status == STATUS_OK
+        np.testing.assert_array_equal(
+            out, np.asarray(Pipeline.parse(OPS).jit()(img))
+        )
+        assert req.trace_id
+    finally:
+        app.stop()
+    traces = _spans_by_trace(tracer)
+    evs = traces[req.trace_id]
+    names = {e["name"] for e in evs}
+    for want in (
+        "serve.request", "serve.enqueue", "serve.coalesce",
+        "serve.dispatch", "serve.retry", "engine.force", "engine.encode",
+    ):
+        assert want in names, f"missing {want} in {sorted(names)}"
+    _assert_parentage_closed(evs)
+    by_name = {e["name"]: e for e in evs if e["ph"] == "X"}
+    root_id = by_name["serve.request"]["args"]["span_id"]
+    assert "parent_id" not in by_name["serve.request"]["args"]
+    assert by_name["serve.enqueue"]["args"]["parent_id"] == root_id
+    assert by_name["serve.coalesce"]["args"]["parent_id"] == root_id
+    assert by_name["serve.dispatch"]["args"]["parent_id"] == root_id
+    # completion-side spans nest under the dispatch span (context rode
+    # the engine work item across threads)
+    d_id = by_name["serve.dispatch"]["args"]["span_id"]
+    assert by_name["engine.force"]["args"]["parent_id"] == d_id
+    assert by_name["engine.encode"]["args"]["parent_id"] == d_id
+    # the injected failure is an event on this trace, not a log line
+    retry = next(e for e in evs if e["name"] == "serve.retry")
+    assert retry["args"]["error"] == "FailpointError"
+    assert by_name["serve.request"]["args"]["status"] == STATUS_OK
+
+
+def test_retry_bisect_parentage_survives():
+    """Invariant 2: a poison request in a coalesced batch — its trace
+    shows bisect + quarantine; batch-mates' traces complete ok."""
+    POISON_H = 13
+    tracer = obs_trace.configure(sample=1.0)
+    failpoints.install(
+        "serve.dispatch",
+        lambda ctx: any(r.true_h == POISON_H for r in ctx["requests"]),
+    )
+    app = _app(max_delay_ms=40.0)
+    try:
+        client = Client(app)
+        imgs = [
+            synthetic_image(20, 30, channels=3, seed=1),
+            synthetic_image(POISON_H, 30, channels=3, seed=2),  # poison
+            synthetic_image(21, 31, channels=3, seed=3),
+        ]
+        reqs = [client.submit(im) for im in imgs]  # same bucket: coalesce
+        for r in reqs:
+            assert r.done.wait(120)
+        assert reqs[1].status == STATUS_QUARANTINED
+        assert reqs[0].status == STATUS_OK and reqs[2].status == STATUS_OK
+    finally:
+        app.stop()
+    traces = _spans_by_trace(tracer)
+    poison = traces[reqs[1].trace_id]
+    _assert_parentage_closed(poison)
+    names = {e["name"] for e in poison}
+    assert "serve.bisect" in names and "serve.quarantine" in names
+    assert "serve.retry" in names  # the batch attempts became events
+    by_name = {e["name"]: e for e in poison if e["ph"] == "X"}
+    root_id = by_name["serve.request"]["args"]["span_id"]
+    assert by_name["serve.bisect"]["args"]["parent_id"] == root_id
+    # solo attempts nest under the bisect span
+    attempts = [
+        e for e in poison
+        if e["ph"] == "X" and e["name"] == "serve.attempt"
+    ]
+    bisect_id = by_name["serve.bisect"]["args"]["span_id"]
+    assert any(
+        a["args"]["parent_id"] == bisect_id for a in attempts
+    )
+    assert by_name["serve.request"]["args"]["status"] == STATUS_QUARANTINED
+    # survivors: their own traces, their own bisect, ok status
+    for k in (0, 2):
+        tev = traces[reqs[k].trace_id]
+        _assert_parentage_closed(tev)
+        rn = {e["name"] for e in tev}
+        assert "serve.bisect" in rn
+        roots = [
+            e for e in tev if e["ph"] == "X" and e["name"] == "serve.request"
+        ]
+        assert roots[0]["args"]["status"] == STATUS_OK
+
+
+def test_stats_and_metrics_agree_everywhere():
+    """Invariant 4 first half: every quantity present in both /stats and
+    the registry exposition matches exactly — they read one store."""
+    app = _app()
+    try:
+        client = Client(app)
+        # a mixed workload: completions, a rejection, a retry
+        failpoints.configure("serve.dispatch=once")
+        for k in range(5):
+            client.process(
+                synthetic_image(40 + k, 40, channels=3, seed=k)
+            )
+        failpoints.clear()
+        with pytest.raises(Exception):
+            client.process(
+                synthetic_image(400, 400, channels=3, seed=9)
+            )  # above every bucket -> rejected
+        stats = app.stats()
+        fams = parse_exposition(app.render_metrics())
+
+        def metric(family, labels=""):
+            return fams[family]["samples"].get((family, labels), 0.0)
+
+        assert stats["submitted"] == metric("mcim_serve_submitted_total")
+        assert stats["completed"] == metric(
+            "mcim_serve_requests_total", 'status="ok"'
+        )
+        assert stats["rejected"] == metric(
+            "mcim_serve_requests_total", 'status="rejected"'
+        )
+        assert stats["retries"] == metric("mcim_serve_retries_total")
+        assert stats["dispatches"] == metric("mcim_serve_dispatches_total")
+        assert stats["queued"] == metric("mcim_serve_queue_depth")
+        assert stats["queued_peak"] == metric("mcim_serve_queue_depth_peak")
+        assert stats["quarantined"] == metric(
+            "mcim_serve_requests_total", 'status="quarantined"'
+        )
+        # histograms: /stats percentiles read the same reservoir the
+        # exposition's _count counts (the parser files _count under the
+        # base family)
+        assert stats["completed"] == fams[
+            "mcim_serve_e2e_latency_seconds"
+        ]["samples"][("mcim_serve_e2e_latency_seconds_count", "")]
+        # engine + cache + health families render from the same registry
+        assert stats["engine"]["submitted"] == metric(
+            "mcim_engine_submitted_total"
+        )
+        assert metric("mcim_health_state", 'state="serving"') == 1.0
+        assert sum(
+            v for (_n, ls), v in fams["mcim_cache_hits"]["samples"].items()
+        ) == stats["cache"]["hits"]
+    finally:
+        app.stop()
+
+
+def test_loadgen_fault_rate_counters_match_registry():
+    """Invariant 4 second half: a fault-rate sweep's availability columns
+    equal the registry's retry/quarantine counters (per-rate deltas sum
+    to the totals), and traced runs name their slowest requests."""
+    from mpi_cuda_imagemanipulation_tpu.serve import loadgen
+
+    obs_trace.configure(sample=1.0)
+    app = _app(max_delay_ms=2.0)
+    try:
+        records = loadgen.sweep(
+            app,
+            offered_rps=(120.0,),
+            duration_s=1.0,
+            n_images=16,
+            fault_rate=0.15,
+            fault_seed=7,
+        )
+        stats = app.stats()
+        fams = parse_exposition(app.render_metrics())
+        retried_total = sum(r["retried"] for r in records)
+        assert retried_total == stats["retries"]
+        assert (
+            fams["mcim_serve_retries_total"]["samples"][
+                ("mcim_serve_retries_total", "")
+            ]
+            == stats["retries"]
+        )
+        quarantined_total = sum(r["quarantined"] for r in records)
+        assert quarantined_total == stats["quarantined"]
+        assert retried_total >= 1  # 15% fault rate over >=100 requests
+        rec = records[0]
+        assert rec["submitted"] >= 50
+        # traced: the p99 outlier is pullable by id
+        assert rec.get("slowest_traces"), rec
+        assert all(s["trace_id"] for s in rec["slowest_traces"])
+    finally:
+        app.stop()
+
+
+# --------------------------------------------------------------------------
+# satellites: log adapter, profile merge, batch CLI wiring
+# --------------------------------------------------------------------------
+
+
+def test_log_level_env_and_trace_prefix(monkeypatch):
+    from mpi_cuda_imagemanipulation_tpu.utils import log as ulog
+
+    monkeypatch.setenv("MCIM_LOG_LEVEL", "DEBUG")
+    logger = ulog.get_logger("mcim_obs_test_a")
+    assert logger.logger.level == logging.DEBUG
+    monkeypatch.setenv("MCIM_LOG_LEVEL", "41")
+    assert ulog.get_logger("mcim_obs_test_b").logger.level == 41
+    # bogus values fall back to INFO, not crash
+    monkeypatch.setenv("MCIM_LOG_LEVEL", "bogus")
+    assert ulog.get_logger("mcim_obs_test_c").logger.level == logging.INFO
+
+    # the adapter prefixes the active trace id — log lines join traces
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    logger = ulog.get_logger("mcim_obs_test_a")
+    logger.logger.addHandler(Capture())
+    t = obs_trace.configure(sample=1.0)
+    root = t.start_trace("r")
+    with root:
+        logger.info("inside")
+    logger.info("outside")
+    assert records[0] == f"[{root.trace_id}] inside"
+    assert records[1] == "outside"
+
+
+def test_profile_merge_host_and_device(tmp_path):
+    # a host trace from the real tracer
+    t = obs_trace.Tracer(sample=1.0)
+    with t.start_trace("serve.request"):
+        with t.span("serve.dispatch"):
+            pass
+    host_path = tmp_path / "spans.json"
+    t.export(str(host_path))
+    # a synthetic device trace with DMA- and compute-shaped events
+    device_events = [
+        {"ph": "M", "name": "process_name", "pid": 7,
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "X", "name": "fusion.23", "pid": 7, "tid": 1,
+         "ts": 1000.0, "dur": 400.0},
+        {"ph": "X", "name": "dma.copy_h2d", "pid": 7, "tid": 2,
+         "ts": 1100.0, "dur": 100.0},
+    ]
+    device_path = tmp_path / "device.json"
+    device_path.write_text(json.dumps({"traceEvents": device_events}))
+    merged_out = tmp_path / "merged.json"
+    summary = obs_profile.merge_and_summarize(
+        str(host_path), str(device_path), merged_out=str(merged_out)
+    )
+    # both sides present, re-based to ts=0, DMA split computed
+    assert summary["host_events"] >= 2
+    assert summary["device_events"] == 2
+    assert summary["device_dma_us"] == 100.0
+    assert summary["device_compute_us"] == 400.0
+    assert "mcim-host" in summary["processes"]
+    merged = json.loads(merged_out.read_text())["traceEvents"]
+    ts = [e["ts"] for e in merged if e.get("ph") == "X"]
+    assert min(ts) == 0.0
+    procs = {
+        e["args"]["name"]
+        for e in merged
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    assert {"mcim-host", "/device:TPU:0"} <= procs
+    # host spans interleave with device tracks in one summary table
+    names = {t["name"] for t in summary["top_events"]}
+    assert {"serve.request", "fusion.23", "dma.copy_h2d"} <= names
+
+
+def test_batch_cli_metrics_out_and_trace_out(tmp_path):
+    from PIL import Image
+
+    from mpi_cuda_imagemanipulation_tpu.cli import main
+
+    indir = tmp_path / "in"
+    outdir = tmp_path / "out"
+    indir.mkdir()
+    for k in range(3):
+        Image.fromarray(
+            synthetic_image(24, 24, channels=3, seed=k)
+        ).save(indir / f"img{k}.png")
+    metrics_out = tmp_path / "batch_metrics.prom"
+    trace_out = tmp_path / "batch_trace.json"
+    rc = main([
+        "batch", "--input-dir", str(indir), "--output-dir", str(outdir),
+        "--ops", "grayscale", "--impl", "xla",
+        "--metrics-out", str(metrics_out),
+        "--trace-out", str(trace_out),
+    ])
+    assert rc == 0
+    fams = parse_exposition(metrics_out.read_text())
+    assert fams["mcim_batch_inputs_total"]["samples"][
+        ("mcim_batch_inputs_total", 'outcome="ok"')
+    ] == 3.0
+    assert fams["mcim_engine_submitted_total"]["samples"][
+        ("mcim_engine_submitted_total", "")
+    ] == 3.0
+    events = json.loads(trace_out.read_text())["traceEvents"]
+    names = {e["name"] for e in events if e["ph"] == "X"}
+    assert {"batch.dispatch", "engine.force", "engine.encode"} <= names
+    # every engine span is parented into a batch.dispatch trace
+    by_trace: dict[str, list] = {}
+    for e in events:
+        tid = e.get("args", {}).get("trace_id")
+        if tid:
+            by_trace.setdefault(tid, []).append(e)
+    assert len(by_trace) == 3  # one trace per dispatch
+    for evs in by_trace.values():
+        _assert_parentage_closed(evs)
+
+
+def test_engine_metrics_shared_registry_exposes_stages():
+    """The serving engine registers into the app registry: one scrape
+    carries serve + engine families (no second metrics island)."""
+    app = _app()
+    try:
+        client = Client(app)
+        client.process(synthetic_image(40, 40, channels=3, seed=1))
+        names = app.registry.names()
+        assert "mcim_engine_stage_seconds" in names
+        assert "mcim_serve_e2e_latency_seconds" in names
+        fams = parse_exposition(app.render_metrics())
+        stage_counts = {
+            ls: v
+            for (name, ls), v in fams["mcim_engine_stage_seconds"][
+                "samples"
+            ].items()
+            if name.endswith("_count")
+        }
+        assert stage_counts.get('stage="force"', 0) >= 1
+        assert stage_counts.get('stage="encode"', 0) >= 1
+    finally:
+        app.stop()
+
+
+def test_tracing_off_serving_untouched():
+    """Tracing disarmed (the production default): requests carry no
+    trace id, the shared no-op rides every hook, and nothing buffers."""
+    app = _app()
+    try:
+        client = Client(app)
+        req = client.submit(synthetic_image(40, 40, channels=3, seed=1))
+        req.wait(120)
+        assert req.status == STATUS_OK
+        assert req.trace_id == ""
+        assert req.trace is obs_trace.NOOP_SPAN
+        assert req.coalesce_span is obs_trace.NOOP_SPAN
+        assert obs_trace.get_tracer() is None
+    finally:
+        app.stop()

@@ -1,0 +1,178 @@
+#!/usr/bin/env python
+"""CI observability smoke — a short traced serve run over real HTTP.
+
+The tier1.yml obs step runs this on CPU: stand up the full `Server`
+(compile-cache warmup, scheduler, engine, HTTP listener on an ephemeral
+port) with tracing armed and one injected transient dispatch failure,
+drive a handful of requests through POST /v1/process, then assert the
+whole observability contract end to end:
+
+  1. GET /metrics parses as Prometheus text exposition
+     (obs.metrics.parse_exposition) and carries the serve/engine/cache/
+     health families;
+  2. GET /stats agrees with /metrics on every shared quantity (single
+     registry — no drift);
+  3. the exported trace (argv[1]) contains the acceptance span chain for
+     a retried request: serve.request -> serve.enqueue / serve.coalesce /
+     serve.dispatch -> serve.retry event -> engine.force + engine.encode,
+     all on ONE trace id, correctly parented;
+  4. responses carry X-Trace-Id and the id appears in the trace file.
+
+Exit 0 = contract holds; any assertion prints and fails the step. The
+trace JSON is uploaded as a CI artifact either way.
+
+Usage: python tools/obs_smoke.py [TRACE_OUT.json]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from mpi_cuda_imagemanipulation_tpu.utils.platform import claim_platform  # noqa: E402
+
+claim_platform(os.environ.get("JAX_PLATFORMS") or "cpu")
+
+import numpy as np  # noqa: E402
+
+from mpi_cuda_imagemanipulation_tpu.obs import parse_exposition  # noqa: E402
+from mpi_cuda_imagemanipulation_tpu.obs import trace as obs_trace  # noqa: E402
+from mpi_cuda_imagemanipulation_tpu.io.image import (  # noqa: E402
+    encode_image_bytes,
+    synthetic_image,
+)
+from mpi_cuda_imagemanipulation_tpu.resilience import failpoints  # noqa: E402
+from mpi_cuda_imagemanipulation_tpu.serve.server import (  # noqa: E402
+    ServeConfig,
+    Server,
+)
+
+REQUIRED_FAMILIES = (
+    "mcim_serve_requests_total",
+    "mcim_serve_retries_total",
+    "mcim_serve_e2e_latency_seconds",
+    "mcim_engine_submitted_total",
+    "mcim_engine_stage_seconds",
+    "mcim_cache_hits",
+    "mcim_health_state",
+)
+
+# /stats key -> (family, label string) — the shared quantities the two
+# endpoints must agree on
+SHARED = {
+    "submitted": ("mcim_serve_submitted_total", ""),
+    "completed": ("mcim_serve_requests_total", 'status="ok"'),
+    "retries": ("mcim_serve_retries_total", ""),
+    "dispatches": ("mcim_serve_dispatches_total", ""),
+    "queued": ("mcim_serve_queue_depth", ""),
+}
+
+
+def fetch(url: str):
+    with urllib.request.urlopen(url, timeout=30) as resp:
+        return resp.read().decode(), dict(resp.headers)
+
+
+def main() -> int:
+    trace_out = sys.argv[1] if len(sys.argv) > 1 else "/tmp/obs_trace.json"
+    obs_trace.configure(sample=1.0)
+    # one transient dispatch failure: the trace must show the recovery
+    failpoints.configure("serve.dispatch=once")
+    cfg = ServeConfig(
+        buckets=((64, 64), (128, 128)),
+        channels=(3,),
+        max_batch=4,
+        max_delay_ms=2.0,
+    )
+    img = synthetic_image(60, 60, channels=3, seed=7)
+    png = encode_image_bytes(np.asarray(img))
+    with Server(cfg, port=0) as srv:
+        base = f"http://127.0.0.1:{srv.address[1]}"
+        trace_ids = []
+        for _ in range(6):
+            req = urllib.request.Request(f"{base}/v1/process", data=png)
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                assert resp.status == 200, resp.status
+                tid = resp.headers.get("X-Trace-Id")
+                assert tid, "missing X-Trace-Id on a traced request"
+                trace_ids.append(tid)
+                resp.read()
+        metrics_text, headers = fetch(f"{base}/metrics")
+        assert headers.get("Content-Type", "").startswith("text/plain"), (
+            headers.get("Content-Type")
+        )
+        stats = json.loads(fetch(f"{base}/stats")[0])
+    failpoints.clear()
+
+    # 1. exposition parses + required families present
+    fams = parse_exposition(metrics_text)
+    missing = [f for f in REQUIRED_FAMILIES if f not in fams]
+    assert not missing, f"missing /metrics families: {missing}"
+    print(f"/metrics: {len(fams)} families parse as exposition text")
+
+    # 2. /stats == /metrics on every shared quantity
+    for key, (family, labels) in SHARED.items():
+        sample_key = next(
+            (
+                (name, ls)
+                for (name, ls) in fams[family]["samples"]
+                if ls == labels and not name.endswith(("_bucket",))
+            ),
+            None,
+        )
+        got = fams[family]["samples"].get(sample_key, 0.0) if sample_key else 0.0
+        assert float(stats[key]) == got, (
+            f"/stats[{key}]={stats[key]} != /metrics {family}{{{labels}}}={got}"
+        )
+    assert stats["retries"] >= 1, "injected failure produced no retry"
+    print(
+        f"/stats agrees with /metrics on {sorted(SHARED)} "
+        f"(retries={stats['retries']})"
+    )
+
+    # 3. the trace: export + acceptance span chain on one trace id
+    n = obs_trace.export(trace_out)
+    print(f"trace: {n} events -> {trace_out}")
+    events = json.load(open(trace_out))["traceEvents"]
+    by_trace: dict[str, list[dict]] = {}
+    for e in events:
+        tid = e.get("args", {}).get("trace_id")
+        if tid:
+            by_trace.setdefault(tid, []).append(e)
+    retried = [
+        t for t, evs in by_trace.items()
+        if any(e["name"] == "serve.retry" for e in evs)
+    ]
+    assert retried, "no trace carries the injected retry event"
+    evs = by_trace[retried[0]]
+    names = {e["name"] for e in evs}
+    for want in ("serve.request", "serve.enqueue", "serve.coalesce",
+                 "serve.dispatch", "serve.retry", "engine.force",
+                 "engine.encode"):
+        assert want in names, f"span {want!r} missing from trace {retried[0]}"
+    # parentage: every non-root span's parent_id is a span_id in the trace
+    ids = {
+        e["args"].get("span_id") for e in evs if e["ph"] == "X"
+    }
+    for e in evs:
+        pid = e["args"].get("parent_id")
+        if pid:
+            assert pid in ids, f"{e['name']} parent {pid} not in trace"
+    print(
+        f"trace {retried[0]}: {sorted(names)} — parentage closed"
+    )
+
+    # 4. response headers join the trace file
+    assert set(trace_ids) <= set(by_trace), "X-Trace-Id not in trace file"
+    print(f"{len(trace_ids)} X-Trace-Id headers all present in trace file")
+    print("obs smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

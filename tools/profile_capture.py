@@ -1,102 +1,85 @@
 #!/usr/bin/env python
-"""Capture a jax.profiler trace of the headline kernel and summarize it.
+"""Capture a jax.profiler trace of the headline kernel and summarize it —
+a thin capture shim over obs/profile.py (the parser/merge logic graduated
+there; this file keeps the chip-window workflow and the file outputs).
 
-VERDICT r2 missing #4: the roofline argument (BASELINE.md) rests on modeled
-HBM traffic; a DMA-wait vs compute breakdown from a real trace corroborates
-or kills it independently of the wide-word A/B. This script:
+Two modes:
 
-  1. compiles the headline pipeline (8K 5x5 Gaussian, Pallas),
-  2. records `jax.profiler.trace(..., create_perfetto_trace=True)` around
-     ~30 steady-state iterations,
-  3. parses the Perfetto/Chrome trace JSON (stdlib gzip+json — no
-     tensorboard_plugin_profile in this image) and writes
-     {OUTDIR}_summary.md + .json: per-track top events by total
-     duration, plus a device-time split over DMA/copy-shaped vs
-     compute-shaped event names.
+  1. CAPTURE (default, TPU only): compile the headline pipeline (8K 5x5
+     Gaussian), record `jax.profiler.trace(..., create_perfetto_trace=
+     True)` around ~30 steady-state iterations for the u8 and SWAR
+     variants, and write {OUTDIR}_summary.md + .json — per-track top
+     events plus the device DMA-vs-compute split (the roofline
+     corroboration artifact, VERDICT r2 #4).
 
-Usage: python tools/profile_capture.py [OUTDIR]   (default profile_r03)
+  2. MERGE (`--merge-host-trace SPANS.json --device-trace DIR`, any
+     backend): join an obs `--trace-out` host-span file with a Perfetto
+     device trace onto ONE timeline — combined trace JSON for
+     ui.perfetto.dev plus a single summary table interleaving host spans
+     (serve.dispatch / engine.force / engine.encode ...) with device
+     tracks, so host stalls vs DMA vs compute are one picture.
+
+Usage:
+  python tools/profile_capture.py [OUTDIR]            (capture; default
+                                                       profile_r03)
+  python tools/profile_capture.py --merge-host-trace spans.json \
+      --device-trace profile_r03 [--out merged]       (merge + summarize)
 """
 
 from __future__ import annotations
 
-import glob
-import gzip
+import argparse
 import json
 import os
 import sys
-from collections import defaultdict
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-DMA_MARKERS = ("dma", "copy", "memcpy", "transfer", "infeed", "outfeed")
+from mpi_cuda_imagemanipulation_tpu.obs.profile import (  # noqa: E402
+    DMA_MARKERS,  # noqa: F401  (re-export: round-3 scripts import it here)
+    load_device_trace,
+    merge_and_summarize,
+    summarize,
+    summary_table,
+)
 
 
 def _load_trace_events(out_dir: str) -> list[dict]:
-    paths = sorted(
-        glob.glob(os.path.join(out_dir, "**", "*.json.gz"), recursive=True),
-        key=os.path.getmtime,
+    """Back-compat alias for the pre-graduation name."""
+    return load_device_trace(out_dir)
+
+
+def run_merge(args: argparse.Namespace) -> int:
+    out = args.out or "merged_trace"
+    merged_json = f"{out}.json"
+    summary = merge_and_summarize(
+        args.merge_host_trace, args.device_trace, merged_out=merged_json
     )
-    if not paths:
-        return []
-    with gzip.open(paths[-1], "rt") as f:
-        data = json.load(f)
-    return data.get("traceEvents", data) if isinstance(data, dict) else data
+    lines = [
+        "# Merged host-span + device-trace summary",
+        "",
+        f"Host spans: `{args.merge_host_trace}` "
+        f"({summary['host_events']} events); device trace: "
+        f"`{args.device_trace}` ({summary['device_events']} events); "
+        f"combined timeline: `{merged_json}` (open in ui.perfetto.dev).",
+        "",
+        f"Device DMA-shaped time: {summary.get('device_dma_us', 0)} us; "
+        f"device compute-shaped time: "
+        f"{summary.get('device_compute_us', 0)} us.",
+        "",
+    ] + summary_table(summary)
+    summary_md = f"{out}_summary.md"
+    summary_json = f"{out}_summary.json"
+    with open(summary_md, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    with open(summary_json, "w") as f:
+        json.dump(summary, f, indent=1)
+    print(f"wrote {merged_json} / {summary_md} / {summary_json}", flush=True)
+    return 0
 
 
-def summarize(events: list[dict]) -> dict:
-    pid_name: dict = {}
-    tid_name: dict = {}
-    for e in events:
-        if e.get("ph") == "M" and e.get("name") == "process_name":
-            pid_name[e.get("pid")] = e.get("args", {}).get("name", "")
-        if e.get("ph") == "M" and e.get("name") == "thread_name":
-            tid_name[(e.get("pid"), e.get("tid"))] = e.get("args", {}).get(
-                "name", ""
-            )
-    agg: dict = defaultdict(lambda: [0.0, 0])  # (proc, name) -> [us, count]
-    proc_total: dict = defaultdict(float)
-    for e in events:
-        if e.get("ph") != "X":
-            continue
-        dur = float(e.get("dur", 0.0))
-        proc = pid_name.get(e.get("pid"), str(e.get("pid")))
-        key = (proc, e.get("name", "?"))
-        agg[key][0] += dur
-        agg[key][1] += 1
-        proc_total[proc] += dur
-    top = sorted(agg.items(), key=lambda kv: -kv[1][0])[:40]
-    # device-side DMA vs compute split: XLA device tracks are the processes
-    # that are not the python host thread
-    device_procs = {
-        p for p in proc_total if not p.lower().startswith(("python", "/host"))
-    }
-    dma_us = comp_us = 0.0
-    for (proc, name), (us, _n) in agg.items():
-        if proc not in device_procs:
-            continue
-        if any(m in name.lower() for m in DMA_MARKERS):
-            dma_us += us
-        else:
-            comp_us += us
-    return {
-        "processes": {p: round(v, 1) for p, v in sorted(proc_total.items())},
-        "device_dma_us": round(dma_us, 1),
-        "device_compute_us": round(comp_us, 1),
-        "top_events": [
-            {
-                "process": proc,
-                "name": name,
-                "total_us": round(us, 1),
-                "count": n,
-            }
-            for (proc, name), (us, n) in top
-        ],
-    }
-
-
-def main() -> int:
-    out_dir = sys.argv[1] if len(sys.argv) > 1 else "profile_r03"
+def run_capture(out_dir: str) -> int:
     summary_json = f"{out_dir}_summary.json"
     summary_md = f"{out_dir}_summary.md"
     import jax
@@ -139,7 +122,7 @@ def main() -> int:
                 for _ in range(30):
                     out = fn(img)
                 _sync(out)
-            events = _load_trace_events(vdir)
+            events = load_device_trace(vdir)
             print(f"{variant}: trace events: {len(events)}", flush=True)
             summary = (
                 summarize(events) if events else {"error": "no perfetto trace"}
@@ -160,14 +143,7 @@ def main() -> int:
             f"{summary.get('device_compute_us', 0)} us."
             + (f" ERROR: {summary['error']}" if "error" in summary else ""),
             "",
-            "| process | event | total us | count |",
-            "|---|---|---|---|",
-        ]
-        for t in summary.get("top_events", []):
-            lines.append(
-                f"| {t['process']} | {t['name'][:60]} | "
-                f"{t['total_us']} | {t['count']} |"
-            )
+        ] + summary_table(summary)
         # write after EVERY variant: a later variant wedging (and the step
         # timeout killing the process) must not lose an earlier variant's
         # completed measurement
@@ -179,6 +155,36 @@ def main() -> int:
     # the u8 headline trace is the round's required artifact; swar is
     # best-effort diagnosis
     return 0 if "error" not in combined["pallas"] else 1
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="profile_capture")
+    ap.add_argument("out_dir", nargs="?", default="profile_r03")
+    ap.add_argument(
+        "--merge-host-trace",
+        default=None,
+        metavar="SPANS_JSON",
+        help="merge this obs --trace-out span file with --device-trace "
+        "onto one timeline instead of capturing (works on any backend)",
+    )
+    ap.add_argument(
+        "--device-trace",
+        default=None,
+        metavar="DIR_OR_JSON",
+        help="jax.profiler output dir (newest *.json.gz inside) or a "
+        "plain trace json; required with --merge-host-trace",
+    )
+    ap.add_argument(
+        "--out",
+        default=None,
+        help="merge-mode output stem (default merged_trace)",
+    )
+    args = ap.parse_args(argv)
+    if args.merge_host_trace:
+        if not args.device_trace:
+            ap.error("--merge-host-trace requires --device-trace")
+        return run_merge(args)
+    return run_capture(args.out_dir)
 
 
 if __name__ == "__main__":
