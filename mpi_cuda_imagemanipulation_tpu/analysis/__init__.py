@@ -1,0 +1,28 @@
+"""mcim-check — the repo-native static analysis suite (CI gate).
+
+Four rule families over the repo's own conventions, plus a runtime
+lock-order recorder that validates the static concurrency model:
+
+  * concurrency (rules_concurrency.py) — static lock-order graph,
+    blocking-calls-under-lock, guard-consistency for shared attributes;
+  * tracer (rules_tracer.py) — JAX tracer escapes (host casts, np.* on
+    traced values, Python control flow on tracers), jit-closure
+    recompile keys, use-after-donation;
+  * obs (rules_obs.py) — span lifecycle, metric naming scheme,
+    failpoint site registry;
+  * surface (rules_surface.py) — CLI flags and MCIM_* env vars vs the
+    docs and the utils/env.py registry.
+
+Run via ``python tools/mcim_check.py`` (text or ``--format json``);
+suppress a false positive inline with ``# mcim: allow(<rule>: reason)``.
+Rule catalog: docs/design.md "Static analysis & invariants".
+"""
+
+from mpi_cuda_imagemanipulation_tpu.analysis.core import (  # noqa: F401
+    RULES,
+    Finding,
+    Repo,
+    render_json,
+    render_text,
+    run,
+)

@@ -1,0 +1,376 @@
+"""Observability-contract rules — span lifecycle, metric naming,
+failpoint site registry.
+
+The obs/ fabric only yields one joined timeline if every subsystem keeps
+three contracts, all mechanical enough to machine-check:
+
+  * **obs-span-leak** — every ``obs_trace.span(...)``/``start_trace(...)``
+    handle must be closed: used as a context manager, ``.end()``-ed in
+    the same function, or handed off (stored on an object/dict, returned,
+    or passed on) to whoever closes it. A dropped handle is a span that
+    never lands in the export — the trace shows a hole exactly where the
+    interesting latency went.
+  * **obs-metric-name** / **obs-metric-kind-drift** — metric families
+    follow ``mcim_<subsystem>_<what>[_total|_seconds]`` (docs/design.md
+    "Observability"): counters end ``_total``, duration histograms end
+    ``_seconds``, subsystems come from the known set. One name must keep
+    one kind across every registration site (the Registry dedups by
+    name, so a kind clash would raise at runtime — in whichever process
+    happens to register both).
+  * **obs-failpoint-unknown** / **obs-failpoint-unused** — every
+    ``failpoints.maybe_fail("site")``/``install("site")`` literal must
+    exist in ``resilience/failpoints.py``'s ``KNOWN_SITES`` (the typo'd
+    site would never fire), and every registered site must be called
+    somewhere (a dead registry entry is a recovery path no test can
+    reach).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from mpi_cuda_imagemanipulation_tpu.analysis.core import (
+    PACKAGE,
+    Repo,
+    checker,
+    make_finding,
+    rule,
+)
+
+rule(
+    "obs-span-leak", "obs",
+    "A span handle from obs_trace.span()/start_trace() is neither "
+    "closed (with/.end()) nor handed off — the span never reaches the "
+    "export.",
+)
+rule(
+    "obs-metric-name", "obs",
+    "Metric name violates the mcim_<subsystem>_<what>[_total|_seconds] "
+    "scheme (counters end _total, duration histograms _seconds).",
+)
+rule(
+    "obs-metric-kind-drift", "obs",
+    "The same metric name registered as different kinds "
+    "(counter/gauge/histogram) at different sites.",
+)
+rule(
+    "obs-failpoint-unknown", "obs",
+    "failpoints.maybe_fail()/install() names a site missing from "
+    "KNOWN_SITES in resilience/failpoints.py.",
+)
+rule(
+    "obs-failpoint-unused", "obs",
+    "A KNOWN_SITES entry is never exercised by any maybe_fail() call.",
+)
+
+_METRIC_RE = re.compile(
+    r"^mcim_(serve|engine|cache|breaker|health|batch|analysis)_"
+    r"[a-z0-9_]+$"
+)
+
+
+def _span_funcs(aliases: dict[str, str]) -> set[str]:
+    """Local names that resolve to obs.trace span constructors."""
+    out = set()
+    for alias, target in aliases.items():
+        if target.endswith((".span", ".start_trace")) and ".obs" in target:
+            out.add(alias)
+    return out
+
+
+@checker("obs")
+def check_obs(repo: Repo):
+    findings: list = []
+    findings.extend(_check_spans(repo))
+    findings.extend(_check_metrics(repo))
+    findings.extend(_check_failpoints(repo))
+    return findings
+
+
+# -- span lifecycle ----------------------------------------------------------
+
+
+def _is_span_call(node: ast.Call, aliases: dict[str, str],
+                  local_span_funcs: set[str]) -> bool:
+    fn = node.func
+    if isinstance(fn, ast.Attribute) and fn.attr in ("span", "start_trace"):
+        if isinstance(fn.value, ast.Name):
+            base = aliases.get(fn.value.id, fn.value.id)
+            return "trace" in base or "obs" in base or fn.value.id in (
+                "obs_trace", "tracer",
+            )
+        return False
+    if isinstance(fn, ast.Name):
+        return fn.id in local_span_funcs
+    return False
+
+
+def _check_spans(repo: Repo) -> list:
+    findings = []
+    for sf in repo.package_files() + [
+        f for f in repo.files if f.rel.startswith("tools/")
+    ]:
+        if sf.rel == f"{PACKAGE}/obs/trace.py":
+            continue  # the implementation itself
+        aliases = repo.alias_targets(sf.modname)
+        span_funcs = _span_funcs(aliases)
+        for fn in ast.walk(sf.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            # direct statements only — nested functions get their own turn
+            findings.extend(
+                _check_spans_in_function(sf, fn, aliases, span_funcs)
+            )
+    return findings
+
+
+def _check_spans_in_function(sf, fn, aliases, span_funcs) -> list:
+    findings = []
+    with_exprs: set[int] = set()  # id() of calls used as context managers
+    assigned: dict[str, int] = {}  # name -> line of span assignment
+    handed_off: set[str] = set()
+    ended: set[str] = set()
+    discarded: list[tuple[int, str]] = []
+
+    own_nodes = []
+    skip: set[int] = set()
+    for node in ast.walk(fn):
+        if node is not fn and isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ):
+            for sub in ast.walk(node):
+                skip.add(id(sub))
+    for node in ast.walk(fn):
+        if id(node) not in skip or node is fn:
+            own_nodes.append(node)
+
+    for node in own_nodes:
+        if isinstance(node, ast.With):
+            for item in node.items:
+                if isinstance(item.context_expr, ast.Call):
+                    with_exprs.add(id(item.context_expr))
+    for node in own_nodes:
+        if not isinstance(node, ast.Call):
+            continue
+        if not _is_span_call(node, aliases, span_funcs):
+            continue
+        if id(node) in with_exprs:
+            continue
+        # find how the result is used: walk statements
+        # (classified below via parent scan)
+        node._mcim_span = True  # type: ignore[attr-defined]
+    for node in own_nodes:
+        if isinstance(node, ast.Assign) and getattr(
+            node.value, "_mcim_span", False
+        ):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    assigned[tgt.id] = node.lineno
+                else:
+                    # req.trace = span(...) — handed off to the object
+                    pass
+            node.value._mcim_span = False
+        elif isinstance(node, ast.Expr) and getattr(
+            node.value, "_mcim_span", False
+        ):
+            discarded.append((node.lineno, "result discarded"))
+            node.value._mcim_span = False
+        elif isinstance(node, ast.Return) and getattr(
+            node.value, "_mcim_span", False
+        ):
+            node.value._mcim_span = False  # returned: caller owns it
+    # any still-marked span call is an argument / nested use: handed off
+    for node in own_nodes:
+        if isinstance(node, ast.Call) and getattr(
+            node, "_mcim_span", False
+        ):
+            node._mcim_span = False
+
+    if not assigned and not discarded:
+        return findings
+
+    for node in own_nodes:
+        if not isinstance(node, ast.Call):
+            continue
+        f2 = node.func
+        if (
+            isinstance(f2, ast.Attribute)
+            and f2.attr == "end"
+            and isinstance(f2.value, ast.Name)
+        ):
+            ended.add(f2.value.id)
+        else:
+            for a in list(node.args) + [k.value for k in node.keywords]:
+                if isinstance(a, ast.Name):
+                    handed_off.add(a.id)
+    for node in own_nodes:
+        if isinstance(node, ast.Assign):
+            # name stored onto an attribute/dict/other name: handed off
+            for tgt in node.targets:
+                if isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                    if isinstance(node.value, ast.Name):
+                        handed_off.add(node.value.id)
+        elif isinstance(node, ast.Return) and isinstance(
+            node.value, ast.Name
+        ):
+            handed_off.add(node.value.id)
+        elif isinstance(node, ast.With):
+            for item in node.items:
+                if isinstance(item.context_expr, ast.Name):
+                    ended.add(item.context_expr.id)
+
+    for name, line in assigned.items():
+        if name not in ended and name not in handed_off:
+            findings.append(
+                make_finding(
+                    "obs-span-leak", sf.rel, line,
+                    f"span handle {name!r} (in {fn.name}) is never "
+                    "ended or handed off",
+                )
+            )
+    for line, why in discarded:
+        findings.append(
+            make_finding(
+                "obs-span-leak", sf.rel, line,
+                f"span call {why} (in {fn.name}) — use `with` or keep "
+                "the handle and .end() it",
+            )
+        )
+    return findings
+
+
+# -- metric naming -----------------------------------------------------------
+
+_REG_METHODS = {"counter", "gauge", "histogram"}
+
+
+def _check_metrics(repo: Repo) -> list:
+    findings = []
+    sites: dict[str, list[tuple[str, str, int]]] = {}  # name -> (kind, file, line)
+    for sf in repo.package_files():
+        if sf.rel == f"{PACKAGE}/obs/metrics.py":
+            continue
+        for node in ast.walk(sf.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _REG_METHODS
+            ):
+                continue
+            if not node.args or not (
+                isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                continue
+            name = node.args[0].value
+            if not name.startswith("mcim"):
+                continue  # some other .counter() API
+            kind = node.func.attr
+            sites.setdefault(name, []).append((kind, sf.rel, node.lineno))
+            msg = None
+            if not _METRIC_RE.match(name):
+                msg = (
+                    f"metric {name!r} violates the "
+                    "mcim_<subsystem>_<what> scheme "
+                    "(subsystems: serve/engine/cache/breaker/health/"
+                    "batch/analysis)"
+                )
+            elif kind == "counter" and not name.endswith("_total"):
+                msg = f"counter {name!r} must end in _total"
+            elif kind == "histogram" and not name.endswith("_seconds"):
+                msg = (
+                    f"histogram {name!r} must end in _seconds "
+                    "(durations are seconds; consumers rescale)"
+                )
+            elif kind == "gauge" and name.endswith("_total"):
+                msg = (
+                    f"gauge {name!r} must not end in _total (reserved "
+                    "for counters)"
+                )
+            if msg:
+                findings.append(
+                    make_finding(
+                        "obs-metric-name", sf.rel, node.lineno, msg
+                    )
+                )
+    for name, regs in sites.items():
+        kinds = {k for k, _f, _l in regs}
+        if len(kinds) > 1:
+            k, f, l = regs[1]
+            findings.append(
+                make_finding(
+                    "obs-metric-kind-drift", f, l,
+                    f"metric {name!r} registered as {sorted(kinds)} at "
+                    "different sites: "
+                    + ", ".join(f"{ff}:{ll}({kk})" for kk, ff, ll in regs),
+                )
+            )
+    return findings
+
+
+# -- failpoint registry -------------------------------------------------------
+
+
+def _known_sites(repo: Repo) -> tuple[set[str], int]:
+    sf = repo.by_rel.get(f"{PACKAGE}/resilience/failpoints.py")
+    if sf is None:
+        return set(), 0
+    for node in sf.tree.body:
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == "KNOWN_SITES":
+                    vals = set()
+                    for e in ast.walk(node.value):
+                        if isinstance(e, ast.Constant) and isinstance(
+                            e.value, str
+                        ):
+                            vals.add(e.value)
+                    return vals, node.lineno
+    return set(), 0
+
+
+def _check_failpoints(repo: Repo) -> list:
+    findings = []
+    known, reg_line = _known_sites(repo)
+    if not known:
+        return findings
+    used: set[str] = set()
+    for sf in repo.files:
+        if sf.rel == f"{PACKAGE}/resilience/failpoints.py":
+            continue
+        for node in ast.walk(sf.tree):
+            if not (
+                isinstance(node, ast.Call) and node.args
+            ):
+                continue
+            fname = None
+            if isinstance(node.func, ast.Attribute):
+                fname = node.func.attr
+            elif isinstance(node.func, ast.Name):
+                fname = node.func.id
+            if fname not in ("maybe_fail", "install"):
+                continue
+            a0 = node.args[0]
+            if isinstance(a0, ast.Constant) and isinstance(a0.value, str):
+                site = a0.value
+                if fname == "maybe_fail":
+                    used.add(site)
+                if site not in known:
+                    findings.append(
+                        make_finding(
+                            "obs-failpoint-unknown", sf.rel, node.lineno,
+                            f"failpoint site {site!r} is not in "
+                            "KNOWN_SITES (resilience/failpoints.py)",
+                        )
+                    )
+    for site in sorted(known - used):
+        findings.append(
+            make_finding(
+                "obs-failpoint-unused",
+                f"{PACKAGE}/resilience/failpoints.py", reg_line,
+                f"KNOWN_SITES entry {site!r} has no maybe_fail() caller "
+                "anywhere in the repo",
+            )
+        )
+    return findings

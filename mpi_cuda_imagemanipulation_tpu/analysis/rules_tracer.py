@@ -1,0 +1,761 @@
+"""JAX tracer-safety rules — host escapes, control flow, recompile keys,
+use-after-donation.
+
+Inside a ``jax.jit``/``vmap``/``shard_map``/``pallas_call``-traced
+function the array arguments are *tracers*: Python control flow on them
+fails at trace time (or silently specializes), host casts
+(``float``/``int``/``bool``/``.item()``) either raise
+``ConcretizationTypeError`` under jit or force a device sync outside it,
+and ``np.*`` calls pull the value to host and break the trace. The repo
+is full of *legitimate* host-side numpy (kernel weights, tap tables —
+concrete at trace time), so a naive "no np inside jitted code" rule
+would drown in noise. Instead this checker runs a positional taint
+analysis:
+
+  * roots: callables literally passed to ``jax.jit``, ``jax.vmap``,
+    ``pl.pallas_call``, ``shard_map`` (and the repo's compat wrappers),
+    resolved scope-aware (a nested ``run`` shadowing another module's
+    ``run`` resolves to the enclosing definition); their parameters are
+    the traced values;
+  * taint propagates through assignments, arithmetic, subscripts and
+    repo-internal calls (positionally, following from-imports and into
+    nested helper defs with their closure taint), but NOT through
+    ``.shape``/``.ndim``/``.dtype`` or ``len()`` — shape math is
+    static;
+  * a Python *list* of tracers is tracked separately (container taint):
+    iterating it is legal, the elements it yields are tracers.
+
+Also here:
+
+  * **tracer-recompile-closure** — a lambda handed to ``jax.jit`` inside
+    a loop that closes over the loop variable instead of binding it as a
+    default argument (``lambda x, b=bh:``): every iteration builds a new
+    closure identity, and a captured Python scalar that should have been
+    a bound static arg re-keys the jit cache (or silently captures the
+    wrong iteration when called later).
+  * **tracer-use-after-donate** — a callable built with ``donate=True``
+    (or ``donate_argnums``) invalidates its input buffer; reading the
+    same variable afterwards is use-after-free on device memory.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from mpi_cuda_imagemanipulation_tpu.analysis.core import (
+    Repo,
+    SourceFile,
+    checker,
+    make_finding,
+    rule,
+)
+
+rule(
+    "tracer-host-cast", "tracer",
+    "float()/int()/bool()/.item()/.tolist() applied to a traced value "
+    "inside a jit/shard_map/pallas-reachable function — raises "
+    "ConcretizationTypeError at trace time.",
+)
+rule(
+    "tracer-host-np", "tracer",
+    "np.* called on a traced value inside traced code — forces the "
+    "tracer to host and breaks the trace (use jnp).",
+)
+rule(
+    "tracer-control-flow", "tracer",
+    "Python if/while/for over a traced value inside traced code — "
+    "control flow must use lax.cond/lax.fori_loop or jnp.where.",
+)
+rule(
+    "tracer-recompile-closure", "tracer",
+    "Lambda passed to jax.jit inside a loop closes over the loop "
+    "variable (bind it as a default: `lambda x, b=b:`) — silent "
+    "recompile key / wrong-value capture.",
+)
+rule(
+    "tracer-use-after-donate", "tracer",
+    "A buffer passed to a donate=True callable is read again afterwards "
+    "— donation recycles the input's device memory into the output.",
+)
+
+_TRACE_WRAPPER_NAMES = {
+    "jit", "vmap", "pmap", "shard_map", "shard_map_compat", "_shard_map",
+    "pallas_call",
+}
+_UNTAINT_ATTRS = {"shape", "ndim", "dtype", "weak_type", "sharding",
+                  "itemsize", "nbytes"}
+# calls whose result is static even over tracers (len = leading dim)
+_PURE_STATIC_FUNCS = {"len", "range", "isinstance", "type", "id",
+                      "enumerate_static", "hasattr", "getattr"}
+_CAST_FUNCS = {"float", "int", "bool", "complex"}
+_HOST_METHODS = {"item", "tolist", "__array__"}
+
+
+def _callable_arg(call: ast.Call) -> ast.expr | None:
+    if call.args:
+        return call.args[0]
+    for k in call.keywords:
+        if k.arg in ("fun", "f", "kernel"):
+            return k.value
+    return None
+
+
+def _is_trace_wrapper(call: ast.Call, aliases: dict[str, str]) -> bool:
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr in _TRACE_WRAPPER_NAMES
+    if isinstance(fn, ast.Name):
+        target = aliases.get(fn.id, fn.id)
+        return (
+            fn.id in _TRACE_WRAPPER_NAMES
+            or target.rpartition(".")[2] in _TRACE_WRAPPER_NAMES
+        )
+    return False
+
+
+def _params(fn) -> list[str]:
+    a = fn.args
+    return [p.arg for p in a.posonlyargs + a.args]
+
+
+def _free_loads(fn) -> set[str]:
+    """Names loaded in fn's body that are not bound by its params."""
+    bound = set(_params(fn))
+    a = fn.args
+    bound.update(p.arg for p in a.kwonlyargs)
+    if a.vararg:
+        bound.add(a.vararg.arg)
+    if a.kwarg:
+        bound.add(a.kwarg.arg)
+    loads: set[str] = set()
+    body = [fn.body] if isinstance(fn, ast.Lambda) else fn.body
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Name):
+                if isinstance(node.ctx, ast.Load):
+                    loads.add(node.id)
+                else:
+                    bound.add(node.id)
+    return loads - bound
+
+
+class _FnIndex:
+    """Module-level function defs only — cross-module resolution follows
+    from-imports; nested defs are resolved scope-aware by the callers."""
+
+    def __init__(self, repo: Repo):
+        self.repo = repo
+
+    def resolve(self, modname: str, name: str):
+        fns = self.repo.functions.get(modname, {})
+        if name in fns:
+            sf = self.repo.module_file(modname)
+            if sf is not None:
+                return (sf, fns[name])
+        target = self.repo.imports.get(modname, {}).get(name)
+        if target and "." in target:
+            mod, _, fname = target.rpartition(".")
+            fns2 = self.repo.functions.get(mod, {})
+            if fname in fns2:
+                sf2 = self.repo.module_file(mod)
+                if sf2 is not None:
+                    return (sf2, fns2[fname])
+        return None
+
+
+def _scope_resolve(sf: SourceFile, call: ast.Call, name: str,
+                   parents: dict[int, ast.AST], index: _FnIndex):
+    """Resolve `name` at a call site: innermost enclosing function's
+    nested defs first, then module level / imports."""
+    node: ast.AST = call
+    while id(node) in parents:
+        node = parents[id(node)]
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Module)):
+            body = node.body
+            for stmt in body:
+                if (
+                    isinstance(stmt, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))
+                    and stmt.name == name
+                ):
+                    return (sf, stmt)
+    return index.resolve(sf.modname, name)
+
+
+class _TaintVisitor:
+    def __init__(
+        self,
+        repo: Repo,
+        sf: SourceFile,
+        fn,
+        tainted_params: frozenset[str],
+        container_params: frozenset[str],
+        index: _FnIndex,
+        findings: list,
+        enqueue,
+    ):
+        self.repo = repo
+        self.sf = sf
+        self.fn = fn
+        self.index = index
+        self.findings = findings
+        self.enqueue = enqueue
+        self.aliases = repo.alias_targets(sf.modname)
+        self.tainted: set[str] = set(tainted_params)
+        self.containers: set[str] = set(container_params)
+        self.np_aliases = {
+            a for a, t in self.aliases.items() if t == "numpy"
+        }
+        # nested defs local to this function (one level)
+        self.local_defs: dict[str, ast.AST] = {}
+        body = [fn.body] if isinstance(fn, ast.Lambda) else fn.body
+        for stmt in body:
+            for node in self._shallow_walk(stmt):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    self.local_defs.setdefault(node.name, node)
+
+    def _shallow_walk_body(self):
+        body = (
+            [self.fn.body]
+            if isinstance(self.fn, ast.Lambda)
+            else self.fn.body
+        )
+        for stmt in body:
+            yield from self._shallow_walk(stmt)
+
+    @staticmethod
+    def _shallow_walk(node):
+        """Walk without descending into nested function bodies."""
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                yield child  # the def itself, not its body
+            else:
+                yield from _TaintVisitor._shallow_walk(child)
+
+    # -- taint of an expression ---------------------------------------------
+
+    def is_tainted(self, node: ast.expr) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted or node.id in self.containers
+        if isinstance(node, ast.Attribute):
+            if node.attr in _UNTAINT_ATTRS:
+                return False
+            return self.is_tainted(node.value)
+        if isinstance(node, ast.Subscript):
+            return self.is_tainted(node.value)
+        if isinstance(node, ast.BinOp):
+            return self.is_tainted(node.left) or self.is_tainted(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.is_tainted(node.operand)
+        if isinstance(node, ast.Compare):
+            return self.is_tainted(node.left) or any(
+                self.is_tainted(c) for c in node.comparators
+            )
+        if isinstance(node, ast.BoolOp):
+            return any(self.is_tainted(v) for v in node.values)
+        if isinstance(node, ast.IfExp):
+            return self.is_tainted(node.body) or self.is_tainted(node.orelse)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self.is_tainted(e) for e in node.elts)
+        if isinstance(node, ast.Starred):
+            return self.is_tainted(node.value)
+        if isinstance(node, (ast.ListComp, ast.GeneratorExp)):
+            return self.is_tainted(node.elt)
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Name) and fn.id in _PURE_STATIC_FUNCS:
+                return False
+            args_tainted = any(
+                self.is_tainted(a) for a in node.args
+            ) or any(self.is_tainted(k.value) for k in node.keywords)
+            if not args_tainted:
+                return False
+            # resolvable repo callee: ask whether any of its returns is
+            # actually tainted under these arguments (a shape/eligibility
+            # predicate over a tracer returns a static bool)
+            return self._call_returns_tainted(node)
+        return False
+
+    def _call_returns_tainted(self, node: ast.Call) -> bool:
+        callee = self._resolve_callee(node.func)
+        if callee is None:
+            return True  # unknown: conservative
+        csf, cfn = callee
+        if isinstance(cfn, ast.Lambda):
+            return True
+        params = _params(cfn)
+        tainted_params: set[str] = set()
+        container_params: set[str] = set()
+        for i, a in enumerate(node.args):
+            if i < len(params) and self.is_tainted(a):
+                (container_params
+                 if self._is_container(a) else tainted_params).add(
+                    params[i]
+                )
+        for k in node.keywords:
+            if k.arg in params and self.is_tainted(k.value):
+                (container_params
+                 if self._is_container(k.value) else tainted_params).add(
+                    k.arg
+                )
+        return _returns_tainted(
+            self.repo, self.index, csf, cfn,
+            frozenset(tainted_params), frozenset(container_params),
+        )
+
+    def _resolve_callee(self, fn: ast.expr):
+        if isinstance(fn, ast.Name):
+            if fn.id in self.local_defs:
+                return (self.sf, self.local_defs[fn.id])
+            return self.index.resolve(self.sf.modname, fn.id)
+        if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+            base = self.aliases.get(fn.value.id, fn.value.id)
+            return self.index.resolve(base, fn.attr)
+        return None
+
+    def _is_container(self, node: ast.expr) -> bool:
+        """A Python sequence whose *elements* are traced (iteration is
+        static; the yielded values are tracers)."""
+        if isinstance(node, ast.Name):
+            return node.id in self.containers
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return True  # literal sequence: iterating it is static
+        if isinstance(node, (ast.ListComp, ast.GeneratorExp)):
+            return True
+        if isinstance(node, ast.Call):
+            f = node.func
+            name = (
+                f.id if isinstance(f, ast.Name)
+                else f.attr if isinstance(f, ast.Attribute) else ""
+            )
+            return name in ("zip", "enumerate", "reversed", "sorted",
+                            "list", "tuple", "items", "values", "keys",
+                            "range")
+        return False
+
+    # -- walking -------------------------------------------------------------
+
+    def run(self) -> None:
+        body = (
+            [self.fn.body]
+            if isinstance(self.fn, ast.Lambda)
+            else self.fn.body
+        )
+        for _ in range(2):  # loop-carried assignments settle
+            before = (set(self.tainted), set(self.containers))
+            for stmt in body:
+                if isinstance(stmt, ast.stmt):
+                    self.stmt(stmt)
+                else:
+                    self.check_expr(stmt)
+            if (set(self.tainted), set(self.containers)) == before:
+                break
+
+    def stmt(self, node: ast.stmt) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # analyzed on call (with closure taint)
+        if isinstance(node, ast.Assign):
+            self.check_expr(node.value)
+            container = self._is_container(node.value) and self.is_tainted(
+                node.value
+            )
+            t = self.is_tainted(node.value)
+            for tgt in node.targets:
+                self.assign_target(tgt, t, container)
+            return
+        if isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            if node.value is not None:
+                self.check_expr(node.value)
+                if isinstance(node.target, ast.Name) and self.is_tainted(
+                    node.value
+                ):
+                    self.tainted.add(node.target.id)
+            return
+        if isinstance(node, (ast.If, ast.While)):
+            self.check_expr(node.test)
+            if self.is_tainted(node.test) and not self._is_container(
+                node.test
+            ):
+                self.findings.append(
+                    make_finding(
+                        "tracer-control-flow", self.sf.rel,
+                        node.test.lineno,
+                        "Python control flow on a traced value "
+                        f"(in {self._fn_name()}) — use lax.cond/"
+                        "jnp.where",
+                    )
+                )
+            for s in node.body + node.orelse:
+                self.stmt(s)
+            return
+        if isinstance(node, ast.For):
+            self.check_expr(node.iter)
+            tainted_iter = self.is_tainted(node.iter)
+            if tainted_iter and not self._is_container(node.iter):
+                self.findings.append(
+                    make_finding(
+                        "tracer-control-flow", self.sf.rel,
+                        node.iter.lineno,
+                        "Python iteration over a traced value "
+                        f"(in {self._fn_name()}) — use lax.fori_loop/"
+                        "scan",
+                    )
+                )
+            self.assign_target(node.target, tainted_iter, False)
+            for s in node.body + node.orelse:
+                self.stmt(s)
+            return
+        if isinstance(node, ast.Return):
+            if node.value is not None:
+                self.check_expr(node.value)
+            return
+        for field in ast.iter_fields(node):
+            val = field[1]
+            items = val if isinstance(val, list) else [val]
+            for it in items:
+                if isinstance(it, ast.stmt):
+                    self.stmt(it)
+                elif isinstance(it, ast.expr):
+                    self.check_expr(it)
+
+    def assign_target(
+        self, tgt: ast.expr, tainted: bool, container: bool
+    ) -> None:
+        if isinstance(tgt, ast.Name):
+            if container:
+                self.containers.add(tgt.id)
+                self.tainted.discard(tgt.id)
+            elif tainted:
+                self.tainted.add(tgt.id)
+                self.containers.discard(tgt.id)
+            else:
+                self.tainted.discard(tgt.id)
+                self.containers.discard(tgt.id)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for e in tgt.elts:
+                self.assign_target(e, tainted, container)
+
+    def _fn_name(self) -> str:
+        return getattr(self.fn, "name", "<lambda>")
+
+    # -- expression checks ---------------------------------------------------
+
+    def check_expr(self, node: ast.expr | None) -> None:
+        if node is None:
+            return
+        for sub in self._shallow_walk(node):
+            if isinstance(sub, ast.Call):
+                self.check_call(sub)
+            elif isinstance(sub, ast.Lambda):
+                # lambdas analyzed inline with closure taint (they run
+                # inside the traced region when called)
+                inner = _TaintVisitor(
+                    self.repo, self.sf, sub,
+                    frozenset(self.tainted & _free_loads(sub)),
+                    frozenset(self.containers & _free_loads(sub)),
+                    self.index, self.findings, self.enqueue,
+                )
+                inner.run()
+
+    def check_call(self, node: ast.Call) -> None:
+        fn = node.func
+        if isinstance(fn, ast.Name) and fn.id in _CAST_FUNCS:
+            if node.args and self.is_tainted(node.args[0]):
+                self.findings.append(
+                    make_finding(
+                        "tracer-host-cast", self.sf.rel, node.lineno,
+                        f"{fn.id}() on a traced value (in "
+                        f"{self._fn_name()})",
+                    )
+                )
+            return
+        if (
+            isinstance(fn, ast.Attribute)
+            and fn.attr in _HOST_METHODS
+            and self.is_tainted(fn.value)
+        ):
+            self.findings.append(
+                make_finding(
+                    "tracer-host-cast", self.sf.rel, node.lineno,
+                    f".{fn.attr}() on a traced value (in "
+                    f"{self._fn_name()})",
+                )
+            )
+            return
+        if (
+            isinstance(fn, ast.Attribute)
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id in self.np_aliases
+        ):
+            if any(self.is_tainted(a) for a in node.args):
+                self.findings.append(
+                    make_finding(
+                        "tracer-host-np", self.sf.rel, node.lineno,
+                        f"np.{fn.attr}() on a traced value (in "
+                        f"{self._fn_name()}) — use jnp",
+                    )
+                )
+            return
+        # repo-internal call with tainted args -> analyze the callee
+        callee = None
+        if isinstance(fn, ast.Name):
+            if fn.id in self.local_defs:
+                callee = (self.sf, self.local_defs[fn.id])
+            else:
+                callee = self.index.resolve(self.sf.modname, fn.id)
+        elif isinstance(fn, ast.Attribute) and isinstance(
+            fn.value, ast.Name
+        ):
+            base = self.aliases.get(fn.value.id, fn.value.id)
+            callee = self.index.resolve(base, fn.attr)
+        if callee is None:
+            return
+        csf, cfn = callee
+        params = _params(cfn)
+        tainted_params: set[str] = set()
+        container_params: set[str] = set()
+        for i, a in enumerate(node.args):
+            if i < len(params) and self.is_tainted(a):
+                (container_params
+                 if self._is_container(a) else tainted_params).add(
+                    params[i]
+                )
+        for k in node.keywords:
+            if k.arg in params and self.is_tainted(k.value):
+                (container_params
+                 if self._is_container(k.value) else tainted_params).add(
+                    k.arg
+                )
+        if tainted_params or container_params:
+            # closure taint rides along for nested defs
+            if cfn in self.local_defs.values():
+                free = _free_loads(cfn)
+                tainted_params |= self.tainted & free
+                container_params |= self.containers & free
+            self.enqueue(
+                csf, cfn, frozenset(tainted_params),
+                frozenset(container_params),
+            )
+
+
+_RETURN_TAINT_MEMO: dict[tuple, bool] = {}
+_RETURN_TAINT_DEPTH = {"n": 0}
+
+
+def _returns_tainted(repo, index, sf, fn, tainted, containers) -> bool:
+    """Whether any `return` in `fn` yields a tainted value given tainted
+    params — memoized, depth-bounded (cycles resolve conservative)."""
+    key = (sf.rel, getattr(fn, "lineno", 0), tainted, containers)
+    if key in _RETURN_TAINT_MEMO:
+        return _RETURN_TAINT_MEMO[key]
+    if _RETURN_TAINT_DEPTH["n"] >= 4:
+        return True
+    _RETURN_TAINT_MEMO[key] = True  # cycle default: conservative
+    _RETURN_TAINT_DEPTH["n"] += 1
+    try:
+        v = _TaintVisitor(
+            repo, sf, fn, tainted, containers, index, [],
+            lambda *a: None,
+        )
+        v.run()
+        out = False
+        for node in v._shallow_walk_body():
+            if isinstance(node, ast.Return) and node.value is not None:
+                if v.is_tainted(node.value):
+                    out = True
+                    break
+    finally:
+        _RETURN_TAINT_DEPTH["n"] -= 1
+    _RETURN_TAINT_MEMO[key] = out
+    return out
+
+
+@checker("tracer")
+def check_tracer(repo: Repo):
+    # the memo is keyed by repo-relative paths: two different roots (the
+    # real tree vs a test fixture dir) may reuse a rel+lineno, so the
+    # cache must not outlive one checker invocation
+    _RETURN_TAINT_MEMO.clear()
+    findings: list = []
+    index = _FnIndex(repo)
+    seen: set[tuple] = set()
+    work: list[tuple] = []
+
+    def enqueue(sf, fn, tainted, containers) -> None:
+        key = (sf.rel, getattr(fn, "lineno", 0), tainted, containers)
+        if key not in seen and len(seen) < 4000:
+            seen.add(key)
+            work.append((sf, fn, tainted, containers))
+
+    scope = [
+        f for f in repo.files
+        if f.rel.startswith(("mpi_cuda_imagemanipulation_tpu/", "tools/"))
+        or f.rel in ("bench.py",)
+    ]
+    for sf in scope:
+        aliases = repo.alias_targets(sf.modname)
+        parents: dict[int, ast.AST] = {}
+        for parent in ast.walk(sf.tree):
+            for child in ast.iter_child_nodes(parent):
+                parents[id(child)] = parent
+        for node in ast.walk(sf.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and _is_trace_wrapper(node, aliases)
+            ):
+                continue
+            target = _callable_arg(node)
+            if isinstance(target, ast.Lambda):
+                enqueue(
+                    sf, target, frozenset(_params(target)), frozenset()
+                )
+            elif isinstance(target, ast.Name):
+                resolved = _scope_resolve(
+                    sf, node, target.id, parents, index
+                )
+                if resolved is not None:
+                    enqueue(
+                        resolved[0], resolved[1],
+                        frozenset(_params(resolved[1])), frozenset(),
+                    )
+
+    while work:
+        sf, fn, tainted, containers = work.pop()
+        _TaintVisitor(
+            repo, sf, fn, tainted, containers, index, findings, enqueue
+        ).run()
+
+    findings.extend(_check_recompile_closures(repo))
+    findings.extend(_check_use_after_donate(repo))
+    return findings
+
+
+# -- recompile-key closures --------------------------------------------------
+
+
+def _check_recompile_closures(repo: Repo) -> list:
+    findings = []
+    for sf in repo.files:
+        if not sf.rel.startswith(
+            ("mpi_cuda_imagemanipulation_tpu/", "tools/", "bench")
+        ):
+            continue
+        aliases = repo.alias_targets(sf.modname)
+        for loop in ast.walk(sf.tree):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            targets: set[str] = set()
+            if isinstance(loop, ast.For):
+                for t in ast.walk(loop.target):
+                    if isinstance(t, ast.Name):
+                        targets.add(t.id)
+            if not targets:
+                continue
+            for node in ast.walk(loop):
+                if not (
+                    isinstance(node, ast.Call)
+                    and _is_trace_wrapper(node, aliases)
+                ):
+                    continue
+                lam = _callable_arg(node)
+                if not isinstance(lam, ast.Lambda):
+                    continue
+                bound = {
+                    a.arg for a in lam.args.args + lam.args.kwonlyargs
+                }
+                free_loop_vars = set()
+                for n in ast.walk(lam.body):
+                    if (
+                        isinstance(n, ast.Name)
+                        and isinstance(n.ctx, ast.Load)
+                        and n.id in targets
+                        and n.id not in bound
+                    ):
+                        free_loop_vars.add(n.id)
+                if free_loop_vars:
+                    v = sorted(free_loop_vars)[0]
+                    findings.append(
+                        make_finding(
+                            "tracer-recompile-closure", sf.rel,
+                            lam.lineno,
+                            "lambda passed to a jit wrapper closes over "
+                            f"loop variable(s) {sorted(free_loop_vars)} "
+                            f"— bind as default args (lambda ..., "
+                            f"{v}={v}: ...)",
+                        )
+                    )
+    return findings
+
+
+# -- use-after-donation ------------------------------------------------------
+
+
+def _check_use_after_donate(repo: Repo) -> list:
+    findings = []
+    for sf in repo.files:
+        if not sf.rel.startswith(("mpi_cuda_imagemanipulation_tpu/",)):
+            continue
+        for fn in ast.walk(sf.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            donating: set[str] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call
+                ):
+                    call = node.value
+                    donates = any(
+                        k.arg in ("donate", "donate_argnums")
+                        and not (
+                            isinstance(k.value, ast.Constant)
+                            and k.value.value in (False, None)
+                        )
+                        for k in call.keywords
+                    )
+                    if donates:
+                        for tgt in node.targets:
+                            if isinstance(tgt, ast.Name):
+                                donating.add(tgt.id)
+            if not donating:
+                continue
+            # linear scan: a Name arg passed to a donating callable must
+            # not be loaded again later without reassignment
+            events: list[tuple[int, str, str]] = []
+            for node in ast.walk(fn):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in donating
+                ):
+                    for a in node.args:
+                        if isinstance(a, ast.Name):
+                            events.append((node.lineno, "donate", a.id))
+                elif isinstance(node, ast.Name):
+                    kind = (
+                        "store"
+                        if isinstance(node.ctx, (ast.Store, ast.Del))
+                        else "load"
+                    )
+                    events.append((node.lineno, kind, node.id))
+            events.sort()
+            for line, kind, name in [e for e in events if e[1] == "donate"]:
+                for l2, k2, n2 in events:
+                    if n2 != name or l2 <= line:
+                        continue
+                    if k2 == "store":
+                        break
+                    if k2 == "load":
+                        findings.append(
+                            make_finding(
+                                "tracer-use-after-donate", sf.rel, l2,
+                                f"{name!r} read after being passed to a "
+                                f"donate=True callable at line {line} — "
+                                "its device buffer was recycled",
+                            )
+                        )
+                        break
+    return findings

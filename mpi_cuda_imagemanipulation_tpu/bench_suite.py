@@ -29,6 +29,7 @@ from mpi_cuda_imagemanipulation_tpu.parallel.mesh import (
     make_mesh,
     shard_map_compat,
 )
+from mpi_cuda_imagemanipulation_tpu.utils import env as env_registry
 from mpi_cuda_imagemanipulation_tpu.utils.log import emit_json_metrics, get_logger
 from mpi_cuda_imagemanipulation_tpu.utils.platform import is_tpu_backend
 from mpi_cuda_imagemanipulation_tpu.utils.timing import device_throughput
@@ -138,7 +139,7 @@ def _halo_ab_enabled() -> bool:
     per-group comms breakdown. MCIM_HALO_AB=1 forces it on, =0 off;
     default: only on real TPU hardware (the extra compiles are worth chip
     minutes, not CPU test minutes)."""
-    v = os.environ.get("MCIM_HALO_AB", "")
+    v = env_registry.get("MCIM_HALO_AB") or ""
     if v == "1":
         return True
     if v == "0":
@@ -340,7 +341,7 @@ def mxu_ab_params() -> dict:
         ("MCIM_MXU_AB_HEIGHT", "height", int),
         ("MCIM_MXU_AB_WIDTH", "width", int),
     ):
-        raw = os.environ.get(env)
+        raw = env_registry.get(env)
         if raw:
             params[key] = cast(raw)
     return params
@@ -486,7 +487,7 @@ def engine_ab_params() -> dict:
         ("MCIM_ENGINE_AB_ENCODE_MS", "encode_ms", float),
         ("MCIM_ENGINE_AB_INFLIGHT", "inflight", int),
     ):
-        raw = os.environ.get(env)
+        raw = env_registry.get(env)
         if raw:
             params[key] = cast(raw)
     return params
@@ -699,15 +700,15 @@ def serve_loadgen_params() -> dict:
         # shed fractions under faults; 0 = fault-free latency sweep
         "fault_rate": 0.0,
     }
-    rps_env = os.environ.get("MCIM_SERVE_RPS")
+    rps_env = env_registry.get("MCIM_SERVE_RPS")
     if rps_env:
         params["offered_rps"] = tuple(
             float(t) for t in rps_env.split(",") if t.strip()
         )
-    dur_env = os.environ.get("MCIM_SERVE_DURATION_S")
+    dur_env = env_registry.get("MCIM_SERVE_DURATION_S")
     if dur_env:
         params["duration_s"] = float(dur_env)
-    fault_env = os.environ.get("MCIM_SERVE_FAULT_RATE")
+    fault_env = env_registry.get("MCIM_SERVE_FAULT_RATE")
     if fault_env:
         params["fault_rate"] = float(fault_env)
     return params
@@ -736,10 +737,10 @@ def run_serve_loadgen(
     # default every request) and export the span timeline — per-rate
     # records then carry slowest_traces/failed_traces ids to pull p99
     # outliers up by id (serve/loadgen.py; the CI obs smoke lane uses this)
-    trace_out = os.environ.get("MCIM_TRACE_OUT")
+    trace_out = env_registry.get("MCIM_TRACE_OUT")
     if trace_out:
         obs_trace.configure(
-            sample=float(os.environ.get(obs_trace.ENV_SAMPLE, "1.0"))
+            sample=float(env_registry.get(obs_trace.ENV_SAMPLE) or "1.0")
         )
     app = ServeApp(
         ServeConfig(

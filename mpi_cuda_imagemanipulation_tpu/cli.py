@@ -1093,7 +1093,9 @@ def cmd_serve(args: argparse.Namespace) -> int:
             sorted({int(c) for c in args.channels.split(",") if c.strip()})
         )
     except ValueError:
-        raise ValueError(f"--channels must be comma-separated ints: {args.channels!r}")
+        raise ValueError(
+            f"--channels must be comma-separated ints: {args.channels!r}"
+        ) from None
     if not channels or not set(channels) <= {1, 3}:
         raise ValueError(f"--channels entries must be 1 and/or 3, got {channels}")
     cfg = ServeConfig(
@@ -1230,7 +1232,9 @@ def cmd_autotune(args: argparse.Namespace) -> int:
     try:
         candidates = [int(tok) for tok in args.blocks.split(",") if tok.strip()]
     except ValueError:
-        raise ValueError(f"--blocks must be comma-separated ints: {args.blocks!r}")
+        raise ValueError(
+            f"--blocks must be comma-separated ints: {args.blocks!r}"
+        ) from None
     if not candidates:
         raise ValueError("--blocks is empty")
     # the sweep must not leak env mutations: a caller's kill-switch or store

@@ -306,9 +306,11 @@ def configure(*, sample: float = 1.0, max_events: int = 200_000) -> Tracer:
     return _tracer
 
 
-def configure_from_env(env=os.environ) -> Tracer | None:
+def configure_from_env(env=None) -> Tracer | None:
     """Arm iff MCIM_TRACE_SAMPLE is set (a fraction; 1 = every trace)."""
-    raw = env.get(ENV_SAMPLE)
+    from mpi_cuda_imagemanipulation_tpu.utils import env as env_registry
+
+    raw = env_registry.get(ENV_SAMPLE, env=env)
     if raw:
         return configure(sample=float(raw))
     return None

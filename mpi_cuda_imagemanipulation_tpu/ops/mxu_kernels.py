@@ -82,8 +82,6 @@ MCIM_PREFER_SWAR).
 
 from __future__ import annotations
 
-import os
-
 import jax.numpy as jnp
 import numpy as np
 
@@ -96,6 +94,7 @@ from mpi_cuda_imagemanipulation_tpu.ops.spec import (
     pad2d,
 )
 from mpi_cuda_imagemanipulation_tpu.utils import calibration
+from mpi_cuda_imagemanipulation_tpu.utils import env as env_registry
 from mpi_cuda_imagemanipulation_tpu.utils.platform import is_tpu_backend
 
 B = 128  # one MXU / lane tile: the banded-matmul block width
@@ -110,7 +109,7 @@ def mxu_mode() -> str:
     """Execution mode: 'banded' (both separable passes on the MXU) or
     'hybrid' (row pass on the VPU, column pass on the MXU) — env
     MCIM_MXU_MODE, default banded."""
-    m = os.environ.get("MCIM_MXU_MODE", "") or "banded"
+    m = env_registry.get("MCIM_MXU_MODE") or "banded"
     if m not in MXU_MODES:
         raise ValueError(f"MCIM_MXU_MODE={m!r}; known: {MXU_MODES}")
     return m
@@ -120,7 +119,7 @@ def mxu_col_variant() -> str:
     """Column-pass arithmetic: 'bf16split' (the proven 64a+b split — the
     production default) or 'f32' (direct f32 einsum, kept for the A/B
     lane) — env MCIM_MXU_COL."""
-    v = os.environ.get("MCIM_MXU_COL", "") or "bf16split"
+    v = env_registry.get("MCIM_MXU_COL") or "bf16split"
     if v not in MXU_COL_VARIANTS:
         raise ValueError(f"MCIM_MXU_COL={v!r}; known: {MXU_COL_VARIANTS}")
     return v
@@ -131,7 +130,7 @@ def prefer_mxu() -> bool:
     routes eligible stencil groups through the MXU path on every auto
     path without a calibration entry. Honored only on real TPU backends —
     auto must never route to the MXU on platforms that lack one."""
-    return os.environ.get("MCIM_PREFER_MXU", "") not in ("", "0")
+    return env_registry.get_bool("MCIM_PREFER_MXU")
 
 
 # --------------------------------------------------------------------------

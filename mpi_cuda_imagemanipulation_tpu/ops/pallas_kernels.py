@@ -944,9 +944,9 @@ def prefer_swar() -> bool:
     off; it remains for A/B reproduction. The sharded runner snapshots
     this flag once at build time (sharded_pipeline), so a mid-session env
     change never splits routing across retraces."""
-    import os
+    from mpi_cuda_imagemanipulation_tpu.utils import env as env_registry
 
-    return os.environ.get("MCIM_PREFER_SWAR", "") not in ("", "0")
+    return env_registry.get_bool("MCIM_PREFER_SWAR")
 
 
 def pipeline_auto(

@@ -236,6 +236,7 @@ def _piece_edge_rows(pieces, k: int):
     is what lets the cross-group prefetch ppermute issue as soon as the
     previous group's boundary rows are final."""
     first, need = [], k
+    # mcim: allow(tracer-control-flow: pieces is a Python list of per-piece arrays; its length and shapes are static at trace time)
     for p in pieces:
         take = min(need, p.shape[0])
         if take:

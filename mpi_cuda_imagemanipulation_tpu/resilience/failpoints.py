@@ -30,7 +30,6 @@ flag check (no lock), so production code pays ~nothing.
 
 from __future__ import annotations
 
-import os
 import random
 import threading
 import zlib
@@ -130,12 +129,14 @@ def configure(spec: str | None, *, seed: int = 0) -> None:
         _active = bool(_sites)
 
 
-def configure_from_env(env=os.environ) -> None:
+def configure_from_env(env=None) -> None:
     """Arm from MCIM_FAILPOINTS / MCIM_FAILPOINT_SEED (no-op when unset —
     an already-armed in-process configuration is left alone)."""
-    spec = env.get(ENV_SPEC)
+    from mpi_cuda_imagemanipulation_tpu.utils import env as env_registry
+
+    spec = env_registry.get(ENV_SPEC, env=env)
     if spec:
-        configure(spec, seed=int(env.get(ENV_SEED, "0")))
+        configure(spec, seed=int(env_registry.get(ENV_SEED, env=env) or "0"))
 
 
 def install(site: str, decider) -> None:

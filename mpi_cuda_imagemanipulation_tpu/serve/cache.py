@@ -68,16 +68,21 @@ class CompileCache:
         self.warm_retries = 0
 
     def _on_trace(self) -> None:
-        self.traces += 1
+        # fired from inside jit tracing, which always happens OUTSIDE
+        # self._lock (warmup/get build off-lock below), so taking the
+        # lock here is deadlock-free — and the counter stays consistent
+        # with the locked readers (warmup's snapshot, stats())
+        with self._lock:
+            self.traces += 1
 
     def _build(self, key: Key):
+        """Construct (never store) the serving callable for one grid
+        cell — pure trace-graph building, safe off-lock."""
         bh, bw, ch, nb = key
-        fn = self.pipe.serving(
+        return self.pipe.serving(
             bh, bw, ch, nb,
             backend=self.backend, mesh=self.mesh, on_trace=self._on_trace,
         )
-        self._fns[key] = fn
-        return fn
 
     def _compile_one(self, key: Key) -> None:
         bh, bw, ch, nb = key
@@ -88,30 +93,38 @@ class CompileCache:
         true = np.full((nb,), min(bh, bw), dtype=np.int32)
         import jax
 
+        # trace + compile OUTSIDE the lock (mcim-check lock-blocking-call:
+        # a multi-second XLA compile must never stall concurrent get()s on
+        # the warmed grid); the lock guards only the dict insert
         jax.block_until_ready(fn(imgs, true, true))
+        with self._lock:
+            self._fns.setdefault(key, fn)
 
     def warmup(self) -> float:
         """Trace + compile the full shape grid; returns wall seconds."""
         t0 = time.perf_counter()
+        for bh, bw in self.buckets:
+            for ch in self.channels:
+                for nb in self.batch_buckets:
+                    key = (bh, bw, ch, nb)
+                    with self._lock:
+                        warmed = key in self._fns
+                    if not warmed:
+                        call_with_retry(
+                            lambda k=key: self._compile_one(k),
+                            policy=self.warm_retry_policy,
+                            on_retry=lambda a, e, d, k=key: (
+                                self._on_warm_retry(k, a, e)
+                            ),
+                        )
         with self._lock:
-            for bh, bw in self.buckets:
-                for ch in self.channels:
-                    for nb in self.batch_buckets:
-                        key = (bh, bw, ch, nb)
-                        if key not in self._fns:
-                            call_with_retry(
-                                lambda k=key: self._compile_one(k),
-                                policy=self.warm_retry_policy,
-                                on_retry=lambda a, e, d: self._on_warm_retry(
-                                    key, a, e
-                                ),
-                            )
             self.traces_at_warmup = self.traces
-        self.warmup_s = time.perf_counter() - t0
-        return self.warmup_s
+            self.warmup_s = time.perf_counter() - t0
+            return self.warmup_s
 
     def _on_warm_retry(self, key: Key, attempt: int, exc: Exception) -> None:
-        self.warm_retries += 1
+        with self._lock:
+            self.warm_retries += 1
         from mpi_cuda_imagemanipulation_tpu.utils.log import get_logger
 
         get_logger().warning(
@@ -136,7 +149,12 @@ class CompileCache:
                 return fn
             # off-grid key: serviceable, but a scheduler bug — count it
             self.misses += 1
-            return self._build(key)
+        # build OUTSIDE the lock (same contract as _compile_one: a trace
+        # must never stall warmed-path gets); two racing misses may both
+        # build, setdefault keeps exactly one
+        fn = self._build(key)
+        with self._lock:
+            return self._fns.setdefault(key, fn)
 
     def stats(self) -> dict:
         with self._lock:

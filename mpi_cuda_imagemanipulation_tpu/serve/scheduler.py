@@ -683,7 +683,7 @@ class MicroBatchScheduler:
                         lambda r=r: self._run_batch([r]),
                         policy=self.retry_policy,
                         rng=self._retry_rng,
-                        on_retry=lambda a, e, d: self._note_retry(
+                        on_retry=lambda a, e, d, r=r: self._note_retry(
                             bucket, a, e, d, live=(r,)
                         ),
                     )

@@ -37,6 +37,8 @@ import json
 import os
 import tempfile
 
+from mpi_cuda_imagemanipulation_tpu.utils import env as env_registry
+
 _ENV_FILE = "MCIM_CALIB_FILE"
 _ENV_DISABLE = "MCIM_NO_CALIB"
 _DEFAULT_NAME = ".mcim_calibration.json"
@@ -49,7 +51,9 @@ _cache: dict = {"key": None, "data": None}
 
 
 def calib_path() -> str:
-    return os.environ.get(_ENV_FILE) or os.path.join(os.getcwd(), _DEFAULT_NAME)
+    return env_registry.get(_ENV_FILE) or os.path.join(
+        os.getcwd(), _DEFAULT_NAME
+    )
 
 
 def _load() -> dict:
@@ -112,7 +116,7 @@ def lookup_block_h(
     under the min rule, but a silent perf regression). Entries without a
     recorded width (legacy stores) apply unconditionally.
     """
-    if os.environ.get(_ENV_DISABLE):
+    if env_registry.get(_ENV_DISABLE):
         return None
     if device_kind is None:
         try:
@@ -183,7 +187,7 @@ def lookup_backend_choice(
     'mxu' or 'hybrid'. None when no (valid, width-compatible) entry
     exists or MCIM_NO_CALIB is set — callers then keep their default
     (VPU/XLA) routing."""
-    if family is None or os.environ.get(_ENV_DISABLE):
+    if family is None or env_registry.get(_ENV_DISABLE):
         return None
     if device_kind is None:
         try:

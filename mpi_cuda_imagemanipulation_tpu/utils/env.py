@@ -1,0 +1,190 @@
+"""Central registry of every ``MCIM_*`` environment variable.
+
+Before this module the env surface was scattered: each subsystem read
+``os.environ`` directly, and nothing guaranteed a variable was documented
+— or even spelled consistently — across readers, docs and the tpu_queue
+scripts. Here every variable is declared ONCE with its default, consumer
+module and a one-line doc, and the package reads env state only through
+:func:`get`/:func:`get_bool`/... so a typo'd name fails loudly at the
+read site instead of silently returning the fallback forever.
+
+The declaration table is machine-checked, not aspirational: the
+``env-unregistered`` / ``env-undocumented`` rules in
+``mpi_cuda_imagemanipulation_tpu/analysis`` (run via
+``tools/mcim_check.py``, blocking in CI) verify that
+
+  * every ``MCIM_*`` literal read anywhere in the repo names a registered
+    variable,
+  * package modules go through this registry rather than ``os.environ``,
+  * every registered variable appears in README.md or docs/ (the table in
+    docs/design.md "Static analysis & invariants" is generated from
+    :func:`doc_table`).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class EnvVar:
+    name: str
+    default: str | None  # value get() returns when unset (None = unset)
+    consumer: str  # the module that reads it
+    doc: str  # one line; docs/design.md table row
+
+
+_VARS = (
+    # -- fault injection (resilience/failpoints.py) -------------------------
+    EnvVar("MCIM_FAILPOINTS", None, "resilience/failpoints.py",
+           "Arm deterministic fault injection: comma-separated site=mode "
+           "pairs (e.g. serve.dispatch=0.1,io.decode=first:2)."),
+    EnvVar("MCIM_FAILPOINT_SEED", "0", "resilience/failpoints.py",
+           "Seed for probabilistic failpoint modes (deterministic "
+           "fail/pass sequence per site)."),
+    # -- observability (obs/, utils/log.py) ---------------------------------
+    EnvVar("MCIM_TRACE_SAMPLE", None, "obs/trace.py",
+           "Arm request-scoped tracing at this sample fraction "
+           "(deterministic every-k-th; 1 = every trace)."),
+    EnvVar("MCIM_TRACE_OUT", None, "bench_suite.py",
+           "serve_loadgen lane: export the sweep's span timeline to this "
+           "path (Chrome/Perfetto JSON)."),
+    EnvVar("MCIM_LOG_LEVEL", None, "utils/log.py",
+           "Logger verbosity: level name or number (DEBUG..CRITICAL or "
+           "10..50); default INFO."),
+    # -- concurrency checking (analysis/lockcheck.py) -----------------------
+    EnvVar("MCIM_LOCK_CHECK", None, "analysis/lockcheck.py",
+           "=1: instrument threading.Lock/RLock/Condition with the "
+           "lock-order recorder for the whole test session; the observed "
+           "acquisition graph is asserted cycle-free at exit."),
+    # -- calibration store (utils/calibration.py) ---------------------------
+    EnvVar("MCIM_CALIB_FILE", None, "utils/calibration.py",
+           "Calibration store path (default ./.mcim_calibration.json)."),
+    EnvVar("MCIM_NO_CALIB", None, "utils/calibration.py",
+           "Any non-empty value disables calibration lookups (A/B tools "
+           "must not be steered by a committed store)."),
+    # -- backend routing switches (ops/) ------------------------------------
+    EnvVar("MCIM_PREFER_SWAR", None, "ops/pallas_kernels.py",
+           "=1: route eligible stencil groups through the SWAR "
+           "quarter-strip backend on every auto path (A/B switch; "
+           "measured 0.83x the u8 kernels, so off by default)."),
+    EnvVar("MCIM_PREFER_MXU", None, "ops/mxu_kernels.py",
+           "=1: route eligible stencil families onto the MXU banded path "
+           "on auto paths without a calibration win (TPU-only A/B "
+           "switch)."),
+    EnvVar("MCIM_MXU_MODE", "banded", "ops/mxu_kernels.py",
+           "MXU execution mode: banded (both separable passes on the "
+           "MXU) or hybrid (VPU row pass + MXU column pass)."),
+    EnvVar("MCIM_MXU_COL", "bf16split", "ops/mxu_kernels.py",
+           "MXU column-pass arithmetic: bf16split (the proven 64a+b "
+           "split) or f32 (direct einsum, A/B lane)."),
+    # -- bench lanes (bench_suite.py) ----------------------------------------
+    EnvVar("MCIM_HALO_AB", None, "bench_suite.py",
+           "=1 forces the sharded serial-vs-overlap halo A/B on, =0 off; "
+           "default: only on real TPU hardware."),
+    EnvVar("MCIM_MXU_AB_OPS", None, "bench_suite.py",
+           "mxu_ab lane: pipeline override (default gaussian:5)."),
+    EnvVar("MCIM_MXU_AB_HEIGHT", None, "bench_suite.py",
+           "mxu_ab lane: image height override."),
+    EnvVar("MCIM_MXU_AB_WIDTH", None, "bench_suite.py",
+           "mxu_ab lane: image width override."),
+    EnvVar("MCIM_MXU_AB_JSON", None, "tests/test_mxu_backend.py",
+           "CI: write the mxu_ab lane record to this path (uploaded as an "
+           "artifact)."),
+    EnvVar("MCIM_ENGINE_AB_IMAGES", None, "bench_suite.py",
+           "engine_ab lane: synthetic corpus size override."),
+    EnvVar("MCIM_ENGINE_AB_DECODE_MS", None, "bench_suite.py",
+           "engine_ab lane: per-image synthetic decode delay override."),
+    EnvVar("MCIM_ENGINE_AB_ENCODE_MS", None, "bench_suite.py",
+           "engine_ab lane: per-image synthetic encode delay override."),
+    EnvVar("MCIM_ENGINE_AB_INFLIGHT", None, "bench_suite.py",
+           "engine_ab lane: overlapped-lane dispatch depth override."),
+    EnvVar("MCIM_ENGINE_AB_JSON", None, "tests/test_engine.py",
+           "CI: write the engine_ab lane record to this path (uploaded "
+           "as an artifact)."),
+    EnvVar("MCIM_SERVE_RPS", None, "bench_suite.py",
+           "serve_loadgen lane: offered-rate sweep override (comma "
+           "list)."),
+    EnvVar("MCIM_SERVE_DURATION_S", None, "bench_suite.py",
+           "serve_loadgen lane: per-rate sweep duration override."),
+    EnvVar("MCIM_SERVE_FAULT_RATE", None, "bench_suite.py",
+           "serve_loadgen lane: injected transient dispatch-failure rate "
+           "(availability columns)."),
+    # -- bench driver (bench.py, repo root) ----------------------------------
+    EnvVar("MCIM_NO_HISTORY", None, "bench.py",
+           "Any non-empty value: do not append promoted records to "
+           "BENCH_HISTORY.jsonl (tests set this)."),
+    EnvVar("MCIM_PROBE_SCHEDULE", None, "bench.py",
+           "Comma-separated seconds between device-availability probe "
+           "attempts (overrides the backend-sized default)."),
+    EnvVar("MCIM_RETRY_PROBE_SCHEDULE", None, "bench.py",
+           "Legacy alias for MCIM_PROBE_SCHEDULE (still honored)."),
+    # -- test harness / archived tools ---------------------------------------
+    EnvVar("MCIM_MP_BACKEND", None, "tests/_mp_worker.py",
+           "Multi-process coordinator tests: backend the spawned worker "
+           "claims."),
+    EnvVar("MCIM_MP_MESH", None, "tests/_mp_worker.py",
+           "Multi-process coordinator tests: RxC mesh the spawned worker "
+           "builds."),
+)
+
+REGISTRY: dict[str, EnvVar] = {v.name: v for v in _VARS}
+
+
+def spec(name: str) -> EnvVar:
+    """The declaration for `name`; raises KeyError with the fix-it hint
+    for unregistered names (the analyzer enforces this statically too)."""
+    try:
+        return REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"env var {name!r} is not registered in "
+            "mpi_cuda_imagemanipulation_tpu/utils/env.py — declare it "
+            "there (name, default, consumer, doc) first"
+        ) from None
+
+
+def get(name: str, env=None) -> str | None:
+    """The registered variable's value (or its declared default). `env`
+    defaults to os.environ; tests pass a mapping."""
+    v = spec(name)
+    raw = (os.environ if env is None else env).get(name)
+    return v.default if raw is None else raw
+
+
+def get_bool(name: str, env=None) -> bool:
+    """Switch semantics shared by every MCIM_* toggle: unset, empty and
+    "0" are off, anything else is on."""
+    return get(name, env=env) not in (None, "", "0")
+
+
+def get_int(name: str, env=None) -> int | None:
+    raw = get(name, env=env)
+    return None if raw in (None, "") else int(raw)
+
+
+def get_float(name: str, env=None) -> float | None:
+    raw = get(name, env=env)
+    return None if raw in (None, "") else float(raw)
+
+
+def registry_rows() -> tuple[EnvVar, ...]:
+    """Every declared variable, sorted by name (docs/tests)."""
+    return tuple(sorted(_VARS, key=lambda v: v.name))
+
+
+def doc_table() -> str:
+    """The markdown table docs/design.md embeds — regenerate with
+    ``python -c "from mpi_cuda_imagemanipulation_tpu.utils import env;
+    print(env.doc_table())"`` after adding a variable."""
+    lines = [
+        "| Variable | Default | Consumer | Meaning |",
+        "|---|---|---|---|",
+    ]
+    lines.extend(
+        f"| `{v.name}` | {'`' + v.default + '`' if v.default else '—'} "
+        f"| `{v.consumer}` | {v.doc} |"
+        for v in registry_rows()
+    )
+    return "\n".join(lines)

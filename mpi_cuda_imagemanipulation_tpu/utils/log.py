@@ -20,8 +20,9 @@ from __future__ import annotations
 
 import json
 import logging
-import os
 import sys
+
+from mpi_cuda_imagemanipulation_tpu.utils import env as env_registry
 
 _FORMAT = "%(asctime)s %(levelname)s %(name)s :: %(message)s"
 
@@ -29,7 +30,7 @@ ENV_LEVEL = "MCIM_LOG_LEVEL"
 
 
 def _level_from_env(default: int = logging.INFO) -> int:
-    raw = os.environ.get(ENV_LEVEL, "").strip()
+    raw = (env_registry.get(ENV_LEVEL) or "").strip()
     if not raw:
         return default
     if raw.isdigit():

@@ -35,3 +35,26 @@ os.environ.setdefault(
         ".jax_cache",
     ),
 )
+
+import pytest  # noqa: E402
+
+from mpi_cuda_imagemanipulation_tpu.analysis import lockcheck  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _mcim_lock_check():
+    """MCIM_LOCK_CHECK=1 (the CI tier-1 step sets it): record every
+    lock-acquisition order for the whole session through the
+    threading.Lock/RLock/Condition shims, and assert the observed
+    lock-order graph is cycle-free at session end — the runtime
+    validation of mcim-check's static lock graph (analysis/lockcheck.py,
+    docs/design.md "Static analysis & invariants")."""
+    if not lockcheck.enabled():
+        yield
+        return
+    lockcheck.install()
+    try:
+        yield
+    finally:
+        lockcheck.uninstall()
+    lockcheck.recorder().assert_acyclic()

@@ -533,3 +533,47 @@ def test_probe_schedule_env_override(monkeypatch):
     )
     monkeypatch.delenv("MCIM_PROBE_SCHEDULE")
     assert bench._env_schedule("MCIM_PROBE_SCHEDULE", ((1, 2),)) == ((1, 2),)
+
+
+# --------------------------------------------------------------------------
+# acceptance: runtime lock-order recorder (analysis/lockcheck.py, ISSUE-7)
+# --------------------------------------------------------------------------
+
+
+def test_engine_lock_order_recorder_acyclic():
+    """The engine's completion thread + encode pool under the lock-order
+    recorder: results stay bit-identical and the observed acquisition
+    graph (engine _cond, metrics locks, queue internals) is cycle-free
+    (the runtime half of mcim-check's concurrency gate)."""
+    from mpi_cuda_imagemanipulation_tpu.analysis import lockcheck
+
+    fn = Pipeline.parse(REFERENCE_OPS).jit()
+    imgs = [
+        synthetic_image(40 + (k % 3), 40, channels=3, seed=k)
+        for k in range(10)
+    ]
+    with lockcheck.recording():
+        outs: dict[int, np.ndarray] = {}
+        errs: list[BaseException] = []
+        done_lock = threading.Lock()
+
+        def on_done(key, out, info):
+            with done_lock:
+                outs[key] = np.asarray(out)
+
+        def on_error(key, exc):
+            with done_lock:
+                errs.append(exc)
+
+        with Engine(inflight=2, io_threads=2) as eng:
+            for k, img in enumerate(imgs):
+                eng.submit(
+                    k, lambda img=img: img, fn,
+                    on_done=on_done, on_error=on_error,
+                )
+            assert eng.flush(120)
+        assert not errs, errs
+        assert sorted(outs) == list(range(10))
+        for k, img in enumerate(imgs):
+            np.testing.assert_array_equal(outs[k], np.asarray(fn(img)))
+    # lockcheck.recording().__exit__ asserted the observed graph acyclic

@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""mcim-check CLI — run the repo-native static analysis suite.
+
+    python tools/mcim_check.py                       # human output
+    python tools/mcim_check.py --format json --out analysis.json
+    python tools/mcim_check.py --rules concurrency,obs
+    python tools/mcim_check.py --list-rules
+
+Exit status: 0 when the tree is clean (no unsuppressed error-severity
+findings), 1 otherwise — the blocking contract the CI `analyze` job
+enforces. False positives are waived inline with
+`# mcim: allow(<rule>: reason)`; stale waivers are themselves findings.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="mcim-check", description=__doc__)
+    ap.add_argument(
+        "--root", default=_ROOT, help="repo root to analyze (default: "
+        "the checkout containing this script)"
+    )
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument(
+        "--out", default=None,
+        help="also write the report to this path (the CI artifact)",
+    )
+    ap.add_argument(
+        "--rules", default=None,
+        help="comma-separated rule families to run "
+        "(concurrency,tracer,obs,surface; default: all)",
+    )
+    ap.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalog and exit",
+    )
+    args = ap.parse_args(argv)
+
+    from mpi_cuda_imagemanipulation_tpu.analysis import core
+
+    if args.list_rules:
+        # importing the rule modules populates the catalog
+        from mpi_cuda_imagemanipulation_tpu.analysis import (  # noqa: F401
+            rules_concurrency,
+            rules_obs,
+            rules_surface,
+            rules_tracer,
+        )
+
+        for r in sorted(core.RULES.values(), key=lambda r: (r.family, r.id)):
+            print(f"{r.family:12s} {r.id:28s} [{r.severity}] {r.doc}")
+        return 0
+
+    families = (
+        {f.strip() for f in args.rules.split(",") if f.strip()}
+        if args.rules
+        else None
+    )
+    findings, repo = core.run(args.root, families=families)
+    report = (
+        core.render_json(findings, repo)
+        if args.format == "json"
+        else core.render_text(findings)
+    )
+    sys.stdout.write(report)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(
+                core.render_json(findings, repo)
+                if args.out.endswith(".json")
+                else report
+            )
+    return 1 if any(f.severity == "error" for f in findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -434,7 +434,7 @@ def run_wide_backends_sweep(shapes, results) -> int:
                     results, f"mxu_{mode}", spec, ch, hw,
                     lambda: golden_of(pipe.ops, mimg),
                     lambda: jax.jit(
-                        lambda x: pipeline_mxu(pipe.ops, x, mode=mode)
+                        lambda x, m=mode: pipeline_mxu(pipe.ops, x, mode=m)
                     )(mimg),
                 )
 
