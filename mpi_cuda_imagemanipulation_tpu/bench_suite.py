@@ -323,6 +323,341 @@ def run_config(cfg: BenchConfig, impl: str, *, n_shards: int | None = None) -> d
 SERVE_LOADGEN = "serve_loadgen"
 ENGINE_AB = "engine_ab"
 MXU_AB = "mxu_ab"
+FABRIC_LOADGEN = "fabric_loadgen"
+
+
+def fabric_loadgen_params() -> dict:
+    """The pod-fabric lane knobs, sized to the backend. The offered rate
+    deliberately EXCEEDS one replica's service capacity so the achieved
+    column measures sustained pod throughput (capacity), not the arrival
+    clock — that is what makes replicas=1 vs replicas=N a scaling claim.
+    Env overrides: MCIM_FABRIC_RPS / MCIM_FABRIC_DURATION_S /
+    MCIM_FABRIC_REPLICAS."""
+    on_tpu = is_tpu_backend()
+    params = {
+        # several bucket keys spread sticky affinity over the replica set
+        "ops": "grayscale,gaussian:5,contrast:3.5",
+        "buckets": "512,768,1024,1536,2048" if on_tpu
+        else "48,64,80,96,112,128",
+        "max_batch": 8 if on_tpu else 4,
+        "max_delay_ms": 4.0,
+        "queue_depth": 256,
+        "channels": "3",
+        # saturation rate: must exceed ONE replica's service capacity so
+        # `achieved` reads capacity (the scaling numerator/denominator)
+        "offered_rps": 2048.0 if on_tpu else 600.0,
+        # churn rate: moderate (below pod capacity) so the during-kill
+        # phase measures rerouting, not saturation shedding
+        "churn_rps": 512.0 if on_tpu else 120.0,
+        "phase_s": 4.0 if on_tpu else 2.0,
+        "replicas": 3,
+        "n_images": 24,
+        "heartbeat_s": 0.25,
+        "max_workers": 256,
+        # CPU only: per-dispatch synthetic DEVICE time via the sleep:MS
+        # failpoint mode (resilience/failpoints.py). On a pod each
+        # replica's dispatch waits on ITS OWN chip — that wait is what
+        # parallelizes across replicas. A shared-core CI host has no
+        # per-replica device, so without this floor every replica
+        # contends for one CPU and replicas=N can never beat replicas=1
+        # regardless of the fabric's correctness (the engine_ab lane's
+        # synthetic decode/encode delays make the same modeling move).
+        # On TPU the floor is OFF and the lane measures real chips.
+        "device_floor_ms": None if on_tpu else 40.0,
+    }
+    raw = env_registry.get("MCIM_FABRIC_RPS")
+    if raw:
+        params["offered_rps"] = float(raw)
+        params["churn_rps"] = float(raw) / 4.0
+    raw = env_registry.get("MCIM_FABRIC_DURATION_S")
+    if raw:
+        params["phase_s"] = float(raw)
+    raw = env_registry.get("MCIM_FABRIC_REPLICAS")
+    if raw:
+        params["replicas"] = int(raw)
+    return params
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class _FabricProc:
+    """A whole pod (router + supervisor + replicas) as ONE subprocess via
+    the `fabric` CLI. The loadgen client then owns this process's GIL
+    alone — an in-process router would serialize against the 96 client
+    threads and cap both lanes at the same number, which is exactly the
+    measurement error a replicas=1 vs replicas=N claim cannot carry."""
+
+    def __init__(self, p: dict, replicas: int):
+        import subprocess
+        import sys
+
+        self.port = _free_port()
+        self.url = f"http://127.0.0.1:{self.port}"
+        self.replicas = replicas
+        env = dict(os.environ)
+        if p.get("device_floor_ms"):
+            # replicas inherit this env from the fabric process: every
+            # dispatch pays the synthetic device floor (sleep:MS mode)
+            env["MCIM_FAILPOINTS"] = (
+                f"serve.dispatch=sleep:{p['device_floor_ms']:g}"
+            )
+        # spill the sticky target early: under deliberate saturation the
+        # lane wants queue pressure converted into cross-replica spread
+        # (capacity additivity), not into one deep affinity queue
+        env["MCIM_FABRIC_SHED_FRAC"] = "0.25"
+        self.proc = subprocess.Popen(
+            env=env,
+            args=[
+                sys.executable, "-m", "mpi_cuda_imagemanipulation_tpu",
+                "fabric",
+                "--replicas", str(replicas),
+                "--ops", p["ops"],
+                "--buckets", p["buckets"],
+                "--channels", p["channels"],
+                "--max-batch", str(p["max_batch"]),
+                "--max-delay-ms", str(p["max_delay_ms"]),
+                "--queue-depth", str(p["queue_depth"]),
+                "--host", "127.0.0.1",
+                "--port", str(self.port),
+                "--heartbeat-s", str(p["heartbeat_s"]),
+                "--stale-s", str(4 * p["heartbeat_s"]),
+            ],
+        )
+
+    def stats(self) -> dict:
+        import json
+        import urllib.request
+
+        with urllib.request.urlopen(self.url + "/stats", timeout=10) as r:
+            return json.loads(r.read())
+
+    def routable(self) -> list[str]:
+        try:
+            st = self.stats()
+        except Exception:
+            return []
+        return [
+            rid
+            for rid, rep in st["replicas"].items()
+            if rep["fresh"] and rep["state"] in ("serving", "degraded")
+        ]
+
+    def wait_routable(self, n: int, timeout_s: float = 240.0) -> None:
+        import time as _time
+
+        deadline = _time.monotonic() + timeout_s
+        while _time.monotonic() < deadline:
+            if self.proc.poll() is not None:
+                raise RuntimeError(
+                    f"fabric process exited rc={self.proc.returncode}"
+                )
+            if len(self.routable()) >= n:
+                return
+            _time.sleep(0.2)
+        raise TimeoutError(
+            f"{n} replicas not routable within {timeout_s:.0f}s "
+            f"(routable: {self.routable()})"
+        )
+
+    def kill_replica(self, replica_id: str) -> int:
+        """SIGKILL one replica by the pid its heartbeat reported; the
+        fabric process's supervisor restarts it with backoff."""
+        import signal as _signal
+
+        pid = self.stats()["replicas"][replica_id]["pid"]
+        os.kill(pid, _signal.SIGKILL)
+        return pid
+
+    def close(self) -> None:
+        import signal as _signal
+
+        if self.proc.poll() is None:
+            self.proc.send_signal(_signal.SIGTERM)
+            try:
+                self.proc.wait(timeout=60.0)
+            except Exception:
+                self.proc.kill()
+                self.proc.wait(timeout=10.0)
+
+    def __enter__(self) -> "_FabricProc":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()
+
+
+def _phase_public(rec: dict) -> dict:
+    """A phase record minus the raw per-request results (response bytes
+    do not belong in a committed bench JSON)."""
+    return {k: v for k, v in rec.items() if k != "results"}
+
+
+def run_fabric_loadgen(
+    *,
+    json_path: str | None = None,
+    printer: Callable[[str], None] = print,
+    replicas: int | None = None,
+) -> dict:
+    """The pod-fabric bench lane: the SAME open-loop HTTP request mix
+    against (a) one replica, (b) N replicas, and (c) N replicas with a
+    SIGKILL mid-sweep (serve/loadgen.churn_run) — throughput, p99 and
+    availability columns per lane. The scaling headline is
+    replicas=N achieved / replicas=1 achieved at equal mix; the churn
+    headline is the during-phase ok%/retried% (rerouting, not luck).
+    Successes are gated bit-exact against the golden per-request path
+    before any timing (the proto discipline)."""
+    import numpy as np
+
+    from mpi_cuda_imagemanipulation_tpu.io.image import encode_image_bytes
+    from mpi_cuda_imagemanipulation_tpu.serve import loadgen
+    from mpi_cuda_imagemanipulation_tpu.serve.bucketing import parse_buckets
+    from mpi_cuda_imagemanipulation_tpu.serve.padded import min_true_dim
+
+    p = fabric_loadgen_params()
+    if replicas is not None:
+        p["replicas"] = replicas
+    pipe = Pipeline.parse(p["ops"])
+    images = loadgen.mixed_shapes(
+        parse_buckets(p["buckets"]),
+        p["n_images"],
+        channels=3,
+        seed=7,
+        min_dim=min_true_dim(pipe),
+    )
+    blobs = [encode_image_bytes(im) for im in images]
+    golden_fn = pipe.jit()
+    golden = [np.asarray(golden_fn(im)) for im in images]
+
+    def check_bit_exact(results) -> int:
+        from mpi_cuda_imagemanipulation_tpu.io.image import (
+            decode_image_bytes,
+        )
+
+        n = 0
+        for k, r in results:
+            if r["code"] != 200:
+                continue
+            got = decode_image_bytes(r["body"])
+            if not np.array_equal(got, golden[k]):
+                raise AssertionError(
+                    f"fabric_loadgen: response for image {k} mismatches "
+                    "the golden per-request output"
+                )
+            n += 1
+        return n
+
+    lanes: dict[str, dict] = {}
+    n_rep = p["replicas"]
+    # -- replicas=1 baseline ------------------------------------------------
+    with _FabricProc(p, 1) as fab:
+        fab.wait_routable(1)
+        # bit-exact gate BEFORE any timing: one pass over the unique mix
+        gate = loadgen.http_run_offered_load(
+            fab.url, blobs, min(64.0, p["offered_rps"]),
+            len(blobs) / min(64.0, p["offered_rps"]),
+        )
+        gate_checked = check_bit_exact(gate["results"])
+        rec1 = loadgen.http_run_offered_load(
+            fab.url, blobs, p["offered_rps"], p["phase_s"],
+            max_workers=p["max_workers"],
+        )
+        check_bit_exact(rec1["results"])
+        lanes["replicas_1"] = _phase_public(rec1)
+    # -- replicas=N, same mix ----------------------------------------------
+    with _FabricProc(p, n_rep) as fab:
+        fab.wait_routable(n_rep)
+        recn = loadgen.http_run_offered_load(
+            fab.url, blobs, p["offered_rps"], p["phase_s"],
+            max_workers=p["max_workers"],
+        )
+        check_bit_exact(recn["results"])
+        lanes[f"replicas_{n_rep}"] = _phase_public(recn)
+        # -- churn: SIGKILL one replica mid-sweep, same fabric -------------
+        # the victim is the replica serving the MOST traffic (sticky
+        # affinity concentrates buckets): killing an idle sibling would
+        # prove nothing about rerouting
+        from collections import Counter
+
+        by_replica = Counter(
+            r["replica"] for _, r in recn["results"] if r["replica"]
+        )
+        victim = (
+            by_replica.most_common(1)[0][0] if by_replica else "r0"
+        )
+        killed_pid: list[int] = []
+        phases = loadgen.churn_run(
+            fab.url,
+            blobs,
+            offered_rps=p["churn_rps"],
+            phase_s=p["phase_s"],
+            kill=lambda: killed_pid.append(fab.kill_replica(victim)),
+            before_after=lambda: fab.wait_routable(n_rep),
+        )
+        for ph in phases.values():
+            check_bit_exact(ph["results"])
+        new_pid = fab.stats()["replicas"][victim]["pid"]
+        lanes[f"replicas_{n_rep}_churn"] = {
+            name: _phase_public(ph) for name, ph in phases.items()
+        }
+        lanes[f"replicas_{n_rep}_churn"].update(
+            victim=victim,
+            churn_rps=p["churn_rps"],
+            killed_pid=killed_pid[0] if killed_pid else None,
+            respawned=bool(killed_pid) and new_pid != killed_pid[0],
+        )
+    scaling = (
+        lanes[f"replicas_{n_rep}"]["achieved_rps"]
+        / lanes["replicas_1"]["achieved_rps"]
+        if lanes["replicas_1"]["achieved_rps"] > 0
+        else None
+    )
+    rec = {
+        "config": FABRIC_LOADGEN,
+        "pipeline": p["ops"],
+        "impl": "xla",
+        "platform": jax.default_backend(),
+        "buckets": p["buckets"],
+        "replicas": n_rep,
+        "offered_rps": p["offered_rps"],
+        "phase_s": p["phase_s"],
+        "bit_exact_gate": f"passed ({gate_checked} responses vs golden)",
+        "lanes": lanes,
+        "scaling_vs_1": scaling,
+        "scaling_ok": scaling is not None and scaling >= 2.0,
+    }
+    printer(
+        f"{'lane':22s} {'achieved':>9s} {'ok%':>6s} {'retry%':>7s} "
+        f"{'p99 ms':>8s}"
+    )
+
+    def _row(name: str, r: dict) -> None:
+        printer(
+            f"{name:22s} {r['achieved_rps']:9.1f} "
+            f"{r['ok_frac'] * 100:5.1f}% "
+            f"{r['retried_frac'] * 100:6.1f}% "
+            f"{r.get('e2e_p99_ms', float('nan')):8.2f}"
+        )
+
+    _row("replicas_1", lanes["replicas_1"])
+    _row(f"replicas_{n_rep}", lanes[f"replicas_{n_rep}"])
+    for ph in ("before", "during", "after"):
+        _row(f"churn/{ph}", lanes[f"replicas_{n_rep}_churn"][ph])
+    printer(
+        f"scaling replicas_{n_rep}/replicas_1 = "
+        + (f"{scaling:.2f}x" if scaling else "n/a")
+        + f" (>=2x: {rec['scaling_ok']})"
+    )
+    if json_path:
+        emit_json_metrics(rec, None if json_path == "-" else json_path)
+    return rec
 
 
 def mxu_ab_params() -> dict:
@@ -833,12 +1168,21 @@ def run_suite(
         records.append(run_mxu_ab(json_path=json_path, printer=printer))
         if not names:
             return records
+    if names and FABRIC_LOADGEN in names:
+        # the fabric lane measures a multi-process pod (router + replica
+        # workers + churn), not one executable
+        names = [n for n in names if n != FABRIC_LOADGEN]
+        records.append(
+            run_fabric_loadgen(json_path=json_path, printer=printer)
+        )
+        if not names:
+            return records
     if names:
         unknown = [n for n in names if n not in CONFIGS]
         if unknown:
             raise ValueError(
                 f"unknown bench config(s) {unknown}; known: "
-                f"{sorted(CONFIGS) + [ENGINE_AB, MXU_AB, SERVE_LOADGEN]}"
+                f"{sorted(CONFIGS) + [ENGINE_AB, FABRIC_LOADGEN, MXU_AB, SERVE_LOADGEN]}"
             )
         selected = [CONFIGS[n] for n in names]
     else:
@@ -935,7 +1279,8 @@ def main(argv: Sequence[str] | None = None) -> int:
     ap.add_argument(
         "--config",
         required=True,
-        choices=sorted(CONFIGS) + [ENGINE_AB, MXU_AB, SERVE_LOADGEN],
+        choices=sorted(CONFIGS)
+        + [ENGINE_AB, FABRIC_LOADGEN, MXU_AB, SERVE_LOADGEN],
     )
     ap.add_argument(
         "--impl",
@@ -971,10 +1316,21 @@ def main(argv: Sequence[str] | None = None) -> int:
         help="engine_ab only: overlapped-lane dispatch depth "
         "(env MCIM_ENGINE_AB_INFLIGHT works too)",
     )
+    ap.add_argument(
+        "--replicas",
+        type=int,
+        default=None,
+        help="fabric_loadgen only: scaled-lane replica count "
+        "(env MCIM_FABRIC_REPLICAS works too)",
+    )
     args = ap.parse_args(argv)
     if args.config == SERVE_LOADGEN:
         rec = run_serve_loadgen(
             printer=lambda s: None, fault_rate=args.fault_rate
+        )
+    elif args.config == FABRIC_LOADGEN:
+        rec = run_fabric_loadgen(
+            printer=lambda s: None, replicas=args.replicas
         )
     elif args.config == ENGINE_AB:
         rec = run_engine_ab(printer=lambda s: None, inflight=args.inflight)

@@ -416,8 +416,80 @@ def _build_parser() -> argparse.ArgumentParser:
         "queued + in-flight work gets this long to flush before the "
         "scheduler is stopped",
     )
+    srv.add_argument(
+        "--replicas",
+        type=int,
+        default=1,
+        help="N > 1 runs the pod-scale fabric instead of a single "
+        "process: a front-door router on --port load-balancing over N "
+        "supervised replica worker processes (each this serve stack), "
+        "with sticky shape-bucket affinity, health-aware shedding and "
+        "restart-with-backoff (fabric/; the `fabric` subcommand exposes "
+        "the router knobs)",
+    )
     _add_failpoint_flags(srv)
     _add_trace_flags(srv)
+
+    fab = sub.add_parser(
+        "fabric",
+        help="pod-scale serving fabric: front-door router + N supervised "
+        "replica workers (each the full serve stack), heartbeat-driven "
+        "health/affinity routing, rerouting retries, restart-with-"
+        "backoff; optional jax.distributed mesh lane for requests too "
+        "large for any replica bucket (fabric/)",
+    )
+    fab.add_argument("--replicas", type=int, default=3)
+    fab.add_argument("--ops", default="grayscale,contrast:3.5,emboss:3")
+    fab.add_argument("--buckets", default="512,1024,2048,4096")
+    fab.add_argument("--channels", default="1,3")
+    fab.add_argument("--max-batch", type=int, default=8)
+    fab.add_argument("--max-delay-ms", type=float, default=5.0)
+    fab.add_argument("--queue-depth", type=int, default=64)
+    fab.add_argument(
+        "--impl", choices=("auto", "xla", "mxu"), default="xla"
+    )
+    fab.add_argument("--host", default="", help="router bind address")
+    fab.add_argument("--port", type=int, default=8000)
+    fab.add_argument("--device", default=None)
+    fab.add_argument(
+        "--heartbeat-s",
+        type=float,
+        default=None,
+        help="replica heartbeat period (default: MCIM_FABRIC_HEARTBEAT_S); "
+        "the router marks a replica stale after --stale-s without one",
+    )
+    fab.add_argument(
+        "--stale-s",
+        type=float,
+        default=None,
+        help="router freshness window: replicas silent this long are "
+        "routed around (default: MCIM_FABRIC_STALE_S)",
+    )
+    fab.add_argument(
+        "--forward-attempts",
+        type=int,
+        default=None,
+        help="distinct replicas tried per request before 503 (default: "
+        "MCIM_FABRIC_FORWARD_ATTEMPTS); attempt 2+ counts as retried",
+    )
+    fab.add_argument(
+        "--mesh-shards",
+        type=int,
+        default=0,
+        help="N > 0 arms the oversize mesh lane: requests exceeding every "
+        "replica bucket run ONE row-sharded dispatch over an N-device "
+        "jax.distributed mesh (spanning hosts on a pod; CPU-simulated "
+        "via forced host device count in tests) instead of being "
+        "rejected",
+    )
+    fab.add_argument(
+        "--json-metrics",
+        default=None,
+        help="write the shutdown fabric stats record to this path "
+        "('-' = stdout)",
+    )
+    _add_failpoint_flags(fab)
+    _add_trace_flags(fab)
 
     bench = sub.add_parser("bench", help="run the benchmark suite")
     bench.add_argument("--configs", default=None, help="subset, comma-separated")
@@ -1071,6 +1143,17 @@ def cmd_serve(args: argparse.Namespace) -> int:
     gracefully — admission stops, queued + in-flight work flushes under
     --drain-deadline-s — and print the metrics summary (the north star's
     "heavy traffic" front door)."""
+    if getattr(args, "replicas", 1) > 1:
+        # pod mode: same flags, but the process becomes the front-door
+        # router over N supervised replica workers (fabric/); the
+        # `fabric` subcommand exposes the router-specific knobs
+        for name, default in (
+            ("heartbeat_s", None), ("stale_s", None),
+            ("forward_attempts", None), ("mesh_shards", 0),
+        ):
+            if not hasattr(args, name):
+                setattr(args, name, default)
+        return cmd_fabric(args)
     _configure_platform(args.device)
     _arm_failpoints(args)
     _configure_tracing(args)
@@ -1148,6 +1231,95 @@ def cmd_serve(args: argparse.Namespace) -> int:
         if args.json_metrics:
             emit_json_metrics(
                 {"event": "serve", **srv.app.stats()},
+                None if args.json_metrics == "-" else args.json_metrics,
+            )
+        _export_trace(args, log)
+    return 0
+
+
+def cmd_fabric(args: argparse.Namespace) -> int:
+    """Pod-scale serving: front-door router + N supervised replica worker
+    processes (fabric/). The router owns --port; replicas bind ephemeral
+    ports and register via heartbeat. SIGTERM/SIGINT drains the whole pod
+    (replicas flush in-flight work, then the router stops)."""
+    _configure_platform(args.device)
+    _arm_failpoints(args)
+    _configure_tracing(args)
+    import signal
+    import threading
+
+    from mpi_cuda_imagemanipulation_tpu.fabric.control import (
+        default_heartbeat_s,
+    )
+    from mpi_cuda_imagemanipulation_tpu.fabric.router import RouterConfig
+    from mpi_cuda_imagemanipulation_tpu.fabric.supervisor import (
+        Fabric,
+        FabricConfig,
+    )
+    from mpi_cuda_imagemanipulation_tpu.serve.bucketing import parse_buckets
+    from mpi_cuda_imagemanipulation_tpu.utils.log import (
+        emit_json_metrics,
+        get_logger,
+    )
+
+    log = get_logger()
+    cfg = FabricConfig(
+        replicas=args.replicas,
+        ops=args.ops,
+        buckets=args.buckets,
+        channels=args.channels,
+        max_batch=args.max_batch,
+        max_delay_ms=args.max_delay_ms,
+        queue_depth=args.queue_depth,
+        impl="xla" if args.impl == "auto" else args.impl,
+        heartbeat_s=args.heartbeat_s,
+        router=RouterConfig(
+            buckets=parse_buckets(args.buckets),
+            stale_s=args.stale_s,
+            forward_attempts=args.forward_attempts,
+        ),
+        mesh_shards=args.mesh_shards,
+    )
+    stop_evt = threading.Event()
+
+    def _on_signal(signum, frame):
+        log.info(
+            "signal %s: draining the fabric",
+            signal.Signals(signum).name,
+        )
+        stop_evt.set()
+
+    prev_handlers = {
+        s: signal.signal(s, _on_signal)
+        for s in (signal.SIGTERM, signal.SIGINT)
+    }
+    fab = Fabric(cfg)
+    try:
+        fab.start(args.host, args.port)
+        log.info(
+            "fabric serving [%s] on %s:%d: router over %d replicas "
+            "(buckets %s, heartbeat %.2fs%s) — POST /v1/process, "
+            "GET /healthz, GET /stats, GET /metrics",
+            args.ops, args.host or "0.0.0.0", fab.router.address[1],
+            args.replicas, args.buckets,
+            fab.config.heartbeat_s
+            if fab.config.heartbeat_s is not None
+            else default_heartbeat_s(),
+            f", mesh lane {args.mesh_shards} shards"
+            if args.mesh_shards
+            else "",
+        )
+        stop_evt.wait()
+    except KeyboardInterrupt:
+        log.info("interrupt: draining the fabric")
+    finally:
+        for s, h in prev_handlers.items():
+            signal.signal(s, h)
+        stats = fab.stats() if fab.supervisor is not None else None
+        fab.close(drain=True)
+        if args.json_metrics and stats is not None:
+            emit_json_metrics(
+                {"event": "fabric", **stats},
                 None if args.json_metrics == "-" else args.json_metrics,
             )
         _export_trace(args, log)
@@ -1576,6 +1748,7 @@ def main(argv: list[str] | None = None) -> int:
         "run": cmd_run,
         "batch": cmd_batch,
         "serve": cmd_serve,
+        "fabric": cmd_fabric,
         "bench": cmd_bench,
         "diff": cmd_diff,
         "autotune": cmd_autotune,

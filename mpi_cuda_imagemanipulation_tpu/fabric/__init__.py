@@ -1,0 +1,46 @@
+"""Pod-scale serving fabric — front-door router over N replica workers.
+
+The source paper's whole distribution story is MPI rank coordination:
+scatter rows to N workers, compute, gather (kern.cpp:55-83). The serving
+tier's analogue of "N workers" is N *replica processes*, each the full
+serve stack (scheduler + async engine + shape-bucket compile cache), with
+a front-door HTTP router load-balancing `POST /v1/process` across them —
+and, unlike MPI_COMM_WORLD, surviving a worker dying mid-collective.
+
+    fabric/control.py     replica -> router heartbeat protocol (health
+                          state, queue depth, open breakers, hot buckets)
+    fabric/router.py      the front door: sticky shape-bucket affinity
+                          with consistent-hash fallback, health-/load-
+                          aware shedding, per-replica circuit breakers,
+                          rerouting retries, 503 + Retry-After only when
+                          NO replica is serving
+    fabric/replica.py     one replica worker process (python -m ...fabric
+                          .replica): Server + HeartbeatSender + SIGTERM
+                          drain
+    fabric/supervisor.py  spawn + monitor + restart-with-backoff, and the
+                          `Fabric` facade (router + supervised replicas
+                          as one context manager)
+    fabric/mesh.py        the multi-host lane: jax.distributed-
+                          initialized mesh so ONE oversize request spans
+                          hosts while small requests ride data-parallel
+                          replicas (CPU-simulated in tests via
+                          XLA_FLAGS=--xla_force_host_platform_device_count)
+
+The guiding principle is the software-systolic one (PAPERS.md, arxiv
+1907.06154): keep every replica's scheduler fed from the request stream
+even while sibling replicas churn.
+"""
+
+from mpi_cuda_imagemanipulation_tpu.fabric.control import (  # noqa: F401
+    Heartbeat,
+    HeartbeatSender,
+)
+from mpi_cuda_imagemanipulation_tpu.fabric.router import (  # noqa: F401
+    Router,
+    RouterConfig,
+)
+from mpi_cuda_imagemanipulation_tpu.fabric.supervisor import (  # noqa: F401
+    Fabric,
+    FabricConfig,
+    Supervisor,
+)

@@ -1,0 +1,182 @@
+"""Fabric control plane — the replica -> router heartbeat protocol.
+
+Replicas PUSH state; the router never polls. Every `MCIM_FABRIC_HEARTBEAT_S`
+seconds each replica POSTs one JSON `Heartbeat` to the router's
+`/control/heartbeat` endpoint:
+
+    replica_id    stable identity (the supervisor reuses it across restarts,
+                  so routing affinity and metrics labels stay bounded)
+    incarnation   unique per process start — the router detects a restart
+                  by the change and resets that replica's breaker (a new
+                  process must not inherit its predecessor's open circuit)
+    addr/port     where /v1/process actually listens (replicas bind port 0
+                  and report the real port here, so there is no port-
+                  assignment race between supervisor and worker)
+    pid           the worker's OS pid — surfaced in the router's /stats so
+                  an external churn driver (the fabric_loadgen bench lane)
+                  can SIGKILL a specific replica without asking the
+                  supervisor
+    state         the health state machine (resilience/health.py): only
+                  serving/degraded replicas receive traffic
+    queued/queue_depth   current admission-queue fill — the router's
+                  least-loaded shedding signal
+    breaker_open  "HxW" buckets whose dispatch breaker is not closed on
+                  this replica — the router routes exactly those buckets
+                  around it while the rest of its traffic flows normally
+    warm_buckets  "HxW" buckets with a compiled executable in this
+                  replica's cache — the warm-affinity signal. Warmup
+                  rebuilds it on restart, so a respawned replica reclaims
+                  its consistent-hash buckets (a serving-history signal
+                  would starve it forever)
+
+Liveness is the ABSENCE of heartbeats: the router marks a replica stale
+after `MCIM_FABRIC_STALE_S` without a beat and routes around it. The
+`replica.heartbeat` failpoint drops beats (the loss is injected on the
+sender, so the replica keeps serving — exactly the partition the router
+must tolerate), and a router outage only costs the replica a log line.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import urllib.request
+from typing import Callable
+
+from mpi_cuda_imagemanipulation_tpu.resilience import failpoints
+from mpi_cuda_imagemanipulation_tpu.utils import env as env_registry
+from mpi_cuda_imagemanipulation_tpu.utils.log import get_logger
+
+ENV_HEARTBEAT_S = "MCIM_FABRIC_HEARTBEAT_S"
+
+HEARTBEAT_PATH = "/control/heartbeat"
+
+
+@dataclasses.dataclass
+class Heartbeat:
+    """One replica's pushed state — the wire format is its JSON dict."""
+
+    replica_id: str
+    addr: str
+    port: int
+    pid: int
+    incarnation: str
+    state: str
+    queued: int
+    queue_depth: int
+    breaker_open: list[str]
+    warm_buckets: list[str]
+    seq: int
+    sent_unix_s: float
+
+    def to_json(self) -> bytes:
+        return json.dumps(dataclasses.asdict(self)).encode()
+
+    @classmethod
+    def from_json(cls, data: bytes) -> "Heartbeat":
+        raw = json.loads(data)
+        fields = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(raw) - fields
+        if unknown:
+            # tolerate FUTURE extra fields? No: the fabric ships router and
+            # replica from one tree, so an unknown field is a version skew
+            # bug worth failing loudly on, not silently dropping
+            raise ValueError(f"heartbeat has unknown fields {sorted(unknown)}")
+        missing = fields - set(raw)
+        if missing:
+            raise ValueError(f"heartbeat missing fields {sorted(missing)}")
+        return cls(**raw)
+
+
+def default_heartbeat_s() -> float:
+    return float(env_registry.get(ENV_HEARTBEAT_S))
+
+
+class HeartbeatSender:
+    """The replica-side push loop: one daemon thread POSTing `collect()`'s
+    Heartbeat to the router until `stop()`.
+
+    Failure posture: a dropped beat (armed `replica.heartbeat` failpoint)
+    or an unreachable router NEVER raises out of the loop — the replica's
+    job is serving, and the router's staleness window is the protocol's
+    loss handling. Send timeouts are bounded by the interval so a wedged
+    router can't back beats up behind a stuck socket."""
+
+    def __init__(
+        self,
+        control_url: str,
+        collect: Callable[[int], Heartbeat],
+        *,
+        interval_s: float | None = None,
+    ):
+        # control_url is the router base (http://host:port); beats go to
+        # its /control/heartbeat route
+        self.url = control_url.rstrip("/") + HEARTBEAT_PATH
+        self._collect = collect
+        self.interval_s = (
+            default_heartbeat_s() if interval_s is None else interval_s
+        )
+        self.sent = 0
+        self.dropped = 0  # failpoint-dropped beats
+        self.failed = 0  # router unreachable / send error
+        self._seq = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._log = get_logger()
+
+    def start(self) -> "HeartbeatSender":
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(
+            target=self._loop, name="mcim-fabric-heartbeat", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def _loop(self) -> None:
+        # first beat immediately: the router learns the replica's bound
+        # port from it, so registration latency is one send, not one period
+        while not self._stop.is_set():
+            self.beat()
+            self._stop.wait(self.interval_s)
+
+    def beat(self) -> bool:
+        """One send attempt; returns True when the router acknowledged."""
+        self._seq += 1
+        hb = self._collect(self._seq)
+        try:
+            # an armed replica.heartbeat failpoint models HEARTBEAT LOSS:
+            # the beat is dropped before the socket, the replica serves on
+            failpoints.maybe_fail(
+                "replica.heartbeat", replica=hb.replica_id, seq=hb.seq
+            )
+        except failpoints.FailpointError:
+            self.dropped += 1
+            return False
+        req = urllib.request.Request(
+            self.url,
+            data=hb.to_json(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(
+                req, timeout=max(self.interval_s, 0.2)
+            ) as resp:
+                resp.read()
+            self.sent += 1
+            return True
+        except Exception as e:  # router down/restarting: serve on, log once
+            self.failed += 1
+            if self.failed in (1, 10, 100):
+                self._log.warning(
+                    "heartbeat %s -> %s failed (%s; %d so far)",
+                    hb.replica_id, self.url, type(e).__name__, self.failed,
+                )
+            return False

@@ -1,0 +1,98 @@
+"""Multi-host mesh lane — one LARGE request spans hosts; small requests
+ride data-parallel replicas.
+
+The replica tier scales throughput: N processes, each one device (or one
+slice), each serving bucketed small images. What it cannot do is serve an
+image bigger than one replica's largest bucket. This lane is the other
+axis of the paper's MPI story: the row-scatter across ranks
+(kern.cpp:55), but as a `jax.distributed`-initialized `Mesh` whose
+devices may live on MANY hosts — the same `pipe.sharded` program the
+single-host sharded path compiles (pad-to-multiple + crop, ghost-row
+ppermutes, bit-exact vs the golden path) just runs with DCN-backed ICI
+collectives once `jax.distributed.initialize` has stitched the processes
+together (parallel/mesh.distributed_init, driven by
+JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID).
+
+On TPU pods that is real multi-host execution. On CPU — CI and tests —
+the same program runs against fake host devices
+(`XLA_FLAGS=--xla_force_host_platform_device_count=N`, which
+tests/conftest.py already arms): structurally the identical mesh +
+ppermute program, minus the wire. `simulated_hosts_xla_flags` builds that
+env for spawned processes.
+
+Dispatches jit-cache per (shape, channels): the lane exists for RARE
+oversize requests, so a trace per novel shape is the right trade — the
+bucket grid's zero-trace contract stays a replica property.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline
+from mpi_cuda_imagemanipulation_tpu.parallel.mesh import (
+    distributed_init,
+    make_mesh,
+)
+
+
+def simulated_hosts_xla_flags(n_devices: int, existing: str = "") -> str:
+    """XLA_FLAGS value giving a CPU process `n_devices` fake host devices
+    (the tests' stand-in for a multi-host slice). Appends to `existing`,
+    replacing any previous force-host-device-count flag."""
+    kept = [
+        f
+        for f in existing.split()
+        if not f.startswith("--xla_force_host_platform_device_count")
+    ]
+    kept.append(f"--xla_force_host_platform_device_count={n_devices}")
+    return " ".join(kept)
+
+
+class MeshLane:
+    """The router's oversize-request executor: `pipe.sharded` over an
+    `n_shards`-device (possibly multi-host) row mesh."""
+
+    def __init__(
+        self,
+        ops: str,
+        n_shards: int,
+        *,
+        halo_mode: str = "serial",
+        backend: str = "xla",
+    ):
+        # multi-host first: initialize() must run before any backend
+        # query; a single-process run no-ops here (parallel/mesh.py)
+        distributed_init()
+        self.pipe = Pipeline.parse(ops)
+        self.n_shards = n_shards
+        self.mesh = make_mesh(n_shards)
+        self._fn = self.pipe.sharded(
+            self.mesh, backend=backend, halo_mode=halo_mode
+        )
+        self._lock = threading.Lock()
+        self._dispatches = 0
+        self._shapes: set[tuple] = set()
+
+    def process(self, img: np.ndarray) -> np.ndarray:
+        """Run one image through the sharded pipeline; bit-exact vs the
+        golden path by the sharded runner's contract (pad-to-multiple +
+        crop, parallel/api.py)."""
+        import jax
+
+        out = np.asarray(jax.block_until_ready(self._fn(img)))
+        with self._lock:
+            self._dispatches += 1
+            self._shapes.add(img.shape)
+        return out
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "shards": self.n_shards,
+                "ops": self.pipe.name,
+                "dispatches": self._dispatches,
+                "shapes_seen": len(self._shapes),
+            }

@@ -1,0 +1,193 @@
+"""One replica worker — the existing serve stack as a supervised process.
+
+`python -m mpi_cuda_imagemanipulation_tpu.fabric.replica --replica-id r0
+--router http://host:port ...` stands up exactly the PR 2-6 serving stack
+(ServeApp: scheduler + async engine + pre-warmed shape-bucket compile
+cache + HTTP Server) on `--port 0` (kernel-assigned, race-free) and
+pushes heartbeats to the router, which learns the bound port from the
+first beat — the supervisor never has to guess ports.
+
+The heartbeat payload is assembled here from the stack's own state:
+health machine state, admission-queue fill, "HxW" buckets whose dispatch
+breaker is open (BreakerBoard.open_keys), and the warm-affinity signal
+(the compile cache's warmed bucket set, serve/cache.warm_buckets).
+
+SIGTERM drains gracefully: admission stops, queued + in-flight work
+flushes under `--drain-deadline-s`, the trace buffer exports (so a
+drained replica's spans still join the router's on trace id), then exit
+0. A SIGKILL (the churn test / a real OOM) skips all of that — which is
+precisely what the router's staleness window, per-replica breaker and
+rerouting retries exist to absorb.
+
+This module is also importable: `ReplicaRuntime` runs the same wiring
+in-process for tests that don't need process isolation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import threading
+import time
+
+from mpi_cuda_imagemanipulation_tpu.fabric.control import (
+    Heartbeat,
+    HeartbeatSender,
+)
+from mpi_cuda_imagemanipulation_tpu.utils.log import get_logger
+
+
+class ReplicaRuntime:
+    """Server + HeartbeatSender for one replica id, embeddable in-process
+    (tests) or driven by main() as a worker process."""
+
+    def __init__(
+        self,
+        replica_id: str,
+        router_url: str,
+        serve_config,
+        *,
+        host: str = "",
+        port: int = 0,
+        heartbeat_s: float | None = None,
+    ):
+        from mpi_cuda_imagemanipulation_tpu.serve.server import Server
+
+        self.replica_id = replica_id
+        self.router_url = router_url
+        # incarnation: unique per construction, so the router can tell a
+        # restart from a continuation and reset the replica's breaker
+        self.incarnation = f"{os.getpid():x}-{time.time_ns():x}"
+        self.server = Server(serve_config, host, port)
+        self.sender = HeartbeatSender(
+            router_url, self._collect, interval_s=heartbeat_s
+        )
+
+    def _collect(self, seq: int) -> Heartbeat:
+        app = self.server.app
+        return Heartbeat(
+            replica_id=self.replica_id,
+            addr="127.0.0.1",
+            port=self.server.address[1] if self.server.httpd else 0,
+            pid=os.getpid(),
+            incarnation=self.incarnation,
+            state=app.health.state,
+            queued=app.metrics.queued,
+            queue_depth=app.config.queue_depth,
+            breaker_open=[
+                f"{k[0]}x{k[1]}" for k in app.breakers.open_keys()
+            ],
+            warm_buckets=app.cache.warm_buckets(),
+            seq=seq,
+            sent_unix_s=time.time(),
+        )
+
+    def start(self) -> "ReplicaRuntime":
+        # warmup + socket first: the first heartbeat must carry the real
+        # port and a state the router can act on
+        self.server.start()
+        self.sender.start()
+        return self
+
+    def close(self, *, drain: bool = True, deadline_s: float = 30.0) -> None:
+        self.sender.stop()
+        self.server.close(drain=drain, deadline_s=deadline_s)
+
+    def __enter__(self) -> "ReplicaRuntime":
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="mcim-fabric-replica",
+        description="one fabric replica worker (spawned by the supervisor)",
+    )
+    p.add_argument("--replica-id", required=True)
+    p.add_argument("--router", required=True, help="router base URL")
+    p.add_argument("--ops", default="grayscale,contrast:3.5,emboss:3")
+    p.add_argument("--buckets", default="512,1024,2048,4096")
+    p.add_argument("--channels", default="1,3")
+    p.add_argument("--max-batch", type=int, default=8)
+    p.add_argument("--max-delay-ms", type=float, default=5.0)
+    p.add_argument("--queue-depth", type=int, default=64)
+    p.add_argument("--impl", default="xla", choices=("auto", "xla", "mxu"))
+    p.add_argument("--host", default="")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--heartbeat-s", type=float, default=None)
+    p.add_argument("--drain-deadline-s", type=float, default=30.0)
+    p.add_argument("--trace-out", default=None)
+    p.add_argument("--trace-sample", type=float, default=None)
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = _build_parser().parse_args(argv)
+    # the worker inherits JAX_PLATFORMS / MCIM_FAILPOINTS / MCIM_TRACE_*
+    # from the supervisor's env (per-replica overrides ride extra_env)
+    from mpi_cuda_imagemanipulation_tpu.obs import trace as obs_trace
+    from mpi_cuda_imagemanipulation_tpu.serve.bucketing import parse_buckets
+    from mpi_cuda_imagemanipulation_tpu.serve.server import ServeConfig
+
+    log = get_logger()
+    if args.trace_out or args.trace_sample is not None:
+        obs_trace.configure(
+            sample=1.0 if args.trace_sample is None else args.trace_sample
+        )
+    else:
+        obs_trace.configure_from_env()
+    channels = tuple(
+        sorted({int(c) for c in args.channels.split(",") if c.strip()})
+    )
+    cfg = ServeConfig(
+        ops=args.ops,
+        buckets=parse_buckets(args.buckets),
+        max_batch=args.max_batch,
+        max_delay_ms=args.max_delay_ms,
+        queue_depth=args.queue_depth,
+        channels=channels,
+        backend="xla" if args.impl == "auto" else args.impl,
+    )
+    rt = ReplicaRuntime(
+        args.replica_id,
+        args.router,
+        cfg,
+        host=args.host,
+        port=args.port,
+        heartbeat_s=args.heartbeat_s,
+    )
+    stop_evt = threading.Event()
+
+    def _on_signal(signum, frame):
+        log.info(
+            "replica %s: signal %s, draining (deadline %.0fs)",
+            args.replica_id, signal.Signals(signum).name,
+            args.drain_deadline_s,
+        )
+        stop_evt.set()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+    rt.start()
+    log.info(
+        "replica %s serving on port %d (router %s, heartbeat %.2fs)",
+        args.replica_id, rt.server.address[1], args.router,
+        rt.sender.interval_s,
+    )
+    stop_evt.wait()
+    rt.close(drain=True, deadline_s=args.drain_deadline_s)
+    if args.trace_out:
+        n = obs_trace.export(args.trace_out)
+        log.info(
+            "replica %s trace: %d events -> %s",
+            args.replica_id, n, args.trace_out,
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,738 @@
+"""Front-door router — health- and affinity-aware load balancing over
+replica workers.
+
+One `POST /v1/process` arrives; the router sniffs the image's shape
+bucket from the PNG header (no full decode on the proxy path), orders the
+live replicas, and proxies the body to the first that takes it:
+
+  1. **sticky bucket affinity** — among fresh serving replicas, prefer
+     those whose heartbeat lists the bucket as WARM in their compile
+     cache; the rendezvous hash of (bucket, replica_id) picks the sticky
+     target inside that pool (and is the consistent-hash fallback when
+     nothing reports warm): every router instance picks the same target
+     without coordination, one replica's death only remaps ITS buckets,
+     and a RESTARTED replica reclaims them as soon as warmup re-reports
+     the grid.
+  2. **shed when the sticky target is unhealthy** — degraded state, a
+     breaker open for this very bucket, or queue fill past
+     MCIM_FABRIC_SHED_FRAC demotes the sticky pick behind the
+     least-loaded healthy replica (draining/stale replicas are excluded
+     outright).
+  3. **reroute on failure** — a connection error, timeout, or 5xx/429
+     moves to the next candidate (up to MCIM_FABRIC_FORWARD_ATTEMPTS
+     distinct replicas); connection-class failures feed that replica's
+     circuit breaker so a dead worker is routed around for the breaker
+     window instead of eating a timeout per request. A replica restart
+     (new heartbeat incarnation) resets its breaker.
+  4. **503 + Retry-After only when NO replica is serving** — the fabric's
+     equivalent of the scheduler's explicit shed: callers get a clear
+     signal, never a hang.
+
+Requests too large for every replica bucket take the optional MESH lane
+(fabric/mesh.py): one jax.distributed row-sharded dispatch spanning hosts,
+in the router process — big requests span the pod, small requests ride
+data-parallel replicas.
+
+Observability: every quantity is an `mcim_fabric_*` family on the
+router's registry (`GET /metrics`), the router's root span propagates its
+trace id to the replica via X-Trace-Id (the replica ADOPTS it — one trace
+covers the full hop), and `router.forward` is a failpoint so rerouting is
+testable without killing anything.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import http.client
+import io as _io
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from mpi_cuda_imagemanipulation_tpu.fabric.control import (
+    HEARTBEAT_PATH,
+    Heartbeat,
+)
+from mpi_cuda_imagemanipulation_tpu.obs import metrics as obs_metrics
+from mpi_cuda_imagemanipulation_tpu.obs import trace as obs_trace
+from mpi_cuda_imagemanipulation_tpu.obs.metrics import Registry
+from mpi_cuda_imagemanipulation_tpu.resilience import failpoints
+from mpi_cuda_imagemanipulation_tpu.resilience.breaker import BreakerBoard
+from mpi_cuda_imagemanipulation_tpu.serve import bucketing
+from mpi_cuda_imagemanipulation_tpu.utils import env as env_registry
+from mpi_cuda_imagemanipulation_tpu.utils.log import get_logger
+
+ENV_STALE_S = "MCIM_FABRIC_STALE_S"
+ENV_FORWARD_TIMEOUT_S = "MCIM_FABRIC_FORWARD_TIMEOUT_S"
+ENV_FORWARD_ATTEMPTS = "MCIM_FABRIC_FORWARD_ATTEMPTS"
+ENV_SHED_FRAC = "MCIM_FABRIC_SHED_FRAC"
+
+# replica states that may receive proxied traffic at all; "serving" alone
+# qualifies for the sticky fast path (degraded = shed to least-loaded)
+_ROUTABLE = ("serving", "degraded")
+
+# HTTP status -> the bounded label set of mcim_fabric_requests_total
+_STATUS_LABEL = {
+    200: "ok", 400: "rejected", 422: "quarantined", 429: "overloaded",
+    503: "unavailable", 504: "deadline_expired",
+}
+
+_PNG_MAGIC = b"\x89PNG\r\n\x1a\n"
+
+
+class _ConnPool:
+    """Keep-alive connection reuse per (addr, port): the proxy hot path
+    must not pay a TCP handshake per forward. Connections come back to
+    the pool only after a CLEAN full response; any error path closes and
+    discards, so a half-read socket can never serve the next request."""
+
+    def __init__(self, timeout_s: float, cap_per_target: int = 32):
+        self.timeout_s = timeout_s
+        self.cap = cap_per_target
+        self._lock = threading.Lock()
+        self._pools: dict[tuple[str, int], list] = {}
+
+    def take(self, addr: str, port: int) -> http.client.HTTPConnection:
+        with self._lock:
+            pool = self._pools.get((addr, port))
+            if pool:
+                return pool.pop()
+        return http.client.HTTPConnection(
+            addr, port, timeout=self.timeout_s
+        )
+
+    def give(self, addr: str, port: int, conn) -> None:
+        with self._lock:
+            pool = self._pools.setdefault((addr, port), [])
+            if len(pool) < self.cap:
+                pool.append(conn)
+                return
+        conn.close()
+
+    def close_all(self) -> None:
+        with self._lock:
+            conns = [c for pool in self._pools.values() for c in pool]
+            self._pools.clear()
+        for c in conns:
+            c.close()
+
+
+def _rendezvous_score(bucket: str, replica_id: str) -> int:
+    """Deterministic cross-process score for consistent hashing (never
+    builtins.hash — PYTHONHASHSEED would shuffle routing per process)."""
+    import hashlib
+
+    h = hashlib.blake2b(
+        f"{bucket}|{replica_id}".encode(), digest_size=8
+    ).digest()
+    return int.from_bytes(h, "big")
+
+
+@dataclasses.dataclass
+class ReplicaView:
+    """The router's picture of one replica: the last heartbeat plus the
+    router-side receive clock (freshness uses OUR clock — the wire
+    timestamp would import cross-process clock skew)."""
+
+    hb: Heartbeat
+    last_seen: float  # router monotonic
+    beats: int = 0
+
+    @property
+    def replica_id(self) -> str:
+        return self.hb.replica_id
+
+    def fresh(self, now: float, stale_s: float) -> bool:
+        return now - self.last_seen <= stale_s
+
+    def load_frac(self) -> float:
+        depth = max(1, self.hb.queue_depth)
+        return self.hb.queued / depth
+
+
+class ReplicaTable:
+    """Heartbeat-built replica registry. The lock guards only dict
+    mutation; routing works on snapshot copies."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._replicas: dict[str, ReplicaView] = {}
+
+    def observe(self, hb: Heartbeat, now: float) -> bool:
+        """Fold one heartbeat in; returns True when this is a NEW
+        incarnation of the replica id (first sight or restart)."""
+        with self._lock:
+            prev = self._replicas.get(hb.replica_id)
+            new_inc = prev is None or prev.hb.incarnation != hb.incarnation
+            beats = 1 if prev is None else prev.beats + 1
+            self._replicas[hb.replica_id] = ReplicaView(
+                hb=hb, last_seen=now, beats=beats
+            )
+            return new_inc
+
+    def views(self) -> list[ReplicaView]:
+        with self._lock:
+            return list(self._replicas.values())
+
+    def get(self, replica_id: str) -> ReplicaView | None:
+        with self._lock:
+            return self._replicas.get(replica_id)
+
+
+@dataclasses.dataclass(frozen=True)
+class RouterConfig:
+    buckets: tuple[tuple[int, int], ...] = bucketing.DEFAULT_BUCKETS
+    stale_s: float | None = None  # None: MCIM_FABRIC_STALE_S
+    forward_timeout_s: float | None = None
+    forward_attempts: int | None = None
+    shed_frac: float | None = None
+    # router-side per-replica breaker: trips fast (a dead replica costs a
+    # connect timeout per probe) and resets fast (restarts should rejoin
+    # within a breaker window, not a serving outage)
+    breaker_threshold: int = 2
+    breaker_reset_s: float = 3.0
+
+
+class Router:
+    """The front door. `start()` binds the HTTP listener; replicas
+    register themselves by heartbeating `POST /control/heartbeat`.
+
+        POST /v1/process        proxied to a replica (see module doc)
+        POST /control/heartbeat replica state push (fabric/control.py)
+        GET  /healthz           200 while >=1 routable fresh replica
+        GET  /stats             replica table + routing counters (JSON)
+        GET  /metrics           Prometheus exposition (mcim_fabric_*)
+    """
+
+    def __init__(
+        self,
+        config: RouterConfig,
+        *,
+        registry: Registry | None = None,
+        mesh_lane=None,
+        clock=time.monotonic,
+    ):
+        self.config = config
+        self.buckets = tuple(config.buckets)
+        self.stale_s = (
+            float(env_registry.get(ENV_STALE_S))
+            if config.stale_s is None
+            else config.stale_s
+        )
+        self.forward_timeout_s = (
+            float(env_registry.get(ENV_FORWARD_TIMEOUT_S))
+            if config.forward_timeout_s is None
+            else config.forward_timeout_s
+        )
+        self.forward_attempts = (
+            int(env_registry.get(ENV_FORWARD_ATTEMPTS))
+            if config.forward_attempts is None
+            else config.forward_attempts
+        )
+        self.shed_frac = (
+            float(env_registry.get(ENV_SHED_FRAC))
+            if config.shed_frac is None
+            else config.shed_frac
+        )
+        self.table = ReplicaTable()
+        self.breakers = BreakerBoard(
+            failure_threshold=config.breaker_threshold,
+            reset_timeout_s=config.breaker_reset_s,
+        )
+        self.mesh_lane = mesh_lane
+        self._pool = _ConnPool(self.forward_timeout_s)
+        self._clock = clock
+        self.registry = registry or Registry()
+        self._register_metrics()
+        self.httpd: ThreadingHTTPServer | None = None
+        self._http_thread: threading.Thread | None = None
+        self._closed = False
+        self._log = get_logger()
+
+    # -- metrics -----------------------------------------------------------
+
+    def _register_metrics(self) -> None:
+        r = self.registry
+        self._m_requests = r.counter(
+            "mcim_fabric_requests_total",
+            "Front-door requests by terminal status.",
+            labels=("status",),
+        )
+        self._m_forwards = r.counter(
+            "mcim_fabric_forwards_total",
+            "Proxy attempts per replica, by outcome (ok/http_error/"
+            "net_error).",
+            labels=("replica", "outcome"),
+        )
+        self._m_retries = r.counter(
+            "mcim_fabric_forward_retries_total",
+            "Requests re-forwarded to another replica after a failed "
+            "attempt (attempt 2+ each counts once).",
+        )
+        self._m_route = r.counter(
+            "mcim_fabric_route_total",
+            "Routing decisions by policy (sticky/least_loaded/mesh).",
+            labels=("policy",),
+        )
+        self._m_heartbeats = r.counter(
+            "mcim_fabric_heartbeats_total",
+            "Heartbeats accepted per replica.",
+            labels=("replica",),
+        )
+        self._m_forward_s = r.histogram(
+            "mcim_fabric_forward_seconds",
+            "Router->replica proxy time per successful attempt.",
+        )
+        r.gauge(
+            "mcim_fabric_replica_serving",
+            "1 when the replica is fresh and routable (serving/degraded), "
+            "0 otherwise.",
+            labels=("replica",),
+            fn=self._serving_gauge,
+        )
+        r.gauge(
+            "mcim_fabric_replica_queue_depth",
+            "Last-heartbeat admission-queue fill per replica.",
+            labels=("replica",),
+            fn=lambda: {
+                (v.replica_id,): float(v.hb.queued)
+                for v in self.table.views()
+            },
+        )
+        r.gauge(
+            "mcim_fabric_replicas_routable",
+            "Count of fresh serving/degraded replicas.",
+            fn=lambda: float(len(self._routable())),
+        )
+        r.gauge(
+            "mcim_fabric_breaker_open_events",
+            "Cumulative router-side replica-breaker trips.",
+            fn=lambda: float(self.breakers.snapshot()["open_events"]),
+        )
+
+    def _serving_gauge(self) -> dict:
+        now = self._clock()
+        return {
+            (v.replica_id,): (
+                1.0
+                if v.fresh(now, self.stale_s) and v.hb.state in _ROUTABLE
+                else 0.0
+            )
+            for v in self.table.views()
+        }
+
+    # -- routing policy ----------------------------------------------------
+
+    def _routable(self) -> list[ReplicaView]:
+        now = self._clock()
+        return [
+            v
+            for v in self.table.views()
+            if v.fresh(now, self.stale_s) and v.hb.state in _ROUTABLE
+        ]
+
+    def route(self, bucket: str) -> tuple[list[ReplicaView], str]:
+        """Ordered forward candidates for a "HxW" bucket + the policy
+        label. Pure over the current table snapshot (unit-testable)."""
+        live = self._routable()
+        if not live:
+            return [], "none"
+        warm = [v for v in live if bucket in v.hb.warm_buckets]
+        pool = warm or live
+        sticky = max(
+            pool,
+            key=lambda v: _rendezvous_score(bucket, v.replica_id),
+        )
+        sticky_ok = (
+            sticky.hb.state == "serving"
+            and bucket not in sticky.hb.breaker_open
+            and sticky.load_frac() < self.shed_frac
+        )
+        rest = sorted(
+            (v for v in live if v.replica_id != sticky.replica_id),
+            key=lambda v: (
+                # a replica with THIS bucket's breaker open or in degraded
+                # state is a last resort, then least-loaded first
+                bucket in v.hb.breaker_open,
+                v.hb.state != "serving",
+                v.load_frac(),
+            ),
+        )
+        if sticky_ok:
+            return [sticky] + rest, "sticky"
+        return rest + [sticky], "least_loaded"
+
+    # -- request path ------------------------------------------------------
+
+    @staticmethod
+    def _sniff_dims(data: bytes) -> tuple[int, int]:
+        """(h, w) from the image header only — the proxy path must not pay
+        a full decode (or even a PIL import) for routing. PNG is the wire
+        format, so its fixed-offset IHDR is read directly; anything else
+        falls back to PIL's lazy header parse."""
+        if data[:8] == _PNG_MAGIC and data[12:16] == b"IHDR":
+            w = int.from_bytes(data[16:20], "big")
+            h = int.from_bytes(data[20:24], "big")
+            if h > 0 and w > 0:
+                return h, w
+        from PIL import Image
+
+        with Image.open(_io.BytesIO(data)) as im:
+            w, h = im.size
+        return h, w
+
+    def handle_process(
+        self, body: bytes, headers
+    ) -> tuple[int, str, bytes, list[tuple[str, str]]]:
+        """One front-door request -> (status, content_type, body, extra
+        headers). Runs on the HTTP handler thread."""
+        try:
+            h, w = self._sniff_dims(body)
+        except Exception as e:
+            self._m_requests.inc(status="rejected")
+            return _json_response(400, {"error": f"undecodable image: {e}"})
+        picked = bucketing.pick_bucket(h, w, self.buckets)
+        if picked is None:
+            if self.mesh_lane is not None:
+                return self._dispatch_mesh(body, h, w)
+            self._m_requests.inc(status="rejected")
+            big = self.buckets[-1]
+            return _json_response(
+                400,
+                {
+                    "error": (
+                        f"image {h}x{w} exceeds the largest bucket "
+                        f"{big[0]}x{big[1]} and no mesh lane is configured"
+                    )
+                },
+            )
+        bucket = f"{picked[0]}x{picked[1]}"
+        candidates, policy = self.route(bucket)
+        if not candidates:
+            self._m_requests.inc(status="unavailable")
+            return _json_response(
+                503,
+                {"error": "no replica is serving", "status": "unavailable"},
+                extra=[("Retry-After", "1")],
+            )
+        self._m_route.inc(policy=policy)
+        root = obs_trace.start_trace(
+            "fabric.request", h=h, w=w, bucket=bucket, policy=policy
+        )
+        code, ctype, out, extra = self._forward_with_retries(
+            root, bucket, body, candidates
+        )
+        self._m_requests.inc(
+            status=_STATUS_LABEL.get(code, "error" if code >= 500 else "ok")
+        )
+        root.set(status=code)
+        root.end()
+        if root.trace_id:
+            extra = extra + [("X-Trace-Id", root.trace_id)]
+        return code, ctype, out, extra
+
+    def _forward_with_retries(
+        self, root, bucket: str, body: bytes, candidates: list[ReplicaView]
+    ) -> tuple[int, str, bytes, list[tuple[str, str]]]:
+        attempts = 0
+        last: tuple[int, str, bytes, list] | None = None
+        for view in candidates:
+            if attempts >= self.forward_attempts:
+                break
+            rid = view.replica_id
+            breaker = self.breakers.get(rid)
+            if not breaker.allow():
+                continue  # routed around for the breaker window
+            attempts += 1
+            if attempts > 1:
+                self._m_retries.inc()
+                obs_trace.event(
+                    "fabric.retry", parent=root.context(),
+                    attempt=attempts, replica=rid,
+                )
+            t0 = self._clock()
+            try:
+                with obs_trace.span(
+                    "fabric.forward", parent=root.context(), replica=rid
+                ):
+                    failpoints.maybe_fail(
+                        "router.forward", replica=rid, attempt=attempts
+                    )
+                    code, ctype, out = self._forward_once(
+                        view, body, root.trace_id
+                    )
+            except Exception as e:
+                # connection-class failure: the replica is gone or wedged —
+                # feed its breaker and move on to the next candidate
+                breaker.on_failure()
+                self._m_forwards.inc(replica=rid, outcome="net_error")
+                self._log.warning(
+                    "forward to %s failed (%s: %s)",
+                    rid, type(e).__name__, str(e)[:120],
+                )
+                continue
+            if code == 429 or code >= 500:
+                # the replica answered but couldn't take it: 429 means
+                # alive-but-full (no breaker signal), 5xx feeds the breaker
+                if code >= 500:
+                    breaker.on_failure()
+                self._m_forwards.inc(replica=rid, outcome="http_error")
+                last = (code, ctype, out, [("X-Fabric-Replica", rid)])
+                continue
+            breaker.on_success()
+            self._m_forwards.inc(replica=rid, outcome="ok")
+            self._m_forward_s.observe(self._clock() - t0)
+            return (
+                code, ctype, out,
+                [
+                    ("X-Fabric-Replica", rid),
+                    ("X-Fabric-Attempts", str(attempts)),
+                ],
+            )
+        if last is not None:
+            # every candidate was tried; surface the most recent replica
+            # answer (e.g. pod-wide 429) rather than masking it as 503
+            return last
+        return _json_response(
+            503,
+            {"error": "no replica accepted the request",
+             "status": "unavailable"},
+            extra=[("Retry-After", "1")],
+        )
+
+    def _forward_once(
+        self, view: ReplicaView, body: bytes, trace_id: str
+    ) -> tuple[int, str, bytes]:
+        """One proxy attempt: POST the body to the replica, read fully.
+        Connections are pooled (HTTP/1.1 keep-alive); an error closes the
+        socket instead of returning it."""
+        addr = view.hb.addr or "127.0.0.1"
+        port = view.hb.port
+        conn = self._pool.take(addr, port)
+        try:
+            hdrs = {"Content-Type": "application/octet-stream"}
+            if trace_id:
+                # the distributed-trace hop: the replica adopts this id as
+                # its serve.request root, so both processes' exports join
+                hdrs["X-Trace-Id"] = trace_id
+            conn.request("POST", "/v1/process", body=body, headers=hdrs)
+            resp = conn.getresponse()
+            out = resp.read()
+            ctype = resp.getheader("Content-Type", "application/json")
+        except BaseException:
+            conn.close()
+            raise
+        self._pool.give(addr, port, conn)
+        return resp.status, ctype, out
+
+    def _dispatch_mesh(
+        self, body: bytes, h: int, w: int
+    ) -> tuple[int, str, bytes, list[tuple[str, str]]]:
+        """The oversize lane: ONE request row-sharded over the multi-host
+        mesh in the router process (fabric/mesh.py)."""
+        from mpi_cuda_imagemanipulation_tpu.io.image import (
+            decode_image_bytes,
+            encode_image_bytes,
+        )
+
+        self._m_route.inc(policy="mesh")
+        root = obs_trace.start_trace(
+            "fabric.request", h=h, w=w, bucket="mesh", policy="mesh"
+        )
+        try:
+            with obs_trace.span("fabric.mesh", parent=root.context()):
+                img = decode_image_bytes(body)
+                out = self.mesh_lane.process(img)
+            png = encode_image_bytes(out)
+        except Exception as e:
+            self._m_requests.inc(status="error")
+            root.set(status=500)
+            root.end()
+            return _json_response(
+                500, {"error": f"mesh dispatch failed: {e}"}
+            )
+        self._m_requests.inc(status="ok")
+        root.set(status=200)
+        root.end()
+        extra = [("X-Fabric-Replica", "mesh")]
+        if root.trace_id:
+            extra.append(("X-Trace-Id", root.trace_id))
+        return 200, "image/png", png, extra
+
+    # -- control + introspection ------------------------------------------
+
+    def handle_heartbeat(self, body: bytes) -> tuple[int, dict]:
+        try:
+            hb = Heartbeat.from_json(body)
+        except (ValueError, TypeError) as e:
+            return 400, {"error": f"bad heartbeat: {e}"}
+        new_inc = self.table.observe(hb, self._clock())
+        if new_inc:
+            # fresh process behind the same id: it must not inherit its
+            # predecessor's open breaker (the restart IS the recovery)
+            self.breakers.reset(hb.replica_id)
+            self._log.info(
+                "replica %s registered (incarnation %s, %s:%d, state %s)",
+                hb.replica_id, hb.incarnation, hb.addr or "127.0.0.1",
+                hb.port, hb.state,
+            )
+        self._m_heartbeats.inc(replica=hb.replica_id)
+        return 200, {"ok": True}
+
+    def healthz(self) -> tuple[int, dict]:
+        routable = self._routable()
+        code = 200 if routable else 503
+        return code, {
+            "state": "serving" if routable else "unavailable",
+            "routable": sorted(v.replica_id for v in routable),
+            "known": len(self.table.views()),
+        }
+
+    def stats(self) -> dict:
+        now = self._clock()
+        return {
+            "buckets": [f"{h}x{w}" for h, w in self.buckets],
+            "stale_s": self.stale_s,
+            "forward_attempts": self.forward_attempts,
+            "shed_frac": self.shed_frac,
+            "mesh_lane": (
+                self.mesh_lane.stats() if self.mesh_lane is not None else None
+            ),
+            "replicas": {
+                v.replica_id: {
+                    "addr": v.hb.addr or "127.0.0.1",
+                    "port": v.hb.port,
+                    "pid": v.hb.pid,
+                    "incarnation": v.hb.incarnation,
+                    "state": v.hb.state,
+                    "fresh": v.fresh(now, self.stale_s),
+                    "age_s": now - v.last_seen,
+                    "queued": v.hb.queued,
+                    "queue_depth": v.hb.queue_depth,
+                    "breaker_open": v.hb.breaker_open,
+                    "warm_buckets": v.hb.warm_buckets,
+                    "beats": v.beats,
+                }
+                for v in self.table.views()
+            },
+            "breakers": self.breakers.snapshot(),
+        }
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self, host: str = "", port: int = 0) -> "Router":
+        try:
+            self.httpd = _RouterHTTPServer(
+                (host, port), _make_handler(self)
+            )
+            self._http_thread = threading.Thread(
+                target=self.httpd.serve_forever,
+                name="mcim-fabric-router",
+                daemon=True,
+            )
+            self._http_thread.start()
+        except BaseException:
+            self.close()
+            raise
+        return self
+
+    @property
+    def address(self) -> tuple[str, int]:
+        assert self.httpd is not None, "Router not started"
+        host, port = self.httpd.server_address[:2]
+        return (host, port)
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.address[1]}"
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self.httpd is not None:
+            try:
+                self.httpd.shutdown()
+            except Exception:
+                pass
+            self.httpd.server_close()
+        if self._http_thread is not None:
+            self._http_thread.join(timeout=10.0)
+        self._pool.close_all()
+
+    def __enter__(self) -> "Router":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()
+
+
+class _RouterHTTPServer(ThreadingHTTPServer):
+    # the front door takes every client's connection burst: the stock
+    # backlog of 5 turns load spikes into refused connections
+    request_queue_size = 128
+
+
+def _json_response(
+    code: int, payload: dict, extra: list[tuple[str, str]] | None = None
+) -> tuple[int, str, bytes, list[tuple[str, str]]]:
+    return (
+        code,
+        "application/json",
+        json.dumps(payload).encode(),
+        list(extra or ()),
+    )
+
+
+def _make_handler(router: Router):
+    log = get_logger()
+
+    class Handler(BaseHTTPRequestHandler):
+        # HTTP/1.1 keep-alive toward clients too (Content-Length is
+        # always set, so persistent connections are safe)
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):
+            log.debug("fabric-http: " + fmt, *args)
+
+        def _reply(self, code, ctype, body, extra=()):
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in extra:
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _reply_json(self, code, payload, extra=()):
+            c, t, b, e = _json_response(code, payload, list(extra))
+            self._reply(c, t, b, e)
+
+        def do_GET(self):  # noqa: N802 (stdlib casing)
+            if self.path == "/healthz":
+                code, payload = router.healthz()
+                self._reply_json(code, payload)
+            elif self.path == "/stats":
+                self._reply_json(200, router.stats())
+            elif self.path == "/metrics":
+                body = router.registry.render().encode()
+                self._reply(200, obs_metrics.CONTENT_TYPE, body)
+            else:
+                self._reply_json(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):  # noqa: N802
+            n = int(self.headers.get("Content-Length", "0"))
+            body = self.rfile.read(n)
+            if self.path == HEARTBEAT_PATH:
+                code, payload = router.handle_heartbeat(body)
+                self._reply_json(code, payload)
+            elif self.path == "/v1/process":
+                code, ctype, out, extra = router.handle_process(
+                    body, self.headers
+                )
+                self._reply(code, ctype, out, extra)
+            else:
+                self._reply_json(404, {"error": f"no route {self.path}"})
+
+    return Handler

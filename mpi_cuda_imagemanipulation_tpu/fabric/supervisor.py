@@ -1,0 +1,377 @@
+"""Replica supervision — spawn, monitor, restart-with-backoff — and the
+`Fabric` facade that runs router + supervised replicas as one unit.
+
+The supervisor is deliberately dumb: it owns PROCESS lifecycle only.
+Liveness, routing and breakers are the router's job (heartbeats), so the
+supervisor never talks to replicas beyond signals — the same separation
+that lets a real deployment swap this module for systemd/k8s while the
+router stays unchanged.
+
+Restart policy: a replica that exits (crash, OOM kill, the churn test's
+SIGKILL) is respawned after an exponential backoff (base * 2^attempt,
+capped), and the attempt counter resets once an incarnation survives
+`stable_s` — so a crash loop backs off instead of spinning, while a
+one-off kill rejoins after one base delay. Each restart increments
+`mcim_fabric_replica_restarts_total{replica=...}` on the shared fabric
+registry.
+
+`Fabric` is the assembly the CLI (`serve --replicas N` / `fabric`) and
+the tests use:
+
+    with Fabric(FabricConfig(replicas=3, ...)).start() as fab:
+        ... fab.url ...            # the front door
+        fab.kill_replica("r1")     # churn: SIGKILL; supervisor restarts it
+    # replicas SIGTERMed (graceful drain), router closed, on every path
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+from mpi_cuda_imagemanipulation_tpu.fabric.router import (
+    Router,
+    RouterConfig,
+)
+from mpi_cuda_imagemanipulation_tpu.obs.metrics import Registry
+from mpi_cuda_imagemanipulation_tpu.serve import bucketing
+from mpi_cuda_imagemanipulation_tpu.utils.log import get_logger
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+@dataclasses.dataclass
+class ReplicaSpec:
+    """How to (re)spawn one replica: its stable id, argv and env extras."""
+
+    replica_id: str
+    argv: list[str]
+    extra_env: dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+class _Managed:
+    """Supervisor-internal per-replica state (monitor thread only)."""
+
+    def __init__(self, spec: ReplicaSpec):
+        self.spec = spec
+        self.proc: subprocess.Popen | None = None
+        self.spawned_at = 0.0
+        self.attempts = 0  # consecutive restarts without a stable run
+        self.restart_due: float | None = None
+
+
+class Supervisor:
+    def __init__(
+        self,
+        specs: list[ReplicaSpec],
+        *,
+        registry: Registry | None = None,
+        backoff_base_s: float = 0.5,
+        backoff_max_s: float = 10.0,
+        stable_s: float = 5.0,
+        clock=time.monotonic,
+    ):
+        self.specs = list(specs)
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
+        self.stable_s = stable_s
+        self._clock = clock
+        self._managed = {s.replica_id: _Managed(s) for s in specs}
+        self._lock = threading.Lock()  # guards _managed.proc handles
+        self._running = False
+        self._wake = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._log = get_logger()
+        self._m_restarts = (registry or Registry()).counter(
+            "mcim_fabric_replica_restarts_total",
+            "Replica processes respawned by the supervisor, per replica.",
+            labels=("replica",),
+        )
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "Supervisor":
+        self._running = True
+        for m in self._managed.values():
+            self._spawn(m)
+        self._thread = threading.Thread(
+            target=self._monitor, name="mcim-fabric-supervisor", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _spawn(self, m: _Managed) -> None:
+        env = dict(os.environ)
+        # the worker must import THIS checkout even without an installed
+        # package (tests); prepending is harmless when one is installed
+        env["PYTHONPATH"] = _REPO_ROOT + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+        )
+        env.update(m.spec.extra_env)
+        m.proc = subprocess.Popen(m.spec.argv, env=env)
+        m.spawned_at = self._clock()
+        m.restart_due = None
+        self._log.info(
+            "spawned replica %s (pid %d)", m.spec.replica_id, m.proc.pid
+        )
+
+    def _monitor(self) -> None:
+        while self._running:
+            now = self._clock()
+            for m in self._managed.values():
+                proc = m.proc
+                if proc is None:
+                    continue
+                if proc.poll() is None:
+                    # alive; a long stable run forgives past crashes
+                    if m.attempts and now - m.spawned_at >= self.stable_s:
+                        m.attempts = 0
+                    continue
+                if not self._running:
+                    break
+                if m.restart_due is None:
+                    if now - m.spawned_at >= self.stable_s:
+                        m.attempts = 0
+                    delay = min(
+                        self.backoff_base_s * (2**m.attempts),
+                        self.backoff_max_s,
+                    )
+                    m.restart_due = now + delay
+                    self._log.warning(
+                        "replica %s exited (rc %s); restart in %.2fs "
+                        "(attempt %d)",
+                        m.spec.replica_id, proc.returncode, delay,
+                        m.attempts + 1,
+                    )
+                elif now >= m.restart_due:
+                    m.attempts += 1
+                    self._m_restarts.inc(replica=m.spec.replica_id)
+                    self._spawn(m)
+            self._wake.wait(0.05)
+
+    def stop(self, *, drain: bool = True, deadline_s: float = 30.0) -> None:
+        """SIGTERM every replica (graceful drain in the worker), wait out
+        the deadline, SIGKILL stragglers. Idempotent."""
+        self._running = False
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        procs = [
+            m.proc for m in self._managed.values() if m.proc is not None
+        ]
+        sig = signal.SIGTERM if drain else signal.SIGKILL
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.send_signal(sig)
+                except OSError:
+                    pass
+        deadline = self._clock() + deadline_s
+        for p in procs:
+            left = max(0.1, deadline - self._clock())
+            try:
+                p.wait(timeout=left)
+            except subprocess.TimeoutExpired:
+                self._log.warning(
+                    "replica pid %d ignored the drain deadline; killing",
+                    p.pid,
+                )
+                p.kill()
+                p.wait(timeout=10.0)
+
+    # -- churn / introspection --------------------------------------------
+
+    def kill(self, replica_id: str) -> int:
+        """SIGKILL one replica (no drain, no warning — the churn test's
+        simulated hard failure). The monitor restarts it with backoff.
+        Returns the killed pid."""
+        with self._lock:
+            m = self._managed[replica_id]
+            proc = m.proc
+        assert proc is not None, f"{replica_id} was never spawned"
+        proc.kill()
+        proc.wait(timeout=10.0)
+        return proc.pid
+
+    def pids(self) -> dict[str, int | None]:
+        with self._lock:
+            return {
+                rid: (m.proc.pid if m.proc is not None else None)
+                for rid, m in self._managed.items()
+            }
+
+    def restarts(self, replica_id: str) -> int:
+        return int(self._m_restarts.value(replica=replica_id))
+
+
+@dataclasses.dataclass(frozen=True)
+class FabricConfig:
+    """The whole pod in one value: replica count + the serve knobs each
+    replica runs with + router policy overrides."""
+
+    replicas: int = 3
+    ops: str = "grayscale,contrast:3.5,emboss:3"
+    buckets: str = "512,1024,2048,4096"  # CLI spec; parsed for the router
+    channels: str = "1,3"
+    max_batch: int = 8
+    max_delay_ms: float = 5.0
+    queue_depth: int = 64
+    impl: str = "xla"
+    heartbeat_s: float | None = None  # None: MCIM_FABRIC_HEARTBEAT_S
+    router: RouterConfig | None = None  # None: RouterConfig(buckets=...)
+    mesh_shards: int = 0  # >0: arm the oversize mesh lane in the router
+    mesh_halo_mode: str = "serial"
+    # per-replica env overrides (failpoint injection on one worker, trace
+    # export paths, ...) and extra replica argv (e.g. --trace-out)
+    replica_env: dict[str, dict[str, str]] = dataclasses.field(
+        default_factory=dict
+    )
+    replica_argv_extra: dict[str, list[str]] = dataclasses.field(
+        default_factory=dict
+    )
+    supervisor_backoff_s: float = 0.5
+    supervisor_stable_s: float = 5.0
+
+
+class Fabric:
+    """Router + supervised replicas, one lifecycle."""
+
+    def __init__(self, config: FabricConfig):
+        self.config = config
+        self.registry = Registry()
+        mesh_lane = None
+        if config.mesh_shards > 0:
+            from mpi_cuda_imagemanipulation_tpu.fabric.mesh import MeshLane
+
+            mesh_lane = MeshLane(
+                config.ops,
+                config.mesh_shards,
+                halo_mode=config.mesh_halo_mode,
+            )
+        self.router = Router(
+            config.router
+            or RouterConfig(buckets=bucketing.parse_buckets(config.buckets)),
+            registry=self.registry,
+            mesh_lane=mesh_lane,
+        )
+        self.supervisor: Supervisor | None = None
+        self._log = get_logger()
+
+    def replica_ids(self) -> list[str]:
+        return [f"r{i}" for i in range(self.config.replicas)]
+
+    def _replica_argv(self, rid: str) -> list[str]:
+        c = self.config
+        argv = [
+            sys.executable, "-m",
+            "mpi_cuda_imagemanipulation_tpu.fabric.replica",
+            "--replica-id", rid,
+            "--router", self.router.url,
+            "--ops", c.ops,
+            "--buckets", c.buckets,
+            "--channels", c.channels,
+            "--max-batch", str(c.max_batch),
+            "--max-delay-ms", str(c.max_delay_ms),
+            "--queue-depth", str(c.queue_depth),
+            "--impl", c.impl,
+        ]
+        if c.heartbeat_s is not None:
+            argv += ["--heartbeat-s", str(c.heartbeat_s)]
+        argv += c.replica_argv_extra.get(rid, [])
+        return argv
+
+    def start(
+        self,
+        host: str = "",
+        port: int = 0,
+        *,
+        ready_timeout_s: float = 180.0,
+    ) -> "Fabric":
+        try:
+            self.router.start(host, port)
+            specs = [
+                ReplicaSpec(
+                    replica_id=rid,
+                    argv=self._replica_argv(rid),
+                    extra_env=self.config.replica_env.get(rid, {}),
+                )
+                for rid in self.replica_ids()
+            ]
+            self.supervisor = Supervisor(
+                specs,
+                registry=self.registry,
+                backoff_base_s=self.config.supervisor_backoff_s,
+                stable_s=self.config.supervisor_stable_s,
+            ).start()
+            self.wait_ready(
+                self.config.replicas, timeout_s=ready_timeout_s
+            )
+        except BaseException:
+            self.close(drain=False)
+            raise
+        return self
+
+    def wait_ready(self, n: int, *, timeout_s: float = 180.0) -> None:
+        """Block until `n` replicas are fresh + routable (each has warmed
+        its compile cache and heartbeated `serving`)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if len(self.router._routable()) >= n:
+                return
+            time.sleep(0.1)
+        pids = self.supervisor.pids() if self.supervisor else {}
+        raise TimeoutError(
+            f"{n} replicas not serving within {timeout_s:.0f}s "
+            f"(routable: {sorted(v.replica_id for v in self.router._routable())}, "
+            f"pids: {pids})"
+        )
+
+    @property
+    def url(self) -> str:
+        return self.router.url
+
+    def kill_replica(self, replica_id: str) -> int:
+        assert self.supervisor is not None
+        return self.supervisor.kill(replica_id)
+
+    def stats(self) -> dict:
+        return {
+            "router": self.router.stats(),
+            "pids": self.supervisor.pids() if self.supervisor else {},
+        }
+
+    def scrape(self) -> str:
+        """The router's /metrics body over HTTP (what a Prometheus scrape
+        sees — exercised, not simulated)."""
+        with urllib.request.urlopen(
+            self.url + "/metrics", timeout=10.0
+        ) as resp:
+            return resp.read().decode()
+
+    def http_stats(self) -> dict:
+        with urllib.request.urlopen(
+            self.url + "/stats", timeout=10.0
+        ) as resp:
+            return json.loads(resp.read())
+
+    def close(self, *, drain: bool = True, deadline_s: float = 30.0) -> None:
+        if self.supervisor is not None:
+            self.supervisor.stop(drain=drain, deadline_s=deadline_s)
+            self.supervisor = None
+        self.router.close()
+
+    def __enter__(self) -> "Fabric":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()

@@ -175,21 +175,28 @@ class Tracer:
                 self._thread_names[tid] = threading.current_thread().name
         return Span(self, name, trace_id, sid, parent_id, args)
 
-    def start_trace(self, name: str, **args) -> Span:
+    def start_trace(self, name: str, *, trace_id: str | None = None,
+                    **args) -> Span:
         """Root span of a NEW trace — the only call that makes a sampling
         decision. Deterministic: at rate f, trace n is kept iff
-        floor(n*f) > floor((n-1)*f), i.e. evenly every 1/f traces."""
+        floor(n*f) > floor((n-1)*f), i.e. evenly every 1/f traces.
+
+        `trace_id` ADOPTS an upstream id instead of minting one (the
+        fabric router → replica hop: the router made the sampling
+        decision and propagated the id via X-Trace-Id, so the replica's
+        root span joins the same distributed trace rather than rolling
+        its own dice — exports from both processes merge on the id)."""
         with self._lock:
             self._n_traces += 1
             n = self._n_traces
-            take = math.floor(n * self.sample) > math.floor(
-                (n - 1) * self.sample
-            )
+            take = trace_id is not None or math.floor(
+                n * self.sample
+            ) > math.floor((n - 1) * self.sample)
             if take:
                 self._n_sampled += 1
         if not take:
             return NOOP_SPAN
-        trace_id = f"{self._prefix}-{n:x}"
+        trace_id = trace_id or f"{self._prefix}-{n:x}"
         span = self._new_span(name, trace_id, 0, args)
         span.args.setdefault("trace_id", trace_id)
         return span
@@ -330,10 +337,10 @@ def get_tracer() -> Tracer | None:
     return _tracer
 
 
-def start_trace(name: str, **args):
+def start_trace(name: str, *, trace_id: str | None = None, **args):
     if not _enabled:
         return NOOP_SPAN
-    return _tracer.start_trace(name, **args)
+    return _tracer.start_trace(name, trace_id=trace_id, **args)
 
 
 def span(name: str, parent: SpanContext | None = None, **args):

@@ -123,6 +123,10 @@ class BreakerBoard:
         )
         self._lock = threading.Lock()
         self._breakers: dict = {}
+        # trips of breakers since reset() — open_events is CUMULATIVE
+        # over the board's lifetime, so dropping a replica's breaker on
+        # restart cannot erase the evidence that it tripped
+        self._reset_open_events = 0
 
     def get(self, key) -> CircuitBreaker:
         with self._lock:
@@ -136,11 +140,32 @@ class BreakerBoard:
             breakers = list(self._breakers.values())
         return any(b.state != CLOSED for b in breakers)
 
+    def open_keys(self) -> list:
+        """The RAW keys whose breaker is not closed (snapshot() stringifies
+        them for JSON) — the fabric heartbeat reports these per replica so
+        the router can route a bucket around a replica whose breaker for
+        exactly that bucket is open."""
+        with self._lock:
+            breakers = list(self._breakers.items())
+        return [k for k, b in breakers if b.state != CLOSED]
+
+    def reset(self, key) -> None:
+        """Drop the breaker for `key` entirely (fresh CLOSED on next get).
+        The fabric router calls this when a replica restarts — a new
+        incarnation must not inherit its predecessor's open breaker. The
+        dropped breaker's trips stay in the board's cumulative count."""
+        with self._lock:
+            b = self._breakers.pop(key, None)
+            if b is not None:
+                self._reset_open_events += b.open_events
+
     def snapshot(self) -> dict:
         with self._lock:
             breakers = list(self._breakers.items())
+            dropped = self._reset_open_events
         return {
-            "open_events": sum(b.open_events for _, b in breakers),
+            "open_events": dropped
+            + sum(b.open_events for _, b in breakers),
             "by_key": {
                 str(k): {"state": b.state, "open_events": b.open_events}
                 for k, b in breakers

@@ -17,6 +17,13 @@ Spec grammar (comma-separated `site=mode` pairs):
     batch.interrupt=after:5   every call after the 5th fails (simulates a
                               mid-run kill/preemption for --resume tests)
     serve.dispatch=always     every call fails
+    serve.dispatch=sleep:40   every call SLEEPS 40 ms instead of failing —
+                              latency injection: simulates a slow replica
+                              (tail-latency testing for the fabric
+                              router's shedding) and stands in for
+                              per-dispatch device time in CPU bench lanes
+                              (fabric_loadgen), where 1-core hosts cannot
+                              express real device parallelism
 
 Tests can also `install(site, decider)` a predicate over the call's
 keyword context (e.g. fail only when a poison request is in the batch).
@@ -48,6 +55,12 @@ KNOWN_SITES = (
                         # dispatch that enqueued fine but fails at
                         # force/D2H time — the failure class async
                         # execution exposes that the serial loop cannot
+    "router.forward",   # fabric/router.py: one proxy attempt to a replica
+                        # (injected forward failure drives rerouting +
+                        # the per-replica breaker without killing anyone)
+    "replica.heartbeat",  # fabric/control.py HeartbeatSender: a hit DROPS
+                        # that beat, so the router sees heartbeat loss /
+                        # staleness while the replica keeps serving
 )
 
 ENV_SPEC = "MCIM_FAILPOINTS"
@@ -67,10 +80,11 @@ class FailpointError(RuntimeError):
 class _Site:
     """One armed site: decider + deterministic PRNG + call counter."""
 
-    def __init__(self, name: str, decider, seed: int):
+    def __init__(self, name: str, decider, seed: int, delay_s: float = 0.0):
         self.name = name
         self.decider = decider
         self.rng = random.Random(seed ^ zlib.crc32(name.encode()))
+        self.delay_s = delay_s  # sleep:MS latency injection (never raises)
         self.calls = 0
         self.fired = 0
 
@@ -81,8 +95,18 @@ _active = False  # lock-free fast-path flag; only flipped under _lock
 
 
 def _parse_mode(site: str, mode: str):
-    """Mode string -> decider(site_state, ctx) -> bool."""
+    """Mode string -> (decider(site_state, ctx) -> bool, delay_s)."""
     mode = mode.strip().lower()
+    if mode.startswith("sleep:"):
+        ms = float(mode.split(":", 1)[1])
+        if ms < 0:
+            raise ValueError(f"failpoint {site!r}: negative sleep {ms}ms")
+        # latency injection: every call delays, none raise
+        return (lambda s, ctx: False), ms / 1e3
+    return _parse_fail_mode(site, mode), 0.0
+
+
+def _parse_fail_mode(site: str, mode: str):
     if mode == "always":
         return lambda s, ctx: True
     if mode == "once":
@@ -121,7 +145,8 @@ def configure(spec: str | None, *, seed: int = 0) -> None:
                 raise ValueError(
                     f"unknown failpoint site {site!r}; known: {KNOWN_SITES}"
                 )
-            new[site] = _Site(site, _parse_mode(site, mode), seed)
+            decider, delay_s = _parse_mode(site, mode)
+            new[site] = _Site(site, decider, seed, delay_s=delay_s)
     global _active
     with _lock:
         _sites.clear()
@@ -161,7 +186,9 @@ def is_active() -> bool:
 
 def maybe_fail(site: str, **ctx) -> None:
     """The injection point. Disarmed: one flag check. Armed: count the
-    call, ask the site's decider, raise FailpointError on a hit."""
+    call, ask the site's decider, raise FailpointError on a hit (or, for
+    `sleep:MS` modes, delay the caller — OUTSIDE the lock, so a slow
+    site never stalls other sites' decisions)."""
     if not _active:
         return
     with _lock:
@@ -170,9 +197,14 @@ def maybe_fail(site: str, **ctx) -> None:
             return
         s.calls += 1
         hit = s.decider(s, ctx)
+        delay_s = s.delay_s
         if hit:
             s.fired += 1
             n = s.calls
+    if delay_s:
+        import time
+
+        time.sleep(delay_s)
     if hit:
         raise FailpointError(site, n)
 

@@ -57,9 +57,13 @@ class CompileCache:
         self.hits = 0
         self.misses = 0
         # per-shape-bucket hit split ("HxW" -> count): the /metrics
-        # mcim_cache_hits family — the signal replica bucket affinity
-        # (ROADMAP item 1) will route on
+        # mcim_cache_hits family and the fabric heartbeat's hot-bucket
+        # affinity signal (fabric/control.py). Label cardinality is
+        # CAPPED at the admission bucket set: off-grid keys — which
+        # adversarial shape traffic could otherwise mint without bound,
+        # one label per novel shape — fold into the single "other" label
         self.hits_by_bucket: dict[str, int] = {}
+        self._tracked_buckets = {f"{h}x{w}" for h, w in self.buckets}
         self.warmup_s: float | None = None
         # transient compile failures at warmup (wedged backend coming up,
         # injected cache.warm failpoint) retry with backoff instead of
@@ -139,6 +143,8 @@ class CompileCache:
     def get(self, bucket_h: int, bucket_w: int, channels: int, batch: int):
         key = (bucket_h, bucket_w, channels, batch)
         bucket = f"{bucket_h}x{bucket_w}"
+        if bucket not in self._tracked_buckets:
+            bucket = "other"  # bounded label set: admission grid + other
         with self._lock:
             fn = self._fns.get(key)
             if fn is not None:
@@ -155,6 +161,15 @@ class CompileCache:
         fn = self._build(key)
         with self._lock:
             return self._fns.setdefault(key, fn)
+
+    def warm_buckets(self) -> list[str]:
+        """The "HxW" buckets with at least one compiled executable — the
+        fabric heartbeat's warm-affinity signal. After warmup this is the
+        whole admission grid (which is exactly why a RESTARTED replica
+        reclaims its consistent-hash buckets once it reports in: warmth
+        is rebuilt by warmup, unlike serving history)."""
+        with self._lock:
+            return sorted({f"{bh}x{bw}" for (bh, bw, _c, _n) in self._fns})
 
     def stats(self) -> dict:
         with self._lock:

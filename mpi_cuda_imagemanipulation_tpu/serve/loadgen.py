@@ -17,6 +17,14 @@ also reports AVAILABILITY under injected transient faults: success %,
 shed %, retried %, quarantined — the numbers that size `--retry-attempts`
 and the breaker knobs the way the latency curve sizes the batching ones.
 
+The CHURN mode (`churn_run`, the `fabric_loadgen` lane) drives the pod
+fabric over real HTTP instead: the same open-loop arrival clock fires
+`POST /v1/process` at the front-door router through a worker pool, a
+replica is SIGKILLed mid-sweep, and the record reports ok% / retried%
+(router rerouting, from the X-Fabric-Attempts response header) / p99 for
+the BEFORE, DURING and AFTER phases — availability under churn as three
+numbers, not an anecdote.
+
 With tracing armed (obs/trace.py, e.g. MCIM_TRACE_SAMPLE=1) every request
 carries a trace id and each per-rate record names its slowest completions
 (`slowest_traces`) and failures (`failed_traces`) by id — the p99 outlier
@@ -25,6 +33,7 @@ is pulled up by id in the `--trace-out` file, not found by eyeballing.
 
 from __future__ import annotations
 
+import threading
 import time
 
 import numpy as np
@@ -130,6 +139,158 @@ def run_offered_load(
         if failed_ids:
             rec["failed_traces"] = failed_ids[:10]
     return rec
+
+
+# --------------------------------------------------------------------------
+# HTTP loadgen + availability-under-churn (the fabric front door)
+# --------------------------------------------------------------------------
+
+
+def http_post_image(url: str, blob: bytes, *, timeout_s: float = 30.0) -> dict:
+    """One `POST /v1/process` against a front door (router or replica).
+    Returns {code, body, attempts, replica, trace_id, e2e_s}; transport
+    errors surface as code 599 so open-loop accounting never raises."""
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(
+        url.rstrip("/") + "/v1/process",
+        data=blob,
+        headers={"Content-Type": "application/octet-stream"},
+        method="POST",
+    )
+    t0 = time.monotonic()
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            body = resp.read()
+            code = resp.status
+            hdrs = resp.headers
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        code = e.code
+        hdrs = e.headers
+    except Exception:
+        # connection refused/reset mid-churn: a transport-level failure,
+        # distinct from any server-sent status
+        return {
+            "code": 599, "body": b"", "attempts": 1, "replica": "",
+            "trace_id": "", "e2e_s": time.monotonic() - t0,
+        }
+    return {
+        "code": code,
+        "body": body,
+        "attempts": int(hdrs.get("X-Fabric-Attempts", "1") or 1),
+        "replica": hdrs.get("X-Fabric-Replica", ""),
+        "trace_id": hdrs.get("X-Trace-Id", ""),
+        "e2e_s": time.monotonic() - t0,
+    }
+
+
+def http_run_offered_load(
+    url: str,
+    blobs: list[bytes],
+    offered_rps: float,
+    duration_s: float,
+    *,
+    timeout_s: float = 30.0,
+    max_workers: int = 32,
+    clock=time.monotonic,
+    sleep=time.sleep,
+) -> dict:
+    """The open-loop driver over HTTP: arrivals on the offered clock via a
+    worker pool, collection afterwards (same discipline as
+    `run_offered_load` — completions never gate arrivals). Returns the
+    phase record plus `results`: [(blob_index, response dict), ...] so the
+    caller can verify successes bit-exactly against golden outputs."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    period = 1.0 / offered_rps
+    futures = []
+    with ThreadPoolExecutor(max_workers=max_workers) as pool:
+        t0 = clock()
+        i = 0
+        while True:
+            due = t0 + i * period
+            now = clock()
+            if due - t0 >= duration_s:
+                break
+            if due > now:
+                sleep(due - now)
+            k = i % len(blobs)
+            futures.append(
+                (k, pool.submit(http_post_image, url, blobs[k],
+                                timeout_s=timeout_s))
+            )
+            i += 1
+        results = [(k, f.result()) for k, f in futures]
+        wall = clock() - t0
+    ok = [r for _, r in results if r["code"] == 200]
+    retried = sum(1 for _, r in results if r["attempts"] > 1)
+    lat = [r["e2e_s"] for r in ok]
+    n = len(results)
+    rec = {
+        "offered_rps": offered_rps,
+        "submitted": n,
+        "ok": len(ok),
+        "ok_frac": len(ok) / n if n else 0.0,
+        "retried": retried,
+        "retried_frac": retried / n if n else 0.0,
+        "unavailable": sum(
+            1 for _, r in results if r["code"] in (503, 599)
+        ),
+        "overloaded": sum(1 for _, r in results if r["code"] == 429),
+        "achieved_rps": len(ok) / wall if wall > 0 else 0.0,
+        "wall_s": wall,
+        "results": results,
+    }
+    if lat:
+        p = percentiles(lat, PERCENTILES)
+        rec.update({f"e2e_p{int(q)}_ms": p[q] * 1e3 for q in PERCENTILES})
+    return rec
+
+
+def churn_run(
+    url: str,
+    blobs: list[bytes],
+    *,
+    offered_rps: float,
+    phase_s: float,
+    kill,
+    before_after=None,
+    timeout_s: float = 30.0,
+) -> dict:
+    """Availability under churn, in three measured phases:
+
+        before   steady state, every replica up
+        during   `kill()` fires at the phase midpoint (SIGKILL one
+                 replica) while the offered load keeps arriving — the
+                 in-flight forwards to the dead replica must resolve via
+                 router rerouting, not hang or error
+        after    `before_after()` (e.g. wait for the supervisor restart
+                 to rejoin) runs first, then steady state again
+
+    Each phase reports ok% / retried% / p99; `results` ride along for
+    bit-exactness checks. The per-phase numbers ARE the acceptance
+    criterion: during-phase ok_frac stays 1.0 when rerouting works."""
+    phases: dict[str, dict] = {}
+    phases["before"] = http_run_offered_load(
+        url, blobs, offered_rps, phase_s, timeout_s=timeout_s
+    )
+    killer = threading.Timer(phase_s / 2.0, kill)
+    killer.start()
+    try:
+        phases["during"] = http_run_offered_load(
+            url, blobs, offered_rps, phase_s, timeout_s=timeout_s
+        )
+    finally:
+        killer.cancel()  # no-op if it already fired
+        killer.join()
+    if before_after is not None:
+        before_after()
+    phases["after"] = http_run_offered_load(
+        url, blobs, offered_rps, phase_s, timeout_s=timeout_s
+    )
+    return phases
 
 
 def sweep(

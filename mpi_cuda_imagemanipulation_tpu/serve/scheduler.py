@@ -252,12 +252,18 @@ class MicroBatchScheduler:
     # -- admission ---------------------------------------------------------
 
     def submit(
-        self, img: np.ndarray, *, deadline_ms: float | None = None
+        self,
+        img: np.ndarray,
+        *,
+        deadline_ms: float | None = None,
+        trace_id: str | None = None,
     ) -> Request:
         """Admit one image; returns a Request whose `.wait()` yields the
         response. Never blocks: over-depth submissions fail immediately
         with `overloaded` (the Request is returned already-resolved, so
-        open-loop callers can fire-and-collect)."""
+        open-loop callers can fire-and-collect). `trace_id` adopts an
+        upstream distributed-trace id (the fabric router's X-Trace-Id
+        hop) instead of minting one here."""
         now = self._clock()
         self.metrics.on_submit()
         img = np.asarray(img)
@@ -271,9 +277,9 @@ class MicroBatchScheduler:
         )
         # root span: one trace per request, made HERE (the only sampling
         # decision on this request's path — everything downstream anchors
-        # to it or no-ops)
+        # to it or no-ops; an adopted upstream id overrides the decision)
         root = obs_trace.start_trace(
-            "serve.request", h=req.true_h, w=req.true_w
+            "serve.request", trace_id=trace_id, h=req.true_h, w=req.true_w
         )
         req.trace = root
         req.trace_id = root.trace_id

@@ -310,6 +310,11 @@ def _make_handler(app: ServeApp):
     log = get_logger()
 
     class Handler(BaseHTTPRequestHandler):
+        # HTTP/1.1: persistent connections, so the fabric router's proxy
+        # pool reuses sockets instead of paying a TCP setup per forward
+        # (every response already carries Content-Length)
+        protocol_version = "HTTP/1.1"
+
         # threaded server + per-request work => keep socket errors quiet
         def log_message(self, fmt, *args):  # route through our logger
             log.debug("http: " + fmt, *args)
@@ -366,7 +371,13 @@ def _make_handler(app: ServeApp):
                 self._send_json(400, {"error": f"undecodable image: {e}"})
                 return
             req = app.scheduler.submit(
-                img, deadline_ms=app.config.default_deadline_ms
+                img,
+                deadline_ms=app.config.default_deadline_ms,
+                # adopt the fabric router's distributed trace id when the
+                # request arrived through the front door (X-Trace-Id hop:
+                # the router made the sampling decision; this replica's
+                # serve.request root joins that trace)
+                trace_id=self.headers.get("X-Trace-Id") or None,
             )
             req.done.wait()
             # the trace id rides the response either way, so a slow or
@@ -400,12 +411,19 @@ def _make_handler(app: ServeApp):
     return Handler
 
 
+class _ServeHTTPServer(ThreadingHTTPServer):
+    # socketserver's default listen backlog is 5 — at fabric rates a
+    # connection burst overflows it and clients see refused connections
+    # that look like server failures; 128 rides bursts out
+    request_queue_size = 128
+
+
 def make_http_server(app: ServeApp, host: str = "", port: int = 8000):
     """A ThreadingHTTPServer bound to (host, port); port 0 picks a free one
     (the bound port is `server.server_address[1]`). Caller owns
     serve_forever()/shutdown(). Prefer `Server`, which guarantees release
     on exception paths."""
-    return ThreadingHTTPServer((host, port), _make_handler(app))
+    return _ServeHTTPServer((host, port), _make_handler(app))
 
 
 class Server:

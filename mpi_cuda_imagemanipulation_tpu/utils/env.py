@@ -111,6 +111,33 @@ _VARS = (
     EnvVar("MCIM_SERVE_FAULT_RATE", None, "bench_suite.py",
            "serve_loadgen lane: injected transient dispatch-failure rate "
            "(availability columns)."),
+    # -- pod-scale serving fabric (fabric/) ----------------------------------
+    EnvVar("MCIM_FABRIC_HEARTBEAT_S", "0.5", "fabric/control.py",
+           "Replica heartbeat period in seconds (replica -> router push "
+           "over HTTP)."),
+    EnvVar("MCIM_FABRIC_STALE_S", "2.0", "fabric/router.py",
+           "Router freshness window: a replica whose last heartbeat is "
+           "older than this is routed around until it beats again."),
+    EnvVar("MCIM_FABRIC_FORWARD_TIMEOUT_S", "30", "fabric/router.py",
+           "Per-attempt router -> replica proxy timeout (connect + full "
+           "response read)."),
+    EnvVar("MCIM_FABRIC_FORWARD_ATTEMPTS", "3", "fabric/router.py",
+           "Forward attempts per request across DISTINCT replicas before "
+           "the router answers 503 (attempt 2+ counts as retried)."),
+    EnvVar("MCIM_FABRIC_SHED_FRAC", "0.8", "fabric/router.py",
+           "Queue-fill fraction (queued/queue_depth from the heartbeat) "
+           "past which the sticky target is skipped for the least-loaded "
+           "healthy replica."),
+    EnvVar("MCIM_FABRIC_RPS", None, "bench_suite.py",
+           "fabric_loadgen lane: offered-rate override (single float)."),
+    EnvVar("MCIM_FABRIC_DURATION_S", None, "bench_suite.py",
+           "fabric_loadgen lane: per-phase sweep duration override."),
+    EnvVar("MCIM_FABRIC_REPLICAS", None, "bench_suite.py",
+           "fabric_loadgen lane: scaled-lane replica count override "
+           "(default 3; the baseline lane is always 1)."),
+    EnvVar("MCIM_FABRIC_AB_JSON", None, "tests/test_fabric.py",
+           "CI: write the fabric_loadgen lane record to this path "
+           "(uploaded as an artifact)."),
     # -- bench driver (bench.py, repo root) ----------------------------------
     EnvVar("MCIM_NO_HISTORY", None, "bench.py",
            "Any non-empty value: do not append promoted records to "

@@ -1,0 +1,549 @@
+"""Pod-scale serving fabric (fabric/) — the ISSUE-8 acceptance suite.
+
+The load-bearing invariants:
+  1. routing is health- and affinity-aware: warm/sticky targets first,
+     degraded / breaker-open / queue-full targets demoted, stale replicas
+     excluded, 503 + Retry-After only when NOTHING is routable;
+  2. the full hop is bit-exact: a PNG through router -> replica ->
+     response equals the golden per-request `Pipeline.jit` output;
+  3. churn is survivable: SIGKILL one of three replica processes
+     mid-loadgen and every accepted request still resolves ok (bit-exact)
+     via rerouting retries, the router's breaker opens for the dead
+     replica, and the supervisor-restarted replica rejoins and receives
+     traffic again;
+  4. one trace spans the hop: the router's X-Trace-Id is adopted by the
+     replica's serve.request root (obs/trace.py adoption).
+"""
+
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mpi_cuda_imagemanipulation_tpu.fabric.control import (
+    HEARTBEAT_PATH,
+    Heartbeat,
+)
+from mpi_cuda_imagemanipulation_tpu.fabric.replica import ReplicaRuntime
+from mpi_cuda_imagemanipulation_tpu.fabric.router import (
+    Router,
+    RouterConfig,
+    _rendezvous_score,
+)
+from mpi_cuda_imagemanipulation_tpu.io.image import (
+    decode_image_bytes,
+    encode_image_bytes,
+    synthetic_image,
+)
+from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline
+from mpi_cuda_imagemanipulation_tpu.obs import trace as obs_trace
+from mpi_cuda_imagemanipulation_tpu.obs.metrics import parse_exposition
+from mpi_cuda_imagemanipulation_tpu.resilience import failpoints
+from mpi_cuda_imagemanipulation_tpu.serve import loadgen
+from mpi_cuda_imagemanipulation_tpu.serve.bucketing import parse_buckets
+from mpi_cuda_imagemanipulation_tpu.serve.server import ServeConfig
+
+OPS = "grayscale,contrast:3.5"
+BUCKETS = "48,96"
+
+
+# --------------------------------------------------------------------------
+# control plane: heartbeat protocol + replica table
+# --------------------------------------------------------------------------
+
+
+def _hb(
+    rid: str,
+    *,
+    state: str = "serving",
+    queued: int = 0,
+    queue_depth: int = 64,
+    breaker_open=(),
+    warm=(),
+    incarnation: str = "i1",
+    port: int = 1,
+    seq: int = 1,
+) -> Heartbeat:
+    return Heartbeat(
+        replica_id=rid,
+        addr="127.0.0.1",
+        port=port,
+        pid=0,
+        incarnation=incarnation,
+        state=state,
+        queued=queued,
+        queue_depth=queue_depth,
+        breaker_open=list(breaker_open),
+        warm_buckets=list(warm),
+        seq=seq,
+        sent_unix_s=0.0,
+    )
+
+
+def test_heartbeat_json_roundtrip():
+    hb = _hb("r0", warm=["48x48"], breaker_open=["96x96"])
+    assert Heartbeat.from_json(hb.to_json()) == hb
+
+
+def test_heartbeat_rejects_version_skew():
+    import json
+
+    raw = json.loads(_hb("r0").to_json())
+    raw["bogus_field"] = 1
+    with pytest.raises(ValueError, match="unknown fields"):
+        Heartbeat.from_json(json.dumps(raw).encode())
+    del raw["bogus_field"]
+    del raw["state"]
+    with pytest.raises(ValueError, match="missing fields"):
+        Heartbeat.from_json(json.dumps(raw).encode())
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def _router(**cfg_over) -> tuple[Router, _Clock]:
+    clock = _Clock()
+    cfg = RouterConfig(
+        buckets=parse_buckets(BUCKETS),
+        stale_s=1.0,
+        forward_attempts=3,
+        shed_frac=0.8,
+        **cfg_over,
+    )
+    return Router(cfg, clock=clock), clock
+
+
+def test_table_detects_restart_incarnation():
+    router, clock = _router()
+    assert router.table.observe(_hb("r0"), clock()) is True
+    assert router.table.observe(_hb("r0"), clock()) is False
+    assert (
+        router.table.observe(_hb("r0", incarnation="i2"), clock()) is True
+    )
+
+
+# --------------------------------------------------------------------------
+# routing policy (pure, over injected heartbeats)
+# --------------------------------------------------------------------------
+
+
+def test_route_prefers_warm_replica():
+    router, clock = _router()
+    router.table.observe(_hb("r0"), clock())
+    router.table.observe(_hb("r1", warm=["48x48"]), clock())
+    cands, policy = router.route("48x48")
+    assert policy == "sticky"
+    assert cands[0].replica_id == "r1"  # warm beats rendezvous
+    assert [c.replica_id for c in cands[1:]] == ["r0"]
+
+
+def test_route_consistent_hash_fallback_is_deterministic():
+    router, clock = _router()
+    router.table.observe(_hb("r0"), clock())
+    router.table.observe(_hb("r1"), clock())
+    first = router.route("96x96")[0][0].replica_id
+    for _ in range(5):
+        assert router.route("96x96")[0][0].replica_id == first
+    # the rendezvous winner really is the max-score replica
+    want = max(
+        ("r0", "r1"), key=lambda rid: _rendezvous_score("96x96", rid)
+    )
+    assert first == want
+
+
+def test_route_sheds_off_degraded_and_loaded_sticky():
+    router, clock = _router()
+    router.table.observe(_hb("r0", warm=["48x48"], state="degraded"), clock())
+    router.table.observe(_hb("r1"), clock())
+    cands, policy = router.route("48x48")
+    assert policy == "least_loaded"
+    assert cands[0].replica_id == "r1"
+    # queue past shed_frac demotes the sticky target the same way
+    router.table.observe(
+        _hb("r0", warm=["48x48"], queued=60, queue_depth=64), clock()
+    )
+    cands, policy = router.route("48x48")
+    assert (policy, cands[0].replica_id) == ("least_loaded", "r1")
+    # an open breaker for exactly this bucket too
+    router.table.observe(
+        _hb("r0", warm=["48x48"], breaker_open=["48x48"]), clock()
+    )
+    cands, policy = router.route("48x48")
+    assert (policy, cands[0].replica_id) == ("least_loaded", "r1")
+
+
+def test_route_excludes_stale_and_reports_none():
+    router, clock = _router()
+    router.table.observe(_hb("r0"), clock())
+    clock.t += 0.5
+    assert router.route("48x48")[0]  # fresh
+    clock.t += 1.0  # past stale_s
+    cands, policy = router.route("48x48")
+    assert cands == [] and policy == "none"
+
+
+def test_restart_resets_router_breaker():
+    router, clock = _router()
+    router.handle_heartbeat(_hb("r0").to_json())
+    b = router.breakers.get("r0")
+    b.on_failure()
+    b.on_failure()
+    assert b.state != "closed"
+    router.handle_heartbeat(_hb("r0", incarnation="i2").to_json())
+    assert router.breakers.get("r0").state == "closed"
+
+
+def test_sniff_dims_png_header_only():
+    img = synthetic_image(37, 53, channels=3, seed=1)
+    assert Router._sniff_dims(encode_image_bytes(img)) == (37, 53)
+
+
+# --------------------------------------------------------------------------
+# satellites: cache hit-label cap, sleep failpoint, trace adoption
+# --------------------------------------------------------------------------
+
+
+def test_cache_hits_by_bucket_label_set_is_capped():
+    from mpi_cuda_imagemanipulation_tpu.serve.cache import CompileCache
+
+    cache = CompileCache(
+        Pipeline.parse("grayscale"), ((48, 48),), (1,), channels=(3,)
+    )
+    cache.warmup()
+    cache.get(48, 48, 3, 1)  # on-grid hit
+    # adversarial shape traffic: off-grid keys must not mint new labels
+    for dim in (31, 33, 35):
+        cache.get(dim, dim, 3, 1)  # miss + compile
+        cache.get(dim, dim, 3, 1)  # hit under the folded label
+    stats = cache.stats()
+    assert set(stats["hits_by_bucket"]) <= {"48x48", "other"}
+    assert stats["hits_by_bucket"]["other"] == 3
+    assert stats["misses"] == 3
+
+
+def test_failpoint_sleep_mode_delays_without_raising():
+    failpoints.configure("serve.dispatch=sleep:30")
+    try:
+        t0 = time.perf_counter()
+        failpoints.maybe_fail("serve.dispatch")  # must NOT raise
+        assert time.perf_counter() - t0 >= 0.025
+        assert failpoints.counts()["serve.dispatch"]["fired"] == 0
+    finally:
+        failpoints.clear()
+
+
+def test_trace_adoption_overrides_sampling():
+    tracer = obs_trace.Tracer(sample=0.0)
+    assert tracer.start_trace("x") is obs_trace.NOOP_SPAN
+    span = tracer.start_trace("x", trace_id="upstream-1")
+    assert span.trace_id == "upstream-1"
+    span.end()
+
+
+# --------------------------------------------------------------------------
+# in-process fabric: router + 2 replica runtimes, real HTTP
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def small_fabric():
+    """Router + two in-process replicas (threads, not processes): the
+    cheap harness for routing/obs behavior. Process-level churn gets its
+    own subprocess fixture below."""
+    cfg = ServeConfig(
+        ops=OPS,
+        buckets=parse_buckets(BUCKETS),
+        max_batch=4,
+        max_delay_ms=5.0,
+        queue_depth=64,
+        channels=(3,),
+    )
+    router = Router(
+        RouterConfig(
+            buckets=parse_buckets(BUCKETS),
+            stale_s=0.9,
+            forward_attempts=3,
+            breaker_threshold=2,
+            breaker_reset_s=0.5,
+        )
+    ).start()
+    reps = [
+        ReplicaRuntime(
+            f"r{i}", router.url, cfg, heartbeat_s=0.15
+        ).start()
+        for i in range(2)
+    ]
+    deadline = time.monotonic() + 120.0
+    while len(router._routable()) < 2:
+        assert time.monotonic() < deadline, "replicas never registered"
+        time.sleep(0.05)
+    yield router
+    for rt in reps:
+        rt.close()
+    router.close()
+
+
+def _post(router: Router, img: np.ndarray) -> dict:
+    return loadgen.http_post_image(router.url, encode_image_bytes(img))
+
+
+def test_fabric_roundtrip_bit_exact(small_fabric):
+    pipe = Pipeline.parse(OPS)
+    for shape, seed in (((40, 44), 3), ((48, 48), 4), ((90, 66), 5)):
+        img = synthetic_image(*shape, channels=3, seed=seed)
+        r = _post(small_fabric, img)
+        assert r["code"] == 200
+        assert r["replica"] in ("r0", "r1")
+        golden = np.asarray(pipe.jit()(img))
+        np.testing.assert_array_equal(
+            decode_image_bytes(r["body"]), golden
+        )
+
+
+def test_fabric_oversize_rejected_without_mesh(small_fabric):
+    img = synthetic_image(120, 120, channels=3, seed=6)  # > 96x96
+    r = _post(small_fabric, img)
+    assert r["code"] == 400
+
+
+def test_fabric_healthz_stats_metrics(small_fabric):
+    code, payload = small_fabric.healthz()
+    assert code == 200 and len(payload["routable"]) == 2
+    st = small_fabric.stats()
+    assert set(st["replicas"]) == {"r0", "r1"}
+    for rep in st["replicas"].values():
+        assert rep["state"] == "serving" and rep["fresh"]
+        assert rep["queue_depth"] == 64
+    with urllib.request.urlopen(
+        small_fabric.url + "/metrics", timeout=10
+    ) as resp:
+        fams = parse_exposition(resp.read().decode())
+    for fam in (
+        "mcim_fabric_requests_total",
+        "mcim_fabric_forwards_total",
+        "mcim_fabric_replicas_routable",
+        "mcim_fabric_heartbeats_total",
+    ):
+        assert fam in fams, f"{fam} missing from /metrics"
+
+
+def test_fabric_heartbeat_loss_reroutes(small_fabric):
+    """Injected heartbeat loss on ONE replica (the replica keeps serving)
+    must route traffic to its sibling within the staleness window."""
+    # find who currently serves this bucket, then silence exactly them
+    img = synthetic_image(40, 40, channels=3, seed=7)
+    target = _post(small_fabric, img)["replica"]
+    other = {"r0": "r1", "r1": "r0"}[target]
+    failpoints.install(
+        "replica.heartbeat", lambda ctx: ctx["replica"] == target
+    )
+    try:
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            ids = [v.replica_id for v in small_fabric._routable()]
+            if ids == [other]:
+                break
+            time.sleep(0.05)
+        assert [v.replica_id for v in small_fabric._routable()] == [other]
+        for _ in range(3):
+            assert _post(small_fabric, img)["replica"] == other
+    finally:
+        failpoints.clear()
+    # beats resume -> the silenced replica becomes routable again
+    deadline = time.monotonic() + 10.0
+    while len(small_fabric._routable()) < 2:
+        assert time.monotonic() < deadline, "silenced replica never rejoined"
+        time.sleep(0.05)
+
+
+def test_fabric_forward_failpoint_reroutes_and_counts(small_fabric):
+    failpoints.configure("router.forward=once")
+    try:
+        before = small_fabric._m_retries.value()
+        img = synthetic_image(88, 88, channels=3, seed=8)
+        r = _post(small_fabric, img)
+        assert r["code"] == 200
+        assert r["attempts"] == 2  # first attempt injected dead, rerouted
+        assert small_fabric._m_retries.value() == before + 1
+    finally:
+        failpoints.clear()
+
+
+def test_fabric_trace_spans_cover_router_and_replica(small_fabric):
+    """One trace id covers the full hop: the router roots fabric.request,
+    propagates the id via X-Trace-Id, and the replica's serve.request
+    root ADOPTS it (in-process replicas share the tracer, so both ends'
+    spans land in one buffer)."""
+    tracer = obs_trace.configure(sample=1.0)
+    try:
+        img = synthetic_image(44, 44, channels=3, seed=9)
+        r = _post(small_fabric, img)
+        assert r["code"] == 200 and r["trace_id"]
+        events = tracer.drain()
+        by_name = {}
+        for e in events:
+            if e["args"].get("trace_id") == r["trace_id"]:
+                by_name.setdefault(e["name"], []).append(e)
+        for name in ("fabric.request", "fabric.forward", "serve.request",
+                     "serve.dispatch"):
+            assert name in by_name, (
+                f"span {name!r} missing from trace {r['trace_id']}: "
+                f"{sorted(by_name)}"
+            )
+    finally:
+        obs_trace.disable()
+
+
+def test_mesh_lane_serves_oversize_bit_exact():
+    """The multi-host lane (CPU-simulated: conftest forces 8 host
+    devices): an image larger than every replica bucket runs ONE
+    row-sharded dispatch in the router and stays bit-exact."""
+    from mpi_cuda_imagemanipulation_tpu.fabric.mesh import MeshLane
+
+    lane = MeshLane(OPS, 4)
+    router = Router(
+        RouterConfig(buckets=parse_buckets(BUCKETS), stale_s=1.0),
+        mesh_lane=lane,
+    ).start()
+    try:
+        img = synthetic_image(130, 140, channels=3, seed=10)  # > 96x96
+        r = loadgen.http_post_image(router.url, encode_image_bytes(img))
+        assert r["code"] == 200
+        assert r["replica"] == "mesh"
+        golden = np.asarray(Pipeline.parse(OPS).jit()(img))
+        np.testing.assert_array_equal(
+            decode_image_bytes(r["body"]), golden
+        )
+        assert lane.stats()["dispatches"] == 1
+    finally:
+        router.close()
+
+
+def test_simulated_hosts_xla_flags():
+    from mpi_cuda_imagemanipulation_tpu.fabric.mesh import (
+        simulated_hosts_xla_flags,
+    )
+
+    flags = simulated_hosts_xla_flags(4, "--xla_foo=1")
+    assert "--xla_foo=1" in flags
+    assert "--xla_force_host_platform_device_count=4" in flags
+    # replaces, never stacks
+    again = simulated_hosts_xla_flags(8, flags)
+    assert again.count("--xla_force_host_platform_device_count") == 1
+
+
+# --------------------------------------------------------------------------
+# ACCEPTANCE: three replica PROCESSES, SIGKILL mid-loadgen, rejoin
+# --------------------------------------------------------------------------
+
+
+def test_churn_acceptance_kill_one_of_three_mid_loadgen():
+    """The headline: a 3-replica fabric takes a SIGKILL of its hottest
+    replica mid-sweep with 100% of accepted requests resolving ok
+    (bit-exact), the router breaker opens for the dead replica, and the
+    supervisor-restarted replica rejoins and receives traffic."""
+    from mpi_cuda_imagemanipulation_tpu.fabric.supervisor import (
+        Fabric,
+        FabricConfig,
+    )
+
+    pipe = Pipeline.parse(OPS)
+    images = [
+        synthetic_image(40 + 7 * i, 44 + 5 * i, channels=3, seed=20 + i)
+        for i in range(6)
+    ]
+    blobs = [encode_image_bytes(im) for im in images]
+    golden = [np.asarray(pipe.jit()(im)) for im in images]
+    cfg = FabricConfig(
+        replicas=3,
+        ops=OPS,
+        buckets=BUCKETS,
+        channels="3",
+        max_batch=4,
+        queue_depth=64,
+        heartbeat_s=0.2,
+        router=RouterConfig(
+            buckets=parse_buckets(BUCKETS),
+            stale_s=0.8,
+            forward_attempts=3,
+            breaker_threshold=2,
+            breaker_reset_s=0.5,
+        ),
+        supervisor_backoff_s=0.25,
+    )
+    with Fabric(cfg).start() as fab:
+        # the victim must be a replica that actually serves this mix
+        probe = loadgen.http_post_image(fab.url, blobs[0])
+        assert probe["code"] == 200
+        victim = probe["replica"]
+        killed: list[int] = []
+        phases = loadgen.churn_run(
+            fab.url,
+            blobs,
+            offered_rps=80.0,
+            phase_s=1.5,
+            kill=lambda: killed.append(fab.kill_replica(victim)),
+            before_after=lambda: fab.wait_ready(3, timeout_s=120.0),
+        )
+        # 1. every accepted request resolved ok, in every phase
+        for name, ph in phases.items():
+            assert ph["ok_frac"] == 1.0, (
+                f"phase {name}: {ph['submitted'] - ph['ok']} of "
+                f"{ph['submitted']} requests did not resolve ok"
+            )
+            # 2. successes are bit-exact
+            for k, r in ph["results"]:
+                np.testing.assert_array_equal(
+                    decode_image_bytes(r["body"]), golden[k]
+                )
+        # 3. the kill really happened mid-sweep and forced rerouting
+        assert killed, "churn kill never fired"
+        assert phases["during"]["retried"] >= 1
+        # 4. the router breaker opened for the dead replica
+        snap = fab.router.breakers.snapshot()
+        assert snap["open_events"] >= 1, snap
+        # 5. the restarted replica rejoined (new incarnation, serving)
+        assert fab.supervisor.restarts(victim) >= 1
+        st = fab.router.stats()["replicas"][victim]
+        assert st["state"] == "serving" and st["fresh"]
+        # ... and receives traffic again: its bucket affinity still maps
+        # requests to it once its breaker closes (reset on registration)
+        deadline = time.monotonic() + 20.0
+        seen = set()
+        while time.monotonic() < deadline and victim not in seen:
+            for b in blobs:
+                seen.add(loadgen.http_post_image(fab.url, b)["replica"])
+        assert victim in seen, (
+            f"restarted {victim} never served again (saw {seen})"
+        )
+
+
+@pytest.mark.slow
+def test_fabric_loadgen_lane_scaling_and_churn():
+    """The full bench lane (several fabric stand-ups; minutes): replicas=3
+    must sustain >= 2x replicas=1 throughput at equal request mix, and
+    every churn phase must resolve 100% ok. MCIM_FABRIC_AB_JSON (CI)
+    uploads the record as an artifact."""
+    from mpi_cuda_imagemanipulation_tpu.bench_suite import (
+        run_fabric_loadgen,
+    )
+    from mpi_cuda_imagemanipulation_tpu.utils import env as env_registry
+
+    rec = run_fabric_loadgen(
+        json_path=env_registry.get("MCIM_FABRIC_AB_JSON"),
+        printer=lambda s: None,
+    )
+    assert rec["scaling_ok"], (
+        f"replicas=3 achieved only {rec['scaling_vs_1']:.2f}x replicas=1"
+    )
+    churn = rec["lanes"][f"replicas_{rec['replicas']}_churn"]
+    for ph in ("before", "during", "after"):
+        assert churn[ph]["ok_frac"] == 1.0, (ph, churn[ph])
+    assert churn["respawned"]

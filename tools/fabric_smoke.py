@@ -1,0 +1,196 @@
+#!/usr/bin/env python
+"""Fabric CI smoke: router + 2 CPU replica processes, injected heartbeat
+loss, rerouting, and a closed router->replica span chain.
+
+    python tools/fabric_smoke.py METRICS_OUT TRACE_OUT
+
+Asserts, against a REAL pod (replica worker processes, real HTTP):
+
+  1. both replicas register by heartbeat and serve bit-exact responses;
+  2. injected heartbeat loss on r0 (`replica.heartbeat=after:N` in ITS
+     env — the replica keeps serving, only its beats vanish) makes the
+     router mark it stale and reroute everything to r1;
+  3. the distributed trace is closed across the hop: one trace id covers
+     the router's fabric.request/fabric.forward spans AND the replica's
+     serve.request/serve.dispatch spans (the replica ADOPTS the
+     X-Trace-Id; its spans come from its own --trace-out export, written
+     on graceful drain);
+  4. the router's /metrics snapshot parses as Prometheus exposition with
+     the mcim_fabric_* families populated.
+
+METRICS_OUT gets the router exposition text, TRACE_OUT the MERGED
+(router + both replicas) Chrome trace JSON — both uploaded as CI
+artifacts (.github/workflows/tier1.yml fabric step).
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+from mpi_cuda_imagemanipulation_tpu.fabric.router import RouterConfig
+from mpi_cuda_imagemanipulation_tpu.fabric.supervisor import (
+    Fabric,
+    FabricConfig,
+)
+from mpi_cuda_imagemanipulation_tpu.io.image import (
+    decode_image_bytes,
+    encode_image_bytes,
+    synthetic_image,
+)
+from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline
+from mpi_cuda_imagemanipulation_tpu.obs import trace as obs_trace
+from mpi_cuda_imagemanipulation_tpu.obs.metrics import parse_exposition
+from mpi_cuda_imagemanipulation_tpu.serve import loadgen
+from mpi_cuda_imagemanipulation_tpu.serve.bucketing import parse_buckets
+
+OPS = "grayscale,contrast:3.5"
+BUCKETS = "48,96"
+
+
+def main(metrics_out: str, trace_out: str) -> int:
+    tracer = obs_trace.configure(sample=1.0)  # router-side spans
+    tmp = tempfile.mkdtemp(prefix="fabric_smoke_")
+    rep_traces = {
+        rid: os.path.join(tmp, f"{rid}_trace.json") for rid in ("r0", "r1")
+    }
+    cfg = FabricConfig(
+        replicas=2,
+        ops=OPS,
+        buckets=BUCKETS,
+        channels="3",
+        max_batch=4,
+        queue_depth=64,
+        heartbeat_s=0.2,
+        router=RouterConfig(
+            buckets=parse_buckets(BUCKETS), stale_s=0.8, forward_attempts=3
+        ),
+        # heartbeat LOSS on r0 only: beats 9+ are dropped at the sender
+        # while the process keeps serving — the router must notice the
+        # silence and reroute
+        replica_env={"r0": {"MCIM_FAILPOINTS": "replica.heartbeat=after:8"}},
+        replica_argv_extra={
+            rid: ["--trace-out", path] for rid, path in rep_traces.items()
+        },
+    )
+    pipe = Pipeline.parse(OPS)
+    imgs = [
+        synthetic_image(40 + 9 * i, 44 + 7 * i, channels=3, seed=50 + i)
+        for i in range(4)
+    ]
+    blobs = [encode_image_bytes(im) for im in imgs]
+    golden = [np.asarray(pipe.jit()(im)) for im in imgs]
+    trace_ids: list[str] = []
+
+    with Fabric(cfg).start() as fab:
+        # -- 1. both replicas serving, responses bit-exact ------------------
+        served = set()
+        for k, blob in enumerate(blobs * 4):
+            r = loadgen.http_post_image(fab.url, blob)
+            assert r["code"] == 200, (r["code"], r["body"][:200])
+            np.testing.assert_array_equal(
+                decode_image_bytes(r["body"]), golden[k % len(golden)]
+            )
+            served.add(r["replica"])
+            if r["trace_id"]:
+                trace_ids.append(r["trace_id"])
+        print(f"smoke: {len(blobs) * 4} requests ok, replicas {sorted(served)}")
+
+        # -- 2. heartbeat loss -> staleness -> rerouting --------------------
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            routable = [v.replica_id for v in fab.router._routable()]
+            if routable == ["r1"]:
+                break
+            time.sleep(0.1)
+        assert routable == ["r1"], (
+            f"r0's heartbeat loss never made it stale (routable {routable})"
+        )
+        for blob in blobs:
+            r = loadgen.http_post_image(fab.url, blob)
+            assert r["code"] == 200
+            assert r["replica"] == "r1", (
+                f"request routed to stale replica {r['replica']}"
+            )
+            if r["trace_id"]:
+                trace_ids.append(r["trace_id"])
+        print("smoke: r0 stale after injected heartbeat loss; all traffic on r1")
+
+        # -- 4. metrics snapshot (written before teardown) ------------------
+        exposition = fab.scrape()
+        with open(metrics_out, "w") as f:
+            f.write(exposition)
+    # graceful drain done: replicas exported their traces on SIGTERM
+
+    fams = parse_exposition(exposition)
+    for fam in (
+        "mcim_fabric_requests_total",
+        "mcim_fabric_forwards_total",
+        "mcim_fabric_route_total",
+        "mcim_fabric_heartbeats_total",
+        "mcim_fabric_replicas_routable",
+    ):
+        assert fam in fams, f"{fam} missing from /metrics"
+    ok = sum(
+        v
+        for (name, labels), v in fams["mcim_fabric_requests_total"][
+            "samples"
+        ].items()
+        if 'status="ok"' in labels
+    )
+    assert ok >= len(blobs) * 5, f"requests_total{{ok}} = {ok}"
+    print(f"smoke: /metrics parses; requests_total{{ok}} = {ok:.0f}")
+
+    # -- 3. closed router->replica span chain ------------------------------
+    router_events = tracer.chrome_events()
+    merged = list(router_events)
+    for rid, path in rep_traces.items():
+        assert os.path.exists(path), f"{rid} never exported {path}"
+        with open(path) as f:
+            merged.extend(json.load(f)["traceEvents"])
+    with open(trace_out, "w") as f:
+        json.dump({"traceEvents": merged, "displayTimeUnit": "ms"}, f)
+
+    def spans_for(tid: str) -> dict[str, list[dict]]:
+        out: dict[str, list[dict]] = {}
+        for e in merged:
+            if e.get("args", {}).get("trace_id") == tid:
+                out.setdefault(e["name"], []).append(e)
+        return out
+
+    assert trace_ids, "no request carried a trace id"
+    checked = 0
+    for tid in trace_ids:
+        spans = spans_for(tid)
+        if "serve.request" not in spans:
+            continue  # replica killed before export? not here — skip none
+        for name in ("fabric.request", "fabric.forward", "serve.request",
+                     "serve.dispatch"):
+            assert name in spans, (
+                f"trace {tid}: span {name!r} missing ({sorted(spans)})"
+            )
+        root_id = spans["fabric.request"][0]["args"]["span_id"]
+        fwd = spans["fabric.forward"][0]["args"]
+        assert fwd.get("parent_id") == root_id, (
+            f"trace {tid}: fabric.forward not parented to fabric.request"
+        )
+        checked += 1
+    assert checked >= len(trace_ids) * 0.9, (
+        f"only {checked}/{len(trace_ids)} traces had the full "
+        "router->replica chain"
+    )
+    print(
+        f"smoke: {checked}/{len(trace_ids)} traces span the full "
+        f"router->replica hop ({len(merged)} merged events -> {trace_out})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) != 3:
+        print(__doc__)
+        sys.exit(2)
+    sys.exit(main(sys.argv[1], sys.argv[2]))

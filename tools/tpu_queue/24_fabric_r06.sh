@@ -1,0 +1,24 @@
+#!/bin/bash
+# Pod-fabric availability + scaling lane (round 6): the fabric_loadgen
+# bench lane on real hardware — the SAME open-loop HTTP mix against a
+# replicas=1 pod and a replicas=3 pod (each replica a full serve stack on
+# its own process; on a multi-chip host give each replica its own chip
+# via the supervisor env), then the churn phases: SIGKILL the hottest
+# replica mid-sweep and report ok%/retried%/p99 before/during/after plus
+# the supervisor respawn. Headline columns: achieved rps per lane, the
+# replicas=3 / replicas=1 scaling factor (>= 2x gate), and during-kill
+# ok% (100% = rerouting works; the during-phase retried% is the price).
+# On TPU the synthetic per-dispatch device floor is OFF — the lane
+# measures real chips (bench_suite.fabric_loadgen_params).
+# Knobs: MCIM_FABRIC_RPS / MCIM_FABRIC_DURATION_S / MCIM_FABRIC_REPLICAS.
+# Budget: ~4-6 min warm (3 pod stand-ups; each replica pays the serving
+# grid warmup: ~10-15 min cold).
+set -u
+cd "$(dirname "$0")/../.."
+. tools/tpu_queue/_lib.sh
+out=artifacts/fabric_loadgen_r06.out
+: > "$out"
+timeout 2400 python -m mpi_cuda_imagemanipulation_tpu.bench_suite \
+  --config fabric_loadgen >> "$out" 2>&1
+commit_artifacts "TPU window: pod-fabric scaling + churn lane (round 6)" "$out"
+exit 0
