@@ -324,6 +324,7 @@ SERVE_LOADGEN = "serve_loadgen"
 ENGINE_AB = "engine_ab"
 MXU_AB = "mxu_ab"
 FABRIC_LOADGEN = "fabric_loadgen"
+STREAM_AB = "stream_ab"
 
 
 def fabric_loadgen_params() -> dict:
@@ -516,7 +517,6 @@ def run_fabric_loadgen(
     before any timing (the proto discipline)."""
     import numpy as np
 
-    from mpi_cuda_imagemanipulation_tpu.io.image import encode_image_bytes
     from mpi_cuda_imagemanipulation_tpu.serve import loadgen
     from mpi_cuda_imagemanipulation_tpu.serve.bucketing import parse_buckets
     from mpi_cuda_imagemanipulation_tpu.serve.padded import min_true_dim
@@ -532,7 +532,8 @@ def run_fabric_loadgen(
         seed=7,
         min_dim=min_true_dim(pipe),
     )
-    blobs = [encode_image_bytes(im) for im in images]
+    # single-copy blobs: the encoder's own buffer posts as a memoryview
+    blobs = [loadgen.encode_blob(im) for im in images]
     golden_fn = pipe.jit()
     golden = [np.asarray(golden_fn(im)) for im in images]
 
@@ -1010,6 +1011,238 @@ def run_engine_ab(
     return rec
 
 
+def stream_ab_params() -> dict:
+    """The stream A/B knobs, sized to the backend. The read stage carries
+    a small synthetic per-band latency (models decode/disk — the same
+    move as engine_ab's slow-decode corpus) so the serial lane's
+    device-idle fraction is substantial and overlap is measurable on
+    1-core CI. Env overrides: MCIM_STREAM_AB_HEIGHT/_WIDTH/_TILE_ROWS."""
+    on_tpu = is_tpu_backend()
+    params = {
+        "ops": "grayscale,contrast:3.5,emboss:3",
+        "n_images": 2 if on_tpu else 3,
+        "height": 8192 if on_tpu else 1536,
+        "width": 2048 if on_tpu else 256,
+        "channels": 3,
+        "tile_rows": 512 if on_tpu else 128,
+        "inflight": 2,
+        "read_ms_per_band": 0.0 if on_tpu else 4.0,
+    }
+    for env, key, cast in (
+        ("MCIM_STREAM_AB_HEIGHT", "height", int),
+        ("MCIM_STREAM_AB_WIDTH", "width", int),
+        ("MCIM_STREAM_AB_TILE_ROWS", "tile_rows", int),
+    ):
+        raw = env_registry.get(env)
+        if raw:
+            params[key] = cast(raw)
+    return params
+
+
+def run_stream_ab(
+    *,
+    json_path: str | None = None,
+    printer: Callable[[str], None] = print,
+    tile_rows: int | None = None,
+) -> dict:
+    """Serial-whole-image vs streamed-tiles A/B (stream/runner.py):
+
+      * serial lane:   generate the full frame, ONE whole-image dispatch,
+                       encode the full PNG — the pre-stream memory shape
+                       (peak resident = the whole frame + its encoding);
+      * streamed lane: the same rows through the tile engine — windowed
+                       synthetic reader, seam-stitched fixed-shape tiles,
+                       double-buffered dispatches, ordered incremental
+                       PNG encode.
+
+    Reports img/s, device-idle fraction and PEAK RESIDENT BYTES per lane
+    — overlap is proven when the streamed lane's idle fraction drops
+    below serial, and the constant-memory claim is the resident ratio.
+    Outputs are gated bit-identical (decode both PNGs, compare) before
+    any number is reported."""
+    import io as _io
+    import time as _time
+
+    import numpy as np
+
+    from mpi_cuda_imagemanipulation_tpu.engine import Engine, EngineMetrics
+    from mpi_cuda_imagemanipulation_tpu.io.image import (
+        decode_image_bytes,
+        encode_image_bytes,
+        synthetic_image,
+    )
+    from mpi_cuda_imagemanipulation_tpu.io.stream_codec import (
+        PNGTileWriter,
+        SyntheticTileReader,
+    )
+    from mpi_cuda_imagemanipulation_tpu.stream import (
+        StreamMetrics,
+        stream_pipeline,
+    )
+    from mpi_cuda_imagemanipulation_tpu.stream.tiles import out_channels
+
+    p = stream_ab_params()
+    if tile_rows is not None:
+        p["tile_rows"] = tile_rows
+    h, w, c = p["height"], p["width"], p["channels"]
+    T = p["tile_rows"]
+    n_bands = -(-h // T)
+    read_s_band = p["read_ms_per_band"] / 1e3
+    pipe = Pipeline.parse(p["ops"])
+    out_c = out_channels(pipe.ops, c)
+
+    fn = pipe.jit(backend="xla")
+    # compile both lanes OUTSIDE the clocks (full serial shape + every
+    # streamed tile variant) — the A/B compares execution structures,
+    # not compile caches
+    jax.block_until_ready(fn(synthetic_image(h, w, channels=c, seed=0)))
+
+    # -- serial lane: whole image resident, one dispatch -------------------
+    serial_png: dict[int, bytes] = {}
+    serial_peak = 0
+    busy = 0.0
+    t0 = _time.perf_counter()
+    for k in range(p["n_images"]):
+        img = synthetic_image(h, w, channels=c, seed=100 + k)
+        _time.sleep(read_s_band * n_bands)  # same modeled decode latency
+        tb = _time.perf_counter()
+        out = np.asarray(jax.block_until_ready(fn(img)))
+        busy += _time.perf_counter() - tb
+        png = encode_image_bytes(out)
+        serial_peak = max(serial_peak, img.nbytes + out.nbytes + len(png))
+        serial_png[k] = png
+    serial_wall = _time.perf_counter() - t0
+    serial_idle = max(0.0, 1.0 - busy / serial_wall)
+
+    # -- streamed lane: fixed-shape tiles, constant footprint --------------
+    class _SlowSynthetic(SyntheticTileReader):
+        def _read(self, n):
+            _time.sleep(read_s_band)  # modeled per-band decode latency
+            return super()._read(n)
+
+    smetrics = StreamMetrics()
+    engine = Engine(
+        inflight=p["inflight"],
+        io_threads=2,
+        stage=jax.device_put,
+        metrics=EngineMetrics(registry=smetrics.registry),
+        ordered_done=True,
+        name="stream-ab",
+    )
+    from mpi_cuda_imagemanipulation_tpu.stream.tiles import TileFnCache
+
+    fn_cache = TileFnCache(pipe.ops, global_h=h, global_w=w, impl="xla")
+    # warm the streamed lane's compiles (one un-timed pass; the engine
+    # metrics reset below so the timed window is clean)
+    _warm = PNGTileWriter(_io.BytesIO(), h, w, out_c)
+    with Engine(
+        inflight=p["inflight"], io_threads=2, stage=jax.device_put,
+        ordered_done=True, name="stream-ab-warm",
+    ) as _weng:
+        stream_pipeline(
+            SyntheticTileReader(h, w, channels=c, seed=99), _warm,
+            pipe.ops, tile_rows=T, impl="xla",
+            metrics=StreamMetrics(), engine=_weng, fn_cache=fn_cache,
+        )
+    _warm.close()
+
+    stream_png: dict[int, bytes] = {}
+    t0 = _time.perf_counter()
+    try:
+        for k in range(p["n_images"]):
+            sink = _io.BytesIO()
+            writer = PNGTileWriter(sink, h, w, out_c)
+            stream_pipeline(
+                _SlowSynthetic(h, w, channels=c, seed=100 + k),
+                writer,
+                pipe.ops,
+                tile_rows=T,
+                impl="xla",
+                metrics=smetrics,
+                engine=engine,
+                fn_cache=fn_cache,
+            )
+            writer.close()
+            stream_png[k] = sink.getvalue()
+    finally:
+        engine.close()
+    stream_wall = _time.perf_counter() - t0
+    stream_idle = engine.metrics.device_idle_frac()
+    stream_peak = smetrics.peak_resident_bytes
+
+    bit_identical = all(
+        np.array_equal(
+            decode_image_bytes(serial_png[k]),
+            decode_image_bytes(stream_png[k]),
+        )
+        for k in range(p["n_images"])
+    )
+    if not bit_identical:
+        raise RuntimeError(
+            "stream_ab gate: streamed output mismatches the whole-image "
+            "golden — refusing to report performance for wrong results"
+        )
+    n = p["n_images"]
+    rec = {
+        "config": STREAM_AB,
+        "pipeline": p["ops"],
+        "impl": "xla",
+        "platform": jax.default_backend(),
+        "n_images": n,
+        "height": h,
+        "width": w,
+        "channels": c,
+        "tile_rows": T,
+        "inflight": p["inflight"],
+        "read_ms_per_band": p["read_ms_per_band"],
+        "serial": {
+            "wall_s": serial_wall,
+            "images_per_s": n / serial_wall,
+            "mp_per_s": n * h * w / 1e6 / serial_wall,
+            "device_idle_frac": serial_idle,
+            "peak_resident_bytes": serial_peak,
+        },
+        "stream": {
+            "wall_s": stream_wall,
+            "images_per_s": n / stream_wall,
+            "mp_per_s": n * h * w / 1e6 / stream_wall,
+            "device_idle_frac": stream_idle,
+            "peak_resident_bytes": stream_peak,
+            "inflight_peak": engine.metrics.snapshot()["inflight_peak"],
+        },
+        "speedup": serial_wall / stream_wall if stream_wall > 0 else None,
+        "memory_ratio": serial_peak / stream_peak if stream_peak else None,
+        "overlap_won": (
+            stream_idle is not None and stream_idle < serial_idle
+        ),
+        "bit_identical": bit_identical,
+    }
+    printer(
+        f"{'lane':10s} {'wall s':>8s} {'img/s':>8s} {'dev idle':>9s} "
+        f"{'peak MiB':>9s}"
+    )
+    printer(
+        f"{'serial':10s} {serial_wall:8.2f} {n / serial_wall:8.2f} "
+        f"{serial_idle * 100:8.1f}% {serial_peak / 2**20:9.2f}"
+    )
+    printer(
+        f"{'stream':10s} {stream_wall:8.2f} {n / stream_wall:8.2f} "
+        + (
+            f"{stream_idle * 100:8.1f}%"
+            if stream_idle is not None
+            else f"{'-':>9s}"
+        )
+        + f" {stream_peak / 2**20:9.2f}"
+    )
+    printer(
+        f"speedup {rec['speedup']:.2f}x, memory {rec['memory_ratio']:.1f}x "
+        f"smaller resident, tile_rows {T}, bit_identical={bit_identical}"
+    )
+    if json_path:
+        emit_json_metrics(rec, None if json_path == "-" else json_path)
+    return rec
+
+
 def serve_loadgen_params() -> dict:
     """The serving-lane knobs, sized to the backend: CPU keeps the sweep
     small enough for tests/dev; real hardware gets serving-sized buckets
@@ -1177,12 +1410,19 @@ def run_suite(
         )
         if not names:
             return records
+    if names and STREAM_AB in names:
+        # the stream lane compares two execution structures (whole-image
+        # vs tiled stream) over one workload, like engine_ab
+        names = [n for n in names if n != STREAM_AB]
+        records.append(run_stream_ab(json_path=json_path, printer=printer))
+        if not names:
+            return records
     if names:
         unknown = [n for n in names if n not in CONFIGS]
         if unknown:
             raise ValueError(
                 f"unknown bench config(s) {unknown}; known: "
-                f"{sorted(CONFIGS) + [ENGINE_AB, FABRIC_LOADGEN, MXU_AB, SERVE_LOADGEN]}"
+                f"{sorted(CONFIGS) + [ENGINE_AB, FABRIC_LOADGEN, MXU_AB, SERVE_LOADGEN, STREAM_AB]}"
             )
         selected = [CONFIGS[n] for n in names]
     else:
@@ -1280,7 +1520,7 @@ def main(argv: Sequence[str] | None = None) -> int:
         "--config",
         required=True,
         choices=sorted(CONFIGS)
-        + [ENGINE_AB, FABRIC_LOADGEN, MXU_AB, SERVE_LOADGEN],
+        + [ENGINE_AB, FABRIC_LOADGEN, MXU_AB, SERVE_LOADGEN, STREAM_AB],
     )
     ap.add_argument(
         "--impl",
@@ -1323,6 +1563,13 @@ def main(argv: Sequence[str] | None = None) -> int:
         help="fabric_loadgen only: scaled-lane replica count "
         "(env MCIM_FABRIC_REPLICAS works too)",
     )
+    ap.add_argument(
+        "--tile-rows",
+        type=int,
+        default=None,
+        help="stream_ab only: streamed-lane tile height "
+        "(env MCIM_STREAM_AB_TILE_ROWS works too)",
+    )
     args = ap.parse_args(argv)
     if args.config == SERVE_LOADGEN:
         rec = run_serve_loadgen(
@@ -1336,6 +1583,10 @@ def main(argv: Sequence[str] | None = None) -> int:
         rec = run_engine_ab(printer=lambda s: None, inflight=args.inflight)
     elif args.config == MXU_AB:
         rec = run_mxu_ab(printer=lambda s: None)
+    elif args.config == STREAM_AB:
+        rec = run_stream_ab(
+            printer=lambda s: None, tile_rows=args.tile_rows
+        )
     else:
         cfg = CONFIGS[args.config]
         if args.halo_mode is not None and cfg.sharded:

@@ -246,6 +246,17 @@ def _build_parser() -> argparse.ArgumentParser:
         "(the engine's output pool; decode prefetch is --threads)",
     )
     batch.add_argument(
+        "--stream-rows",
+        type=int,
+        default=0,
+        metavar="N",
+        help="N > 0 routes every input through the streaming tile engine "
+        "(stream/) in N-row bands with the output encoded incrementally "
+        "— device rows hand to the encoder single-copy, full frames "
+        "never buffer host-side (gigapixel inputs in a batch dir); "
+        "incompatible with --stack/--shards",
+    )
+    batch.add_argument(
         "--stack",
         type=int,
         default=1,
@@ -490,6 +501,122 @@ def _build_parser() -> argparse.ArgumentParser:
     )
     _add_failpoint_flags(fab)
     _add_trace_flags(fab)
+
+    stm = sub.add_parser(
+        "stream",
+        help="constant-memory streaming tile engine: run a pipeline over "
+        "an arbitrarily large image (or a video frame sequence) as "
+        "fixed-height row bands with seam-stitched halos — bit-exact "
+        "against the whole-image path, peak resident bytes set by "
+        "--tile-rows/--inflight, never by image size (stream/)",
+    )
+    stm.add_argument(
+        "--input",
+        default=None,
+        help="input image path (ppm/pgm stream via seek, png via the "
+        "scanline decoder; other formats fall back to whole-image "
+        "decode with a warning)",
+    )
+    stm.add_argument(
+        "--synthetic",
+        default=None,
+        metavar="HxW[xC]",
+        help="process a deterministic synthetic image of this shape "
+        "instead of --input (windowed generation — a 100000x4096 scan "
+        "never materialises host-side; the gigapixel demo/bench source)",
+    )
+    stm.add_argument(
+        "--output",
+        default=None,
+        help="output path, encoded incrementally (png: streamed IDAT "
+        "bands; ppm/pgm: appended raw rows — the resumable container)",
+    )
+    stm.add_argument(
+        "--video-frames",
+        default=None,
+        metavar="GLOB",
+        help="video mode: process this ordered frame glob instead of one "
+        "image; temporal ops (framediff, tdenoise:K) may lead --ops and "
+        "read a bounded frame-history ring (ops/temporal.py)",
+    )
+    stm.add_argument(
+        "--output-dir",
+        default=None,
+        help="video mode: directory for per-frame outputs (basename "
+        "preserved, extension from --out-ext)",
+    )
+    stm.add_argument(
+        "--out-ext",
+        default=".png",
+        help="video mode: output frame container extension",
+    )
+    stm.add_argument("--ops", default="grayscale,contrast:3.5,emboss:3")
+    stm.add_argument(
+        "--impl",
+        choices=("auto", "xla", "mxu"),
+        default="xla",
+        help="tile compute backend: xla (golden), mxu (banded-matmul "
+        "contraction for eligible stencil families, bit-identical), "
+        "auto (calibration-gated MXU routing — never off-TPU)",
+    )
+    stm.add_argument(
+        "--tile-rows",
+        type=int,
+        default=None,
+        help="row-band height — the memory budget knob (default "
+        "MCIM_STREAM_TILE_ROWS=512); must be at least the chain halo",
+    )
+    stm.add_argument(
+        "--inflight",
+        type=int,
+        default=None,
+        help="tile dispatches kept outstanding (default "
+        "MCIM_STREAM_INFLIGHT=2): >= 2 stages tile k+1's H2D while "
+        "tile k computes and k-1 encodes",
+    )
+    stm.add_argument(
+        "--io-threads",
+        type=int,
+        default=2,
+        help="engine completion workers (writes are delivered in tile "
+        "order regardless)",
+    )
+    stm.add_argument("--device", default=None)
+    stm.add_argument(
+        "--resume",
+        action="store_true",
+        help="skip tiles (or video frames) journaled ok by a previous "
+        "killed run — image-mode resume needs a ppm/pgm output (a PNG "
+        "compressor's state does not survive a kill)",
+    )
+    stm.add_argument(
+        "--journal",
+        default=None,
+        metavar="PATH",
+        help="stream journal path (default: <output>.journal.jsonl, or "
+        "<output-dir>/.mcim_stream_journal.jsonl for video)",
+    )
+    stm.add_argument(
+        "--no-journal",
+        action="store_true",
+        help="disable the journal (no kill-mid-stream resume)",
+    )
+    stm.add_argument("--show-timing", action="store_true")
+    stm.add_argument(
+        "--json-metrics",
+        default=None,
+        help="write the stream summary record to this path ('-' = stdout)",
+    )
+    stm.add_argument(
+        "--metrics-out",
+        default=None,
+        metavar="PATH",
+        help="write a Prometheus snapshot of the stream registry "
+        "(mcim_stream_* incl. the peak-resident-bytes gauge, plus the "
+        "engine families) at exit",
+    )
+    _add_failpoint_flags(stm)
+    _add_trace_flags(stm)
 
     bench = sub.add_parser("bench", help="run the benchmark suite")
     bench.add_argument("--configs", default=None, help="subset, comma-separated")
@@ -854,6 +981,14 @@ def cmd_batch(args: argparse.Namespace) -> int:
         )
     failed: dict[int, str] = {}  # index -> error (decode or compute)
     pipe = Pipeline.parse(args.ops)
+    if args.stream_rows:
+        _n_r, _n_c = parse_shards(args.shards)
+        if max(1, args.stack) > 1 or _n_r * (_n_c or 1) > 1:
+            raise ValueError(
+                "--stream-rows streams each input through the tile "
+                "engine and is incompatible with --stack/--shards"
+            )
+        return _batch_stream(args, paths, rels, resumed, journal, _digest, pipe, log)
     stack = max(1, args.stack)
     n_r, n_c = parse_shards(args.shards)
     n_flat = n_r * (n_c or 1)
@@ -1135,6 +1270,363 @@ def cmd_batch(args: argparse.Namespace) -> int:
     # partial failure (skipped/failed inputs) is a nonzero exit for
     # scripted callers — distinct from the no-inputs-matched exit (3) above
     return 0 if done + len(resumed) == len(paths) else 1
+
+
+def _batch_stream(args, paths, rels, resumed, journal, digest_fn, pipe, log) -> int:
+    """cmd_batch's streaming lane (--stream-rows): every input runs
+    through the tile engine with the output encoded incrementally —
+    device row bands hand to the encoder single-copy in tile order, so a
+    gigapixel input in a batch directory costs tile memory, not frame
+    memory. Journal granularity stays per input (digest-verified), so
+    --resume composes exactly as in the whole-image lane."""
+    import jax
+
+    from mpi_cuda_imagemanipulation_tpu.engine import Engine, EngineMetrics
+    from mpi_cuda_imagemanipulation_tpu.io.stream_codec import (
+        open_tile_reader,
+        open_tile_writer,
+    )
+    from mpi_cuda_imagemanipulation_tpu.ops.registry import make_op
+    from mpi_cuda_imagemanipulation_tpu.stream import (
+        StreamMetrics,
+        stream_pipeline,
+    )
+    from mpi_cuda_imagemanipulation_tpu.stream.tiles import out_channels
+    from mpi_cuda_imagemanipulation_tpu.utils.log import emit_json_metrics
+
+    if args.impl not in ("auto", "xla", "mxu"):
+        raise ValueError(
+            "--stream-rows computes tiles with xla/mxu/auto (the Pallas "
+            f"streaming kernels are full-image by design); got {args.impl!r}"
+        )
+    metrics = StreamMetrics()
+    engine = Engine(
+        inflight=max(1, args.inflight or 2),
+        io_threads=max(1, args.io_threads),
+        stage=jax.device_put,
+        metrics=EngineMetrics(registry=metrics.registry),
+        ordered_done=True,
+        name="batch-stream",
+    )
+    done = 0
+    failed: dict[int, str] = {}
+    total_mp = 0.0
+    t0 = time.perf_counter()
+    try:
+        for i, p in enumerate(paths):
+            if i in resumed:
+                continue
+            rel = rels[i]
+            try:
+                reader = open_tile_reader(p)
+                ops = pipe.ops
+                if not args.gray_output and out_channels(
+                    ops, reader.channels
+                ) == 1:
+                    # keep the batch lane's gray->RGB replication contract
+                    ops = (*ops, make_op("gray2rgb"))
+                base, ext = os.path.splitext(rel)
+                if ext.lower() not in (".png", ".ppm", ".pgm", ".pnm"):
+                    log.info(
+                        "%s: no incremental encoder for %r; writing .png",
+                        rel, ext,
+                    )
+                    rel = base + ".png"
+                dst = os.path.join(args.output_dir, rel)
+                os.makedirs(os.path.dirname(dst) or ".", exist_ok=True)
+                writer = open_tile_writer(
+                    dst, reader.height, reader.width,
+                    out_channels(ops, reader.channels),
+                )
+                total_mp += reader.height * reader.width / 1e6
+                stream_pipeline(
+                    reader, writer, ops,
+                    tile_rows=args.stream_rows,
+                    impl=args.impl,
+                    metrics=metrics,
+                    engine=engine,
+                )
+                writer.close()
+            except Exception as e:
+                failed[i] = f"{type(e).__name__}: {e}"
+                log.error("failed %s: %s", rels[i], failed[i])
+                if journal is not None:
+                    journal.record_failed(rels[i], digest_fn(i), failed[i])
+                continue
+            if journal is not None:
+                journal.record_ok(rels[i], digest_fn(i), rel)
+            done += 1
+    finally:
+        engine.close()
+    wall = time.perf_counter() - t0
+    log.info(
+        "streamed %d/%d inputs (%.1f MP) in %.2fs — peak resident %.1f MiB",
+        done, len(paths), total_mp, wall,
+        metrics.peak_resident_bytes / 2**20,
+    )
+    if args.json_metrics:
+        emit_json_metrics(
+            {
+                "event": "batch",
+                "mode": "stream",
+                "ops": pipe.name,
+                "impl": args.impl,
+                "stream_rows": args.stream_rows,
+                "inputs": len(paths),
+                "processed": done,
+                "resumed": len(resumed),
+                "failed": {rels[i]: m for i, m in sorted(failed.items())},
+                "total_mp": total_mp,
+                "wall_s": wall,
+                "peak_resident_bytes": metrics.peak_resident_bytes,
+                "engine": engine.metrics.snapshot(),
+            },
+            None if args.json_metrics == "-" else args.json_metrics,
+        )
+    if args.metrics_out:
+        with open(args.metrics_out, "w") as f:
+            f.write(metrics.registry.render())
+    _export_trace(args, log)
+    return 0 if done + len(resumed) == len(paths) else 1
+
+
+def cmd_stream(args: argparse.Namespace) -> int:
+    """Constant-memory streaming: one gigapixel-class image (or a video
+    frame sequence) through the tile engine — fixed-shape row bands,
+    double-buffered H2D prefetch, seam-stitched halos, ordered
+    incremental encode. Bit-exact vs the whole-image golden path; peak
+    resident bytes follow --tile-rows/--inflight, not image size."""
+    _configure_platform(args.device)
+    _arm_failpoints(args)
+    _configure_tracing(args)
+    from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline
+    from mpi_cuda_imagemanipulation_tpu.obs import trace as obs_trace
+    from mpi_cuda_imagemanipulation_tpu.resilience.journal import BatchJournal
+    from mpi_cuda_imagemanipulation_tpu.stream import (
+        StreamMetrics,
+        resumable_tiles,
+        stream_fingerprint,
+        stream_pipeline,
+        stream_video,
+    )
+    from mpi_cuda_imagemanipulation_tpu.stream.runner import DEFAULT_TILE_ROWS
+    from mpi_cuda_imagemanipulation_tpu.stream.tiles import (
+        out_channels,
+        plan_tiles,
+        validate_stream_ops,
+    )
+    from mpi_cuda_imagemanipulation_tpu.utils import env as env_registry
+    from mpi_cuda_imagemanipulation_tpu.utils.log import (
+        emit_json_metrics,
+        get_logger,
+    )
+
+    log = get_logger()
+    tile_rows = args.tile_rows or env_registry.get_int(
+        "MCIM_STREAM_TILE_ROWS"
+    ) or DEFAULT_TILE_ROWS
+    inflight = args.inflight or env_registry.get_int(
+        "MCIM_STREAM_INFLIGHT"
+    ) or 2
+    metrics = StreamMetrics()
+
+    # -- video mode ---------------------------------------------------------
+    if args.video_frames:
+        import glob as globmod
+
+        if not args.output_dir:
+            raise ValueError("--video-frames needs --output-dir")
+        frames = sorted(
+            p for p in globmod.glob(args.video_frames) if os.path.isfile(p)
+        )
+        if not frames:
+            log.error("no frames match %s", args.video_frames)
+            return 3
+        journal = None
+        if not args.no_journal:
+            journal = BatchJournal(
+                args.journal
+                or os.path.join(args.output_dir, ".mcim_stream_journal.jsonl")
+            )
+        rec = stream_video(
+            frames,
+            args.output_dir,
+            args.ops,
+            tile_rows=tile_rows,
+            inflight=inflight,
+            io_threads=max(1, args.io_threads),
+            impl=args.impl,
+            out_ext=args.out_ext,
+            metrics=metrics,
+            journal=journal,
+            resume=args.resume,
+        )
+        log.info(
+            "video: %d/%d frames (%d resumed) in %.2fs (%.1f fps), "
+            "peak resident %.1f MiB",
+            rec["frames_done"], rec["frames"], rec["frames_resumed"],
+            rec["wall_s"], rec["fps"] or 0.0,
+            rec["peak_resident_bytes"] / 2**20,
+        )
+        if args.show_timing:
+            print(
+                f"video [{args.ops}] impl={args.impl}: "
+                f"{rec['frames_done']}/{rec['frames']} frames in "
+                f"{rec['wall_s']:.2f}s ({rec['fps'] or 0.0:.1f} fps, "
+                f"tile_rows {tile_rows}, inflight {inflight}, peak "
+                f"resident {rec['peak_resident_bytes'] / 2**20:.1f} MiB)"
+            )
+        if args.json_metrics:
+            emit_json_metrics(
+                {"event": "stream", "mode": "video", "ops": args.ops, **rec},
+                None if args.json_metrics == "-" else args.json_metrics,
+            )
+        if args.metrics_out:
+            with open(args.metrics_out, "w") as f:
+                f.write(metrics.registry.render())
+        _export_trace(args, log)
+        return 0
+
+    # -- single-image mode --------------------------------------------------
+    if bool(args.input) == bool(args.synthetic):
+        raise ValueError("stream needs exactly one of --input/--synthetic")
+    if not args.output:
+        raise ValueError("stream needs --output")
+    if args.synthetic:
+        from mpi_cuda_imagemanipulation_tpu.io.stream_codec import (
+            SyntheticTileReader,
+        )
+
+        dims = [int(v) for v in args.synthetic.lower().split("x")]
+        if len(dims) not in (2, 3):
+            raise ValueError("--synthetic wants HxW or HxWxC")
+        h, w = dims[0], dims[1]
+        c = dims[2] if len(dims) == 3 else 3
+        reader = SyntheticTileReader(h, w, channels=c, seed=0)
+    else:
+        from mpi_cuda_imagemanipulation_tpu.io.stream_codec import (
+            open_tile_reader,
+        )
+
+        reader = open_tile_reader(args.input)
+
+    pipe = Pipeline.parse(args.ops)
+    halo = validate_stream_ops(pipe.ops)
+    out_c = out_channels(pipe.ops, reader.channels)
+    tiles = plan_tiles(reader.height, tile_rows, halo)
+    fingerprint = stream_fingerprint(
+        pipe.name, reader.height, reader.width, reader.channels,
+        tile_rows, args.impl,
+    )
+    journal = None
+    if not args.no_journal:
+        journal = BatchJournal(args.journal or args.output + ".journal.jsonl")
+
+    resume_tiles = 0
+    out_ext = os.path.splitext(args.output)[1].lower()
+    if args.resume:
+        if journal is None:
+            raise ValueError("--resume needs the journal (drop --no-journal)")
+        if out_ext not in (".ppm", ".pgm", ".pnm"):
+            raise ValueError(
+                "image-mode --resume needs a ppm/pgm output (a PNG "
+                "compressor's state does not survive a kill); video-mode "
+                "resume works per frame with any container"
+            )
+        resume_tiles = resumable_tiles(journal, "stream", fingerprint, len(tiles))
+
+    from mpi_cuda_imagemanipulation_tpu.io.stream_codec import (
+        PNMTileWriter,
+        open_tile_writer,
+    )
+
+    if resume_tiles and os.path.exists(args.output):
+        writer = PNMTileWriter.resume(
+            args.output, reader.height, reader.width, out_c,
+            tiles[resume_tiles - 1].out_hi,
+        )
+    else:
+        resume_tiles = 0
+        writer = open_tile_writer(
+            args.output, reader.height, reader.width, out_c
+        )
+
+    root = obs_trace.start_trace(
+        "stream", ops=pipe.name, impl=args.impl,
+        h=reader.height, w=reader.width, tile_rows=tile_rows,
+    )
+    t0 = time.perf_counter()
+    with root:
+        try:
+            res = stream_pipeline(
+                reader, writer, pipe.ops,
+                tile_rows=tile_rows,
+                inflight=inflight,
+                io_threads=max(1, args.io_threads),
+                impl=args.impl,
+                metrics=metrics,
+                journal=journal,
+                resume_tiles=resume_tiles,
+                trace_parent=root.context() if root is not obs_trace.NOOP_SPAN else None,
+            )
+        except RuntimeError as e:
+            # completed tiles are durable + journaled; exit clean so a
+            # scripted caller retries with --resume instead of parsing a
+            # traceback (cmd_batch's partial-failure discipline). Closing
+            # the writer here is what MAKES the prefix durable — rows the
+            # journal already claims must not die in a file buffer.
+            writer.close()
+            log.error("%s", e)
+            root.set(error="StreamError")
+            _export_trace(args, log)
+            return 1
+        writer.close()
+    wall = time.perf_counter() - t0
+    mp = reader.height * reader.width / 1e6
+    log.info(
+        "streamed %dx%d (%.1f MP) as %d tiles (%d resumed) in %.2fs — "
+        "peak resident %.1f MiB vs %.1f MiB whole-image",
+        reader.height, reader.width, mp, res.tiles, res.tiles_resumed,
+        wall, res.peak_resident_bytes / 2**20,
+        reader.height * reader.width * reader.channels / 2**20,
+    )
+    if args.show_timing:
+        eng = res.engine
+        idle = eng.get("device_idle_frac")
+        print(
+            f"stream [{pipe.name}] impl={args.impl}: {mp:.1f} MP in "
+            f"{wall:.2f}s ({mp / wall:.1f} MP/s e2e; tile_rows "
+            f"{tile_rows}, inflight {inflight}, {res.tiles} tiles, "
+            f"{res.compiles} compiles, peak resident "
+            f"{res.peak_resident_bytes / 2**20:.2f} MiB"
+            + (f", device idle {idle * 100:.0f}%" if idle is not None else "")
+            + ")"
+        )
+    if args.json_metrics:
+        emit_json_metrics(
+            {
+                "event": "stream",
+                "mode": "image",
+                "ops": pipe.name,
+                "impl": args.impl,
+                "height": reader.height,
+                "width": reader.width,
+                "channels": reader.channels,
+                "tile_rows": tile_rows,
+                "inflight": inflight,
+                "halo": halo,
+                "mp": mp,
+                "mp_per_s": mp / wall if wall > 0 else None,
+                **res.as_dict(),
+            },
+            None if args.json_metrics == "-" else args.json_metrics,
+        )
+    if args.metrics_out:
+        with open(args.metrics_out, "w") as f:
+            f.write(metrics.registry.render())
+        log.info("metrics snapshot -> %s", args.metrics_out)
+    _export_trace(args, log)
+    return 0
 
 
 def cmd_serve(args: argparse.Namespace) -> int:
@@ -1747,6 +2239,7 @@ def main(argv: list[str] | None = None) -> int:
     cmd = {
         "run": cmd_run,
         "batch": cmd_batch,
+        "stream": cmd_stream,
         "serve": cmd_serve,
         "fabric": cmd_fabric,
         "bench": cmd_bench,

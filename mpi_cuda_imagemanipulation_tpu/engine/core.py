@@ -33,6 +33,10 @@ Invariants:
     order even though the device pipeline is deep. ``on_done`` callbacks
     for *different* items may interleave across pool workers
     (``io_threads=1`` serializes them); items are independent by contract.
+    ``ordered_done=True`` — the tile-stream submission mode — instead
+    gates pool delivery so ``on_done`` runs strictly in submission order
+    (an incremental encoder can only append row band k after k-1);
+    failed items advance the gate so a bad tile never wedges the stream.
   * **Results are bit-identical to the serial loop** — the engine changes
     *when* work happens, never *what* runs: same callable, same inputs.
   * **Failure is per-item.** A force (D2H) failure routes that one
@@ -78,6 +82,7 @@ class _InFlight:
     on_done: Callable[[Any, Any, dict], None]
     on_error: Callable[[Any, BaseException], None]
     info: dict = field(default_factory=dict)
+    seq: int = 0  # submission index (ordered_done delivery gate)
 
 
 class Engine:
@@ -94,6 +99,7 @@ class Engine:
         stage: Callable[[Any], Any] | None = None,
         metrics: EngineMetrics | None = None,
         name: str = "engine",
+        ordered_done: bool = False,
     ):
         if inflight < 1:
             raise ValueError(f"inflight must be >= 1, got {inflight}")
@@ -125,6 +131,17 @@ class Engine:
         self._thread: threading.Thread | None = None
         self._closed = False
         self._log = get_logger()
+        # ordered_done: deliver on_done strictly in submission order (the
+        # tile-stream mode — an incremental encoder can only append row
+        # band k after k-1). Results are already FORCED in submission
+        # order; this gate additionally serialises the pool's delivery.
+        # Deadlock-free: the completion thread hands items to the FIFO
+        # pool in order, so the lowest outstanding seq is always running
+        # or queued ahead of every waiter.
+        self._ordered = ordered_done
+        self._seq = 0
+        self._next_done = 0
+        self._order_cond = threading.Condition()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -247,8 +264,10 @@ class Engine:
         self.metrics.on_stage("enqueue", info["enqueue_s"])
         with self._cond:
             self._outstanding += 1
+            seq = self._seq
+            self._seq += 1
         self.metrics.on_submit(t3)
-        self._q.put(_InFlight(key, out, on_done, on_error, info))
+        self._q.put(_InFlight(key, out, on_done, on_error, info, seq))
 
     # -- completion stage (own thread) -------------------------------------
 
@@ -286,6 +305,9 @@ class Engine:
             self.metrics.on_forced()
             self._slots.release()
             self.metrics.on_failed(time.perf_counter())
+            # an item that dies before the pool must still advance the
+            # ordered-delivery gate, or every later tile waits forever
+            self._advance_order(item)
             self._resolve_error(item, e)
             return
         fspan.end()
@@ -313,7 +335,24 @@ class Engine:
 
     # -- encode stage (worker pool) ----------------------------------------
 
+    def _wait_turn(self, item: _InFlight) -> None:
+        """Block until every earlier submission's on_done has resolved
+        (ordered_done mode). Runs on a pool worker; the lock is released
+        before on_done runs, so user callbacks never execute under it."""
+        with self._order_cond:
+            while item.seq != self._next_done:
+                self._order_cond.wait()
+
+    def _advance_order(self, item: _InFlight) -> None:
+        if not self._ordered:
+            return
+        with self._order_cond:
+            self._next_done = max(self._next_done, item.seq + 1)
+            self._order_cond.notify_all()
+
     def _encode_one(self, item: _InFlight, host) -> None:
+        if self._ordered:
+            self._wait_turn(item)
         t0 = time.perf_counter()
         try:
             # entered (not just timed) so the caller's on_done — response
@@ -327,6 +366,7 @@ class Engine:
             self._resolve_error(item, e)
             return
         finally:
+            self._advance_order(item)
             self._encode_slots.release()
             self.metrics.on_stage("encode", time.perf_counter() - t0)
         self.metrics.on_complete(time.perf_counter())

@@ -103,11 +103,13 @@ def decode_image_bytes(data: bytes) -> np.ndarray:
         return np.asarray(im.convert("RGB"), dtype=np.uint8)
 
 
-def encode_image_bytes(img: np.ndarray, format: str = "PNG") -> bytes:
-    """Encode (H, W) or (H, W, 3) uint8 to image bytes (the serving
-    response codec; PNG keeps the bit-exactness contract lossless)."""
-    import io as _io
-
+def encode_image_into(img: np.ndarray, sink, format: str = "PNG") -> None:
+    """Encode (H, W) or (H, W, 3) uint8 straight into a writable binary
+    file object — the single-copy handoff for the engine's encode-worker
+    path: the encoder writes into the response/file sink directly
+    instead of materialising the full byte string and copying it out
+    again (`encode_image_bytes` keeps the bytes-returning contract for
+    callers that need one)."""
     from PIL import Image
 
     img = np.asarray(img)
@@ -115,8 +117,16 @@ def encode_image_bytes(img: np.ndarray, format: str = "PNG") -> bytes:
         raise TypeError(f"expected uint8 image, got {img.dtype}")
     if img.ndim == 3 and img.shape[2] == 1:
         img = img[..., 0]
+    Image.fromarray(img).save(sink, format=format)
+
+
+def encode_image_bytes(img: np.ndarray, format: str = "PNG") -> bytes:
+    """Encode (H, W) or (H, W, 3) uint8 to image bytes (the serving
+    response codec; PNG keeps the bit-exactness contract lossless)."""
+    import io as _io
+
     buf = _io.BytesIO()
-    Image.fromarray(img).save(buf, format=format)
+    encode_image_into(img, buf, format=format)
     return buf.getvalue()
 
 
@@ -218,8 +228,49 @@ def batch_load(
                 yield _deliver(i, got)
 
 
-def synthetic_image(height: int, width: int, *, channels: int = 3, seed: int = 0) -> np.ndarray:
-    """Deterministic pseudo-random test/bench image (uint8)."""
-    rng = np.random.default_rng(seed)
-    shape = (height, width, channels) if channels > 1 else (height, width)
+# Row-block granularity of the synthetic generator: every block of rows
+# draws from its own seeded stream, so any row window can be produced
+# without materialising the rows before it (synthetic_tile).
+_SYNTH_BLOCK_ROWS = 256
+
+
+def _synthetic_block(
+    block: int, rows: int, width: int, channels: int, seed: int
+) -> np.ndarray:
+    rng = np.random.default_rng((seed, width, channels, block))
+    shape = (rows, width, channels) if channels > 1 else (rows, width)
     return rng.integers(0, 256, size=shape, dtype=np.uint8)
+
+
+def synthetic_image(height: int, width: int, *, channels: int = 3, seed: int = 0) -> np.ndarray:
+    """Deterministic pseudo-random test/bench image (uint8).
+
+    Generated in fixed row blocks, each from its own seeded stream, so
+    `synthetic_tile` can produce any row window bit-identically WITHOUT
+    allocating the full frame — the gigapixel stream tests and benches
+    depend on that equivalence (tile == full[rows] is asserted by
+    tests/test_stream.py)."""
+    return synthetic_tile(
+        0, height, width, channels=channels, seed=seed
+    )
+
+
+def synthetic_tile(
+    row0: int, rows: int, width: int, *, channels: int = 3, seed: int = 0
+) -> np.ndarray:
+    """Rows ``[row0, row0 + rows)`` of ``synthetic_image(H, width, ...)``
+    for any H > row0 + rows — bit-identical to slicing the full frame,
+    at cost proportional to the WINDOW, not the image. The windowed
+    decoder the streaming engine's synthetic reader and the gigapixel
+    benches use (a 100k x 100k scan must never exist host-side)."""
+    if rows < 0 or row0 < 0:
+        raise ValueError(f"bad window row0={row0} rows={rows}")
+    b0 = row0 // _SYNTH_BLOCK_ROWS
+    b1 = (row0 + rows + _SYNTH_BLOCK_ROWS - 1) // _SYNTH_BLOCK_ROWS
+    parts = [
+        _synthetic_block(b, _SYNTH_BLOCK_ROWS, width, channels, seed)
+        for b in range(b0, max(b1, b0 + 1))
+    ]
+    band = parts[0] if len(parts) == 1 else np.concatenate(parts, axis=0)
+    off = row0 - b0 * _SYNTH_BLOCK_ROWS
+    return np.ascontiguousarray(band[off : off + rows])

@@ -15,7 +15,7 @@ Naming scheme (docs/design.md "Observability"):
     mcim_<subsystem>_<what>[_total|_seconds]{label="value"}
 
   * prefix `mcim_`; subsystem in {serve, engine, cache, breaker, health,
-    batch, fabric};
+    batch, fabric, stream};
   * counters end `_total` and only go up; durations are SECONDS with a
     `_seconds` suffix (never ms — the exposition consumer rescales);
   * statuses/stages/buckets are LABELS, not name suffixes, so one family

@@ -552,6 +552,18 @@ class GlobalOp:
 Op = PointwiseOp | StencilOp | GeometricOp | GlobalOp
 
 
+def chain_halo(ops) -> int:
+    """Total row context a chain of ops needs on each side of a region to
+    reproduce the whole-image result there bit-exactly: the SUM of the
+    per-op halos (op k's halo-h output row depends on op k-1's output
+    h rows further out, and so on down the chain). This is the seam
+    sizing rule the streaming tile engine (stream/tiles.py) and the
+    temporally-blocked sharded runners share: one `chain_halo` strip of
+    real neighbour rows per seam buys the entire chain, instead of one
+    exchange per op."""
+    return sum(op.halo for op in ops)
+
+
 def _check_channels(name: str, want: int, img: jnp.ndarray) -> None:
     got = img.shape[2] if img.ndim == 3 else 1
     if want and got != want:

@@ -12,6 +12,7 @@ unsharded result bit-exactly (the invariant tests/test_sharded.py asserts).
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from mpi_cuda_imagemanipulation_tpu.parallel.mesh import ROWS
@@ -75,6 +76,42 @@ def exchange_halo_strips(
     idx[axis] = slice(tile.shape[axis] - halo, None)
     last = tile[tuple(idx)]
     return exchange_edge_strips(first, last, n_shards, axis_name=axis_name)
+
+
+def host_edge_strips(
+    tile: np.ndarray, halo: int, *, axis: int = 0
+) -> tuple[np.ndarray, np.ndarray]:
+    """(leading, trailing) ``halo``-thick strips of a HOST-resident tile.
+
+    The same slicing convention as the device-side exchanges above,
+    generalized from device boundaries to tile boundaries: the streaming
+    tile engine (stream/) keeps these strips from tile k to extend tile
+    k+1 instead of re-reading neighbour rows from the decoder — the
+    Casper seam-reuse move, with a host copy standing in for the
+    ppermute. Copies (not views) so the donor tile's buffer can be
+    released while the strip is still pending."""
+    lead = np.take(tile, range(halo), axis=axis)
+    n = tile.shape[axis]
+    tail = np.take(tile, range(n - halo, n), axis=axis)
+    return np.ascontiguousarray(lead), np.ascontiguousarray(tail)
+
+
+def stitch_tile(
+    before: np.ndarray | None,
+    tile: np.ndarray,
+    after: np.ndarray | None,
+    *,
+    axis: int = 0,
+) -> np.ndarray:
+    """Concatenate a host tile with its neighbour seam strips — the
+    host-memory analogue of ``exchange_halo``'s concatenated device tile.
+    ``None`` strips mean a global image edge: nothing is stitched there
+    and the op-level edge extension (pad2d, asymmetric) takes over,
+    exactly as the sharded runner fixes ring-wrapped strips."""
+    parts = [p for p in (before, tile, after) if p is not None]
+    if len(parts) == 1:
+        return tile
+    return np.concatenate(parts, axis=axis)
 
 
 def exchange_halo(

@@ -61,6 +61,13 @@ KNOWN_SITES = (
     "replica.heartbeat",  # fabric/control.py HeartbeatSender: a hit DROPS
                         # that beat, so the router sees heartbeat loss /
                         # staleness while the replica keeps serving
+    "stream.tile",      # stream/runner.py: per-tile submission — a hit
+                        # fails that tile (and so the stream) after the
+                        # prior tiles are durable, the kill-mid-stream
+                        # shape the journal resume tests re-run from
+    "stream.stitch",    # stream/runner.py: seam assembly — a fault in
+                        # the host-side strip carry, distinct from the
+                        # dispatch path so stitch recovery is testable
 )
 
 ENV_SPEC = "MCIM_FAILPOINTS"

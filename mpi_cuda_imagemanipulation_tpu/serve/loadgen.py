@@ -146,10 +146,30 @@ def run_offered_load(
 # --------------------------------------------------------------------------
 
 
-def http_post_image(url: str, blob: bytes, *, timeout_s: float = 30.0) -> dict:
+def encode_blob(img: np.ndarray) -> memoryview:
+    """Single-copy request blob: the PNG encoder writes into ONE buffer
+    (`io.image.encode_image_into`) and the HTTP client posts a view of
+    it — the full byte string is never duplicated. The streamed outputs'
+    incremental encoder (io/stream_codec.PNGTileWriter over a BytesIO)
+    hands its buffer through the same path, so a stream-produced frame
+    costs one resident copy end to end."""
+    import io as _io
+
+    from mpi_cuda_imagemanipulation_tpu.io.image import encode_image_into
+
+    buf = _io.BytesIO()
+    encode_image_into(img, buf)
+    return buf.getbuffer()
+
+
+def http_post_image(
+    url: str, blob: bytes | bytearray | memoryview, *, timeout_s: float = 30.0
+) -> dict:
     """One `POST /v1/process` against a front door (router or replica).
-    Returns {code, body, attempts, replica, trace_id, e2e_s}; transport
-    errors surface as code 599 so open-loop accounting never raises."""
+    `blob` is any bytes-like body (memoryviews from `encode_blob` / the
+    incremental stream encoder post without a defensive copy). Returns
+    {code, body, attempts, replica, trace_id, e2e_s}; transport errors
+    surface as code 599 so open-loop accounting never raises."""
     import urllib.error
     import urllib.request
 
@@ -188,7 +208,7 @@ def http_post_image(url: str, blob: bytes, *, timeout_s: float = 30.0) -> dict:
 
 def http_run_offered_load(
     url: str,
-    blobs: list[bytes],
+    blobs: list[bytes | bytearray | memoryview],
     offered_rps: float,
     duration_s: float,
     *,

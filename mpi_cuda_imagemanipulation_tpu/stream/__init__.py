@@ -1,0 +1,33 @@
+"""Constant-footprint streaming tile engine (docs/design.md "Streaming
+tile engine"): gigapixel images and video as row-band streams through
+the async engine — fixed-shape tiles, seam-stitched halos
+(parallel/halo host strips), incremental decode/encode
+(io/stream_codec), problem size decoupled from memory footprint."""
+
+from mpi_cuda_imagemanipulation_tpu.stream.metrics import StreamMetrics
+from mpi_cuda_imagemanipulation_tpu.stream.runner import (
+    DEFAULT_TILE_ROWS,
+    StreamResult,
+    resumable_tiles,
+    stream_fingerprint,
+    stream_pipeline,
+)
+from mpi_cuda_imagemanipulation_tpu.stream.tiles import (
+    StreamabilityError,
+    plan_tiles,
+    validate_stream_ops,
+)
+from mpi_cuda_imagemanipulation_tpu.stream.video import stream_video
+
+__all__ = [
+    "DEFAULT_TILE_ROWS",
+    "StreamMetrics",
+    "StreamResult",
+    "StreamabilityError",
+    "plan_tiles",
+    "resumable_tiles",
+    "stream_fingerprint",
+    "stream_pipeline",
+    "stream_video",
+    "validate_stream_ops",
+]

@@ -1,0 +1,376 @@
+"""The constant-footprint streaming tile runner.
+
+One pass over the image, problem size decoupled from memory footprint:
+
+    reader thread       caller thread                engine completion   encode pool
+    ─────────────       ─────────────                ─────────────────   ───────────
+    read band k+2 ──►┐
+    (bounded queue,  ├─ stitch seam strips → ext_k
+     2 bands ahead) ─┘  submit: H2D stage + enqueue ─► force D2H in ───► ordered
+                          ▲ blocks at `inflight`       submission        write_rows
+                          │ outstanding (backpressure) order             → journal ok
+
+Reads are single-pass: every row is decoded ONCE. Tile k's extension is
+assembled from the seam strips of its neighbours — the previous band's
+tail strip is carried forward host-side (parallel/halo.host_edge_strips,
+the ppermute edge-strip logic generalized to tile boundaries) and the
+next band, already read for prefetch, donates its head — so interior
+seams cost one `chain_halo` strip copy instead of a re-read (the Casper
+reuse). With `inflight >= 2` the H2D upload of tile k+1 is staged while
+tile k computes and tile k-1 encodes: the double-buffered steady state
+the async engine was built for, now fed by a stream instead of a file
+list.
+
+Failure model: a tile that fails at dispatch/force/encode fails the
+STREAM (one output file), but every completed tile was already written
+and journaled, so `--resume` restarts at the first missing tile — the
+journal trusts a tile record only when its config fingerprint matches
+(ops/shape/tile_rows/impl), mirroring cmd_batch's digest rule. The
+`stream.tile` and `stream.stitch` failpoints inject exactly these
+faults for the tier-1 recovery tests.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+from mpi_cuda_imagemanipulation_tpu.engine import Engine, EngineMetrics
+from mpi_cuda_imagemanipulation_tpu.io.stream_codec import TileReader, TileWriter
+from mpi_cuda_imagemanipulation_tpu.obs import trace as obs_trace
+from mpi_cuda_imagemanipulation_tpu.parallel.halo import (
+    host_edge_strips,
+    stitch_tile,
+)
+from mpi_cuda_imagemanipulation_tpu.resilience import failpoints
+from mpi_cuda_imagemanipulation_tpu.stream.metrics import StreamMetrics
+from mpi_cuda_imagemanipulation_tpu.stream.tiles import (
+    TileFnCache,
+    plan_tiles,
+    validate_stream_ops,
+)
+from mpi_cuda_imagemanipulation_tpu.utils.log import get_logger
+
+DEFAULT_TILE_ROWS = 512
+
+
+def stream_fingerprint(
+    ops_name: str, height: int, width: int, channels: int,
+    tile_rows: int, impl: str,
+) -> str:
+    """The journal 'digest' for stream tiles: a resumed run must be the
+    SAME decomposition of the same computation, or every prior tile is
+    distrusted (cmd_batch's edited-input rule, applied to config)."""
+    import hashlib
+
+    key = f"{ops_name}|{height}x{width}x{channels}|T{tile_rows}|{impl}"
+    return hashlib.sha256(key.encode()).hexdigest()
+
+
+@dataclass
+class StreamResult:
+    tiles: int
+    tiles_done: int
+    tiles_resumed: int
+    rows: int
+    wall_s: float
+    peak_resident_bytes: int
+    engine: dict
+    compiles: int
+
+    def as_dict(self) -> dict:
+        return {
+            "tiles": self.tiles,
+            "tiles_done": self.tiles_done,
+            "tiles_resumed": self.tiles_resumed,
+            "rows": self.rows,
+            "wall_s": self.wall_s,
+            "peak_resident_bytes": self.peak_resident_bytes,
+            "compiles": self.compiles,
+            "engine": self.engine,
+        }
+
+
+def stream_pipeline(
+    reader: TileReader,
+    writer: TileWriter,
+    ops,
+    *,
+    tile_rows: int = DEFAULT_TILE_ROWS,
+    inflight: int = 2,
+    io_threads: int = 2,
+    impl: str = "xla",
+    metrics: StreamMetrics | None = None,
+    engine: Engine | None = None,
+    journal=None,
+    journal_key: str = "stream",
+    resume_tiles: int = 0,
+    trace_parent=None,
+    fn_cache: TileFnCache | None = None,
+) -> StreamResult:
+    """Run `ops` over `reader`'s rows into `writer`, holding O(tile_rows)
+    pixels host-side regardless of image height. Bit-identical to the
+    whole-image golden path for every streamable chain (stream/tiles.py).
+
+    `engine=None` creates a private ordered engine and closes it;
+    passing a shared one (video mode) flushes instead, so consecutive
+    frames ride one steady state. `fn_cache` likewise shares the
+    compiled tile closures across same-shape runs (video frames compile
+    ONCE for the whole stream). `resume_tiles` skips that many leading
+    tiles — the caller has verified (journal + output state) they are
+    already durable."""
+    log = get_logger()
+    metrics = metrics or StreamMetrics()
+    halo = validate_stream_ops(tuple(ops))
+    H, W = reader.height, reader.width
+    tiles = plan_tiles(H, tile_rows, halo)
+    fingerprint = stream_fingerprint(
+        ",".join(op.name for op in ops), H, W, reader.channels,
+        tile_rows, impl,
+    )
+    if fn_cache is not None and (
+        fn_cache.global_h != H or fn_cache.global_w != W
+        or fn_cache.impl != impl
+    ):
+        raise ValueError(
+            "shared fn_cache was built for "
+            f"{fn_cache.global_h}x{fn_cache.global_w}/{fn_cache.impl}, "
+            f"stream is {H}x{W}/{impl}"
+        )
+    cache = fn_cache or TileFnCache(
+        tuple(ops), global_h=H, global_w=W, impl=impl
+    )
+
+    own_engine = engine is None
+    if own_engine:
+        import jax
+
+        engine = Engine(
+            inflight=inflight,
+            io_threads=io_threads,
+            stage=jax.device_put,
+            metrics=EngineMetrics(registry=metrics.registry),
+            ordered_done=True,
+            name="stream",
+        )
+
+    root_ctx = trace_parent
+    if root_ctx is None:
+        cur = obs_trace.current_context()
+        root_ctx = cur if cur is not None else None
+
+    errors: list[tuple[int, BaseException]] = []
+    done = {"n": 0}
+    # host bytes of each in-flight tile's assembled extension: tracked
+    # from stitch until the tile resolves (bounded by `inflight`)
+    ext_bytes: dict[int, int] = {}
+
+    def on_done(key, host, info):
+        spec = tiles[key]
+        host = np.asarray(host)
+        metrics.track(host.nbytes)
+        t0 = time.perf_counter()
+        try:
+            with obs_trace.span("stream.write", tile=key):
+                writer.write_rows(host)
+        finally:
+            metrics.untrack(host.nbytes)
+            metrics.untrack(ext_bytes.pop(key, 0))
+            metrics.on_stage("write", time.perf_counter() - t0)
+        if journal is not None:
+            # flush first: the ok record claims these rows survive a kill
+            writer.flush()
+            journal.record_ok(
+                f"{journal_key}#tile{key}", fingerprint, f"rows{spec.out_lo}"
+            )
+        metrics.tiles.inc(outcome="ok")
+        metrics.rows.inc(spec.out_rows)
+        done["n"] += 1
+
+    def on_error(key, exc):
+        metrics.untrack(ext_bytes.pop(key, 0))
+        metrics.tiles.inc(outcome="failed")
+        errors.append((key, exc))
+        if journal is not None:
+            journal.record_failed(
+                f"{journal_key}#tile{key}", fingerprint,
+                f"{type(exc).__name__}: {exc}",
+            )
+        log.error("stream tile %s failed: %s", key, exc)
+
+    # -- resume fast-forward ------------------------------------------------
+    resume_tiles = min(resume_tiles, len(tiles))
+    prev_tail: np.ndarray | None = None
+    start = resume_tiles
+    if resume_tiles:
+        skipped_rows = tiles[resume_tiles - 1].out_hi
+        if start < len(tiles) and tiles[start].lead:
+            reader.skip_rows(skipped_rows - halo)
+            prev_tail = reader.read_rows(halo)
+        else:
+            reader.skip_rows(skipped_rows)
+        metrics.tiles.inc(resume_tiles, outcome="resumed")
+        metrics.rows.inc(skipped_rows)
+        log.info(
+            "stream resume: %d/%d tiles (%d rows) already durable",
+            resume_tiles, len(tiles), skipped_rows,
+        )
+
+    # -- decode prefetch thread --------------------------------------------
+    # bands are read AHEAD of the submit loop on their own thread through
+    # a bounded queue (2 bands — the decode double-buffer), so read
+    # latency overlaps tile compute instead of serializing the stream;
+    # backpressure composes: a full queue stalls the reader, a full
+    # engine stalls the submitter, and both bounds are constants
+    import queue as _queue
+    import threading
+
+    band_q: _queue.Queue = _queue.Queue(maxsize=2)
+    stop_reading = threading.Event()
+
+    def _produce():
+        try:
+            for j in range(start, len(tiles)):
+                t0 = time.perf_counter()
+                with obs_trace.span(
+                    "stream.prefetch", parent=root_ctx, tile=j
+                ):
+                    b = reader.read_rows(tiles[j].out_rows)
+                metrics.on_stage("read", time.perf_counter() - t0)
+                metrics.track(b.nbytes)
+                while not stop_reading.is_set():
+                    try:
+                        band_q.put((j, b), timeout=0.1)
+                        break
+                    except _queue.Full:
+                        continue
+                if stop_reading.is_set():
+                    metrics.untrack(b.nbytes)
+                    return
+            band_q.put((None, None))
+        except BaseException as e:  # surfaced to the submit loop
+            band_q.put((None, e))
+
+    producer = threading.Thread(
+        target=_produce, name="mcim-stream-read", daemon=True
+    )
+
+    def _next_band() -> np.ndarray | None:
+        j, b = band_q.get()
+        if j is None:
+            if isinstance(b, BaseException):
+                raise b
+            return None
+        return b
+
+    t_start = time.perf_counter()
+    band: np.ndarray | None = None
+    try:
+        producer.start()
+        if start < len(tiles):
+            band = _next_band()
+        if prev_tail is not None:
+            metrics.track(prev_tail.nbytes)
+
+        for i in range(start, len(tiles)):
+            if errors:
+                break  # a failed tile fails the stream; stop feeding it
+            spec = tiles[i]
+            nxt = _next_band() if i + 1 < len(tiles) else None
+
+            t0 = time.perf_counter()
+            with obs_trace.span("stream.stitch", parent=root_ctx, tile=i):
+                failpoints.maybe_fail("stream.stitch", tile=i)
+                head = nxt[: spec.tail] if spec.tail else None
+                ext = stitch_tile(
+                    prev_tail if spec.lead else None, band, head
+                )
+            metrics.on_stage("stitch", time.perf_counter() - t0)
+            metrics.track(ext.nbytes)
+            ext_bytes[i] = ext.nbytes
+
+            # carry the seam strip for tile i+1 BEFORE the band is dropped
+            new_tail = None
+            if i + 1 < len(tiles) and tiles[i + 1].lead:
+                new_tail = host_edge_strips(band, halo)[1]
+                metrics.track(new_tail.nbytes)
+            metrics.untrack(band.nbytes)
+            if prev_tail is not None:
+                metrics.untrack(prev_tail.nbytes)
+            prev_tail, band = new_tail, nxt
+
+            fn = cache.fn(spec)
+            with obs_trace.span(
+                "stream.tile", parent=root_ctx, tile=i,
+                rows=spec.out_rows,
+            ) as tspan:
+                try:
+                    failpoints.maybe_fail("stream.tile", tile=i)
+                    engine.submit(
+                        i,
+                        lambda e=ext, y=spec.ext_lo: (e, np.int32(y)),
+                        lambda x, f=fn: f(*x),
+                        on_done=on_done,
+                        on_error=on_error,
+                    )
+                except Exception as e:
+                    tspan.set(error=type(e).__name__)
+                    on_error(i, e)
+                    break
+
+    finally:
+        stop_reading.set()
+        while True:  # unblock a producer stuck on a full queue
+            try:
+                band_q.get_nowait()
+            except _queue.Empty:
+                break
+        if producer.is_alive():
+            producer.join(timeout=10.0)
+        if own_engine:
+            engine.close()
+        else:
+            engine.flush()
+        reader.close()
+    wall = time.perf_counter() - t_start
+
+    if errors:
+        k, exc = errors[0]
+        raise RuntimeError(
+            f"stream failed at tile {k} "
+            f"({done['n'] + resume_tiles}/{len(tiles)} tiles durable; "
+            f"re-run with --resume): {exc}"
+        ) from exc
+
+    return StreamResult(
+        tiles=len(tiles),
+        tiles_done=done["n"],
+        tiles_resumed=resume_tiles,
+        rows=H,
+        wall_s=wall,
+        peak_resident_bytes=metrics.peak_resident_bytes,
+        engine=engine.metrics.snapshot(),
+        compiles=len(cache._fns),
+    )
+
+
+def resumable_tiles(journal, journal_key: str, fingerprint: str, n_tiles: int) -> int:
+    """The longest PREFIX of tiles journaled ok under `fingerprint` — a
+    stream output is sequential, so only a contiguous prefix is durable
+    (a lone ok tile after a gap is unreachable and re-run)."""
+    if journal is None:
+        return 0
+    records = journal.load()
+    k = 0
+    while k < n_tiles:
+        rec = records.get(f"{journal_key}#tile{k}")
+        if not (
+            rec
+            and rec.get("status") == "ok"
+            and rec.get("digest") == fingerprint
+        ):
+            break
+        k += 1
+    return k

@@ -1,0 +1,285 @@
+"""Tile geometry + the compiled per-tile op chain.
+
+The streaming engine decomposes an (H, W[, C]) image into fixed-height
+row bands and runs the SAME op chain every other backend runs — but on a
+band extended with `chain_halo` real neighbour rows per interior seam,
+so the band's output is bit-identical to the corresponding rows of the
+whole-image golden result. The machinery is the sharded runner's
+(parallel/api.py `_stencil_on_ext`), generalized from device boundaries
+to tile boundaries:
+
+  * each stencil op consumes `op.halo` rows of context from every
+    interior side of the band and PADS (pad2d, the op's own edge mode)
+    at sides that are the true image boundary — a chain of ops walks
+    the extension down exactly as `ops.spec.chain_halo` sizes it;
+  * `finalize` runs at GLOBAL row offsets (y0 is a traced scalar), so
+    `edge_mode='interior'` masks (the reference guard) see image
+    coordinates, not band coordinates — the same trick that removes the
+    reference's per-slice seams removes ours;
+  * only shape-preserving ops stream: pointwise + stencil families.
+    Geometric ops re-index globally and global-statistics ops need a
+    full-image pass; both are rejected loudly (`StreamabilityError`).
+
+Compile cost is bounded by construction, not by image size: every
+middle band shares one (shape, lead, tail) signature, so an arbitrarily
+tall image compiles at most four variants (first / middle / last /
+short-last) per chain. `y0` rides as a traced argument precisely so the
+band index never recompiles anything.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mpi_cuda_imagemanipulation_tpu.ops.spec import (
+    GeometricOp,
+    GlobalOp,
+    Op,
+    PointwiseOp,
+    StencilOp,
+    chain_halo,
+    exact_f32,
+    pad2d,
+)
+
+STREAM_IMPLS = ("auto", "xla", "mxu")
+
+
+class StreamabilityError(ValueError):
+    """The op chain cannot run as a row stream."""
+
+
+def validate_stream_ops(ops: tuple[Op, ...]) -> int:
+    """Reject non-streamable ops; return the chain halo (seam size)."""
+    for op in ops:
+        if isinstance(op, GeometricOp):
+            raise StreamabilityError(
+                f"op {op.name!r} re-indexes the image globally and cannot "
+                "run as a row stream (geometric ops need the whole frame)"
+            )
+        if isinstance(op, GlobalOp):
+            raise StreamabilityError(
+                f"op {op.name!r} depends on a full-image statistic and "
+                "cannot run as a single-pass row stream"
+            )
+        if not isinstance(op, (PointwiseOp, StencilOp)):
+            raise StreamabilityError(f"op {op.name!r} is not streamable")
+    return chain_halo(ops)
+
+
+def out_channels(ops: tuple[Op, ...], in_channels: int) -> int:
+    """Channel count after the chain (grayscale 3->1, gray2rgb 1->3)."""
+    chan = in_channels
+    for op in ops:
+        if op.in_channels and chan != op.in_channels:
+            raise ValueError(
+                f"op {op.name!r} expects {op.in_channels} channels, "
+                f"stream carries {chan}"
+            )
+        if op.out_channels:
+            chan = op.out_channels
+    return chan
+
+
+@dataclass(frozen=True)
+class TileSpec:
+    """One band of the decomposition, in global row coordinates."""
+
+    index: int
+    out_lo: int  # first output row this tile produces
+    out_hi: int  # one past the last
+    lead: int  # context rows included above out_lo (0 at the image top)
+    tail: int  # context rows included below out_hi (0 at the bottom)
+
+    @property
+    def ext_lo(self) -> int:
+        return self.out_lo - self.lead
+
+    @property
+    def ext_hi(self) -> int:
+        return self.out_hi + self.tail
+
+    @property
+    def out_rows(self) -> int:
+        return self.out_hi - self.out_lo
+
+
+def plan_tiles(height: int, tile_rows: int, halo: int) -> list[TileSpec]:
+    """Decompose `height` rows into bands of `tile_rows`, each extended
+    by `halo` rows of real context at interior seams. `tile_rows` must
+    cover the chain halo: a seam strip comes from exactly one neighbour
+    band (the Casper single-strip reuse), so halo > tile_rows would need
+    multi-band carries — raise and let the caller pick a bigger tile."""
+    if tile_rows < 1:
+        raise ValueError(f"tile_rows must be >= 1, got {tile_rows}")
+    if height < 1:
+        raise ValueError(f"height must be >= 1, got {height}")
+    if halo > tile_rows:
+        raise StreamabilityError(
+            f"tile_rows={tile_rows} is smaller than the chain halo "
+            f"{halo}; a seam would span multiple bands — raise "
+            f"--tile-rows to at least {halo}"
+        )
+    n = math.ceil(height / tile_rows)
+    bounds = [
+        (k * tile_rows, min(height, (k + 1) * tile_rows)) for k in range(n)
+    ]
+    # a short last band (< halo rows) would hand its predecessor a
+    # partial seam strip; merge it into the predecessor instead — the
+    # merged band is at most tile_rows + halo <= 2*tile_rows tall, so
+    # the memory bound only gains a constant
+    if len(bounds) > 1 and bounds[-1][1] - bounds[-1][0] < halo:
+        lo, _ = bounds[-2]
+        bounds[-2] = (lo, height)
+        bounds.pop()
+    tiles = []
+    for k, (lo, hi) in enumerate(bounds):
+        tiles.append(
+            TileSpec(
+                index=k,
+                out_lo=lo,
+                out_hi=hi,
+                lead=min(halo, lo),
+                tail=min(halo, height - hi),
+            )
+        )
+    return tiles
+
+
+# --------------------------------------------------------------------------
+# The compiled per-tile chain
+# --------------------------------------------------------------------------
+
+
+def _acc_fn(op: StencilOp, impl: str, width: int):
+    """The valid-region accumulator for one stencil under `impl`: the
+    golden VPU path, the forced MXU banded contraction, or — for 'auto'
+    — the calibration-gated routing decision, made ONCE at build time
+    (ops/mxu_kernels.use_mxu_for_stencil), never inside the trace."""
+    if impl == "xla":
+        return op.valid
+    from mpi_cuda_imagemanipulation_tpu.ops.mxu_kernels import (
+        mxu_eligible,
+        mxu_valid,
+        use_mxu_for_stencil,
+    )
+
+    if impl == "mxu":
+        if mxu_eligible(op):
+            return partial(mxu_valid, op)
+        return op.valid
+    # auto: MXU only behind a measured calibration win on this device kind
+    mode = use_mxu_for_stencil(op, width)
+    if mode is not None:
+        return partial(mxu_valid, op, mode=mode)
+    return op.valid
+
+
+def _stencil_band(
+    op: StencilOp,
+    buf: jnp.ndarray,
+    acc_fn,
+    take_top: int,
+    take_bot: int,
+    y0,
+    global_h: int,
+    global_w: int,
+) -> jnp.ndarray:
+    """One stencil over a band: consume `take_*` real context rows, pad
+    the rest per the op's edge mode (asymmetric — the band's global-edge
+    sides only), finalize at global coordinates. Mirrors
+    parallel/api._stencil_on_ext with host tiles in place of shards."""
+    h = op.halo
+    pad_top, pad_bot = h - take_top, h - take_bot
+
+    def plane(x: jnp.ndarray) -> jnp.ndarray:
+        xpad = pad2d(exact_f32(x), op.edge_mode, pad_top, pad_bot, h, h)
+        acc = acc_fn(xpad)
+        orig = x[take_top : x.shape[0] - take_bot]
+        return op.finalize(acc, orig, y0, 0, global_h, global_w)
+
+    if buf.ndim == 3:
+        return jnp.stack(
+            [plane(buf[..., c]) for c in range(buf.shape[2])], axis=-1
+        )
+    return plane(buf)
+
+
+def make_tile_fn(
+    ops: tuple[Op, ...],
+    *,
+    lead: int,
+    tail: int,
+    global_h: int,
+    global_w: int,
+    impl: str = "xla",
+):
+    """A jitted ``f(ext_u8, y_ext0) -> out_u8`` for tiles with this
+    (lead, tail) context signature. ``ext`` covers global rows
+    [y_ext0, y_ext0 + ext.rows); the result covers
+    [y_ext0 + lead, y_ext0 + ext.rows - tail). One closure serves every
+    band with the same signature — `y_ext0` is traced, so only the four
+    edge-position variants (and the short last band) ever retrace."""
+    if impl not in STREAM_IMPLS:
+        raise ValueError(f"unknown stream impl {impl!r}; known: {STREAM_IMPLS}")
+    acc_fns = {
+        id(op): _acc_fn(op, impl, global_w)
+        for op in ops
+        if isinstance(op, StencilOp)
+    }
+
+    def run(ext: jnp.ndarray, y_ext0: jnp.ndarray) -> jnp.ndarray:
+        cur = ext
+        lead_rem, tail_rem = lead, tail
+        consumed_top = 0
+        for op in ops:
+            if isinstance(op, StencilOp) and op.halo > 0:
+                h = op.halo
+                take_top = h if lead_rem > 0 else 0
+                take_bot = h if tail_rem > 0 else 0
+                y0 = y_ext0 + (consumed_top + take_top)
+                cur = _stencil_band(
+                    op, cur, acc_fns[id(op)], take_top, take_bot,
+                    y0, global_h, global_w,
+                )
+                lead_rem -= take_top
+                tail_rem -= take_bot
+                consumed_top += take_top
+            else:
+                cur = op(cur)
+        return cur
+
+    return jax.jit(run)
+
+
+class TileFnCache:
+    """The per-run compile cache: one jitted closure per (lead, tail)
+    signature (jit itself keys on the band shape). At most four entries
+    for any image height — the bounded-compile guarantee."""
+
+    def __init__(self, ops, *, global_h, global_w, impl):
+        self.ops = ops
+        self.global_h = global_h
+        self.global_w = global_w
+        self.impl = impl
+        self._fns: dict[tuple[int, int], object] = {}
+
+    def fn(self, spec: TileSpec):
+        key = (spec.lead, spec.tail)
+        f = self._fns.get(key)
+        if f is None:
+            f = self._fns[key] = make_tile_fn(
+                self.ops,
+                lead=spec.lead,
+                tail=spec.tail,
+                global_h=self.global_h,
+                global_w=self.global_w,
+                impl=self.impl,
+            )
+        return f

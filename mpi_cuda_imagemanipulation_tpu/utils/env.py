@@ -138,6 +138,23 @@ _VARS = (
     EnvVar("MCIM_FABRIC_AB_JSON", None, "tests/test_fabric.py",
            "CI: write the fabric_loadgen lane record to this path "
            "(uploaded as an artifact)."),
+    # -- streaming tile engine (stream/) -------------------------------------
+    EnvVar("MCIM_STREAM_TILE_ROWS", "512", "cli.py",
+           "Default row-band height for the `stream` subcommand "
+           "(--tile-rows overrides); the constant-memory budget knob."),
+    EnvVar("MCIM_STREAM_INFLIGHT", "2", "cli.py",
+           "Default in-flight tile dispatches for the `stream` "
+           "subcommand (--inflight overrides); >= 2 double-buffers the "
+           "H2D prefetch of tile k+1 under tile k's compute."),
+    EnvVar("MCIM_STREAM_AB_HEIGHT", None, "bench_suite.py",
+           "stream_ab lane: image height override."),
+    EnvVar("MCIM_STREAM_AB_WIDTH", None, "bench_suite.py",
+           "stream_ab lane: image width override."),
+    EnvVar("MCIM_STREAM_AB_TILE_ROWS", None, "bench_suite.py",
+           "stream_ab lane: streamed-lane tile height override."),
+    EnvVar("MCIM_STREAM_AB_JSON", None, "tests/test_stream.py",
+           "CI: write the stream_ab lane record to this path (uploaded "
+           "as an artifact)."),
     # -- bench driver (bench.py, repo root) ----------------------------------
     EnvVar("MCIM_NO_HISTORY", None, "bench.py",
            "Any non-empty value: do not append promoted records to "

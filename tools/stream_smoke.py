@@ -1,0 +1,196 @@
+#!/usr/bin/env python
+"""CI stream smoke (tier1.yml): the constant-memory acceptance, end to end.
+
+A synthetic 8192-row image runs through the streaming tile engine in
+32-row bands (windowed synthetic decode -> seam-stitched tiles ->
+double-buffered dispatch -> incremental PNG encode) with tracing armed,
+and the run must prove, in one process:
+
+  1. **bit-exactness** — the streamed PNG decodes identical to the
+     whole-image golden pipeline output (a >= 3-op chain whose
+     accumulated halo crosses every seam);
+  2. **constant memory** — measured peak resident bytes at least 20x
+     smaller than the frame, AND flat: a 4x shorter image must report
+     the same peak (within tolerance), because the bound is a function
+     of (tile_rows, inflight, halo) only;
+  3. **observability** — the metrics registry renders as parseable
+     Prometheus exposition with the mcim_stream_* families populated
+     (incl. the peak gauge), and the exported trace holds the
+     stream.prefetch / stream.stitch / stream.tile / stream.write span
+     chain with every span carrying the run's trace id.
+
+The trace JSON lands at argv[1] (uploaded as a CI artifact); the
+metrics snapshot at argv[2] when given.
+
+Usage: python tools/stream_smoke.py /tmp/stream_trace.json [/tmp/stream.prom]
+"""
+
+import io
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+OPS = "grayscale,contrast:3.5,emboss:3"
+HEIGHT = 8192
+SHORT_HEIGHT = 2048
+WIDTH = 256
+CHANNELS = 3
+TILE_ROWS = 32
+MIN_MEMORY_RATIO = 20.0
+FLATNESS = 1.25  # peak(8192 rows) / peak(2048 rows) must stay under this
+
+
+def run_stream(height: int, metrics, engine_name: str):
+    import jax
+
+    from mpi_cuda_imagemanipulation_tpu.engine import Engine, EngineMetrics
+    from mpi_cuda_imagemanipulation_tpu.io.stream_codec import (
+        PNGTileWriter,
+        SyntheticTileReader,
+    )
+    from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline
+    from mpi_cuda_imagemanipulation_tpu.obs import trace as obs_trace
+    from mpi_cuda_imagemanipulation_tpu.stream import stream_pipeline
+    from mpi_cuda_imagemanipulation_tpu.stream.tiles import out_channels
+
+    pipe = Pipeline.parse(OPS)
+    sink = io.BytesIO()
+    writer = PNGTileWriter(
+        sink, height, WIDTH, out_channels(pipe.ops, CHANNELS)
+    )
+    engine = Engine(
+        inflight=2,
+        # ordered delivery serializes writes anyway; one worker keeps the
+        # encode backlog (and so the tracked in-flight extensions) minimal
+        io_threads=1,
+        stage=jax.device_put,
+        metrics=EngineMetrics(registry=metrics.registry),
+        ordered_done=True,
+        name=engine_name,
+    )
+    root = obs_trace.start_trace("stream", ops=OPS, h=height, w=WIDTH)
+    try:
+        with root:
+            res = stream_pipeline(
+                SyntheticTileReader(height, WIDTH, channels=CHANNELS, seed=11),
+                writer,
+                pipe.ops,
+                tile_rows=TILE_ROWS,
+                metrics=metrics,
+                engine=engine,
+                trace_parent=root.context(),
+            )
+    finally:
+        engine.close()
+    writer.close()
+    return res, sink.getvalue(), root.trace_id
+
+
+def main() -> int:
+    trace_out = sys.argv[1] if len(sys.argv) > 1 else "/tmp/_stream_trace.json"
+    prom_out = sys.argv[2] if len(sys.argv) > 2 else None
+
+    from mpi_cuda_imagemanipulation_tpu.io.image import (
+        decode_image_bytes,
+        synthetic_image,
+    )
+    from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline
+    from mpi_cuda_imagemanipulation_tpu.obs import trace as obs_trace
+    from mpi_cuda_imagemanipulation_tpu.obs.metrics import parse_exposition
+    from mpi_cuda_imagemanipulation_tpu.stream import StreamMetrics
+
+    obs_trace.configure(sample=1.0)
+
+    # -- the headline run: 8192 rows through a 64-row tile budget ----------
+    metrics = StreamMetrics()
+    res, png, trace_id = run_stream(HEIGHT, metrics, "stream-smoke")
+    frame_bytes = HEIGHT * WIDTH * CHANNELS
+    peak = res.peak_resident_bytes
+    ratio = frame_bytes / peak
+    print(
+        f"streamed {HEIGHT}x{WIDTH}x{CHANNELS} "
+        f"({frame_bytes / 2**20:.1f} MiB) as {res.tiles} tiles of "
+        f"{TILE_ROWS} rows in {res.wall_s:.2f}s — peak resident "
+        f"{peak / 2**20:.2f} MiB ({ratio:.1f}x smaller), "
+        f"{res.compiles} compiles"
+    )
+    assert ratio >= MIN_MEMORY_RATIO, (
+        f"memory bound broken: frame/peak = {ratio:.1f}x < "
+        f"{MIN_MEMORY_RATIO}x"
+    )
+    assert res.compiles <= 4, f"unbounded compiles: {res.compiles}"
+
+    # bit-exactness vs the whole-image golden (the one allocation this
+    # smoke makes on purpose — the oracle)
+    golden = np.asarray(
+        Pipeline.parse(OPS).jit()(
+            synthetic_image(HEIGHT, WIDTH, channels=CHANNELS, seed=11)
+        )
+    )
+    got = decode_image_bytes(png)
+    assert got.shape == golden.shape, (got.shape, golden.shape)
+    assert np.array_equal(got, golden), "streamed output != golden"
+    print("bit-exact vs whole-image golden: OK")
+
+    # -- flatness: 4x fewer rows, same peak --------------------------------
+    short = StreamMetrics()
+    res_s, png_s, _ = run_stream(SHORT_HEIGHT, short, "stream-smoke-s")
+    flat = metrics.peak_resident_bytes / max(short.peak_resident_bytes, 1)
+    print(
+        f"peak flatness {SHORT_HEIGHT}->{HEIGHT} rows: "
+        f"{short.peak_resident_bytes / 2**20:.2f} -> "
+        f"{metrics.peak_resident_bytes / 2**20:.2f} MiB ({flat:.2f}x)"
+    )
+    assert flat <= FLATNESS, (
+        f"peak resident grew {flat:.2f}x with 4x the rows — not constant"
+    )
+
+    # -- metrics contract --------------------------------------------------
+    text = metrics.registry.render()
+    fams = parse_exposition(text)
+    for fam in (
+        "mcim_stream_tiles_total",
+        "mcim_stream_rows_total",
+        "mcim_stream_stage_seconds",
+        "mcim_stream_peak_resident_bytes",
+        "mcim_engine_stage_seconds",
+    ):
+        assert fam in fams, f"missing metric family {fam}"
+    assert metrics.tiles.value(outcome="ok") == res.tiles
+    assert metrics.rows.value() == HEIGHT
+    if prom_out:
+        with open(prom_out, "w") as f:
+            f.write(text)
+        print(f"metrics snapshot -> {prom_out}")
+
+    # -- trace contract ----------------------------------------------------
+    n = obs_trace.export(trace_out)
+    import json
+
+    events = json.load(open(trace_out))["traceEvents"]
+    spans = [e for e in events if e.get("ph") == "X"]
+    by_name: dict[str, int] = {}
+    for e in spans:
+        by_name[e["name"]] = by_name.get(e["name"], 0) + 1
+    for name in (
+        "stream", "stream.prefetch", "stream.stitch", "stream.tile",
+        "engine.force", "engine.encode", "stream.write",
+    ):
+        assert by_name.get(name), f"span {name!r} missing from the trace"
+    on_trace = [
+        e for e in spans if e["args"].get("trace_id") == trace_id
+    ]
+    assert len(on_trace) >= res.tiles * 3, "trace chain incomplete"
+    print(
+        f"trace: {n} events -> {trace_out} "
+        f"({by_name.get('stream.tile')} tile spans on trace {trace_id})"
+    )
+    print("stream smoke: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

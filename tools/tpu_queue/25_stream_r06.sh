@@ -1,0 +1,33 @@
+#!/bin/bash
+# Streaming tile engine lane (round 6): the stream_ab bench lane on real
+# hardware — serial whole-image vs streamed fixed-shape row bands over
+# the SAME op chain (bit-exactness gated before any timing). Headline
+# columns: e2e img/s per lane, per-lane device-idle fraction (the
+# overlap proof: streamed must sit below serial), and peak resident
+# bytes per lane (the constant-memory proof: the streamed lane's peak
+# follows tile_rows, not image size). On TPU the tile budget is worth
+# sweeping upward — HBM fits far bigger bands than the CI smoke's, and
+# the MXU banded backend is eligible inside tiles (--impl mxu streams
+# bit-exact; stream/tiles.py routes per stencil exactly like the
+# whole-image paths).
+# Also runs one gigapixel-scale demo through the CLI so the window
+# leaves a measured "problem size decoupled from footprint" record:
+# 100000x4096 synthetic rows through a 1024-row budget.
+# Knobs: MCIM_STREAM_AB_HEIGHT / _WIDTH / _TILE_ROWS.
+# Budget: ~2-4 min.
+set -u
+cd "$(dirname "$0")/../.."
+. tools/tpu_queue/_lib.sh
+out=artifacts/stream_ab_r06.out
+: > "$out"
+timeout 1200 python -m mpi_cuda_imagemanipulation_tpu.bench_suite \
+  --config stream_ab >> "$out" 2>&1
+timeout 1200 python -m mpi_cuda_imagemanipulation_tpu.cli stream \
+  --synthetic 100000x4096x3 --output artifacts/_stream_giga.png \
+  --ops grayscale,contrast:3.5,emboss:3 --tile-rows 1024 --inflight 4 \
+  --show-timing --json-metrics artifacts/stream_giga_r06.json \
+  >> "$out" 2>&1
+rm -f artifacts/_stream_giga.png
+commit_artifacts "TPU window: streaming tile engine A/B + gigapixel record (round 6)" \
+  "$out" artifacts/stream_giga_r06.json
+exit 0
