@@ -65,8 +65,8 @@ rule(
 )
 
 _METRIC_RE = re.compile(
-    r"^mcim_(serve|engine|cache|breaker|health|batch|analysis|fabric|stream)_"
-    r"[a-z0-9_]+$"
+    r"^mcim_(serve|engine|cache|breaker|health|batch|analysis|fabric|stream"
+    r"|plan)_[a-z0-9_]+$"
 )
 
 
@@ -274,7 +274,7 @@ def _check_metrics(repo: Repo) -> list:
                     f"metric {name!r} violates the "
                     "mcim_<subsystem>_<what> scheme "
                     "(subsystems: serve/engine/cache/breaker/health/"
-                    "batch/analysis/fabric/stream)"
+                    "batch/analysis/fabric/stream/plan)"
                 )
             elif kind == "counter" and not name.endswith("_total"):
                 msg = f"counter {name!r} must end in _total"

@@ -325,6 +325,7 @@ ENGINE_AB = "engine_ab"
 MXU_AB = "mxu_ab"
 FABRIC_LOADGEN = "fabric_loadgen"
 STREAM_AB = "stream_ab"
+PLAN_AB = "plan_ab"
 
 
 def fabric_loadgen_params() -> dict:
@@ -1243,6 +1244,179 @@ def run_stream_ab(
     return rec
 
 
+def plan_ab_params() -> dict:
+    """The fusion-planner A/B knobs, sized to the backend: the
+    representative pointwise-heavy headline chain (two pointwise ops
+    riding one stencil, plus a trailing pointwise) at 8K on real
+    hardware, a CPU-sized shape otherwise. Env overrides for
+    tools/tpu_queue and tests: MCIM_PLAN_AB_OPS/_HEIGHT/_WIDTH."""
+    on_tpu = is_tpu_backend()
+    params = {
+        "ops": "grayscale,contrast:3.5,gaussian:5,quantize:6",
+        "height": 4320 if on_tpu else 512,
+        "width": 7680 if on_tpu else 512,
+        "channels": 3,
+    }
+    for env, key, cast in (
+        ("MCIM_PLAN_AB_OPS", "ops", str),
+        ("MCIM_PLAN_AB_HEIGHT", "height", int),
+        ("MCIM_PLAN_AB_WIDTH", "width", int),
+    ):
+        raw = env_registry.get(env)
+        if raw:
+            params[key] = cast(raw)
+    return params
+
+
+def run_plan_ab(
+    *,
+    json_path: str | None = None,
+    printer: Callable[[str], None] = print,
+) -> dict:
+    """Unfused-vs-fused execution-plan bench lane (plan/):
+
+      * off       — the product's golden reference, `--plan off`: the
+                    per-op chain in one jit — every op materialises u8
+                    and pays its own whole-image pass;
+      * per_op    — the op-at-a-time dispatch model: one INDEPENDENTLY
+                    jitted callable per op, chained — the reference's
+                    sequential kernel launches, each a full HBM round
+                    trip plus its own dispatch;
+      * pointwise — pointwise absorption only: each stencil carries its
+                    adjacent pointwise run in one pass;
+      * fused     — full temporal blocking: maximal pointwise/stencil
+                    runs as single stages (`--plan fused`).
+
+    Every lane is gated bit-identical to the golden per-op chain on
+    three odd shapes BEFORE any timing (the mxu_ab discipline), then the
+    same workload is timed e2e per lane, plus a per-stage breakdown of
+    the fused plan — so the record shows WHERE the pass savings land,
+    not just that they do. The modelled HBM-pass counts ride along: the
+    speedup (fused vs `--plan off`, the two structures the plan knob
+    actually switches between) is the measured side of
+    `hbm_passes_saved`."""
+    import numpy as np
+
+    from mpi_cuda_imagemanipulation_tpu.plan import build_plan
+    from mpi_cuda_imagemanipulation_tpu.plan.exec import (
+        plan_callable,
+        run_stage_full,
+        run_unfused,
+        unfused_callables,
+    )
+
+    p = plan_ab_params()
+    pipe = Pipeline.parse(p["ops"])
+    c = p["channels"]
+    plans = {m: build_plan(pipe.ops, m) for m in ("pointwise", "fused")}
+    per_op = unfused_callables(pipe.ops)
+    lanes: dict[str, Callable] = {
+        "off": pipe.jit(plan="off"),
+        "per_op": lambda x: run_unfused(per_op, x),
+        "pointwise": jax.jit(plan_callable(plans["pointwise"])),
+        "fused": jax.jit(plan_callable(plans["fused"])),
+    }
+
+    # -- bit-exactness gate before any timing (vs the golden chain) --------
+    for th, tw, seed in ((48, 64, 1), (37, 200, 2), (130, 384, 3)):
+        timg = jnp.asarray(synthetic_image(th, tw, channels=c, seed=seed))
+        golden = np.asarray(pipe(timg))
+        for lane, fn in lanes.items():
+            got = np.asarray(fn(timg))
+            if not np.array_equal(got, golden):
+                raise AssertionError(
+                    f"plan_ab gate: lane {lane!r} mismatches golden at "
+                    f"{th}x{tw}"
+                )
+
+    img = jnp.asarray(
+        synthetic_image(p["height"], p["width"], channels=c, seed=99)
+    )
+    mp = p["height"] * p["width"] / 1e6
+    lane_recs: dict[str, dict] = {}
+    for lane, fn in lanes.items():
+        try:
+            sec = device_throughput(fn, [img])
+        except Exception as e:  # one lane failing must not kill the A/B
+            lane_recs[lane] = {"error": str(e)[:200]}
+            continue
+        plan = plans.get(lane)
+        lane_recs[lane] = {
+            "ms_per_iter": sec * 1e3,
+            "mp_per_s_per_chip": mp / sec,
+            "stages": len(plan.stages) if plan else len(pipe.ops),
+            "hbm_passes_model": (
+                plan.hbm_passes if plan else plans["fused"].hbm_passes_unfused
+            ),
+        }
+    # -- per-stage breakdown of the fused plan (where the time went) -------
+    stage_ms = []
+    for stage in plans["fused"].stages:
+        sfn = jax.jit(lambda x, s=stage: run_stage_full(s, x, "xla"))
+        try:
+            sec = device_throughput(sfn, [img], trials=3)
+            stage_ms.append(
+                {"ops": "+".join(stage.names), "halo": stage.halo,
+                 "ms_per_iter": sec * 1e3}
+            )
+        except Exception as e:
+            stage_ms.append(
+                {"ops": "+".join(stage.names), "error": str(e)[:200]}
+            )
+    ok = {k: v for k, v in lane_recs.items() if "error" not in v}
+    speedup = speedup_dispatch = None
+    if "off" in ok and "fused" in ok:
+        speedup = ok["off"]["ms_per_iter"] / ok["fused"]["ms_per_iter"]
+    if "per_op" in ok and "fused" in ok:
+        speedup_dispatch = (
+            ok["per_op"]["ms_per_iter"] / ok["fused"]["ms_per_iter"]
+        )
+    rec = {
+        "config": PLAN_AB,
+        "pipeline": p["ops"],
+        "impl": "plan_ab",
+        "platform": jax.default_backend(),
+        "height": p["height"],
+        "width": p["width"],
+        "channels": c,
+        "bit_exact_gate": "passed (3 shapes x 3 lanes vs golden)",
+        "lanes": lane_recs,
+        "fused_stage_breakdown": stage_ms,
+        "hbm_passes_saved_model": plans["fused"].hbm_passes_saved,
+        "speedup_fused_vs_off": speedup,
+        "speedup_fused_vs_per_op_dispatch": speedup_dispatch,
+    }
+    if is_tpu_backend():
+        rec["tpu_gen"] = _tpu_gen()
+    printer(
+        f"{'lane':10s} {'ms/iter':>9s} {'MP/s/chip':>11s} "
+        f"{'stages':>7s} {'hbm':>4s}"
+    )
+    for lane, lr in lane_recs.items():
+        if "error" in lr:
+            printer(f"{lane:10s} ERROR {lr['error'][:80]}")
+            continue
+        printer(
+            f"{lane:10s} {lr['ms_per_iter']:9.3f} "
+            f"{lr['mp_per_s_per_chip']:11.0f} {lr['stages']:7d} "
+            f"{lr['hbm_passes_model']:4d}"
+        )
+    for s in stage_ms:
+        printer(
+            f"  stage {s['ops']}: "
+            + (f"{s['ms_per_iter']:.3f} ms" if "ms_per_iter" in s
+               else f"ERROR {s['error'][:60]}")
+        )
+    if speedup is not None:
+        printer(
+            f"fused speedup {speedup:.2f}x e2e vs --plan off "
+            f"({plans['fused'].hbm_passes_saved} modelled HBM passes saved)"
+        )
+    if json_path:
+        emit_json_metrics(rec, None if json_path == "-" else json_path)
+    return rec
+
+
 def serve_loadgen_params() -> dict:
     """The serving-lane knobs, sized to the backend: CPU keeps the sweep
     small enough for tests/dev; real hardware gets serving-sized buckets
@@ -1417,12 +1591,20 @@ def run_suite(
         records.append(run_stream_ab(json_path=json_path, printer=printer))
         if not names:
             return records
+    if names and PLAN_AB in names:
+        # the plan lane compares execution STRUCTURES of one chain
+        # (per-op vs pointwise-absorbed vs temporally blocked), so it
+        # owns its own lane axis like mxu_ab
+        names = [n for n in names if n != PLAN_AB]
+        records.append(run_plan_ab(json_path=json_path, printer=printer))
+        if not names:
+            return records
     if names:
         unknown = [n for n in names if n not in CONFIGS]
         if unknown:
             raise ValueError(
                 f"unknown bench config(s) {unknown}; known: "
-                f"{sorted(CONFIGS) + [ENGINE_AB, FABRIC_LOADGEN, MXU_AB, SERVE_LOADGEN, STREAM_AB]}"
+                f"{sorted(CONFIGS) + [ENGINE_AB, FABRIC_LOADGEN, MXU_AB, PLAN_AB, SERVE_LOADGEN, STREAM_AB]}"
             )
         selected = [CONFIGS[n] for n in names]
     else:
@@ -1520,7 +1702,8 @@ def main(argv: Sequence[str] | None = None) -> int:
         "--config",
         required=True,
         choices=sorted(CONFIGS)
-        + [ENGINE_AB, FABRIC_LOADGEN, MXU_AB, SERVE_LOADGEN, STREAM_AB],
+        + [ENGINE_AB, FABRIC_LOADGEN, MXU_AB, PLAN_AB, SERVE_LOADGEN,
+           STREAM_AB],
     )
     ap.add_argument(
         "--impl",
@@ -1587,6 +1770,8 @@ def main(argv: Sequence[str] | None = None) -> int:
         rec = run_stream_ab(
             printer=lambda s: None, tile_rows=args.tile_rows
         )
+    elif args.config == PLAN_AB:
+        rec = run_plan_ab(printer=lambda s: None)
     else:
         cfg = CONFIGS[args.config]
         if args.halo_mode is not None and cfg.sharded:

@@ -77,6 +77,22 @@ def _add_trace_flags(sp: argparse.ArgumentParser) -> None:
     )
 
 
+def _add_plan_flag(sp: argparse.ArgumentParser) -> None:
+    sp.add_argument(
+        "--plan",
+        choices=("auto", "off", "pointwise", "fused"),
+        default="auto",
+        help="fusion-planner execution structure (plan/): 'off' runs "
+        "op-by-op (the golden reference — one HBM pass and, sharded, one "
+        "ghost exchange per op); 'pointwise' absorbs pointwise runs into "
+        "their neighbouring stencil's pass; 'fused' additionally "
+        "temporally blocks consecutive stencils behind ONE grown-halo "
+        "exchange per stage; 'auto' consults the calibration store "
+        "(`autotune --dimension plan`), then the backend default. "
+        "Bit-identical output in every mode",
+    )
+
+
 def _configure_tracing(args: argparse.Namespace) -> bool:
     """Arm the obs tracer from --trace-out/--trace-sample (or the
     MCIM_TRACE_SAMPLE env). Returns True when armed."""
@@ -192,6 +208,7 @@ def _build_parser() -> argparse.ArgumentParser:
         "detection posture, SURVEY.md §5 — the reference deadlocks its "
         "peers on mid-collective failure, kernel.cu:150)",
     )
+    _add_plan_flag(run)
     _add_failpoint_flags(run)
     _add_trace_flags(run)
 
@@ -301,6 +318,7 @@ def _build_parser() -> argparse.ArgumentParser:
         "at exit — the offline counterpart of the serving GET /metrics "
         "(obs/metrics.py)",
     )
+    _add_plan_flag(batch)
     _add_failpoint_flags(batch)
     _add_trace_flags(batch)
 
@@ -438,6 +456,7 @@ def _build_parser() -> argparse.ArgumentParser:
         "restart-with-backoff (fabric/; the `fabric` subcommand exposes "
         "the router knobs)",
     )
+    _add_plan_flag(srv)
     _add_failpoint_flags(srv)
     _add_trace_flags(srv)
 
@@ -615,6 +634,7 @@ def _build_parser() -> argparse.ArgumentParser:
         "(mcim_stream_* incl. the peak-resident-bytes gauge, plus the "
         "engine families) at exit",
     )
+    _add_plan_flag(stm)
     _add_failpoint_flags(stm)
     _add_trace_flags(stm)
 
@@ -663,14 +683,19 @@ def _build_parser() -> argparse.ArgumentParser:
     )
     tune.add_argument(
         "--dimension",
-        choices=("block", "backend"),
+        choices=("block", "backend", "plan"),
         default="block",
         help="what to calibrate: 'block' sweeps Pallas row-block heights "
         "(--impl/--blocks apply); 'backend' measures VPU (pallas) vs MXU "
         "banded vs hybrid per eligible stencil family in --ops and "
         "records the winner per device kind — `--impl auto` then routes "
         "a family to the MXU only behind such a recorded win "
-        "(ops/mxu_kernels.py, utils/calibration.py)",
+        "(ops/mxu_kernels.py, utils/calibration.py); 'plan' measures the "
+        "per-op / pointwise-absorption / fully-fused execution plans of "
+        "--ops (all bit-identical, gated before timing) and records the "
+        "fastest per (device kind, pipeline fingerprint) — `--plan auto` "
+        "entry points then route through the recorded structure "
+        "(plan/planner.py)",
     )
     tune.add_argument("--height", type=int, default=4320)
     tune.add_argument("--width", type=int, default=7680)
@@ -813,14 +838,17 @@ def cmd_run(args: argparse.Namespace) -> int:
                     "--block applies to single-device Pallas runs; ignored"
                 )
             fn = pipe.sharded(
-                mesh, backend=args.impl, halo_mode=args.halo_mode
+                mesh, backend=args.impl, halo_mode=args.halo_mode,
+                plan=args.plan,
             )
         else:
             if args.block and args.impl == "xla":
                 log.warning(
                     "--block only affects Pallas kernels; ignored for xla"
                 )
-            fn = pipe.jit(backend=args.impl, block_h=args.block)
+            fn = pipe.jit(
+                backend=args.impl, block_h=args.block, plan=args.plan
+            )
 
         if args.profile_dir:
             jax.profiler.start_trace(args.profile_dir)
@@ -1006,17 +1034,21 @@ def cmd_batch(args: argparse.Namespace) -> int:
                 "round --stack to a mesh multiple to avoid the waste",
                 stack, n_flat, -(-stack // n_flat) * n_flat,
             )
-        fn = pipe.data_parallel(make_mesh(n_flat), backend=args.impl)
+        fn = pipe.data_parallel(
+            make_mesh(n_flat), backend=args.impl, plan=args.plan
+        )
     elif stack > 1:  # incl. --shards 1 / 1x1: stacked dispatch, one device
         # donated inputs: each dispatch's staged buffer recycles into its
         # output, so steady state runs without per-batch HBM allocation
-        fn = pipe.batched(backend=args.impl, donate=True)
+        fn = pipe.batched(backend=args.impl, donate=True, plan=args.plan)
     elif n_flat > 1 or n_c is not None:
         mesh = make_mesh_2d(n_r, n_c) if n_c is not None else make_mesh(n_r)
-        fn = pipe.sharded(mesh, backend=args.impl, halo_mode=args.halo_mode)
+        fn = pipe.sharded(
+            mesh, backend=args.impl, halo_mode=args.halo_mode, plan=args.plan
+        )
     else:
         # one jit: re-traces only per shape; donation as above
-        fn = pipe.jit(backend=args.impl, donate=True)
+        fn = pipe.jit(backend=args.impl, donate=True, plan=args.plan)
     if stack == 1 and n_flat == 1 and n_c is None or stack > 1 and n_flat == 1:
         import jax
 
@@ -1343,6 +1375,7 @@ def _batch_stream(args, paths, rels, resumed, journal, digest_fn, pipe, log) -> 
                     reader, writer, ops,
                     tile_rows=args.stream_rows,
                     impl=args.impl,
+                    plan=args.plan,
                     metrics=metrics,
                     engine=engine,
                 )
@@ -1456,6 +1489,7 @@ def cmd_stream(args: argparse.Namespace) -> int:
             inflight=inflight,
             io_threads=max(1, args.io_threads),
             impl=args.impl,
+            plan=args.plan,
             out_ext=args.out_ext,
             metrics=metrics,
             journal=journal,
@@ -1564,6 +1598,7 @@ def cmd_stream(args: argparse.Namespace) -> int:
                 inflight=inflight,
                 io_threads=max(1, args.io_threads),
                 impl=args.impl,
+                plan=args.plan,
                 metrics=metrics,
                 journal=journal,
                 resume_tiles=resume_tiles,
@@ -1682,6 +1717,7 @@ def cmd_serve(args: argparse.Namespace) -> int:
         channels=channels,
         shards=args.shards,
         backend="xla" if args.impl == "auto" else args.impl,
+        plan=args.plan,
         default_deadline_ms=args.deadline_ms,
         retry_attempts=args.retry_attempts,
         breaker_threshold=args.breaker_threshold,
@@ -1932,6 +1968,11 @@ def cmd_autotune(args: argparse.Namespace) -> int:
             is_tpu_backend,
         )
 
+        if args.dimension == "plan":
+            # the plan sweep times pure-XLA executables — meaningful on
+            # any backend, and recorded per device kind, so a CPU record
+            # only ever steers CPU runs (no interpret-mode hazard)
+            return _autotune_plan(args, make_pipeline_ops(args.ops))
         backend = jax.default_backend()
         if not is_tpu_backend() and not args.allow_interpret:
             # pipeline_pallas defaults to interpret=True off-TPU, so the
@@ -2187,6 +2228,92 @@ def _autotune_backend(args: argparse.Namespace, ops) -> int:
     return 0
 
 
+def _autotune_plan(args: argparse.Namespace, ops) -> int:
+    """The fused-plan autotune dimension (`--dimension plan`): measure
+    the per-op ('off'), pointwise-absorption and fully fused execution
+    structures of --ops end-to-end on the live backend and record the
+    fastest per (device kind, pipeline fingerprint, width) in the
+    calibration store. Every candidate is gated bit-identical to the
+    per-op golden output BEFORE timing — a plan that ever diverged would
+    be a planner bug, and must never win a record. `plan='auto'` entry
+    points (jit/batched/sharded/serving/stream) then route through the
+    recorded structure (plan/planner.resolve_plan_mode). Runs under the
+    caller's MCIM_NO_CALIB=1 env, so an existing store cannot steer the
+    sweep it is about to overwrite."""
+    import numpy as np
+
+    import jax
+
+    from mpi_cuda_imagemanipulation_tpu.io.image import synthetic_image
+    from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline
+    from mpi_cuda_imagemanipulation_tpu.plan import (
+        build_plan,
+        pipeline_fingerprint,
+    )
+    from mpi_cuda_imagemanipulation_tpu.serve.padded import accepts_channels
+    from mpi_cuda_imagemanipulation_tpu.utils import calibration
+    from mpi_cuda_imagemanipulation_tpu.utils.log import emit_json_metrics
+    from mpi_cuda_imagemanipulation_tpu.utils.timing import device_throughput
+
+    pipe = Pipeline(list(ops))
+    ch = 3 if accepts_channels(pipe, 3) else 1
+    img = jax.numpy.asarray(
+        synthetic_image(args.height, args.width, channels=ch, seed=7)
+    )
+    kind = calibration.current_device_kind()
+    mp = args.height * args.width / 1e6
+    fp = pipeline_fingerprint(ops)
+    plans = {m: build_plan(ops, m) for m in ("off", "pointwise", "fused")}
+    golden = np.asarray(jax.block_until_ready(pipe.jit(plan="off")(img)))
+    timed: dict = {}
+    for mode in plans:
+        fn = pipe.jit(plan=mode)
+        got = np.asarray(jax.block_until_ready(fn(img)))
+        if not (got == golden).all():  # pragma: no cover - planner bug
+            print(
+                f"error: plan mode {mode!r} diverged from the per-op "
+                "golden output — refusing to record (planner bug)",
+                file=sys.stderr,
+            )
+            return 1
+        timed[mode] = device_throughput(fn, [img])
+    choice = min(timed, key=timed.get)
+    lane_mp = {k: round(mp / v, 1) for k, v in timed.items()}
+    for mode in ("off", "pointwise", "fused"):
+        p = plans[mode]
+        mark = " <- winner" if mode == choice else ""
+        print(
+            f"{mode:10s} {timed[mode] * 1e3:8.3f} ms/iter"
+            f"  {lane_mp[mode]:>10,.0f} MP/s"
+            f"  ({len(p.stages)} stages, {p.hbm_passes} hbm passes){mark}"
+        )
+    rec = {
+        "event": "autotune_plan",
+        "device_kind": kind,
+        "backend": jax.default_backend(),
+        "pipeline": args.ops,
+        "pipeline_fp": fp,
+        "height": args.height,
+        "width": args.width,
+        "choice": choice,
+        "mp_per_s": lane_mp,
+        "stages": {m: len(p.stages) for m, p in plans.items()},
+        "dry_run": bool(args.dry_run),
+    }
+    if args.dry_run:
+        print("dry run; calibration store not written")
+    else:
+        rec["calib_file"] = calibration.record_plan_choice(
+            kind, fp, choice,
+            ops=args.ops, width=args.width, mp_per_s=lane_mp,
+        )
+    if args.json_metrics:
+        emit_json_metrics(
+            rec, None if args.json_metrics == "-" else args.json_metrics
+        )
+    return 0
+
+
 def cmd_info(args: argparse.Namespace) -> int:
     _configure_platform(args.device)
     import jax
@@ -2221,6 +2348,13 @@ def cmd_info(args: argparse.Namespace) -> int:
                     parts.extend(
                         f"{kind}/backend:{fam}={ent.get('choice')}"
                         for fam, ent in sorted(rec.items())
+                        if isinstance(ent, dict)
+                    )
+                elif impl == "plan_choice":
+                    # the fused-plan dimension (pipeline fp -> build mode)
+                    parts.extend(
+                        f"{kind}/plan:{fp}={ent.get('choice')}"
+                        for fp, ent in sorted(rec.items())
                         if isinstance(ent, dict)
                     )
                 else:

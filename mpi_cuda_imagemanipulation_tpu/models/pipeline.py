@@ -29,6 +29,14 @@ from mpi_cuda_imagemanipulation_tpu.ops.spec import Op
 
 BACKENDS = ("xla", "pallas", "swar", "mxu", "auto")
 
+# the fusion-planner knob on every compiled entry point (plan/planner.py):
+# 'off' = per-op golden execution; 'pointwise' absorbs pointwise runs into
+# their neighbouring stencil's pass; 'fused' additionally temporally
+# blocks consecutive stencils (one grown halo per stage); 'auto' resolves
+# per (pipeline, backend, device kind, width) through the calibration
+# store — `autotune --dimension plan` records the measured winner
+PLAN_MODES = ("auto", "off", "pointwise", "fused")
+
 def _silence_unused_donation_warning() -> None:
     """Donation here is opportunistic: shape-changing pipelines (e.g.
     grayscale 3ch→1ch) can't reuse the input buffer and XLA says so with a
@@ -71,7 +79,34 @@ class Pipeline:
 
     # -- compiled entry points -------------------------------------------
 
-    def _callable(self, backend: str, block_h: int | None = None):
+    def _planned_callable(self, backend: str, plan: str):
+        """The fused-plan executor for this (backend, plan) pair, or None
+        when the resolution says per-op (then `_callable`'s legacy paths
+        run unchanged). Pure-XLA/MXU backends execute plans directly;
+        `auto` engages only behind a calibrated plan choice, keeping the
+        measured Pallas group routing by default (plan/planner.py)."""
+        if backend not in ("xla", "mxu", "auto"):
+            return None
+        from mpi_cuda_imagemanipulation_tpu.plan import (
+            build_plan,
+            resolve_plan_mode,
+        )
+        from mpi_cuda_imagemanipulation_tpu.plan.exec import plan_callable
+
+        mode = resolve_plan_mode(self.ops, plan, backend=backend)
+        if mode == "off":
+            return None
+        return plan_callable(build_plan(self.ops, mode), impl=backend)
+
+    def _callable(
+        self,
+        backend: str,
+        block_h: int | None = None,
+        plan: str = "auto",
+    ):
+        planned = self._planned_callable(backend, plan)
+        if planned is not None:
+            return planned
         if backend == "xla":
             return self.apply
         if backend == "pallas":
@@ -115,11 +150,18 @@ class Pipeline:
         block_h: int | None = None,
         *,
         donate: bool = False,
+        plan: str = "auto",
     ):
         """A jitted image -> image function on the current default device.
 
         `block_h` overrides the Pallas row-block height (the reference's
         BLOCK_SIZE knob, kernel.cu:13); None auto-tunes to VMEM.
+
+        `plan` selects the fusion-planner execution structure
+        (PLAN_MODES): fused stages do one pass per stencil group instead
+        of one per op, bit-identical to `plan='off'` (the per-op golden
+        reference). 'auto' resolves through the calibration store
+        (plan/planner.resolve_plan_mode).
 
         `donate=True` donates the input buffer to the computation
         (`donate_argnums`) so same-shape u8→u8 pipelines recycle it into
@@ -131,11 +173,15 @@ class Pipeline:
         if donate:
             _silence_unused_donation_warning()
             return jax.jit(
-                self._callable(backend, block_h=block_h), donate_argnums=0
+                self._callable(backend, block_h=block_h, plan=plan),
+                donate_argnums=0,
             )
-        return jax.jit(self._callable(backend, block_h=block_h))
+        return jax.jit(self._callable(backend, block_h=block_h, plan=plan))
 
-    def batched(self, backend: str = "xla", *, donate: bool = False):
+    def batched(
+        self, backend: str = "xla", *, donate: bool = False,
+        plan: str = "auto",
+    ):
         """A jitted (N, H, W[, C]) -> (N, ...) batch function: one compiled
         dispatch for a stack of same-shape images (`jax.vmap`; the Pallas
         kernels batch through their vmap rule as an extra grid dimension).
@@ -143,13 +189,19 @@ class Pipeline:
         The reference has no batch concept — one hardcoded image per
         process launch (kernel.cu:110). Batching amortises dispatch
         overhead, which dominates small images on remote-attached TPUs.
-        `donate` as in `.jit`."""
+        `donate` as in `.jit`; `plan` as in `.jit` (the planned executor
+        vmaps like any backend callable)."""
         if donate:
             _silence_unused_donation_warning()
-            return jax.jit(jax.vmap(self._callable(backend)), donate_argnums=0)
-        return jax.jit(jax.vmap(self._callable(backend)))
+            return jax.jit(
+                jax.vmap(self._callable(backend, plan=plan)), donate_argnums=0
+            )
+        return jax.jit(jax.vmap(self._callable(backend, plan=plan)))
 
-    def sharded(self, mesh, backend: str = "xla", halo_mode: str = "serial"):
+    def sharded(
+        self, mesh, backend: str = "xla", halo_mode: str = "serial",
+        plan: str = "auto",
+    ):
         """A jitted function running this pipeline sharded over `mesh` with
         ppermute ghost halo exchange.
 
@@ -164,7 +216,17 @@ class Pipeline:
         compute interior rows while the ICI ghost-strip ppermutes are in
         flight, and multi-group pipelines prefetch the next group's
         exchange from the previous group's boundary outputs. Bit-identical
-        output either way — the knob only changes execution structure."""
+        output either way — the knob only changes execution structure.
+
+        `plan` (PLAN_MODES) engages the fusion planner on the 1-D runner:
+        a fused stage exchanges ONE `Stage.halo`-row ghost strip pair per
+        stage (one ppermute pair) instead of one per stencil op —
+        temporal blocking over the wire. 'auto' resolves to fused for the
+        pure-XLA/MXU backends under halo_mode='serial'; the overlap mode
+        keeps its measured per-group prefetch structure unless a plan is
+        explicitly requested (then stages run interior-first at stage
+        granularity). The 2-D tile runner keeps per-op execution (its
+        two-phase corner-carrying exchange has no stage form yet)."""
         if len(mesh.axis_names) == 2:
             if backend not in ("xla", "auto"):
                 raise ValueError(
@@ -194,7 +256,7 @@ class Pipeline:
             )
 
             fn = sharded_pipeline(
-                self, mesh, backend=backend, halo_mode=halo_mode
+                self, mesh, backend=backend, halo_mode=halo_mode, plan=plan
             )
 
         mesh_desc = str(dict(mesh.shape))  # hoisted: no per-call build
@@ -224,7 +286,7 @@ class Pipeline:
         run.__wrapped__ = fn
         return run
 
-    def data_parallel(self, mesh, backend: str = "xla"):
+    def data_parallel(self, mesh, backend: str = "xla", plan: str = "auto"):
         """A jitted (N, H, W[, C]) -> (N, ...) batch function with the
         stack sharded over `mesh`'s first axis: each device runs the whole
         pipeline on its slice of the images (SPMD data parallelism — zero
@@ -249,7 +311,7 @@ class Pipeline:
         sharding = NamedSharding(mesh, spec)
         n_dev = mesh.devices.size
         fn = jax.jit(
-            jax.vmap(self._callable(backend)),
+            jax.vmap(self._callable(backend, plan=plan)),
             in_shardings=sharding,
             out_shardings=sharding,
         )
@@ -276,6 +338,7 @@ class Pipeline:
         backend: str = "xla",
         mesh=None,
         on_trace=None,
+        plan: str = "auto",
     ):
         """The online-serving executable for one shape-bucket cell: a jitted
         (imgs[B, Hb, Wb(,C)], true_h[B], true_w[B]) -> out[B, ...] function
@@ -288,12 +351,17 @@ class Pipeline:
         over it (the `.data_parallel` layout). `backend='mxu'` keeps the
         same executor but contracts eligible stencils on the matrix unit
         (a drop-in for op.valid — bit-identical; ops/mxu_kernels.py);
-        'auto' follows the calibration-gated MXU routing."""
+        'auto' follows the calibration-gated MXU routing. `plan`
+        (PLAN_MODES) stages the executor through the fusion planner:
+        fused stages keep the f32 carry between member ops (border
+        reconstruction stays per-op — the dynamic true border is what the
+        gathers implement), and the compile cache keys executables by the
+        resolved plan's fingerprint (serve/cache.py)."""
         from mpi_cuda_imagemanipulation_tpu.serve.padded import make_serving_fn
 
         return make_serving_fn(
             self, bucket_h, bucket_w, channels, batch,
-            backend=backend, mesh=mesh, on_trace=on_trace,
+            backend=backend, mesh=mesh, on_trace=on_trace, plan=plan,
         )
 
 

@@ -566,6 +566,11 @@ REGISTRY: dict[str, Callable[[str | None], Op]] = {
     "posterize": lambda a: pointwise_from_core(
         f"posterize{_int_arg(a, 4)}", 0, 0, make_posterize_core(_int_arg(a, 4))
     ),
+    # bit-depth quantization: keep the top N bits — posterize's core under
+    # the name the fusion-planner exemplars use (quantize:6 == posterize:6)
+    "quantize": lambda a: pointwise_from_core(
+        f"quantize{_int_arg(a, 6)}", 0, 0, make_posterize_core(_int_arg(a, 6))
+    ),
     "solarize": lambda a: pointwise_from_core(
         f"solarize{_float_arg(a, 128):g}", 0, 0, make_solarize_core(_float_arg(a, 128))
     ),
@@ -641,6 +646,52 @@ def _parse_scale(arg: str | None):
     factor = float(parts[0])
     method = parts[1] if len(parts) > 1 else "bilinear"
     return geometry.make_scale(factor, method)
+
+
+# --------------------------------------------------------------------------
+# Family classification (the fusion planner's dispatch key)
+# --------------------------------------------------------------------------
+
+FAMILIES = ("pointwise", "stencil", "geometric", "global-stat")
+
+# registry names whose factories require an argument — the defaults used
+# ONLY to materialize a representative instance for the classification
+# table (registry_family_table); runtime parsing is unchanged
+_FAMILY_PROBE_ARGS = {
+    "crop": "0:0:16:16",
+    "pad": "2",
+    "resize": "32x32",
+    "scale": "0.5",
+    "rotate": "90",
+    "filter": "1/1/1/1/1/1/1/1/1:0.111",
+}
+
+
+def op_family(op: Op) -> str:
+    """The op's explicit family: 'pointwise', 'stencil', 'geometric' or
+    'global-stat' (the `family` class attribute every op spec declares —
+    ops/spec.py). The fusion planner (plan/) and any other
+    family-dispatching consumer read THIS, not isinstance checks, so a
+    new op kind fails loudly here instead of silently mis-planning."""
+    fam = getattr(op, "family", None)
+    if fam not in FAMILIES:
+        raise TypeError(
+            f"op {getattr(op, 'name', op)!r} declares no known family "
+            f"(got {fam!r}; known: {FAMILIES}) — set the `family` class "
+            "attribute on its spec dataclass (ops/spec.py)"
+        )
+    return fam
+
+
+def registry_family_table() -> dict[str, str]:
+    """Every registered op name -> family, materialized through each
+    factory with its default (or probe) argument. The classification
+    completeness test asserts every entry classifies — a registered op
+    whose spec class forgot `family` fails there, not in the planner."""
+    table: dict[str, str] = {}
+    for name, factory in REGISTRY.items():
+        table[name] = op_family(factory(_FAMILY_PROBE_ARGS.get(name)))
+    return table
 
 
 def make_op(spec: str) -> Op:

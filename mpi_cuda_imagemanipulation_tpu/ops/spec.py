@@ -33,7 +33,7 @@ float64 emulator in tests/test_golden.py).
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable
+from typing import Callable, ClassVar
 
 import jax.numpy as jnp
 import numpy as np
@@ -304,6 +304,11 @@ class PointwiseOp:
     Pallas planar path); 1->3 replication (gray2rgb) is handled by name.
     """
 
+    # explicit family classification (ops/registry.op_family): the fusion
+    # planner and every family-dispatching consumer read this attribute
+    # instead of isinstance-sniffing op classes
+    family: ClassVar[str] = "pointwise"
+
     name: str
     in_channels: int  # 3, 1, or 0 (= any)
     out_channels: int  # 3, 1, or 0 (= same as input)
@@ -370,6 +375,8 @@ class StencilOp:
                others filter every pixel with the named border extension.
     quantize : 'trunc_clip' (reference C semantics) or 'rint_clip'.
     """
+
+    family: ClassVar[str] = "stencil"  # see PointwiseOp.family
 
     name: str
     halo: int
@@ -497,6 +504,8 @@ class GeometricOp:
     is XLA's job; Mosaic kernels keep static block shapes.
     """
 
+    family: ClassVar[str] = "geometric"  # see PointwiseOp.family
+
     name: str
     fn: Callable[[jnp.ndarray], jnp.ndarray]  # u8 -> u8, shape may change
     in_channels: int = 0
@@ -531,6 +540,8 @@ class GlobalOp:
     MPI_Allreduce analogue; the reference has no reduction collective at
     all (SURVEY.md §2.3 lists only Bcast/Scatter/Gather/Barrier).
     """
+
+    family: ClassVar[str] = "global-stat"  # see PointwiseOp.family
 
     name: str
     stats: Callable  # (u8 img, valid mask or None) -> int32 vector

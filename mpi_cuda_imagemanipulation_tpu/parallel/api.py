@@ -479,6 +479,185 @@ def _stencil_on_ext(
     return op.finalize(acc, tile, y0, 0, global_h, global_w)
 
 
+# --------------------------------------------------------------------------
+# Plan-fused segment execution (plan/): temporally blocked stages
+# --------------------------------------------------------------------------
+
+
+def _plan_stage_fused_ok(stage, n: int, local_h: int, global_h: int,
+                         overlap: bool) -> bool:
+    """Whether one fused stage can run temporally blocked on this
+    decomposition: a real stage halo, no pad-to-multiple rows inside the
+    tile (the per-op dynamic edge fix gathers only from real rows — the
+    same gate as the fused-ghost and overlap paths), and enough local
+    rows to slice the stage-halo strips (overlap additionally needs a
+    non-empty interior after consuming 2H context rows). Static, so the
+    fallback decision is identical on every shard."""
+    H = stage.halo
+    if H < 1 or n * local_h != global_h:
+        return H == 0  # halo-0 stages always "fuse" (no exchange at all)
+    if overlap:
+        return local_h > 2 * H
+    return local_h > H
+
+
+def _plan_walk(stage, ext, y_lo, global_h: int, global_w: int, impl: str):
+    """One fused stage over a materialised extended tile: the shared
+    stage walker (plan/exec.walk_stage) with the sharded edge
+    convention — context rows are always present (the stage's single
+    exchange), and out-of-image rows are rewritten per op by
+    _fix_edge_axis BEFORE that op reads them, so ring-wrapped strips and
+    global-edge extension resolve exactly as the per-op serial path's
+    fixups do, one op at a time (no commuting assumption between an op's
+    output and the next op's border)."""
+    from mpi_cuda_imagemanipulation_tpu.ops.spec import U8, exact_f32
+    from mpi_cuda_imagemanipulation_tpu.plan.exec import acc_fns_for, walk_stage
+
+    acc_fns = acc_fns_for(stage.ops, impl, global_w)
+
+    def fix(cur, op, row_lo):
+        return _fix_edge_axis(cur, op, row_lo + op.halo, global_h, 0)
+
+    cur, _, _, _ = walk_stage(
+        stage.ops,
+        exact_f32(ext),
+        y_lo=y_lo,
+        lead_rem=stage.halo,
+        tail_rem=stage.halo,
+        global_h=global_h,
+        global_w=global_w,
+        acc_fns=acc_fns,
+        edge_fix=fix,
+    )
+    return cur.astype(U8)
+
+
+def _apply_stage_serial(stage, tile, y0, global_h, global_w, n, impl, si):
+    """Temporally blocked serial execution of one fused stage: ONE
+    ppermute ghost-strip pair sized to the stage's grown halo
+    (`Stage.halo` = chain_halo of the member stencils), then the whole
+    stage walks the extended tile — where the per-op serial path pays
+    one exchange per stencil, a fused stage pays one total. The
+    `plan_exchange_s<si>` scope is what the structural HLO test counts:
+    exactly one collective-permute pair per fused stage."""
+    H = stage.halo
+    if H == 0:
+        return _plan_walk(stage, tile, y0, global_h, global_w, impl)
+    with jax.named_scope(f"plan_exchange_s{si}"):
+        top, bottom = exchange_halo_strips(tile, H, n)
+    ext = jnp.concatenate([top, tile, bottom], axis=0)
+    with jax.named_scope(f"plan_stage_s{si}"):
+        return _plan_walk(stage, ext, y0 - H, global_h, global_w, impl)
+
+
+def _apply_stage_overlap(stage, tile, y0, global_h, global_w, n, impl, si):
+    """Stage-granular interior-first execution (the PR-1 overlap
+    machinery lifted from per-op groups to fused stages): the stage's
+    single exchange is issued first, the interior — every output row the
+    local tile can produce alone, i.e. all but H per side — walks the
+    stage with NO data dependence on the strips, and two 3H-row boundary
+    bands stitch once they land. Output is bit-identical to the serial
+    stage (the walker is the same; only the region decomposition
+    differs)."""
+    H = stage.halo
+    local_h = tile.shape[0]
+    with jax.named_scope(f"plan_exchange_s{si}"):
+        top, bottom = exchange_halo_strips(tile, H, n)
+    with jax.named_scope(f"plan_overlap_interior_s{si}"):
+        interior = _plan_walk(stage, tile, y0, global_h, global_w, impl)
+    with jax.named_scope(f"plan_overlap_boundary_s{si}"):
+        top_out = _plan_walk(
+            stage,
+            jnp.concatenate([top, tile[: 2 * H]], axis=0),
+            y0 - H, global_h, global_w, impl,
+        )
+        bottom_out = _plan_walk(
+            stage,
+            jnp.concatenate([tile[local_h - 2 * H :], bottom], axis=0),
+            y0 + local_h - 2 * H, global_h, global_w, impl,
+        )
+    return jnp.concatenate([top_out, interior, bottom_out], axis=0)
+
+
+def _run_segment_planned(
+    plan, mesh, impl: str, img: jnp.ndarray, halo_mode: str
+):
+    """One shard_map region executed stage-by-stage from a fused plan.
+    Stages the decomposition gate rejects (pad rows in the tile,
+    sub-halo tiles) fall back to the per-op materialised-ext path inside
+    the same region, so the output contract is unchanged."""
+    n = mesh.shape[ROWS]
+    ops = plan.ops
+    # feasibility bounds come from the PER-OP fallback (legacy rule): a
+    # stage whose grown halo outsizes the tile falls back to per-op
+    # execution instead of failing the build
+    max_halo = max((op.halo for op in ops), default=0)
+    global_h, global_w = img.shape[0], img.shape[1]
+    padded_h = -(-global_h // n) * n
+    pad = padded_h - global_h
+    local_h = padded_h // n
+    min_local = max(2 * pad + 1, pad + max_halo, max_halo)
+    if local_h < min_local:
+        raise ValueError(
+            f"image height {global_h} over {n} shards gives {local_h} "
+            f"rows/shard, below the minimum {min_local} for halo "
+            f"{max_halo} and padding {pad}; use fewer shards"
+        )
+    img_p = (
+        jnp.pad(img, ((0, pad),) + ((0, 0),) * (img.ndim - 1)) if pad else img
+    )
+    overlap = halo_mode == "overlap"
+
+    def tile_fn(tile):
+        y0 = lax.axis_index(ROWS) * local_h
+        for si, stage in enumerate(plan.stages):
+            if stage.kind == "global":
+                op = stage.ops[0]
+                rows = y0 + lax.broadcasted_iota(
+                    jnp.int32, (tile.shape[0], 1), 0
+                )
+                valid = (rows < global_h).reshape(
+                    (tile.shape[0],) + (1,) * (tile.ndim - 1)
+                )
+                stats = lax.psum(op.stats(tile, valid), ROWS)
+                tile = op.apply(tile, stats)
+            elif _plan_stage_fused_ok(stage, n, local_h, global_h, overlap):
+                if overlap and stage.halo >= 1:
+                    tile = _apply_stage_overlap(
+                        stage, tile, y0, global_h, global_w, n, impl, si
+                    )
+                else:
+                    tile = _apply_stage_serial(
+                        stage, tile, y0, global_h, global_w, n, impl, si
+                    )
+            else:
+                # fallback: per-op execution for this stage only (the
+                # golden contract the fused path is gated against)
+                for op in stage.ops:
+                    if isinstance(op, PointwiseOp):
+                        tile = op.fn(tile)
+                    else:
+                        tile = _apply_stencil(
+                            op, tile, y0, global_h, global_w, n,
+                            backend="xla" if impl == "auto" else impl,
+                        )
+        return tile
+
+    def seq(x):
+        for op in ops:
+            x = op(x)
+        return x
+
+    out_shape = jax.eval_shape(seq, img_p)
+    in_spec = P(ROWS, *([None] * (img.ndim - 1)))
+    out_spec = P(ROWS, *([None] * (len(out_shape.shape) - 1)))
+    out = shard_map_compat(
+        tile_fn, mesh=mesh, in_specs=in_spec, out_specs=out_spec,
+        check_vma=True,  # the planned paths are pure XLA (+ MXU einsums)
+    )(img_p)
+    return out[:global_h]
+
+
 def _split_segments(ops):
     """Partition an op sequence into shard_map segments separated by
     geometric (shape-changing) steps.
@@ -715,7 +894,8 @@ def _run_segment(
 
 
 def sharded_pipeline(
-    pipe, mesh, backend: str = "xla", halo_mode: str = "serial"
+    pipe, mesh, backend: str = "xla", halo_mode: str = "serial",
+    plan: str = "auto",
 ):
     """Compile `pipe` to run row-sharded over `mesh` with halo exchange.
 
@@ -727,6 +907,14 @@ def sharded_pipeline(
     (see HALO_MODES); groups the overlap gate rejects (halo 0, pad rows,
     sub-2*halo tiles) fall back to the serial paths, so the output
     contract is unchanged.
+
+    `plan` engages the fusion planner (plan/): a fused plan exchanges ONE
+    stage-halo ghost-strip pair per fused stage — temporal blocking over
+    the wire — instead of one per stencil op. 'auto' resolves to fused
+    for the pure-XLA/MXU backends under halo_mode='serial' (the measured
+    overlap prefetch structure is preserved unless a plan is explicitly
+    requested); resolution and bit-exactness contracts are
+    plan/planner.resolve_plan_mode's.
     """
     if backend not in ("xla", "pallas", "swar", "mxu", "auto"):
         raise ValueError(f"unknown backend {backend!r}")
@@ -734,6 +922,45 @@ def sharded_pipeline(
         raise ValueError(
             f"unknown halo_mode {halo_mode!r}; known: {HALO_MODES}"
         )
+    from mpi_cuda_imagemanipulation_tpu.plan import (
+        build_plan,
+        resolve_plan_mode,
+    )
+
+    plan_mode = resolve_plan_mode(pipe.ops, plan, backend=backend)
+    if plan_mode != "off" and halo_mode == "overlap" and plan in (
+        "auto", None, "",
+    ):
+        # overlap's per-group interior-first prefetch is a measured
+        # structure (PR 1); only an EXPLICIT plan request restructures it
+        plan_mode = "off"
+    if plan_mode != "off":
+        segments = _split_segments(pipe.ops)
+        seg_plans = [
+            build_plan(ops, plan_mode) if kind == "shard_map" else None
+            for kind, ops in segments
+        ]
+        impl = backend  # 'xla' | 'mxu' | 'auto' (resolver guarantees)
+
+        def run_planned(img: jnp.ndarray) -> jnp.ndarray:
+            from jax.sharding import NamedSharding
+
+            for (kind, seg_ops), seg_plan in zip(segments, seg_plans):
+                if kind == "xla":
+                    img = seg_ops[0].fn(img)
+                    img = lax.with_sharding_constraint(
+                        img,
+                        NamedSharding(
+                            mesh, P(ROWS, *([None] * (img.ndim - 1)))
+                        ),
+                    )
+                else:
+                    img = _run_segment_planned(
+                        seg_plan, mesh, impl, img, halo_mode
+                    )
+            return img
+
+        return jax.jit(run_planned)
     # The MCIM_PREFER_SWAR promotion switch is snapshotted ONCE here:
     # routing and the vma-checker decision below must agree, and a
     # mid-session env change between build and a retrace must not split

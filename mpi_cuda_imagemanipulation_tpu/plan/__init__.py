@@ -1,0 +1,36 @@
+"""Op-graph fusion planner (ROADMAP item 3).
+
+`plan/` compiles an op chain into fused execution *stages* before any
+backend dispatches: pointwise prefixes/suffixes are absorbed into their
+neighbouring stencil's read/write, and consecutive stencils are
+temporally blocked — the stage grows its halo once (`ops.spec.chain_halo`
+over the stage) instead of extending/exchanging per op. Every executor
+that consumes a plan (`Pipeline.jit/batched/sharded/serving`, the
+streaming tile engine) then does one HBM pass per stage, one ppermute
+ghost exchange per stage on the sharded path, and one seam strip per
+stage on the stream path — while staying bit-identical to the per-op
+golden chain (`--plan off`), which remains the reference execution.
+"""
+
+from mpi_cuda_imagemanipulation_tpu.plan.ir import (
+    Plan,
+    Stage,
+    pipeline_fingerprint,
+)
+from mpi_cuda_imagemanipulation_tpu.plan.metrics import PlanMetrics, plan_metrics
+from mpi_cuda_imagemanipulation_tpu.plan.planner import (
+    PLAN_MODES,
+    build_plan,
+    resolve_plan_mode,
+)
+
+__all__ = [
+    "PLAN_MODES",
+    "Plan",
+    "PlanMetrics",
+    "Stage",
+    "build_plan",
+    "pipeline_fingerprint",
+    "plan_metrics",
+    "resolve_plan_mode",
+]

@@ -1,0 +1,243 @@
+"""Plan execution: the one stage walker every fused path shares.
+
+A fused stage runs over an *extended region*: a buffer that covers its
+output rows plus up to `Stage.halo` rows of real context on each
+interior side. The walker applies the stage's ops in order on a float32
+carry holding exact u8 integer values (the package's cross-backend
+exactness invariant — every core maps exact integers to exact integers,
+so one u8 materialisation per stage is bit-identical to one per op):
+
+  * pointwise ops run their `core`/`planes_core` on the carry (fn-only
+    ops — LUT gathers, gray2rgb — round-trip through u8, which is exact);
+  * each stencil consumes `op.halo` context rows per interior side and
+    PADS (`pad2d`, the op's own edge mode, asymmetric) at sides that are
+    the true image boundary, then finalizes at GLOBAL row offsets so
+    'interior' masks (the reference guard) see image coordinates — the
+    same walk the streaming tile engine proved out per-op
+    (stream/tiles.py), generalized to a fused stage.
+
+Three consumers, three context conventions, one walker:
+
+  * full image (`plan_callable`): lead = tail = 0 — every stencil pads
+    both sides per its mode; literally the golden computation, staged.
+  * stream tiles: lead/tail from the tile plan (real rows at interior
+    seams, pad at true image edges), threaded ACROSS stages.
+  * sharded tiles (parallel/api): context is always materialised (the
+    stage's single ghost exchange), and an `edge_fix` callback rewrites
+    out-of-image rows per op *before* each stencil reads them — the
+    dynamic-gather equivalent of pad2d (parallel.api._fix_edge_axis),
+    re-applied per op so no commuting assumption is ever made between
+    an op's output and the next op's border extension.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from mpi_cuda_imagemanipulation_tpu.ops.registry import op_family
+from mpi_cuda_imagemanipulation_tpu.ops.spec import (
+    U8,
+    StencilOp,
+    _check_channels,
+    exact_f32,
+    pad2d,
+)
+from mpi_cuda_imagemanipulation_tpu.plan.ir import Plan
+
+PLAN_IMPLS = ("xla", "mxu", "auto")
+
+
+def stencil_acc_fn(op: StencilOp, impl: str, width: int | None):
+    """The valid-region accumulator for one stencil under `impl`: the
+    golden VPU path (`op.valid`), the forced MXU banded contraction, or —
+    for 'auto' — the calibration-gated routing decision, made ONCE at
+    build time (ops/mxu_kernels.use_mxu_for_stencil), never inside the
+    trace. Shared by the plan executors and the streaming tile engine so
+    per-stencil backend routing cannot drift between them."""
+    if impl == "xla":
+        return op.valid
+    from mpi_cuda_imagemanipulation_tpu.ops.mxu_kernels import (
+        mxu_eligible,
+        mxu_valid,
+        use_mxu_for_stencil,
+    )
+
+    if impl == "mxu":
+        if mxu_eligible(op):
+            return partial(mxu_valid, op)
+        return op.valid
+    # auto: MXU only behind a measured calibration win on this device kind
+    mode = use_mxu_for_stencil(op, width)
+    if mode is not None:
+        return partial(mxu_valid, op, mode=mode)
+    return op.valid
+
+
+def acc_fns_for(ops, impl: str, width: int | None) -> dict:
+    if impl not in PLAN_IMPLS:
+        raise ValueError(f"unknown plan impl {impl!r}; known: {PLAN_IMPLS}")
+    return {
+        id(op): stencil_acc_fn(op, impl, width)
+        for op in ops
+        if isinstance(op, StencilOp)
+    }
+
+
+def apply_pointwise_f32(op, cur: jnp.ndarray) -> jnp.ndarray:
+    """One pointwise op on the f32 exact-integer carry."""
+    _check_channels(op.name, op.in_channels, cur)
+    if op.planes_core is not None and cur.ndim == 3:
+        planes = op.planes_core(cur[..., 0], cur[..., 1], cur[..., 2])
+        if isinstance(planes, (list, tuple)):
+            return jnp.stack(list(planes), axis=-1)
+        return planes
+    if op.core is not None:
+        return op.core(cur)
+    # fn-only op (LUT gather, gray2rgb): the u8 round trip is exact on
+    # integer-valued f32, and XLA fuses the casts into the gather pass
+    return exact_f32(op.fn(cur.astype(U8)))
+
+
+def _stencil_region(
+    op: StencilOp,
+    buf: jnp.ndarray,
+    acc_fn,
+    take_top: int,
+    take_bot: int,
+    y0,
+    global_h: int,
+    global_w: int,
+) -> jnp.ndarray:
+    """One stencil over an extended f32 region: consume `take_*` real
+    context rows, pad the rest per the op's edge mode (asymmetric — only
+    at true-image-edge sides), finalize at global coordinates."""
+    h = op.halo
+    pad_top, pad_bot = h - take_top, h - take_bot
+
+    def plane(x: jnp.ndarray) -> jnp.ndarray:
+        xpad = pad2d(x, op.edge_mode, pad_top, pad_bot, h, h)
+        acc = acc_fn(xpad)
+        orig = x[take_top : x.shape[0] - take_bot]
+        return op.finalize_f32(acc, orig, y0, 0, global_h, global_w)
+
+    if buf.ndim == 3:
+        return jnp.stack(
+            [plane(buf[..., c]) for c in range(buf.shape[2])], axis=-1
+        )
+    return plane(buf)
+
+
+def walk_stage(
+    ops,
+    cur: jnp.ndarray,
+    *,
+    y_lo,
+    lead_rem: int,
+    tail_rem: int,
+    global_h: int,
+    global_w: int,
+    acc_fns: dict,
+    edge_fix=None,
+):
+    """Apply one fused stage's ops over the f32 region `cur`, whose first
+    row sits at (traced) global row `y_lo` with `lead_rem`/`tail_rem`
+    real context rows still unconsumed at each end.
+
+    `edge_fix(cur, op, y_lo)` — the sharded convention — marks context as
+    always materialised: every stencil consumes its full halo and the
+    callback rewrites out-of-image rows per that op's edge mode first.
+    Without it (full-image/stream convention), a stencil consumes context
+    only while `*_rem > 0` and pads otherwise.
+
+    Returns ``(cur, y_lo, lead_rem, tail_rem)`` so stream tiles can
+    thread the context budget across consecutive stages.
+    """
+    for op in ops:
+        fam = op_family(op)
+        if fam == "pointwise":
+            cur = apply_pointwise_f32(op, cur)
+            continue
+        if fam != "stencil":  # pragma: no cover - planner invariant
+            raise ValueError(
+                f"op {op.name!r} ({fam}) cannot appear inside a fused stage"
+            )
+        _check_channels(op.name, op.in_channels, cur)
+        h = op.halo
+        if h == 0:
+            # degenerate stencil (box1): shape-preserving, no context
+            cur = _stencil_region(
+                op, cur, acc_fns[id(op)], 0, 0, y_lo, global_h, global_w
+            )
+            continue
+        if edge_fix is not None:
+            cur = edge_fix(cur, op, y_lo)
+            take_top = take_bot = h
+        else:
+            take_top = h if lead_rem > 0 else 0
+            take_bot = h if tail_rem > 0 else 0
+        y0 = y_lo + take_top
+        cur = _stencil_region(
+            op, cur, acc_fns[id(op)], take_top, take_bot,
+            y0, global_h, global_w,
+        )
+        lead_rem -= take_top
+        tail_rem -= take_bot
+        y_lo = y0
+    return cur, y_lo, lead_rem, tail_rem
+
+
+def run_stage_full(stage, img: jnp.ndarray, impl: str) -> jnp.ndarray:
+    """One fused stage over a whole u8 image (lead = tail = 0)."""
+    global_h, global_w = img.shape[0], img.shape[1]
+    acc_fns = acc_fns_for(stage.ops, impl, global_w)
+    cur, _, _, _ = walk_stage(
+        stage.ops,
+        exact_f32(img),
+        y_lo=0,
+        lead_rem=0,
+        tail_rem=0,
+        global_h=global_h,
+        global_w=global_w,
+        acc_fns=acc_fns,
+    )
+    return cur.astype(U8)
+
+
+def plan_callable(plan: Plan, *, impl: str = "xla"):
+    """The full-image executor for a plan: an image -> image function
+    (jit it / vmap it like any backend callable). Barrier stages run
+    their golden op; fused stages run as one pass each."""
+    if impl not in PLAN_IMPLS:
+        raise ValueError(f"unknown plan impl {impl!r}; known: {PLAN_IMPLS}")
+
+    def run(img: jnp.ndarray) -> jnp.ndarray:
+        for stage in plan.stages:
+            if stage.kind in ("geometric", "global"):
+                img = stage.ops[0](img)
+            else:
+                with jax.named_scope(f"plan_stage_{stage.kind}"):
+                    img = run_stage_full(stage, img, impl)
+        return img
+
+    return run
+
+
+def unfused_callables(ops, *, jit: bool = True) -> list:
+    """One independently compiled callable per op — the op-at-a-time
+    execution model (each op a full HBM round trip, like the reference's
+    sequential kernel launches). This is the `--plan off` golden lane the
+    plan_ab bench and the smoke gate time the fused plan against."""
+    if jit:
+        # close over the op rather than jitting the (frozen, ndarray-
+        # holding, hence unhashable) spec dataclass itself
+        return [jax.jit(lambda x, o=op: o(x)) for op in ops]
+    return list(ops)
+
+
+def run_unfused(fns, img):
+    for f in fns:
+        img = f(img)
+    return img

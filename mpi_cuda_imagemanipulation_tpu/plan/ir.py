@@ -1,0 +1,144 @@
+"""The op-graph IR: a pipeline compiled into fused execution stages.
+
+A `Plan` is a partition of the op chain into `Stage`s, in op order:
+
+  * ``fused``     — a run of pointwise/stencil ops executed as ONE pass:
+                    the carried image stays in f32 (exact u8 integer
+                    values — the package's cross-backend invariant, see
+                    ops/spec.py) between ops, stencils consume context
+                    rows from a stage-level halo grown ONCE
+                    (`Stage.halo` = the chain_halo of the stage), and u8
+                    is materialised only at the stage boundary. A fused
+                    stage with zero stencils is a pure elementwise pass.
+  * ``geometric`` — one shape-changing data-movement op; a barrier
+                    (re-indexes globally, so nothing fuses across it).
+  * ``global``    — one full-image-statistic op; a barrier (its stats
+                    pass needs every pixel before its apply pass).
+
+The IR is deliberately tiny: stages are the only structure any executor
+needs — the sharded runner exchanges `Stage.halo` ghost rows once per
+stage, the stream engine sizes its seam strips per stage, and the
+full-image executor walks each stage as one fusion region. Classification
+comes from `ops.registry.op_family` (the explicit per-op family export),
+never from planner-side isinstance sniffing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+
+from mpi_cuda_imagemanipulation_tpu.ops.registry import op_family
+from mpi_cuda_imagemanipulation_tpu.ops.spec import Op, chain_halo
+
+STAGE_KINDS = ("fused", "geometric", "global")
+
+
+def _op_hbm_passes(op: Op) -> int:
+    """Whole-image HBM passes the per-op execution model charges for one
+    op: 1 read+write pass, except global-statistics ops, whose stats and
+    apply halves each read the image (2)."""
+    return 2 if op_family(op) == "global-stat" else 1
+
+
+@dataclasses.dataclass(frozen=True)
+class Stage:
+    """One fused execution region, in global op order."""
+
+    kind: str  # one of STAGE_KINDS
+    ops: tuple[Op, ...]
+    halo: int  # sum of member stencil halos (the stage's grown halo)
+
+    def __post_init__(self):
+        if self.kind not in STAGE_KINDS:  # pragma: no cover - planner bug
+            raise ValueError(f"unknown stage kind {self.kind!r}")
+
+    @property
+    def names(self) -> tuple[str, ...]:
+        return tuple(op.name for op in self.ops)
+
+    @property
+    def n_stencils(self) -> int:
+        return sum(1 for op in self.ops if op_family(op) == "stencil")
+
+    @property
+    def hbm_passes(self) -> int:
+        """Passes this stage costs under the fused model: one for a fused
+        region regardless of member count; barriers keep their op cost."""
+        if self.kind == "fused":
+            return 1
+        return _op_hbm_passes(self.ops[0])
+
+
+@dataclasses.dataclass(frozen=True)
+class Plan:
+    """A compiled stage partition of one op chain."""
+
+    stages: tuple[Stage, ...]
+    mode: str  # 'off' | 'pointwise' | 'fused' (how it was built)
+
+    @property
+    def ops(self) -> tuple[Op, ...]:
+        return tuple(op for s in self.stages for op in s.ops)
+
+    @property
+    def total_halo(self) -> int:
+        """Sum of stage halos — equals chain_halo(ops) by construction
+        (asserted by the property tests): fusing never changes the total
+        row context the chain needs."""
+        return sum(s.halo for s in self.stages)
+
+    @property
+    def fused_stages(self) -> tuple[Stage, ...]:
+        return tuple(s for s in self.stages if s.kind == "fused")
+
+    @property
+    def n_absorbed_ops(self) -> int:
+        """Ops that ride another op's HBM pass instead of paying their
+        own (member count minus one, per multi-op fused stage)."""
+        return sum(len(s.ops) - 1 for s in self.fused_stages)
+
+    @property
+    def hbm_passes(self) -> int:
+        return sum(s.hbm_passes for s in self.stages)
+
+    @property
+    def hbm_passes_unfused(self) -> int:
+        return sum(_op_hbm_passes(op) for op in self.ops)
+
+    @property
+    def hbm_passes_saved(self) -> int:
+        return self.hbm_passes_unfused - self.hbm_passes
+
+    @property
+    def fingerprint(self) -> str:
+        """Stable identity of the *execution structure*: pipeline ops plus
+        the stage partition. The serving compile cache keys executables by
+        this, so a calibration flip (auto resolving to a different mode)
+        can never serve a stale executable built for another structure."""
+        key = pipeline_fingerprint(self.ops) + "|" + self.mode + "|" + ";".join(
+            f"{s.kind}:{','.join(s.names)}:h{s.halo}" for s in self.stages
+        )
+        return hashlib.sha256(key.encode()).hexdigest()[:16]
+
+    def describe(self) -> str:
+        """One human line per stage (CLI/log exposition)."""
+        rows = []
+        for i, s in enumerate(self.stages):
+            rows.append(
+                f"  stage {i} [{s.kind}] halo={s.halo}: {'+'.join(s.names)}"
+            )
+        head = (
+            f"plan mode={self.mode}: {len(self.ops)} ops -> "
+            f"{len(self.stages)} stages, hbm passes "
+            f"{self.hbm_passes_unfused} -> {self.hbm_passes}"
+        )
+        return "\n".join([head, *rows])
+
+
+def pipeline_fingerprint(ops) -> str:
+    """Stable identity of an op chain (names + halos + families) — the
+    calibration store's plan-choice key, shared by autotune and the
+    `plan='auto'` resolution so they can never drift."""
+    key = "|".join(f"{op.name}/{op_family(op)}/h{op.halo}" for op in ops)
+    return hashlib.sha256(key.encode()).hexdigest()[:16]

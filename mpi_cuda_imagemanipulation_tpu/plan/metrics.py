@@ -1,0 +1,60 @@
+"""Planner instrumentation — the `mcim_plan_*` metric family.
+
+One module-level registry: plans are built at executable-construction
+time from many entry points (jit/batched/sharded/serving/stream), and a
+per-call registry would fragment the counters across them. The smoke
+gate (tools/plan_smoke.py) asserts from these that a fused build
+actually reduced modelled HBM passes, and `--json-metrics` surfaces
+`snapshot()` wherever a plan ran.
+"""
+
+from __future__ import annotations
+
+from mpi_cuda_imagemanipulation_tpu.obs.metrics import Registry
+
+
+class PlanMetrics:
+    def __init__(self, registry: Registry | None = None):
+        self.registry = registry or Registry()
+        r = self.registry
+        self.builds = r.counter(
+            "mcim_plan_builds_total",
+            "Plans built, by build mode (off/pointwise/fused).",
+            labels=("mode",),
+        )
+        self.stages = r.counter(
+            "mcim_plan_stages_total",
+            "Stages emitted across all built plans, by kind.",
+            labels=("kind",),
+        )
+        self.fused_ops = r.counter(
+            "mcim_plan_fused_ops_total",
+            "Ops absorbed into another op's HBM pass (fused-stage members "
+            "beyond the first).",
+        )
+        self.passes_saved = r.counter(
+            "mcim_plan_hbm_passes_saved_total",
+            "Modelled whole-image HBM passes removed vs per-op execution, "
+            "summed over built plans.",
+        )
+
+    def on_build(self, plan) -> None:
+        self.builds.inc(mode=plan.mode)
+        for s in plan.stages:
+            self.stages.inc(kind=s.kind)
+        self.fused_ops.inc(plan.n_absorbed_ops)
+        self.passes_saved.inc(plan.hbm_passes_saved)
+
+    def snapshot(self) -> dict:
+        return {
+            "builds_fused": int(self.builds.value(mode="fused")),
+            "builds_pointwise": int(self.builds.value(mode="pointwise")),
+            "builds_off": int(self.builds.value(mode="off")),
+            "stages_fused": int(self.stages.value(kind="fused")),
+            "fused_ops": int(self.fused_ops.value()),
+            "hbm_passes_saved": int(self.passes_saved.value()),
+        }
+
+
+# the shared instance every build reports into (see module docstring)
+plan_metrics = PlanMetrics()

@@ -1,0 +1,156 @@
+"""The fusion planner: op chain -> `Plan`, plus the mode resolution every
+entry point shares.
+
+Three build modes, all bit-identical in output (the property tests
+hammer this) — they differ only in execution structure:
+
+  * ``off``       — one stage per op: the per-op golden reference
+                    execution (`--plan off`). What every fused plan is
+                    gated bit-exact against.
+  * ``pointwise`` — pointwise absorption only: each stage carries at most
+                    one stencil with its adjacent pointwise run; stencils
+                    never merge with each other (no temporal blocking).
+  * ``fused``     — full fusion: maximal pointwise/stencil runs become one
+                    stage whose halo is the run's chain_halo (temporal
+                    blocking: ONE ghost exchange / seam strip / extension
+                    buys the whole stage).
+
+``resolve_plan_mode`` maps the user-facing ``plan`` knob ('auto' plus the
+three modes) to a build mode per (backend, pipeline, width): 'auto'
+consults the calibration store's plan-choice table (`autotune
+--dimension plan`) keyed by (pipeline fingerprint, device kind, width
+window), defaults to 'fused' on the pure-XLA/MXU backends, and stays
+'off' for backends with their own in-kernel group fusion (pallas/swar)
+and for `impl=auto` without a calibrated win — so the measured Pallas
+routing keeps its structure unless a plan measurement beats it.
+"""
+
+from __future__ import annotations
+
+from mpi_cuda_imagemanipulation_tpu.ops.registry import op_family
+from mpi_cuda_imagemanipulation_tpu.ops.spec import chain_halo
+from mpi_cuda_imagemanipulation_tpu.plan.ir import Plan, Stage
+from mpi_cuda_imagemanipulation_tpu.plan.metrics import plan_metrics
+from mpi_cuda_imagemanipulation_tpu.resilience import failpoints
+from mpi_cuda_imagemanipulation_tpu.utils import env as env_registry
+
+# the user-facing knob ('on' is an accepted alias for 'fused'); build
+# modes are the subset without 'auto'/'on'
+PLAN_MODES = ("auto", "off", "pointwise", "fused")
+BUILD_MODES = ("off", "pointwise", "fused")
+
+# backends whose kernels carry their own measured group fusion — the
+# planner must not restructure what their in-kernel streaming already
+# fused (ops/pallas_kernels.run_group, ops/swar_kernels.swar_stencil)
+_SELF_FUSING_BACKENDS = ("pallas", "swar")
+
+
+def _norm_mode(plan: str) -> str:
+    mode = (plan or "auto").strip().lower()
+    if mode == "on":
+        mode = "fused"
+    if mode not in PLAN_MODES:
+        raise ValueError(f"unknown plan mode {plan!r}; known: {PLAN_MODES}")
+    return mode
+
+
+def resolve_plan_mode(
+    ops,
+    plan: str = "auto",
+    *,
+    backend: str = "xla",
+    width: int | None = None,
+) -> str:
+    """The build mode for this (pipeline, backend, width) — 'off',
+    'pointwise' or 'fused'. Pure resolution, no tracing; safe on the
+    build path (it may touch the live backend's device kind for the
+    calibration lookup, like every other calibrated decision)."""
+    mode = _norm_mode(plan)
+    if mode == "auto":
+        env_mode = env_registry.get("MCIM_PLAN")
+        if env_mode:
+            mode = _norm_mode(env_mode)
+    if mode != "auto":
+        if mode != "off" and backend in _SELF_FUSING_BACKENDS:
+            from mpi_cuda_imagemanipulation_tpu.utils.log import get_logger
+
+            get_logger().info(
+                "plan=%s ignored for backend %r (its kernels fuse groups "
+                "in-stream already); running per-op", mode, backend,
+            )
+            return "off"
+        return mode
+    if backend in _SELF_FUSING_BACKENDS:
+        return "off"
+    from mpi_cuda_imagemanipulation_tpu.plan.ir import pipeline_fingerprint
+    from mpi_cuda_imagemanipulation_tpu.utils import calibration
+
+    calibrated = calibration.lookup_plan_choice(
+        pipeline_fingerprint(ops), width=width
+    )
+    if calibrated is not None:
+        return calibrated
+    # no measured choice: the pure-XLA/MXU executors default to fused (the
+    # structural win is one-sided there); impl=auto keeps its measured
+    # Pallas group routing until a plan calibration beats it
+    return "off" if backend == "auto" else "fused"
+
+
+def build_plan(ops, mode: str = "fused") -> Plan:
+    """Partition `ops` into execution stages per `mode` (a BUILD mode —
+    resolve 'auto' with resolve_plan_mode first)."""
+    ops = tuple(ops)
+    if mode not in BUILD_MODES:
+        raise ValueError(f"unknown build mode {mode!r}; known: {BUILD_MODES}")
+    if mode != "off":
+        # the injectable planner fault (resilience/failpoints.py): an armed
+        # `plan.fuse` site fails the fusion decision loudly at build time —
+        # before any executable exists — so callers' build-path error
+        # handling is testable without a real planner bug
+        failpoints.maybe_fail(
+            "plan.fuse", n_ops=len(ops), mode=mode
+        )
+    stages: list[Stage] = []
+    run: list = []  # current pointwise/stencil run
+
+    def flush_run() -> None:
+        if not run:
+            return
+        if mode == "off":
+            for op in run:
+                stages.append(Stage("fused", (op,), op.halo))
+        elif mode == "pointwise":
+            # split so each stage holds at most one stencil: a stencil
+            # closes its stage, absorbing the pointwise run before it; a
+            # trailing pointwise run rides the last stage's write
+            cur: list = []
+            for op in run:
+                cur.append(op)
+                if op_family(op) == "stencil":
+                    stages.append(Stage("fused", tuple(cur), chain_halo(cur)))
+                    cur = []
+            if cur:
+                if stages and stages[-1].kind == "fused" and run[0] is not cur[0]:
+                    prev = stages.pop()
+                    merged = prev.ops + tuple(cur)
+                    stages.append(Stage("fused", merged, prev.halo))
+                else:
+                    stages.append(Stage("fused", tuple(cur), 0))
+        else:  # fused: the whole run is one temporally-blocked stage
+            stages.append(Stage("fused", tuple(run), chain_halo(run)))
+        run.clear()
+
+    for op in ops:
+        fam = op_family(op)
+        if fam == "geometric":
+            flush_run()
+            stages.append(Stage("geometric", (op,), 0))
+        elif fam == "global-stat":
+            flush_run()
+            stages.append(Stage("global", (op,), 0))
+        else:
+            run.append(op)
+    flush_run()
+    plan = Plan(stages=tuple(stages), mode=mode)
+    plan_metrics.on_build(plan)
+    return plan

@@ -68,6 +68,12 @@ KNOWN_SITES = (
     "stream.stitch",    # stream/runner.py: seam assembly — a fault in
                         # the host-side strip carry, distinct from the
                         # dispatch path so stitch recovery is testable
+    "plan.fuse",        # plan/planner.py build_plan: the fusion decision
+                        # itself — a hit fails a fused/pointwise build
+                        # loudly BEFORE any executable exists, so callers'
+                        # build-path error handling is testable without a
+                        # real planner bug ('off' builds never consult it:
+                        # the golden per-op reference must stay reachable)
 )
 
 ENV_SPEC = "MCIM_FAILPOINTS"

@@ -31,6 +31,14 @@ from mpi_cuda_imagemanipulation_tpu.serve.padded import check_servable
 
 Key = tuple[int, int, int, int]  # (bucket_h, bucket_w, channels, batch)
 
+# storage key: the grid cell PLUS the resolved fusion-plan fingerprint
+# (plan.ir.Plan.fingerprint, or "off" for per-op execution). Keying by
+# the op-list alone would let a calibration flip — `autotune --dimension
+# plan` recording a new winner while the server is up — keep serving an
+# executable built for the PREVIOUS execution structure; with the
+# fingerprint in the key such a flip is a miss that rebuilds instead.
+StoredKey = tuple[int, int, int, int, str]
+
 
 class CompileCache:
     def __init__(
@@ -42,6 +50,7 @@ class CompileCache:
         *,
         backend: str = "xla",
         mesh=None,
+        plan: str = "auto",
     ):
         check_servable(pipe)
         self.pipe = pipe
@@ -50,7 +59,8 @@ class CompileCache:
         self.channels = tuple(channels)
         self.backend = backend
         self.mesh = mesh
-        self._fns: dict[Key, object] = {}
+        self.plan = plan
+        self._fns: dict[StoredKey, object] = {}
         self._lock = threading.Lock()
         self.traces = 0  # fired at trace time from inside the jitted body
         self.traces_at_warmup = 0
@@ -79,18 +89,37 @@ class CompileCache:
         with self._lock:
             self.traces += 1
 
+    def plan_fingerprint(self, bucket_w: int) -> str:
+        """The fingerprint of the fusion plan CURRENTLY resolved for this
+        bucket width ("off" for per-op execution) — the storage-key
+        component that keeps executables honest across calibration flips.
+        Resolution is cheap: the calibration store is mtime-cached."""
+        from mpi_cuda_imagemanipulation_tpu.serve.padded import (
+            resolve_serving_plan,
+        )
+
+        built = resolve_serving_plan(self.pipe, self.plan, self.backend, bucket_w)
+        return "off" if built is None else built.fingerprint
+
+    def _stored_key(self, key: Key) -> StoredKey:
+        return (*key, self.plan_fingerprint(key[1]))
+
     def _build(self, key: Key):
         """Construct (never store) the serving callable for one grid
-        cell — pure trace-graph building, safe off-lock."""
+        cell — pure trace-graph building, safe off-lock. The callable
+        resolves the SAME plan the fingerprint in its storage key
+        recorded (one resolution point: serve/padded.resolve_serving_plan)."""
         bh, bw, ch, nb = key
         return self.pipe.serving(
             bh, bw, ch, nb,
             backend=self.backend, mesh=self.mesh, on_trace=self._on_trace,
+            plan=self.plan,
         )
 
     def _compile_one(self, key: Key) -> None:
         bh, bw, ch, nb = key
         failpoints.maybe_fail("cache.warm", key=key)
+        skey = self._stored_key(key)
         fn = self._build(key)
         shape = (nb, bh, bw, ch) if ch > 1 else (nb, bh, bw)
         imgs = np.zeros(shape, dtype=np.uint8)
@@ -102,7 +131,7 @@ class CompileCache:
         # the warmed grid); the lock guards only the dict insert
         jax.block_until_ready(fn(imgs, true, true))
         with self._lock:
-            self._fns.setdefault(key, fn)
+            self._fns.setdefault(skey, fn)
 
     def warmup(self) -> float:
         """Trace + compile the full shape grid; returns wall seconds."""
@@ -111,8 +140,9 @@ class CompileCache:
             for ch in self.channels:
                 for nb in self.batch_buckets:
                     key = (bh, bw, ch, nb)
+                    skey = self._stored_key(key)
                     with self._lock:
-                        warmed = key in self._fns
+                        warmed = skey in self._fns
                     if not warmed:
                         call_with_retry(
                             lambda k=key: self._compile_one(k),
@@ -142,25 +172,30 @@ class CompileCache:
 
     def get(self, bucket_h: int, bucket_w: int, channels: int, batch: int):
         key = (bucket_h, bucket_w, channels, batch)
+        # the CURRENT plan fingerprint joins the lookup key: a warmed
+        # entry whose plan the calibration store has since flipped away
+        # from simply stops matching (a rebuild-miss, never a stale serve)
+        skey = self._stored_key(key)
         bucket = f"{bucket_h}x{bucket_w}"
         if bucket not in self._tracked_buckets:
             bucket = "other"  # bounded label set: admission grid + other
         with self._lock:
-            fn = self._fns.get(key)
+            fn = self._fns.get(skey)
             if fn is not None:
                 self.hits += 1
                 self.hits_by_bucket[bucket] = (
                     self.hits_by_bucket.get(bucket, 0) + 1
                 )
                 return fn
-            # off-grid key: serviceable, but a scheduler bug — count it
+            # off-grid key (or a plan flip since warmup): serviceable,
+            # but unexpected in production — count it
             self.misses += 1
         # build OUTSIDE the lock (same contract as _compile_one: a trace
         # must never stall warmed-path gets); two racing misses may both
         # build, setdefault keeps exactly one
         fn = self._build(key)
         with self._lock:
-            return self._fns.setdefault(key, fn)
+            return self._fns.setdefault(skey, fn)
 
     def warm_buckets(self) -> list[str]:
         """The "HxW" buckets with at least one compiled executable — the
@@ -169,7 +204,7 @@ class CompileCache:
         reclaims its consistent-hash buckets once it reports in: warmth
         is rebuilt by warmup, unlike serving history)."""
         with self._lock:
-            return sorted({f"{bh}x{bw}" for (bh, bw, _c, _n) in self._fns})
+            return sorted({f"{bh}x{bw}" for (bh, bw, *_rest) in self._fns})
 
     def stats(self) -> dict:
         with self._lock:

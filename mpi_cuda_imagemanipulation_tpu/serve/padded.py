@@ -106,12 +106,14 @@ def _ext_ids(n_ext: int, halo: int, true_n, bucket_n: int, edge_mode: str):
     return jnp.minimum(idx, bucket_n - 1)  # safety for the unread tail
 
 
-def _stencil_plane(
-    op: StencilOp, x: jnp.ndarray, th, tw, backend: str = "xla"
+def _stencil_plane_f32(
+    op: StencilOp, xf: jnp.ndarray, th, tw, backend: str = "xla"
 ) -> jnp.ndarray:
+    """One stencil on an f32 exact-integer plane; f32 exact-integer out.
+    The plan-staged executor chains these without intermediate u8
+    materialisation; the per-op path wraps with the u8 casts."""
     h = op.halo
-    bh, bw = x.shape
-    xf = x.astype(F32)  # same cast as StencilOp._apply2d
+    bh, bw = xf.shape
     rid = _ext_ids(bh + 2 * h, h, th, bh, op.edge_mode)
     cid = _ext_ids(bw + 2 * h, h, tw, bw, op.edge_mode)
     xpad = xf[rid[:, None], cid[None, :]]
@@ -132,7 +134,16 @@ def _stencil_plane(
     else:
         acc = op.valid(xpad)
     # dynamic global extent: the interior guard masks in TRUE coordinates
-    return op.finalize(acc, x, 0, 0, th, tw)
+    return op.finalize_f32(acc, xf, 0, 0, th, tw)
+
+
+def _stencil_plane(
+    op: StencilOp, x: jnp.ndarray, th, tw, backend: str = "xla"
+) -> jnp.ndarray:
+    # same cast as StencilOp._apply2d on entry; exact u8 integers out
+    return _stencil_plane_f32(op, x.astype(F32), th, tw, backend).astype(
+        jnp.uint8
+    )
 
 
 def _stencil_backend(op: StencilOp, backend: str, bucket_w: int) -> str:
@@ -180,21 +191,86 @@ def _apply_global(op: GlobalOp, x: jnp.ndarray, th, tw) -> jnp.ndarray:
     return op.apply(x, op.stats(x, valid))
 
 
+def _apply_stencil_f32(
+    op: StencilOp, xf: jnp.ndarray, th, tw, backend: str = "xla"
+) -> jnp.ndarray:
+    _check_channels(op.name, op.in_channels, xf)
+    be = _stencil_backend(op, backend, xf.shape[1])
+    if xf.ndim == 3:
+        return jnp.stack(
+            [
+                _stencil_plane_f32(op, xf[..., c], th, tw, be)
+                for c in range(xf.shape[2])
+            ],
+            axis=-1,
+        )
+    return _stencil_plane_f32(op, xf, th, tw, be)
+
+
 def padded_apply(
-    pipe: Pipeline, x: jnp.ndarray, th, tw, backend: str = "xla"
+    pipe: Pipeline, x: jnp.ndarray, th, tw, backend: str = "xla", plan=None
 ) -> jnp.ndarray:
     """The pipeline over one bucket-shaped u8 image with dynamic true shape
-    (th, tw). Output is bucket-shaped; only [:th, :tw] is meaningful."""
-    for op in pipe.ops:
-        if isinstance(op, StencilOp):
-            x = _apply_stencil(op, x, th, tw, backend)
-        elif isinstance(op, GlobalOp):
-            x = _apply_global(op, x, th, tw)
-        elif isinstance(op, PointwiseOp):
-            x = op(x)
-        else:  # pragma: no cover - check_servable refuses these up front
-            raise UnservablePipeline(f"op {op.name!r} is not servable")
+    (th, tw). Output is bucket-shaped; only [:th, :tw] is meaningful.
+
+    With a built `plan` (plan.ir.Plan), fused stages keep the carried
+    image in f32 exact integers between member ops — pointwise runs ride
+    their neighbouring stencil's pass — and u8 materialises once per
+    stage. Border reconstruction stays PER OP either way: the dynamic
+    true border is realised by each op's gather maps, which is exactly
+    the per-op extension the bit-exactness induction (module docstring)
+    is proven over. `plan=None` is the per-op golden reference."""
+    if plan is None:
+        for op in pipe.ops:
+            if isinstance(op, StencilOp):
+                x = _apply_stencil(op, x, th, tw, backend)
+            elif isinstance(op, GlobalOp):
+                x = _apply_global(op, x, th, tw)
+            elif isinstance(op, PointwiseOp):
+                x = op(x)
+            else:  # pragma: no cover - check_servable refuses these up front
+                raise UnservablePipeline(f"op {op.name!r} is not servable")
+        return x
+    from mpi_cuda_imagemanipulation_tpu.ops.spec import exact_f32
+    from mpi_cuda_imagemanipulation_tpu.plan.exec import apply_pointwise_f32
+
+    for stage in plan.stages:
+        if stage.kind == "global":
+            x = _apply_global(stage.ops[0], x, th, tw)
+            continue
+        if stage.kind == "geometric":  # pragma: no cover - check_servable
+            raise UnservablePipeline(
+                f"op {stage.ops[0].name!r} is not servable"
+            )
+        xf = exact_f32(x)
+        for op in stage.ops:
+            if isinstance(op, StencilOp):
+                xf = _apply_stencil_f32(op, xf, th, tw, backend)
+            else:
+                xf = apply_pointwise_f32(op, xf)
+        x = xf.astype(jnp.uint8)
     return x
+
+
+def resolve_serving_plan(
+    pipe: Pipeline, plan: str, backend: str, bucket_w: int | None
+):
+    """The built fusion plan this (pipeline, plan knob, backend, bucket
+    width) serves with, or None for per-op execution. ONE resolution
+    point shared by make_serving_fn (which executes the plan) and
+    serve/cache.CompileCache (which keys executables by its fingerprint)
+    — the two can never disagree about which structure is live."""
+    from mpi_cuda_imagemanipulation_tpu.plan import (
+        build_plan,
+        resolve_plan_mode,
+    )
+
+    mode = resolve_plan_mode(
+        pipe.ops, plan, backend=backend, width=bucket_w
+    )
+    if mode == "off":
+        return None
+    return build_plan(pipe.ops, mode)
 
 
 def make_serving_fn(
@@ -207,6 +283,7 @@ def make_serving_fn(
     backend: str = "xla",
     mesh=None,
     on_trace: Callable[[], None] | None = None,
+    plan: str = "auto",
 ):
     """The jitted serving executable for one (bucket, channels, batch) cell:
 
@@ -226,7 +303,13 @@ def make_serving_fn(
     op.valid on the same gathered window array), or 'auto' (the shared
     calibration-gated MXU routing). The Pallas streaming kernels remain
     unservable by design: they extend edges at the *bucket* border, which
-    is exactly what padding must not do."""
+    is exactly what padding must not do.
+
+    `plan` (models.pipeline.PLAN_MODES) stages the executor through the
+    fusion planner: fused stages keep the f32 exact-integer carry between
+    member ops (see padded_apply), resolved ONCE here at the bucket's
+    width — the resolved structure is what serve/cache keys executables
+    by."""
     if backend not in ("xla", "mxu", "auto"):
         raise ValueError(
             f"serving computes with the XLA or MXU backends (got "
@@ -237,13 +320,14 @@ def make_serving_fn(
         raise ValueError(
             f"batch {batch} does not divide over the {mesh.devices.size}-device mesh"
         )
+    built_plan = resolve_serving_plan(pipe, plan, backend, bucket_w)
     del bucket_h, bucket_w, channels, batch  # keyed by the caller's shapes
 
     def batched(imgs, th, tw):
         if on_trace is not None:
             on_trace()  # python side effect => fires once per (re)trace
         return jax.vmap(
-            lambda i, h, w: padded_apply(pipe, i, h, w, backend)
+            lambda i, h, w: padded_apply(pipe, i, h, w, backend, built_plan)
         )(imgs, th, tw)
 
     if mesh is None:

@@ -90,6 +90,11 @@ class ServeConfig:
     channels: tuple[int, ...] = (1, 3)
     shards: int = 1
     backend: str = "xla"
+    # fusion-planner mode for the padded executors (models.pipeline
+    # PLAN_MODES); the compile cache keys executables by the RESOLVED
+    # plan's fingerprint so calibration flips rebuild instead of serving
+    # a stale structure (serve/cache.py)
+    plan: str = "auto"
     default_deadline_ms: float | None = None
     # -- async execution engine (engine/) ----------------------------------
     inflight: int = 2  # micro-batch dispatches kept outstanding
@@ -137,6 +142,7 @@ class ServeApp:
             channels=channels,
             backend=config.backend,
             mesh=mesh,
+            plan=config.plan,
         )
         self.health = HealthState()
         self.breakers = BreakerBoard(
@@ -146,7 +152,11 @@ class ServeApp:
         # degraded mode: the golden per-request path (bit-identical to the
         # padded executor by the serving contract; traces per novel shape,
         # which is acceptable for a fallback that only runs breaker-open)
-        self._fallback_jit = self.pipe.jit() if config.degrade_to_golden else None
+        # plan='off': the fallback IS the per-op golden reference — a
+        # calibration flip must never restructure the degraded path
+        self._fallback_jit = (
+            self.pipe.jit(plan="off") if config.degrade_to_golden else None
+        )
         self.scheduler = MicroBatchScheduler(
             self.cache,
             max_batch=config.max_batch,

@@ -102,6 +102,7 @@ def stream_pipeline(
     inflight: int = 2,
     io_threads: int = 2,
     impl: str = "xla",
+    plan: str = "auto",
     metrics: StreamMetrics | None = None,
     engine: Engine | None = None,
     journal=None,
@@ -139,8 +140,13 @@ def stream_pipeline(
             f"{fn_cache.global_h}x{fn_cache.global_w}/{fn_cache.impl}, "
             f"stream is {H}x{W}/{impl}"
         )
+    # `plan` stages the per-tile chain through the fusion planner
+    # (stream/tiles.TileFnCache): fused stages do one pass per stencil
+    # group inside each tile. Seam geometry is untouched (strips are
+    # already per-chain), and output stays bit-identical across modes,
+    # so the resume fingerprint deliberately excludes the plan.
     cache = fn_cache or TileFnCache(
-        tuple(ops), global_h=H, global_w=W, impl=impl
+        tuple(ops), global_h=H, global_w=W, impl=impl, plan=plan
     )
 
     own_engine = engine is None

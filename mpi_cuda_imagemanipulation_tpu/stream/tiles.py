@@ -31,13 +31,12 @@ from __future__ import annotations
 
 import math
 from dataclasses import dataclass
-from functools import partial
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from mpi_cuda_imagemanipulation_tpu.ops.spec import (
+    U8,
     GeometricOp,
     GlobalOp,
     Op,
@@ -45,7 +44,6 @@ from mpi_cuda_imagemanipulation_tpu.ops.spec import (
     StencilOp,
     chain_halo,
     exact_f32,
-    pad2d,
 )
 
 STREAM_IMPLS = ("auto", "xla", "mxu")
@@ -158,57 +156,12 @@ def plan_tiles(height: int, tile_rows: int, halo: int) -> list[TileSpec]:
 
 
 def _acc_fn(op: StencilOp, impl: str, width: int):
-    """The valid-region accumulator for one stencil under `impl`: the
-    golden VPU path, the forced MXU banded contraction, or — for 'auto'
-    — the calibration-gated routing decision, made ONCE at build time
-    (ops/mxu_kernels.use_mxu_for_stencil), never inside the trace."""
-    if impl == "xla":
-        return op.valid
-    from mpi_cuda_imagemanipulation_tpu.ops.mxu_kernels import (
-        mxu_eligible,
-        mxu_valid,
-        use_mxu_for_stencil,
-    )
+    """Per-stencil accumulator routing — graduated to the shared
+    plan-executor helper (plan/exec.stencil_acc_fn) so the stream, plan
+    and sharded fused paths make identical per-op backend decisions."""
+    from mpi_cuda_imagemanipulation_tpu.plan.exec import stencil_acc_fn
 
-    if impl == "mxu":
-        if mxu_eligible(op):
-            return partial(mxu_valid, op)
-        return op.valid
-    # auto: MXU only behind a measured calibration win on this device kind
-    mode = use_mxu_for_stencil(op, width)
-    if mode is not None:
-        return partial(mxu_valid, op, mode=mode)
-    return op.valid
-
-
-def _stencil_band(
-    op: StencilOp,
-    buf: jnp.ndarray,
-    acc_fn,
-    take_top: int,
-    take_bot: int,
-    y0,
-    global_h: int,
-    global_w: int,
-) -> jnp.ndarray:
-    """One stencil over a band: consume `take_*` real context rows, pad
-    the rest per the op's edge mode (asymmetric — the band's global-edge
-    sides only), finalize at global coordinates. Mirrors
-    parallel/api._stencil_on_ext with host tiles in place of shards."""
-    h = op.halo
-    pad_top, pad_bot = h - take_top, h - take_bot
-
-    def plane(x: jnp.ndarray) -> jnp.ndarray:
-        xpad = pad2d(exact_f32(x), op.edge_mode, pad_top, pad_bot, h, h)
-        acc = acc_fn(xpad)
-        orig = x[take_top : x.shape[0] - take_bot]
-        return op.finalize(acc, orig, y0, 0, global_h, global_w)
-
-    if buf.ndim == 3:
-        return jnp.stack(
-            [plane(buf[..., c]) for c in range(buf.shape[2])], axis=-1
-        )
-    return plane(buf)
+    return stencil_acc_fn(op, impl, width)
 
 
 def make_tile_fn(
@@ -219,15 +172,29 @@ def make_tile_fn(
     global_h: int,
     global_w: int,
     impl: str = "xla",
+    plan=None,
 ):
     """A jitted ``f(ext_u8, y_ext0) -> out_u8`` for tiles with this
     (lead, tail) context signature. ``ext`` covers global rows
     [y_ext0, y_ext0 + ext.rows); the result covers
     [y_ext0 + lead, y_ext0 + ext.rows - tail). One closure serves every
     band with the same signature — `y_ext0` is traced, so only the four
-    edge-position variants (and the short last band) ever retrace."""
+    edge-position variants (and the short last band) ever retrace.
+
+    `plan` (a built plan.ir.Plan, default per-op) stages the walk: each
+    fused stage runs as one pass via the shared stage walker
+    (plan/exec.walk_stage), with the context budget threaded ACROSS
+    stages so seam consumption is identical to the per-op walk — the
+    seam strips themselves are already per-chain (`chain_halo`), so the
+    plan changes in-tile structure, never tile geometry."""
     if impl not in STREAM_IMPLS:
         raise ValueError(f"unknown stream impl {impl!r}; known: {STREAM_IMPLS}")
+    if plan is None:
+        from mpi_cuda_imagemanipulation_tpu.plan import build_plan
+
+        plan = build_plan(ops, "off")
+    from mpi_cuda_imagemanipulation_tpu.plan.exec import walk_stage
+
     acc_fns = {
         id(op): _acc_fn(op, impl, global_w)
         for op in ops
@@ -237,22 +204,21 @@ def make_tile_fn(
     def run(ext: jnp.ndarray, y_ext0: jnp.ndarray) -> jnp.ndarray:
         cur = ext
         lead_rem, tail_rem = lead, tail
-        consumed_top = 0
-        for op in ops:
-            if isinstance(op, StencilOp) and op.halo > 0:
-                h = op.halo
-                take_top = h if lead_rem > 0 else 0
-                take_bot = h if tail_rem > 0 else 0
-                y0 = y_ext0 + (consumed_top + take_top)
-                cur = _stencil_band(
-                    op, cur, acc_fns[id(op)], take_top, take_bot,
-                    y0, global_h, global_w,
-                )
-                lead_rem -= take_top
-                tail_rem -= take_bot
-                consumed_top += take_top
-            else:
-                cur = op(cur)
+        y_lo = y_ext0
+        for stage in plan.stages:
+            # validate_stream_ops rejected geometric/global ops up front,
+            # so every stage is a fused pointwise/stencil run
+            f, y_lo, lead_rem, tail_rem = walk_stage(
+                stage.ops,
+                exact_f32(cur),
+                y_lo=y_lo,
+                lead_rem=lead_rem,
+                tail_rem=tail_rem,
+                global_h=global_h,
+                global_w=global_w,
+                acc_fns=acc_fns,
+            )
+            cur = f.astype(U8)
         return cur
 
     return jax.jit(run)
@@ -261,13 +227,28 @@ def make_tile_fn(
 class TileFnCache:
     """The per-run compile cache: one jitted closure per (lead, tail)
     signature (jit itself keys on the band shape). At most four entries
-    for any image height — the bounded-compile guarantee."""
+    for any image height — the bounded-compile guarantee. `plan` is the
+    fusion-planner knob (a PLAN_MODES string), resolved once here so
+    every band variant shares one stage structure."""
 
-    def __init__(self, ops, *, global_h, global_w, impl):
+    def __init__(self, ops, *, global_h, global_w, impl, plan="auto"):
+        from mpi_cuda_imagemanipulation_tpu.plan import (
+            build_plan,
+            resolve_plan_mode,
+        )
+
         self.ops = ops
         self.global_h = global_h
         self.global_w = global_w
         self.impl = impl
+        # the stream computes with XLA/MXU accumulators only (no Pallas),
+        # so resolution follows the pure-XLA convention at the stream's
+        # width; 'auto' therefore defaults to fused here
+        self.plan_mode = resolve_plan_mode(
+            ops, plan, backend="xla" if impl == "auto" else impl,
+            width=global_w,
+        )
+        self.plan = build_plan(ops, self.plan_mode)
         self._fns: dict[tuple[int, int], object] = {}
 
     def fn(self, spec: TileSpec):
@@ -281,5 +262,6 @@ class TileFnCache:
                 global_h=self.global_h,
                 global_w=self.global_w,
                 impl=self.impl,
+                plan=self.plan,
             )
         return f

@@ -92,6 +92,7 @@ def stream_video(
     inflight: int = 2,
     io_threads: int = 2,
     impl: str = "xla",
+    plan: str = "auto",
     out_ext: str = ".png",
     metrics: StreamMetrics | None = None,
     journal=None,
@@ -182,6 +183,7 @@ def stream_video(
                         global_h=tframe.shape[0],
                         global_w=tframe.shape[1],
                         impl=impl,
+                        plan=plan,
                     )
                 try:
                     stream_pipeline(

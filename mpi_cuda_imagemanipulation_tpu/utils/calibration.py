@@ -232,6 +232,79 @@ def record_backend_choice(
     return _write_store(data)
 
 
+# --------------------------------------------------------------------------
+# Plan-choice calibration (the fused-plan autotune dimension)
+#
+# `mcim-tpu autotune --dimension plan` measures the per-op ('off'),
+# pointwise-absorption and fully fused execution plans of a pipeline on
+# the live backend (all bit-identical — gated before timing) and records
+# the fastest, keyed by device kind and PIPELINE FINGERPRINT
+# (plan.ir.pipeline_fingerprint: op names + halos + families). The
+# `plan='auto'` resolution (plan/planner.resolve_plan_mode) consults this
+# table, so a recorded choice steers jit/batched/sharded/serving/stream
+# alike; the serving compile cache keys executables by the RESOLVED
+# plan's fingerprint, so flipping this entry can never serve a stale
+# executable built for the previous structure. Same width window rule as
+# the other dimensions.
+# --------------------------------------------------------------------------
+
+_PLAN_KEY = "plan_choice"
+PLAN_CHOICES = ("off", "pointwise", "fused")
+
+
+def lookup_plan_choice(
+    pipeline_fp: str | None,
+    device_kind: str | None = None,
+    width: int | None = None,
+) -> str | None:
+    """Calibrated plan build mode for (pipeline fingerprint, device kind),
+    if any. None when no (valid, width-compatible) entry exists or
+    MCIM_NO_CALIB is set — callers then keep their default resolution."""
+    if pipeline_fp is None or env_registry.get(_ENV_DISABLE):
+        return None
+    if device_kind is None:
+        try:
+            device_kind = current_device_kind()
+        except Exception:
+            return None
+    rec = entries().get(device_kind)
+    if not isinstance(rec, dict):
+        return None
+    table = rec.get(_PLAN_KEY)
+    if not isinstance(table, dict):
+        return None
+    ent = table.get(pipeline_fp)
+    if not isinstance(ent, dict):
+        return None
+    rec_w = ent.get("width")
+    if (
+        width is not None
+        and isinstance(rec_w, (int, float))
+        and rec_w > 0
+        and not (rec_w / 2 <= width <= rec_w * 2)
+    ):
+        return None
+    choice = ent.get("choice")
+    return choice if choice in PLAN_CHOICES else None
+
+
+def record_plan_choice(
+    device_kind: str, pipeline_fp: str, choice: str, **extra
+) -> str:
+    """Write/replace the (device kind, pipeline fingerprint) plan choice;
+    returns the store path. Same atomic-write contract as record_block_h."""
+    if choice not in PLAN_CHOICES:
+        raise ValueError(
+            f"unknown plan choice {choice!r}; known: {PLAN_CHOICES}"
+        )
+    data, kind_rec = _kind_record(device_kind)
+    table = kind_rec.setdefault(_PLAN_KEY, {})
+    if not isinstance(table, dict):  # legacy/corrupt entry: replace
+        table = kind_rec[_PLAN_KEY] = {}
+    table[pipeline_fp] = {"choice": choice, **extra}
+    return _write_store(data)
+
+
 def _kind_record(device_kind: str) -> tuple[dict, dict]:
     """(whole store, mutable per-device-kind record) — the caller mutates
     the record and hands the store back to _write_store."""

@@ -155,6 +155,23 @@ _VARS = (
     EnvVar("MCIM_STREAM_AB_JSON", None, "tests/test_stream.py",
            "CI: write the stream_ab lane record to this path (uploaded "
            "as an artifact)."),
+    # -- fusion planner (plan/) ----------------------------------------------
+    EnvVar("MCIM_PLAN", None, "plan/planner.py",
+           "Global fusion-plan mode override consulted when an entry "
+           "point is called with plan='auto': off / pointwise / fused "
+           "('on' = fused). Unset: 'auto' resolves through the "
+           "calibration store's plan-choice table, then the backend "
+           "default (plan/planner.resolve_plan_mode)."),
+    EnvVar("MCIM_PLAN_AB_OPS", None, "bench_suite.py",
+           "plan_ab lane: pipeline override (default the pointwise-heavy "
+           "grayscale,contrast,gaussian:5,quantize headline chain)."),
+    EnvVar("MCIM_PLAN_AB_HEIGHT", None, "bench_suite.py",
+           "plan_ab lane: image height override."),
+    EnvVar("MCIM_PLAN_AB_WIDTH", None, "bench_suite.py",
+           "plan_ab lane: image width override."),
+    EnvVar("MCIM_PLAN_AB_JSON", None, "tests/test_plan.py",
+           "CI: write the plan_ab lane record to this path (uploaded as "
+           "an artifact)."),
     # -- bench driver (bench.py, repo root) ----------------------------------
     EnvVar("MCIM_NO_HISTORY", None, "bench.py",
            "Any non-empty value: do not append promoted records to "

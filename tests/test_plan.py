@@ -1,0 +1,591 @@
+"""Fusion planner (plan/): IR, fusion rules, and the bit-exactness
+contract of every fused execution path.
+
+The planner's one promise: a plan NEVER changes output, only execution
+structure. So almost every test here is some variant of "fused ==
+op-by-op golden, bit for bit" — through the plain executor, jit,
+batched, sharded (serial + overlap, incl. the fallback gates), serving
+(dynamic true shapes + the plan-fingerprint compile-cache key) and the
+streaming tile engine — plus the structural assertions that the fusion
+actually happened (stage partition, halo conservation, modelled HBM
+passes, one ppermute pair per fused stage in the compiled HLO).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+try:  # hypothesis is an optional dev dependency (tests/test_properties.py)
+    from hypothesis import given, settings
+    from hypothesis import strategies as st
+
+    HAVE_HYPOTHESIS = True
+except ImportError:  # the seeded deterministic sweep below still runs
+    HAVE_HYPOTHESIS = False
+
+import jax.numpy as jnp
+
+from mpi_cuda_imagemanipulation_tpu.io.image import synthetic_image
+from mpi_cuda_imagemanipulation_tpu.models.pipeline import (
+    PLAN_MODES,
+    Pipeline,
+)
+from mpi_cuda_imagemanipulation_tpu.ops.registry import (
+    FAMILIES,
+    REGISTRY,
+    make_op,
+    make_pipeline_ops,
+    op_family,
+    registry_family_table,
+)
+from mpi_cuda_imagemanipulation_tpu.ops.spec import chain_halo
+from mpi_cuda_imagemanipulation_tpu.parallel.mesh import make_mesh
+from mpi_cuda_imagemanipulation_tpu.plan import (
+    Stage,
+    build_plan,
+    pipeline_fingerprint,
+    plan_metrics,
+    resolve_plan_mode,
+)
+from mpi_cuda_imagemanipulation_tpu.plan.exec import (
+    plan_callable,
+    run_unfused,
+    unfused_callables,
+)
+from mpi_cuda_imagemanipulation_tpu.resilience import failpoints
+from mpi_cuda_imagemanipulation_tpu.resilience.failpoints import (
+    FailpointError,
+)
+from mpi_cuda_imagemanipulation_tpu.utils import calibration
+
+MIXED = "grayscale,contrast:3.5,gaussian:5,quantize:6"
+
+
+def img_u8(h=64, w=96, c=3, seed=0):
+    return jnp.asarray(synthetic_image(h, w, channels=c, seed=seed))
+
+
+def golden(ops, img):
+    out = img
+    for op in ops:
+        out = op(out)
+    return np.asarray(out)
+
+
+@pytest.fixture
+def calib_file(tmp_path, monkeypatch):
+    path = tmp_path / "calib.json"
+    monkeypatch.setenv("MCIM_CALIB_FILE", str(path))
+    # earlier tests in a full-suite run can leave the lookup kill-switch
+    # or a global plan override behind — clear both, like
+    # tests/test_calibration.py's fixture does
+    monkeypatch.delenv("MCIM_NO_CALIB", raising=False)
+    monkeypatch.delenv("MCIM_PLAN", raising=False)
+    calibration._cache["key"] = None
+    yield path
+    calibration._cache["key"] = None
+
+
+# --------------------------------------------------------------------------
+# ops/registry family classification (satellite)
+# --------------------------------------------------------------------------
+
+
+def test_every_registered_op_classifies():
+    table = registry_family_table()
+    assert set(table) == set(REGISTRY)
+    assert set(table.values()) <= set(FAMILIES)
+    # the families the planner's rules key on are all represented
+    assert {"pointwise", "stencil", "geometric", "global-stat"} <= set(
+        table.values()
+    )
+
+
+def test_op_family_reads_the_class_attribute_not_isinstance():
+    assert op_family(make_op("invert")) == "pointwise"
+    assert op_family(make_op("gaussian:5")) == "stencil"
+    assert op_family(make_op("rot90")) == "geometric"
+    assert op_family(make_op("equalize")) == "global-stat"
+
+
+def test_op_family_rejects_unclassified():
+    class Mystery:
+        name = "mystery"
+
+    with pytest.raises(TypeError, match="declares no known family"):
+        op_family(Mystery())
+
+
+# --------------------------------------------------------------------------
+# IR + planner structure
+# --------------------------------------------------------------------------
+
+
+def test_off_is_one_stage_per_op():
+    ops = make_pipeline_ops(MIXED)
+    plan = build_plan(ops, "off")
+    assert len(plan.stages) == len(ops)
+    assert all(len(s.ops) == 1 for s in plan.stages)
+    assert plan.hbm_passes == plan.hbm_passes_unfused
+    assert plan.hbm_passes_saved == 0
+
+
+def test_fused_absorbs_the_whole_pointwise_stencil_run():
+    ops = make_pipeline_ops(MIXED)
+    plan = build_plan(ops, "fused")
+    assert len(plan.stages) == 1
+    assert plan.stages[0].names == tuple(op.name for op in ops)
+    assert plan.stages[0].halo == chain_halo(ops)
+    assert plan.hbm_passes == 1
+    assert plan.hbm_passes_saved == 3
+    assert plan.n_absorbed_ops == 3
+
+
+def test_pointwise_mode_splits_at_stencils():
+    ops = make_pipeline_ops("invert,gaussian:3,sharpen,quantize:6")
+    plan = build_plan(ops, "pointwise")
+    # [invert+gaussian3] [sharpen+quantize6]: one stencil per stage,
+    # trailing pointwise rides the last stage's write
+    assert [s.names for s in plan.stages] == [
+        ("invert", "gaussian3"), ("sharpen", "quantize6"),
+    ]
+    assert [s.halo for s in plan.stages] == [1, 1]
+    # fused merges the lot behind one grown halo
+    fused = build_plan(ops, "fused")
+    assert len(fused.stages) == 1
+    assert fused.stages[0].halo == 2
+
+
+def test_barriers_split_stages():
+    ops = make_pipeline_ops("invert,gaussian:3,rot90,sharpen,equalize,sobel")
+    plan = build_plan(ops, "fused")
+    assert [s.kind for s in plan.stages] == [
+        "fused", "geometric", "fused", "global", "fused",
+    ]
+    # barrier stages are singletons with no halo of their own
+    assert all(
+        len(s.ops) == 1 and s.halo == 0
+        for s in plan.stages
+        if s.kind != "fused"
+    )
+    # a global-stat op costs 2 modelled passes (stats + apply)
+    assert plan.hbm_passes_unfused == 5 + 2
+
+
+def test_stage_halos_sum_to_chain_halo_every_mode():
+    ops = make_pipeline_ops("invert,gaussian:5,box:3,sharpen,quantize:6")
+    for mode in ("off", "pointwise", "fused"):
+        plan = build_plan(ops, mode)
+        assert plan.total_halo == chain_halo(ops), mode
+
+
+def test_unknown_modes_rejected():
+    ops = make_pipeline_ops("invert")
+    with pytest.raises(ValueError, match="unknown build mode"):
+        build_plan(ops, "auto")  # resolve first; build modes only
+    with pytest.raises(ValueError, match="unknown build mode"):
+        build_plan(ops, "maximal")
+    with pytest.raises(ValueError, match="unknown plan mode"):
+        resolve_plan_mode(ops, "wat")
+    with pytest.raises(ValueError):
+        Stage("mystery", tuple(make_pipeline_ops("invert")), 0)
+
+
+def test_fingerprints_track_structure_not_just_ops():
+    ops = make_pipeline_ops(MIXED)
+    off, fused = build_plan(ops, "off"), build_plan(ops, "fused")
+    assert off.fingerprint != fused.fingerprint
+    assert build_plan(ops, "fused").fingerprint == fused.fingerprint
+    # the pipeline fingerprint keys on names + halos + families
+    assert pipeline_fingerprint(ops) == pipeline_fingerprint(list(ops))
+    assert pipeline_fingerprint(ops) != pipeline_fingerprint(
+        make_pipeline_ops("grayscale,contrast:3.5,gaussian:3,quantize:6")
+    )
+
+
+def test_describe_mentions_every_stage():
+    plan = build_plan(make_pipeline_ops(MIXED), "fused")
+    text = plan.describe()
+    assert "4 ops -> 1 stages" in text
+    assert "grayscale+contrast3.5+gaussian5+quantize6" in text
+
+
+# --------------------------------------------------------------------------
+# resolution (the 'auto' knob)
+# --------------------------------------------------------------------------
+
+
+def test_resolution_defaults(calib_file):
+    ops = make_pipeline_ops(MIXED)
+    assert resolve_plan_mode(ops, "off") == "off"
+    assert resolve_plan_mode(ops, "on") == "fused"  # alias
+    assert resolve_plan_mode(ops, "fused", backend="xla") == "fused"
+    # pure-XLA/MXU backends default auto to fused; impl=auto keeps its
+    # measured Pallas routing; self-fusing kernels never restructure
+    assert resolve_plan_mode(ops, "auto", backend="xla") == "fused"
+    assert resolve_plan_mode(ops, "auto", backend="mxu") == "fused"
+    assert resolve_plan_mode(ops, "auto", backend="auto") == "off"
+    assert resolve_plan_mode(ops, "auto", backend="pallas") == "off"
+    assert resolve_plan_mode(ops, "fused", backend="swar") == "off"
+
+
+def test_env_override_and_calibration_routing(calib_file, monkeypatch):
+    ops = make_pipeline_ops(MIXED)
+    monkeypatch.setenv("MCIM_PLAN", "pointwise")
+    assert resolve_plan_mode(ops, "auto", backend="xla") == "pointwise"
+    monkeypatch.delenv("MCIM_PLAN")
+    fp = pipeline_fingerprint(ops)
+    kind = calibration.current_device_kind()
+    calibration.record_plan_choice(kind, fp, "pointwise", width=512)
+    calibration._cache["key"] = None
+    assert (
+        resolve_plan_mode(ops, "auto", backend="xla", width=512)
+        == "pointwise"
+    )
+    # the width window rule: a far-off width ignores the record
+    assert resolve_plan_mode(ops, "auto", backend="xla", width=64) == "fused"
+    # an explicitly calibrated choice steers impl=auto too
+    assert (
+        resolve_plan_mode(ops, "auto", backend="auto", width=512)
+        == "pointwise"
+    )
+    with pytest.raises(ValueError, match="unknown plan choice"):
+        calibration.record_plan_choice(kind, fp, "maximal")
+
+
+# --------------------------------------------------------------------------
+# bit-exactness: full-image executors
+# --------------------------------------------------------------------------
+
+
+def test_plan_callable_matches_golden_all_modes():
+    ops = make_pipeline_ops(MIXED)
+    img = img_u8(61, 83, 3, seed=1)  # odd shape: exercise the borders
+    ref = golden(ops, img)
+    for mode in ("off", "pointwise", "fused"):
+        got = np.asarray(plan_callable(build_plan(ops, mode))(img))
+        assert np.array_equal(got, ref), mode
+
+
+def test_jit_and_batched_and_dp_match_golden():
+    pipe = Pipeline.parse(MIXED)
+    img = img_u8(48, 64, 3, seed=2)
+    ref = golden(pipe.ops, img)
+    for mode in ("off", "fused"):
+        assert np.array_equal(np.asarray(pipe.jit(plan=mode)(img)), ref)
+    stack = jnp.stack([img, img_u8(48, 64, 3, seed=3)])
+    ref_b = np.stack([ref, golden(pipe.ops, stack[1])])
+    got = np.asarray(pipe.batched(plan="fused")(stack))
+    assert np.array_equal(got, ref_b)
+    got = np.asarray(pipe.data_parallel(make_mesh(2), plan="fused")(stack))
+    assert np.array_equal(got, ref_b)
+
+
+def test_mixed_chain_with_barriers_matches_golden():
+    ops = make_pipeline_ops(
+        "grayscale,gaussian:3,equalize,sharpen,rot90,sobel,quantize:6"
+    )
+    img = img_u8(57, 45, 3, seed=4)
+    ref = golden(ops, img)
+    for mode in ("pointwise", "fused"):
+        got = np.asarray(plan_callable(build_plan(ops, mode))(img))
+        assert np.array_equal(got, ref), mode
+
+
+def test_single_channel_and_fn_only_ops_match_golden():
+    # gray2rgb is fn-only (u8 round trip inside the f32 carry walk)
+    ops = make_pipeline_ops("median:3,gray2rgb,sepia,gaussian:3")
+    img = img_u8(40, 52, 1, seed=5)
+    ref = golden(ops, img)
+    got = np.asarray(plan_callable(build_plan(ops, "fused"))(img))
+    assert np.array_equal(got, ref)
+
+
+# deterministic random-chain sweep (runs with or without hypothesis);
+# the pool spans edge modes (reflect/replicate/zero/interior guards) and
+# channel-agnostic families so any sampled chain is well-formed
+_POOL = (
+    "invert", "brightness:30", "contrast:2.0", "quantize:5", "solarize:99",
+    "gaussian:3", "gaussian:5", "box:3", "sharpen", "sobel", "prewitt",
+    "laplacian", "emboss:3", "median:3", "erode", "dilate",
+)
+
+
+def _chain_case(seed: int):
+    rng = np.random.default_rng(seed)
+    names = [str(rng.choice(_POOL)) for _ in range(int(rng.integers(2, 7)))]
+    ops = make_pipeline_ops(",".join(names))
+    h = int(rng.integers(24, 80))
+    w = int(rng.integers(24, 96))
+    img = img_u8(h, w, 1, seed=seed)
+    return ops, img
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_random_chain_fused_is_bit_identical(seed):
+    ops, img = _chain_case(seed)
+    ref = golden(ops, img)
+    for mode in ("pointwise", "fused"):
+        plan = build_plan(ops, mode)
+        assert plan.total_halo == chain_halo(ops)
+        got = np.asarray(plan_callable(plan)(img))
+        assert np.array_equal(got, ref), (
+            mode, [op.name for op in ops], img.shape,
+        )
+
+
+if HAVE_HYPOTHESIS:
+
+    @settings(max_examples=25, deadline=None)
+    @given(
+        names=st.lists(st.sampled_from(_POOL), min_size=1, max_size=6),
+        h=st.integers(min_value=20, max_value=96),
+        w=st.integers(min_value=20, max_value=96),
+        seed=st.integers(min_value=0, max_value=2**31 - 1),
+    )
+    def test_property_fused_plan_bit_identical(names, h, w, seed):
+        ops = make_pipeline_ops(",".join(names))
+        img = img_u8(h, w, 1, seed=seed)
+        ref = golden(ops, img)
+        for mode in ("pointwise", "fused"):
+            plan = build_plan(ops, mode)
+            assert plan.total_halo == chain_halo(ops)
+            assert tuple(o.name for o in plan.ops) == tuple(
+                o.name for o in ops
+            )
+            got = np.asarray(plan_callable(plan)(img))
+            assert np.array_equal(got, ref)
+
+
+# --------------------------------------------------------------------------
+# sharded: temporal blocking over the wire
+# --------------------------------------------------------------------------
+
+
+def test_sharded_fused_matches_golden():
+    pipe = Pipeline.parse(MIXED)
+    mesh = make_mesh(4)
+    img = img_u8(128, 96, 3, seed=6)
+    ref = golden(pipe.ops, img)
+    for mode in ("off", "pointwise", "fused"):
+        got = np.asarray(pipe.sharded(mesh, plan=mode)(img))
+        assert np.array_equal(got, ref), mode
+
+
+def test_sharded_hlo_one_ppermute_pair_per_fused_stage():
+    """The PR-1-style structural assertion: the compiled fused chain
+    exchanges ONE ghost-strip ppermute pair per halo-carrying fused
+    stage — not one per stencil op."""
+    mesh = make_mesh(4)
+    img = img_u8(128, 96, 3, seed=7)
+    cases = (
+        # (chain, halo-carrying fused stages, stencil count)
+        (MIXED, 1, 1),
+        ("gaussian:3,sharpen,grayscale,sobel", 1, 3),
+        ("invert,gaussian:3,rot90,sharpen,sobel,quantize:6", 2, 3),
+    )
+    for chain, n_stages, n_stencils in cases:
+        pipe = Pipeline.parse(chain)
+        fused_txt = pipe.sharded(mesh, plan="fused").lower(img).as_text()
+        off_txt = pipe.sharded(mesh, plan="off").lower(img).as_text()
+        assert fused_txt.count("collective_permute") == 2 * n_stages, chain
+        assert off_txt.count("collective_permute") == 2 * n_stencils, chain
+
+
+def test_sharded_overlap_with_explicit_plan_matches_golden():
+    pipe = Pipeline.parse("invert,gaussian:5,sharpen,quantize:6")
+    mesh = make_mesh(4)
+    img = img_u8(160, 64, 3, seed=8)
+    ref = golden(pipe.ops, img)
+    got = np.asarray(
+        pipe.sharded(mesh, halo_mode="overlap", plan="fused")(img)
+    )
+    assert np.array_equal(got, ref)
+    # auto under overlap keeps PR 1's measured per-group structure
+    got = np.asarray(
+        pipe.sharded(mesh, halo_mode="overlap", plan="auto")(img)
+    )
+    assert np.array_equal(got, ref)
+
+
+def test_sharded_fallback_gates_stay_bit_exact():
+    mesh = make_mesh(4)
+    pipe = Pipeline.parse(MIXED)
+    # pad rows inside the tile (130 % 4 != 0): fused stage falls back to
+    # the per-op materialised-ext path inside the same region
+    img = img_u8(130, 48, 3, seed=9)
+    ref = golden(pipe.ops, img)
+    got = np.asarray(pipe.sharded(mesh, plan="fused")(img))
+    assert np.array_equal(got, ref)
+    # stage halo outgrows the tile (2 stencils x halo 2 = 4 > 24/8 = 3
+    # rows/shard): per-op execution still fits and must take over
+    mesh8 = make_mesh(8)
+    pipe2 = Pipeline.parse("gaussian:5,gaussian:5")
+    img2 = img_u8(24, 40, 3, seed=10)
+    got2 = np.asarray(pipe2.sharded(mesh8, plan="fused")(img2))
+    assert np.array_equal(got2, golden(pipe2.ops, img2))
+
+
+# --------------------------------------------------------------------------
+# serving: staged padded executor + plan-fingerprint cache key
+# --------------------------------------------------------------------------
+
+
+def test_serving_fused_bit_exact_at_dynamic_true_shapes():
+    pipe = Pipeline.parse(MIXED)
+    imgs = np.zeros((3, 40, 48, 3), dtype=np.uint8)
+    th = np.array([40, 33, 17], dtype=np.int32)
+    tw = np.array([48, 29, 48], dtype=np.int32)
+    for i in range(3):
+        imgs[i, : th[i], : tw[i]] = synthetic_image(
+            int(th[i]), int(tw[i]), channels=3, seed=20 + i
+        )
+    fn_off = pipe.serving(40, 48, 3, 3, plan="off")
+    fn_fused = pipe.serving(40, 48, 3, 3, plan="fused")
+    a, b = np.asarray(fn_off(imgs, th, tw)), np.asarray(fn_fused(imgs, th, tw))
+    for i in range(3):
+        assert np.array_equal(
+            a[i, : th[i], : tw[i]], b[i, : th[i], : tw[i]]
+        ), i
+        ref = golden(
+            pipe.ops, jnp.asarray(imgs[i, : th[i], : tw[i]])
+        )
+        assert np.array_equal(b[i, : th[i], : tw[i]], ref), i
+
+
+def test_compile_cache_keys_by_plan_fingerprint(calib_file):
+    """A calibration flip mid-flight must MISS and rebuild — never serve
+    the executable compiled for the previous plan structure."""
+    from mpi_cuda_imagemanipulation_tpu.serve.cache import CompileCache
+
+    pipe = Pipeline.parse(MIXED)
+    cache = CompileCache(
+        pipe, buckets=((32, 32),), batch_buckets=(2,), channels=(3,),
+        backend="xla", plan="auto",
+    )
+    cache.warmup()
+    fp_before = cache.plan_fingerprint(32)
+    assert fp_before != "off"  # auto on xla defaults to fused
+    fn1 = cache.get(32, 32, 3, 2)
+    assert cache.stats()["hits"] == 1 and cache.stats()["misses"] == 0
+    # flip the calibrated choice to per-op execution
+    calibration.record_plan_choice(
+        calibration.current_device_kind(),
+        pipeline_fingerprint(pipe.ops), "off", width=32,
+    )
+    calibration._cache["key"] = None
+    assert cache.plan_fingerprint(32) == "off"
+    fn2 = cache.get(32, 32, 3, 2)
+    assert cache.stats()["misses"] == 1  # rebuilt, not served stale
+    assert fn2 is not fn1
+    # both structures serve identical bytes
+    imgs = np.zeros((2, 32, 32, 3), dtype=np.uint8)
+    imgs[0, :30, :31] = synthetic_image(30, 31, channels=3, seed=30)
+    th = np.array([30, 32], dtype=np.int32)
+    tw = np.array([31, 32], dtype=np.int32)
+    assert np.array_equal(
+        np.asarray(fn1(imgs, th, tw)), np.asarray(fn2(imgs, th, tw))
+    )
+    # the flipped-away entry is still warm under its own fingerprint:
+    # flipping BACK must hit, not recompile
+    calibration.record_plan_choice(
+        calibration.current_device_kind(),
+        pipeline_fingerprint(pipe.ops), "fused", width=32,
+    )
+    calibration._cache["key"] = None
+    assert cache.plan_fingerprint(32) == fp_before
+    assert cache.get(32, 32, 3, 2) is fn1
+    assert cache.stats()["misses"] == 1
+
+
+# --------------------------------------------------------------------------
+# stream: per-stage seam walk
+# --------------------------------------------------------------------------
+
+
+def test_stream_tile_cache_plans_stay_bit_exact():
+    from mpi_cuda_imagemanipulation_tpu.io.stream_codec import (
+        ArrayTileReader,
+        ArrayTileWriter,
+    )
+    from mpi_cuda_imagemanipulation_tpu.stream import stream_pipeline
+
+    ops = make_pipeline_ops("invert,gaussian:5,sharpen,quantize:6")
+    frame = synthetic_image(240, 64, channels=3, seed=40)
+    ref = golden(ops, jnp.asarray(frame))
+    for mode in ("off", "fused"):
+        writer = ArrayTileWriter(240, 64, 3)
+        stream_pipeline(
+            ArrayTileReader(frame), writer, ops, tile_rows=48, plan=mode
+        )
+        assert np.array_equal(writer.array, ref), mode
+
+
+# --------------------------------------------------------------------------
+# failpoint, metrics, exposition
+# --------------------------------------------------------------------------
+
+
+def test_plan_fuse_failpoint_fails_fused_builds_only():
+    ops = make_pipeline_ops(MIXED)
+    failpoints.configure("plan.fuse=1.0")
+    try:
+        with pytest.raises(FailpointError):
+            build_plan(ops, "fused")
+        with pytest.raises(FailpointError):
+            build_plan(ops, "pointwise")
+        # the golden per-op reference must stay reachable under the fault
+        plan = build_plan(ops, "off")
+        assert len(plan.stages) == len(ops)
+    finally:
+        failpoints.clear()
+
+
+def test_plan_metrics_count_builds_and_savings():
+    snap0 = plan_metrics.snapshot()
+    build_plan(make_pipeline_ops(MIXED), "fused")
+    snap1 = plan_metrics.snapshot()
+    assert snap1["builds_fused"] == snap0["builds_fused"] + 1
+    assert snap1["hbm_passes_saved"] == snap0["hbm_passes_saved"] + 3
+    assert snap1["fused_ops"] == snap0["fused_ops"] + 3
+    from mpi_cuda_imagemanipulation_tpu.obs.metrics import parse_exposition
+
+    fams = parse_exposition(plan_metrics.registry.render())
+    assert "mcim_plan_builds_total" in fams
+    assert "mcim_plan_hbm_passes_saved_total" in fams
+
+
+def test_plan_modes_surface():
+    assert PLAN_MODES == ("auto", "off", "pointwise", "fused")
+
+
+# --------------------------------------------------------------------------
+# plan_ab lane — the acceptance record
+# --------------------------------------------------------------------------
+
+
+def test_plan_ab_lane_gates_and_saves(monkeypatch):
+    from mpi_cuda_imagemanipulation_tpu.bench_suite import run_plan_ab
+
+    monkeypatch.setenv("MCIM_PLAN_AB_HEIGHT", "256")
+    monkeypatch.setenv("MCIM_PLAN_AB_WIDTH", "384")
+    json_path = os.environ.get("MCIM_PLAN_AB_JSON")  # CI failure artifact
+    rec = run_plan_ab(printer=lambda s: None, json_path=json_path)
+    assert rec["bit_exact_gate"].startswith("passed")
+    assert rec["hbm_passes_saved_model"] == 3
+    for lane in ("off", "per_op", "pointwise", "fused"):
+        assert "ms_per_iter" in rec["lanes"][lane], rec["lanes"][lane]
+    assert rec["lanes"]["fused"]["stages"] == 1
+    assert rec["lanes"]["off"]["stages"] == 4
+    assert rec["speedup_fused_vs_off"] is not None
+    assert rec["fused_stage_breakdown"][0]["halo"] == 2
+
+
+def test_unfused_callables_chain_matches_golden():
+    ops = make_pipeline_ops(MIXED)
+    img = img_u8(33, 47, 3, seed=50)
+    fns = unfused_callables(ops)
+    assert np.array_equal(
+        np.asarray(run_unfused(fns, img)), golden(ops, img)
+    )

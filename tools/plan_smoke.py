@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""CI plan smoke (tier1.yml): the fusion planner acceptance, end to end.
+
+One process proves, on a mixed chain that exercises every stage kind
+(pointwise runs, consecutive stencils, a global-stat barrier, a
+geometric barrier):
+
+  1. **bit-exactness** — the fused and pointwise-absorbed plans produce
+     output identical to the per-op golden chain (`--plan off`), through
+     the plain executor, jit, AND the row-sharded path over fake XLA
+     host devices;
+  2. **structure** — the fused plan's stage halos sum to
+     `chain_halo(ops)`, the modelled HBM-pass counter drops vs per-op
+     execution (mcim_plan_hbm_passes_saved_total > 0), and the compiled
+     sharded fused chain contains exactly ONE ppermute pair per
+     halo-carrying fused stage (not one per stencil) — temporal
+     blocking over the wire, in the HLO;
+  3. **observability** — the mcim_plan_* families render as parseable
+     Prometheus exposition with the build counters populated;
+  4. **the lane** — the plan_ab bench lane runs (its own pre-timing
+     bit-exactness gate must pass) and its record lands at argv[1]
+     (uploaded as a CI artifact). The speedup itself is asserted by the
+     committed BENCH_HISTORY record, not here — shared CI runners are
+     too noisy to gate on a ratio.
+
+Usage: python tools/plan_smoke.py /tmp/plan_ab.json
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=4")
+
+import numpy as np  # noqa: E402
+
+# pointwise prefix -> stencil -> global-stat barrier -> stencil pair ->
+# geometric barrier -> stencil -> pointwise tail: every stage kind and
+# every fusion rule fires
+OPS = "grayscale,contrast:3.5,gaussian:5,equalize,sharpen,sobel,rot180,emboss:3,quantize:6"
+H, W, C = 160, 96, 3
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from mpi_cuda_imagemanipulation_tpu.io.image import synthetic_image
+    from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline
+    from mpi_cuda_imagemanipulation_tpu.obs.metrics import parse_exposition
+    from mpi_cuda_imagemanipulation_tpu.ops.spec import chain_halo
+    from mpi_cuda_imagemanipulation_tpu.parallel.mesh import make_mesh
+    from mpi_cuda_imagemanipulation_tpu.plan import build_plan, plan_metrics
+    from mpi_cuda_imagemanipulation_tpu.plan.exec import plan_callable
+
+    pipe = Pipeline.parse(OPS)
+    img = jnp.asarray(synthetic_image(H, W, channels=C, seed=11))
+    golden = np.asarray(pipe.apply(img))
+
+    # -- 1. bit-exactness across modes and entry points --------------------
+    saved0 = plan_metrics.passes_saved.value()
+    plans = {m: build_plan(pipe.ops, m) for m in ("off", "pointwise", "fused")}
+    for mode, plan in plans.items():
+        got = np.asarray(plan_callable(plan)(img))
+        assert np.array_equal(got, golden), f"plan {mode} != golden"
+        got = np.asarray(pipe.jit(plan=mode)(img))
+        assert np.array_equal(got, golden), f"jit plan {mode} != golden"
+    print(f"bit-exact: off/pointwise/fused == golden at {H}x{W}x{C}")
+
+    # -- 2. structure: halo conservation, pass savings, HLO ppermutes ------
+    assert plans["fused"].total_halo == chain_halo(pipe.ops), (
+        plans["fused"].total_halo, chain_halo(pipe.ops)
+    )
+    assert plans["fused"].hbm_passes < plans["off"].hbm_passes, (
+        "fusion saved no modelled HBM passes"
+    )
+    assert plan_metrics.passes_saved.value() > saved0, (
+        "mcim_plan_hbm_passes_saved_total did not advance"
+    )
+    mesh = make_mesh(4)
+    # the sharded chain splits at the geometric barrier into two
+    # shard_map segments; count ppermutes per compiled plan mode
+    counts = {}
+    for mode in ("off", "fused"):
+        fn = pipe.sharded(mesh, plan=mode)
+        assert np.array_equal(np.asarray(fn(img)), golden), (
+            f"sharded plan {mode} != golden"
+        )
+        counts[mode] = fn.lower(img).as_text().count("collective_permute")
+    # fused: one ppermute PAIR per halo-carrying fused stage. The chain
+    # fuses to [gray+contrast+gaussian][equalize][sharpen+sobel] then,
+    # post-rot180, [emboss+quantize] -> 3 halo-carrying stages = 3 pairs.
+    # off: one pair per stencil (gaussian/sharpen/sobel/emboss) = 4 pairs.
+    n_stages = sum(
+        1 for s in plans["fused"].stages if s.kind == "fused" and s.halo > 0
+    )
+    assert counts["fused"] == 2 * n_stages, (counts, n_stages)
+    n_stencils = sum(1 for op in pipe.ops if getattr(op, "halo", 0) > 0)
+    assert counts["off"] == 2 * n_stencils, (counts, n_stencils)
+    assert counts["fused"] < counts["off"]
+    print(
+        f"HLO: {counts['off']} ppermutes per-op -> {counts['fused']} fused "
+        f"({n_stages} halo-carrying stages)"
+    )
+
+    # -- 3. exposition ------------------------------------------------------
+    text = plan_metrics.registry.render()
+    fams = parse_exposition(text)
+    for fam in (
+        "mcim_plan_builds_total",
+        "mcim_plan_stages_total",
+        "mcim_plan_fused_ops_total",
+        "mcim_plan_hbm_passes_saved_total",
+    ):
+        assert fam in fams, f"missing metric family {fam}"
+    snap = plan_metrics.snapshot()
+    assert snap["builds_fused"] >= 1 and snap["hbm_passes_saved"] > 0, snap
+    print(f"exposition: {len(fams)} families parse; snapshot {snap}")
+
+    # -- 4. the plan_ab lane (record -> CI artifact) ------------------------
+    out = sys.argv[1] if len(sys.argv) > 1 else None
+    # CI-sized shape: the lane's own gate still runs at full strength
+    os.environ.setdefault("MCIM_PLAN_AB_HEIGHT", "384")
+    os.environ.setdefault("MCIM_PLAN_AB_WIDTH", "512")
+    from mpi_cuda_imagemanipulation_tpu.bench_suite import run_plan_ab
+
+    rec = run_plan_ab(json_path=out, printer=lambda s: None)
+    assert rec["bit_exact_gate"].startswith("passed"), rec["bit_exact_gate"]
+    assert rec["hbm_passes_saved_model"] > 0
+    print(
+        f"plan_ab: fused {rec['speedup_fused_vs_off'] or 0:.2f}x vs off "
+        f"({rec['hbm_passes_saved_model']} modelled passes saved)"
+        + (f" -> {out}" if out else "")
+    )
+    print("plan smoke: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

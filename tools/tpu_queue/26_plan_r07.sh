@@ -1,0 +1,46 @@
+#!/bin/bash
+# Fusion-planner lane (round 7): the plan_ab bench lane on real hardware
+# — the SAME pointwise-heavy chain four ways (bit-exactness gated before
+# any timing): `--plan off` (per-op golden, one jit), per-op DISPATCHES
+# (the reference's sequential launches), pointwise absorption, and full
+# temporal blocking. Headline columns: ms/iter + MP/s/chip per lane, the
+# fused speedup vs --plan off, and the per-stage breakdown of the fused
+# plan — the measured side of the modelled hbm_passes_saved. On TPU the
+# HBM round trips the planner removes are the real cost (the CPU smoke
+# only proves structure), so this record is what decides whether 'auto'
+# should default further than the calibration table already steers it.
+# Then the plan autotune dimension records the measured winner per
+# (device kind, pipeline fingerprint) so every `--plan auto` entry point
+# (jit/batched/sharded/serving/stream) routes through it, and a sharded
+# A/B shows the one-ppermute-pair-per-stage effect end to end.
+# Knobs: MCIM_PLAN_AB_OPS / _HEIGHT / _WIDTH.
+# Budget: ~3-5 min.
+set -u
+cd "$(dirname "$0")/../.."
+. tools/tpu_queue/_lib.sh
+out=artifacts/plan_ab_r07.out
+: > "$out"
+timeout 1200 python -m mpi_cuda_imagemanipulation_tpu.bench_suite \
+  --config plan_ab >> "$out" 2>&1
+timeout 1200 python -m mpi_cuda_imagemanipulation_tpu.cli autotune \
+  --dimension plan --ops grayscale,contrast:3.5,gaussian:5,quantize:6 \
+  --height 4320 --width 7680 \
+  --json-metrics artifacts/plan_autotune_r07.json >> "$out" 2>&1
+# sharded structure A/B: per-op ghost exchange vs one ppermute pair per
+# fused stage, all visible devices (bit-identical output either way)
+python - <<'EOF'
+from mpi_cuda_imagemanipulation_tpu.io.image import save_image, synthetic_image
+save_image("artifacts/_plan_8k.ppm", synthetic_image(4320, 7680, channels=3, seed=7))
+EOF
+for plan in off fused; do
+  timeout 1200 python -m mpi_cuda_imagemanipulation_tpu.cli run \
+    --input artifacts/_plan_8k.ppm --output artifacts/_plan_8k_out.ppm \
+    --ops grayscale,contrast:3.5,gaussian:5,quantize:6 --impl xla \
+    --shards 4 --plan "$plan" --show-timing \
+    --json-metrics "artifacts/plan_sharded_${plan}_r07.json" \
+    >> "$out" 2>&1 || true
+done
+rm -f artifacts/_plan_8k.ppm artifacts/_plan_8k_out.ppm
+commit_artifacts "TPU window: fusion-planner A/B + plan autotune (round 7)" \
+  "$out" artifacts/plan_autotune_r07.json artifacts/plan_sharded_off_r07.json artifacts/plan_sharded_fused_r07.json
+exit 0
