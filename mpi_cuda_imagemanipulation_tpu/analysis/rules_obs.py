@@ -63,10 +63,27 @@ rule(
     "obs-failpoint-unused", "obs",
     "A KNOWN_SITES entry is never exercised by any maybe_fail() call.",
 )
+rule(
+    "obs-exemplar-missing", "obs",
+    "A *_seconds histogram in serve/ or fabric/ is observed without ever "
+    "attaching an exemplar trace id — its p99 in the exposition would be "
+    "an anonymous count instead of linking to a trace.",
+)
+rule(
+    "obs-recorder-trigger-unknown", "obs",
+    "recorder.dump() names a trigger missing from KNOWN_TRIGGERS in "
+    "obs/recorder.py (the typo'd trigger would raise at dump time — on "
+    "a failure path).",
+)
+rule(
+    "obs-recorder-trigger-unused", "obs",
+    "A KNOWN_TRIGGERS entry has no recorder.dump() caller anywhere — a "
+    "post-mortem trigger no failure path can reach.",
+)
 
 _METRIC_RE = re.compile(
     r"^mcim_(serve|engine|cache|breaker|health|batch|analysis|fabric|stream"
-    r"|plan)_[a-z0-9_]+$"
+    r"|plan|fleet|slo)_[a-z0-9_]+$"
 )
 
 
@@ -85,6 +102,8 @@ def check_obs(repo: Repo):
     findings.extend(_check_spans(repo))
     findings.extend(_check_metrics(repo))
     findings.extend(_check_failpoints(repo))
+    findings.extend(_check_exemplars(repo))
+    findings.extend(_check_recorder_triggers(repo))
     return findings
 
 
@@ -306,6 +325,146 @@ def _check_metrics(repo: Repo) -> list:
                     + ", ".join(f"{ff}:{ll}({kk})" for kk, ff, ll in regs),
                 )
             )
+    return findings
+
+
+# -- exemplar contract (serve/ + fabric/ latency histograms) ------------------
+
+
+def _check_exemplars(repo: Repo) -> list:
+    """Every `*_seconds` histogram registered in serve/ or fabric/ must
+    have at least one `.observe(..., exemplar=...)` call on the same
+    attribute in the same file — the latency exposition's trace-id link
+    is a contract, not a nicety."""
+    findings = []
+    prefixes = (f"{PACKAGE}/serve/", f"{PACKAGE}/fabric/")
+    for sf in repo.package_files():
+        if not sf.rel.startswith(prefixes):
+            continue
+        # attr name -> (metric name, line) for *_seconds histogram regs
+        regs: dict[str, tuple[str, int]] = {}
+        # attr name -> True if ANY observe carries exemplar=
+        observed: dict[str, bool] = {}
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                fn = node.value.func
+                if (
+                    isinstance(fn, ast.Attribute)
+                    and fn.attr == "histogram"
+                    and node.value.args
+                    and isinstance(node.value.args[0], ast.Constant)
+                    and isinstance(node.value.args[0].value, str)
+                    and node.value.args[0].value.endswith("_seconds")
+                ):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Attribute):
+                            regs[tgt.attr] = (
+                                node.value.args[0].value, node.lineno
+                            )
+                        elif isinstance(tgt, ast.Name):
+                            regs[tgt.id] = (
+                                node.value.args[0].value, node.lineno
+                            )
+            elif isinstance(node, ast.Call):
+                fn = node.func
+                if not (
+                    isinstance(fn, ast.Attribute) and fn.attr == "observe"
+                ):
+                    continue
+                recv = None
+                if isinstance(fn.value, ast.Attribute):
+                    recv = fn.value.attr
+                elif isinstance(fn.value, ast.Name):
+                    recv = fn.value.id
+                if recv is None:
+                    continue
+                has_ex = any(k.arg == "exemplar" for k in node.keywords)
+                observed[recv] = observed.get(recv, False) or has_ex
+        for attr, (metric, line) in regs.items():
+            if attr in observed and not observed[attr]:
+                findings.append(
+                    make_finding(
+                        "obs-exemplar-missing", sf.rel, line,
+                        f"histogram {metric!r} (self.{attr}) is observed "
+                        "in this file but no observe() call attaches an "
+                        "exemplar trace id",
+                    )
+                )
+    return findings
+
+
+# -- flight-recorder trigger registry -----------------------------------------
+
+
+def _known_triggers(repo: Repo) -> tuple[set[str], int]:
+    sf = repo.by_rel.get(f"{PACKAGE}/obs/recorder.py")
+    if sf is None:
+        return set(), 0
+    for node in sf.tree.body:
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == "KNOWN_TRIGGERS":
+                    vals = {
+                        e.value
+                        for e in ast.walk(node.value)
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)
+                    }
+                    return vals, node.lineno
+    return set(), 0
+
+
+def _is_recorder_dump(node: ast.Call, aliases: dict[str, str]) -> bool:
+    fn = node.func
+    if isinstance(fn, ast.Attribute) and fn.attr == "dump":
+        if isinstance(fn.value, ast.Name):
+            base = aliases.get(fn.value.id, fn.value.id)
+            return "recorder" in base or "recorder" in fn.value.id
+        return False
+    if isinstance(fn, ast.Name) and fn.id == "dump":
+        return "recorder" in aliases.get("dump", "")
+    return False
+
+
+def _check_recorder_triggers(repo: Repo) -> list:
+    findings = []
+    known, reg_line = _known_triggers(repo)
+    if not known:
+        return findings
+    used: set[str] = set()
+    for sf in repo.files:
+        if sf.rel == f"{PACKAGE}/obs/recorder.py":
+            continue
+        aliases = repo.alias_targets(sf.modname)
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            if not _is_recorder_dump(node, aliases):
+                continue
+            a0 = node.args[0]
+            if isinstance(a0, ast.Constant) and isinstance(a0.value, str):
+                trigger = a0.value
+                used.add(trigger)
+                if trigger not in known:
+                    findings.append(
+                        make_finding(
+                            "obs-recorder-trigger-unknown", sf.rel,
+                            node.lineno,
+                            f"recorder trigger {trigger!r} is not in "
+                            "KNOWN_TRIGGERS (obs/recorder.py)",
+                        )
+                    )
+    for trigger in sorted(known - used):
+        findings.append(
+            make_finding(
+                "obs-recorder-trigger-unused",
+                f"{PACKAGE}/obs/recorder.py", reg_line,
+                f"KNOWN_TRIGGERS entry {trigger!r} has no recorder.dump() "
+                "caller anywhere in the repo",
+            )
+        )
     return findings
 
 

@@ -582,6 +582,27 @@ def run_fabric_loadgen(
         )
         check_bit_exact(recn["results"])
         lanes[f"replicas_{n_rep}"] = _phase_public(recn)
+        # the router's federated view of the lane just measured: the
+        # fleet p99 (bucket-merged across replicas) with its exemplar
+        # trace id — the lane's outlier is pull-up-able by id
+        try:
+            import json as _json
+            import urllib.request as _rq
+
+            with _rq.urlopen(fab.url + "/slo", timeout=10.0) as resp:
+                slo_view = _json.loads(resp.read())
+            rec_slo = {
+                "p99": slo_view.get("p99"),
+                "slos": {
+                    name: {
+                        k: s[k]
+                        for k in ("alert", "burn_fast", "burn_slow")
+                    }
+                    for name, s in slo_view.get("slos", {}).items()
+                },
+            }
+        except Exception:
+            rec_slo = None
         # -- churn: SIGKILL one replica mid-sweep, same fabric -------------
         # the victim is the replica serving the MOST traffic (sticky
         # affinity concentrates buckets): killing an idle sibling would
@@ -632,6 +653,7 @@ def run_fabric_loadgen(
         "phase_s": p["phase_s"],
         "bit_exact_gate": f"passed ({gate_checked} responses vs golden)",
         "lanes": lanes,
+        "fleet_slo": rec_slo,
         "scaling_vs_1": scaling,
         "scaling_ok": scaling is not None and scaling >= 2.0,
     }
@@ -657,6 +679,16 @@ def run_fabric_loadgen(
         + (f"{scaling:.2f}x" if scaling else "n/a")
         + f" (>=2x: {rec['scaling_ok']})"
     )
+    if rec_slo and rec_slo["p99"] and rec_slo["p99"].get("p99_s"):
+        p99v = rec_slo["p99"]
+        printer(
+            f"federated p99 ~{p99v['p99_s'] * 1e3:.1f} ms"
+            + (
+                f"  p99~{p99v['exemplar_trace_id']}"
+                if p99v.get("exemplar_trace_id")
+                else ""
+            )
+        )
     if json_path:
         emit_json_metrics(rec, None if json_path == "-" else json_path)
     return rec
@@ -1526,6 +1558,7 @@ def run_serve_loadgen(
         f"{'p50 ms':>8s} {'p95 ms':>8s} {'p99 ms':>8s}"
     )
     for s in sweep:
+        ex = s.get("p99_exemplar")
         printer(
             f"{s['offered_rps']:11.0f} {s['achieved_rps']:9.1f} "
             f"{s['ok_frac'] * 100:5.1f}% "
@@ -1535,6 +1568,9 @@ def run_serve_loadgen(
             f"{s.get('e2e_p50_ms', float('nan')):8.2f} "
             f"{s.get('e2e_p95_ms', float('nan')):8.2f} "
             f"{s.get('e2e_p99_ms', float('nan')):8.2f}"
+            # the p99's exemplar trace id (obs/metrics.py histogram
+            # exemplars): the outlier, pull-up-able by id in --trace-out
+            + (f"  p99~{ex['trace_id']}" if ex else "")
         )
     if json_path:
         emit_json_metrics(rec, None if json_path == "-" else json_path)

@@ -513,6 +513,15 @@ def _build_parser() -> argparse.ArgumentParser:
         "rejected",
     )
     fab.add_argument(
+        "--slo",
+        default=None,
+        metavar="SPECS",
+        help="SLO specs the router's burn-rate engine evaluates over the "
+        "federated fleet metrics: comma-separated avail:<pct> and "
+        "latency:<le_seconds>:<pct> entries (default MCIM_SLO_SPECS; "
+        "served at GET /slo and as mcim_slo_* gauges)",
+    )
+    fab.add_argument(
         "--json-metrics",
         default=None,
         help="write the shutdown fabric stats record to this path "
@@ -1677,6 +1686,7 @@ def cmd_serve(args: argparse.Namespace) -> int:
         for name, default in (
             ("heartbeat_s", None), ("stale_s", None),
             ("forward_attempts", None), ("mesh_shards", 0),
+            ("slo", None),
         ):
             if not hasattr(args, name):
                 setattr(args, name, default)
@@ -1756,6 +1766,14 @@ def cmd_serve(args: argparse.Namespace) -> int:
         for s, h in prev_handlers.items():
             signal.signal(s, h)
         srv.close(drain=True, deadline_s=args.drain_deadline_s)
+        # the SIGTERM drain is a flight-recorder dump trigger: the ring's
+        # serving-time facts (hot buckets, breaker/failpoint history)
+        # become the shutdown post-mortem (obs/recorder.py)
+        from mpi_cuda_imagemanipulation_tpu.obs import recorder as _recorder
+
+        dump_path = _recorder.dump("sigterm_drain", extra={"entry": "serve"})
+        if dump_path:
+            log.info("recorder dump -> %s", dump_path)
         if args.json_metrics:
             emit_json_metrics(
                 {"event": "serve", **srv.app.stats()},
@@ -1805,6 +1823,7 @@ def cmd_fabric(args: argparse.Namespace) -> int:
             buckets=parse_buckets(args.buckets),
             stale_s=args.stale_s,
             forward_attempts=args.forward_attempts,
+            slo_specs=args.slo,
         ),
         mesh_shards=args.mesh_shards,
     )

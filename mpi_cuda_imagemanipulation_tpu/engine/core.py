@@ -315,7 +315,11 @@ class Engine:
         item.info["force_s"] = t1 - t0
         self.metrics.on_forced()
         self._slots.release()
-        self.metrics.on_stage("force", item.info["force_s"])
+        ctx = item.info.get("trace")
+        self.metrics.on_stage(
+            "force", item.info["force_s"],
+            exemplar=ctx.trace_id if ctx is not None and ctx.sampled else None,
+        )
         self._encode_slots.acquire()
         assert self._pool is not None
         try:

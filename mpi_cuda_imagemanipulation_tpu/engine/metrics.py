@@ -125,8 +125,12 @@ class EngineMetrics:
             self._failed.inc()
             self.t_last_complete = now
 
-    def on_stage(self, stage: str, seconds: float) -> None:
-        self._stage.observe(seconds, stage=stage)
+    def on_stage(
+        self, stage: str, seconds: float, exemplar: str | None = None
+    ) -> None:
+        # exemplar: the batch's trace id — a force/encode latency spike
+        # in the exposition links straight to its trace (obs/metrics.py)
+        self._stage.observe(seconds, stage=stage, exemplar=exemplar)
 
     # -- reporting ---------------------------------------------------------
 

@@ -28,12 +28,23 @@ seconds each replica POSTs one JSON `Heartbeat` to the router's
                   rebuilds it on restart, so a respawned replica reclaims
                   its consistent-hash buckets (a serving-history signal
                   would starve it forever)
+    metrics       compact metrics-federation delta (obs/fleet.py
+                  DeltaSource payload: only the series that changed since
+                  the last router-ACKED snapshot, absolute values) — the
+                  router folds these into its fleet view so federation
+                  costs no extra scrape round-trip. The router's ack body
+                  carries `resync: true` when its baseline is stale
+                  (router restart, missed epoch); the sender then resets
+                  its DeltaSource and the next beat pushes a FULL
+                  snapshot. May be None (metrics-less heartbeat).
 
 Liveness is the ABSENCE of heartbeats: the router marks a replica stale
 after `MCIM_FABRIC_STALE_S` without a beat and routes around it. The
 `replica.heartbeat` failpoint drops beats (the loss is injected on the
 sender, so the replica keeps serving — exactly the partition the router
-must tolerate), and a router outage only costs the replica a log line.
+must tolerate; the fleet view falls back to a full scrape of the
+replica's `GET /fleet/snapshot`), and a router outage only costs the
+replica a log line.
 """
 
 from __future__ import annotations
@@ -69,6 +80,9 @@ class Heartbeat:
     warm_buckets: list[str]
     seq: int
     sent_unix_s: float
+    # metrics-federation delta (obs/fleet.py DeltaSource payload), or
+    # None for a metrics-less beat
+    metrics: dict | None = None
 
     def to_json(self) -> bytes:
         return json.dumps(dataclasses.asdict(self)).encode()
@@ -83,7 +97,13 @@ class Heartbeat:
             # replica from one tree, so an unknown field is a version skew
             # bug worth failing loudly on, not silently dropping
             raise ValueError(f"heartbeat has unknown fields {sorted(unknown)}")
-        missing = fields - set(raw)
+        required = {
+            f.name
+            for f in dataclasses.fields(cls)
+            if f.default is dataclasses.MISSING
+            and f.default_factory is dataclasses.MISSING
+        }
+        missing = required - set(raw)
         if missing:
             raise ValueError(f"heartbeat missing fields {sorted(missing)}")
         return cls(**raw)
@@ -109,11 +129,16 @@ class HeartbeatSender:
         collect: Callable[[int], Heartbeat],
         *,
         interval_s: float | None = None,
+        on_ack: Callable[[Heartbeat, dict], None] | None = None,
     ):
         # control_url is the router base (http://host:port); beats go to
         # its /control/heartbeat route
         self.url = control_url.rstrip("/") + HEARTBEAT_PATH
         self._collect = collect
+        # on_ack(hb, ack_body): the router acknowledged this beat — the
+        # metrics DeltaSource advances its baseline here (and resets it
+        # when the ack carries resync=true)
+        self._on_ack = on_ack
         self.interval_s = (
             default_heartbeat_s() if interval_s is None else interval_s
         )
@@ -169,8 +194,14 @@ class HeartbeatSender:
             with urllib.request.urlopen(
                 req, timeout=max(self.interval_s, 0.2)
             ) as resp:
-                resp.read()
+                body = resp.read()
             self.sent += 1
+            if self._on_ack is not None:
+                try:
+                    ack = json.loads(body) if body else {}
+                except ValueError:
+                    ack = {}
+                self._on_ack(hb, ack)
             return True
         except Exception as e:  # router down/restarting: serve on, log once
             self.failed += 1

@@ -53,6 +53,7 @@ class ReplicaRuntime:
         port: int = 0,
         heartbeat_s: float | None = None,
     ):
+        from mpi_cuda_imagemanipulation_tpu.obs.fleet import DeltaSource
         from mpi_cuda_imagemanipulation_tpu.serve.server import Server
 
         self.replica_id = replica_id
@@ -61,8 +62,15 @@ class ReplicaRuntime:
         # restart from a continuation and reset the replica's breaker
         self.incarnation = f"{os.getpid():x}-{time.time_ns():x}"
         self.server = Server(serve_config, host, port)
+        # metrics federation (obs/fleet.py): every heartbeat carries the
+        # compact delta of this replica's registries; the router's ack
+        # advances the baseline (or asks for a full resync)
+        self.delta_source = DeltaSource(self.server.app.fleet_registries())
         self.sender = HeartbeatSender(
-            router_url, self._collect, interval_s=heartbeat_s
+            router_url,
+            self._collect,
+            interval_s=heartbeat_s,
+            on_ack=self._on_heartbeat_ack,
         )
 
     def _collect(self, seq: int) -> Heartbeat:
@@ -82,7 +90,16 @@ class ReplicaRuntime:
             warm_buckets=app.cache.warm_buckets(),
             seq=seq,
             sent_unix_s=time.time(),
+            metrics=self.delta_source.delta(),
         )
+
+    def _on_heartbeat_ack(self, hb: Heartbeat, ack: dict) -> None:
+        if ack.get("resync"):
+            # router baseline mismatch (restart / missed epoch): next
+            # beat carries a full snapshot
+            self.delta_source.force_full()
+        elif hb.metrics is not None:
+            self.delta_source.ack(hb.metrics["seq"])
 
     def start(self) -> "ReplicaRuntime":
         # warmup + socket first: the first heartbeat must carry the real
@@ -180,6 +197,16 @@ def main(argv: list[str] | None = None) -> int:
     )
     stop_evt.wait()
     rt.close(drain=True, deadline_s=args.drain_deadline_s)
+    # flight recorder (obs/recorder.py): the SIGTERM drain is a dump
+    # trigger — the ring still holds the serving-time facts (hot buckets,
+    # breaker transitions, failpoint hits) plus the drain itself
+    from mpi_cuda_imagemanipulation_tpu.obs import recorder
+
+    dump_path = recorder.dump(
+        "sigterm_drain", extra={"replica_id": args.replica_id}
+    )
+    if dump_path:
+        log.info("replica %s recorder dump -> %s", args.replica_id, dump_path)
     if args.trace_out:
         n = obs_trace.export(args.trace_out)
         log.info(

@@ -48,13 +48,17 @@ import io as _io
 import json
 import threading
 import time
+import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from mpi_cuda_imagemanipulation_tpu.fabric.control import (
     HEARTBEAT_PATH,
     Heartbeat,
 )
+from mpi_cuda_imagemanipulation_tpu.obs import fleet as obs_fleet
 from mpi_cuda_imagemanipulation_tpu.obs import metrics as obs_metrics
+from mpi_cuda_imagemanipulation_tpu.obs import recorder as flight_recorder
+from mpi_cuda_imagemanipulation_tpu.obs import slo as obs_slo
 from mpi_cuda_imagemanipulation_tpu.obs import trace as obs_trace
 from mpi_cuda_imagemanipulation_tpu.obs.metrics import Registry
 from mpi_cuda_imagemanipulation_tpu.resilience import failpoints
@@ -192,6 +196,13 @@ class RouterConfig:
     # within a breaker window, not a serving outage)
     breaker_threshold: int = 2
     breaker_reset_s: float = 3.0
+    # SLO burn-rate engine (obs/slo.py) over the federated registries;
+    # None fields fall back to their MCIM_SLO_* env defaults
+    slo_specs: str | None = None
+    slo_fast_s: float | None = None
+    slo_slow_s: float | None = None
+    slo_tick_s: float | None = None
+    slo_burn_threshold: float | None = None
 
 
 class Router:
@@ -244,6 +255,29 @@ class Router:
         self._pool = _ConnPool(self.forward_timeout_s)
         self._clock = clock
         self.registry = registry or Registry()
+        # metrics federation (obs/fleet.py): per-replica registries fold
+        # into this view via heartbeat deltas; staleness shares the
+        # routing liveness window so "routable" and "counted" agree
+        self.fleet = obs_fleet.FleetAggregator(
+            stale_s=self.stale_s, clock=clock
+        )
+        self._fleet_scraped_at: dict[str, float] = {}
+        # SLO burn-rate engine over the fleet view (obs/slo.py); the
+        # ticker thread starts with the router
+        self.slo = obs_slo.SLOEngine(
+            obs_slo.parse_slo_specs(
+                config.slo_specs
+                if config.slo_specs is not None
+                else env_registry.get(obs_slo.ENV_SPECS)
+            ),
+            obs_slo.fleet_slo_source(self.fleet.merged),
+            fast_s=config.slo_fast_s,
+            slow_s=config.slo_slow_s,
+            tick_s=config.slo_tick_s,
+            burn_threshold=config.slo_burn_threshold,
+            registry=self.registry,
+            clock=clock,
+        )
         self._register_metrics()
         self.httpd: ThreadingHTTPServer | None = None
         self._http_thread: threading.Thread | None = None
@@ -309,6 +343,36 @@ class Router:
             "mcim_fabric_breaker_open_events",
             "Cumulative router-side replica-breaker trips.",
             fn=lambda: float(self.breakers.snapshot()["open_events"]),
+        )
+        # -- fleet federation health (obs/fleet.py) -------------------------
+        r.gauge(
+            "mcim_fleet_replicas",
+            "Replicas currently contributing to the federated view.",
+            fn=lambda: float(len(self.fleet.fresh_ids())),
+        )
+        r.gauge(
+            "mcim_fleet_snapshot_age_seconds",
+            "Seconds since each replica's metrics snapshot last advanced.",
+            labels=("replica",),
+            fn=lambda: {
+                (rid,): age for rid, age in self.fleet.ages().items()
+            },
+        )
+        r.gauge(
+            "mcim_fleet_applied_deltas",
+            "Heartbeat metrics deltas folded into the fleet view.",
+            fn=lambda: float(self.fleet.applied_deltas),
+        )
+        r.gauge(
+            "mcim_fleet_full_syncs",
+            "Full snapshots applied (first beats, resyncs, scrapes).",
+            fn=lambda: float(self.fleet.full_syncs),
+        )
+        r.gauge(
+            "mcim_fleet_resyncs",
+            "Heartbeat deltas refused for a stale baseline (the ack asked "
+            "the replica to resend full).",
+            fn=lambda: float(self.fleet.resyncs),
         )
 
     def _serving_gauge(self) -> dict:
@@ -466,6 +530,7 @@ class Router:
                 # connection-class failure: the replica is gone or wedged —
                 # feed its breaker and move on to the next candidate
                 breaker.on_failure()
+                self._maybe_breaker_dump(rid, breaker)
                 self._m_forwards.inc(replica=rid, outcome="net_error")
                 self._log.warning(
                     "forward to %s failed (%s: %s)",
@@ -477,12 +542,18 @@ class Router:
                 # alive-but-full (no breaker signal), 5xx feeds the breaker
                 if code >= 500:
                     breaker.on_failure()
+                    self._maybe_breaker_dump(rid, breaker)
                 self._m_forwards.inc(replica=rid, outcome="http_error")
                 last = (code, ctype, out, [("X-Fabric-Replica", rid)])
                 continue
             breaker.on_success()
             self._m_forwards.inc(replica=rid, outcome="ok")
-            self._m_forward_s.observe(self._clock() - t0)
+            # exemplar: the proxy-time histogram keeps this request's
+            # trace id per bucket, so a forward-latency spike in the
+            # exposition pulls up the exact router->replica trace
+            self._m_forward_s.observe(
+                self._clock() - t0, exemplar=root.trace_id or None
+            )
             return (
                 code, ctype, out,
                 [
@@ -500,6 +571,15 @@ class Router:
              "status": "unavailable"},
             extra=[("Retry-After", "1")],
         )
+
+    def _maybe_breaker_dump(self, rid: str, breaker) -> None:
+        """A router-side replica breaker that is (now) open is a
+        post-mortem moment: dump the flight recorder (rate-limited per
+        trigger, so a dead replica's retry storm writes one artifact)."""
+        if breaker.state == "open":
+            flight_recorder.dump(
+                "breaker_open", extra={"scope": "router", "replica": rid}
+            )
 
     def _forward_once(
         self, view: ReplicaView, body: bytes, trace_id: str
@@ -567,7 +647,9 @@ class Router:
             hb = Heartbeat.from_json(body)
         except (ValueError, TypeError) as e:
             return 400, {"error": f"bad heartbeat: {e}"}
-        new_inc = self.table.observe(hb, self._clock())
+        now = self._clock()
+        prev = self.table.get(hb.replica_id)
+        new_inc = self.table.observe(hb, now)
         if new_inc:
             # fresh process behind the same id: it must not inherit its
             # predecessor's open breaker (the restart IS the recovery)
@@ -577,8 +659,108 @@ class Router:
                 hb.replica_id, hb.incarnation, hb.addr or "127.0.0.1",
                 hb.port, hb.state,
             )
+        if (
+            new_inc
+            or prev is None
+            or prev.hb.state != hb.state
+            or prev.hb.breaker_open != hb.breaker_open
+            or set(prev.hb.warm_buckets) != set(hb.warm_buckets)
+        ):
+            # flight recorder (obs/recorder.py): the router's ring keeps
+            # each replica's last meaningful heartbeat, so a post-mortem
+            # dump after a SIGKILL still names the dead replica's warm
+            # buckets (the supervisor's replica_death dump reads this)
+            flight_recorder.note(
+                "heartbeat",
+                replica=hb.replica_id,
+                state=hb.state,
+                queued=hb.queued,
+                warm_buckets=list(hb.warm_buckets),
+                breaker_open=list(hb.breaker_open),
+                incarnation=hb.incarnation,
+            )
         self._m_heartbeats.inc(replica=hb.replica_id)
-        return 200, {"ok": True}
+        # metrics federation: fold the beat's delta in; a refused
+        # baseline rides back on the ack as resync=true and the replica
+        # pushes a full snapshot next beat
+        ok = self.fleet.apply(
+            hb.replica_id, hb.incarnation, hb.metrics, now
+        )
+        return 200, {"ok": True, "resync": not ok}
+
+    def _fleet_refresh(self) -> None:
+        """Full-scrape fallback: a replica the table knows about whose
+        fleet snapshot is stale (heartbeats lost or deltas refused) gets
+        one `GET /fleet/snapshot` pull per staleness window — the
+        federation survives heartbeat gaps as long as the replica's HTTP
+        port answers. Runs on the /metrics//slo scrape path, bounded by
+        a short timeout per replica."""
+        now = self._clock()
+        ages = self.fleet.ages(now)
+        for v in self.table.views():
+            rid = v.replica_id
+            age = ages.get(rid)
+            if age is not None and age <= self.stale_s:
+                continue
+            if now - self._fleet_scraped_at.get(rid, -1e18) < self.stale_s:
+                continue
+            self._fleet_scraped_at[rid] = now
+            url = (
+                f"http://{v.hb.addr or '127.0.0.1'}:{v.hb.port}"
+                f"{obs_fleet.SNAPSHOT_PATH}"
+            )
+            try:
+                with urllib.request.urlopen(url, timeout=2.0) as resp:
+                    snap = json.loads(resp.read())
+                self.fleet.full_sync(rid, v.hb.incarnation, snap, now)
+                self._log.info(
+                    "fleet: full-scraped %s (snapshot age was %s)",
+                    rid, "inf" if age is None else f"{age:.1f}s",
+                )
+            except Exception as e:
+                self._log.debug(
+                    "fleet: full scrape of %s failed (%s)", rid,
+                    type(e).__name__,
+                )
+
+    def render_metrics(self) -> str:
+        """The router `GET /metrics` body: the router's own families plus
+        the FEDERATED replica families (counters summed, histograms
+        bucket-merged, gauges labeled {replica=...})."""
+        self._fleet_refresh()
+        return self.registry.render() + self.fleet.render()
+
+    def fleet_p99(self) -> dict:
+        """The federated e2e p99 with its exemplar trace id — the number
+        the pod's operators actually ask for, joined to the trace that
+        shows where the time went."""
+        merged = self.fleet.merged()
+        entry = merged.get("mcim_serve_e2e_latency_seconds")
+        if not entry:
+            return {"p99_s": None, "exemplar_trace_id": None}
+        data = entry["series"].get(())
+        if not data:
+            return {"p99_s": None, "exemplar_trace_id": None}
+        p99 = obs_fleet.quantile_from_buckets(
+            entry["bounds"], data["buckets"], data["count"], 99
+        )
+        ex = obs_fleet.merged_exemplar_for_quantile(entry, 99)
+        return {
+            "p99_s": p99,
+            "exemplar_trace_id": ex[0] if ex else None,
+            "exemplar_value_s": ex[1] if ex else None,
+        }
+
+    def slo_status(self) -> dict:
+        """The `GET /slo` body: engine status + the federated p99 and
+        fleet freshness, one JSON for dashboards and the acceptance
+        tests."""
+        self._fleet_refresh()
+        return {
+            **self.slo.status(),
+            "fleet": self.fleet.stats(),
+            "p99": self.fleet_p99(),
+        }
 
     def healthz(self) -> tuple[int, dict]:
         routable = self._routable()
@@ -599,6 +781,8 @@ class Router:
             "mesh_lane": (
                 self.mesh_lane.stats() if self.mesh_lane is not None else None
             ),
+            "fleet": self.fleet.stats(now),
+            "slo": self.slo.status(),
             "replicas": {
                 v.replica_id: {
                     "addr": v.hb.addr or "127.0.0.1",
@@ -632,6 +816,7 @@ class Router:
                 daemon=True,
             )
             self._http_thread.start()
+            self.slo.start()
         except BaseException:
             self.close()
             raise
@@ -651,6 +836,7 @@ class Router:
         if self._closed:
             return
         self._closed = True
+        self.slo.stop()
         if self.httpd is not None:
             try:
                 self.httpd.shutdown()
@@ -716,8 +902,11 @@ def _make_handler(router: Router):
             elif self.path == "/stats":
                 self._reply_json(200, router.stats())
             elif self.path == "/metrics":
-                body = router.registry.render().encode()
+                # router families + the federated per-replica families
+                body = router.render_metrics().encode()
                 self._reply(200, obs_metrics.CONTENT_TYPE, body)
+            elif self.path == "/slo":
+                self._reply_json(200, router.slo_status())
             else:
                 self._reply_json(404, {"error": f"no route {self.path}"})
 

@@ -79,12 +79,18 @@ class Supervisor:
         backoff_max_s: float = 10.0,
         stable_s: float = 5.0,
         clock=time.monotonic,
+        death_info=None,
     ):
         self.specs = list(specs)
         self.backoff_base_s = backoff_base_s
         self.backoff_max_s = backoff_max_s
         self.stable_s = stable_s
         self._clock = clock
+        # death_info(replica_id) -> dict: extra context for the
+        # replica_death flight-recorder dump (Fabric passes the router's
+        # last heartbeat view, so the dump names the dead replica's warm
+        # buckets even though its own ring died with it)
+        self._death_info = death_info
         self._managed = {s.replica_id: _Managed(s) for s in specs}
         self._lock = threading.Lock()  # guards _managed.proc handles
         self._running = False
@@ -152,11 +158,33 @@ class Supervisor:
                         m.spec.replica_id, proc.returncode, delay,
                         m.attempts + 1,
                     )
+                    self._dump_death(m.spec.replica_id, proc)
                 elif now >= m.restart_due:
                     m.attempts += 1
                     self._m_restarts.inc(replica=m.spec.replica_id)
                     self._spawn(m)
             self._wake.wait(0.05)
+
+    def _dump_death(self, replica_id: str, proc) -> None:
+        """A replica died while the pod was supposed to be up: write the
+        replica_death flight-recorder post-mortem. The SUPERVISOR process
+        ring (shared with the router in a `Fabric`) holds the dead
+        replica's last heartbeats — `death_info` lifts its warm buckets
+        and state into the dump header. Never raises (runs on the
+        monitor thread)."""
+        from mpi_cuda_imagemanipulation_tpu.obs import recorder
+
+        extra = {"replica": replica_id, "returncode": proc.returncode}
+        if self._death_info is not None:
+            try:
+                extra.update(self._death_info(replica_id) or {})
+            except Exception:  # a racing table read must not kill monitor
+                pass
+        path = recorder.dump("replica_death", extra=extra)
+        if path:
+            self._log.warning(
+                "replica %s death post-mortem -> %s", replica_id, path
+            )
 
     def stop(self, *, drain: bool = True, deadline_s: float = 30.0) -> None:
         """SIGTERM every replica (graceful drain in the worker), wait out
@@ -270,6 +298,21 @@ class Fabric:
     def replica_ids(self) -> list[str]:
         return [f"r{i}" for i in range(self.config.replicas)]
 
+    def _death_info(self, replica_id: str) -> dict:
+        """Context for the replica_death post-mortem dump: the dead
+        replica's last heartbeat as the router saw it — state, queue
+        fill and (the churn question) which buckets it was serving warm."""
+        view = self.router.table.get(replica_id)
+        if view is None:
+            return {}
+        return {
+            "last_state": view.hb.state,
+            "last_queued": view.hb.queued,
+            "warm_buckets": list(view.hb.warm_buckets),
+            "breaker_open": list(view.hb.breaker_open),
+            "incarnation": view.hb.incarnation,
+        }
+
     def _replica_argv(self, rid: str) -> list[str]:
         c = self.config
         argv = [
@@ -312,6 +355,7 @@ class Fabric:
                 registry=self.registry,
                 backoff_base_s=self.config.supervisor_backoff_s,
                 stable_s=self.config.supervisor_stable_s,
+                death_info=self._death_info,
             ).start()
             self.wait_ready(
                 self.config.replicas, timeout_s=ready_timeout_s

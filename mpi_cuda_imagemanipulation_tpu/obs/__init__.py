@@ -12,12 +12,23 @@ subsystem (docs/design.md "Observability").
                        jax.profiler device-trace merge (one timeline for
                        host stalls vs DMA vs compute; the capture tool
                        `tools/profile_capture.py` is a shim over this).
+  * `obs/fleet.py`   — metrics federation: heartbeat delta snapshots,
+                       restart-safe counter folding, bucket-merged fleet
+                       histograms, the router's one-pod view.
+  * `obs/slo.py`     — declarative SLOs evaluated as multi-window burn
+                       rates over the federated view (`GET /slo`).
+  * `obs/recorder.py`— the always-on flight recorder: bounded ring of
+                       recent facts, dumped to JSON post-mortems on
+                       breaker-open/quarantine/drain/replica-death.
 
 The serving scheduler, the async engine, the resilience retry/bisect
 path, the sharded halo dispatch and the batch CLI all report through
 here — it is the substrate later fabric/streaming work reports through.
 """
 
+from mpi_cuda_imagemanipulation_tpu.obs import fleet  # noqa: F401
+from mpi_cuda_imagemanipulation_tpu.obs import recorder  # noqa: F401
+from mpi_cuda_imagemanipulation_tpu.obs import slo  # noqa: F401
 from mpi_cuda_imagemanipulation_tpu.obs import trace  # noqa: F401
 from mpi_cuda_imagemanipulation_tpu.obs.metrics import (  # noqa: F401
     CONTENT_TYPE,
@@ -52,7 +63,10 @@ __all__ = [
     "current_context",
     "current_trace_id",
     "event",
+    "fleet",
     "parse_exposition",
+    "recorder",
+    "slo",
     "span",
     "start_trace",
     "trace",

@@ -30,13 +30,25 @@ count: the reservoir is a `deque(maxlen=sample_cap)` and label
 cardinality is bounded by the callers (buckets and statuses are finite
 sets by construction).
 
+Histograms also carry **exemplars** (docs/design.md "Fleet
+observability"): `observe(v, exemplar=trace_id)` remembers the most
+recent (trace_id, value, ts) per bucket, rendered OpenMetrics-style after
+the bucket line (`... # {trace_id="..."} value ts`). A p99 spike in the
+exposition therefore links directly to a concrete trace in the Perfetto
+export instead of being an anonymous count — `exemplar_for_quantile(99)`
+is the programmatic version the loadgen/bench reports use.
+
 `parse_exposition()` is the matching parser — tests and the CI smoke lane
-use it to assert `/metrics` actually parses as exposition text.
+use it to assert `/metrics` actually parses as exposition text. It
+tokenizes label blocks with full escape handling (`\\`, `\"`, `\n` in
+label values), so render→parse round-trips even adversarial values, and
+captures exemplars per sample.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 
 from mpi_cuda_imagemanipulation_tpu.utils.timing import percentiles
@@ -53,6 +65,29 @@ PERCENTILES = (50, 95, 99)
 
 def _escape_label(v: str) -> str:
     return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _escape_help(v: str) -> str:
+    # HELP text escapes only backslash and newline (the exposition spec);
+    # quotes are legal there
+    return v.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _unescape(v: str) -> str:
+    """Inverse of `_escape_label`/`_escape_help` (one pass, so '\\\\n'
+    round-trips as backslash + n, not newline)."""
+    out: list[str] = []
+    i = 0
+    while i < len(v):
+        c = v[i]
+        if c == "\\" and i + 1 < len(v):
+            nxt = v[i + 1]
+            out.append({"n": "\n", "\\": "\\", '"': '"'}.get(nxt, c + nxt))
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
 
 
 def _fmt_value(v: float) -> str:
@@ -100,7 +135,7 @@ class _Metric:
 
     def render(self) -> list[str]:
         lines = [
-            f"# HELP {self.name} {self.help}",
+            f"# HELP {self.name} {_escape_help(self.help)}",
             f"# TYPE {self.name} {self.kind}",
         ]
         with self._lock:
@@ -182,6 +217,20 @@ class Gauge(_Metric):
         return super().render()
 
 
+def _fmt_exemplar(ex: tuple[str, float, float] | None) -> str:
+    """OpenMetrics exemplar suffix for a bucket line, or ''. Our
+    `parse_exposition` reads these back; 0.0.4-only scrapers treat the
+    trailing ` # ...` as the OpenMetrics spec defines (an exemplar), and
+    plain-text consumers ignore everything after the value."""
+    if ex is None:
+        return ""
+    trace_id, value, ts = ex
+    return (
+        f' # {{trace_id="{_escape_label(trace_id)}"}} '
+        f"{_fmt_value(value)} {repr(float(ts))}"
+    )
+
+
 class Histogram:
     """Prometheus histogram + bounded percentile reservoir.
 
@@ -217,20 +266,56 @@ class Histogram:
             s = self._series[key] = [
                 [0] * len(self.buckets), 0.0, 0,
                 deque(maxlen=self.sample_cap),
+                # per-bucket exemplar slots (last = +Inf): the most recent
+                # (trace_id, value, unix_ts) observed into that bucket
+                [None] * (len(self.buckets) + 1),
             ]
         return s
 
-    def observe(self, v: float, **labels) -> None:
+    def _bucket_index(self, v: float) -> int:
+        """Index of the FIRST bucket containing v (len(buckets) = +Inf)."""
+        for i, ub in enumerate(self.buckets):
+            if v <= ub:
+                return i
+        return len(self.buckets)
+
+    def observe(self, v: float, *, exemplar: str | None = None,
+                **labels) -> None:
+        """Record one observation. `exemplar` attaches a trace id to the
+        observation's bucket — the exposition then links that bucket (and
+        any percentile that lands in it) to a concrete trace."""
         key = self._key(labels)
         with self._lock:
-            counts, _sum, _n, reservoir = self._cell(key)
+            s = self._cell(key)
+            counts, _sum, _n, reservoir, exemplars = s
             for i, ub in enumerate(self.buckets):
                 if v <= ub:
                     counts[i] += 1
-            s = self._series[key]
             s[1] = _sum + v
             s[2] = _n + 1
             reservoir.append(v)
+            if exemplar:
+                exemplars[self._bucket_index(v)] = (
+                    str(exemplar), float(v), time.time()
+                )
+
+    def data(self) -> dict[tuple[str, ...], dict]:
+        """Raw per-series state for federation snapshots (obs/fleet.py):
+        cumulative bucket counts, sum, count and the exemplar slots."""
+        with self._lock:
+            return {
+                k: {
+                    "buckets": list(s[0]),
+                    "sum": s[1],
+                    "count": s[2],
+                    "exemplars": [
+                        [i, *ex]
+                        for i, ex in enumerate(s[4])
+                        if ex is not None
+                    ],
+                }
+                for k, s in self._series.items()
+            }
 
     def count(self, **labels) -> int:
         with self._lock:
@@ -247,6 +332,50 @@ class Histogram:
             s = self._series.get(self._key(labels))
             return list(s[3]) if s else []
 
+    def exemplars(self, **labels) -> dict[str, tuple[str, float, float]]:
+        """`{le_string: (trace_id, value, unix_ts)}` for the buckets that
+        hold one ("+Inf" for the overflow bucket)."""
+        with self._lock:
+            s = self._series.get(self._key(labels))
+            if not s:
+                return {}
+            exs = list(s[4])
+        out = {}
+        for i, ex in enumerate(exs):
+            if ex is not None:
+                le = (
+                    _fmt_value(self.buckets[i])
+                    if i < len(self.buckets)
+                    else "+Inf"
+                )
+                out[le] = ex
+        return out
+
+    def exemplar_for_quantile(
+        self, q: float, **labels
+    ) -> tuple[str, float, float] | None:
+        """The exemplar nearest the q-th percentile: compute the
+        percentile over the recent reservoir, then return the exemplar of
+        the bucket it falls in (or the nearest populated bucket at or
+        above it). The join from "p99 spiked" to "this trace shows why"."""
+        xs = self.samples(**labels)
+        if not xs:
+            return None
+        v = percentiles(xs, (q,))[q]
+        with self._lock:
+            s = self._series.get(self._key(labels))
+            exs = list(s[4]) if s else []
+        if not exs:
+            return None
+        start = self._bucket_index(v)
+        # nearest populated bucket by index distance (ties go up — a
+        # tail quantile should prefer the slower neighbour)
+        for d in range(len(exs)):
+            for i in (start + d, start - d):
+                if 0 <= i < len(exs) and exs[i] is not None:
+                    return exs[i]
+        return None
+
     def percentiles_ms(self, qs=PERCENTILES, **labels) -> dict | None:
         """`{"p50_ms": ...}` over the recent reservoir — the exact
         percentile view /stats and the shutdown summaries report
@@ -259,22 +388,29 @@ class Histogram:
 
     def render(self) -> list[str]:
         lines = [
-            f"# HELP {self.name} {self.help}",
+            f"# HELP {self.name} {_escape_help(self.help)}",
             f"# TYPE {self.name} histogram",
         ]
         with self._lock:
             series = {
-                k: (list(s[0]), s[1], s[2]) for k, s in self._series.items()
+                k: (list(s[0]), s[1], s[2], list(s[4]))
+                for k, s in self._series.items()
             }
         for key in sorted(series):
-            counts, total, n = series[key]
+            counts, total, n, exemplars = series[key]
             for i, ub in enumerate(self.buckets):
                 ls = _label_str(
                     self.label_names, key, (("le", _fmt_value(ub)),)
                 )
-                lines.append(f"{self.name}_bucket{ls} {counts[i]}")
+                lines.append(
+                    f"{self.name}_bucket{ls} {counts[i]}"
+                    + _fmt_exemplar(exemplars[i])
+                )
             inf_ls = _label_str(self.label_names, key, (("le", "+Inf"),))
-            lines.append(f"{self.name}_bucket{inf_ls} {n}")
+            lines.append(
+                f"{self.name}_bucket{inf_ls} {n}"
+                + _fmt_exemplar(exemplars[len(self.buckets)])
+            )
             plain = _label_str(self.label_names, key)
             lines.append(f"{self.name}_sum{plain} {repr(float(total))}")
             lines.append(f"{self.name}_count{plain} {n}")
@@ -324,6 +460,12 @@ class Registry:
         with self._lock:
             return self._metrics.get(name)
 
+    def metrics(self) -> list:
+        """Every registered metric object, name-sorted (federation
+        snapshots walk these; obs/fleet.py)."""
+        with self._lock:
+            return [self._metrics[n] for n in sorted(self._metrics)]
+
     def names(self) -> list[str]:
         with self._lock:
             return sorted(self._metrics)
@@ -342,16 +484,121 @@ class Registry:
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
+def _parse_label_block(
+    line: str, i: int, lineno: int
+) -> tuple[dict[str, str], str, int]:
+    """Tokenize `line[i:]` starting at '{': returns (labels dict with
+    unescaped values, the raw inner text, index just past '}'). Escape-
+    aware, so label values containing `\\`, `\"`, `}`, `,` or rendered
+    newlines parse correctly — rpartition-style splitting does not."""
+    assert line[i] == "{"
+    j = i + 1
+    labels: dict[str, str] = {}
+    while True:
+        if j >= len(line):
+            raise ValueError(f"line {lineno}: unterminated label block")
+        if line[j] == "}":
+            return labels, line[i + 1 : j], j + 1
+        k = j
+        while j < len(line) and line[j] not in '="}':
+            j += 1
+        if j >= len(line) or line[j] != "=":
+            raise ValueError(f"line {lineno}: expected label=\"value\"")
+        name = line[k:j].strip(", \t")
+        j += 1
+        if j >= len(line) or line[j] != '"':
+            raise ValueError(
+                f"line {lineno}: label {name!r} value must be quoted"
+            )
+        j += 1
+        buf: list[str] = []
+        while True:
+            if j >= len(line):
+                raise ValueError(
+                    f"line {lineno}: unterminated value for label {name!r}"
+                )
+            c = line[j]
+            if c == "\\" and j + 1 < len(line):
+                buf.append(
+                    {"n": "\n", "\\": "\\", '"': '"'}.get(
+                        line[j + 1], c + line[j + 1]
+                    )
+                )
+                j += 2
+                continue
+            if c == '"':
+                j += 1
+                break
+            buf.append(c)
+            j += 1
+        labels[name] = "".join(buf)
+        if j < len(line) and line[j] == ",":
+            j += 1
+
+
+def parse_labels(labelstr: str) -> dict[str, str]:
+    """Parse the inner text of a label block (the `labelstr` keys
+    `parse_exposition` returns) into `{name: unescaped value}`."""
+    if not labelstr:
+        return {}
+    labels, _raw, _end = _parse_label_block("{" + labelstr + "}", 0, 0)
+    return labels
+
+
+def _parse_sample_line(line: str, lineno: int):
+    """One sample line -> (name, raw labelstr, value, exemplar | None).
+    Exemplars are the OpenMetrics ` # {labels} value [ts]` suffix."""
+    i = 0
+    while i < len(line) and line[i] not in "{ \t":
+        i += 1
+    name = line[:i]
+    raw = ""
+    if i < len(line) and line[i] == "{":
+        _labels, raw, i = _parse_label_block(line, i, lineno)
+    rest = line[i:].strip()
+    exemplar = None
+    if " # " in rest:
+        val_part, _, ex_part = rest.partition(" # ")
+        ex_part = ex_part.strip()
+        if not ex_part.startswith("{"):
+            raise ValueError(f"line {lineno}: malformed exemplar")
+        ex_labels, _exraw, k = _parse_label_block(ex_part, 0, lineno)
+        ex_fields = ex_part[k:].split()
+        if not ex_fields:
+            raise ValueError(f"line {lineno}: exemplar missing value")
+        exemplar = {
+            "labels": ex_labels,
+            "value": float(ex_fields[0]),
+            "ts": float(ex_fields[1]) if len(ex_fields) > 1 else None,
+        }
+    else:
+        val_part = rest
+    fields = val_part.split()
+    if not fields:
+        raise ValueError(f"line {lineno}: expected 'name value'")
+    try:
+        value = float(fields[0])
+    except ValueError:
+        raise ValueError(
+            f"line {lineno}: unparsable value {fields[0]!r}"
+        ) from None
+    return name, raw, value, exemplar
+
+
 def parse_exposition(text: str) -> dict[str, dict]:
     """Parse Prometheus text exposition into
-    `{family: {"type": str, "help": str, "samples": {(name, labelstr): value}}}`.
+    `{family: {"type": str, "help": str, "samples": {(name, labelstr):
+    value}, "exemplars": {(name, labelstr): {...}}}}`.
     Raises ValueError on malformed lines — the CI smoke lane's
-    "/metrics parses" assertion."""
+    "/metrics parses" assertion. Label values round-trip escapes
+    (`parse_labels` on a labelstr recovers the original values), and
+    histogram bucket exemplars are captured per sample."""
     families: dict[str, dict] = {}
 
     def fam(name: str) -> dict:
         return families.setdefault(
-            name, {"type": "untyped", "help": "", "samples": {}}
+            name,
+            {"type": "untyped", "help": "", "samples": {}, "exemplars": {}},
         )
 
     for lineno, line in enumerate(text.splitlines(), 1):
@@ -360,7 +607,7 @@ def parse_exposition(text: str) -> dict[str, dict]:
         if line.startswith("# HELP "):
             _, _, rest = line.partition("# HELP ")
             name, _, help_text = rest.partition(" ")
-            fam(name)["help"] = help_text
+            fam(name)["help"] = _unescape(help_text)
             continue
         if line.startswith("# TYPE "):
             _, _, rest = line.partition("# TYPE ")
@@ -372,30 +619,13 @@ def parse_exposition(text: str) -> dict[str, dict]:
             continue
         if line.startswith("#"):
             continue
-        # sample line: name[{labels}] value
-        if "{" in line:
-            name, _, rest = line.partition("{")
-            labels, sep, val_part = rest.rpartition("} ")
-            if not sep:
-                raise ValueError(f"line {lineno}: unterminated labels")
-            labelstr = labels
-            value_str = val_part.strip().split()[0]
-        else:
-            parts = line.split()
-            if len(parts) < 2:
-                raise ValueError(f"line {lineno}: expected 'name value'")
-            name, value_str = parts[0], parts[1]
-            labelstr = ""
-        try:
-            value = float(value_str)
-        except ValueError:
-            raise ValueError(
-                f"line {lineno}: unparsable value {value_str!r}"
-            ) from None
+        name, labelstr, value, exemplar = _parse_sample_line(line, lineno)
         base = name
         for suffix in ("_bucket", "_sum", "_count"):
             if name.endswith(suffix) and name[: -len(suffix)] in families:
                 base = name[: -len(suffix)]
                 break
         fam(base)["samples"][(name, labelstr)] = value
+        if exemplar is not None:
+            fam(base)["exemplars"][(name, labelstr)] = exemplar
     return families

@@ -49,6 +49,8 @@ import time
 from collections import deque
 from typing import NamedTuple
 
+from mpi_cuda_imagemanipulation_tpu.obs import recorder
+
 
 class SpanContext(NamedTuple):
     """The value that carries parentage across threads: put it on the work
@@ -238,12 +240,20 @@ class Tracer:
         args["span_id"] = span.span_id
         if span.parent_id:
             args.setdefault("parent_id", span.parent_id)
+        dur_us = max((t1 - span.t0) * 1e6, 0.0)
         with self._lock:
             self._events.append({
                 "ph": "X", "name": span.name, "ts": ts,
-                "dur": max((t1 - span.t0) * 1e6, 0.0),
+                "dur": dur_us,
                 "tid": span.tid, "args": args,
             })
+        # flight-recorder summary (obs/recorder.py): the always-on ring
+        # keeps recent span names/durations even after this buffer wraps,
+        # so a post-mortem dump shows what the process was doing
+        recorder.note(
+            "span", name=span.name, dur_ms=dur_us / 1e3,
+            trace_id=span.trace_id,
+        )
 
     # -- reporting ---------------------------------------------------------
 

@@ -6,6 +6,12 @@ per-call registry would fragment the counters across them. The smoke
 gate (tools/plan_smoke.py) asserts from these that a fused build
 actually reduced modelled HBM passes, and `--json-metrics` surfaces
 `snapshot()` wherever a plan ran.
+
+This registry also federates: a fabric replica's heartbeat delta
+snapshots include it (serve/server.ServeApp.fleet_registries), so a
+calibration flip that rebuilds plans mid-flight shows up in the router's
+fleet view as `mcim_plan_builds_total` movement next to the serving
+counters it affects.
 """
 
 from __future__ import annotations

@@ -24,6 +24,8 @@ from __future__ import annotations
 import threading
 import time
 
+from mpi_cuda_imagemanipulation_tpu.obs import recorder as flight_recorder
+
 CLOSED = "closed"
 OPEN = "open"
 HALF_OPEN = "half_open"
@@ -36,6 +38,7 @@ class CircuitBreaker:
         failure_threshold: int = 5,
         reset_timeout_s: float = 30.0,
         clock=time.monotonic,
+        key=None,
     ):
         if failure_threshold < 1:
             raise ValueError(
@@ -50,6 +53,16 @@ class CircuitBreaker:
         self._opened_at: float | None = None
         self._probe_in_flight = False
         self.open_events = 0  # cumulative trips (metrics)
+        # the board's key (shape bucket / replica id) — only used to label
+        # flight-recorder transition notes; None for standalone breakers
+        self.key = key
+
+    def _note_transition(self, new_state: str) -> None:
+        # flight recorder (obs/recorder.py): breaker transitions are core
+        # post-mortem evidence. A deque append — safe under self._lock.
+        flight_recorder.note(
+            "breaker", key=str(self.key), state=new_state
+        )
 
     @property
     def state(self) -> str:
@@ -66,6 +79,7 @@ class CircuitBreaker:
         ):
             self._state = HALF_OPEN
             self._probe_in_flight = False
+            self._note_transition(HALF_OPEN)
 
     def allow(self) -> bool:
         """May the caller attempt the protected operation right now?
@@ -81,10 +95,13 @@ class CircuitBreaker:
 
     def on_success(self) -> None:
         with self._lock:
+            was = self._state
             self._state = CLOSED
             self._consecutive_failures = 0
             self._opened_at = None
             self._probe_in_flight = False
+            if was != CLOSED:
+                self._note_transition(CLOSED)
 
     def on_failure(self) -> None:
         with self._lock:
@@ -95,6 +112,7 @@ class CircuitBreaker:
                 self._opened_at = self._clock()
                 self._probe_in_flight = False
                 self.open_events += 1
+                self._note_transition(OPEN)
                 return
             self._consecutive_failures += 1
             if (
@@ -104,6 +122,15 @@ class CircuitBreaker:
                 self._state = OPEN
                 self._opened_at = self._clock()
                 self.open_events += 1
+                self._note_transition(OPEN)
+
+    def snapshot(self) -> dict:
+        """State + cumulative trips, read atomically under this breaker's
+        lock (the board's snapshot uses this so `open_events` is never
+        read lockless while on_failure writes it)."""
+        with self._lock:
+            self._maybe_half_open()
+            return {"state": self._state, "open_events": self.open_events}
 
 
 class BreakerBoard:
@@ -132,7 +159,7 @@ class BreakerBoard:
         with self._lock:
             b = self._breakers.get(key)
             if b is None:
-                b = self._breakers[key] = CircuitBreaker(**self._kw)
+                b = self._breakers[key] = CircuitBreaker(**self._kw, key=key)
             return b
 
     def any_open(self) -> bool:
@@ -156,18 +183,23 @@ class BreakerBoard:
         dropped breaker's trips stay in the board's cumulative count."""
         with self._lock:
             b = self._breakers.pop(key, None)
-            if b is not None:
-                self._reset_open_events += b.open_events
+        if b is None:
+            return
+        # the dropped breaker's trips are read under ITS lock (snapshot)
+        # with the board lock released, then folded back in
+        trips = b.snapshot()["open_events"]
+        with self._lock:
+            self._reset_open_events += trips
 
     def snapshot(self) -> dict:
         with self._lock:
             breakers = list(self._breakers.items())
             dropped = self._reset_open_events
+        # each member read atomically under ITS lock (board lock released
+        # first — the board->breaker order here matches every other path)
+        per_key = {str(k): b.snapshot() for k, b in breakers}
         return {
             "open_events": dropped
-            + sum(b.open_events for _, b in breakers),
-            "by_key": {
-                str(k): {"state": b.state, "open_events": b.open_events}
-                for k, b in breakers
-            },
+            + sum(s["open_events"] for s in per_key.values()),
+            "by_key": per_key,
         }

@@ -219,6 +219,11 @@ def maybe_fail(site: str, **ctx) -> None:
 
         time.sleep(delay_s)
     if hit:
+        # flight recorder (obs/recorder.py): injected faults are exactly
+        # the events a post-mortem dump needs next to breaker/span entries
+        from mpi_cuda_imagemanipulation_tpu.obs import recorder
+
+        recorder.note("failpoint", site=site, n_call=n)
         raise FailpointError(site, n)
 
 

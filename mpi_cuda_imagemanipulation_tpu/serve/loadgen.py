@@ -359,6 +359,12 @@ def sweep(
                 rec["retried"] / rec["submitted"] if rec["submitted"] else 0.0
             )
             rec["degraded"] = after["degraded"] - before["degraded"]
+            # the p99's exemplar trace id (histogram bucket exemplars) —
+            # printed next to the percentile in the lane table, so the
+            # outlier links to its --trace-out spans without eyeballing
+            ex = app.metrics.e2e_exemplar(99)
+            if ex is not None:
+                rec["p99_exemplar"] = ex
             if fault_rate > 0.0:
                 rec["fault_rate"] = fault_rate
             records.append(rec)

@@ -125,24 +125,32 @@ class ServeMetrics:
     def on_reject(self) -> None:
         self._requests.inc(status="rejected")
 
-    def on_deadline(self, queue_wait_s: float) -> None:
+    def on_deadline(self, queue_wait_s: float, trace_id: str = "") -> None:
         with self._lock:
             self._requests.inc(status="deadline_expired")
             self._queued.dec()
-        self._queue_wait.observe(queue_wait_s)
+        self._queue_wait.observe(queue_wait_s, exemplar=trace_id or None)
 
-    def on_dispatch(self, n_real: int, n_slots: int, device_s: float) -> None:
+    def on_dispatch(
+        self, n_real: int, n_slots: int, device_s: float,
+        trace_id: str = "",
+    ) -> None:
         self._dispatches.inc()
         self._batch_real.inc(n_real)
         self._batch_slots.inc(n_slots)
-        self._device.observe(device_s)
+        self._device.observe(device_s, exemplar=trace_id or None)
 
-    def on_complete(self, queue_wait_s: float, e2e_s: float) -> None:
+    def on_complete(
+        self, queue_wait_s: float, e2e_s: float, trace_id: str = ""
+    ) -> None:
+        """`trace_id` rides as the latency histograms' exemplar: a p99
+        spike in the (federated) exposition then names the trace that
+        caused it instead of an anonymous bucket count."""
         with self._lock:
             self._requests.inc(status="ok")
             self._queued.dec()
-        self._queue_wait.observe(queue_wait_s)
-        self._e2e.observe(e2e_s)
+        self._queue_wait.observe(queue_wait_s, exemplar=trace_id or None)
+        self._e2e.observe(e2e_s, exemplar=trace_id or None)
 
     def on_error(self, n: int = 1) -> None:
         with self._lock:
@@ -163,6 +171,15 @@ class ServeMetrics:
         self._degraded.inc(n)
 
     # -- reporting ---------------------------------------------------------
+
+    def e2e_exemplar(self, q: float = 99) -> dict | None:
+        """The e2e-latency exemplar nearest the q-th percentile — the
+        trace id loadgen/bench reports print next to the outlier
+        percentile (obs/metrics.Histogram.exemplar_for_quantile)."""
+        ex = self._e2e.exemplar_for_quantile(q)
+        if ex is None:
+            return None
+        return {"trace_id": ex[0], "value_s": ex[1]}
 
     def snapshot(self) -> dict:
         dispatches = int(self._dispatches.value())

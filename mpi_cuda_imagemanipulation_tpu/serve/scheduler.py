@@ -63,6 +63,7 @@ from collections import OrderedDict, deque
 import numpy as np
 
 from mpi_cuda_imagemanipulation_tpu.engine import Engine, EngineMetrics
+from mpi_cuda_imagemanipulation_tpu.obs import recorder as flight_recorder
 from mpi_cuda_imagemanipulation_tpu.obs import trace as obs_trace
 from mpi_cuda_imagemanipulation_tpu.resilience import failpoints
 from mpi_cuda_imagemanipulation_tpu.resilience.breaker import (
@@ -455,7 +456,7 @@ class MicroBatchScheduler:
         for r in batch:
             r.coalesce_span.end()  # popped: the micro-batching wait is over
             if r.deadline is not None and now > r.deadline:
-                self.metrics.on_deadline(now - r.t_submit)
+                self.metrics.on_deadline(now - r.t_submit, r.trace_id)
                 self._resolve(r, STATUS_DEADLINE, "expired while queued")
             else:
                 live.append(r)
@@ -531,6 +532,13 @@ class MicroBatchScheduler:
                     "breaker.not_closed", parent=r.trace_ctx(),
                     bucket=str(bucket), state=breaker.state,
                 )
+            # breaker-open is a flight-recorder dump trigger: the ring
+            # (recent dispatches, failpoint hits, warnings) explains
+            # which bucket was hot when the path failed (rate-limited)
+            flight_recorder.dump(
+                "breaker_open",
+                extra={"scope": "serve", "bucket": str(bucket)},
+            )
         self._update_health()
         self._log.warning(
             "dispatch failed after %d attempts for bucket %s: %s",
@@ -541,6 +549,10 @@ class MicroBatchScheduler:
             obs_trace.event(
                 "serve.quarantine", parent=live[0].trace_ctx(),
                 error=type(e).__name__,
+            )
+            flight_recorder.dump(
+                "quarantine",
+                extra={"bucket": str(bucket), "error": type(e).__name__},
             )
             self._resolve(
                 live[0], STATUS_QUARANTINED, f"{type(e).__name__}: {e}"
@@ -646,7 +658,16 @@ class MicroBatchScheduler:
             return out, nb, self._clock() - t0
 
     def _complete(self, live, out, nb, device_s) -> None:
-        self.metrics.on_dispatch(len(live), nb, device_s)
+        batch_tid = next((r.trace_id for r in live if r.trace_id), "")
+        self.metrics.on_dispatch(len(live), nb, device_s, batch_tid)
+        # flight recorder: per-dispatch bucket summaries are the "which
+        # bucket was hot" evidence a post-mortem dump aggregates
+        flight_recorder.note(
+            "dispatch",
+            bucket="{}x{}x{}".format(*live[0].bucket),
+            n=len(live),
+            device_ms=device_s * 1e3,
+        )
         t_done = self._clock()
         for k, r in enumerate(live):
             r.result = out[k, : r.true_h, : r.true_w, ...]
@@ -655,6 +676,7 @@ class MicroBatchScheduler:
             self.metrics.on_complete(
                 (r.t_dispatch or r.t_submit) - r.t_submit,
                 t_done - r.t_submit,
+                r.trace_id,
             )
             r.trace.set(status=STATUS_OK)
             r.trace.end()
@@ -699,6 +721,13 @@ class MicroBatchScheduler:
                         "serve.quarantine", parent=r.trace_ctx(),
                         error=type(e).__name__,
                     )
+                    flight_recorder.dump(
+                        "quarantine",
+                        extra={
+                            "bucket": str(bucket),
+                            "error": type(e).__name__,
+                        },
+                    )
                     self._resolve(
                         r, STATUS_QUARANTINED, f"{type(e).__name__}: {e}"
                     )
@@ -735,7 +764,7 @@ class MicroBatchScheduler:
             r.status = STATUS_OK
             self.metrics.on_degraded()
             self.metrics.on_complete(
-                r.t_dispatch - r.t_submit, t_done - r.t_submit
+                r.t_dispatch - r.t_submit, t_done - r.t_submit, r.trace_id
             )
             r.trace.set(status=STATUS_OK, degraded=True)
             r.trace.end()

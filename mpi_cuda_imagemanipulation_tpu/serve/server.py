@@ -242,6 +242,23 @@ class ServeApp:
         app's registry (serving + engine + health/breaker/cache gauges)."""
         return self.registry.render()
 
+    def fleet_registries(self) -> list[Registry]:
+        """The registries this process federates to the router
+        (obs/fleet.py): the app registry (serve + engine + gauges) plus
+        the module-level plan registry (plan builds report there, and
+        serving rebuilds on calibration flips are fleet-relevant)."""
+        from mpi_cuda_imagemanipulation_tpu.plan.metrics import plan_metrics
+
+        return [self.registry, plan_metrics.registry]
+
+    def fleet_snapshot(self) -> dict:
+        """A FULL federation snapshot (the replica's `GET /fleet/snapshot`
+        body — the router's heartbeat-gap fallback and the CI federation
+        equality check read this)."""
+        from mpi_cuda_imagemanipulation_tpu.obs import fleet
+
+        return fleet.snapshot_registries(self.fleet_registries())
+
     def start(self) -> "ServeApp":
         warm_s = self.cache.warmup()
         self._log.info(
@@ -357,6 +374,10 @@ def _make_handler(app: ServeApp):
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+            elif self.path == "/fleet/snapshot":
+                # full federation snapshot (obs/fleet.py) — the router's
+                # heartbeat-gap full-scrape fallback hits this
+                self._send_json(200, app.fleet_snapshot())
             else:
                 self._send_json(404, {"error": f"no route {self.path}"})
 

@@ -75,8 +75,12 @@ class StreamMetrics:
     def peak_resident_bytes(self) -> int:
         return int(self.resident_peak.value())
 
-    def on_stage(self, stage: str, seconds: float) -> None:
-        self.stage.observe(seconds, stage=stage)
+    def on_stage(
+        self, stage: str, seconds: float, exemplar: str | None = None
+    ) -> None:
+        # exemplar: the stream's trace id joins a stage-latency spike in
+        # the exposition to its tile span chain (obs/metrics.py)
+        self.stage.observe(seconds, stage=stage, exemplar=exemplar)
 
     def snapshot(self) -> dict:
         return {

@@ -184,7 +184,10 @@ def stream_pipeline(
         finally:
             metrics.untrack(host.nbytes)
             metrics.untrack(ext_bytes.pop(key, 0))
-            metrics.on_stage("write", time.perf_counter() - t0)
+            metrics.on_stage(
+                "write", time.perf_counter() - t0,
+                exemplar=obs_trace.current_trace_id() or None,
+            )
         if journal is not None:
             # flush first: the ok record claims these rows survive a kill
             writer.flush()

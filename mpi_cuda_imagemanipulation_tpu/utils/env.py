@@ -53,6 +53,35 @@ _VARS = (
     EnvVar("MCIM_LOG_LEVEL", None, "utils/log.py",
            "Logger verbosity: level name or number (DEBUG..CRITICAL or "
            "10..50); default INFO."),
+    # -- flight recorder (obs/recorder.py) -----------------------------------
+    EnvVar("MCIM_RECORDER_DIR", None, "obs/recorder.py",
+           "Directory post-mortem flight-recorder dumps are written to "
+           "(default artifacts/recorder/)."),
+    EnvVar("MCIM_RECORDER_CAP", "2048", "obs/recorder.py",
+           "Flight-recorder ring capacity: the newest N entries (span/"
+           "dispatch/failpoint/breaker/heartbeat/log facts) a dump can "
+           "contain."),
+    EnvVar("MCIM_RECORDER_MIN_INTERVAL_S", "30", "obs/recorder.py",
+           "Per-trigger dump rate limit in seconds: a quarantine storm "
+           "produces one artifact per window, not thousands."),
+    # -- SLO burn-rate engine (obs/slo.py) -----------------------------------
+    EnvVar("MCIM_SLO_SPECS", "avail:99.5,latency:1.0:99", "obs/slo.py",
+           "Default SLO spec list for the fabric router's /slo engine: "
+           "comma-separated avail:<pct> and latency:<le_seconds>:<pct> "
+           "entries (docs/design.md \"Fleet observability\")."),
+    EnvVar("MCIM_SLO_FAST_S", "300", "obs/slo.py",
+           "Fast burn-rate window in seconds (the 5m page window; an "
+           "alert fires only when fast AND slow burn exceed the "
+           "threshold)."),
+    EnvVar("MCIM_SLO_SLOW_S", "3600", "obs/slo.py",
+           "Slow burn-rate window in seconds (the 1h confirmation "
+           "window)."),
+    EnvVar("MCIM_SLO_TICK_S", "5", "obs/slo.py",
+           "SLO engine evaluation period in seconds (each tick samples "
+           "the federated counters into the window ring)."),
+    EnvVar("MCIM_SLO_BURN_THRESHOLD", "10", "obs/slo.py",
+           "Burn-rate alert threshold: error-budget consumption rate "
+           "(1 = exactly on budget) both windows must exceed to fire."),
     # -- concurrency checking (analysis/lockcheck.py) -----------------------
     EnvVar("MCIM_LOCK_CHECK", None, "analysis/lockcheck.py",
            "=1: instrument threading.Lock/RLock/Condition with the "

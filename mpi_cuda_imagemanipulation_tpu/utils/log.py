@@ -39,6 +39,25 @@ def _level_from_env(default: int = logging.INFO) -> int:
     return level if isinstance(level, int) else default
 
 
+class _RecorderHandler(logging.Handler):
+    """WARNING+ log lines feed the flight recorder's ring (obs/recorder):
+    a post-mortem dump then carries the process's recent warnings next to
+    its span/dispatch/breaker entries. Failures here must never break
+    logging itself."""
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            from mpi_cuda_imagemanipulation_tpu.obs import recorder
+
+            recorder.note(
+                "log",
+                level=record.levelname,
+                msg=record.getMessage()[:300],
+            )
+        except Exception:  # a broken ring must never kill logging
+            pass
+
+
 class TraceAdapter(logging.LoggerAdapter):
     """Prefixes messages with the active obs trace id — the log/trace
     join key. No-allocation when untraced (the common case): the id
@@ -63,6 +82,8 @@ def get_logger(
         handler = logging.StreamHandler(sys.stderr)
         handler.setFormatter(logging.Formatter(_FORMAT))
         logger.addHandler(handler)
+        rec_handler = _RecorderHandler(level=logging.WARNING)
+        logger.addHandler(rec_handler)
         logger.setLevel(level if level is not None else _level_from_env())
         logger.propagate = False
     elif level is not None:

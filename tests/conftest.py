@@ -24,6 +24,15 @@ claim_platform("cpu", n_host_devices=8, keep_existing_count=True)
 # BENCH_HISTORY.jsonl (bench.py _append_history honors this)
 os.environ["MCIM_NO_HISTORY"] = "1"
 
+# flight-recorder dumps (obs/recorder.py) triggered by breaker/quarantine
+# tests land in a scratch dir, never in the working tree
+import tempfile  # noqa: E402
+
+os.environ.setdefault(
+    "MCIM_RECORDER_DIR",
+    os.path.join(tempfile.gettempdir(), f"mcim_recorder_{os.getpid()}"),
+)
+
 # share the persistent XLA compilation cache (tools/tpu_queue/_lib.sh):
 # CPU executables cache too, cutting repeat full-suite wall time — keyed
 # on HLO + compile options, so cached runs cannot change results

@@ -444,15 +444,26 @@ def test_simulated_hosts_xla_flags():
 # --------------------------------------------------------------------------
 
 
-def test_churn_acceptance_kill_one_of_three_mid_loadgen():
+def test_churn_acceptance_kill_one_of_three_mid_loadgen(
+    tmp_path, monkeypatch
+):
     """The headline: a 3-replica fabric takes a SIGKILL of its hottest
     replica mid-sweep with 100% of accepted requests resolving ok
-    (bit-exact), the router breaker opens for the dead replica, and the
-    supervisor-restarted replica rejoins and receives traffic."""
+    (bit-exact), the router breaker opens for the dead replica, the
+    supervisor-restarted replica rejoins and receives traffic — and the
+    death leaves a flight-recorder post-mortem dump naming the dead
+    replica's warm buckets (obs/recorder.py)."""
+    import json
+    import os
+
     from mpi_cuda_imagemanipulation_tpu.fabric.supervisor import (
         Fabric,
         FabricConfig,
     )
+
+    rec_dir = str(tmp_path / "recorder")
+    monkeypatch.setenv("MCIM_RECORDER_DIR", rec_dir)
+    monkeypatch.setenv("MCIM_RECORDER_MIN_INTERVAL_S", "0")
 
     pipe = Pipeline.parse(OPS)
     images = [
@@ -523,6 +534,21 @@ def test_churn_acceptance_kill_one_of_three_mid_loadgen():
         assert victim in seen, (
             f"restarted {victim} never served again (saw {seen})"
         )
+        # 6. the death left a post-mortem: the supervisor's replica_death
+        # dump names the victim and its warm buckets (from the router
+        # ring's last heartbeat note — the dead process's own ring died
+        # with it, which is exactly why the supervisor dumps)
+        dumps = sorted(
+            p
+            for p in (os.listdir(rec_dir) if os.path.isdir(rec_dir) else [])
+            if p.startswith("recorder_replica_death")
+        )
+        assert dumps, f"no replica_death dump in {rec_dir}"
+        with open(os.path.join(rec_dir, dumps[0])) as f:
+            dump = json.load(f)
+        assert dump["extra"]["replica"] == victim
+        assert dump["extra"].get("warm_buckets"), dump["extra"]
+        assert dump["summary"]["last_heartbeat"].get(victim)
 
 
 @pytest.mark.slow

@@ -1,0 +1,669 @@
+"""Fleet observability (ISSUE 11) — metrics federation, SLO burn rates,
+exemplars, the flight recorder, and the bench-regression sentinel.
+
+The load-bearing invariants:
+  1. exposition round-trips adversarial label values (escapes in render
+     AND parse) and every family carries # TYPE/# HELP;
+  2. federation math: merged histogram buckets equal the buckets of the
+     POOLED observations (so percentiles agree at bucket resolution),
+     counter sums survive a replica restart without double-counting, and
+     stale replicas age out of the view;
+  3. the SLO engine's multi-window burn alert fires under injected
+     faults and clears on recovery — end to end through a real router +
+     replicas, visible at GET /slo;
+  4. the federated p99 carries an exemplar trace id that resolves to a
+     closed router->replica span chain;
+  5. the flight recorder is bounded, dump triggers are the closed
+     KNOWN_TRIGGERS vocabulary, and dumps name the hot buckets;
+  6. tools/bench_regress.py is green on the committed BENCH_HISTORY.jsonl
+     and trips on a synthetic regression.
+"""
+
+import json
+import os
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mpi_cuda_imagemanipulation_tpu.obs import fleet, recorder, slo
+from mpi_cuda_imagemanipulation_tpu.obs import trace as obs_trace
+from mpi_cuda_imagemanipulation_tpu.obs.metrics import (
+    Registry,
+    parse_exposition,
+    parse_labels,
+)
+
+try:
+    from hypothesis import given, settings
+    from hypothesis import strategies as st
+
+    HAVE_HYPOTHESIS = True
+except ImportError:
+    HAVE_HYPOTHESIS = False
+
+
+class _Clock:
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+# --------------------------------------------------------------------------
+# exposition round trip (satellite: escaping + parser)
+# --------------------------------------------------------------------------
+
+ADVERSARIAL_VALUES = [
+    'plain',
+    'with "quotes"',
+    "back\\slash",
+    "new\nline",
+    'all "of\\it"\ntogether',
+    'trailing brace} ',
+    'a"} b',  # the value that breaks rpartition-style parsing
+    "comma,equals=brace{",
+    "",
+]
+
+
+def _roundtrip(values: list[str]) -> None:
+    r = Registry()
+    c = r.counter("mcim_serve_adv_total", 'help with "quotes"\nand newline',
+                  labels=("v",))
+    for i, v in enumerate(values):
+        c.inc(i + 1, v=v)
+    text = r.render()
+    fams = parse_exposition(text)
+    fam = fams["mcim_serve_adv_total"]
+    assert fam["type"] == "counter"
+    assert fam["help"] == 'help with "quotes"\nand newline'
+    got = {
+        parse_labels(labels)["v"]: val
+        for (_n, labels), val in fam["samples"].items()
+    }
+    assert got == {v: float(i + 1) for i, v in enumerate(values)}
+
+
+def test_exposition_roundtrips_adversarial_labels():
+    _roundtrip(ADVERSARIAL_VALUES)
+
+
+if HAVE_HYPOTHESIS:
+
+    @settings(max_examples=50, deadline=None)
+    @given(
+        st.lists(
+            st.text(min_size=0, max_size=12).filter(
+                lambda s: "\r" not in s
+            ),
+            min_size=1,
+            max_size=5,
+            unique=True,
+        )
+    )
+    def test_exposition_roundtrip_property(values):
+        _roundtrip(values)
+
+
+def test_every_family_has_type_and_help():
+    r = Registry()
+    r.counter("mcim_serve_a_total", "a")
+    r.gauge("mcim_serve_b", "b", labels=("x",))  # labeled, zero samples
+    r.histogram("mcim_serve_c_seconds", "c")
+    text = r.render()
+    fams = parse_exposition(text)
+    for name in ("mcim_serve_a_total", "mcim_serve_b",
+                 "mcim_serve_c_seconds"):
+        assert fams[name]["type"] != "untyped", name
+        assert fams[name]["help"], name
+        assert f"# HELP {name} " in text and f"# TYPE {name} " in text
+
+
+def test_histogram_exemplars_render_parse_and_quantile():
+    r = Registry()
+    h = r.histogram("mcim_serve_lat_seconds", "lat")
+    h.observe(0.02, exemplar="fast-trace")
+    for _ in range(89):
+        h.observe(0.03)
+    for _ in range(9):
+        h.observe(0.8)
+    h.observe(0.8, exemplar="slow-trace")
+    fams = parse_exposition(r.render())
+    exs = fams["mcim_serve_lat_seconds"]["exemplars"]
+    ids = {e["labels"]["trace_id"] for e in exs.values()}
+    assert ids == {"fast-trace", "slow-trace"}
+    # the p99 exemplar is the slow outlier, the p10 the fast one
+    assert h.exemplar_for_quantile(99)[0] == "slow-trace"
+    assert h.exemplar_for_quantile(10)[0] == "fast-trace"
+
+
+# --------------------------------------------------------------------------
+# federation math
+# --------------------------------------------------------------------------
+
+
+def _replica_registry(seed: int, n: int):
+    r = Registry()
+    c = r.counter("mcim_serve_requests_total", "req", labels=("status",))
+    h = r.histogram("mcim_serve_e2e_latency_seconds", "lat")
+    g = r.gauge("mcim_serve_queue_depth", "queue")
+    rng = np.random.default_rng(seed)
+    samples = []
+    for i in range(n):
+        v = float(rng.uniform(0.0, 3.0))
+        samples.append(v)
+        h.observe(v, exemplar=f"t{seed}-{i}")
+        c.inc(status="ok")
+    g.set(float(seed))
+    return r, samples
+
+
+def _pooled_buckets(samples):
+    ref = Registry().histogram("mcim_serve_ref_seconds", "ref")
+    for v in samples:
+        ref.observe(v)
+    return ref.data()[()]
+
+
+def _federate(regs, *, clock=None):
+    clock = clock or _Clock()
+    agg = fleet.FleetAggregator(stale_s=5.0, clock=clock)
+    for i, reg in enumerate(regs):
+        src = fleet.DeltaSource([reg])
+        payload = json.loads(json.dumps(src.delta()))  # the wire hop
+        assert agg.apply(f"r{i}", "i1", payload)
+    return agg
+
+
+def _merged_percentiles_match(seeds_and_sizes):
+    regs, all_samples = [], []
+    for seed, n in seeds_and_sizes:
+        reg, samples = _replica_registry(seed, n)
+        regs.append(reg)
+        all_samples.extend(samples)
+    agg = _federate(regs)
+    merged = agg.merged()
+    entry = merged["mcim_serve_e2e_latency_seconds"]
+    data = entry["series"][()]
+    ref = _pooled_buckets(all_samples)
+    # bucket-exact: the merged histogram IS the pooled histogram, so any
+    # quantile estimated from it equals the pooled estimate exactly
+    assert data["buckets"] == ref["buckets"]
+    assert data["count"] == ref["count"]
+    assert data["sum"] == pytest.approx(ref["sum"])
+    for q in (50, 95, 99):
+        got = fleet.quantile_from_buckets(
+            entry["bounds"], data["buckets"], data["count"], q
+        )
+        want = fleet.quantile_from_buckets(
+            entry["bounds"], ref["buckets"], ref["count"], q
+        )
+        assert got == want
+    # counters summed
+    total = merged["mcim_serve_requests_total"]["series"][("ok",)]
+    assert total == float(len(all_samples))
+
+
+def test_merged_histogram_equals_pooled_observations():
+    _merged_percentiles_match([(1, 40), (2, 70), (3, 25)])
+
+
+if HAVE_HYPOTHESIS:
+
+    @settings(max_examples=25, deadline=None)
+    @given(
+        st.lists(
+            st.tuples(
+                st.integers(min_value=0, max_value=1000),
+                st.integers(min_value=1, max_value=40),
+            ),
+            min_size=1,
+            max_size=4,
+        )
+    )
+    def test_merged_histogram_pooled_property(seeds_and_sizes):
+        _merged_percentiles_match(seeds_and_sizes)
+
+
+def test_counter_sums_survive_replica_restart():
+    clock = _Clock()
+    agg = fleet.FleetAggregator(stale_s=5.0, clock=clock)
+    reg1, _ = _replica_registry(1, 30)
+    src1 = fleet.DeltaSource([reg1])
+    assert agg.apply("r0", "inc-a", src1.delta())
+    assert (
+        agg.merged()["mcim_serve_requests_total"]["series"][("ok",)] == 30.0
+    )
+    # restart: fresh registry (counters back to 0), new incarnation
+    reg2, _ = _replica_registry(1, 7)
+    src2 = fleet.DeltaSource([reg2])
+    assert agg.apply("r0", "inc-b", src2.delta())
+    merged = agg.merged()["mcim_serve_requests_total"]["series"][("ok",)]
+    assert merged == 37.0  # 30 banked + 7 new — no double count, no reset
+    # histogram counts fold the same way
+    lat = agg.merged()["mcim_serve_e2e_latency_seconds"]["series"][()]
+    assert lat["count"] == 37
+
+
+def test_delta_carries_only_changed_series_and_resync_recovers():
+    reg, _ = _replica_registry(5, 10)
+    src = fleet.DeltaSource([reg])
+    clock = _Clock()
+    agg = fleet.FleetAggregator(stale_s=5.0, clock=clock)
+    first = src.delta()
+    assert first["full"]
+    assert agg.apply("r0", "i1", first)
+    src.ack(first["seq"])
+    reg.get("mcim_serve_requests_total").inc(status="error")
+    d = src.delta()
+    assert not d["full"]
+    assert set(d["metrics"]) == {"mcim_serve_requests_total"}
+    assert len(d["metrics"]["mcim_serve_requests_total"]["series"]) == 1
+    # a router that lost its baseline refuses the delta and asks to resync
+    fresh = fleet.FleetAggregator(stale_s=5.0, clock=clock)
+    assert fresh.apply("r0", "i1", d) is False
+    src.force_full()
+    full = src.delta()
+    assert full["full"]
+    assert fresh.apply("r0", "i1", full)
+    got = fresh.merged()["mcim_serve_requests_total"]["series"]
+    assert got[("error",)] == 1.0 and got[("ok",)] == 10.0
+
+
+def test_stale_replicas_age_out_of_fleet_view():
+    clock = _Clock()
+    agg = fleet.FleetAggregator(stale_s=2.0, clock=clock)
+    reg1, _ = _replica_registry(1, 10)
+    reg2, _ = _replica_registry(2, 20)
+    s1, s2 = fleet.DeltaSource([reg1]), fleet.DeltaSource([reg2])
+    assert agg.apply("r0", "i1", s1.delta())
+    assert agg.apply("r1", "i1", s2.delta())
+    assert agg.merged()["mcim_serve_requests_total"]["series"][("ok",)] == 30
+    clock.t += 3.0  # r0 and r1 both stale now; refresh only r1
+    assert agg.apply("r1", "i1", s2.delta())
+    assert agg.fresh_ids() == ["r1"]
+    merged = agg.merged()
+    assert merged["mcim_serve_requests_total"]["series"][("ok",)] == 20.0
+    # gauges: only the fresh replica's label remains
+    assert set(merged["mcim_serve_queue_depth"]["series"]) == {("r1",)}
+
+
+def test_fleet_render_parses_and_gauges_carry_replica_label():
+    agg = _federate([_replica_registry(i, 5)[0] for i in (1, 2)])
+    fams = parse_exposition(agg.render())
+    assert fams["mcim_serve_requests_total"]["type"] == "counter"
+    gauge_labels = {
+        parse_labels(labels).get("replica")
+        for (_n, labels) in fams["mcim_serve_queue_depth"]["samples"]
+    }
+    assert gauge_labels == {"r0", "r1"}
+    # federated exemplars survive the merge + render
+    assert fams["mcim_serve_e2e_latency_seconds"]["exemplars"]
+
+
+# --------------------------------------------------------------------------
+# SLO engine units
+# --------------------------------------------------------------------------
+
+
+def test_parse_slo_specs_grammar():
+    specs = slo.parse_slo_specs("avail:99.5, latency:0.25:99")
+    assert [s.kind for s in specs] == ["availability", "latency"]
+    assert specs[0].target == pytest.approx(0.995)
+    assert specs[1].le == 0.25
+    for bad in ("avail", "avail:0", "avail:100", "latency:0.25",
+                "latency:-1:99", "p99<250ms"):
+        with pytest.raises(ValueError, match="bad SLO spec"):
+            slo.parse_slo_specs(bad)
+
+
+def test_slo_burn_alert_fires_and_clears_with_fake_clock():
+    state = {"good": 0.0, "total": 0.0}
+
+    def source(sp):
+        return {s.name: (state["good"], state["total"]) for s in sp}
+
+    clock = _Clock(0.0)
+    reg = Registry()
+    eng = slo.SLOEngine(
+        slo.parse_slo_specs("avail:99"), source,
+        fast_s=2.0, slow_s=8.0, tick_s=0.5, burn_threshold=5.0,
+        registry=reg, clock=clock,
+    )
+
+    def drive(n, good_per_tick, total_per_tick):
+        for _ in range(n):
+            clock.t += 0.5
+            state["good"] += good_per_tick
+            state["total"] += total_per_tick
+            eng.tick()
+
+    drive(20, 50, 50)  # healthy
+    a = eng.status()["slos"]["availability_99"]
+    assert a["alert"] == "ok" and a["burn_fast"] == 0.0
+    drive(8, 25, 50)  # 50% failures: burn 50 >> 5 in both windows
+    a = eng.status()["slos"]["availability_99"]
+    assert a["alert"] == "firing"
+    assert a["burn_fast"] > 5.0 and a["burn_slow"] > 5.0
+    drive(20, 50, 50)  # recovery: the fast window clears the alert
+    a = eng.status()["slos"]["availability_99"]
+    assert a["alert"] == "ok" and a["transitions"] == 2
+    text = reg.render()
+    assert 'mcim_slo_transitions_total{slo="availability_99",to="firing"} 1' in text
+    assert 'mcim_slo_transitions_total{slo="availability_99",to="ok"} 1' in text
+
+
+def test_slo_latency_kind_reads_histogram_buckets():
+    reg, _ = _replica_registry(3, 0)
+    h = reg.get("mcim_serve_e2e_latency_seconds")
+    for _ in range(90):
+        h.observe(0.01)
+    for _ in range(10):
+        h.observe(2.0)  # 10% slower than the 0.25s bound
+    agg = _federate([reg])
+    source = slo.fleet_slo_source(agg.merged)
+    specs = slo.parse_slo_specs("latency:0.25:99")
+    got = source(specs)[specs[0].name]
+    assert got == (90.0, 100.0)
+
+
+# --------------------------------------------------------------------------
+# flight recorder units
+# --------------------------------------------------------------------------
+
+
+def test_recorder_ring_is_bounded_and_summarises_hot_buckets(tmp_path):
+    recorder.configure(cap=16)
+    try:
+        for _i in range(100):
+            recorder.note("dispatch", bucket="48x48x3", n=2)
+        recorder.note("dispatch", bucket="96x96x3", n=1)
+        entries = recorder.get_recorder().entries()
+        assert len(entries) == 16  # bounded
+        s = recorder.get_recorder().summary()
+        assert list(s["hot_buckets"]) == ["48x48x3", "96x96x3"]
+        path = recorder.dump(
+            "manual", path=str(tmp_path / "d.json"), force=True
+        )
+        with open(path) as f:
+            payload = json.load(f)
+        assert payload["trigger"] == "manual"
+        assert payload["summary"]["hot_buckets"]["48x48x3"] == 30
+    finally:
+        recorder.configure(cap=None)
+
+
+def test_recorder_rejects_unknown_trigger_and_rate_limits(tmp_path):
+    rec = recorder.FlightRecorder(cap=8)
+    with pytest.raises(ValueError, match="unknown recorder trigger"):
+        rec.dump("not_a_trigger")
+    p1 = rec.dump("manual", path=str(tmp_path / "a.json"))
+    assert p1 is not None
+    # second dump inside the rate window is suppressed unless forced
+    assert rec.dump("manual", path=str(tmp_path / "b.json")) is None
+    assert rec.dump("manual", path=str(tmp_path / "c.json"), force=True)
+
+
+def test_recorder_captures_breaker_and_failpoint_facts():
+    from mpi_cuda_imagemanipulation_tpu.resilience import failpoints
+    from mpi_cuda_imagemanipulation_tpu.resilience.breaker import (
+        CircuitBreaker,
+    )
+
+    rec = recorder.configure(cap=64)
+    try:
+        b = CircuitBreaker(failure_threshold=2, key=("48", "48", 3))
+        b.on_failure()
+        b.on_failure()  # trips open -> noted
+        failpoints.configure("serve.dispatch=always")
+        with pytest.raises(failpoints.FailpointError):
+            failpoints.maybe_fail("serve.dispatch")
+        kinds = {k for _ts, k, _f in rec.entries()}
+        assert {"breaker", "failpoint"} <= kinds
+        breaker_notes = [
+            f for _ts, k, f in rec.entries() if k == "breaker"
+        ]
+        assert breaker_notes[-1]["state"] == "open"
+    finally:
+        failpoints.clear()
+        recorder.configure(cap=None)
+
+
+# --------------------------------------------------------------------------
+# bench-regression sentinel
+# --------------------------------------------------------------------------
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _bench_regress():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_regress", os.path.join(_REPO_ROOT, "tools", "bench_regress.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_regress_green_on_committed_history():
+    br = _bench_regress()
+    hist = os.path.join(_REPO_ROOT, "BENCH_HISTORY.jsonl")
+    assert br.main(["--history", hist]) == 0
+
+
+def test_bench_regress_trips_on_synthetic_regression():
+    br = _bench_regress()
+    hist = os.path.join(_REPO_ROOT, "BENCH_HISTORY.jsonl")
+    # --self-test synthesizes a halved headline and REQUIRES a trip
+    assert br.main(["--history", hist, "--self-test"]) == 0
+
+
+def test_bench_regress_candidate_mode(tmp_path):
+    br = _bench_regress()
+    lines = br.load_history(os.path.join(_REPO_ROOT, "BENCH_HISTORY.jsonl"))
+    good = br.synthesize_regressed(lines)[0]
+    # un-halve: a candidate at the historical level passes
+    for field, value, _h in br._metrics_of(good):
+        good[field] = value * 2.0
+    cand = tmp_path / "cand.json"
+    cand.write_text(json.dumps({"records": [good]}))
+    hist = os.path.join(_REPO_ROOT, "BENCH_HISTORY.jsonl")
+    assert br.main(["--history", hist, "--candidate", str(cand)]) == 0
+    for field, value, _h in br._metrics_of(good):
+        good[field] = value * 0.25
+    cand.write_text(json.dumps({"records": [good]}))
+    assert br.main(["--history", hist, "--candidate", str(cand)]) == 1
+
+
+def test_bench_regress_noise_model():
+    br = _bench_regress()
+    # tight history: 10% drop is outside the 25% floor? no — inside
+    assert br.check_value([100, 101, 99, 100], 90)["ok"]
+    # a 40% drop is a regression even with some spread
+    assert not br.check_value([100, 101, 99, 100], 60)["ok"]
+    # noisy history widens the allowance (MAD term dominates)
+    noisy = [100, 40, 120, 60, 110]
+    assert br.check_value(noisy, 55)["ok"]
+    # single prior point: 40% tolerance
+    assert br.check_value([100], 61)["ok"]
+    assert not br.check_value([100], 59)["ok"]
+
+
+# --------------------------------------------------------------------------
+# ACCEPTANCE: router + replicas — /slo alert fire/clear, federated p99
+# exemplar resolving to a closed router->replica chain, federation equality
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def slo_fabric():
+    """Router (fast SLO windows) + two in-process replicas with
+    max_batch=1 and retry_attempts=1, so an injected dispatch fault fails
+    exactly its own request — a 10% failpoint is a 10% error rate."""
+    from mpi_cuda_imagemanipulation_tpu.fabric.replica import ReplicaRuntime
+    from mpi_cuda_imagemanipulation_tpu.fabric.router import (
+        Router,
+        RouterConfig,
+    )
+    from mpi_cuda_imagemanipulation_tpu.serve.bucketing import parse_buckets
+    from mpi_cuda_imagemanipulation_tpu.serve.server import ServeConfig
+
+    cfg = ServeConfig(
+        ops="grayscale,contrast:3.5",
+        buckets=parse_buckets("48"),
+        max_batch=1,
+        max_delay_ms=1.0,
+        queue_depth=64,
+        channels=(3,),
+        retry_attempts=1,
+        breaker_threshold=1000,  # keep the breaker out of this test
+    )
+    router = Router(
+        RouterConfig(
+            buckets=parse_buckets("48"),
+            stale_s=1.5,
+            forward_attempts=1,  # a failed request must FAIL, not reroute
+            slo_specs="avail:99",
+            slo_fast_s=1.2,
+            slo_slow_s=6.0,
+            slo_tick_s=0.1,
+            slo_burn_threshold=2.0,
+        )
+    ).start()
+    reps = [
+        ReplicaRuntime(f"r{i}", router.url, cfg, heartbeat_s=0.15).start()
+        for i in range(2)
+    ]
+    deadline = time.monotonic() + 120.0
+    while len(router._routable()) < 2:
+        assert time.monotonic() < deadline, "replicas never registered"
+        time.sleep(0.05)
+    yield router
+    for rt in reps:
+        rt.close()
+    router.close()
+
+
+def _slo_view(router) -> dict:
+    with urllib.request.urlopen(router.url + "/slo", timeout=10.0) as resp:
+        return json.loads(resp.read())
+
+
+def test_slo_alert_fires_and_clears_end_to_end(slo_fabric):
+    from mpi_cuda_imagemanipulation_tpu.io.image import (
+        encode_image_bytes,
+        synthetic_image,
+    )
+    from mpi_cuda_imagemanipulation_tpu.resilience import failpoints
+    from mpi_cuda_imagemanipulation_tpu.serve import loadgen
+
+    router = slo_fabric
+    tracer = obs_trace.configure(sample=1.0)
+    blob = encode_image_bytes(synthetic_image(44, 44, channels=3, seed=3))
+
+    def pump(n, sleep_s=0.01):
+        codes = []
+        for _ in range(n):
+            codes.append(loadgen.http_post_image(router.url, blob)["code"])
+            time.sleep(sleep_s)
+        return codes
+
+    try:
+        pump(20)  # healthy baseline traffic
+        # -- 10% injected dispatch faults -> availability burn fires ------
+        # (retry_attempts=1 + max_batch=1: every hit quarantines exactly
+        # one request, so the error rate IS the failpoint rate; burn =
+        # 0.10 / 0.01 = 10 > threshold 2 in both windows)
+        failpoints.configure("serve.dispatch=0.1", seed=11)
+        fired = False
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline and not fired:
+            pump(10, sleep_s=0.005)
+            view = _slo_view(router)
+            fired = view["slos"]["availability_99"]["alert"] == "firing"
+        assert fired, f"availability alert never fired: {_slo_view(router)}"
+        # -- recovery: faults cleared, the fast window drains -> clears ---
+        failpoints.clear()
+        cleared = False
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline and not cleared:
+            pump(10, sleep_s=0.005)
+            view = _slo_view(router)
+            cleared = view["slos"]["availability_99"]["alert"] == "ok"
+        assert cleared, f"alert never cleared: {_slo_view(router)}"
+        assert view["slos"]["availability_99"]["transitions"] >= 2
+
+        # -- federated p99 exemplar -> closed router->replica chain -------
+        p99 = view["p99"]
+        assert p99["p99_s"] is not None
+        tid = p99["exemplar_trace_id"]
+        assert tid, p99
+        by_name: dict[str, list] = {}
+        for e in tracer.drain():
+            if e.get("args", {}).get("trace_id") == tid:
+                by_name.setdefault(e["name"], []).append(e)
+        for name in ("fabric.request", "fabric.forward", "serve.request",
+                     "serve.dispatch"):
+            assert name in by_name, (
+                f"exemplar trace {tid}: span {name!r} missing "
+                f"({sorted(by_name)})"
+            )
+        # closed parentage across the hop: fabric.forward under the root
+        root_id = by_name["fabric.request"][0]["args"]["span_id"]
+        assert (
+            by_name["fabric.forward"][0]["args"].get("parent_id") == root_id
+        )
+    finally:
+        failpoints.clear()
+        obs_trace.disable()
+
+
+def test_federated_metrics_equal_sum_of_replica_registries(slo_fabric):
+    from mpi_cuda_imagemanipulation_tpu.io.image import (
+        encode_image_bytes,
+        synthetic_image,
+    )
+    from mpi_cuda_imagemanipulation_tpu.serve import loadgen
+
+    router = slo_fabric
+    blob = encode_image_bytes(synthetic_image(40, 40, channels=3, seed=4))
+    for _ in range(12):
+        assert loadgen.http_post_image(router.url, blob)["code"] == 200
+
+    def replica_sum() -> float:
+        total = 0.0
+        for v in router.table.views():
+            url = f"http://127.0.0.1:{v.hb.port}/fleet/snapshot"
+            with urllib.request.urlopen(url, timeout=10.0) as resp:
+                snap = json.loads(resp.read())
+            for key, val in snap["metrics"][
+                "mcim_serve_requests_total"
+            ]["series"]:
+                if key == ["ok"]:
+                    total += val
+        return total
+
+    deadline = time.monotonic() + 20.0
+    while True:
+        want = replica_sum()
+        fams = parse_exposition(router.render_metrics())
+        got = sum(
+            v
+            for (_n, labels), v in fams["mcim_serve_requests_total"][
+                "samples"
+            ].items()
+            if 'status="ok"' in labels
+        )
+        if got == want and want >= 12:
+            break
+        assert time.monotonic() < deadline, (got, want)
+        time.sleep(0.1)
+    # the fleet meta-gauges see both replicas
+    assert fams["mcim_fleet_replicas"]["samples"][
+        ("mcim_fleet_replicas", "")
+    ] == 2.0

@@ -1,0 +1,296 @@
+#!/usr/bin/env python
+"""bench_regress — noise-aware perf-regression sentinel over
+BENCH_HISTORY.jsonl.
+
+    python tools/bench_regress.py --history BENCH_HISTORY.jsonl
+    python tools/bench_regress.py --history ... --candidate lane.json
+    python tools/bench_regress.py --history ... --self-test
+
+Perf claims land in BENCH_HISTORY.jsonl (PR 5's promotion mechanism);
+until now only humans read the trajectory. This tool makes the committed
+history a CI gate: group every record into SERIES keyed by
+(config, impl, platform) — CPU smoke numbers never get compared against
+TPU headlines — pick each config's headline metric (higher is better),
+and check the newest point of every series against its own history.
+
+Noise model (per series, all prior points):
+
+    median  m, spread s = 1.4826 * MAD   (robust to the odd outlier run)
+    allowed = m - max(K_MAD * s, REL_TOL * m)
+
+A fresh value below `allowed` is a regression. The MAD term absorbs
+series whose history is genuinely noisy (the TPU pallas trajectory swings
+with tunnel health); the REL_TOL floor stops a zero-spread series (two
+identical runs) from flagging a 0.1% wobble. With exactly one prior
+point the tolerance widens to REL_TOL_SINGLE — one sample tells you
+little about noise. Series with no prior point pass (nothing to compare).
+
+Modes:
+  * default: the LATEST record of each series is the candidate, its
+    predecessors the history — "is the committed history self-consistent"
+    (the CI step runs this; it must stay green).
+  * --candidate FILE: a fresh lane record (the JSON a bench lane writes,
+    a full history line, or a list of records) is checked against the
+    ENTIRE committed trajectory — the pre-merge question.
+  * --self-test: synthesize a regressed candidate from the history
+    (headline metric scaled by 0.5) and assert the sentinel TRIPS — CI
+    proves the gate can actually fire, then proves the real history
+    passes.
+
+Exit codes: 0 clean, 1 regression(s), 2 usage/data error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import copy
+import json
+import sys
+
+K_MAD = 4.0
+REL_TOL = 0.25
+REL_TOL_SINGLE = 0.40
+
+# config -> list of (record field, higher_is_better). Configs not listed
+# fall back to _DEFAULT_FIELDS (first present wins).
+METRIC_FIELDS: dict[str, list[tuple[str, bool]]] = {
+    "plan_ab": [("speedup_fused_vs_off", True)],
+    "stream_ab": [("speedup", True), ("memory_ratio", True)],
+    "engine_ab": [("speedup", True)],
+    "halo_ab": [("comms_hidden_frac", True)],
+    "fabric_loadgen": [("scaling_vs_1", True)],
+}
+_DEFAULT_FIELDS: list[tuple[str, bool]] = [
+    ("mp_per_s_per_chip", True),
+    ("mp_per_s", True),
+    ("speedup", True),
+]
+
+
+def _series_key(rec: dict) -> tuple | None:
+    cfg = rec.get("config")
+    if not cfg:
+        return None
+    return (cfg, str(rec.get("impl", "")), str(rec.get("platform", "")))
+
+
+def _metrics_of(rec: dict) -> list[tuple[str, float, bool]]:
+    """(field, value, higher_is_better) entries present on this record."""
+    cfg = rec.get("config", "")
+    fields = METRIC_FIELDS.get(cfg, _DEFAULT_FIELDS)
+    out = []
+    for field, higher in fields:
+        v = rec.get(field)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            out.append((field, float(v), higher))
+            if cfg not in METRIC_FIELDS:
+                break  # default list: first present metric only
+    return out
+
+
+def load_history(path: str) -> list[dict]:
+    lines = []
+    with open(path) as f:
+        for i, raw in enumerate(f, 1):
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                lines.append(json.loads(raw))
+            except ValueError as e:
+                raise SystemExit(
+                    f"{path}:{i}: unparsable history line ({e})"
+                ) from None
+    return lines
+
+
+def build_series(lines: list[dict]) -> dict[tuple, list[tuple[str, float]]]:
+    """(config, impl, platform, field) -> [(ts, value), ...] in history
+    order."""
+    series: dict[tuple, list[tuple[str, float]]] = {}
+    for line in lines:
+        ts = line.get("ts", "")
+        for rec in line.get("records", ()):
+            key = _series_key(rec)
+            if key is None:
+                continue
+            for field, value, higher in _metrics_of(rec):
+                series.setdefault((*key, field, higher), []).append(
+                    (ts, value)
+                )
+    return series
+
+
+def _median(xs: list[float]) -> float:
+    s = sorted(xs)
+    n = len(s)
+    mid = n // 2
+    return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
+def check_value(
+    history: list[float], value: float, *, higher: bool = True
+) -> dict:
+    """One candidate value vs its series history -> verdict dict."""
+    if not history:
+        return {"ok": True, "reason": "no history", "allowed": None}
+    if not higher:
+        history = [-v for v in history]
+        value = -value
+    m = _median(history)
+    if len(history) == 1:
+        allowed = m * (1.0 - REL_TOL_SINGLE)
+        reason = f"single prior point {m:.4g}, tol {REL_TOL_SINGLE:.0%}"
+    else:
+        mad = _median([abs(v - m) for v in history])
+        spread = 1.4826 * mad
+        slack = max(K_MAD * spread, REL_TOL * abs(m))
+        allowed = m - slack
+        reason = (
+            f"median {m:.4g}, spread {spread:.4g} "
+            f"(n={len(history)}), slack {slack:.4g}"
+        )
+    return {
+        "ok": value >= allowed,
+        "allowed": allowed if higher else -allowed,
+        "median": m if higher else -m,
+        "reason": reason,
+    }
+
+
+def _records_of_candidate(obj) -> list[dict]:
+    if isinstance(obj, list):
+        return [r for r in obj if isinstance(r, dict)]
+    if isinstance(obj, dict):
+        if "records" in obj:
+            return list(obj["records"])
+        return [obj]
+    return []
+
+
+def run_check(
+    lines: list[dict],
+    candidate_records: list[dict] | None = None,
+    *,
+    printer=print,
+) -> int:
+    """Returns the number of regressions found (0 = green)."""
+    series = build_series(lines)
+    regressions = 0
+    checked = 0
+    if candidate_records is None:
+        # self-consistency: newest point of each series vs its elders
+        for key, points in sorted(series.items()):
+            if len(points) < 2:
+                continue
+            *cfg_key, field, higher = key
+            hist = [v for _, v in points[:-1]]
+            ts, value = points[-1]
+            verdict = check_value(hist, value, higher=higher)
+            checked += 1
+            tag = "ok " if verdict["ok"] else "REGRESSION"
+            printer(
+                f"{tag} {'/'.join(map(str, cfg_key))}.{field}: "
+                f"latest {value:.4g} vs {verdict['reason']} "
+                f"(allowed >= {verdict['allowed']:.4g})"
+            )
+            if not verdict["ok"]:
+                regressions += 1
+    else:
+        for rec in candidate_records:
+            key = _series_key(rec)
+            if key is None:
+                continue
+            for field, value, higher in _metrics_of(rec):
+                points = series.get((*key, field, higher), [])
+                hist = [v for _, v in points]
+                if not hist:
+                    printer(
+                        f"new {'/'.join(map(str, key))}.{field}: "
+                        f"{value:.4g} (no history — passes)"
+                    )
+                    continue
+                verdict = check_value(hist, value, higher=higher)
+                checked += 1
+                tag = "ok " if verdict["ok"] else "REGRESSION"
+                printer(
+                    f"{tag} {'/'.join(map(str, key))}.{field}: "
+                    f"candidate {value:.4g} vs {verdict['reason']} "
+                    f"(allowed >= {verdict['allowed']:.4g})"
+                )
+                if not verdict["ok"]:
+                    regressions += 1
+    printer(
+        f"bench_regress: {checked} series checked, "
+        f"{regressions} regression(s)"
+    )
+    return regressions
+
+
+def synthesize_regressed(lines: list[dict]) -> list[dict]:
+    """A candidate built from the newest comparable record with its
+    headline metric halved — the self-test's guaranteed trip."""
+    series = build_series(lines)
+    comparable = {k for k, pts in series.items() if len(pts) >= 1}
+    for line in reversed(lines):
+        for rec in reversed(line.get("records", ())):
+            key = _series_key(rec)
+            if key is None:
+                continue
+            for field, value, _higher in _metrics_of(rec):
+                if (*key, field, True) in comparable:
+                    bad = copy.deepcopy(rec)
+                    bad[field] = value * 0.5
+                    return [bad]
+    raise SystemExit("self-test: no comparable record found in history")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bench_regress", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("--history", default="BENCH_HISTORY.jsonl")
+    ap.add_argument(
+        "--candidate", default=None,
+        help="fresh lane record JSON to check against the full history "
+        "(default: check the history's own newest points)",
+    )
+    ap.add_argument(
+        "--self-test", action="store_true",
+        help="synthesize a regressed candidate from the history and "
+        "REQUIRE the sentinel to trip (exit 0 iff it does)",
+    )
+    args = ap.parse_args(argv)
+    try:
+        lines = load_history(args.history)
+    except OSError as e:
+        print(f"bench_regress: cannot read {args.history}: {e}")
+        return 2
+    if args.self_test:
+        bad = synthesize_regressed(lines)
+        n = run_check(lines, bad)
+        if n == 0:
+            print(
+                "bench_regress: SELF-TEST FAILED — the synthetic "
+                "regression did not trip the sentinel"
+            )
+            return 1
+        print(
+            f"bench_regress: self-test ok (synthetic regression tripped "
+            f"{n} check(s))"
+        )
+        return 0
+    candidate_records = None
+    if args.candidate:
+        with open(args.candidate) as f:
+            candidate_records = _records_of_candidate(json.load(f))
+        if not candidate_records:
+            print(f"bench_regress: no records in {args.candidate}")
+            return 2
+    n = run_check(lines, candidate_records)
+    return 1 if n else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -16,7 +16,15 @@ Asserts, against a REAL pod (replica worker processes, real HTTP):
      X-Trace-Id; its spans come from its own --trace-out export, written
      on graceful drain);
   4. the router's /metrics snapshot parses as Prometheus exposition with
-     the mcim_fabric_* families populated.
+     the mcim_fabric_* families populated;
+  5. FEDERATION (obs/fleet.py): the router's federated families equal
+     the SUM of the per-replica registries — `mcim_serve_requests_total`
+     on the router's /metrics matches the total from each replica's
+     `GET /fleet/snapshot`, and the federated e2e histogram count
+     matches the pooled count;
+  6. FLIGHT RECORDER (obs/recorder.py): SIGKILLing a replica makes the
+     supervisor write a `replica_death` post-mortem dump that names the
+     dead replica's warm buckets (lifted from its last heartbeat).
 
 METRICS_OUT gets the router exposition text, TRACE_OUT the MERGED
 (router + both replicas) Chrome trace JSON — both uploaded as CI
@@ -28,6 +36,7 @@ import os
 import sys
 import tempfile
 import time
+import urllib.request
 
 import numpy as np
 
@@ -51,9 +60,51 @@ OPS = "grayscale,contrast:3.5"
 BUCKETS = "48,96"
 
 
+def _replica_ok_total(port: int) -> tuple[float, float]:
+    """(requests ok, e2e count) straight from one replica's full fleet
+    snapshot — the per-replica side of the federation equality check."""
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/fleet/snapshot", timeout=10.0
+    ) as resp:
+        snap = json.loads(resp.read())
+    ok = 0.0
+    for key, v in snap["metrics"]["mcim_serve_requests_total"]["series"]:
+        if key == ["ok"]:
+            ok = v
+    e2e = sum(
+        data["count"]
+        for _k, data in snap["metrics"][
+            "mcim_serve_e2e_latency_seconds"
+        ]["series"]
+    )
+    return ok, e2e
+
+
+def _federated_ok_total(exposition: str) -> tuple[float, float]:
+    fams = parse_exposition(exposition)
+    ok = sum(
+        v
+        for (_n, labels), v in fams["mcim_serve_requests_total"][
+            "samples"
+        ].items()
+        if 'status="ok"' in labels
+    )
+    e2e = sum(
+        v
+        for (name, _labels), v in fams["mcim_serve_e2e_latency_seconds"][
+            "samples"
+        ].items()
+        if name.endswith("_count")
+    )
+    return ok, e2e
+
+
 def main(metrics_out: str, trace_out: str) -> int:
     tracer = obs_trace.configure(sample=1.0)  # router-side spans
     tmp = tempfile.mkdtemp(prefix="fabric_smoke_")
+    # recorder dumps land somewhere inspectable (and never in the tree)
+    rec_dir = os.path.join(tmp, "recorder")
+    os.environ["MCIM_RECORDER_DIR"] = rec_dir
     rep_traces = {
         rid: os.path.join(tmp, f"{rid}_trace.json") for rid in ("r0", "r1")
     }
@@ -83,7 +134,7 @@ def main(metrics_out: str, trace_out: str) -> int:
     ]
     blobs = [encode_image_bytes(im) for im in imgs]
     golden = [np.asarray(pipe.jit()(im)) for im in imgs]
-    trace_ids: list[str] = []
+    trace_ids: list[tuple[str, str]] = []  # (trace id, serving replica)
 
     with Fabric(cfg).start() as fab:
         # -- 1. both replicas serving, responses bit-exact ------------------
@@ -96,7 +147,7 @@ def main(metrics_out: str, trace_out: str) -> int:
             )
             served.add(r["replica"])
             if r["trace_id"]:
-                trace_ids.append(r["trace_id"])
+                trace_ids.append((r["trace_id"], r["replica"]))
         print(f"smoke: {len(blobs) * 4} requests ok, replicas {sorted(served)}")
 
         # -- 2. heartbeat loss -> staleness -> rerouting --------------------
@@ -116,13 +167,83 @@ def main(metrics_out: str, trace_out: str) -> int:
                 f"request routed to stale replica {r['replica']}"
             )
             if r["trace_id"]:
-                trace_ids.append(r["trace_id"])
+                trace_ids.append((r["trace_id"], r["replica"]))
         print("smoke: r0 stale after injected heartbeat loss; all traffic on r1")
 
+        # -- 5. federation: router view == sum of replica registries --------
+        # r0 is heartbeat-silent by now, so its contribution arrives via
+        # the router's full-scrape fallback (GET /fleet/snapshot) — this
+        # check proves BOTH the delta path (r1) and the gap fallback (r0)
+        ports = {
+            rid: rep["port"]
+            for rid, rep in fab.http_stats()["replicas"].items()
+        }
+        deadline = time.monotonic() + 30.0
+        while True:
+            want_ok = want_e2e = 0.0
+            for port in ports.values():
+                ok_i, e2e_i = _replica_ok_total(port)
+                want_ok += ok_i
+                want_e2e += e2e_i
+            exposition = fab.scrape()
+            got_ok, got_e2e = _federated_ok_total(exposition)
+            if got_ok == want_ok and got_e2e == want_e2e:
+                break
+            assert time.monotonic() < deadline, (
+                f"federated view never converged: requests ok "
+                f"{got_ok} != {want_ok} or e2e count {got_e2e} != {want_e2e}"
+            )
+            time.sleep(0.2)
+        print(
+            f"smoke: federated /metrics == sum of replica registries "
+            f"(ok {got_ok:.0f}, e2e count {got_e2e:.0f})"
+        )
+        with urllib.request.urlopen(fab.url + "/slo", timeout=10.0) as resp:
+            slo_view = json.loads(resp.read())
+        assert slo_view["slos"], "router /slo exposes no SLOs"
+        assert slo_view["p99"]["p99_s"] is not None, slo_view["p99"]
+        print(
+            f"smoke: /slo live (federated p99 ~"
+            f"{slo_view['p99']['p99_s'] * 1e3:.1f} ms, exemplar "
+            f"{slo_view['p99']['exemplar_trace_id']})"
+        )
+
         # -- 4. metrics snapshot (written before teardown) ------------------
-        exposition = fab.scrape()
         with open(metrics_out, "w") as f:
             f.write(exposition)
+
+        # -- 6. SIGKILL -> replica_death flight-recorder dump ---------------
+        # r0 is already heartbeat-silent: its warm buckets reach the dump
+        # from the router ring's LAST heartbeat note — the exact shape of
+        # a real post-mortem. (Killing r1 would also lose its graceful
+        # trace export, which section 3 still needs.)
+        victim = "r0"
+        fab.kill_replica(victim)
+        deadline = time.monotonic() + 30.0
+        dump_path = None
+        while time.monotonic() < deadline and dump_path is None:
+            if os.path.isdir(rec_dir):
+                dumps = sorted(
+                    p
+                    for p in os.listdir(rec_dir)
+                    if p.startswith("recorder_replica_death")
+                )
+                if dumps:
+                    dump_path = os.path.join(rec_dir, dumps[0])
+                    break
+            time.sleep(0.1)
+        assert dump_path, "supervisor never wrote a replica_death dump"
+        with open(dump_path) as f:
+            dump = json.load(f)
+        assert dump["extra"]["replica"] == victim, dump["extra"]
+        assert dump["extra"].get("warm_buckets"), (
+            f"replica_death dump does not name {victim}'s warm buckets: "
+            f"{dump['extra']}"
+        )
+        print(
+            f"smoke: replica_death dump ({os.path.basename(dump_path)}) "
+            f"names {victim}'s warm buckets {dump['extra']['warm_buckets']}"
+        )
     # graceful drain done: replicas exported their traces on SIGTERM
 
     fams = parse_exposition(exposition)
@@ -148,6 +269,10 @@ def main(metrics_out: str, trace_out: str) -> int:
     router_events = tracer.chrome_events()
     merged = list(router_events)
     for rid, path in rep_traces.items():
+        if rid == victim:
+            # the SIGKILLed replica never drained; its respawn exports a
+            # fresh (empty-of-our-traces) file, if it got that far
+            continue
         assert os.path.exists(path), f"{rid} never exported {path}"
         with open(path) as f:
             merged.extend(json.load(f)["traceEvents"])
@@ -162,11 +287,12 @@ def main(metrics_out: str, trace_out: str) -> int:
         return out
 
     assert trace_ids, "no request carried a trace id"
+    survivors = [tid for tid, rid in trace_ids if rid != victim]
     checked = 0
-    for tid in trace_ids:
+    for tid in survivors:
         spans = spans_for(tid)
         if "serve.request" not in spans:
-            continue  # replica killed before export? not here — skip none
+            continue
         for name in ("fabric.request", "fabric.forward", "serve.request",
                      "serve.dispatch"):
             assert name in spans, (
@@ -178,12 +304,12 @@ def main(metrics_out: str, trace_out: str) -> int:
             f"trace {tid}: fabric.forward not parented to fabric.request"
         )
         checked += 1
-    assert checked >= len(trace_ids) * 0.9, (
-        f"only {checked}/{len(trace_ids)} traces had the full "
-        "router->replica chain"
+    assert checked >= len(survivors) * 0.9, (
+        f"only {checked}/{len(survivors)} surviving-replica traces had "
+        "the full router->replica chain"
     )
     print(
-        f"smoke: {checked}/{len(trace_ids)} traces span the full "
+        f"smoke: {checked}/{len(survivors)} traces span the full "
         f"router->replica hop ({len(merged)} merged events -> {trace_out})"
     )
     return 0
