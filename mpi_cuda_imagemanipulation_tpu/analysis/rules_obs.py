@@ -80,6 +80,12 @@ rule(
     "A KNOWN_TRIGGERS entry has no recorder.dump() caller anywhere — a "
     "post-mortem trigger no failure path can reach.",
 )
+rule(
+    "obs-recorder-trigger-dynamic", "obs",
+    "recorder.dump() called with a non-literal trigger in package code — "
+    "the closed KNOWN_TRIGGERS vocabulary is only machine-checkable when "
+    "every production dump site names its trigger as a string literal.",
+)
 
 _METRIC_RE = re.compile(
     r"^mcim_(serve|engine|cache|breaker|health|batch|analysis|fabric|stream"
@@ -456,6 +462,19 @@ def _check_recorder_triggers(repo: Repo) -> list:
                             "KNOWN_TRIGGERS (obs/recorder.py)",
                         )
                     )
+            elif sf.rel.startswith(PACKAGE + "/"):
+                # a computed trigger in production code would dodge the
+                # unknown/unused checks entirely — the vocabulary is only
+                # closed if every package dump site is a literal (tests
+                # and tools may parameterize; they are not failure paths)
+                findings.append(
+                    make_finding(
+                        "obs-recorder-trigger-dynamic", sf.rel,
+                        node.lineno,
+                        "recorder.dump() trigger is not a string literal "
+                        "— name one of KNOWN_TRIGGERS directly",
+                    )
+                )
     for trigger in sorted(known - used):
         findings.append(
             make_finding(

@@ -397,7 +397,9 @@ class _FabricProc:
     threads and cap both lanes at the same number, which is exactly the
     measurement error a replicas=1 vs replicas=N claim cannot carry."""
 
-    def __init__(self, p: dict, replicas: int):
+    def __init__(
+        self, p: dict, replicas: int, *, extra_args=(), extra_env=None
+    ):
         import subprocess
         import sys
 
@@ -415,6 +417,7 @@ class _FabricProc:
         # lane wants queue pressure converted into cross-replica spread
         # (capacity additivity), not into one deep affinity queue
         env["MCIM_FABRIC_SHED_FRAC"] = "0.25"
+        env.update(extra_env or {})
         self.proc = subprocess.Popen(
             env=env,
             args=[
@@ -431,6 +434,7 @@ class _FabricProc:
                 "--port", str(self.port),
                 "--heartbeat-s", str(p["heartbeat_s"]),
                 "--stale-s", str(4 * p["heartbeat_s"]),
+                *extra_args,
             ],
         )
 
@@ -478,6 +482,31 @@ class _FabricProc:
         os.kill(pid, _signal.SIGKILL)
         return pid
 
+    def preempt_replica(self, replica_id: str) -> int:
+        """SIGUSR1 = preemption notice: graceful drain + `preempt` dump
+        + immediate no-backoff replacement by the supervisor."""
+        import signal as _signal
+
+        pid = self.stats()["replicas"][replica_id]["pid"]
+        os.kill(pid, _signal.SIGUSR1)
+        return pid
+
+    def fresh_ids(self) -> list[str]:
+        try:
+            st = self.stats()
+        except Exception:
+            return []
+        return [
+            rid for rid, rep in st["replicas"].items() if rep["fresh"]
+        ]
+
+    def autoscaler_events(self) -> list[dict]:
+        try:
+            auto = self.stats().get("autoscaler")
+        except Exception:
+            return []
+        return list(auto["events"]) if auto else []
+
     def close(self) -> None:
         import signal as _signal
 
@@ -509,13 +538,17 @@ def run_fabric_loadgen(
     replicas: int | None = None,
 ) -> dict:
     """The pod-fabric bench lane: the SAME open-loop HTTP request mix
-    against (a) one replica, (b) N replicas, and (c) N replicas with a
-    SIGKILL mid-sweep (serve/loadgen.churn_run) — throughput, p99 and
-    availability columns per lane. The scaling headline is
+    against (a) one replica, (b) N replicas, (c) N replicas with a
+    SIGKILL mid-sweep (serve/loadgen.churn_run), and (d) an AUTOSCALED
+    pod that must grow 1->N under the saturating rate, absorb a SIGUSR1
+    preemption mid-load, and drain back down once idle — throughput,
+    p99, ok%/shed% columns per lane. The scaling headline is
     replicas=N achieved / replicas=1 achieved at equal mix; the churn
-    headline is the during-phase ok%/retried% (rerouting, not luck).
-    Successes are gated bit-exact against the golden per-request path
-    before any timing (the proto discipline)."""
+    headline is the during-phase ok%/retried% (rerouting, not luck);
+    the elastic headline is scale-up/scale-down latency with the
+    drain-before-kill reason asserted from the autoscaler's own event
+    record. Successes are gated bit-exact against the golden
+    per-request path before any timing (the proto discipline)."""
     import numpy as np
 
     from mpi_cuda_imagemanipulation_tpu.serve import loadgen
@@ -636,6 +669,122 @@ def run_fabric_loadgen(
             killed_pid=killed_pid[0] if killed_pid else None,
             respawned=bool(killed_pid) and new_pid != killed_pid[0],
         )
+    # -- elastic: autoscale 1->N under saturation, preempt, drain back ------
+    # the same offered mix against an AUTOSCALED pod: starts at one
+    # replica, must grow to n_rep under the saturating rate, absorb a
+    # SIGUSR1 preemption mid-load (graceful drain + immediate no-backoff
+    # replacement), and, once the load stops, shrink back by DRAINING
+    # (the recorded scale-down reason must be "drained"). Shed (503 +
+    # Retry-After) is the expected elastic response while capacity
+    # catches up — counted in its own column, never as unavailability.
+    import threading as _threading
+    import time as _time
+
+    scale_env = {
+        "MCIM_FABRIC_SCALE_TICK_S": "0.25",
+        "MCIM_FABRIC_SCALE_SUSTAIN_S": "1.0",
+        "MCIM_FABRIC_SCALE_COOLDOWN_S": "3.0",
+        "MCIM_FABRIC_SCALE_UP_FRAC": "0.5",
+        "MCIM_FABRIC_SCALE_DOWN_FRAC": "0.15",
+    }
+    with _FabricProc(
+        p, 1,
+        extra_args=[
+            "--autoscale", "--min-replicas", "1",
+            "--max-replicas", str(n_rep),
+        ],
+        extra_env=scale_env,
+    ) as fab:
+        fab.wait_routable(1)
+        stop_load = _threading.Event()
+        elastic_recs: list[dict] = []
+
+        def _elastic_load():
+            while not stop_load.is_set():
+                elastic_recs.append(
+                    loadgen.http_run_offered_load(
+                        fab.url, blobs, p["offered_rps"], 1.0,
+                        max_workers=p["max_workers"],
+                    )
+                )
+
+        loader = _threading.Thread(target=_elastic_load, daemon=True)
+        t0 = _time.monotonic()
+        loader.start()
+        scale_up_s = None
+        deadline = _time.monotonic() + 180.0
+        while _time.monotonic() < deadline:
+            if len(fab.routable()) >= n_rep:
+                scale_up_s = _time.monotonic() - t0
+                break
+            _time.sleep(0.25)
+        # preemption mid-load: evict one scaled-up replica gracefully
+        preempted = False
+        if scale_up_s is not None:
+            victim = sorted(fab.routable())[-1]
+            old_inc = fab.stats()["replicas"][victim]["incarnation"]
+            fab.preempt_replica(victim)
+            deadline = _time.monotonic() + 90.0
+            while _time.monotonic() < deadline:
+                rep = fab.stats()["replicas"].get(victim)
+                if (
+                    rep
+                    and rep["incarnation"] != old_inc
+                    and rep["state"] == "serving"
+                ):
+                    preempted = True
+                    break
+                _time.sleep(0.25)
+        stop_load.set()
+        loader.join(timeout=120.0)
+        for rec_i in elastic_recs:
+            check_bit_exact(rec_i["results"])
+        # idle -> the loop must shrink back down by draining
+        t1 = _time.monotonic()
+        scale_down_s = None
+        deadline = _time.monotonic() + 180.0
+        while _time.monotonic() < deadline:
+            if len(fab.fresh_ids()) <= 1:
+                scale_down_s = _time.monotonic() - t1
+                break
+            _time.sleep(0.25)
+        events = fab.autoscaler_events()
+        n_el = sum(r["submitted"] for r in elastic_recs)
+        ok_el = sum(r["ok"] for r in elastic_recs)
+        shed_el = sum(r["shed"] for r in elastic_recs)
+        accepted_el = sum(r["accepted"] for r in elastic_recs)
+        lanes["elastic"] = {
+            "offered_rps": p["offered_rps"],
+            "submitted": n_el,
+            "ok": ok_el,
+            "ok_frac": ok_el / n_el if n_el else 0.0,
+            "shed": shed_el,
+            "shed_frac": shed_el / n_el if n_el else 0.0,
+            "accepted": accepted_el,
+            "ok_accepted_frac": (
+                ok_el / accepted_el if accepted_el else 1.0
+            ),
+            "unavailable": sum(r["unavailable"] for r in elastic_recs),
+            "retried_frac": (
+                sum(r["retried"] for r in elastic_recs) / n_el
+                if n_el else 0.0
+            ),
+            "achieved_rps": (
+                sum(r["ok"] for r in elastic_recs)
+                / sum(r["wall_s"] for r in elastic_recs)
+                if elastic_recs else 0.0
+            ),
+            "scaled_up": scale_up_s is not None,
+            "scale_up_s": scale_up_s,
+            "preempted": preempted,
+            "scaled_down": scale_down_s is not None,
+            "scale_down_s": scale_down_s,
+            "drained": any(
+                e["direction"] == "down" and e["reason"] == "drained"
+                for e in events
+            ),
+            "events": events,
+        }
     scaling = (
         lanes[f"replicas_{n_rep}"]["achieved_rps"]
         / lanes["replicas_1"]["achieved_rps"]
@@ -658,14 +807,15 @@ def run_fabric_loadgen(
         "scaling_ok": scaling is not None and scaling >= 2.0,
     }
     printer(
-        f"{'lane':22s} {'achieved':>9s} {'ok%':>6s} {'retry%':>7s} "
-        f"{'p99 ms':>8s}"
+        f"{'lane':22s} {'achieved':>9s} {'ok%':>6s} {'shed%':>6s} "
+        f"{'retry%':>7s} {'p99 ms':>8s}"
     )
 
     def _row(name: str, r: dict) -> None:
         printer(
             f"{name:22s} {r['achieved_rps']:9.1f} "
             f"{r['ok_frac'] * 100:5.1f}% "
+            f"{r.get('shed_frac', 0.0) * 100:5.1f}% "
             f"{r['retried_frac'] * 100:6.1f}% "
             f"{r.get('e2e_p99_ms', float('nan')):8.2f}"
         )
@@ -674,6 +824,17 @@ def run_fabric_loadgen(
     _row(f"replicas_{n_rep}", lanes[f"replicas_{n_rep}"])
     for ph in ("before", "during", "after"):
         _row(f"churn/{ph}", lanes[f"replicas_{n_rep}_churn"][ph])
+    el = lanes["elastic"]
+    _row("elastic", el)
+    printer(
+        "elastic: scale-up "
+        + (f"{el['scale_up_s']:.1f}s" if el["scaled_up"] else "NEVER")
+        + ", preempt->replace "
+        + ("ok" if el["preempted"] else "FAILED")
+        + ", scale-down "
+        + (f"{el['scale_down_s']:.1f}s" if el["scaled_down"] else "NEVER")
+        + (" (drained)" if el["drained"] else " (NOT drained)")
+    )
     printer(
         f"scaling replicas_{n_rep}/replicas_1 = "
         + (f"{scaling:.2f}x" if scaling else "n/a")

@@ -513,6 +513,28 @@ def _build_parser() -> argparse.ArgumentParser:
         "rejected",
     )
     fab.add_argument(
+        "--autoscale",
+        action="store_true",
+        help="arm the elastic control loop (fabric/autoscaler.py): "
+        "replica count follows queue-fill/p99 pressure between "
+        "--min-replicas and --max-replicas with hysteresis; scale-down "
+        "is drain-before-kill (routing stops, the queue empties, THEN "
+        "SIGTERM). --replicas is the starting count",
+    )
+    fab.add_argument(
+        "--min-replicas",
+        type=int,
+        default=None,
+        help="autoscaler floor (default MCIM_FABRIC_MIN_REPLICAS)",
+    )
+    fab.add_argument(
+        "--max-replicas",
+        type=int,
+        default=None,
+        help="autoscaler ceiling (default MCIM_FABRIC_MAX_REPLICAS)",
+    )
+    _add_plan_flag(fab)
+    fab.add_argument(
         "--slo",
         default=None,
         metavar="SPECS",
@@ -1686,7 +1708,8 @@ def cmd_serve(args: argparse.Namespace) -> int:
         for name, default in (
             ("heartbeat_s", None), ("stale_s", None),
             ("forward_attempts", None), ("mesh_shards", 0),
-            ("slo", None),
+            ("slo", None), ("autoscale", False),
+            ("min_replicas", None), ("max_replicas", None),
         ):
             if not hasattr(args, name):
                 setattr(args, name, default)
@@ -1818,6 +1841,7 @@ def cmd_fabric(args: argparse.Namespace) -> int:
         max_delay_ms=args.max_delay_ms,
         queue_depth=args.queue_depth,
         impl="xla" if args.impl == "auto" else args.impl,
+        plan=getattr(args, "plan", "auto"),
         heartbeat_s=args.heartbeat_s,
         router=RouterConfig(
             buckets=parse_buckets(args.buckets),
@@ -1826,6 +1850,9 @@ def cmd_fabric(args: argparse.Namespace) -> int:
             slo_specs=args.slo,
         ),
         mesh_shards=args.mesh_shards,
+        autoscale=args.autoscale,
+        min_replicas=args.min_replicas,
+        max_replicas=args.max_replicas,
     )
     stop_evt = threading.Event()
 

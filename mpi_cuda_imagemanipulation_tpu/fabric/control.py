@@ -38,6 +38,21 @@ seconds each replica POSTs one JSON `Heartbeat` to the router's
                   its DeltaSource and the next beat pushes a FULL
                   snapshot. May be None (metrics-less heartbeat).
 
+The router's ACK body closes two control loops without a second channel:
+`resync: true` asks for a full metrics snapshot next beat (obs/fleet.py),
+and `drain: true` tells a scale-down victim to stop admitting — the
+router already stopped routing to it (`mark_draining`), so within one
+heartbeat period the drain is honored end to end and the replica's
+subsequent beats report `state: draining` with a falling queue, which is
+exactly the signal the autoscaler waits on before SIGTERM
+(drain-before-kill, fabric/autoscaler.py).
+
+A replica that exits with `PREEMPT_EXIT_CODE` was PREEMPTED (spot/
+maintenance eviction, or the `replica.preempt` failpoint): it drained
+gracefully and dumped the `preempt` flight-recorder artifact on its way
+out. The supervisor replaces it immediately — no crash-loop backoff,
+because a preemption is the platform's doing, not the replica's.
+
 Liveness is the ABSENCE of heartbeats: the router marks a replica stale
 after `MCIM_FABRIC_STALE_S` without a beat and routes around it. The
 `replica.heartbeat` failpoint drops beats (the loss is injected on the
@@ -62,6 +77,10 @@ from mpi_cuda_imagemanipulation_tpu.utils.log import get_logger
 ENV_HEARTBEAT_S = "MCIM_FABRIC_HEARTBEAT_S"
 
 HEARTBEAT_PATH = "/control/heartbeat"
+
+# exit status of a replica that drained after a preemption notice — the
+# supervisor reads it to skip crash-loop backoff (immediate replacement)
+PREEMPT_EXIT_CODE = 43
 
 
 @dataclasses.dataclass

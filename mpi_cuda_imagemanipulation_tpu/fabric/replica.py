@@ -19,6 +19,19 @@ drained replica's spans still join the router's on trace id), then exit
 precisely what the router's staleness window, per-replica breaker and
 rerouting retries exist to absorb.
 
+Two more ways out, both graceful:
+
+  * **drain ack** — the router's heartbeat ack carries `drain: true`
+    when the autoscaler marked this replica for scale-down: the health
+    machine flips to `draining` (admission refused, /v1/process answers
+    503 + Retry-After), in-flight work flushes, and the beats keep
+    flowing so the autoscaler can watch the queue empty before SIGTERM.
+  * **preemption notice** — SIGUSR1 (the spot/maintenance eviction
+    stand-in) or a `replica.preempt` failpoint hit: drain as above, dump
+    the `preempt` flight-recorder artifact (the ring still holds the
+    serving-time facts the post-mortem needs), exit `PREEMPT_EXIT_CODE`
+    so the supervisor replaces immediately instead of backing off.
+
 This module is also importable: `ReplicaRuntime` runs the same wiring
 in-process for tests that don't need process isolation.
 """
@@ -33,9 +46,11 @@ import threading
 import time
 
 from mpi_cuda_imagemanipulation_tpu.fabric.control import (
+    PREEMPT_EXIT_CODE,
     Heartbeat,
     HeartbeatSender,
 )
+from mpi_cuda_imagemanipulation_tpu.resilience import failpoints
 from mpi_cuda_imagemanipulation_tpu.utils.log import get_logger
 
 
@@ -61,6 +76,9 @@ class ReplicaRuntime:
         # incarnation: unique per construction, so the router can tell a
         # restart from a continuation and reset the replica's breaker
         self.incarnation = f"{os.getpid():x}-{time.time_ns():x}"
+        # set by a preemption notice (SIGUSR1 / replica.preempt
+        # failpoint); main() watches it next to the SIGTERM event
+        self.preempted = threading.Event()
         self.server = Server(serve_config, host, port)
         # metrics federation (obs/fleet.py): every heartbeat carries the
         # compact delta of this replica's registries; the router's ack
@@ -75,6 +93,14 @@ class ReplicaRuntime:
 
     def _collect(self, seq: int) -> Heartbeat:
         app = self.server.app
+        try:
+            # a hit is a PREEMPTION NOTICE, not a dropped beat: the beat
+            # still goes out (the router should see the drain coming)
+            failpoints.maybe_fail(
+                "replica.preempt", replica=self.replica_id, seq=seq
+            )
+        except failpoints.FailpointError:
+            self.preempted.set()
         return Heartbeat(
             replica_id=self.replica_id,
             addr="127.0.0.1",
@@ -94,12 +120,36 @@ class ReplicaRuntime:
         )
 
     def _on_heartbeat_ack(self, hb: Heartbeat, ack: dict) -> None:
+        if ack.get("drain"):
+            # the autoscaler marked us for scale-down: stop admitting,
+            # keep serving what's queued, keep beating so the router can
+            # watch the queue empty before the SIGTERM arrives
+            self.begin_drain()
         if ack.get("resync"):
             # router baseline mismatch (restart / missed epoch): next
             # beat carries a full snapshot
             self.delta_source.force_full()
         elif hb.metrics is not None:
             self.delta_source.ack(hb.metrics["seq"])
+
+    def begin_drain(self) -> None:
+        """Drain-before-kill step on the replica: health -> draining
+        (admission refused by the HTTP front end), dispatch keeps
+        running so in-flight + queued work flushes. Idempotent — every
+        subsequent ack carries the flag again."""
+        from mpi_cuda_imagemanipulation_tpu.resilience.health import (
+            DEGRADED,
+            DRAINING,
+            SERVING,
+        )
+
+        health = self.server.app.health
+        if health.state in (SERVING, DEGRADED):
+            health.to(DRAINING)
+            get_logger().info(
+                "replica %s: drain requested by router; admission stopped",
+                self.replica_id,
+            )
 
     def start(self) -> "ReplicaRuntime":
         # warmup + socket first: the first heartbeat must carry the real
@@ -133,6 +183,9 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-delay-ms", type=float, default=5.0)
     p.add_argument("--queue-depth", type=int, default=64)
     p.add_argument("--impl", default="xla", choices=("auto", "xla", "mxu"))
+    # the canary deploy path flips this per replica (plan-mode config
+    # flips are the gate's canonical workload)
+    p.add_argument("--plan", default="auto")
     p.add_argument("--host", default="")
     p.add_argument("--port", type=int, default=0)
     p.add_argument("--heartbeat-s", type=float, default=None)
@@ -168,6 +221,7 @@ def main(argv: list[str] | None = None) -> int:
         queue_depth=args.queue_depth,
         channels=channels,
         backend="xla" if args.impl == "auto" else args.impl,
+        plan=args.plan,
     )
     rt = ReplicaRuntime(
         args.replica_id,
@@ -187,24 +241,43 @@ def main(argv: list[str] | None = None) -> int:
         )
         stop_evt.set()
 
+    def _on_preempt(signum, frame):
+        log.warning(
+            "replica %s: SIGUSR1 preemption notice — draining for "
+            "replacement", args.replica_id,
+        )
+        rt.preempted.set()
+
     signal.signal(signal.SIGTERM, _on_signal)
     signal.signal(signal.SIGINT, _on_signal)
+    # the spot/maintenance eviction stand-in: a real deployment's
+    # preemption watcher delivers exactly this kind of early notice
+    signal.signal(signal.SIGUSR1, _on_preempt)
     rt.start()
     log.info(
         "replica %s serving on port %d (router %s, heartbeat %.2fs)",
         args.replica_id, rt.server.address[1], args.router,
         rt.sender.interval_s,
     )
-    stop_evt.wait()
+    while not stop_evt.wait(0.1):
+        if rt.preempted.is_set():
+            break
+    preempted = rt.preempted.is_set() and not stop_evt.is_set()
     rt.close(drain=True, deadline_s=args.drain_deadline_s)
-    # flight recorder (obs/recorder.py): the SIGTERM drain is a dump
-    # trigger — the ring still holds the serving-time facts (hot buckets,
-    # breaker transitions, failpoint hits) plus the drain itself
+    # flight recorder (obs/recorder.py): both exits are dump triggers —
+    # the ring still holds the serving-time facts (hot buckets, breaker
+    # transitions, failpoint hits) plus the drain itself. A preemption
+    # writes its OWN trigger so the post-mortem names the eviction.
     from mpi_cuda_imagemanipulation_tpu.obs import recorder
 
-    dump_path = recorder.dump(
-        "sigterm_drain", extra={"replica_id": args.replica_id}
-    )
+    if preempted:
+        dump_path = recorder.dump(
+            "preempt", extra={"replica_id": args.replica_id}
+        )
+    else:
+        dump_path = recorder.dump(
+            "sigterm_drain", extra={"replica_id": args.replica_id}
+        )
     if dump_path:
         log.info("replica %s recorder dump -> %s", args.replica_id, dump_path)
     if args.trace_out:
@@ -213,7 +286,7 @@ def main(argv: list[str] | None = None) -> int:
             "replica %s trace: %d events -> %s",
             args.replica_id, n, args.trace_out,
         )
-    return 0
+    return PREEMPT_EXIT_CODE if preempted else 0
 
 
 if __name__ == "__main__":

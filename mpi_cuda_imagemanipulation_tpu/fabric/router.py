@@ -51,6 +51,8 @@ import time
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from mpi_cuda_imagemanipulation_tpu.fabric import canary as fabric_canary
+from mpi_cuda_imagemanipulation_tpu.fabric import session as fabric_session
 from mpi_cuda_imagemanipulation_tpu.fabric.control import (
     HEARTBEAT_PATH,
     Heartbeat,
@@ -203,6 +205,9 @@ class RouterConfig:
     slo_slow_s: float | None = None
     slo_tick_s: float | None = None
     slo_burn_threshold: float | None = None
+    # canary rollback gate knobs (fabric/canary.py); None fields fall
+    # back to their MCIM_FABRIC_CANARY_* env defaults
+    canary: fabric_canary.CanaryConfig | None = None
 
 
 class Router:
@@ -210,10 +215,19 @@ class Router:
     register themselves by heartbeating `POST /control/heartbeat`.
 
         POST /v1/process        proxied to a replica (see module doc)
-        POST /control/heartbeat replica state push (fabric/control.py)
+        POST /v1/session/<sid>/frame
+                                live video frame: sticky session routing
+                                with journal-tail failover replay
+                                (fabric/session.py)
+        POST /control/heartbeat replica state push (fabric/control.py);
+                                the ack carries drain/resync flags
+        GET|POST /control/canary
+                                canary gate status / deploy / abort
+                                (fabric/canary.py)
         GET  /healthz           200 while >=1 routable fresh replica
         GET  /stats             replica table + routing counters (JSON)
         GET  /metrics           Prometheus exposition (mcim_fabric_*)
+        GET  /slo               SLO burn-rate engine status (obs/slo.py)
     """
 
     def __init__(
@@ -251,6 +265,22 @@ class Router:
             failure_threshold=config.breaker_threshold,
             reset_timeout_s=config.breaker_reset_s,
         )
+        # replicas the control plane is DRAINING (autoscaler scale-down):
+        # routing stops here immediately, and the next heartbeat ack
+        # carries drain=true so the replica stops admitting end to end
+        self._draining: set[str] = set()
+        self._draining_lock = threading.Lock()
+        # canary rollback gate (fabric/canary.py); the Fabric wires the
+        # deploy/rollback callbacks (it owns the replica processes)
+        self.canary = fabric_canary.CanaryGate(config.canary, clock=clock)
+        self.on_canary_deploy = None  # callable(flip: dict) -> replica_id
+        self.on_canary_rollback = None  # callable(status: dict) -> None
+        self._canary_rollback_handled = False
+        # live video sessions (fabric/session.py): sticky affinity +
+        # journal-tail failover
+        self.sessions = fabric_session.SessionTable()
+        # set by the Fabric when the elastic loop is armed (status only)
+        self.autoscaler = None
         self.mesh_lane = mesh_lane
         self._pool = _ConnPool(self.forward_timeout_s)
         self._clock = clock
@@ -317,6 +347,57 @@ class Router:
         self._m_forward_s = r.histogram(
             "mcim_fabric_forward_seconds",
             "Router->replica proxy time per successful attempt.",
+        )
+        # -- canary rollback gate (fabric/canary.py) ------------------------
+        self._m_canary = r.counter(
+            "mcim_fabric_canary_requests_total",
+            "Canary-gate outcomes by lane (canary/stable) and result "
+            "(ok/bad).",
+            labels=("lane", "result"),
+        )
+        self._m_canary_shadow = r.counter(
+            "mcim_fabric_canary_shadow_total",
+            "Shadow digest spot checks by result (match/mismatch).",
+            labels=("result",),
+        )
+        self._m_canary_rollbacks = r.counter(
+            "mcim_fabric_canary_rollbacks_total",
+            "Config flips auto-reverted by the rollback gate.",
+        )
+        r.gauge(
+            "mcim_fabric_canary_active",
+            "1 while a canary flip is under evaluation.",
+            fn=lambda: (
+                1.0 if self.canary.state == fabric_canary.CANARY else 0.0
+            ),
+        )
+        # -- live video sessions (fabric/session.py) ------------------------
+        self._m_session_frames = r.counter(
+            "mcim_fabric_session_frames_total",
+            "Session frames through the front door by outcome "
+            "(ok/unavailable/error).",
+            labels=("outcome",),
+        )
+        self._m_session_failovers = r.counter(
+            "mcim_fabric_session_failovers_total",
+            "Live sessions rebound to a new replica with journal-tail "
+            "replay after their replica died or drained.",
+        )
+        self._m_session_replayed = r.counter(
+            "mcim_fabric_session_replayed_frames_total",
+            "Journal-tail frames replayed to rebuild temporal rings on "
+            "a replacement replica.",
+        )
+        r.gauge(
+            "mcim_fabric_sessions_live",
+            "Video sessions the router currently tracks.",
+            fn=lambda: float(len(self.sessions.sessions())),
+        )
+        r.gauge(
+            "mcim_fabric_replicas_draining",
+            "Replicas the control plane is draining (routing stopped, "
+            "SIGTERM pending on empty queue).",
+            fn=lambda: float(len(self.draining_ids())),
         )
         r.gauge(
             "mcim_fabric_replica_serving",
@@ -386,14 +467,41 @@ class Router:
             for v in self.table.views()
         }
 
+    # -- drain control (autoscaler scale-down) -----------------------------
+
+    def mark_draining(self, replica_id: str) -> None:
+        """Stop routing to this replica NOW; its next heartbeat ack
+        carries drain=true so the replica flips its health machine to
+        draining (admission refused end to end). Its live sessions
+        rebind with tail replay on their next frame."""
+        with self._draining_lock:
+            self._draining.add(replica_id)
+        self._log.info("draining %s: routing stopped", replica_id)
+
+    def unmark_draining(self, replica_id: str) -> None:
+        with self._draining_lock:
+            self._draining.discard(replica_id)
+
+    def draining_ids(self) -> list[str]:
+        with self._draining_lock:
+            return sorted(self._draining)
+
+    def _is_draining(self, replica_id: str) -> bool:
+        with self._draining_lock:
+            return replica_id in self._draining
+
     # -- routing policy ----------------------------------------------------
 
     def _routable(self) -> list[ReplicaView]:
         now = self._clock()
+        with self._draining_lock:
+            draining = set(self._draining)
         return [
             v
             for v in self.table.views()
-            if v.fresh(now, self.stale_s) and v.hb.state in _ROUTABLE
+            if v.fresh(now, self.stale_s)
+            and v.hb.state in _ROUTABLE
+            and v.replica_id not in draining
         ]
 
     def route(self, bucket: str) -> tuple[list[ReplicaView], str]:
@@ -480,13 +588,23 @@ class Router:
                 {"error": "no replica is serving", "status": "unavailable"},
                 extra=[("Retry-After", "1")],
             )
+        mode, canary_view, candidates = self._apply_canary(candidates)
+        if not candidates and mode != "shadow":
+            # the canary slice never strands a request: with no stable
+            # replica left the canary itself is the only door
+            candidates = [canary_view] if canary_view is not None else []
         self._m_route.inc(policy=policy)
         root = obs_trace.start_trace(
             "fabric.request", h=h, w=w, bucket=bucket, policy=policy
         )
-        code, ctype, out, extra = self._forward_with_retries(
-            root, bucket, body, candidates
-        )
+        if mode == "shadow":
+            code, ctype, out, extra = self._shadow_forward(
+                root, bucket, body, canary_view, candidates
+            )
+        else:
+            code, ctype, out, extra = self._forward_with_retries(
+                root, bucket, body, candidates
+            )
         self._m_requests.inc(
             status=_STATUS_LABEL.get(code, "error" if code >= 500 else "ok")
         )
@@ -532,22 +650,50 @@ class Router:
                 breaker.on_failure()
                 self._maybe_breaker_dump(rid, breaker)
                 self._m_forwards.inc(replica=rid, outcome="net_error")
+                self._canary_record(rid, False)
                 self._log.warning(
                     "forward to %s failed (%s: %s)",
                     rid, type(e).__name__, str(e)[:120],
                 )
                 continue
-            if code == 429 or code >= 500:
+            # a 422 from the CANARY replica is a flip signal, not a
+            # poison-request verdict: the flip itself may be what breaks
+            # the request, so the gate counts it bad and the client gets
+            # the stable answer instead (stable 422s stay final — the
+            # quarantine contract is per-request there)
+            canary_quarantine = (
+                code == 422
+                and self.canary.state == fabric_canary.CANARY
+                and rid == self.canary.replica_id
+            )
+            if code in (429, 503) or code >= 500 or canary_quarantine:
                 # the replica answered but couldn't take it: 429 means
-                # alive-but-full (no breaker signal), 5xx feeds the breaker
+                # alive-but-full and 503 not-admitting (a draining
+                # scale-down victim in its last heartbeat window — no
+                # breaker signal, no canary signal, load shedding is not
+                # a config defect; the next candidate may well take it),
+                # 5xx feeds both
                 if code >= 500:
                     breaker.on_failure()
                     self._maybe_breaker_dump(rid, breaker)
+                if code >= 500 or canary_quarantine:
+                    self._canary_record(rid, False)
                 self._m_forwards.inc(replica=rid, outcome="http_error")
-                last = (code, ctype, out, [("X-Fabric-Replica", rid)])
+                # a relayed shed keeps its retry-later semantics: the
+                # replica's 429/503 carried Retry-After, and stripping
+                # it would turn an explicit shed into apparent downtime
+                # in every client's accounting
+                shed_hdr = (
+                    [("Retry-After", "1")] if code in (429, 503) else []
+                )
+                last = (
+                    code, ctype, out,
+                    [("X-Fabric-Replica", rid)] + shed_hdr,
+                )
                 continue
             breaker.on_success()
             self._m_forwards.inc(replica=rid, outcome="ok")
+            self._canary_record(rid, True)
             # exemplar: the proxy-time histogram keeps this request's
             # trace id per bucket, so a forward-latency spike in the
             # exposition pulls up the exact router->replica trace
@@ -640,6 +786,314 @@ class Router:
             extra.append(("X-Trace-Id", root.trace_id))
         return 200, "image/png", png, extra
 
+    # -- canary / shadow routing (fabric/canary.py) ------------------------
+
+    def _apply_canary(
+        self, candidates: list[ReplicaView]
+    ) -> tuple[str, ReplicaView | None, list[ReplicaView]]:
+        """Split routing for an in-flight flip: stable traffic never
+        touches the canary replica; the deterministic ~frac slice routes
+        canary-first (stable candidates stay as fallback, so a broken
+        canary costs the client a retry, not an error); every k-th
+        canary request shadows instead. Returns (mode, canary view,
+        forward candidates)."""
+        gate = self.canary
+        if gate.state != fabric_canary.CANARY:
+            return "off", None, candidates
+        crid = gate.replica_id
+        canary_view = next(
+            (v for v in candidates if v.replica_id == crid), None
+        )
+        stable = [v for v in candidates if v.replica_id != crid]
+        if canary_view is None:
+            return "off", None, stable or candidates
+        if not gate.take_canary():
+            return "stable", canary_view, stable
+        if gate.take_shadow():
+            return "shadow", canary_view, stable
+        return "canary", canary_view, [canary_view] + stable
+
+    def _canary_record(self, rid: str, ok: bool) -> None:
+        gate = self.canary
+        if gate.state != fabric_canary.CANARY:
+            return
+        lane = "canary" if rid == gate.replica_id else "stable"
+        self._m_canary.inc(lane=lane, result="ok" if ok else "bad")
+        if gate.record(lane, ok) == fabric_canary.ROLLED_BACK:
+            self._handle_canary_rollback()
+
+    def _shadow_forward(
+        self,
+        root,
+        bucket: str,
+        body: bytes,
+        canary_view: ReplicaView,
+        stable_candidates: list[ReplicaView],
+    ) -> tuple[int, str, bytes, list[tuple[str, str]]]:
+        """The bit-exactness spot check: duplicate one sampled request to
+        canary AND stable, compare response digests, answer the client
+        from STABLE — the canary cannot hurt a shadowed request no
+        matter how broken the flip is."""
+        import hashlib
+
+        c_code = None
+        c_digest = None
+        try:
+            with obs_trace.span(
+                "fabric.shadow", parent=root.context(),
+                replica=canary_view.replica_id,
+            ):
+                c_code, _ct, c_out = self._forward_once(
+                    canary_view, body, root.trace_id
+                )
+            if c_code == 200:
+                c_digest = hashlib.sha256(c_out).hexdigest()
+        except Exception as e:
+            self._log.warning(
+                "shadow forward to canary %s failed (%s)",
+                canary_view.replica_id, type(e).__name__,
+            )
+        self._canary_record(
+            canary_view.replica_id,
+            c_code is not None and c_code < 500 and c_code != 422,
+        )
+        code, ctype, out, extra = self._forward_with_retries(
+            root, bucket, body, stable_candidates or [canary_view]
+        )
+        if c_code == 200 and code == 200:
+            match = hashlib.sha256(out).hexdigest() == c_digest
+            self._m_canary_shadow.inc(
+                result="match" if match else "mismatch"
+            )
+            if (
+                self.canary.record_shadow(match)
+                == fabric_canary.ROLLED_BACK
+            ):
+                self._handle_canary_rollback()
+        return code, ctype, out, extra + [
+            ("X-Fabric-Shadow", canary_view.replica_id)
+        ]
+
+    def _handle_canary_rollback(self) -> None:
+        """Breach -> exactly one rollback: dump the post-mortem, count
+        it, and hand the revert to the Fabric OFF the request thread
+        (the respawn takes seconds; the breaching request must not)."""
+        with self._draining_lock:
+            if self._canary_rollback_handled:
+                return
+            self._canary_rollback_handled = True
+        status = self.canary.status()
+        self._m_canary_rollbacks.inc()
+        flight_recorder.dump("canary_rollback", extra=status)
+        self._log.warning(
+            "canary rollback on %s: %s", status["replica"], status["reason"]
+        )
+        cb = self.on_canary_rollback
+        if cb is not None:
+            threading.Thread(
+                target=cb, args=(status,),
+                name="mcim-canary-rollback", daemon=True,
+            ).start()
+
+    def canary_deploy(self, flip: dict) -> dict:
+        """Start a flip: the Fabric's deploy hook respawns one replica
+        with the flip config and blocks until it is serving again; only
+        then does the gate open the traffic slice."""
+        if self.on_canary_deploy is None:
+            raise RuntimeError(
+                "no canary deploy hook (router running without a Fabric)"
+            )
+        rid = self.on_canary_deploy(flip)
+        with self._draining_lock:
+            self._canary_rollback_handled = False
+        self.canary.start(rid, flip)
+        return self.canary.status()
+
+    # -- live video sessions (fabric/session.py) ---------------------------
+
+    def handle_session_frame(
+        self, sid: str, body: bytes, headers
+    ) -> tuple[int, str, bytes, list[tuple[str, str]]]:
+        """One session frame through the front door. Frames of one
+        session serialize on its lock (an ordered stream has no
+        concurrency to exploit); the sticky binding, tail bookkeeping
+        and failover replay all happen under it."""
+        ops = headers.get(fabric_session.HDR_OPS) or ""
+        if not ops:
+            self._m_session_frames.inc(outcome="error")
+            return _json_response(
+                400, {"error": f"missing {fabric_session.HDR_OPS} header"}
+            )
+        sess = self.sessions.get_or_create(sid, ops)
+        with sess.lock:
+            raw_seq = headers.get(fabric_session.HDR_SEQ)
+            try:
+                seq = sess.next_seq if raw_seq is None else int(raw_seq)
+            except ValueError:
+                self._m_session_frames.inc(outcome="error")
+                return _json_response(
+                    400, {"error": f"bad {fabric_session.HDR_SEQ} {raw_seq!r}"}
+                )
+            with obs_trace.start_trace(
+                "fabric.session", sid=sid, seq=seq
+            ) as root:
+                code, ctype, out, extra = self._forward_session(
+                    root, sess, seq, body
+                )
+                root.set(status=code)
+            if root.trace_id:
+                extra = extra + [("X-Trace-Id", root.trace_id)]
+            return code, ctype, out, extra
+
+    def _forward_session(
+        self, root, sess, seq: int, body: bytes
+    ) -> tuple[int, str, bytes, list[tuple[str, str]]]:
+        prev_rid = sess.replica_id if sess.frames > 0 else None
+        tried: set[str] = set()
+        last: tuple[int, str, bytes, list] | None = None
+        for _attempt in range(self.forward_attempts):
+            live = [
+                v for v in self._routable() if v.replica_id not in tried
+            ]
+            if not live:
+                break
+            bound = next(
+                (v for v in live if v.replica_id == sess.replica_id), None
+            )
+            if bound is None:
+                # rebind: rendezvous winner among survivors — the same
+                # hash discipline as bucket affinity, keyed by session
+                view = max(
+                    live,
+                    key=lambda v: _rendezvous_score(
+                        "sess|" + sess.sid, v.replica_id
+                    ),
+                )
+                rebind = True
+            else:
+                view, rebind = bound, False
+            rid = view.replica_id
+            breaker = self.breakers.get(rid)
+            if not breaker.allow():
+                tried.add(rid)
+                continue
+            try:
+                with obs_trace.span(
+                    "fabric.session_forward", parent=root.context(),
+                    replica=rid, rebind=rebind,
+                ):
+                    if rebind:
+                        self._replay_tail(view, sess, seq, root.trace_id)
+                    code, ctype, out = self._forward_session_once(
+                        view, sess, seq, body, root.trace_id,
+                        replay=False, reset=False,
+                    )
+            except Exception as e:
+                breaker.on_failure()
+                self._maybe_breaker_dump(rid, breaker)
+                tried.add(rid)
+                sess.replica_id = None  # force a clean replay elsewhere
+                self._log.warning(
+                    "session %s frame %d to %s failed (%s: %s)",
+                    sess.sid, seq, rid, type(e).__name__, str(e)[:120],
+                )
+                continue
+            if code in (429, 503) or code >= 500:
+                if code >= 500:
+                    breaker.on_failure()
+                    self._maybe_breaker_dump(rid, breaker)
+                tried.add(rid)
+                sess.replica_id = None
+                last = (code, ctype, out, [("X-Fabric-Replica", rid)])
+                continue
+            breaker.on_success()
+            if rebind and prev_rid is not None and rid != prev_rid:
+                sess.failovers += 1
+                self._m_session_failovers.inc()
+                self._log.info(
+                    "session %s failed over %s -> %s at frame %d "
+                    "(%d tail frames replayed)",
+                    sess.sid, prev_rid, rid, seq, len(sess.tail),
+                )
+            sess.replica_id = rid
+            if code == 200:
+                sess.remember(seq, bytes(body))
+                self._m_session_frames.inc(outcome="ok")
+            else:
+                self._m_session_frames.inc(outcome="error")
+            return (
+                code, ctype, out,
+                [
+                    ("X-Fabric-Replica", rid),
+                    (fabric_session.HDR_SEQ, str(seq)),
+                ],
+            )
+        if last is not None:
+            self._m_session_frames.inc(outcome="error")
+            return last
+        self._m_session_frames.inc(outcome="unavailable")
+        return _json_response(
+            503,
+            {"error": "no replica can take the session frame",
+             "status": "unavailable"},
+            extra=[("Retry-After", "1")],
+        )
+
+    def _replay_tail(self, view, sess, before_seq: int, trace_id) -> int:
+        """Rebuild the temporal rings on a replacement replica: push the
+        journal tail (oldest first, reset on the first frame so stale
+        state from an earlier binding can never contaminate the rings);
+        replayed frames decode + push but skip compute/encode (204)."""
+        frames = sess.replay_frames(before_seq)
+        n = 0
+        for i, (s, b) in enumerate(frames):
+            code, _ct, _out = self._forward_session_once(
+                view, sess, s, b, trace_id, replay=True, reset=(i == 0)
+            )
+            if code not in (200, 204):
+                raise RuntimeError(
+                    f"session {sess.sid}: replay of frame {s} to "
+                    f"{view.replica_id} answered {code}"
+                )
+            n += 1
+        if n:
+            self._m_session_replayed.inc(n)
+        return n
+
+    def _forward_session_once(
+        self, view, sess, seq: int, body: bytes, trace_id,
+        *, replay: bool, reset: bool,
+    ) -> tuple[int, str, bytes]:
+        addr = view.hb.addr or "127.0.0.1"
+        port = view.hb.port
+        conn = self._pool.take(addr, port)
+        try:
+            hdrs = {
+                "Content-Type": "application/octet-stream",
+                fabric_session.HDR_OPS: sess.ops,
+                fabric_session.HDR_SEQ: str(seq),
+            }
+            if replay:
+                hdrs[fabric_session.HDR_REPLAY] = "1"
+            if reset:
+                hdrs[fabric_session.HDR_RESET] = "1"
+            if trace_id:
+                hdrs["X-Trace-Id"] = trace_id
+            conn.request(
+                "POST",
+                f"{fabric_session.SESSION_PATH_PREFIX}{sess.sid}/frame",
+                body=body,
+                headers=hdrs,
+            )
+            resp = conn.getresponse()
+            out = resp.read()
+            ctype = resp.getheader("Content-Type", "application/json")
+        except BaseException:
+            conn.close()
+            raise
+        self._pool.give(addr, port, conn)
+        return resp.status, ctype, out
+
     # -- control + introspection ------------------------------------------
 
     def handle_heartbeat(self, body: bytes) -> tuple[int, dict]:
@@ -686,7 +1140,14 @@ class Router:
         ok = self.fleet.apply(
             hb.replica_id, hb.incarnation, hb.metrics, now
         )
-        return 200, {"ok": True, "resync": not ok}
+        # drain=true tells a scale-down victim to stop admitting: the
+        # router already stopped routing to it (mark_draining); the ack
+        # closes the loop on the replica side within one heartbeat
+        return 200, {
+            "ok": True,
+            "resync": not ok,
+            "drain": self._is_draining(hb.replica_id),
+        }
 
     def _fleet_refresh(self) -> None:
         """Full-scrape fallback: a replica the table knows about whose
@@ -778,6 +1239,14 @@ class Router:
             "stale_s": self.stale_s,
             "forward_attempts": self.forward_attempts,
             "shed_frac": self.shed_frac,
+            "draining": self.draining_ids(),
+            "canary": self.canary.status(),
+            "sessions": self.sessions.stats(),
+            "autoscaler": (
+                self.autoscaler.status()
+                if self.autoscaler is not None
+                else None
+            ),
             "mesh_lane": (
                 self.mesh_lane.stats() if self.mesh_lane is not None else None
             ),
@@ -907,6 +1376,8 @@ def _make_handler(router: Router):
                 self._reply(200, obs_metrics.CONTENT_TYPE, body)
             elif self.path == "/slo":
                 self._reply_json(200, router.slo_status())
+            elif self.path == "/control/canary":
+                self._reply_json(200, router.canary.status())
             else:
                 self._reply_json(404, {"error": f"no route {self.path}"})
 
@@ -921,6 +1392,24 @@ def _make_handler(router: Router):
                     body, self.headers
                 )
                 self._reply(code, ctype, out, extra)
+            elif (route := fabric_session.parse_session_path(self.path)):
+                code, ctype, out, extra = router.handle_session_frame(
+                    route[0], body, self.headers
+                )
+                self._reply(code, ctype, out, extra)
+            elif self.path == "/control/canary":
+                # operator/bench control plane: start a flip ({"env":
+                # {...}, "argv": [...]}) or abort the one in flight
+                try:
+                    req = json.loads(body or b"{}")
+                    if req.get("action") == "abort":
+                        router.canary.abort("operator abort")
+                        router._handle_canary_rollback()
+                        self._reply_json(200, router.canary.status())
+                    else:
+                        self._reply_json(200, router.canary_deploy(req))
+                except Exception as e:
+                    self._reply_json(400, {"error": str(e)})
             else:
                 self._reply_json(404, {"error": f"no route {self.path}"})
 

@@ -15,6 +15,20 @@ one-off kill rejoins after one base delay. Each restart increments
 `mcim_fabric_replica_restarts_total{replica=...}` on the shared fabric
 registry.
 
+PREEMPTION is not a crash: a replica that exits `PREEMPT_EXIT_CODE`
+(fabric/control.py) drained gracefully after an eviction notice — it is
+replaced IMMEDIATELY, with no backoff and no attempt-counter increment
+(backing off on the platform's scheduling decision would compound the
+capacity loss), and counted separately in
+`mcim_fabric_replica_preemptions_total`. The replica already wrote the
+`preempt` post-mortem dump; the supervisor only logs.
+
+The membership is DYNAMIC for the autoscaler (fabric/autoscaler.py):
+`add()` grows the set, `remove()` SIGTERMs a (drained) replica and
+forgets it — the monitor will not resurrect a removed replica — and
+`respawn()` is the canary deploy path: replace one replica's process
+with a (possibly different) spec, gracefully.
+
 `Fabric` is the assembly the CLI (`serve --replicas N` / `fabric`) and
 the tests use:
 
@@ -36,6 +50,7 @@ import threading
 import time
 import urllib.request
 
+from mpi_cuda_imagemanipulation_tpu.fabric.control import PREEMPT_EXIT_CODE
 from mpi_cuda_imagemanipulation_tpu.fabric.router import (
     Router,
     RouterConfig,
@@ -67,6 +82,7 @@ class _Managed:
         self.spawned_at = 0.0
         self.attempts = 0  # consecutive restarts without a stable run
         self.restart_due: float | None = None
+        self.removed = False  # hands-off flag: remove()/respawn() owns it
 
 
 class Supervisor:
@@ -97,9 +113,16 @@ class Supervisor:
         self._wake = threading.Event()
         self._thread: threading.Thread | None = None
         self._log = get_logger()
-        self._m_restarts = (registry or Registry()).counter(
+        reg = registry or Registry()
+        self._m_restarts = reg.counter(
             "mcim_fabric_replica_restarts_total",
             "Replica processes respawned by the supervisor, per replica.",
+            labels=("replica",),
+        )
+        self._m_preemptions = reg.counter(
+            "mcim_fabric_replica_preemptions_total",
+            "Graceful preemption exits replaced WITHOUT backoff, per "
+            "replica.",
             labels=("replica",),
         )
 
@@ -133,9 +156,11 @@ class Supervisor:
     def _monitor(self) -> None:
         while self._running:
             now = self._clock()
-            for m in self._managed.values():
+            with self._lock:
+                managed = list(self._managed.values())
+            for m in managed:
                 proc = m.proc
-                if proc is None:
+                if proc is None or m.removed:
                     continue
                 if proc.poll() is None:
                     # alive; a long stable run forgives past crashes
@@ -144,6 +169,19 @@ class Supervisor:
                     continue
                 if not self._running:
                     break
+                if proc.returncode == PREEMPT_EXIT_CODE:
+                    # preemption: the replica drained and dumped its own
+                    # post-mortem; replace NOW — backoff is for crash
+                    # loops, not for the platform evicting a slice
+                    self._m_preemptions.inc(replica=m.spec.replica_id)
+                    self._m_restarts.inc(replica=m.spec.replica_id)
+                    self._log.warning(
+                        "replica %s preempted (rc %d); immediate "
+                        "replacement, no backoff",
+                        m.spec.replica_id, proc.returncode,
+                    )
+                    self._spawn(m)
+                    continue
                 if m.restart_due is None:
                     if now - m.spawned_at >= self.stable_s:
                         m.attempts = 0
@@ -217,6 +255,90 @@ class Supervisor:
                 p.kill()
                 p.wait(timeout=10.0)
 
+    # -- dynamic membership (autoscaler + canary) --------------------------
+
+    def add(self, spec: ReplicaSpec) -> None:
+        """Grow the set by one replica (autoscaler scale-up). The new
+        process registers itself with the router by heartbeat like any
+        other."""
+        with self._lock:
+            if spec.replica_id in self._managed:
+                raise ValueError(
+                    f"replica {spec.replica_id!r} is already managed"
+                )
+            m = self._managed[spec.replica_id] = _Managed(spec)
+        self._spawn(m)
+
+    def remove(self, replica_id: str, *, deadline_s: float = 30.0) -> None:
+        """Shrink the set: SIGTERM (the replica drains what it still
+        holds) and FORGET — the monitor will not resurrect it. The
+        autoscaler only calls this after the router-side drain emptied
+        the replica's queue (drain-before-kill)."""
+        with self._lock:
+            m = self._managed.get(replica_id)
+            if m is None:
+                return
+            m.removed = True
+            del self._managed[replica_id]
+        proc = m.proc
+        if proc is None or proc.poll() is not None:
+            return
+        try:
+            proc.send_signal(signal.SIGTERM)
+        except OSError:
+            return
+        try:
+            proc.wait(timeout=deadline_s)
+        except subprocess.TimeoutExpired:
+            self._log.warning(
+                "removed replica %s ignored the drain deadline; killing",
+                replica_id,
+            )
+            proc.kill()
+            proc.wait(timeout=10.0)
+        self._log.info(
+            "replica %s removed (rc %s)", replica_id, proc.returncode
+        )
+
+    def respawn(
+        self,
+        replica_id: str,
+        *,
+        spec: ReplicaSpec | None = None,
+        deadline_s: float = 30.0,
+    ) -> None:
+        """Replace one replica's PROCESS, gracefully, optionally with a
+        new spec — the canary deploy/revert path (a config flip is a
+        respawn with different argv/env, nothing more)."""
+        with self._lock:
+            m = self._managed[replica_id]
+            m.removed = True  # monitor hands off while we swap
+            proc = m.proc
+        if proc is not None and proc.poll() is None:
+            try:
+                proc.send_signal(signal.SIGTERM)
+                proc.wait(timeout=deadline_s)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=10.0)
+            except OSError:
+                pass
+        with self._lock:
+            if spec is not None:
+                m.spec = spec
+            m.attempts = 0
+            m.removed = False
+        self._m_restarts.inc(replica=replica_id)
+        self._spawn(m)
+
+    def spec_of(self, replica_id: str) -> ReplicaSpec:
+        with self._lock:
+            return self._managed[replica_id].spec
+
+    def replica_ids(self) -> list[str]:
+        with self._lock:
+            return sorted(self._managed)
+
     # -- churn / introspection --------------------------------------------
 
     def kill(self, replica_id: str) -> int:
@@ -241,6 +363,9 @@ class Supervisor:
     def restarts(self, replica_id: str) -> int:
         return int(self._m_restarts.value(replica=replica_id))
 
+    def preemptions(self, replica_id: str) -> int:
+        return int(self._m_preemptions.value(replica=replica_id))
+
 
 @dataclasses.dataclass(frozen=True)
 class FabricConfig:
@@ -259,6 +384,9 @@ class FabricConfig:
     router: RouterConfig | None = None  # None: RouterConfig(buckets=...)
     mesh_shards: int = 0  # >0: arm the oversize mesh lane in the router
     mesh_halo_mode: str = "serial"
+    # fusion-plan mode every replica serves with (the canary deploy path
+    # flips it per replica via `--plan` in the flip argv)
+    plan: str = "auto"
     # per-replica env overrides (failpoint injection on one worker, trace
     # export paths, ...) and extra replica argv (e.g. --trace-out)
     replica_env: dict[str, dict[str, str]] = dataclasses.field(
@@ -267,8 +395,26 @@ class FabricConfig:
     replica_argv_extra: dict[str, list[str]] = dataclasses.field(
         default_factory=dict
     )
+    # env applied to EVERY replica, including ones the autoscaler adds
+    # later (per-replica replica_env wins on clashes)
+    all_replica_env: dict[str, str] = dataclasses.field(
+        default_factory=dict
+    )
     supervisor_backoff_s: float = 0.5
     supervisor_stable_s: float = 5.0
+    # -- elastic control loop (fabric/autoscaler.py) ------------------------
+    # autoscale=True arms the loop; `replicas` is the STARTING count and
+    # the loop then steers within [min_replicas, max_replicas] (None
+    # fields fall back to MCIM_FABRIC_MIN/MAX_REPLICAS / SCALE_* env)
+    autoscale: bool = False
+    min_replicas: int | None = None
+    max_replicas: int | None = None
+    scale_up_frac: float | None = None
+    scale_down_frac: float | None = None
+    scale_sustain_s: float | None = None
+    scale_cooldown_s: float | None = None
+    scale_tick_s: float | None = None
+    scale_drain_deadline_s: float | None = None
 
 
 class Fabric:
@@ -292,7 +438,14 @@ class Fabric:
             registry=self.registry,
             mesh_lane=mesh_lane,
         )
+        # canary control plane: the router gates + decides, the Fabric
+        # owns the process swaps (deploy = respawn with the flip config,
+        # rollback = respawn with the stable one)
+        self.router.on_canary_deploy = self._canary_deploy
+        self.router.on_canary_rollback = self._canary_rollback
+        self._canary_stable_spec: ReplicaSpec | None = None
         self.supervisor: Supervisor | None = None
+        self.autoscaler = None
         self._log = get_logger()
 
     def replica_ids(self) -> list[str]:
@@ -327,11 +480,22 @@ class Fabric:
             "--max-delay-ms", str(c.max_delay_ms),
             "--queue-depth", str(c.queue_depth),
             "--impl", c.impl,
+            "--plan", c.plan,
         ]
         if c.heartbeat_s is not None:
             argv += ["--heartbeat-s", str(c.heartbeat_s)]
         argv += c.replica_argv_extra.get(rid, [])
         return argv
+
+    def _replica_spec(self, rid: str) -> ReplicaSpec:
+        return ReplicaSpec(
+            replica_id=rid,
+            argv=self._replica_argv(rid),
+            extra_env={
+                **self.config.all_replica_env,
+                **self.config.replica_env.get(rid, {}),
+            },
+        )
 
     def start(
         self,
@@ -343,12 +507,7 @@ class Fabric:
         try:
             self.router.start(host, port)
             specs = [
-                ReplicaSpec(
-                    replica_id=rid,
-                    argv=self._replica_argv(rid),
-                    extra_env=self.config.replica_env.get(rid, {}),
-                )
-                for rid in self.replica_ids()
+                self._replica_spec(rid) for rid in self.replica_ids()
             ]
             self.supervisor = Supervisor(
                 specs,
@@ -357,13 +516,142 @@ class Fabric:
                 stable_s=self.config.supervisor_stable_s,
                 death_info=self._death_info,
             ).start()
+            if self.config.autoscale:
+                from mpi_cuda_imagemanipulation_tpu.fabric.autoscaler import (
+                    Autoscaler,
+                    AutoscalerConfig,
+                )
+
+                c = self.config
+                self.autoscaler = Autoscaler(
+                    self.router,
+                    scale_up=self._scale_up_replica,
+                    scale_down=self._scale_down_replica,
+                    live_count=lambda: len(self.supervisor.replica_ids()),
+                    config=AutoscalerConfig(
+                        min_replicas=c.min_replicas,
+                        max_replicas=c.max_replicas,
+                        up_frac=c.scale_up_frac,
+                        down_frac=c.scale_down_frac,
+                        sustain_s=c.scale_sustain_s,
+                        cooldown_s=c.scale_cooldown_s,
+                        tick_s=c.scale_tick_s,
+                        drain_deadline_s=c.scale_drain_deadline_s,
+                    ),
+                    registry=self.registry,
+                )
+                self.router.autoscaler = self.autoscaler
             self.wait_ready(
                 self.config.replicas, timeout_s=ready_timeout_s
             )
+            if self.autoscaler is not None:
+                # only after the seed set is serving: the loop must not
+                # misread warmup as an outage and over-spawn
+                self.autoscaler.start()
         except BaseException:
             self.close(drain=False)
             raise
         return self
+
+    # -- elastic membership (autoscaler callbacks) -------------------------
+
+    def _next_replica_id(self) -> str:
+        """Lowest free index, so drained ids are REUSED: metric label
+        sets and rendezvous layouts stay bounded over any number of
+        scale cycles."""
+        assert self.supervisor is not None
+        taken = set(self.supervisor.replica_ids())
+        i = 0
+        while f"r{i}" in taken:
+            i += 1
+        return f"r{i}"
+
+    def _scale_up_replica(self) -> str:
+        assert self.supervisor is not None
+        rid = self._next_replica_id()
+        self.supervisor.add(self._replica_spec(rid))
+        return rid
+
+    def _scale_down_replica(self, rid: str) -> None:
+        assert self.supervisor is not None
+        self.supervisor.remove(
+            rid,
+            deadline_s=self.config.scale_drain_deadline_s or 30.0,
+        )
+
+    # -- canary control plane (router callbacks) ---------------------------
+
+    def _wait_incarnation_change(
+        self, rid: str, old_incarnation: str | None, timeout_s: float = 180.0
+    ) -> None:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            view = self.router.table.get(rid)
+            if (
+                view is not None
+                and view.hb.incarnation != old_incarnation
+                and view.hb.state == "serving"
+            ):
+                return
+            time.sleep(0.1)
+        raise TimeoutError(
+            f"replica {rid} did not re-register serving within "
+            f"{timeout_s:.0f}s"
+        )
+
+    def _canary_pick(self) -> str:
+        """The flip's guinea pig: the highest-index routable replica —
+        deterministic, and r0 (the rendezvous-heaviest seed) keeps
+        serving stable traffic."""
+        live = sorted(v.replica_id for v in self.router._routable())
+        if not live:
+            raise RuntimeError("no routable replica to canary")
+        return live[-1]
+
+    def _canary_deploy(self, flip: dict) -> str:
+        """Router deploy hook: respawn one replica with the flip's
+        argv/env delta, block until its new incarnation is serving, and
+        hand the id back for the gate to open the traffic slice."""
+        assert self.supervisor is not None
+        rid = flip.get("replica") or self._canary_pick()
+        stable = self.supervisor.spec_of(rid)
+        self._canary_stable_spec = stable
+        view = self.router.table.get(rid)
+        old_inc = view.hb.incarnation if view is not None else None
+        canary_spec = ReplicaSpec(
+            replica_id=rid,
+            argv=list(stable.argv) + [str(a) for a in flip.get("argv", [])],
+            extra_env={
+                **stable.extra_env,
+                **{str(k): str(v) for k, v in flip.get("env", {}).items()},
+            },
+        )
+        self._log.info(
+            "canary deploy on %s: argv+=%s env+=%s",
+            rid, flip.get("argv", []), sorted(flip.get("env", {})),
+        )
+        self.supervisor.respawn(rid, spec=canary_spec)
+        self._wait_incarnation_change(rid, old_inc)
+        return rid
+
+    def _canary_rollback(self, status: dict) -> None:
+        """Router rollback hook (off the request thread): put the stable
+        spec back, wait for it to serve, then return the gate to idle."""
+        assert self.supervisor is not None
+        rid = status.get("replica")
+        stable = self._canary_stable_spec
+        if rid is None or stable is None:
+            return
+        view = self.router.table.get(rid)
+        old_inc = view.hb.incarnation if view is not None else None
+        self._log.warning(
+            "canary rollback on %s: reverting to the stable spec", rid
+        )
+        try:
+            self.supervisor.respawn(rid, spec=stable)
+            self._wait_incarnation_change(rid, old_inc)
+        finally:
+            self.router.canary.reset()
 
     def wait_ready(self, n: int, *, timeout_s: float = 180.0) -> None:
         """Block until `n` replicas are fresh + routable (each has warmed
@@ -409,6 +697,9 @@ class Fabric:
             return json.loads(resp.read())
 
     def close(self, *, drain: bool = True, deadline_s: float = 30.0) -> None:
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
+            self.autoscaler = None
         if self.supervisor is not None:
             self.supervisor.stop(drain=drain, deadline_s=deadline_s)
             self.supervisor = None

@@ -29,6 +29,15 @@ production wiring fires it on:
                     (fabric/supervisor — the dump is the SUPERVISOR's
                     ring, which holds the dead replica's last heartbeats
                     incl. its warm buckets)
+    autoscale       the elastic control loop changed the replica set
+                    (fabric/autoscaler — the dump records the signals
+                    that drove the decision next to the heartbeats)
+    preempt         a replica received a preemption/maintenance notice
+                    (fabric/replica — the dump is the PREEMPTED process's
+                    own ring, written after the graceful drain)
+    canary_rollback the canary rollback gate auto-reverted a config flip
+                    (fabric/router — the dump carries the canary-vs-
+                    stable outcome counts and shadow mismatches)
     manual          operator/test-initiated (`dump("manual")`)
 
 Dumps are rate-limited per trigger (`MCIM_RECORDER_MIN_INTERVAL_S`) so a
@@ -62,6 +71,9 @@ KNOWN_TRIGGERS = (
     "quarantine",
     "sigterm_drain",
     "replica_death",
+    "autoscale",
+    "preempt",
+    "canary_rollback",
     "manual",
 )
 

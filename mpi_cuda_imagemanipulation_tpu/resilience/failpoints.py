@@ -68,6 +68,13 @@ KNOWN_SITES = (
     "stream.stitch",    # stream/runner.py: seam assembly — a fault in
                         # the host-side strip carry, distinct from the
                         # dispatch path so stitch recovery is testable
+    "replica.preempt",  # fabric/replica.py heartbeat collect: a hit is a
+                        # PREEMPTION NOTICE, not a fault — the replica
+                        # drains gracefully, dumps the `preempt` recorder
+                        # artifact and exits PREEMPT_EXIT_CODE, so spot/
+                        # maintenance eviction is testable on CPU without
+                        # a cloud metadata server (mode `after:N` models
+                        # "preempted after N beats")
     "plan.fuse",        # plan/planner.py build_plan: the fusion decision
                         # itself — a hit fails a fused/pointwise build
                         # loudly BEFORE any executable exists, so callers'

@@ -25,6 +25,13 @@ replica is SIGKILLed mid-sweep, and the record reports ok% / retried%
 the BEFORE, DURING and AFTER phases — availability under churn as three
 numbers, not an anecdote.
 
+The router's `503 + Retry-After` is an explicit SHED ("come back
+later"), not unavailability: it gets its own shed/shed_frac columns and
+`ok_accepted_frac` reports goodput over the load the pod actually
+accepted. Without the distinction, the elastic lanes would misread the
+autoscaler intentionally shedding during a scale-up as the pod being
+down — the opposite of what is happening.
+
 With tracing armed (obs/trace.py, e.g. MCIM_TRACE_SAMPLE=1) every request
 carries a trace id and each per-rate record names its slowest completions
 (`slowest_traces`) and failures (`failed_traces`) by id — the p99 outlier
@@ -168,8 +175,11 @@ def http_post_image(
     """One `POST /v1/process` against a front door (router or replica).
     `blob` is any bytes-like body (memoryviews from `encode_blob` / the
     incremental stream encoder post without a defensive copy). Returns
-    {code, body, attempts, replica, trace_id, e2e_s}; transport errors
-    surface as code 599 so open-loop accounting never raises."""
+    {code, body, attempts, replica, trace_id, retry_after, e2e_s};
+    transport errors surface as code 599 so open-loop accounting never
+    raises. `retry_after` carries the server's Retry-After header — the
+    router's explicit shed-and-retry-later signal, which the accounting
+    layer must keep distinct from real unavailability."""
     import urllib.error
     import urllib.request
 
@@ -194,7 +204,8 @@ def http_post_image(
         # distinct from any server-sent status
         return {
             "code": 599, "body": b"", "attempts": 1, "replica": "",
-            "trace_id": "", "e2e_s": time.monotonic() - t0,
+            "trace_id": "", "retry_after": "",
+            "e2e_s": time.monotonic() - t0,
         }
     return {
         "code": code,
@@ -202,6 +213,7 @@ def http_post_image(
         "attempts": int(hdrs.get("X-Fabric-Attempts", "1") or 1),
         "replica": hdrs.get("X-Fabric-Replica", ""),
         "trace_id": hdrs.get("X-Trace-Id", ""),
+        "retry_after": hdrs.get("Retry-After", ""),
         "e2e_s": time.monotonic() - t0,
     }
 
@@ -246,19 +258,40 @@ def http_run_offered_load(
         wall = clock() - t0
     ok = [r for _, r in results if r["code"] == 200]
     retried = sum(1 for _, r in results if r["attempts"] > 1)
-    lat = [r["e2e_s"] for r in ok]
+    # a 503 WITH Retry-After is the router's explicit shed — "come back
+    # later", the intended elastic behavior during scale-up — and must
+    # not be folded into unavailability (a 599/bare-503 failure class):
+    # an elastic A/B that counts intentional shedding as downtime would
+    # misread the autoscaler absorbing load as the pod losing it
+    shed = sum(
+        1
+        for _, r in results
+        if r["code"] == 503 and r.get("retry_after")
+    )
+    overloaded = sum(1 for _, r in results if r["code"] == 429)
+    # accepted = the offered load the pod actually took on (not shed at
+    # either door); the elastic acceptance gates ok/accepted at 100%
     n = len(results)
+    accepted = n - shed - overloaded
+    lat = [r["e2e_s"] for r in ok]
     rec = {
         "offered_rps": offered_rps,
         "submitted": n,
         "ok": len(ok),
         "ok_frac": len(ok) / n if n else 0.0,
+        "accepted": accepted,
+        "ok_accepted_frac": len(ok) / accepted if accepted else 1.0,
         "retried": retried,
         "retried_frac": retried / n if n else 0.0,
+        "shed": shed,
+        "shed_frac": shed / n if n else 0.0,
         "unavailable": sum(
-            1 for _, r in results if r["code"] in (503, 599)
+            1
+            for _, r in results
+            if r["code"] == 599
+            or (r["code"] == 503 and not r.get("retry_after"))
         ),
-        "overloaded": sum(1 for _, r in results if r["code"] == 429),
+        "overloaded": overloaded,
         "achieved_rps": len(ok) / wall if wall > 0 else 0.0,
         "wall_s": wall,
         "results": results,

@@ -178,6 +178,10 @@ class ServeApp:
             io_threads=config.io_threads,
         )
         self._register_state_gauges()
+        # live video sessions (stream/video.VideoSessionHost): created on
+        # the first session frame — a pod serving no video pays nothing
+        self._session_host = None
+        self._session_lock = threading.Lock()
         self._log = get_logger()
 
     def _register_state_gauges(self) -> None:
@@ -236,6 +240,21 @@ class ServeApp:
             "Compile-cache misses (off-grid keys — a scheduler bug).",
             fn=lambda: float(self.cache.stats()["misses"]),
         )
+
+    @property
+    def session_host(self):
+        """The per-session temporal-ring host (lazy; fabric/session.py
+        routes land here via the HTTP handler)."""
+        with self._session_lock:
+            if self._session_host is None:
+                from mpi_cuda_imagemanipulation_tpu.stream.video import (
+                    VideoSessionHost,
+                )
+
+                self._session_host = VideoSessionHost(
+                    registry=self.registry
+                )
+            return self._session_host
 
     def render_metrics(self) -> str:
         """The `GET /metrics` body: Prometheus text exposition over the
@@ -297,6 +316,11 @@ class ServeApp:
             "health": self.health.to_dict(),
             "breakers": self.breakers.snapshot(),
             "cache": self.cache.stats(),
+            "sessions": (
+                self._session_host.stats()
+                if self._session_host is not None
+                else None
+            ),
             "engine": (
                 self.scheduler.engine.metrics.snapshot()
                 if self.scheduler.engine is not None
@@ -381,8 +405,86 @@ def _make_handler(app: ServeApp):
             else:
                 self._send_json(404, {"error": f"no route {self.path}"})
 
+        def _handle_session_frame(self, sid: str) -> None:
+            """One live-session frame (fabric/session.py protocol): push
+            the temporal rings, return the processed frame (200 PNG) for
+            live traffic or an empty 204 for replays/duplicates. 409 on
+            a sequence gap tells the router to rebind with a replay."""
+            # lazy import: the protocol constants live with the router's
+            # session table, but nothing here needs the fabric at import
+            # time (and a bare Server must not drag the pod stack in)
+            from mpi_cuda_imagemanipulation_tpu.fabric import (
+                session as fabric_session,
+            )
+            from mpi_cuda_imagemanipulation_tpu.io.image import (
+                decode_image_bytes,
+                encode_image_bytes,
+            )
+            from mpi_cuda_imagemanipulation_tpu.stream.video import (
+                SessionGapError,
+            )
+
+            # drain the body FIRST: an early 400 that leaves it unread
+            # would desync the router's keep-alive connection pool
+            n = int(self.headers.get("Content-Length", "0"))
+            data = self.rfile.read(n)
+            ops = self.headers.get(fabric_session.HDR_OPS) or ""
+            raw_seq = self.headers.get(fabric_session.HDR_SEQ)
+            try:
+                seq = int(raw_seq)
+            except (TypeError, ValueError):
+                self._send_json(
+                    400, {"error": f"bad {fabric_session.HDR_SEQ} {raw_seq!r}"}
+                )
+                return
+            if not ops:
+                self._send_json(
+                    400,
+                    {"error": f"missing {fabric_session.HDR_OPS} header"},
+                )
+                return
+            try:
+                frame = decode_image_bytes(data)
+            except Exception as e:
+                self._send_json(400, {"error": f"undecodable frame: {e}"})
+                return
+            try:
+                out = app.session_host.process_frame(
+                    sid,
+                    ops,
+                    seq,
+                    frame,
+                    replay=bool(self.headers.get(fabric_session.HDR_REPLAY)),
+                    reset=bool(self.headers.get(fabric_session.HDR_RESET)),
+                )
+            except SessionGapError as e:
+                self._send_json(409, {"error": str(e)})
+                return
+            except Exception as e:
+                self._send_json(500, {"error": f"session frame failed: {e}"})
+                return
+            if out is None:  # replay/duplicate: rings advanced, no pixels
+                self.send_response(204)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+            png = encode_image_bytes(out)
+            self.send_response(200)
+            self.send_header("Content-Type", "image/png")
+            self.send_header("Content-Length", str(len(png)))
+            self.end_headers()
+            self.wfile.write(png)
+
         def do_POST(self):  # noqa: N802
             if self.path != "/v1/process":
+                from mpi_cuda_imagemanipulation_tpu.fabric import (
+                    session as fabric_session,
+                )
+
+                route = fabric_session.parse_session_path(self.path)
+                if route is not None:
+                    self._handle_session_frame(route[0])
+                    return
                 self._send_json(404, {"error": f"no route {self.path}"})
                 return
             from mpi_cuda_imagemanipulation_tpu.io.image import (
@@ -390,6 +492,17 @@ def _make_handler(app: ServeApp):
                 encode_image_bytes,
             )
 
+            if not app.health.is_admitting():
+                # draining/stopped: the drain-before-kill contract — the
+                # router stopped routing on mark_draining, and anything
+                # that still arrives gets an explicit retry-later, never
+                # admission into a queue about to be torn down
+                self._send_json(
+                    503,
+                    {"status": app.health.state, "error": "not admitting"},
+                    [("Retry-After", "1")],
+                )
+                return
             try:
                 n = int(self.headers.get("Content-Length", "0"))
                 data = self.rfile.read(n)

@@ -157,6 +157,67 @@ _VARS = (
            "Queue-fill fraction (queued/queue_depth from the heartbeat) "
            "past which the sticky target is skipped for the least-loaded "
            "healthy replica."),
+    # -- elastic fabric (fabric/autoscaler.py, fabric/canary.py,
+    # fabric/session.py) ----------------------------------------------------
+    EnvVar("MCIM_FABRIC_MIN_REPLICAS", "1", "fabric/autoscaler.py",
+           "Autoscaler floor: the control loop never drains the replica "
+           "set below this count."),
+    EnvVar("MCIM_FABRIC_MAX_REPLICAS", "8", "fabric/autoscaler.py",
+           "Autoscaler ceiling: scale-up stops here regardless of "
+           "pressure."),
+    EnvVar("MCIM_FABRIC_SCALE_UP_FRAC", "0.75", "fabric/autoscaler.py",
+           "Mean queue-fill fraction across routable replicas that, "
+           "sustained for MCIM_FABRIC_SCALE_SUSTAIN_S, triggers a "
+           "scale-up."),
+    EnvVar("MCIM_FABRIC_SCALE_DOWN_FRAC", "0.15", "fabric/autoscaler.py",
+           "Mean queue-fill fraction BELOW which (sustained, and with a "
+           "majority of replicas idle) the autoscaler drains one "
+           "replica."),
+    EnvVar("MCIM_FABRIC_SCALE_SUSTAIN_S", "3", "fabric/autoscaler.py",
+           "How long a pressure signal must persist before the "
+           "autoscaler acts on it (the hysteresis window — a blip "
+           "scales nothing)."),
+    EnvVar("MCIM_FABRIC_SCALE_COOLDOWN_S", "5", "fabric/autoscaler.py",
+           "Quiet period after any scale action before the next one "
+           "(lets the new replica set settle before re-evaluating)."),
+    EnvVar("MCIM_FABRIC_SCALE_TICK_S", "0.5", "fabric/autoscaler.py",
+           "Autoscaler evaluation period in seconds."),
+    EnvVar("MCIM_FABRIC_SCALE_P99_TARGET_S", None, "fabric/autoscaler.py",
+           "Optional latency up-signal: a federated p99 above this "
+           "(sustained) also triggers scale-up, independent of queue "
+           "fill."),
+    EnvVar("MCIM_FABRIC_SCALE_DRAIN_DEADLINE_S", "30",
+           "fabric/autoscaler.py",
+           "Drain-before-kill budget: a draining replica whose queue "
+           "has not emptied by then is SIGTERMed anyway (the replica's "
+           "own drain deadline still flushes in-flight work)."),
+    EnvVar("MCIM_FABRIC_CANARY_FRAC", "0.05", "fabric/canary.py",
+           "Fraction of front-door traffic routed to the canary replica "
+           "while a config flip is under evaluation."),
+    EnvVar("MCIM_FABRIC_CANARY_MIN_REQUESTS", "40", "fabric/canary.py",
+           "Canary outcomes the rollback gate needs before it may "
+           "decide (breach can fire earlier on shadow digest "
+           "mismatches, which are individually damning)."),
+    EnvVar("MCIM_FABRIC_CANARY_SHADOW_EVERY", "5", "fabric/canary.py",
+           "Every k-th canary-routed request is ALSO forwarded to a "
+           "stable replica and the response digests compared (the "
+           "bit-exactness spot check; the client gets the stable "
+           "answer)."),
+    EnvVar("MCIM_FABRIC_CANARY_BAD_FRAC", "0.10", "fabric/canary.py",
+           "Absolute canary bad-outcome fraction past which the gate "
+           "rolls back."),
+    EnvVar("MCIM_FABRIC_CANARY_BURN_RATIO", "3", "fabric/canary.py",
+           "Relative breach: canary bad rate must stay under this "
+           "multiple of the stable lanes' bad rate over the gate "
+           "window (the canary-vs-stable burn-rate comparison)."),
+    EnvVar("MCIM_FABRIC_CANARY_PROMOTE_REQUESTS", "400",
+           "fabric/canary.py",
+           "Canary outcomes without a breach after which the gate "
+           "reports the flip promotable."),
+    EnvVar("MCIM_FABRIC_SESSION_TAIL", "0", "fabric/session.py",
+           "Frames of journal tail the router retains per live video "
+           "session for failover replay; 0 = sized automatically from "
+           "the session pipeline's temporal windows (sum of windows)."),
     EnvVar("MCIM_FABRIC_RPS", None, "bench_suite.py",
            "fabric_loadgen lane: offered-rate override (single float)."),
     EnvVar("MCIM_FABRIC_DURATION_S", None, "bench_suite.py",

@@ -1,0 +1,810 @@
+"""Elastic fabric (ISSUE 12) — autoscaling, drain-before-kill,
+preemption-aware recovery, canary rollback, live-session failover.
+
+Unit layers are tested pure (fake clocks, injected heartbeats, no
+sockets): the autoscaler's hysteresis/bounds/drain machine, the canary
+gate's slice + breach arithmetic, the session table's tail math, the
+replica-side ring protocol, and the loadgen's shed accounting. The
+acceptance layer stands up REAL pods (replica worker processes over
+HTTP) and proves the headline claims: scale 1->3-and-back with 100% of
+accepted requests bit-exact, SIGKILL of a replica holding a live video
+session resuming that session bit-exact elsewhere, and a deliberately
+broken canary flip (failpoint-injected) auto-reverted by the rollback
+gate before it exceeds its traffic slice — with the `canary_rollback`
+and `preempt` recorder dumps on disk.
+"""
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mpi_cuda_imagemanipulation_tpu.fabric import canary as fabric_canary
+from mpi_cuda_imagemanipulation_tpu.fabric import session as fabric_session
+from mpi_cuda_imagemanipulation_tpu.fabric.autoscaler import (
+    Autoscaler,
+    AutoscalerConfig,
+)
+from mpi_cuda_imagemanipulation_tpu.fabric.control import (
+    PREEMPT_EXIT_CODE,
+    Heartbeat,
+)
+from mpi_cuda_imagemanipulation_tpu.fabric.router import Router, RouterConfig
+from mpi_cuda_imagemanipulation_tpu.fabric.supervisor import (
+    Fabric,
+    FabricConfig,
+    ReplicaSpec,
+    Supervisor,
+)
+from mpi_cuda_imagemanipulation_tpu.io.image import (
+    decode_image_bytes,
+    encode_image_bytes,
+    synthetic_image,
+)
+from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline
+from mpi_cuda_imagemanipulation_tpu.obs.metrics import Registry
+from mpi_cuda_imagemanipulation_tpu.ops.temporal import split_temporal
+from mpi_cuda_imagemanipulation_tpu.serve import loadgen
+from mpi_cuda_imagemanipulation_tpu.serve.bucketing import parse_buckets
+from mpi_cuda_imagemanipulation_tpu.stream import video as svideo
+
+BUCKETS = "48,96"
+
+
+class _Clock:
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def _hb(
+    rid: str,
+    *,
+    state: str = "serving",
+    queued: int = 0,
+    queue_depth: int = 64,
+    warm=(),
+    incarnation: str = "i1",
+    port: int = 1,
+) -> Heartbeat:
+    return Heartbeat(
+        replica_id=rid,
+        addr="127.0.0.1",
+        port=port,
+        pid=0,
+        incarnation=incarnation,
+        state=state,
+        queued=queued,
+        queue_depth=queue_depth,
+        breaker_open=[],
+        warm_buckets=list(warm),
+        seq=1,
+        sent_unix_s=0.0,
+    )
+
+
+def _router(clock: _Clock) -> Router:
+    return Router(
+        RouterConfig(
+            buckets=parse_buckets(BUCKETS), stale_s=5.0, forward_attempts=3
+        ),
+        clock=clock,
+    )
+
+
+# --------------------------------------------------------------------------
+# autoscaler: hysteresis, bounds, drain-before-kill (pure, fake clock)
+# --------------------------------------------------------------------------
+
+
+def _autoscaler(router, clock, live, ups, downs, **over):
+    cfg = AutoscalerConfig(
+        min_replicas=over.pop("min_replicas", 1),
+        max_replicas=over.pop("max_replicas", 3),
+        up_frac=0.5,
+        down_frac=0.2,
+        sustain_s=1.0,
+        cooldown_s=2.0,
+        tick_s=0.1,
+        drain_deadline_s=5.0,
+        **over,
+    )
+    return Autoscaler(
+        router,
+        scale_up=lambda: (ups.append("up"), live.__setitem__(0, live[0] + 1))
+        and "rX",
+        scale_down=lambda rid: (
+            downs.append(rid), live.__setitem__(0, live[0] - 1),
+        ),
+        live_count=lambda: live[0],
+        config=cfg,
+        clock=clock,
+    )
+
+
+def test_autoscaler_scales_up_on_sustained_pressure_only():
+    clock = _Clock()
+    router = _router(clock)
+    live, ups, downs = [1], [], []
+    auto = _autoscaler(router, clock, live, ups, downs)
+    router.table.observe(_hb("r0", queued=60), clock())
+    auto.tick()  # pressure seen, sustain window opens
+    assert ups == []
+    clock.t += 0.5
+    router.table.observe(_hb("r0", queued=0), clock())
+    auto.tick()  # blip over: window resets, nothing fires
+    clock.t += 0.1
+    router.table.observe(_hb("r0", queued=60), clock())
+    auto.tick()
+    clock.t += 0.5
+    auto.tick()  # only 0.5s sustained
+    assert ups == []
+    clock.t += 0.6
+    auto.tick()  # 1.1s sustained -> scale up
+    assert ups == ["up"] and live[0] == 2
+    # cooldown: continued pressure does not immediately fire again
+    clock.t += 0.5
+    auto.tick()
+    assert ups == ["up"]
+
+
+def test_autoscaler_respects_max_and_min_bounds():
+    clock = _Clock()
+    router = _router(clock)
+    live, ups, downs = [3], [], []
+    auto = _autoscaler(router, clock, live, ups, downs, max_replicas=3)
+    router.table.observe(_hb("r0", queued=64), clock())
+    clock.t += 1.5
+    auto.tick()
+    clock.t += 1.5
+    auto.tick()
+    assert ups == []  # at ceiling: sustained pressure scales nothing
+    # below min: immediate corrective scale-up, no sustain needed
+    live[0] = 0
+    auto2 = _autoscaler(router, clock, live, ups, downs, min_replicas=1)
+    auto2.tick()
+    assert ups == ["up"] and live[0] == 1
+
+
+def test_autoscaler_drain_before_kill_sequence():
+    clock = _Clock()
+    router = _router(clock)
+    live, ups, downs = [2], [], []
+    auto = _autoscaler(router, clock, live, ups, downs)
+    router.table.observe(_hb("r0", queued=0), clock())
+    router.table.observe(_hb("r1", queued=0), clock())
+    auto.tick()
+    clock.t += 1.1
+    auto.tick()  # idle sustained -> pick victim, mark draining
+    assert auto.draining is not None
+    victim = auto.draining[0]
+    assert victim == "r1"  # fewest-warm tie -> highest id goes first
+    assert router.draining_ids() == ["r1"]
+    # routing stopped immediately; the heartbeat ack says drain
+    assert [v.replica_id for v in router._routable()] == ["r0"]
+    _code, ack = router.handle_heartbeat(_hb("r1").to_json())
+    assert ack["drain"] is True
+    _code, ack0 = router.handle_heartbeat(_hb("r0").to_json())
+    assert ack0["drain"] is False
+    # still serving with work queued: NOT killed
+    router.table.observe(_hb("r1", state="draining", queued=3), clock())
+    clock.t += 0.2
+    auto.tick()
+    assert downs == []
+    # drained: queue empty in the draining state -> SIGTERM now
+    router.table.observe(_hb("r1", state="draining", queued=0), clock())
+    clock.t += 0.2
+    auto.tick()
+    assert downs == ["r1"] and live[0] == 1
+    assert auto.draining is None and router.draining_ids() == []
+
+
+def test_autoscaler_drain_deadline_forces_removal():
+    clock = _Clock()
+    router = _router(clock)
+    live, ups, downs = [2], [], []
+    auto = _autoscaler(router, clock, live, ups, downs)
+    router.table.observe(_hb("r0", queued=0), clock())
+    router.table.observe(_hb("r1", queued=0), clock())
+    auto.tick()
+    clock.t += 1.1
+    auto.tick()
+    assert auto.draining is not None
+    # the victim never drains (wedged queue): the deadline removes it
+    router.table.observe(_hb("r1", queued=5), clock())
+    clock.t += 5.1
+    auto.tick()
+    assert downs == ["r1"]
+    assert auto.events[-1]["reason"] == "drain deadline"
+
+
+# --------------------------------------------------------------------------
+# canary gate (pure)
+# --------------------------------------------------------------------------
+
+
+def _gate(**over) -> fabric_canary.CanaryGate:
+    cfg = dict(
+        frac=0.05, min_requests=10, shadow_every=4,
+        bad_frac=0.10, burn_ratio=3.0, promote_requests=100,
+    )
+    cfg.update(over)
+    return fabric_canary.CanaryGate(fabric_canary.CanaryConfig(**cfg))
+
+
+def test_canary_slice_is_deterministic_fraction():
+    g = _gate(frac=0.05)
+    g.start("r1", {})
+    takes = [g.take_canary() for _ in range(400)]
+    assert sum(takes) == 20  # exactly every 20th request
+    assert takes[19] and not takes[0]
+
+
+def test_canary_rate_breach_needs_min_requests_and_ratio():
+    g = _gate(min_requests=10)
+    g.start("r1", {})
+    for _ in range(200):
+        g.record("stable", True)
+    for _ in range(9):
+        g.record("canary", False)
+    assert g.state == fabric_canary.CANARY  # below min_requests
+    g.record("canary", False)
+    assert g.state == fabric_canary.ROLLED_BACK
+    assert "bad rate" in g.reason
+
+
+def test_canary_tolerates_shared_badness():
+    """Stable failing at the same rate is not the flip's fault — the
+    ratio guard keeps a pod-wide incident from rolling back an innocent
+    canary."""
+    g = _gate(min_requests=10, bad_frac=0.05, burn_ratio=3.0)
+    g.start("r1", {})
+    for _ in range(100):
+        g.record("stable", False)  # everything is on fire
+    for _ in range(5):
+        g.record("canary", False)
+    for _ in range(5):
+        g.record("canary", True)
+    assert g.state == fabric_canary.CANARY
+
+
+def test_canary_shadow_mismatch_breaches_immediately():
+    g = _gate()
+    g.start("r1", {})
+    g.record("canary", True)
+    assert g.record_shadow(False) == fabric_canary.ROLLED_BACK
+    assert "digest" in g.reason
+
+
+def test_canary_promotes_after_quiet_window():
+    g = _gate(min_requests=5, promote_requests=30)
+    g.start("r1", {})
+    for _ in range(30):
+        g.record("canary", True)
+    assert g.state == fabric_canary.PROMOTED
+
+
+# --------------------------------------------------------------------------
+# session table + replica-side ring protocol (pure)
+# --------------------------------------------------------------------------
+
+
+def test_session_tail_capacity_covers_temporal_windows():
+    assert fabric_session.tail_capacity("grayscale") == 1
+    assert fabric_session.tail_capacity("tdenoise:3,grayscale") == 3
+    assert fabric_session.tail_capacity("tdenoise:4,framediff,invert") == 6
+
+
+def test_session_table_evicts_oldest_idle_only():
+    table = fabric_session.SessionTable(cap=2)
+    s0 = table.get_or_create("s0", "grayscale")
+    time.sleep(0.01)
+    table.get_or_create("s1", "grayscale")
+    s0.remember(0, b"x")  # s0 active more recently than s1 now
+    table.get_or_create("s2", "grayscale")
+    assert table.get("s1") is None and table.get("s0") is not None
+    assert table.evicted == 1
+
+
+def test_parse_session_path():
+    assert fabric_session.parse_session_path("/v1/session/abc/frame") == (
+        "abc", "frame",
+    )
+    assert fabric_session.parse_session_path("/v1/session//frame") is None
+    assert fabric_session.parse_session_path("/v1/session/abc") is None
+    assert fabric_session.parse_session_path("/v1/process") is None
+
+
+def test_session_host_replay_rebuilds_rings_bit_exact():
+    """The failover arithmetic: reset + tail replay + live == the
+    uninterrupted stream, frame for frame."""
+    ops = "tdenoise:3,grayscale,contrast:3.5"
+    frames = [
+        synthetic_image(24, 28, channels=3, seed=40 + i) for i in range(10)
+    ]
+    temporal, rest = split_temporal(ops)
+    rings = svideo.FrameRings(temporal)
+    fn = Pipeline.parse(rest).jit()
+    golden = [np.asarray(fn(rings.push(f))) for f in frames]
+
+    host_a = svideo.VideoSessionHost()
+    for seq in range(6):
+        out = host_a.process_frame("s", ops, seq, frames[seq])
+        np.testing.assert_array_equal(out, golden[seq])
+    # replica A dies; replica B rebuilds from the router's journal tail
+    # (sum of windows = 3 frames) with reset-on-first, then goes live
+    host_b = svideo.VideoSessionHost()
+    tail = [3, 4, 5]
+    for i, seq in enumerate(tail):
+        assert (
+            host_b.process_frame(
+                "s", ops, seq, frames[seq], replay=True, reset=(i == 0)
+            )
+            is None
+        )
+    for seq in range(6, 10):
+        out = host_b.process_frame("s", ops, seq, frames[seq])
+        np.testing.assert_array_equal(out, golden[seq])
+
+
+def test_session_host_is_strict_about_sequence():
+    ops = "framediff,grayscale"
+    host = svideo.VideoSessionHost()
+    f = synthetic_image(16, 16, channels=3, seed=1)
+    host.process_frame("s", ops, 0, f)
+    host.process_frame("s", ops, 1, f)
+    assert host.process_frame("s", ops, 1, f) is None  # duplicate: no-op
+    with pytest.raises(svideo.SessionGapError):
+        host.process_frame("s", ops, 3, f)  # gap: never silently pushed
+
+
+# --------------------------------------------------------------------------
+# loadgen shed accounting (503 + Retry-After != unavailability)
+# --------------------------------------------------------------------------
+
+
+def _mini_server(code: int, headers: list):
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class H(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            self.rfile.read(int(self.headers.get("Content-Length", "0")))
+            body = b"{}"
+            self.send_response(code)
+            for k, v in headers:
+                self.send_header(k, v)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+def test_loadgen_counts_retry_after_503_as_shed():
+    srv = _mini_server(503, [("Retry-After", "1")])
+    try:
+        url = f"http://127.0.0.1:{srv.server_address[1]}"
+        rec = loadgen.http_run_offered_load(url, [b"x"], 200.0, 0.05)
+        assert rec["submitted"] > 0
+        assert rec["shed"] == rec["submitted"]
+        assert rec["unavailable"] == 0
+        assert rec["accepted"] == 0 and rec["ok_accepted_frac"] == 1.0
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_loadgen_counts_bare_503_as_unavailable():
+    srv = _mini_server(503, [])
+    try:
+        url = f"http://127.0.0.1:{srv.server_address[1]}"
+        rec = loadgen.http_run_offered_load(url, [b"x"], 200.0, 0.05)
+        assert rec["unavailable"] == rec["submitted"]
+        assert rec["shed"] == 0
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+# --------------------------------------------------------------------------
+# supervisor restart semantics (real processes, tiny scripts)
+# --------------------------------------------------------------------------
+
+
+def _crasher(rc: int, sleep_s: float = 0.0) -> list:
+    return [
+        sys.executable, "-c",
+        f"import time; time.sleep({sleep_s}); raise SystemExit({rc})",
+    ]
+
+
+def test_supervisor_backs_off_on_crash_loop():
+    sup = Supervisor(
+        [ReplicaSpec("c0", _crasher(1))],
+        backoff_base_s=0.2,
+        backoff_max_s=2.0,
+        stable_s=10.0,
+    ).start()
+    try:
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            if sup.restarts("c0") >= 2:
+                break
+            time.sleep(0.05)
+        assert sup.restarts("c0") >= 2
+        # consecutive instant crashes ratchet the attempt counter (the
+        # exponent), and none of them are preemptions
+        assert sup._managed["c0"].attempts >= 2
+        assert sup.preemptions("c0") == 0
+    finally:
+        sup.stop(drain=False)
+
+
+def test_supervisor_skips_backoff_on_preemption():
+    sup = Supervisor(
+        [ReplicaSpec("p0", _crasher(PREEMPT_EXIT_CODE))],
+        backoff_base_s=5.0,  # a crash would wait 5s between respawns
+        stable_s=10.0,
+    ).start()
+    try:
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            if sup.preemptions("p0") >= 3:
+                break
+            time.sleep(0.05)
+        # 3+ replacements in well under one crash-backoff period: the
+        # preemption path never waited
+        assert sup.preemptions("p0") >= 3
+        assert sup._managed["p0"].attempts == 0
+    finally:
+        sup.stop(drain=False)
+
+
+def test_supervisor_forgives_attempts_after_stable_run():
+    sup = Supervisor(
+        [ReplicaSpec("s0", _crasher(1, sleep_s=0.5))],
+        backoff_base_s=0.1,
+        stable_s=0.2,  # a 0.5s run counts as stable
+    ).start()
+    try:
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            if sup.restarts("s0") >= 2:
+                break
+            time.sleep(0.05)
+        assert sup.restarts("s0") >= 2
+        # every incarnation survived stable_s, so the exponent never
+        # ratchets past the first step
+        assert sup._managed["s0"].attempts <= 1
+    finally:
+        sup.stop(drain=False)
+
+
+def test_supervisor_remove_forgets_replica():
+    sup = Supervisor(
+        [ReplicaSpec("d0", _crasher(0, sleep_s=60.0))],
+        backoff_base_s=0.1,
+    ).start()
+    try:
+        assert sup.replica_ids() == ["d0"]
+        sup.remove("d0", deadline_s=10.0)
+        assert sup.replica_ids() == []
+        time.sleep(0.3)  # the monitor must NOT resurrect it
+        assert sup.pids() == {}
+    finally:
+        sup.stop(drain=False)
+
+
+# --------------------------------------------------------------------------
+# ACCEPTANCE: real pods over HTTP
+# --------------------------------------------------------------------------
+
+OPS = "grayscale,contrast:3.5"
+ACCEPT_BUCKETS = "48"
+
+
+def _recorder_env(monkeypatch, tmp_path) -> str:
+    rec_dir = str(tmp_path / "recorder")
+    monkeypatch.setenv("MCIM_RECORDER_DIR", rec_dir)
+    monkeypatch.setenv("MCIM_RECORDER_MIN_INTERVAL_S", "0")
+    return rec_dir
+
+
+def test_elastic_acceptance_scale_up_down_and_preempt(tmp_path, monkeypatch):
+    """The churn acceptance: saturating open-loop load grows the pod
+    1->3 (every accepted request bit-exact, sheds explicit), a SIGUSR1
+    preemption mid-load is absorbed with a `preempt` dump and an
+    immediate replacement, and the idle pod drains back down —
+    scale-down never drops accepted work."""
+    rec_dir = _recorder_env(monkeypatch, tmp_path)
+    pipe = Pipeline.parse(OPS)
+    imgs = [
+        synthetic_image(40 + i, 44 + i, channels=3, seed=90 + i)
+        for i in range(4)
+    ]
+    blobs = [encode_image_bytes(im) for im in imgs]
+    golden = [np.asarray(pipe.jit()(im)) for im in imgs]
+    cfg = FabricConfig(
+        replicas=1,
+        ops=OPS,
+        buckets=ACCEPT_BUCKETS,
+        channels="3",
+        max_batch=4,
+        max_delay_ms=4.0,
+        queue_depth=16,
+        heartbeat_s=0.2,
+        router=RouterConfig(
+            buckets=parse_buckets(ACCEPT_BUCKETS),
+            stale_s=0.8,
+            forward_attempts=3,
+        ),
+        all_replica_env={"MCIM_FAILPOINTS": "serve.dispatch=sleep:60"},
+        autoscale=True,
+        min_replicas=1,
+        max_replicas=3,
+        scale_up_frac=0.5,
+        scale_down_frac=0.2,
+        scale_sustain_s=0.5,
+        scale_cooldown_s=1.5,
+        scale_tick_s=0.2,
+        scale_drain_deadline_s=30.0,
+    )
+    stop = threading.Event()
+    recs: list[dict] = []
+    with Fabric(cfg).start() as fab:
+
+        def load_loop():
+            while not stop.is_set():
+                recs.append(
+                    loadgen.http_run_offered_load(
+                        fab.url, blobs, 250.0, 1.0, max_workers=64,
+                        timeout_s=20.0,
+                    )
+                )
+
+        loader = threading.Thread(target=load_loop, daemon=True)
+        loader.start()
+        try:
+            # -- scale 1 -> 3 under saturation (3 SERVING replicas — a
+            # just-spawned process is not preemptable yet: a notice
+            # before its signal handlers exist is plain SIGUSR1 death)
+            deadline = time.monotonic() + 150.0
+            while time.monotonic() < deadline:
+                if len(fab.router._routable()) >= 3:
+                    break
+                time.sleep(0.1)
+            assert len(fab.router._routable()) >= 3, (
+                f"never scaled to 3: {fab.router.autoscaler.status()}"
+            )
+            # -- preemption mid-load ------------------------------------
+            victim = sorted(
+                v.replica_id for v in fab.router._routable()
+            )[-1]
+            old_inc_view = fab.router.table.get(victim)
+            old_inc = (
+                old_inc_view.hb.incarnation if old_inc_view else None
+            )
+            os.kill(fab.supervisor.pids()[victim], signal.SIGUSR1)
+            deadline = time.monotonic() + 90.0
+            while time.monotonic() < deadline:
+                view = fab.router.table.get(victim)
+                if (
+                    fab.supervisor.preemptions(victim) >= 1
+                    and view is not None
+                    and view.hb.incarnation != old_inc
+                    and view.hb.state == "serving"
+                ):
+                    break
+                time.sleep(0.1)
+            assert fab.supervisor.preemptions(victim) >= 1
+            assert fab.supervisor._managed[victim].attempts == 0, (
+                "preemption must not ratchet the crash-loop exponent"
+            )
+        finally:
+            stop.set()
+            loader.join(timeout=120.0)
+        # -- every accepted request resolved ok and bit-exact ------------
+        import collections
+
+        submitted = sum(r["submitted"] for r in recs)
+        accepted = sum(r["accepted"] for r in recs)
+        ok = sum(r["ok"] for r in recs)
+        codes = collections.Counter(
+            r["code"] for rec in recs for _k, r in rec["results"]
+        )
+        assert submitted > 0 and ok == accepted, (
+            f"{accepted - ok} accepted requests did not resolve ok "
+            f"(of {submitted} submitted; sheds are explicit and "
+            f"excluded; status histogram {dict(codes)})"
+        )
+        assert sum(r["unavailable"] for r in recs) == 0
+        for rec in recs:
+            for k, r in rec["results"]:
+                if r["code"] == 200:
+                    np.testing.assert_array_equal(
+                        decode_image_bytes(r["body"]),
+                        golden[k % len(golden)],
+                    )
+        # -- preempt dump on disk ----------------------------------------
+        preempt_dumps = [
+            p for p in os.listdir(rec_dir)
+            if p.startswith("recorder_preempt")
+        ]
+        assert preempt_dumps, f"no preempt dump in {rec_dir}"
+        # -- idle -> drain back toward min --------------------------------
+        deadline = time.monotonic() + 150.0
+        down: list = []
+        while time.monotonic() < deadline:
+            down = [
+                e for e in fab.router.autoscaler.events
+                if e["direction"] == "down"
+            ]
+            if down:
+                break
+            time.sleep(0.1)
+        assert down, (
+            f"no scale-down happened: {fab.router.autoscaler.status()}"
+        )
+        assert down[-1]["reason"] == "drained", (
+            f"scale-down did not drain first: {down[-1]}"
+        )
+
+
+def test_canary_failpoint_flip_rolls_back_within_slice(
+    tmp_path, monkeypatch
+):
+    """A deliberately broken canary flip — the canary replica's env arms
+    `engine.complete=always`, so every request it serves fails — must be
+    auto-reverted by the rollback gate while its traffic share stays
+    within the canary slice, the clients never see the breakage (canary
+    requests fall back to stable), and the `canary_rollback` dump names
+    the breach."""
+    rec_dir = _recorder_env(monkeypatch, tmp_path)
+    pipe = Pipeline.parse(OPS)
+    imgs = [
+        synthetic_image(40 + 3 * i, 42 + 2 * i, channels=3, seed=60 + i)
+        for i in range(3)
+    ]
+    blobs = [encode_image_bytes(im) for im in imgs]
+    golden = [np.asarray(pipe.jit()(im)) for im in imgs]
+    cfg = FabricConfig(
+        replicas=2,
+        ops=OPS,
+        buckets=ACCEPT_BUCKETS,
+        channels="3",
+        max_batch=4,
+        queue_depth=64,
+        heartbeat_s=0.2,
+        router=RouterConfig(
+            buckets=parse_buckets(ACCEPT_BUCKETS),
+            stale_s=0.8,
+            forward_attempts=3,
+            canary=fabric_canary.CanaryConfig(
+                frac=0.05, min_requests=5, shadow_every=1000,
+            ),
+        ),
+    )
+    with Fabric(cfg).start() as fab:
+        status = fab.router.canary_deploy(
+            {"env": {"MCIM_FAILPOINTS": "engine.complete=always"}}
+        )
+        canary_rid = status["replica"]
+        assert status["state"] == fabric_canary.CANARY
+        # drive traffic until the gate decides (min_requests canary
+        # outcomes at a 5% slice ~= 100 requests; give it 1200)
+        rolled = False
+        for i in range(1200):
+            r = loadgen.http_post_image(fab.url, blobs[i % len(blobs)])
+            # the client never sees the broken flip: canary-first falls
+            # back to stable, so every accepted answer is ok + bit-exact
+            assert r["code"] == 200, (i, r["code"], r["body"][:120])
+            np.testing.assert_array_equal(
+                decode_image_bytes(r["body"]), golden[i % len(golden)]
+            )
+            if fab.router.canary.state == fabric_canary.ROLLED_BACK or (
+                fab.router.canary.state == fabric_canary.IDLE
+            ):
+                rolled = True
+                break
+        assert rolled, f"gate never decided: {fab.router.canary.status()}"
+        # traffic share: the flip never exceeded its slice (plus margin
+        # — the dump froze the lane counts at the moment of the breach)
+        dumps = [
+            p for p in os.listdir(rec_dir)
+            if p.startswith("recorder_canary_rollback")
+        ]
+        assert dumps, f"no canary_rollback dump in {rec_dir}"
+        with open(os.path.join(rec_dir, dumps[0])) as f:
+            dump = json.load(f)
+        canary_n = dump["extra"]["canary"]["ok"] + dump["extra"]["canary"]["bad"]
+        stable_n = dump["extra"]["stable"]["ok"] + dump["extra"]["stable"]["bad"]
+        assert canary_n + stable_n > 0
+        share = canary_n / (canary_n + stable_n)
+        assert share <= 0.08, (
+            f"broken flip reached {share:.1%} of traffic before rollback"
+        )
+        assert dump["extra"]["canary"]["bad"] >= 5
+        # the revert restores a 2-replica stable pod that serves again
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            if (
+                fab.router.canary.state == fabric_canary.IDLE
+                and len(fab.router._routable()) == 2
+            ):
+                break
+            time.sleep(0.2)
+        assert fab.router.canary.state == fabric_canary.IDLE
+        view = fab.router.table.get(canary_rid)
+        assert view is not None and view.hb.state == "serving"
+        r = loadgen.http_post_image(fab.url, blobs[0])
+        assert r["code"] == 200
+        np.testing.assert_array_equal(
+            decode_image_bytes(r["body"]), golden[0]
+        )
+
+
+def test_video_session_survives_sigkill_bit_exact(tmp_path, monkeypatch):
+    """SIGKILL the replica HOLDING a live video session mid-stream: the
+    router rebinds the session to the survivor, replays the journal
+    tail, and the resumed stream is bit-exact with the uninterrupted
+    one — the stateful half of the churn acceptance."""
+    _recorder_env(monkeypatch, tmp_path)
+    session_ops = "tdenoise:3,grayscale,contrast:3.5"
+    frames = [
+        synthetic_image(40, 44, channels=3, seed=130 + i) for i in range(12)
+    ]
+    temporal, rest = split_temporal(session_ops)
+    rings = svideo.FrameRings(temporal)
+    fn = Pipeline.parse(rest).jit()
+    golden = [np.asarray(fn(rings.push(f))) for f in frames]
+    cfg = FabricConfig(
+        replicas=2,
+        ops=OPS,
+        buckets=ACCEPT_BUCKETS,
+        channels="3",
+        max_batch=4,
+        queue_depth=64,
+        heartbeat_s=0.2,
+        router=RouterConfig(
+            buckets=parse_buckets(ACCEPT_BUCKETS),
+            stale_s=0.8,
+            forward_attempts=3,
+            breaker_threshold=2,
+            breaker_reset_s=0.5,
+        ),
+        supervisor_backoff_s=0.25,
+    )
+    with Fabric(cfg).start() as fab:
+        first = svideo.stream_video_session(
+            frames[:6], fab.url, session_ops, session_id="live-1"
+        )
+        for k in range(6):
+            np.testing.assert_array_equal(first["outputs"][k], golden[k])
+        bound = fab.router.sessions.get("live-1").replica_id
+        assert bound in first["replicas"]
+        fab.kill_replica(bound)  # SIGKILL: no drain, no goodbye
+        rest_run = svideo.stream_video_session(
+            frames[6:], fab.url, session_ops,
+            session_id="live-1", start_seq=6,
+        )
+        for k in range(6):
+            np.testing.assert_array_equal(
+                rest_run["outputs"][k], golden[6 + k]
+            )
+        sess = fab.router.sessions.stats()["by_id"]["live-1"]
+        assert sess["failovers"] >= 1
+        assert sess["replica"] != bound
+        # the restarted replica rejoins the pod afterwards
+        fab.wait_ready(2, timeout_s=120.0)

@@ -573,3 +573,13 @@ def test_fabric_loadgen_lane_scaling_and_churn():
     for ph in ("before", "during", "after"):
         assert churn[ph]["ok_frac"] == 1.0, (ph, churn[ph])
     assert churn["respawned"]
+    # ISSUE-12: the elastic sub-lane — the autoscaled pod grew under the
+    # same saturating mix, absorbed a mid-load preemption, shrank back
+    # by DRAINING, and every request it accepted resolved ok (503 +
+    # Retry-After sheds are explicit and excluded by construction)
+    el = rec["lanes"]["elastic"]
+    assert el["scaled_up"], el
+    assert el["preempted"], el
+    assert el["drained"] and el["scaled_down"], el
+    assert el["ok_accepted_frac"] == 1.0, el
+    assert el["unavailable"] == 0, el

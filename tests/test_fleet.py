@@ -248,6 +248,51 @@ def test_counter_sums_survive_replica_restart():
     assert lat["count"] == 37
 
 
+def test_preemption_replacement_incarnations_never_double_count():
+    """ISSUE-12 satellite: a preempted replica's IMMEDIATE replacement
+    (and the replacement's replacement — eviction storms happen) folds
+    exactly like any restart: every dead incarnation's last counters
+    bank once, the successor stacks on top, and the fleet total is the
+    true pooled count at every step — even when the replacement's first
+    beat is a delta the aggregator must refuse (resync handshake)."""
+    clock = _Clock()
+    agg = fleet.FleetAggregator(stale_s=5.0, clock=clock)
+    reg1, _ = _replica_registry(1, 30)
+    src1 = fleet.DeltaSource([reg1])
+    first = src1.delta()
+    assert agg.apply("r0", "inc-a", first)
+    src1.ack(first["seq"])
+    # preemption: the replacement's first beat is a DELTA against a
+    # baseline the router never saw from this incarnation — it must be
+    # refused (resync), folding inc-a's totals exactly once meanwhile
+    reg2, _ = _replica_registry(2, 7)
+    src2 = fleet.DeltaSource([reg2])
+    d = src2.delta()
+    src2.ack(d["seq"])
+    reg2.get("mcim_serve_requests_total").inc(status="ok")
+    stale_delta = src2.delta()  # not full: baseline unknown to router
+    assert not stale_delta["full"]
+    assert agg.apply("r0", "inc-b", stale_delta) is False
+    # mid-handshake the replica drops OUT of the view (same as a target
+    # disappearing) — crucially the refused delta contributed NOTHING
+    assert "mcim_serve_requests_total" not in agg.merged()
+    # the resync full snapshot lands: 30 banked + 8 live, never 38+30
+    src2.force_full()
+    assert agg.apply("r0", "inc-b", src2.delta())
+    merged = agg.merged()["mcim_serve_requests_total"]["series"][("ok",)]
+    assert merged == 38.0
+    # a second replacement (preemption storm) banks inc-b exactly once
+    reg3, _ = _replica_registry(3, 2)
+    src3 = fleet.DeltaSource([reg3])
+    assert agg.apply("r0", "inc-c", src3.delta())
+    merged = agg.merged()["mcim_serve_requests_total"]["series"][("ok",)]
+    assert merged == 40.0
+    # histograms fold the same way (30 + 7 + 2 observations; the extra
+    # counter inc above had no matching observe)
+    lat = agg.merged()["mcim_serve_e2e_latency_seconds"]["series"][()]
+    assert lat["count"] == 39
+
+
 def test_delta_carries_only_changed_series_and_resync_recovers():
     reg, _ = _replica_registry(5, 10)
     src = fleet.DeltaSource([reg])

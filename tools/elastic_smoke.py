@@ -1,0 +1,297 @@
+#!/usr/bin/env python
+"""Elastic-fabric CI smoke: a real pod on CPU breathing 1→2→1 under
+offered load, with drain-before-kill, a rolled-back canary flip, and a
+preemption — the PR 12 control loops exercised end to end.
+
+    python tools/elastic_smoke.py METRICS_OUT
+
+Asserts, against a REAL pod (replica worker processes, real HTTP):
+
+  1. SCALE-UP: saturating offered load (a synthetic per-dispatch device
+     floor via the serve.dispatch sleep failpoint makes one CPU replica
+     saturable) drives mean queue fill over the threshold and the
+     autoscaler grows the pod 1→2; responses stay bit-exact and any
+     503s are explicit sheds (Retry-After), never unavailability.
+  2. CANARY ROLLBACK: a config flip that changes pixels (`--ops`
+     override on the canary replica) is caught by the FIRST shadow
+     digest spot-check, auto-reverted, and leaves a `canary_rollback`
+     recorder dump; after the revert the pod serves bit-exact again.
+  3. SCALE-DOWN IS DRAIN-BEFORE-KILL: with the load stopped, the
+     autoscaler drains one replica — the victim is observed (via its
+     own heartbeats in /stats) in state `draining` before it leaves,
+     and the recorded scale-down reason is `drained`, meaning the
+     SIGTERM waited for the empty queue. An `autoscale` recorder dump
+     exists for the actions.
+  4. PREEMPTION: SIGUSR1 on the survivor produces a `preempt` recorder
+     dump from the replica's own ring and an IMMEDIATE no-backoff
+     replacement (mcim_fabric_replica_preemptions_total).
+
+METRICS_OUT gets the router's final /metrics exposition (uploaded as a
+CI artifact by .github/workflows/tier1.yml).
+"""
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+OPS = "grayscale,contrast:3.5"
+BUCKETS = "48"
+
+
+def main(metrics_out: str) -> int:
+    tmp = tempfile.mkdtemp(prefix="elastic_smoke_")
+    rec_dir = os.path.join(tmp, "recorder")
+    os.environ["MCIM_RECORDER_DIR"] = rec_dir
+    os.environ["MCIM_RECORDER_MIN_INTERVAL_S"] = "0"
+
+    from mpi_cuda_imagemanipulation_tpu.fabric.canary import CanaryConfig
+    from mpi_cuda_imagemanipulation_tpu.fabric.router import RouterConfig
+    from mpi_cuda_imagemanipulation_tpu.fabric.supervisor import (
+        Fabric,
+        FabricConfig,
+    )
+    from mpi_cuda_imagemanipulation_tpu.io.image import (
+        decode_image_bytes,
+        synthetic_image,
+    )
+    from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline
+    from mpi_cuda_imagemanipulation_tpu.serve import loadgen
+    from mpi_cuda_imagemanipulation_tpu.serve.bucketing import parse_buckets
+
+    cfg = FabricConfig(
+        replicas=1,
+        ops=OPS,
+        buckets=BUCKETS,
+        channels="3",
+        max_batch=4,
+        max_delay_ms=4.0,
+        queue_depth=16,
+        heartbeat_s=0.2,
+        router=RouterConfig(
+            buckets=parse_buckets(BUCKETS),
+            stale_s=0.8,
+            forward_attempts=3,
+            canary=CanaryConfig(frac=0.1, shadow_every=2, min_requests=10),
+        ),
+        # the synthetic device floor: every dispatch sleeps 50 ms, so one
+        # replica saturates near 80 img/s and the queue-fill signal is
+        # real on a shared-core CI host (same move as fabric_loadgen)
+        all_replica_env={"MCIM_FAILPOINTS": "serve.dispatch=sleep:50"},
+        autoscale=True,
+        min_replicas=1,
+        max_replicas=2,
+        scale_up_frac=0.5,
+        scale_down_frac=0.2,
+        scale_sustain_s=0.5,
+        scale_cooldown_s=2.0,
+        scale_tick_s=0.2,
+        scale_drain_deadline_s=30.0,
+    )
+    pipe = Pipeline.parse(OPS)
+    imgs = [
+        synthetic_image(40 + 3 * i, 44 + 2 * i, channels=3, seed=70 + i)
+        for i in range(4)
+    ]
+    blobs = [loadgen.encode_blob(im) for im in imgs]
+    golden = [np.asarray(pipe.jit()(im)) for im in imgs]
+
+    def check_bit_exact(results) -> int:
+        n = 0
+        for k, r in results:
+            if r["code"] != 200:
+                continue
+            np.testing.assert_array_equal(
+                decode_image_bytes(r["body"]), golden[k % len(golden)]
+            )
+            n += 1
+        return n
+
+    load_stop = threading.Event()
+    load_recs: list[dict] = []
+
+    def load_loop():
+        while not load_stop.is_set():
+            load_recs.append(
+                loadgen.http_run_offered_load(
+                    fab.url, blobs, 120.0, 1.0, max_workers=64,
+                    timeout_s=20.0,
+                )
+            )
+
+    with Fabric(cfg).start() as fab:
+        replica_states: dict[str, set] = {}
+
+        def poll_states():
+            for rid, rep in fab.router.stats()["replicas"].items():
+                replica_states.setdefault(rid, set()).add(rep["state"])
+
+        # -- 1. saturate -> scale-up 1 -> 2 ---------------------------------
+        loader = threading.Thread(target=load_loop, daemon=True)
+        loader.start()
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            poll_states()
+            if len(fab.router._routable()) >= 2:
+                break
+            time.sleep(0.1)
+        assert len(fab.router._routable()) >= 2, (
+            "autoscaler never scaled to 2 under saturating load: "
+            f"{fab.router.autoscaler.status()}"
+        )
+        up_events = [
+            e for e in fab.router.autoscaler.events if e["direction"] == "up"
+        ]
+        assert up_events, "no scale-up event recorded"
+        print(
+            f"smoke: scaled 1->2 (reason {up_events[0]['reason']!r}, "
+            f"queue_fill {up_events[0]['signals']['queue_fill']:.2f})"
+        )
+
+        # -- 2. canary flip that changes pixels -> shadow digest rollback ---
+        status = fab.router.canary_deploy({"argv": ["--ops", "grayscale"]})
+        canary_rid = status["replica"]
+        print(f"smoke: canary flip live on {canary_rid} (slice 10%)")
+        deadline = time.monotonic() + 90.0
+        while time.monotonic() < deadline:
+            st = fab.router.canary.status()
+            if st["state"] in ("rolled_back", "idle"):
+                break
+            time.sleep(0.1)
+        st = fab.router.canary.status()
+        assert st["state"] in ("rolled_back", "idle"), (
+            f"canary never breached: {st}"
+        )
+        # wait out the revert (gate returns to idle once stable serves)
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            if fab.router.canary.status()["state"] == "idle":
+                break
+            time.sleep(0.2)
+        assert fab.router.canary.status()["state"] == "idle", (
+            "canary revert never completed"
+        )
+        dumps = [
+            p for p in os.listdir(rec_dir)
+            if p.startswith("recorder_canary_rollback")
+        ]
+        assert dumps, f"no canary_rollback dump in {rec_dir}"
+        with open(os.path.join(rec_dir, dumps[0])) as f:
+            dump = json.load(f)
+        assert dump["extra"]["shadow"]["mismatch"] >= 1, dump["extra"]
+        print(
+            f"smoke: canary rolled back ({dump['extra']['reason']}); "
+            f"dump {dumps[0]}"
+        )
+
+        # -- stop the load; verify shed accounting + bit-exactness ----------
+        load_stop.set()
+        loader.join(timeout=60.0)
+        total_unavailable = sum(r["unavailable"] for r in load_recs)
+        total_shed = sum(r["shed"] for r in load_recs)
+        assert total_unavailable == 0, (
+            f"{total_unavailable} responses counted unavailable — an "
+            "elastic pod sheds explicitly (503 + Retry-After), it does "
+            "not go dark"
+        )
+        # bit-exactness: every 200 outside the canary window matches the
+        # golden output (the flip window intentionally served different
+        # pixels on its slice — that is what the gate bounded)
+        checked = check_bit_exact(
+            [kv for rec in load_recs[:2] for kv in rec["results"]]
+        )
+        print(
+            f"smoke: load done ({len(load_recs)} windows, shed "
+            f"{total_shed}, unavailable 0, {checked} pre-canary "
+            "responses bit-exact)"
+        )
+
+        # -- 3. idle -> drain-before-kill scale-down 2 -> 1 -----------------
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            poll_states()
+            if len(fab.supervisor.replica_ids()) == 1:
+                break
+            time.sleep(0.05)
+        assert len(fab.supervisor.replica_ids()) == 1, (
+            f"autoscaler never scaled back down: "
+            f"{fab.router.autoscaler.status()}"
+        )
+        down_events = [
+            e for e in fab.router.autoscaler.events
+            if e["direction"] == "down"
+        ]
+        assert down_events and down_events[-1]["reason"] == "drained", (
+            f"scale-down was not drain-before-kill: {down_events}"
+        )
+        victim = down_events[-1]["replica"]
+        assert "draining" in replica_states.get(victim, set()), (
+            f"victim {victim} was never observed draining via its own "
+            f"heartbeats (saw {replica_states.get(victim)})"
+        )
+        assert any(
+            p.startswith("recorder_autoscale") for p in os.listdir(rec_dir)
+        ), f"no autoscale dump in {rec_dir}"
+        print(
+            f"smoke: scaled 2->1 by draining {victim} (queue observed "
+            "empty before SIGTERM)"
+        )
+
+        # -- 4. preemption: SIGUSR1 -> preempt dump + immediate respawn -----
+        survivor = fab.supervisor.replica_ids()[0]
+        pid = fab.supervisor.pids()[survivor]
+        old_inc = fab.router.table.get(survivor).hb.incarnation
+        import signal as _signal
+
+        t_kill = time.monotonic()
+        os.kill(pid, _signal.SIGUSR1)
+        deadline = time.monotonic() + 90.0
+        while time.monotonic() < deadline:
+            view = fab.router.table.get(survivor)
+            if (
+                fab.supervisor.preemptions(survivor) >= 1
+                and view.hb.incarnation != old_inc
+                and view.hb.state == "serving"
+            ):
+                break
+            time.sleep(0.1)
+        assert fab.supervisor.preemptions(survivor) >= 1, (
+            "preemption exit was not recognized"
+        )
+        view = fab.router.table.get(survivor)
+        assert view.hb.incarnation != old_inc and view.hb.state == "serving"
+        print(
+            f"smoke: {survivor} preempted and replaced in "
+            f"{time.monotonic() - t_kill:.1f}s (no backoff)"
+        )
+        dumps = [
+            p for p in os.listdir(rec_dir)
+            if p.startswith("recorder_preempt")
+        ]
+        assert dumps, f"no preempt dump in {rec_dir}"
+        print(f"smoke: preempt dump {dumps[0]}")
+
+        # a replacement must serve bit-exact stable traffic again
+        r = loadgen.http_post_image(fab.url, blobs[0])
+        assert r["code"] == 200
+        np.testing.assert_array_equal(
+            decode_image_bytes(r["body"]), golden[0]
+        )
+
+        with open(metrics_out, "w") as f:
+            f.write(fab.scrape())
+    print(f"smoke: metrics exposition -> {metrics_out}")
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) != 2:
+        print(__doc__)
+        sys.exit(2)
+    sys.exit(main(sys.argv[1]))

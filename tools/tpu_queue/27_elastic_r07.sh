@@ -1,0 +1,29 @@
+#!/bin/bash
+# Elastic-fabric lane (round 7): the PR 12 control loops on real
+# hardware. The fabric_loadgen lane now carries an `elastic` sub-lane —
+# an AUTOSCALED pod (replicas start at 1, ceiling at the lane's N) under
+# the same saturating offered mix: scale-up latency, a SIGUSR1
+# preemption absorbed mid-load (graceful drain + preempt dump +
+# immediate no-backoff replacement), and the idle scale-down which must
+# be recorded as "drained" (the victim's queue observed empty before
+# SIGTERM). Headline columns gain shed% — on TPU the interesting number
+# is how much offered load the pod sheds (503 + Retry-After) before the
+# new replica's warmup finishes, i.e. the real cost of a scale-up on
+# hardware where a compile-cache warm takes seconds. The elastic smoke
+# runs after it for the canary-rollback and drain-observability asserts
+# against a real pod. On TPU the per-dispatch device floor is OFF — the
+# lane measures real chips contending for real HBM.
+# Budget: ~6-10 min.
+set -u
+cd "$(dirname "$0")/../.."
+. tools/tpu_queue/_lib.sh
+out=artifacts/elastic_r07.out
+: > "$out"
+timeout 1800 python -m mpi_cuda_imagemanipulation_tpu.bench_suite \
+  --config fabric_loadgen \
+  --json-metrics artifacts/fabric_elastic_suite_r07.json >> "$out" 2>&1
+timeout 900 python tools/elastic_smoke.py \
+  artifacts/elastic_metrics_r07.prom >> "$out" 2>&1
+commit_artifacts "TPU window: elastic fabric — autoscale/preempt/canary (round 7)" \
+  "$out" artifacts/fabric_elastic_suite_r07.json artifacts/elastic_metrics_r07.prom
+exit 0
