@@ -86,10 +86,29 @@ rule(
     "the closed KNOWN_TRIGGERS vocabulary is only machine-checkable when "
     "every production dump site names its trigger as a string literal.",
 )
+rule(
+    "graph-taxonomy-unknown", "obs",
+    "A SpecError() construction names a rejection code missing from "
+    "graph/spec.py's TAXONOMY — the pipeline service's closed error "
+    "vocabulary (every spec-validation rejection path must map to a "
+    "registered code; an unknown code would KeyError on the rejection "
+    "path itself).",
+)
+rule(
+    "graph-taxonomy-dynamic", "obs",
+    "SpecError() constructed with a non-literal code in package code — "
+    "the closed taxonomy is only machine-checkable when every rejection "
+    "site names its code as a string literal.",
+)
+rule(
+    "graph-taxonomy-unused", "obs",
+    "A TAXONOMY entry has no SpecError() constructor anywhere — a "
+    "rejection code no path can produce (clients cannot rely on it).",
+)
 
 _METRIC_RE = re.compile(
     r"^mcim_(serve|engine|cache|breaker|health|batch|analysis|fabric|stream"
-    r"|plan|fleet|slo)_[a-z0-9_]+$"
+    r"|plan|fleet|slo|graph)_[a-z0-9_]+$"
 )
 
 
@@ -110,6 +129,7 @@ def check_obs(repo: Repo):
     findings.extend(_check_failpoints(repo))
     findings.extend(_check_exemplars(repo))
     findings.extend(_check_recorder_triggers(repo))
+    findings.extend(_check_graph_taxonomy(repo))
     return findings
 
 
@@ -299,7 +319,7 @@ def _check_metrics(repo: Repo) -> list:
                     f"metric {name!r} violates the "
                     "mcim_<subsystem>_<what> scheme "
                     "(subsystems: serve/engine/cache/breaker/health/"
-                    "batch/analysis/fabric/stream/plan)"
+                    "batch/analysis/fabric/stream/plan/fleet/slo/graph)"
                 )
             elif kind == "counter" and not name.endswith("_total"):
                 msg = f"counter {name!r} must end in _total"
@@ -482,6 +502,105 @@ def _check_recorder_triggers(repo: Repo) -> list:
                 f"{PACKAGE}/obs/recorder.py", reg_line,
                 f"KNOWN_TRIGGERS entry {trigger!r} has no recorder.dump() "
                 "caller anywhere in the repo",
+            )
+        )
+    return findings
+
+
+# -- pipeline-service error taxonomy (graph/spec.py) --------------------------
+
+
+def _taxonomy_codes(repo: Repo) -> tuple[set[str], int, set[int]]:
+    """The closed rejection-code vocabulary: the keys of graph/spec.py's
+    TAXONOMY dict literal (the graph analogue of KNOWN_SITES). The third
+    element is the id() set of the registry's own AST nodes, so the
+    usage scan can exclude the declaration from counting as a use."""
+    sf = repo.by_rel.get(f"{PACKAGE}/graph/spec.py")
+    if sf is None:
+        return set(), 0, set()
+    for node in sf.tree.body:
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == "TAXONOMY":
+                    if isinstance(node.value, ast.Dict):
+                        keys = {
+                            k.value
+                            for k in node.value.keys
+                            if isinstance(k, ast.Constant)
+                            and isinstance(k.value, str)
+                        }
+                        own = {id(n) for n in ast.walk(node)}
+                        return keys, node.lineno, own
+    return set(), 0, set()
+
+
+def _check_graph_taxonomy(repo: Repo) -> list:
+    """Every spec-validation rejection path must map to a registered
+    taxonomy code: a `SpecError("<code>", ...)` construction anywhere
+    must name a TAXONOMY key (unknown = blocking — the rejection path
+    itself would KeyError), package-code constructions must use literal
+    codes (a computed code dodges the closed vocabulary), and every
+    registered code must be reachable by some literal use."""
+    findings = []
+    codes, reg_line, own_nodes = _taxonomy_codes(repo)
+    if not codes:
+        return findings
+    used: set[str] = set()
+    for sf in repo.files:
+        in_package = sf.rel.startswith(PACKAGE + "/")
+        for node in ast.walk(sf.tree):
+            # any literal occurrence of a code counts toward 'used' —
+            # rejection codes also appear in structured-response dicts
+            # (e.g. the HTTP 404 shapes), which are production paths too.
+            # The TAXONOMY declaration itself is excluded: registering a
+            # code is not producing it.
+            if (
+                in_package
+                and isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and node.value in codes
+                and id(node) not in own_nodes
+            ):
+                used.add(node.value)
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            fn = node.func
+            fname = (
+                fn.attr if isinstance(fn, ast.Attribute)
+                else fn.id if isinstance(fn, ast.Name) else None
+            )
+            if fname != "SpecError":
+                continue
+            a0 = node.args[0]
+            if isinstance(a0, ast.Constant) and isinstance(a0.value, str):
+                # package rejection paths only: tests deliberately
+                # construct unregistered codes to exercise the runtime
+                # KeyError guard (the dynamic rule scopes the same way)
+                if a0.value not in codes and in_package:
+                    findings.append(
+                        make_finding(
+                            "graph-taxonomy-unknown", sf.rel, node.lineno,
+                            f"rejection code {a0.value!r} is not in "
+                            "TAXONOMY (graph/spec.py)",
+                        )
+                    )
+            elif in_package and sf.rel != f"{PACKAGE}/graph/spec.py":
+                # spec.py itself holds the (guarded) class definition;
+                # everywhere else a computed code dodges the vocabulary
+                findings.append(
+                    make_finding(
+                        "graph-taxonomy-dynamic", sf.rel, node.lineno,
+                        "SpecError code is not a string literal — name "
+                        "one of graph/spec.TAXONOMY directly",
+                    )
+                )
+    for code in sorted(codes - used):
+        findings.append(
+            make_finding(
+                "graph-taxonomy-unused",
+                f"{PACKAGE}/graph/spec.py", reg_line,
+                f"TAXONOMY entry {code!r} is produced by no rejection "
+                "path anywhere in the package",
             )
         )
     return findings

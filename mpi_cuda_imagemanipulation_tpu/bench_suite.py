@@ -326,6 +326,7 @@ MXU_AB = "mxu_ab"
 FABRIC_LOADGEN = "fabric_loadgen"
 STREAM_AB = "stream_ab"
 PLAN_AB = "plan_ab"
+GRAPH_LOADGEN = "graph_loadgen"
 
 
 def fabric_loadgen_params() -> dict:
@@ -1738,6 +1739,202 @@ def run_serve_loadgen(
     return rec
 
 
+def graph_loadgen_params() -> dict:
+    """The pipeline-service lane knobs, sized to the backend. The tenant
+    count cycles the QoS classes (interactive/standard/batch), so the
+    per-tenant columns show the admission ladder under one offered mix.
+    Overrides: MCIM_GRAPH_TENANTS / the --tenants flag."""
+    on_tpu = is_tpu_backend()
+    params = {
+        # a pointwise-heavy linear chain: the SAME workload runs as the
+        # baked-in chain path and as a registered degenerate-DAG spec
+        "ops": "grayscale,contrast:3.5,gaussian:5",
+        "buckets": ((512, 512), (1024, 1024)) if on_tpu
+        else ((64, 64), (96, 96)),
+        "max_batch": 8 if on_tpu else 4,
+        "max_delay_ms": 4.0,
+        "queue_depth": 64,
+        "offered_rps": 512.0 if on_tpu else 120.0,
+        "duration_s": 3.0 if on_tpu else 1.5,
+        "tenants": 3,
+        "n_images": 8,
+    }
+    raw = env_registry.get("MCIM_GRAPH_TENANTS")
+    if raw:
+        params["tenants"] = int(raw)
+    return params
+
+
+def run_graph_loadgen(
+    *,
+    json_path: str | None = None,
+    printer: Callable[[str], None] = print,
+    tenants: int | None = None,
+) -> dict:
+    """The pipeline-service bench lane (graph/): ONE serving stack over
+    real HTTP, the SAME linear chain driven down both doors —
+
+      * ``chain`` — the baked-in `--ops` path (`POST /v1/process`);
+      * ``dag``   — the identical chain registered as a degenerate-DAG
+                    spec (`POST /v1/pipelines`) and served by pipeline id
+                    (the graph lane: per-tenant admission + per-request
+                    jitted graph executor, no micro-batching);
+
+    gated BIT-IDENTICAL response bytes pre-timing (the acceptance
+    contract: a linear DAG is indistinguishable from the chain), then
+    measured under the same offered load — the dag column prices what
+    "pipelines as data" costs over the baked-in path. A multi-tenant mix
+    (``--tenants N``, QoS classes cycling interactive/standard/batch)
+    rides the same stack and reports per-tenant ok% / shed% / p99 — the
+    admission-ladder columns. Client and server share this process (and
+    its GIL): both lanes pay identically, so the comparison is
+    structure-vs-structure, not a throughput claim (the fabric lane's
+    process split covers that)."""
+    import json as _json
+    import urllib.request
+
+    from mpi_cuda_imagemanipulation_tpu.graph.spec import chain_as_spec
+    from mpi_cuda_imagemanipulation_tpu.serve import loadgen
+    from mpi_cuda_imagemanipulation_tpu.serve.server import (
+        Server,
+        ServeConfig,
+    )
+
+    p = graph_loadgen_params()
+    if tenants is not None:
+        p["tenants"] = tenants
+    qos_cycle = ("interactive", "standard", "batch")
+    with Server(
+        ServeConfig(
+            ops=p["ops"],
+            buckets=p["buckets"],
+            max_batch=p["max_batch"],
+            max_delay_ms=p["max_delay_ms"],
+            queue_depth=p["queue_depth"],
+            channels=(3,),
+        ),
+        port=0,
+    ) as srv:
+        url = f"http://127.0.0.1:{srv.address[1]}"
+
+        def post_json(path: str, payload: dict) -> dict:
+            req = urllib.request.Request(
+                url + path, data=_json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=30.0) as resp:
+                return _json.loads(resp.read())
+
+        tenant_ids = [f"t{i}" for i in range(max(1, p["tenants"]))]
+        for i, tid in enumerate(tenant_ids):
+            post_json(
+                "/v1/tenants",
+                {"tenant": tid, "qos": qos_cycle[i % len(qos_cycle)]},
+            )
+            reg = post_json(
+                "/v1/pipelines",
+                {"tenant": tid, "spec": chain_as_spec(p["ops"])},
+            )
+        pid = reg["pipeline"]
+
+        min_dim = max(op.halo for op in Pipeline.parse(p["ops"]).ops) + 1
+        images = loadgen.mixed_shapes(
+            p["buckets"], p["n_images"], channels=3, seed=11,
+            min_dim=min_dim,
+        )
+        blobs = [bytes(loadgen.encode_blob(im)) for im in images]
+
+        # -- bit-exactness gate BEFORE any timing --------------------------
+        hdrs = {
+            "X-MCIM-Tenant": tenant_ids[0], "X-MCIM-Pipeline": pid,
+        }
+        for k in range(min(3, len(blobs))):
+            chain_r = loadgen.http_post_image(url, blobs[k])
+            dag_r = loadgen.http_post_image(url, blobs[k], headers=hdrs)
+            if chain_r["code"] != 200 or dag_r["code"] != 200:
+                raise AssertionError(
+                    f"graph_loadgen gate: image {k} answered "
+                    f"{chain_r['code']}/{dag_r['code']}"
+                )
+            if chain_r["body"] != dag_r["body"]:
+                raise AssertionError(
+                    f"graph_loadgen gate: DAG response for image {k} is "
+                    "not byte-identical to the chain path"
+                )
+
+        # -- the two lanes under the same offered load ---------------------
+        chain_rec = loadgen.http_run_offered_load(
+            url, blobs, p["offered_rps"], p["duration_s"]
+        )
+        chain_rec.pop("results", None)
+        dag_rec = loadgen.multi_tenant_run(
+            url,
+            [{"tenant": tenant_ids[0], "blobs": blobs, "headers": hdrs}],
+            p["offered_rps"],
+            p["duration_s"],
+        )[tenant_ids[0]]
+
+        # -- the multi-tenant QoS mix --------------------------------------
+        lanes = [
+            {
+                "tenant": tid,
+                "blobs": blobs,
+                "headers": {"X-MCIM-Tenant": tid, "X-MCIM-Pipeline": pid},
+            }
+            for tid in tenant_ids
+        ]
+        mix = loadgen.multi_tenant_run(
+            url, lanes, p["offered_rps"], p["duration_s"]
+        )
+        graph_stats = srv.app.graph_service.stats()
+    rec = {
+        "config": GRAPH_LOADGEN,
+        "pipeline": p["ops"],
+        "impl": "graph_loadgen",
+        "platform": jax.default_backend(),
+        "buckets": [f"{h}x{w}" for h, w in p["buckets"]],
+        "offered_rps": p["offered_rps"],
+        "duration_s": p["duration_s"],
+        "pipeline_id": pid,
+        "bit_exact_gate": "passed (3 images, DAG bytes == chain bytes)",
+        "lanes": {"chain": chain_rec, "dag": dag_rec},
+        "tenants": {
+            tid: {
+                "qos": qos_cycle[i % len(qos_cycle)],
+                **mix[tid],
+            }
+            for i, tid in enumerate(tenant_ids)
+        },
+        "cache_entries": sum(
+            t["cache_entries"]
+            for t in graph_stats["tenants"].values()
+        ),
+    }
+    printer(
+        f"{'lane':14s} {'ok%':>6s} {'shed%':>6s} {'achieved':>9s} "
+        f"{'p50 ms':>8s} {'p99 ms':>8s}"
+    )
+
+    def _row(name: str, r: dict) -> str:
+        return (
+            f"{name:14s} {r['ok_frac'] * 100:5.1f}% "
+            f"{r['shed_frac'] * 100:5.1f}% {r['achieved_rps']:9.1f} "
+            f"{r.get('e2e_p50_ms', float('nan')):8.2f} "
+            f"{r.get('e2e_p99_ms', float('nan')):8.2f}"
+        )
+
+    printer(_row("chain", chain_rec))
+    printer(_row("dag", dag_rec))
+    for i, tid in enumerate(tenant_ids):
+        printer(
+            _row(f"{tid}/{qos_cycle[i % len(qos_cycle)][:5]}", mix[tid])
+        )
+    if json_path:
+        emit_json_metrics(rec, None if json_path == "-" else json_path)
+    return rec
+
+
 def run_suite(
     names: Sequence[str] | None = None,
     *,
@@ -1796,12 +1993,22 @@ def run_suite(
         records.append(run_plan_ab(json_path=json_path, printer=printer))
         if not names:
             return records
+    if names and GRAPH_LOADGEN in names:
+        # the pipeline-service lane measures the graph door vs the chain
+        # door of one serving stack (plus the multi-tenant mix), not one
+        # executable
+        names = [n for n in names if n != GRAPH_LOADGEN]
+        records.append(
+            run_graph_loadgen(json_path=json_path, printer=printer)
+        )
+        if not names:
+            return records
     if names:
         unknown = [n for n in names if n not in CONFIGS]
         if unknown:
             raise ValueError(
                 f"unknown bench config(s) {unknown}; known: "
-                f"{sorted(CONFIGS) + [ENGINE_AB, FABRIC_LOADGEN, MXU_AB, PLAN_AB, SERVE_LOADGEN, STREAM_AB]}"
+                f"{sorted(CONFIGS) + [ENGINE_AB, FABRIC_LOADGEN, GRAPH_LOADGEN, MXU_AB, PLAN_AB, SERVE_LOADGEN, STREAM_AB]}"
             )
         selected = [CONFIGS[n] for n in names]
     else:
@@ -1899,8 +2106,8 @@ def main(argv: Sequence[str] | None = None) -> int:
         "--config",
         required=True,
         choices=sorted(CONFIGS)
-        + [ENGINE_AB, FABRIC_LOADGEN, MXU_AB, PLAN_AB, SERVE_LOADGEN,
-           STREAM_AB],
+        + [ENGINE_AB, FABRIC_LOADGEN, GRAPH_LOADGEN, MXU_AB, PLAN_AB,
+           SERVE_LOADGEN, STREAM_AB],
     )
     ap.add_argument(
         "--impl",
@@ -1950,6 +2157,14 @@ def main(argv: Sequence[str] | None = None) -> int:
         help="stream_ab only: streamed-lane tile height "
         "(env MCIM_STREAM_AB_TILE_ROWS works too)",
     )
+    ap.add_argument(
+        "--tenants",
+        type=int,
+        default=None,
+        help="graph_loadgen only: multi-tenant mix size, QoS classes "
+        "cycling interactive/standard/batch "
+        "(env MCIM_GRAPH_TENANTS works too)",
+    )
     args = ap.parse_args(argv)
     if args.config == SERVE_LOADGEN:
         rec = run_serve_loadgen(
@@ -1969,6 +2184,10 @@ def main(argv: Sequence[str] | None = None) -> int:
         )
     elif args.config == PLAN_AB:
         rec = run_plan_ab(printer=lambda s: None)
+    elif args.config == GRAPH_LOADGEN:
+        rec = run_graph_loadgen(
+            printer=lambda s: None, tenants=args.tenants
+        )
     else:
         cfg = CONFIGS[args.config]
         if args.halo_mode is not None and cfg.sharded:

@@ -669,6 +669,66 @@ def _build_parser() -> argparse.ArgumentParser:
     _add_failpoint_flags(stm)
     _add_trace_flags(stm)
 
+    gph = sub.add_parser(
+        "graph",
+        help="validate/run a pipeline-spec DAG (graph/): branch taps, "
+        "merge combinators, side outputs — the file form of what "
+        "POST /v1/pipelines registers",
+    )
+    gph.add_argument(
+        "--spec",
+        required=True,
+        metavar="PATH",
+        help="pipeline spec JSON (graph/spec.py schema; refusals print "
+        "their closed-taxonomy code and exit 2)",
+    )
+    gph.add_argument(
+        "--input", default=None, help="image to run the graph on"
+    )
+    gph.add_argument(
+        "--synthetic",
+        default=None,
+        metavar="HxW[xC]",
+        help="run on a deterministic synthetic image of this shape "
+        "instead of --input",
+    )
+    gph.add_argument(
+        "--output", default=None, help="write the image output here"
+    )
+    gph.add_argument(
+        "--histogram-out",
+        default=None,
+        metavar="PATH",
+        help="write the histogram side output (JSON int[256]); needs a "
+        "spec with outputs.histogram",
+    )
+    gph.add_argument(
+        "--stats-out",
+        default=None,
+        metavar="PATH",
+        help="write the stats side output (JSON count/min/max/mean); "
+        "needs a spec with outputs.stats",
+    )
+    gph.add_argument(
+        "--impl",
+        choices=("xla", "mxu", "auto"),
+        default="xla",
+        help="stencil accumulation backend for the graph's fused "
+        "segments (the plan-executor impls)",
+    )
+    gph.add_argument(
+        "--validate-only",
+        action="store_true",
+        help="parse + compile-plan the spec and print its structure "
+        "without running anything (no device touch)",
+    )
+    gph.add_argument("--device", default=None)
+    gph.add_argument(
+        "--json-metrics", default=None, help="write the run record "
+        "('-' = stdout)"
+    )
+    _add_plan_flag(gph)
+
     bench = sub.add_parser("bench", help="run the benchmark suite")
     bench.add_argument("--configs", default=None, help="subset, comma-separated")
     bench.add_argument("--device", default=None)
@@ -1900,6 +1960,116 @@ def cmd_fabric(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_graph(args: argparse.Namespace) -> int:
+    """Validate (and optionally run) a pipeline-spec DAG from a file —
+    the offline form of the pipeline service's POST surface."""
+    from mpi_cuda_imagemanipulation_tpu.graph.spec import SpecError
+
+    try:
+        with open(args.spec, "rb") as f:
+            raw = f.read()
+    except OSError as e:
+        raise FileNotFoundError(f"cannot read --spec: {e}") from None
+    try:
+        from mpi_cuda_imagemanipulation_tpu.graph import (
+            compile_graph,
+            dag_fingerprint,
+            graph_callable,
+            parse_spec,
+        )
+
+        graph = parse_spec(raw)
+    except SpecError as e:
+        print(f"spec rejected [{e.code}]: {e}", file=sys.stderr)
+        return 2
+    program = compile_graph(graph, plan=args.plan, backend=args.impl)
+    print(graph.describe())
+    print(program.describe())
+    print(f"pipeline id: {dag_fingerprint(graph)}")
+    if args.validate_only:
+        return 0
+    if bool(args.input) == bool(args.synthetic):
+        raise ValueError("graph needs exactly one of --input/--synthetic")
+    _configure_platform(args.device)
+    import json as _json
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    from mpi_cuda_imagemanipulation_tpu.io.image import (
+        load_image,
+        save_image,
+        synthetic_image,
+    )
+
+    if args.synthetic:
+        dims = [int(v) for v in args.synthetic.lower().split("x")]
+        if len(dims) not in (2, 3):
+            raise ValueError("--synthetic wants HxW or HxWxC")
+        img = synthetic_image(
+            dims[0], dims[1], channels=dims[2] if len(dims) == 3 else 3,
+            seed=0,
+        )
+    else:
+        img = load_image(args.input)
+    try:
+        graph.check_channels(img.shape[2] if img.ndim == 3 else 1)
+    except SpecError as e:
+        print(f"request rejected [{e.code}]: {e}", file=sys.stderr)
+        return 2
+    fn = jax.jit(graph_callable(program, impl=args.impl))
+    t0 = _time.perf_counter()
+    out = jax.tree_util.tree_map(np.asarray, fn(img))
+    wall = _time.perf_counter() - t0
+    print(
+        f"ran {len(program.steps)} steps in {wall * 1e3:.1f} ms "
+        f"(outputs: {sorted(out)})"
+    )
+    if args.output:
+        save_image(args.output, out["image"])
+        print(f"image -> {args.output}")
+    if args.histogram_out:
+        if "histogram" not in out:
+            raise ValueError(
+                "--histogram-out needs a spec with outputs.histogram"
+            )
+        with open(args.histogram_out, "w") as f:
+            _json.dump([int(v) for v in out["histogram"]], f)
+        print(f"histogram -> {args.histogram_out}")
+    if args.stats_out:
+        if "stats" not in out:
+            raise ValueError("--stats-out needs a spec with outputs.stats")
+        stats = {
+            "count": int(out["stats"]["count"]),
+            "min": int(out["stats"]["min"]),
+            "max": int(out["stats"]["max"]),
+            "mean": round(float(out["stats"]["mean"]), 4),
+        }
+        with open(args.stats_out, "w") as f:
+            _json.dump(stats, f)
+        print(f"stats -> {args.stats_out}")
+    if args.json_metrics:
+        rec = {
+            "event": "graph",
+            "spec": args.spec,
+            "pipeline_id": dag_fingerprint(graph),
+            "nodes": len(graph.nodes),
+            "segments": program.n_segments,
+            "merges": program.n_merges,
+            "mode": program.mode,
+            "wall_ms": wall * 1e3,
+            "outputs": sorted(out),
+        }
+        payload = _json.dumps(rec, indent=2)
+        if args.json_metrics == "-":
+            print(payload)
+        else:
+            with open(args.json_metrics, "w") as f:
+                f.write(payload)
+    return 0
+
+
 def cmd_bench(args: argparse.Namespace) -> int:
     _configure_platform(args.device)
     from mpi_cuda_imagemanipulation_tpu.bench_suite import run_suite
@@ -2422,6 +2592,7 @@ def main(argv: list[str] | None = None) -> int:
         "stream": cmd_stream,
         "serve": cmd_serve,
         "fabric": cmd_fabric,
+        "graph": cmd_graph,
         "bench": cmd_bench,
         "diff": cmd_diff,
         "autotune": cmd_autotune,

@@ -102,6 +102,12 @@ class Heartbeat:
     # metrics-federation delta (obs/fleet.py DeltaSource payload), or
     # None for a metrics-less beat
     metrics: dict | None = None
+    # pipeline-service state (graph/service.py): the pipeline ids this
+    # replica has registered. The router re-pushes a stored spec before
+    # forwarding a graph request to a replica whose beat lacks its id —
+    # so a RESTARTED replica (empty registry, same warm discipline as
+    # the compile cache) reconverges within one forward, not never.
+    pipelines: list[str] | None = None
 
     def to_json(self) -> bytes:
         return json.dumps(dataclasses.asdict(self)).encode()

@@ -117,6 +117,7 @@ class ReplicaRuntime:
             seq=seq,
             sent_unix_s=time.time(),
             metrics=self.delta_source.delta(),
+            pipelines=app.graph_pipeline_ids(),
         )
 
     def _on_heartbeat_ack(self, hb: Heartbeat, ack: dict) -> None:

@@ -214,7 +214,15 @@ class Router:
     """The front door. `start()` binds the HTTP listener; replicas
     register themselves by heartbeating `POST /control/heartbeat`.
 
-        POST /v1/process        proxied to a replica (see module doc)
+        POST /v1/process        proxied to a replica (see module doc).
+                                With X-MCIM-Pipeline/?pipeline=: the
+                                graph lane — sticky on (tenant,
+                                pipeline, bucket), headers forwarded,
+                                stored specs re-pushed to replicas
+                                whose heartbeat lacks the id
+        POST /v1/pipelines      validate + store + broadcast a pipeline
+                                spec to every routable replica (graph/)
+        POST /v1/tenants        tenant QoS/quota config, same broadcast
         POST /v1/session/<sid>/frame
                                 live video frame: sticky session routing
                                 with journal-tail failover replay
@@ -279,6 +287,19 @@ class Router:
         # live video sessions (fabric/session.py): sticky affinity +
         # journal-tail failover
         self.sessions = fabric_session.SessionTable()
+        # pipeline-service state (graph/): specs registered THROUGH this
+        # front door, keyed (tenant, pipeline id), plus tenant configs.
+        # The router re-pushes a stored spec to any replica whose
+        # heartbeat lacks the id before forwarding to it — so replica
+        # restarts and late joiners reconverge without client retries.
+        self._graph_lock = threading.Lock()
+        self.graph_specs: dict[tuple[str, str], dict] = {}
+        self.graph_tenants: dict[str, dict] = {}
+        # (replica id, incarnation) -> tenants whose config this exact
+        # process has received: tenant configs have no heartbeat echo
+        # (unlike pipelines), so the re-push bookkeeping lives here — a
+        # restart changes the incarnation and naturally re-pushes
+        self._tenant_pushed: dict[tuple[str, str], set[str]] = {}
         # set by the Fabric when the elastic loop is armed (status only)
         self.autoscaler = None
         self.mesh_lane = mesh_lane
@@ -347,6 +368,17 @@ class Router:
         self._m_forward_s = r.histogram(
             "mcim_fabric_forward_seconds",
             "Router->replica proxy time per successful attempt.",
+        )
+        # -- pipeline service (graph/) --------------------------------------
+        self._m_graph_pushes = r.counter(
+            "mcim_fabric_graph_pushes_total",
+            "Pipeline specs re-pushed to a replica whose heartbeat "
+            "lacked the id (restart/late-join reconvergence).",
+        )
+        r.gauge(
+            "mcim_fabric_graph_specs",
+            "(tenant, pipeline) specs registered through this router.",
+            fn=lambda: float(len(self.graph_specs)),
         )
         # -- canary rollback gate (fabric/canary.py) ------------------------
         self._m_canary = r.counter(
@@ -504,17 +536,32 @@ class Router:
             and v.replica_id not in draining
         ]
 
-    def route(self, bucket: str) -> tuple[list[ReplicaView], str]:
+    def route(
+        self, bucket: str, *, affinity_key: str | None = None,
+        prefer_warm: bool = True,
+    ) -> tuple[list[ReplicaView], str]:
         """Ordered forward candidates for a "HxW" bucket + the policy
-        label. Pure over the current table snapshot (unit-testable)."""
+        label. Pure over the current table snapshot (unit-testable).
+
+        `affinity_key` overrides the rendezvous-hash key: graph requests
+        sticky on (tenant, pipeline id, bucket) so one tenant-pipeline's
+        jitted executables concentrate on one replica per bucket
+        (`prefer_warm=False` there — chain-cache warmth says nothing
+        about graph executables)."""
         live = self._routable()
         if not live:
             return [], "none"
-        warm = [v for v in live if bucket in v.hb.warm_buckets]
+        warm = (
+            [v for v in live if bucket in v.hb.warm_buckets]
+            if prefer_warm
+            else []
+        )
         pool = warm or live
         sticky = max(
             pool,
-            key=lambda v: _rendezvous_score(bucket, v.replica_id),
+            key=lambda v: _rendezvous_score(
+                affinity_key or bucket, v.replica_id
+            ),
         )
         sticky_ok = (
             sticky.hb.state == "serving"
@@ -555,15 +602,37 @@ class Router:
         return h, w
 
     def handle_process(
-        self, body: bytes, headers
+        self, body: bytes, headers, query: dict | None = None
     ) -> tuple[int, str, bytes, list[tuple[str, str]]]:
         """One front-door request -> (status, content_type, body, extra
-        headers). Runs on the HTTP handler thread."""
+        headers). Runs on the HTTP handler thread. A request carrying a
+        pipeline id (X-MCIM-Pipeline header or ?pipeline=) takes the
+        graph lane: sticky affinity on (tenant, pipeline, bucket), the
+        tenant + pipeline headers forwarded verbatim, and a stored-spec
+        re-push to any replica whose heartbeat lacks the id."""
+        from mpi_cuda_imagemanipulation_tpu.graph.service import (
+            HDR_PIPELINE,
+            HDR_TENANT,
+        )
+
+        q = query or {}
+
+        def _pick(hname: str, qname: str) -> str:
+            v = headers.get(hname)
+            if v:
+                return v
+            vals = q.get(qname)
+            return vals[0] if vals else ""
+
+        tenant = _pick(HDR_TENANT, "tenant") or "default"
+        pipeline = _pick(HDR_PIPELINE, "pipeline")
         try:
             h, w = self._sniff_dims(body)
         except Exception as e:
             self._m_requests.inc(status="rejected")
             return _json_response(400, {"error": f"undecodable image: {e}"})
+        if pipeline:
+            return self._handle_graph_process(body, tenant, pipeline, h, w)
         picked = bucketing.pick_bucket(h, w, self.buckets)
         if picked is None:
             if self.mesh_lane is not None:
@@ -615,7 +684,15 @@ class Router:
         return code, ctype, out, extra
 
     def _forward_with_retries(
-        self, root, bucket: str, body: bytes, candidates: list[ReplicaView]
+        self,
+        root,
+        bucket: str,
+        body: bytes,
+        candidates: list[ReplicaView],
+        *,
+        extra_headers: tuple[tuple[str, str], ...] = (),
+        before_forward=None,
+        admission_shed_is_final: bool = False,
     ) -> tuple[int, str, bytes, list[tuple[str, str]]]:
         attempts = 0
         last: tuple[int, str, bytes, list] | None = None
@@ -641,8 +718,14 @@ class Router:
                     failpoints.maybe_fail(
                         "router.forward", replica=rid, attempt=attempts
                     )
-                    code, ctype, out = self._forward_once(
-                        view, body, root.trace_id
+                    if before_forward is not None:
+                        # graph lane: converge the replica's pipeline
+                        # registry first (spec re-push); a push failure
+                        # is a net-error-class miss — next candidate
+                        before_forward(view)
+                    code, ctype, out, fwd_hdrs = self._forward_once(
+                        view, body, root.trace_id,
+                        extra_headers=extra_headers,
                     )
             except Exception as e:
                 # connection-class failure: the replica is gone or wedged —
@@ -666,6 +749,22 @@ class Router:
                 and self.canary.state == fabric_canary.CANARY
                 and rid == self.canary.replica_id
             )
+            if (
+                admission_shed_is_final
+                and code == 503
+                and _is_admission_shed(out)
+            ):
+                # a tenant-level admission verdict (quota window / QoS
+                # ladder — the graph lane's {"status": "shed"} body):
+                # rerouting it to a sibling would multiply the tenant's
+                # budget by the replica count, so it relays as FINAL.
+                # Drain/stopped 503s keep rerouting — those are about
+                # the replica, not the tenant.
+                self._m_forwards.inc(replica=rid, outcome="ok")
+                return (
+                    code, ctype, out,
+                    [("X-Fabric-Replica", rid)] + fwd_hdrs,
+                )
             if code in (429, 503) or code >= 500 or canary_quarantine:
                 # the replica answered but couldn't take it: 429 means
                 # alive-but-full and 503 not-admitting (a draining
@@ -680,15 +779,20 @@ class Router:
                     self._canary_record(rid, False)
                 self._m_forwards.inc(replica=rid, outcome="http_error")
                 # a relayed shed keeps its retry-later semantics: the
-                # replica's 429/503 carried Retry-After, and stripping
-                # it would turn an explicit shed into apparent downtime
-                # in every client's accounting
+                # replica's 429/503 carried Retry-After (passed through
+                # with its REAL value — a quota window's remainder, not
+                # a router guess), and stripping it would turn an
+                # explicit shed into apparent downtime in every
+                # client's accounting
                 shed_hdr = (
-                    [("Retry-After", "1")] if code in (429, 503) else []
+                    [("Retry-After", "1")]
+                    if code in (429, 503)
+                    and not any(k == "Retry-After" for k, _ in fwd_hdrs)
+                    else []
                 )
                 last = (
                     code, ctype, out,
-                    [("X-Fabric-Replica", rid)] + shed_hdr,
+                    [("X-Fabric-Replica", rid)] + fwd_hdrs + shed_hdr,
                 )
                 continue
             breaker.on_success()
@@ -705,7 +809,8 @@ class Router:
                 [
                     ("X-Fabric-Replica", rid),
                     ("X-Fabric-Attempts", str(attempts)),
-                ],
+                ]
+                + fwd_hdrs,
             )
         if last is not None:
             # every candidate was tried; surface the most recent replica
@@ -728,16 +833,27 @@ class Router:
             )
 
     def _forward_once(
-        self, view: ReplicaView, body: bytes, trace_id: str
+        self,
+        view: ReplicaView,
+        body: bytes,
+        trace_id: str,
+        *,
+        extra_headers: tuple[tuple[str, str], ...] = (),
     ) -> tuple[int, str, bytes]:
         """One proxy attempt: POST the body to the replica, read fully.
         Connections are pooled (HTTP/1.1 keep-alive); an error closes the
-        socket instead of returning it."""
+        socket instead of returning it. `extra_headers` rides the graph
+        lane's tenant + pipeline identity to the replica verbatim.
+        Returns (status, content type, body, pass-through headers) — the
+        replica's Retry-After (the REAL quota-window remainder, not a
+        router guess) and the graph side-output headers survive the hop."""
         addr = view.hb.addr or "127.0.0.1"
         port = view.hb.port
         conn = self._pool.take(addr, port)
         try:
             hdrs = {"Content-Type": "application/octet-stream"}
+            for k, v in extra_headers:
+                hdrs[k] = v
             if trace_id:
                 # the distributed-trace hop: the replica adopts this id as
                 # its serve.request root, so both processes' exports join
@@ -746,11 +862,18 @@ class Router:
             resp = conn.getresponse()
             out = resp.read()
             ctype = resp.getheader("Content-Type", "application/json")
+            passthrough = [
+                (name, val)
+                for name in (
+                    "Retry-After", "X-MCIM-Histogram", "X-MCIM-Stats",
+                )
+                if (val := resp.getheader(name))
+            ]
         except BaseException:
             conn.close()
             raise
         self._pool.give(addr, port, conn)
-        return resp.status, ctype, out
+        return resp.status, ctype, out, passthrough
 
     def _dispatch_mesh(
         self, body: bytes, h: int, w: int
@@ -785,6 +908,245 @@ class Router:
         if root.trace_id:
             extra.append(("X-Trace-Id", root.trace_id))
         return 200, "image/png", png, extra
+
+    # -- pipeline service lane (graph/) ------------------------------------
+
+    def _handle_graph_process(
+        self, body: bytes, tenant: str, pipeline: str, h: int, w: int
+    ) -> tuple[int, str, bytes, list[tuple[str, str]]]:
+        """The graph lane: sticky affinity keyed on (tenant, pipeline,
+        bucket), tenant + pipeline headers forwarded verbatim, stored
+        specs re-pushed to replicas whose heartbeat lacks the id. The
+        canary gate does not slice this lane — a pipeline flip is its
+        own deploy unit (the spec re-registers), not a replica config."""
+        from mpi_cuda_imagemanipulation_tpu.graph.service import (
+            HDR_PIPELINE,
+            HDR_TENANT,
+        )
+
+        picked = bucketing.pick_bucket(h, w, self.buckets)
+        if picked is None:
+            self._m_requests.inc(status="rejected")
+            big = self.buckets[-1]
+            return _json_response(
+                400,
+                {
+                    "code": "bad-image",
+                    "error": (
+                        f"image {h}x{w} exceeds the largest bucket "
+                        f"{big[0]}x{big[1]} (the mesh lane serves chains "
+                        "only)"
+                    ),
+                },
+            )
+        bucket = f"{picked[0]}x{picked[1]}"
+        candidates, policy = self.route(
+            bucket,
+            affinity_key=f"{tenant}|{pipeline}|{bucket}",
+            prefer_warm=False,
+        )
+        if not candidates:
+            self._m_requests.inc(status="unavailable")
+            return _json_response(
+                503,
+                {"error": "no replica is serving", "status": "unavailable"},
+                extra=[("Retry-After", "1")],
+            )
+        self._m_route.inc(policy=policy)
+        root = obs_trace.start_trace(
+            "fabric.request", h=h, w=w, bucket=bucket, policy=policy,
+            tenant=tenant, pipeline=pipeline,
+        )
+        code, ctype, out, extra = self._forward_with_retries(
+            root, bucket, body, candidates,
+            extra_headers=(
+                (HDR_TENANT, tenant), (HDR_PIPELINE, pipeline),
+            ),
+            before_forward=lambda v: self._ensure_graph_state(
+                v, tenant, pipeline
+            ),
+            admission_shed_is_final=True,
+        )
+        self._m_requests.inc(
+            status=_STATUS_LABEL.get(code, "error" if code >= 500 else "ok")
+        )
+        root.set(status=code)
+        root.end()
+        if root.trace_id:
+            extra = extra + [("X-Trace-Id", root.trace_id)]
+        return code, ctype, out, extra
+
+    def _push_json(self, view: ReplicaView, path: str, payload: dict):
+        """POST one JSON control payload to a replica over the pooled
+        proxy connection; (status, body) back, errors propagate."""
+        addr = view.hb.addr or "127.0.0.1"
+        port = view.hb.port
+        conn = self._pool.take(addr, port)
+        try:
+            conn.request(
+                "POST", path, body=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            out = resp.read()
+        except BaseException:
+            conn.close()
+            raise
+        self._pool.give(addr, port, conn)
+        return resp.status, out
+
+    def _ensure_graph_state(
+        self, view: ReplicaView, tenant: str, pipeline: str
+    ) -> None:
+        """Converge one replica's graph state before a forward: push the
+        stored spec when its heartbeat lacks the pipeline id, and push
+        the stored tenant config when THIS incarnation has never
+        received it (tenant configs have no heartbeat echo, so the
+        bookkeeping is per (replica, incarnation) — a restart re-pushes
+        both). Restart/late-join recovery, the graph analogue of warmup
+        re-reporting the chain buckets."""
+        from mpi_cuda_imagemanipulation_tpu.graph.service import (
+            PIPELINES_PATH,
+            TENANTS_PATH,
+        )
+
+        inc_key = (view.replica_id, view.hb.incarnation)
+        with self._graph_lock:
+            reg = self.graph_specs.get((tenant, pipeline))
+            tcfg = self.graph_tenants.get(tenant)
+            need_tenant = (
+                tcfg is not None
+                and tenant not in self._tenant_pushed.get(inc_key, ())
+            )
+        need_spec = (
+            reg is not None and pipeline not in (view.hb.pipelines or ())
+        )
+        # a pipeline never registered through this front door forwards
+        # as-is: the replica may know it (direct registration), and its
+        # structured unknown-pipeline refusal beats a router guess
+        if not need_tenant and not need_spec:
+            return
+        if need_tenant:
+            code, out = self._push_json(view, TENANTS_PATH, tcfg)
+            if code != 200:
+                raise RuntimeError(
+                    f"tenant push to {view.replica_id} answered {code}: "
+                    f"{out[:120]!r}"
+                )
+            self._note_tenant_pushed(view, tenant)
+        if need_spec:
+            code, out = self._push_json(view, PIPELINES_PATH, reg)
+            if code != 200:
+                raise RuntimeError(
+                    f"spec push to {view.replica_id} answered {code}: "
+                    f"{out[:120]!r}"
+                )
+        self._m_graph_pushes.inc()
+        self._log.info(
+            "graph: re-pushed %s/%s to %s (tenant=%s spec=%s)",
+            tenant, pipeline, view.replica_id, need_tenant, need_spec,
+        )
+
+    def _note_tenant_pushed(self, view: ReplicaView, tenant: str) -> None:
+        with self._graph_lock:
+            self._tenant_pushed.setdefault(
+                (view.replica_id, view.hb.incarnation), set()
+            ).add(tenant)
+
+    def handle_graph_register(self, body: bytes) -> tuple[int, dict]:
+        """`POST /v1/pipelines` at the front door: validate HERE (the
+        closed taxonomy — a malformed spec never costs a replica
+        round-trip), store for re-push, broadcast to every routable
+        replica, answer with the per-replica outcome."""
+        from mpi_cuda_imagemanipulation_tpu.graph.ir import dag_fingerprint
+        from mpi_cuda_imagemanipulation_tpu.graph.spec import (
+            SpecError,
+            parse_spec,
+        )
+
+        try:
+            try:
+                payload = json.loads(body or b"null")
+            except ValueError as e:
+                raise SpecError(
+                    "bad-json", f"body is not JSON: {e}"
+                ) from None
+            if not isinstance(payload, dict):
+                raise SpecError(
+                    "bad-root", "registration body must be an object"
+                )
+            spec = payload.get("spec", payload)
+            tenant = payload.get("tenant") or "default"
+            graph = parse_spec(spec)
+        except SpecError as e:
+            return (
+                400 if e.code == "bad-json" else 422,
+                {"status": "rejected", "code": e.code, "error": str(e)},
+            )
+        pid = dag_fingerprint(graph)
+        reg = {"tenant": tenant, "spec": spec}
+        with self._graph_lock:
+            self.graph_specs[(tenant, pid)] = reg
+        pushed: dict[str, object] = {}
+        for v in self._routable():
+            try:
+                code, _out = self._push_json(v, "/v1/pipelines", reg)
+                pushed[v.replica_id] = code
+            except Exception as e:
+                pushed[v.replica_id] = f"error: {type(e).__name__}"
+        return 200, {
+            "pipeline": pid,
+            "tenant": tenant,
+            "name": graph.name,
+            "nodes": len(graph.nodes),
+            "outputs": sorted(graph.outputs),
+            "replicas": pushed,
+        }
+
+    def handle_graph_tenant(self, body: bytes) -> tuple[int, dict]:
+        """`POST /v1/tenants` at the front door: validate, store for
+        re-push, broadcast (same shape as spec registration)."""
+        from mpi_cuda_imagemanipulation_tpu.graph.spec import SpecError
+        from mpi_cuda_imagemanipulation_tpu.graph.tenancy import (
+            TenantConfig,
+        )
+
+        try:
+            try:
+                payload = json.loads(body or b"null")
+            except ValueError as e:
+                raise SpecError(
+                    "bad-json", f"body is not JSON: {e}"
+                ) from None
+            if not isinstance(payload, dict):
+                raise SpecError(
+                    "bad-root", "tenant config must be an object"
+                )
+            TenantConfig(  # validation only; replicas hold the state
+                tenant_id=payload.get("tenant", ""),
+                qos=payload.get("qos", "standard"),
+                quota_requests=payload.get("quota_requests"),
+                quota_bytes=payload.get("quota_bytes"),
+                window_s=payload.get("window_s"),
+            )
+        except SpecError as e:
+            return (
+                400 if e.code == "bad-json" else 422,
+                {"status": "rejected", "code": e.code, "error": str(e)},
+            )
+        tenant = payload["tenant"]
+        with self._graph_lock:
+            self.graph_tenants[tenant] = payload
+        pushed: dict[str, object] = {}
+        for v in self._routable():
+            try:
+                code, _out = self._push_json(v, "/v1/tenants", payload)
+                pushed[v.replica_id] = code
+                if code == 200:
+                    self._note_tenant_pushed(v, tenant)
+            except Exception as e:
+                pushed[v.replica_id] = f"error: {type(e).__name__}"
+        return 200, {"tenant": tenant, "replicas": pushed}
 
     # -- canary / shadow routing (fabric/canary.py) ------------------------
 
@@ -843,7 +1205,7 @@ class Router:
                 "fabric.shadow", parent=root.context(),
                 replica=canary_view.replica_id,
             ):
-                c_code, _ct, c_out = self._forward_once(
+                c_code, _ct, c_out, _ph = self._forward_once(
                     canary_view, body, root.trace_id
                 )
             if c_code == 200:
@@ -1240,6 +1602,12 @@ class Router:
             "forward_attempts": self.forward_attempts,
             "shed_frac": self.shed_frac,
             "draining": self.draining_ids(),
+            "graph": {
+                "specs": sorted(
+                    f"{t}/{p}" for (t, p) in self.graph_specs
+                ),
+                "tenants": sorted(self.graph_tenants),
+            },
             "canary": self.canary.status(),
             "sessions": self.sessions.stats(),
             "autoscaler": (
@@ -1329,6 +1697,16 @@ class _RouterHTTPServer(ThreadingHTTPServer):
     request_queue_size = 128
 
 
+def _is_admission_shed(body: bytes) -> bool:
+    """Whether a replica's 503 body is the graph lane's tenant-level
+    admission shed ({"status": "shed", ...}) as opposed to a
+    replica-level drain/stopped refusal."""
+    try:
+        return json.loads(body).get("status") == "shed"
+    except Exception:
+        return False
+
+
 def _json_response(
     code: int, payload: dict, extra: list[tuple[str, str]] | None = None
 ) -> tuple[int, str, bytes, list[tuple[str, str]]]:
@@ -1382,16 +1760,26 @@ def _make_handler(router: Router):
                 self._reply_json(404, {"error": f"no route {self.path}"})
 
         def do_POST(self):  # noqa: N802
+            from urllib.parse import parse_qs, urlsplit
+
             n = int(self.headers.get("Content-Length", "0"))
             body = self.rfile.read(n)
+            split = urlsplit(self.path)
+            path = split.path
             if self.path == HEARTBEAT_PATH:
                 code, payload = router.handle_heartbeat(body)
                 self._reply_json(code, payload)
-            elif self.path == "/v1/process":
+            elif path == "/v1/process":
                 code, ctype, out, extra = router.handle_process(
-                    body, self.headers
+                    body, self.headers, query=parse_qs(split.query)
                 )
                 self._reply(code, ctype, out, extra)
+            elif path == "/v1/pipelines":
+                code, payload = router.handle_graph_register(body)
+                self._reply_json(code, payload)
+            elif path == "/v1/tenants":
+                code, payload = router.handle_graph_tenant(body)
+                self._reply_json(code, payload)
             elif (route := fabric_session.parse_session_path(self.path)):
                 code, ctype, out, extra = router.handle_session_frame(
                     route[0], body, self.headers

@@ -1,0 +1,49 @@
+"""Pipelines as data — the DAG op-graph IR and the pipeline service.
+
+`graph/` generalizes the repo's execution model from "one op chain baked
+into the CLI" to "a pipeline *service*": clients POST a versioned JSON
+pipeline spec (graph/spec.py) describing a DAG of ops — branch taps,
+merge combinators (blend / alpha_composite / subtract), side outputs
+(image + histogram + stats in one dispatch) — validated against
+`ops/registry` under a CLOSED error taxonomy (malformed specs are always
+4xx-class, never 500), compiled into fused linear segments by the same
+Stage rules `plan/` proved on chains (graph/compile.py), and served
+per-tenant with quota + QoS admission and bounded compile-cache
+namespaces (graph/tenancy.py, graph/service.py).
+
+The bit-exactness contract is the gate everywhere: a DAG that happens to
+be a linear chain produces output bit-identical to the `--plan` chain
+path (its `dag_fingerprint` IS that chain's `pipeline_fingerprint`, so
+calibration and cache keying carry over unchanged), and every merge
+combinator has golden semantics in ops/spec.py style.
+"""
+
+from mpi_cuda_imagemanipulation_tpu.graph.compile import (
+    GraphProgram,
+    compile_graph,
+    graph_callable,
+)
+from mpi_cuda_imagemanipulation_tpu.graph.ir import (
+    MERGE_COMBINATORS,
+    PipelineGraph,
+    dag_fingerprint,
+)
+from mpi_cuda_imagemanipulation_tpu.graph.spec import (
+    SPEC_VERSION,
+    TAXONOMY,
+    SpecError,
+    parse_spec,
+)
+
+__all__ = [
+    "MERGE_COMBINATORS",
+    "SPEC_VERSION",
+    "TAXONOMY",
+    "GraphProgram",
+    "PipelineGraph",
+    "SpecError",
+    "compile_graph",
+    "dag_fingerprint",
+    "graph_callable",
+    "parse_spec",
+]

@@ -1,0 +1,266 @@
+"""Graph compilation: topo-sort + stage partition, generalizing `plan/`'s
+chain fusion to fan-out/fan-in.
+
+The compiler cuts the DAG at its *materialization boundaries* — the
+source, every merge, every fan-out tap (a node with more than one
+consumer), and every node a spec output names. Between boundaries each
+maximal linear op run becomes one `RunSegment`, compiled by the SAME
+`plan/planner.build_plan` stage rules the chain path uses (pointwise
+absorption + temporal blocking; the per-segment plan mode resolves
+through `resolve_plan_mode`, whose calibration lookup keys on the
+segment's `pipeline_fingerprint` — so a DAG branch that equals a
+calibrated chain reuses its measured plan choice unchanged). Merges are
+join barriers: both inputs are materialized env values before the
+combinator core runs.
+
+Shared prefixes are computed ONCE by construction: the executor
+evaluates steps in topological order into an environment keyed by node
+id, so a tap's value is produced by exactly one step no matter how many
+branches read it (the `on_stage` trace-time hook lets tests count this —
+tests/test_graph.py's dispatch-count assertion).
+
+Side outputs ride the same dispatch: `histogram` is the 256-bin int32
+count of the named node's u8 value (`ops/histogram.histogram_stats` —
+the exact additive statistic the global-stat ops psum), and `stats`
+(count/min/max/mean) derives from that histogram, so one device program
+produces image + histogram + stats with no second pass over the pixels.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+
+import jax.numpy as jnp
+
+from mpi_cuda_imagemanipulation_tpu.graph.ir import (
+    MergeNode,
+    OpNode,
+    PipelineGraph,
+    SourceNode,
+    dag_fingerprint,
+    merge_core,
+)
+from mpi_cuda_imagemanipulation_tpu.ops.histogram import histogram_stats
+from mpi_cuda_imagemanipulation_tpu.ops.spec import U8, exact_f32
+from mpi_cuda_imagemanipulation_tpu.plan.ir import Plan
+from mpi_cuda_imagemanipulation_tpu.plan.planner import (
+    build_plan,
+    resolve_plan_mode,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class RunSegment:
+    """One maximal linear op run between materialization boundaries,
+    compiled into fused stages by the chain planner."""
+
+    dst: str  # node id whose value this segment produces
+    src: str  # env key the segment reads
+    plan: Plan
+
+    @property
+    def names(self) -> tuple[str, ...]:
+        return tuple(op.name for op in self.plan.ops)
+
+
+@dataclasses.dataclass(frozen=True)
+class MergeStep:
+    """A join barrier: both inputs are materialized env values."""
+
+    dst: str
+    node: MergeNode
+
+
+Step = RunSegment | MergeStep
+
+
+@dataclasses.dataclass(frozen=True)
+class GraphProgram:
+    """A compiled graph: executable steps in topological order."""
+
+    graph: PipelineGraph
+    steps: tuple[Step, ...]
+    mode: str  # the resolved build mode segments were fused with
+
+    @property
+    def dag_fp(self) -> str:
+        return dag_fingerprint(self.graph)
+
+    @property
+    def n_segments(self) -> int:
+        return sum(1 for s in self.steps if isinstance(s, RunSegment))
+
+    @property
+    def n_merges(self) -> int:
+        return sum(1 for s in self.steps if isinstance(s, MergeStep))
+
+    @property
+    def hbm_passes(self) -> int:
+        return sum(
+            s.plan.hbm_passes for s in self.steps if isinstance(s, RunSegment)
+        ) + self.n_merges
+
+    @property
+    def hbm_passes_unfused(self) -> int:
+        return sum(
+            s.plan.hbm_passes_unfused
+            for s in self.steps
+            if isinstance(s, RunSegment)
+        ) + self.n_merges
+
+    @property
+    def fingerprint(self) -> str:
+        """Execution-structure identity: the DAG fingerprint plus every
+        segment's resolved stage partition — the graph compile-cache key
+        component, exactly the role plan.Plan.fingerprint plays for the
+        chain serve cache."""
+        key = self.dag_fp + "|" + self.mode + "|" + ";".join(
+            f"{s.dst}<{s.src}:{s.plan.fingerprint}"
+            if isinstance(s, RunSegment)
+            else f"{s.dst}<{s.node.inputs[0]},{s.node.inputs[1]}:"
+            f"{s.node.combinator}/k{s.node.alpha_k}"
+            for s in self.steps
+        )
+        return hashlib.sha256(key.encode()).hexdigest()[:16]
+
+    def describe(self) -> str:
+        rows = [
+            f"graph program {self.graph.name or self.dag_fp}: "
+            f"{self.n_segments} segments + {self.n_merges} merges "
+            f"(mode={self.mode}, hbm passes "
+            f"{self.hbm_passes_unfused} -> {self.hbm_passes})"
+        ]
+        for s in self.steps:
+            if isinstance(s, RunSegment):
+                rows.append(
+                    f"  seg {s.dst} <- {s.src}: {'+'.join(s.names)} "
+                    f"({len(s.plan.stages)} stages)"
+                )
+            else:
+                rows.append(
+                    f"  merge {s.dst} <- {s.node.inputs[0]} "
+                    f"{s.node.combinator} {s.node.inputs[1]}"
+                )
+        return "\n".join(rows)
+
+
+def compile_graph(
+    graph: PipelineGraph,
+    *,
+    plan: str = "auto",
+    backend: str = "xla",
+    width: int | None = None,
+) -> GraphProgram:
+    """Partition the DAG into steps; each linear segment's fusion mode
+    resolves through the chain planner's calibration-aware resolution
+    (per-segment `pipeline_fingerprint` lookup — chain keys carry over)."""
+    consumers = graph.consumers
+    out_refs = set(graph.outputs.values())
+    by_id = graph.by_id
+
+    def is_boundary(nid: str) -> bool:
+        """A node whose value must materialize into the env."""
+        if consumers[nid] != 1 or nid in out_refs:
+            return True
+        (consumer,) = (
+            n for n in graph.nodes
+            if (isinstance(n, OpNode) and n.input == nid)
+            or (isinstance(n, MergeNode) and nid in n.inputs)
+        )
+        return not isinstance(consumer, OpNode)
+
+    steps: list[Step] = []
+    # op node id -> (segment source env key, ops so far) while the run is
+    # still open (its nodes are interior — single-consumer, op-fed)
+    open_seg: dict[str, tuple[str, list]] = {}
+    resolved_mode: str | None = None
+    for node in graph.nodes:
+        if isinstance(node, SourceNode):
+            continue
+        if isinstance(node, MergeNode):
+            steps.append(MergeStep(dst=node.id, node=node))
+            continue
+        src, ops = open_seg.pop(node.input, (node.input, []))
+        ops = ops + [node.op]
+        if is_boundary(node.id):
+            mode = resolve_plan_mode(
+                tuple(ops), plan, backend=backend, width=width
+            )
+            resolved_mode = resolved_mode or mode
+            steps.append(
+                RunSegment(
+                    dst=node.id, src=src, plan=build_plan(tuple(ops), mode)
+                )
+            )
+        else:
+            open_seg[node.id] = (src, ops)
+    assert not open_seg, f"unterminated segments {sorted(open_seg)}"
+    # a graph of only merges/source still needs a mode label
+    return GraphProgram(
+        graph=graph, steps=tuple(steps), mode=resolved_mode or "off"
+    )
+
+
+def _stats_from_hist(hist: jnp.ndarray) -> dict[str, jnp.ndarray]:
+    """count/min/max/mean from the integer histogram — derived, so the
+    whole side-output family costs one pixels pass. The mean is f32 over
+    exact integer counts: deterministic (same replicated arithmetic as
+    ops/histogram's Otsu moments)."""
+    bins = jnp.arange(256, dtype=jnp.int32)
+    total = jnp.sum(hist)
+    occupied = hist > 0
+    lo = jnp.min(jnp.where(occupied, bins, 256))
+    hi = jnp.max(jnp.where(occupied, bins, -1))
+    s = jnp.sum(hist.astype(jnp.float32) * bins.astype(jnp.float32))
+    mean = s / jnp.maximum(total, 1).astype(jnp.float32)
+    return {"count": total, "min": lo, "max": hi, "mean": mean}
+
+
+def graph_callable(program: GraphProgram, *, impl: str = "xla", on_stage=None):
+    """The full-image executor: a u8 image -> {output kind: array}
+    function (jit it like any backend callable; outputs are `image` u8
+    plus any declared `histogram` int32[256] / `stats` scalars).
+
+    `on_stage(step)` fires at trace time once per executed step — the
+    computed-once evidence for shared prefixes (a tap's segment appears
+    exactly once in the traced program no matter how many branches read
+    it)."""
+    from mpi_cuda_imagemanipulation_tpu.plan.exec import run_stage_full
+
+    graph = program.graph
+
+    def run(img: jnp.ndarray):
+        env: dict[str, jnp.ndarray] = {graph.source_id: img}
+        for step in program.steps:
+            if on_stage is not None:
+                on_stage(step)  # python side effect => once per (re)trace
+            if isinstance(step, RunSegment):
+                x = env[step.src]
+                for stage in step.plan.stages:
+                    if stage.kind == "global":
+                        x = stage.ops[0](x)
+                    else:
+                        x = run_stage_full(stage, x, impl)
+                env[step.dst] = x
+            else:
+                a, b = (env[i] for i in step.node.inputs)
+                env[step.dst] = merge_core(
+                    step.node, exact_f32(a), exact_f32(b)
+                ).astype(U8)
+        out: dict[str, jnp.ndarray] = {
+            "image": env[graph.outputs["image"]]
+        }
+        hist_node = graph.outputs.get("histogram")
+        stats_node = graph.outputs.get("stats")
+        # one histogram serves both side outputs when they name one node
+        hists: dict[str, jnp.ndarray] = {}
+        for nid in {n for n in (hist_node, stats_node) if n}:
+            hists[nid] = histogram_stats(env[nid], None)
+        if hist_node:
+            out["histogram"] = hists[hist_node]
+        if stats_node:
+            out["stats"] = _stats_from_hist(hists[stats_node])
+        return out
+
+    return run

@@ -1,0 +1,364 @@
+"""The pipeline service: registration + tenant-admitted graph dispatch.
+
+`GraphService` is the engine behind the HTTP surface (serve/server.py):
+
+    register(tenant, spec)   validate (closed taxonomy, graph/spec.py),
+                             compile-plan the DAG, store under the
+                             tenant; returns the pipeline id — the
+                             spec's `dag_fingerprint`, so registration
+                             is idempotent and two tenants registering
+                             one spec agree on the id.
+    process(tenant, id, img) admission (quota + QoS ladder,
+                             graph/tenancy.py) -> per-tenant compile
+                             cache -> ONE jitted dispatch producing
+                             image + any declared side outputs.
+
+Wire surface (shared with the fabric router, which forwards these
+headers and keys warm affinity on (tenant, pipeline id, bucket)):
+
+    POST /v1/pipelines                  {"tenant": ..., "spec": {...}}
+    POST /v1/tenants                    {"tenant": ..., "qos": ...,
+                                         "quota_requests"/"quota_bytes"}
+    POST /v1/process?pipeline=<id>      X-MCIM-Tenant / X-MCIM-Pipeline
+                                        headers work too
+
+Failure posture: every refusal is a `SpecError` (4xx-class structured
+JSON with the taxonomy code) or a `GraphShed` (503 + Retry-After,
+counted as shed) — a hostile spec or request can never 500. The
+`graph.dispatch` failpoint injects the one genuine 500 class (a device
+dispatch failure) so the error path stays testable.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import jax
+import numpy as np
+
+from mpi_cuda_imagemanipulation_tpu.graph.compile import (
+    compile_graph,
+    graph_callable,
+)
+from mpi_cuda_imagemanipulation_tpu.graph.spec import SpecError, parse_spec
+from mpi_cuda_imagemanipulation_tpu.graph.tenancy import (
+    GraphShed,
+    TenantConfig,
+    TenantRegistry,
+)
+from mpi_cuda_imagemanipulation_tpu.obs.metrics import Registry
+from mpi_cuda_imagemanipulation_tpu.resilience import failpoints
+from mpi_cuda_imagemanipulation_tpu.utils import env as env_registry
+from mpi_cuda_imagemanipulation_tpu.utils.log import get_logger
+
+ENV_MAX_INFLIGHT = "MCIM_GRAPH_MAX_INFLIGHT"
+
+# the graph wire headers the fabric router forwards verbatim
+HDR_TENANT = "X-MCIM-Tenant"
+HDR_PIPELINE = "X-MCIM-Pipeline"
+HDR_HISTOGRAM = "X-MCIM-Histogram"
+HDR_STATS = "X-MCIM-Stats"
+PIPELINES_PATH = "/v1/pipelines"
+TENANTS_PATH = "/v1/tenants"
+
+# bounded terminal-status label set of mcim_graph_requests_total
+STATUSES = ("ok", "shed", "rejected", "error")
+
+
+class GraphService:
+    def __init__(
+        self,
+        *,
+        registry: Registry | None = None,
+        backend: str = "xla",
+        plan: str = "auto",
+        load_frac=None,
+        clock=time.monotonic,
+    ):
+        self.registry = registry or Registry()
+        self.backend = backend
+        self.plan = plan
+        self.tenants = TenantRegistry(clock=clock)
+        # external load signal (the serving scheduler's queue fill); the
+        # QoS ladder sheds on max(external, own-inflight fraction)
+        self._load_frac = load_frac
+        self.max_inflight = int(env_registry.get(ENV_MAX_INFLIGHT))
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        self._clock = clock
+        self._log = get_logger()
+        r = self.registry
+        self._m_requests = r.counter(
+            "mcim_graph_requests_total",
+            "Graph-pipeline requests by terminal status "
+            "(ok/shed/rejected/error).",
+            labels=("status",),
+        )
+        self._m_rejections = r.counter(
+            "mcim_graph_rejections_total",
+            "Spec/request refusals by closed-taxonomy code "
+            "(graph/spec.TAXONOMY — a bounded label set by construction).",
+            labels=("code",),
+        )
+        self._m_shed = r.counter(
+            "mcim_graph_shed_total",
+            "Explicit sheds by reason (quota window / qos ladder / "
+            "inflight cap).",
+            labels=("reason",),
+        )
+        self._m_registrations = r.counter(
+            "mcim_graph_registrations_total",
+            "Accepted pipeline-spec registrations (idempotent re-posts "
+            "count — the wire cost is real either way).",
+        )
+        self._m_dispatch_s = r.histogram(
+            "mcim_graph_dispatch_seconds",
+            "Device+host time per graph dispatch.",
+        )
+        self._m_compiles = r.counter(
+            "mcim_graph_compiles_total",
+            "Graph executables built into a tenant cache namespace.",
+        )
+        r.gauge(
+            "mcim_graph_tenants",
+            "Tenants in the registry (bounded by MCIM_GRAPH_MAX_TENANTS).",
+            fn=lambda: float(len(self.tenants.tenants())),
+        )
+        r.gauge(
+            "mcim_graph_pipelines",
+            "Registered (tenant, pipeline) pairs.",
+            fn=lambda: float(
+                sum(len(t.pipelines) for t in self.tenants.tenants())
+            ),
+        )
+        r.gauge(
+            "mcim_graph_cache_entries",
+            "Compiled executables across all tenant cache namespaces "
+            "(each namespace capped at MCIM_GRAPH_CACHE_CAP).",
+            fn=lambda: float(
+                sum(len(t.cache) for t in self.tenants.tenants())
+            ),
+        )
+        r.gauge(
+            "mcim_graph_cache_evictions",
+            "Cumulative LRU evictions out of tenant cache namespaces.",
+            fn=lambda: float(
+                sum(t.cache_evictions for t in self.tenants.tenants())
+            ),
+        )
+
+    # -- registration ------------------------------------------------------
+
+    def on_reject(self, code: str) -> None:
+        """Count one closed-taxonomy refusal (the HTTP layer calls this
+        for refusals it maps itself, e.g. undecodable request bodies)."""
+        self._m_requests.inc(status="rejected")
+        self._m_rejections.inc(code=code)
+
+    def register(self, tenant_id: str, spec_raw) -> dict:
+        """Validate + store one spec under the tenant; idempotent.
+        Raises SpecError (closed taxonomy) on any refusal."""
+        try:
+            graph = parse_spec(spec_raw)
+            st = self.tenants.ensure(tenant_id)
+        except SpecError as e:
+            self._m_rejections.inc(code=e.code)
+            raise
+        program = compile_graph(
+            graph, plan=self.plan, backend=self.backend
+        )
+        pid = program.dag_fp
+        canonical = spec_raw if isinstance(spec_raw, dict) else None
+        st.pipelines[pid] = (graph, canonical)
+        self._m_registrations.inc()
+        chain = graph.as_linear_chain()
+        self._log.info(
+            "graph: tenant %s registered %s (%s, %d nodes, %d segments)",
+            tenant_id, pid, graph.name or "<unnamed>", len(graph.nodes),
+            program.n_segments,
+        )
+        return {
+            "pipeline": pid,
+            "tenant": tenant_id,
+            "name": graph.name,
+            "nodes": len(graph.nodes),
+            "segments": program.n_segments,
+            "merges": program.n_merges,
+            "outputs": sorted(graph.outputs),
+            "linear_chain": (
+                ",".join(op.name for op in chain) if chain else None
+            ),
+            "fingerprint": program.fingerprint,
+        }
+
+    def configure_tenant(self, body: dict) -> dict:
+        """`POST /v1/tenants` body -> stored TenantConfig; SpecError on
+        any refusal (bad-tenant-id / bad-qos / bad-quota)."""
+        if not isinstance(body, dict):
+            raise SpecError("bad-root", "tenant config must be an object")
+        unknown = set(body) - {
+            "tenant", "qos", "quota_requests", "quota_bytes", "window_s"
+        }
+        if unknown:
+            raise SpecError(
+                "unknown-field",
+                f"unknown tenant fields {sorted(unknown)}",
+            )
+        cfg = TenantConfig(
+            tenant_id=body.get("tenant", ""),
+            qos=body.get("qos", "standard"),
+            quota_requests=body.get("quota_requests"),
+            quota_bytes=body.get("quota_bytes"),
+            window_s=body.get("window_s"),
+        )
+        st = self.tenants.configure(cfg)
+        return {
+            "tenant": cfg.tenant_id,
+            "qos": cfg.qos,
+            "quota_requests": cfg.quota_requests,
+            "quota_bytes": cfg.quota_bytes,
+            "window_s": st.config.window_s,
+        }
+
+    # -- dispatch ----------------------------------------------------------
+
+    def _current_load(self) -> float:
+        own = self._inflight / max(1, self.max_inflight)
+        ext = 0.0
+        if self._load_frac is not None:
+            try:
+                ext = float(self._load_frac())
+            except Exception:  # the signal must never fail a request
+                ext = 0.0
+        return max(own, ext)
+
+    def process(
+        self,
+        tenant_id: str,
+        pipeline_id: str,
+        img: np.ndarray,
+        *,
+        nbytes: int | None = None,
+        trace_id: str = "",
+    ) -> dict:
+        """One admitted graph dispatch -> {'image': np.uint8 array,
+        'histogram'?: list[int], 'stats'?: dict}. Raises SpecError
+        (rejected) / GraphShed (shed) / anything else = a real error."""
+        try:
+            st = self.tenants.get(tenant_id)
+            graph_entry = st.pipelines.get(pipeline_id)
+            if graph_entry is None:
+                raise SpecError(
+                    "unknown-pipeline",
+                    f"tenant {tenant_id!r} has no pipeline "
+                    f"{pipeline_id!r}",
+                )
+            graph = graph_entry[0]
+            self._validate_image(graph, img)
+        except SpecError as e:
+            self._m_requests.inc(status="rejected")
+            self._m_rejections.inc(code=e.code)
+            raise
+        try:
+            self.tenants.admit(
+                st, img.nbytes if nbytes is None else nbytes,
+                self._current_load(),
+            )
+        except GraphShed as e:
+            self._m_requests.inc(status="shed")
+            self._m_shed.inc(reason=e.reason)
+            raise
+        with self._inflight_lock:
+            if self._inflight >= self.max_inflight:
+                self._m_requests.inc(status="shed")
+                self._m_shed.inc(reason="inflight")
+                raise GraphShed(
+                    "inflight",
+                    f"{self._inflight} graph dispatches already in "
+                    f"flight (cap {self.max_inflight})",
+                    0.5,
+                )
+            self._inflight += 1
+        t0 = self._clock()
+        try:
+            failpoints.maybe_fail(
+                "graph.dispatch", tenant=tenant_id, pipeline=pipeline_id
+            )
+            fn = st.cache_get(pipeline_id)
+            if fn is None:
+                # build + jit OFF the registry lock (serve/cache.py
+                # discipline); a racing miss builds twice, cache_put
+                # keeps the newest — correctness is unaffected (both
+                # are the same program)
+                program = compile_graph(
+                    graph, plan=self.plan, backend=self.backend,
+                    width=img.shape[1] if img.ndim >= 2 else None,
+                )
+                fn = jax.jit(graph_callable(program, impl=self.backend))
+                st.cache_put(pipeline_id, fn)
+                self._m_compiles.inc()
+            out = fn(img)
+            result: dict = {"image": np.asarray(out["image"])}
+            if "histogram" in out:
+                result["histogram"] = [
+                    int(v) for v in np.asarray(out["histogram"])
+                ]
+            if "stats" in out:
+                s = out["stats"]
+                result["stats"] = {
+                    "count": int(s["count"]),
+                    "min": int(s["min"]),
+                    "max": int(s["max"]),
+                    "mean": round(float(s["mean"]), 4),
+                }
+        except Exception:
+            self._m_requests.inc(status="error")
+            raise
+        finally:
+            with self._inflight_lock:
+                self._inflight -= 1
+        self._m_dispatch_s.observe(
+            self._clock() - t0, exemplar=trace_id or None
+        )
+        self._m_requests.inc(status="ok")
+        st.requests_ok += 1
+        return result
+
+    def _validate_image(self, graph, img: np.ndarray) -> None:
+        if (
+            not isinstance(img, np.ndarray)
+            or img.dtype != np.uint8
+            or img.ndim not in (2, 3)
+        ):
+            raise SpecError(
+                "bad-image",
+                "graphs take (H, W[, C]) uint8 images",
+            )
+        if min(img.shape[:2]) < graph.min_true_dim:
+            raise SpecError(
+                "bad-image",
+                f"image {img.shape[0]}x{img.shape[1]} is below the "
+                f"graph's minimum dimension {graph.min_true_dim} "
+                "(stencil border extension)",
+            )
+        ch = img.shape[2] if img.ndim == 3 else 1
+        graph.check_channels(ch)
+
+    def pipeline_ids(self) -> list[str]:
+        """Every registered pipeline id across tenants — the replica
+        heartbeat's `pipelines` field (the router re-pushes specs to
+        replicas whose beat lacks one)."""
+        ids: set[str] = set()
+        for st in self.tenants.tenants():
+            ids.update(st.pipelines)
+        return sorted(ids)
+
+    def stats(self) -> dict:
+        return {
+            "backend": self.backend,
+            "plan": self.plan,
+            "max_inflight": self.max_inflight,
+            "inflight": self._inflight,
+            **self.tenants.stats(),
+        }

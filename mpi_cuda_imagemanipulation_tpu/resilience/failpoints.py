@@ -81,6 +81,10 @@ KNOWN_SITES = (
                         # build-path error handling is testable without a
                         # real planner bug ('off' builds never consult it:
                         # the golden per-op reference must stay reachable)
+    "graph.dispatch",   # graph/service.py process: one admitted graph
+                        # dispatch — a hit is the one genuine 500 class
+                        # (device failure AFTER admission), so tests can
+                        # prove shed/rejected stay distinct from error
 )
 
 ENV_SPEC = "MCIM_FAILPOINTS"

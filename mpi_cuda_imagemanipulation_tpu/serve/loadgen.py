@@ -170,7 +170,11 @@ def encode_blob(img: np.ndarray) -> memoryview:
 
 
 def http_post_image(
-    url: str, blob: bytes | bytearray | memoryview, *, timeout_s: float = 30.0
+    url: str,
+    blob: bytes | bytearray | memoryview,
+    *,
+    timeout_s: float = 30.0,
+    headers: dict | None = None,
 ) -> dict:
     """One `POST /v1/process` against a front door (router or replica).
     `blob` is any bytes-like body (memoryviews from `encode_blob` / the
@@ -179,14 +183,19 @@ def http_post_image(
     transport errors surface as code 599 so open-loop accounting never
     raises. `retry_after` carries the server's Retry-After header — the
     router's explicit shed-and-retry-later signal, which the accounting
-    layer must keep distinct from real unavailability."""
+    layer must keep distinct from real unavailability. `headers` adds
+    request headers — the multi-tenant lanes ride tenant + pipeline
+    identity (X-MCIM-Tenant / X-MCIM-Pipeline) through here."""
     import urllib.error
     import urllib.request
 
     req = urllib.request.Request(
         url.rstrip("/") + "/v1/process",
         data=blob,
-        headers={"Content-Type": "application/octet-stream"},
+        headers={
+            "Content-Type": "application/octet-stream",
+            **(headers or {}),
+        },
         method="POST",
     )
     t0 = time.monotonic()
@@ -256,21 +265,31 @@ def http_run_offered_load(
             i += 1
         results = [(k, f.result()) for k, f in futures]
         wall = clock() - t0
+    rec = summarize_http_results(results, wall, offered_rps)
+    rec["results"] = results
+    return rec
+
+
+def summarize_http_results(
+    results: list[tuple[int, dict]], wall: float, offered_rps: float
+) -> dict:
+    """The shared HTTP open-loop accounting: one phase/lane record from
+    [(blob_index, response dict), ...]. A 503 WITH Retry-After is an
+    explicit shed — "come back later", the intended behavior under
+    quota/QoS/elastic pressure — and must not be folded into
+    unavailability (the 599/bare-503 failure class): a lane that counts
+    intentional shedding as downtime would misread admission control
+    doing its job as the pod losing traffic. `accepted` is the offered
+    load the pod actually took on; `ok_accepted_frac` is goodput over
+    it (the elastic/tenant acceptance criteria gate on it at 100%)."""
     ok = [r for _, r in results if r["code"] == 200]
     retried = sum(1 for _, r in results if r["attempts"] > 1)
-    # a 503 WITH Retry-After is the router's explicit shed — "come back
-    # later", the intended elastic behavior during scale-up — and must
-    # not be folded into unavailability (a 599/bare-503 failure class):
-    # an elastic A/B that counts intentional shedding as downtime would
-    # misread the autoscaler absorbing load as the pod losing it
     shed = sum(
         1
         for _, r in results
         if r["code"] == 503 and r.get("retry_after")
     )
     overloaded = sum(1 for _, r in results if r["code"] == 429)
-    # accepted = the offered load the pod actually took on (not shed at
-    # either door); the elastic acceptance gates ok/accepted at 100%
     n = len(results)
     accepted = n - shed - overloaded
     lat = [r["e2e_s"] for r in ok]
@@ -294,12 +313,77 @@ def http_run_offered_load(
         "overloaded": overloaded,
         "achieved_rps": len(ok) / wall if wall > 0 else 0.0,
         "wall_s": wall,
-        "results": results,
     }
     if lat:
         p = percentiles(lat, PERCENTILES)
         rec.update({f"e2e_p{int(q)}_ms": p[q] * 1e3 for q in PERCENTILES})
     return rec
+
+
+def multi_tenant_run(
+    url: str,
+    lanes: list[dict],
+    offered_rps: float,
+    duration_s: float,
+    *,
+    timeout_s: float = 30.0,
+    max_workers: int = 32,
+    clock=time.monotonic,
+    sleep=time.sleep,
+) -> dict:
+    """The multi-tenant offered-load mix: ONE open-loop arrival clock at
+    `offered_rps` total, arrivals round-robined across the tenant lanes,
+    per-tenant accounting out. Each lane is
+
+        {"tenant": <id>, "blobs": [...], "headers": {...}}
+
+    — `headers` carries the lane's identity (X-MCIM-Tenant, and
+    X-MCIM-Pipeline for graph lanes), so each tenant's quota window and
+    QoS class act on exactly its slice of the offered load. Returns
+    {tenant: phase record} with the shared shed-vs-unavailable
+    accounting per tenant (ok% / shed% / p99 are the lane's columns —
+    the numbers that show low-QoS tenants shedding FIRST while the
+    interactive tenant's goodput holds)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    period = 1.0 / offered_rps
+    futures: list[tuple[str, int, object]] = []
+    with ThreadPoolExecutor(max_workers=max_workers) as pool:
+        t0 = clock()
+        i = 0
+        while True:
+            due = t0 + i * period
+            now = clock()
+            if due - t0 >= duration_s:
+                break
+            if due > now:
+                sleep(due - now)
+            lane = lanes[i % len(lanes)]
+            blobs = lane["blobs"]
+            k = (i // len(lanes)) % len(blobs)
+            futures.append(
+                (
+                    lane["tenant"],
+                    k,
+                    pool.submit(
+                        http_post_image, url, blobs[k],
+                        timeout_s=timeout_s,
+                        headers=lane.get("headers"),
+                    ),
+                )
+            )
+            i += 1
+        by_tenant: dict[str, list[tuple[int, dict]]] = {
+            lane["tenant"]: [] for lane in lanes
+        }
+        for tenant, k, f in futures:
+            by_tenant[tenant].append((k, f.result()))
+        wall = clock() - t0
+    share = offered_rps / len(lanes)
+    return {
+        tenant: summarize_http_results(results, wall, share)
+        for tenant, results in by_tenant.items()
+    }
 
 
 def churn_run(

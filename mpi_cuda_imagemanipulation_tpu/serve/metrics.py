@@ -54,6 +54,13 @@ class ServeMetrics:
             "mcim_serve_retries_total",
             "Dispatch attempts re-run by the retry executor.",
         )
+        self._qos_shed = r.counter(
+            "mcim_serve_qos_shed_total",
+            "Sheds caused by a QoS class hitting its queue fraction "
+            "before the full depth (low classes shed first; "
+            "graph/tenancy ladder).",
+            labels=("qos",),
+        )
         self._degraded = r.counter(
             "mcim_serve_degraded_total",
             "Requests served via the golden fallback (breaker open).",
@@ -119,8 +126,13 @@ class ServeMetrics:
             self._queued.inc()
             self._queued_peak.set_max(self._queued.value())
 
-    def on_shed(self) -> None:
+    def on_shed(self, qos: str = "") -> None:
+        """`qos` names the admission class when the shed happened at a
+        class fraction BELOW the full queue depth (QoS-first shedding);
+        "" is the plain full-queue shed."""
         self._requests.inc(status="overloaded")
+        if qos:
+            self._qos_shed.inc(qos=qos)
 
     def on_reject(self) -> None:
         self._requests.inc(status="rejected")

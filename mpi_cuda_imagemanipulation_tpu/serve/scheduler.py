@@ -250,6 +250,12 @@ class MicroBatchScheduler:
         if self.engine is not None:
             self.engine.close(timeout)
 
+    def queue_fill_frac(self) -> float:
+        """Current admission-queue fill fraction — the load signal the
+        graph service's QoS ladder shares with chain admission."""
+        with self._cond:
+            return self._queued / max(1, self.queue_depth)
+
     # -- admission ---------------------------------------------------------
 
     def submit(
@@ -258,13 +264,21 @@ class MicroBatchScheduler:
         *,
         deadline_ms: float | None = None,
         trace_id: str | None = None,
+        qos: str = "interactive",
     ) -> Request:
         """Admit one image; returns a Request whose `.wait()` yields the
         response. Never blocks: over-depth submissions fail immediately
         with `overloaded` (the Request is returned already-resolved, so
         open-loop callers can fire-and-collect). `trace_id` adopts an
         upstream distributed-trace id (the fabric router's X-Trace-Id
-        hop) instead of minting one here."""
+        hop) instead of minting one here.
+
+        `qos` is the tenant's admission class (graph/tenancy.QOS_CLASSES
+        — the pipeline-service ladder, honored here for chain traffic
+        too): a non-interactive class admits only while the queue is
+        below its fraction of `queue_depth`, so under load the LOW
+        classes shed first and interactive keeps the full depth (the
+        default preserves the historical single-class behavior)."""
         now = self._clock()
         self.metrics.on_submit()
         img = np.asarray(img)
@@ -296,17 +310,23 @@ class MicroBatchScheduler:
         )
         req.bucket = (bh, bw, ch)
         enq.set(bucket=f"{bh}x{bw}x{ch}")
+        limit = self._qos_depth(qos)
         with self._cond:
             if not self._running:
                 enq.end()
                 return self._resolve(req, STATUS_SHUTDOWN, "scheduler stopped")
-            if self._queued >= self.queue_depth:
-                self.metrics.on_shed()
+            if self._queued >= limit:
+                self.metrics.on_shed(
+                    qos=qos if limit < self.queue_depth else ""
+                )
                 enq.end()
                 return self._resolve(
                     req,
                     STATUS_OVERLOADED,
-                    f"queue at capacity ({self.queue_depth})",
+                    f"queue at capacity ({limit} of {self.queue_depth} "
+                    f"for qos={qos})"
+                    if limit < self.queue_depth
+                    else f"queue at capacity ({self.queue_depth})",
                 )
             self._pending.setdefault(req.bucket, deque()).append(req)
             self._queued += 1
@@ -320,6 +340,23 @@ class MicroBatchScheduler:
             "serve.coalesce", parent=root.context()
         )
         return req
+
+    def _qos_depth(self, qos: str) -> int:
+        """The queue depth this admission class may fill: interactive
+        (and any unknown label — never punish a typo with data loss)
+        keeps the full depth; lower classes stop at their fraction of
+        it, so as the queue grows past the shed threshold the low-QoS
+        tenants shed FIRST (graph/tenancy.qos_admit_frac)."""
+        if qos in (None, "", "interactive"):
+            return self.queue_depth
+        from mpi_cuda_imagemanipulation_tpu.graph.tenancy import (
+            QOS_CLASSES,
+            qos_admit_frac,
+        )
+
+        if qos not in QOS_CLASSES:
+            return self.queue_depth
+        return max(1, int(self.queue_depth * qos_admit_frac(qos)))
 
     def _validate(self, img: np.ndarray) -> str | None:
         if img.dtype != np.uint8 or img.ndim not in (2, 3):

@@ -10,7 +10,15 @@ Two front doors share it:
     socket and the scheduler thread are released on EVERY exit path
     (exception mid-startup included), so repeated runs can't EADDRINUSE.
         POST /v1/process   PNG (or any PIL-decodable) bytes in, PNG out
-                           (X-Trace-Id response header when traced)
+                           (X-Trace-Id response header when traced).
+                           With X-MCIM-Pipeline/?pipeline=: the graph
+                           lane — tenant-admitted DAG dispatch, side
+                           outputs riding X-MCIM-Histogram/-Stats
+                           headers (graph/service.py)
+        POST /v1/pipelines register a pipeline spec for a tenant
+                           (graph/spec.py schema; refusals are 4xx
+                           structured JSON with the taxonomy code)
+        POST /v1/tenants   tenant QoS class + quota configuration
         GET  /healthz      health state machine (resilience/health.py):
                            200 serving/degraded · 503 otherwise
         GET  /stats        metrics snapshot — a JSON view over the app's
@@ -44,6 +52,7 @@ import numpy as np
 
 from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline
 from mpi_cuda_imagemanipulation_tpu.obs import metrics as obs_metrics
+from mpi_cuda_imagemanipulation_tpu.obs import trace as obs_trace
 from mpi_cuda_imagemanipulation_tpu.obs.metrics import Registry
 from mpi_cuda_imagemanipulation_tpu.resilience.breaker import (
     CLOSED,
@@ -182,6 +191,11 @@ class ServeApp:
         # the first session frame — a pod serving no video pays nothing
         self._session_host = None
         self._session_lock = threading.Lock()
+        # the pipeline service (graph/service.py): created on the first
+        # spec registration — a pod serving only the configured chain
+        # pays nothing
+        self._graph_service = None
+        self._graph_lock = threading.Lock()
         self._log = get_logger()
 
     def _register_state_gauges(self) -> None:
@@ -256,6 +270,55 @@ class ServeApp:
                 )
             return self._session_host
 
+    @property
+    def graph_service(self):
+        """The multi-tenant pipeline service (lazy; POST /v1/pipelines
+        and pipeline-tagged /v1/process requests land here). Shares the
+        app registry so mcim_graph_* families render in the same
+        /metrics scrape."""
+        with self._graph_lock:
+            if self._graph_service is None:
+                from mpi_cuda_imagemanipulation_tpu.graph.service import (
+                    GraphService,
+                )
+
+                backend = self.config.backend
+                if backend not in ("xla", "mxu", "auto"):
+                    backend = "xla"  # graph stages run the plan executors
+                self._graph_service = GraphService(
+                    registry=self.registry,
+                    backend=backend,
+                    plan=self.config.plan,
+                    # the QoS ladder sheds on the WORSE of the graph
+                    # service's own inflight fraction and the chain
+                    # scheduler's queue fill — one load signal for both
+                    # traffic classes
+                    load_frac=self.scheduler.queue_fill_frac,
+                )
+            return self._graph_service
+
+    def graph_pipeline_ids(self) -> list[str]:
+        """Registered pipeline ids, [] when the service was never touched
+        (the replica heartbeat's `pipelines` field — must not instantiate
+        anything)."""
+        with self._graph_lock:
+            svc = self._graph_service
+        return svc.pipeline_ids() if svc is not None else []
+
+    def tenant_qos(self, tenant_id: str | None) -> str:
+        """The admission class chain traffic from `tenant_id` submits
+        under: the tenant's configured QoS when the pipeline service
+        knows it, the full-depth default otherwise (an unknown tenant on
+        the chain path is ordinary anonymous traffic, not an error)."""
+        with self._graph_lock:
+            svc = self._graph_service
+        if not tenant_id or svc is None:
+            return "interactive"
+        try:
+            return svc.tenants.get(tenant_id).config.qos
+        except Exception:
+            return "interactive"
+
     def render_metrics(self) -> str:
         """The `GET /metrics` body: Prometheus text exposition over the
         app's registry (serving + engine + health/breaker/cache gauges)."""
@@ -319,6 +382,11 @@ class ServeApp:
             "sessions": (
                 self._session_host.stats()
                 if self._session_host is not None
+                else None
+            ),
+            "graph": (
+                self._graph_service.stats()
+                if self._graph_service is not None
                 else None
             ),
             "engine": (
@@ -402,8 +470,21 @@ def _make_handler(app: ServeApp):
                 # full federation snapshot (obs/fleet.py) — the router's
                 # heartbeat-gap full-scrape fallback hits this
                 self._send_json(200, app.fleet_snapshot())
+            elif self.path == "/v1/pipelines":
+                # the pipeline service's registry view (tenants, specs,
+                # cache namespaces) — [] shape until first registration
+                self._send_json(
+                    200,
+                    app._graph_service.stats()
+                    if app._graph_service is not None
+                    else {"tenants": {}},
+                )
             else:
-                self._send_json(404, {"error": f"no route {self.path}"})
+                self._send_json(
+                    404,
+                    {"code": "unknown-route",
+                     "error": f"no route {self.path}"},
+                )
 
         def _handle_session_frame(self, sid: str) -> None:
             """One live-session frame (fabric/session.py protocol): push
@@ -475,17 +556,232 @@ def _make_handler(app: ServeApp):
             self.end_headers()
             self.wfile.write(png)
 
+        def _read_body(self) -> bytes:
+            n = int(self.headers.get("Content-Length", "0"))
+            return self.rfile.read(n)
+
+        def _graph_refusal(self, e, trace_id: str) -> None:
+            """One closed-taxonomy refusal (graph/spec.SpecError) as
+            structured JSON: {code, error, trace_id} — the 422-quarantine
+            contract extended to every pipeline-service refusal (unknown
+            pipeline/tenant included; the old bare-404 shape is gone)."""
+            http = 404 if e.code in ("unknown-pipeline", "unknown-tenant") \
+                else 400 if e.code in ("bad-image", "bad-json") else 422
+            self._send_json(
+                http,
+                {
+                    "status": "rejected",
+                    "code": e.code,
+                    "error": str(e),
+                    **({"trace_id": trace_id} if trace_id else {}),
+                },
+                [("X-Trace-Id", trace_id)] if trace_id else [],
+            )
+
+        def _handle_graph_register(self) -> None:
+            """POST /v1/pipelines: {"tenant": ..., "spec": {...}} (or the
+            spec itself with the tenant in X-MCIM-Tenant). Malformed
+            specs are ALWAYS 4xx with a taxonomy code — never 500."""
+            import json as _json
+
+            from mpi_cuda_imagemanipulation_tpu.graph.service import (
+                HDR_TENANT,
+            )
+            from mpi_cuda_imagemanipulation_tpu.graph.spec import SpecError
+
+            data = self._read_body()
+            with obs_trace.start_trace("graph.register") as root:
+                tid = root.trace_id
+                try:
+                    try:
+                        payload = _json.loads(data or b"null")
+                    except ValueError as e:
+                        raise SpecError(
+                            "bad-json", f"body is not JSON: {e}"
+                        ) from None
+                    if not isinstance(payload, dict):
+                        raise SpecError(
+                            "bad-root", "registration body must be an object"
+                        )
+                    spec = payload.get("spec", payload)
+                    tenant = (
+                        payload.get("tenant")
+                        or self.headers.get(HDR_TENANT)
+                        or "default"
+                    )
+                    result = app.graph_service.register(tenant, spec)
+                except SpecError as e:
+                    root.set(code=e.code)
+                    self._graph_refusal(e, tid)
+                    return
+                self._send_json(
+                    200,
+                    {**result, **({"trace_id": tid} if tid else {})},
+                    [("X-Trace-Id", tid)] if tid else [],
+                )
+
+        def _handle_tenant_config(self) -> None:
+            """POST /v1/tenants: QoS class + quota configuration."""
+            import json as _json
+
+            from mpi_cuda_imagemanipulation_tpu.graph.spec import SpecError
+
+            data = self._read_body()
+            try:
+                try:
+                    payload = _json.loads(data or b"null")
+                except ValueError as e:
+                    raise SpecError(
+                        "bad-json", f"body is not JSON: {e}"
+                    ) from None
+                result = app.graph_service.configure_tenant(payload)
+            except SpecError as e:
+                self._graph_refusal(e, "")
+                return
+            self._send_json(200, result)
+
+        def _handle_graph_process(
+            self, tenant: str, pipeline_id: str
+        ) -> None:
+            """One pipeline-tagged /v1/process request: tenant-admitted
+            graph dispatch, image + side outputs in ONE response (side
+            outputs ride X-MCIM-Histogram / X-MCIM-Stats JSON headers)."""
+            import json as _json
+
+            from mpi_cuda_imagemanipulation_tpu.graph.service import (
+                HDR_HISTOGRAM,
+                HDR_STATS,
+            )
+            from mpi_cuda_imagemanipulation_tpu.graph.spec import SpecError
+            from mpi_cuda_imagemanipulation_tpu.graph.tenancy import (
+                GraphShed,
+            )
+            from mpi_cuda_imagemanipulation_tpu.io.image import (
+                decode_image_bytes,
+                encode_image_bytes,
+            )
+
+            data = self._read_body()
+            if not app.health.is_admitting():
+                self._send_json(
+                    503,
+                    {"status": app.health.state, "error": "not admitting"},
+                    [("Retry-After", "1")],
+                )
+                return
+            root = obs_trace.start_trace(
+                "graph.request", tenant=tenant, pipeline=pipeline_id,
+                trace_id=self.headers.get("X-Trace-Id") or None,
+            )
+            tid = root.trace_id
+            trace_hdr = [("X-Trace-Id", tid)] if tid else []
+            try:
+                try:
+                    img = decode_image_bytes(data)
+                except Exception as e:
+                    app.graph_service.on_reject("bad-image")
+                    raise SpecError(
+                        "bad-image", f"undecodable image: {e}"
+                    ) from None
+                out = app.graph_service.process(
+                    tenant, pipeline_id, img, nbytes=len(data),
+                    trace_id=tid,
+                )
+            except SpecError as e:
+                root.set(status="rejected", code=e.code)
+                self._graph_refusal(e, tid)
+                return
+            except GraphShed as e:
+                # an explicit shed — "come back later", never an error:
+                # 503 + Retry-After, the same contract the router's
+                # loadgen accounting reads as shed (serve/loadgen.py)
+                root.set(status="shed", reason=e.reason)
+                self._send_json(
+                    503,
+                    {
+                        "status": "shed",
+                        "reason": e.reason,
+                        "error": str(e),
+                        **({"trace_id": tid} if tid else {}),
+                    },
+                    [("Retry-After",
+                      str(max(1, int(round(e.retry_after_s)))))]
+                    + trace_hdr,
+                )
+                return
+            except Exception as e:
+                root.set(status="error")
+                self._send_json(
+                    500,
+                    {
+                        "status": "error",
+                        "error": f"graph dispatch failed: {e}",
+                        **({"trace_id": tid} if tid else {}),
+                    },
+                    trace_hdr,
+                )
+                return
+            finally:
+                root.end()
+            png = encode_image_bytes(out["image"])
+            self.send_response(200)
+            self.send_header("Content-Type", "image/png")
+            self.send_header("Content-Length", str(len(png)))
+            if "histogram" in out:
+                self.send_header(
+                    HDR_HISTOGRAM, _json.dumps(out["histogram"])
+                )
+            if "stats" in out:
+                self.send_header(HDR_STATS, _json.dumps(out["stats"]))
+            for k, v in trace_hdr:
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(png)
+
         def do_POST(self):  # noqa: N802
-            if self.path != "/v1/process":
+            from urllib.parse import parse_qs, urlsplit
+
+            from mpi_cuda_imagemanipulation_tpu.graph.service import (
+                HDR_PIPELINE,
+                HDR_TENANT,
+                PIPELINES_PATH,
+                TENANTS_PATH,
+            )
+
+            split = urlsplit(self.path)
+            path = split.path
+            query = parse_qs(split.query)
+            if path == PIPELINES_PATH:
+                self._handle_graph_register()
+                return
+            if path == TENANTS_PATH:
+                self._handle_tenant_config()
+                return
+            if path != "/v1/process":
                 from mpi_cuda_imagemanipulation_tpu.fabric import (
                     session as fabric_session,
                 )
 
-                route = fabric_session.parse_session_path(self.path)
+                route = fabric_session.parse_session_path(path)
                 if route is not None:
                     self._handle_session_frame(route[0])
                     return
-                self._send_json(404, {"error": f"no route {self.path}"})
+                self._send_json(
+                    404,
+                    {"code": "unknown-route", "error": f"no route {path}"},
+                )
+                return
+            tenant = (
+                self.headers.get(HDR_TENANT)
+                or (query.get("tenant") or [""])[0]
+            )
+            pipeline = (
+                self.headers.get(HDR_PIPELINE)
+                or (query.get("pipeline") or [""])[0]
+            )
+            if pipeline:
+                # pipeline-tagged: the graph service's dispatch path
+                self._handle_graph_process(tenant or "default", pipeline)
                 return
             from mpi_cuda_imagemanipulation_tpu.io.image import (
                 decode_image_bytes,
@@ -504,8 +800,7 @@ def _make_handler(app: ServeApp):
                 )
                 return
             try:
-                n = int(self.headers.get("Content-Length", "0"))
-                data = self.rfile.read(n)
+                data = self._read_body()
                 img = decode_image_bytes(data)
             except Exception as e:
                 # count as submitted+rejected so the accounting invariant
@@ -522,6 +817,9 @@ def _make_handler(app: ServeApp):
                 # the router made the sampling decision; this replica's
                 # serve.request root joins that trace)
                 trace_id=self.headers.get("X-Trace-Id") or None,
+                # a known tenant's chain traffic admits under its QoS
+                # class (graph/tenancy ladder — low classes shed first)
+                qos=app.tenant_qos(tenant),
             )
             req.done.wait()
             # the trace id rides the response either way, so a slow or

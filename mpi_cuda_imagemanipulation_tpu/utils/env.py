@@ -262,6 +262,36 @@ _VARS = (
     EnvVar("MCIM_PLAN_AB_JSON", None, "tests/test_plan.py",
            "CI: write the plan_ab lane record to this path (uploaded as "
            "an artifact)."),
+    # -- pipeline service (graph/) -------------------------------------------
+    EnvVar("MCIM_GRAPH_MAX_NODES", "64", "graph/spec.py",
+           "Node-count cap on POSTed pipeline specs (a hostile spec is "
+           "refused with the closed `too-large` taxonomy code, never "
+           "traced)."),
+    EnvVar("MCIM_GRAPH_MAX_TENANTS", "64", "graph/tenancy.py",
+           "Tenant-registry cap: tenant ids are metric labels, so the "
+           "tenant set must be bounded (`tenant-limit` refusal past it)."),
+    EnvVar("MCIM_GRAPH_CACHE_CAP", "8", "graph/tenancy.py",
+           "Per-tenant compile-cache namespace cap (LRU entries): a "
+           "tenant registering pipelines without bound recycles its own "
+           "slots (the PR 8 bucket-cardinality-cap pattern)."),
+    EnvVar("MCIM_GRAPH_QOS_SHED_FRAC", "0.5", "graph/tenancy.py",
+           "Load fraction past which batch-class tenants shed (standard "
+           "sheds halfway between this and 1; interactive rides to full "
+           "capacity) — honored by both the graph service and the "
+           "serving scheduler's qos= admission."),
+    EnvVar("MCIM_GRAPH_QUOTA_WINDOW_S", "1.0", "graph/tenancy.py",
+           "Default fixed quota window in seconds for per-tenant "
+           "request/byte budgets (tenant config can override per "
+           "tenant)."),
+    EnvVar("MCIM_GRAPH_MAX_INFLIGHT", "8", "graph/service.py",
+           "Concurrent graph dispatches per replica; past it even "
+           "interactive traffic sheds with 503 + Retry-After."),
+    EnvVar("MCIM_GRAPH_TENANTS", None, "bench_suite.py",
+           "graph_loadgen lane: tenant-count override (--tenants flag "
+           "works too)."),
+    EnvVar("MCIM_GRAPH_AB_JSON", None, "tests/test_graph.py",
+           "CI: write the graph_loadgen lane record to this path "
+           "(uploaded as an artifact)."),
     # -- bench driver (bench.py, repo root) ----------------------------------
     EnvVar("MCIM_NO_HISTORY", None, "bench.py",
            "Any non-empty value: do not append promoted records to "

@@ -1,0 +1,845 @@
+"""Pipelines as data (graph/) — the ISSUE-13 acceptance suite.
+
+The load-bearing invariants:
+  1. hostile/malformed specs ALWAYS refuse with a closed-taxonomy
+     SpecError (4xx-class) — never any other exception (never a 500);
+  2. a DAG that happens to be a linear chain is bit-identical to the
+     chain path, and its `dag_fingerprint` IS the chain's
+     `pipeline_fingerprint` (cache/calibration keys carry over);
+  3. merge combinators follow their golden semantics exactly;
+  4. shared prefixes are computed ONCE per dispatch (fan-out taps
+     materialize one value no matter how many branches read it);
+  5. tenancy: quota windows shed with Retry-After, the QoS ladder sheds
+     low classes FIRST (graph service AND chain scheduler), and each
+     tenant's compile-cache namespace is cardinality-bounded.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mpi_cuda_imagemanipulation_tpu.graph import (
+    compile_graph,
+    dag_fingerprint,
+    graph_callable,
+    parse_spec,
+)
+from mpi_cuda_imagemanipulation_tpu.graph.spec import (
+    TAXONOMY,
+    SpecError,
+    chain_as_spec,
+)
+from mpi_cuda_imagemanipulation_tpu.graph.tenancy import (
+    GraphShed,
+    TenantRegistry,
+    qos_admit_frac,
+)
+from mpi_cuda_imagemanipulation_tpu.io.image import synthetic_image
+from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline
+from mpi_cuda_imagemanipulation_tpu.plan.ir import pipeline_fingerprint
+
+UNSHARP_SPEC = {
+    "version": 1,
+    "name": "unsharp",
+    "nodes": [
+        {"id": "src", "kind": "source"},
+        {"id": "g", "kind": "op", "op": "grayscale", "input": "src"},
+        {"id": "blur", "kind": "op", "op": "gaussian:5", "input": "g"},
+        {"id": "mask", "kind": "merge", "merge": "subtract",
+         "inputs": ["g", "blur"]},
+    ],
+    "outputs": {"image": "mask", "histogram": "mask", "stats": "mask"},
+}
+
+
+def _jit(program, **kw):
+    import jax
+
+    return jax.jit(graph_callable(program, **kw))
+
+
+# --------------------------------------------------------------------------
+# spec schema + closed taxonomy
+# --------------------------------------------------------------------------
+
+
+def test_parse_unsharp_spec_structure():
+    g = parse_spec(UNSHARP_SPEC)
+    assert [n.id for n in g.nodes] == ["src", "g", "blur", "mask"]
+    assert g.consumers["g"] == 2  # the implicit fan-out tap
+    assert g.outputs == {"image": "mask", "histogram": "mask",
+                         "stats": "mask"}
+    assert g.as_linear_chain() is None
+    prog = compile_graph(g)
+    assert prog.n_segments == 2 and prog.n_merges == 1
+
+
+@pytest.mark.parametrize(
+    "spec,code",
+    [
+        (b"\xff\xfe not json", "bad-json"),
+        (b"[1, 2]", "bad-root"),
+        ({"version": 99, "nodes": [], "outputs": {}}, "bad-version"),
+        ({"version": 1, "nodes": [], "outputs": {}}, "bad-nodes"),
+        ({"version": 1, "bogus": 1, "nodes": [], "outputs": {}},
+         "unknown-field"),
+        ({"version": 1, "name": ["x"], "nodes": [], "outputs": {}},
+         "bad-name"),
+        ({"version": 1, "nodes": [{"id": "s!", "kind": "source"}],
+          "outputs": {}}, "bad-node-id"),
+        ({"version": 1,
+          "nodes": [{"id": "s", "kind": "source"},
+                    {"id": "s", "kind": "source"}],
+          "outputs": {}}, "duplicate-node"),
+        ({"version": 1, "nodes": [{"id": "s", "kind": "wat"}],
+          "outputs": {}}, "unknown-kind"),
+        ({"version": 1,
+          "nodes": [{"id": "a", "kind": "op", "op": "invert",
+                     "input": "a"}],
+          "outputs": {"image": "a"}}, "no-source"),
+        ({"version": 1,
+          "nodes": [{"id": "s", "kind": "source"},
+                    {"id": "t", "kind": "source"}],
+          "outputs": {"image": "s"}}, "multi-source"),
+        ({"version": 1,
+          "nodes": [{"id": "s", "kind": "source"},
+                    {"id": "x", "kind": "op", "op": "zzz", "input": "s"}],
+          "outputs": {"image": "x"}}, "unknown-op"),
+        ({"version": 1,
+          "nodes": [{"id": "s", "kind": "source"},
+                    {"id": "x", "kind": "op", "op": "gaussian:999",
+                     "input": "s"}],
+          "outputs": {"image": "x"}}, "bad-op-arg"),
+        ({"version": 1,
+          "nodes": [{"id": "s", "kind": "source"},
+                    {"id": "x", "kind": "op", "op": "rot90",
+                     "input": "s"}],
+          "outputs": {"image": "x"}}, "unservable-op"),
+        ({"version": 1,
+          "nodes": [{"id": "s", "kind": "source"},
+                    {"id": "m", "kind": "merge", "merge": "xor",
+                     "inputs": ["s", "s"]}],
+          "outputs": {"image": "m"}}, "unknown-merge"),
+        ({"version": 1,
+          "nodes": [{"id": "s", "kind": "source"},
+                    {"id": "m", "kind": "merge", "merge": "blend",
+                     "inputs": ["s"]}],
+          "outputs": {"image": "m"}}, "bad-merge-arity"),
+        ({"version": 1,
+          "nodes": [{"id": "s", "kind": "source"},
+                    {"id": "m", "kind": "merge",
+                     "merge": "alpha_composite", "inputs": ["s", "s"],
+                     "alpha": 7}],
+          "outputs": {"image": "m"}}, "bad-merge-arg"),
+        ({"version": 1,
+          "nodes": [{"id": "s", "kind": "source"},
+                    {"id": "x", "kind": "op", "op": "invert",
+                     "input": "ghost"}],
+          "outputs": {"image": "x"}}, "unknown-input"),
+        ({"version": 1,
+          "nodes": [{"id": "s", "kind": "source"},
+                    {"id": "a", "kind": "op", "op": "invert",
+                     "input": "b"},
+                    {"id": "b", "kind": "op", "op": "invert",
+                     "input": "a"}],
+          "outputs": {"image": "b"}}, "graph-cycle"),
+        ({"version": 1,
+          "nodes": [{"id": "s", "kind": "source"},
+                    {"id": "a", "kind": "op", "op": "invert",
+                     "input": "s"},
+                    {"id": "b", "kind": "op", "op": "invert",
+                     "input": "s"}],
+          "outputs": {"image": "a"}}, "dangling-node"),
+        ({"version": 1,
+          "nodes": [{"id": "s", "kind": "source"},
+                    {"id": "g", "kind": "op", "op": "grayscale",
+                     "input": "s"},
+                    {"id": "g2", "kind": "op", "op": "grayscale",
+                     "input": "g"}],
+          "outputs": {"image": "g2"}}, "channel-mismatch"),
+        ({"version": 1, "nodes": [{"id": "s", "kind": "source"}],
+          "outputs": {}}, "no-output"),
+        ({"version": 1, "nodes": [{"id": "s", "kind": "source"}],
+          "outputs": {"thumbnail": "s"}}, "unknown-output"),
+        ({"version": 1,
+          "nodes": [{"id": "s", "kind": "source"}] + [
+              {"id": f"n{i}", "kind": "op", "op": "invert",
+               "input": "s" if i == 0 else f"n{i - 1}"}
+              for i in range(200)
+          ],
+          "outputs": {"image": "n199"}}, "too-large"),
+    ],
+)
+def test_malformed_specs_refuse_with_taxonomy_code(spec, code):
+    with pytest.raises(SpecError) as ei:
+        parse_spec(spec)
+    assert ei.value.code == code
+    assert ei.value.code in TAXONOMY
+
+
+def test_spec_fuzz_never_escapes_the_taxonomy():
+    """Seeded structural fuzz: random mutations of a valid spec must
+    either parse or refuse with a SpecError — NEVER any other exception
+    (the no-500 contract at the validation layer)."""
+    rng = np.random.default_rng(7)
+    junk = [None, 0, -1, 3.5, "", "x", [], {}, True, "src", ["src"],
+            {"a": 1}, "gaussian:5", 1e308]
+
+    def mutate(obj):
+        obj = json.loads(json.dumps(obj))  # deep copy
+        for _ in range(int(rng.integers(1, 4))):
+            roll = rng.integers(6)
+            nodes = obj.get("nodes") if isinstance(obj, dict) else None
+            if roll == 0 and isinstance(obj, dict) and obj:
+                obj.pop(list(obj)[int(rng.integers(len(obj)))], None)
+            elif roll == 1 and isinstance(obj, dict):
+                obj[str(rng.integers(100))] = junk[
+                    int(rng.integers(len(junk)))
+                ]
+            elif roll == 2 and isinstance(nodes, list) and nodes:
+                nodes[int(rng.integers(len(nodes)))] = junk[
+                    int(rng.integers(len(junk)))
+                ]
+            elif roll == 3 and isinstance(nodes, list) and nodes:
+                nd = nodes[int(rng.integers(len(nodes)))]
+                if isinstance(nd, dict) and nd:
+                    key = list(nd)[int(rng.integers(len(nd)))]
+                    nd[key] = junk[int(rng.integers(len(junk)))]
+            elif roll == 4 and isinstance(obj, dict):
+                obj["outputs"] = junk[int(rng.integers(len(junk)))]
+            elif roll == 5 and isinstance(nodes, list):
+                nodes.append(
+                    {"id": "dup", "kind": "op", "op": "invert",
+                     "input": "src"}
+                )
+        return obj
+
+    parsed = refused = 0
+    for _ in range(300):
+        mutated = mutate(UNSHARP_SPEC)
+        try:
+            parse_spec(mutated)
+            parsed += 1
+        except SpecError as e:
+            assert e.code in TAXONOMY
+            refused += 1
+    assert refused > 50  # the fuzz actually bites
+    assert parsed + refused == 300
+
+
+def test_spec_error_refuses_unregistered_codes():
+    with pytest.raises(KeyError):
+        SpecError("not-a-real-code", "x")
+
+
+# --------------------------------------------------------------------------
+# fingerprints: chain keys carry over
+# --------------------------------------------------------------------------
+
+
+def test_linear_dag_fingerprint_is_the_chain_fingerprint():
+    ops = "grayscale,contrast:3.5,emboss:3"
+    g = parse_spec(chain_as_spec(ops))
+    chain = g.as_linear_chain()
+    assert chain is not None
+    assert dag_fingerprint(g) == pipeline_fingerprint(
+        Pipeline.parse(ops).ops
+    )
+    # a true DAG gets the dag- namespace, never colliding with chains
+    g2 = parse_spec(UNSHARP_SPEC)
+    assert dag_fingerprint(g2).startswith("dag-")
+
+
+def test_dag_fingerprint_sensitive_to_structure():
+    a = parse_spec(UNSHARP_SPEC)
+    blended = json.loads(json.dumps(UNSHARP_SPEC))
+    blended["nodes"][3]["merge"] = "blend"
+    b = parse_spec(blended)
+    assert dag_fingerprint(a) != dag_fingerprint(b)
+
+
+# --------------------------------------------------------------------------
+# bit-exactness: degenerate DAG == chain, merge goldens
+# --------------------------------------------------------------------------
+
+# a pool mixing pointwise runs, stencils of several edge modes, and a
+# global-stat barrier — the plan/ property-test discipline
+_CHAIN_POOL = (
+    "grayscale", "contrast:3.5", "invert", "gaussian:5", "sharpen",
+    "median:3", "quantize:6", "emboss:3", "equalize", "solarize:100",
+)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_degenerate_dag_bit_identical_to_chain(seed):
+    rng = np.random.default_rng(seed)
+    names = list(
+        rng.choice(_CHAIN_POOL, size=int(rng.integers(2, 5)), replace=False)
+    )
+    if "grayscale" in names:  # 3->1 op must come first to chain channels
+        names.remove("grayscale")
+        names.insert(0, "grayscale")
+    if "equalize" in names and "grayscale" not in names:
+        names.insert(0, "grayscale")  # global-stat ops are 1-channel
+    ops = ",".join(names)
+    pipe = Pipeline.parse(ops)
+    g = parse_spec(chain_as_spec(ops))
+    img = synthetic_image(39 + seed, 52 + 3 * seed, channels=3, seed=seed)
+    golden = np.asarray(pipe.jit()(img))
+    for mode in ("off", "fused"):
+        prog = compile_graph(g, plan=mode)
+        out = _jit(prog)(img)
+        np.testing.assert_array_equal(np.asarray(out["image"]), golden)
+
+
+def _merge_graph(comb: str, **extra) -> dict:
+    return {
+        "version": 1,
+        "nodes": [
+            {"id": "src", "kind": "source"},
+            {"id": "b", "kind": "op", "op": "invert", "input": "src"},
+            {"id": "m", "kind": "merge", "merge": comb,
+             "inputs": ["src", "b"], **extra},
+        ],
+        "outputs": {"image": "m"},
+    }
+
+
+@pytest.mark.parametrize("channels", [1, 3])
+def test_merge_combinator_goldens(channels):
+    """Each combinator against its independent numpy formula: subtract =
+    clamp(a-b), blend = round-half-even((a+b)/2), alpha_composite =
+    round((a*k + b*(256-k))/256) with k = round(alpha*256)."""
+    img = synthetic_image(24, 31, channels=channels, seed=9)
+    a = img.astype(np.int64)
+    b = (255 - img).astype(np.int64)  # invert of exact u8 is exact
+
+    def rint(x):
+        return np.clip(
+            np.rint(x).astype(np.int64), 0, 255
+        ).astype(np.uint8)
+
+    expected = {
+        "subtract": np.clip(a - b, 0, 255).astype(np.uint8),
+        "blend": rint((a + b) / 2.0),
+        "alpha_composite": rint((a * 64 + b * 192) / 256.0),
+    }
+    for comb, want in expected.items():
+        extra = {"alpha": 0.25} if comb == "alpha_composite" else {}
+        prog = compile_graph(parse_spec(_merge_graph(comb, **extra)))
+        out = _jit(prog)(img)
+        np.testing.assert_array_equal(np.asarray(out["image"]), want)
+
+
+def test_unsharp_mask_golden():
+    img = synthetic_image(41, 57, channels=3, seed=3)
+    gray = np.asarray(Pipeline.parse("grayscale").jit()(img))
+    blur = np.asarray(Pipeline.parse("grayscale,gaussian:5").jit()(img))
+    want = np.clip(
+        gray.astype(np.int64) - blur.astype(np.int64), 0, 255
+    ).astype(np.uint8)
+    out = _jit(compile_graph(parse_spec(UNSHARP_SPEC)))(img)
+    np.testing.assert_array_equal(np.asarray(out["image"]), want)
+
+
+# --------------------------------------------------------------------------
+# shared prefixes + side outputs
+# --------------------------------------------------------------------------
+
+
+def test_shared_prefix_computed_once():
+    """A fan-out tap's producing segment appears EXACTLY once in the
+    traced program no matter how many branches read it (the env is the
+    memo table) — counted by the trace-time on_stage hook."""
+    spec = {
+        "version": 1,
+        "nodes": [
+            {"id": "src", "kind": "source"},
+            {"id": "pre", "kind": "op", "op": "gaussian:3",
+             "input": "src"},
+            {"id": "a", "kind": "op", "op": "contrast:3.5",
+             "input": "pre"},
+            {"id": "b", "kind": "op", "op": "invert", "input": "pre"},
+            {"id": "m", "kind": "merge", "merge": "blend",
+             "inputs": ["a", "b"]},
+        ],
+        "outputs": {"image": "m"},
+    }
+    prog = compile_graph(parse_spec(spec))
+    # the shared prefix 'pre' is one segment; naive per-path evaluation
+    # would run it twice (once under each branch)
+    assert prog.n_segments == 3 and prog.n_merges == 1
+    runs: list = []
+    fn = _jit(prog, on_stage=runs.append)
+    img = synthetic_image(30, 30, channels=1, seed=1)
+    np.asarray(fn(img)["image"])
+    assert len(runs) == len(prog.steps) == 4
+    pre_runs = [
+        s for s in runs
+        if getattr(s, "dst", None) == "pre"
+    ]
+    assert len(pre_runs) == 1
+
+
+def test_side_outputs_one_dispatch():
+    img = synthetic_image(33, 47, channels=3, seed=2)
+    out = _jit(compile_graph(parse_spec(UNSHARP_SPEC)))(img)
+    im = np.asarray(out["image"])
+    hist = np.asarray(out["histogram"])
+    np.testing.assert_array_equal(
+        hist, np.bincount(im.ravel(), minlength=256)
+    )
+    stats = out["stats"]
+    assert int(stats["count"]) == im.size
+    assert int(stats["min"]) == int(im.min())
+    assert int(stats["max"]) == int(im.max())
+    assert float(stats["mean"]) == pytest.approx(float(im.mean()), abs=1e-3)
+
+
+def test_channel_validation_static_and_runtime():
+    # static: two grayscales in a row cannot chain (registration-time)
+    with pytest.raises(SpecError) as ei:
+        parse_spec(
+            chain_as_spec("grayscale,grayscale")
+        )
+    assert ei.value.code == "channel-mismatch"
+    # runtime: a 1-channel image into a grayscale-first graph
+    g = parse_spec(chain_as_spec("grayscale,contrast:3.5"))
+    with pytest.raises(SpecError) as ei:
+        g.check_channels(1)
+    assert ei.value.code == "bad-image"
+
+
+# --------------------------------------------------------------------------
+# tenancy: quotas, QoS ladder, bounded cache namespaces
+# --------------------------------------------------------------------------
+
+
+def test_quota_window_sheds_and_resets():
+    clock = [100.0]
+    reg = TenantRegistry(clock=lambda: clock[0])
+    st = reg.configure(
+        __import__(
+            "mpi_cuda_imagemanipulation_tpu.graph.tenancy",
+            fromlist=["TenantConfig"],
+        ).TenantConfig(
+            tenant_id="t", quota_requests=2, quota_bytes=1000,
+            window_s=10.0,
+        )
+    )
+    reg.admit(st, 100, 0.0)
+    reg.admit(st, 100, 0.0)
+    with pytest.raises(GraphShed) as ei:
+        reg.admit(st, 100, 0.0)
+    assert ei.value.reason == "quota"
+    assert 0 < ei.value.retry_after_s <= 10.0
+    clock[0] += 10.0  # window rolls: budget refreshed
+    reg.admit(st, 100, 0.0)
+    # byte quota inside the fresh window
+    with pytest.raises(GraphShed) as ei:
+        reg.admit(st, 950, 0.0)
+    assert ei.value.reason == "quota"
+
+
+def test_qos_ladder_sheds_low_first():
+    assert (
+        qos_admit_frac("batch", 0.5)
+        < qos_admit_frac("standard", 0.5)
+        < qos_admit_frac("interactive", 0.5)
+        == 1.0
+    )
+    from mpi_cuda_imagemanipulation_tpu.graph.tenancy import TenantConfig
+
+    reg = TenantRegistry(clock=lambda: 0.0)
+    batch = reg.configure(TenantConfig(tenant_id="b", qos="batch"))
+    inter = reg.configure(TenantConfig(tenant_id="i", qos="interactive"))
+    load = (qos_admit_frac("batch", reg.qos_shed_frac) + 1.0) / 2
+    with pytest.raises(GraphShed) as ei:
+        reg.admit(batch, 10, load)
+    assert ei.value.reason == "qos"
+    reg.admit(inter, 10, load)  # interactive rides the same load fine
+
+
+def test_tenant_config_validation_codes():
+    from mpi_cuda_imagemanipulation_tpu.graph.tenancy import TenantConfig
+
+    with pytest.raises(SpecError) as ei:
+        TenantConfig(tenant_id="bad tenant!")
+    assert ei.value.code == "bad-tenant-id"
+    with pytest.raises(SpecError) as ei:
+        TenantConfig(tenant_id="t", qos="platinum")
+    assert ei.value.code == "bad-qos"
+    with pytest.raises(SpecError) as ei:
+        TenantConfig(tenant_id="t", quota_requests=-1)
+    assert ei.value.code == "bad-quota"
+
+
+def test_cache_namespace_cardinality_bounded():
+    from mpi_cuda_imagemanipulation_tpu.graph.service import GraphService
+
+    svc = GraphService()
+    cap = svc.tenants.cache_cap
+    img = synthetic_image(16, 16, channels=1, seed=0)
+    pids = []
+    for i in range(cap + 3):
+        # distinct pipelines: vary a pointwise parameter
+        reg = svc.register(
+            "hoard", chain_as_spec(f"brightness:{i + 1}")
+        )
+        pids.append(reg["pipeline"])
+    for pid in pids:
+        svc.process("hoard", pid, img)
+    st = svc.tenants.get("hoard")
+    assert len(st.cache) <= cap
+    assert st.cache_evictions >= 3
+    # the evicted executable still serves — a rebuild-miss, not an error
+    out = svc.process("hoard", pids[0], img)
+    assert out["image"].shape == (16, 16)
+
+
+def test_graph_dispatch_failpoint_is_error_not_shed():
+    """The one genuine 500 class (device failure AFTER admission) stays
+    distinct from shed/rejected in the accounting."""
+    from mpi_cuda_imagemanipulation_tpu.graph.service import GraphService
+    from mpi_cuda_imagemanipulation_tpu.resilience import failpoints
+
+    svc = GraphService()
+    reg = svc.register("t", chain_as_spec("invert"))
+    img = synthetic_image(16, 16, channels=1, seed=0)
+    failpoints.configure("graph.dispatch=always")
+    try:
+        with pytest.raises(failpoints.FailpointError):
+            svc.process("t", reg["pipeline"], img)
+    finally:
+        failpoints.clear()
+    assert svc._m_requests.value(status="error") == 1
+    assert svc._m_requests.value(status="shed") == 0
+    svc.process("t", reg["pipeline"], img)  # cleared: healthy again
+    assert svc._m_requests.value(status="ok") == 1
+
+
+# --------------------------------------------------------------------------
+# chain-scheduler QoS admission (serve/scheduler.py)
+# --------------------------------------------------------------------------
+
+
+def test_scheduler_qos_sheds_low_class_first():
+    from mpi_cuda_imagemanipulation_tpu.serve.server import (
+        ServeApp,
+        ServeConfig,
+    )
+
+    app = ServeApp(
+        ServeConfig(
+            ops="grayscale,contrast:3.5",
+            buckets=((32, 32),),
+            channels=(3,),
+            max_batch=64,
+            max_delay_ms=10_000.0,  # nothing dispatches during the test
+            queue_depth=8,
+        )
+    ).start()
+    try:
+        img = synthetic_image(20, 20, channels=3, seed=0)
+        # fill to 4 = batch's fraction of depth (0.5 * 8)
+        held = [app.scheduler.submit(img) for _ in range(4)]
+        shed = app.scheduler.submit(img, qos="batch")
+        assert shed.status == "overloaded"
+        ok = app.scheduler.submit(img, qos="interactive")
+        assert ok.status == "ok"  # still pending, admitted
+        m = app.metrics.snapshot()
+        assert m["shed_overloaded"] == 1
+        assert app.metrics._qos_shed.value(qos="batch") == 1
+        del held
+    finally:
+        app.stop(drain=False)
+
+
+# --------------------------------------------------------------------------
+# HTTP surface (serve/server.py) + router lane (fabric/router.py)
+# --------------------------------------------------------------------------
+
+
+def _post(base, path, data, headers=None):
+    req = urllib.request.Request(
+        base + path, data=data, headers=headers or {}, method="POST"
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return r.status, dict(r.headers), r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+def test_http_pipeline_service_end_to_end():
+    from mpi_cuda_imagemanipulation_tpu.io.image import (
+        decode_image_bytes,
+        encode_image_bytes,
+    )
+    from mpi_cuda_imagemanipulation_tpu.obs.metrics import parse_exposition
+    from mpi_cuda_imagemanipulation_tpu.serve.server import (
+        ServeApp,
+        ServeConfig,
+        make_http_server,
+    )
+
+    ops = "grayscale,contrast:3.5"
+    app = ServeApp(
+        ServeConfig(
+            ops=ops, buckets=((48, 48),), channels=(3,), max_batch=2
+        )
+    ).start()
+    httpd = make_http_server(app, "127.0.0.1", 0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        code, _, out = _post(
+            base, "/v1/pipelines",
+            json.dumps({"tenant": "acme",
+                        "spec": chain_as_spec(ops)}).encode(),
+        )
+        assert code == 200, out
+        pid = json.loads(out)["pipeline"]
+        img = synthetic_image(33, 40, channels=3, seed=5)
+        blob = encode_image_bytes(img)
+        # degenerate linear DAG: byte-identical to the chain door
+        c1, _, chain_png = _post(base, "/v1/process", blob)
+        c2, _, dag_png = _post(
+            base, "/v1/process", blob,
+            {"X-MCIM-Tenant": "acme", "X-MCIM-Pipeline": pid},
+        )
+        assert (c1, c2) == (200, 200)
+        assert chain_png == dag_png
+        # side outputs in ONE dispatch (headers ride the PNG response)
+        code, _, out = _post(
+            base, "/v1/pipelines",
+            json.dumps({"tenant": "acme", "spec": UNSHARP_SPEC}).encode(),
+        )
+        upid = json.loads(out)["pipeline"]
+        c3, h3, png3 = _post(
+            base, f"/v1/process?tenant=acme&pipeline={upid}", blob
+        )
+        assert c3 == 200
+        im3 = decode_image_bytes(png3)
+        hist = json.loads(h3["X-MCIM-Histogram"])
+        assert hist == [
+            int(v) for v in np.bincount(im3.ravel(), minlength=256)
+        ]
+        assert json.loads(h3["X-MCIM-Stats"])["max"] == int(im3.max())
+        # unknown pipeline: structured 404 with the taxonomy code
+        c4, _, out4 = _post(
+            base, "/v1/process", blob,
+            {"X-MCIM-Tenant": "acme",
+             "X-MCIM-Pipeline": "dag-0000000000000000"},
+        )
+        assert c4 == 404 and json.loads(out4)["code"] == "unknown-pipeline"
+        # unknown tenant likewise
+        c5, _, out5 = _post(
+            base, "/v1/process", blob,
+            {"X-MCIM-Tenant": "nobody", "X-MCIM-Pipeline": pid},
+        )
+        assert c5 == 404 and json.loads(out5)["code"] == "unknown-tenant"
+        # malformed spec: 422 + code, never 500
+        c6, _, out6 = _post(
+            base, "/v1/pipelines",
+            json.dumps({"tenant": "acme", "spec": {"version": 1}}).encode(),
+        )
+        assert c6 == 422 and json.loads(out6)["code"] == "bad-nodes"
+        # quota exhaustion: 503 + Retry-After, counted as shed
+        _post(
+            base, "/v1/tenants",
+            json.dumps({"tenant": "smol", "qos": "batch",
+                        "quota_requests": 1, "window_s": 300.0}).encode(),
+        )
+        _post(
+            base, "/v1/pipelines",
+            json.dumps({"tenant": "smol",
+                        "spec": chain_as_spec(ops)}).encode(),
+        )
+        smol_h = {"X-MCIM-Tenant": "smol", "X-MCIM-Pipeline": pid}
+        c7a, _, _ = _post(base, "/v1/process", blob, smol_h)
+        c7b, h7b, _ = _post(base, "/v1/process", blob, smol_h)
+        assert (c7a, c7b) == (200, 503)
+        assert int(h7b["Retry-After"]) >= 1
+        svc = app.graph_service
+        assert svc._m_requests.value(status="shed") == 1
+        assert svc._m_shed.value(reason="quota") == 1
+        # exposition parses with the graph families populated
+        with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
+            fams = parse_exposition(r.read().decode())
+        for fam in (
+            "mcim_graph_requests_total",
+            "mcim_graph_rejections_total",
+            "mcim_graph_pipelines",
+            "mcim_graph_dispatch_seconds",
+        ):
+            assert fam in fams, fam
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        app.stop(drain=False)
+
+
+def test_heartbeat_carries_pipelines():
+    from mpi_cuda_imagemanipulation_tpu.fabric.control import Heartbeat
+
+    hb = Heartbeat(
+        replica_id="r0", addr="", port=1, pid=2, incarnation="x",
+        state="serving", queued=0, queue_depth=8, breaker_open=[],
+        warm_buckets=[], seq=1, sent_unix_s=0.0,
+        pipelines=["dag-abc"],
+    )
+    rt = Heartbeat.from_json(hb.to_json())
+    assert rt.pipelines == ["dag-abc"]
+    # a beat WITHOUT the field still parses (defaulted) — same-tree skew
+    # tolerance is not required, but absence of an optional field is
+    legacy = json.loads(hb.to_json())
+    legacy.pop("pipelines")
+    assert Heartbeat.from_json(
+        json.dumps(legacy).encode()
+    ).pipelines is None
+
+
+def test_router_graph_lane_affinity_and_repush():
+    """Router + one live replica runtime: registration broadcasts, the
+    graph lane forwards tenant+pipeline headers, and after a replica
+    restart the router re-pushes the stored spec before forwarding (the
+    convergence window surfaces as explicit 503+Retry-After sheds, never
+    errors)."""
+    from mpi_cuda_imagemanipulation_tpu.fabric.replica import (
+        ReplicaRuntime,
+    )
+    from mpi_cuda_imagemanipulation_tpu.fabric.router import (
+        Router,
+        RouterConfig,
+    )
+    from mpi_cuda_imagemanipulation_tpu.io.image import encode_image_bytes
+    from mpi_cuda_imagemanipulation_tpu.serve.bucketing import parse_buckets
+    from mpi_cuda_imagemanipulation_tpu.serve.server import ServeConfig
+
+    ops = "grayscale,contrast:3.5"
+    router = Router(
+        RouterConfig(buckets=parse_buckets("48"), stale_s=2.0)
+    ).start()
+    cfg = ServeConfig(
+        ops=ops, buckets=((48, 48),), channels=(3,), max_batch=2
+    )
+    rt = ReplicaRuntime("r0", router.url, cfg, heartbeat_s=0.1).start()
+    try:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not router._routable():
+            time.sleep(0.05)
+        code, _, out = _post(
+            router.url, "/v1/pipelines",
+            json.dumps({"tenant": "acme",
+                        "spec": chain_as_spec(ops)}).encode(),
+        )
+        assert code == 200
+        reg = json.loads(out)
+        assert reg["replicas"] == {"r0": 200}
+        pid = reg["pipeline"]
+        img = synthetic_image(33, 40, channels=3, seed=5)
+        blob = encode_image_bytes(img)
+        hdrs = {"X-MCIM-Tenant": "acme", "X-MCIM-Pipeline": pid}
+        c1, h1, direct = _post(
+            f"http://127.0.0.1:{rt.server.address[1]}", "/v1/process",
+            blob, hdrs,
+        )
+        c2, h2, via_router = _post(router.url, "/v1/process", blob, hdrs)
+        assert (c1, c2) == (200, 200)
+        assert direct == via_router  # the proxy is byte-transparent
+        assert h2.get("X-Fabric-Replica") == "r0"
+        # restart: fresh runtime, empty graph registry
+        rt.close()
+        rt = ReplicaRuntime(
+            "r0", router.url, cfg, heartbeat_s=0.1
+        ).start()
+        # converge: the staleness/heartbeat window may relay explicit
+        # 503+Retry-After sheds first — never an error class
+        deadline = time.monotonic() + 30
+        while True:
+            c3, h3, out3 = _post(router.url, "/v1/process", blob, hdrs)
+            if c3 == 200:
+                break
+            assert c3 == 503 and h3.get("Retry-After"), (c3, out3[:200])
+            assert time.monotonic() < deadline, "never reconverged"
+            time.sleep(0.2)
+        assert out3 == direct
+        assert router._m_graph_pushes.value() >= 1
+    finally:
+        rt.close()
+        router.close()
+
+
+# --------------------------------------------------------------------------
+# the bench lane (bit-exactness gated pre-timing)
+# --------------------------------------------------------------------------
+
+
+def test_graph_loadgen_lane_gate_and_columns():
+    """The graph_loadgen lane end to end at a tiny scale: the pre-timing
+    DAG==chain byte gate must pass, both lanes and every tenant get the
+    ok/shed/p99 columns, and the record lands at MCIM_GRAPH_AB_JSON when
+    CI asks for the artifact."""
+    from mpi_cuda_imagemanipulation_tpu.bench_suite import run_graph_loadgen
+    from mpi_cuda_imagemanipulation_tpu.utils import env as env_registry
+
+    rec = run_graph_loadgen(printer=lambda s: None, tenants=2)
+    assert rec["bit_exact_gate"].startswith("passed")
+    for lane in ("chain", "dag"):
+        r = rec["lanes"][lane]
+        assert r["submitted"] > 0
+        assert r["ok"] + r["shed"] + r["unavailable"] + r["overloaded"] \
+            >= r["ok"]
+        assert r["unavailable"] == 0
+    assert set(rec["tenants"]) == {"t0", "t1"}
+    for tr in rec["tenants"].values():
+        assert "ok_frac" in tr and "shed_frac" in tr
+    out_path = env_registry.get("MCIM_GRAPH_AB_JSON")
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(rec, f, indent=2)
+
+
+# --------------------------------------------------------------------------
+# the graph-taxonomy analysis rule (analysis/rules_obs.py)
+# --------------------------------------------------------------------------
+
+
+def test_graph_taxonomy_rule_flags_unknown_and_dynamic(tmp_path):
+    import textwrap
+
+    from mpi_cuda_imagemanipulation_tpu.analysis import core
+
+    files = {
+        f"{core.PACKAGE}/graph/spec.py": """
+            TAXONOMY = {"bad-json": "x", "never-raised": "y"}
+            class SpecError(ValueError):
+                def __init__(self, code, message):
+                    super().__init__(message)
+                    self.code = code
+        """,
+        f"{core.PACKAGE}/graph/other.py": """
+            from mpi_cuda_imagemanipulation_tpu.graph.spec import SpecError
+            def a():
+                raise SpecError("bad-json", "fine")
+            def b():
+                raise SpecError("not-registered", "unknown code")
+            def c(code):
+                raise SpecError(code, "dynamic code")
+        """,
+    }
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    findings, _repo = core.run(str(tmp_path), families=["obs"])
+    rules = {f.rule for f in findings}
+    assert "graph-taxonomy-unknown" in rules
+    assert "graph-taxonomy-dynamic" in rules
+    assert "graph-taxonomy-unused" in rules  # 'never-raised'

@@ -1,0 +1,298 @@
+#!/usr/bin/env python
+"""Pipeline-service CI smoke: a REAL pod (router + 2 replica processes)
+serving registered DAG pipelines to two tenants.
+
+    python tools/graph_smoke.py METRICS_OUT
+
+Asserts, end to end over real HTTP:
+
+  1. a spec registered at the FRONT DOOR (`POST /v1/pipelines`)
+     broadcasts to every replica, and both replicas' heartbeats report
+     the pipeline id;
+  2. an unsharp-mask DAG (branch + subtract merge + histogram/stats
+     side outputs) serves through the router from TWO tenants — the
+     response PNG matches the in-process golden executor bit for bit
+     and the X-MCIM-Histogram header matches the decoded image's
+     histogram exactly;
+  3. the degenerate linear-chain DAG's response is BYTE-IDENTICAL to
+     the baked-in chain path for the same request (the acceptance
+     contract: a chain written as a DAG is indistinguishable);
+  4. the quota tenant's over-budget requests shed with 503 +
+     Retry-After and are counted as SHED, not error (the federated
+     mcim_graph_requests_total splits prove it);
+  5. the router's /metrics parses as Prometheus exposition with the
+     mcim_fabric_graph_* and federated mcim_graph_* families populated.
+
+METRICS_OUT gets the router exposition text (uploaded as a CI artifact,
+.github/workflows/tier1.yml graph step).
+"""
+
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+from mpi_cuda_imagemanipulation_tpu.fabric.router import (  # noqa: E402
+    RouterConfig,
+)
+from mpi_cuda_imagemanipulation_tpu.fabric.supervisor import (  # noqa: E402
+    Fabric,
+    FabricConfig,
+)
+from mpi_cuda_imagemanipulation_tpu.graph import (  # noqa: E402
+    compile_graph,
+    graph_callable,
+    parse_spec,
+)
+from mpi_cuda_imagemanipulation_tpu.graph.spec import (  # noqa: E402
+    chain_as_spec,
+)
+from mpi_cuda_imagemanipulation_tpu.io.image import (  # noqa: E402
+    decode_image_bytes,
+    encode_image_bytes,
+    synthetic_image,
+)
+from mpi_cuda_imagemanipulation_tpu.obs.metrics import (  # noqa: E402
+    parse_exposition,
+)
+from mpi_cuda_imagemanipulation_tpu.serve.bucketing import (  # noqa: E402
+    parse_buckets,
+)
+
+OPS = "grayscale,contrast:3.5"
+BUCKETS = "48,96"
+
+UNSHARP = {
+    "version": 1,
+    "name": "unsharp",
+    "nodes": [
+        {"id": "src", "kind": "source"},
+        {"id": "g", "kind": "op", "op": "grayscale", "input": "src"},
+        {"id": "blur", "kind": "op", "op": "gaussian:5", "input": "g"},
+        {"id": "mask", "kind": "merge", "merge": "subtract",
+         "inputs": ["g", "blur"]},
+    ],
+    "outputs": {"image": "mask", "histogram": "mask", "stats": "mask"},
+}
+
+
+def _post(url: str, path: str, data: bytes, headers=None):
+    req = urllib.request.Request(
+        url + path, data=data, headers=headers or {}, method="POST"
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return r.status, dict(r.headers), r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+def _post_retry(url, path, data, headers=None, deadline_s=30.0):
+    """Retry explicit sheds (503 + Retry-After) — the pod converging is
+    not a failure; anything else unexpected IS."""
+    t_end = time.monotonic() + deadline_s
+    while True:
+        code, hdrs, body = _post(url, path, data, headers)
+        if code != 503 or not hdrs.get("Retry-After"):
+            return code, hdrs, body
+        assert time.monotonic() < t_end, "pod never converged past sheds"
+        time.sleep(0.2)
+
+
+def main(metrics_out: str) -> int:
+    cfg = FabricConfig(
+        replicas=2,
+        ops=OPS,
+        buckets=BUCKETS,
+        channels="3",
+        max_batch=4,
+        queue_depth=64,
+        heartbeat_s=0.2,
+        router=RouterConfig(
+            buckets=parse_buckets(BUCKETS), stale_s=2.0, forward_attempts=3
+        ),
+    )
+    img = synthetic_image(40, 44, channels=3, seed=50)
+    blob = encode_image_bytes(img)
+
+    with Fabric(cfg).start() as fab:
+        # both replicas must be ROUTABLE before the control-plane posts:
+        # broadcasts cover the live set, re-pushes cover later joiners —
+        # the smoke wants the broadcast path proven on both
+        deadline = time.monotonic() + 30.0
+        while (
+            time.monotonic() < deadline
+            and len(fab.router._routable()) < 2
+        ):
+            time.sleep(0.1)
+        assert len(fab.router._routable()) == 2, "replicas never registered"
+
+        # -- tenants: acme (standard), smol (batch + 3-request quota) ------
+        for tenant_body in (
+            {"tenant": "acme", "qos": "standard"},
+            {"tenant": "smol", "qos": "batch", "quota_requests": 3,
+             "window_s": 300.0},
+        ):
+            code, _h, out = _post(
+                fab.url, "/v1/tenants", json.dumps(tenant_body).encode()
+            )
+            assert code == 200, (code, out[:200])
+            pushed = json.loads(out)["replicas"]
+            assert len(pushed) == 2 and all(
+                v == 200 for v in pushed.values()
+            ), pushed
+
+        # -- 1. front-door registration broadcasts to every replica --------
+        pids = {}
+        for tenant in ("acme", "smol"):
+            for name, spec in (
+                ("unsharp", UNSHARP), ("chain", chain_as_spec(OPS)),
+            ):
+                code, _h, out = _post(
+                    fab.url, "/v1/pipelines",
+                    json.dumps({"tenant": tenant, "spec": spec}).encode(),
+                )
+                assert code == 200, (code, out[:300])
+                reg = json.loads(out)
+                assert len(reg["replicas"]) == 2 and all(
+                    v == 200 for v in reg["replicas"].values()
+                ), reg["replicas"]
+                pids[name] = reg["pipeline"]
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            reps = fab.http_stats()["replicas"]
+            # the stats view reflects the last heartbeat; both replicas
+            # must report both pipelines once a post-registration beat
+            # lands (Heartbeat.pipelines -> the router's re-push signal)
+            beats = [
+                v
+                for v in fab.router.table.views()
+                if set(pids.values()) <= set(v.hb.pipelines or ())
+            ]
+            if len(beats) == 2:
+                break
+            time.sleep(0.1)
+        assert len(beats) == 2, (
+            f"only {len(beats)} replicas report the registered pipelines"
+        )
+        print(
+            f"smoke: both replicas report pipelines "
+            f"{sorted(pids.values())} in their heartbeats "
+            f"({len(reps)} replicas up)"
+        )
+
+        # -- 2. unsharp DAG from two tenants, golden + histogram ------------
+        golden = np.asarray(
+            graph_callable(compile_graph(parse_spec(UNSHARP)))(img)["image"]
+        )
+        for tenant in ("acme", "smol"):
+            code, hdrs, out = _post_retry(
+                fab.url, "/v1/process", blob,
+                {"X-MCIM-Tenant": tenant,
+                 "X-MCIM-Pipeline": pids["unsharp"]},
+            )
+            assert code == 200, (tenant, code, out[:200])
+            got = decode_image_bytes(out)
+            np.testing.assert_array_equal(got, golden)
+            hist = json.loads(hdrs["X-MCIM-Histogram"])
+            want = [int(v) for v in np.bincount(got.ravel(), minlength=256)]
+            assert hist == want, "histogram side output mismatches"
+            stats = json.loads(hdrs["X-MCIM-Stats"])
+            assert stats["max"] == int(got.max()), stats
+        print(
+            "smoke: unsharp DAG served from both tenants through the "
+            "router — image golden-exact, histogram+stats side outputs "
+            "consistent"
+        )
+
+        # -- 3. linear DAG byte-identical to the chain path -----------------
+        c1, _h1, chain_png = _post_retry(fab.url, "/v1/process", blob)
+        c2, _h2, dag_png = _post_retry(
+            fab.url, "/v1/process", blob,
+            {"X-MCIM-Tenant": "acme", "X-MCIM-Pipeline": pids["chain"]},
+        )
+        assert (c1, c2) == (200, 200)
+        assert chain_png == dag_png, (
+            "linear-DAG response is not byte-identical to the chain path"
+        )
+        print(
+            f"smoke: linear-chain DAG ({pids['chain']}) byte-identical "
+            "to the --ops chain path through the fabric"
+        )
+
+        # -- 4. smol exceeds its quota: shed (503+Retry-After), not error --
+        # (affinity pins (tenant, pipeline, bucket) to one replica, so
+        # the per-replica quota window sees every request)
+        smol_h = {"X-MCIM-Tenant": "smol", "X-MCIM-Pipeline": pids["chain"]}
+        outcomes = []
+        for _ in range(5):
+            code, hdrs, _out = _post(fab.url, "/v1/process", blob, smol_h)
+            outcomes.append((code, bool(hdrs.get("Retry-After"))))
+        sheds = [o for o in outcomes if o == (503, True)]
+        oks = [o for o in outcomes if o[0] == 200]
+        # smol's step-2 unsharp request spent 1 of the budget IF its
+        # (tenant, pipeline, bucket) affinity landed on the same replica
+        # as the chain pipeline's — so 2 or 3 of the 5 admit, the rest
+        # shed finally (the router must NOT reroute a quota shed to the
+        # sibling, which would double the tenant's budget)
+        assert len(oks) in (2, 3), outcomes
+        assert len(oks) + len(sheds) == 5, outcomes
+        print(
+            f"smoke: smol's quota window shed {len(sheds)}/5 requests "
+            "with 503 + Retry-After (explicit shed, not an error)"
+        )
+
+        # -- 5. exposition: router + federated graph families ---------------
+        deadline = time.monotonic() + 30.0
+        while True:
+            exposition = fab.scrape()
+            fams = parse_exposition(exposition)
+            have_graph = "mcim_graph_requests_total" in fams
+            if have_graph:
+                samples = fams["mcim_graph_requests_total"]["samples"]
+                shed_n = sum(
+                    v for (_n, labels), v in samples.items()
+                    if 'status="shed"' in labels
+                )
+                err_n = sum(
+                    v for (_n, labels), v in samples.items()
+                    if 'status="error"' in labels
+                )
+                if shed_n >= len(sheds):
+                    break
+            assert time.monotonic() < deadline, (
+                "federated graph families never converged"
+            )
+            time.sleep(0.2)
+        assert err_n == 0, f"quota sheds were miscounted as errors ({err_n})"
+        for fam in (
+            "mcim_fabric_graph_specs",
+            "mcim_fabric_requests_total",
+            "mcim_graph_pipelines",
+            "mcim_graph_shed_total",
+        ):
+            assert fam in fams, f"{fam} missing from /metrics"
+        with open(metrics_out, "w") as f:
+            f.write(exposition)
+        print(
+            f"smoke: /metrics parses; federated graph shed={shed_n:.0f} "
+            f"error={err_n:.0f} -> {metrics_out}"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) != 2:
+        print(__doc__)
+        sys.exit(2)
+    sys.exit(main(sys.argv[1]))

@@ -1,0 +1,29 @@
+#!/bin/bash
+# Pipeline-service lane (round 7): pipelines-as-data (graph/) on real
+# hardware. The graph_loadgen lane drives ONE serving stack's two doors
+# with the same linear chain — the baked-in --ops path vs the chain
+# registered as a degenerate-DAG spec and served by pipeline id — gated
+# byte-identical BEFORE timing, so the dag column prices what the
+# pipeline service costs over the chain path on a real chip (per-request
+# jitted graph executor vs the micro-batched bucket cache). The
+# multi-tenant mix (interactive/standard/batch QoS) rides the same
+# offered load; on TPU the interesting columns are the batch tenant's
+# shed% under saturation (the admission ladder doing its job) and the
+# dag lane's p99 vs chain (dispatch-path overhead at real device
+# latencies). The graph smoke then proves the full pod contract —
+# broadcast registration, affinity forwarding, quota sheds counted as
+# sheds — against a real 2-replica pod on the chip.
+# Budget: ~5-8 min.
+set -u
+cd "$(dirname "$0")/../.."
+. tools/tpu_queue/_lib.sh
+out=artifacts/graph_r07.out
+: > "$out"
+timeout 1800 python -m mpi_cuda_imagemanipulation_tpu.bench_suite \
+  --config graph_loadgen --tenants 3 \
+  --json-metrics artifacts/graph_loadgen_r07.json >> "$out" 2>&1
+timeout 900 python tools/graph_smoke.py \
+  artifacts/graph_metrics_r07.prom >> "$out" 2>&1
+commit_artifacts "TPU window: pipeline service — graph_loadgen + pod smoke (round 7)" \
+  "$out" artifacts/graph_loadgen_r07.json artifacts/graph_metrics_r07.prom
+exit 0
