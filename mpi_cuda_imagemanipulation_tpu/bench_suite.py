@@ -326,6 +326,7 @@ MXU_AB = "mxu_ab"
 FABRIC_LOADGEN = "fabric_loadgen"
 STREAM_AB = "stream_ab"
 PLAN_AB = "plan_ab"
+MEGAKERNEL_AB = "megakernel_ab"
 GRAPH_LOADGEN = "graph_loadgen"
 
 
@@ -1611,6 +1612,161 @@ def run_plan_ab(
     return rec
 
 
+def megakernel_ab_params() -> dict:
+    """The fused-XLA-vs-fused-pallas A/B knobs: a two-stencil chain so
+    the headline stage is genuinely temporally blocked (gaussian:5 +
+    sharpen fuse behind one halo-3 stage) at 8K on real hardware, a
+    CPU-sized shape otherwise. Env overrides for tools/tpu_queue and
+    tests: MCIM_MEGAKERNEL_AB_OPS/_HEIGHT/_WIDTH."""
+    on_tpu = is_tpu_backend()
+    params = {
+        "ops": "grayscale,contrast:3.5,gaussian:5,sharpen,quantize:6",
+        "height": 4320 if on_tpu else 384,
+        "width": 7680 if on_tpu else 512,
+        "channels": 3,
+    }
+    for env, key, cast in (
+        ("MCIM_MEGAKERNEL_AB_OPS", "ops", str),
+        ("MCIM_MEGAKERNEL_AB_HEIGHT", "height", int),
+        ("MCIM_MEGAKERNEL_AB_WIDTH", "width", int),
+    ):
+        raw = env_registry.get(env)
+        if raw:
+            params[key] = cast(raw)
+    return params
+
+
+def run_megakernel_ab(
+    *,
+    json_path: str | None = None,
+    printer: Callable[[str], None] = print,
+) -> dict:
+    """Fused-XLA vs fused-pallas megakernel bench lane (plan/pallas_exec):
+
+      * off          — `--plan off`, the per-op golden reference;
+      * fused        — the PR-10 fused-XLA stage walker (the incumbent
+                       this lane must beat on silicon);
+      * fused_pallas — each eligible stage as ONE VMEM-resident
+                       megakernel (`--plan fused-pallas`).
+
+    Every lane is gated bit-identical to the golden per-op chain on
+    three odd shapes BEFORE any timing (the plan_ab/mxu_ab discipline).
+    Off-TPU the fused_pallas lane times the Pallas INTERPRETER — the
+    committed CPU record is the gate + regression anchor, never a perf
+    claim; tools/tpu_queue/29_megakernel_r07.sh carries the on-chip A/B.
+    The record also reports the per-stage eligibility verdicts, so a
+    silent everything-fell-back run is visible in the JSON."""
+    import numpy as np
+
+    from mpi_cuda_imagemanipulation_tpu.plan import build_plan
+    from mpi_cuda_imagemanipulation_tpu.plan.exec import plan_callable
+    from mpi_cuda_imagemanipulation_tpu.plan.pallas_exec import (
+        plan_callable_pallas,
+        stage_pallas_reject,
+    )
+
+    p = megakernel_ab_params()
+    pipe = Pipeline.parse(p["ops"])
+    c = p["channels"]
+    plans = {
+        "fused": build_plan(pipe.ops, "fused"),
+        "fused_pallas": build_plan(pipe.ops, "fused-pallas"),
+    }
+    lanes: dict[str, Callable] = {
+        "off": pipe.jit(plan="off"),
+        "fused": jax.jit(plan_callable(plans["fused"])),
+        "fused_pallas": jax.jit(plan_callable_pallas(plans["fused_pallas"])),
+    }
+
+    # -- bit-exactness gate before any timing (vs the golden chain) --------
+    for th, tw, seed in ((48, 64, 1), (37, 200, 2), (130, 384, 3)):
+        timg = jnp.asarray(synthetic_image(th, tw, channels=c, seed=seed))
+        golden = np.asarray(pipe(timg))
+        for lane, fn in lanes.items():
+            got = np.asarray(fn(timg))
+            if not np.array_equal(got, golden):
+                raise AssertionError(
+                    f"megakernel_ab gate: lane {lane!r} mismatches golden "
+                    f"at {th}x{tw}"
+                )
+
+    img = jnp.asarray(
+        synthetic_image(p["height"], p["width"], channels=c, seed=99)
+    )
+    mp = p["height"] * p["width"] / 1e6
+    eligibility = [
+        {
+            "ops": "+".join(s.names),
+            "halo": s.halo,
+            "reject": stage_pallas_reject(s, p["height"], p["width"], c),
+        }
+        for s in plans["fused_pallas"].stages
+    ]
+    lane_recs: dict[str, dict] = {}
+    for lane, fn in lanes.items():
+        try:
+            sec = device_throughput(fn, [img])
+        except Exception as e:  # one lane failing must not kill the A/B
+            lane_recs[lane] = {"error": str(e)[:200]}
+            continue
+        lane_recs[lane] = {
+            "ms_per_iter": sec * 1e3,
+            "mp_per_s_per_chip": mp / sec,
+        }
+    ok = {k: v for k, v in lane_recs.items() if "error" not in v}
+    speedup = speedup_vs_off = None
+    if "fused" in ok and "fused_pallas" in ok:
+        speedup = ok["fused"]["ms_per_iter"] / ok["fused_pallas"]["ms_per_iter"]
+    if "off" in ok and "fused_pallas" in ok:
+        speedup_vs_off = (
+            ok["off"]["ms_per_iter"] / ok["fused_pallas"]["ms_per_iter"]
+        )
+    rec = {
+        "config": MEGAKERNEL_AB,
+        "pipeline": p["ops"],
+        "impl": "megakernel_ab",
+        "platform": jax.default_backend(),
+        "interpret_mode": not is_tpu_backend(),
+        "height": p["height"],
+        "width": p["width"],
+        "channels": c,
+        "bit_exact_gate": "passed (3 shapes x 3 lanes vs golden)",
+        "lanes": lane_recs,
+        "stage_eligibility": eligibility,
+        "megakernel_stages": sum(
+            1 for e in eligibility if e["reject"] is None
+        ),
+        "speedup_pallas_vs_fused": speedup,
+        "speedup_pallas_vs_off": speedup_vs_off,
+    }
+    if is_tpu_backend():
+        rec["tpu_gen"] = _tpu_gen()
+    printer(f"{'lane':14s} {'ms/iter':>9s} {'MP/s/chip':>11s}")
+    for lane, lr in lane_recs.items():
+        if "error" in lr:
+            printer(f"{lane:14s} ERROR {lr['error'][:80]}")
+            continue
+        printer(
+            f"{lane:14s} {lr['ms_per_iter']:9.3f} "
+            f"{lr['mp_per_s_per_chip']:11.0f}"
+        )
+    for e in eligibility:
+        printer(
+            f"  stage {e['ops']} halo={e['halo']}: "
+            + ("megakernel" if e["reject"] is None
+               else f"fallback ({e['reject']})")
+        )
+    if speedup is not None:
+        printer(
+            f"fused-pallas {speedup:.2f}x vs fused-XLA"
+            + (" (INTERPRET mode — gate record, not a perf claim)"
+               if rec["interpret_mode"] else "")
+        )
+    if json_path:
+        emit_json_metrics(rec, None if json_path == "-" else json_path)
+    return rec
+
+
 def serve_loadgen_params() -> dict:
     """The serving-lane knobs, sized to the backend: CPU keeps the sweep
     small enough for tests/dev; real hardware gets serving-sized buckets
@@ -1993,6 +2149,15 @@ def run_suite(
         records.append(run_plan_ab(json_path=json_path, printer=printer))
         if not names:
             return records
+    if names and MEGAKERNEL_AB in names:
+        # the megakernel lane compares the fused-XLA stage walker against
+        # the fused-pallas VMEM megakernel over one chain, like plan_ab
+        names = [n for n in names if n != MEGAKERNEL_AB]
+        records.append(
+            run_megakernel_ab(json_path=json_path, printer=printer)
+        )
+        if not names:
+            return records
     if names and GRAPH_LOADGEN in names:
         # the pipeline-service lane measures the graph door vs the chain
         # door of one serving stack (plus the multi-tenant mix), not one
@@ -2008,7 +2173,7 @@ def run_suite(
         if unknown:
             raise ValueError(
                 f"unknown bench config(s) {unknown}; known: "
-                f"{sorted(CONFIGS) + [ENGINE_AB, FABRIC_LOADGEN, GRAPH_LOADGEN, MXU_AB, PLAN_AB, SERVE_LOADGEN, STREAM_AB]}"
+                f"{sorted(CONFIGS) + [ENGINE_AB, FABRIC_LOADGEN, GRAPH_LOADGEN, MEGAKERNEL_AB, MXU_AB, PLAN_AB, SERVE_LOADGEN, STREAM_AB]}"
             )
         selected = [CONFIGS[n] for n in names]
     else:
@@ -2106,8 +2271,8 @@ def main(argv: Sequence[str] | None = None) -> int:
         "--config",
         required=True,
         choices=sorted(CONFIGS)
-        + [ENGINE_AB, FABRIC_LOADGEN, GRAPH_LOADGEN, MXU_AB, PLAN_AB,
-           SERVE_LOADGEN, STREAM_AB],
+        + [ENGINE_AB, FABRIC_LOADGEN, GRAPH_LOADGEN, MEGAKERNEL_AB, MXU_AB,
+           PLAN_AB, SERVE_LOADGEN, STREAM_AB],
     )
     ap.add_argument(
         "--impl",
@@ -2184,6 +2349,8 @@ def main(argv: Sequence[str] | None = None) -> int:
         )
     elif args.config == PLAN_AB:
         rec = run_plan_ab(printer=lambda s: None)
+    elif args.config == MEGAKERNEL_AB:
+        rec = run_megakernel_ab(printer=lambda s: None)
     elif args.config == GRAPH_LOADGEN:
         rec = run_graph_loadgen(
             printer=lambda s: None, tenants=args.tenants

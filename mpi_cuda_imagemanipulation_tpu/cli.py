@@ -80,16 +80,18 @@ def _add_trace_flags(sp: argparse.ArgumentParser) -> None:
 def _add_plan_flag(sp: argparse.ArgumentParser) -> None:
     sp.add_argument(
         "--plan",
-        choices=("auto", "off", "pointwise", "fused"),
+        choices=("auto", "off", "pointwise", "fused", "fused-pallas"),
         default="auto",
         help="fusion-planner execution structure (plan/): 'off' runs "
         "op-by-op (the golden reference — one HBM pass and, sharded, one "
         "ghost exchange per op); 'pointwise' absorbs pointwise runs into "
         "their neighbouring stencil's pass; 'fused' additionally "
         "temporally blocks consecutive stencils behind ONE grown-halo "
-        "exchange per stage; 'auto' consults the calibration store "
-        "(`autotune --dimension plan`), then the backend default. "
-        "Bit-identical output in every mode",
+        "exchange per stage; 'fused-pallas' lowers each eligible fused "
+        "stage into ONE VMEM-resident Pallas megakernel (one HBM read + "
+        "one write per stage; per-op fallback otherwise); 'auto' "
+        "consults the calibration store (`autotune --dimension plan`), "
+        "then the backend default. Bit-identical output in every mode",
     )
 
 
@@ -2469,6 +2471,7 @@ def _autotune_plan(args: argparse.Namespace, ops) -> int:
     from mpi_cuda_imagemanipulation_tpu.serve.padded import accepts_channels
     from mpi_cuda_imagemanipulation_tpu.utils import calibration
     from mpi_cuda_imagemanipulation_tpu.utils.log import emit_json_metrics
+    from mpi_cuda_imagemanipulation_tpu.utils.platform import is_tpu_backend
     from mpi_cuda_imagemanipulation_tpu.utils.timing import device_throughput
 
     pipe = Pipeline(list(ops))
@@ -2479,7 +2482,19 @@ def _autotune_plan(args: argparse.Namespace, ops) -> int:
     kind = calibration.current_device_kind()
     mp = args.height * args.width / 1e6
     fp = pipeline_fingerprint(ops)
-    plans = {m: build_plan(ops, m) for m in ("off", "pointwise", "fused")}
+    modes = ["off", "pointwise", "fused"]
+    # the fused-pallas lane joins the sweep only where its kernels
+    # compile (real TPU) or the operator explicitly asked for the
+    # interpreter (the same guard the block dimension uses) — an
+    # interpret-mode timing must never win a plan record
+    if is_tpu_backend() or args.allow_interpret:
+        modes.append("fused-pallas")
+    else:
+        print(
+            "fused-pallas lane skipped off-TPU (interpret-mode timings "
+            "are meaningless; pass --allow-interpret to include it)"
+        )
+    plans = {m: build_plan(ops, m) for m in modes}
     golden = np.asarray(jax.block_until_ready(pipe.jit(plan="off")(img)))
     timed: dict = {}
     for mode in plans:
@@ -2495,7 +2510,7 @@ def _autotune_plan(args: argparse.Namespace, ops) -> int:
         timed[mode] = device_throughput(fn, [img])
     choice = min(timed, key=timed.get)
     lane_mp = {k: round(mp / v, 1) for k, v in timed.items()}
-    for mode in ("off", "pointwise", "fused"):
+    for mode in modes:
         p = plans[mode]
         mark = " <- winner" if mode == choice else ""
         print(

@@ -32,10 +32,13 @@ BACKENDS = ("xla", "pallas", "swar", "mxu", "auto")
 # the fusion-planner knob on every compiled entry point (plan/planner.py):
 # 'off' = per-op golden execution; 'pointwise' absorbs pointwise runs into
 # their neighbouring stencil's pass; 'fused' additionally temporally
-# blocks consecutive stencils (one grown halo per stage); 'auto' resolves
-# per (pipeline, backend, device kind, width) through the calibration
-# store — `autotune --dimension plan` records the measured winner
-PLAN_MODES = ("auto", "off", "pointwise", "fused")
+# blocks consecutive stencils (one grown halo per stage); 'fused-pallas'
+# executes each eligible fused stage as ONE VMEM-resident Pallas
+# megakernel (plan/pallas_exec.py — intermediates never touch HBM);
+# 'auto' resolves per (pipeline, backend, device kind, width) through the
+# calibration store — `autotune --dimension plan` records the measured
+# winner, and fused-pallas enters auto routing only behind such a win
+PLAN_MODES = ("auto", "off", "pointwise", "fused", "fused-pallas")
 
 def _silence_unused_donation_warning() -> None:
     """Donation here is opportunistic: shape-changing pipelines (e.g.
@@ -96,6 +99,14 @@ class Pipeline:
         mode = resolve_plan_mode(self.ops, plan, backend=backend)
         if mode == "off":
             return None
+        if mode == "fused-pallas":
+            from mpi_cuda_imagemanipulation_tpu.plan.pallas_exec import (
+                plan_callable_pallas,
+            )
+
+            return plan_callable_pallas(
+                build_plan(self.ops, mode), impl=backend
+            )
         return plan_callable(build_plan(self.ops, mode), impl=backend)
 
     def _callable(
@@ -218,15 +229,20 @@ class Pipeline:
         exchange from the previous group's boundary outputs. Bit-identical
         output either way — the knob only changes execution structure.
 
-        `plan` (PLAN_MODES) engages the fusion planner on the 1-D runner:
-        a fused stage exchanges ONE `Stage.halo`-row ghost strip pair per
-        stage (one ppermute pair) instead of one per stencil op —
-        temporal blocking over the wire. 'auto' resolves to fused for the
-        pure-XLA/MXU backends under halo_mode='serial'; the overlap mode
-        keeps its measured per-group prefetch structure unless a plan is
-        explicitly requested (then stages run interior-first at stage
-        granularity). The 2-D tile runner keeps per-op execution (its
-        two-phase corner-carrying exchange has no stage form yet)."""
+        `plan` (PLAN_MODES) engages the fusion planner: on the 1-D
+        runner a fused stage exchanges ONE `Stage.halo`-row ghost strip
+        pair per stage (one ppermute pair) instead of one per stencil op
+        — temporal blocking over the wire — and `plan='fused-pallas'`
+        additionally streams each eligible stage through the ghost-mode
+        VMEM megakernel (plan/pallas_exec), consuming that same
+        pre-exchanged halo. On a 2-D mesh a fused stage pays ONE
+        two-phase corner-carrying exchange round for its grown halo
+        (parallel/api2d stage forms; tile compute stays XLA). 'auto'
+        resolves to fused for the pure-XLA/MXU backends under
+        halo_mode='serial'; the overlap mode keeps its measured
+        per-group prefetch structure unless a plan is explicitly
+        requested (then 1-D stages run interior-first at stage
+        granularity)."""
         if len(mesh.axis_names) == 2:
             if backend not in ("xla", "auto"):
                 raise ValueError(
@@ -249,7 +265,9 @@ class Pipeline:
                 sharded_pipeline_2d,
             )
 
-            fn = sharded_pipeline_2d(self, mesh, halo_mode=halo_mode)
+            fn = sharded_pipeline_2d(
+                self, mesh, halo_mode=halo_mode, plan=plan
+            )
         else:
             from mpi_cuda_imagemanipulation_tpu.parallel.api import (
                 sharded_pipeline,

@@ -872,6 +872,367 @@ def stencil_tile_pallas_fused(
     )[0]
 
 
+# --------------------------------------------------------------------------
+# Fused-stage megakernel (plan=fused-pallas)
+#
+# One pallas_call per fused plan Stage: the ENTIRE stage — pointwise runs,
+# MULTIPLE stencils (temporal blocking), per-op edge extension and finalize
+# — executes block-by-block with every intermediate living in VMEM/
+# registers. Where `_stream_kernel` above fuses [pointwise*, stencil] (one
+# stencil per launch, exactly-once HBM reads via a cross-step scratch
+# carry), the megakernel trades a sliver of re-read for generality: each
+# grid step reads a HALO-EXTENDED input block — the (block_h, W) main
+# block plus two sublane-aligned context strips delivered as separate
+# BlockSpec refs over the same array — and computes its output rows
+# entirely locally, so chained stencils need no cross-step delay pipeline.
+# HBM traffic per stage: one write plus one read times (1 + 2*strip/bh)
+# (~5% overlap at the default block heights); intermediates between member
+# ops NEVER touch HBM. Pallas's sequential-grid pipelining double-buffers
+# the block + strip DMAs under the previous step's compute — the
+# "software systolic" stream of PAPERS.md arxiv 1907.06154, per stage.
+#
+# The in-kernel walk mirrors plan/exec.walk_stage under the MATERIALISED
+# convention (context rows present; out-of-image rows rewritten per op
+# before that op reads them — the sharded `edge_fix` convention, proven
+# bit-exact against the pad2d golden by tests/test_plan.py): each stencil
+# rewrites the `halo` out-of-image rows its kept outputs can reach from
+# static row slices of the carry (Mosaic has no reverse/pad primitive),
+# width-extends per its own mode (`_row_identity_ext`), runs its golden
+# `valid` and finalizes at global coordinates. Deeper garbage rows feed
+# only outputs that later shrinks/crops discard — the same reachability
+# argument `_assemble_ext` documents for the single-stencil kernel.
+#
+# Two modes, one kernel:
+#   * full   — the image itself is the array; rows beyond it synthesised
+#              from the op's edge extension at the first/last blocks.
+#   * ghost  — the sharded path: the array is a (local_h + 2H, W) tile
+#              already extended by the stage's ONE ppermute ghost-strip
+#              pair (parallel/api._run_segment_planned), `y0` rides as an
+#              SMEM scalar, and edge synthesis fires only on the shards
+#              whose tile actually touches a global image edge.
+# --------------------------------------------------------------------------
+
+
+def _stage_strip_h(halo: int) -> int:
+    """Context-strip block height: sublane-aligned (multiple of 8) and
+    covering 2*halo rows, so ONE bottom strip ref serves both the full
+    mode (halo rows) and the ghost mode (2*halo rows)."""
+    return max(8, -(-(2 * halo) // 8) * 8)
+
+
+def _rewrite_rows(cur: jnp.ndarray, pieces: list, lo: int, hi: int, cond):
+    """Replace carry rows [lo, hi) with `pieces` (1-row arrays) under the
+    scalar condition `cond` — the select-merge all edge fixes share."""
+    synth = pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces, axis=0)
+    mid = jnp.where(cond, synth, cur[lo:hi])
+    out = []
+    if lo:
+        out.append(cur[:lo])
+    out.append(mid)
+    if hi < cur.shape[0]:
+        out.append(cur[hi:])
+    return out[0] if len(out) == 1 else jnp.concatenate(out, axis=0)
+
+
+def _fix_top_edge(cur: jnp.ndarray, op: StencilOp, n_above: int, cond):
+    """Synthesise the op's edge extension for the `halo` rows directly
+    above global row 0 (carry rows [n_above - k, n_above)), from static
+    slices of the carry — reflect101 reads +t, edge reads row 0,
+    zero/'interior' write the constant-0 pad2d uses. Deeper out-of-image
+    rows feed only outputs the walk's shrinks discard (module comment)."""
+    h = op.halo
+    k = min(h, n_above)
+    if k == 0:
+        return cur
+    pieces = []
+    for t in range(k, 0, -1):  # carry row n_above - t == global row -t
+        if op.edge_mode == "reflect101":
+            pieces.append(cur[n_above + t : n_above + t + 1])
+        elif op.edge_mode == "edge":
+            pieces.append(cur[n_above : n_above + 1])
+        else:  # zero / interior: constant-0 padding (finalize masks)
+            pieces.append(jnp.zeros((1, cur.shape[1]), cur.dtype))
+    return _rewrite_rows(cur, pieces, n_above - k, n_above, cond)
+
+
+def _fix_bottom_edge(cur: jnp.ndarray, op: StencilOp, r_last: int, cond):
+    """Synthesise the op's edge extension for the `halo` rows directly
+    below the image bottom, whose last real row sits at carry row
+    `r_last` (static under `cond`'s block index)."""
+    h = op.halo
+    k = min(h, cur.shape[0] - 1 - r_last)
+    if k <= 0 or r_last < h:  # infeasible reflect gated by the caller
+        return cur
+    pieces = []
+    for t in range(1, k + 1):  # carry row r_last + t == global bottom + t
+        if op.edge_mode == "reflect101":
+            pieces.append(cur[r_last - t : r_last - t + 1])
+        elif op.edge_mode == "edge":
+            pieces.append(cur[r_last : r_last + 1])
+        else:
+            pieces.append(jnp.zeros((1, cur.shape[1]), cur.dtype))
+    return _rewrite_rows(cur, pieces, r_last + 1, r_last + 1 + k, cond)
+
+
+def _stage_kernel(
+    *refs,
+    stage_ops,
+    n_in: int,
+    n_out: int,
+    block_h: int,
+    nb: int,
+    halo: int,
+    height: int,
+    width: int,
+    ghosts: bool,
+    local_h: int | None,
+    image_h: int | None,
+    image_w: int | None,
+):
+    """The megakernel body: one halo-extended block through the whole
+    stage. `height` is the array height (image height in full mode, the
+    extended tile height local_h + 2*halo in ghost mode)."""
+    H = halo
+    if ghosts:
+        y0_ref = refs[0]
+        in_refs = refs[1 : 1 + n_in]
+        tail_refs = refs[1 + n_in : 1 + 2 * n_in]
+        out_refs = refs[1 + 2 * n_in :]
+    else:
+        in_refs = refs[:n_in]
+        top_refs = refs[n_in : 2 * n_in] if H else ()
+        tail_refs = refs[2 * n_in : 3 * n_in] if H else ()
+        out_refs = refs[(3 * n_in if H else n_in) :]
+
+    i = pl.program_id(0)
+    if ghosts:
+        # edge synthesis fires only where the tile touches a global edge
+        is_top = (i == 0) & (y0_ref[0] == 0)
+        is_bot = y0_ref[0] + local_h == image_h
+        glob_h, glob_w = image_h, image_w
+        # carry row r of block i <-> local row i*block_h - H + r; the
+        # last real local row (local_h - 1) in block j's carry:
+        r_last_of = lambda j, off: (local_h - 1) - (j * block_h - (H - off))
+        y_base = y0_ref[0]
+    else:
+        is_top = i == 0
+        is_bot = True
+        glob_h, glob_w = height, width
+        r_last_of = lambda j, off: (height - 1) - (j * block_h - (H - off))
+        y_base = 0
+
+    # assemble the halo-extended f32 carry: strip tails + main block
+    planes = []
+    for p_idx in range(n_in):
+        main = in_refs[p_idx][:]
+        if H == 0:
+            planes.append(exact_f32(main))
+            continue
+        if ghosts:
+            ext = jnp.concatenate([main, tail_refs[p_idx][: 2 * H]], axis=0)
+        else:
+            top = top_refs[p_idx][:]
+            ext = jnp.concatenate(
+                [top[top.shape[0] - H :], main, tail_refs[p_idx][:H]], axis=0
+            )
+        planes.append(exact_f32(ext))
+
+    off = 0
+    for op in stage_ops:
+        if not isinstance(op, StencilOp):
+            planes = _apply_pointwise_planes(op, planes)
+            continue
+        h = op.halo
+        rows = planes[0].shape[0]
+        n_above = H - off  # carry rows above the first output-reachable row
+        new_planes = []
+        for p in planes:
+            if h:
+                if n_above:
+                    p = _fix_top_edge(p, op, n_above, is_top)
+                # bottom fixes: only the last two blocks' carries can hold
+                # rows at/past the image bottom (block_h >= 2*halo)
+                for j in (nb - 2, nb - 1):
+                    if j < 0:
+                        continue
+                    r_last = r_last_of(j, off)
+                    if 0 <= r_last < rows - 1:
+                        p = _fix_bottom_edge(p, op, r_last, (i == j) & is_bot)
+            acc = op.valid(_row_identity_ext(p, h, op.edge_mode))
+            orig = p[h : rows - h] if h else p
+            y0 = y_base + i * block_h - n_above + h
+            new_planes.append(
+                op.finalize_f32(acc, orig, y0, 0, glob_h, glob_w)
+            )
+        planes = new_planes
+        off += h
+
+    assert len(planes) == n_out, (len(planes), n_out)
+    for p_idx in range(n_out):
+        out_refs[p_idx][:] = _f32_to_u8(planes[p_idx])
+
+
+def _stage_live_f32(stage_ops) -> int:
+    """Peak live block-sized f32 temporaries per plane for the stage walk:
+    the widest member op's live set (the walk is sequential, so peaks
+    don't stack) plus the carry copies the edge-fix concats hold."""
+    live = 8
+    for op in stage_ops:
+        if isinstance(op, StencilOp):
+            live = max(live, _live_f32_temps(op))
+    return live + 4
+
+
+def fused_stage_block_h(
+    stage_ops, halo: int, width: int, n_ch: int, block_h: int | None = None
+) -> int | None:
+    """The megakernel's row-block height: the shared VMEM working-set
+    model (`_pick_block_h`, impl key 'fused-pallas' for calibration
+    overrides) rounded DOWN to the context-strip alignment. None when
+    even the minimum block busts the budget — the caller falls back to
+    the per-stage XLA walker (plan/pallas_exec counts the rejection)."""
+    S = _stage_strip_h(halo)
+    if block_h is None:
+        block_h = _pick_block_h(
+            width, n_ch, n_ch, halo, _stage_live_f32(stage_ops),
+            impl="fused-pallas",
+        )
+    bh = (block_h // S) * S
+    if bh < S or bh < 2 * halo:
+        return None
+    return bh
+
+
+def fused_stage_call(
+    stage_ops,
+    planes: list[jnp.ndarray],
+    *,
+    halo: int,
+    interpret: bool | None = None,
+    block_h: int | None = None,
+    ghosts: bool = False,
+    y0=None,
+    image_h: int | None = None,
+    image_w: int | None = None,
+) -> list[jnp.ndarray]:
+    """Execute one fused plan stage as a single streaming pallas_call.
+
+    Full mode: `planes` are (H, W) image planes; returns output planes.
+    Ghost mode: `planes` are (local_h + 2*halo, W) extended tile planes
+    (the stage's single ppermute pair already materialised), `y0` is the
+    tile's traced global row offset and `image_h`/`image_w` the true
+    image dims; returns (local_h, W) planes. Eligibility (edge-synthesis
+    feasibility, VMEM budget, kernel-safe members) is the CALLER's
+    contract — plan/pallas_exec.stage_pallas_reject gates it."""
+    H = halo
+    height, width = planes[0].shape
+    n_in = len(planes)
+    n_out = _channels_after(
+        [op for op in stage_ops if not isinstance(op, StencilOp)], n_in
+    )
+    bh = fused_stage_block_h(stage_ops, H, width, max(n_in, n_out), block_h)
+    if bh is None:
+        raise ValueError(
+            f"no feasible megakernel block height for halo {H} at width "
+            f"{width} (VMEM budget) — caller must gate on "
+            "fused_stage_block_h"
+        )
+    if interpret is None:
+        interpret = not is_tpu_backend()
+    S = _stage_strip_h(H)
+    r = bh // S
+    if ghosts:
+        local_h = height - 2 * H
+        nb = -(-local_h // bh)
+        ns = -(-height // S)
+        in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)]
+        in_specs += [
+            pl.BlockSpec((bh, width), lambda i: (i, 0), memory_space=pltpu.VMEM)
+            for _ in range(n_in)
+        ]
+        in_specs += [
+            pl.BlockSpec(
+                (S, width),
+                partial(lambda i, n, rr: (jnp.minimum(i * rr + rr, n - 1), 0),
+                        n=ns, rr=r),
+                memory_space=pltpu.VMEM,
+            )
+            for _ in range(n_in)
+        ]
+        args = [jnp.asarray(y0, jnp.int32).reshape(1)] + list(planes) * 2
+        out_rows = local_h
+    else:
+        local_h = None
+        nb = -(-height // bh)
+        ns = -(-height // S)
+        in_specs = [
+            pl.BlockSpec(
+                (bh, width),
+                partial(lambda i, n: (jnp.minimum(i, n - 1), 0), n=nb),
+                memory_space=pltpu.VMEM,
+            )
+            for _ in range(n_in)
+        ]
+        if H:
+            in_specs += [
+                pl.BlockSpec(
+                    (S, width),
+                    partial(lambda i, rr: (jnp.maximum(i * rr - 1, 0), 0),
+                            rr=r),
+                    memory_space=pltpu.VMEM,
+                )
+                for _ in range(n_in)
+            ]
+            in_specs += [
+                pl.BlockSpec(
+                    (S, width),
+                    partial(
+                        lambda i, n, rr: (jnp.minimum(i * rr + rr, n - 1), 0),
+                        n=ns, rr=r,
+                    ),
+                    memory_space=pltpu.VMEM,
+                )
+                for _ in range(n_in)
+            ]
+            args = list(planes) * 3
+        else:
+            args = list(planes)
+        out_rows = height
+    kernel = partial(
+        _stage_kernel,
+        stage_ops=tuple(stage_ops),
+        n_in=n_in,
+        n_out=n_out,
+        block_h=bh,
+        nb=nb,
+        halo=H,
+        height=height,
+        width=width,
+        ghosts=ghosts,
+        local_h=local_h,
+        image_h=image_h,
+        image_w=image_w,
+    )
+    outs = pl.pallas_call(
+        kernel,
+        grid=(nb,),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec(
+                (bh, width), lambda i: (i, 0), memory_space=pltpu.VMEM
+            )
+            for _ in range(n_out)
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((nb * bh, width), U8) for _ in range(n_out)
+        ],
+        interpret=interpret,
+        compiler_params=_COMPILER_PARAMS,
+    )(*args)
+    outs = outs if isinstance(outs, (tuple, list)) else [outs]
+    return [o[:out_rows] for o in outs]
+
+
 def pipeline_pallas(
     ops,
     img: jnp.ndarray,

@@ -579,13 +579,42 @@ def _apply_stage_overlap(stage, tile, y0, global_h, global_w, n, impl, si):
     return jnp.concatenate([top_out, interior, bottom_out], axis=0)
 
 
+def _apply_stage_megakernel(stage, tile, y0, global_h, global_w, n, si):
+    """Fused-pallas execution of one stage on a shard: the stage's ONE
+    ppermute ghost-strip pair (identical wire structure to
+    _apply_stage_serial — the HLO test counts the same
+    `plan_exchange_s<i>` scopes), then the ghost-mode megakernel streams
+    the pre-exchanged tile with every member-op intermediate resident in
+    VMEM (ops/pallas_kernels.fused_stage_call). Strips ride RAW: ring-
+    wrapped rows on the edge shards are rewritten per op inside the
+    kernel (keyed on the traced y0), the same reachability contract the
+    full-image mode documents."""
+    from mpi_cuda_imagemanipulation_tpu.plan.pallas_exec import (
+        run_stage_pallas_ext,
+    )
+
+    H = stage.halo
+    with jax.named_scope(f"plan_exchange_s{si}"):
+        top, bottom = exchange_halo_strips(tile, H, n)
+    ext = jnp.concatenate([top, tile, bottom], axis=0)
+    with jax.named_scope(f"plan_stage_pallas_s{si}"):
+        return run_stage_pallas_ext(
+            stage, ext, y0=y0, image_h=global_h, image_w=global_w
+        )
+
+
 def _run_segment_planned(
-    plan, mesh, impl: str, img: jnp.ndarray, halo_mode: str
+    plan, mesh, impl: str, img: jnp.ndarray, halo_mode: str,
+    mega: bool = False,
 ):
     """One shard_map region executed stage-by-stage from a fused plan.
     Stages the decomposition gate rejects (pad rows in the tile,
     sub-halo tiles) fall back to the per-op materialised-ext path inside
-    the same region, so the output contract is unchanged."""
+    the same region, so the output contract is unchanged. `mega` (plan
+    mode 'fused-pallas') additionally routes eligible fused stages
+    through the ghost-mode megakernel — one pallas_call consuming the
+    stage's single pre-exchanged halo — with the XLA stage walker as the
+    per-stage fallback."""
     n = mesh.shape[ROWS]
     ops = plan.ops
     # feasibility bounds come from the PER-OP fallback (legacy rule): a
@@ -607,6 +636,31 @@ def _run_segment_planned(
         jnp.pad(img, ((0, pad),) + ((0, 0),) * (img.ndim - 1)) if pad else img
     )
     overlap = halo_mode == "overlap"
+    # static per-stage megakernel eligibility (identical on every shard):
+    # the decomposition gate at overlap strength (local_h > 2H — the
+    # in-kernel edge synthesis bound) plus the Pallas eligibility matrix
+    mega_stages: set[int] = set()
+    if mega and not overlap:
+        from mpi_cuda_imagemanipulation_tpu.plan.metrics import plan_metrics
+        from mpi_cuda_imagemanipulation_tpu.plan.pallas_exec import (
+            stage_pallas_reject,
+        )
+
+        ch = img.shape[2] if img.ndim == 3 else 1
+        for si, stage in enumerate(plan.stages):
+            if stage.kind != "fused" or stage.halo < 1:
+                continue
+            if not _plan_stage_fused_ok(
+                stage, n, local_h, global_h, overlap=True
+            ):
+                plan_metrics.pallas_fallbacks.inc(reason="image-too-small")
+                continue
+            reason = stage_pallas_reject(stage, local_h, global_w, ch)
+            if reason is None:
+                plan_metrics.pallas_stages.inc()
+                mega_stages.add(si)
+            else:
+                plan_metrics.pallas_fallbacks.inc(reason=reason)
 
     def tile_fn(tile):
         y0 = lax.axis_index(ROWS) * local_h
@@ -621,6 +675,10 @@ def _run_segment_planned(
                 )
                 stats = lax.psum(op.stats(tile, valid), ROWS)
                 tile = op.apply(tile, stats)
+            elif si in mega_stages:
+                tile = _apply_stage_megakernel(
+                    stage, tile, y0, global_h, global_w, n, si
+                )
             elif _plan_stage_fused_ok(stage, n, local_h, global_h, overlap):
                 if overlap and stage.halo >= 1:
                     tile = _apply_stage_overlap(
@@ -653,7 +711,9 @@ def _run_segment_planned(
     out_spec = P(ROWS, *([None] * (len(out_shape.shape) - 1)))
     out = shard_map_compat(
         tile_fn, mesh=mesh, in_specs=in_spec, out_specs=out_spec,
-        check_vma=True,  # the planned paths are pure XLA (+ MXU einsums)
+        # the walker paths are pure XLA (+ MXU einsums); megakernel
+        # stages are pallas_calls, whose outputs carry no vma annotations
+        check_vma=not mega_stages,
     )(img_p)
     return out[:global_h]
 
@@ -941,6 +1001,7 @@ def sharded_pipeline(
             for kind, ops in segments
         ]
         impl = backend  # 'xla' | 'mxu' | 'auto' (resolver guarantees)
+        mega = plan_mode == "fused-pallas"
 
         def run_planned(img: jnp.ndarray) -> jnp.ndarray:
             from jax.sharding import NamedSharding
@@ -956,7 +1017,7 @@ def sharded_pipeline(
                     )
                 else:
                     img = _run_segment_planned(
-                        seg_plan, mesh, impl, img, halo_mode
+                        seg_plan, mesh, impl, img, halo_mode, mega=mega
                     )
             return img
 

@@ -177,7 +177,100 @@ def _min_local(pad: int, halo: int) -> int:
     return max(2 * pad + 1, pad + halo, halo, 1)
 
 
-def _run_segment_2d(ops, mesh, img: jnp.ndarray, halo_mode: str = "serial"):
+# --------------------------------------------------------------------------
+# Plan-fused stage forms (plan/): temporal blocking over BOTH mesh axes
+# --------------------------------------------------------------------------
+
+
+def _plan_stage_fused_ok_2d(
+    stage, pad_h: int, pad_w: int, local_h: int, local_w: int
+) -> bool:
+    """Whether one fused stage can run temporally blocked on this 2-D
+    decomposition: no pad rows/cols inside the tile (the per-op dynamic
+    edge fix gathers only from real data) and enough local extent on
+    BOTH axes to source the stage-halo strips — the 1-D serial gate
+    applied per axis. Static, so every shard decides identically."""
+    H = stage.halo
+    if H < 1:
+        return True  # halo-0 stages fuse with no exchange at all
+    return (
+        pad_h == 0 and pad_w == 0 and local_h > H and local_w > H
+    )
+
+
+def _plan_walk_2d(stage, ext, y0, x0, global_h: int, global_w: int):
+    """One fused stage over a (local_h + 2H, local_w + 2H[, C]) tile
+    whose four-sided context was materialised by the stage's single
+    two-phase exchange. The walk is plan/exec.walk_stage's sharded
+    convention generalized to both axes: each stencil REWRITES the
+    out-of-image rows then columns of the carry per its own edge mode
+    (`_fix_edge_axis`, row fix before column fix — the column fix's
+    sources are then row-fixed values, so global corners resolve to the
+    separable reflect-of-reflect the golden pad2d produces), runs its
+    golden `valid` over the doubly-extended carry, and finalizes at
+    global (y, x) offsets. The carry stays f32 exact-integer between
+    member ops; u8 materialises once at the stage boundary."""
+    from mpi_cuda_imagemanipulation_tpu.ops.spec import U8, exact_f32
+    from mpi_cuda_imagemanipulation_tpu.plan.exec import apply_pointwise_f32
+
+    H = stage.halo
+    cur = exact_f32(ext)
+    off = 0
+    for op in stage.ops:
+        if not isinstance(op, StencilOp):
+            cur = apply_pointwise_f32(op, cur)
+            continue
+        h = op.halo
+        # global coordinates of the carry's first row/col
+        row0 = y0 - (H - off)
+        col0 = x0 - (H - off)
+        if h:
+            cur = _fix_edge_axis(cur, op, row0 + h, global_h, 0)
+            cur = _fix_edge_axis(cur, op, col0 + h, global_w, 1)
+        rows, cols = cur.shape[0], cur.shape[1]
+
+        def plane(p, op=op, h=h, rows=rows, cols=cols, row0=row0, col0=col0):
+            acc = op.valid(p)
+            orig = p[h : rows - h, h : cols - h]
+            return op.finalize_f32(
+                acc, orig, row0 + h, col0 + h, global_h, global_w
+            )
+
+        if cur.ndim == 3:
+            cur = jnp.stack(
+                [plane(cur[..., c]) for c in range(cur.shape[2])], axis=-1
+            )
+        else:
+            cur = plane(cur)
+        off += h
+    return cur.astype(U8)
+
+
+def _apply_stage_serial_2d(
+    stage, tile, y0, x0, global_h, global_w, n_r, n_c, si
+):
+    """Temporally blocked execution of one fused stage on a 2-D tile:
+    ONE two-phase corner-carrying exchange sized to the stage's grown
+    halo — the vertical ppermute pair first, then the horizontal pair
+    carrying the vertically-extended strips so corner ghosts arrive via
+    the shared neighbour — then the whole stage walks the extended tile.
+    Where the per-op path pays one exchange round per stencil, a fused
+    stage pays one total (the `plan_exchange2d_s<i>` scope is what the
+    structural HLO test counts: exactly 4 collective-permutes per
+    halo-carrying fused stage)."""
+    H = stage.halo
+    if H == 0:
+        return _plan_walk_2d(stage, tile, y0, x0, global_h, global_w)
+    with jax.named_scope(f"plan_exchange2d_s{si}"):
+        vext = exchange_halo(tile, H, n_r, axis_name=ROWS, axis=0)
+        ext = exchange_halo(vext, H, n_c, axis_name=COLS, axis=1)
+    with jax.named_scope(f"plan_stage2d_s{si}"):
+        return _plan_walk_2d(stage, ext, y0, x0, global_h, global_w)
+
+
+def _run_segment_2d(
+    ops, mesh, img: jnp.ndarray, halo_mode: str = "serial", plan=None
+):
     n_r, n_c = mesh.shape[ROWS], mesh.shape[COLS]
     max_halo = max((op.halo for op in ops), default=0)
     global_h, global_w = img.shape[0], img.shape[1]
@@ -206,6 +299,40 @@ def _run_segment_2d(ops, mesh, img: jnp.ndarray, halo_mode: str = "serial"):
     def tile_fn(tile):
         y0 = lax.axis_index(ROWS) * local_h
         x0 = lax.axis_index(COLS) * local_w
+        if plan is not None:
+            for si, stage in enumerate(plan.stages):
+                if stage.kind == "global":
+                    op = stage.ops[0]
+                    rows = y0 + lax.iota(jnp.int32, tile.shape[0])
+                    cols = x0 + lax.iota(jnp.int32, tile.shape[1])
+                    valid = (rows < global_h)[:, None] & (
+                        cols < global_w
+                    )[None, :]
+                    valid = valid.reshape(
+                        valid.shape + (1,) * (tile.ndim - 2)
+                    )
+                    stats = lax.psum(op.stats(tile, valid), (ROWS, COLS))
+                    tile = op.apply(tile, stats)
+                elif _plan_stage_fused_ok_2d(
+                    stage, pad_h, pad_w, local_h, local_w
+                ):
+                    tile = _apply_stage_serial_2d(
+                        stage, tile, y0, x0, global_h, global_w,
+                        n_r, n_c, si,
+                    )
+                else:
+                    # per-op fallback for this stage only (pad rows /
+                    # sub-halo tiles) — the golden contract the fused
+                    # path is gated against, same rule as the 1-D runner
+                    for op in stage.ops:
+                        if isinstance(op, PointwiseOp):
+                            tile = op.fn(tile)
+                        else:
+                            tile = _apply_stencil_2d(
+                                op, tile, y0, x0, global_h, global_w,
+                                n_r, n_c,
+                            )
+            return tile
         gi = 0
         for op in ops:
             if isinstance(op, PointwiseOp):
@@ -247,7 +374,8 @@ def _run_segment_2d(ops, mesh, img: jnp.ndarray, halo_mode: str = "serial"):
     return out[:global_h, :global_w]
 
 
-def sharded_pipeline_2d(pipe, mesh, halo_mode: str = "serial"):
+def sharded_pipeline_2d(pipe, mesh, halo_mode: str = "serial",
+                        plan: str = "auto"):
     """Compile `pipe` to run tile-sharded over a ('rows', 'cols') mesh.
 
     Returns a jitted (H, W[, 3]) uint8 -> uint8 function, bit-identical to
@@ -256,19 +384,44 @@ def sharded_pipeline_2d(pipe, mesh, halo_mode: str = "serial"):
     same recipe as the 1-D runner. `halo_mode='overlap'` computes each
     eligible stencil's interior while the four ring ppermutes are in
     flight (_apply_stencil_2d_overlap); ineligible stencils (pad
-    rows/cols, halo 0, tiny tiles) stay serial, output unchanged."""
+    rows/cols, halo 0, tiny tiles) stay serial, output unchanged.
+
+    `plan` engages the fusion planner's stage forms: a fused stage pays
+    ONE two-phase corner-carrying exchange round (its grown halo, both
+    axes) instead of one round per stencil op. The 2-D tile compute is
+    XLA, so 'fused-pallas' executes its (identical) stage partition
+    through the same walker — the megakernel is the 1-D/full-image
+    specialty (see parallel/api2d scope note). `halo_mode='overlap'`
+    keeps PR 1's measured per-op interior-first structure unless a plan
+    is explicitly requested — under an explicit plan the stage forms run
+    serial (the stage exchange subsumes the per-op prefetch)."""
     from mpi_cuda_imagemanipulation_tpu.parallel.api import _split_segments
+    from mpi_cuda_imagemanipulation_tpu.plan import (
+        build_plan,
+        resolve_plan_mode,
+    )
 
     if halo_mode not in HALO_MODES:
         raise ValueError(
             f"unknown halo_mode {halo_mode!r}; known: {HALO_MODES}"
         )
+    plan_mode = resolve_plan_mode(pipe.ops, plan, backend="xla")
+    if plan_mode != "off" and halo_mode == "overlap" and plan in (
+        "auto", None, "",
+    ):
+        plan_mode = "off"  # same rule as the 1-D runner (PR 1 structure)
     segments = _split_segments(pipe.ops)
+    seg_plans = [
+        build_plan(ops, plan_mode)
+        if kind == "shard_map" and plan_mode != "off"
+        else None
+        for kind, ops in segments
+    ]
 
     def run(img: jnp.ndarray) -> jnp.ndarray:
         from jax.sharding import NamedSharding
 
-        for kind, ops in segments:
+        for (kind, ops), seg_plan in zip(segments, seg_plans):
             if kind == "xla":
                 img = ops[0].fn(img)
                 img = lax.with_sharding_constraint(
@@ -278,7 +431,9 @@ def sharded_pipeline_2d(pipe, mesh, halo_mode: str = "serial"):
                     ),
                 )
             else:
-                img = _run_segment_2d(ops, mesh, img, halo_mode=halo_mode)
+                img = _run_segment_2d(
+                    ops, mesh, img, halo_mode=halo_mode, plan=seg_plan
+                )
         return img
 
     return jax.jit(run)

@@ -43,6 +43,21 @@ class PlanMetrics:
             "Modelled whole-image HBM passes removed vs per-op execution, "
             "summed over built plans.",
         )
+        # fused-pallas backend instrumentation (plan/pallas_exec.py):
+        # decisions are made per traced shape at executable-build time,
+        # so these advance once per (re)trace, not per dispatch
+        self.pallas_stages = r.counter(
+            "mcim_plan_pallas_stages_total",
+            "Fused stages lowered as VMEM-resident megakernel launches "
+            "(one pallas_call per stage; plan=fused-pallas).",
+        )
+        self.pallas_fallbacks = r.counter(
+            "mcim_plan_pallas_fallbacks_total",
+            "Fused-pallas stages rejected to the XLA stage walker, by "
+            "closed reason (lut-op/no-f32-core/halo-too-large/"
+            "image-too-small/vmem-budget/barrier).",
+            labels=("reason",),
+        )
 
     def on_build(self, plan) -> None:
         self.builds.inc(mode=plan.mode)
@@ -56,9 +71,13 @@ class PlanMetrics:
             "builds_fused": int(self.builds.value(mode="fused")),
             "builds_pointwise": int(self.builds.value(mode="pointwise")),
             "builds_off": int(self.builds.value(mode="off")),
+            "builds_fused_pallas": int(
+                self.builds.value(mode="fused-pallas")
+            ),
             "stages_fused": int(self.stages.value(kind="fused")),
             "fused_ops": int(self.fused_ops.value()),
             "hbm_passes_saved": int(self.passes_saved.value()),
+            "pallas_stages": int(self.pallas_stages.value()),
         }
 
 

@@ -1,0 +1,208 @@
+"""The fused-pallas stage executor: `plan='fused-pallas'`.
+
+`plan/exec.py` walks a fused stage as ONE XLA computation — one HBM pass
+per stage, but the carry between member ops still materialises in HBM
+between the stage's internal device passes whenever XLA's fusion gives
+up (multi-stencil stages, wide live sets). This module lowers an entire
+eligible fused `Stage` into ONE `pallas_call`
+(ops/pallas_kernels.fused_stage_call): the pointwise runs, every member
+stencil, the per-op edge extension and the finalize all execute
+block-by-block with intermediates resident in VMEM/registers, and the
+HBM traffic per stage drops to one u8 read (+ a ~5% halo-strip overlap)
+plus one u8 write — the road past the 0.11 roofline fraction the
+BENCH_HISTORY plan_ab record measures for the fused-XLA plan.
+
+Gating is the package's standard backend discipline:
+
+  * bit-exactness — the megakernel reproduces `--plan off` bit for bit
+    (the in-kernel walk is the sharded `edge_fix` convention of
+    plan/exec.walk_stage, built from the same ops/spec tile functions;
+    hammered by tests/test_plan.py's fused-pallas lanes and the
+    megakernel smoke);
+  * per-op fallback — a stage the eligibility matrix rejects (LUT
+    member, oversized halo, image too small for in-kernel edge
+    synthesis, VMEM budget) runs through the XLA stage walker instead,
+    counted per reason in `mcim_plan_pallas_fallbacks_total`;
+  * measured entry — `plan='auto'` only resolves to 'fused-pallas'
+    behind a calibration win recorded by `autotune --dimension plan`
+    (utils/calibration.PLAN_CHOICES);
+  * CPU — kernels run `interpret=True` off-TPU, exactly like the
+    existing `backend='pallas'` guard rails (ops/pallas_kernels).
+
+Eligibility matrix (the docs/design.md table is rendered from this):
+
+  consumer               fused-pallas execution
+  ---------------------  -------------------------------------------
+  jit / batched / dp     megakernel per eligible stage (this module)
+  sharded serial (1-D)   ghost-mode megakernel per eligible stage —
+                         the stage's ONE ppermute pair is preserved;
+                         the kernel consumes the pre-exchanged rows
+                         (parallel/api._run_segment_planned)
+  sharded overlap        XLA stage walker (the interior-first split is
+                         a measured structure; not restructured)
+  serving (bucket pad)   XLA stage walker — dynamic true-shape borders
+                         are gather-built per op, which is exactly what
+                         a static-block Mosaic kernel cannot express;
+                         the resolved fingerprint still keys the cache
+  stream tiles           XLA stage walker — seam budgets thread across
+                         stages on the host-tiled path unchanged
+  2-D tile shards        XLA stage walker (parallel/api2d stage forms)
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from mpi_cuda_imagemanipulation_tpu.ops.registry import op_family
+from mpi_cuda_imagemanipulation_tpu.ops.spec import StencilOp
+from mpi_cuda_imagemanipulation_tpu.plan.ir import Plan, Stage
+from mpi_cuda_imagemanipulation_tpu.plan.metrics import plan_metrics
+
+# stage halos past this would make the context strips a material fraction
+# of the block read; no registry chain comes close (gaussian:7 x2 = 6)
+STAGE_MAX_HALO = 16
+
+
+def stage_pallas_reject(
+    stage: Stage, height: int, width: int, channels: int
+) -> str | None:
+    """Why this stage cannot run as a megakernel, or None when it can.
+
+    The closed reason vocabulary labels
+    `mcim_plan_pallas_fallbacks_total`; every reason maps to a fallback
+    path that is bit-exact by construction (the XLA stage walker)."""
+    if stage.kind != "fused":
+        return "barrier"
+    for op in stage.ops:
+        fam = op_family(op)
+        if fam == "pointwise":
+            if not op.kernel_safe:
+                return "lut-op"  # gather LUTs cannot lower in Mosaic
+            if (
+                op.core is None
+                and op.planes_core is None
+                and op.name != "gray2rgb"
+            ):
+                return "no-f32-core"
+        elif fam != "stencil":  # pragma: no cover - planner invariant
+            return "barrier"
+    H = stage.halo
+    if H > STAGE_MAX_HALO:
+        return "halo-too-large"
+    max_op_halo = max((op.halo for op in stage.ops), default=0)
+    # in-kernel edge synthesis feasibility: vertical reflect sources must
+    # be real rows (height > 2H covers the one-block case where both
+    # edges land in the same carry), and the width extension's reflected
+    # columns must exist
+    if H and height <= 2 * H:
+        return "image-too-small"
+    if max_op_halo and width <= max_op_halo:
+        return "image-too-small"
+    from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
+        fused_stage_block_h,
+    )
+
+    if fused_stage_block_h(stage.ops, H, width, max(channels, 1)) is None:
+        return "vmem-budget"
+    return None
+
+
+def run_stage_pallas(
+    stage: Stage,
+    img: jnp.ndarray,
+    *,
+    interpret: bool | None = None,
+    block_h: int | None = None,
+) -> jnp.ndarray:
+    """One eligible fused stage over a whole u8 image as one megakernel
+    launch (planar channel decomposition at the stage boundary, like
+    every Pallas path)."""
+    from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
+        fused_stage_call,
+    )
+
+    if img.ndim == 3:
+        planes = [img[..., c] for c in range(img.shape[2])]
+    else:
+        planes = [img]
+    outs = fused_stage_call(
+        stage.ops, planes, halo=stage.halo,
+        interpret=interpret, block_h=block_h,
+    )
+    return outs[0] if len(outs) == 1 else jnp.stack(outs, axis=-1)
+
+
+def run_stage_pallas_ext(
+    stage: Stage,
+    ext: jnp.ndarray,
+    *,
+    y0,
+    image_h: int,
+    image_w: int,
+    interpret: bool | None = None,
+    block_h: int | None = None,
+) -> jnp.ndarray:
+    """Ghost-mode megakernel over a (local_h + 2*Stage.halo, W[, C]) tile
+    whose context rows were materialised by the stage's single ppermute
+    pair (parallel/api). `y0` is the tile's traced global row offset."""
+    from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
+        fused_stage_call,
+    )
+
+    if ext.ndim == 3:
+        planes = [ext[..., c] for c in range(ext.shape[2])]
+    else:
+        planes = [ext]
+    outs = fused_stage_call(
+        stage.ops, planes, halo=stage.halo,
+        interpret=interpret, block_h=block_h,
+        ghosts=True, y0=y0, image_h=image_h, image_w=image_w,
+    )
+    return outs[0] if len(outs) == 1 else jnp.stack(outs, axis=-1)
+
+
+def plan_callable_pallas(
+    plan: Plan,
+    *,
+    impl: str = "xla",
+    interpret: bool | None = None,
+    block_h: int | None = None,
+):
+    """The full-image fused-pallas executor: an image -> image function
+    (jit/vmap it like any backend callable). Eligible fused stages run
+    as megakernels; rejected stages fall back to the shared XLA stage
+    walker (plan/exec.run_stage_full, `impl` = its accumulator routing);
+    barrier stages run their golden op. Eligibility is re-judged per
+    traced shape — the same chain can megakernel an 8K frame and walk a
+    thumbnail — and every decision is counted (mcim_plan_pallas_*)."""
+    from mpi_cuda_imagemanipulation_tpu.plan.exec import (
+        PLAN_IMPLS,
+        run_stage_full,
+    )
+
+    if impl not in PLAN_IMPLS:
+        raise ValueError(f"unknown plan impl {impl!r}; known: {PLAN_IMPLS}")
+
+    def run(img: jnp.ndarray) -> jnp.ndarray:
+        import jax
+
+        for stage in plan.stages:
+            if stage.kind in ("geometric", "global"):
+                img = stage.ops[0](img)
+                continue
+            h, w = img.shape[0], img.shape[1]
+            ch = img.shape[2] if img.ndim == 3 else 1
+            reason = stage_pallas_reject(stage, h, w, ch)
+            if reason is None:
+                plan_metrics.pallas_stages.inc()
+                with jax.named_scope("plan_stage_pallas"):
+                    img = run_stage_pallas(
+                        stage, img, interpret=interpret, block_h=block_h
+                    )
+            else:
+                plan_metrics.pallas_fallbacks.inc(reason=reason)
+                with jax.named_scope("plan_stage_fallback"):
+                    img = run_stage_full(stage, img, impl)
+        return img
+
+    return run

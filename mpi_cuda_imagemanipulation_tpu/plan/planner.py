@@ -35,9 +35,22 @@ from mpi_cuda_imagemanipulation_tpu.resilience import failpoints
 from mpi_cuda_imagemanipulation_tpu.utils import env as env_registry
 
 # the user-facing knob ('on' is an accepted alias for 'fused'); build
-# modes are the subset without 'auto'/'on'
-PLAN_MODES = ("auto", "off", "pointwise", "fused")
-BUILD_MODES = ("off", "pointwise", "fused")
+# modes are the subset without 'auto'/'on'. 'fused-pallas' partitions
+# exactly like 'fused' but executes each eligible stage as ONE
+# VMEM-resident megakernel (plan/pallas_exec.py) — a distinct build mode
+# so Plan.fingerprint (the serving compile-cache key) distinguishes the
+# two executions.
+PLAN_MODES = ("auto", "off", "pointwise", "fused", "fused-pallas")
+BUILD_MODES = ("off", "pointwise", "fused", "fused-pallas")
+
+# geometric ops that are pure pixel permutations with unchanged (H, W):
+# a per-pixel (pointwise) op commutes with them exactly —
+# p(g(x)) == g(p(x)) element for element — so the planner may hoist them
+# left past pointwise runs to merge runs a geometric barrier would
+# otherwise split (PR 10 leftover). Shape-changing permutations
+# (transpose/rot90) and interpolating ops (resize/rotate) stay barriers
+# in place; pad does too (pointwise(0) != 0 in general).
+_COMMUTE_GEOMS = ("rot180", "fliph", "flipv")
 
 # backends whose kernels carry their own measured group fusion — the
 # planner must not restructure what their in-kernel streaming already
@@ -92,17 +105,52 @@ def resolve_plan_mode(
         return calibrated
     # no measured choice: the pure-XLA/MXU executors default to fused (the
     # structural win is one-sided there); impl=auto keeps its measured
-    # Pallas group routing until a plan calibration beats it
+    # Pallas group routing until a plan calibration beats it. 'auto'
+    # NEVER defaults to fused-pallas — the megakernel enters only behind
+    # a recorded `autotune --dimension plan` win (the standard
+    # new-backend discipline).
     return "off" if backend == "auto" else "fused"
+
+
+def commute_geometrics(ops) -> tuple:
+    """Bubble commuting geometric ops (rot180/flip — pixel permutations)
+    LEFT past adjacent pointwise ops, so a permutation sandwiched between
+    pointwise runs stops splitting an otherwise-fusable stage:
+
+        pw1, rot180, pw2, stencil  ->  rot180, pw1, pw2, stencil
+
+    Each swap (pointwise, geom) -> (geom, pointwise) is bit-exact — a
+    per-pixel op composed with a pixel permutation commutes element for
+    element — so the reordered chain's output is identical (the seeded
+    property sweep in tests/test_plan.py asserts it). Stage count never
+    increases: hoisting only ever merges pointwise runs. Disable with
+    MCIM_PLAN_COMMUTE=0 (A/B escape hatch)."""
+    if not env_registry.get_bool("MCIM_PLAN_COMMUTE"):
+        return tuple(ops)
+    out = list(ops)
+    for i in range(1, len(out)):
+        if (
+            op_family(out[i]) == "geometric"
+            and out[i].name in _COMMUTE_GEOMS
+        ):
+            j = i
+            while j > 0 and op_family(out[j - 1]) == "pointwise":
+                out[j - 1], out[j] = out[j], out[j - 1]
+                j -= 1
+    return tuple(out)
 
 
 def build_plan(ops, mode: str = "fused") -> Plan:
     """Partition `ops` into execution stages per `mode` (a BUILD mode —
-    resolve 'auto' with resolve_plan_mode first)."""
+    resolve 'auto' with resolve_plan_mode first). Fusing modes first
+    hoist commuting geometric ops out of pointwise runs
+    (`commute_geometrics`); `mode='off'` keeps the user's op order — the
+    golden reference never restructures."""
     ops = tuple(ops)
     if mode not in BUILD_MODES:
         raise ValueError(f"unknown build mode {mode!r}; known: {BUILD_MODES}")
     if mode != "off":
+        ops = commute_geometrics(ops)
         # the injectable planner fault (resilience/failpoints.py): an armed
         # `plan.fuse` site fails the fusion decision loudly at build time —
         # before any executable exists — so callers' build-path error

@@ -270,6 +270,12 @@ def resolve_serving_plan(
     )
     if mode == "off":
         return None
+    # 'fused-pallas' serves through the SAME staged walker as 'fused' —
+    # the dynamic true-shape border is gather-built per op, which is
+    # exactly what a static-block Mosaic kernel cannot express
+    # (plan/pallas_exec eligibility matrix) — but it is a DISTINCT build
+    # mode, so the resolved fingerprint still keys the compile cache and
+    # an autotune flip to/from it rebuilds instead of serving stale.
     return build_plan(pipe.ops, mode)
 
 

@@ -243,7 +243,12 @@ class TileFnCache:
         self.impl = impl
         # the stream computes with XLA/MXU accumulators only (no Pallas),
         # so resolution follows the pure-XLA convention at the stream's
-        # width; 'auto' therefore defaults to fused here
+        # width; 'auto' therefore defaults to fused here. A resolved
+        # 'fused-pallas' keeps the identical stage partition but walks it
+        # with the same XLA executor — tile seams thread their (lead,
+        # tail) budget across stages on the host-tiled path, which the
+        # static-block megakernel does not model (plan/pallas_exec
+        # eligibility matrix)
         self.plan_mode = resolve_plan_mode(
             ops, plan, backend="xla" if impl == "auto" else impl,
             width=global_w,

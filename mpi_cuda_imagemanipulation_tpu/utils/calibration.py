@@ -249,7 +249,7 @@ def record_backend_choice(
 # --------------------------------------------------------------------------
 
 _PLAN_KEY = "plan_choice"
-PLAN_CHOICES = ("off", "pointwise", "fused")
+PLAN_CHOICES = ("off", "pointwise", "fused", "fused-pallas")
 
 
 def lookup_plan_choice(

@@ -262,6 +262,21 @@ _VARS = (
     EnvVar("MCIM_PLAN_AB_JSON", None, "tests/test_plan.py",
            "CI: write the plan_ab lane record to this path (uploaded as "
            "an artifact)."),
+    EnvVar("MCIM_PLAN_COMMUTE", "1", "plan/planner.py",
+           "=0 disables geometric-commute fusion (hoisting rot180/flip "
+           "pixel permutations out of pointwise runs before stage "
+           "partitioning); on by default — bit-exact either way."),
+    EnvVar("MCIM_MEGAKERNEL_AB_OPS", None, "bench_suite.py",
+           "megakernel_ab lane: pipeline override (default the "
+           "two-stencil grayscale,contrast,gaussian:5,sharpen,quantize "
+           "chain — one temporally-blocked stage)."),
+    EnvVar("MCIM_MEGAKERNEL_AB_HEIGHT", None, "bench_suite.py",
+           "megakernel_ab lane: image height override."),
+    EnvVar("MCIM_MEGAKERNEL_AB_WIDTH", None, "bench_suite.py",
+           "megakernel_ab lane: image width override."),
+    EnvVar("MCIM_MEGAKERNEL_AB_JSON", None, "tests/test_plan.py",
+           "CI: write the megakernel_ab lane record to this path "
+           "(uploaded as an artifact)."),
     # -- pipeline service (graph/) -------------------------------------------
     EnvVar("MCIM_GRAPH_MAX_NODES", "64", "graph/spec.py",
            "Node-count cap on POSTed pipeline specs (a hostile spec is "

@@ -141,3 +141,70 @@ def test_pipeline_jit_pallas_backend():
 def test_ragged_last_block_shorter_than_halo(spec, height):
     img = synthetic_image(height, 140, channels=1, seed=41)
     _assert_pallas_equals_golden(spec, img, block_h=32)
+
+
+# --------------------------------------------------------------------------
+# fused-stage megakernel (plan=fused-pallas; ops/pallas_kernels
+# fused_stage_call via plan/pallas_exec.run_stage_pallas)
+# --------------------------------------------------------------------------
+
+
+def _assert_megakernel_equals_golden(spec, img, block_h=None):
+    from mpi_cuda_imagemanipulation_tpu.ops.spec import chain_halo
+    from mpi_cuda_imagemanipulation_tpu.plan.ir import Stage
+    from mpi_cuda_imagemanipulation_tpu.plan.pallas_exec import (
+        run_stage_pallas,
+    )
+
+    pipe = Pipeline.parse(spec)
+    golden = np.asarray(pipe(jnp.asarray(img)))
+    stage = Stage("fused", pipe.ops, chain_halo(pipe.ops))
+    got = np.asarray(
+        run_stage_pallas(
+            stage, jnp.asarray(img), interpret=True, block_h=block_h
+        )
+    )
+    np.testing.assert_array_equal(got, golden)
+
+
+@pytest.mark.parametrize(
+    "spec",
+    [
+        "invert,gaussian:5,sharpen,quantize:6",  # temporally blocked pair
+        "grayscale,contrast:3.5,emboss:3",       # interior-mode finalize
+        "erode:5,dilate:3",                      # edge-mode morphology
+        "median:5,gaussian:3",                   # selection network member
+        "sobel,box:3",                           # magnitude combine member
+        "median:3,gray2rgb,sepia,gaussian:3",    # channel changes mid-stage
+    ],
+)
+def test_megakernel_stage_bitexact(spec):
+    channels = 3 if spec.startswith("grayscale") else 1
+    img = synthetic_image(97, 72, channels=channels, seed=50)
+    _assert_megakernel_equals_golden(spec, img)
+
+
+@pytest.mark.parametrize(
+    "spec,height",
+    [
+        # ragged last block with fewer real rows than the STAGE halo:
+        # the bottom edge synthesis must fire in the penultimate block's
+        # carry too (static r_last geometry per candidate block)
+        ("gaussian:5,gaussian:5", 65),   # H=4, a=1
+        ("gaussian:5,sharpen", 66),      # H=3, a=2
+        ("erode:5,dilate:5", 65),        # edge mode, H=4
+        ("emboss:5,emboss:3", 70),       # interior chain, H=3
+        ("gaussian:5,box:3", 33),        # 2 blocks, a=1 < H=3
+        ("gaussian:5,gaussian:5", 64),   # exact-multiple control
+        ("gaussian:5", 17),              # single ragged row in last block
+    ],
+)
+def test_megakernel_ragged_blocks(spec, height):
+    img = synthetic_image(height, 140, channels=1, seed=51)
+    _assert_megakernel_equals_golden(spec, img, block_h=16)
+
+
+def test_megakernel_single_block_both_edges():
+    # nb == 1: top and bottom synthesis fire in the same carry
+    img = synthetic_image(30, 64, channels=1, seed=52)
+    _assert_megakernel_equals_golden("gaussian:5,sharpen", img, block_h=32)

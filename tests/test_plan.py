@@ -53,6 +53,10 @@ from mpi_cuda_imagemanipulation_tpu.plan.exec import (
     run_unfused,
     unfused_callables,
 )
+from mpi_cuda_imagemanipulation_tpu.plan.pallas_exec import (
+    plan_callable_pallas,
+    stage_pallas_reject,
+)
 from mpi_cuda_imagemanipulation_tpu.resilience import failpoints
 from mpi_cuda_imagemanipulation_tpu.resilience.failpoints import (
     FailpointError,
@@ -333,6 +337,13 @@ def test_random_chain_fused_is_bit_identical(seed):
         assert np.array_equal(got, ref), (
             mode, [op.name for op in ops], img.shape,
         )
+    # the fused-pallas lane: same partition, megakernel execution
+    # (interpret mode on CPU) with per-op fallback where ineligible
+    plan = build_plan(ops, "fused-pallas")
+    got = np.asarray(plan_callable_pallas(plan)(img))
+    assert np.array_equal(got, ref), (
+        "fused-pallas", [op.name for op in ops], img.shape,
+    )
 
 
 if HAVE_HYPOTHESIS:
@@ -356,6 +367,10 @@ if HAVE_HYPOTHESIS:
             )
             got = np.asarray(plan_callable(plan)(img))
             assert np.array_equal(got, ref)
+        got = np.asarray(
+            plan_callable_pallas(build_plan(ops, "fused-pallas"))(img)
+        )
+        assert np.array_equal(got, ref)
 
 
 # --------------------------------------------------------------------------
@@ -523,6 +538,275 @@ def test_stream_tile_cache_plans_stay_bit_exact():
 
 
 # --------------------------------------------------------------------------
+# fused-pallas: the VMEM megakernel backend (plan/pallas_exec)
+# --------------------------------------------------------------------------
+
+
+def test_fused_pallas_resolution_and_auto_gating(calib_file):
+    """Explicit fused-pallas resolves on the XLA-family backends; 'auto'
+    NEVER routes to it without a measured calibration win; self-fusing
+    kernel backends ignore it like every other plan mode."""
+    ops = make_pipeline_ops(MIXED)
+    assert resolve_plan_mode(ops, "fused-pallas", backend="xla") == (
+        "fused-pallas"
+    )
+    assert resolve_plan_mode(ops, "fused-pallas", backend="pallas") == "off"
+    # no calibration: auto keeps the fused-XLA default
+    assert resolve_plan_mode(ops, "auto", backend="xla") == "fused"
+    # behind a recorded win, auto routes to the megakernel
+    calibration.record_plan_choice(
+        calibration.current_device_kind(),
+        pipeline_fingerprint(ops), "fused-pallas", width=512,
+    )
+    calibration._cache["key"] = None
+    assert (
+        resolve_plan_mode(ops, "auto", backend="xla", width=512)
+        == "fused-pallas"
+    )
+
+
+def test_fused_pallas_fingerprint_is_distinct():
+    ops = make_pipeline_ops(MIXED)
+    fused = build_plan(ops, "fused")
+    mega = build_plan(ops, "fused-pallas")
+    # same stage partition, distinct execution identity (the serving
+    # compile-cache key must distinguish walker from megakernel builds)
+    assert [s.names for s in fused.stages] == [s.names for s in mega.stages]
+    assert fused.fingerprint != mega.fingerprint
+
+
+def test_stage_pallas_reject_reasons():
+    plan = build_plan(make_pipeline_ops(MIXED), "fused-pallas")
+    stage = plan.stages[0]
+    assert stage_pallas_reject(stage, 256, 256, 3) is None
+    # image too small for in-kernel edge synthesis (height <= 2*halo)
+    assert stage_pallas_reject(stage, 2 * stage.halo, 256, 3) == (
+        "image-too-small"
+    )
+    # LUT members cannot lower in Mosaic
+    lut = build_plan(
+        make_pipeline_ops("gamma:2.2,gaussian:3"), "fused-pallas"
+    ).stages[0]
+    assert stage_pallas_reject(lut, 256, 256, 1) == "lut-op"
+    barrier = build_plan(
+        make_pipeline_ops("rot90"), "fused-pallas"
+    ).stages[0]
+    assert stage_pallas_reject(barrier, 256, 256, 1) == "barrier"
+
+
+def test_vmem_budget_reject_falls_back_bit_exact(monkeypatch):
+    """A stage the VMEM working-set model rejects must run through the
+    XLA walker — counted, and bit-exact."""
+    from mpi_cuda_imagemanipulation_tpu.ops import pallas_kernels
+
+    ops = make_pipeline_ops(MIXED)
+    img = img_u8(48, 64, 3, seed=13)
+    ref = golden(ops, img)
+    plan = build_plan(ops, "fused-pallas")
+    monkeypatch.setattr(
+        pallas_kernels, "fused_stage_block_h",
+        lambda *a, **k: None,
+    )
+    assert stage_pallas_reject(plan.stages[0], 48, 64, 3) == "vmem-budget"
+    snap0 = int(plan_metrics.pallas_fallbacks.value(reason="vmem-budget"))
+    got = np.asarray(plan_callable_pallas(plan)(img))
+    assert np.array_equal(got, ref)
+    assert (
+        int(plan_metrics.pallas_fallbacks.value(reason="vmem-budget"))
+        == snap0 + 1
+    )
+
+
+def test_fused_pallas_jit_batched_sharded_match_golden():
+    pipe = Pipeline.parse(MIXED)
+    img = img_u8(128, 96, 3, seed=14)
+    ref = golden(pipe.ops, img)
+    assert np.array_equal(
+        np.asarray(pipe.jit(plan="fused-pallas")(img)), ref
+    )
+    stack = jnp.stack([img, img_u8(128, 96, 3, seed=15)])
+    ref_b = np.stack([ref, golden(pipe.ops, stack[1])])
+    got = np.asarray(pipe.batched(plan="fused-pallas")(stack))
+    assert np.array_equal(got, ref_b)
+    mesh = make_mesh(4)
+    got = np.asarray(pipe.sharded(mesh, plan="fused-pallas")(img))
+    assert np.array_equal(got, ref)
+
+
+def test_sharded_fused_pallas_one_ppermute_pair_per_stage():
+    """The megakernel consumes the stage's pre-exchanged halo: the wire
+    structure is identical to the fused-XLA plan — one ppermute pair per
+    halo-carrying fused stage."""
+    mesh = make_mesh(4)
+    img = img_u8(128, 96, 3, seed=16)
+    pipe = Pipeline.parse("gaussian:3,sharpen,grayscale,sobel")
+    txt = pipe.sharded(mesh, plan="fused-pallas").lower(img).as_text()
+    assert txt.count("collective_permute") == 2
+
+
+def test_sharded_fused_pallas_fallback_gates_stay_bit_exact():
+    mesh = make_mesh(4)
+    pipe = Pipeline.parse(MIXED)
+    # pad rows inside the tile: megakernel ineligible, walker ineligible
+    # -> per-op materialised-ext fallback inside the same region
+    img = img_u8(130, 48, 3, seed=17)
+    got = np.asarray(pipe.sharded(mesh, plan="fused-pallas")(img))
+    assert np.array_equal(got, golden(pipe.ops, img))
+
+
+def test_serve_cache_flips_between_fused_and_fused_pallas(calib_file):
+    """An autotune flip fused <-> fused-pallas mid-flight must MISS and
+    rebuild on the new fingerprint, then HIT the still-warm entry when
+    flipped back (the PR-10 cache contract extended to the new mode)."""
+    from mpi_cuda_imagemanipulation_tpu.serve.cache import CompileCache
+
+    pipe = Pipeline.parse(MIXED)
+    kind = calibration.current_device_kind()
+    fp = pipeline_fingerprint(pipe.ops)
+    calibration.record_plan_choice(kind, fp, "fused", width=32)
+    calibration._cache["key"] = None
+    cache = CompileCache(
+        pipe, buckets=((32, 32),), batch_buckets=(2,), channels=(3,),
+        backend="xla", plan="auto",
+    )
+    cache.warmup()
+    fp_fused = cache.plan_fingerprint(32)
+    fn1 = cache.get(32, 32, 3, 2)
+    assert cache.stats()["misses"] == 0
+    calibration.record_plan_choice(kind, fp, "fused-pallas", width=32)
+    calibration._cache["key"] = None
+    fp_mega = cache.plan_fingerprint(32)
+    assert fp_mega != fp_fused
+    fn2 = cache.get(32, 32, 3, 2)
+    assert cache.stats()["misses"] == 1 and fn2 is not fn1
+    # both structures serve identical bytes at dynamic true shapes
+    imgs = np.zeros((2, 32, 32, 3), dtype=np.uint8)
+    imgs[0, :30, :31] = synthetic_image(30, 31, channels=3, seed=30)
+    th = np.array([30, 32], dtype=np.int32)
+    tw = np.array([31, 32], dtype=np.int32)
+    assert np.array_equal(
+        np.asarray(fn1(imgs, th, tw)), np.asarray(fn2(imgs, th, tw))
+    )
+    calibration.record_plan_choice(kind, fp, "fused", width=32)
+    calibration._cache["key"] = None
+    assert cache.plan_fingerprint(32) == fp_fused
+    assert cache.get(32, 32, 3, 2) is fn1
+    assert cache.stats()["misses"] == 1
+
+
+# --------------------------------------------------------------------------
+# geometric-commute fusion (PR 10 leftover)
+# --------------------------------------------------------------------------
+
+
+def test_commute_hoists_geoms_out_of_pointwise_runs():
+    ops = make_pipeline_ops("invert,rot180,brightness:10,gaussian:3")
+    plan = build_plan(ops, "fused")
+    # rot180 hoists left past invert: [rot180][invert+brightness+gauss]
+    assert [s.kind for s in plan.stages] == ["geometric", "fused"]
+    assert plan.stages[1].names == ("invert", "brightness10", "gaussian3")
+    # the golden reference never restructures
+    off = build_plan(ops, "off")
+    assert tuple(o.name for o in off.ops) == tuple(o.name for o in ops)
+
+
+def test_commute_respects_stencil_barriers_and_kill_switch(monkeypatch):
+    ops = make_pipeline_ops("gaussian:3,invert,rot180,sharpen")
+    plan = build_plan(ops, "fused")
+    # rot180 hoists past invert but NOT past gaussian (a stencil)
+    assert [s.kind for s in plan.stages] == ["fused", "geometric", "fused"]
+    assert plan.stages[0].names == ("gaussian3",)
+    assert plan.stages[2].names == ("invert", "sharpen")
+    monkeypatch.setenv("MCIM_PLAN_COMMUTE", "0")
+    plan2 = build_plan(ops, "fused")
+    assert [s.names for s in plan2.stages] == [
+        ("gaussian3", "invert"), ("rot180",), ("sharpen",),
+    ]
+
+
+_COMMUTE_POOL = (
+    "invert", "brightness:30", "rot180", "fliph", "flipv",
+    "gaussian:3", "sharpen", "quantize:5", "emboss:3", "erode",
+)
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_commute_random_chain_bit_identical(seed):
+    rng = np.random.default_rng(2000 + seed)
+    names = [
+        str(rng.choice(_COMMUTE_POOL))
+        for _ in range(int(rng.integers(2, 8)))
+    ]
+    ops = make_pipeline_ops(",".join(names))
+    img = img_u8(int(rng.integers(24, 64)), int(rng.integers(24, 64)), 1,
+                 seed=seed)
+    ref = golden(ops, img)
+    for mode, ex in (
+        ("pointwise", plan_callable),
+        ("fused", plan_callable),
+        ("fused-pallas", plan_callable_pallas),
+    ):
+        plan = build_plan(ops, mode)
+        assert plan.total_halo == chain_halo(ops)
+        # commuting reorders but never drops/duplicates ops
+        assert sorted(o.name for o in plan.ops) == sorted(
+            o.name for o in ops
+        )
+        got = np.asarray(ex(plan)(img))
+        assert np.array_equal(got, ref), (mode, names)
+
+
+# --------------------------------------------------------------------------
+# 2-D tile runner stage forms (PR 10 leftover)
+# --------------------------------------------------------------------------
+
+
+def test_2d_stage_forms_bit_exact():
+    from mpi_cuda_imagemanipulation_tpu.parallel.mesh import make_mesh_2d
+
+    mesh = make_mesh_2d(2, 2)
+    for spec, c in (
+        (MIXED, 3),
+        ("invert,gaussian:5,sharpen,quantize:6", 3),
+        ("erode:5,dilate:3", 1),
+        ("grayscale,gaussian:3,equalize,sharpen", 3),
+    ):
+        pipe = Pipeline.parse(spec)
+        img = img_u8(64, 64, c, seed=18)
+        ref = golden(pipe.ops, img)
+        for mode in ("off", "fused"):
+            got = np.asarray(pipe.sharded(mesh, plan=mode)(img))
+            assert np.array_equal(got, ref), (spec, mode)
+    # pad cols inside the tile: per-op fallback inside the region
+    pipe = Pipeline.parse(MIXED)
+    img = img_u8(64, 67, 3, seed=19)
+    got = np.asarray(pipe.sharded(mesh, plan="fused")(img))
+    assert np.array_equal(got, golden(pipe.ops, img))
+
+
+def test_2d_stage_forms_one_exchange_round_per_stage():
+    """Structural HLO assertion: a halo-carrying fused stage pays ONE
+    two-phase corner-carrying exchange round (2 ppermute pairs — one per
+    mesh axis) instead of one round per stencil op."""
+    from mpi_cuda_imagemanipulation_tpu.parallel.mesh import make_mesh_2d
+
+    mesh = make_mesh_2d(2, 2)
+    img = img_u8(64, 64, 3, seed=20)
+    cases = (
+        # (chain, halo-carrying fused stages, stencils)
+        (MIXED, 1, 1),
+        ("gaussian:3,sharpen,grayscale,sobel", 1, 3),
+        ("invert,gaussian:3,rot90,sharpen,sobel,quantize:6", 2, 3),
+    )
+    for chain, n_stages, n_stencils in cases:
+        pipe = Pipeline.parse(chain)
+        fused_txt = pipe.sharded(mesh, plan="fused").lower(img).as_text()
+        off_txt = pipe.sharded(mesh, plan="off").lower(img).as_text()
+        assert fused_txt.count("collective_permute") == 4 * n_stages, chain
+        assert off_txt.count("collective_permute") == 4 * n_stencils, chain
+
+
+# --------------------------------------------------------------------------
 # failpoint, metrics, exposition
 # --------------------------------------------------------------------------
 
@@ -557,7 +841,9 @@ def test_plan_metrics_count_builds_and_savings():
 
 
 def test_plan_modes_surface():
-    assert PLAN_MODES == ("auto", "off", "pointwise", "fused")
+    assert PLAN_MODES == (
+        "auto", "off", "pointwise", "fused", "fused-pallas",
+    )
 
 
 # --------------------------------------------------------------------------
@@ -580,6 +866,27 @@ def test_plan_ab_lane_gates_and_saves(monkeypatch):
     assert rec["lanes"]["off"]["stages"] == 4
     assert rec["speedup_fused_vs_off"] is not None
     assert rec["fused_stage_breakdown"][0]["halo"] == 2
+
+
+def test_megakernel_ab_lane_gates_and_reports(monkeypatch):
+    from mpi_cuda_imagemanipulation_tpu.bench_suite import run_megakernel_ab
+
+    monkeypatch.setenv("MCIM_MEGAKERNEL_AB_HEIGHT", "128")
+    monkeypatch.setenv("MCIM_MEGAKERNEL_AB_WIDTH", "192")
+    json_path = os.environ.get("MCIM_MEGAKERNEL_AB_JSON")  # CI artifact
+    rec = run_megakernel_ab(printer=lambda s: None, json_path=json_path)
+    assert rec["bit_exact_gate"].startswith("passed")
+    # the two-stencil headline chain fuses into ONE megakernel stage
+    assert rec["megakernel_stages"] == 1
+    assert rec["stage_eligibility"][0]["halo"] == 3
+    for lane in ("off", "fused", "fused_pallas"):
+        assert "ms_per_iter" in rec["lanes"][lane], rec["lanes"][lane]
+    assert rec["speedup_pallas_vs_fused"] is not None
+    from mpi_cuda_imagemanipulation_tpu.obs.metrics import parse_exposition
+
+    fams = parse_exposition(plan_metrics.registry.render())
+    assert "mcim_plan_pallas_stages_total" in fams
+    assert "mcim_plan_pallas_fallbacks_total" in fams
 
 
 def test_unfused_callables_chain_matches_golden():

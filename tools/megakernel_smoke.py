@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""CI megakernel smoke (tier1.yml): plan=fused-pallas acceptance, end to
+end, in interpret mode on CPU.
+
+One process proves, on chains that exercise the eligibility matrix
+(temporally-blocked stencil pairs, interior/edge/reflect modes, channel
+changes, LUT fallback, barriers):
+
+  1. **bit-exactness** — the fused-pallas executor reproduces the per-op
+     golden chain (`--plan off`) through jit AND the row-sharded
+     ghost-mode path over fake XLA host devices;
+  2. **structure** — the sharded fused-pallas chain compiles to exactly
+     ONE ppermute pair per halo-carrying fused stage (the megakernel
+     consumes the pre-exchanged rows — same wire structure as fused-XLA),
+     and the commuted-geometry plan stops splitting pointwise runs;
+  3. **fallback** — a LUT-bearing stage routes through the XLA walker
+     (counted in mcim_plan_pallas_fallbacks_total) and stays bit-exact;
+  4. **observability** — mcim_plan_pallas_* families render as parseable
+     exposition with the launch counter populated;
+  5. **the lane** — the megakernel_ab bench lane runs (its pre-timing
+     bit-exactness gate must pass) and its record lands at argv[1].
+     Interpret-mode timings are never asserted — the committed
+     BENCH_HISTORY record is the gate anchor, the TPU window script
+     (tools/tpu_queue/29_megakernel_r07.sh) carries the perf claim.
+
+Usage: python tools/megakernel_smoke.py /tmp/megakernel_ab.json
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=4")
+
+import numpy as np  # noqa: E402
+
+H, W, C = 160, 96, 3
+
+
+def main() -> int:
+    import jax.numpy as jnp
+
+    from mpi_cuda_imagemanipulation_tpu.io.image import synthetic_image
+    from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline
+    from mpi_cuda_imagemanipulation_tpu.obs.metrics import parse_exposition
+    from mpi_cuda_imagemanipulation_tpu.parallel.mesh import make_mesh
+    from mpi_cuda_imagemanipulation_tpu.plan import build_plan, plan_metrics
+    from mpi_cuda_imagemanipulation_tpu.plan.pallas_exec import (
+        plan_callable_pallas,
+        stage_pallas_reject,
+    )
+
+    # -- 1. bit-exactness: jit + sharded ghost mode -------------------------
+    mesh = make_mesh(4)
+    chains = (
+        "grayscale,contrast:3.5,gaussian:5,sharpen,quantize:6",  # blocked pair
+        "grayscale,contrast:3.5,emboss:3",                       # interior
+        "erode:5,dilate:3",                                      # edge mode
+        "median:3,gaussian:3",                                   # median
+    )
+    for spec in chains:
+        pipe = Pipeline.parse(spec)
+        ch = 3 if spec.startswith("grayscale") else 1
+        img = jnp.asarray(synthetic_image(H, W, channels=ch, seed=21))
+        golden = np.asarray(pipe.apply(img))
+        got = np.asarray(pipe.jit(plan="fused-pallas")(img))
+        assert np.array_equal(got, golden), f"jit fused-pallas != golden: {spec}"
+        got = np.asarray(pipe.sharded(mesh, plan="fused-pallas")(img))
+        assert np.array_equal(got, golden), f"sharded fused-pallas: {spec}"
+    print(f"bit-exact: {len(chains)} chains, jit + 4-shard ghost mode")
+
+    # -- 2. structure: one ppermute pair per stage; commuted geometry ------
+    pipe = Pipeline.parse("gaussian:3,sharpen,grayscale,sobel")
+    img = jnp.asarray(synthetic_image(128, W, channels=3, seed=22))
+    txt = pipe.sharded(mesh, plan="fused-pallas").lower(img).as_text()
+    n = txt.count("collective_permute")
+    assert n == 2, f"expected 1 ppermute pair for the fused stage, got {n}"
+    commuted = build_plan(
+        Pipeline.parse("invert,rot180,brightness:10,gaussian:3").ops,
+        "fused-pallas",
+    )
+    assert [s.kind for s in commuted.stages] == ["geometric", "fused"], (
+        commuted.describe()
+    )
+    print("structure: 1 ppermute pair/stage; rot180 commuted out of the run")
+
+    # -- 3. fallback: LUT member -> XLA walker, counted, bit-exact ---------
+    pipe = Pipeline.parse("gamma:2.2,gaussian:3")
+    img = jnp.asarray(synthetic_image(H, W, channels=1, seed=23))
+    golden = np.asarray(pipe.apply(img))
+    plan = build_plan(pipe.ops, "fused-pallas")
+    assert stage_pallas_reject(plan.stages[0], H, W, 1) == "lut-op"
+    before = int(plan_metrics.pallas_fallbacks.value(reason="lut-op"))
+    got = np.asarray(plan_callable_pallas(plan)(img))
+    assert np.array_equal(got, golden), "LUT fallback diverged"
+    after = int(plan_metrics.pallas_fallbacks.value(reason="lut-op"))
+    assert after == before + 1, (before, after)
+    print("fallback: lut-op stage walked in XLA, counted, bit-exact")
+
+    # -- 4. exposition ------------------------------------------------------
+    fams = parse_exposition(plan_metrics.registry.render())
+    for fam in (
+        "mcim_plan_pallas_stages_total",
+        "mcim_plan_pallas_fallbacks_total",
+    ):
+        assert fam in fams, f"missing metric family {fam}"
+    assert plan_metrics.snapshot()["pallas_stages"] >= 1
+    print(f"exposition: {len(fams)} families parse; megakernel launches "
+          f"counted ({plan_metrics.snapshot()['pallas_stages']})")
+
+    # -- 5. the megakernel_ab lane (record -> CI artifact) ------------------
+    out = sys.argv[1] if len(sys.argv) > 1 else None
+    os.environ.setdefault("MCIM_MEGAKERNEL_AB_HEIGHT", "256")
+    os.environ.setdefault("MCIM_MEGAKERNEL_AB_WIDTH", "384")
+    from mpi_cuda_imagemanipulation_tpu.bench_suite import run_megakernel_ab
+
+    rec = run_megakernel_ab(json_path=out, printer=lambda s: None)
+    assert rec["bit_exact_gate"].startswith("passed"), rec["bit_exact_gate"]
+    assert rec["megakernel_stages"] >= 1, rec["stage_eligibility"]
+    print(
+        f"megakernel_ab: gate passed, {rec['megakernel_stages']} megakernel "
+        f"stage(s), pallas {rec['speedup_pallas_vs_fused'] or 0:.2f}x vs "
+        "fused-XLA (interpret mode — gate record only)"
+        + (f" -> {out}" if out else "")
+    )
+    print("megakernel smoke: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

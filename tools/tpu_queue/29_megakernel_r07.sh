@@ -1,0 +1,61 @@
+#!/bin/bash
+# Megakernel lane (round 7): fused-XLA vs fused-pallas on real hardware,
+# PLUS the still-pending 26_plan_r07 items folded in (one window slot
+# covers the whole plan axis — run 26 separately only if this step gets
+# cut short).
+#
+# megakernel_ab times the SAME two-stencil chain three ways (bit-
+# exactness gated before any timing): `--plan off` (per-op golden),
+# `--plan fused` (the PR-10 fused-XLA stage walker — incumbent), and
+# `--plan fused-pallas` (each eligible stage as ONE VMEM-resident
+# pallas_call: one u8 read + one u8 write per stage, intermediates never
+# touching HBM — plan/pallas_exec.py). This is the record that decides
+# the roofline_frac claim: the fused-XLA plan measured ~11% of the ~550
+# GB/s streaming bound (BENCH_HISTORY plan_ab); the megakernel's whole
+# point is work-per-HBM-byte, so the MP/s/chip delta here IS the thesis.
+# Then `autotune --dimension plan` sweeps all four modes (fused-pallas
+# joins on real TPU) and records the measured winner per (device kind,
+# pipeline fingerprint) — the ONLY way `--plan auto` ever routes to the
+# megakernel — and a sharded off/fused-pallas CLI A/B shows the
+# ghost-mode megakernel behind one ppermute pair per stage end to end.
+# Knobs: MCIM_MEGAKERNEL_AB_OPS / _HEIGHT / _WIDTH, MCIM_PLAN_AB_*.
+# Budget: ~5-8 min.
+set -u
+cd "$(dirname "$0")/../.."
+. tools/tpu_queue/_lib.sh
+out=artifacts/megakernel_ab_r07.out
+: > "$out"
+timeout 1200 python -m mpi_cuda_imagemanipulation_tpu.bench_suite \
+  --config megakernel_ab >> "$out" 2>&1
+# folded-in 26_plan_r07: the plan_ab lane (off / per-op dispatch /
+# pointwise / fused) — still unrecorded on silicon
+timeout 1200 python -m mpi_cuda_imagemanipulation_tpu.bench_suite \
+  --config plan_ab >> "$out" 2>&1
+# plan autotune over all modes incl. fused-pallas (TPU => compiled
+# kernels, no interpret hazard); the recorded winner steers --plan auto
+timeout 1200 python -m mpi_cuda_imagemanipulation_tpu.cli autotune \
+  --dimension plan \
+  --ops grayscale,contrast:3.5,gaussian:5,sharpen,quantize:6 \
+  --height 4320 --width 7680 \
+  --json-metrics artifacts/megakernel_autotune_r07.json >> "$out" 2>&1
+# sharded structure A/B: fused-XLA walker vs ghost-mode megakernel, both
+# behind one ppermute pair per stage (bit-identical output)
+python - <<'EOF'
+from mpi_cuda_imagemanipulation_tpu.io.image import save_image, synthetic_image
+save_image("artifacts/_mega_8k.ppm", synthetic_image(4320, 7680, channels=3, seed=7))
+EOF
+for plan in off fused fused-pallas; do
+  timeout 1200 python -m mpi_cuda_imagemanipulation_tpu.cli run \
+    --input artifacts/_mega_8k.ppm --output artifacts/_mega_8k_out.ppm \
+    --ops grayscale,contrast:3.5,gaussian:5,sharpen,quantize:6 --impl xla \
+    --shards 4 --plan "$plan" --show-timing \
+    --json-metrics "artifacts/megakernel_sharded_${plan}_r07.json" \
+    >> "$out" 2>&1 || true
+done
+rm -f artifacts/_mega_8k.ppm artifacts/_mega_8k_out.ppm
+commit_artifacts "TPU window: megakernel A/B + plan autotune incl. fused-pallas (round 7)" \
+  "$out" artifacts/megakernel_autotune_r07.json \
+  artifacts/megakernel_sharded_off_r07.json \
+  artifacts/megakernel_sharded_fused_r07.json \
+  artifacts/megakernel_sharded_fused-pallas_r07.json
+exit 0
