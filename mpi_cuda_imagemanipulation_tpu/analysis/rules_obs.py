@@ -87,6 +87,14 @@ rule(
     "every production dump site names its trigger as a string literal.",
 )
 rule(
+    "obs-cost-attribution-missing", "obs",
+    "A compile-cache insertion site (a store into a `_fns` cache dict or "
+    "a cache_put() call) in package code never touches the cost-"
+    "attribution layer (obs/cost.attribute_jit / wrap_cache_fn) — the "
+    "executable would serve traffic with no measured cost record, and "
+    "the plan-model drift gate goes blind at that site.",
+)
+rule(
     "graph-taxonomy-unknown", "obs",
     "A SpecError() construction names a rejection code missing from "
     "graph/spec.py's TAXONOMY — the pipeline service's closed error "
@@ -108,7 +116,7 @@ rule(
 
 _METRIC_RE = re.compile(
     r"^mcim_(serve|engine|cache|breaker|health|batch|analysis|fabric|stream"
-    r"|plan|fleet|slo|graph)_[a-z0-9_]+$"
+    r"|plan|fleet|slo|graph|cost|devmem)_[a-z0-9_]+$"
 )
 
 
@@ -130,6 +138,7 @@ def check_obs(repo: Repo):
     findings.extend(_check_exemplars(repo))
     findings.extend(_check_recorder_triggers(repo))
     findings.extend(_check_graph_taxonomy(repo))
+    findings.extend(_check_cost_attribution(repo))
     return findings
 
 
@@ -504,6 +513,92 @@ def _check_recorder_triggers(repo: Repo) -> list:
                 "caller anywhere in the repo",
             )
         )
+    return findings
+
+
+# -- cost-attribution contract (obs/cost.py) ----------------------------------
+
+# the cost-layer entry points a compile-cache file must reach
+_COST_HOOKS = {"attribute_jit", "wrap_cache_fn", "attribute_plan", "extract"}
+
+
+def _file_touches_cost_layer(sf) -> bool:
+    """Whether the file imports obs.cost (module- or function-level) or
+    calls one of its attribution hooks by name."""
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod.endswith("obs.cost"):
+                return True
+            if mod.endswith(".obs") or mod == "obs":
+                if any(a.name == "cost" for a in node.names):
+                    return True
+        elif isinstance(node, ast.Call):
+            fn = node.func
+            name = (
+                fn.attr if isinstance(fn, ast.Attribute)
+                else fn.id if isinstance(fn, ast.Name) else None
+            )
+            if name in _COST_HOOKS:
+                return True
+    return False
+
+
+def _cache_insertions(sf) -> list[tuple[int, str]]:
+    """(line, description) for every compile-cache insertion in the
+    file: a store/setdefault into a `_fns`-named attribute (the
+    compile-cache idiom serve/cache and stream/tiles share) or a
+    `cache_put()` call (the graph tenancy namespaces)."""
+    out: list[tuple[int, str]] = []
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if (
+                    isinstance(tgt, ast.Subscript)
+                    and isinstance(tgt.value, ast.Attribute)
+                    and tgt.value.attr == "_fns"
+                ):
+                    out.append((node.lineno, "store into _fns"))
+        elif isinstance(node, ast.Call):
+            fn = node.func
+            if not isinstance(fn, ast.Attribute):
+                continue
+            if fn.attr == "setdefault" and (
+                isinstance(fn.value, ast.Attribute)
+                and fn.value.attr == "_fns"
+            ):
+                out.append((node.lineno, "_fns.setdefault"))
+            elif fn.attr == "cache_put":
+                out.append((node.lineno, "cache_put call"))
+    return out
+
+
+def _check_cost_attribution(repo: Repo) -> list:
+    """Every compile-cache insertion site must record cost attribution:
+    an executable that enters a cache without touching obs/cost serves
+    traffic the drift gate never sees."""
+    findings = []
+    for sf in repo.package_files():
+        if sf.rel in (
+            f"{PACKAGE}/obs/cost.py",  # the layer itself
+            f"{PACKAGE}/graph/tenancy.py",  # cache_put DEFINITION, not a site
+        ):
+            continue
+        insertions = _cache_insertions(sf)
+        if not insertions:
+            continue
+        if _file_touches_cost_layer(sf):
+            continue
+        for line, what in insertions:
+            findings.append(
+                make_finding(
+                    "obs-cost-attribution-missing", sf.rel, line,
+                    f"compile-cache insertion ({what}) in a file that "
+                    "never reaches obs/cost — wrap the callable with "
+                    "attribute_jit/wrap_cache_fn so the executable's "
+                    "measured cost lands in the ledger",
+                )
+            )
     return findings
 
 

@@ -285,6 +285,22 @@ def run_config(cfg: BenchConfig, impl: str, *, n_shards: int | None = None) -> d
         "hbm_bytes_model": hbm_bytes,
         "hbm_gb_s_model": gb_s,
     }
+    # MEASURED traffic columns (obs/cost, the roofline_probe question
+    # folded into the production path): what XLA's own cost model says
+    # the compiled executable moves, next to the analytical u8 model —
+    # the committed record carries both so the model stays checked, and
+    # tools/bench_regress.py tracks the measured series too
+    from mpi_cuda_imagemanipulation_tpu.obs import cost as obs_cost
+
+    if obs_cost.enabled():
+        cost = obs_cost.extract(fn, [img])
+        if cost is not None:
+            rec["hbm_bytes_hlo"] = cost.hlo_bytes
+            rec["hbm_gb_s_measured"] = obs_cost.measured_gb_s(
+                cost.hlo_bytes, sec, n_chips
+            )
+            rec["hlo_flops"] = cost.flops
+            rec["hlo_temp_bytes"] = cost.temp_bytes
     if cfg.sharded:
         rec["halo_mode"] = cfg.halo_mode
         if _halo_ab_enabled():
@@ -295,6 +311,13 @@ def run_config(cfg: BenchConfig, impl: str, *, n_shards: int | None = None) -> d
         gen = _tpu_gen()
         rec["tpu_gen"] = gen
         rec["roofline_frac"] = gb_s / HBM_GB_S.get(gen, HBM_GB_S["v5e"])
+        if "hbm_gb_s_measured" in rec:
+            # the measured roofline fraction: compiled-executable bytes
+            # over the datasheet bound — the number the analytical
+            # roofline_frac claims to approximate
+            rec["roofline_frac_measured"] = rec[
+                "hbm_gb_s_measured"
+            ] / HBM_GB_S.get(gen, HBM_GB_S["v5e"])
         # the traffic model counts u8 planes, so modeled bytes == modeled
         # elements and gb_s doubles as giga-elements/s against the measured
         # kernel-class element rate — but only for impls that stream u8

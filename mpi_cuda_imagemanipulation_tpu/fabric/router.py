@@ -232,6 +232,11 @@ class Router:
         GET|POST /control/canary
                                 canary gate status / deploy / abort
                                 (fabric/canary.py)
+        POST /control/profile   on-demand fleet profiling: relay a
+                                rate-limited jax.profiler capture to one
+                                replica under live traffic; the merged
+                                host+device artifact path rides back
+                                (obs/profile.capture_live)
         GET  /healthz           200 while >=1 routable fresh replica
         GET  /stats             replica table + routing counters (JSON)
         GET  /metrics           Prometheus exposition (mcim_fabric_*)
@@ -379,6 +384,13 @@ class Router:
             "mcim_fabric_graph_specs",
             "(tenant, pipeline) specs registered through this router.",
             fn=lambda: float(len(self.graph_specs)),
+        )
+        # -- on-demand fleet profiling (obs/profile.capture_live) -----------
+        self._m_profile = r.counter(
+            "mcim_fabric_profile_captures_total",
+            "On-demand replica profile captures relayed through the "
+            "front door, by outcome (ok/rate_limited/error).",
+            labels=("outcome",),
         )
         # -- canary rollback gate (fabric/canary.py) ------------------------
         self._m_canary = r.counter(
@@ -1458,6 +1470,65 @@ class Router:
 
     # -- control + introspection ------------------------------------------
 
+    def handle_profile(self, body: bytes) -> tuple[int, dict]:
+        """`POST /control/profile`: target ONE replica with an on-demand
+        `jax.profiler` capture under live traffic (body: {"replica":
+        optional id, "seconds": optional float}). The replica runs the
+        rate-limited capture (obs/profile.capture_live), merges its obs
+        host spans onto the device timeline, files the artifact + a
+        `profile_capture` recorder dump, and the whole result relays
+        back through the front door — so a fleet operator profiles a
+        serving pod with one HTTP call and zero SSH."""
+        try:
+            payload = json.loads(body or b"{}")
+        except ValueError as e:
+            return 400, {"error": f"body is not JSON: {e}"}
+        if not isinstance(payload, dict):
+            return 400, {"error": "profile request must be an object"}
+        want = payload.get("replica") or ""
+        live = self._routable()
+        if not live:
+            self._m_profile.inc(outcome="error")
+            return 503, {"error": "no replica is serving"}
+        if want:
+            view = next(
+                (v for v in live if v.replica_id == want), None
+            )
+            if view is None:
+                self._m_profile.inc(outcome="error")
+                return 404, {
+                    "error": f"replica {want!r} is not routable",
+                    "routable": sorted(v.replica_id for v in live),
+                }
+        else:
+            # default target: the least-loaded serving replica — the
+            # capture steals cycles, so don't aim it at the hottest one
+            # unless the operator names it
+            view = min(live, key=lambda v: v.load_frac())
+        try:
+            code, out = self._push_json(
+                view, "/control/profile",
+                {"seconds": payload.get("seconds")},
+            )
+        except Exception as e:
+            self._m_profile.inc(outcome="error")
+            return 502, {
+                "error": (
+                    f"profile relay to {view.replica_id} failed "
+                    f"({type(e).__name__}: {str(e)[:120]})"
+                ),
+                "replica": view.replica_id,
+            }
+        try:
+            resp = json.loads(out)
+        except ValueError:
+            resp = {"raw": out[:200].decode(errors="replace")}
+        self._m_profile.inc(
+            outcome="ok" if code == 200
+            else "rate_limited" if code == 429 else "error"
+        )
+        return code, {"replica": view.replica_id, **resp}
+
     def handle_heartbeat(self, body: bytes) -> tuple[int, dict]:
         try:
             hb = Heartbeat.from_json(body)
@@ -1785,6 +1856,17 @@ def _make_handler(router: Router):
                     route[0], body, self.headers
                 )
                 self._reply(code, ctype, out, extra)
+            elif self.path == "/control/profile":
+                code, payload = router.handle_profile(body)
+                extra = (
+                    # keep the replica's real rate-limit remainder on the
+                    # relayed shed, like every other Retry-After pass-through
+                    [("Retry-After",
+                      str(max(1, int(payload.get("retry_after_s", 1)))))]
+                    if code == 429
+                    else []
+                )
+                self._reply_json(code, payload, extra)
             elif self.path == "/control/canary":
                 # operator/bench control plane: start a flip ({"env":
                 # {...}, "argv": [...]}) or abort the one in flight

@@ -66,6 +66,25 @@ TENANTS_PATH = "/v1/tenants"
 STATUSES = ("ok", "shed", "rejected", "error")
 
 
+def _graph_modeled_bytes(program, backend: str, args) -> float:
+    """The DAG's boundary model for cost attribution (obs/cost): the u8
+    source in, the DECLARED outputs out (image + histogram/stats side
+    outputs) — shared prefixes, merge joins and fused segments are
+    in-executable structure and must add nothing at the boundary. The
+    output avals come from eval_shape (spec-determined: the callable
+    returns exactly the spec's `outputs` mapping), never from the
+    compiled artifact itself."""
+    img = args[0]
+    aval = jax.ShapeDtypeStruct(tuple(img.shape), np.uint8)
+    out = jax.eval_shape(graph_callable(program, impl=backend), aval)
+    total = int(np.prod(aval.shape, dtype=np.int64))
+    for leaf in jax.tree_util.tree_leaves(out):
+        total += int(
+            np.prod(leaf.shape, dtype=np.int64)
+        ) * leaf.dtype.itemsize
+    return float(total)
+
+
 class GraphService:
     def __init__(
         self,
@@ -295,7 +314,24 @@ class GraphService:
                     graph, plan=self.plan, backend=self.backend,
                     width=img.shape[1] if img.ndim >= 2 else None,
                 )
-                fn = jax.jit(graph_callable(program, impl=self.backend))
+                from mpi_cuda_imagemanipulation_tpu.obs import (
+                    cost as obs_cost,
+                )
+
+                # cost attribution rides the insertion (obs/cost):
+                # each request shape's first dispatch compiles AOT and
+                # lands its measured cost in the ledger keyed by the
+                # program's execution-structure fingerprint; the model
+                # is the DAG's boundary — source in, declared outputs
+                # out, shared prefixes and fused segments adding nothing
+                fn = obs_cost.wrap_cache_fn(
+                    "graph",
+                    program.fingerprint,
+                    jax.jit(graph_callable(program, impl=self.backend)),
+                    modeled_fn=lambda args, p=program: (
+                        _graph_modeled_bytes(p, self.backend, args)
+                    ),
+                )
                 st.cache_put(pipeline_id, fn)
                 self._m_compiles.inc()
             out = fn(img)

@@ -83,13 +83,15 @@ class Pipeline:
     # -- compiled entry points -------------------------------------------
 
     def _planned_callable(self, backend: str, plan: str):
-        """The fused-plan executor for this (backend, plan) pair, or None
-        when the resolution says per-op (then `_callable`'s legacy paths
-        run unchanged). Pure-XLA/MXU backends execute plans directly;
-        `auto` engages only behind a calibrated plan choice, keeping the
-        measured Pallas group routing by default (plan/planner.py)."""
+        """`(executor, built_plan)` for this (backend, plan) pair, or
+        `(None, None)` when the resolution says per-op (then
+        `_callable`'s legacy paths run unchanged). Pure-XLA/MXU backends
+        execute plans directly; `auto` engages only behind a calibrated
+        plan choice, keeping the measured Pallas group routing by
+        default (plan/planner.py). The built plan rides back so `jit`
+        can key cost attribution by its fingerprint (obs/cost)."""
         if backend not in ("xla", "mxu", "auto"):
-            return None
+            return None, None
         from mpi_cuda_imagemanipulation_tpu.plan import (
             build_plan,
             resolve_plan_mode,
@@ -98,16 +100,15 @@ class Pipeline:
 
         mode = resolve_plan_mode(self.ops, plan, backend=backend)
         if mode == "off":
-            return None
+            return None, None
+        built = build_plan(self.ops, mode)
         if mode == "fused-pallas":
             from mpi_cuda_imagemanipulation_tpu.plan.pallas_exec import (
                 plan_callable_pallas,
             )
 
-            return plan_callable_pallas(
-                build_plan(self.ops, mode), impl=backend
-            )
-        return plan_callable(build_plan(self.ops, mode), impl=backend)
+            return plan_callable_pallas(built, impl=backend), built
+        return plan_callable(built, impl=backend), built
 
     def _callable(
         self,
@@ -115,7 +116,7 @@ class Pipeline:
         block_h: int | None = None,
         plan: str = "auto",
     ):
-        planned = self._planned_callable(backend, plan)
+        planned, _built = self._planned_callable(backend, plan)
         if planned is not None:
             return planned
         if backend == "xla":
@@ -183,11 +184,43 @@ class Pipeline:
         new buffer). Results are bit-identical either way."""
         if donate:
             _silence_unused_donation_warning()
-            return jax.jit(
+            jitted = jax.jit(
                 self._callable(backend, block_h=block_h, plan=plan),
                 donate_argnums=0,
             )
-        return jax.jit(self._callable(backend, block_h=block_h, plan=plan))
+        else:
+            jitted = jax.jit(
+                self._callable(backend, block_h=block_h, plan=plan)
+            )
+        _planned, built = self._planned_callable(backend, plan)
+        if built is None:
+            return jitted
+        # a PLANNED executable is a compile site the cost layer tracks
+        # (obs/cost): the first call attributes the compiled artifact
+        # under the plan's fingerprint — one u8 image in, one out, no
+        # matter how many stages the plan holds — so a planner change
+        # that leaks structure across the boundary trips the drift gate
+        from mpi_cuda_imagemanipulation_tpu.obs import cost as obs_cost
+
+        def modeled(args):
+            # one u8 image in + one u8 image out; the out aval is
+            # trace-determined (eval_shape — geometric barriers may
+            # re-shape), never read from the compiled artifact
+            import numpy as np
+
+            img = args[0]
+            out_aval = jax.eval_shape(
+                jitted, jax.ShapeDtypeStruct(tuple(img.shape), np.uint8)
+            )
+            return float(
+                int(np.prod(img.shape, dtype=np.int64))
+                + int(np.prod(out_aval.shape, dtype=np.int64))
+                * out_aval.dtype.itemsize
+            )
+
+        return obs_cost.wrap_cache_fn(
+            "plan", built.fingerprint, jitted, modeled_fn=modeled
+        )
 
     def batched(
         self, backend: str = "xla", *, donate: bool = False,

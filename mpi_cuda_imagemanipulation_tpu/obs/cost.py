@@ -1,0 +1,571 @@
+"""Cost attribution — what XLA actually compiled, keyed by the plan/dag
+fingerprints every compile cache already uses.
+
+Every performance claim in the repo — the roofline_frac headline, the
+planner's `hbm_passes_saved` accounting, the megakernel's one-u8-read +
+one-u8-write-per-stage contract — was computed from an ANALYTICAL byte
+model until this module: nothing ever read `compiled.cost_analysis()` or
+`memory_analysis()`. Here every compile-cache insertion site (serve
+bucket cache, stream TileFnCache, per-tenant graph cache, plan
+callables) extracts the compiled executable's measured cost and records
+it into one bounded ledger, so the model is CHECKED against what XLA
+compiled, continuously, on every platform CI runs on.
+
+Two distinct byte quantities, used for two distinct questions:
+
+  * **boundary bytes** (`memory_analysis().argument_size_in_bytes +
+    output_size_in_bytes - alias_size_in_bytes`) — what crosses the
+    executable boundary. This is EXACTLY what the planner models: a
+    fused stage's contract is "one u8 read + one u8 write of the image
+    per stage, intermediates never materialize at the boundary". The
+    **drift ratio** = boundary bytes / planner-modelled bytes
+    (`mcim_cost_model_drift_ratio{site,stage}`) is therefore a
+    structural check that holds on CPU CI too: per-op dispatch must sit
+    at ~1.0 (each op's executable takes u8 in, returns u8 out), a fused
+    or megakernel stage must sit at ~1.0 (absorbed ops add NOTHING at
+    the boundary), and a mis-modelled stage — an executable that leaks
+    its f32 carry, double-materializes, or grows hidden operands —
+    lands outside [MCIM_COST_DRIFT_MIN, MCIM_COST_DRIFT_MAX] and trips
+    `mcim_cost_drift_alerts_total` plus a flight-recorder note. The
+    `cost.model` failpoint deliberately mis-models a stage so the alert
+    path itself is CI-provable.
+  * **HLO bytes accessed** (`cost_analysis()['bytes accessed']`) — the
+    total traffic XLA's cost model charges the compiled program,
+    intermediates included. Divided by the dispatch-time histograms
+    (`mcim_serve_device_seconds` et al.) this yields the MEASURED
+    `hbm_gb_s` / `roofline_frac` columns the bench suite now reports
+    next to the analytical model (tools/roofline_probe.py's question,
+    folded into the production path).
+
+Extraction is AOT (`fn.lower(*args).compile()`), so the jit trace runs
+ONCE and the same compiled executable that was costed serves the
+traffic: `attribute_jit` returns a `CompiledOrJit` wrapper that
+dispatches to the costed executable for matching shapes and falls back
+to the original jit callable otherwise (and permanently on the first
+compiled-call failure — cost attribution must never take serving down).
+`MCIM_COST_ATTRIB=0` disables the whole layer; every failure path
+degrades to the un-attributed callable and a counter.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from collections import OrderedDict
+
+from mpi_cuda_imagemanipulation_tpu.obs import recorder
+from mpi_cuda_imagemanipulation_tpu.obs.metrics import Registry
+from mpi_cuda_imagemanipulation_tpu.resilience import failpoints
+from mpi_cuda_imagemanipulation_tpu.utils import env as env_registry
+from mpi_cuda_imagemanipulation_tpu.utils.log import get_logger
+
+ENV_ATTRIB = "MCIM_COST_ATTRIB"
+ENV_CAP = "MCIM_COST_CAP"
+ENV_DRIFT_MIN = "MCIM_COST_DRIFT_MIN"
+ENV_DRIFT_MAX = "MCIM_COST_DRIFT_MAX"
+ENV_PEAK_GBS = "MCIM_COST_PEAK_GBS"
+
+# the bounded attribution-site label set (one per compile-cache kind)
+SITES = ("serve", "plan", "graph", "stream", "bench")
+
+
+def enabled() -> bool:
+    return env_registry.get_bool(ENV_ATTRIB)
+
+
+def drift_band() -> tuple[float, float]:
+    return (
+        float(env_registry.get(ENV_DRIFT_MIN)),
+        float(env_registry.get(ENV_DRIFT_MAX)),
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class CostRecord:
+    """One compiled executable's measured cost, normalized across the
+    list-vs-dict `cost_analysis()` return shapes."""
+
+    flops: float
+    hlo_bytes: float  # total 'bytes accessed' (intermediates included)
+    arg_bytes: float
+    out_bytes: float
+    alias_bytes: float
+    temp_bytes: float
+    code_bytes: float
+
+    @property
+    def boundary_bytes(self) -> float:
+        """Bytes crossing the executable boundary — donated/aliased
+        buffers counted once (the planner's modelled quantity)."""
+        return self.arg_bytes + self.out_bytes - self.alias_bytes
+
+    @property
+    def peak_bytes(self) -> float:
+        """Peak device allocation the executable needs beyond code:
+        arguments + outputs + temporaries."""
+        return self.arg_bytes + self.out_bytes + self.temp_bytes
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["boundary_bytes"] = self.boundary_bytes
+        d["peak_bytes"] = self.peak_bytes
+        return d
+
+
+def cost_from_compiled(compiled) -> CostRecord | None:
+    """Extract a CostRecord from a `jax.stages.Compiled`; None when the
+    backend exposes neither analysis (extraction never raises)."""
+    flops = hlo_bytes = 0.0
+    have_any = False
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if ca:
+            flops = float(ca.get("flops", 0.0) or 0.0)
+            hlo_bytes = float(ca.get("bytes accessed", 0.0) or 0.0)
+            have_any = True
+    except Exception:
+        pass
+    arg = out = alias = temp = code = 0.0
+    try:
+        ma = compiled.memory_analysis()
+        if ma is not None:
+            arg = float(ma.argument_size_in_bytes)
+            out = float(ma.output_size_in_bytes)
+            alias = float(ma.alias_size_in_bytes)
+            temp = float(ma.temp_size_in_bytes)
+            code = float(ma.generated_code_size_in_bytes)
+            have_any = True
+    except Exception:
+        pass
+    if not have_any:
+        return None
+    return CostRecord(
+        flops=flops, hlo_bytes=hlo_bytes, arg_bytes=arg, out_bytes=out,
+        alias_bytes=alias, temp_bytes=temp, code_bytes=code,
+    )
+
+
+class CostLedger:
+    """The bounded attribution store + its `mcim_cost_*` families.
+
+    Module-level instance (like plan/metrics.plan_metrics): executables
+    are built from many entry points, and a per-call ledger would
+    fragment the drift history across them. The store is an LRU capped
+    at MCIM_COST_CAP entries keyed (site, key, stage) — fingerprints are
+    unbounded in principle, metric label sets must not be."""
+
+    def __init__(self, registry: Registry | None = None):
+        self.registry = registry or Registry()
+        self._lock = threading.Lock()
+        self._store: OrderedDict[tuple[str, str, str], dict] = OrderedDict()
+        r = self.registry
+        self.executables = r.counter(
+            "mcim_cost_executables_total",
+            "Compiled executables cost-attributed, per compile site.",
+            labels=("site",),
+        )
+        self.failures = r.counter(
+            "mcim_cost_extract_failures_total",
+            "Cost extractions that degraded to the un-attributed "
+            "callable, per compile site.",
+            labels=("site",),
+        )
+        self.drift_alerts = r.counter(
+            "mcim_cost_drift_alerts_total",
+            "Drift ratios outside [MCIM_COST_DRIFT_MIN, "
+            "MCIM_COST_DRIFT_MAX] — the plan-model falsification gate.",
+            labels=("site",),
+        )
+        self.drift_ratio = r.gauge(
+            "mcim_cost_model_drift_ratio",
+            "Measured executable-boundary bytes / planner-modelled bytes "
+            "per attributed stage (~1.0 = the one-read-one-write model "
+            "holds structurally).",
+            labels=("site", "stage"),
+            fn=self._drift_gauge,
+        )
+        self.hlo_bytes = r.gauge(
+            "mcim_cost_hlo_bytes",
+            "Total HLO bytes-accessed of the newest attribution per "
+            "(site, key) — the measured-roofline numerator.",
+            labels=("site", "key"),
+            fn=lambda: self._field_gauge("hlo_bytes"),
+        )
+        self.flops = r.gauge(
+            "mcim_cost_flops",
+            "HLO flops of the newest attribution per (site, key).",
+            labels=("site", "key"),
+            fn=lambda: self._field_gauge("flops"),
+        )
+        self.temp_bytes = r.gauge(
+            "mcim_cost_temp_bytes",
+            "Compiled temp allocation per (site, key) — what the "
+            "executable materializes beyond its boundary.",
+            labels=("site", "key"),
+            fn=lambda: self._field_gauge("temp_bytes"),
+        )
+
+    # -- gauges over the store ----------------------------------------------
+
+    def _drift_gauge(self) -> dict:
+        with self._lock:
+            return {
+                (site, stage): e["drift_ratio"]
+                for (site, _key, stage), e in self._store.items()
+                if e.get("drift_ratio") is not None
+            }
+
+    def _field_gauge(self, field: str) -> dict:
+        out: dict = {}
+        with self._lock:
+            # one sample per (site, key): stages of one executable family
+            # share the key, the whole-executable entry ("all") wins
+            for (site, key, stage), e in self._store.items():
+                if stage == "all" or (site, key) not in out:
+                    out[(site, key)] = e["cost"][field]
+        return out
+
+    # -- recording -----------------------------------------------------------
+
+    def record(
+        self,
+        site: str,
+        key: str,
+        cost: CostRecord,
+        *,
+        modeled_bytes: float | None = None,
+        stage: str = "all",
+    ) -> float | None:
+        """Fold one attribution in; returns the drift ratio (measured
+        boundary / modelled bytes) when a model was provided.
+
+        The `cost.model` failpoint deliberately corrupts the model (4x)
+        so the alert wiring is provable end to end: a tripped site is
+        exactly what a real planner mis-model would look like."""
+        if site not in SITES:
+            raise ValueError(f"unknown cost site {site!r}; known: {SITES}")
+        ratio = None
+        if modeled_bytes is not None and modeled_bytes > 0:
+            try:
+                failpoints.maybe_fail("cost.model", cost_site=site, key=key)
+            except failpoints.FailpointError:
+                # the deliberate mis-model: the planner "claims" 4x the
+                # real traffic, so measured/modelled lands at ~0.25
+                modeled_bytes = modeled_bytes * 4.0
+            ratio = cost.boundary_bytes / modeled_bytes
+        entry = {
+            "cost": cost.to_dict(),
+            "modeled_bytes": modeled_bytes,
+            "drift_ratio": ratio,
+        }
+        with self._lock:
+            self._store[(site, key, stage)] = entry
+            self._store.move_to_end((site, key, stage))
+            while len(self._store) > int(env_registry.get(ENV_CAP)):
+                self._store.popitem(last=False)
+        self.executables.inc(site=site)
+        if ratio is not None:
+            lo, hi = drift_band()
+            if not lo <= ratio <= hi:
+                self.drift_alerts.inc(site=site)
+                recorder.note(
+                    "cost_drift", site=site, key=key, stage=stage,
+                    ratio=round(ratio, 4),
+                    measured=cost.boundary_bytes, modeled=modeled_bytes,
+                )
+                get_logger().warning(
+                    "cost drift alert: %s/%s stage %s ratio %.3f outside "
+                    "[%.2f, %.2f] (measured %d B vs modelled %d B)",
+                    site, key, stage, ratio, lo, hi,
+                    int(cost.boundary_bytes), int(modeled_bytes),
+                )
+        return ratio
+
+    def on_extract_failure(self, site: str) -> None:
+        self.failures.inc(site=site)
+
+    def entries(self) -> dict[tuple[str, str, str], dict]:
+        with self._lock:
+            return dict(self._store)
+
+    def drift(self, site: str, key: str, stage: str = "all") -> float | None:
+        with self._lock:
+            e = self._store.get((site, key, stage))
+        return None if e is None else e.get("drift_ratio")
+
+    def snapshot(self) -> dict:
+        entries = self.entries()
+        alerts = {
+            s: int(self.drift_alerts.value(site=s)) for s in SITES
+        }
+        return {
+            "entries": len(entries),
+            "attributed": {
+                s: int(self.executables.value(site=s)) for s in SITES
+            },
+            "drift_alerts": alerts,
+            "ratios": {
+                f"{site}/{key}/{stage}": e["drift_ratio"]
+                for (site, key, stage), e in entries.items()
+                if e.get("drift_ratio") is not None
+            },
+        }
+
+
+# the shared ledger every compile site reports into (see class docstring)
+cost_ledger = CostLedger()
+
+
+# --------------------------------------------------------------------------
+# AOT attribution wrappers
+# --------------------------------------------------------------------------
+
+
+class CompiledOrJit:
+    """The costed AOT executable with the original jit callable behind
+    it: matching-shape calls hit the compiled artifact (the very one the
+    cost record describes), anything else — a novel shape, or the first
+    compiled-call failure — falls back to the jit path permanently for
+    that shape class. Never raises beyond what the jit callable would."""
+
+    __slots__ = ("_compiled", "_jit", "_shapes", "_use_compiled")
+
+    def __init__(self, compiled, jitted, args):
+        self._compiled = compiled
+        self._jit = jitted
+        self._shapes = tuple(
+            (getattr(a, "shape", None), str(getattr(a, "dtype", "")))
+            for a in args
+        )
+        self._use_compiled = True
+
+    def _matches(self, args) -> bool:
+        if len(args) != len(self._shapes):
+            return False
+        return all(
+            (getattr(a, "shape", None), str(getattr(a, "dtype", "")))
+            == want
+            for a, want in zip(args, self._shapes)
+        )
+
+    def __call__(self, *args):
+        if self._use_compiled and self._matches(args):
+            try:
+                return self._compiled(*args)
+            except Exception:
+                # e.g. a sharding/placement mismatch the AOT path is
+                # stricter about than jit dispatch: degrade once, serve on
+                self._use_compiled = False
+        return self._jit(*args)
+
+    def lower(self, *args, **kwargs):
+        """AOT passthrough — HLO-inspection callers keep working."""
+        return self._jit.lower(*args, **kwargs)
+
+
+def extract(jitted, args: tuple | list) -> CostRecord | None:
+    """AOT-lower `jitted` for `args` and read its cost; None on any
+    failure. Pays one compile — the bench-suite measured-column path
+    (attribute_jit is the serving path, which reuses the compile)."""
+    try:
+        return cost_from_compiled(jitted.lower(*args).compile())
+    except Exception:
+        return None
+
+
+def attribute_jit(
+    site: str,
+    key: str,
+    jitted,
+    args: tuple | list,
+    *,
+    modeled_bytes: float | None = None,
+    stage: str = "all",
+    ledger: CostLedger | None = None,
+):
+    """Compile `jitted` AOT for `args`, record the attribution, and
+    return `(callable, CostRecord | None)`. The callable is the costed
+    executable (wrapped with the jit fallback) when extraction worked,
+    the original `jitted` otherwise — callers always get something
+    serviceable, and the jit trace ran exactly once either way."""
+    led = ledger or cost_ledger
+    if not enabled():
+        return jitted, None
+    try:
+        compiled = jitted.lower(*args).compile()
+        cost = cost_from_compiled(compiled)
+    except Exception as e:
+        led.on_extract_failure(site)
+        get_logger().debug(
+            "cost attribution for %s/%s failed (%s): serving the "
+            "un-attributed callable", site, key, type(e).__name__,
+        )
+        return jitted, None
+    if cost is None:
+        led.on_extract_failure(site)
+        return jitted, None
+    led.record(site, key, cost, modeled_bytes=modeled_bytes, stage=stage)
+    return CompiledOrJit(compiled, jitted, args), cost
+
+
+class LazyAttributedFn:
+    """Deferred attribution for caches that compile before the call
+    shapes exist (stream TileFnCache, per-tenant graph caches): the
+    FIRST call AOT-compiles with the live arguments (one compile — the
+    jit path would have compiled here anyway), records the attribution,
+    and keeps the costed executable for that shape; later novel shapes
+    ride the jit callable exactly as before."""
+
+    __slots__ = ("_jit", "_site", "_key", "_modeled_fn", "_stage", "_inner")
+
+    def __init__(self, site: str, key: str, jitted, *, modeled_fn=None,
+                 stage: str = "all"):
+        self._jit = jitted
+        self._site = site
+        self._key = key
+        # modeled_fn(args) -> planner-modelled boundary bytes for this
+        # call signature (None = record cost without a drift check)
+        self._modeled_fn = modeled_fn
+        self._stage = stage
+        self._inner = None
+
+    def __call__(self, *args):
+        if self._inner is None:
+            modeled = None
+            if self._modeled_fn is not None:
+                try:
+                    modeled = self._modeled_fn(args)
+                except Exception:
+                    modeled = None
+            self._inner, _cost = attribute_jit(
+                self._site, self._key, self._jit, args,
+                modeled_bytes=modeled, stage=self._stage,
+            )
+        return self._inner(*args)
+
+    def lower(self, *args, **kwargs):
+        """AOT passthrough — HLO-inspection callers keep working."""
+        return self._jit.lower(*args, **kwargs)
+
+
+def wrap_cache_fn(site: str, key: str, jitted, *, modeled_fn=None):
+    """The compile-cache insertion hook: lazy attribution when the layer
+    is enabled, the bare callable when not (mcim-check's
+    obs-cost-attribution rule verifies every insertion site calls
+    this or attribute_jit)."""
+    if not enabled():
+        return jitted
+    return LazyAttributedFn(site, key, jitted, modeled_fn=modeled_fn)
+
+
+# --------------------------------------------------------------------------
+# per-stage plan attribution (the megakernel one-read-one-write gate)
+# --------------------------------------------------------------------------
+
+
+def _shape_bytes(aval) -> int:
+    import numpy as np
+
+    return int(np.prod(aval.shape, dtype=np.int64)) * aval.dtype.itemsize
+
+
+def attribute_plan(
+    plan,
+    shape: tuple,
+    *,
+    impl: str = "xla",
+    pallas: bool = False,
+    interpret: bool | None = None,
+    ledger: CostLedger | None = None,
+) -> list[dict]:
+    """Attribute every stage of a built plan at `shape` — one AOT
+    compile per stage, drift ratio per stage label `s<i>/<kind>`, keyed
+    by the plan's fingerprint. This is the structural megakernel gate:
+    stage executables whose boundary is anything but one u8 read + one
+    u8 write (+ the halo'd context the model includes) trip the alert.
+
+    Returns `[{stage, names, modeled_bytes, cost, drift_ratio}, ...]`
+    (stages that fail extraction carry cost=None)."""
+    import jax
+    import numpy as np
+
+    from mpi_cuda_imagemanipulation_tpu.plan.exec import run_stage_full
+
+    led = ledger or cost_ledger
+    key = plan.fingerprint
+    out: list[dict] = []
+    aval = jax.ShapeDtypeStruct(tuple(shape), np.uint8)
+    for i, st in enumerate(plan.stages):
+        if st.kind in ("geometric", "global"):
+            fn = jax.jit(lambda x, o=st.ops[0]: o(x))
+        elif pallas:
+            from mpi_cuda_imagemanipulation_tpu.plan.pallas_exec import (
+                run_stage_pallas,
+                stage_pallas_reject,
+            )
+
+            h, w = aval.shape[0], aval.shape[1]
+            ch = aval.shape[2] if len(aval.shape) == 3 else 1
+            if stage_pallas_reject(st, h, w, ch) is None:
+                fn = jax.jit(
+                    lambda x, s=st: run_stage_pallas(
+                        s, x, interpret=interpret
+                    )
+                )
+            else:
+                fn = jax.jit(lambda x, s=st: run_stage_full(s, x, impl))
+        else:
+            fn = jax.jit(lambda x, s=st: run_stage_full(s, x, impl))
+        out_aval = jax.eval_shape(fn, aval)
+        # the planner's model: the stage reads its u8 input once and
+        # writes its u8 output once — absorbed member ops contribute
+        # NOTHING at the executable boundary
+        modeled = float(_shape_bytes(aval) + _shape_bytes(out_aval))
+        stage_label = f"s{i}/{st.kind}"
+        arg = np.zeros(aval.shape, np.uint8)
+        cost = extract(fn, [arg])
+        entry = {
+            "stage": stage_label,
+            "names": list(st.names),
+            "modeled_bytes": modeled,
+            "cost": None if cost is None else cost.to_dict(),
+            "drift_ratio": None,
+        }
+        if cost is None:
+            led.on_extract_failure("plan")
+        else:
+            entry["drift_ratio"] = led.record(
+                "plan", key, cost, modeled_bytes=modeled, stage=stage_label
+            )
+        out.append(entry)
+        aval = out_aval
+    return out
+
+
+# --------------------------------------------------------------------------
+# measured roofline helpers
+# --------------------------------------------------------------------------
+
+
+def peak_gb_s(tpu_gen: str | None = None) -> float:
+    """The roofline denominator: MCIM_COST_PEAK_GBS when set, else the
+    datasheet table keyed by TPU generation (bench_suite.HBM_GB_S)."""
+    override = env_registry.get(ENV_PEAK_GBS)
+    if override:
+        return float(override)
+    from mpi_cuda_imagemanipulation_tpu.bench_suite import HBM_GB_S
+
+    return HBM_GB_S.get(tpu_gen or "v5e", HBM_GB_S["v5e"])
+
+
+def measured_gb_s(nbytes: float, seconds: float, chips: int = 1) -> float:
+    return nbytes / max(seconds, 1e-12) / max(chips, 1) / 1e9
+
+
+def measured_roofline_frac(
+    nbytes: float, seconds: float, *, chips: int = 1,
+    tpu_gen: str | None = None,
+) -> float:
+    return measured_gb_s(nbytes, seconds, chips) / peak_gb_s(tpu_gen)

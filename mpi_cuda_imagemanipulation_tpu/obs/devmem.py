@@ -1,0 +1,129 @@
+"""Device-memory observability — live/peak HBM gauges from the runtime.
+
+A traffic-serving pod cannot run blind on HBM: a compile-cache growing
+past its budget, a leaked donation, or a tenant's oversized graph shows
+up FIRST as shrinking allocator headroom, and only later (fatally) as an
+OOM mid-dispatch. This module turns `device.memory_stats()` — the
+allocator's own live counters on TPU/GPU backends — into callback gauges
+on the serving registry, so every scrape (and every heartbeat's
+federation delta, obs/fleet.py) carries the current picture per replica:
+
+    mcim_devmem_bytes_in_use{device}       live allocator bytes
+    mcim_devmem_peak_bytes_in_use{device}  high-water mark
+    mcim_devmem_bytes_limit{device}        allocator pool limit
+    mcim_devmem_headroom_frac{device}      (limit - in_use) / limit
+
+At the router the federated gauges gain a `{replica=...}` label (gauges
+are never summed — a pod-mean headroom is a lie), and the SLO engine can
+alert on the WORST replica's headroom via the `headroom:<min_frac>:<pct>`
+spec kind (obs/slo.py): "99% of evaluation ticks must see >= 10%
+headroom on every device of every replica" is a declarative objective,
+not a dashboard eyeball.
+
+CPU backends report no `memory_stats()` (the gauges render empty — the
+fleet view simply has no devmem series), so tests and the CPU smoke
+inject a `stats_fn` returning the same mapping shape the TPU runtime
+produces. Keys follow jax's PJRT stats: `bytes_in_use`,
+`peak_bytes_in_use`, `bytes_limit` (absent keys read 0)."""
+
+from __future__ import annotations
+
+from mpi_cuda_imagemanipulation_tpu.obs.metrics import Registry
+
+
+def device_memory_stats() -> dict[str, dict]:
+    """`{device_label: stats}` for every local device that reports
+    allocator stats; {} on backends (CPU) that return None."""
+    import jax
+
+    out: dict[str, dict] = {}
+    try:
+        devices = jax.local_devices()
+    except Exception:
+        return out
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if stats:
+            out[f"{d.platform}:{d.id}"] = dict(stats)
+    return out
+
+
+class DevMemGauges:
+    """The gauge family over one stats source. Construct once per app
+    registry (ServeApp does); `stats_fn` defaults to the live runtime
+    and is injectable for CPU tests."""
+
+    def __init__(self, registry: Registry, stats_fn=None):
+        self.registry = registry
+        self._stats_fn = stats_fn or device_memory_stats
+
+        def field(name: str):
+            def read() -> dict:
+                return {
+                    (dev,): float(stats.get(name, 0) or 0)
+                    for dev, stats in self._stats_fn().items()
+                }
+
+            return read
+
+        self.in_use = registry.gauge(
+            "mcim_devmem_bytes_in_use",
+            "Live allocator bytes per device (device.memory_stats).",
+            labels=("device",),
+            fn=field("bytes_in_use"),
+        )
+        self.peak = registry.gauge(
+            "mcim_devmem_peak_bytes_in_use",
+            "Peak allocator bytes per device since process start.",
+            labels=("device",),
+            fn=field("peak_bytes_in_use"),
+        )
+        self.limit = registry.gauge(
+            "mcim_devmem_bytes_limit",
+            "Allocator pool limit per device.",
+            labels=("device",),
+            fn=field("bytes_limit"),
+        )
+        self.headroom = registry.gauge(
+            "mcim_devmem_headroom_frac",
+            "Fraction of the allocator pool still free per device — the "
+            "SLO-able OOM-distance signal (headroom:<frac>:<pct> specs).",
+            labels=("device",),
+            fn=self._headroom,
+        )
+        self.devices = registry.gauge(
+            "mcim_devmem_devices",
+            "Devices reporting allocator stats (0 on CPU backends).",
+            fn=lambda: float(len(self._stats_fn())),
+        )
+
+    def _headroom(self) -> dict:
+        out = {}
+        for dev, stats in self._stats_fn().items():
+            limit = float(stats.get("bytes_limit", 0) or 0)
+            if limit <= 0:
+                continue
+            in_use = float(stats.get("bytes_in_use", 0) or 0)
+            out[(dev,)] = max(0.0, (limit - in_use) / limit)
+        return out
+
+    def snapshot(self) -> dict:
+        """The /stats section: raw per-device numbers plus headroom."""
+        stats = self._stats_fn()
+        return {
+            dev: {
+                "bytes_in_use": s.get("bytes_in_use", 0),
+                "peak_bytes_in_use": s.get("peak_bytes_in_use", 0),
+                "bytes_limit": s.get("bytes_limit", 0),
+                "headroom_frac": (
+                    (s["bytes_limit"] - s.get("bytes_in_use", 0))
+                    / s["bytes_limit"]
+                    if s.get("bytes_limit")
+                    else None
+                ),
+            }
+            for dev, s in stats.items()
+        }

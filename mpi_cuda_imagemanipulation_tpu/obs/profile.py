@@ -22,6 +22,8 @@ import glob
 import gzip
 import json
 import os
+import threading
+import time
 from collections import defaultdict
 
 # event names that are DMA/copy-shaped on XLA device tracks — the split's
@@ -156,6 +158,122 @@ def summary_table(summary: dict) -> list[str]:
             f"{t['total_us']} | {t['count']} |"
         )
     return lines
+
+
+# --------------------------------------------------------------------------
+# on-demand live capture (the fleet `POST /control/profile` unit)
+# --------------------------------------------------------------------------
+
+ENV_PROFILE_DIR = "MCIM_PROFILE_DIR"
+ENV_PROFILE_MIN_INTERVAL_S = "MCIM_PROFILE_MIN_INTERVAL_S"
+ENV_PROFILE_MAX_S = "MCIM_PROFILE_MAX_S"
+ENV_PROFILE_DEFAULT_S = "MCIM_PROFILE_DEFAULT_S"
+
+
+class ProfileUnavailable(RuntimeError):
+    """A capture cannot run NOW: one is already in flight, or the
+    per-process rate limit has not elapsed. Maps to HTTP 429 — live
+    profiling is deliberately expensive and a fleet control plane must
+    not be able to stack captures on a serving replica."""
+
+    def __init__(self, reason: str, retry_after_s: float):
+        super().__init__(reason)
+        self.reason = reason
+        self.retry_after_s = max(retry_after_s, 1.0)
+
+
+_capture_lock = threading.Lock()  # one capture per process, ever
+_last_capture_ts = 0.0
+_capture_seq = 0
+
+
+def capture_live(
+    seconds: float | None = None,
+    *,
+    out_dir: str | None = None,
+    sleep=time.sleep,
+) -> dict:
+    """One rate-limited `jax.profiler` capture UNDER LIVE TRAFFIC: start
+    the device profiler, keep serving for `seconds` (capped at
+    MCIM_PROFILE_MAX_S — the capture window must stay well under the
+    router's forward timeout), stop, merge the process's obs host spans
+    onto the device timeline, write the merged Perfetto artifact, and
+    file a `profile_capture` flight-recorder dump naming it.
+
+    Returns {artifact, device_trace_dir, seconds, host_events,
+    device_events, summary}. Raises ProfileUnavailable (HTTP 429) when a
+    capture is in flight or the MCIM_PROFILE_MIN_INTERVAL_S limit has
+    not elapsed — never leaves the profiler running."""
+    from mpi_cuda_imagemanipulation_tpu.obs import recorder
+    from mpi_cuda_imagemanipulation_tpu.obs import trace as obs_trace
+    from mpi_cuda_imagemanipulation_tpu.utils import env as env_registry
+
+    global _last_capture_ts, _capture_seq
+    max_s = float(env_registry.get(ENV_PROFILE_MAX_S))
+    default_s = float(env_registry.get(ENV_PROFILE_DEFAULT_S))
+    min_interval = float(env_registry.get(ENV_PROFILE_MIN_INTERVAL_S))
+    seconds = min(max(float(seconds or default_s), 0.1), max_s)
+    if not _capture_lock.acquire(blocking=False):
+        raise ProfileUnavailable("capture already in flight", seconds)
+    try:
+        now = time.time()
+        since = now - _last_capture_ts
+        if _last_capture_ts and since < min_interval:
+            raise ProfileUnavailable(
+                f"rate limited ({since:.1f}s since last capture, min "
+                f"{min_interval:.0f}s)",
+                min_interval - since,
+            )
+        _last_capture_ts = now
+        _capture_seq += 1
+        seq = _capture_seq
+        base = out_dir or env_registry.get(ENV_PROFILE_DIR) or os.path.join(
+            "artifacts", "profile"
+        )
+        run_dir = os.path.join(base, f"capture_{os.getpid()}_{seq}")
+        os.makedirs(run_dir, exist_ok=True)
+        import jax
+
+        jax.profiler.start_trace(run_dir)
+        try:
+            # the capture window: traffic keeps flowing on the serving
+            # threads while the profiler records them
+            sleep(seconds)
+        finally:
+            jax.profiler.stop_trace()
+        tracer = obs_trace.get_tracer()
+        host_events = tracer.chrome_events() if tracer is not None else []
+        device_events = load_device_trace(run_dir)
+        merged = merge_traces(host_events, device_events)
+        artifact = os.path.join(run_dir, "merged_trace.json")
+        with open(artifact, "w") as f:
+            json.dump(
+                {"traceEvents": merged, "displayTimeUnit": "ms"}, f
+            )
+        summary = summarize(merged)
+        result = {
+            "artifact": artifact,
+            "device_trace_dir": run_dir,
+            "seconds": seconds,
+            "host_events": sum(
+                1 for e in host_events if e.get("ph") != "M"
+            ),
+            "device_events": sum(
+                1 for e in device_events if e.get("ph") != "M"
+            ),
+            "summary": summary,
+        }
+        recorder.dump(
+            "profile_capture",
+            extra={
+                "artifact": artifact,
+                "seconds": seconds,
+                "device_events": result["device_events"],
+            },
+        )
+        return result
+    finally:
+        _capture_lock.release()
 
 
 def merge_and_summarize(host_path: str, device_path: str,

@@ -38,6 +38,10 @@ production wiring fires it on:
     canary_rollback the canary rollback gate auto-reverted a config flip
                     (fabric/router — the dump carries the canary-vs-
                     stable outcome counts and shadow mismatches)
+    profile_capture an on-demand fleet profile capture completed
+                    (obs/profile.capture_live — the dump names the
+                    merged-trace artifact so the post-mortem and the
+                    profile join on the same window)
     manual          operator/test-initiated (`dump("manual")`)
 
 Dumps are rate-limited per trigger (`MCIM_RECORDER_MIN_INTERVAL_S`) so a
@@ -74,6 +78,7 @@ KNOWN_TRIGGERS = (
     "autoscale",
     "preempt",
     "canary_rollback",
+    "profile_capture",
     "manual",
 )
 

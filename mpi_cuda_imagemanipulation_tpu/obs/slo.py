@@ -18,11 +18,18 @@ Spec grammar (`MCIM_SLO_SPECS` / `--slo`, comma-separated):
     latency:0.25:99       latency: 99% of requests complete within 0.25 s
                           (the bound must be a histogram bucket edge;
                           good = cumulative count at that bucket)
+    headroom:0.1:99       device memory: 99% of evaluation ticks must
+                          see >= 10% allocator headroom on EVERY device
+                          of EVERY fresh replica (the federated
+                          mcim_devmem_headroom_frac gauges, obs/devmem
+                          — each tick is one good/bad event, so the
+                          same burn-rate machinery applies)
 
-Both read the FEDERATED `mcim_serve_requests_total` /
-`mcim_serve_e2e_latency_seconds` families (obs/fleet.py), so the burn
-rates are fleet-wide — a single replica melting down moves them in
-proportion to its traffic share, which is what an error budget means.
+All kinds read the FEDERATED families (obs/fleet.py) —
+`mcim_serve_requests_total`, `mcim_serve_e2e_latency_seconds`,
+`mcim_devmem_headroom_frac` — so the burn rates are fleet-wide — a
+single replica melting down moves them in proportion to its traffic
+share, which is what an error budget means.
 
 The engine samples those cumulative counters into a bounded ring each
 tick and differences ring endpoints to get windowed rates — no
@@ -62,9 +69,11 @@ _AVAIL_EXCLUDED_STATUSES = ("rejected",)
 @dataclasses.dataclass(frozen=True)
 class SLOSpec:
     name: str
-    kind: str  # "availability" | "latency"
+    kind: str  # "availability" | "latency" | "headroom"
     target: float  # good fraction in (0, 1)
-    le: float | None = None  # latency bound in seconds (bucket edge)
+    # latency: bound in seconds (bucket edge); headroom: the minimum
+    # free-fraction every device must keep
+    le: float | None = None
 
     @property
     def budget(self) -> float:
@@ -110,11 +119,25 @@ def parse_slo_specs(spec: str) -> tuple[SLOSpec, ...]:
                     )
                 )
                 continue
+            if parts[0] == "headroom" and len(parts) == 3:
+                frac = float(parts[1])
+                pct = float(parts[2])
+                if not 0.0 < frac < 1.0 or not 0.0 < pct < 100.0:
+                    raise ValueError
+                out.append(
+                    SLOSpec(
+                        name=f"headroom_{parts[1]}_{parts[2]}",
+                        kind="headroom",
+                        target=pct / 100.0,
+                        le=frac,
+                    )
+                )
+                continue
             raise ValueError
         except ValueError:
             raise ValueError(
-                f"bad SLO spec token {tok!r} (want avail:<pct> or "
-                "latency:<le_seconds>:<pct>)"
+                f"bad SLO spec token {tok!r} (want avail:<pct>, "
+                "latency:<le_seconds>:<pct> or headroom:<min_frac>:<pct>)"
             ) from None
     return tuple(out)
 
@@ -124,13 +147,30 @@ def fleet_slo_source(merged_fn):
     cumulative counts. `merged_fn()` is `FleetAggregator.merged` (or any
     callable returning the same shape, which is what the tests inject)."""
 
+    # headroom specs turn each evaluation tick into one good/bad event
+    # (gauges have no cumulative counter to difference); the accumulators
+    # live here so the ring-endpoint machinery sees monotone counts
+    headroom_cum: dict[str, list[float]] = {}
+
     def source(specs: tuple[SLOSpec, ...]) -> dict[str, tuple[float, float]]:
         merged = merged_fn()
         out: dict[str, tuple[float, float]] = {}
         req = merged.get("mcim_serve_requests_total")
         lat = merged.get("mcim_serve_e2e_latency_seconds")
+        hr = merged.get("mcim_devmem_headroom_frac")
         for s in specs:
             good = total = 0.0
+            if s.kind == "headroom":
+                cum = headroom_cum.setdefault(s.name, [0.0, 0.0])
+                series = (hr or {}).get("series", {})
+                if series:
+                    # the WORST device of the WORST fresh replica decides
+                    worst = min(series.values())
+                    cum[1] += 1.0
+                    if worst >= (s.le or 0.0):
+                        cum[0] += 1.0
+                out[s.name] = (cum[0], cum[1])
+                continue
             if s.kind == "availability" and req is not None:
                 for key, v in req["series"].items():
                     status = key[0] if key else ""

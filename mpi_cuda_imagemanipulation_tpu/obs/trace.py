@@ -36,6 +36,23 @@ host stalls, DMA and compute land on one picture.
 Timestamps use `time.perf_counter()` relative to the tracer's start, in
 microseconds — the Chrome trace unit. Sampling is deterministic (every
 k-th trace at rate 1/k), so a traced A/B re-run selects the same requests.
+
+**Deferred tail keep** (`MCIM_TRACE_TAIL`, the armed-tracer default):
+root-decided sampling has a blind spot — at sample 0.01 the error you
+need to debug and the p99 outlier you need to explain are, with 99%
+probability, exactly the traces the root decision threw away. With a
+tail buffer armed, a sampled-OUT root still records: its spans go to a
+BOUNDED side buffer (`tail` concurrently-open traces; the oldest evicts
+when full), and when the root span ends the trace is either PROMOTED
+into the real event buffer — the root recorded an error/quarantine/
+deadline-class status, or its duration sits at/above the p99 of recent
+roots — or dropped wholesale. Exemplars for slow traces therefore
+resolve in the export even under aggressive sampling, and
+`trace_kept(trace_id)` tells reporting layers (serve/loadgen's
+slow-trace column) which ids actually resolve. Sampled-IN behavior,
+and the disarmed zero-cost contract, are unchanged; sampled-out
+requests now cost a bounded buffer instead of nothing — set
+MCIM_TRACE_TAIL=0 for the old behavior.
 """
 
 from __future__ import annotations
@@ -46,7 +63,7 @@ import math
 import os
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from typing import NamedTuple
 
 from mpi_cuda_imagemanipulation_tpu.obs import recorder
@@ -146,11 +163,23 @@ class Span:
         return False
 
 
+# root statuses that must NOT promote a buffered tail trace: intentional
+# outcomes (ok, explicit sheds, client garbage) — a shed storm promoting
+# every trace would defeat sampling exactly when it matters most
+_TAIL_BENIGN_STATUSES = {
+    "ok", "overloaded", "shed", "rejected",
+    "200", "204", "400", "429", "503",
+}
+# minimum recent-root sample before the slow-promotion threshold engages
+_TAIL_MIN_DURS = 32
+
+
 class Tracer:
     """Span collector: bounded event buffer behind one lock, deterministic
-    trace-level sampling, Chrome trace-event export."""
+    trace-level sampling, deferred tail keep, Chrome trace-event export."""
 
-    def __init__(self, *, sample: float = 1.0, max_events: int = 200_000):
+    def __init__(self, *, sample: float = 1.0, max_events: int = 200_000,
+                 tail: int = 0):
         if not 0.0 <= sample <= 1.0:
             raise ValueError(f"sample must be in [0, 1], got {sample}")
         self.sample = sample
@@ -160,6 +189,18 @@ class Tracer:
         self._next_span = 0
         self._n_traces = 0
         self._n_sampled = 0
+        # deferred tail keep (module docstring): sampled-out traces buffer
+        # here until their root decides; bounded at `tail` open traces
+        self.tail_cap = max(0, int(tail))
+        self._tail: OrderedDict[str, list] = OrderedDict()
+        # recently dropped provisional ids (bounded): trace_kept() answers
+        # "will this id resolve in the export" for reporting layers
+        self._tail_dropped: OrderedDict[str, None] = OrderedDict()
+        self._root_durs: deque = deque(maxlen=512)
+        self.tail_counts = {
+            "buffered": 0, "kept_error": 0, "kept_slow": 0,
+            "dropped": 0, "evicted": 0,
+        }
         self.t0 = time.perf_counter()
         # run-unique trace-id prefix so merged multi-process traces never
         # collide (pid + coarse start time)
@@ -197,7 +238,18 @@ class Tracer:
             if take:
                 self._n_sampled += 1
         if not take:
-            return NOOP_SPAN
+            if self.tail_cap <= 0:
+                return NOOP_SPAN
+            # deferred tail keep: record this trace provisionally; the
+            # root's end decides promote-or-drop (module docstring)
+            trace_id = f"{self._prefix}-{n:x}"
+            with self._lock:
+                self._tail[trace_id] = []
+                self.tail_counts["buffered"] += 1
+                while len(self._tail) > self.tail_cap:
+                    old_tid, _evs = self._tail.popitem(last=False)
+                    self._mark_dropped_locked(old_tid)
+                    self.tail_counts["evicted"] += 1
         trace_id = trace_id or f"{self._prefix}-{n:x}"
         span = self._new_span(name, trace_id, 0, args)
         span.args.setdefault("trace_id", trace_id)
@@ -225,13 +277,18 @@ class Tracer:
         tid = threading.get_ident()
         args.setdefault("trace_id", parent.trace_id)
         args.setdefault("parent_id", parent.span_id)
+        ev = {
+            "ph": "i", "s": "t", "name": name, "ts": ts,
+            "tid": tid, "args": args,
+        }
         with self._lock:
             if tid not in self._thread_names:
                 self._thread_names[tid] = threading.current_thread().name
-            self._events.append({
-                "ph": "i", "s": "t", "name": name, "ts": ts,
-                "tid": tid, "args": args,
-            })
+            buf = self._tail.get(parent.trace_id)
+            if buf is not None:
+                buf.append(ev)  # provisional: the root's end decides
+            elif parent.trace_id not in self._tail_dropped:
+                self._events.append(ev)
 
     def _record(self, span: Span, t1: float) -> None:
         ts = (span.t0 - self.t0) * 1e6
@@ -241,12 +298,25 @@ class Tracer:
         if span.parent_id:
             args.setdefault("parent_id", span.parent_id)
         dur_us = max((t1 - span.t0) * 1e6, 0.0)
+        ev = {
+            "ph": "X", "name": span.name, "ts": ts,
+            "dur": dur_us,
+            "tid": span.tid, "args": args,
+        }
+        is_root = span.parent_id == 0
         with self._lock:
-            self._events.append({
-                "ph": "X", "name": span.name, "ts": ts,
-                "dur": dur_us,
-                "tid": span.tid, "args": args,
-            })
+            buf = self._tail.get(span.trace_id)
+            if buf is not None:
+                buf.append(ev)
+                if is_root:
+                    # the provisional trace is complete: promote or drop
+                    self._decide_tail_locked(span.trace_id, args, dur_us)
+            elif span.trace_id not in self._tail_dropped:
+                self._events.append(ev)
+            if is_root:
+                # every root (sampled-in included) feeds the slow
+                # threshold, so "p99-slow" means p99 of ALL roots
+                self._root_durs.append(dur_us)
         # flight-recorder summary (obs/recorder.py): the always-on ring
         # keeps recent span names/durations even after this buffer wraps,
         # so a post-mortem dump shows what the process was doing
@@ -254,6 +324,53 @@ class Tracer:
             "span", name=span.name, dur_ms=dur_us / 1e3,
             trace_id=span.trace_id,
         )
+
+    # -- deferred tail keep (all called under self._lock) --------------------
+
+    def _mark_dropped_locked(self, trace_id: str) -> None:
+        self._tail_dropped[trace_id] = None
+        while len(self._tail_dropped) > 4096:
+            self._tail_dropped.popitem(last=False)
+
+    def _tail_reason_locked(self, args: dict, dur_us: float) -> str | None:
+        if "error" in args:
+            return "error"
+        status = args.get("status")
+        if (
+            status is not None
+            and str(status) not in _TAIL_BENIGN_STATUSES
+        ):
+            # quarantined / deadline_expired / 422 / 5xx / anything the
+            # caller flagged beyond the intentional outcomes
+            return "error"
+        if len(self._root_durs) >= _TAIL_MIN_DURS:
+            durs = sorted(self._root_durs)
+            p99 = durs[min(len(durs) - 1, int(0.99 * len(durs)))]
+            if dur_us >= p99:
+                return "slow"
+        return None
+
+    def _decide_tail_locked(
+        self, trace_id: str, root_args: dict, dur_us: float
+    ) -> None:
+        buf = self._tail.pop(trace_id, None)
+        if buf is None:
+            return
+        reason = self._tail_reason_locked(root_args, dur_us)
+        if reason is None:
+            self._mark_dropped_locked(trace_id)
+            self.tail_counts["dropped"] += 1
+            return
+        root_args.setdefault("tail_kept", reason)
+        self._events.extend(buf)
+        self.tail_counts[f"kept_{reason}"] += 1
+
+    def trace_kept(self, trace_id: str) -> bool:
+        """Whether `trace_id` will resolve in this tracer's export:
+        False only for a provisional trace that was dropped/evicted
+        (in-flight and sampled-in ids report True)."""
+        with self._lock:
+            return trace_id not in self._tail_dropped
 
     # -- reporting ---------------------------------------------------------
 
@@ -264,6 +381,8 @@ class Tracer:
                 "sampled": self._n_sampled,
                 "events": len(self._events),
                 "sample": self.sample,
+                "tail": dict(self.tail_counts),
+                "tail_open": len(self._tail),
             }
 
     def drain(self) -> list[dict]:
@@ -309,16 +428,30 @@ class Tracer:
 # -- module-level default tracer (the CLI/server wiring surface) -----------
 
 ENV_SAMPLE = "MCIM_TRACE_SAMPLE"
+ENV_TAIL = "MCIM_TRACE_TAIL"
+
+
+def _tail_from_env(env=None) -> int:
+    from mpi_cuda_imagemanipulation_tpu.utils import env as env_registry
+
+    raw = env_registry.get(ENV_TAIL, env=env)
+    return int(raw) if raw else 0
+
 
 _tracer: Tracer | None = None
 _enabled = False  # lock-free fast-path flag, flipped only by (de)configure
 
 
-def configure(*, sample: float = 1.0, max_events: int = 200_000) -> Tracer:
+def configure(*, sample: float = 1.0, max_events: int = 200_000,
+              tail: int | None = None) -> Tracer:
     """Arm the process-wide tracer (idempotent per call: a fresh buffer).
-    `--trace-sample` < 1 keeps tracing cheap enough to leave on."""
+    `--trace-sample` < 1 keeps tracing cheap enough to leave on; the
+    deferred tail-keep buffer (`tail`, default MCIM_TRACE_TAIL) then
+    guarantees error/quarantine/p99-slow traces still export."""
     global _tracer, _enabled
-    _tracer = Tracer(sample=sample, max_events=max_events)
+    if tail is None:
+        tail = _tail_from_env()
+    _tracer = Tracer(sample=sample, max_events=max_events, tail=tail)
     _enabled = True
     return _tracer
 
@@ -329,7 +462,9 @@ def configure_from_env(env=None) -> Tracer | None:
 
     raw = env_registry.get(ENV_SAMPLE, env=env)
     if raw:
-        return configure(sample=float(raw))
+        return configure(
+            sample=float(raw), tail=_tail_from_env(env)
+        )
     return None
 
 
@@ -382,3 +517,13 @@ def export(path: str) -> int:
     if _tracer is None:
         return 0
     return _tracer.export(path)
+
+
+def trace_kept(trace_id: str) -> bool:
+    """Whether `trace_id` resolves in the default tracer's export: False
+    only for a tail-dropped provisional trace. Reporting layers use this
+    to prefer ids a reader can actually pull up (serve/loadgen's
+    slow-trace column)."""
+    if not _enabled or _tracer is None or not trace_id:
+        return True
+    return _tracer.trace_kept(trace_id)

@@ -75,6 +75,12 @@ KNOWN_SITES = (
                         # maintenance eviction is testable on CPU without
                         # a cloud metadata server (mode `after:N` models
                         # "preempted after N beats")
+    "cost.model",       # obs/cost.py CostLedger.record: a hit is a
+                        # DELIBERATE MIS-MODEL, not a fault — the
+                        # planner-modelled bytes are corrupted 4x so the
+                        # drift ratio lands outside the band and the
+                        # alert path (counter + recorder note) is
+                        # CI-provable end to end
     "plan.fuse",        # plan/planner.py build_plan: the fusion decision
                         # itself — a hit fails a fused/pointwise build
                         # loudly BEFORE any executable exists, so callers'

@@ -22,6 +22,7 @@ import time
 import numpy as np
 
 from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline
+from mpi_cuda_imagemanipulation_tpu.obs import cost as obs_cost
 from mpi_cuda_imagemanipulation_tpu.resilience import failpoints
 from mpi_cuda_imagemanipulation_tpu.resilience.retry import (
     RetryPolicy,
@@ -116,6 +117,27 @@ class CompileCache:
             plan=self.plan,
         )
 
+    def _out_channels(self, ch: int) -> int:
+        chan = ch
+        for op in self.pipe.ops:
+            chan = op.out_channels or chan
+        return chan
+
+    def _modeled_bytes(self, key: Key) -> float:
+        """The planner's boundary model for one serving executable: the
+        u8 input stack in, the u8 output stack out, plus the two i32
+        true-shape vectors — NOTHING else crosses the boundary no matter
+        how many ops the plan fused (the one-read-one-write contract,
+        checked against memory_analysis by the cost ledger). Mesh-
+        sharded executables report PER-DEVICE sizes in memory_analysis
+        (each shard holds batch/n_dev), so the model divides out the
+        mesh — the contract is per chip, like every roofline figure."""
+        bh, bw, ch, nb = key
+        n_dev = self.mesh.devices.size if self.mesh is not None else 1
+        return float(
+            nb * bh * bw * (ch + self._out_channels(ch)) + 2 * 4 * nb
+        ) / n_dev
+
     def _compile_one(self, key: Key) -> None:
         bh, bw, ch, nb = key
         failpoints.maybe_fail("cache.warm", key=key)
@@ -128,7 +150,19 @@ class CompileCache:
 
         # trace + compile OUTSIDE the lock (mcim-check lock-blocking-call:
         # a multi-second XLA compile must never stall concurrent get()s on
-        # the warmed grid); the lock guards only the dict insert
+        # the warmed grid); the lock guards only the dict insert.
+        # Compilation goes through the cost-attribution layer (obs/cost):
+        # the SAME compiled executable that serves is the one whose
+        # cost_analysis/memory_analysis land in the ledger, keyed by the
+        # grid cell + the resolved plan fingerprint — one trace, one
+        # compile, measured cost.
+        fn, _cost = obs_cost.attribute_jit(
+            "serve",
+            f"{bh}x{bw}x{ch}x{nb}:{skey[-1]}",
+            fn,
+            (imgs, true, true),
+            modeled_bytes=self._modeled_bytes(key),
+        )
         jax.block_until_ready(fn(imgs, true, true))
         with self._lock:
             self._fns.setdefault(skey, fn)
@@ -192,8 +226,15 @@ class CompileCache:
             self.misses += 1
         # build OUTSIDE the lock (same contract as _compile_one: a trace
         # must never stall warmed-path gets); two racing misses may both
-        # build, setdefault keeps exactly one
-        fn = self._build(key)
+        # build, setdefault keeps exactly one. Off-grid misses attribute
+        # lazily — the first call compiles through the cost layer with
+        # the live shapes (obs/cost.wrap_cache_fn)
+        fn = obs_cost.wrap_cache_fn(
+            "serve",
+            f"{bucket_h}x{bucket_w}x{channels}x{batch}:{skey[-1]}",
+            self._build(key),
+            modeled_fn=lambda _args, k=key: self._modeled_bytes(k),
+        )
         with self._lock:
             return self._fns.setdefault(skey, fn)
 

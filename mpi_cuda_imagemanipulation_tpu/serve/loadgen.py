@@ -46,6 +46,7 @@ import time
 import numpy as np
 
 from mpi_cuda_imagemanipulation_tpu.io.image import synthetic_image
+from mpi_cuda_imagemanipulation_tpu.obs import trace as obs_trace
 from mpi_cuda_imagemanipulation_tpu.resilience import failpoints
 from mpi_cuda_imagemanipulation_tpu.serve.server import Client, ServeApp
 from mpi_cuda_imagemanipulation_tpu.utils.timing import percentiles
@@ -124,17 +125,24 @@ def run_offered_load(
         # tail attribution (obs/trace.py): when tracing is armed each
         # request carried a trace id — record the slowest completions so
         # a p99 outlier can be pulled up BY ID in the --trace-out file
-        # instead of eyeballing the whole timeline
+        # instead of eyeballing the whole timeline. Under sampled
+        # tracing with tail keep, ids that actually RESOLVE in the
+        # export (sampled-in or tail-promoted) rank ahead of
+        # provisional ids the tracer dropped — a slow-trace column full
+        # of unresolvable ids is the old blind spot in a new shape.
         slowest = sorted(
             (h for h in ok if h.trace_id),
-            key=lambda h: h.t_done - h.t_submit,
-            reverse=True,
+            key=lambda h: (
+                not obs_trace.trace_kept(h.trace_id),
+                -(h.t_done - h.t_submit),
+            ),
         )[:3]
         if slowest:
             rec["slowest_traces"] = [
                 {
                     "trace_id": h.trace_id,
                     "e2e_ms": (h.t_done - h.t_submit) * 1e3,
+                    "kept": obs_trace.trace_kept(h.trace_id),
                 }
                 for h in slowest
             ]

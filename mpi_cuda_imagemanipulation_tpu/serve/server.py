@@ -187,6 +187,13 @@ class ServeApp:
             io_threads=config.io_threads,
         )
         self._register_state_gauges()
+        # device-memory observability (obs/devmem.py): live/peak HBM +
+        # headroom gauges on the app registry — federated per replica
+        # via the heartbeat metrics delta, SLO-able at the router
+        # (headroom:<frac>:<pct> specs)
+        from mpi_cuda_imagemanipulation_tpu.obs.devmem import DevMemGauges
+
+        self.devmem = DevMemGauges(self.registry)
         # live video sessions (stream/video.VideoSessionHost): created on
         # the first session frame — a pod serving no video pays nothing
         self._session_host = None
@@ -321,17 +328,49 @@ class ServeApp:
 
     def render_metrics(self) -> str:
         """The `GET /metrics` body: Prometheus text exposition over the
-        app's registry (serving + engine + health/breaker/cache gauges)."""
-        return self.registry.render()
+        app's registry (serving + engine + health/breaker/cache/devmem
+        gauges) plus the process-wide cost ledger (obs/cost — compile
+        sites report there from many entry points)."""
+        from mpi_cuda_imagemanipulation_tpu.obs.cost import cost_ledger
+
+        return self.registry.render() + cost_ledger.registry.render()
+
+    def profile_capture(self, payload: dict) -> tuple[int, dict]:
+        """One on-demand profiler capture UNDER LIVE TRAFFIC — the
+        replica half of the fleet's `POST /control/profile` (the router
+        targets one replica and relays this). Rate-limited per process;
+        the merged host+device artifact path and summary ride back."""
+        from mpi_cuda_imagemanipulation_tpu.obs import (
+            profile as obs_profile,
+        )
+
+        try:
+            seconds = payload.get("seconds")
+            result = obs_profile.capture_live(seconds)
+        except obs_profile.ProfileUnavailable as e:
+            return 429, {
+                "status": "unavailable",
+                "error": e.reason,
+                "retry_after_s": e.retry_after_s,
+            }
+        except Exception as e:
+            return 500, {
+                "status": "error",
+                "error": f"profile capture failed: {e}",
+            }
+        return 200, {"status": "ok", **result}
 
     def fleet_registries(self) -> list[Registry]:
         """The registries this process federates to the router
-        (obs/fleet.py): the app registry (serve + engine + gauges) plus
-        the module-level plan registry (plan builds report there, and
-        serving rebuilds on calibration flips are fleet-relevant)."""
+        (obs/fleet.py): the app registry (serve + engine + gauges incl.
+        devmem), the module-level plan registry (plan builds report
+        there, and serving rebuilds on calibration flips are
+        fleet-relevant), and the cost ledger (obs/cost — drift ratios
+        and measured executable costs per replica)."""
+        from mpi_cuda_imagemanipulation_tpu.obs.cost import cost_ledger
         from mpi_cuda_imagemanipulation_tpu.plan.metrics import plan_metrics
 
-        return [self.registry, plan_metrics.registry]
+        return [self.registry, plan_metrics.registry, cost_ledger.registry]
 
     def fleet_snapshot(self) -> dict:
         """A FULL federation snapshot (the replica's `GET /fleet/snapshot`
@@ -379,6 +418,7 @@ class ServeApp:
             "health": self.health.to_dict(),
             "breakers": self.breakers.snapshot(),
             "cache": self.cache.stats(),
+            "devmem": self.devmem.snapshot(),
             "sessions": (
                 self._session_host.stats()
                 if self._session_host is not None
@@ -756,6 +796,25 @@ def _make_handler(app: ServeApp):
                 return
             if path == TENANTS_PATH:
                 self._handle_tenant_config()
+                return
+            if path == "/control/profile":
+                # on-demand live profiling (obs/profile.capture_live):
+                # the fleet router relays here after picking a replica
+                data = self._read_body()
+                try:
+                    payload = json.loads(data or b"{}")
+                except ValueError:
+                    payload = {}
+                code, resp = app.profile_capture(
+                    payload if isinstance(payload, dict) else {}
+                )
+                extra = (
+                    [("Retry-After",
+                      str(int(resp.get("retry_after_s", 1))))]
+                    if code == 429
+                    else []
+                )
+                self._send_json(code, resp, extra)
                 return
             if path != "/v1/process":
                 from mpi_cuda_imagemanipulation_tpu.fabric import (

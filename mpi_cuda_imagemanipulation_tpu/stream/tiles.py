@@ -256,11 +256,29 @@ class TileFnCache:
         self.plan = build_plan(ops, self.plan_mode)
         self._fns: dict[tuple[int, int], object] = {}
 
+    def _modeled_bytes(self, lead: int, tail: int, args) -> float:
+        """Boundary model for one tile executable: the u8 extended band
+        in (+ the traced y0 scalar), the u8 output band out — seam
+        context rides the input read, nothing else crosses no matter how
+        the plan staged the walk (the cost ledger checks this against
+        memory_analysis per compiled variant)."""
+        ext = args[0]
+        in_px = 1
+        for d in ext.shape:
+            in_px *= int(d)
+        ch_in = ext.shape[2] if len(ext.shape) == 3 else 1
+        ch_out = out_channels(self.ops, ch_in)
+        out_rows = ext.shape[0] - lead - tail
+        out_px = out_rows * ext.shape[1] * ch_out
+        return float(in_px + out_px + 4)  # + the i32 y0 scalar
+
     def fn(self, spec: TileSpec):
         key = (spec.lead, spec.tail)
         f = self._fns.get(key)
         if f is None:
-            f = self._fns[key] = make_tile_fn(
+            from mpi_cuda_imagemanipulation_tpu.obs import cost as obs_cost
+
+            jitted = make_tile_fn(
                 self.ops,
                 lead=spec.lead,
                 tail=spec.tail,
@@ -268,5 +286,17 @@ class TileFnCache:
                 global_w=self.global_w,
                 impl=self.impl,
                 plan=self.plan,
+            )
+            # cost attribution rides the insertion (obs/cost): the first
+            # call per variant compiles AOT with the live band shapes —
+            # the one compile jit would have paid anyway — and the
+            # ledger keys the record by the plan fingerprint + signature
+            f = self._fns[key] = obs_cost.wrap_cache_fn(
+                "stream",
+                f"{self.plan.fingerprint}:l{spec.lead}t{spec.tail}",
+                jitted,
+                modeled_fn=lambda args, lt=key: self._modeled_bytes(
+                    lt[0], lt[1], args
+                ),
             )
         return f

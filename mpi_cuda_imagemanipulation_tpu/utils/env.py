@@ -50,6 +50,43 @@ _VARS = (
     EnvVar("MCIM_TRACE_OUT", None, "bench_suite.py",
            "serve_loadgen lane: export the sweep's span timeline to this "
            "path (Chrome/Perfetto JSON)."),
+    EnvVar("MCIM_TRACE_TAIL", "256", "obs/trace.py",
+           "Deferred tail-keep buffer: sampled-OUT traces buffer up to "
+           "this many concurrently-open traces and promote to kept when "
+           "the root ends with an error/quarantine/deadline status or a "
+           "p99-slow duration; 0 restores pure root sampling."),
+    # -- cost attribution (obs/cost.py) ---------------------------------------
+    EnvVar("MCIM_COST_ATTRIB", "1", "obs/cost.py",
+           "=0 disables compiled-executable cost attribution (the "
+           "cost_analysis/memory_analysis extraction at every compile-"
+           "cache insertion site and its mcim_cost_* families)."),
+    EnvVar("MCIM_COST_CAP", "64", "obs/cost.py",
+           "Cost-ledger LRU capacity: attributions are keyed by "
+           "(site, fingerprint, stage), which is unbounded in principle "
+           "— metric label sets must not be."),
+    EnvVar("MCIM_COST_DRIFT_MIN", "0.8", "obs/cost.py",
+           "Lower edge of the acceptable plan-model drift band: a "
+           "measured/modelled boundary-byte ratio below this trips "
+           "mcim_cost_drift_alerts_total."),
+    EnvVar("MCIM_COST_DRIFT_MAX", "1.25", "obs/cost.py",
+           "Upper edge of the acceptable plan-model drift band."),
+    EnvVar("MCIM_COST_PEAK_GBS", None, "obs/cost.py",
+           "Override the measured-roofline denominator (GB/s); unset "
+           "uses the datasheet table keyed by TPU generation "
+           "(bench_suite.HBM_GB_S)."),
+    # -- on-demand fleet profiling (obs/profile.py) ---------------------------
+    EnvVar("MCIM_PROFILE_DIR", None, "obs/profile.py",
+           "Directory on-demand profile captures write their device "
+           "trace + merged artifact under (default artifacts/profile/)."),
+    EnvVar("MCIM_PROFILE_MIN_INTERVAL_S", "30", "obs/profile.py",
+           "Per-process rate limit between live profile captures: the "
+           "control plane cannot stack captures on a serving replica."),
+    EnvVar("MCIM_PROFILE_MAX_S", "10", "obs/profile.py",
+           "Capture-window ceiling in seconds (must stay well under the "
+           "router's forward timeout — the relay blocks for the "
+           "capture)."),
+    EnvVar("MCIM_PROFILE_DEFAULT_S", "2", "obs/profile.py",
+           "Capture window when POST /control/profile names none."),
     EnvVar("MCIM_LOG_LEVEL", None, "utils/log.py",
            "Logger verbosity: level name or number (DEBUG..CRITICAL or "
            "10..50); default INFO."),

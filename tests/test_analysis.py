@@ -510,6 +510,58 @@ def test_failpoint_registry_unknown_and_unused(tmp_path):
     assert len(unused) == 1 and "b.dead" in unused[0].message
 
 
+def test_cost_attribution_contract_fixture_pair(tmp_path):
+    """obs-cost-attribution-missing: a compile-cache insertion (a
+    `_fns` store or a cache_put call) in a file that never reaches
+    obs/cost is a finding; the attributed twin passes."""
+    bad = {
+        f"{PKG}/serve/somecache.py": """
+        class Cache:
+            def __init__(self):
+                self._fns = {}
+
+            def get(self, key, build):
+                fn = build(key)
+                self._fns[key] = fn
+                return fn
+        """,
+        f"{PKG}/graph/someservice.py": """
+        def dispatch(st, pid, fn):
+            st.cache_put(pid, fn)
+        """,
+    }
+    fs = run_on(tmp_path, bad, families={"obs"})
+    hits = [f for f in fs if f.rule == "obs-cost-attribution-missing"]
+    assert len(hits) == 2, [f.message for f in fs]
+    assert {f.file for f in hits} == {
+        f"{PKG}/serve/somecache.py", f"{PKG}/graph/someservice.py"
+    }
+
+    good = {
+        f"{PKG}/serve/somecache.py": f"""
+        from {PKG}.obs import cost as obs_cost
+
+        class Cache:
+            def __init__(self):
+                self._fns = {{}}
+
+            def get(self, key, build):
+                fn = obs_cost.wrap_cache_fn("serve", key, build(key))
+                self._fns[key] = fn
+                return fn
+        """,
+        f"{PKG}/graph/someservice.py": """
+        def dispatch(st, pid, build):
+            from mpi_cuda_imagemanipulation_tpu.obs import cost as obs_cost
+
+            fn, _cost = obs_cost.attribute_jit("graph", pid, build(), ())
+            st.cache_put(pid, fn)
+        """,
+    }
+    fs = run_on(tmp_path, good, families={"obs"})
+    assert "obs-cost-attribution-missing" not in rules_of(fs)
+
+
 # --------------------------------------------------------------------------
 # surface rules
 # --------------------------------------------------------------------------

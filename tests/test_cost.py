@@ -1,0 +1,592 @@
+"""Cost observability (obs/cost, obs/devmem, trace tail keep — ISSUE 15).
+
+Four pillars, each pinned:
+
+  1. cost EXTRACTION across every compile-cache kind — serve bucket
+     cache, plan stage attribution, per-tenant graph cache, stream
+     TileFnCache — lands ledger entries keyed by the caches' own
+     fingerprints with drift ~1.0 (the one-read-one-write boundary
+     model is structurally true);
+  2. drift-ratio ARITHMETIC against fake cost objects: band edges,
+     alias folding, the cost.model mis-model failpoint, ledger LRU
+     bound;
+  3. HBM gauge FEDERATION: devmem gauges ride the fleet view per
+     replica, a restart (new incarnation) REPLACES the gauge instead of
+     double-reporting, and the headroom SLO spec kind burns on the
+     worst device;
+  4. tail-keep PROMOTION semantics: error and slow roots promote,
+     benign roots drop, the buffer bound evicts oldest-first, and
+     `trace_kept` answers accordingly.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from mpi_cuda_imagemanipulation_tpu.obs import cost as obs_cost
+from mpi_cuda_imagemanipulation_tpu.obs import trace as obs_trace
+from mpi_cuda_imagemanipulation_tpu.obs.cost import CostLedger, CostRecord
+from mpi_cuda_imagemanipulation_tpu.obs.metrics import Registry
+from mpi_cuda_imagemanipulation_tpu.resilience import failpoints
+
+
+def make_cost(arg=1000.0, out=1000.0, alias=0.0, temp=0.0, flops=5.0,
+              hlo=4000.0):
+    return CostRecord(
+        flops=flops, hlo_bytes=hlo, arg_bytes=arg, out_bytes=out,
+        alias_bytes=alias, temp_bytes=temp, code_bytes=0.0,
+    )
+
+
+# --------------------------------------------------------------------------
+# 2. drift arithmetic with fake cost dicts
+# --------------------------------------------------------------------------
+
+
+class _FakeCompiled:
+    """Stub with the jax.stages.Compiled analysis surface."""
+
+    def __init__(self, cost_dict, mem=None, as_list=True):
+        self._cost = cost_dict
+        self._mem = mem
+        self._as_list = as_list
+
+    def cost_analysis(self):
+        if self._cost is None:
+            raise RuntimeError("no cost analysis")
+        return [self._cost] if self._as_list else self._cost
+
+    def memory_analysis(self):
+        if self._mem is None:
+            raise RuntimeError("no memory analysis")
+        return self._mem
+
+
+class _FakeMem:
+    def __init__(self, arg, out, alias=0, temp=0, code=0):
+        self.argument_size_in_bytes = arg
+        self.output_size_in_bytes = out
+        self.alias_size_in_bytes = alias
+        self.temp_size_in_bytes = temp
+        self.generated_code_size_in_bytes = code
+
+
+def test_cost_from_compiled_shapes_and_fields():
+    cost = obs_cost.cost_from_compiled(
+        _FakeCompiled(
+            {"flops": 7.0, "bytes accessed": 123.0},
+            _FakeMem(100, 50, alias=10, temp=30),
+        )
+    )
+    assert cost.flops == 7.0 and cost.hlo_bytes == 123.0
+    assert cost.boundary_bytes == 100 + 50 - 10
+    assert cost.peak_bytes == 100 + 50 + 30
+    # dict (non-list) cost_analysis shape parses too
+    cost2 = obs_cost.cost_from_compiled(
+        _FakeCompiled({"flops": 1.0, "bytes accessed": 2.0}, None,
+                      as_list=False)
+    )
+    assert cost2 is not None and cost2.hlo_bytes == 2.0
+    # neither analysis available -> None, never a raise
+    assert obs_cost.cost_from_compiled(_FakeCompiled(None, None)) is None
+
+
+def test_drift_ratio_band_edges_and_alerts():
+    led = CostLedger(Registry())
+    lo, hi = obs_cost.drift_band()
+    # dead-on model: no alert
+    r = led.record("serve", "k1", make_cost(1000, 1000),
+                   modeled_bytes=2000.0)
+    assert r == 1.0
+    assert led.drift_alerts.value(site="serve") == 0
+    # at the band edges: still no alert (inclusive band)
+    led.record("serve", "k2", make_cost(1000, 1000),
+               modeled_bytes=2000.0 / lo)
+    led.record("serve", "k3", make_cost(1000, 1000),
+               modeled_bytes=2000.0 / hi)
+    assert led.drift_alerts.value(site="serve") == 0
+    # beyond either edge: alerts
+    led.record("serve", "k4", make_cost(1000, 1000),
+               modeled_bytes=2000.0 / (lo * 0.9))
+    led.record("serve", "k5", make_cost(1000, 1000),
+               modeled_bytes=2000.0 / (hi * 1.1))
+    assert led.drift_alerts.value(site="serve") == 2
+    # aliased (donated) bytes fold out of the measured boundary
+    r = led.record("serve", "k6", make_cost(1000, 1000, alias=1000),
+                   modeled_bytes=1000.0)
+    assert r == 1.0
+    # no model -> no ratio, no alert
+    assert led.record("serve", "k7", make_cost()) is None
+
+
+def test_mis_model_failpoint_trips_alert():
+    led = CostLedger(Registry())
+    failpoints.configure("cost.model=always")
+    try:
+        r = led.record("plan", "kf", make_cost(1000, 1000),
+                       modeled_bytes=2000.0)
+    finally:
+        failpoints.clear()
+    assert r == pytest.approx(0.25)
+    assert led.drift_alerts.value(site="plan") == 1
+    assert led.drift("plan", "kf") == pytest.approx(0.25)
+
+
+def test_ledger_is_lru_bounded(monkeypatch):
+    monkeypatch.setenv("MCIM_COST_CAP", "4")
+    led = CostLedger(Registry())
+    for i in range(10):
+        led.record("bench", f"k{i}", make_cost(), modeled_bytes=2000.0)
+    entries = led.entries()
+    assert len(entries) == 4
+    assert ("bench", "k9", "all") in entries
+    assert ("bench", "k0", "all") not in entries
+    # snapshot still renders and the gauges stay bounded with it
+    assert led.snapshot()["entries"] == 4
+
+
+def test_unknown_site_rejected():
+    led = CostLedger(Registry())
+    with pytest.raises(ValueError, match="unknown cost site"):
+        led.record("nope", "k", make_cost())
+
+
+# --------------------------------------------------------------------------
+# 1. extraction across the compile caches
+# --------------------------------------------------------------------------
+
+
+def test_serve_cache_attributes_with_unit_drift():
+    from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline
+    from mpi_cuda_imagemanipulation_tpu.serve.cache import CompileCache
+
+    before = {
+        k for k in obs_cost.cost_ledger.entries() if k[0] == "serve"
+    }
+    cache = CompileCache(
+        Pipeline.parse("grayscale,contrast:3.5,emboss:3"),
+        buckets=((32, 32),), batch_buckets=(1,), channels=(3,),
+    )
+    cache.warmup()
+    lo, hi = obs_cost.drift_band()
+    new = [
+        k for k in obs_cost.cost_ledger.entries()
+        if k[0] == "serve" and k not in before
+    ]
+    assert new, "warmup attributed nothing"
+    for key in new:
+        # keyed by grid cell + the resolved plan fingerprint
+        assert key[1].startswith("32x32x3x1:")
+        ratio = obs_cost.cost_ledger.drift(*key[:2])
+        assert ratio is not None and lo <= ratio <= hi, (key, ratio)
+    # the costed executable serves and matches the golden path bit-exact
+    fn = cache.get(32, 32, 3, 1)
+    imgs = np.zeros((1, 32, 32, 3), np.uint8)
+    true = np.full((1,), 30, np.int32)
+    out = np.asarray(fn(imgs, true, true))
+    assert out.shape[0] == 1
+    assert cache.traces_since_warmup == 0
+
+
+def test_serve_modeled_bytes_divide_out_the_mesh():
+    """memory_analysis reports PER-DEVICE sizes for sharded
+    executables; the serving model divides by the mesh so the drift
+    contract stays per chip (the live-mesh case is covered by the
+    sharded serving tests — this pins the arithmetic)."""
+    from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline
+    from mpi_cuda_imagemanipulation_tpu.serve.cache import CompileCache
+
+    class _FakeMesh:
+        class devices:  # noqa: N801 - mimic mesh.devices.size
+            size = 4
+
+    pipe = Pipeline.parse("grayscale,contrast:3.5,emboss:3")
+    solo = CompileCache(pipe, ((32, 32),), (4,), channels=(3,))
+    sharded = CompileCache(pipe, ((32, 32),), (4,), channels=(3,))
+    sharded.mesh = _FakeMesh()
+    key = (32, 32, 3, 4)
+    assert solo._modeled_bytes(key) == 4 * sharded._modeled_bytes(key)
+
+
+def test_plan_attribution_per_stage_keys_and_band():
+    from mpi_cuda_imagemanipulation_tpu.ops.registry import make_pipeline_ops
+    from mpi_cuda_imagemanipulation_tpu.plan import build_plan
+
+    ops = make_pipeline_ops("grayscale,gaussian:3,rot180,sharpen")
+    plan = build_plan(ops, "fused")
+    rows = obs_cost.attribute_plan(plan, (64, 96, 3))
+    assert len(rows) == len(plan.stages) >= 3
+    lo, hi = obs_cost.drift_band()
+    for row in rows:
+        assert row["drift_ratio"] is not None
+        assert lo <= row["drift_ratio"] <= hi, row
+        assert obs_cost.cost_ledger.drift(
+            "plan", plan.fingerprint, row["stage"]
+        ) == row["drift_ratio"]
+
+
+def test_graph_cache_attributes_by_program_fingerprint():
+    from mpi_cuda_imagemanipulation_tpu.graph.service import GraphService
+    from mpi_cuda_imagemanipulation_tpu.graph.spec import chain_as_spec
+
+    svc = GraphService(registry=Registry())
+    reg = svc.register("t0", chain_as_spec("grayscale,contrast:3.5"))
+    pid = reg["pipeline"]
+    img = np.random.default_rng(0).integers(
+        0, 255, (40, 48, 3), dtype=np.uint8
+    )
+    out = svc.process("t0", pid, img)
+    assert out["image"].shape == (40, 48)
+    entries = [
+        k for k in obs_cost.cost_ledger.entries() if k[0] == "graph"
+    ]
+    assert entries, "graph dispatch attributed nothing"
+    lo, hi = obs_cost.drift_band()
+    ratio = obs_cost.cost_ledger.drift(*entries[-1][:2])
+    assert ratio is not None and lo <= ratio <= hi, ratio
+
+
+def test_stream_tile_cache_attributes_per_variant():
+    from mpi_cuda_imagemanipulation_tpu.ops.registry import make_pipeline_ops
+    from mpi_cuda_imagemanipulation_tpu.stream.tiles import (
+        TileFnCache,
+        plan_tiles,
+    )
+
+    ops = make_pipeline_ops("grayscale,gaussian:3")
+    cache = TileFnCache(ops, global_h=96, global_w=64, impl="xla")
+    halo = 1
+    tiles = plan_tiles(96, 32, halo)
+    img = np.random.default_rng(1).integers(
+        0, 255, (96, 64, 3), dtype=np.uint8
+    )
+    for spec in tiles:
+        f = cache.fn(spec)
+        ext = img[spec.ext_lo: spec.ext_hi]
+        out = np.asarray(f(ext, np.int32(spec.ext_lo)))
+        assert out.shape[0] == spec.out_rows
+    entries = [
+        k for k in obs_cost.cost_ledger.entries() if k[0] == "stream"
+    ]
+    assert entries, "no stream attributions"
+    lo, hi = obs_cost.drift_band()
+    for key in entries:
+        assert key[1].startswith(cache.plan.fingerprint + ":l")
+        ratio = obs_cost.cost_ledger.drift(*key[:2])
+        assert ratio is not None and lo <= ratio <= hi, (key, ratio)
+
+
+def test_attribute_jit_degrades_to_jit_on_failure():
+    """A callable without the AOT surface serves un-attributed (and the
+    failure is counted) — cost extraction must never break a cache."""
+
+    def plain(x):
+        return x
+
+    led = CostLedger(Registry())
+    fn, cost = obs_cost.attribute_jit(
+        "bench", "notjit", plain, (np.zeros(4, np.uint8),),
+        ledger=led,
+    )
+    assert fn is plain and cost is None
+    assert led.failures.value(site="bench") == 1
+
+
+def test_attrib_disabled_is_passthrough(monkeypatch):
+    monkeypatch.setenv("MCIM_COST_ATTRIB", "0")
+
+    def plain(x):
+        return x
+
+    fn, cost = obs_cost.attribute_jit(
+        "bench", "off", plain, (np.zeros(4, np.uint8),)
+    )
+    assert fn is plain and cost is None
+    assert obs_cost.wrap_cache_fn("bench", "off2", plain) is plain
+
+
+# --------------------------------------------------------------------------
+# 3. devmem gauges + federation incarnation folding + headroom SLO
+# --------------------------------------------------------------------------
+
+
+def _fake_stats(in_use, limit=1000, peak=None):
+    return {
+        "tpu:0": {
+            "bytes_in_use": in_use,
+            "peak_bytes_in_use": peak if peak is not None else in_use,
+            "bytes_limit": limit,
+        }
+    }
+
+
+def test_devmem_gauges_and_headroom():
+    from mpi_cuda_imagemanipulation_tpu.obs.devmem import DevMemGauges
+
+    reg = Registry()
+    state = {"stats": _fake_stats(250, limit=1000, peak=400)}
+    dm = DevMemGauges(reg, stats_fn=lambda: state["stats"])
+    assert dm.in_use.value(device="tpu:0") == 250
+    assert dm.peak.value(device="tpu:0") == 400
+    assert dm.headroom.value(device="tpu:0") == pytest.approx(0.75)
+    state["stats"] = _fake_stats(900, limit=1000)
+    assert dm.headroom.value(device="tpu:0") == pytest.approx(0.10)
+    snap = dm.snapshot()
+    assert snap["tpu:0"]["headroom_frac"] == pytest.approx(0.10)
+    # CPU shape: no devices -> empty gauges, devices gauge 0
+    state["stats"] = {}
+    assert dm.devices.value() == 0
+    assert dm.headroom.values() == {}
+
+
+def test_devmem_federation_replaces_across_incarnations():
+    """A replica restart must REPLACE its devmem gauges in the fleet
+    view (labeled per replica), never sum them — and the counter
+    families in the same snapshot fold restart-safely as ever."""
+    from mpi_cuda_imagemanipulation_tpu.obs import fleet
+    from mpi_cuda_imagemanipulation_tpu.obs.devmem import DevMemGauges
+
+    def replica_snapshot(in_use, executables):
+        reg = Registry()
+        DevMemGauges(reg, stats_fn=lambda: _fake_stats(in_use))
+        led = CostLedger(reg)
+        for i in range(executables):
+            led.record("serve", f"k{i}", make_cost(),
+                       modeled_bytes=2000.0)
+        return fleet.snapshot_registries([reg])
+
+    agg = fleet.FleetAggregator(stale_s=100.0, clock=lambda: 1.0)
+    agg.apply("r0", "inc1", replica_snapshot(600, executables=3))
+    merged = agg.merged()
+    gkey = ("tpu:0", "r0")
+    assert merged["mcim_devmem_bytes_in_use"]["series"][gkey] == 600
+    assert (
+        merged["mcim_cost_executables_total"]["series"][("serve",)] == 3
+    )
+    # restart: new incarnation reports LOWER memory and a reset counter
+    agg.apply("r0", "inc2", replica_snapshot(100, executables=1))
+    merged = agg.merged()
+    # gauge REPLACED (100, not 700) — a summed gauge would be a lie
+    assert merged["mcim_devmem_bytes_in_use"]["series"][gkey] == 100
+    # counter FOLDED (3 banked + 1 new) — never double-counted, never
+    # rewound
+    assert (
+        merged["mcim_cost_executables_total"]["series"][("serve",)] == 4
+    )
+
+
+def test_headroom_slo_spec_parses_and_burns():
+    from mpi_cuda_imagemanipulation_tpu.obs import slo as obs_slo
+
+    specs = obs_slo.parse_slo_specs("headroom:0.1:99")
+    assert len(specs) == 1 and specs[0].kind == "headroom"
+    assert specs[0].le == pytest.approx(0.1)
+    with pytest.raises(ValueError):
+        obs_slo.parse_slo_specs("headroom:2:99")  # frac must be < 1
+
+    state = {"headroom": 0.5}
+
+    def merged_fn():
+        return {
+            "mcim_devmem_headroom_frac": {
+                "kind": "gauge", "help": "", "labels": ["device", "replica"],
+                "series": {("tpu:0", "r0"): state["headroom"]},
+            }
+        }
+
+    clock = {"t": 0.0}
+    eng = obs_slo.SLOEngine(
+        specs,
+        obs_slo.fleet_slo_source(merged_fn),
+        fast_s=10.0, slow_s=30.0, tick_s=1.0, burn_threshold=2.0,
+        registry=Registry(),
+        clock=lambda: clock["t"],
+    )
+    name = specs[0].name
+    for _ in range(10):  # healthy ticks
+        clock["t"] += 1.0
+        eng.tick()
+    assert not eng.status()["slos"][name]["alert"] == "firing"
+    state["headroom"] = 0.02  # under the 10% floor on the worst device
+    for _ in range(30):
+        clock["t"] += 1.0
+        eng.tick()
+    assert eng.status()["slos"][name]["alert"] == "firing"
+    state["headroom"] = 0.5
+    for _ in range(40):
+        clock["t"] += 1.0
+        eng.tick()
+    assert eng.status()["slos"][name]["alert"] == "ok"
+
+
+# --------------------------------------------------------------------------
+# 4. tail-keep promotion semantics
+# --------------------------------------------------------------------------
+
+
+def test_tail_keep_error_promotes_benign_drops():
+    t = obs_trace.Tracer(sample=0.0, tail=16)
+    # benign: ok status -> dropped wholesale
+    ok_root = t.start_trace("serve.request")
+    assert ok_root is not obs_trace.NOOP_SPAN
+    with t.span("serve.dispatch", parent=ok_root.context()):
+        pass
+    ok_root.set(status="ok")
+    ok_root.end()
+    assert not t.trace_kept(ok_root.trace_id)
+    assert t.counts()["events"] == 0
+    # error class: quarantined promotes with every buffered span
+    err_root = t.start_trace("serve.request")
+    child = t.span("serve.dispatch", parent=err_root.context())
+    child.end()
+    t.event("serve.quarantine", parent=err_root.context())
+    err_root.set(status="quarantined")
+    err_root.end()
+    assert t.trace_kept(err_root.trace_id)
+    evs = [e for e in t.chrome_events() if e.get("ph") != "M"]
+    names = {e["name"] for e in evs}
+    assert {"serve.request", "serve.dispatch", "serve.quarantine"} <= names
+    assert all(
+        e["args"]["trace_id"] == err_root.trace_id for e in evs
+    )
+    # the promoted root carries the keep reason
+    root_ev = next(e for e in evs if e["name"] == "serve.request")
+    assert root_ev["args"]["tail_kept"] == "error"
+    assert t.counts()["tail"] == {
+        "buffered": 2, "kept_error": 1, "kept_slow": 0,
+        "dropped": 1, "evicted": 0,
+    }
+
+
+def test_tail_keep_error_arg_promotes():
+    t = obs_trace.Tracer(sample=0.0, tail=4)
+    root = t.start_trace("fabric.request")
+    root.set(error="RuntimeError")
+    root.end()
+    assert t.trace_kept(root.trace_id)
+    assert t.counts()["tail"]["kept_error"] == 1
+
+
+def test_tail_keep_slow_promotes_at_p99():
+    t = obs_trace.Tracer(sample=0.0, tail=8)
+    # seed the duration baseline with fast sampled-out roots (dropped)
+    for _ in range(40):
+        r = t.start_trace("serve.request")
+        r.set(status="ok")
+        r.end()
+    # a much slower root promotes as p99-slow despite the ok status
+    slow = t.start_trace("serve.request")
+    slow.t0 -= 1.0  # 1 s older start -> 1 s duration
+    slow.set(status="ok")
+    slow.end()
+    assert t.trace_kept(slow.trace_id)
+    assert t.counts()["tail"]["kept_slow"] == 1
+    evs = [e for e in t.chrome_events() if e.get("ph") != "M"]
+    assert evs and evs[-1]["args"]["tail_kept"] == "slow"
+
+
+def test_tail_buffer_bound_evicts_oldest():
+    t = obs_trace.Tracer(sample=0.0, tail=3)
+    roots = [t.start_trace(f"r{i}") for i in range(5)]
+    # 5 concurrently-open provisional traces with cap 3: the two oldest
+    # evicted (counted) and unresolvable even if they end in error
+    assert t.counts()["tail"]["evicted"] == 2
+    for i, r in enumerate(roots):
+        r.set(status="quarantined")
+        r.end()
+    kept = [r for r in roots if t.trace_kept(r.trace_id)]
+    assert [r.trace_id for r in kept] == [
+        r.trace_id for r in roots[2:]
+    ]
+    assert t.counts()["tail"]["kept_error"] == 3
+
+
+def test_tail_disabled_keeps_noop_identity():
+    t = obs_trace.Tracer(sample=0.0, tail=0)
+    assert t.start_trace("x") is obs_trace.NOOP_SPAN
+    assert t.counts()["events"] == 0
+
+
+def test_adopted_ids_bypass_the_tail_buffer():
+    """An upstream-propagated id always keeps (the upstream made the
+    decision) — adoption must not land in the provisional buffer."""
+    t = obs_trace.Tracer(sample=0.0, tail=4)
+    r = t.start_trace("serve.request", trace_id="upstream-1")
+    r.set(status="ok")
+    r.end()
+    assert t.trace_kept("upstream-1")
+    assert t.counts()["events"] == 1
+    assert t.counts()["tail"]["buffered"] == 0
+
+
+def test_loadgen_slowest_traces_prefer_kept(monkeypatch):
+    """The slow-trace column ranks resolvable ids first (satellite: the
+    loadgen fix)."""
+    from mpi_cuda_imagemanipulation_tpu.serve import loadgen
+
+    class H:
+        def __init__(self, tid, dur, status="ok"):
+            self.trace_id = tid
+            self.t_submit = 0.0
+            self.t_done = dur
+            self.status = status
+
+        @property
+        def done(self):
+            raise AssertionError("not used")
+
+    kept = {"slow-kept": True, "slower-dropped": False}
+    monkeypatch.setattr(
+        loadgen.obs_trace, "trace_kept", lambda tid: kept.get(tid, True)
+    )
+    ok = [H("slower-dropped", 2.0), H("slow-kept", 1.0), H("fast", 0.1)]
+    slowest = sorted(
+        (h for h in ok if h.trace_id),
+        key=lambda h: (
+            not loadgen.obs_trace.trace_kept(h.trace_id),
+            -(h.t_done - h.t_submit),
+        ),
+    )[:2]
+    assert [h.trace_id for h in slowest] == ["slow-kept", "fast"]
+
+
+# --------------------------------------------------------------------------
+# profile capture (the replica half of POST /control/profile)
+# --------------------------------------------------------------------------
+
+
+def test_capture_live_writes_merged_artifact_and_rate_limits(
+    tmp_path, monkeypatch
+):
+    from mpi_cuda_imagemanipulation_tpu.obs import profile as obs_profile
+
+    monkeypatch.setenv("MCIM_PROFILE_DIR", str(tmp_path))
+    monkeypatch.setenv("MCIM_RECORDER_DIR", str(tmp_path / "rec"))
+    monkeypatch.setenv("MCIM_PROFILE_MIN_INTERVAL_S", "60")
+    monkeypatch.setattr(obs_profile, "_last_capture_ts", 0.0)
+    obs_trace.configure(sample=1.0, tail=0)
+    try:
+        with obs_trace.start_trace("test.capture") as root:
+            with obs_trace.span("test.work", parent=root.context()):
+                pass  # a CLOSED span so the host side has >= 1 event
+            import jax
+
+            result = obs_profile.capture_live(
+                0.2,
+                sleep=lambda s: np.asarray(
+                    jax.jit(lambda x: x * 2)(np.ones((64, 64), np.float32))
+                ),
+            )
+    finally:
+        obs_trace.disable()
+    assert result["seconds"] == pytest.approx(0.2)
+    import json
+
+    merged = json.load(open(result["artifact"]))
+    assert merged["traceEvents"], "empty merged trace"
+    assert result["host_events"] >= 1
+    # second capture inside the rate window refuses with retry-after
+    with pytest.raises(obs_profile.ProfileUnavailable) as ei:
+        obs_profile.capture_live(0.1)
+    assert ei.value.retry_after_s > 0

@@ -66,6 +66,16 @@ _DEFAULT_FIELDS: list[tuple[str, bool]] = [
     ("speedup", True),
 ]
 
+# MEASURED-cost columns (obs/cost via bench_suite): tracked on EVERY
+# record that carries them, in addition to the config's headline metric
+# — a regression in compiled-executable GB/s or measured roofline
+# fraction is a perf claim going stale even when the analytical model
+# still looks fine.
+_MEASURED_FIELDS: list[tuple[str, bool]] = [
+    ("hbm_gb_s_measured", True),
+    ("roofline_frac_measured", True),
+]
+
 
 def _series_key(rec: dict) -> tuple | None:
     cfg = rec.get("config")
@@ -85,6 +95,10 @@ def _metrics_of(rec: dict) -> list[tuple[str, float, bool]]:
             out.append((field, float(v), higher))
             if cfg not in METRIC_FIELDS:
                 break  # default list: first present metric only
+    for field, higher in _MEASURED_FIELDS:
+        v = rec.get(field)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            out.append((field, float(v), higher))
     return out
 
 

@@ -1,0 +1,318 @@
+#!/usr/bin/env python
+"""CI cost-observability smoke — the acceptance gate for ISSUE 15.
+
+The tier1.yml cost step runs this on CPU and asserts the whole cost
+layer end to end:
+
+  1. **drift ≈ 1.0 for `--plan off`** — every per-op executable of the
+     headline chain attributes with a measured-boundary/modelled ratio
+     inside [MCIM_COST_DRIFT_MIN, MCIM_COST_DRIFT_MAX]: the planner's
+     one-read-one-write byte model is structurally TRUE per op, checked
+     against XLA's own memory_analysis, on CPU.
+  2. **per-stage attribution for fused and fused-pallas** — each stage
+     of the built plan attributes under the plan's fingerprint with an
+     in-band ratio (the megakernel one-read-one-write claim, judged
+     per stage; fused-pallas runs interpret-mode on CPU — structure,
+     never timings).
+  3. **a deliberately mis-modelled stage trips the drift alert** — the
+     `cost.model` failpoint corrupts the model 4x and
+     mcim_cost_drift_alerts_total must move.
+  4. **`POST /control/profile` under live traffic** — a REAL router +
+     replica pod serves offered load while the front door relays a
+     rate-limited jax.profiler capture; the merged host+device trace
+     must parse, contain both host spans and profiler events, and the
+     artifact is copied to argv[1] for CI upload. A second immediate
+     capture must be 429-rate-limited.
+  5. **an injected-error request's trace survives a sampled-out root**
+     — with MCIM_TRACE_SAMPLE tiny and the tail buffer armed, a
+     quarantined request's trace id resolves in the export while a
+     plain ok request's does not.
+  6. the mcim_cost_* / mcim_devmem_* families parse as exposition text
+     through the replica's /metrics and the router's federated view.
+
+Usage: python tools/cost_smoke.py [MERGED_TRACE_OUT.json] [METRICS_OUT.prom]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from mpi_cuda_imagemanipulation_tpu.utils.platform import claim_platform  # noqa: E402
+
+claim_platform(os.environ.get("JAX_PLATFORMS") or "cpu")
+
+os.environ.setdefault("MCIM_PROFILE_DIR", "/tmp/_cost_smoke_profile")
+os.environ.setdefault("MCIM_RECORDER_DIR", "/tmp/_cost_smoke_recorder")
+
+import numpy as np  # noqa: E402
+
+from mpi_cuda_imagemanipulation_tpu.fabric.replica import ReplicaRuntime  # noqa: E402
+from mpi_cuda_imagemanipulation_tpu.fabric.router import Router, RouterConfig  # noqa: E402
+from mpi_cuda_imagemanipulation_tpu.io.image import (  # noqa: E402
+    encode_image_bytes,
+    synthetic_image,
+)
+from mpi_cuda_imagemanipulation_tpu.obs import cost as obs_cost  # noqa: E402
+from mpi_cuda_imagemanipulation_tpu.obs import trace as obs_trace  # noqa: E402
+from mpi_cuda_imagemanipulation_tpu.obs.metrics import parse_exposition  # noqa: E402
+from mpi_cuda_imagemanipulation_tpu.ops.registry import make_pipeline_ops  # noqa: E402
+from mpi_cuda_imagemanipulation_tpu.plan import build_plan  # noqa: E402
+from mpi_cuda_imagemanipulation_tpu.resilience import failpoints  # noqa: E402
+from mpi_cuda_imagemanipulation_tpu.serve.server import ServeConfig  # noqa: E402
+
+OPS = "grayscale,contrast:3.5,gaussian:5,quantize:6"
+H, W, C = 192, 256, 3
+
+
+def check_per_op_drift() -> None:
+    """Gate 1: --plan off per-op dispatch, drift within the band."""
+    import jax
+
+    lo, hi = obs_cost.drift_band()
+    ops = make_pipeline_ops(OPS)
+    cur = np.asarray(synthetic_image(H, W, channels=C, seed=3))
+    for op in ops:
+        fn = jax.jit(lambda x, o=op: o(x))
+        out = np.asarray(fn(cur))
+        modeled = float(cur.size + out.size)
+        wrapped, cost = obs_cost.attribute_jit(
+            "bench", f"off:{op.name}", fn, (cur,), modeled_bytes=modeled
+        )
+        assert cost is not None, f"no cost extracted for {op.name}"
+        ratio = obs_cost.cost_ledger.drift("bench", f"off:{op.name}")
+        assert ratio is not None and lo <= ratio <= hi, (
+            f"per-op drift for {op.name}: {ratio} outside [{lo}, {hi}]"
+        )
+        assert np.array_equal(np.asarray(wrapped(cur)), out), op.name
+        cur = out
+    print(f"gate 1: per-op dispatch drift in [{lo}, {hi}] for {OPS}")
+
+
+def check_stage_drift() -> None:
+    """Gate 2: fused + fused-pallas per-stage drift, keyed by
+    fingerprint. The mixed chain builds MULTIPLE stages (two fused
+    regions around a geometric barrier), so "per stage" is exercised
+    across stage kinds, not just on a single-stage chain."""
+    lo, hi = obs_cost.drift_band()
+    ops = make_pipeline_ops(OPS + ",rot180,sharpen")
+    for mode, pallas in (("fused", False), ("fused-pallas", True)):
+        plan = build_plan(ops, mode)
+        assert len(plan.stages) >= 3, plan.describe()
+        rows = obs_cost.attribute_plan(
+            plan, (H, W, C), pallas=pallas, interpret=True if pallas else None
+        )
+        assert len(rows) == len(plan.stages)
+        for row in rows:
+            r = row["drift_ratio"]
+            assert r is not None and lo <= r <= hi, (
+                f"{mode} stage {row['stage']} ({row['names']}): drift "
+                f"{r} outside [{lo}, {hi}]"
+            )
+            # the ledger keys megakernel/fused stage cost by fingerprint
+            assert (
+                obs_cost.cost_ledger.drift("plan", plan.fingerprint,
+                                           row["stage"]) == r
+            )
+        print(
+            f"gate 2: {mode} per-stage drift in band "
+            f"({[r['stage'] for r in rows]}, key {plan.fingerprint})"
+        )
+
+
+def check_mis_model_alert() -> None:
+    """Gate 3: the cost.model failpoint trips the drift alert."""
+    import jax
+
+    before = obs_cost.cost_ledger.drift_alerts.value(site="bench")
+    failpoints.configure("cost.model=always")
+    try:
+        img = np.zeros((64, 64), np.uint8)
+        fn = jax.jit(lambda x: (x.astype(np.float32) * 2).astype(np.uint8))
+        obs_cost.attribute_jit(
+            "bench", "mismodel", fn, (img,),
+            modeled_bytes=float(2 * img.size),
+        )
+    finally:
+        failpoints.clear()
+    after = obs_cost.cost_ledger.drift_alerts.value(site="bench")
+    assert after == before + 1, (
+        f"mis-modelled stage did not trip the alert ({before} -> {after})"
+    )
+    ratio = obs_cost.cost_ledger.drift("bench", "mismodel")
+    lo, hi = obs_cost.drift_band()
+    assert ratio is not None and not lo <= ratio <= hi, ratio
+    print(f"gate 3: deliberate mis-model tripped the alert (ratio {ratio})")
+
+
+def post(url: str, payload: dict | bytes, timeout: float = 60.0):
+    data = payload if isinstance(payload, bytes) else json.dumps(payload).encode()
+    req = urllib.request.Request(url, data=data, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read(), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+def main() -> int:
+    trace_out = sys.argv[1] if len(sys.argv) > 1 else "/tmp/_cost_profile.json"
+    metrics_out = sys.argv[2] if len(sys.argv) > 2 else "/tmp/_cost_metrics.prom"
+
+    check_per_op_drift()
+    check_stage_drift()
+    check_mis_model_alert()
+
+    # gates 4-6 need a live pod: router + one in-process replica, with
+    # sampled-out roots and the tail buffer armed
+    obs_trace.configure(sample=1e-6, tail=128)
+    router = Router(RouterConfig(buckets=((64, 64),), stale_s=5.0)).start()
+    cfg = ServeConfig(
+        ops="grayscale,contrast:3.5,emboss:3",
+        buckets=((64, 64),), channels=(3,),
+        max_batch=2, max_delay_ms=2.0,
+    )
+    rt = ReplicaRuntime("r0", router.url, cfg, heartbeat_s=0.2).start()
+    png = encode_image_bytes(
+        np.asarray(synthetic_image(60, 60, channels=3, seed=7))
+    )
+    try:
+        deadline = time.time() + 20
+        while not router._routable() and time.time() < deadline:
+            time.sleep(0.05)
+        assert router._routable(), "replica never registered"
+
+        # benign traffic (sampled out, dropped by the tail buffer)
+        ok_tid = ""
+        for _ in range(4):
+            code, _body, hdrs = post(f"{router.url}/v1/process", png)
+            assert code == 200, code
+            ok_tid = hdrs.get("X-Trace-Id", ok_tid)
+
+        # gate 5: injected-error request under a sampled-out root
+        failpoints.configure("serve.dispatch=always")
+        try:
+            code, _body, hdrs = post(f"{router.url}/v1/process", png)
+        finally:
+            failpoints.clear()
+        assert code == 422, f"expected quarantine, got {code}"
+        err_tid = hdrs.get("X-Trace-Id", "")
+        assert err_tid, "quarantined request carried no trace id"
+
+        # gate 4: profile capture under live offered traffic
+        stop = threading.Event()
+
+        def offered():
+            while not stop.is_set():
+                post(f"{router.url}/v1/process", png)
+                time.sleep(0.02)
+
+        t = threading.Thread(target=offered, daemon=True)
+        t.start()
+        try:
+            code, body, _h = post(
+                f"{router.url}/control/profile", {"seconds": 1.0}
+            )
+        finally:
+            stop.set()
+            t.join()
+        assert code == 200, f"profile capture answered {code}: {body[:200]}"
+        prof = json.loads(body)
+        assert prof["replica"] == "r0" and prof["status"] == "ok", prof
+        merged = json.load(open(prof["artifact"]))
+        events = merged["traceEvents"]
+        assert prof["host_events"] > 0, "no host spans in the capture"
+        assert prof["device_events"] > 0, "no profiler events in the capture"
+        assert any(e.get("ph") == "X" for e in events), "no duration events"
+        shutil.copyfile(prof["artifact"], trace_out)
+        print(
+            f"gate 4: /control/profile -> {len(events)} merged events "
+            f"(host {prof['host_events']} + device {prof['device_events']}) "
+            f"-> {trace_out}"
+        )
+        # the second immediate capture must be rate-limited
+        code2, body2, hdrs2 = post(
+            f"{router.url}/control/profile", {"seconds": 0.5}
+        )
+        assert code2 == 429, f"second capture not rate-limited: {code2}"
+        assert hdrs2.get("Retry-After"), "rate-limited capture lost Retry-After"
+        print("gate 4b: immediate second capture rate-limited (429)")
+
+        # a profile_capture recorder dump exists
+        rec_dir = os.environ["MCIM_RECORDER_DIR"]
+        dumps = [
+            f for f in os.listdir(rec_dir) if "profile_capture" in f
+        ] if os.path.isdir(rec_dir) else []
+        assert dumps, "no profile_capture recorder dump"
+
+        # gate 5 (cont.): the error trace resolves, the ok trace does not
+        obs_trace.export("/tmp/_cost_tail_trace.json")
+        evs = json.load(open("/tmp/_cost_tail_trace.json"))["traceEvents"]
+        tids = {e.get("args", {}).get("trace_id") for e in evs}
+        assert err_tid in tids, (
+            f"error trace {err_tid} missing from export despite tail keep"
+        )
+        assert obs_trace.trace_kept(err_tid)
+        assert ok_tid not in tids and not obs_trace.trace_kept(ok_tid), (
+            "benign sampled-out trace was kept — tail keep is not selective"
+        )
+        print(
+            f"gate 5: error trace {err_tid} exported under a sampled-out "
+            f"root; benign trace dropped "
+            f"(tail {obs_trace.get_tracer().counts()['tail']})"
+        )
+
+        # gate 6: the families parse through both doors
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{rt.server.address[1]}/metrics", timeout=10
+        ) as resp:
+            replica_text = resp.read().decode()
+        fams = parse_exposition(replica_text)
+        for fam in (
+            "mcim_cost_executables_total",
+            "mcim_cost_model_drift_ratio",
+            "mcim_cost_drift_alerts_total",
+            "mcim_devmem_devices",
+        ):
+            assert fam in fams, f"{fam} missing from replica /metrics"
+        drift_samples = {
+            ls: v
+            for (name, ls), v in fams["mcim_cost_model_drift_ratio"][
+                "samples"
+            ].items()
+            if 'site="serve"' in ls
+        }
+        assert drift_samples, "no serve-site drift samples in exposition"
+        with urllib.request.urlopen(
+            f"{router.url}/metrics", timeout=10
+        ) as resp:
+            fed_text = resp.read().decode()
+        fed = parse_exposition(fed_text)
+        assert "mcim_fabric_profile_captures_total" in fed
+        assert "mcim_cost_model_drift_ratio" in fed, (
+            "cost families not federated to the router"
+        )
+        with open(metrics_out, "w") as f:
+            f.write(fed_text)
+        print(
+            f"gate 6: cost/devmem families parse on replica + federated "
+            f"router exposition -> {metrics_out}"
+        )
+    finally:
+        rt.close(drain=False, deadline_s=5.0)
+        router.close()
+    print("cost smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,6 +12,16 @@ open question is what ceiling this chip/access pattern actually supports:
 
 Writes one JSON line per measurement; commit the results into BASELINE.md's
 analysis. Usage:  python tools/roofline_probe.py [--quick]
+
+PRODUCTION FOLD (PR 15): the probe's question — measured traffic vs the
+analytical model — now rides every bench record via obs/cost: run_config
+emits `hbm_gb_s_measured`/`roofline_frac_measured` from the compiled
+executable's own cost_analysis (tools/bench_regress.py tracks the
+series), and the per-stage boundary drift gate (`mcim_cost_model_drift_
+ratio`) checks the one-read-one-write model continuously. This probe
+stays as the raw copy-kernel CEILING instrument (achievable-bandwidth
+cases XLA's cost model cannot answer); use obs/cost for everything that
+was "run the probe to sanity-check a bench claim".
 """
 
 from __future__ import annotations

@@ -1,0 +1,96 @@
+#!/bin/bash
+# Cost observability on silicon (round 7): the measured-vs-model roofline
+# record + one live fleet profile capture.
+#
+# PR 15 made every compile site extract the compiled executable's
+# cost_analysis()/memory_analysis(); bench records now carry
+# hbm_gb_s_measured / roofline_frac_measured next to the analytical
+# hbm_gb_s_model / roofline_frac columns. On CPU those columns only
+# prove plumbing — THIS step records them on the chip, where the
+# question is real: does XLA's compiled-traffic figure corroborate the
+# u8 one-read-one-write model the ~11% roofline_frac headline divides
+# by, or does the measured series re-base the claim? Then a real fabric
+# pod takes offered load while POST /control/profile captures one live
+# window — the first committed merged host+device trace from a
+# traffic-serving replica (until now every committed profile came from
+# the offline capture shim).
+# Budget: ~4-6 min.
+set -u
+cd "$(dirname "$0")/../.."
+. tools/tpu_queue/_lib.sh
+out=artifacts/cost_r07.out
+: > "$out"
+# 1) measured-vs-model columns on the headline + stencil-class configs
+for cfg in gaussian5_8k gaussian3_4k reference_pipeline_4k; do
+  timeout 1200 python -m mpi_cuda_imagemanipulation_tpu.bench_suite \
+    --config "$cfg" >> "$out" 2>&1
+done
+# 2) per-stage drift on silicon: the megakernel one-read-one-write gate
+#    judged by the chip's own memory_analysis, fused AND fused-pallas
+timeout 600 python - >> "$out" 2>&1 <<'EOF'
+import json
+from mpi_cuda_imagemanipulation_tpu.obs import cost as obs_cost
+from mpi_cuda_imagemanipulation_tpu.ops.registry import make_pipeline_ops
+from mpi_cuda_imagemanipulation_tpu.plan import build_plan
+
+ops = make_pipeline_ops("grayscale,contrast:3.5,gaussian:5,sharpen,quantize:6")
+for mode, pallas in (("fused", False), ("fused-pallas", True)):
+    plan = build_plan(ops, mode)
+    rows = obs_cost.attribute_plan(plan, (4320, 7680, 3), pallas=pallas)
+    print(json.dumps({
+        "lane": f"stage_drift_{mode}",
+        "fingerprint": plan.fingerprint,
+        "stages": [
+            {k: r[k] for k in ("stage", "names", "modeled_bytes", "drift_ratio")}
+            for r in rows
+        ],
+    }))
+EOF
+# 3) live profile capture under fabric offered load: pod up, loadgen on,
+#    one POST /control/profile mid-stream, artifact committed
+timeout 900 python - >> "$out" 2>&1 <<'EOF'
+import json, shutil, threading, time, urllib.request
+import numpy as np
+from mpi_cuda_imagemanipulation_tpu.fabric.replica import ReplicaRuntime
+from mpi_cuda_imagemanipulation_tpu.fabric.router import Router, RouterConfig
+from mpi_cuda_imagemanipulation_tpu.io.image import encode_image_bytes, synthetic_image
+from mpi_cuda_imagemanipulation_tpu.obs import trace as obs_trace
+from mpi_cuda_imagemanipulation_tpu.serve.loadgen import http_run_offered_load
+from mpi_cuda_imagemanipulation_tpu.serve.server import ServeConfig
+
+obs_trace.configure(sample=0.05)  # sampled + tail-kept, like production
+router = Router(RouterConfig(buckets=((1024, 1024),))).start()
+rt = ReplicaRuntime("r0", router.url, ServeConfig(
+    ops="grayscale,contrast:3.5,emboss:3", buckets=((1024, 1024),),
+    channels=(3,), max_batch=4,
+), heartbeat_s=0.3).start()
+try:
+    while not router._routable():
+        time.sleep(0.05)
+    blob = bytes(encode_image_bytes(
+        np.asarray(synthetic_image(1000, 1000, channels=3, seed=7))
+    ))
+    prof = {}
+    def capture():
+        time.sleep(2.0)  # mid-loadgen
+        req = urllib.request.Request(
+            router.url + "/control/profile",
+            data=json.dumps({"seconds": 3.0}).encode(), method="POST")
+        with urllib.request.urlopen(req, timeout=60) as r:
+            prof.update(json.loads(r.read()))
+    t = threading.Thread(target=capture); t.start()
+    rec = http_run_offered_load(router.url, [blob], 20.0, 8.0)
+    t.join()
+    rec.pop("results", None)
+    print(json.dumps({"lane": "profile_under_load", "loadgen": rec,
+                      "capture": {k: prof.get(k) for k in
+                                  ("replica", "status", "seconds",
+                                   "host_events", "device_events")}}))
+    shutil.copyfile(prof["artifact"], "artifacts/profile_live_r07.json")
+finally:
+    rt.close(drain=False, deadline_s=5.0)
+    router.close()
+EOF
+commit_artifacts "TPU window: measured-vs-model roofline + live fleet profile capture (round 7)" \
+  "$out" artifacts/profile_live_r07.json
+exit 0
