@@ -87,6 +87,23 @@ rule(
     "every production dump site names its trigger as a string literal.",
 )
 rule(
+    "obs-systolic-fallback-unknown", "obs",
+    "count_fallback() names a reason missing from FALLBACK_REASONS in "
+    "graph/systolic.py (the typo'd reason would raise at count time — "
+    "on the fallback path that exists to never wrong an answer).",
+)
+rule(
+    "obs-systolic-fallback-unused", "obs",
+    "A FALLBACK_REASONS entry has no count_fallback() caller anywhere — "
+    "a fallback lane no dispatch path can attribute to.",
+)
+rule(
+    "obs-systolic-fallback-dynamic", "obs",
+    "count_fallback() called with a non-literal reason in package code — "
+    "the closed FALLBACK_REASONS vocabulary is only machine-checkable "
+    "when every fallback site names its reason as a string literal.",
+)
+rule(
     "obs-cost-attribution-missing", "obs",
     "A compile-cache insertion site (a store into a `_fns` cache dict or "
     "a cache_put() call) in package code never touches the cost-"
@@ -116,7 +133,7 @@ rule(
 
 _METRIC_RE = re.compile(
     r"^mcim_(serve|engine|cache|breaker|health|batch|analysis|fabric|stream"
-    r"|plan|fleet|slo|graph|cost|devmem)_[a-z0-9_]+$"
+    r"|plan|fleet|slo|graph|cost|devmem|systolic)_[a-z0-9_]+$"
 )
 
 
@@ -137,6 +154,7 @@ def check_obs(repo: Repo):
     findings.extend(_check_failpoints(repo))
     findings.extend(_check_exemplars(repo))
     findings.extend(_check_recorder_triggers(repo))
+    findings.extend(_check_systolic_fallbacks(repo))
     findings.extend(_check_graph_taxonomy(repo))
     findings.extend(_check_cost_attribution(repo))
     return findings
@@ -328,7 +346,8 @@ def _check_metrics(repo: Repo) -> list:
                     f"metric {name!r} violates the "
                     "mcim_<subsystem>_<what> scheme "
                     "(subsystems: serve/engine/cache/breaker/health/"
-                    "batch/analysis/fabric/stream/plan/fleet/slo/graph)"
+                    "batch/analysis/fabric/stream/plan/fleet/slo/graph/"
+                    "systolic)"
                 )
             elif kind == "counter" and not name.endswith("_total"):
                 msg = f"counter {name!r} must end in _total"
@@ -511,6 +530,93 @@ def _check_recorder_triggers(repo: Repo) -> list:
                 f"{PACKAGE}/obs/recorder.py", reg_line,
                 f"KNOWN_TRIGGERS entry {trigger!r} has no recorder.dump() "
                 "caller anywhere in the repo",
+            )
+        )
+    return findings
+
+
+# -- systolic fallback reasons (graph/systolic.py) ----------------------------
+
+
+def _known_fallback_reasons(repo: Repo) -> tuple[set[str], int]:
+    sf = repo.by_rel.get(f"{PACKAGE}/graph/systolic.py")
+    if sf is None:
+        return set(), 0
+    for node in sf.tree.body:
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if (
+                    isinstance(tgt, ast.Name)
+                    and tgt.id == "FALLBACK_REASONS"
+                ):
+                    vals = {
+                        e.value
+                        for e in ast.walk(node.value)
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)
+                    }
+                    return vals, node.lineno
+    return set(), 0
+
+
+def _is_count_fallback(node: ast.Call) -> bool:
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr == "count_fallback"
+    return isinstance(fn, ast.Name) and fn.id == "count_fallback"
+
+
+def _check_systolic_fallbacks(repo: Repo) -> list:
+    """The systolic fallback vocabulary is closed exactly like recorder
+    triggers: every count_fallback(counter, reason) site must name a
+    FALLBACK_REASONS literal, and every entry must have a caller — a
+    reason nobody can count is a fallback lane the metrics cannot see."""
+    findings = []
+    known, reg_line = _known_fallback_reasons(repo)
+    if not known:
+        return findings
+    used: set[str] = set()
+    for sf in repo.files:
+        if sf.rel == f"{PACKAGE}/graph/systolic.py":
+            continue
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Call) and len(node.args) >= 2):
+                continue
+            if not _is_count_fallback(node):
+                continue
+            a1 = node.args[1]
+            if isinstance(a1, ast.Constant) and isinstance(a1.value, str):
+                reason = a1.value
+                used.add(reason)
+                if reason not in known and sf.rel.startswith(
+                    (PACKAGE + "/", "tools/")
+                ):
+                    # tests may pass an out-of-vocabulary reason on
+                    # purpose — asserting the ValueError guard fires
+                    findings.append(
+                        make_finding(
+                            "obs-systolic-fallback-unknown", sf.rel,
+                            node.lineno,
+                            f"systolic fallback reason {reason!r} is not "
+                            "in FALLBACK_REASONS (graph/systolic.py)",
+                        )
+                    )
+            elif sf.rel.startswith(PACKAGE + "/"):
+                findings.append(
+                    make_finding(
+                        "obs-systolic-fallback-dynamic", sf.rel,
+                        node.lineno,
+                        "count_fallback() reason is not a string literal "
+                        "— name one of FALLBACK_REASONS directly",
+                    )
+                )
+    for reason in sorted(known - used):
+        findings.append(
+            make_finding(
+                "obs-systolic-fallback-unused",
+                f"{PACKAGE}/graph/systolic.py", reg_line,
+                f"FALLBACK_REASONS entry {reason!r} has no "
+                "count_fallback() caller anywhere in the repo",
             )
         )
     return findings

@@ -351,6 +351,7 @@ STREAM_AB = "stream_ab"
 PLAN_AB = "plan_ab"
 MEGAKERNEL_AB = "megakernel_ab"
 GRAPH_LOADGEN = "graph_loadgen"
+SYSTOLIC_AB = "systolic_ab"
 
 
 def fabric_loadgen_params() -> dict:
@@ -2114,6 +2115,278 @@ def run_graph_loadgen(
     return rec
 
 
+def systolic_ab_params() -> dict:
+    """The pod-level systolic A/B knobs: a chain LONG enough that
+    stage-sharding it across two replicas is a real structural change
+    (8 per-op steps, comfortably past the placement floor), every op
+    streamable and channel-preserving so the program is
+    systolic-eligible. Env overrides for tools/tpu_queue and tests:
+    MCIM_SYSTOLIC_AB_OPS/_REQUESTS/_HEIGHT."""
+    on_tpu = is_tpu_backend()
+    params = {
+        "ops": (
+            "invert,gaussian:3,sharpen,box:3,quantize:6,"
+            "gaussian:5,posterize:4,median"
+        ),
+        "height": 1024 if on_tpu else 72,
+        "requests": 64 if on_tpu else 16,
+        "channels": "3",
+        "max_batch": 4,
+        "max_delay_ms": 2.0,
+        "queue_depth": 64,
+        "heartbeat_s": 0.2,
+    }
+    for env, key, cast in (
+        ("MCIM_SYSTOLIC_AB_OPS", "ops", str),
+        ("MCIM_SYSTOLIC_AB_REQUESTS", "requests", int),
+        ("MCIM_SYSTOLIC_AB_HEIGHT", "height", int),
+    ):
+        raw = env_registry.get(env)
+        if raw:
+            params[key] = cast(raw)
+    params["width"] = params["height"]
+    params["buckets"] = str(params["height"])
+    return params
+
+
+def run_systolic_ab(
+    *,
+    json_path: str | None = None,
+    printer: Callable[[str], None] = print,
+) -> dict:
+    """The pod-level systolic bench lane: the SAME >= 8-stage DAG
+    pipeline driven through two pod shapes —
+
+      * ``systolic`` — a real 2-replica pod with `--systolic` armed: the
+        router stage-shards the registered program across both replicas
+        and the live env streams replica-to-replica at every stage
+        boundary (graph/systolic.py);
+      * ``pinned``   — the identical 2-replica pod with the knob off:
+        sticky affinity pins each request to ONE replica that walks all
+        stages itself (the baseline every fallback degrades to);
+
+    gated BIT-IDENTICAL pre-timing (both lanes' response bytes vs the
+    in-process golden executor — the u8 exact-integer carry makes the
+    cross-replica handoff lossless, so anything else is a bug, not a
+    tolerance), then measured closed-loop over the same request count.
+    After timing, the federated mcim_systolic_tiles_forwarded_total must
+    read EXACTLY requests x stage boundaries — the transport mirror of
+    the HLO collective-permute count, proving no request silently fell
+    back to the pinned lane mid-measurement."""
+    import json as _json
+    import time as _time
+    import urllib.request
+
+    import numpy as np
+
+    from mpi_cuda_imagemanipulation_tpu.graph import (
+        compile_graph,
+        graph_callable,
+        parse_spec,
+    )
+    from mpi_cuda_imagemanipulation_tpu.graph.spec import chain_as_spec
+    from mpi_cuda_imagemanipulation_tpu.io.image import (
+        decode_image_bytes,
+        encode_image_bytes,
+    )
+    from mpi_cuda_imagemanipulation_tpu.obs.metrics import parse_exposition
+    from mpi_cuda_imagemanipulation_tpu.serve import loadgen
+
+    p = systolic_ab_params()
+    spec = chain_as_spec(p["ops"])
+    n_steps = len(p["ops"].split(","))
+    img = synthetic_image(p["height"], p["width"], channels=3, seed=23)
+    blob = bytes(loadgen.encode_blob(np.asarray(img)))
+    golden = np.asarray(
+        graph_callable(compile_graph(parse_spec(spec)))(img)["image"]
+    )
+
+    def counter(fams: dict, name: str) -> float:
+        fam = fams.get(name)
+        if not fam:
+            return 0.0
+        return sum(fam["samples"].values())
+
+    def run_lane(systolic: bool) -> tuple[dict, bytes, dict]:
+        extra = ("--systolic",) if systolic else ()
+        with _FabricProc(p, 2, extra_args=extra) as fab:
+            fab.wait_routable(2)
+            if systolic:
+                # placement needs BOTH replicas advertising stage
+                # ownership (heartbeats) before the first dispatch, or
+                # early requests fall back and the one-forward-per-
+                # boundary accounting below goes soft
+                deadline = _time.monotonic() + 60.0
+                while _time.monotonic() < deadline:
+                    reps = fab.stats()["replicas"]
+                    if sum(
+                        1 for r in reps.values()
+                        if r["fresh"] and r["systolic"]
+                    ) >= 2:
+                        break
+                    _time.sleep(0.2)
+            req = urllib.request.Request(
+                fab.url + "/v1/pipelines",
+                data=_json.dumps(
+                    {"tenant": "acme", "spec": spec}
+                ).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=30.0) as resp:
+                pid = _json.loads(resp.read())["pipeline"]
+            hdrs = {"X-MCIM-Tenant": "acme", "X-MCIM-Pipeline": pid}
+
+            # -- bit-exactness gate BEFORE any timing (also the warmup:
+            # the owners compile their stage ranges here) ---------------
+            n_sent = 0
+            deadline = _time.monotonic() + 120.0
+            while True:
+                gate = loadgen.http_post_image(fab.url, blob, headers=hdrs)
+                n_sent += 1
+                if gate["code"] == 200:
+                    break
+                if _time.monotonic() > deadline:
+                    raise AssertionError(
+                        f"systolic_ab gate: lane "
+                        f"{'systolic' if systolic else 'pinned'} never "
+                        f"answered 200 (last {gate['code']})"
+                    )
+                _time.sleep(0.2)
+            np.testing.assert_array_equal(
+                decode_image_bytes(gate["body"]), golden,
+                err_msg="systolic_ab gate: response is not bit-exact "
+                "against the in-process golden executor",
+            )
+            if systolic:
+                pl = fab.stats()["systolic"]["placements"].get(pid)
+                if not pl or len(pl["ranges"]) < 2:
+                    raise AssertionError(
+                        f"systolic_ab: program was never stage-sharded "
+                        f"(placement {pl})"
+                    )
+                if len(set(pl["owners"])) < 2:
+                    raise AssertionError(
+                        f"systolic_ab: both ranges landed on one "
+                        f"replica ({pl['owners']})"
+                    )
+            else:
+                pl = None
+
+            # -- the timed closed loop ----------------------------------
+            results = []
+            t0 = _time.monotonic()
+            for _ in range(p["requests"]):
+                r = loadgen.http_post_image(fab.url, blob, headers=hdrs)
+                if r["code"] == 200 and r["body"] != gate["body"]:
+                    raise AssertionError(
+                        "systolic_ab: a response drifted mid-run"
+                    )
+                results.append((0, r))
+                n_sent += 1
+            wall = _time.monotonic() - t0
+            rec = loadgen.summarize_http_results(
+                results, wall, len(results) / wall if wall else 0.0
+            )
+
+            extras: dict = {}
+            if systolic:
+                # exactly one transport forward per stage boundary, for
+                # EVERY request this lane sent (gate included) — counted
+                # federated, so give the last heartbeat time to land
+                boundaries = len(pl["ranges"]) - 1
+                expect = n_sent * boundaries
+                deadline = _time.monotonic() + 60.0
+                while True:
+                    with urllib.request.urlopen(
+                        fab.url + "/metrics", timeout=10.0
+                    ) as resp:
+                        fams = parse_exposition(resp.read().decode())
+                    forwards = counter(
+                        fams, "mcim_systolic_tiles_forwarded_total"
+                    )
+                    if forwards >= expect:
+                        break
+                    if _time.monotonic() > deadline:
+                        raise AssertionError(
+                            f"systolic_ab: {forwards:.0f} transport "
+                            f"forwards for {n_sent} requests x "
+                            f"{boundaries} boundaries — some requests "
+                            "fell back mid-measurement"
+                        )
+                    _time.sleep(0.2)
+                if forwards != expect:
+                    raise AssertionError(
+                        f"systolic_ab: {forwards:.0f} forwards != "
+                        f"{n_sent} requests x {boundaries} boundaries"
+                    )
+                extras = {
+                    "placement": pl,
+                    "requests_sent": n_sent,
+                    "stage_boundaries": boundaries,
+                    "forwards": forwards,
+                    "forwards_per_request": forwards / n_sent,
+                    "exchange_bytes_per_request": counter(
+                        fams, "mcim_systolic_exchange_bytes_total"
+                    ) / n_sent,
+                }
+            return rec, gate["body"], extras
+
+    sys_rec, sys_body, sys_extras = run_lane(True)
+    pin_rec, pin_body, _ = run_lane(False)
+    if sys_body != pin_body:
+        raise AssertionError(
+            "systolic_ab: systolic and pinned response bytes differ — "
+            "the cross-replica handoff is NOT lossless"
+        )
+    speedup = (
+        sys_rec["achieved_rps"] / pin_rec["achieved_rps"]
+        if pin_rec["achieved_rps"]
+        else None
+    )
+    rec = {
+        "config": SYSTOLIC_AB,
+        "pipeline": p["ops"],
+        "impl": "systolic_ab",
+        "platform": jax.default_backend(),
+        "height": p["height"],
+        "width": p["width"],
+        "requests": p["requests"],
+        "stages": n_steps,
+        "bit_exact_gate": (
+            "passed (systolic bytes == pinned bytes == in-process golden)"
+        ),
+        "lanes": {"systolic": sys_rec, "pinned": pin_rec},
+        **sys_extras,
+        "speedup_systolic_vs_pinned": speedup,
+    }
+    printer(
+        f"{'lane':10s} {'ok%':>6s} {'req/s':>8s} "
+        f"{'p50 ms':>8s} {'p99 ms':>8s}"
+    )
+    for name, lr in (("systolic", sys_rec), ("pinned", pin_rec)):
+        printer(
+            f"{name:10s} {lr['ok_frac'] * 100:5.1f}% "
+            f"{lr['achieved_rps']:8.1f} "
+            f"{lr.get('e2e_p50_ms', float('nan')):8.2f} "
+            f"{lr.get('e2e_p99_ms', float('nan')):8.2f}"
+        )
+    pl = sys_extras["placement"]
+    printer(
+        f"placed {pl['ranges']} on {pl['owners']} ({pl['source']}); "
+        f"{sys_extras['forwards']:.0f} forwards / "
+        f"{sys_extras['requests_sent']} requests == "
+        f"{sys_extras['stage_boundaries']} per request, "
+        f"{sys_extras['exchange_bytes_per_request']:.0f} exchange "
+        "bytes/request"
+    )
+    if speedup is not None:
+        printer(f"systolic vs pinned: {speedup:.2f}x achieved req/s")
+    if json_path:
+        emit_json_metrics(rec, None if json_path == "-" else json_path)
+    return rec
+
+
 def run_suite(
     names: Sequence[str] | None = None,
     *,
@@ -2191,12 +2464,21 @@ def run_suite(
         )
         if not names:
             return records
+    if names and SYSTOLIC_AB in names:
+        # the systolic lane measures two whole-pod structures (stage-
+        # sharded vs pinned) over one DAG, not one executable
+        names = [n for n in names if n != SYSTOLIC_AB]
+        records.append(
+            run_systolic_ab(json_path=json_path, printer=printer)
+        )
+        if not names:
+            return records
     if names:
         unknown = [n for n in names if n not in CONFIGS]
         if unknown:
             raise ValueError(
                 f"unknown bench config(s) {unknown}; known: "
-                f"{sorted(CONFIGS) + [ENGINE_AB, FABRIC_LOADGEN, GRAPH_LOADGEN, MEGAKERNEL_AB, MXU_AB, PLAN_AB, SERVE_LOADGEN, STREAM_AB]}"
+                f"{sorted(CONFIGS) + [ENGINE_AB, FABRIC_LOADGEN, GRAPH_LOADGEN, MEGAKERNEL_AB, MXU_AB, PLAN_AB, SERVE_LOADGEN, STREAM_AB, SYSTOLIC_AB]}"
             )
         selected = [CONFIGS[n] for n in names]
     else:
@@ -2295,7 +2577,7 @@ def main(argv: Sequence[str] | None = None) -> int:
         required=True,
         choices=sorted(CONFIGS)
         + [ENGINE_AB, FABRIC_LOADGEN, GRAPH_LOADGEN, MEGAKERNEL_AB, MXU_AB,
-           PLAN_AB, SERVE_LOADGEN, STREAM_AB],
+           PLAN_AB, SERVE_LOADGEN, STREAM_AB, SYSTOLIC_AB],
     )
     ap.add_argument(
         "--impl",
@@ -2353,6 +2635,12 @@ def main(argv: Sequence[str] | None = None) -> int:
         "cycling interactive/standard/batch "
         "(env MCIM_GRAPH_TENANTS works too)",
     )
+    ap.add_argument(
+        "--json-metrics",
+        default=None,
+        help="also write the record to this path ('-' = stdout); the "
+        "one JSON line always goes to stdout regardless",
+    )
     args = ap.parse_args(argv)
     if args.config == SERVE_LOADGEN:
         rec = run_serve_loadgen(
@@ -2378,11 +2666,15 @@ def main(argv: Sequence[str] | None = None) -> int:
         rec = run_graph_loadgen(
             printer=lambda s: None, tenants=args.tenants
         )
+    elif args.config == SYSTOLIC_AB:
+        rec = run_systolic_ab(printer=lambda s: None)
     else:
         cfg = CONFIGS[args.config]
         if args.halo_mode is not None and cfg.sharded:
             cfg = dataclasses.replace(cfg, halo_mode=args.halo_mode)
         rec = run_config(cfg, args.impl, n_shards=args.shards)
+    if args.json_metrics and args.json_metrics != "-":
+        emit_json_metrics(rec, args.json_metrics)
     print(json.dumps(rec), flush=True)
     return 0
 

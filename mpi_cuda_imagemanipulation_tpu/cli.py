@@ -530,6 +530,17 @@ def _build_parser() -> argparse.ArgumentParser:
         help="autoscaler floor (default MCIM_FABRIC_MIN_REPLICAS)",
     )
     fab.add_argument(
+        "--systolic",
+        action="store_true",
+        default=None,
+        help="pod-level systolic execution (graph/systolic.py): the "
+        "router stage-shards registered DAG pipelines across replicas "
+        "and the live env streams replica-to-replica at each stage "
+        "boundary; replicas advertise stage ownership in heartbeats and "
+        "any fallback is the pinned single-replica path — never a wrong "
+        "answer (default MCIM_SYSTOLIC)",
+    )
+    fab.add_argument(
         "--max-replicas",
         type=int,
         default=None,
@@ -1772,6 +1783,7 @@ def cmd_serve(args: argparse.Namespace) -> int:
             ("forward_attempts", None), ("mesh_shards", 0),
             ("slo", None), ("autoscale", False),
             ("min_replicas", None), ("max_replicas", None),
+            ("systolic", None),
         ):
             if not hasattr(args, name):
                 setattr(args, name, default)
@@ -1887,13 +1899,20 @@ def cmd_fabric(args: argparse.Namespace) -> int:
         Fabric,
         FabricConfig,
     )
+    from mpi_cuda_imagemanipulation_tpu.graph.systolic import ENV_SYSTOLIC
     from mpi_cuda_imagemanipulation_tpu.serve.bucketing import parse_buckets
+    from mpi_cuda_imagemanipulation_tpu.utils import env as env_registry
     from mpi_cuda_imagemanipulation_tpu.utils.log import (
         emit_json_metrics,
         get_logger,
     )
 
     log = get_logger()
+    systolic = (
+        args.systolic
+        if args.systolic is not None
+        else env_registry.get_bool(ENV_SYSTOLIC)
+    )
     cfg = FabricConfig(
         replicas=args.replicas,
         ops=args.ops,
@@ -1915,6 +1934,7 @@ def cmd_fabric(args: argparse.Namespace) -> int:
         autoscale=args.autoscale,
         min_replicas=args.min_replicas,
         max_replicas=args.max_replicas,
+        systolic=systolic,
     )
     stop_evt = threading.Event()
 

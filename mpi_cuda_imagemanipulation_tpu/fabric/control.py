@@ -108,6 +108,12 @@ class Heartbeat:
     # so a RESTARTED replica (empty registry, same warm discipline as
     # the compile cache) reconverges within one forward, not never.
     pipelines: list[str] | None = None
+    # stage-ownership advert (graph/systolic.py): True when this replica
+    # accepts /v1/systolic hops, so the router only places program
+    # stages on replicas that will run them. None (the wire default) is
+    # "not advertised" — old beats parse, and the router treats both
+    # None and False as ineligible.
+    systolic: bool | None = None
 
     def to_json(self) -> bytes:
         return json.dumps(dataclasses.asdict(self)).encode()

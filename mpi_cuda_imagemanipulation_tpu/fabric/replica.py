@@ -50,7 +50,9 @@ from mpi_cuda_imagemanipulation_tpu.fabric.control import (
     Heartbeat,
     HeartbeatSender,
 )
+from mpi_cuda_imagemanipulation_tpu.graph.systolic import ENV_SYSTOLIC
 from mpi_cuda_imagemanipulation_tpu.resilience import failpoints
+from mpi_cuda_imagemanipulation_tpu.utils import env as env_registry
 from mpi_cuda_imagemanipulation_tpu.utils.log import get_logger
 
 
@@ -118,6 +120,7 @@ class ReplicaRuntime:
             sent_unix_s=time.time(),
             metrics=self.delta_source.delta(),
             pipelines=app.graph_pipeline_ids(),
+            systolic=app.config.systolic,
         )
 
     def _on_heartbeat_ack(self, hb: Heartbeat, ack: dict) -> None:
@@ -187,6 +190,13 @@ def _build_parser() -> argparse.ArgumentParser:
     # the canary deploy path flips this per replica (plan-mode config
     # flips are the gate's canonical workload)
     p.add_argument("--plan", default="auto")
+    # pod-level systolic execution (graph/systolic.py): accept placed
+    # stage ranges + /v1/systolic hops; advertised in every heartbeat
+    p.add_argument(
+        "--systolic",
+        action="store_true",
+        default=env_registry.get_bool(ENV_SYSTOLIC),
+    )
     p.add_argument("--host", default="")
     p.add_argument("--port", type=int, default=0)
     p.add_argument("--heartbeat-s", type=float, default=None)
@@ -223,6 +233,7 @@ def main(argv: list[str] | None = None) -> int:
         channels=channels,
         backend="xla" if args.impl == "auto" else args.impl,
         plan=args.plan,
+        systolic=args.systolic,
     )
     rt = ReplicaRuntime(
         args.replica_id,

@@ -57,6 +57,7 @@ from mpi_cuda_imagemanipulation_tpu.fabric.control import (
     HEARTBEAT_PATH,
     Heartbeat,
 )
+from mpi_cuda_imagemanipulation_tpu.graph import systolic as graph_systolic
 from mpi_cuda_imagemanipulation_tpu.obs import fleet as obs_fleet
 from mpi_cuda_imagemanipulation_tpu.obs import metrics as obs_metrics
 from mpi_cuda_imagemanipulation_tpu.obs import recorder as flight_recorder
@@ -208,6 +209,9 @@ class RouterConfig:
     # canary rollback gate knobs (fabric/canary.py); None fields fall
     # back to their MCIM_FABRIC_CANARY_* env defaults
     canary: fabric_canary.CanaryConfig | None = None
+    # pod-level systolic execution (graph/systolic.py): stage-shard
+    # eligible graph programs across systolic-advertising replicas
+    systolic: bool = False
 
 
 class Router:
@@ -305,6 +309,15 @@ class Router:
         # (unlike pipelines), so the re-push bookkeeping lives here — a
         # restart changes the incarnation and naturally re-pushes
         self._tenant_pushed: dict[tuple[str, str], set[str]] = {}
+        # systolic lane state: compiled-program cache (compile_graph is
+        # pure Python — cheap, but not per-request cheap) + the last
+        # placement per pipeline for /stats
+        self.systolic = config.systolic
+        self.systolic_min_steps = int(
+            env_registry.get(graph_systolic.ENV_MIN_STEPS)
+        )
+        self._systolic_programs: dict[tuple[str, str], object] = {}
+        self._systolic_last: dict[str, dict] = {}
         # set by the Fabric when the elastic loop is armed (status only)
         self.autoscaler = None
         self.mesh_lane = mesh_lane
@@ -384,6 +397,27 @@ class Router:
             "mcim_fabric_graph_specs",
             "(tenant, pipeline) specs registered through this router.",
             fn=lambda: float(len(self.graph_specs)),
+        )
+        # -- pod-level systolic execution (graph/systolic.py) ---------------
+        self._m_sys_requests = r.counter(
+            "mcim_systolic_requests_total",
+            "Graph requests dispatched on the stage-sharded lane, by "
+            "terminal outcome (ok = final owner's response relayed; "
+            "refused = the entry owner's own 4xx/shed relayed verbatim).",
+            labels=("status",),
+        )
+        self._m_sys_placed = r.counter(
+            "mcim_systolic_stages_placed_total",
+            "Step ranges placed onto stage owners (one per owner per "
+            "placed request).",
+        )
+        self._m_sys_fallbacks = r.counter(
+            "mcim_systolic_fallbacks_total",
+            "Graph requests answered on the pinned-replica lane "
+            "instead, by reason (graph/systolic.FALLBACK_REASONS — a "
+            "closed vocabulary enforced at the count_fallback choke "
+            "point).",
+            labels=("reason",),
         )
         # -- on-demand fleet profiling (obs/profile.capture_live) -----------
         self._m_profile = r.counter(
@@ -923,6 +957,178 @@ class Router:
 
     # -- pipeline service lane (graph/) ------------------------------------
 
+    def _systolic_program(self, tenant: str, pipeline: str):
+        """The compiled GraphProgram for a stored spec (placement needs
+        its step structure + balancer weights), cached per (tenant,
+        pipeline) — compile_graph is pure Python, but not per-request
+        cheap. None when the spec never registered through this router."""
+        from mpi_cuda_imagemanipulation_tpu.graph.compile import (
+            compile_graph,
+            split_for_placement,
+        )
+        from mpi_cuda_imagemanipulation_tpu.graph.spec import parse_spec
+
+        with self._graph_lock:
+            prog = self._systolic_programs.get((tenant, pipeline))
+            reg = self.graph_specs.get((tenant, pipeline))
+        if prog is not None:
+            return prog
+        if reg is None:
+            return None
+        try:
+            # the canonical systolic step form — plan='off' + stage
+            # splitting, matching graph/service._sub_fn exactly so the
+            # placement's step indices mean the same thing on the owners
+            prog = split_for_placement(
+                compile_graph(parse_spec(reg["spec"]), plan="off")
+            )
+        except Exception:
+            return None
+        with self._graph_lock:
+            self._systolic_programs[(tenant, pipeline)] = prog
+        return prog
+
+    def _systolic_owners(self, tenant: str, pipeline: str):
+        """Routable stage-owner candidates, rendezvous-ordered per
+        pipeline so repeated requests land on the same owners (warm
+        subrange executables), in a stable stage order."""
+        views = [v for v in self._routable() if v.hb.systolic]
+        views.sort(
+            key=lambda v: _rendezvous_score(
+                f"systolic|{tenant}|{pipeline}", v.replica_id
+            ),
+            reverse=True,
+        )
+        return views
+
+    def _try_systolic(
+        self, body: bytes, tenant: str, pipeline: str, h: int, w: int
+    ):
+        """Attempt the stage-sharded lane for one graph request. Returns
+        a complete HTTP response tuple, or None to fall back to the
+        pinned-replica lane — every None counts exactly one closed-
+        vocabulary fallback reason, and the failure-shaped reasons
+        (owner_down / forward_failed) file a flight-recorder dump. A
+        fallback re-dispatches the SAME body pinned, so a broken chain
+        can slow an answer but never wrong it."""
+        from mpi_cuda_imagemanipulation_tpu.graph.compile import place_steps
+
+        fall = self._m_sys_fallbacks
+        program = self._systolic_program(tenant, pipeline)
+        if program is None or len(program.steps) < self.systolic_min_steps:
+            graph_systolic.count_fallback(fall, "ineligible")
+            return None
+        owners = self._systolic_owners(tenant, pipeline)
+        if len(owners) < 2:
+            graph_systolic.count_fallback(fall, "replicas")
+            return None
+        placement = place_steps(program, len(owners))
+        if placement is None:
+            graph_systolic.count_fallback(fall, "ineligible")
+            return None
+        owners = owners[: placement.n_ranges]
+        try:
+            for v in owners:
+                self._ensure_graph_state(v, tenant, pipeline)
+        except Exception as e:
+            graph_systolic.count_fallback(fall, "owner_down")
+            flight_recorder.dump(
+                "systolic_fallback",
+                extra={
+                    "reason": "owner_down",
+                    "tenant": tenant,
+                    "pipeline": pipeline,
+                    "error": f"{type(e).__name__}: {e}",
+                },
+            )
+            return None
+        root = obs_trace.start_trace(
+            "fabric.systolic", tenant=tenant, pipeline=pipeline,
+            h=h, w=w, owners=len(owners),
+        )
+        header = graph_systolic.encode_placement(
+            tenant=tenant,
+            pipeline=pipeline,
+            ranges=placement.ranges,
+            addrs=[
+                f"{v.hb.addr or '127.0.0.1'}:{v.hb.port}" for v in owners
+            ],
+            trace_id=root.trace_id,
+        )
+        from mpi_cuda_imagemanipulation_tpu.graph.service import (
+            HDR_PIPELINE,
+            HDR_TENANT,
+        )
+
+        try:
+            code, ctype, out, passthrough = self._forward_once(
+                owners[0], body, root.trace_id,
+                extra_headers=(
+                    (HDR_TENANT, tenant),
+                    (HDR_PIPELINE, pipeline),
+                    (graph_systolic.HDR_PLAN, header),
+                ),
+            )
+        except Exception as e:
+            root.set(status="owner_down")
+            root.end()
+            graph_systolic.count_fallback(fall, "owner_down")
+            flight_recorder.dump(
+                "systolic_fallback",
+                extra={
+                    "reason": "owner_down",
+                    "tenant": tenant,
+                    "pipeline": pipeline,
+                    "owner": owners[0].replica_id,
+                    "error": f"{type(e).__name__}: {e}",
+                },
+            )
+            return None
+        if code == 424 or code >= 500:
+            # a broken stage chain (entry answered systolic-broken, or
+            # an owner died into a 5xx): rerun pinned — idempotent
+            # compute, so the client still gets the bit-exact answer
+            root.set(status="forward_failed", code=code)
+            root.end()
+            graph_systolic.count_fallback(fall, "forward_failed")
+            flight_recorder.dump(
+                "systolic_fallback",
+                extra={
+                    "reason": "forward_failed",
+                    "tenant": tenant,
+                    "pipeline": pipeline,
+                    "owner": owners[0].replica_id,
+                    "code": code,
+                },
+            )
+            return None
+        # 200 (relayed final response) or the entry owner's own
+        # refusal/shed — either way the systolic lane answered
+        self._m_sys_placed.inc(placement.n_ranges)
+        self._m_sys_requests.inc(
+            status="ok" if code == 200 else "refused"
+        )
+        self._m_requests.inc(
+            status=_STATUS_LABEL.get(code, "error" if code >= 500 else "ok")
+        )
+        with self._graph_lock:
+            self._systolic_last[pipeline] = {
+                "tenant": tenant,
+                "ranges": [list(r) for r in placement.ranges],
+                "owners": [v.replica_id for v in owners],
+                "weights": [
+                    round(placement.range_weight(k), 3)
+                    for k in range(placement.n_ranges)
+                ],
+                "source": placement.source,
+            }
+        root.set(status=code)
+        root.end()
+        extra = list(passthrough)
+        if root.trace_id:
+            extra.append(("X-Trace-Id", root.trace_id))
+        return code, ctype, out, extra
+
     def _handle_graph_process(
         self, body: bytes, tenant: str, pipeline: str, h: int, w: int
     ) -> tuple[int, str, bytes, list[tuple[str, str]]]:
@@ -952,6 +1158,15 @@ class Router:
                 },
             )
         bucket = f"{picked[0]}x{picked[1]}"
+        if self.systolic:
+            resp = self._try_systolic(body, tenant, pipeline, h, w)
+            if resp is not None:
+                return resp
+        else:
+            # knob accounting: every graph request lands in exactly one
+            # lane, so fallbacks_total partitions the traffic even when
+            # the mode is off
+            graph_systolic.count_fallback(self._m_sys_fallbacks, "off")
         candidates, policy = self.route(
             bucket,
             affinity_key=f"{tenant}|{pipeline}|{bucket}",
@@ -1679,6 +1894,11 @@ class Router:
                 ),
                 "tenants": sorted(self.graph_tenants),
             },
+            "systolic": {
+                "enabled": self.systolic,
+                "min_steps": self.systolic_min_steps,
+                "placements": dict(self._systolic_last),
+            },
             "canary": self.canary.status(),
             "sessions": self.sessions.stats(),
             "autoscaler": (
@@ -1704,6 +1924,7 @@ class Router:
                     "queue_depth": v.hb.queue_depth,
                     "breaker_open": v.hb.breaker_open,
                     "warm_buckets": v.hb.warm_buckets,
+                    "systolic": v.hb.systolic,
                     "beats": v.beats,
                 }
                 for v in self.table.views()

@@ -387,6 +387,10 @@ class FabricConfig:
     # fusion-plan mode every replica serves with (the canary deploy path
     # flips it per replica via `--plan` in the flip argv)
     plan: str = "auto"
+    # pod-level systolic execution: arm the router's stage-sharding lane
+    # AND start every replica with --systolic so heartbeats advertise
+    # stage ownership (graph/systolic.py)
+    systolic: bool = False
     # per-replica env overrides (failpoint injection on one worker, trace
     # export paths, ...) and extra replica argv (e.g. --trace-out)
     replica_env: dict[str, dict[str, str]] = dataclasses.field(
@@ -432,9 +436,13 @@ class Fabric:
                 config.mesh_shards,
                 halo_mode=config.mesh_halo_mode,
             )
+        router_cfg = config.router or RouterConfig(
+            buckets=bucketing.parse_buckets(config.buckets)
+        )
+        if config.systolic:
+            router_cfg = dataclasses.replace(router_cfg, systolic=True)
         self.router = Router(
-            config.router
-            or RouterConfig(buckets=bucketing.parse_buckets(config.buckets)),
+            router_cfg,
             registry=self.registry,
             mesh_lane=mesh_lane,
         )
@@ -482,6 +490,8 @@ class Fabric:
             "--impl", c.impl,
             "--plan", c.plan,
         ]
+        if c.systolic:
+            argv += ["--systolic"]
         if c.heartbeat_s is not None:
             argv += ["--heartbeat-s", str(c.heartbeat_s)]
         argv += c.replica_argv_extra.get(rid, [])

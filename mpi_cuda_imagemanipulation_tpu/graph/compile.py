@@ -202,6 +202,199 @@ def compile_graph(
     )
 
 
+# --------------------------------------------------------------------------
+# Stage placement (pod-level systolic execution)
+# --------------------------------------------------------------------------
+
+
+def split_for_placement(program: GraphProgram) -> GraphProgram:
+    """The program with every multi-stage RunSegment split into one
+    segment per plan stage — the canonical systolic step form.
+
+    A linear chain compiles to ONE RunSegment (no interior
+    materialization boundary), which would leave the placement pass
+    nothing to cut; but the segment's plan stages each materialize u8
+    anyway (`_run_step` runs `run_stage_full` per stage), so promoting
+    those stage boundaries to step boundaries changes no value — it only
+    names the intermediates (`dst~i`; `~` cannot appear in a spec node
+    id, so synthesized keys never collide) and makes them placeable.
+    Both the router (placement) and the stage owners (subrange
+    executables) derive this form from the same spec with `plan='off'`,
+    so step indices agree across processes with no shared state."""
+    steps: list[Step] = []
+    for step in program.steps:
+        if (
+            not isinstance(step, RunSegment)
+            or len(step.plan.stages) <= 1
+        ):
+            steps.append(step)
+            continue
+        src = step.src
+        n = len(step.plan.stages)
+        for i, stage in enumerate(step.plan.stages):
+            dst = step.dst if i == n - 1 else f"{step.dst}~{i}"
+            steps.append(
+                RunSegment(
+                    dst=dst,
+                    src=src,
+                    plan=Plan(stages=(stage,), mode=step.plan.mode),
+                )
+            )
+            src = dst
+    return dataclasses.replace(program, steps=tuple(steps))
+
+
+@dataclasses.dataclass(frozen=True)
+class StagePlacement:
+    """Contiguous step-index ranges assigned to stage-owning replicas.
+
+    Cuts land exactly at the materialization boundaries the step
+    partition already produces (every step's `dst` is an env value), so
+    a cut ships only live env arrays — u8, already materialized — and
+    the cross-replica handoff inherits the exact-integer carry contract
+    for free. Contiguity in topological order is also the merge-barrier
+    guarantee: every input of a step in range k was produced in range
+    <= k, so a merge never waits on a later-placed branch."""
+
+    ranges: tuple[tuple[int, int], ...]  # [lo, hi) step indices, topo order
+    weights: tuple[float, ...]  # per-step balancer weight (bytes/pixel)
+    source: str  # "measured" when any ledger record fed a weight
+
+    @property
+    def n_ranges(self) -> int:
+        return len(self.ranges)
+
+    def owner_of(self, step_idx: int) -> int:
+        for k, (lo, hi) in enumerate(self.ranges):
+            if lo <= step_idx < hi:
+                return k
+        raise IndexError(f"step {step_idx} is outside every range")
+
+    def range_weight(self, k: int) -> float:
+        lo, hi = self.ranges[k]
+        return float(sum(self.weights[lo:hi]))
+
+
+def partition_weights(
+    weights: list[float] | tuple[float, ...], n: int
+) -> tuple[tuple[int, int], ...]:
+    """Contiguous partition of `weights` into `n` non-empty ranges
+    minimizing the maximum range sum — the classic linear-partition DP
+    (step/stage counts are tiny, so O(n * k^2) is free). Returns [lo, hi)
+    index pairs covering the whole list in order."""
+    k = len(weights)
+    if not 1 <= n <= k:
+        raise ValueError(f"cannot cut {k} weights into {n} non-empty ranges")
+    prefix = [0.0]
+    for w in weights:
+        prefix.append(prefix[-1] + float(w))
+
+    # best[j][i] = minimal max-range-sum splitting weights[:i] into j ranges
+    best = [[float("inf")] * (k + 1) for _ in range(n + 1)]
+    cut = [[0] * (k + 1) for _ in range(n + 1)]
+    for i in range(1, k + 1):
+        best[1][i] = prefix[i]
+    for j in range(2, n + 1):
+        for i in range(j, k + 1):
+            for m in range(j - 1, i):
+                cand = max(best[j - 1][m], prefix[i] - prefix[m])
+                if cand < best[j][i]:
+                    best[j][i] = cand
+                    cut[j][i] = m
+    bounds = [k]
+    j, i = n, k
+    while j > 1:
+        i = cut[j][i]
+        bounds.append(i)
+        j -= 1
+    bounds.append(0)
+    bounds.reverse()
+    return tuple(
+        (bounds[t], bounds[t + 1]) for t in range(len(bounds) - 1)
+    )
+
+
+def _segment_weight(
+    seg: RunSegment, c_in: int, ledger
+) -> tuple[float, int, bool]:
+    """One RunSegment's balancer weight in bytes per source pixel: each
+    fused stage reads its u8 input once and writes its u8 output once
+    (the planner's one-read-one-write model), scaled by the measured
+    drift ratio when the cost ledger holds a record for that stage of
+    this segment's plan (site 'plan', key = plan fingerprint, stage
+    label 's<i>/<kind>' — obs/cost.attribute_plan's keying). Returns
+    (weight, out_channels, measured_any)."""
+    from mpi_cuda_imagemanipulation_tpu.stream.tiles import out_channels
+
+    weight = 0.0
+    measured = False
+    ch = c_in
+    for i, stage in enumerate(seg.plan.stages):
+        try:
+            ch_out = out_channels(stage.ops, ch)
+        except ValueError:
+            ch_out = ch
+        w = float(ch + ch_out)  # u8 in + u8 out, per pixel
+        if ledger is not None:
+            ratio = ledger.drift(
+                "plan", seg.plan.fingerprint, f"s{i}/{stage.kind}"
+            )
+            if ratio is not None and ratio > 0:
+                w *= ratio
+                measured = True
+        weight += w
+        ch = ch_out
+    return weight, ch, measured
+
+
+def place_steps(
+    program: GraphProgram,
+    n_replicas: int,
+    *,
+    channels: int = 3,
+    ledger=None,
+) -> StagePlacement | None:
+    """The stage-placement pass: assign contiguous step subsets of a
+    compiled program to up to `n_replicas` replicas, balanced by
+    per-step boundary bytes — the measured cost-ledger record when one
+    matches the segment plan's stage fingerprint, the analytical
+    one-u8-read-one-u8-write model otherwise.
+
+    Returns None when the program cannot be split usefully (fewer than
+    two steps, or fewer than two replicas) — callers fall back to
+    pinned-replica execution."""
+    if ledger is None:
+        from mpi_cuda_imagemanipulation_tpu.obs.cost import cost_ledger
+
+        ledger = cost_ledger
+    n_steps = len(program.steps)
+    n = min(int(n_replicas), n_steps)
+    if n < 2:
+        return None
+    # channel counts per env key, walked in topo order (merges preserve
+    # the channel count of their inputs by the static channel check)
+    ch_of: dict[str, int] = {program.graph.source_id: channels}
+    weights: list[float] = []
+    measured_any = False
+    for step in program.steps:
+        if isinstance(step, RunSegment):
+            w, ch_out, m = _segment_weight(
+                step, ch_of.get(step.src, channels), ledger
+            )
+            measured_any = measured_any or m
+            ch_of[step.dst] = ch_out
+            weights.append(w)
+        else:
+            ch = ch_of.get(step.node.inputs[0], channels)
+            ch_of[step.dst] = ch
+            weights.append(float(3 * ch))  # two u8 reads + one u8 write
+    return StagePlacement(
+        ranges=partition_weights(weights, n),
+        weights=tuple(weights),
+        source="measured" if measured_any else "modeled",
+    )
+
+
 def _stats_from_hist(hist: jnp.ndarray) -> dict[str, jnp.ndarray]:
     """count/min/max/mean from the integer histogram — derived, so the
     whole side-output family costs one pixels pass. The mean is f32 over
@@ -217,6 +410,27 @@ def _stats_from_hist(hist: jnp.ndarray) -> dict[str, jnp.ndarray]:
     return {"count": total, "min": lo, "max": hi, "mean": mean}
 
 
+def _run_step(step: Step, env: dict, impl: str) -> None:
+    """Execute one step against the env — the single step semantics every
+    executor variant (full program, systolic subrange) shares, so a cut
+    program cannot drift from the pinned one."""
+    from mpi_cuda_imagemanipulation_tpu.plan.exec import run_stage_full
+
+    if isinstance(step, RunSegment):
+        x = env[step.src]
+        for stage in step.plan.stages:
+            if stage.kind == "global":
+                x = stage.ops[0](x)
+            else:
+                x = run_stage_full(stage, x, impl)
+        env[step.dst] = x
+    else:
+        a, b = (env[i] for i in step.node.inputs)
+        env[step.dst] = merge_core(
+            step.node, exact_f32(a), exact_f32(b)
+        ).astype(U8)
+
+
 def graph_callable(program: GraphProgram, *, impl: str = "xla", on_stage=None):
     """The full-image executor: a u8 image -> {output kind: array}
     function (jit it like any backend callable; outputs are `image` u8
@@ -226,8 +440,6 @@ def graph_callable(program: GraphProgram, *, impl: str = "xla", on_stage=None):
     computed-once evidence for shared prefixes (a tap's segment appears
     exactly once in the traced program no matter how many branches read
     it)."""
-    from mpi_cuda_imagemanipulation_tpu.plan.exec import run_stage_full
-
     graph = program.graph
 
     def run(img: jnp.ndarray):
@@ -235,19 +447,7 @@ def graph_callable(program: GraphProgram, *, impl: str = "xla", on_stage=None):
         for step in program.steps:
             if on_stage is not None:
                 on_stage(step)  # python side effect => once per (re)trace
-            if isinstance(step, RunSegment):
-                x = env[step.src]
-                for stage in step.plan.stages:
-                    if stage.kind == "global":
-                        x = stage.ops[0](x)
-                    else:
-                        x = run_stage_full(stage, x, impl)
-                env[step.dst] = x
-            else:
-                a, b = (env[i] for i in step.node.inputs)
-                env[step.dst] = merge_core(
-                    step.node, exact_f32(a), exact_f32(b)
-                ).astype(U8)
+            _run_step(step, env, impl)
         out: dict[str, jnp.ndarray] = {
             "image": env[graph.outputs["image"]]
         }
@@ -261,6 +461,65 @@ def graph_callable(program: GraphProgram, *, impl: str = "xla", on_stage=None):
             out["histogram"] = hists[hist_node]
         if stats_node:
             out["stats"] = _stats_from_hist(hists[stats_node])
+        return out
+
+    return run
+
+
+def live_keys_at(program: GraphProgram, cut: int) -> tuple[str, ...]:
+    """Env keys a cut at step index `cut` must ship downstream: values
+    produced at or before the cut (the source included) that a step in
+    [cut, n) still reads, or that a declared output names. This is
+    exactly the systolic handoff payload — everything else is dead at
+    the boundary and never crosses the wire."""
+    produced = {program.graph.source_id}
+    for step in program.steps[:cut]:
+        produced.add(step.dst)
+    needed: set[str] = set()
+    for step in program.steps[cut:]:
+        if isinstance(step, RunSegment):
+            needed.add(step.src)
+        else:
+            needed.update(step.node.inputs)
+    needed.update(program.graph.outputs.values())
+    return tuple(sorted(needed & produced))
+
+
+def graph_sub_callable(
+    program: GraphProgram, lo: int, hi: int, *, impl: str = "xla"
+):
+    """Executor for the step subrange [lo, hi) — one stage-owning
+    replica's share of a placed program. Takes the live env dict at the
+    `lo` boundary (u8 arrays keyed by node id), returns the live env at
+    the `hi` boundary; when `hi` is the final step the declared outputs
+    ride along under the reserved keys the full executor produces
+    (`~image` / `~histogram` / `~stats` — node ids cannot collide: the
+    spec id regex has no `~`). Step semantics are `_run_step`'s, so a
+    split execution is bit-identical to the pinned one at every env
+    materialization point."""
+    if not 0 <= lo < hi <= len(program.steps):
+        raise ValueError(
+            f"bad step range [{lo}, {hi}) for {len(program.steps)} steps"
+        )
+    graph = program.graph
+    final = hi == len(program.steps)
+
+    def run(env_in: dict):
+        env = dict(env_in)
+        for step in program.steps[lo:hi]:
+            _run_step(step, env, impl)
+        if not final:
+            return {k: env[k] for k in live_keys_at(program, hi)}
+        out = {"~image": env[graph.outputs["image"]]}
+        hist_node = graph.outputs.get("histogram")
+        stats_node = graph.outputs.get("stats")
+        hists: dict[str, jnp.ndarray] = {}
+        for nid in {n for n in (hist_node, stats_node) if n}:
+            hists[nid] = histogram_stats(env[nid], None)
+        if hist_node:
+            out["~histogram"] = hists[hist_node]
+        if stats_node:
+            out["~stats"] = _stats_from_hist(hists[stats_node])
         return out
 
     return run

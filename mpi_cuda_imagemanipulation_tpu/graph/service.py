@@ -40,6 +40,8 @@ import numpy as np
 from mpi_cuda_imagemanipulation_tpu.graph.compile import (
     compile_graph,
     graph_callable,
+    graph_sub_callable,
+    split_for_placement,
 )
 from mpi_cuda_imagemanipulation_tpu.graph.spec import SpecError, parse_spec
 from mpi_cuda_imagemanipulation_tpu.graph.tenancy import (
@@ -92,12 +94,17 @@ class GraphService:
         registry: Registry | None = None,
         backend: str = "xla",
         plan: str = "auto",
+        systolic: bool = False,
         load_frac=None,
         clock=time.monotonic,
     ):
         self.registry = registry or Registry()
         self.backend = backend
         self.plan = plan
+        # stage-sharded execution across replicas (graph/systolic.py);
+        # advertised in heartbeats so the router only places stages on
+        # replicas that will accept /v1/systolic hops
+        self.systolic = systolic
         self.tenants = TenantRegistry(clock=clock)
         # external load signal (the serving scheduler's queue fill); the
         # QoS ladder sheds on max(external, own-inflight fraction)
@@ -138,6 +145,20 @@ class GraphService:
         self._m_compiles = r.counter(
             "mcim_graph_compiles_total",
             "Graph executables built into a tenant cache namespace.",
+        )
+        # replica-side systolic accounting (the router holds the
+        # placement/fallback families; these live where the bytes move)
+        self._m_sys_tiles = r.counter(
+            "mcim_systolic_tiles_forwarded_total",
+            "Live-env handoffs forwarded to the next stage owner "
+            "(one per stage boundary per request — the fabric mirror "
+            "of the sharded path's collective-permute count).",
+        )
+        self._m_sys_bytes = r.counter(
+            "mcim_systolic_exchange_bytes_total",
+            "u8 payload bytes crossing stage boundaries replica-to-"
+            "replica (the traffic the systolic mode moves off the "
+            "front door).",
         )
         r.gauge(
             "mcim_graph_tenants",
@@ -361,6 +382,172 @@ class GraphService:
         st.requests_ok += 1
         return result
 
+    # -- systolic (stage-sharded) dispatch ---------------------------------
+
+    def _sub_fn(self, st, pipeline_id: str, graph, lo: int, hi: int,
+                width: int | None):
+        """Cached jitted executor for the step subrange [lo, hi) — the
+        same tenant LRU namespace as the pinned executable (the '#'
+        cache-key separator cannot appear in a pipeline id), with cost
+        attribution keyed by fingerprint + range so the ledger can
+        tell a stage-owner's share from the whole program."""
+        key = f"{pipeline_id}#r{lo}-{hi}"
+        fn = st.cache_get(key)
+        if fn is None:
+            # the canonical systolic step form: plan='off' (per-op
+            # stages, no calibration dependence) + stage-boundary
+            # splitting, so every owner and the router derive the SAME
+            # step indices from the spec with no shared state — and
+            # bit-exactness holds because plan partitioning never
+            # changes values (the repo's exact-integer premise)
+            program = split_for_placement(
+                compile_graph(
+                    graph, plan="off", backend=self.backend, width=width
+                )
+            )
+            sub = graph_sub_callable(program, lo, hi, impl=self.backend)
+            from mpi_cuda_imagemanipulation_tpu.obs import cost as obs_cost
+
+            def modeled(args, s=sub):
+                env = args[0]
+                total = 0
+                for leaf in jax.tree_util.tree_leaves(env):
+                    total += int(
+                        np.prod(np.shape(leaf), dtype=np.int64)
+                    ) * np.asarray(leaf).dtype.itemsize
+                out = jax.eval_shape(s, env)
+                for leaf in jax.tree_util.tree_leaves(out):
+                    total += int(
+                        np.prod(leaf.shape, dtype=np.int64)
+                    ) * leaf.dtype.itemsize
+                return float(total)
+
+            fn = obs_cost.wrap_cache_fn(
+                "graph",
+                f"{program.fingerprint}:r{lo}-{hi}",
+                jax.jit(sub),
+                modeled_fn=modeled,
+            )
+            st.cache_put(key, fn)
+            self._m_compiles.inc()
+        return fn
+
+    def count_forward(self, nbytes: int) -> None:
+        """One live-env handoff left this replica (the HTTP layer calls
+        this after a successful peer POST)."""
+        self._m_sys_tiles.inc()
+        self._m_sys_bytes.inc(nbytes)
+
+    def systolic_process(
+        self,
+        placement: dict,
+        idx: int,
+        payload,
+        *,
+        nbytes: int | None = None,
+        trace_id: str = "",
+    ):
+        """Run this replica's step range of a placed program.
+
+        `idx` is this replica's index in placement['ranges']. At the
+        entry owner (idx 0) `payload` is the decoded u8 image and the
+        FULL admission path runs (validation, quota/QoS, inflight cap) —
+        a refusal here is the request's real refusal, relayed verbatim.
+        At interior owners `payload` is the live env decoded from the
+        handoff frame; the request was already admitted, so a hop never
+        sheds (shedding mid-chain would break accepted => answered).
+
+        Returns ``("env", env)`` with the [hi) boundary env to forward,
+        or ``("result", result)`` at the final owner — `result` in the
+        exact `process()` shape, counted as the request's one terminal
+        'ok' (fleet-wide the request still counts once)."""
+        tenant_id = placement["tenant"]
+        pipeline_id = placement["pipeline"]
+        ranges = placement["ranges"]
+        lo, hi = ranges[idx]
+        entry = idx == 0
+        final = idx == len(ranges) - 1
+        try:
+            st = self.tenants.get(tenant_id)
+            graph_entry = st.pipelines.get(pipeline_id)
+            if graph_entry is None:
+                raise SpecError(
+                    "unknown-pipeline",
+                    f"tenant {tenant_id!r} has no pipeline "
+                    f"{pipeline_id!r}",
+                )
+            graph = graph_entry[0]
+            if entry:
+                self._validate_image(graph, payload)
+        except SpecError as e:
+            self._m_requests.inc(status="rejected")
+            self._m_rejections.inc(code=e.code)
+            raise
+        if entry:
+            try:
+                self.tenants.admit(
+                    st, payload.nbytes if nbytes is None else nbytes,
+                    self._current_load(),
+                )
+            except GraphShed as e:
+                self._m_requests.inc(status="shed")
+                self._m_shed.inc(reason=e.reason)
+                raise
+            with self._inflight_lock:
+                if self._inflight >= self.max_inflight:
+                    self._m_requests.inc(status="shed")
+                    self._m_shed.inc(reason="inflight")
+                    raise GraphShed(
+                        "inflight",
+                        f"{self._inflight} graph dispatches already in "
+                        f"flight (cap {self.max_inflight})",
+                        0.5,
+                    )
+                self._inflight += 1
+            env = {graph.source_id: payload}
+            width = payload.shape[1] if payload.ndim >= 2 else None
+        else:
+            env = {k: np.asarray(v) for k, v in payload.items()}
+            any_leaf = next(iter(env.values()))
+            width = any_leaf.shape[1] if any_leaf.ndim >= 2 else None
+        t0 = self._clock()
+        try:
+            if entry:
+                failpoints.maybe_fail(
+                    "graph.dispatch", tenant=tenant_id,
+                    pipeline=pipeline_id,
+                )
+            fn = self._sub_fn(st, pipeline_id, graph, lo, hi, width)
+            out = fn(env)
+        except Exception:
+            self._m_requests.inc(status="error")
+            raise
+        finally:
+            if entry:
+                with self._inflight_lock:
+                    self._inflight -= 1
+        self._m_dispatch_s.observe(
+            self._clock() - t0, exemplar=trace_id or None
+        )
+        if not final:
+            return "env", {k: np.asarray(v) for k, v in out.items()}
+        result: dict = {"image": np.asarray(out["~image"])}
+        if "~histogram" in out:
+            result["histogram"] = [
+                int(v) for v in np.asarray(out["~histogram"])
+            ]
+        if "~stats" in out:
+            s = out["~stats"]
+            result["stats"] = {
+                "count": int(s["count"]),
+                "min": int(s["min"]),
+                "max": int(s["max"]),
+                "mean": round(float(s["mean"]), 4),
+            }
+        self._m_requests.inc(status="ok")
+        st.requests_ok += 1
+        return "result", result
+
     def _validate_image(self, graph, img: np.ndarray) -> None:
         if (
             not isinstance(img, np.ndarray)
@@ -394,6 +581,7 @@ class GraphService:
         return {
             "backend": self.backend,
             "plan": self.plan,
+            "systolic": self.systolic,
             "max_inflight": self.max_inflight,
             "inflight": self._inflight,
             **self.tenants.stats(),

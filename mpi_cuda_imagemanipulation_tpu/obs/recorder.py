@@ -78,6 +78,8 @@ KNOWN_TRIGGERS = (
     "autoscale",
     "preempt",
     "canary_rollback",
+    "systolic_fallback",  # stage-sharded dispatch fell back pinned
+    #                       (owner death / broken hop — fabric/router.py)
     "profile_capture",
     "manual",
 )

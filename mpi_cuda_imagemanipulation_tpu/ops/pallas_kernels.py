@@ -587,6 +587,7 @@ def _pick_block_h(
     halo: int,
     live_f32: int = 8,
     impl: str = "pallas",
+    io_scale: float | None = None,
 ) -> int:
     """Row-block height maximising VMEM use without overflowing it.
 
@@ -594,12 +595,23 @@ def _pick_block_h(
     buffered by the pipeline) + u8 output double-buffer + f32 row-pass
     scratch + `live_f32` live f32 temps per plane while the kernel body
     runs (see _live_f32_temps). Calibrated on v5e: the 8K gaussian5 kernel
-    at bh=128 reports ~21 MB scoped use."""
+    at bh=128 reports ~21 MB scoped use.
+
+    `io_scale` is the measured cost-ledger drift ratio for this stage
+    (measured boundary bytes / modelled one-read-one-write bytes,
+    obs/cost.attribute_plan). Ratios above 1 mean the executable really
+    moves more than the analytical model reserves for, so the working
+    set is inflated accordingly — shrink-only, bounded, and the
+    analytical estimate stays the answer whenever no measurement exists."""
     budget = 3 * _VMEM_LIMIT // 4
     n_live = max(n_in, n_out)
     # row-pass scratch rows are width + 2*halo wide for non-separable ops;
     # folding the halo into every term over-reserves by a harmless epsilon
     per_row = (width + 2 * halo) * (4 * n_in + 8 * n_out + 4 * live_f32 * n_live)
+    if io_scale is not None and io_scale > 1.0:
+        # never grow past the model, and never trust a wild measurement
+        # with more than the drift-alert band's headroom
+        per_row = int(per_row * min(io_scale, 4.0))
     bh = budget // max(per_row, 1)
     bh = int(max(32, min(512, bh)))
     bh = (bh // 32) * 32
@@ -1084,18 +1096,20 @@ def _stage_live_f32(stage_ops) -> int:
 
 
 def fused_stage_block_h(
-    stage_ops, halo: int, width: int, n_ch: int, block_h: int | None = None
+    stage_ops, halo: int, width: int, n_ch: int, block_h: int | None = None,
+    io_scale: float | None = None,
 ) -> int | None:
     """The megakernel's row-block height: the shared VMEM working-set
     model (`_pick_block_h`, impl key 'fused-pallas' for calibration
-    overrides) rounded DOWN to the context-strip alignment. None when
+    overrides, `io_scale` = this stage's measured cost-ledger drift)
+    rounded DOWN to the context-strip alignment. None when
     even the minimum block busts the budget — the caller falls back to
     the per-stage XLA walker (plan/pallas_exec counts the rejection)."""
     S = _stage_strip_h(halo)
     if block_h is None:
         block_h = _pick_block_h(
             width, n_ch, n_ch, halo, _stage_live_f32(stage_ops),
-            impl="fused-pallas",
+            impl="fused-pallas", io_scale=io_scale,
         )
     bh = (block_h // S) * S
     if bh < S or bh < 2 * halo:
@@ -1110,6 +1124,7 @@ def fused_stage_call(
     halo: int,
     interpret: bool | None = None,
     block_h: int | None = None,
+    io_scale: float | None = None,
     ghosts: bool = False,
     y0=None,
     image_h: int | None = None,
@@ -1130,7 +1145,9 @@ def fused_stage_call(
     n_out = _channels_after(
         [op for op in stage_ops if not isinstance(op, StencilOp)], n_in
     )
-    bh = fused_stage_block_h(stage_ops, H, width, max(n_in, n_out), block_h)
+    bh = fused_stage_block_h(
+        stage_ops, H, width, max(n_in, n_out), block_h, io_scale
+    )
     if bh is None:
         raise ValueError(
             f"no feasible megakernel block height for halo {H} at width "

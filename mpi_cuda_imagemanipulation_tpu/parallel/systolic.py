@@ -1,0 +1,332 @@
+"""Pod-level systolic streaming: plan stages sharded over a 'stage' mesh
+axis, row-band tiles flowing device-to-device on the ICI ppermute path.
+
+PR 14's megakernels apply the software-systolic model WITHIN one chip
+(stage intermediates live in VMEM). This module applies the same model
+ACROSS chips: a fused-stage pipeline is cut into contiguous stage
+groups, each group owned by one device on a dedicated 1-D ``'stage'``
+mesh axis, and the image streams through as fixed-height row bands —
+device g runs its stages on tile k while device g-1 runs its stages on
+tile k+1, the classic systolic wavefront. Between steps one
+``lax.ppermute`` shifts every in-flight band to its successor stage
+owner, so a band crosses each stage boundary exactly once and HBM sees
+one u8 read + one u8 write per stage GROUP instead of per stage — the
+Casper move (compute goes to where the data is) expressed on the ICI
+ring instead of the memory hierarchy.
+
+Bit-exactness is inherited, not re-proven: inside a group the walk is
+`plan/exec.walk_stage` under the sharded edge convention (context always
+materialised, out-of-image rows rewritten per op by ``_fix_edge_axis``
+BEFORE each stencil reads them — the exact `parallel/api._plan_walk`
+fixture), every stage materialises u8 between stages exactly as
+`run_stage_full` does, and the carry is the f32 exact-integer contract
+from `ops.spec` — so the device-boundary handoff moves u8 values that
+are bit-identical to the pinned path's stage intermediates.
+
+Geometry: every band rides in a fixed (E, W[, C]) u8 buffer with
+``E = tile_rows + 2 * total_halo``; group g's live region sits at the
+STATIC offset ``off_g`` (the halo consumed by all prior groups), so one
+traced program serves every (tile, device) pair — injection at device 0
+and collection at device n-1 are data-dependent selects, never shape
+changes, and an arbitrarily tall image compiles exactly once.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from mpi_cuda_imagemanipulation_tpu.ops.spec import U8, Op, exact_f32
+from mpi_cuda_imagemanipulation_tpu.parallel.api import _fix_edge_axis
+from mpi_cuda_imagemanipulation_tpu.parallel.mesh import shard_map_compat
+from mpi_cuda_imagemanipulation_tpu.stream.tiles import (
+    StreamabilityError,
+    out_channels,
+    validate_stream_ops,
+)
+
+STAGE = "stage"
+
+# closed vocabulary of sharded-eligibility refusals (tests pin it; the
+# fabric lane folds any of these into its own "ineligible" fallback)
+ELIGIBILITY_REASONS = (
+    "not-streamable",  # geometric/global op in the chain
+    "channel-changing",  # stage in/out channel counts differ (switch
+    #                      branches need one buffer aval)
+    "halo-exceeds-tile",  # chain halo > tile_rows (seam spans bands)
+    "too-few-stages",  # fewer plan stages than 2 (nothing to shard)
+)
+
+
+def systolic_eligible(
+    ops: tuple[Op, ...], *, channels: int = 3, tile_rows: int
+) -> str | None:
+    """``None`` when the chain can run stage-sharded, else the refusal
+    reason (one of ELIGIBILITY_REASONS)."""
+    try:
+        halo = validate_stream_ops(ops)
+    except StreamabilityError:
+        return "not-streamable"
+    try:
+        if out_channels(ops, channels) != channels:
+            return "channel-changing"
+    except ValueError:
+        return "channel-changing"
+    for op in ops:
+        if op.out_channels and op.out_channels != channels:
+            return "channel-changing"
+    if halo > tile_rows:
+        return "halo-exceeds-tile"
+    if len(ops) < 2:
+        return "too-few-stages"
+    return None
+
+
+def make_stage_mesh(n: int, *, devices=None) -> Mesh:
+    """A 1-D mesh of `n` devices named 'stage' — its own axis (not the
+    'rows' data axis) because the decomposition is by pipeline DEPTH."""
+    if devices is None:
+        devices = jax.devices()
+    if n < 2:
+        raise ValueError(f"systolic mesh needs >= 2 devices, got {n}")
+    if n > len(devices):
+        raise ValueError(
+            f"systolic mesh wants {n} devices, only {len(devices)} present"
+        )
+    import numpy as np
+
+    return Mesh(np.asarray(devices[:n]), (STAGE,))
+
+
+def stage_weights(plan, *, channels: int = 3, ledger=None) -> list[float]:
+    """Per-stage balancer weight in bytes/pixel: the one-u8-read +
+    one-u8-write analytical guess, scaled by the cost ledger's measured
+    drift ratio when a record with this plan fingerprint + stage label
+    exists (the PR 15 measured feed; analytical stays the fallback)."""
+    if ledger is None:
+        from mpi_cuda_imagemanipulation_tpu.obs.cost import cost_ledger
+
+        ledger = cost_ledger
+    weights = []
+    for i, stage in enumerate(plan.stages):
+        w = float(2 * channels)
+        drift = ledger.drift("plan", plan.fingerprint, f"s{i}/{stage.kind}")
+        if drift is not None and drift > 0:
+            w *= float(drift)
+        weights.append(w)
+    return weights
+
+
+@dataclasses.dataclass(frozen=True)
+class SystolicBuild:
+    """A compiled-shape sharded executor plus its static structure.
+
+    The counters are STRUCTURAL — fixed by (geometry, grouping) at build
+    time, which is what lets the smoke/bench lanes assert "exactly one
+    exchange per stage boundary" against the compiled HLO instead of
+    sampling runtime behaviour."""
+
+    fn: object  # jitted (H, W[, C]) u8 -> (H, W[, C]) u8
+    ranges: tuple[tuple[int, int], ...]  # stage index ranges per device
+    n_tiles: int
+    tile_rows: int
+    buf_rows: int  # E = tile_rows + 2 * total_halo
+    n_steps: int  # wavefront length: n_tiles + n_groups - 1
+    tiles_forwarded: int  # n_tiles * (n_groups - 1): boundary crossings
+    exchange_bytes: int  # u8 payload bytes crossing stage boundaries
+
+    @property
+    def n_groups(self) -> int:
+        return len(self.ranges)
+
+    @property
+    def n_exchanges(self) -> int:
+        """ppermute count in the compiled program: one per wavefront
+        step except the last. With n_tiles == 1 this equals
+        n_groups - 1 — exactly one exchange per stage boundary, the
+        structural form the acceptance test counts in HLO."""
+        return self.n_steps - 1
+
+
+def systolic_callable(
+    plan,
+    *,
+    height: int,
+    width: int,
+    channels: int = 3,
+    tile_rows: int,
+    n_devices: int | None = None,
+    mesh: Mesh | None = None,
+    impl: str = "xla",
+    ledger=None,
+) -> SystolicBuild:
+    """Build the stage-sharded streaming executor for one image shape.
+
+    Stages are grouped contiguously over `n_devices` by the same
+    linear-partition balancer the fabric placement pass uses
+    (`graph.compile.partition_weights` over modelled-or-measured
+    bytes/pixel), then the wavefront runs ``n_tiles + n_groups - 1``
+    steps: device 0 injects band t, every device runs its group on the
+    band it holds, one ppermute shifts all bands down the chain, device
+    n-1 collects finished rows. Returns the jitted callable plus the
+    build's static exchange structure."""
+    from mpi_cuda_imagemanipulation_tpu.graph.compile import partition_weights
+    from mpi_cuda_imagemanipulation_tpu.plan.exec import (
+        acc_fns_for,
+        walk_stage,
+    )
+
+    reason = systolic_eligible(
+        plan.ops, channels=channels, tile_rows=tile_rows
+    )
+    if reason is not None:
+        raise StreamabilityError(f"chain not systolic-eligible: {reason}")
+    if mesh is None:
+        mesh = make_stage_mesh(n_devices or 2)
+    n = mesh.shape[STAGE]
+    stages = plan.stages
+    n_use = min(n, len(stages))
+    if n_use < 2:
+        raise StreamabilityError(
+            f"plan has {len(stages)} stage(s); systolic needs >= 2"
+        )
+    if n_use < n:
+        raise ValueError(
+            f"mesh has {n} devices but the plan only has {len(stages)} "
+            "stages — build the mesh with n <= n_stages"
+        )
+    ranges = partition_weights(
+        stage_weights(plan, channels=channels, ledger=ledger), n
+    )
+    group_halos = [
+        sum(stages[i].halo for i in range(lo, hi)) for lo, hi in ranges
+    ]
+    h_total = sum(group_halos)
+    assert h_total == plan.total_halo
+    # static offset of group g's live region inside the E-row buffer:
+    # the context consumed by every earlier group
+    offs = [0]
+    for gh in group_halos:
+        offs.append(offs[-1] + gh)
+    e_rows = tile_rows + 2 * h_total
+    n_tiles = math.ceil(height / tile_rows)
+    n_steps = n_tiles + n - 1
+
+    acc_fns = {}
+    for stage in stages:
+        acc_fns.update(acc_fns_for(stage.ops, impl, width))
+
+    has_c = channels > 1
+    buf_shape = (e_rows, width, channels) if has_c else (e_rows, width)
+
+    def fix(cur, op, row_lo):
+        return _fix_edge_axis(cur, op, row_lo + op.halo, height, 0)
+
+    def run_group(g: int, buf: jnp.ndarray, y0: jnp.ndarray) -> jnp.ndarray:
+        """Group g's stages over its live region; result re-embedded at
+        the next group's static offset so every branch of the switch
+        yields one (E, W[, C]) u8 aval."""
+        lo, hi = ranges[g]
+        off = offs[g]
+        cur = buf[off : e_rows - off] if off else buf
+        y_lo = y0 + off
+        for si in range(lo, hi):
+            stage = stages[si]
+            cur, y_lo, _, _ = walk_stage(
+                stage.ops,
+                exact_f32(cur),
+                y_lo=y_lo,
+                lead_rem=stage.halo,
+                tail_rem=stage.halo,
+                global_h=height,
+                global_w=width,
+                acc_fns=acc_fns,
+                edge_fix=fix,
+            )
+            # per-stage u8 materialisation: the pinned path's stage
+            # boundary contract, so cross-device handoff is bit-exact
+            cur = cur.astype(U8)
+        off_next = offs[g + 1]
+        out = jnp.zeros(buf_shape, U8)
+        return out.at[off_next : e_rows - off_next].set(cur)
+
+    # stacked extended bands, gathered host-side of the shard_map with
+    # clipped row indices (out-of-image rows carry clipped copies; the
+    # per-op edge_fix rewrites them before any stencil reads them)
+    def stack_tiles(img: jnp.ndarray) -> jnp.ndarray:
+        rows = (
+            jnp.arange(n_tiles)[:, None] * tile_rows
+            - h_total
+            + jnp.arange(e_rows)[None, :]
+        )
+        return jnp.take(img, jnp.clip(rows, 0, height - 1), axis=0)
+
+    y0s = jnp.asarray(
+        [k * tile_rows - h_total for k in range(n_tiles)], jnp.int32
+    )
+    fwd = [(i, i + 1) for i in range(n - 1)]
+    branches = [
+        (lambda b, y, g=g: run_group(g, b, y)) for g in range(n)
+    ]
+
+    def shard_body(tiles: jnp.ndarray, y0v: jnp.ndarray) -> jnp.ndarray:
+        me = lax.axis_index(STAGE)
+        buf = jnp.zeros(buf_shape, U8)
+        outs = jnp.zeros(
+            (n_tiles, tile_rows) + buf_shape[1:], U8
+        )
+        for t in range(n_steps):
+            # device 0 injects band t (clipped index keeps the gather
+            # in-bounds after the wavefront passes the last band; the
+            # re-injected copy is never collected)
+            k_in = min(t, n_tiles - 1)
+            buf = jnp.where(me == 0, tiles[k_in], buf)
+            # band held here this step: k = t - me (clipped for the y0
+            # lookup; out-of-range holdings produce garbage that a
+            # later real band overwrites before collection)
+            k = jnp.clip(t - me, 0, n_tiles - 1)
+            buf = lax.switch(me, branches, buf, y0v[k])
+            valid = (t - me >= 0) & (t - me < n_tiles)
+            done = jnp.where(
+                valid & (me == n - 1),
+                buf[h_total : e_rows - h_total],
+                jax.lax.dynamic_index_in_dim(outs, k, keepdims=False),
+            )
+            outs = jax.lax.dynamic_update_index_in_dim(outs, done, k, 0)
+            if t < n_steps - 1:
+                with jax.named_scope(f"systolic_exchange_t{t}"):
+                    buf = lax.ppermute(buf, STAGE, fwd)
+        return outs
+
+    sharded = shard_map_compat(
+        shard_body,
+        mesh=mesh,
+        in_specs=(P(), P()),
+        out_specs=P(STAGE),
+    )
+
+    def run(img: jnp.ndarray) -> jnp.ndarray:
+        stacked = sharded(stack_tiles(img), y0s)
+        # out_specs=P('stage') concatenates per-device blocks on axis 0;
+        # only the last device's block holds collected bands
+        final = stacked[(n - 1) * n_tiles :]
+        out = final.reshape((n_tiles * tile_rows,) + buf_shape[1:])
+        return out[:height]
+
+    px = e_rows * width * channels
+    tiles_forwarded = n_tiles * (n - 1)
+    return SystolicBuild(
+        fn=jax.jit(run),
+        ranges=ranges,
+        n_tiles=n_tiles,
+        tile_rows=tile_rows,
+        buf_rows=e_rows,
+        n_steps=n_steps,
+        tiles_forwarded=tiles_forwarded,
+        exchange_bytes=tiles_forwarded * px,
+    )

@@ -107,12 +107,25 @@ def stage_pallas_reject(
     return None
 
 
+def stage_io_scale(plan: Plan, i: int) -> float | None:
+    """The measured cost-ledger drift for stage `i` of `plan` — the
+    ratio of measured boundary bytes to the one-read-one-write model the
+    block-height picker reserves for (obs/cost.attribute_plan records it
+    under the plan fingerprint + `s<i>/<kind>` label). None when nothing
+    was measured; the analytical VMEM model stays the fallback."""
+    from mpi_cuda_imagemanipulation_tpu.obs.cost import cost_ledger
+
+    st = plan.stages[i]
+    return cost_ledger.drift("plan", plan.fingerprint, f"s{i}/{st.kind}")
+
+
 def run_stage_pallas(
     stage: Stage,
     img: jnp.ndarray,
     *,
     interpret: bool | None = None,
     block_h: int | None = None,
+    io_scale: float | None = None,
 ) -> jnp.ndarray:
     """One eligible fused stage over a whole u8 image as one megakernel
     launch (planar channel decomposition at the stage boundary, like
@@ -127,7 +140,7 @@ def run_stage_pallas(
         planes = [img]
     outs = fused_stage_call(
         stage.ops, planes, halo=stage.halo,
-        interpret=interpret, block_h=block_h,
+        interpret=interpret, block_h=block_h, io_scale=io_scale,
     )
     return outs[0] if len(outs) == 1 else jnp.stack(outs, axis=-1)
 
@@ -186,7 +199,7 @@ def plan_callable_pallas(
     def run(img: jnp.ndarray) -> jnp.ndarray:
         import jax
 
-        for stage in plan.stages:
+        for i, stage in enumerate(plan.stages):
             if stage.kind in ("geometric", "global"):
                 img = stage.ops[0](img)
                 continue
@@ -197,7 +210,8 @@ def plan_callable_pallas(
                 plan_metrics.pallas_stages.inc()
                 with jax.named_scope("plan_stage_pallas"):
                     img = run_stage_pallas(
-                        stage, img, interpret=interpret, block_h=block_h
+                        stage, img, interpret=interpret, block_h=block_h,
+                        io_scale=stage_io_scale(plan, i),
                     )
             else:
                 plan_metrics.pallas_fallbacks.inc(reason=reason)

@@ -104,6 +104,10 @@ class ServeConfig:
     # plan's fingerprint so calibration flips rebuild instead of serving
     # a stale structure (serve/cache.py)
     plan: str = "auto"
+    # pod-level systolic execution (graph/systolic.py): accept stage-
+    # sharded graph dispatches — run a placed step range and forward the
+    # live env to the next stage owner instead of running whole programs
+    systolic: bool = False
     default_deadline_ms: float | None = None
     # -- async execution engine (engine/) ----------------------------------
     inflight: int = 2  # micro-batch dispatches kept outstanding
@@ -296,6 +300,7 @@ class ServeApp:
                     registry=self.registry,
                     backend=backend,
                     plan=self.config.plan,
+                    systolic=self.config.systolic,
                     # the QoS ladder sheds on the WORSE of the graph
                     # service's own inflight fraction and the chain
                     # scheduler's queue fill — one load signal for both
@@ -686,19 +691,16 @@ def _make_handler(app: ServeApp):
             """One pipeline-tagged /v1/process request: tenant-admitted
             graph dispatch, image + side outputs in ONE response (side
             outputs ride X-MCIM-Histogram / X-MCIM-Stats JSON headers)."""
-            import json as _json
-
-            from mpi_cuda_imagemanipulation_tpu.graph.service import (
-                HDR_HISTOGRAM,
-                HDR_STATS,
-            )
             from mpi_cuda_imagemanipulation_tpu.graph.spec import SpecError
+            from mpi_cuda_imagemanipulation_tpu.graph.systolic import (
+                HDR_PLAN,
+                decode_placement,
+            )
             from mpi_cuda_imagemanipulation_tpu.graph.tenancy import (
                 GraphShed,
             )
             from mpi_cuda_imagemanipulation_tpu.io.image import (
                 decode_image_bytes,
-                encode_image_bytes,
             )
 
             data = self._read_body()
@@ -723,10 +725,34 @@ def _make_handler(app: ServeApp):
                     raise SpecError(
                         "bad-image", f"undecodable image: {e}"
                     ) from None
-                out = app.graph_service.process(
-                    tenant, pipeline_id, img, nbytes=len(data),
-                    trace_id=tid,
-                )
+                plan_hdr = self.headers.get(HDR_PLAN)
+                if plan_hdr and app.graph_service.systolic:
+                    # stage-0 owner of a placed program: run our range,
+                    # forward the live env down the chain, relay the
+                    # final owner's response (the placement header only
+                    # arrives from the router, which checked our
+                    # heartbeat advert first; with the knob off we just
+                    # run the whole program — never a wrong answer)
+                    try:
+                        placement = decode_placement(plan_hdr)
+                    except ValueError as e:
+                        raise SpecError(
+                            "bad-json", f"bad placement header: {e}"
+                        ) from None
+                    kind, val = app.graph_service.systolic_process(
+                        placement, 0, img, nbytes=len(data), trace_id=tid,
+                    )
+                    if kind == "env":
+                        self._systolic_forward_and_relay(
+                            placement, 1, val, tid, trace_hdr
+                        )
+                        return
+                    out = val
+                else:
+                    out = app.graph_service.process(
+                        tenant, pipeline_id, img, nbytes=len(data),
+                        trace_id=tid,
+                    )
             except SpecError as e:
                 root.set(status="rejected", code=e.code)
                 self._graph_refusal(e, tid)
@@ -763,6 +789,21 @@ def _make_handler(app: ServeApp):
                 return
             finally:
                 root.end()
+            self._send_graph_result(out, trace_hdr)
+
+        def _send_graph_result(self, out: dict, trace_hdr) -> None:
+            """The graph dispatch success response: PNG body, side
+            outputs in X-MCIM-Histogram / X-MCIM-Stats JSON headers."""
+            import json as _json
+
+            from mpi_cuda_imagemanipulation_tpu.graph.service import (
+                HDR_HISTOGRAM,
+                HDR_STATS,
+            )
+            from mpi_cuda_imagemanipulation_tpu.io.image import (
+                encode_image_bytes,
+            )
+
             png = encode_image_bytes(out["image"])
             self.send_response(200)
             self.send_header("Content-Type", "image/png")
@@ -777,6 +818,145 @@ def _make_handler(app: ServeApp):
                 self.send_header(k, v)
             self.end_headers()
             self.wfile.write(png)
+
+        def _systolic_post(self, addr: str, body: bytes):
+            """POST a handoff frame to a peer stage owner's /v1/systolic.
+            Returns (status, headers, body) or None on transport failure."""
+            import http.client
+
+            from mpi_cuda_imagemanipulation_tpu.graph.systolic import (
+                SYSTOLIC_PATH,
+            )
+
+            host, _, port = addr.rpartition(":")
+            try:
+                conn = http.client.HTTPConnection(
+                    host, int(port), timeout=30
+                )
+                try:
+                    conn.request(
+                        "POST", SYSTOLIC_PATH, body,
+                        {"Content-Type": "application/octet-stream"},
+                    )
+                    r = conn.getresponse()
+                    return r.status, dict(r.getheaders()), r.read()
+                finally:
+                    conn.close()
+            except (OSError, ValueError, http.client.HTTPException):
+                return None
+
+        def _systolic_forward_and_relay(
+            self, placement: dict, next_idx: int, env: dict,
+            tid: str, trace_hdr,
+        ) -> None:
+            """Hand the live env to stage owner `next_idx` and relay its
+            (eventually the final owner's) response verbatim — success
+            replies chain back through the nested forwards, so one POST
+            per stage boundary is the whole transport story. Any
+            downstream failure becomes 424 systolic-broken: the router
+            reruns the request on the pinned lane (idempotent compute),
+            so a broken chain can delay an answer but never wrong it."""
+            from mpi_cuda_imagemanipulation_tpu.graph.service import (
+                HDR_HISTOGRAM,
+                HDR_STATS,
+            )
+            from mpi_cuda_imagemanipulation_tpu.graph.systolic import (
+                encode_handoff,
+            )
+
+            body = encode_handoff(
+                {"placement": placement, "idx": next_idx, "trace_id": tid},
+                env,
+            )
+            resp = self._systolic_post(placement["addrs"][next_idx], body)
+            if resp is None or resp[0] != 200:
+                status = "unreachable" if resp is None else resp[0]
+                self._send_json(
+                    424,
+                    {
+                        "status": "systolic-broken",
+                        "error": (
+                            f"stage owner {next_idx} failed ({status})"
+                        ),
+                        **({"trace_id": tid} if tid else {}),
+                    },
+                    trace_hdr,
+                )
+                return
+            app.graph_service.count_forward(len(body))
+            _, headers, rbody = resp
+            self.send_response(200)
+            self.send_header(
+                "Content-Type", headers.get("Content-Type", "image/png")
+            )
+            self.send_header("Content-Length", str(len(rbody)))
+            for h in (HDR_HISTOGRAM, HDR_STATS):
+                if headers.get(h):
+                    self.send_header(h, headers[h])
+            for k, v in trace_hdr:
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(rbody)
+
+        def _handle_systolic_hop(self) -> None:
+            """POST /v1/systolic: one interior/final stage of a placed
+            program. The request was admitted at the entry owner; here we
+            decode the live env, run our range, and either forward to
+            the next owner or render the final response."""
+            from mpi_cuda_imagemanipulation_tpu.graph.systolic import (
+                decode_handoff,
+            )
+
+            data = self._read_body()
+            if not app.graph_service.systolic:
+                self._send_json(
+                    409,
+                    {
+                        "status": "systolic-broken",
+                        "error": "systolic mode disabled on this replica",
+                    },
+                )
+                return
+            try:
+                meta, env = decode_handoff(data)
+                placement = meta["placement"]
+                idx = int(meta["idx"])
+                tid = str(meta.get("trace_id") or "")
+                if not isinstance(placement, dict):
+                    raise ValueError("placement must be an object")
+            except (KeyError, TypeError, ValueError) as e:
+                self._send_json(
+                    400,
+                    {"status": "rejected", "code": "bad-json",
+                     "error": f"bad handoff frame: {e}"},
+                )
+                return
+            trace_hdr = [("X-Trace-Id", tid)] if tid else []
+            try:
+                kind, val = app.graph_service.systolic_process(
+                    placement, idx, env, trace_id=tid,
+                )
+            except Exception as e:
+                # SpecError included: an admitted request failing at a
+                # hop is a broken chain, not a client refusal — the 5xx
+                # propagates up and the entry owner answers 424 so the
+                # router falls back to the pinned lane
+                self._send_json(
+                    500,
+                    {
+                        "status": "error",
+                        "error": f"systolic stage failed: {e}",
+                        **({"trace_id": tid} if tid else {}),
+                    },
+                    trace_hdr,
+                )
+                return
+            if kind == "env":
+                self._systolic_forward_and_relay(
+                    placement, idx + 1, val, tid, trace_hdr
+                )
+                return
+            self._send_graph_result(val, trace_hdr)
 
         def do_POST(self):  # noqa: N802
             from urllib.parse import parse_qs, urlsplit
@@ -796,6 +976,9 @@ def _make_handler(app: ServeApp):
                 return
             if path == TENANTS_PATH:
                 self._handle_tenant_config()
+                return
+            if path == "/v1/systolic":
+                self._handle_systolic_hop()
                 return
             if path == "/control/profile":
                 # on-demand live profiling (obs/profile.capture_live):

@@ -344,6 +344,26 @@ _VARS = (
     EnvVar("MCIM_GRAPH_AB_JSON", None, "tests/test_graph.py",
            "CI: write the graph_loadgen lane record to this path "
            "(uploaded as an artifact)."),
+    # -- pod-level systolic execution (graph/systolic.py) --------------------
+    EnvVar("MCIM_SYSTOLIC", "0", "fabric/replica.py",
+           "Default for --systolic: accept stage-sharded graph "
+           "dispatches (run a placed step range, forward the live env "
+           "to the next stage owner) and advertise it in heartbeats."),
+    EnvVar("MCIM_SYSTOLIC_MIN_STEPS", "4", "fabric/router.py",
+           "Smallest program (compiled step count) the router will "
+           "stage-shard; shorter programs stay on the pinned lane "
+           "(counted as fallback reason 'ineligible')."),
+    EnvVar("MCIM_SYSTOLIC_AB_OPS", None, "bench_suite.py",
+           "systolic_ab lane: op-chain override for the >=8-stage DAG "
+           "(must stay systolic-eligible: pointwise/stencil, "
+           "channel-preserving)."),
+    EnvVar("MCIM_SYSTOLIC_AB_REQUESTS", None, "bench_suite.py",
+           "systolic_ab lane: requests per arm."),
+    EnvVar("MCIM_SYSTOLIC_AB_HEIGHT", None, "bench_suite.py",
+           "systolic_ab lane: image height override."),
+    EnvVar("MCIM_SYSTOLIC_AB_JSON", None, "tools/systolic_smoke.py",
+           "CI: write the systolic_ab lane record to this path "
+           "(uploaded as an artifact)."),
     # -- bench driver (bench.py, repo root) ----------------------------------
     EnvVar("MCIM_NO_HISTORY", None, "bench.py",
            "Any non-empty value: do not append promoted records to "

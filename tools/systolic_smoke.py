@@ -1,0 +1,290 @@
+#!/usr/bin/env python
+"""Pod-level systolic CI smoke: a REAL pod (router + 2 replica
+processes) running one DAG pipeline stage-sharded across both replicas.
+
+    python tools/systolic_smoke.py METRICS_OUT
+
+Asserts, end to end over real HTTP:
+
+  1. an 8-stage chain registered at the front door gets PLACED across
+     both replicas (the router's /stats placement map names two
+     contiguous step ranges with two distinct owners);
+  2. the systolic response is bit-exact against the in-process golden
+     executor — the u8 exact-integer carry survives the cross-replica
+     handoff;
+  3. exactly ONE transport forward per stage boundary: after N systolic
+     requests the federated mcim_systolic_tiles_forwarded_total reads
+     N * (ranges - 1), not one more, not one less;
+  4. SIGKILL of a stage-owning replica mid-load degrades to the PINNED
+     lane: every accepted request stays BYTE-IDENTICAL to the systolic
+     response (never a wrong answer), the fallback is counted under a
+     closed-vocabulary reason, and the router files a
+     `systolic_fallback` flight-recorder dump;
+  5. the router /metrics exposition parses with every mcim_systolic_*
+     family present (router-side + federated replica-side).
+
+METRICS_OUT gets the router exposition text (uploaded as a CI artifact,
+.github/workflows/tier1.yml systolic step). MCIM_SYSTOLIC_AB_JSON, when
+set, gets a one-line JSON summary of the counts the asserts consumed.
+"""
+
+import glob
+import json
+import os
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the router process files the systolic_fallback post-mortem; pin the
+# recorder dir so the smoke can assert the artifact landed
+_REC_DIR = os.environ.setdefault(
+    "MCIM_RECORDER_DIR", tempfile.mkdtemp(prefix="systolic_smoke_rec_")
+)
+
+import numpy as np  # noqa: E402
+
+from mpi_cuda_imagemanipulation_tpu.fabric.router import (  # noqa: E402
+    RouterConfig,
+)
+from mpi_cuda_imagemanipulation_tpu.fabric.supervisor import (  # noqa: E402
+    Fabric,
+    FabricConfig,
+)
+from mpi_cuda_imagemanipulation_tpu.graph import (  # noqa: E402
+    compile_graph,
+    graph_callable,
+    parse_spec,
+)
+from mpi_cuda_imagemanipulation_tpu.graph.spec import (  # noqa: E402
+    chain_as_spec,
+)
+from mpi_cuda_imagemanipulation_tpu.graph.systolic import (  # noqa: E402
+    ENV_AB_JSON,
+    FALLBACK_REASONS,
+)
+from mpi_cuda_imagemanipulation_tpu.io.image import (  # noqa: E402
+    decode_image_bytes,
+    encode_image_bytes,
+    synthetic_image,
+)
+from mpi_cuda_imagemanipulation_tpu.obs.metrics import (  # noqa: E402
+    parse_exposition,
+)
+from mpi_cuda_imagemanipulation_tpu.serve.bucketing import (  # noqa: E402
+    parse_buckets,
+)
+from mpi_cuda_imagemanipulation_tpu.utils import (  # noqa: E402
+    env as env_registry,
+)
+
+# 8 per-op stages (>= the 6 the acceptance floor asks for); every op is
+# streamable and channel-preserving, so the chain is systolic-eligible
+CHAIN = "invert,gaussian:3,sharpen,box:3,quantize:6,gaussian:5,posterize:4,median"
+BUCKETS = "48,96"
+N_WARM = 4  # systolic requests before the kill
+
+
+def _post(url: str, path: str, data: bytes, headers=None):
+    req = urllib.request.Request(
+        url + path, data=data, headers=headers or {}, method="POST"
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return r.status, dict(r.headers), r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+def _post_retry(url, path, data, headers=None, deadline_s=30.0):
+    t_end = time.monotonic() + deadline_s
+    while True:
+        code, hdrs, body = _post(url, path, data, headers)
+        if code != 503 or not hdrs.get("Retry-After"):
+            return code, hdrs, body
+        assert time.monotonic() < t_end, "pod never converged past sheds"
+        time.sleep(0.2)
+
+
+def _counter(fams, name, label=None):
+    fam = fams.get(name)
+    if not fam:
+        return 0.0
+    return sum(
+        v for (_n, labels), v in fam["samples"].items()
+        if label is None or label in labels
+    )
+
+
+def main(metrics_out: str) -> int:
+    cfg = FabricConfig(
+        replicas=2,
+        ops="grayscale,contrast:3.5,emboss:3",
+        buckets=BUCKETS,
+        channels="3",
+        max_batch=4,
+        queue_depth=64,
+        heartbeat_s=0.2,
+        systolic=True,
+        router=RouterConfig(
+            buckets=parse_buckets(BUCKETS), stale_s=2.0,
+            forward_attempts=3, systolic=True,
+        ),
+    )
+    img = synthetic_image(44, 40, channels=3, seed=61)
+    blob = encode_image_bytes(img)
+    spec = chain_as_spec(CHAIN)
+    golden = np.asarray(
+        graph_callable(compile_graph(parse_spec(spec)))(img)["image"]
+    )
+
+    with Fabric(cfg).start() as fab:
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            views = fab.router._routable()
+            if len(views) == 2 and all(v.hb.systolic for v in views):
+                break
+            time.sleep(0.1)
+        views = fab.router._routable()
+        assert len(views) == 2 and all(v.hb.systolic for v in views), (
+            "replicas never advertised systolic stage ownership"
+        )
+
+        code, _h, out = _post(
+            fab.url, "/v1/pipelines",
+            json.dumps({"tenant": "acme", "spec": spec}).encode(),
+        )
+        assert code == 200, (code, out[:300])
+        pid = json.loads(out)["pipeline"]
+
+        # -- 1+2. systolic dispatch: placed across BOTH replicas, golden --
+        q = f"/v1/process?tenant=acme&pipeline={pid}"
+        code, _h, sys_body = _post_retry(fab.url, q, blob)
+        assert code == 200, (code, sys_body[:300])
+        np.testing.assert_array_equal(decode_image_bytes(sys_body), golden)
+        st = fab.http_stats()["systolic"]
+        assert st["enabled"], st
+        pl = st["placements"][pid]
+        ranges = [tuple(r) for r in pl["ranges"]]
+        owners = list(pl["owners"])
+        assert len(ranges) == 2 and len(set(owners)) == 2, pl
+        assert ranges[0][0] == 0 and ranges[0][1] == ranges[1][0], ranges
+        assert ranges[1][1] == len(CHAIN.split(",")), ranges
+        print(
+            f"smoke: 8-stage chain placed {ranges} on {owners} "
+            f"(weights {pl['weights']}, {pl['source']}) — response "
+            "bit-exact vs the in-process golden"
+        )
+
+        for _ in range(N_WARM - 1):
+            code, _h, body = _post_retry(fab.url, q, blob)
+            assert code == 200 and body == sys_body
+
+        # -- 3. one transport forward per stage boundary ------------------
+        boundaries = len(ranges) - 1
+        deadline = time.monotonic() + 30.0
+        while True:
+            fams = parse_exposition(fab.scrape())
+            forwards = _counter(fams, "mcim_systolic_tiles_forwarded_total")
+            if forwards >= N_WARM * boundaries:
+                break
+            assert time.monotonic() < deadline, (
+                f"federated forward count stuck at {forwards}"
+            )
+            time.sleep(0.2)
+        assert forwards == N_WARM * boundaries, (
+            f"{forwards} forwards for {N_WARM} requests x {boundaries} "
+            "boundaries — the one-forward-per-boundary contract broke"
+        )
+        xbytes = _counter(fams, "mcim_systolic_exchange_bytes_total")
+        assert xbytes > 0
+        placed = _counter(fams, "mcim_systolic_stages_placed_total")
+        assert placed == N_WARM * len(ranges), (placed, N_WARM, ranges)
+        print(
+            f"smoke: exactly one exchange per stage boundary — "
+            f"{forwards:.0f} forwards / {N_WARM} requests, "
+            f"{xbytes:.0f} exchange bytes"
+        )
+
+        # -- 4. SIGKILL a stage owner mid-load: pinned, never wrong -------
+        victim = owners[0]
+        fab.kill_replica(victim)
+        accepted = 0
+        for _ in range(12):
+            code, _h, body = _post(fab.url, q, blob)
+            if code == 200:
+                accepted += 1
+                assert body == sys_body, (
+                    "a fallback response differed from the systolic "
+                    "bytes — WRONG ANSWER"
+                )
+            time.sleep(0.1)
+        assert accepted > 0, "pod never accepted after the owner kill"
+        fams = parse_exposition(fab.scrape())
+        fallbacks = {
+            labels: v
+            for (_n, labels), v in fams.get(
+                "mcim_systolic_fallbacks_total", {"samples": {}}
+            )["samples"].items()
+        }
+        n_fallbacks = sum(fallbacks.values())
+        assert n_fallbacks > 0, "owner death was never counted as fallback"
+        for labels in fallbacks:
+            reason = labels.split('"')[1]
+            assert reason in FALLBACK_REASONS, (labels, FALLBACK_REASONS)
+        dumps = glob.glob(
+            os.path.join(_REC_DIR, "recorder_systolic_fallback_*.json")
+        )
+        assert dumps, f"no systolic_fallback recorder dump in {_REC_DIR}"
+        with open(dumps[0]) as f:
+            assert json.load(f)["trigger"] == "systolic_fallback"
+        print(
+            f"smoke: killed stage owner {victim} mid-load — "
+            f"{accepted}/12 accepted, ALL byte-identical to the systolic "
+            f"response; fallbacks counted {fallbacks}; post-mortem "
+            f"{os.path.basename(dumps[0])}"
+        )
+
+        # -- 5. exposition parses with every systolic family --------------
+        exposition = fab.scrape()
+        fams = parse_exposition(exposition)
+        for fam in (
+            "mcim_systolic_requests_total",
+            "mcim_systolic_stages_placed_total",
+            "mcim_systolic_fallbacks_total",
+            "mcim_systolic_tiles_forwarded_total",
+            "mcim_systolic_exchange_bytes_total",
+        ):
+            assert fam in fams, f"{fam} missing from /metrics"
+        with open(metrics_out, "w") as f:
+            f.write(exposition)
+        print(f"smoke: /metrics parses federated -> {metrics_out}")
+
+        summary_path = env_registry.get(ENV_AB_JSON)
+        if summary_path:
+            with open(summary_path, "w") as f:
+                json.dump({
+                    "lane": "systolic_smoke",
+                    "placement": {"ranges": ranges, "owners": owners},
+                    "requests_warm": N_WARM,
+                    "forwards": forwards,
+                    "exchange_bytes": xbytes,
+                    "accepted_after_kill": accepted,
+                    "fallbacks": {
+                        k.split('"')[1]: v for k, v in fallbacks.items()
+                    },
+                }, f, indent=2)
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) != 2:
+        print(__doc__)
+        sys.exit(2)
+    sys.exit(main(sys.argv[1]))

@@ -1,0 +1,155 @@
+#!/bin/bash
+# Round-7 burndown (consolidates the former 26/27/28/29/30 steps): the
+# whole pending r07 backlog in ONE serialized window slot, ordered so an
+# early cut still captures the decisive records first. Former steps:
+#
+#   26_plan_r07       folded into the megakernel section (plan_ab +
+#                     plan autotune are the same window slot)
+#   27_elastic_r07    elastic fabric: autoscale/preempt/canary loadgen
+#   28_graph_r07      pipeline service: graph_loadgen + pod smoke
+#   29_megakernel_r07 megakernel A/B + plan autotune incl. fused-pallas
+#   30_cost_r07       measured-vs-model roofline + live profile capture
+#
+# Each section tolerates its own failure (the window drains on): the
+# artifacts that did land are committed regardless.
+# Budget: ~20-30 min warm, ~45 min cold.
+set -u
+cd "$(dirname "$0")/../.."
+. tools/tpu_queue/_lib.sh
+out=artifacts/burndown_r07.out
+: > "$out"
+
+# -- 1) megakernel + plan axis (former 29, incl. folded 26) ------------------
+# megakernel_ab gates bit-exactness before timing; this is the
+# work-per-HBM-byte record that moves roofline_frac past 0.11.
+timeout 1200 python -m mpi_cuda_imagemanipulation_tpu.bench_suite \
+  --config megakernel_ab >> "$out" 2>&1 || true
+timeout 1200 python -m mpi_cuda_imagemanipulation_tpu.bench_suite \
+  --config plan_ab >> "$out" 2>&1 || true
+# plan autotune over all modes incl. fused-pallas — the ONLY way
+# `--plan auto` ever routes to the megakernel
+timeout 1200 python -m mpi_cuda_imagemanipulation_tpu.cli autotune \
+  --dimension plan \
+  --ops grayscale,contrast:3.5,gaussian:5,sharpen,quantize:6 \
+  --height 4320 --width 7680 \
+  --json-metrics artifacts/megakernel_autotune_r07.json >> "$out" 2>&1 || true
+# sharded structure A/B: fused-XLA walker vs ghost-mode megakernel, both
+# behind one ppermute pair per stage (bit-identical output)
+python - <<'EOF'
+from mpi_cuda_imagemanipulation_tpu.io.image import save_image, synthetic_image
+save_image("artifacts/_mega_8k.ppm", synthetic_image(4320, 7680, channels=3, seed=7))
+EOF
+for plan in off fused fused-pallas; do
+  timeout 1200 python -m mpi_cuda_imagemanipulation_tpu.cli run \
+    --input artifacts/_mega_8k.ppm --output artifacts/_mega_8k_out.ppm \
+    --ops grayscale,contrast:3.5,gaussian:5,sharpen,quantize:6 --impl xla \
+    --shards 4 --plan "$plan" --show-timing \
+    --json-metrics "artifacts/megakernel_sharded_${plan}_r07.json" \
+    >> "$out" 2>&1 || true
+done
+rm -f artifacts/_mega_8k.ppm artifacts/_mega_8k_out.ppm
+
+# -- 2) elastic fabric (former 27) -------------------------------------------
+# autoscaled pod under saturating offered mix: scale-up latency, SIGUSR1
+# preemption absorbed mid-load, idle scale-down recorded as drained
+timeout 1800 python -m mpi_cuda_imagemanipulation_tpu.bench_suite \
+  --config fabric_loadgen \
+  --json-metrics artifacts/fabric_elastic_suite_r07.json >> "$out" 2>&1 || true
+timeout 900 python tools/elastic_smoke.py \
+  artifacts/elastic_metrics_r07.prom >> "$out" 2>&1 || true
+
+# -- 3) pipeline service (former 28) -----------------------------------------
+# chain-vs-DAG doors gated byte-identical pre-timing; multi-tenant QoS
+# mix; then the pod smoke against a real 2-replica pod on the chip
+timeout 1800 python -m mpi_cuda_imagemanipulation_tpu.bench_suite \
+  --config graph_loadgen --tenants 3 \
+  --json-metrics artifacts/graph_loadgen_r07.json >> "$out" 2>&1 || true
+timeout 900 python tools/graph_smoke.py \
+  artifacts/graph_metrics_r07.prom >> "$out" 2>&1 || true
+
+# -- 4) cost observability (former 30) ---------------------------------------
+# measured-vs-model roofline columns on the headline + stencil-class
+# configs, on the chip's own cost_analysis
+for cfg in gaussian5_8k gaussian3_4k reference_pipeline_4k; do
+  timeout 1200 python -m mpi_cuda_imagemanipulation_tpu.bench_suite \
+    --config "$cfg" >> "$out" 2>&1 || true
+done
+# per-stage drift on silicon: the megakernel one-read-one-write gate
+# judged by the chip's own memory_analysis, fused AND fused-pallas
+timeout 600 python - >> "$out" 2>&1 <<'EOF'
+import json
+from mpi_cuda_imagemanipulation_tpu.obs import cost as obs_cost
+from mpi_cuda_imagemanipulation_tpu.ops.registry import make_pipeline_ops
+from mpi_cuda_imagemanipulation_tpu.plan import build_plan
+
+ops = make_pipeline_ops("grayscale,contrast:3.5,gaussian:5,sharpen,quantize:6")
+for mode, pallas in (("fused", False), ("fused-pallas", True)):
+    plan = build_plan(ops, mode)
+    rows = obs_cost.attribute_plan(plan, (4320, 7680, 3), pallas=pallas)
+    print(json.dumps({
+        "lane": f"stage_drift_{mode}",
+        "fingerprint": plan.fingerprint,
+        "stages": [
+            {k: r[k] for k in ("stage", "names", "modeled_bytes", "drift_ratio")}
+            for r in rows
+        ],
+    }))
+EOF
+# live profile capture under fabric offered load: pod up, loadgen on,
+# one POST /control/profile mid-stream, artifact committed
+timeout 900 python - >> "$out" 2>&1 <<'EOF'
+import json, shutil, threading, time, urllib.request
+import numpy as np
+from mpi_cuda_imagemanipulation_tpu.fabric.replica import ReplicaRuntime
+from mpi_cuda_imagemanipulation_tpu.fabric.router import Router, RouterConfig
+from mpi_cuda_imagemanipulation_tpu.io.image import encode_image_bytes, synthetic_image
+from mpi_cuda_imagemanipulation_tpu.obs import trace as obs_trace
+from mpi_cuda_imagemanipulation_tpu.serve.loadgen import http_run_offered_load
+from mpi_cuda_imagemanipulation_tpu.serve.server import ServeConfig
+
+obs_trace.configure(sample=0.05)  # sampled + tail-kept, like production
+router = Router(RouterConfig(buckets=((1024, 1024),))).start()
+rt = ReplicaRuntime("r0", router.url, ServeConfig(
+    ops="grayscale,contrast:3.5,emboss:3", buckets=((1024, 1024),),
+    channels=(3,), max_batch=4,
+), heartbeat_s=0.3).start()
+try:
+    while not router._routable():
+        time.sleep(0.05)
+    blob = bytes(encode_image_bytes(
+        np.asarray(synthetic_image(1000, 1000, channels=3, seed=7))
+    ))
+    prof = {}
+    def capture():
+        time.sleep(2.0)  # mid-loadgen
+        req = urllib.request.Request(
+            router.url + "/control/profile",
+            data=json.dumps({"seconds": 3.0}).encode(), method="POST")
+        with urllib.request.urlopen(req, timeout=60) as r:
+            prof.update(json.loads(r.read()))
+    t = threading.Thread(target=capture); t.start()
+    rec = http_run_offered_load(router.url, [blob], 20.0, 8.0)
+    t.join()
+    rec.pop("results", None)
+    print(json.dumps({"lane": "profile_under_load", "loadgen": rec,
+                      "capture": {k: prof.get(k) for k in
+                                  ("replica", "status", "seconds",
+                                   "host_events", "device_events")}}))
+    shutil.copyfile(prof["artifact"], "artifacts/profile_live_r07.json")
+finally:
+    rt.close(drain=False, deadline_s=5.0)
+    router.close()
+EOF
+
+commit_artifacts "TPU window: round-7 burndown — megakernel/plan + elastic + graph + cost (consolidated 26-30)" \
+  "$out" \
+  artifacts/megakernel_autotune_r07.json \
+  artifacts/megakernel_sharded_off_r07.json \
+  artifacts/megakernel_sharded_fused_r07.json \
+  artifacts/megakernel_sharded_fused-pallas_r07.json \
+  artifacts/fabric_elastic_suite_r07.json \
+  artifacts/elastic_metrics_r07.prom \
+  artifacts/graph_loadgen_r07.json \
+  artifacts/graph_metrics_r07.prom \
+  artifacts/profile_live_r07.json
+exit 0

@@ -1,0 +1,42 @@
+#!/bin/bash
+# Pod-level systolic execution on silicon (round 7, ISSUE 16): DAG
+# stages sharded across replicas, row-band tiles streaming over ICI.
+#
+# Two records, both bit-exactness-gated before any timing:
+#
+#   systolic_ab   the bench lane — a real 2-replica systolic pod vs the
+#                 pinned single-replica path on the >= 8-stage headline
+#                 chain (same offered requests, byte-identical bodies
+#                 required pre-timing); columns: req/s + p99 per lane,
+#                 transport forwards per request (must equal stage
+#                 boundaries crossed), exchange bytes/request. On TPU
+#                 the question is real: does streaming tiles between
+#                 stage-owning replicas over ICI beat one replica
+#                 walking all stages, once per-stage VMEM residency is
+#                 on the table?
+#   device lane   the in-process sharded executor (parallel/systolic):
+#                 the wavefront over a real stage mesh — its exchange
+#                 count is proven STRUCTURALLY (collective-permute count
+#                 in the lowered HLO == stage boundaries), so the lane
+#                 records MP/s at n_devices=2/4 against --plan off.
+#
+# The smoke then proves the full pod contract on the chip: placement
+# across both replicas, one transport forward per boundary, SIGKILL of
+# a stage owner mid-load -> counted fallback with 100% of accepted
+# requests bit-exact, mcim_systolic_* parsing federated on the router.
+# Knobs: MCIM_SYSTOLIC_AB_OPS / _REQUESTS / _HEIGHT, MCIM_SYSTOLIC_AB_JSON.
+# Budget: ~5-8 min.
+set -u
+cd "$(dirname "$0")/../.."
+. tools/tpu_queue/_lib.sh
+out=artifacts/systolic_r07.out
+: > "$out"
+timeout 1500 python -m mpi_cuda_imagemanipulation_tpu.bench_suite \
+  --config systolic_ab \
+  --json-metrics artifacts/systolic_ab_r07.json >> "$out" 2>&1 || true
+MCIM_SYSTOLIC_AB_JSON=artifacts/systolic_smoke_r07.json \
+timeout 900 python tools/systolic_smoke.py \
+  artifacts/systolic_metrics_r07.prom >> "$out" 2>&1 || true
+commit_artifacts "TPU window: pod-level systolic A/B + pod smoke (round 7)" \
+  "$out" artifacts/systolic_ab_r07.json artifacts/systolic_metrics_r07.prom
+exit 0
