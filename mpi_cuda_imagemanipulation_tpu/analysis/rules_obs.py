@@ -104,6 +104,23 @@ rule(
     "when every fallback site names its reason as a string literal.",
 )
 rule(
+    "obs-fed-reroute-unknown", "obs",
+    "count_reroute() names a reason missing from REROUTE_REASONS in "
+    "federation/frontdoor.py (the typo'd reason would raise at count "
+    "time — on the failover path that exists to never lose a request).",
+)
+rule(
+    "obs-fed-reroute-unused", "obs",
+    "A REROUTE_REASONS entry has no count_reroute() caller anywhere — a "
+    "failover lane no forwarding path can attribute to.",
+)
+rule(
+    "obs-fed-reroute-dynamic", "obs",
+    "count_reroute() called with a non-literal reason in package code — "
+    "the closed REROUTE_REASONS vocabulary is only machine-checkable "
+    "when every reroute site names its reason as a string literal.",
+)
+rule(
     "obs-cost-attribution-missing", "obs",
     "A compile-cache insertion site (a store into a `_fns` cache dict or "
     "a cache_put() call) in package code never touches the cost-"
@@ -133,7 +150,7 @@ rule(
 
 _METRIC_RE = re.compile(
     r"^mcim_(serve|engine|cache|breaker|health|batch|analysis|fabric|stream"
-    r"|plan|fleet|slo|graph|cost|devmem|systolic)_[a-z0-9_]+$"
+    r"|plan|fleet|slo|graph|cost|devmem|systolic|fed)_[a-z0-9_]+$"
 )
 
 
@@ -155,6 +172,7 @@ def check_obs(repo: Repo):
     findings.extend(_check_exemplars(repo))
     findings.extend(_check_recorder_triggers(repo))
     findings.extend(_check_systolic_fallbacks(repo))
+    findings.extend(_check_fed_reroutes(repo))
     findings.extend(_check_graph_taxonomy(repo))
     findings.extend(_check_cost_attribution(repo))
     return findings
@@ -347,7 +365,7 @@ def _check_metrics(repo: Repo) -> list:
                     "mcim_<subsystem>_<what> scheme "
                     "(subsystems: serve/engine/cache/breaker/health/"
                     "batch/analysis/fabric/stream/plan/fleet/slo/graph/"
-                    "systolic)"
+                    "systolic/fed)"
                 )
             elif kind == "counter" and not name.endswith("_total"):
                 msg = f"counter {name!r} must end in _total"
@@ -617,6 +635,91 @@ def _check_systolic_fallbacks(repo: Repo) -> list:
                 f"{PACKAGE}/graph/systolic.py", reg_line,
                 f"FALLBACK_REASONS entry {reason!r} has no "
                 "count_fallback() caller anywhere in the repo",
+            )
+        )
+    return findings
+
+
+# -- federation reroute reasons (federation/frontdoor.py) ---------------------
+
+
+def _known_reroute_reasons(repo: Repo) -> tuple[set[str], int]:
+    sf = repo.by_rel.get(f"{PACKAGE}/federation/frontdoor.py")
+    if sf is None:
+        return set(), 0
+    for node in sf.tree.body:
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if (
+                    isinstance(tgt, ast.Name)
+                    and tgt.id == "REROUTE_REASONS"
+                ):
+                    vals = {
+                        e.value
+                        for e in ast.walk(node.value)
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)
+                    }
+                    return vals, node.lineno
+    return set(), 0
+
+
+def _is_count_reroute(node: ast.Call) -> bool:
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr == "count_reroute"
+    return isinstance(fn, ast.Name) and fn.id == "count_reroute"
+
+
+def _check_fed_reroutes(repo: Repo) -> list:
+    """The federation reroute vocabulary is closed exactly like systolic
+    fallback reasons: every count_reroute(counter, reason) site must name
+    a REROUTE_REASONS literal, and every entry must have a caller — a
+    reason nobody can count is a failover lane the metrics cannot see."""
+    findings = []
+    known, reg_line = _known_reroute_reasons(repo)
+    if not known:
+        return findings
+    used: set[str] = set()
+    for sf in repo.files:
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Call) and len(node.args) >= 2):
+                continue
+            if not _is_count_reroute(node):
+                continue
+            a1 = node.args[1]
+            if isinstance(a1, ast.Constant) and isinstance(a1.value, str):
+                reason = a1.value
+                used.add(reason)
+                if reason not in known and sf.rel.startswith(
+                    (PACKAGE + "/", "tools/")
+                ):
+                    # tests may pass an out-of-vocabulary reason on
+                    # purpose — asserting the ValueError guard fires
+                    findings.append(
+                        make_finding(
+                            "obs-fed-reroute-unknown", sf.rel,
+                            node.lineno,
+                            f"federation reroute reason {reason!r} is not "
+                            "in REROUTE_REASONS (federation/frontdoor.py)",
+                        )
+                    )
+            elif sf.rel.startswith(PACKAGE + "/"):
+                findings.append(
+                    make_finding(
+                        "obs-fed-reroute-dynamic", sf.rel,
+                        node.lineno,
+                        "count_reroute() reason is not a string literal "
+                        "— name one of REROUTE_REASONS directly",
+                    )
+                )
+    for reason in sorted(known - used):
+        findings.append(
+            make_finding(
+                "obs-fed-reroute-unused",
+                f"{PACKAGE}/federation/frontdoor.py", reg_line,
+                f"REROUTE_REASONS entry {reason!r} has no "
+                "count_reroute() caller anywhere in the repo",
             )
         )
     return findings

@@ -352,6 +352,7 @@ PLAN_AB = "plan_ab"
 MEGAKERNEL_AB = "megakernel_ab"
 GRAPH_LOADGEN = "graph_loadgen"
 SYSTOLIC_AB = "systolic_ab"
+FEDERATION_LOADGEN = "federation_loadgen"
 
 
 def fabric_loadgen_params() -> dict:
@@ -876,6 +877,274 @@ def run_fabric_loadgen(
                 else ""
             )
         )
+    if json_path:
+        emit_json_metrics(rec, None if json_path == "-" else json_path)
+    return rec
+
+
+def federation_loadgen_params() -> dict:
+    """The federation lane knobs: the fabric_loadgen posture one tier up
+    — two whole pods (each `replicas` CPU replica processes behind its
+    own router) joined to one front door. The fabric lane's env
+    overrides (MCIM_FABRIC_RPS / _DURATION_S / _REPLICAS) apply here
+    too; the pod count is fixed at 2 — the smallest topology where
+    "reroute" and "failover" mean different pods."""
+    p = fabric_loadgen_params()
+    p["pods"] = 2
+    return p
+
+
+def run_federation_loadgen(
+    *,
+    json_path: str | None = None,
+    printer: Callable[[str], None] = print,
+) -> dict:
+    """The multi-pod federation bench lane: the same open-loop HTTP mix
+    through the federation front door (federation/frontdoor.py) over
+    2 pods x N replicas, with a WHOLE POD SIGKILLed mid-sweep —
+    supervisor and replicas together, no drain, no handover, and no
+    restart (nothing supervises a pod; `after` measures the surviving
+    single-pod steady state). The acceptance gate is the fabric churn
+    rule one tier up: during the pod loss every ACCEPTED request
+    completes 200 and bit-exact against the golden per-request path
+    (unavailable == 0 — rerouting, not luck), and the front door books
+    the loss in mcim_fed_reroutes_total under the closed
+    REROUTE_REASONS vocabulary only. The front door runs in-process
+    with the client threads (it proxies, the pods compute), so this
+    lane's headline is availability under whole-pod loss — peak
+    capacity is fabric_loadgen's claim."""
+    import signal as _signal
+    import tempfile as _tempfile
+    import time
+
+    import numpy as np
+
+    from mpi_cuda_imagemanipulation_tpu.federation.frontdoor import (
+        REROUTE_REASONS,
+        FrontDoor,
+        FrontDoorConfig,
+    )
+    from mpi_cuda_imagemanipulation_tpu.obs.metrics import parse_exposition
+    from mpi_cuda_imagemanipulation_tpu.serve import loadgen
+    from mpi_cuda_imagemanipulation_tpu.serve.bucketing import parse_buckets
+    from mpi_cuda_imagemanipulation_tpu.serve.padded import min_true_dim
+
+    p = federation_loadgen_params()
+    pipe = Pipeline.parse(p["ops"])
+    images = loadgen.mixed_shapes(
+        parse_buckets(p["buckets"]),
+        p["n_images"],
+        channels=3,
+        seed=7,
+        min_dim=min_true_dim(pipe),
+    )
+    blobs = [loadgen.encode_blob(im) for im in images]
+    golden_fn = pipe.jit()
+    golden = [np.asarray(golden_fn(im)) for im in images]
+
+    def check_bit_exact(results) -> int:
+        from mpi_cuda_imagemanipulation_tpu.io.image import (
+            decode_image_bytes,
+        )
+
+        n = 0
+        for k, r in results:
+            if r["code"] != 200:
+                continue
+            got = decode_image_bytes(r["body"])
+            if not np.array_equal(got, golden[k]):
+                raise AssertionError(
+                    f"federation_loadgen: response for image {k} "
+                    "mismatches the golden per-request output"
+                )
+            n += 1
+        return n
+
+    def _fed_forwards_ok(door) -> dict[str, float]:
+        fams = parse_exposition(door.registry.render())
+        out: dict[str, float] = {}
+        fam = fams.get("mcim_fed_forwards_total")
+        if fam:
+            for (_n, labels), v in fam["samples"].items():
+                if 'outcome="ok"' not in labels:
+                    continue
+                pod = labels.split('pod="', 1)[1].split('"', 1)[0]
+                out[pod] = out.get(pod, 0.0) + v
+        return out
+
+    def _fed_reroutes(door) -> dict[str, float]:
+        fams = parse_exposition(door.registry.render())
+        out: dict[str, float] = {}
+        fam = fams.get("mcim_fed_reroutes_total")
+        if fam:
+            for (_n, labels), v in fam["samples"].items():
+                reason = labels.split('reason="', 1)[1].split('"', 1)[0]
+                out[reason] = out.get(reason, 0.0) + v
+        return out
+
+    tmp = _tempfile.mkdtemp(prefix="federation_loadgen_")
+    door = FrontDoor(
+        FrontDoorConfig(
+            registry_path=os.path.join(tmp, "fed_registry.jsonl"),
+            buckets=tuple(parse_buckets(p["buckets"])),
+            stale_s=4 * p["heartbeat_s"],
+            forward_timeout_s=60.0,
+            forward_attempts=3,
+        )
+    ).start(host="127.0.0.1", port=0)
+    pods: dict[str, _FabricProc] = {}
+    lanes: dict[str, dict] = {}
+    try:
+        for i in range(p["pods"]):
+            pods[f"pod{i}"] = _FabricProc(
+                p,
+                p["replicas"],
+                extra_args=["--federate", door.url, "--pod-id", f"pod{i}"],
+                extra_env={
+                    "MCIM_FED_HEARTBEAT_S": str(p["heartbeat_s"]),
+                },
+            )
+        deadline = time.monotonic() + 240.0
+        while time.monotonic() < deadline:
+            for pid, fab in pods.items():
+                if fab.proc.poll() is not None:
+                    raise RuntimeError(
+                        f"pod {pid} exited rc={fab.proc.returncode}"
+                    )
+            now = door._clock()
+            ready = {
+                v.pod_id
+                for v in door.table.views()
+                if v.fresh(now, door.stale_s)
+                and v.hb.routable >= p["replicas"]
+            }
+            if ready >= set(pods):
+                break
+            time.sleep(0.25)
+        else:
+            raise TimeoutError(
+                f"pods never joined the front door (ready: {ready})"
+            )
+        # bit-exact gate BEFORE any timing: one pass over the unique mix
+        gate = loadgen.http_run_offered_load(
+            door.url, blobs, min(64.0, p["offered_rps"]),
+            len(blobs) / min(64.0, p["offered_rps"]),
+        )
+        gate_checked = check_bit_exact(gate["results"])
+        # -- 2 pods, steady state -------------------------------------------
+        rec2 = loadgen.http_run_offered_load(
+            door.url, blobs, p["offered_rps"], p["phase_s"],
+            max_workers=p["max_workers"],
+        )
+        check_bit_exact(rec2["results"])
+        lanes["pods_2"] = _phase_public(rec2)
+        # -- whole-pod churn ------------------------------------------------
+        # the victim is the pod that carried the most successful forwards
+        # (sticky affinity concentrates keys): killing the idle pod would
+        # prove nothing about rerouting under loss
+        by_pod = _fed_forwards_ok(door)
+        victim = (
+            max(by_pod, key=by_pod.get) if by_pod else next(iter(pods))
+        )
+        survivor = next(pid for pid in pods if pid != victim)
+        killed_pids: list[int] = []
+
+        def _kill_whole_pod():
+            fab = pods[victim]
+            try:
+                killed_pids.extend(
+                    rep["pid"] for rep in fab.stats()["replicas"].values()
+                )
+            except Exception:
+                pass
+            if fab.proc.poll() is None:
+                killed_pids.append(fab.proc.pid)
+                fab.proc.send_signal(_signal.SIGKILL)
+            for pid in killed_pids:
+                try:
+                    os.kill(pid, _signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+
+        phases = loadgen.churn_run(
+            door.url,
+            blobs,
+            offered_rps=p["churn_rps"],
+            phase_s=p["phase_s"],
+            kill=_kill_whole_pod,
+        )
+        for ph in phases.values():
+            check_bit_exact(ph["results"])
+        during = phases["during"]
+        if during["unavailable"] or during["ok"] != during["accepted"]:
+            raise AssertionError(
+                f"federation_loadgen: requests lost during whole-pod "
+                f"SIGKILL of {victim}: ok {during['ok']} / accepted "
+                f"{during['accepted']} / unavailable "
+                f"{during['unavailable']}"
+            )
+        reroutes = _fed_reroutes(door)
+        unknown = set(reroutes) - set(REROUTE_REASONS)
+        if unknown:
+            raise AssertionError(
+                f"federation_loadgen: reroute reasons outside the closed "
+                f"vocabulary: {sorted(unknown)}"
+            )
+        if not reroutes:
+            raise AssertionError(
+                "federation_loadgen: whole-pod SIGKILL produced no "
+                "counted reroute"
+            )
+        lanes["pod_churn"] = {
+            name: _phase_public(ph) for name, ph in phases.items()
+        }
+        lanes["pod_churn"].update(
+            victim=victim,
+            survivor=survivor,
+            churn_rps=p["churn_rps"],
+            killed_pids=killed_pids,
+            reroutes=reroutes,
+        )
+    finally:
+        door.close()
+        for fab in pods.values():
+            fab.close()
+    rec = {
+        "config": FEDERATION_LOADGEN,
+        "pipeline": p["ops"],
+        "impl": "xla",
+        "platform": jax.default_backend(),
+        "buckets": p["buckets"],
+        "pods": p["pods"],
+        "replicas_per_pod": p["replicas"],
+        "offered_rps": p["offered_rps"],
+        "phase_s": p["phase_s"],
+        "bit_exact_gate": f"passed ({gate_checked} responses vs golden)",
+        "lanes": lanes,
+        "reroutes": reroutes,
+    }
+    printer(
+        f"{'lane':22s} {'achieved':>9s} {'ok%':>6s} {'shed%':>6s} "
+        f"{'retry%':>7s} {'p99 ms':>8s}"
+    )
+
+    def _row(name: str, r: dict) -> None:
+        printer(
+            f"{name:22s} {r['achieved_rps']:9.1f} "
+            f"{r['ok_frac'] * 100:5.1f}% "
+            f"{r.get('shed_frac', 0.0) * 100:5.1f}% "
+            f"{r['retried_frac'] * 100:6.1f}% "
+            f"{r.get('e2e_p99_ms', float('nan')):8.2f}"
+        )
+
+    _row("pods_2", lanes["pods_2"])
+    for ph in ("before", "during", "after"):
+        _row(f"pod_churn/{ph}", lanes["pod_churn"][ph])
+    printer(
+        f"whole-pod SIGKILL of {victim}: during-phase "
+        f"{during['ok']}/{during['accepted']} accepted requests ok "
+        f"(bit-exact), reroutes {reroutes}"
+    )
     if json_path:
         emit_json_metrics(rec, None if json_path == "-" else json_path)
     return rec
@@ -2473,12 +2742,21 @@ def run_suite(
         )
         if not names:
             return records
+    if names and FEDERATION_LOADGEN in names:
+        # the federation lane measures a two-pod topology behind the
+        # front door (whole-pod SIGKILL mid-sweep), not one executable
+        names = [n for n in names if n != FEDERATION_LOADGEN]
+        records.append(
+            run_federation_loadgen(json_path=json_path, printer=printer)
+        )
+        if not names:
+            return records
     if names:
         unknown = [n for n in names if n not in CONFIGS]
         if unknown:
             raise ValueError(
                 f"unknown bench config(s) {unknown}; known: "
-                f"{sorted(CONFIGS) + [ENGINE_AB, FABRIC_LOADGEN, GRAPH_LOADGEN, MEGAKERNEL_AB, MXU_AB, PLAN_AB, SERVE_LOADGEN, STREAM_AB, SYSTOLIC_AB]}"
+                f"{sorted(CONFIGS) + [ENGINE_AB, FABRIC_LOADGEN, FEDERATION_LOADGEN, GRAPH_LOADGEN, MEGAKERNEL_AB, MXU_AB, PLAN_AB, SERVE_LOADGEN, STREAM_AB, SYSTOLIC_AB]}"
             )
         selected = [CONFIGS[n] for n in names]
     else:

@@ -562,8 +562,57 @@ def _build_parser() -> argparse.ArgumentParser:
         help="write the shutdown fabric stats record to this path "
         "('-' = stdout)",
     )
+    fab.add_argument(
+        "--federate",
+        default=None,
+        metavar="URL",
+        help="federation front-door URL (federation/): this pod's router "
+        "pushes pod-aggregate heartbeats there, receives tenant "
+        "quota-share leases on the acks, and serves forwarded /v1/* "
+        "traffic as one pod among many",
+    )
+    fab.add_argument(
+        "--pod-id",
+        default=None,
+        help="stable pod identity at the federation tier (affinity "
+        "routing and mcim_fed_* labels key on it; default pod-<pid>)",
+    )
     _add_failpoint_flags(fab)
     _add_trace_flags(fab)
+
+    fed = sub.add_parser(
+        "federation",
+        help="multi-pod federation front door (federation/): routes "
+        "/v1/* across registered pods (rendezvous affinity, per-pod "
+        "breakers, whole-pod failover), persists tenant configs + "
+        "pipeline specs in an fsync'd registry that survives restarts, "
+        "and leases per-pod shares of each tenant's global fixed-window "
+        "quota; pods join with `fabric --federate URL --pod-id NAME`",
+    )
+    fed.add_argument("--host", default="", help="front-door bind address")
+    fed.add_argument("--port", type=int, default=8100)
+    fed.add_argument(
+        "--registry",
+        default=None,
+        help="durable tenant/spec/session registry path (default: "
+        "MCIM_FED_REGISTRY)",
+    )
+    fed.add_argument(
+        "--stale-s",
+        type=float,
+        default=None,
+        help="pod freshness window: pods silent this long are routed "
+        "around (default: MCIM_FED_STALE_S)",
+    )
+    fed.add_argument(
+        "--shed-frac",
+        type=float,
+        default=0.9,
+        help="pod queue-fill fraction past which a pod loses sticky "
+        "preference (counted reroute reason 'overloaded')",
+    )
+    _add_failpoint_flags(fed)
+    _add_trace_flags(fed)
 
     stm = sub.add_parser(
         "stream",
@@ -1935,6 +1984,8 @@ def cmd_fabric(args: argparse.Namespace) -> int:
         min_replicas=args.min_replicas,
         max_replicas=args.max_replicas,
         systolic=systolic,
+        federate=args.federate,
+        pod_id=args.pod_id,
     )
     stop_evt = threading.Event()
 
@@ -1978,6 +2029,64 @@ def cmd_fabric(args: argparse.Namespace) -> int:
                 {"event": "fabric", **stats},
                 None if args.json_metrics == "-" else args.json_metrics,
             )
+        _export_trace(args, log)
+    return 0
+
+
+def cmd_federation(args: argparse.Namespace) -> int:
+    """Multi-pod federation front door (federation/): a meta-router over
+    whole fabric pods. Pods join by heartbeating (`fabric --federate`);
+    tenant/spec registrations persist in the durable registry across
+    restarts. SIGTERM/SIGINT stops the listener (pods serve on)."""
+    _arm_failpoints(args)
+    _configure_tracing(args)
+    import signal
+    import threading
+
+    from mpi_cuda_imagemanipulation_tpu.federation.frontdoor import (
+        FrontDoor,
+        FrontDoorConfig,
+    )
+    from mpi_cuda_imagemanipulation_tpu.utils.log import get_logger
+
+    log = get_logger()
+    door = FrontDoor(
+        FrontDoorConfig(
+            registry_path=args.registry,
+            stale_s=args.stale_s,
+            shed_frac=args.shed_frac,
+        )
+    )
+    stop_evt = threading.Event()
+
+    def _on_signal(signum, frame):
+        log.info(
+            "signal %s: stopping the front door",
+            signal.Signals(signum).name,
+        )
+        stop_evt.set()
+
+    prev_handlers = {
+        s: signal.signal(s, _on_signal)
+        for s in (signal.SIGTERM, signal.SIGINT)
+    }
+    try:
+        door.start(args.host, args.port)
+        log.info(
+            "federation front door on %s:%d (registry %s: %d records "
+            "rehydrated, %d lines skipped) — pods join via "
+            "`fabric --federate http://HOST:%d --pod-id NAME`",
+            args.host or "0.0.0.0", door.address[1], door.durable.path,
+            door.durable.loaded_records, door.durable.skipped_lines,
+            door.address[1],
+        )
+        stop_evt.wait()
+    except KeyboardInterrupt:
+        log.info("interrupt: stopping the front door")
+    finally:
+        for s, h in prev_handlers.items():
+            signal.signal(s, h)
+        door.close()
         _export_trace(args, log)
     return 0
 
@@ -2627,6 +2736,7 @@ def main(argv: list[str] | None = None) -> int:
         "stream": cmd_stream,
         "serve": cmd_serve,
         "fabric": cmd_fabric,
+        "federation": cmd_federation,
         "graph": cmd_graph,
         "bench": cmd_bench,
         "diff": cmd_diff,

@@ -46,6 +46,7 @@ import dataclasses
 import http.client
 import io as _io
 import json
+import os
 import threading
 import time
 import urllib.request
@@ -57,6 +58,7 @@ from mpi_cuda_imagemanipulation_tpu.fabric.control import (
     HEARTBEAT_PATH,
     Heartbeat,
 )
+from mpi_cuda_imagemanipulation_tpu.federation import control as fed_control
 from mpi_cuda_imagemanipulation_tpu.graph import systolic as graph_systolic
 from mpi_cuda_imagemanipulation_tpu.obs import fleet as obs_fleet
 from mpi_cuda_imagemanipulation_tpu.obs import metrics as obs_metrics
@@ -321,6 +323,13 @@ class Router:
         # set by the Fabric when the elastic loop is armed (status only)
         self.autoscaler = None
         self.mesh_lane = mesh_lane
+        # federation uplink (federation/): armed by federate() — this
+        # router then represents its whole pod to a front door, pushing
+        # pod-aggregate heartbeats and applying quota leases from acks
+        self._fed_sender = None
+        self._fed_pod_id: str | None = None
+        self._fed_incarnation: str | None = None
+        self._fed_source = None
         self._pool = _ConnPool(self.forward_timeout_s)
         self._clock = clock
         self.registry = registry or Registry()
@@ -672,13 +681,19 @@ class Router:
 
         tenant = _pick(HDR_TENANT, "tenant") or "default"
         pipeline = _pick(HDR_PIPELINE, "pipeline")
+        # the federation identity thread: a front door stamps X-Fed-Pod
+        # on its forward; the pod router relays it replica-deep so the
+        # serving process can echo which pod carried the request
+        fed_pod = headers.get(fed_control.HDR_FED_POD) or ""
         try:
             h, w = self._sniff_dims(body)
         except Exception as e:
             self._m_requests.inc(status="rejected")
             return _json_response(400, {"error": f"undecodable image: {e}"})
         if pipeline:
-            return self._handle_graph_process(body, tenant, pipeline, h, w)
+            return self._handle_graph_process(
+                body, tenant, pipeline, h, w, fed_pod=fed_pod
+            )
         picked = bucketing.pick_bucket(h, w, self.buckets)
         if picked is None:
             if self.mesh_lane is not None:
@@ -718,7 +733,10 @@ class Router:
             )
         else:
             code, ctype, out, extra = self._forward_with_retries(
-                root, bucket, body, candidates
+                root, bucket, body, candidates,
+                extra_headers=(
+                    ((fed_control.HDR_FED_POD, fed_pod),) if fed_pod else ()
+                ),
             )
         self._m_requests.inc(
             status=_STATUS_LABEL.get(code, "error" if code >= 500 else "ok")
@@ -1130,7 +1148,8 @@ class Router:
         return code, ctype, out, extra
 
     def _handle_graph_process(
-        self, body: bytes, tenant: str, pipeline: str, h: int, w: int
+        self, body: bytes, tenant: str, pipeline: str, h: int, w: int,
+        fed_pod: str = "",
     ) -> tuple[int, str, bytes, list[tuple[str, str]]]:
         """The graph lane: sticky affinity keyed on (tenant, pipeline,
         bucket), tenant + pipeline headers forwarded verbatim, stored
@@ -1188,7 +1207,8 @@ class Router:
             root, bucket, body, candidates,
             extra_headers=(
                 (HDR_TENANT, tenant), (HDR_PIPELINE, pipeline),
-            ),
+            )
+            + (((fed_control.HDR_FED_POD, fed_pod),) if fed_pod else ()),
             before_forward=lambda v: self._ensure_graph_state(
                 v, tenant, pipeline
             ),
@@ -1832,6 +1852,108 @@ class Router:
                     type(e).__name__,
                 )
 
+    # -- federation uplink (federation/) -----------------------------------
+
+    def federate(
+        self, frontdoor_url: str, pod_id: str, *,
+        interval_s: float | None = None,
+    ):
+        """Arm this router's pod-level uplink to a federation front
+        door: a PodHeartbeatSender pushing pod aggregates (the same
+        push protocol the replicas speak to THIS router, one tier up),
+        with the ack applying quota leases and metrics-resync. The pod
+        incarnation is minted per call, so a pod restart is visible to
+        the front door the way a replica restart is visible here."""
+        if self._fed_sender is not None:
+            return self._fed_sender
+        self._fed_pod_id = pod_id
+        self._fed_incarnation = f"{os.getpid():x}-{time.time_ns():x}"
+        # second federation hop: the delta rides the pod heartbeat and
+        # the front door's FleetAggregator folds it in keyed by pod id
+        self._fed_source = obs_fleet.DeltaSource([self.registry])
+        self._fed_sender = fed_control.PodHeartbeatSender(
+            frontdoor_url,
+            self._collect_pod_heartbeat,
+            interval_s=interval_s,
+            on_ack=self._on_fed_ack,
+        ).start()
+        self._log.info(
+            "federation: pod %s heartbeating to %s", pod_id, frontdoor_url
+        )
+        return self._fed_sender
+
+    def _collect_pod_heartbeat(self, seq: int) -> fed_control.PodHeartbeat:
+        live = self._routable()
+        with self._graph_lock:
+            pipelines = {p for (_t, p) in self.graph_specs}
+        for v in live:
+            pipelines.update(v.hb.pipelines or ())
+        addr, port = self.address
+        return fed_control.PodHeartbeat(
+            pod_id=self._fed_pod_id or "",
+            addr="" if addr in ("", "0.0.0.0") else addr,
+            port=port,
+            pid=os.getpid(),
+            incarnation=self._fed_incarnation or "",
+            routable=len(live),
+            queued=sum(v.hb.queued for v in live),
+            queue_depth=max(1, sum(v.hb.queue_depth for v in live)),
+            warm_buckets=sorted(
+                {b for v in live for b in v.hb.warm_buckets}
+            ),
+            pipelines=sorted(pipelines),
+            seq=seq,
+            sent_unix_s=time.time(),
+            metrics=self._fed_source.delta(),
+        )
+
+    def _on_fed_ack(self, hb, ack: dict) -> None:
+        if ack.get("resync"):
+            self._fed_source.force_full()
+        elif hb.metrics is not None:
+            self._fed_source.ack(hb.metrics["seq"])
+        leases = ack.get("leases")
+        if leases:
+            self._apply_leases(leases)
+
+    def _apply_leases(self, leases: dict) -> None:
+        """Overwrite stored tenant quotas with the front door's leased
+        shares and force a re-push to the replicas (their TenantRegistry
+        keeps spent window counters across a configure(), so a
+        mid-window lease update never refunds spent tokens). A tenant
+        the front door leases but this pod never saw is adopted — the
+        lease payload IS a valid tenant config."""
+        changed: list[str] = []
+        with self._graph_lock:
+            for tenant, lease in leases.items():
+                if not isinstance(lease, dict):
+                    continue
+                cfg = self.graph_tenants.get(tenant)
+                if cfg is None:
+                    cfg = {"tenant": tenant}
+                new = {
+                    **cfg,
+                    "quota_requests": lease.get("quota_requests"),
+                    "quota_bytes": lease.get("quota_bytes"),
+                }
+                if new == cfg and tenant in self.graph_tenants:
+                    continue
+                self.graph_tenants[tenant] = new
+                changed.append(tenant)
+            if changed:
+                # replica re-push happens lazily on the next forward
+                # (_ensure_graph_state), exactly like a fresh config
+                for pushed in self._tenant_pushed.values():
+                    pushed.difference_update(changed)
+        for tenant in changed:
+            self._log.info(
+                "federation: lease applied for tenant %s "
+                "(quota_requests=%s quota_bytes=%s)",
+                tenant,
+                self.graph_tenants[tenant].get("quota_requests"),
+                self.graph_tenants[tenant].get("quota_bytes"),
+            )
+
     def render_metrics(self) -> str:
         """The router `GET /metrics` body: the router's own families plus
         the FEDERATED replica families (counters summed, histograms
@@ -1909,6 +2031,17 @@ class Router:
             "mesh_lane": (
                 self.mesh_lane.stats() if self.mesh_lane is not None else None
             ),
+            "federation": (
+                {
+                    "pod_id": self._fed_pod_id,
+                    "incarnation": self._fed_incarnation,
+                    "sent": self._fed_sender.sent,
+                    "dropped": self._fed_sender.dropped,
+                    "failed": self._fed_sender.failed,
+                }
+                if self._fed_sender is not None
+                else None
+            ),
             "fleet": self.fleet.stats(now),
             "slo": self.slo.status(),
             "replicas": {
@@ -1965,6 +2098,8 @@ class Router:
         if self._closed:
             return
         self._closed = True
+        if self._fed_sender is not None:
+            self._fed_sender.stop()
         self.slo.stop()
         if self.httpd is not None:
             try:
@@ -2046,6 +2181,14 @@ def _make_handler(router: Router):
                 self._reply(200, obs_metrics.CONTENT_TYPE, body)
             elif self.path == "/slo":
                 self._reply_json(200, router.slo_status())
+            elif self.path == obs_fleet.SNAPSHOT_PATH:
+                # the federation front door's full-scrape fallback: the
+                # pod router's own registry (the same payload the pod
+                # heartbeat's delta narrows), one tier above the
+                # replica's /fleet/snapshot
+                self._reply_json(
+                    200, obs_fleet.snapshot_registries([router.registry])
+                )
             elif self.path == "/control/canary":
                 self._reply_json(200, router.canary.status())
             else:

@@ -419,6 +419,13 @@ class FabricConfig:
     scale_cooldown_s: float | None = None
     scale_tick_s: float | None = None
     scale_drain_deadline_s: float | None = None
+    # -- multi-pod federation (federation/) ---------------------------------
+    # federate=<front-door URL> arms the router's pod-level uplink: this
+    # pod pushes aggregate heartbeats there and applies quota leases
+    # from the acks; pod_id is the pod's stable identity across restarts
+    federate: str | None = None
+    pod_id: str | None = None
+    fed_heartbeat_s: float | None = None  # None: MCIM_FED_HEARTBEAT_S
 
 
 class Fabric:
@@ -516,6 +523,16 @@ class Fabric:
     ) -> "Fabric":
         try:
             self.router.start(host, port)
+            if self.config.federate:
+                # pod-level uplink AFTER the listener is bound (the pod
+                # heartbeat advertises the router's real address) and
+                # BEFORE the replicas: the front door learns of this
+                # pod within one beat of it being reachable
+                self.router.federate(
+                    self.config.federate,
+                    self.config.pod_id or f"pod-{os.getpid()}",
+                    interval_s=self.config.fed_heartbeat_s,
+                )
             specs = [
                 self._replica_spec(rid) for rid in self.replica_ids()
             ]

@@ -96,11 +96,18 @@ class GraphService:
         plan: str = "auto",
         systolic: bool = False,
         load_frac=None,
+        coalescer=None,
         clock=time.monotonic,
     ):
         self.registry = registry or Registry()
         self.backend = backend
         self.plan = plan
+        # serve/scheduler.MicroBatchScheduler (or None): when attached,
+        # admitted graph dispatches ride the chain path's coalescing
+        # queue as group lanes keyed (dag fingerprint, true shape) — one
+        # vmapped executable per (pipeline, batch bucket) instead of one
+        # jit per request shape per request
+        self.coalescer = coalescer
         # stage-sharded execution across replicas (graph/systolic.py);
         # advertised in heartbeats so the router only places stages on
         # replicas that will accept /v1/systolic hops
@@ -145,6 +152,14 @@ class GraphService:
         self._m_compiles = r.counter(
             "mcim_graph_compiles_total",
             "Graph executables built into a tenant cache namespace.",
+        )
+        self._m_coalesced = r.counter(
+            "mcim_graph_coalesced_total",
+            "Graph dispatches routed through the serving scheduler's "
+            "group lanes, by outcome (batched = answered by the lane; "
+            "fallback = lane refused, answered by the solo golden path "
+            "— a bounded two-label set).",
+            labels=("outcome",),
         )
         # replica-side systolic accounting (the router holds the
         # placement/fallback families; these live where the bytes move)
@@ -325,37 +340,14 @@ class GraphService:
             failpoints.maybe_fail(
                 "graph.dispatch", tenant=tenant_id, pipeline=pipeline_id
             )
-            fn = st.cache_get(pipeline_id)
-            if fn is None:
-                # build + jit OFF the registry lock (serve/cache.py
-                # discipline); a racing miss builds twice, cache_put
-                # keeps the newest — correctness is unaffected (both
-                # are the same program)
-                program = compile_graph(
-                    graph, plan=self.plan, backend=self.backend,
-                    width=img.shape[1] if img.ndim >= 2 else None,
+            width = img.shape[1] if img.ndim >= 2 else None
+            if self.coalescer is not None:
+                out = self._coalesced(
+                    st, pipeline_id, graph, img, width,
+                    qos=st.config.qos, trace_id=trace_id,
                 )
-                from mpi_cuda_imagemanipulation_tpu.obs import (
-                    cost as obs_cost,
-                )
-
-                # cost attribution rides the insertion (obs/cost):
-                # each request shape's first dispatch compiles AOT and
-                # lands its measured cost in the ledger keyed by the
-                # program's execution-structure fingerprint; the model
-                # is the DAG's boundary — source in, declared outputs
-                # out, shared prefixes and fused segments adding nothing
-                fn = obs_cost.wrap_cache_fn(
-                    "graph",
-                    program.fingerprint,
-                    jax.jit(graph_callable(program, impl=self.backend)),
-                    modeled_fn=lambda args, p=program: (
-                        _graph_modeled_bytes(p, self.backend, args)
-                    ),
-                )
-                st.cache_put(pipeline_id, fn)
-                self._m_compiles.inc()
-            out = fn(img)
+            else:
+                out = self._pipeline_fn(st, pipeline_id, graph, width)(img)
             result: dict = {"image": np.asarray(out["image"])}
             if "histogram" in out:
                 result["histogram"] = [
@@ -381,6 +373,107 @@ class GraphService:
         self._m_requests.inc(status="ok")
         st.requests_ok += 1
         return result
+
+    # -- coalesced (group-lane) dispatch -----------------------------------
+
+    def _pipeline_fn(self, st, pipeline_id: str, graph, width: int | None):
+        """Cached jitted solo executor for the whole program (the
+        uncoalesced path and the group lane's golden fallback)."""
+        fn = st.cache_get(pipeline_id)
+        if fn is None:
+            # build + jit OFF the registry lock (serve/cache.py
+            # discipline); a racing miss builds twice, cache_put keeps
+            # the newest — correctness is unaffected (both are the same
+            # program)
+            program = compile_graph(
+                graph, plan=self.plan, backend=self.backend, width=width
+            )
+            from mpi_cuda_imagemanipulation_tpu.obs import cost as obs_cost
+
+            # cost attribution rides the insertion (obs/cost): each
+            # request shape's first dispatch compiles AOT and lands its
+            # measured cost in the ledger keyed by the program's
+            # execution-structure fingerprint; the model is the DAG's
+            # boundary — source in, declared outputs out, shared
+            # prefixes and fused segments adding nothing
+            fn = obs_cost.wrap_cache_fn(
+                "graph",
+                program.fingerprint,
+                jax.jit(graph_callable(program, impl=self.backend)),
+                modeled_fn=lambda args, p=program: (
+                    _graph_modeled_bytes(p, self.backend, args)
+                ),
+            )
+            st.cache_put(pipeline_id, fn)
+            self._m_compiles.inc()
+        return fn
+
+    def _batched_fn(
+        self, st, pipeline_id: str, graph, width: int | None, nb: int
+    ):
+        """Cached jitted vmapped executor for nb-stacked group-lane
+        dispatch, cached as f"{pipeline_id}@b{nb}" in the same tenant
+        LRU namespace (the '@' separator cannot appear in a pipeline
+        id). vmap over the program is value-preserving: every op is
+        per-image elementwise/stencil/reduction and the histogram is a
+        fixed-length bincount, so batched and solo dispatch are
+        bit-exact — the group lane's correctness premise."""
+        key = f"{pipeline_id}@b{nb}"
+        fn = st.cache_get(key)
+        if fn is None:
+            program = compile_graph(
+                graph, plan=self.plan, backend=self.backend, width=width
+            )
+            from mpi_cuda_imagemanipulation_tpu.obs import cost as obs_cost
+
+            fn = obs_cost.wrap_cache_fn(
+                "graph",
+                f"{program.fingerprint}@b{nb}",
+                jax.jit(
+                    jax.vmap(graph_callable(program, impl=self.backend))
+                ),
+                modeled_fn=lambda args, p=program, n=nb: n * (
+                    _graph_modeled_bytes(p, self.backend, (args[0][0],))
+                ),
+            )
+            st.cache_put(key, fn)
+            self._m_compiles.inc()
+        return fn
+
+    def _coalesced(
+        self, st, pipeline_id: str, graph, img, width: int | None,
+        *, qos: str, trace_id: str,
+    ):
+        """One dispatch through the serving scheduler's group lane,
+        keyed (dag fingerprint, true shape) so same-program same-shape
+        requests share one vmapped executable per batch bucket.
+        Coalescing is a pure optimisation: any lane-level refusal
+        (queue at depth, lane quarantined, scheduler stopping) falls
+        back to the solo golden path — tenant admission already passed,
+        so the request must still be answered, and solo output is
+        bit-exact with batched by construction."""
+        from mpi_cuda_imagemanipulation_tpu.serve.scheduler import GroupSpec
+
+        ch = img.shape[2] if img.ndim == 3 else 1
+        spec = GroupSpec(
+            key=("graph", pipeline_id, img.shape[0], img.shape[1], ch),
+            get_fn=lambda nb: self._batched_fn(
+                st, pipeline_id, graph, width, nb
+            ),
+            fallback=lambda im: self._pipeline_fn(
+                st, pipeline_id, graph, width
+            )(im),
+        )
+        req = self.coalescer.submit_group(
+            img, spec, trace_id=trace_id or None, qos=qos
+        )
+        try:
+            out = req.wait()
+        except Exception:
+            self._m_coalesced.inc(outcome="fallback")
+            return self._pipeline_fn(st, pipeline_id, graph, width)(img)
+        self._m_coalesced.inc(outcome="batched")
+        return out
 
     # -- systolic (stage-sharded) dispatch ---------------------------------
 

@@ -91,6 +91,11 @@ KNOWN_SITES = (
                         # dispatch — a hit is the one genuine 500 class
                         # (device failure AFTER admission), so tests can
                         # prove shed/rejected stay distinct from error
+    "pod.heartbeat",    # federation/control.py PodHeartbeatSender: a hit
+                        # DROPS that pod-level beat, so the front door
+                        # sees pod staleness / death while the pod keeps
+                        # serving — the federation mirror of
+                        # replica.heartbeat one tier up
 )
 
 ENV_SPEC = "MCIM_FAILPOINTS"

@@ -50,6 +50,15 @@ retry exactly as before on the scheduler thread; completion-time errors
 (D2H, the `engine.complete` failpoint) re-run the batch through the
 synchronous retry unit and fall through to the same bisect/quarantine/
 breaker machinery.
+
+Group lanes (graph/ DAG dispatch): `submit_group` admits traffic whose
+coalescing unit is an opaque lane key instead of a spatial bucket — for
+graphs, (dag fingerprint, true shape) — so same-program same-shape
+requests stack into one vmapped dispatch and stop jitting per request.
+Lane members are never spatially padded (stencil border extension at a
+pad seam would change values); only the batch dimension pads. Everything
+else — queue depth, QoS ladder, aged-bucket pops, retry, per-lane
+breaker, bisect/quarantine, the async engine — is the same machinery.
 """
 
 from __future__ import annotations
@@ -121,17 +130,49 @@ class Quarantined(ServeError):
 
 
 @dataclasses.dataclass
+class GroupSpec:
+    """A coalescing lane for non-chain traffic (graph/ DAG dispatch).
+
+    The lane key replaces the spatial bucket as the coalescing unit: a
+    producer keys it on everything that must match for two requests to
+    share one compiled dispatch — for graphs that is (dag fingerprint,
+    TRUE shape), so members are value-identical under batching and there
+    is never any spatial padding (stencil border extension at a pad seam
+    would change values; only the batch dimension pads, repeat-last,
+    dropped on the completion slice).
+
+      key       opaque hashable lane id; also the breaker key, so a
+                poisoned lane degrades without touching chain buckets
+      get_fn    nb -> callable(imgs[nb, ...]) returning a result pytree
+                (called on the dispatch thread; expected to hit the
+                producer's own compile cache)
+      fallback  img -> result pytree — the golden per-request path this
+                lane degrades to while its breaker is open (bit-exact
+                with the batched path by construction)
+    """
+
+    key: tuple
+    get_fn: object
+    fallback: object = None
+
+
+@dataclasses.dataclass
 class Request:
     img: np.ndarray
     true_h: int
     true_w: int
-    bucket: tuple[int, int, int]  # (bucket_h, bucket_w, channels)
+    # (bucket_h, bucket_w, channels) for chain traffic; an opaque
+    # GroupSpec.key for group-lane traffic (graph/ DAG dispatch)
+    bucket: tuple
     t_submit: float
     deadline: float | None  # absolute monotonic seconds, or None
     done: threading.Event = dataclasses.field(default_factory=threading.Event)
     status: str = STATUS_OK
-    result: np.ndarray | None = None
+    # chain responses are cropped u8 arrays; group-lane responses are the
+    # producer's result pytree sliced per member
+    result: object = None
     error: str | None = None
+    group: GroupSpec | None = None
     t_dispatch: float | None = None
     t_done: float | None = None
     # -- observability (obs/trace.py): the request's root span + id -------
@@ -205,9 +246,9 @@ class MicroBatchScheduler:
         self._io_threads = max(1, io_threads)
         self.engine: Engine | None = None
         self._cond = threading.Condition()
-        # bucket key -> FIFO of Requests; OrderedDict so the aged-bucket
-        # scan is deterministic under equal deadlines
-        self._pending: OrderedDict[tuple[int, int, int], deque] = OrderedDict()
+        # bucket/lane key -> FIFO of Requests; OrderedDict so the
+        # aged-bucket scan is deterministic under equal deadlines
+        self._pending: OrderedDict[tuple, deque] = OrderedDict()
         self._queued = 0
         self._running = False
         self._thread: threading.Thread | None = None
@@ -310,6 +351,51 @@ class MicroBatchScheduler:
         )
         req.bucket = (bh, bw, ch)
         enq.set(bucket=f"{bh}x{bw}x{ch}")
+        return self._admit_queued(req, qos, enq)
+
+    def submit_group(
+        self,
+        img: np.ndarray,
+        group: GroupSpec,
+        *,
+        deadline_ms: float | None = None,
+        trace_id: str | None = None,
+        qos: str = "interactive",
+    ) -> Request:
+        """Admit one ALREADY-VALIDATED image into an opaque coalescing
+        lane (graph/ DAG dispatch — the producer has run its own
+        validation and tenant admission before calling this). Shares the
+        chain path's queue depth, QoS ladder, dispatch loop, retry/
+        breaker/bisect machinery and engine; differs only in the
+        coalescing key (the GroupSpec's lane id instead of a spatial
+        bucket) and in `.wait()` yielding the lane's result pytree
+        sliced per member instead of a cropped array."""
+        now = self._clock()
+        self.metrics.on_submit()
+        img = np.asarray(img)
+        req = Request(
+            img=img,
+            true_h=img.shape[0] if img.ndim >= 2 else 0,
+            true_w=img.shape[1] if img.ndim >= 2 else 0,
+            bucket=group.key,
+            t_submit=now,
+            deadline=(
+                now + deadline_ms / 1e3 if deadline_ms is not None else None
+            ),
+            group=group,
+        )
+        root = obs_trace.start_trace(
+            "serve.request", trace_id=trace_id, h=req.true_h, w=req.true_w
+        )
+        req.trace = root
+        req.trace_id = root.trace_id
+        enq = obs_trace.span("serve.enqueue", parent=root.context())
+        enq.set(bucket=str(group.key))
+        return self._admit_queued(req, qos, enq)
+
+    def _admit_queued(self, req: Request, qos: str, enq) -> Request:
+        """Shared admission tail (chain + group lanes): depth check under
+        the lock, enqueue + notify, open the coalesce span."""
         limit = self._qos_depth(qos)
         with self._cond:
             if not self._running:
@@ -337,7 +423,7 @@ class MicroBatchScheduler:
         # the scheduler thread when the batch pops — its duration IS the
         # micro-batching queue wait on the timeline
         req.coalesce_span = obs_trace.span(
-            "serve.coalesce", parent=root.context()
+            "serve.coalesce", parent=req.trace.context()
         )
         return req
 
@@ -601,8 +687,19 @@ class MicroBatchScheduler:
 
     def _prepare_batch(self, live: list[Request]):
         """(fn, host inputs, batch bucket) for one dispatch attempt."""
-        bh, bw, ch = live[0].bucket
         nb = bucketing.pick_batch_bucket(len(live), self.cache.batch_buckets)
+        group = live[0].group
+        if group is not None:
+            # group lane: the key IS the true shape, so members stack
+            # as-is — no spatial padding (stencil border extension at a
+            # pad seam would change values); only the batch dimension
+            # pads, repeat-last, dropped on the completion slice
+            fn = group.get_fn(nb)
+            imgs = np.stack(
+                [r.img for r in live] + [live[-1].img] * (nb - len(live))
+            )
+            return fn, (imgs,), nb
+        bh, bw, ch = live[0].bucket
         fn = self.cache.get(bh, bw, ch, nb)
         imgs = bucketing.pad_stack(
             [bucketing.pad_to_bucket(r.img, bh, bw) for r in live], nb
@@ -641,7 +738,10 @@ class MicroBatchScheduler:
         breaker = self.breakers.get(live[0].bucket)
         breaker.on_success()
         self._update_health()
-        self._complete(live, np.asarray(out), nb, info.get("force_s", 0.0))
+        # group-lane results are pytrees (the engine's device_get already
+        # forced them leaf-wise); chain results normalise to one ndarray
+        host = out if live[0].group is not None else np.asarray(out)
+        self._complete(live, host, nb, info.get("force_s", 0.0))
 
     def _on_engine_error(self, key, exc) -> None:
         """Completion-stage failure (D2H / engine.complete failpoint): the
@@ -683,12 +783,12 @@ class MicroBatchScheduler:
             n=len(live),
         ):
             failpoints.maybe_fail("serve.dispatch", requests=live)
-            fn, (imgs, th, tw), nb = self._prepare_batch(live)
+            fn, inputs, nb = self._prepare_batch(live)
             now = self._clock()
             for r in live:
                 r.t_dispatch = now
             t0 = self._clock()
-            out = np.asarray(fn(imgs, th, tw))  # forces completion + transfer
+            out = _force_host(fn(*inputs))  # forces completion + transfer
             # completion-stage failpoint fires on the sync path too, so an
             # `always`-armed site drives the full quarantine pipeline
             failpoints.maybe_fail("engine.complete", requests=live)
@@ -697,17 +797,25 @@ class MicroBatchScheduler:
     def _complete(self, live, out, nb, device_s) -> None:
         batch_tid = next((r.trace_id for r in live if r.trace_id), "")
         self.metrics.on_dispatch(len(live), nb, device_s, batch_tid)
+        group = live[0].group
         # flight recorder: per-dispatch bucket summaries are the "which
         # bucket was hot" evidence a post-mortem dump aggregates
         flight_recorder.note(
             "dispatch",
-            bucket="{}x{}x{}".format(*live[0].bucket),
+            bucket=(
+                str(live[0].bucket) if group is not None
+                else "{}x{}x{}".format(*live[0].bucket)
+            ),
             n=len(live),
             device_ms=device_s * 1e3,
         )
         t_done = self._clock()
         for k, r in enumerate(live):
-            r.result = out[k, : r.true_h, : r.true_w, ...]
+            if group is not None:
+                # lane members ran at their true shape: slice, don't crop
+                r.result = _tree_index(out, k)
+            else:
+                r.result = out[k, : r.true_h, : r.true_w, ...]
             r.t_done = t_done
             r.status = STATUS_OK
             self.metrics.on_complete(
@@ -776,8 +884,12 @@ class MicroBatchScheduler:
 
     def _dispatch_degraded(self, live: list[Request]) -> None:
         """Open-breaker path: serve each request through the golden
-        per-request fallback (bit-identical output, no micro-batching)."""
-        if self.fallback is None:
+        per-request fallback (bit-identical output, no micro-batching).
+        Group lanes bring their own fallback (the producer's solo
+        dispatch); chain buckets use the scheduler-wide one."""
+        group = live[0].group
+        fallback = group.fallback if group is not None else self.fallback
+        if fallback is None:
             self.metrics.on_error(len(live))
             for r in live:
                 self._resolve(
@@ -788,7 +900,7 @@ class MicroBatchScheduler:
         for r in live:
             r.t_dispatch = self._clock()
             try:
-                out = np.asarray(self.fallback(r.img))
+                out = _force_host(fallback(r.img))
             except Exception as e:
                 self.metrics.on_quarantine()
                 self._resolve(
@@ -824,3 +936,23 @@ def _min_dim(cache: CompileCache) -> int:
     from mpi_cuda_imagemanipulation_tpu.serve.padded import min_true_dim
 
     return min_true_dim(cache.pipe)
+
+
+def _force_host(out):
+    """Force a device result to host, structure-preserving: chain
+    dispatches return one stacked array, group lanes a result pytree."""
+    if isinstance(out, dict):
+        return {k: _force_host(v) for k, v in out.items()}
+    if isinstance(out, (list, tuple)):
+        return type(out)(_force_host(v) for v in out)
+    return np.asarray(out)
+
+
+def _tree_index(out, k: int):
+    """Slice member k out of a stacked result pytree (group lanes):
+    every leaf loses its batch dimension, the structure is preserved."""
+    if isinstance(out, dict):
+        return {key: _tree_index(v, k) for key, v in out.items()}
+    if isinstance(out, (list, tuple)):
+        return type(out)(_tree_index(v, k) for v in out)
+    return np.asarray(out)[k]

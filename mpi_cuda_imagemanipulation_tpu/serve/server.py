@@ -296,6 +296,10 @@ class ServeApp:
                 backend = self.config.backend
                 if backend not in ("xla", "mxu", "auto"):
                     backend = "xla"  # graph stages run the plan executors
+                from mpi_cuda_imagemanipulation_tpu.utils import (
+                    env as env_registry,
+                )
+
                 self._graph_service = GraphService(
                     registry=self.registry,
                     backend=backend,
@@ -306,6 +310,16 @@ class ServeApp:
                     # scheduler's queue fill — one load signal for both
                     # traffic classes
                     load_frac=self.scheduler.queue_fill_frac,
+                    # admitted graph dispatches coalesce through the
+                    # chain scheduler's group lanes keyed (dag
+                    # fingerprint, true shape) — one vmapped executable
+                    # per (pipeline, batch bucket) instead of one jit
+                    # per request; =0 keeps the per-request path
+                    coalescer=(
+                        self.scheduler
+                        if env_registry.get_bool("MCIM_GRAPH_COALESCE")
+                        else None
+                    ),
                 )
             return self._graph_service
 
@@ -717,6 +731,12 @@ def _make_handler(app: ServeApp):
             )
             tid = root.trace_id
             trace_hdr = [("X-Trace-Id", tid)] if tid else []
+            # federation identity thread: a front door stamped which pod
+            # this forward rode through (relayed by the pod router);
+            # echo it so the client-visible response names the pod
+            fed_pod = self.headers.get("X-Fed-Pod")
+            if fed_pod:
+                trace_hdr = trace_hdr + [("X-Fed-Pod", fed_pod)]
             try:
                 try:
                     img = decode_image_bytes(data)
@@ -1070,6 +1090,10 @@ def _make_handler(app: ServeApp):
             trace_hdr = (
                 [("X-Trace-Id", req.trace_id)] if req.trace_id else []
             )
+            fed_pod = self.headers.get("X-Fed-Pod")
+            if fed_pod:
+                # echo the federation pod stamp (see _handle_graph_process)
+                trace_hdr = trace_hdr + [("X-Fed-Pod", fed_pod)]
             if req.status == "ok":
                 png = encode_image_bytes(req.result)
                 self.send_response(200)

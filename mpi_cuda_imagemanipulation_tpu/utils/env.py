@@ -364,6 +364,29 @@ _VARS = (
     EnvVar("MCIM_SYSTOLIC_AB_JSON", None, "tools/systolic_smoke.py",
            "CI: write the systolic_ab lane record to this path "
            "(uploaded as an artifact)."),
+    # -- multi-pod federation (federation/) ----------------------------------
+    EnvVar("MCIM_FED_HEARTBEAT_S", "1.0", "federation/control.py",
+           "Pod -> front-door heartbeat interval (the pod router pushes "
+           "aggregate PodHeartbeats; liveness at the federation tier is "
+           "the absence of beats)."),
+    EnvVar("MCIM_FED_STALE_S", "4.0", "federation/frontdoor.py",
+           "Beat absence past which the front door treats a pod as dead "
+           "and reroutes only that pod's affinity slice."),
+    EnvVar("MCIM_FED_REGISTRY", ".mcim_fed_registry.jsonl",
+           "federation/frontdoor.py",
+           "Path of the front door's durable tenant/spec/session "
+           "registry (fsync'd JSONL; rehydrated on restart so clients "
+           "never re-register)."),
+    EnvVar("MCIM_FED_FORWARD_TIMEOUT_S", "30.0", "federation/frontdoor.py",
+           "Per-attempt front-door -> pod proxy timeout."),
+    EnvVar("MCIM_FED_FORWARD_ATTEMPTS", "3", "federation/frontdoor.py",
+           "Pod candidates tried per request before 503 (pod-level "
+           "admission sheds are FINAL and never retried — the "
+           "lease-not-budget-times-pods invariant)."),
+    EnvVar("MCIM_GRAPH_COALESCE", "1", "serve/server.py",
+           "=0 disables graph micro-batch coalescing (per-request "
+           "dispatch through the scheduler's (dag_fingerprint, bucket) "
+           "queue; batched executables are vmapped and bit-exact)."),
     # -- bench driver (bench.py, repo root) ----------------------------------
     EnvVar("MCIM_NO_HISTORY", None, "bench.py",
            "Any non-empty value: do not append promoted records to "

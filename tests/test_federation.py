@@ -1,0 +1,317 @@
+"""Federation tier (federation/) — PR 17 acceptance suite.
+
+The load-bearing invariants:
+  1. the durable registry survives a front-door restart: tenants,
+     specs and session bindings round-trip through the fsync'd JSONL
+     journal, a torn tail loses only itself, re-push is idempotent;
+  2. quota leases never multiply the budget by pod count: granted
+     shares sum to <= the tenant's per-window budget across any
+     sequence of joins, reconnects and pod deaths within a window;
+  3. the reroute vocabulary is closed: count_reroute refuses reasons
+     outside REROUTE_REASONS at count time;
+  4. the pod-heartbeat wire format is strict: unknown or missing
+     fields refuse loudly (a silently-tolerant control plane drifts).
+
+Plus the PR's satellite: graph dispatch rides the serving scheduler's
+group lanes — same-program same-shape requests coalesce into one
+vmapped dispatch, bit-exact with the solo path.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from mpi_cuda_imagemanipulation_tpu.federation.control import PodHeartbeat
+from mpi_cuda_imagemanipulation_tpu.federation.frontdoor import (
+    REROUTE_REASONS,
+    count_reroute,
+)
+from mpi_cuda_imagemanipulation_tpu.federation.quota import LeaseLedger
+from mpi_cuda_imagemanipulation_tpu.federation.registry import (
+    KINDS,
+    DurableRegistry,
+)
+
+# --------------------------------------------------------------------------
+# durable registry: restart round-trip, torn tail, idempotent re-push
+# --------------------------------------------------------------------------
+
+
+def test_registry_restart_round_trip(tmp_path):
+    path = tmp_path / "fed.jsonl"
+    reg = DurableRegistry(path).load()
+    reg.put("tenant", "acme", {"tenant": "acme", "quota_requests": 10})
+    reg.put("pipeline", "acme/dag-1", {"tenant": "acme", "spec": {"v": 1}})
+    reg.put("session", "s-1", {"pod": "pod-a", "ops": "grayscale"})
+    # a fresh instance on the same path is the restart
+    reg2 = DurableRegistry(path).load()
+    assert reg2.loaded_records == 3
+    assert reg2.skipped_lines == 0
+    assert reg2.get("tenant", "acme")["quota_requests"] == 10
+    assert reg2.get("pipeline", "acme/dag-1")["spec"] == {"v": 1}
+    assert reg2.get("session", "s-1")["pod"] == "pod-a"
+    assert reg2.counts() == {"tenant": 1, "pipeline": 1, "session": 1}
+
+
+def test_registry_later_lines_win_and_tombstones(tmp_path):
+    path = tmp_path / "fed.jsonl"
+    reg = DurableRegistry(path).load()
+    reg.put("tenant", "acme", {"tenant": "acme", "quota_requests": 10})
+    reg.put("tenant", "acme", {"tenant": "acme", "quota_requests": 99})
+    reg.put("session", "s-1", {"pod": "pod-a"})
+    reg.delete("session", "s-1")
+    reg2 = DurableRegistry(path).load()
+    assert reg2.get("tenant", "acme")["quota_requests"] == 99
+    assert reg2.get("session", "s-1") is None
+    assert reg2.counts()["session"] == 0
+
+
+def test_registry_corrupt_tail_truncation_recovery(tmp_path):
+    path = tmp_path / "fed.jsonl"
+    reg = DurableRegistry(path).load()
+    reg.put("tenant", "acme", {"tenant": "acme"})
+    # a mid-write kill: torn trailing line with no newline
+    with open(path, "a", encoding="utf-8") as f:
+        f.write('{"kind": "tenant", "key": "half')
+    reg2 = DurableRegistry(path).load()
+    assert reg2.loaded_records == 1
+    assert reg2.skipped_lines == 1  # the torn line lost only itself
+    assert reg2.get("tenant", "acme") == {"tenant": "acme"}
+    # the next append terminates the torn line; both records replay
+    reg2.put("tenant", "bravo", {"tenant": "bravo"})
+    reg3 = DurableRegistry(path).load()
+    assert reg3.get("tenant", "acme") is not None
+    assert reg3.get("tenant", "bravo") is not None
+    assert reg3.skipped_lines == 1
+
+
+def test_registry_corrupt_interior_line_skipped(tmp_path):
+    path = tmp_path / "fed.jsonl"
+    with open(path, "w", encoding="utf-8") as f:
+        f.write('{"kind": "tenant", "key": "a", "payload": {"x": 1}}\n')
+        f.write("not json at all\n")
+        f.write('{"kind": "bogus-kind", "key": "b", "payload": {}}\n')
+        f.write('{"kind": "tenant", "key": "c", "payload": {"x": 3}}\n')
+    reg = DurableRegistry(path).load()
+    assert reg.loaded_records == 2
+    assert reg.skipped_lines == 2
+    assert reg.get("tenant", "a") == {"x": 1}
+    assert reg.get("tenant", "c") == {"x": 3}
+
+
+def test_registry_idempotent_repush_and_kind_guard(tmp_path):
+    path = tmp_path / "fed.jsonl"
+    reg = DurableRegistry(path).load()
+    rec = {"tenant": "acme", "spec": {"v": 1}}
+    reg.put("pipeline", "acme/p", rec)
+    reg.put("pipeline", "acme/p", rec)  # re-push: harmless
+    reg2 = DurableRegistry(path).load()
+    assert reg2.items("pipeline") == {"acme/p": rec}
+    with pytest.raises(ValueError):
+        reg.put("nonsense", "k", {})
+    assert set(KINDS) == {"tenant", "pipeline", "session"}
+
+
+# --------------------------------------------------------------------------
+# quota leases: shares sum <= budget, always
+# --------------------------------------------------------------------------
+
+CFG = {"quota_requests": 10, "quota_bytes": None, "window_s": 100.0}
+
+
+def _ledger(t=0.0):
+    holder = {"t": t}
+    return LeaseLedger(clock=lambda: holder["t"]), holder
+
+
+def test_lease_single_pod_gets_whole_budget():
+    led, _ = _ledger()
+    share = led.lease("acme", CFG, "pod-a", ["pod-a"], now=5.0)
+    assert share["quota_requests"] == 10
+    assert share["quota_bytes"] is None  # unlimited stays unlimited
+
+
+def test_lease_shares_sum_to_budget_across_joins():
+    led, _ = _ledger()
+    s1 = led.lease("acme", CFG, "pod-a", ["pod-a", "pod-b"], now=5.0)
+    s2 = led.lease("acme", CFG, "pod-b", ["pod-a", "pod-b"], now=6.0)
+    total = s1["quota_requests"] + s2["quota_requests"]
+    assert s1["quota_requests"] == 5
+    assert total <= 10
+    # a third pod joining mid-window splits only the ungranted remainder
+    s3 = led.lease("acme", CFG, "pod-c", ["pod-a", "pod-b", "pod-c"], now=7.0)
+    assert (
+        s1["quota_requests"] + s2["quota_requests"] + s3["quota_requests"]
+        <= 10
+    )
+
+
+def test_lease_reconnect_is_idempotent():
+    led, _ = _ledger()
+    s1 = led.lease("acme", CFG, "pod-a", ["pod-a"], now=5.0)
+    issued = led.grants_issued
+    s2 = led.lease("acme", CFG, "pod-a", ["pod-a"], now=50.0)  # same window
+    assert s2 == s1
+    assert led.grants_issued == issued  # honored, not re-split
+
+
+def test_lease_dead_pod_grant_stays_booked_until_window_rolls():
+    led, _ = _ledger()
+    s1 = led.lease("acme", CFG, "pod-a", ["pod-a", "pod-b"], now=5.0)
+    led.lease("acme", CFG, "pod-b", ["pod-a", "pod-b"], now=5.0)
+    # pod-a dies; pod-c joins the same window: only the ungranted
+    # remainder (zero) is available — conservative, never double-granted
+    s3 = led.lease("acme", CFG, "pod-c", ["pod-b", "pod-c"], now=50.0)
+    assert s3["quota_requests"] == 0
+    # the next window forgets the dead pod and re-splits fresh
+    s4 = led.lease("acme", CFG, "pod-c", ["pod-b", "pod-c"], now=150.0)
+    assert s4["quota_requests"] == 5
+    assert s4["window_id"] != s1["window_id"]
+
+
+def test_lease_no_budget_multiplication_by_pod_count():
+    """The acceptance invariant: P pods never hold more than ONE global
+    budget between them, for any P."""
+    for n_pods in (1, 2, 3, 7):
+        led, _ = _ledger()
+        pods = [f"pod-{i}" for i in range(n_pods)]
+        shares = [
+            led.lease("acme", CFG, p, pods, now=5.0)["quota_requests"]
+            for p in pods
+        ]
+        assert sum(shares) <= 10, (n_pods, shares)
+
+
+def test_leases_for_pod_skips_quota_less_tenants():
+    led, holder = _ledger(t=5.0)
+    tenants = {
+        "acme": CFG,
+        "free": {"quota_requests": None, "quota_bytes": None},
+    }
+    out = led.leases_for_pod("pod-a", tenants, ["pod-a"])
+    assert set(out) == {"acme"}
+    assert out["acme"]["quota_requests"] == 10
+
+
+# --------------------------------------------------------------------------
+# closed reroute vocabulary + strict heartbeat wire format
+# --------------------------------------------------------------------------
+
+
+class _Counter:
+    def __init__(self):
+        self.by_reason = {}
+
+    def inc(self, n=1, **labels):
+        self.by_reason[labels["reason"]] = (
+            self.by_reason.get(labels["reason"], 0) + n
+        )
+
+
+def test_count_reroute_rejects_unknown_reason():
+    c = _Counter()
+    for reason in REROUTE_REASONS:
+        count_reroute(c, reason)
+    assert set(c.by_reason) == set(REROUTE_REASONS)
+    with pytest.raises(ValueError):
+        count_reroute(c, "cosmic-rays")
+
+
+def test_pod_heartbeat_wire_is_strict():
+    hb = PodHeartbeat(
+        pod_id="pod-a", addr="127.0.0.1", port=8090, pid=42,
+        incarnation="abc", routable=3, queued=1, queue_depth=64,
+        warm_buckets=["48x48x3"], pipelines=["dag-1"], seq=7,
+        sent_unix_s=123.0,
+    )
+    wire = json.loads(hb.to_json())
+    back = PodHeartbeat.from_json(hb.to_json())
+    assert back.pod_id == "pod-a" and back.seq == 7
+    with pytest.raises(ValueError):
+        PodHeartbeat.from_json(json.dumps({**wire, "surprise": 1}).encode())
+    missing = dict(wire)
+    del missing["incarnation"]
+    with pytest.raises(ValueError):
+        PodHeartbeat.from_json(json.dumps(missing).encode())
+
+
+# --------------------------------------------------------------------------
+# satellite: graph dispatch coalesces through the scheduler's group lanes
+# --------------------------------------------------------------------------
+
+
+def test_graph_dispatch_coalesces_bit_exact():
+    from mpi_cuda_imagemanipulation_tpu.graph.service import GraphService
+    from mpi_cuda_imagemanipulation_tpu.graph.spec import chain_as_spec
+    from mpi_cuda_imagemanipulation_tpu.io.image import synthetic_image
+    from mpi_cuda_imagemanipulation_tpu.serve.server import (
+        ServeApp,
+        ServeConfig,
+    )
+
+    ops = "grayscale,contrast:3.5"
+    app = ServeApp(
+        ServeConfig(
+            ops=ops, buckets=((48, 48),), channels=(3,), max_batch=4,
+            max_delay_ms=20.0,
+        )
+    ).start()
+    try:
+        svc = app.graph_service
+        assert svc.coalescer is app.scheduler  # MCIM_GRAPH_COALESCE=1
+        svc.configure_tenant({"tenant": "acme", "qos": "interactive"})
+        pid = svc.register("acme", chain_as_spec(ops))["pipeline"]
+        img = synthetic_image(33, 40, channels=3, seed=5)
+        solo = GraphService(backend="xla", plan="auto")
+        solo.register("acme", chain_as_spec(ops))
+        golden = solo.process("acme", pid, img)
+
+        results = [None] * 4
+        def run(i):
+            results[i] = svc.process("acme", pid, img)
+        ts = [
+            threading.Thread(target=run, args=(i,)) for i in range(4)
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        for r in results:
+            np.testing.assert_array_equal(r["image"], golden["image"])
+        assert svc._m_coalesced.value(outcome="batched") == 4
+        # one vmapped executable per (pipeline, batch bucket), not one
+        # jit per request: the lane cache key carries the batch size
+        st = svc.tenants.get("acme")
+        assert any("@b" in k for k in st.cache), list(st.cache)
+    finally:
+        app.stop(drain=False)
+
+
+def test_group_lane_fallback_answers_on_lane_refusal():
+    """Coalescing is a pure optimisation: a request the lane cannot
+    serve (scheduler stopped) still gets its answer via the solo golden
+    path, counted as a fallback."""
+    from mpi_cuda_imagemanipulation_tpu.graph.spec import chain_as_spec
+    from mpi_cuda_imagemanipulation_tpu.io.image import synthetic_image
+    from mpi_cuda_imagemanipulation_tpu.serve.server import (
+        ServeApp,
+        ServeConfig,
+    )
+
+    ops = "grayscale,contrast:3.5"
+    app = ServeApp(
+        ServeConfig(ops=ops, buckets=((48, 48),), channels=(3,))
+    ).start()
+    try:
+        svc = app.graph_service
+        svc.configure_tenant({"tenant": "acme", "qos": "interactive"})
+        pid = svc.register("acme", chain_as_spec(ops))["pipeline"]
+        img = synthetic_image(33, 40, channels=3, seed=5)
+        app.scheduler.stop(drain=False)  # the lane refuses from now on
+        out = svc.process("acme", pid, img)
+        assert out["image"].shape == (33, 40)  # grayscale drops channels
+        assert svc._m_coalesced.value(outcome="fallback") == 1
+    finally:
+        app.stop(drain=False)

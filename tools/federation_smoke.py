@@ -1,0 +1,460 @@
+#!/usr/bin/env python
+"""Federation CI smoke: an in-process front door over TWO real pods
+(each a `fabric` CLI subprocess with its own router + 2 replica
+processes), whole-pod SIGKILL, a front-door restart, and global quota
+leases.
+
+    python tools/federation_smoke.py METRICS_OUT
+
+Asserts, end to end over real HTTP:
+
+  1. both pods join by pushing pod heartbeats (`fabric --federate URL
+     --pod-id NAME`) and a DAG spec registered ONCE at the front door
+     serves BIT-EXACT from both pods — through the front door and
+     straight at each pod's router — with zero per-pod registration;
+  2. a quota tenant driving BOTH pods at once never exceeds its GLOBAL
+     fixed-window budget: the front door leases each pod an integral
+     share (federation/quota.py), shares sum to the budget, and the
+     over-lease requests shed with 503 + Retry-After (FINAL, so a shed
+     is never retried into a second pod's share);
+  3. SIGKILLing a WHOLE pod (supervisor + both replicas) mid-traffic
+     loses nothing: every request completes 200 bit-exact on the
+     survivor, and the reroutes are counted in
+     mcim_fed_reroutes_total under closed-vocabulary reasons —
+     `pod_down` once the dead pod's heartbeat silence crosses the
+     staleness window;
+  4. the front door's /metrics parses as Prometheus exposition with
+     the mcim_fed_* families populated (written to METRICS_OUT);
+  5. a front-door RESTART on the same registry path rehydrates every
+     tenant + spec from the fsync'd journal — zero client
+     re-registration — the surviving pod rejoins by its next beat, and
+     the cold front door re-pushes tenant state before its first
+     forward (mcim_fed_pushes_total), serving the same spec bit-exact.
+
+METRICS_OUT gets the pre-restart front-door exposition text (uploaded
+as a CI artifact, .github/workflows/tier1.yml federation step).
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# pods inherit this: fast beats keep the smoke's staleness waits short
+os.environ["MCIM_FED_HEARTBEAT_S"] = "0.25"
+
+import numpy as np  # noqa: E402
+
+from mpi_cuda_imagemanipulation_tpu.federation.frontdoor import (  # noqa: E402
+    REROUTE_REASONS,
+    FrontDoor,
+    FrontDoorConfig,
+)
+from mpi_cuda_imagemanipulation_tpu.graph import (  # noqa: E402
+    compile_graph,
+    graph_callable,
+    parse_spec,
+)
+from mpi_cuda_imagemanipulation_tpu.io.image import (  # noqa: E402
+    decode_image_bytes,
+    encode_image_bytes,
+    synthetic_image,
+)
+from mpi_cuda_imagemanipulation_tpu.obs.metrics import (  # noqa: E402
+    parse_exposition,
+)
+from mpi_cuda_imagemanipulation_tpu.serve.bucketing import (  # noqa: E402
+    parse_buckets,
+)
+
+OPS = "grayscale,contrast:3.5"
+BUCKETS = "48,96"
+STALE_S = 1.2  # front-door staleness window (~5 pod beats)
+
+SPEC = {
+    "version": 1,
+    "name": "unsharp",
+    "nodes": [
+        {"id": "src", "kind": "source"},
+        {"id": "g", "kind": "op", "op": "grayscale", "input": "src"},
+        {"id": "blur", "kind": "op", "op": "gaussian:5", "input": "g"},
+        {"id": "mask", "kind": "merge", "merge": "subtract",
+         "inputs": ["g", "blur"]},
+    ],
+    "outputs": {"image": "mask"},
+}
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class _Pod:
+    """One whole pod — router + supervisor + 2 replicas — as a single
+    `fabric` CLI subprocess joined to the front door by `--federate`.
+    Out-of-process on purpose: `sigkill()` takes down the supervisor
+    AND the replicas it spawned, the failure shape the federation tier
+    exists to absorb (a pod-local replica death is the pod router's
+    journal-tail problem and never reaches the front door)."""
+
+    def __init__(self, pod_id: str, frontdoor_url: str):
+        self.pod_id = pod_id
+        self.port = _free_port()
+        self.url = f"http://127.0.0.1:{self.port}"
+        self.proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "mpi_cuda_imagemanipulation_tpu",
+                "fabric",
+                "--replicas", "2",
+                "--ops", OPS,
+                "--buckets", BUCKETS,
+                "--channels", "3",
+                "--max-batch", "4",
+                "--queue-depth", "64",
+                "--host", "127.0.0.1",
+                "--port", str(self.port),
+                "--heartbeat-s", "0.2",
+                "--stale-s", "0.8",
+                "--federate", frontdoor_url,
+                "--pod-id", pod_id,
+            ],
+        )
+
+    def replica_pids(self) -> list[int]:
+        with urllib.request.urlopen(self.url + "/stats", timeout=10) as r:
+            st = json.loads(r.read())
+        return [rep["pid"] for rep in st["replicas"].values()]
+
+    def sigkill(self) -> None:
+        """The whole pod, hard: replicas first (their pids come from the
+        router's own stats, grabbed while it still answers), then the
+        supervisor — nothing drains, nothing hands over."""
+        pids = []
+        try:
+            pids = self.replica_pids()
+        except Exception:
+            pass
+        if self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGKILL)
+        for pid in pids:
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+        self.proc.wait(timeout=10.0)
+
+    def close(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGTERM)
+            try:
+                self.proc.wait(timeout=60.0)
+            except Exception:
+                self.proc.kill()
+                self.proc.wait(timeout=10.0)
+
+
+def _post(url: str, path: str, data: bytes, headers=None):
+    req = urllib.request.Request(
+        url + path, data=data, headers=headers or {}, method="POST"
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return r.status, dict(r.headers), r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+def _post_retry(url, path, data, headers=None, deadline_s=60.0):
+    """Retry explicit sheds (503 + Retry-After) — a pod converging or a
+    breaker probing is not a failure; anything else unexpected IS."""
+    t_end = time.monotonic() + deadline_s
+    while True:
+        code, hdrs, body = _post(url, path, data, headers)
+        if code != 503 or not hdrs.get("Retry-After"):
+            return code, hdrs, body
+        assert time.monotonic() < t_end, "requests never converged past sheds"
+        time.sleep(0.2)
+
+
+def _door_metrics(url: str) -> str:
+    with urllib.request.urlopen(url + "/metrics", timeout=10) as r:
+        return r.read().decode()
+
+
+def _door_stats(url: str) -> dict:
+    with urllib.request.urlopen(url + "/stats", timeout=10) as r:
+        return json.loads(r.read())
+
+
+def _reroute_counts(exposition: str) -> dict[str, float]:
+    fams = parse_exposition(exposition)
+    out: dict[str, float] = {}
+    fam = fams.get("mcim_fed_reroutes_total")
+    if fam:
+        for (_n, labels), v in fam["samples"].items():
+            reason = labels.split('reason="', 1)[1].split('"', 1)[0]
+            out[reason] = out.get(reason, 0.0) + v
+    return out
+
+
+def _wait_pods(url: str, want: set[str], deadline_s: float = 240.0):
+    """Until every wanted pod is fresh at the front door with its full
+    replica capacity routable."""
+    t_end = time.monotonic() + deadline_s
+    while time.monotonic() < t_end:
+        try:
+            pods = _door_stats(url)["pods"]
+        except Exception:
+            pods = {}
+        ready = {
+            pid
+            for pid, v in pods.items()
+            if v["fresh"] and v["routable"] >= 2
+        }
+        if want <= ready:
+            return
+        time.sleep(0.2)
+    raise TimeoutError(f"pods {sorted(want)} never joined (saw {pods.keys()})")
+
+
+def main(metrics_out: str) -> int:
+    tmp = tempfile.mkdtemp(prefix="federation_smoke_")
+    registry_path = os.path.join(tmp, "fed_registry.jsonl")
+    fd_cfg = FrontDoorConfig(
+        registry_path=registry_path,
+        buckets=tuple(parse_buckets(BUCKETS)),
+        stale_s=STALE_S,
+        forward_timeout_s=30.0,
+        forward_attempts=3,
+    )
+    door = FrontDoor(fd_cfg).start(host="127.0.0.1", port=0)
+    fd_port = door.address[1]
+    pods = {pid: _Pod(pid, door.url) for pid in ("pod0", "pod1")}
+    img48 = synthetic_image(40, 44, channels=3, seed=50)
+    img96 = synthetic_image(80, 72, channels=3, seed=51)
+    blob48 = encode_image_bytes(img48)
+    blob96 = encode_image_bytes(img96)
+    golden = {
+        id(blob48): np.asarray(
+            graph_callable(compile_graph(parse_spec(SPEC)))(img48)["image"]
+        ),
+        id(blob96): np.asarray(
+            graph_callable(compile_graph(parse_spec(SPEC)))(img96)["image"]
+        ),
+    }
+    try:
+        _wait_pods(door.url, {"pod0", "pod1"})
+        print("smoke: pod0 + pod1 joined by pod heartbeat, 2 replicas each")
+
+        # -- 1. one registration, served from both pods ---------------------
+        code, _h, out = _post(
+            door.url, "/v1/tenants",
+            json.dumps({"tenant": "acme", "qos": "interactive"}).encode(),
+        )
+        assert code == 200, (code, out[:200])
+        assert set(json.loads(out)["pods"]) == {"pod0", "pod1"}
+        code, _h, out = _post(
+            door.url, "/v1/pipelines",
+            json.dumps({"tenant": "acme", "spec": SPEC}).encode(),
+        )
+        assert code == 200, (code, out[:300])
+        reg = json.loads(out)
+        pid = reg["pipeline"]
+        assert reg["persisted"] and set(reg["pods"]) == {"pod0", "pod1"}, reg
+        # both pods' NEXT heartbeats must echo the pipeline id back
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            views = _door_stats(door.url)["pods"]
+            echoed = {
+                p for p, v in views.items() if pid in (v["pipelines"] or ())
+            }
+            if echoed == {"pod0", "pod1"}:
+                break
+            time.sleep(0.2)
+        assert echoed == {"pod0", "pod1"}, (
+            f"only {sorted(echoed)} echo the registered pipeline"
+        )
+        acme_h = {"X-MCIM-Tenant": "acme", "X-MCIM-Pipeline": pid}
+        served: dict[int, str] = {}
+        for blob in (blob48, blob96):
+            code, hdrs, out = _post_retry(door.url, "/v1/process", blob, acme_h)
+            assert code == 200, (code, out[:200])
+            np.testing.assert_array_equal(
+                decode_image_bytes(out), golden[id(blob)]
+            )
+            served[id(blob)] = hdrs.get("X-Fed-Pod", "")
+            assert served[id(blob)] in pods, hdrs
+        # ...and straight at each pod's own router: the broadcast (not a
+        # client retry) is what put the spec there
+        for pod in pods.values():
+            code, _h, out = _post_retry(pod.url, "/v1/process", blob48, acme_h)
+            assert code == 200, (pod.pod_id, code, out[:200])
+            np.testing.assert_array_equal(
+                decode_image_bytes(out), golden[id(blob48)]
+            )
+        print(
+            f"smoke: spec {pid} registered once serves bit-exact from "
+            f"both pods (front-door picks: {sorted(set(served.values()))})"
+        )
+
+        # -- 2. global quota budget across both pods ------------------------
+        code, _h, out = _post(
+            door.url, "/v1/tenants",
+            json.dumps({
+                "tenant": "metered", "qos": "interactive",
+                "quota_requests": 6, "window_s": 3600.0,
+            }).encode(),
+        )
+        assert code == 200, (code, out[:200])
+        code, _h, out = _post(
+            door.url, "/v1/pipelines",
+            json.dumps({"tenant": "metered", "spec": SPEC}).encode(),
+        )
+        assert code == 200, (code, out[:300])
+        leases = _door_stats(door.url)["leases"]
+        shares = [
+            g["quota_requests"]
+            for w in leases.get("windows", [])
+            if w["tenant"] == "metered"
+            for g in w["pods"].values()
+        ]
+        assert sum(s or 0 for s in shares) <= 6, (
+            f"granted shares exceed the global budget: {shares}"
+        )
+        metered_h = {"X-MCIM-Tenant": "metered", "X-MCIM-Pipeline": pid}
+        # drive BOTH pods directly — the adversarial client shape: if
+        # leases were copies instead of shares, this would admit 12
+        oks, sheds = 0, 0
+        for pod in pods.values():
+            for _ in range(6):
+                code, hdrs, _out = _post(
+                    pod.url, "/v1/process", blob48, metered_h
+                )
+                if code == 200:
+                    oks += 1
+                else:
+                    assert code == 503 and hdrs.get("Retry-After"), (
+                        pod.pod_id, code, _out[:200]
+                    )
+                    sheds += 1
+        assert 1 <= oks <= 6, (
+            f"global budget 6 violated across pods: {oks} accepted "
+            f"({sheds} shed, leases {shares})"
+        )
+        print(
+            f"smoke: metered tenant drove both pods, {oks}/12 accepted "
+            f"<= global budget 6 ({sheds} shed 503+Retry-After)"
+        )
+
+        # -- 3. whole-pod SIGKILL mid-traffic -------------------------------
+        victim = served[id(blob48)] or "pod0"
+        survivor = next(p for p in pods if p != victim)
+        pods[victim].sigkill()
+        t_end = time.monotonic() + max(4.0 * STALE_S, 6.0)
+        n_ok = 0
+        while time.monotonic() < t_end:
+            code, hdrs, out = _post(door.url, "/v1/process", blob48, acme_h)
+            assert code == 200, (
+                f"request lost during pod {victim} death: {code} "
+                f"{out[:200]!r}"
+            )
+            np.testing.assert_array_equal(
+                decode_image_bytes(out), golden[id(blob48)]
+            )
+            assert hdrs.get("X-Fed-Pod") == survivor, hdrs
+            n_ok += 1
+            time.sleep(0.1)
+        reroutes = _reroute_counts(_door_metrics(door.url))
+        assert reroutes, "no reroute was counted after whole-pod SIGKILL"
+        unknown = set(reroutes) - set(REROUTE_REASONS)
+        assert not unknown, f"reroute reasons outside the vocabulary: {unknown}"
+        assert reroutes.get("pod_down", 0) >= 1, (
+            f"pod staleness never produced a pod_down reroute ({reroutes})"
+        )
+        code, _h, out = _post_retry(door.url, "/v1/process", blob96, acme_h)
+        assert code == 200
+        np.testing.assert_array_equal(
+            decode_image_bytes(out), golden[id(blob96)]
+        )
+        hz = json.loads(
+            urllib.request.urlopen(door.url + "/healthz", timeout=10).read()
+        )
+        assert hz["pods"] == [survivor], hz
+        print(
+            f"smoke: SIGKILLed {victim} whole (supervisor + replicas); "
+            f"{n_ok} mid-death requests all 200 bit-exact on {survivor}; "
+            f"reroutes {reroutes}"
+        )
+
+        # -- 4. exposition snapshot (pre-restart, carries the reroutes) -----
+        exposition = _door_metrics(door.url)
+        fams = parse_exposition(exposition)
+        for fam in (
+            "mcim_fed_requests_total",
+            "mcim_fed_forwards_total",
+            "mcim_fed_reroutes_total",
+            "mcim_fed_heartbeats_total",
+            "mcim_fed_lease_grants_total",
+            "mcim_fed_pods",
+            "mcim_fed_tenants",
+            "mcim_fed_specs",
+        ):
+            assert fam in fams, f"{fam} missing from front-door /metrics"
+        with open(metrics_out, "w") as f:
+            f.write(exposition)
+        print(f"smoke: front-door /metrics parses -> {metrics_out}")
+
+        # -- 5. front-door restart: durable registry, zero re-registration --
+        door.close()
+        door = FrontDoor(fd_cfg).start(host="127.0.0.1", port=fd_port)
+        st = _door_stats(door.url)
+        assert "acme" in st["tenants"] and "metered" in st["tenants"], st
+        assert f"acme/{pid}" in st["specs"], st["specs"]
+        assert st["registry"]["loaded_records"] >= 4, st["registry"]
+        assert st["registry"]["skipped_lines"] == 0, st["registry"]
+        _wait_pods(door.url, {survivor}, deadline_s=30.0)
+        code, hdrs, out = _post_retry(door.url, "/v1/process", blob48, acme_h)
+        assert code == 200, (code, out[:200])
+        np.testing.assert_array_equal(
+            decode_image_bytes(out), golden[id(blob48)]
+        )
+        post = parse_exposition(_door_metrics(door.url))
+        pushes = sum(
+            v for _k, v in post["mcim_fed_pushes_total"]["samples"].items()
+        )
+        assert pushes >= 1, (
+            "cold front door never re-pushed tenant state before a forward"
+        )
+        print(
+            f"smoke: front-door restart rehydrated "
+            f"{st['registry']['loaded_records']} records from "
+            f"{os.path.basename(st['registry']['path'])}, zero client "
+            f"re-registration, {pushes:.0f} state push(es) on first forward"
+        )
+    finally:
+        door.close()
+        for pod in pods.values():
+            pod.close()
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) != 2:
+        print(__doc__)
+        sys.exit(2)
+    sys.exit(main(sys.argv[1]))

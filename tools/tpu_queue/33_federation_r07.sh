@@ -1,0 +1,46 @@
+#!/bin/bash
+# Multi-pod federation on silicon (round 7, ISSUE 17): the front door
+# over two real pods, whole-pod loss under load.
+#
+# Two records, both bit-exactness-gated before any timing:
+#
+#   federation_loadgen   the bench lane — the open-loop HTTP mix through
+#                        the federation front door over 2 pods x 3
+#                        replicas, then a WHOLE POD SIGKILLed mid-sweep
+#                        (supervisor + replicas, no restart; the pod is
+#                        gone, not degraded). Acceptance: during the pod
+#                        loss every ACCEPTED request completes 200 and
+#                        bit-exact (unavailable == 0), and the front
+#                        door books the loss only under the closed
+#                        REROUTE_REASONS vocabulary. Columns: achieved
+#                        req/s + ok%/shed%/p99 per phase. On TPU the
+#                        question is the failover cliff: how much of
+#                        2-pod achieved throughput survives on one pod,
+#                        and how long the affinity slice takes to
+#                        re-home once beats go silent.
+#   federation smoke     the full federation contract on the chip: one
+#                        registration served from both pods, the global
+#                        quota budget held while a tenant drives both
+#                        pods at once (integral leases, sheds FINAL),
+#                        whole-pod SIGKILL with zero lost accepted
+#                        requests, mcim_fed_* parsing, and a front-door
+#                        restart rehydrating the fsync'd registry with
+#                        zero client re-registration.
+#
+# Knobs: MCIM_FABRIC_RPS / _DURATION_S / _REPLICAS (the fabric lane's
+# knobs apply one tier up), MCIM_FED_HEARTBEAT_S / _STALE_S.
+# Budget: ~5-8 min.
+set -u
+cd "$(dirname "$0")/../.."
+. tools/tpu_queue/_lib.sh
+out=artifacts/federation_r07.out
+: > "$out"
+timeout 1500 python -m mpi_cuda_imagemanipulation_tpu.bench_suite \
+  --config federation_loadgen \
+  --json-metrics artifacts/federation_loadgen_r07.json >> "$out" 2>&1 || true
+timeout 900 python tools/federation_smoke.py \
+  artifacts/federation_metrics_r07.prom >> "$out" 2>&1 || true
+commit_artifacts "TPU window: multi-pod federation loadgen + smoke (round 7)" \
+  "$out" artifacts/federation_loadgen_r07.json \
+  artifacts/federation_metrics_r07.prom
+exit 0
