@@ -121,6 +121,43 @@ rule(
     "when every reroute site names its reason as a string literal.",
 )
 rule(
+    "obs-deadline-tier-unknown", "obs",
+    "count_expired()/count_budget_denied() names a tier missing from "
+    "TIERS in resilience/deadline.py (the typo'd tier would raise at "
+    "count time — on the 504-answer path that exists to refuse doomed "
+    "work cleanly).",
+)
+rule(
+    "obs-deadline-tier-unused", "obs",
+    "A deadline TIERS entry has no count_expired() caller anywhere — a "
+    "tier that claims to check deadlines but can never account an "
+    "expiry.",
+)
+rule(
+    "obs-deadline-tier-dynamic", "obs",
+    "count_expired()/count_budget_denied() called with a non-literal "
+    "tier in package code — the closed TIERS vocabulary is only "
+    "machine-checkable when every expiry site names its tier as a "
+    "string literal.",
+)
+rule(
+    "obs-hedge-outcome-unknown", "obs",
+    "count_hedge() names an outcome missing from HEDGE_OUTCOMES in "
+    "resilience/deadline.py (the typo'd outcome would raise at count "
+    "time, inside the hedged-forward race).",
+)
+rule(
+    "obs-hedge-outcome-unused", "obs",
+    "A HEDGE_OUTCOMES entry has no count_hedge() caller anywhere — a "
+    "hedge decision the accounting can never attribute.",
+)
+rule(
+    "obs-hedge-outcome-dynamic", "obs",
+    "count_hedge() called with a non-literal outcome in package code — "
+    "the closed HEDGE_OUTCOMES vocabulary is only machine-checkable "
+    "when every hedge site names its outcome as a string literal.",
+)
+rule(
     "obs-cost-attribution-missing", "obs",
     "A compile-cache insertion site (a store into a `_fns` cache dict or "
     "a cache_put() call) in package code never touches the cost-"
@@ -150,7 +187,8 @@ rule(
 
 _METRIC_RE = re.compile(
     r"^mcim_(serve|engine|cache|breaker|health|batch|analysis|fabric|stream"
-    r"|plan|fleet|slo|graph|cost|devmem|systolic|fed)_[a-z0-9_]+$"
+    r"|plan|fleet|slo|graph|cost|devmem|systolic|fed|deadline|hedge)"
+    r"_[a-z0-9_]+$"
 )
 
 
@@ -173,6 +211,7 @@ def check_obs(repo: Repo):
     findings.extend(_check_recorder_triggers(repo))
     findings.extend(_check_systolic_fallbacks(repo))
     findings.extend(_check_fed_reroutes(repo))
+    findings.extend(_check_deadline_vocab(repo))
     findings.extend(_check_graph_taxonomy(repo))
     findings.extend(_check_cost_attribution(repo))
     return findings
@@ -365,7 +404,7 @@ def _check_metrics(repo: Repo) -> list:
                     "mcim_<subsystem>_<what> scheme "
                     "(subsystems: serve/engine/cache/breaker/health/"
                     "batch/analysis/fabric/stream/plan/fleet/slo/graph/"
-                    "systolic/fed)"
+                    "systolic/fed/deadline/hedge)"
                 )
             elif kind == "counter" and not name.endswith("_total"):
                 msg = f"counter {name!r} must end in _total"
@@ -723,6 +762,131 @@ def _check_fed_reroutes(repo: Repo) -> list:
             )
         )
     return findings
+
+
+# -- deadline tiers & hedge outcomes (resilience/deadline.py) ------------------
+
+
+def _known_vocab(repo: Repo, varname: str) -> tuple[set[str], int]:
+    """A closed string-tuple vocabulary assigned at module level in
+    resilience/deadline.py (TIERS / HEDGE_OUTCOMES)."""
+    sf = repo.by_rel.get(f"{PACKAGE}/resilience/deadline.py")
+    if sf is None:
+        return set(), 0
+    for node in sf.tree.body:
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == varname:
+                    vals = {
+                        e.value
+                        for e in ast.walk(node.value)
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)
+                    }
+                    return vals, node.lineno
+    return set(), 0
+
+
+def _is_call_named(node: ast.Call, name: str) -> bool:
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr == name
+    return isinstance(fn, ast.Name) and fn.id == name
+
+
+def _check_closed_vocab_calls(
+    repo: Repo,
+    *,
+    funcs: tuple[str, ...],
+    known: set[str],
+    vocab_name: str,
+    reg_line: int,
+    rule_prefix: str,
+    require_used: tuple[str, ...],
+) -> list:
+    """Shared closed-vocabulary discipline (mirrors _check_fed_reroutes):
+    every call to any of `funcs` must pass a literal member of `known`;
+    members must additionally have a caller of the functions named in
+    `require_used` (functions outside that set — e.g. count_budget_denied
+    over TIERS — validate membership but don't establish coverage, since
+    only a subset of tiers hold a retry budget)."""
+    findings = []
+    if not known:
+        return findings
+    used: set[str] = set()
+    for sf in repo.files:
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Call) and len(node.args) >= 2):
+                continue
+            fname = next(
+                (f for f in funcs if _is_call_named(node, f)), None
+            )
+            if fname is None:
+                continue
+            a1 = node.args[1]
+            if isinstance(a1, ast.Constant) and isinstance(a1.value, str):
+                member = a1.value
+                if fname in require_used:
+                    used.add(member)
+                if member not in known and sf.rel.startswith(
+                    (PACKAGE + "/", "tools/")
+                ):
+                    # tests may pass an out-of-vocabulary member on
+                    # purpose — asserting the ValueError guard fires
+                    findings.append(
+                        make_finding(
+                            f"{rule_prefix}-unknown", sf.rel,
+                            node.lineno,
+                            f"{fname}() names {member!r}, not in "
+                            f"{vocab_name} (resilience/deadline.py)",
+                        )
+                    )
+            elif sf.rel.startswith(PACKAGE + "/"):
+                findings.append(
+                    make_finding(
+                        f"{rule_prefix}-dynamic", sf.rel,
+                        node.lineno,
+                        f"{fname}() member is not a string literal — "
+                        f"name one of {vocab_name} directly",
+                    )
+                )
+    for member in sorted(known - used):
+        findings.append(
+            make_finding(
+                f"{rule_prefix}-unused",
+                f"{PACKAGE}/resilience/deadline.py", reg_line,
+                f"{vocab_name} entry {member!r} has no "
+                f"{'/'.join(require_used)}() caller anywhere in the repo",
+            )
+        )
+    return findings
+
+
+def _check_deadline_vocab(repo: Repo) -> list:
+    """The request-lifecycle vocabularies are closed exactly like
+    federation reroute reasons: per-tier deadline expiry (TIERS, counted
+    by count_expired — count_budget_denied validates against the same
+    tuple but only budget-holding tiers call it) and hedge outcomes
+    (HEDGE_OUTCOMES, counted by count_hedge)."""
+    tiers, tiers_line = _known_vocab(repo, "TIERS")
+    outcomes, outcomes_line = _known_vocab(repo, "HEDGE_OUTCOMES")
+    return _check_closed_vocab_calls(
+        repo,
+        funcs=("count_expired", "count_budget_denied"),
+        known=tiers,
+        vocab_name="TIERS",
+        reg_line=tiers_line,
+        rule_prefix="obs-deadline-tier",
+        require_used=("count_expired",),
+    ) + _check_closed_vocab_calls(
+        repo,
+        funcs=("count_hedge",),
+        known=outcomes,
+        vocab_name="HEDGE_OUTCOMES",
+        reg_line=outcomes_line,
+        rule_prefix="obs-hedge-outcome",
+        require_used=("count_hedge",),
+    )
 
 
 # -- cost-attribution contract (obs/cost.py) ----------------------------------

@@ -42,6 +42,7 @@ testable without killing anything.
 
 from __future__ import annotations
 
+import concurrent.futures
 import dataclasses
 import http.client
 import io as _io
@@ -66,6 +67,7 @@ from mpi_cuda_imagemanipulation_tpu.obs import recorder as flight_recorder
 from mpi_cuda_imagemanipulation_tpu.obs import slo as obs_slo
 from mpi_cuda_imagemanipulation_tpu.obs import trace as obs_trace
 from mpi_cuda_imagemanipulation_tpu.obs.metrics import Registry
+from mpi_cuda_imagemanipulation_tpu.resilience import deadline as deadline_mod
 from mpi_cuda_imagemanipulation_tpu.resilience import failpoints
 from mpi_cuda_imagemanipulation_tpu.resilience.breaker import BreakerBoard
 from mpi_cuda_imagemanipulation_tpu.serve import bucketing
@@ -214,6 +216,20 @@ class RouterConfig:
     # pod-level systolic execution (graph/systolic.py): stage-shard
     # eligible graph programs across systolic-advertising replicas
     systolic: bool = False
+    # -- request lifecycle (resilience/deadline.py) ------------------------
+    # retry-budget token bucket: deposit `frac` per accepted request,
+    # withdraw 1 per retry/hedge; `reserve` covers cold-start failover.
+    # None fields fall back to MCIM_RETRY_BUDGET_FRAC / _RESERVE
+    retry_budget_frac: float | None = None
+    retry_budget_reserve: float | None = None
+    # hedged requests on the idempotent chain lane: a first attempt
+    # still pending past hedge_delay_frac x (federated e2e p99) gets ONE
+    # secondary forward to a different replica, first response wins;
+    # hedges withdraw from the retry budget and are capped at
+    # hedge_max_frac of accepted requests. delay frac 0 disables. None
+    # fields fall back to MCIM_HEDGE_DELAY_FRAC / MCIM_HEDGE_MAX_FRAC
+    hedge_delay_frac: float | None = None
+    hedge_max_frac: float | None = None
 
 
 class Router:
@@ -332,6 +348,35 @@ class Router:
         self._fed_source = None
         self._pool = _ConnPool(self.forward_timeout_s)
         self._clock = clock
+        # request lifecycle (resilience/deadline.py): this tier's retry
+        # budget + hedging knobs. The hedge worker pool is lazy — only
+        # a router that actually hedges pays the threads.
+        self.retry_budget = deadline_mod.RetryBudget(
+            frac=(
+                float(env_registry.get(deadline_mod.ENV_BUDGET_FRAC))
+                if config.retry_budget_frac is None
+                else config.retry_budget_frac
+            ),
+            reserve=(
+                float(env_registry.get(deadline_mod.ENV_BUDGET_RESERVE))
+                if config.retry_budget_reserve is None
+                else config.retry_budget_reserve
+            ),
+        )
+        self.hedge_delay_frac = (
+            float(env_registry.get(deadline_mod.ENV_HEDGE_DELAY_FRAC))
+            if config.hedge_delay_frac is None
+            else config.hedge_delay_frac
+        )
+        self.hedge_max_frac = (
+            float(env_registry.get(deadline_mod.ENV_HEDGE_MAX_FRAC))
+            if config.hedge_max_frac is None
+            else config.hedge_max_frac
+        )
+        self._hedge_lock = threading.Lock()
+        self._hedge_pool = None
+        self._hedges_fired = 0
+        self._hedge_delay_cache: tuple[float, float | None] = (-1e18, None)
         self.registry = registry or Registry()
         # metrics federation (obs/fleet.py): per-replica registries fold
         # into this view via heartbeat deltas; staleness shares the
@@ -396,6 +441,10 @@ class Router:
             "mcim_fabric_forward_seconds",
             "Router->replica proxy time per successful attempt.",
         )
+        # request-lifecycle accounting (resilience/deadline.py)
+        self._m_deadline = deadline_mod.expired_counter(r)
+        self._m_budget_denied = deadline_mod.budget_denied_counter(r)
+        self._m_hedges = deadline_mod.hedge_counter(r)
         # -- pipeline service (graph/) --------------------------------------
         self._m_graph_pushes = r.counter(
             "mcim_fabric_graph_pushes_total",
@@ -685,6 +734,16 @@ class Router:
         # on its forward; the pod router relays it replica-deep so the
         # serving process can echo which pod carried the request
         fed_pod = headers.get(fed_control.HDR_FED_POD) or ""
+        # the deadline chain (resilience/deadline.py): re-anchor the
+        # remaining budget from the wire on this process's clock; a
+        # request already dead answers 504 before any replica burns on it
+        dl = deadline_mod.from_headers(headers, clock=self._clock)
+        if dl is not None and dl.expired():
+            deadline_mod.count_expired(self._m_deadline, "router")
+            self._m_requests.inc(status="deadline_expired")
+            return _json_response(
+                504, deadline_mod.expired_response_body()
+            )
         try:
             h, w = self._sniff_dims(body)
         except Exception as e:
@@ -692,7 +751,7 @@ class Router:
             return _json_response(400, {"error": f"undecodable image: {e}"})
         if pipeline:
             return self._handle_graph_process(
-                body, tenant, pipeline, h, w, fed_pod=fed_pod
+                body, tenant, pipeline, h, w, fed_pod=fed_pod, deadline=dl
             )
         picked = bucketing.pick_bucket(h, w, self.buckets)
         if picked is None:
@@ -727,6 +786,7 @@ class Router:
         root = obs_trace.start_trace(
             "fabric.request", h=h, w=w, bucket=bucket, policy=policy
         )
+        self.retry_budget.deposit()
         if mode == "shadow":
             code, ctype, out, extra = self._shadow_forward(
                 root, bucket, body, canary_view, candidates
@@ -737,6 +797,10 @@ class Router:
                 extra_headers=(
                     ((fed_control.HDR_FED_POD, fed_pod),) if fed_pod else ()
                 ),
+                deadline=dl,
+                # the chain lane is idempotent by construction (pure
+                # image in -> image out), so it may hedge the tail
+                hedge=True,
             )
         self._m_requests.inc(
             status=_STATUS_LABEL.get(code, "error" if code >= 500 else "ok")
@@ -757,22 +821,53 @@ class Router:
         extra_headers: tuple[tuple[str, str], ...] = (),
         before_forward=None,
         admission_shed_is_final: bool = False,
+        deadline: deadline_mod.Deadline | None = None,
+        hedge: bool = False,
     ) -> tuple[int, str, bytes, list[tuple[str, str]]]:
+        """Walk the replica candidates until one answers. Deadline-honest
+        and retry-bounded (resilience/deadline.py): the remaining budget
+        is re-checked before every attempt (an expired request answers
+        504 HERE, never burns a replica), each forward carries the
+        remainder on the wire, attempt 2+ must withdraw from the retry
+        budget (a refused withdrawal gives up with the best answer so
+        far), and — on the idempotent chain lane (`hedge=True`) — a
+        first attempt still pending past the p99-based hedge delay gets
+        one secondary forward to the next candidate, first response
+        wins."""
         attempts = 0
         last: tuple[int, str, bytes, list] | None = None
-        for view in candidates:
+        hedge_delay = self._hedge_delay_s() if hedge else None
+        for ci, view in enumerate(candidates):
             if attempts >= self.forward_attempts:
                 break
+            if deadline is not None and deadline.expired():
+                deadline_mod.count_expired(self._m_deadline, "router")
+                self._m_requests.inc(status="deadline_expired")
+                return _json_response(
+                    504, deadline_mod.expired_response_body()
+                )
             rid = view.replica_id
             breaker = self.breakers.get(rid)
             if not breaker.allow():
                 continue  # routed around for the breaker window
             attempts += 1
             if attempts > 1:
+                if not self.retry_budget.try_withdraw():
+                    deadline_mod.count_budget_denied(
+                        self._m_budget_denied, "router"
+                    )
+                    break  # give up with the best answer so far
                 self._m_retries.inc()
                 obs_trace.event(
                     "fabric.retry", parent=root.context(),
                     attempt=attempts, replica=rid,
+                )
+            fwd_extra = extra_headers
+            if deadline is not None:
+                # remaining-budget form, recomputed PER ATTEMPT so the
+                # wire always carries what is actually left
+                fwd_extra = tuple(fwd_extra) + (
+                    (deadline_mod.HEADER, deadline.header_value()),
                 )
             t0 = self._clock()
             try:
@@ -787,10 +882,20 @@ class Router:
                         # registry first (spec re-push); a push failure
                         # is a net-error-class miss — next candidate
                         before_forward(view)
-                    code, ctype, out, fwd_hdrs = self._forward_once(
-                        view, body, root.trace_id,
-                        extra_headers=extra_headers,
-                    )
+                    if hedge_delay is not None and attempts == 1:
+                        (
+                            code, ctype, out, fwd_hdrs, rid, extra_fwds,
+                        ) = self._forward_maybe_hedged(
+                            view, candidates[ci + 1:], body,
+                            root.trace_id, fwd_extra, hedge_delay,
+                        )
+                        attempts += extra_fwds
+                        breaker = self.breakers.get(rid)
+                    else:
+                        code, ctype, out, fwd_hdrs = self._forward_once(
+                            view, body, root.trace_id,
+                            extra_headers=fwd_extra,
+                        )
             except Exception as e:
                 # connection-class failure: the replica is gone or wedged —
                 # feed its breaker and move on to the next candidate
@@ -828,6 +933,22 @@ class Router:
                 return (
                     code, ctype, out,
                     [("X-Fabric-Replica", rid)] + fwd_hdrs,
+                )
+            if code == 504:
+                # a downstream deadline_expired verdict is FINAL: the
+                # request's budget is gone everywhere, so rerouting it
+                # would burn another replica on work the caller already
+                # abandoned. Not a replica-health signal either — the
+                # deadline died, not the server.
+                breaker.on_success()
+                self._m_forwards.inc(replica=rid, outcome="http_error")
+                return (
+                    code, ctype, out,
+                    [
+                        ("X-Fabric-Replica", rid),
+                        ("X-Fabric-Attempts", str(attempts)),
+                    ]
+                    + fwd_hdrs,
                 )
             if code in (429, 503) or code >= 500 or canary_quarantine:
                 # the replica answered but couldn't take it: 429 means
@@ -895,6 +1016,183 @@ class Router:
             flight_recorder.dump(
                 "breaker_open", extra={"scope": "router", "replica": rid}
             )
+
+    # -- hedged forwards (resilience/deadline.py) --------------------------
+
+    def _ensure_hedge_pool(self):
+        with self._hedge_lock:
+            if self._hedge_pool is None:
+                self._hedge_pool = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=8, thread_name_prefix="mcim-hedge"
+                )
+            return self._hedge_pool
+
+    def _hedge_delay_s(self) -> float | None:
+        """The current hedge trigger delay: MCIM_HEDGE_DELAY_FRAC of the
+        federated p99, cached for 1s (fleet_p99 merges every replica's
+        histogram — too heavy per request). None = don't hedge (disabled
+        or the fleet has no latency data yet)."""
+        if self.hedge_delay_frac <= 0.0:
+            return None
+        now = self._clock()
+        cached_at, cached = self._hedge_delay_cache
+        if now - cached_at < 1.0:
+            return cached
+        try:
+            p99 = self.fleet_p99().get("p99_s")
+        except Exception:
+            p99 = None
+        delay = deadline_mod.hedge_delay_s(p99, self.hedge_delay_frac)
+        self._hedge_delay_cache = (now, delay)
+        return delay
+
+    def _book_hedge_loser(self, view: ReplicaView):
+        """Done-callback for the hedge leg that lost: its answer still
+        feeds the breaker and forward accounting — a hedge must never
+        make a replica's failures invisible."""
+
+        def _cb(fut) -> None:
+            rid = view.replica_id
+            breaker = self.breakers.get(rid)
+            try:
+                code = fut.result()[0]
+            except Exception:
+                breaker.on_failure()
+                self._maybe_breaker_dump(rid, breaker)
+                self._m_forwards.inc(replica=rid, outcome="net_error")
+                return
+            if code >= 500 and code != 504:
+                breaker.on_failure()
+                self._maybe_breaker_dump(rid, breaker)
+            else:
+                breaker.on_success()
+            self._m_forwards.inc(
+                replica=rid,
+                outcome="ok" if code < 400 else "http_error",
+            )
+
+        return _cb
+
+    def _forward_maybe_hedged(
+        self,
+        view: ReplicaView,
+        rest: list[ReplicaView],
+        body: bytes,
+        trace_id: str,
+        extra_headers: tuple[tuple[str, str], ...],
+        delay_s: float,
+    ) -> tuple[int, str, bytes, list, str, int]:
+        """First forward attempt with a tail hedge: if the primary is
+        still pending after `delay_s` (a fraction of the federated p99),
+        fire ONE secondary to the next routable candidate; the first
+        usable response wins. Hedges withdraw from the retry budget and
+        are capped at MCIM_HEDGE_MAX_FRAC of accepted requests, so the
+        tail-chasing extra load is bounded like every other retry.
+
+        Returns (code, ctype, out, fwd_hdrs, winner_replica_id,
+        extra_forwards); raises the primary's exception if no leg
+        produced a response. The caller books the winner's breaker /
+        forward metrics as usual; the losing leg books itself via a done
+        callback."""
+        pool = self._ensure_hedge_pool()
+        primary = pool.submit(
+            self._forward_once, view, body, trace_id,
+            extra_headers=extra_headers,
+        )
+        try:
+            code, ctype, out, fwd_hdrs = primary.result(timeout=delay_s)
+            return code, ctype, out, fwd_hdrs, view.replica_id, 0
+        except concurrent.futures.TimeoutError:
+            pass
+        # the primary is past the hedge delay — find a different
+        # routable replica to race it against
+        second = next(
+            (
+                v for v in rest
+                if v.replica_id != view.replica_id
+                and self.breakers.get(v.replica_id).allow()
+            ),
+            None,
+        )
+        fire = second is not None
+        if fire:
+            with self._hedge_lock:
+                cap = self.hedge_max_frac * max(
+                    1.0, float(self.retry_budget.deposits)
+                )
+                if self._hedges_fired + 1 > cap:
+                    fire = False
+                else:
+                    self._hedges_fired += 1
+            if not fire:
+                deadline_mod.count_hedge(self._m_hedges, "suppressed_cap")
+            elif not self.retry_budget.try_withdraw():
+                with self._hedge_lock:
+                    self._hedges_fired -= 1
+                deadline_mod.count_hedge(
+                    self._m_hedges, "suppressed_budget"
+                )
+                fire = False
+        if not fire:
+            # no sibling / cap / budget: just wait out the primary
+            code, ctype, out, fwd_hdrs = primary.result()
+            return code, ctype, out, fwd_hdrs, view.replica_id, 0
+        obs_trace.event(
+            "fabric.hedge", primary=view.replica_id,
+            secondary=second.replica_id, delay_s=round(delay_s, 4),
+        )
+        secondary = pool.submit(
+            self._forward_once, second, body, trace_id,
+            extra_headers=extra_headers,
+        )
+        legs = {primary: view, secondary: second}
+        results: dict = {}
+        pending = set(legs)
+        winner = None
+        while pending and winner is None:
+            done, pending = concurrent.futures.wait(
+                pending,
+                return_when=concurrent.futures.FIRST_COMPLETED,
+            )
+            for fut in done:
+                try:
+                    results[fut] = ("ok", fut.result())
+                except Exception as e:
+                    results[fut] = ("err", e)
+            for fut in (primary, secondary):  # primary-first: stable
+                got = results.get(fut)
+                if got is None or got[0] != "ok":
+                    continue
+                code = got[1][0]
+                # usable = final for the request: not a shed/retryable
+                # error (those fall back to the outer reroute loop),
+                # where 504 counts as final (deadline verdicts relay)
+                if code not in (429, 503) and (code < 500 or code == 504):
+                    winner = fut
+                    break
+        if winner is not None:
+            loser = secondary if winner is primary else primary
+            loserv = legs[loser]
+            loser.add_done_callback(self._book_hedge_loser(loserv))
+            if winner is primary:
+                deadline_mod.count_hedge(self._m_hedges, "lost")
+            else:
+                deadline_mod.count_hedge(self._m_hedges, "won")
+            code, ctype, out, fwd_hdrs = results[winner][1]
+            return (
+                code, ctype, out, fwd_hdrs,
+                legs[winner].replica_id, 1,
+            )
+        # both legs finished, neither final: book the secondary here and
+        # surface the primary's outcome to the outer loop (which owns
+        # the primary's breaker / reroute bookkeeping)
+        deadline_mod.count_hedge(self._m_hedges, "lost")
+        secondary.add_done_callback(self._book_hedge_loser(second))
+        kind, payload = results[primary]
+        if kind == "err":
+            raise payload
+        code, ctype, out, fwd_hdrs = payload
+        return code, ctype, out, fwd_hdrs, view.replica_id, 1
 
     def _forward_once(
         self,
@@ -1020,7 +1318,8 @@ class Router:
         return views
 
     def _try_systolic(
-        self, body: bytes, tenant: str, pipeline: str, h: int, w: int
+        self, body: bytes, tenant: str, pipeline: str, h: int, w: int,
+        deadline: deadline_mod.Deadline | None = None,
     ):
         """Attempt the stage-sharded lane for one graph request. Returns
         a complete HTTP response tuple, or None to fall back to the
@@ -1078,14 +1377,20 @@ class Router:
             HDR_TENANT,
         )
 
+        sys_extra = (
+            (HDR_TENANT, tenant),
+            (HDR_PIPELINE, pipeline),
+            (graph_systolic.HDR_PLAN, header),
+        )
+        if deadline is not None:
+            # the stage chain inherits the remaining budget: the entry
+            # owner's scheduler (and each stage handoff behind it) must
+            # expire this request like any other
+            sys_extra += ((deadline_mod.HEADER, deadline.header_value()),)
         try:
             code, ctype, out, passthrough = self._forward_once(
                 owners[0], body, root.trace_id,
-                extra_headers=(
-                    (HDR_TENANT, tenant),
-                    (HDR_PIPELINE, pipeline),
-                    (graph_systolic.HDR_PLAN, header),
-                ),
+                extra_headers=sys_extra,
             )
         except Exception as e:
             root.set(status="owner_down")
@@ -1102,10 +1407,12 @@ class Router:
                 },
             )
             return None
-        if code == 424 or code >= 500:
+        if code == 424 or (code >= 500 and code != 504):
             # a broken stage chain (entry answered systolic-broken, or
             # an owner died into a 5xx): rerun pinned — idempotent
-            # compute, so the client still gets the bit-exact answer
+            # compute, so the client still gets the bit-exact answer.
+            # 504 stays FINAL: the deadline died, not the chain, and a
+            # pinned rerun would burn replicas on abandoned work
             root.set(status="forward_failed", code=code)
             root.end()
             graph_systolic.count_fallback(fall, "forward_failed")
@@ -1150,6 +1457,7 @@ class Router:
     def _handle_graph_process(
         self, body: bytes, tenant: str, pipeline: str, h: int, w: int,
         fed_pod: str = "",
+        deadline: deadline_mod.Deadline | None = None,
     ) -> tuple[int, str, bytes, list[tuple[str, str]]]:
         """The graph lane: sticky affinity keyed on (tenant, pipeline,
         bucket), tenant + pipeline headers forwarded verbatim, stored
@@ -1178,7 +1486,9 @@ class Router:
             )
         bucket = f"{picked[0]}x{picked[1]}"
         if self.systolic:
-            resp = self._try_systolic(body, tenant, pipeline, h, w)
+            resp = self._try_systolic(
+                body, tenant, pipeline, h, w, deadline=deadline
+            )
             if resp is not None:
                 return resp
         else:
@@ -1203,6 +1513,9 @@ class Router:
             "fabric.request", h=h, w=w, bucket=bucket, policy=policy,
             tenant=tenant, pipeline=pipeline,
         )
+        # both lanes fund the SAME router budget: graph traffic earns
+        # the retry headroom its own reroutes spend
+        self.retry_budget.deposit()
         code, ctype, out, extra = self._forward_with_retries(
             root, bucket, body, candidates,
             extra_headers=(
@@ -1213,6 +1526,10 @@ class Router:
                 v, tenant, pipeline
             ),
             admission_shed_is_final=True,
+            # the graph lane propagates the deadline but does NOT hedge:
+            # DAG dispatch may carry side outputs / tenant accounting a
+            # duplicate dispatch would double-bill
+            deadline=deadline,
         )
         self._m_requests.inc(
             status=_STATUS_LABEL.get(code, "error" if code >= 500 else "ok")
@@ -1546,6 +1863,9 @@ class Router:
             with obs_trace.start_trace(
                 "fabric.session", sid=sid, seq=seq
             ) as root:
+                # each accepted frame banks retry-budget tokens, same as
+                # a chain request — failover retries withdraw from it
+                self.retry_budget.deposit()
                 code, ctype, out, extra = self._forward_session(
                     root, sess, seq, body
                 )
@@ -1561,6 +1881,14 @@ class Router:
         tried: set[str] = set()
         last: tuple[int, str, bytes, list] | None = None
         for _attempt in range(self.forward_attempts):
+            if _attempt > 0 and not self.retry_budget.try_withdraw():
+                # session failover retries draw from the same bucket as
+                # chain reroutes: a brownout must not amplify through
+                # the stateful lane either
+                deadline_mod.count_budget_denied(
+                    self._m_budget_denied, "router"
+                )
+                break
             live = [
                 v for v in self._routable() if v.replica_id not in tried
             ]
@@ -2009,6 +2337,13 @@ class Router:
             "stale_s": self.stale_s,
             "forward_attempts": self.forward_attempts,
             "shed_frac": self.shed_frac,
+            "retry_budget": self.retry_budget.stats(),
+            "hedge": {
+                "delay_frac": self.hedge_delay_frac,
+                "max_frac": self.hedge_max_frac,
+                "fired": self._hedges_fired,
+                "delay_s": self._hedge_delay_cache[1],
+            },
             "draining": self.draining_ids(),
             "graph": {
                 "specs": sorted(
@@ -2109,6 +2444,10 @@ class Router:
             self.httpd.server_close()
         if self._http_thread is not None:
             self._http_thread.join(timeout=10.0)
+        with self._hedge_lock:
+            pool, self._hedge_pool = self._hedge_pool, None
+        if pool is not None:
+            pool.shutdown(wait=False, cancel_futures=True)
         self._pool.close_all()
 
     def __enter__(self) -> "Router":

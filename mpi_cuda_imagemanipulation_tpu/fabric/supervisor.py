@@ -461,6 +461,11 @@ class Fabric:
         self._canary_stable_spec: ReplicaSpec | None = None
         self.supervisor: Supervisor | None = None
         self.autoscaler = None
+        # injectable like the Supervisor's (line ~245): the _wait_*
+        # helpers poll through these, so fake-clock tests can exercise
+        # their timeout paths without real 180s waits
+        self._clock = time.monotonic
+        self._sleep = time.sleep
         self._log = get_logger()
 
     def replica_ids(self) -> list[str]:
@@ -611,8 +616,8 @@ class Fabric:
     def _wait_incarnation_change(
         self, rid: str, old_incarnation: str | None, timeout_s: float = 180.0
     ) -> None:
-        deadline = time.monotonic() + timeout_s
-        while time.monotonic() < deadline:
+        deadline = self._clock() + timeout_s
+        while self._clock() < deadline:
             view = self.router.table.get(rid)
             if (
                 view is not None
@@ -620,7 +625,7 @@ class Fabric:
                 and view.hb.state == "serving"
             ):
                 return
-            time.sleep(0.1)
+            self._sleep(0.1)
         raise TimeoutError(
             f"replica {rid} did not re-register serving within "
             f"{timeout_s:.0f}s"
@@ -683,11 +688,11 @@ class Fabric:
     def wait_ready(self, n: int, *, timeout_s: float = 180.0) -> None:
         """Block until `n` replicas are fresh + routable (each has warmed
         its compile cache and heartbeated `serving`)."""
-        deadline = time.monotonic() + timeout_s
-        while time.monotonic() < deadline:
+        deadline = self._clock() + timeout_s
+        while self._clock() < deadline:
             if len(self.router._routable()) >= n:
                 return
-            time.sleep(0.1)
+            self._sleep(0.1)
         pids = self.supervisor.pids() if self.supervisor else {}
         raise TimeoutError(
             f"{n} replicas not serving within {timeout_s:.0f}s "

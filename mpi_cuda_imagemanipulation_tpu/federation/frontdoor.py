@@ -71,6 +71,7 @@ from mpi_cuda_imagemanipulation_tpu.obs import fleet as obs_fleet
 from mpi_cuda_imagemanipulation_tpu.obs import metrics as obs_metrics
 from mpi_cuda_imagemanipulation_tpu.obs import trace as obs_trace
 from mpi_cuda_imagemanipulation_tpu.obs.metrics import Registry
+from mpi_cuda_imagemanipulation_tpu.resilience import deadline as deadline_mod
 from mpi_cuda_imagemanipulation_tpu.resilience.breaker import BreakerBoard
 from mpi_cuda_imagemanipulation_tpu.serve import bucketing
 from mpi_cuda_imagemanipulation_tpu.utils import env as env_registry
@@ -93,12 +94,16 @@ ENV_FED_REGISTRY = "MCIM_FED_REGISTRY"
 #   forward_failed  an attempt on the affinity pod failed; survivors took it
 #   session_reset   a session's owning pod died; the session restarted
 #                   fresh on a new pod (no cross-pod tail replay)
+#   retry_budget    the token-bucket retry budget (resilience/deadline.py)
+#                   refused the reroute: the request gave up with its best
+#                   answer so far instead of amplifying a brownout
 REROUTE_REASONS = (
     "pod_down",
     "breaker_open",
     "overloaded",
     "forward_failed",
     "session_reset",
+    "retry_budget",
 )
 
 
@@ -179,6 +184,15 @@ class FrontDoorConfig:
     # per probe, a restarted pod rejoins within a breaker window
     breaker_threshold: int = 2
     breaker_reset_s: float = 3.0
+    # -- request lifecycle (resilience/deadline.py) ------------------------
+    # edge deadline applied to requests that arrive WITHOUT their own
+    # X-MCIM-Deadline-Ms budget; 0 disables. None: MCIM_FED_DEADLINE_MS
+    default_deadline_ms: float | None = None
+    # retry-budget token bucket: deposit `frac` per accepted request,
+    # withdraw 1 per reroute; `reserve` covers cold-start failover.
+    # None fields fall back to MCIM_RETRY_BUDGET_FRAC / _RESERVE
+    retry_budget_frac: float | None = None
+    retry_budget_reserve: float | None = None
 
 
 class FrontDoor:
@@ -227,6 +241,23 @@ class FrontDoor:
         )
         self.buckets = tuple(self.config.buckets)
         self.shed_frac = self.config.shed_frac
+        self.default_deadline_ms = (
+            float(env_registry.get(deadline_mod.ENV_DEADLINE_MS))
+            if self.config.default_deadline_ms is None
+            else self.config.default_deadline_ms
+        )
+        self.retry_budget = deadline_mod.RetryBudget(
+            frac=(
+                float(env_registry.get(deadline_mod.ENV_BUDGET_FRAC))
+                if self.config.retry_budget_frac is None
+                else self.config.retry_budget_frac
+            ),
+            reserve=(
+                float(env_registry.get(deadline_mod.ENV_BUDGET_RESERVE))
+                if self.config.retry_budget_reserve is None
+                else self.config.retry_budget_reserve
+            ),
+        )
         path = (
             self.config.registry_path
             or env_registry.get(ENV_FED_REGISTRY)
@@ -302,6 +333,11 @@ class FrontDoor:
             "mcim_fed_forward_seconds",
             "Front-door -> pod proxy time per successful attempt.",
         )
+        # request-lifecycle accounting (resilience/deadline.py): expiry
+        # answered locally at THIS tier, and reroutes the retry budget
+        # refused (the latter also count a `retry_budget` reroute)
+        self._m_deadline = deadline_mod.expired_counter(r)
+        self._m_budget_denied = deadline_mod.budget_denied_counter(r)
         self._m_pushes = r.counter(
             "mcim_fed_pushes_total",
             "Tenant/spec state re-pushed to a pod whose heartbeat "
@@ -438,16 +474,30 @@ class FrontDoor:
         extra_headers=(),
         before_forward=None,
         admission_shed_is_final: bool = False,
+        deadline: deadline_mod.Deadline | None = None,
     ):
         """Walk the pod candidates until one answers. The reroute
         accounting fires exactly once, when the request completes on a
         pod other than its rendezvous-preferred one — with the most
         specific reason observed (`base_reason` from routing, upgraded
-        by what actually happened to the preferred pod in this loop)."""
+        by what actually happened to the preferred pod in this loop).
+
+        Deadline-honest and retry-bounded (resilience/deadline.py): the
+        remaining budget is re-checked before every attempt (an expired
+        request answers 504 HERE instead of burning a pod), each forward
+        carries the remainder on the wire, and attempt 2+ must withdraw
+        from the retry budget — a refused withdrawal gives up with the
+        best answer so far, counted under the closed `retry_budget`
+        reroute reason."""
         reason = base_reason
         last: tuple | None = None
         attempts = 0
         for view in candidates:
+            if deadline is not None and deadline.expired():
+                deadline_mod.count_expired(self._m_deadline, "door")
+                return _json_response(
+                    504, deadline_mod.expired_response_body()
+                )
             pod = view.pod_id
             breaker = self.breakers.get(pod)
             if not breaker.allow():
@@ -456,7 +506,18 @@ class FrontDoor:
                 continue
             attempts += 1
             if attempts > 1:
+                if not self.retry_budget.try_withdraw():
+                    deadline_mod.count_budget_denied(
+                        self._m_budget_denied, "door"
+                    )
+                    count_reroute(self._m_reroutes, "retry_budget")
+                    break
                 self._m_retries.inc()
+            fwd_extra = tuple(extra_headers)
+            if deadline is not None:
+                fwd_extra = fwd_extra + (
+                    (deadline_mod.HEADER, deadline.header_value()),
+                )
             if before_forward is not None:
                 try:
                     before_forward(view)
@@ -476,7 +537,7 @@ class FrontDoor:
                     "fed.forward", parent=root.context(), pod=pod
                 ):
                     code, ctype, out, passthrough = self._forward_once(
-                        view, path, body, extra_headers, root.trace_id
+                        view, path, body, fwd_extra, root.trace_id
                     )
             except Exception as e:
                 breaker.on_failure()
@@ -488,6 +549,18 @@ class FrontDoor:
                     pod, type(e).__name__, str(e)[:120],
                 )
                 continue
+            if code == 504:
+                # a downstream deadline verdict is FINAL by definition:
+                # the budget is as gone on every sibling pod as it was
+                # on this one, so a retry could only burn more replica
+                # time on work the caller already abandoned. Not a pod
+                # fault either — the pod answered honestly.
+                breaker.on_success()
+                self._m_forwards.inc(pod=pod, outcome="http_error")
+                return (
+                    code, ctype, out,
+                    passthrough + [(HDR_FED_POD, pod)],
+                )
             if (
                 admission_shed_is_final
                 and code == 503
@@ -571,6 +644,19 @@ class FrontDoor:
 
         tenant = _pick(HDR_TENANT, "tenant") or "default"
         pipeline = _pick(HDR_PIPELINE, "pipeline")
+        # the deadline chain starts HERE: adopt the client's remaining
+        # budget, or mint the edge default for clients that sent none
+        dl = deadline_mod.from_headers(headers, clock=self._clock)
+        if dl is None and self.default_deadline_ms > 0:
+            dl = deadline_mod.Deadline(
+                self.default_deadline_ms, clock=self._clock
+            )
+        if dl is not None and dl.expired():
+            deadline_mod.count_expired(self._m_deadline, "door")
+            self._m_requests.inc(status="deadline_expired")
+            return _json_response(
+                504, deadline_mod.expired_response_body()
+            )
         try:
             h, w = Router._sniff_dims(body)
         except Exception as e:
@@ -602,10 +688,13 @@ class FrontDoor:
             "fed.request", h=h, w=w, bucket=bucket,
             tenant=tenant, pipeline=pipeline or None,
         )
+        # one accepted request = one retry-budget deposit (the bucket
+        # the reroute withdrawals below draw down)
+        self.retry_budget.deposit()
         code, ctype, out, hdrs_out = self._forward_with_retries(
             root, "/v1/process", body, candidates, preferred,
             base_reason, extra_headers=extra, before_forward=before,
-            admission_shed_is_final=shed_final,
+            admission_shed_is_final=shed_final, deadline=dl,
         )
         self._m_requests.inc(
             status=_STATUS_LABEL.get(
@@ -980,6 +1069,8 @@ class FrontDoor:
         return {
             "stale_s": self.stale_s,
             "forward_attempts": self.forward_attempts,
+            "default_deadline_ms": self.default_deadline_ms,
+            "retry_budget": self.retry_budget.stats(),
             "registry": {
                 "path": self.durable.path,
                 "counts": self.durable.counts(),

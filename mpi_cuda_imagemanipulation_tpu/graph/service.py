@@ -50,6 +50,9 @@ from mpi_cuda_imagemanipulation_tpu.graph.tenancy import (
     TenantRegistry,
 )
 from mpi_cuda_imagemanipulation_tpu.obs.metrics import Registry
+from mpi_cuda_imagemanipulation_tpu.resilience import (
+    deadline as deadline_mod,
+)
 from mpi_cuda_imagemanipulation_tpu.resilience import failpoints
 from mpi_cuda_imagemanipulation_tpu.utils import env as env_registry
 from mpi_cuda_imagemanipulation_tpu.utils.log import get_logger
@@ -145,6 +148,7 @@ class GraphService:
             "Accepted pipeline-spec registrations (idempotent re-posts "
             "count — the wire cost is real either way).",
         )
+        self._m_deadline = deadline_mod.expired_counter(r)
         self._m_dispatch_s = r.histogram(
             "mcim_graph_dispatch_seconds",
             "Device+host time per graph dispatch.",
@@ -296,10 +300,12 @@ class GraphService:
         *,
         nbytes: int | None = None,
         trace_id: str = "",
+        deadline: deadline_mod.Deadline | None = None,
     ) -> dict:
         """One admitted graph dispatch -> {'image': np.uint8 array,
         'histogram'?: list[int], 'stats'?: dict}. Raises SpecError
-        (rejected) / GraphShed (shed) / anything else = a real error."""
+        (rejected) / GraphShed (shed) / DeadlineExpired (the propagated
+        budget died before dispatch) / anything else = a real error."""
         try:
             st = self.tenants.get(tenant_id)
             graph_entry = st.pipelines.get(pipeline_id)
@@ -315,6 +321,15 @@ class GraphService:
             self._m_requests.inc(status="rejected")
             self._m_rejections.inc(code=e.code)
             raise
+        if deadline is not None and deadline.expired():
+            # checked between validation and admission: a dead budget
+            # must not charge the tenant's quota window, and certainly
+            # not reach the compiled dispatch
+            deadline_mod.count_expired(self._m_deadline, "graph")
+            self._m_requests.inc(status="deadline_expired")
+            raise deadline_mod.DeadlineExpired(
+                "graph dispatch budget exhausted before admission"
+            )
         try:
             self.tenants.admit(
                 st, img.nbytes if nbytes is None else nbytes,

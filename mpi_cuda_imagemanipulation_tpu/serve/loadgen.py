@@ -245,14 +245,28 @@ def http_run_offered_load(
     max_workers: int = 32,
     clock=time.monotonic,
     sleep=time.sleep,
+    headers: dict | None = None,
+    deadline_ms: float | None = None,
 ) -> dict:
     """The open-loop driver over HTTP: arrivals on the offered clock via a
     worker pool, collection afterwards (same discipline as
     `run_offered_load` — completions never gate arrivals). Returns the
     phase record plus `results`: [(blob_index, response dict), ...] so the
-    caller can verify successes bit-exactly against golden outputs."""
+    caller can verify successes bit-exactly against golden outputs.
+    `headers` rides every request (e.g. the X-MCIM-Deadline-Ms budget the
+    chaos lane sets); `deadline_ms` additionally feeds the summary's
+    goodput-within-deadline column."""
     from concurrent.futures import ThreadPoolExecutor
 
+    from mpi_cuda_imagemanipulation_tpu.resilience import (
+        deadline as deadline_mod,
+    )
+
+    if deadline_ms is not None:
+        headers = {
+            **(headers or {}),
+            deadline_mod.HEADER: f"{deadline_ms:.1f}",
+        }
     period = 1.0 / offered_rps
     futures = []
     with ThreadPoolExecutor(max_workers=max_workers) as pool:
@@ -268,18 +282,21 @@ def http_run_offered_load(
             k = i % len(blobs)
             futures.append(
                 (k, pool.submit(http_post_image, url, blobs[k],
-                                timeout_s=timeout_s))
+                                timeout_s=timeout_s, headers=headers))
             )
             i += 1
         results = [(k, f.result()) for k, f in futures]
         wall = clock() - t0
-    rec = summarize_http_results(results, wall, offered_rps)
+    rec = summarize_http_results(
+        results, wall, offered_rps, deadline_ms=deadline_ms
+    )
     rec["results"] = results
     return rec
 
 
 def summarize_http_results(
-    results: list[tuple[int, dict]], wall: float, offered_rps: float
+    results: list[tuple[int, dict]], wall: float, offered_rps: float,
+    *, deadline_ms: float | None = None,
 ) -> dict:
     """The shared HTTP open-loop accounting: one phase/lane record from
     [(blob_index, response dict), ...]. A 503 WITH Retry-After is an
@@ -287,9 +304,14 @@ def summarize_http_results(
     quota/QoS/elastic pressure — and must not be folded into
     unavailability (the 599/bare-503 failure class): a lane that counts
     intentional shedding as downtime would misread admission control
-    doing its job as the pod losing traffic. `accepted` is the offered
-    load the pod actually took on; `ok_accepted_frac` is goodput over
-    it (the elastic/tenant acceptance criteria gate on it at 100%)."""
+    doing its job as the pod losing traffic. A 504 is a deadline miss
+    (`deadline_expired`) — its own class, NOT unavailability: the stack
+    refusing doomed work is the deadline chain doing its job. `accepted`
+    is the offered load the pod actually took on; `ok_accepted_frac` is
+    goodput over it (the elastic/tenant acceptance criteria gate on it
+    at 100%). With `deadline_ms` set, `ok_in_deadline` / `goodput_rps`
+    count only the 200s that ALSO landed within the client's budget —
+    the chaos/elastic lanes' real goodput."""
     ok = [r for _, r in results if r["code"] == 200]
     retried = sum(1 for _, r in results if r["attempts"] > 1)
     shed = sum(
@@ -298,9 +320,17 @@ def summarize_http_results(
         if r["code"] == 503 and r.get("retry_after")
     )
     overloaded = sum(1 for _, r in results if r["code"] == 429)
+    deadline_expired = sum(1 for _, r in results if r["code"] == 504)
     n = len(results)
-    accepted = n - shed - overloaded
+    # a deadline-expired request was REFUSED (the stack declined doomed
+    # work), not taken on — it leaves `accepted` like a shed does
+    accepted = n - shed - overloaded - deadline_expired
     lat = [r["e2e_s"] for r in ok]
+    ok_in_deadline = (
+        sum(1 for r in ok if r["e2e_s"] * 1e3 <= deadline_ms)
+        if deadline_ms is not None
+        else len(ok)
+    )
     rec = {
         "offered_rps": offered_rps,
         "submitted": n,
@@ -312,6 +342,9 @@ def summarize_http_results(
         "retried_frac": retried / n if n else 0.0,
         "shed": shed,
         "shed_frac": shed / n if n else 0.0,
+        "deadline_expired": deadline_expired,
+        "ok_in_deadline": ok_in_deadline,
+        "goodput_rps": ok_in_deadline / wall if wall > 0 else 0.0,
         "unavailable": sum(
             1
             for _, r in results

@@ -24,6 +24,9 @@ from __future__ import annotations
 import threading
 
 from mpi_cuda_imagemanipulation_tpu.obs.metrics import Registry
+from mpi_cuda_imagemanipulation_tpu.resilience import (
+    deadline as deadline_mod,
+)
 
 PERCENTILES = (50, 95, 99)
 
@@ -97,6 +100,11 @@ class ServeMetrics:
             "Submit-to-done latency per completed request.",
             sample_cap=sample_cap,
         )
+        # the per-tier deadline-expiry counter (resilience/deadline.py):
+        # shared by this process's HTTP edge ("replica"), queue-pop
+        # expiry ("scheduler") and graph dispatch ("graph") — the
+        # registry dedups, so each subsystem just asks for it
+        self.deadline_tiers = deadline_mod.expired_counter(r)
 
     # -- registry-backed readers (back-compat attribute surface) -----------
 
@@ -137,10 +145,20 @@ class ServeMetrics:
     def on_reject(self) -> None:
         self._requests.inc(status="rejected")
 
+    def on_deadline_at_submit(self) -> None:
+        """A request whose propagated budget was already dead at submit:
+        resolved deadline_expired without ever being admitted (so no
+        queue-depth bookkeeping, unlike `on_deadline`)."""
+        self._requests.inc(status="deadline_expired")
+        deadline_mod.count_expired(self.deadline_tiers, "scheduler")
+
     def on_deadline(self, queue_wait_s: float, trace_id: str = "") -> None:
         with self._lock:
             self._requests.inc(status="deadline_expired")
             self._queued.dec()
+        # the queue-pop expiry is the LAST link of the propagated
+        # deadline chain — same per-tier family the door/router use
+        deadline_mod.count_expired(self.deadline_tiers, "scheduler")
         self._queue_wait.observe(queue_wait_s, exemplar=trace_id or None)
 
     def on_dispatch(

@@ -340,6 +340,18 @@ class MicroBatchScheduler:
         req.trace = root
         req.trace_id = root.trace_id
         enq = obs_trace.span("serve.enqueue", parent=root.context())
+        if deadline_ms is not None and deadline_ms <= 0.0:
+            # a propagated budget already dead on arrival (the HTTP edge
+            # forwards the wire remainder, floored at 0): resolve it
+            # without queue admission — the pop-time check would only
+            # discover the same verdict after a pointless wait. Counted
+            # as a resolution (never on_admit'd, so no queue-gauge
+            # bookkeeping like metrics.on_deadline does).
+            self.metrics.on_deadline_at_submit()
+            enq.end()
+            return self._resolve(
+                req, STATUS_DEADLINE, "expired before admission"
+            )
         problem = self._validate(img)
         if problem is not None:
             self.metrics.on_reject()

@@ -65,6 +65,9 @@ from mpi_cuda_imagemanipulation_tpu.resilience.health import (
     STOPPED,
     HealthState,
 )
+from mpi_cuda_imagemanipulation_tpu.resilience import (
+    deadline as deadline_mod,
+)
 from mpi_cuda_imagemanipulation_tpu.resilience.retry import RetryPolicy
 from mpi_cuda_imagemanipulation_tpu.serve import bucketing
 from mpi_cuda_imagemanipulation_tpu.serve.cache import CompileCache
@@ -725,6 +728,17 @@ def _make_handler(app: ServeApp):
                     [("Retry-After", "1")],
                 )
                 return
+            # propagated deadline: dead-on-arrival answers 504 before
+            # the tenant ladder or the DAG dispatcher see the request
+            dl = deadline_mod.from_headers(self.headers)
+            if dl is not None and dl.expired():
+                deadline_mod.count_expired(
+                    app.metrics.deadline_tiers, "replica"
+                )
+                self._send_json(
+                    504, deadline_mod.expired_response_body()
+                )
+                return
             root = obs_trace.start_trace(
                 "graph.request", tenant=tenant, pipeline=pipeline_id,
                 trace_id=self.headers.get("X-Trace-Id") or None,
@@ -764,15 +778,24 @@ def _make_handler(app: ServeApp):
                     )
                     if kind == "env":
                         self._systolic_forward_and_relay(
-                            placement, 1, val, tid, trace_hdr
+                            placement, 1, val, tid, trace_hdr,
+                            deadline=dl,
                         )
                         return
                     out = val
                 else:
                     out = app.graph_service.process(
                         tenant, pipeline_id, img, nbytes=len(data),
-                        trace_id=tid,
+                        trace_id=tid, deadline=dl,
                     )
+            except deadline_mod.DeadlineExpired:
+                # the graph service found the budget dead at dispatch
+                # time (tier "graph" counted there); 504 is the verdict
+                root.set(status="deadline_expired")
+                self._send_json(
+                    504, deadline_mod.expired_response_body(), trace_hdr
+                )
+                return
             except SpecError as e:
                 root.set(status="rejected", code=e.code)
                 self._graph_refusal(e, tid)
@@ -868,6 +891,7 @@ def _make_handler(app: ServeApp):
         def _systolic_forward_and_relay(
             self, placement: dict, next_idx: int, env: dict,
             tid: str, trace_hdr,
+            deadline=None,
         ) -> None:
             """Hand the live env to stage owner `next_idx` and relay its
             (eventually the final owner's) response verbatim — success
@@ -884,11 +908,32 @@ def _make_handler(app: ServeApp):
                 encode_handoff,
             )
 
-            body = encode_handoff(
-                {"placement": placement, "idx": next_idx, "trace_id": tid},
-                env,
-            )
+            meta = {
+                "placement": placement, "idx": next_idx, "trace_id": tid,
+            }
+            if deadline is not None:
+                # the stage chain carries the REMAINING budget in the
+                # handoff frame (same remaining-ms form as the HTTP
+                # header): each stage owner re-anchors and re-checks
+                meta["deadline_ms"] = deadline.remaining_ms()
+            body = encode_handoff(meta, env)
             resp = self._systolic_post(placement["addrs"][next_idx], body)
+            if resp is not None and resp[0] == 504:
+                # a downstream stage found the deadline dead: relay the
+                # verdict instead of declaring the chain broken (a 424
+                # would trigger a pinned RERUN of abandoned work)
+                _, headers, rbody = resp
+                self.send_response(504)
+                self.send_header(
+                    "Content-Type",
+                    headers.get("Content-Type", "application/json"),
+                )
+                self.send_header("Content-Length", str(len(rbody)))
+                for k, v in trace_hdr:
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(rbody)
+                return
             if resp is None or resp[0] != 200:
                 status = "unreachable" if resp is None else resp[0]
                 self._send_json(
@@ -952,6 +997,23 @@ def _make_handler(app: ServeApp):
                 )
                 return
             trace_hdr = [("X-Trace-Id", tid)] if tid else []
+            dl = None
+            raw_dl = meta.get("deadline_ms")
+            if raw_dl is not None:
+                try:
+                    dl = deadline_mod.Deadline(float(raw_dl))
+                except (TypeError, ValueError):
+                    dl = None  # garbled budget degrades to none
+            if dl is not None and dl.expired():
+                # the budget died in transit between stage owners: stop
+                # the chain HERE — upstream relays the 504 verbatim
+                deadline_mod.count_expired(
+                    app.metrics.deadline_tiers, "replica"
+                )
+                self._send_json(
+                    504, deadline_mod.expired_response_body(), trace_hdr
+                )
+                return
             try:
                 kind, val = app.graph_service.systolic_process(
                     placement, idx, env, trace_id=tid,
@@ -973,7 +1035,7 @@ def _make_handler(app: ServeApp):
                 return
             if kind == "env":
                 self._systolic_forward_and_relay(
-                    placement, idx + 1, val, tid, trace_hdr
+                    placement, idx + 1, val, tid, trace_hdr, deadline=dl
                 )
                 return
             self._send_graph_result(val, trace_hdr)
@@ -1061,6 +1123,18 @@ def _make_handler(app: ServeApp):
                     [("Retry-After", "1")],
                 )
                 return
+            # the propagated deadline (resilience/deadline.py): a budget
+            # already dead on arrival answers 504 here, before decode or
+            # queue admission — the caller gave up, don't burn the GPU
+            dl = deadline_mod.from_headers(self.headers)
+            if dl is not None and dl.expired():
+                deadline_mod.count_expired(
+                    app.metrics.deadline_tiers, "replica"
+                )
+                self._send_json(
+                    504, deadline_mod.expired_response_body()
+                )
+                return
             try:
                 data = self._read_body()
                 img = decode_image_bytes(data)
@@ -1073,7 +1147,14 @@ def _make_handler(app: ServeApp):
                 return
             req = app.scheduler.submit(
                 img,
-                deadline_ms=app.config.default_deadline_ms,
+                # the wire remainder (what the CLIENT still waits for)
+                # overrides the local default; the scheduler's queue-pop
+                # expiry becomes the last link of the propagated chain
+                deadline_ms=(
+                    dl.remaining_ms()
+                    if dl is not None
+                    else app.config.default_deadline_ms
+                ),
                 # adopt the fabric router's distributed trace id when the
                 # request arrived through the front door (X-Trace-Id hop:
                 # the router made the sampling decision; this replica's

@@ -387,6 +387,35 @@ _VARS = (
            "=0 disables graph micro-batch coalescing (per-request "
            "dispatch through the scheduler's (dag_fingerprint, bucket) "
            "queue; batched executables are vmapped and bit-exact)."),
+    # -- request lifecycle (resilience/deadline.py) --------------------------
+    EnvVar("MCIM_FED_DEADLINE_MS", "0", "federation/frontdoor.py",
+           "Default end-to-end deadline budget (ms) the front door "
+           "stamps on requests that arrive without X-MCIM-Deadline-Ms; "
+           "0 = no default (only client-set budgets propagate)."),
+    EnvVar("MCIM_RETRY_BUDGET_FRAC", "0.1", "resilience/deadline.py",
+           "Retry-budget deposit per accepted request at the door and "
+           "router: retries/reroutes/hedges each withdraw one token, "
+           "bounding attempt amplification at 1+frac asymptotically."),
+    EnvVar("MCIM_RETRY_BUDGET_RESERVE", "8", "resilience/deadline.py",
+           "Retry-budget starting balance (tokens): cold-start failover "
+           "headroom before any deposits have banked (the breaker board "
+           "trips within ~2 failures, so this covers the first probes)."),
+    EnvVar("MCIM_HEDGE_DELAY_FRAC", "0", "fabric/router.py",
+           "Hedged requests: a chain forward still pending past this "
+           "fraction of the router's federated p99 gets ONE secondary "
+           "forward to a different replica, first response wins; 0 "
+           "disables hedging."),
+    EnvVar("MCIM_HEDGE_MAX_FRAC", "0.05", "fabric/router.py",
+           "Cap on hedges as a fraction of accepted requests (on top of "
+           "the retry-budget withdrawal each hedge makes)."),
+    # -- chaos harness (resilience/chaos.py, tools/chaos_smoke.py) -----------
+    EnvVar("MCIM_CHAOS_SEED", None, "tools/chaos_smoke.py",
+           "Comma-separated ChaosSchedule seeds the chaos smoke runs "
+           "(default: the two fixed CI seeds)."),
+    EnvVar("MCIM_CHAOS_RPS", "30", "tools/chaos_smoke.py",
+           "Open-loop offered load (req/s) per chaos run."),
+    EnvVar("MCIM_CHAOS_DURATION_S", "8", "tools/chaos_smoke.py",
+           "Duration of each chaos run's load + fault window."),
     # -- bench driver (bench.py, repo root) ----------------------------------
     EnvVar("MCIM_NO_HISTORY", None, "bench.py",
            "Any non-empty value: do not append promoted records to "

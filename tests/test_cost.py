@@ -470,9 +470,13 @@ def test_tail_keep_error_arg_promotes():
 
 def test_tail_keep_slow_promotes_at_p99():
     t = obs_trace.Tracer(sample=0.0, tail=8)
-    # seed the duration baseline with fast sampled-out roots (dropped)
-    for _ in range(40):
+    # seed the duration baseline with sampled-out roots (dropped), each
+    # with a pinned DECREASING duration: once the threshold engages the
+    # p99 of a small sample is its max, so a scheduler hiccup on a real
+    # microsecond-scale seed could sit at the running max and promote
+    for i in range(40):
         r = t.start_trace("serve.request")
+        r.t0 -= (40 - i) * 0.01
         r.set(status="ok")
         r.end()
     # a much slower root promotes as p99-slow despite the ok status

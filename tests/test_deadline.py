@@ -1,0 +1,450 @@
+"""Request lifecycle (resilience/deadline.py + resilience/chaos.py) —
+the ISSUE-18 unit suite.
+
+The load-bearing invariants:
+  1. the wire form is REMAINING milliseconds, re-anchored per hop on the
+     local monotonic clock — decrement arithmetic is exact under a fake
+     clock and malformed headers degrade to "no deadline", never 500;
+  2. per-tier expiry accounting is a closed vocabulary (TIERS) behind
+     the count_expired choke point — unknown tiers raise;
+  3. the retry budget's exact invariant holds under saturation:
+     withdrawals <= frac * deposits + reserve, and a denied withdrawal
+     makes the router give up with its best answer (budget_denied
+     counted), never silently;
+  4. hedged forwards: first usable response wins, the hedge withdraws
+     from the budget, and the cap/budget suppressions count their own
+     closed outcomes;
+  5. a seeded ChaosSchedule is deterministic (same seed -> identical
+     trace) and its runner replays events in order, surviving action
+     exceptions;
+  6. the Fabric's _wait_* helpers poll through the injectable clock
+     (the ISSUE-18 satellite fix), so their timeout paths run under a
+     fake clock in milliseconds, not minutes.
+"""
+
+import threading
+
+import pytest
+
+from mpi_cuda_imagemanipulation_tpu.fabric.control import Heartbeat
+from mpi_cuda_imagemanipulation_tpu.fabric.router import (
+    Router,
+    RouterConfig,
+)
+from mpi_cuda_imagemanipulation_tpu.fabric.supervisor import (
+    Fabric,
+    FabricConfig,
+)
+from mpi_cuda_imagemanipulation_tpu.obs import trace as obs_trace
+from mpi_cuda_imagemanipulation_tpu.obs.metrics import Registry
+from mpi_cuda_imagemanipulation_tpu.resilience import chaos
+from mpi_cuda_imagemanipulation_tpu.resilience import deadline as dl
+from mpi_cuda_imagemanipulation_tpu.serve.bucketing import parse_buckets
+
+
+class _Clock:
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+# --------------------------------------------------------------------------
+# deadline header arithmetic
+# --------------------------------------------------------------------------
+
+
+def test_deadline_remaining_decrements_with_clock():
+    clk = _Clock()
+    d = dl.Deadline(1000.0, clock=clk)
+    assert d.remaining_ms() == pytest.approx(1000.0)
+    clk.t += 0.4
+    assert d.remaining_ms() == pytest.approx(600.0)
+    assert not d.expired()
+    clk.t += 0.6
+    assert d.expired()
+
+
+def test_deadline_header_roundtrip_carries_remainder():
+    clk = _Clock()
+    d = dl.Deadline(250.0, clock=clk)
+    clk.t += 0.1  # this hop spent 100ms
+    hdr = {dl.HEADER: d.header_value()}
+    nxt = dl.from_headers(hdr, clock=clk)
+    assert nxt is not None
+    assert nxt.remaining_ms() == pytest.approx(150.0, abs=0.2)
+
+
+def test_deadline_header_floors_at_zero_when_dead():
+    clk = _Clock()
+    d = dl.Deadline(50.0, clock=clk)
+    clk.t += 1.0
+    # a just-expired budget propagates as dead ("0.0"), never vanishes
+    # or goes negative — the next hop must also answer 504
+    assert d.header_value() == "0.0"
+    nxt = dl.from_headers({dl.HEADER: d.header_value()}, clock=clk)
+    assert nxt is not None and nxt.expired()
+
+
+def test_deadline_absent_or_malformed_header_is_none():
+    assert dl.from_headers({}) is None
+    assert dl.from_headers({dl.HEADER: "not-a-number"}) is None
+    assert dl.from_headers({dl.HEADER: ""}) is None
+
+
+# --------------------------------------------------------------------------
+# per-tier expiry accounting (closed vocabulary)
+# --------------------------------------------------------------------------
+
+
+def test_count_expired_per_tier_and_unknown_raises():
+    r = Registry()
+    c = dl.expired_counter(r)
+    for tier in dl.TIERS:
+        dl.count_expired(c, tier)
+    for tier in dl.TIERS:
+        assert c.value(tier=tier) == 1.0
+    with pytest.raises(ValueError, match="unknown deadline tier"):
+        dl.count_expired(c, "launderette")
+
+
+def test_count_hedge_closed_vocabulary():
+    r = Registry()
+    c = dl.hedge_counter(r)
+    for outcome in dl.HEDGE_OUTCOMES:
+        dl.count_hedge(c, outcome)
+        assert c.value(outcome=outcome) == 1.0
+    with pytest.raises(ValueError, match="unknown hedge outcome"):
+        dl.count_hedge(c, "maybe")
+
+
+def test_expired_counter_registration_is_idempotent():
+    # serve/metrics.py, graph/service.py and the schedulers all ask the
+    # SAME registry for this counter — re-registration must dedup
+    r = Registry()
+    assert dl.expired_counter(r) is dl.expired_counter(r)
+
+
+# --------------------------------------------------------------------------
+# retry budget
+# --------------------------------------------------------------------------
+
+
+def test_retry_budget_invariant_under_saturation():
+    b = dl.RetryBudget(frac=0.1, reserve=3.0)
+    withdrawn = 0
+    for i in range(500):
+        b.deposit()
+        # a pathological caller that retries as hard as it can
+        while b.try_withdraw():
+            withdrawn += 1
+    s = b.stats()
+    assert s["withdrawn"] == withdrawn
+    # THE invariant: withdrawals <= frac * deposits + reserve
+    assert withdrawn <= 0.1 * s["deposits"] + 3.0 + 1e-9
+    assert s["denied"] > 0
+
+
+def test_retry_budget_reserve_covers_cold_start():
+    b = dl.RetryBudget(frac=0.1, reserve=2.0)
+    # no deposits banked yet: the reserve must still allow failover
+    assert b.try_withdraw()
+    assert b.try_withdraw()
+    assert not b.try_withdraw()
+
+
+# --------------------------------------------------------------------------
+# router: budget-denied give-up + hedged forwards
+# --------------------------------------------------------------------------
+
+BUCKETS = parse_buckets("48")
+
+
+def _mk_router(**over) -> Router:
+    cfg = RouterConfig(buckets=BUCKETS, **over)
+    r = Router(cfg)
+    now = r._clock()
+    for i, rid in enumerate(("r0", "r1")):
+        r.table.observe(
+            Heartbeat(
+                replica_id=rid, addr="127.0.0.1", port=i + 1, pid=0,
+                incarnation="i1", state="serving", queued=0,
+                queue_depth=64, breaker_open=[], warm_buckets=["48x48"],
+                seq=1, sent_unix_s=0.0,
+            ),
+            now,
+        )
+    return r
+
+
+def _root():
+    t = obs_trace.start_trace("test.request")
+    t.end()
+    return t
+
+
+def test_router_gives_up_when_budget_denied():
+    r = _mk_router()
+    try:
+        r.retry_budget = dl.RetryBudget(frac=0.0, reserve=0.0)
+        r._forward_once = lambda *a, **k: (503, "application/json",
+                                           b'{"status":"x"}', [])
+        code, _ct, _out, _hdrs = r._forward_with_retries(
+            _root(), "48x48", b"img", r.table.views()
+        )
+        # attempt 2 wanted a reroute; the empty budget refused it, so
+        # the request surfaced its best answer instead of amplifying
+        assert code == 503
+        assert r._m_budget_denied.value(tier="router") == 1.0
+        assert r.retry_budget.stats()["denied"] == 1
+    finally:
+        r.close()
+
+
+def test_router_relays_504_as_final():
+    r = _mk_router()
+    try:
+        calls = []
+
+        def once(view, body, tid, extra_headers=()):
+            calls.append(view.replica_id)
+            return 504, "application/json", b'{"status":"x"}', []
+
+        r._forward_once = once
+        code, *_ = r._forward_with_retries(
+            _root(), "48x48", b"img", r.table.views()
+        )
+        # a downstream deadline verdict must NOT burn a second replica
+        assert code == 504
+        assert len(calls) == 1
+    finally:
+        r.close()
+
+
+def test_router_checks_deadline_before_each_attempt():
+    r = _mk_router()
+    try:
+        clk = _Clock()
+        r._clock = clk
+        d = dl.Deadline(50.0, clock=clk)
+        clk.t += 1.0  # dead before the first forward
+        called = []
+        r._forward_once = lambda *a, **k: called.append(1)
+        code, _ct, out, _h = r._forward_with_retries(
+            _root(), "48x48", b"img", r.table.views(), deadline=d
+        )
+        assert code == 504
+        assert b"deadline_expired" in out
+        assert not called
+        assert r._m_deadline.value(tier="router") == 1.0
+    finally:
+        r.close()
+
+
+def test_hedge_secondary_wins_and_withdraws_budget():
+    r = _mk_router(hedge_delay_frac=0.5, hedge_max_frac=1.0)
+    try:
+        release = threading.Event()
+
+        def once(view, body, tid, extra_headers=()):
+            if view.replica_id == "r0":
+                release.wait(5.0)  # the slow primary
+                return 200, "image/png", b"slow", []
+            return 200, "image/png", b"fast", []
+
+        r._forward_once = once
+        views = r.table.views()
+        v0 = next(v for v in views if v.replica_id == "r0")
+        v1 = next(v for v in views if v.replica_id == "r1")
+        before = r.retry_budget.stats()["withdrawn"]
+        code, _ct, out, _h, rid, extra = r._forward_maybe_hedged(
+            v0, [v1], b"img", "t", (), 0.05
+        )
+        release.set()
+        assert (code, out, rid, extra) == (200, b"fast", "r1", 1)
+        assert r._m_hedges.value(outcome="won") == 1.0
+        assert r.retry_budget.stats()["withdrawn"] == before + 1
+    finally:
+        release.set()
+        r.close()
+
+
+def test_hedge_fast_primary_never_fires_secondary():
+    r = _mk_router(hedge_delay_frac=0.5, hedge_max_frac=1.0)
+    try:
+        r._forward_once = (
+            lambda view, body, tid, extra_headers=():
+            (200, "image/png", b"p:" + view.replica_id.encode(), [])
+        )
+        views = r.table.views()
+        v0 = next(v for v in views if v.replica_id == "r0")
+        v1 = next(v for v in views if v.replica_id == "r1")
+        code, _ct, out, _h, rid, extra = r._forward_maybe_hedged(
+            v0, [v1], b"img", "t", (), 1.0
+        )
+        assert (code, out, rid, extra) == (200, b"p:r0", "r0", 0)
+        for outcome in dl.HEDGE_OUTCOMES:
+            assert r._m_hedges.value(outcome=outcome) == 0.0
+    finally:
+        r.close()
+
+
+def test_hedge_suppressed_by_cap_and_budget():
+    # cap of 0: a due hedge is suppressed_cap and the primary is awaited
+    r = _mk_router(hedge_delay_frac=0.5, hedge_max_frac=0.0)
+    try:
+        def slow(view, body, tid, extra_headers=()):
+            return 200, "image/png", b"p", []
+
+        real_sleepy = threading.Event()
+
+        def once(view, body, tid, extra_headers=()):
+            real_sleepy.wait(0.15)  # past the hedge delay, then answer
+            return slow(view, body, tid, extra_headers=extra_headers)
+
+        r._forward_once = once
+        views = r.table.views()
+        v0 = next(v for v in views if v.replica_id == "r0")
+        v1 = next(v for v in views if v.replica_id == "r1")
+        code, _ct, _o, _h, rid, extra = r._forward_maybe_hedged(
+            v0, [v1], b"img", "t", (), 0.02
+        )
+        assert (code, rid, extra) == (200, "r0", 0)
+        assert r._m_hedges.value(outcome="suppressed_cap") == 1.0
+    finally:
+        r.close()
+    # empty budget: same shape, counted suppressed_budget
+    r = _mk_router(hedge_delay_frac=0.5, hedge_max_frac=1.0)
+    try:
+        r.retry_budget = dl.RetryBudget(frac=0.0, reserve=0.0)
+
+        def once2(view, body, tid, extra_headers=()):
+            threading.Event().wait(0.1)
+            return 200, "image/png", b"p", []
+
+        r._forward_once = once2
+        views = r.table.views()
+        v0 = next(v for v in views if v.replica_id == "r0")
+        v1 = next(v for v in views if v.replica_id == "r1")
+        code, _ct, _o, _h, rid, extra = r._forward_maybe_hedged(
+            v0, [v1], b"img", "t", (), 0.02
+        )
+        assert (code, rid, extra) == (200, "r0", 0)
+        assert r._m_hedges.value(outcome="suppressed_budget") == 1.0
+    finally:
+        r.close()
+
+
+def test_hedge_delay_from_p99():
+    assert dl.hedge_delay_s(None, 0.5) is None
+    assert dl.hedge_delay_s(0.0, 0.5) is None
+    assert dl.hedge_delay_s(2.0, 0.0) is None
+    assert dl.hedge_delay_s(2.0, 0.5) == pytest.approx(1.0)
+
+
+# --------------------------------------------------------------------------
+# chaos schedules
+# --------------------------------------------------------------------------
+
+
+def test_chaos_schedule_same_seed_same_trace():
+    kw = dict(pods=("pa", "pb"), duration_s=8.0, brownout_ms=120)
+    a = chaos.ChaosSchedule.compile(7, **kw)
+    b = chaos.ChaosSchedule.compile(7, **kw)
+    assert a.trace() == b.trace()
+    assert a == b
+    c = chaos.ChaosSchedule.compile(8, **kw)
+    assert c.trace() != a.trace()
+
+
+def test_chaos_schedule_shape():
+    s = chaos.ChaosSchedule.compile(
+        3, pods=("pa", "pb"), duration_s=10.0, brownout_ms=150
+    )
+    kinds = [e.kind for e in s.events]
+    assert kinds.count("kill_pod") == 1
+    for e in s.events:
+        assert e.kind in chaos.EVENT_KINDS
+        assert 0.0 < e.t_s < s.duration_s
+        assert e.pod in s.pods
+    # the brownout arms sleep:MS on exactly one pod's serve.dispatch
+    browns = [
+        p for p, spec in s.failpoints.items()
+        if "serve.dispatch=sleep:150" in spec
+    ]
+    assert len(browns) == 1
+    # every armed site stays inside the closed failpoint vocabulary
+    for spec in s.failpoints.values():
+        for tok in filter(None, spec.split(",")):
+            assert tok.split("=", 1)[0] in chaos.FAULT_SITES
+
+
+def test_chaos_schedule_single_pod_never_kills_it():
+    s = chaos.ChaosSchedule.compile(3, pods=("pa",), duration_s=5.0)
+    assert s.killed_pod() is None
+
+
+def test_chaos_runner_replays_in_order_and_survives_errors():
+    s = chaos.ChaosSchedule.compile(11, pods=("pa", "pb"), duration_s=6.0)
+    assert len(s.events) >= 2
+    clk = _Clock(0.0)
+    applied = []
+
+    def act(ev):
+        applied.append(ev)
+        if len(applied) == 1:
+            raise RuntimeError("the harness action blew up")
+
+    actions = {k: act for k in chaos.EVENT_KINDS}
+    runner = chaos.ChaosRunner(
+        s, actions, clock=clk,
+        sleep=lambda dt: setattr(clk, "t", clk.t + dt),
+    )
+    runner._run()  # synchronous under the fake clock
+    assert applied == list(s.events)
+    # the first action raised; the run continued and recorded it
+    assert len(runner.errors) == 1 and runner.errors[0][0] is s.events[0]
+    assert runner.applied == list(s.events)[1:]
+
+
+def test_chaos_runner_requires_all_actions():
+    s = chaos.ChaosSchedule.compile(11, pods=("pa", "pb"), duration_s=6.0)
+    with pytest.raises(ValueError, match="missing actions"):
+        chaos.ChaosRunner(s, {})
+
+
+# --------------------------------------------------------------------------
+# Fabric _wait_* helpers honor the injectable clock (ISSUE-18 satellite)
+# --------------------------------------------------------------------------
+
+
+def _fake_fabric_clock(fab: Fabric) -> _Clock:
+    clk = _Clock(0.0)
+    fab._clock = clk
+    fab._sleep = lambda dt: setattr(clk, "t", clk.t + dt)
+    return clk
+
+
+def test_fabric_wait_ready_times_out_on_fake_clock():
+    fab = Fabric(FabricConfig(replicas=1, buckets="48"))
+    try:
+        clk = _fake_fabric_clock(fab)
+        with pytest.raises(TimeoutError, match="not serving within"):
+            fab.wait_ready(1, timeout_s=30.0)
+        # the poll loop ran on the INJECTED clock (the old direct
+        # time.monotonic() would still be at ~0 wall seconds here)
+        assert clk.t >= 30.0
+    finally:
+        fab.router.close()
+
+
+def test_fabric_wait_incarnation_change_times_out_on_fake_clock():
+    fab = Fabric(FabricConfig(replicas=1, buckets="48"))
+    try:
+        clk = _fake_fabric_clock(fab)
+        with pytest.raises(TimeoutError, match="did not re-register"):
+            fab._wait_incarnation_change("r0", "i0", timeout_s=45.0)
+        assert clk.t >= 45.0
+    finally:
+        fab.router.close()

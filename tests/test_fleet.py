@@ -512,15 +512,18 @@ def test_bench_regress_candidate_mode(tmp_path):
     br = _bench_regress()
     lines = br.load_history(os.path.join(_REPO_ROOT, "BENCH_HISTORY.jsonl"))
     good = br.synthesize_regressed(lines)[0]
-    # un-halve: a candidate at the historical level passes
-    for field, value, _h in br._metrics_of(good):
-        good[field] = value * 2.0
+    # un-halve the regressed headline (direction-aware: a lower-is-better
+    # column like chaos_loadgen's e2e_p99_ms is already at its historical
+    # level and doubling it would MANUFACTURE a regression)
+    for field, value, higher in br._metrics_of(good):
+        if higher:
+            good[field] = value * 2.0
     cand = tmp_path / "cand.json"
     cand.write_text(json.dumps({"records": [good]}))
     hist = os.path.join(_REPO_ROOT, "BENCH_HISTORY.jsonl")
     assert br.main(["--history", hist, "--candidate", str(cand)]) == 0
-    for field, value, _h in br._metrics_of(good):
-        good[field] = value * 0.25
+    for field, value, higher in br._metrics_of(good):
+        good[field] = value * 0.25 if higher else value * 4.0
     cand.write_text(json.dumps({"records": [good]}))
     assert br.main(["--history", hist, "--candidate", str(cand)]) == 1
 

@@ -59,6 +59,10 @@ METRIC_FIELDS: dict[str, list[tuple[str, bool]]] = {
     "engine_ab": [("speedup", True)],
     "halo_ab": [("comms_hidden_frac", True)],
     "fabric_loadgen": [("scaling_vs_1", True)],
+    # the chaos/brownout lane (tools/chaos_smoke.py): goodput within the
+    # client deadline must not sag, and the hedged tail must not creep
+    # back toward the brownout floor
+    "chaos_loadgen": [("goodput_rps", True), ("e2e_p99_ms", False)],
 }
 _DEFAULT_FIELDS: list[tuple[str, bool]] = [
     ("mp_per_s_per_chip", True),
@@ -153,7 +157,9 @@ def check_value(
         value = -value
     m = _median(history)
     if len(history) == 1:
-        allowed = m * (1.0 - REL_TOL_SINGLE)
+        # abs(): lower-is-better series arrive negated, and scaling a
+        # negative median toward zero would flag an identical candidate
+        allowed = m - REL_TOL_SINGLE * abs(m)
         reason = f"single prior point {m:.4g}, tol {REL_TOL_SINGLE:.0%}"
     else:
         mad = _median([abs(v - m) for v in history])

@@ -1,0 +1,661 @@
+#!/usr/bin/env python
+"""Seeded chaos acceptance for the deadline/budget/hedge machinery
+(ISSUE 18): the front door over TWO real pods (each a `fabric` CLI
+subprocess with 2 replica processes), a compiled ChaosSchedule replayed
+mid-traffic, then a single-pod brownout A/B proving hedged requests buy
+back tail latency.
+
+    python tools/chaos_smoke.py METRICS_OUT [SUMMARY_OUT]
+
+Part A — chaos runs, one per fixed seed (MCIM_CHAOS_SEED overrides to a
+single seed). Each run compiles `ChaosSchedule.compile(seed)` into
+per-pod failpoint env (probabilistic forward/dispatch faults, dropped
+replica + pod heartbeats, a sleep:MS dispatch brownout on one pod) plus
+timed process faults (replica SIGKILL, SIGUSR1 preemption, one whole-pod
+SIGKILL), drives >= 200 open-loop requests through the door with a
+client deadline, and asserts the global invariants:
+
+  1. every 200 is BIT-EXACT against the in-process golden — chaos may
+     slow or refuse work, never corrupt it;
+  2. zero late 200s: nothing lands after deadline + grace (the deadline
+     chain refuses doomed work with 504 instead of finishing it late);
+  3. zero unexplained failures: every response is 200, an explicit shed
+     (503 + Retry-After) or a deadline verdict (504) — bare 503/599
+     unavailability is a lost accepted request, which is the bug the
+     whole tier exists to prevent;
+  4. retry amplification is bounded at EVERY budgeted tier:
+     withdrawn <= frac * deposits + reserve at the door and at the
+     surviving pod's router (per-tier bound 1 + frac + reserve/N; the
+     tiers compose multiplicatively in the worst case, which is why
+     each tier enforces its own budget rather than trusting callers);
+  5. every give-up is closed-vocabulary: reroute reasons within
+     REROUTE_REASONS, deadline tiers within deadline.TIERS, hedge
+     outcomes within HEDGE_OUTCOMES — straight from /metrics.
+
+Part B — brownout A/B on one pod (2 replicas, chain lane): the
+rendezvous-sticky replica for the test bucket (deterministic over
+replica ids r0/r1) gets an unconditional `serve.dispatch=sleep:MS`
+brownout via per-replica env; the same offered load runs with hedging
+off then on (delay frac of the federated p99, cap 100%). Acceptance:
+the hedged arm's p99 lands under the brownout floor the unhedged arm
+cannot get below, with >= 1 hedge won. Both arms are appended to
+BENCH_HISTORY.jsonl as `chaos_loadgen` records (tools/bench_regress.py
+tracks goodput_rps up / e2e_p99_ms down).
+
+METRICS_OUT gets the final chaos run's front-door exposition;
+SUMMARY_OUT (optional) the whole acceptance summary as JSON.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# pods inherit this: fast beats keep staleness waits short under chaos
+os.environ["MCIM_FED_HEARTBEAT_S"] = "0.25"
+
+import numpy as np  # noqa: E402
+
+from mpi_cuda_imagemanipulation_tpu.fabric.router import (  # noqa: E402
+    RouterConfig,
+    _rendezvous_score,
+)
+from mpi_cuda_imagemanipulation_tpu.fabric.supervisor import (  # noqa: E402
+    Fabric,
+    FabricConfig,
+)
+from mpi_cuda_imagemanipulation_tpu.federation.frontdoor import (  # noqa: E402
+    REROUTE_REASONS,
+    FrontDoor,
+    FrontDoorConfig,
+)
+from mpi_cuda_imagemanipulation_tpu.graph import (  # noqa: E402
+    compile_graph,
+    graph_callable,
+    parse_spec,
+)
+from mpi_cuda_imagemanipulation_tpu.io.image import (  # noqa: E402
+    decode_image_bytes,
+    encode_image_bytes,
+    synthetic_image,
+)
+from mpi_cuda_imagemanipulation_tpu.obs.metrics import (  # noqa: E402
+    parse_exposition,
+)
+from mpi_cuda_imagemanipulation_tpu.resilience import (  # noqa: E402
+    chaos,
+    deadline as deadline_mod,
+)
+from mpi_cuda_imagemanipulation_tpu.serve import loadgen  # noqa: E402
+from mpi_cuda_imagemanipulation_tpu.serve.bucketing import (  # noqa: E402
+    parse_buckets,
+)
+from mpi_cuda_imagemanipulation_tpu.utils import (  # noqa: E402
+    env as env_registry,
+)
+
+OPS = "grayscale,contrast:3.5"
+BUCKETS = "48,96"
+STALE_S = 1.2
+DEADLINE_MS = 6000.0   # client budget each chaos request carries
+GRACE_MS = 2000.0      # covers one in-flight dispatch past the budget
+BROWN_MS = 250         # part-B brownout floor on the sticky replica
+
+SPEC = {
+    "version": 1,
+    "name": "unsharp",
+    "nodes": [
+        {"id": "src", "kind": "source"},
+        {"id": "g", "kind": "op", "op": "grayscale", "input": "src"},
+        {"id": "blur", "kind": "op", "op": "gaussian:5", "input": "g"},
+        {"id": "mask", "kind": "merge", "merge": "subtract",
+         "inputs": ["g", "blur"]},
+    ],
+    "outputs": {"image": "mask"},
+}
+
+# "already dead" shapes a chaos action may legitimately race into: a
+# kill_replica scheduled after its whole pod was SIGKILLed, a preempt of
+# a pid the supervisor already replaced. Swallowed by the actions (the
+# fault's intent — that target is down — already holds); anything ELSE
+# raising is a harness bug and must surface through ChaosRunner.errors.
+_GONE = (
+    ProcessLookupError, ConnectionError, TimeoutError, OSError,
+    urllib.error.URLError, KeyError, TypeError, ValueError,
+)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class _Pod:
+    """One whole pod as a `fabric` CLI subprocess (same shape as
+    tools/federation_smoke.py), plus the chaos delta: the compiled
+    schedule's MCIM_FAILPOINTS spec baked into the pod's env at spawn —
+    the router AND the replicas it spawns inherit it, so every armed
+    site fires in the process that owns it."""
+
+    def __init__(self, pod_id: str, frontdoor_url: str,
+                 failpoints: str, seed: int):
+        self.pod_id = pod_id
+        self.port = _free_port()
+        self.url = f"http://127.0.0.1:{self.port}"
+        env = dict(os.environ)
+        if failpoints:
+            env["MCIM_FAILPOINTS"] = failpoints
+            env["MCIM_FAILPOINT_SEED"] = str(seed)
+        self.proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "mpi_cuda_imagemanipulation_tpu",
+                "fabric",
+                "--replicas", "2",
+                "--ops", OPS,
+                "--buckets", BUCKETS,
+                "--channels", "3",
+                "--max-batch", "4",
+                "--queue-depth", "64",
+                "--host", "127.0.0.1",
+                "--port", str(self.port),
+                "--heartbeat-s", "0.2",
+                "--stale-s", "0.8",
+                "--federate", frontdoor_url,
+                "--pod-id", pod_id,
+            ],
+            env=env,
+            # its own process group: kill_pod (and teardown) can killpg
+            # the supervisor AND every replica it spawned, even when the
+            # pod's /stats is already unreachable mid-chaos
+            start_new_session=True,
+        )
+
+    def stats(self) -> dict:
+        with urllib.request.urlopen(self.url + "/stats", timeout=5) as r:
+            return json.loads(r.read())
+
+    def replica_pid(self, rid: str) -> int:
+        return int(self.stats()["replicas"][rid]["pid"])
+
+    def sigkill(self) -> None:
+        """The whole pod, hard: one SIGKILL to the process group takes
+        the supervisor and both replicas at once — nothing drains,
+        nothing hands over, nothing leaks."""
+        try:
+            os.killpg(self.proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        self.proc.wait(timeout=10.0)
+
+    def close(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGTERM)
+            try:
+                self.proc.wait(timeout=60.0)
+            except Exception:
+                pass
+        # belt and braces: reap any straggler in the group (a replica
+        # whose supervisor died before it could be drained)
+        try:
+            os.killpg(self.proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        try:
+            self.proc.wait(timeout=10.0)
+        except Exception:
+            pass
+
+
+def _post(url: str, path: str, data: bytes, headers=None):
+    req = urllib.request.Request(
+        url + path, data=data, headers=headers or {}, method="POST"
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return r.status, dict(r.headers), r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+def _door_stats(url: str) -> dict:
+    with urllib.request.urlopen(url + "/stats", timeout=10) as r:
+        return json.loads(r.read())
+
+
+def _get_metrics(url: str) -> str:
+    with urllib.request.urlopen(url + "/metrics", timeout=10) as r:
+        return r.read().decode()
+
+
+def _label_values(exposition: str, family: str, label: str) -> set:
+    fams = parse_exposition(exposition)
+    out = set()
+    fam = fams.get(family)
+    if fam:
+        for (_n, labels), _v in fam["samples"].items():
+            if f'{label}="' in labels:
+                out.add(labels.split(f'{label}="', 1)[1].split('"', 1)[0])
+    return out
+
+
+def _wait_pods(url: str, want: set, deadline_s: float = 240.0):
+    t_end = time.monotonic() + deadline_s
+    pods = {}
+    while time.monotonic() < t_end:
+        try:
+            pods = _door_stats(url)["pods"]
+        except Exception:
+            pods = {}
+        ready = {
+            pid for pid, v in pods.items()
+            if v["fresh"] and v["routable"] >= 2
+        }
+        if want <= ready:
+            return
+        time.sleep(0.2)
+    raise TimeoutError(f"pods {sorted(want)} never joined (saw {pods.keys()})")
+
+
+def _budget_bound_ok(stats: dict) -> bool:
+    """The amplification invariant one tier enforces for itself:
+    withdrawals never exceed frac * deposits + reserve."""
+    return (
+        stats["withdrawn"]
+        <= stats["frac"] * stats["deposits"] + stats["reserve"] + 1e-9
+    )
+
+
+# --------------------------------------------------------------------------
+# part A: one seeded chaos run
+# --------------------------------------------------------------------------
+
+
+def chaos_run(seed: int, rps: float, duration_s: float,
+              metrics_out: str | None) -> dict:
+    sched = chaos.ChaosSchedule.compile(
+        seed,
+        pods=("pod0", "pod1"),
+        duration_s=duration_s,
+        replicas_per_pod=2,
+        brownout_ms=100,
+    )
+    print(f"chaos[{seed}]: schedule")
+    for line in sched.trace():
+        print(f"chaos[{seed}]:   {line}")
+    tmp = tempfile.mkdtemp(prefix=f"chaos_smoke_{seed}_")
+    door = FrontDoor(FrontDoorConfig(
+        registry_path=os.path.join(tmp, "fed_registry.jsonl"),
+        buckets=tuple(parse_buckets(BUCKETS)),
+        stale_s=STALE_S,
+        forward_timeout_s=20.0,
+        forward_attempts=3,
+    )).start(host="127.0.0.1", port=0)
+    pods = {
+        pid: _Pod(pid, door.url, sched.failpoints[pid], seed)
+        for pid in sched.pods
+    }
+
+    def _kill_replica(ev):
+        try:
+            os.kill(
+                pods[ev.pod].replica_pid(f"r{ev.detail}"), signal.SIGKILL
+            )
+        except _GONE:
+            pass
+
+    def _preempt_replica(ev):
+        try:
+            os.kill(
+                pods[ev.pod].replica_pid(f"r{ev.detail}"), signal.SIGUSR1
+            )
+        except _GONE:
+            pass
+
+    def _kill_pod(ev):
+        try:
+            pods[ev.pod].sigkill()
+        except _GONE:
+            pass
+
+    runner = chaos.ChaosRunner(sched, {
+        "kill_replica": _kill_replica,
+        "preempt_replica": _preempt_replica,
+        "kill_pod": _kill_pod,
+    })
+
+    img = synthetic_image(40, 44, channels=3, seed=50)
+    blob = encode_image_bytes(img)
+    golden = np.asarray(
+        graph_callable(compile_graph(parse_spec(SPEC)))(img)["image"]
+    )
+    try:
+        _wait_pods(door.url, set(sched.pods))
+        code, _h, out = _post(
+            door.url, "/v1/tenants",
+            json.dumps({"tenant": "acme", "qos": "interactive"}).encode(),
+        )
+        assert code == 200, (code, out[:200])
+        code, _h, out = _post(
+            door.url, "/v1/pipelines",
+            json.dumps({"tenant": "acme", "spec": SPEC}).encode(),
+        )
+        assert code == 200, (code, out[:300])
+        pipeline = json.loads(out)["pipeline"]
+        headers = {"X-MCIM-Tenant": "acme", "X-MCIM-Pipeline": pipeline}
+        # warm both pods (jit compile off the measured clock) before any
+        # fault fires
+        for pod in pods.values():
+            code, _h, out = _post(pod.url, "/v1/process", blob, headers)
+            assert code == 200, (pod.pod_id, code, out[:200])
+
+        runner.start()
+        rec = loadgen.http_run_offered_load(
+            door.url, [blob], rps, duration_s,
+            timeout_s=20.0, headers=headers, deadline_ms=DEADLINE_MS,
+        )
+        runner.stop()
+        runner.join(timeout=10.0)
+        results = rec.pop("results")
+
+        # -- invariants ----------------------------------------------------
+        assert not runner.errors, (
+            f"chaos actions failed for their OWN reasons: {runner.errors}"
+        )
+        assert any(e.kind == "kill_pod" for e in runner.applied), (
+            "the whole-pod SIGKILL never fired — the run proved nothing"
+        )
+        assert rec["submitted"] >= 200, (
+            f"need >= 200 requests for the acceptance, got "
+            f"{rec['submitted']} (raise MCIM_CHAOS_RPS/_DURATION_S)"
+        )
+        # 1. bit-exactness over every accepted-and-completed request
+        for _k, r in results:
+            if r["code"] == 200:
+                np.testing.assert_array_equal(
+                    decode_image_bytes(r["body"]), golden
+                )
+        # 2. zero late 200s past deadline + grace
+        late = [
+            r["e2e_s"] for _k, r in results
+            if r["code"] == 200
+            and r["e2e_s"] * 1e3 > DEADLINE_MS + GRACE_MS
+        ]
+        assert not late, (
+            f"{len(late)} responses landed AFTER deadline+grace "
+            f"(worst {max(late):.2f}s): the deadline chain finished "
+            f"doomed work instead of refusing it"
+        )
+        # 3. no unexplained failure class
+        assert rec["unavailable"] == 0, (
+            f"{rec['unavailable']} bare-503/transport failures — "
+            f"accepted requests were LOST, not refused "
+            f"(ok={rec['ok']} shed={rec['shed']} "
+            f"expired={rec['deadline_expired']})"
+        )
+        bad = {
+            r["code"] for _k, r in results
+            if r["code"] not in (200, 503, 504)
+        }
+        assert not bad, f"responses outside the closed contract: {bad}"
+        assert rec["ok"] > 0.5 * rec["submitted"], (
+            f"only {rec['ok']}/{rec['submitted']} completed — the "
+            f"surviving capacity never carried the load"
+        )
+        # 4. per-tier amplification bounds (door + surviving pod router)
+        door_budget = _door_stats(door.url)["retry_budget"]
+        assert _budget_bound_ok(door_budget), door_budget
+        survivor = next(
+            p for p in sched.pods if p != sched.killed_pod()
+        )
+        pod_budget = pods[survivor].stats()["retry_budget"]
+        assert _budget_bound_ok(pod_budget), pod_budget
+        # 5. closed vocabularies, straight from the expositions
+        door_expo = _get_metrics(door.url)
+        pod_expo = _get_metrics(pods[survivor].url)
+        reasons = _label_values(
+            door_expo, "mcim_fed_reroutes_total", "reason"
+        )
+        assert reasons <= set(REROUTE_REASONS), (
+            f"reroute reasons outside the vocabulary: "
+            f"{reasons - set(REROUTE_REASONS)}"
+        )
+        for expo, where in ((door_expo, "door"), (pod_expo, survivor)):
+            tiers = _label_values(
+                expo, "mcim_deadline_expired_total", "tier"
+            )
+            assert tiers <= set(deadline_mod.TIERS), (where, tiers)
+            outcomes = _label_values(
+                expo, "mcim_hedge_requests_total", "outcome"
+            )
+            assert outcomes <= set(deadline_mod.HEDGE_OUTCOMES), (
+                where, outcomes,
+            )
+        if metrics_out:
+            with open(metrics_out, "w") as f:
+                f.write(door_expo)
+        print(
+            f"chaos[{seed}]: {rec['submitted']} requests through "
+            f"{len(runner.applied)} faults (killed {sched.killed_pod()}): "
+            f"{rec['ok']} ok (100% bit-exact, 0 late), "
+            f"{rec['shed']} shed, {rec['deadline_expired']} expired; "
+            f"door budget {door_budget['withdrawn']:.0f}w/"
+            f"{door_budget['deposits']:.0f}d, "
+            f"{survivor} budget {pod_budget['withdrawn']:.0f}w/"
+            f"{pod_budget['deposits']:.0f}d"
+        )
+        return {
+            "seed": seed,
+            "trace": list(sched.trace()),
+            "applied": [e.kind for e in runner.applied],
+            "killed_pod": sched.killed_pod(),
+            "door_budget": door_budget,
+            "survivor_budget": pod_budget,
+            **{
+                k: rec[k]
+                for k in (
+                    "submitted", "ok", "shed", "deadline_expired",
+                    "unavailable", "ok_in_deadline", "goodput_rps",
+                )
+            },
+        }
+    finally:
+        runner.stop()
+        door.close()
+        for pod in pods.values():
+            pod.close()
+
+
+# --------------------------------------------------------------------------
+# part B: brownout A/B — hedging buys back the tail
+# --------------------------------------------------------------------------
+
+
+def brownout_ab(rps: float, duration_s: float) -> dict:
+    img = synthetic_image(40, 44, channels=3, seed=60)
+    blob = encode_image_bytes(img)
+    # the chain lane routes rendezvous-sticky per bucket; replica ids
+    # are deterministic (r0/r1), so the harness can compute which one
+    # the traffic pins to and arm the brownout exactly there — the
+    # other replica stays fast, which is precisely the asymmetry a
+    # hedged request exploits
+    sticky = max(
+        ("r0", "r1"), key=lambda r: _rendezvous_score("48x48", r)
+    )
+    arms = {}
+    digests = {}
+    # delay frac 0.15, NOT larger: the trigger is a fraction of the
+    # MEASURED federated p99, and the brownout inflates that p99 (queue
+    # wait on the browned replica rides into the histograms). A frac
+    # near 1/(1 + inflation) would push the trigger past the brownout
+    # itself and hedging would silently stop — the feedback loop the
+    # first cut of this harness hit at frac 0.3 under queueing.
+    for arm, delay_frac in (("hedge_off", 0.0), ("hedge_on", 0.15)):
+        fab = Fabric(FabricConfig(
+            replicas=2,
+            ops=OPS,
+            buckets="48",
+            channels="3",
+            max_batch=4,
+            queue_depth=64,
+            heartbeat_s=0.2,
+            router=RouterConfig(
+                buckets=tuple(parse_buckets("48")),
+                hedge_delay_frac=delay_frac,
+                hedge_max_frac=1.0,
+                # hedges WITHDRAW from the same retry budget as
+                # reroutes (the shared amplification cap); the default
+                # frac 0.1 would throttle this arm to ~10% hedged.
+                # frac 1.0 = "every request may forward twice" — the
+                # regime whose tail win this A/B measures
+                retry_budget_frac=1.0,
+            ),
+            replica_env={sticky: {
+                "MCIM_FAILPOINTS": f"serve.dispatch=sleep:{BROWN_MS}",
+                "MCIM_FAILPOINT_SEED": "0",
+            }},
+        )).start(host="127.0.0.1", port=0)
+        try:
+            # off the measured clock: jit warmup on the sticky replica,
+            # plus enough e2e samples that the router's federated p99
+            # (the hedge trigger base) is live before the run
+            for _ in range(8):
+                r = loadgen.http_post_image(fab.router.url, blob)
+                assert r["code"] == 200, (arm, r["code"], r["body"][:200])
+            time.sleep(0.6)  # >= 2 heartbeats: fleet p99 lands
+            rec = loadgen.http_run_offered_load(
+                fab.router.url, [blob], rps, duration_s,
+                timeout_s=15.0, deadline_ms=8000.0,
+            )
+            results = rec.pop("results")
+            assert rec["unavailable"] == 0 and rec["shed"] == 0, rec
+            assert rec["deadline_expired"] == 0, rec
+            assert rec["ok"] == rec["submitted"], rec
+            digests[arm] = {r["body"] for _k, r in results}
+            assert len(digests[arm]) == 1, (
+                f"{arm}: non-deterministic bodies across replicas"
+            )
+            hedge = fab.router.stats()["hedge"]
+            won = fab.router._m_hedges.value(outcome="won")
+            suppressed = sum(
+                fab.router._m_hedges.value(outcome=o)
+                for o in ("suppressed_cap", "suppressed_budget")
+            )
+            arms[arm] = {
+                "config": "chaos_loadgen",
+                "impl": arm,
+                "platform": "cpu",
+                "ops": OPS,
+                "brownout_ms": BROWN_MS,
+                "sticky_replica": sticky,
+                "hedge_delay_frac": delay_frac,
+                "hedges_fired": hedge["fired"],
+                "hedges_won": won,
+                "hedges_suppressed": suppressed,
+                **{
+                    k: rec[k]
+                    for k in (
+                        "offered_rps", "submitted", "ok",
+                        "ok_in_deadline", "goodput_rps", "e2e_p50_ms",
+                        "e2e_p99_ms", "wall_s",
+                    )
+                },
+            }
+        finally:
+            fab.close(drain=False)
+    # the two arms ran the same pipeline on the same pixels: one output
+    assert digests["hedge_off"] == digests["hedge_on"], (
+        "hedged responses diverged from unhedged ones bit-wise"
+    )
+    off, on = arms["hedge_off"], arms["hedge_on"]
+    # the unhedged arm cannot get under the brownout floor (every
+    # request rides the browned sticky replica)...
+    assert off["e2e_p99_ms"] >= BROWN_MS, (
+        f"brownout never bit: unhedged p99 {off['e2e_p99_ms']:.0f}ms "
+        f"< sleep {BROWN_MS}ms"
+    )
+    # ...and the hedged arm must: its p99 is hedge-delay + a fast
+    # dispatch, strictly inside the floor
+    assert on["hedges_won"] >= 1, on
+    assert on["e2e_p99_ms"] < BROWN_MS, (
+        f"hedging did not buy back the tail: p99 "
+        f"{on['e2e_p99_ms']:.0f}ms vs brownout {BROWN_MS}ms "
+        f"({on['hedges_fired']} fired, {on['hedges_won']:.0f} won)"
+    )
+    print(
+        f"ab: brownout sleep:{BROWN_MS} on {sticky}; p99 "
+        f"{off['e2e_p99_ms']:.0f}ms unhedged -> {on['e2e_p99_ms']:.0f}ms "
+        f"hedged ({on['hedges_fired']} fired, {on['hedges_won']:.0f} won, "
+        f"goodput {off['goodput_rps']:.1f} -> {on['goodput_rps']:.1f} "
+        f"req/s)"
+    )
+    return arms
+
+
+def _append_history(arms: dict) -> None:
+    entry = {
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "note": (
+            f"chaos brownout A/B (ISSUE 18): chain lane, 2 replicas, "
+            f"serve.dispatch=sleep:{BROWN_MS} on the rendezvous-sticky "
+            f"replica; hedged requests (delay 0.15 x federated p99, cap "
+            f"100%) vs hedging off — tools/chaos_smoke.py"
+        ),
+        "records": [arms["hedge_off"], arms["hedge_on"]],
+    }
+    from bench import git_head_sha
+
+    sha = git_head_sha()
+    if sha:
+        entry["git_sha"] = sha
+    if os.environ.get("MCIM_NO_HISTORY"):
+        return
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(repo, "BENCH_HISTORY.jsonl"), "a") as f:
+        f.write(json.dumps(entry) + "\n")
+
+
+def main(metrics_out: str, summary_out: str | None = None) -> int:
+    seed_env = env_registry.get("MCIM_CHAOS_SEED")
+    seeds = [int(seed_env)] if seed_env else [11, 23]
+    rps = float(env_registry.get("MCIM_CHAOS_RPS"))
+    duration_s = float(env_registry.get("MCIM_CHAOS_DURATION_S"))
+    runs = [
+        chaos_run(
+            seed, rps, duration_s,
+            metrics_out if i == len(seeds) - 1 else None,
+        )
+        for i, seed in enumerate(seeds)
+    ]
+    # 8 req/s on a 2-replica pod whose sticky replica sleeps 250ms per
+    # dispatch: enough load that the tail is real, little enough that
+    # the browned replica's queue stays shallow (so the unhedged arm
+    # measures the brownout, not an overload collapse)
+    arms = brownout_ab(rps=8.0, duration_s=5.0)
+    _append_history(arms)
+    summary = {"chaos_runs": runs, "brownout_ab": arms}
+    if summary_out:
+        with open(summary_out, "w") as f:
+            json.dump(summary, f, indent=2, default=str)
+    print(f"chaos smoke: all invariants held -> {metrics_out}")
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) not in (2, 3):
+        print(__doc__)
+        sys.exit(2)
+    sys.exit(main(sys.argv[1], *sys.argv[2:]))

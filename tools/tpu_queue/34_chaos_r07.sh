@@ -1,0 +1,41 @@
+#!/bin/bash
+# Seeded chaos + deadline/budget/hedge acceptance on silicon (round 7,
+# ISSUE 18): the front door over two real pods under a compiled
+# ChaosSchedule, then the single-pod brownout A/B for hedged requests.
+#
+# One script, two deliverables:
+#
+#   chaos runs        two fixed seeds through door -> 2 pods x 2
+#                     replicas with the schedule's failpoint env baked
+#                     into each pod (probabilistic forward/dispatch
+#                     faults, dropped replica + pod beats, a sleep:MS
+#                     dispatch brownout) and its timed process faults
+#                     replayed mid-traffic (replica SIGKILL, SIGUSR1
+#                     preemption, one whole-pod SIGKILL). Acceptance is
+#                     absolute, not statistical: every 200 bit-exact,
+#                     zero 200s past deadline+grace, zero bare-503/599
+#                     losses, withdrawn <= frac*deposits + reserve at
+#                     the door AND the surviving pod's router, every
+#                     give-up reason inside its closed vocabulary.
+#   chaos_loadgen     the brownout A/B record pair (hedge_off vs
+#                     hedge_on) appended to BENCH_HISTORY.jsonl —
+#                     tools/bench_regress.py tracks goodput_rps up and
+#                     e2e_p99_ms down. On TPU the open question is how
+#                     much tail the hedge buys when the brownout is
+#                     real device contention rather than an injected
+#                     sleep — the same harness answers it unchanged.
+#
+# Knobs: MCIM_CHAOS_SEED (pin one seed), MCIM_CHAOS_RPS /
+# _DURATION_S (load per chaos run), MCIM_FED_HEARTBEAT_S.
+# Budget: ~8-12 min.
+set -u
+cd "$(dirname "$0")/../.."
+. tools/tpu_queue/_lib.sh
+out=artifacts/chaos_r07.out
+: > "$out"
+timeout 1500 python tools/chaos_smoke.py \
+  artifacts/chaos_metrics_r07.prom \
+  artifacts/chaos_smoke_r07.json >> "$out" 2>&1 || true
+commit_artifacts "TPU window: seeded chaos + hedging A/B (round 7)" \
+  "$out" artifacts/chaos_metrics_r07.prom artifacts/chaos_smoke_r07.json
+exit 0
