@@ -184,10 +184,27 @@ rule(
     "A TAXONOMY entry has no SpecError() constructor anywhere — a "
     "rejection code no path can produce (clients cannot rely on it).",
 )
+rule(
+    "obs-tune-decision-unknown", "obs",
+    "count_decision() names a decision missing from DECISIONS in "
+    "tune/controller.py (the typo'd member would raise at count time, "
+    "inside the control loop's tick).",
+)
+rule(
+    "obs-tune-decision-unused", "obs",
+    "A tune DECISIONS entry has no count_decision() caller anywhere — a "
+    "decision the control loop claims to make but can never account.",
+)
+rule(
+    "obs-tune-decision-dynamic", "obs",
+    "count_decision() called with a non-literal decision in package "
+    "code — the closed DECISIONS vocabulary is only machine-checkable "
+    "when every decision site names its member as a string literal.",
+)
 
 _METRIC_RE = re.compile(
     r"^mcim_(serve|engine|cache|breaker|health|batch|analysis|fabric|stream"
-    r"|plan|fleet|slo|graph|cost|devmem|systolic|fed|deadline|hedge)"
+    r"|plan|fleet|slo|graph|cost|devmem|systolic|fed|deadline|hedge|tune)"
     r"_[a-z0-9_]+$"
 )
 
@@ -214,6 +231,7 @@ def check_obs(repo: Repo):
     findings.extend(_check_deadline_vocab(repo))
     findings.extend(_check_graph_taxonomy(repo))
     findings.extend(_check_cost_attribution(repo))
+    findings.extend(_check_tune_decisions(repo))
     return findings
 
 
@@ -404,7 +422,7 @@ def _check_metrics(repo: Repo) -> list:
                     "mcim_<subsystem>_<what> scheme "
                     "(subsystems: serve/engine/cache/breaker/health/"
                     "batch/analysis/fabric/stream/plan/fleet/slo/graph/"
-                    "systolic/fed/deadline/hedge)"
+                    "systolic/fed/deadline/hedge/tune)"
                 )
             elif kind == "counter" and not name.endswith("_total"):
                 msg = f"counter {name!r} must end in _total"
@@ -759,6 +777,89 @@ def _check_fed_reroutes(repo: Repo) -> list:
                 f"{PACKAGE}/federation/frontdoor.py", reg_line,
                 f"REROUTE_REASONS entry {reason!r} has no "
                 "count_reroute() caller anywhere in the repo",
+            )
+        )
+    return findings
+
+
+# -- tune decisions (tune/controller.py) ---------------------------------------
+
+
+def _known_tune_decisions(repo: Repo) -> tuple[set[str], int]:
+    sf = repo.by_rel.get(f"{PACKAGE}/tune/controller.py")
+    if sf is None:
+        return set(), 0
+    for node in sf.tree.body:
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == "DECISIONS":
+                    vals = {
+                        e.value
+                        for e in ast.walk(node.value)
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)
+                    }
+                    return vals, node.lineno
+    return set(), 0
+
+
+def _is_count_decision(node: ast.Call) -> bool:
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr == "count_decision"
+    return isinstance(fn, ast.Name) and fn.id == "count_decision"
+
+
+def _check_tune_decisions(repo: Repo) -> list:
+    """The tune decision vocabulary is closed exactly like systolic
+    fallback reasons and federation reroutes: every
+    count_decision(counter, decision) site must name a DECISIONS
+    literal, and every entry must have a caller — a decision the
+    autonomous control loop cannot account is a flip nobody audited."""
+    findings = []
+    known, reg_line = _known_tune_decisions(repo)
+    if not known:
+        return findings
+    used: set[str] = set()
+    for sf in repo.files:
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Call) and len(node.args) >= 2):
+                continue
+            if not _is_count_decision(node):
+                continue
+            a1 = node.args[1]
+            if isinstance(a1, ast.Constant) and isinstance(a1.value, str):
+                decision = a1.value
+                used.add(decision)
+                if decision not in known and sf.rel.startswith(
+                    (PACKAGE + "/", "tools/")
+                ):
+                    # tests may pass an out-of-vocabulary member on
+                    # purpose — asserting the ValueError guard fires
+                    findings.append(
+                        make_finding(
+                            "obs-tune-decision-unknown", sf.rel,
+                            node.lineno,
+                            f"tune decision {decision!r} is not in "
+                            "DECISIONS (tune/controller.py)",
+                        )
+                    )
+            elif sf.rel.startswith(PACKAGE + "/"):
+                findings.append(
+                    make_finding(
+                        "obs-tune-decision-dynamic", sf.rel,
+                        node.lineno,
+                        "count_decision() decision is not a string "
+                        "literal — name one of DECISIONS directly",
+                    )
+                )
+    for decision in sorted(known - used):
+        findings.append(
+            make_finding(
+                "obs-tune-decision-unused",
+                f"{PACKAGE}/tune/controller.py", reg_line,
+                f"DECISIONS entry {decision!r} has no count_decision() "
+                "caller anywhere in the repo",
             )
         )
     return findings

@@ -353,6 +353,7 @@ MEGAKERNEL_AB = "megakernel_ab"
 GRAPH_LOADGEN = "graph_loadgen"
 SYSTOLIC_AB = "systolic_ab"
 FEDERATION_LOADGEN = "federation_loadgen"
+TUNE_CONVERGENCE = "tune_convergence"
 
 
 def fabric_loadgen_params() -> dict:
@@ -2060,6 +2061,198 @@ def run_megakernel_ab(
     return rec
 
 
+def tune_convergence_params() -> dict:
+    """The autotune-convergence lane knobs: the pointwise-heavy headline
+    chain (where fused-vs-off is a measured ~1.5x on CPU — the spread
+    the controller must find), serving-bucket sized. Env overrides for
+    tools/tpu_queue and tests: MCIM_TUNE_CONV_OPS/_HEIGHT/_WIDTH."""
+    on_tpu = is_tpu_backend()
+    params = {
+        "ops": "grayscale,contrast:3.5,gaussian:5,quantize:6",
+        "height": 2160 if on_tpu else 384,
+        "width": 3840 if on_tpu else 384,
+        "channels": 3,
+        "batch": 4,
+    }
+    for env, key, cast in (
+        ("MCIM_TUNE_CONV_OPS", "ops", str),
+        ("MCIM_TUNE_CONV_HEIGHT", "height", int),
+        ("MCIM_TUNE_CONV_WIDTH", "width", int),
+    ):
+        raw = env_registry.get(env)
+        if raw:
+            params[key] = cast(raw)
+    return params
+
+
+def run_tune_convergence(
+    *,
+    json_path: str | None = None,
+    printer: Callable[[str], None] = print,
+) -> dict:
+    """Online-autotuning convergence lane (tune/): the REAL control
+    loop — TuneController + CanaryGate + OnlineStore — driven by real
+    dispatch timings through the real serving executables, in one
+    process with no sockets (the multi-process version is
+    tools/tune_smoke.py; this lane measures the DYNAMICS):
+
+      * converge_s / iters_to_converge — wall time and dispatches from
+        "pinned to the slow plan, empty store" until the controller has
+        explored `plan:fused` through the canary gate (real shadow
+        comparisons against the incumbent's outputs) and promoted it;
+      * tuned vs pinned — post-convergence device throughput on the
+        promoted plan against the pinned `--plan off` baseline: the
+        payoff the loop banked, in the same MP/s units as plan_ab.
+
+    Bit-exactness is gated before any timing (fused output equals the
+    off output on the bench batch), and the in-loop shadow spot-checks
+    re-verify it the way the serving gate would."""
+    import time as _time
+
+    import numpy as np
+
+    from mpi_cuda_imagemanipulation_tpu.fabric.canary import (
+        CANARY,
+        CanaryConfig,
+        CanaryGate,
+    )
+    from mpi_cuda_imagemanipulation_tpu.obs.metrics import Registry
+    from mpi_cuda_imagemanipulation_tpu.plan.ir import pipeline_fingerprint
+    from mpi_cuda_imagemanipulation_tpu.serve.padded import make_serving_fn
+    from mpi_cuda_imagemanipulation_tpu.tune.controller import (
+        TuneConfig,
+        TuneController,
+    )
+    from mpi_cuda_imagemanipulation_tpu.tune.store import OnlineStore
+
+    p = tune_convergence_params()
+    pipe = Pipeline.parse(p["ops"])
+    B, H, W, C = p["batch"], p["height"], p["width"], p["channels"]
+    fp = pipeline_fingerprint(pipe.ops)
+    fns = {
+        arm: make_serving_fn(pipe, H, W, C, B, plan=mode)
+        for arm, mode in (("plan:off", "off"), ("plan:fused", "fused"))
+    }
+    imgs = np.stack(
+        [synthetic_image(H, W, channels=C, seed=7 + i) for i in range(B)]
+    )
+    th = np.full((B,), H - 9, np.int32)
+    tw = np.full((B,), W - 5, np.int32)
+
+    # -- bit-exactness gate before any timing (and compile warmup) ---------
+    outs = {}
+    for arm, fn in fns.items():
+        outs[arm] = np.asarray(jax.block_until_ready(fn(imgs, th, tw)))
+    if not np.array_equal(outs["plan:off"], outs["plan:fused"]):
+        raise AssertionError(
+            "tune_convergence gate: fused output mismatches --plan off"
+        )
+
+    gate = CanaryGate(
+        CanaryConfig(
+            frac=0.25, min_requests=8, shadow_every=4, promote_requests=16
+        )
+    )
+    canary_arm: dict = {"arm": None}
+
+    def deploy(flip: dict) -> None:
+        argv = flip["argv"]
+        canary_arm["arm"] = "plan:" + argv[argv.index("--plan") + 1]
+        gate.start("bench", flip)
+
+    store = OnlineStore()  # in-memory unless MCIM_TUNE arms persistence
+    ctl = TuneController(
+        gate=gate,
+        deploy=deploy,
+        pipe_fp=fp,
+        current_arm="plan:off",
+        arms=("plan:off", "plan:fused"),
+        registry=Registry(),
+        store=store,
+        config=TuneConfig(
+            tick_s=0.05,
+            min_samples=6,
+            explore_c=0.35,
+            min_gain=1.02,
+            flip_timeout_s=600.0,
+        ),
+    )
+
+    decisions: dict[str, int] = {}
+    shadow_checks = 0
+    max_iters = 3000
+    iters = 0
+    t0 = _time.perf_counter()
+    while ctl.current_arm != "plan:fused" and iters < max_iters:
+        iters += 1
+        lane_arm, lane = ctl.current_arm, "stable"
+        if gate.state == CANARY and gate.take_canary():
+            lane_arm, lane = canary_arm["arm"], "canary"
+        t1 = _time.perf_counter()
+        out = jax.block_until_ready(fns[lane_arm](imgs, th, tw))
+        dt = _time.perf_counter() - t1
+        store.record_dispatch(fp, W, lane_arm, dt / B)
+        if gate.state == CANARY:
+            gate.record(lane, True)
+            if lane == "canary" and gate.take_shadow():
+                ref = np.asarray(
+                    jax.block_until_ready(
+                        fns[ctl.current_arm](imgs, th, tw)
+                    )
+                )
+                shadow_checks += 1
+                gate.record_shadow(np.array_equal(np.asarray(out), ref))
+        d = ctl.tick()
+        decisions[d] = decisions.get(d, 0) + 1
+    converge_s = _time.perf_counter() - t0
+    if ctl.current_arm != "plan:fused":
+        raise AssertionError(
+            f"tune_convergence: not converged after {iters} dispatches: "
+            f"{ctl.status()}"
+        )
+
+    # -- the banked payoff: tuned throughput vs the pinned baseline --------
+    mp = B * int(th[0]) * int(tw[0]) / 1e6
+    tuned_sec = device_throughput(fns[ctl.current_arm], [imgs, th, tw])
+    pinned_sec = device_throughput(fns["plan:off"], [imgs, th, tw])
+    rec = {
+        "config": TUNE_CONVERGENCE,
+        "pipeline": p["ops"],
+        "impl": "tune_convergence",
+        "platform": jax.default_backend(),
+        "height": H,
+        "width": W,
+        "channels": C,
+        "batch": B,
+        "bit_exact_gate": "passed (fused vs --plan off on the bench batch)",
+        "converge_s": converge_s,
+        "iters_to_converge": iters,
+        "shadow_checks": shadow_checks,
+        "decisions": decisions,
+        "tuned_arm": ctl.current_arm,
+        "tuned_ms_per_iter": tuned_sec * 1e3,
+        "tuned_mp_per_s_per_chip": mp / tuned_sec,
+        "pinned_off_ms_per_iter": pinned_sec * 1e3,
+        "pinned_off_mp_per_s_per_chip": mp / pinned_sec,
+        "speedup_tuned_vs_pinned_off": pinned_sec / tuned_sec,
+    }
+    if is_tpu_backend():
+        rec["tpu_gen"] = _tpu_gen()
+    printer(
+        f"tune_convergence: converged to {ctl.current_arm} in "
+        f"{converge_s:.1f}s / {iters} dispatches "
+        f"({shadow_checks} shadow checks, decisions {decisions})"
+    )
+    printer(
+        f"tuned {rec['tuned_ms_per_iter']:.3f} ms/iter vs pinned off "
+        f"{rec['pinned_off_ms_per_iter']:.3f} ms/iter -> "
+        f"{rec['speedup_tuned_vs_pinned_off']:.2f}x banked"
+    )
+    if json_path:
+        emit_json_metrics(rec, None if json_path == "-" else json_path)
+    return rec
+
+
 def serve_loadgen_params() -> dict:
     """The serving-lane knobs, sized to the backend: CPU keeps the sweep
     small enough for tests/dev; real hardware gets serving-sized buckets
@@ -2751,12 +2944,22 @@ def run_suite(
         )
         if not names:
             return records
+    if names and TUNE_CONVERGENCE in names:
+        # the tune lane measures the closed control loop (controller +
+        # canary gate over real dispatch timings) converging onto the
+        # measured-faster plan, not one executable
+        names = [n for n in names if n != TUNE_CONVERGENCE]
+        records.append(
+            run_tune_convergence(json_path=json_path, printer=printer)
+        )
+        if not names:
+            return records
     if names:
         unknown = [n for n in names if n not in CONFIGS]
         if unknown:
             raise ValueError(
                 f"unknown bench config(s) {unknown}; known: "
-                f"{sorted(CONFIGS) + [ENGINE_AB, FABRIC_LOADGEN, FEDERATION_LOADGEN, GRAPH_LOADGEN, MEGAKERNEL_AB, MXU_AB, PLAN_AB, SERVE_LOADGEN, STREAM_AB, SYSTOLIC_AB]}"
+                f"{sorted(CONFIGS) + [ENGINE_AB, FABRIC_LOADGEN, FEDERATION_LOADGEN, GRAPH_LOADGEN, MEGAKERNEL_AB, MXU_AB, PLAN_AB, SERVE_LOADGEN, STREAM_AB, SYSTOLIC_AB, TUNE_CONVERGENCE]}"
             )
         selected = [CONFIGS[n] for n in names]
     else:
@@ -2855,7 +3058,8 @@ def main(argv: Sequence[str] | None = None) -> int:
         required=True,
         choices=sorted(CONFIGS)
         + [ENGINE_AB, FABRIC_LOADGEN, GRAPH_LOADGEN, MEGAKERNEL_AB, MXU_AB,
-           PLAN_AB, SERVE_LOADGEN, STREAM_AB, SYSTOLIC_AB],
+           PLAN_AB, SERVE_LOADGEN, STREAM_AB, SYSTOLIC_AB,
+           TUNE_CONVERGENCE],
     )
     ap.add_argument(
         "--impl",
@@ -2946,6 +3150,8 @@ def main(argv: Sequence[str] | None = None) -> int:
         )
     elif args.config == SYSTOLIC_AB:
         rec = run_systolic_ab(printer=lambda s: None)
+    elif args.config == TUNE_CONVERGENCE:
+        rec = run_tune_convergence(printer=lambda s: None)
     else:
         cfg = CONFIGS[args.config]
         if args.halo_mode is not None and cfg.sharded:

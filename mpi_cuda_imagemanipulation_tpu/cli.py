@@ -541,6 +541,24 @@ def _build_parser() -> argparse.ArgumentParser:
         "answer (default MCIM_SYSTOLIC)",
     )
     fab.add_argument(
+        "--tune",
+        action="store_true",
+        help="arm the continuous autotuning loop (tune/): replicas "
+        "persist serve-path observations to the calibration store and "
+        "the router's tune controller proposes config flips from them, "
+        "deploying each through the canary gate (shadow-digest "
+        "bit-exactness, burn limits) and promoting fleet-wide or "
+        "rolling back with no human in the loop — MCIM_TUNE_* env "
+        "tunes the cadence/thresholds",
+    )
+    fab.add_argument(
+        "--tune-arms",
+        default=None,
+        help="comma-separated candidate arms the controller may propose "
+        "(e.g. plan:off,plan:fused; default MCIM_TUNE_ARMS or every "
+        "plan mode real on this backend)",
+    )
+    fab.add_argument(
         "--max-replicas",
         type=int,
         default=None,
@@ -825,6 +843,23 @@ def _build_parser() -> argparse.ArgumentParser:
         "and record it in the calibration store (the measured replacement "
         "for the reference's hand-tuned compile-time BLOCK_SIZE, "
         "kernel.cu:13; see utils/calibration.py)",
+    )
+    tune.add_argument(
+        "action",
+        nargs="?",
+        choices=("run", "info"),
+        default="run",
+        help="'run' (default) sweeps and records; 'info' prints the "
+        "store's records for --ops — with --online, both the offline "
+        "sweep records AND the online observations/promotions the "
+        "continuous tuner accumulated (tune/store), plus which side the "
+        "newest-wins precedence rule would pick",
+    )
+    tune.add_argument(
+        "--online",
+        action="store_true",
+        help="with 'info': include online observations, promotions, "
+        "quarantines and the audit-trail tail next to the offline records",
     )
     tune.add_argument(
         "--ops",
@@ -1972,6 +2007,8 @@ def cmd_fabric(args: argparse.Namespace) -> int:
         queue_depth=args.queue_depth,
         impl="xla" if args.impl == "auto" else args.impl,
         plan=getattr(args, "plan", "auto"),
+        tune=args.tune,
+        tune_arms=args.tune_arms,
         heartbeat_s=args.heartbeat_s,
         router=RouterConfig(
             buckets=parse_buckets(args.buckets),
@@ -2266,6 +2303,57 @@ def cmd_diff(args: argparse.Namespace) -> int:
     return 0 if ndiff == 0 else 1
 
 
+def _autotune_info(args: argparse.Namespace) -> int:
+    """`autotune info [--online]`: the store's records for --ops — the
+    offline sweep entries, and with --online the continuous tuner's
+    observations/promotions/quarantines plus which side the newest-wins
+    precedence (tune/store.effective_plan_choice) picks."""
+    import json as _json
+
+    from mpi_cuda_imagemanipulation_tpu.ops.registry import make_pipeline_ops
+    from mpi_cuda_imagemanipulation_tpu.plan.ir import pipeline_fingerprint
+    from mpi_cuda_imagemanipulation_tpu.tune.store import (
+        effective_plan_choice,
+        online_store,
+    )
+    from mpi_cuda_imagemanipulation_tpu.utils import calibration
+
+    if args.calib_file:
+        os.environ["MCIM_CALIB_FILE"] = args.calib_file
+    fp = pipeline_fingerprint(make_pipeline_ops(args.ops))
+    try:
+        kind = calibration.current_device_kind()
+    except Exception:
+        print("error: no live backend to resolve the device kind")
+        return 1
+    offline = calibration.plan_entry(fp, device_kind=kind)
+    report: dict = {
+        "store": calibration.calib_path(),
+        "device_kind": kind,
+        "ops": args.ops,
+        "pipeline_fingerprint": fp,
+        "offline": {"plan_choice": offline},
+    }
+    if args.online:
+        windows = online_store.windows(fp, device_kind=kind)
+        report["online"] = {
+            "promoted": online_store.promoted_entry(fp, device_kind=kind),
+            "observations": {
+                w: online_store.arm_stats(fp, w, device_kind=kind)
+                for w in sorted(windows)
+            },
+            "audit_tail": online_store.audit_trail()[-10:],
+        }
+        report["effective"] = {
+            # the choice resolve_plan_mode would act on, newest wins;
+            # disagreement here is exactly what
+            # mcim_tune_stale_overrides_total counts in a serving process
+            "plan_choice": effective_plan_choice(fp, device_kind=kind),
+        }
+    print(_json.dumps(report, indent=2, sort_keys=True, default=str))
+    return 0
+
+
 def cmd_autotune(args: argparse.Namespace) -> int:
     """Sweep candidate block heights on the live backend; record the best.
 
@@ -2273,6 +2361,8 @@ def cmd_autotune(args: argparse.Namespace) -> int:
     cannot steer the sweep it is about to overwrite.
     """
     _configure_platform(args.device)
+    if args.action == "info":
+        return _autotune_info(args)
     # parse/validate ALL candidates before any expensive measurement: a
     # malformed trailing token must not discard minutes of serialized
     # chip-window work (review finding)

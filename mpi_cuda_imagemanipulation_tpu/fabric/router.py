@@ -254,6 +254,9 @@ class Router:
         GET|POST /control/canary
                                 canary gate status / deploy / abort
                                 (fabric/canary.py)
+        GET  /control/tune      tune controller status: current arm,
+                                in-flight proposal, recent decisions
+                                (tune/controller.py; Fabric tune=True)
         POST /control/profile   on-demand fleet profiling: relay a
                                 rate-limited jax.profiler capture to one
                                 replica under live traffic; the merged
@@ -311,6 +314,11 @@ class Router:
         self.on_canary_deploy = None  # callable(flip: dict) -> replica_id
         self.on_canary_rollback = None  # callable(status: dict) -> None
         self._canary_rollback_handled = False
+        # continuous autotuning (tune/controller.py); the Fabric wires a
+        # TuneController here when started with tune=True — the router
+        # only exposes its status (the controller drives canary_deploy
+        # through the same hooks as an operator flip)
+        self.tuner = None
         # live video sessions (fabric/session.py): sticky affinity +
         # journal-tail failover
         self.sessions = fabric_session.SessionTable()
@@ -2357,6 +2365,7 @@ class Router:
                 "placements": dict(self._systolic_last),
             },
             "canary": self.canary.status(),
+            "tune": self.tuner.status() if self.tuner is not None else None,
             "sessions": self.sessions.stats(),
             "autoscaler": (
                 self.autoscaler.status()
@@ -2530,6 +2539,13 @@ def _make_handler(router: Router):
                 )
             elif self.path == "/control/canary":
                 self._reply_json(200, router.canary.status())
+            elif self.path == "/control/tune":
+                self._reply_json(
+                    200,
+                    router.tuner.status()
+                    if router.tuner is not None
+                    else {"enabled": False},
+                )
             else:
                 self._reply_json(404, {"error": f"no route {self.path}"})
 

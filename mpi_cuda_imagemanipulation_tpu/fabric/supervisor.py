@@ -387,6 +387,14 @@ class FabricConfig:
     # fusion-plan mode every replica serves with (the canary deploy path
     # flips it per replica via `--plan` in the flip argv)
     plan: str = "auto"
+    # continuous autotuning (tune/): tune=True arms MCIM_TUNE=1 on every
+    # replica (observations persist to the shared calibration store) and
+    # starts a TuneController on the router that proposes config flips
+    # from those observations and promotes/rolls them back through the
+    # canary gate with no human in the loop
+    tune: bool = False
+    tune_arms: str | None = None  # comma list; None: MCIM_TUNE_ARMS/default
+    tune_config: object | None = None  # tune.controller.TuneConfig; None: env
     # pod-level systolic execution: arm the router's stage-sharding lane
     # AND start every replica with --systolic so heartbeats advertise
     # stage ownership (graph/systolic.py)
@@ -459,6 +467,12 @@ class Fabric:
         self.router.on_canary_deploy = self._canary_deploy
         self.router.on_canary_rollback = self._canary_rollback
         self._canary_stable_spec: ReplicaSpec | None = None
+        # tune controller state: a promoted flip's argv/env delta joins
+        # every FUTURE replica spec too (autoscaler scale-ups, supervisor
+        # restarts), so the fleet stays converged across churn
+        self.tuner = None
+        self._tune_argv: list[str] = []
+        self._tune_env: dict[str, str] = {}
         self.supervisor: Supervisor | None = None
         self.autoscaler = None
         # injectable like the Supervisor's (line ~245): the _wait_*
@@ -507,13 +521,23 @@ class Fabric:
         if c.heartbeat_s is not None:
             argv += ["--heartbeat-s", str(c.heartbeat_s)]
         argv += c.replica_argv_extra.get(rid, [])
+        # a tuner-promoted flip outranks the pinned config (argparse
+        # last-wins — the same mechanism as the canary flip argv)
+        argv += self._tune_argv
         return argv
 
     def _replica_spec(self, rid: str) -> ReplicaSpec:
+        tune_env = {}
+        if self.config.tune:
+            # every replica ingests + persists online observations; the
+            # configured env (user/all_replica_env) still wins on clash
+            tune_env["MCIM_TUNE"] = "1"
         return ReplicaSpec(
             replica_id=rid,
             argv=self._replica_argv(rid),
             extra_env={
+                **tune_env,
+                **self._tune_env,
                 **self.config.all_replica_env,
                 **self.config.replica_env.get(rid, {}),
             },
@@ -580,10 +604,97 @@ class Fabric:
                 # only after the seed set is serving: the loop must not
                 # misread warmup as an outage and over-spawn
                 self.autoscaler.start()
+            if self.config.tune:
+                # after the seed set is serving, like the autoscaler:
+                # the first tick must see a routable pod, not warmup
+                self._start_tuner()
         except BaseException:
             self.close(drain=False)
             raise
         return self
+
+    def _start_tuner(self) -> None:
+        from mpi_cuda_imagemanipulation_tpu.ops.registry import (
+            make_pipeline_ops,
+        )
+        from mpi_cuda_imagemanipulation_tpu.plan.ir import (
+            pipeline_fingerprint,
+        )
+        from mpi_cuda_imagemanipulation_tpu.plan.planner import (
+            resolve_plan_mode,
+        )
+        from mpi_cuda_imagemanipulation_tpu.tune.controller import (
+            TuneController,
+        )
+        from mpi_cuda_imagemanipulation_tpu.utils import env as env_registry
+
+        c = self.config
+        ops = make_pipeline_ops(c.ops)
+        width = max(w for (_h, w) in bucketing.parse_buckets(c.buckets))
+        # the arm the fleet is serving RIGHT NOW: the same resolution the
+        # replicas ran (env/calibration-aware), so the controller's
+        # incumbent matches reality even under plan='auto'
+        mode = resolve_plan_mode(ops, c.plan, backend=c.impl, width=width)
+        current_arm = f"plan:{mode}"
+        raw = c.tune_arms or env_registry.get("MCIM_TUNE_ARMS")
+        if raw:
+            arms = tuple(a.strip() for a in raw.split(",") if a.strip())
+        else:
+            arms = ("plan:off", "plan:fused")
+            try:
+                from mpi_cuda_imagemanipulation_tpu.utils.platform import (
+                    is_tpu_backend,
+                )
+
+                if is_tpu_backend():
+                    # the megakernel is a candidate only where it is real
+                    # (interpret mode would "win" nothing off-TPU)
+                    arms += ("plan:fused-pallas",)
+            except Exception:
+                pass
+        if current_arm not in arms:
+            arms = (current_arm,) + arms
+        self.tuner = TuneController(
+            gate=self.router.canary,
+            deploy=self.router.canary_deploy,
+            pipe_fp=pipeline_fingerprint(ops),
+            current_arm=current_arm,
+            arms=arms,
+            registry=self.registry,
+            on_promote=self._tune_promote,
+            on_revert=self._canary_rollback,
+            config=c.tune_config,
+        )
+        self.router.tuner = self.tuner
+        self.tuner.start()
+
+    def _tune_promote(self, flip: dict) -> None:
+        """Tuner promote hook: the canary replica already runs the flip
+        and proved it — roll the REST of the fleet onto it, one replica
+        at a time so the pod keeps serving throughout, and fold the
+        delta into the base spec so scale-ups and restarts inherit it."""
+        assert self.supervisor is not None
+        argv_extra = [str(a) for a in flip.get("argv", [])]
+        env_extra = {
+            str(k): str(v) for k, v in flip.get("env", {}).items()
+        }
+        canary_rid = self.router.canary.replica_id
+        self._tune_argv = self._tune_argv + argv_extra
+        self._tune_env = {**self._tune_env, **env_extra}
+        for rid in sorted(self.supervisor.replica_ids()):
+            if rid == canary_rid:
+                continue
+            view = self.router.table.get(rid)
+            old_inc = view.hb.incarnation if view is not None else None
+            self._log.info(
+                "tune promote: respawning %s with argv+=%s", rid, argv_extra
+            )
+            self.supervisor.respawn(rid, spec=self._replica_spec(rid))
+            self._wait_incarnation_change(rid, old_inc)
+        # the canary's one-off spec is now the fleet's config; its next
+        # respawn (supervisor restart, scale churn) rebuilds from the
+        # updated base, so the stale stable snapshot must not revive
+        self._canary_stable_spec = None
 
     # -- elastic membership (autoscaler callbacks) -------------------------
 
@@ -729,6 +840,11 @@ class Fabric:
             return json.loads(resp.read())
 
     def close(self, *, drain: bool = True, deadline_s: float = 30.0) -> None:
+        if self.tuner is not None:
+            # before the supervisor: a mid-close promote must not respawn
+            # replicas the supervisor is tearing down
+            self.tuner.stop()
+            self.tuner = None
         if self.autoscaler is not None:
             self.autoscaler.stop()
             self.autoscaler = None

@@ -335,13 +335,24 @@ def _segment_weight(
         except ValueError:
             ch_out = ch
         w = float(ch + ch_out)  # u8 in + u8 out, per pixel
+        ratio = None
         if ledger is not None:
             ratio = ledger.drift(
                 "plan", seg.plan.fingerprint, f"s{i}/{stage.kind}"
             )
-            if ratio is not None and ratio > 0:
-                w *= ratio
-                measured = True
+        if ratio is None:
+            # no live record — the online tuning store may hold one
+            # persisted by another process (tune/store; same keying)
+            from mpi_cuda_imagemanipulation_tpu.tune.store import (
+                persisted_io_scale,
+            )
+
+            ratio = persisted_io_scale(
+                seg.plan.fingerprint, f"s{i}/{stage.kind}"
+            )
+        if ratio is not None and ratio > 0:
+            w *= ratio
+            measured = True
         weight += w
         ch = ch_out
     return weight, ch, measured

@@ -281,6 +281,20 @@ class CostLedger:
                     site, key, stage, ratio, lo, hi,
                     int(cost.boundary_bytes), int(modeled_bytes),
                 )
+            if site == "plan":
+                # plan-site ratios feed the online autotuning store so
+                # OTHER processes can correct the analytical byte model
+                # (tune/store.persisted_io_scale); lazy import — obs/ must
+                # not hard-depend on tune/ — and advisory: a store hiccup
+                # never fails the attribution
+                try:
+                    from mpi_cuda_imagemanipulation_tpu.tune.store import (
+                        online_store,
+                    )
+
+                    online_store.record_io_scale(key, stage, ratio)
+                except Exception:
+                    pass
         return ratio
 
     def on_extract_failure(self, site: str) -> None:

@@ -111,12 +111,23 @@ def stage_io_scale(plan: Plan, i: int) -> float | None:
     """The measured cost-ledger drift for stage `i` of `plan` — the
     ratio of measured boundary bytes to the one-read-one-write model the
     block-height picker reserves for (obs/cost.attribute_plan records it
-    under the plan fingerprint + `s<i>/<kind>` label). None when nothing
-    was measured; the analytical VMEM model stays the fallback."""
+    under the plan fingerprint + `s<i>/<kind>` label). A live in-process
+    ledger record wins; failing that, the online tuning store's
+    PERSISTED ratio (recorded by any replica that ran this fingerprint,
+    tune/store) — so a fresh process corrects its VMEM model from fleet
+    measurement instead of starting analytical every time. None when
+    nothing was measured anywhere; the analytical model stays the
+    fallback."""
     from mpi_cuda_imagemanipulation_tpu.obs.cost import cost_ledger
 
     st = plan.stages[i]
-    return cost_ledger.drift("plan", plan.fingerprint, f"s{i}/{st.kind}")
+    label = f"s{i}/{st.kind}"
+    ratio = cost_ledger.drift("plan", plan.fingerprint, label)
+    if ratio is not None:
+        return ratio
+    from mpi_cuda_imagemanipulation_tpu.tune.store import persisted_io_scale
+
+    return persisted_io_scale(plan.fingerprint, label)
 
 
 def run_stage_pallas(

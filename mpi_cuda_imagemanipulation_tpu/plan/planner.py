@@ -96,9 +96,14 @@ def resolve_plan_mode(
     if backend in _SELF_FUSING_BACKENDS:
         return "off"
     from mpi_cuda_imagemanipulation_tpu.plan.ir import pipeline_fingerprint
-    from mpi_cuda_imagemanipulation_tpu.utils import calibration
+    from mpi_cuda_imagemanipulation_tpu.tune.store import (
+        effective_plan_choice,
+    )
 
-    calibrated = calibration.lookup_plan_choice(
+    # newest-wins across the offline autotune record and the online
+    # tuner's promoted choice (tune/store — freshness precedence;
+    # subsumes the plain calibration.lookup_plan_choice this used to do)
+    calibrated = effective_plan_choice(
         pipeline_fingerprint(ops), width=width
     )
     if calibrated is not None:

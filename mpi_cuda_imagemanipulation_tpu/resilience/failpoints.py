@@ -96,6 +96,13 @@ KNOWN_SITES = (
                         # sees pod staleness / death while the pod keeps
                         # serving — the federation mirror of
                         # replica.heartbeat one tier up
+    "tune.candidate",   # tune/controller.py _propose: a hit POISONS the
+                        # proposed flip — the candidate argv is replaced
+                        # with a pixel-corrupting ops override instead of
+                        # failing the propose — so the canary gate's
+                        # first shadow digest provably catches a
+                        # wrong-pixels flip and the tuner quarantines it,
+                        # end to end, with no client ever served from it
 )
 
 ENV_SPEC = "MCIM_FAILPOINTS"

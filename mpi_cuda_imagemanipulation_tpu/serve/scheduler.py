@@ -241,6 +241,9 @@ class MicroBatchScheduler:
         self.fallback = fallback
         self._retry_rng = random.Random(retry_seed)
         self._clock = clock
+        # bucket width -> (pipeline_fp, "plan:<mode>") memo for the online
+        # tuning observation (tune/store) recorded per dispatch
+        self._tune_keys: dict = {}
         # -- async execution engine (engine/): bounded in-flight dispatch --
         self._inflight = max(1, inflight)
         self._io_threads = max(1, io_threads)
@@ -810,6 +813,8 @@ class MicroBatchScheduler:
         batch_tid = next((r.trace_id for r in live if r.trace_id), "")
         self.metrics.on_dispatch(len(live), nb, device_s, batch_tid)
         group = live[0].group
+        if group is None:
+            self._note_tune_observation(live[0].bucket, len(live), device_s)
         # flight recorder: per-dispatch bucket summaries are the "which
         # bucket was hot" evidence a post-mortem dump aggregates
         flight_recorder.note(
@@ -838,6 +843,44 @@ class MicroBatchScheduler:
             r.trace.set(status=STATUS_OK)
             r.trace.end()
             r.done.set()
+
+    def _note_tune_observation(self, bucket, n, device_s) -> None:
+        """Feed the online autotuning store one per-image device-seconds
+        sample for this dispatch, keyed (pipeline fingerprint, bucket
+        width, resolved-plan arm). Memoized per bucket width — resolving
+        the serving plan is cached in the CompileCache but the arm string
+        need not be rebuilt per dispatch. Never allowed to fail a
+        completed dispatch: the observation is advisory."""
+        try:
+            bh, bw, ch = bucket
+            key = self._tune_keys.get(bw)
+            if key is None:
+                from mpi_cuda_imagemanipulation_tpu.plan.ir import (
+                    pipeline_fingerprint,
+                )
+                from mpi_cuda_imagemanipulation_tpu.serve.padded import (
+                    resolve_serving_plan,
+                )
+
+                built = resolve_serving_plan(
+                    self.cache.pipe, self.cache.plan, self.cache.backend, bw
+                )
+                arm = "plan:" + ("off" if built is None else built.mode)
+                key = (pipeline_fingerprint(self.cache.pipe.ops), arm)
+                self._tune_keys[bw] = key
+            pipe_fp, arm = key
+            from mpi_cuda_imagemanipulation_tpu.tune.store import (
+                online_store,
+            )
+
+            online_store.record_dispatch(
+                pipe_fp, bw, arm, device_s / max(n, 1)
+            )
+        except Exception:
+            # the dispatch already succeeded; a tuning-store hiccup (no
+            # backend, corrupt file, unexpected plan shape) must not
+            # surface as a serving error
+            pass
 
     def _note_retry(self, bucket, attempt, exc, delay_s, live=()) -> None:
         self.metrics.on_retry()

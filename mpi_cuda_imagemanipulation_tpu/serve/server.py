@@ -387,12 +387,21 @@ class ServeApp:
         (obs/fleet.py): the app registry (serve + engine + gauges incl.
         devmem), the module-level plan registry (plan builds report
         there, and serving rebuilds on calibration flips are
-        fleet-relevant), and the cost ledger (obs/cost — drift ratios
-        and measured executable costs per replica)."""
+        fleet-relevant), the cost ledger (obs/cost — drift ratios
+        and measured executable costs per replica), and the online
+        tuning registry (tune/metrics — observation flow per replica,
+        so the router's federated view shows the control loop's inputs
+        arriving)."""
         from mpi_cuda_imagemanipulation_tpu.obs.cost import cost_ledger
         from mpi_cuda_imagemanipulation_tpu.plan.metrics import plan_metrics
+        from mpi_cuda_imagemanipulation_tpu.tune.metrics import tune_metrics
 
-        return [self.registry, plan_metrics.registry, cost_ledger.registry]
+        return [
+            self.registry,
+            plan_metrics.registry,
+            cost_ledger.registry,
+            tune_metrics.registry,
+        ]
 
     def fleet_snapshot(self) -> dict:
         """A FULL federation snapshot (the replica's `GET /fleet/snapshot`

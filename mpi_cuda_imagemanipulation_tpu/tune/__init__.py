@@ -1,0 +1,24 @@
+"""Continuous autotuning — the fleet consumes its own telemetry.
+
+Offline `mcim-tpu autotune` sweeps (PR 9/13) answer "which config is
+fastest" once, on an idle device, and write the answer to the
+calibration store. This package closes the loop at serving time:
+
+  * `store` — online observations (dispatch timings from the serve
+    scheduler, measured boundary-byte ratios from the cost ledger)
+    accumulate under the SAME `(device_kind, pipeline_fingerprint,
+    width_window)` keys the offline sweeps use, in bounded reservoirs
+    with staleness decay, persisted through the calibration file's
+    atomic-rename machinery.
+  * `controller` — a UCB-style explore/exploit engine on the router's
+    tick that ranks candidate config flips from those observations and
+    deploys winners through the PR 12 canary gate: one replica respawns
+    with the flip, shadow digests prove bit-exactness, and the flip is
+    promoted fleet-wide or rolled back with no human in the loop. One
+    digest mismatch quarantines the candidate in the store.
+  * `metrics` — the `mcim_tune_*` family, federated to the router like
+    `mcim_plan_*` so the fleet view shows the control loop working.
+
+Decisions use a closed vocabulary (`controller.DECISIONS`) through a
+single `count_decision` choke point, enforced by mcim-check.
+"""

@@ -36,6 +36,7 @@ from __future__ import annotations
 import json
 import os
 import tempfile
+import time as _time
 
 from mpi_cuda_imagemanipulation_tpu.utils import env as env_registry
 
@@ -292,7 +293,13 @@ def record_plan_choice(
     device_kind: str, pipeline_fp: str, choice: str, **extra
 ) -> str:
     """Write/replace the (device kind, pipeline fingerprint) plan choice;
-    returns the store path. Same atomic-write contract as record_block_h."""
+    returns the store path. Same atomic-write contract as record_block_h.
+
+    Stamps ``recorded_at`` (epoch seconds) unless the caller supplied one:
+    the online tuner (tune/store.effective_plan_choice) resolves
+    offline-vs-online disagreement by freshness, and an unstamped entry
+    would silently lose every comparison. Legacy entries without the
+    stamp sort as oldest."""
     if choice not in PLAN_CHOICES:
         raise ValueError(
             f"unknown plan choice {choice!r}; known: {PLAN_CHOICES}"
@@ -301,7 +308,63 @@ def record_plan_choice(
     table = kind_rec.setdefault(_PLAN_KEY, {})
     if not isinstance(table, dict):  # legacy/corrupt entry: replace
         table = kind_rec[_PLAN_KEY] = {}
+    extra.setdefault("recorded_at", round(_time.time(), 3))
     table[pipeline_fp] = {"choice": choice, **extra}
+    return _write_store(data)
+
+
+def plan_entry(
+    pipeline_fp: str | None,
+    device_kind: str | None = None,
+    width: int | None = None,
+) -> dict | None:
+    """The raw offline plan-choice entry for (fingerprint, device kind),
+    width-window filtered — `{"choice", "width"?, "recorded_at"?, ...}`.
+
+    Unlike lookup_plan_choice this exposes the entry's METADATA, which the
+    online tuner needs for its newest-wins precedence rule. Same
+    MCIM_NO_CALIB and factor-of-two width-window gating."""
+    if pipeline_fp is None or env_registry.get(_ENV_DISABLE):
+        return None
+    if device_kind is None:
+        try:
+            device_kind = current_device_kind()
+        except Exception:
+            return None
+    rec = entries().get(device_kind)
+    if not isinstance(rec, dict):
+        return None
+    table = rec.get(_PLAN_KEY)
+    if not isinstance(table, dict):
+        return None
+    ent = table.get(pipeline_fp)
+    if not isinstance(ent, dict) or ent.get("choice") not in PLAN_CHOICES:
+        return None
+    rec_w = ent.get("width")
+    if (
+        width is not None
+        and isinstance(rec_w, (int, float))
+        and rec_w > 0
+        and not (rec_w / 2 <= width <= rec_w * 2)
+    ):
+        return None
+    return ent
+
+
+def raw_store() -> dict:
+    """A DEEP COPY of the parsed store (or {} when absent/corrupt).
+
+    The online tuner (tune/store) keeps its records in a sibling
+    top-level section of the same file; it mutates this copy and hands it
+    to write_raw_store. A copy, not the cached dict: _load's cache is
+    shared process-wide and callers must not alter it in place."""
+    return json.loads(json.dumps(_load()))
+
+
+def write_raw_store(data: dict) -> str:
+    """Atomically replace the whole store file (tmp + rename, same
+    contract as record_block_h). Callers merge into raw_store() output
+    first — this is a whole-file swap, not a patch."""
     return _write_store(data)
 
 

@@ -408,6 +408,54 @@ _VARS = (
     EnvVar("MCIM_HEDGE_MAX_FRAC", "0.05", "fabric/router.py",
            "Cap on hedges as a fraction of accepted requests (on top of "
            "the retry-budget withdrawal each hedge makes)."),
+    # -- continuous autotuning (tune/) ---------------------------------------
+    EnvVar("MCIM_TUNE", "0", "tune/store.py",
+           "=1 arms the online autotuning loop: serve-path observations "
+           "persist to the calibration store and the router's tune "
+           "controller proposes/promotes config flips through the canary "
+           "gate (fabric --tune sets it on every replica)."),
+    EnvVar("MCIM_TUNE_TICK_S", "1.0", "tune/controller.py",
+           "Tune controller decision-tick period (seconds)."),
+    EnvVar("MCIM_TUNE_MIN_SAMPLES", "8", "tune/controller.py",
+           "Effective observations an arm needs before the controller "
+           "will exploit against it (below this: insufficient_data / "
+           "explore)."),
+    EnvVar("MCIM_TUNE_EXPLORE_C", "0.35", "tune/controller.py",
+           "UCB exploration coefficient — widens the optimistic lower "
+           "confidence bound on under-sampled arms; 0 = pure greedy."),
+    EnvVar("MCIM_TUNE_MIN_GAIN", "1.05", "tune/controller.py",
+           "Measured speedup a candidate must hold over the current arm "
+           "to be proposed/promoted (1.05 = 5% — flips below this are "
+           "churn, not wins)."),
+    EnvVar("MCIM_TUNE_FLIP_TIMEOUT_S", "300", "tune/controller.py",
+           "A promoted-by-the-gate flip that has produced no canary "
+           "measurements after this long is reverted (rollback decision)."),
+    EnvVar("MCIM_TUNE_CANARY_FRAC", None, "tune/controller.py",
+           "Traffic fraction routed to a tuner-proposed canary replica "
+           "(overrides the pod's CanaryConfig.frac for tuner flips only)."),
+    EnvVar("MCIM_TUNE_ARMS", None, "fabric/supervisor.py",
+           "Comma-separated candidate arms the controller may propose "
+           "(e.g. plan:off,plan:fused); default: every plan mode the "
+           "pipeline supports."),
+    EnvVar("MCIM_TUNE_STALE_S", "900", "tune/store.py",
+           "Staleness half-life for online observations (seconds): a "
+           "sample this old carries half the weight of a fresh one; "
+           "samples older than 8 half-lives are dropped."),
+    EnvVar("MCIM_TUNE_RESERVOIR", "64", "tune/store.py",
+           "Max online samples kept per (device kind, fingerprint, "
+           "width window, arm) — newest kept, oldest dropped."),
+    EnvVar("MCIM_TUNE_FLUSH_S", "1.0", "tune/store.py",
+           "Min seconds between online-record merges into the "
+           "calibration file (observation ingestion is in-memory "
+           "between flushes)."),
+    EnvVar("MCIM_TUNE_CONV_OPS", None, "bench_suite.py",
+           "tune_convergence lane: pipeline override (default the "
+           "pointwise-heavy headline chain, where fused-vs-off is the "
+           "measured spread the controller must find)."),
+    EnvVar("MCIM_TUNE_CONV_HEIGHT", None, "bench_suite.py",
+           "tune_convergence lane: bucket height override."),
+    EnvVar("MCIM_TUNE_CONV_WIDTH", None, "bench_suite.py",
+           "tune_convergence lane: bucket width override."),
     # -- chaos harness (resilience/chaos.py, tools/chaos_smoke.py) -----------
     EnvVar("MCIM_CHAOS_SEED", None, "tools/chaos_smoke.py",
            "Comma-separated ChaosSchedule seeds the chaos smoke runs "

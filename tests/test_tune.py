@@ -1,0 +1,476 @@
+"""Continuous autotuning (tune/): store, precedence, controller table.
+
+The control loop's whole value is that it is mechanical: decayed
+reservoirs in, a closed-vocabulary decision out, actuation only through
+the canary gate. These tests drive every row of that table with fake
+clocks and injected gates/callables — no sockets, no subprocesses — plus
+the structural measured-bytes-override contract: wherever a
+fingerprint-keyed measurement exists, the analytical byte model is NOT
+the input to `_pick_block_h` or the chain balancer's stage scoring.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from mpi_cuda_imagemanipulation_tpu.fabric import canary as fabric_canary
+from mpi_cuda_imagemanipulation_tpu.obs.metrics import Registry
+from mpi_cuda_imagemanipulation_tpu.resilience import failpoints
+from mpi_cuda_imagemanipulation_tpu.tune import store as tune_store
+from mpi_cuda_imagemanipulation_tpu.tune.controller import (
+    DECISIONS,
+    TuneConfig,
+    TuneController,
+    count_decision,
+)
+from mpi_cuda_imagemanipulation_tpu.tune.store import (
+    OnlineStore,
+    effective_plan_choice,
+    width_window,
+)
+from mpi_cuda_imagemanipulation_tpu.utils import calibration
+
+FP = "cafe0123deadbeef"
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+@pytest.fixture()
+def calib_file(tmp_path, monkeypatch):
+    path = tmp_path / "calib.json"
+    monkeypatch.setenv("MCIM_CALIB_FILE", str(path))
+    monkeypatch.delenv("MCIM_NO_CALIB", raising=False)
+    monkeypatch.delenv("MCIM_TUNE", raising=False)
+    calibration._cache["key"] = None
+    yield path
+    calibration._cache["key"] = None
+
+
+@pytest.fixture()
+def cpu_kind(monkeypatch):
+    # unit tests must not initialize a backend just to name the device
+    monkeypatch.setattr(tune_store, "_device_kind", lambda: "cpu")
+
+
+def _store(clock) -> OnlineStore:
+    return OnlineStore(clock=clock)
+
+
+def _feed(store, arm, values, width=512, fp=FP):
+    for v in values:
+        store.record_dispatch(fp, width, arm, v)
+
+
+# -- store: reservoirs, decay, persistence ----------------------------------
+
+
+def test_width_window_factor_two_anchors():
+    assert width_window(512) == "512"
+    assert width_window(500) == "256"  # shares the offline lookup window
+    assert width_window(1023) == "512"
+    assert width_window(1024) == "1024"
+
+
+def test_reservoir_caps_and_merges(calib_file, cpu_kind, monkeypatch):
+    monkeypatch.setenv("MCIM_TUNE", "1")
+    monkeypatch.setenv("MCIM_TUNE_RESERVOIR", "4")
+    clock = FakeClock()
+    store = _store(clock)
+    for i in range(10):
+        clock.advance(1.0)
+        store.record_dispatch(FP, 512, "plan:off", 0.01 + i * 1e-4)
+    store.flush(force=True)
+    data = json.loads(calib_file.read_text())
+    samples = data["online"]["cpu"]["obs"][FP]["512"]["plan:off"]["samples"]
+    assert len(samples) == 4  # newest-wins cap
+    assert samples[-1][1] == pytest.approx(0.01 + 9e-4)
+    # a second process's flush MERGES rather than clobbers
+    other = _store(clock)
+    clock.advance(1.0)
+    other.record_dispatch(FP, 512, "plan:fused", 0.005)
+    other.flush(force=True)
+    data = json.loads(calib_file.read_text())
+    arms = data["online"]["cpu"]["obs"][FP]["512"]
+    assert set(arms) == {"plan:off", "plan:fused"}
+
+
+def test_staleness_decay_and_drop(calib_file, cpu_kind, monkeypatch):
+    monkeypatch.setenv("MCIM_TUNE_STALE_S", "100")
+    clock = FakeClock()
+    store = _store(clock)
+    _feed(store, "plan:off", [0.02])
+    clock.advance(100.0)  # one half-life
+    _feed(store, "plan:off", [0.01])
+    stats = store.arm_stats(FP, "512")["plan:off"]
+    # weights 0.5 (old) + 1.0 (fresh): mean pulled toward the fresh value
+    assert stats["n"] == 2
+    assert stats["n_eff"] == pytest.approx(1.5, abs=0.01)
+    assert stats["mean"] == pytest.approx((0.5 * 0.02 + 1.0 * 0.01) / 1.5)
+    # past 8 half-lives the first sample is gone entirely
+    clock.advance(701.0)
+    stats = store.arm_stats(FP, "512")["plan:off"]
+    assert stats["n"] == 1
+
+
+def test_observations_not_persisted_unless_armed(
+    calib_file, cpu_kind, monkeypatch
+):
+    clock = FakeClock()
+    store = _store(clock)
+    _feed(store, "plan:off", [0.01])
+    assert store.flush() is None  # MCIM_TUNE unset: in-memory only
+    assert not calib_file.exists()
+    monkeypatch.setenv("MCIM_TUNE", "1")
+    assert store.flush() is not None
+    assert calib_file.exists()
+
+
+def test_io_scale_roundtrip_and_clamp(calib_file, cpu_kind, monkeypatch):
+    monkeypatch.setenv("MCIM_TUNE", "1")
+    clock = FakeClock()
+    store = _store(clock)
+    store.record_io_scale("planfp", "s0/fused", 1.7)
+    store.flush(force=True)
+    fresh = _store(clock)  # reads the file only
+    assert fresh.io_scale("planfp", "s0/fused") == pytest.approx(1.7)
+    # module-level fallback clamps to the ledger's sanity band
+    monkeypatch.setattr(
+        tune_store.online_store, "io_scale", lambda *a, **k: 97.0
+    )
+    assert tune_store.persisted_io_scale("planfp", "s0/fused") == 4.0
+
+
+# -- freshness precedence (offline vs online) --------------------------------
+
+
+def test_effective_plan_choice_newest_wins(calib_file, cpu_kind):
+    before = tune_store.tune_metrics.stale_overrides.value()
+    calibration.record_plan_choice(
+        "cpu", FP, "off", width=512, recorded_at=1000.0
+    )
+    tune_store.online_store.reset()
+    clock = FakeClock(2000.0)
+    store = tune_store.online_store
+    store._clock = clock
+    store.promote(FP, 512, "fused")
+    try:
+        # online promotion is newer -> it wins, and the override counts
+        assert (
+            effective_plan_choice(FP, device_kind="cpu", width=512)
+            == "fused"
+        )
+        assert tune_store.tune_metrics.stale_overrides.value() == before + 1
+        # a FRESHER offline sweep takes the key back
+        calibration.record_plan_choice(
+            "cpu", FP, "off", width=512, recorded_at=3000.0
+        )
+        assert (
+            effective_plan_choice(FP, device_kind="cpu", width=512) == "off"
+        )
+        # agreement is not an override
+        calibration.record_plan_choice(
+            "cpu", FP, "fused", width=512, recorded_at=1500.0
+        )
+        n = tune_store.tune_metrics.stale_overrides.value()
+        assert (
+            effective_plan_choice(FP, device_kind="cpu", width=512)
+            == "fused"
+        )
+        assert tune_store.tune_metrics.stale_overrides.value() == n
+    finally:
+        store.reset()
+        store._clock = tune_store._now
+
+
+def test_record_plan_choice_stamps_recorded_at(calib_file):
+    calibration.record_plan_choice("cpu", FP, "fused", width=512)
+    ent = calibration.plan_entry(FP, device_kind="cpu")
+    assert isinstance(ent["recorded_at"], float) and ent["recorded_at"] > 0
+
+
+# -- measured bytes override the analytical model (structural) ---------------
+
+
+def test_stage_io_scale_falls_back_to_persisted(
+    calib_file, cpu_kind, monkeypatch
+):
+    """plan/pallas_exec.stage_io_scale: live ledger record wins; a
+    persisted online record is the cross-process fallback; analytical
+    (None) only when neither exists."""
+    monkeypatch.setenv("MCIM_TUNE", "1")
+    from mpi_cuda_imagemanipulation_tpu.ops.registry import (
+        make_pipeline_ops,
+    )
+    from mpi_cuda_imagemanipulation_tpu.plan.pallas_exec import (
+        stage_io_scale,
+    )
+    from mpi_cuda_imagemanipulation_tpu.plan.planner import build_plan
+
+    plan = build_plan(make_pipeline_ops("grayscale,emboss:3"), "fused")
+    label = f"s0/{plan.stages[0].kind}"
+    assert stage_io_scale(plan, 0) is None  # nothing measured anywhere
+    store = tune_store.online_store
+    store.reset()
+    try:
+        store.record_io_scale(plan.fingerprint, label, 1.6)
+        store.flush(force=True)
+        assert stage_io_scale(plan, 0) == pytest.approx(1.6)
+    finally:
+        store.reset()
+
+
+def test_pick_block_h_shrinks_under_measured_io_scale():
+    from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
+        _pick_block_h,
+    )
+
+    base = _pick_block_h(4096, 1, 1, 2)
+    measured = _pick_block_h(4096, 1, 1, 2, io_scale=2.0)
+    assert measured < base  # the measurement, not the model, sized VMEM
+
+
+def test_segment_weight_uses_persisted_scale(
+    calib_file, cpu_kind, monkeypatch
+):
+    """graph/compile._segment_weight: with NO live ledger, a persisted
+    online io_scale still scales the one-read-one-write weight and marks
+    the segment as measured."""
+    monkeypatch.setenv("MCIM_TUNE", "1")
+    from mpi_cuda_imagemanipulation_tpu.graph.compile import (
+        RunSegment,
+        _segment_weight,
+    )
+    from mpi_cuda_imagemanipulation_tpu.ops.registry import (
+        make_pipeline_ops,
+    )
+    from mpi_cuda_imagemanipulation_tpu.plan.planner import build_plan
+
+    plan = build_plan(make_pipeline_ops("grayscale"), "fused")
+    seg = RunSegment(dst="n1", src="src", plan=plan)
+    w0, _, measured0 = _segment_weight(seg, 3, None)
+    assert not measured0
+    store = tune_store.online_store
+    store.reset()
+    try:
+        for i, st in enumerate(plan.stages):
+            store.record_io_scale(plan.fingerprint, f"s{i}/{st.kind}", 2.0)
+        store.flush(force=True)
+        w1, _, measured1 = _segment_weight(seg, 3, None)
+        assert measured1 and w1 == pytest.approx(2.0 * w0)
+    finally:
+        store.reset()
+
+
+# -- controller decision table ------------------------------------------------
+
+
+def _gate(**over) -> fabric_canary.CanaryGate:
+    cfg = dict(
+        frac=0.5,
+        min_requests=2,
+        shadow_every=2,
+        bad_frac=0.5,
+        burn_ratio=2.0,
+        promote_requests=4,
+    )
+    cfg.update(over)
+    return fabric_canary.CanaryGate(fabric_canary.CanaryConfig(**cfg))
+
+
+def _controller(store, clock, gate=None, **cfg_over):
+    gate = gate or _gate()
+    deployed: list[dict] = []
+    promoted: list[dict] = []
+    reverted: list[dict] = []
+
+    def deploy(flip):
+        deployed.append(flip)
+        gate.start("r1", flip)
+
+    cfg = dict(
+        tick_s=0.01,
+        min_samples=3,
+        explore_c=0.35,
+        min_gain=1.05,
+        flip_timeout_s=60,
+    )
+    cfg.update(cfg_over)
+    ctl = TuneController(
+        gate=gate,
+        deploy=deploy,
+        pipe_fp=FP,
+        current_arm="plan:off",
+        arms=("plan:off", "plan:fused"),
+        registry=Registry(),
+        on_promote=promoted.append,
+        on_revert=reverted.append,
+        store=store,
+        config=TuneConfig(**cfg),
+        clock=clock,
+    )
+    return ctl, deployed, promoted, reverted
+
+
+def test_closed_vocabulary_raises_on_unknown():
+    r = Registry()
+    c = r.counter("mcim_tune_decisions_total", "t", labels=("decision",))
+    for d in DECISIONS:
+        count_decision(c, d)
+    with pytest.raises(ValueError, match="unknown tune decision"):
+        count_decision(c, "yolo-deploy")
+
+
+def test_insufficient_data_then_explore_propose(calib_file, cpu_kind):
+    clock = FakeClock()
+    store = _store(clock)
+    ctl, deployed, _, _ = _controller(store, clock)
+    assert ctl.tick() == "insufficient_data"  # empty store
+    _feed(store, "plan:off", [0.010, 0.011, 0.010])
+    # incumbent measured, candidate unmeasured -> optimistic exploration
+    assert ctl.tick() == "propose"
+    assert deployed[0] == {"argv": ["--plan", "fused"]}
+    assert ctl.gate.state == fabric_canary.CANARY
+    assert ctl.tick() == "hold"  # gate deciding; one flip at a time
+
+
+def test_exploit_requires_min_gain(calib_file, cpu_kind):
+    clock = FakeClock()
+    store = _store(clock)
+    _feed(store, "plan:off", [0.010] * 4)
+    _feed(store, "plan:fused", [0.0099] * 4)  # ~1% faster: churn, not a win
+    ctl, deployed, _, _ = _controller(store, clock, explore_c=0.0)
+    assert ctl.tick() == "hold"
+    assert deployed == []
+    # a real 1.5x gap (the measured off-vs-fused CPU spread) proposes
+    store2 = _store(clock)
+    _feed(store2, "plan:off", [0.015] * 4)
+    _feed(store2, "plan:fused", [0.010] * 4)
+    ctl2, deployed2, _, _ = _controller(store2, clock, explore_c=0.0)
+    assert ctl2.tick() == "propose"
+    assert deployed2[0] == {"argv": ["--plan", "fused"]}
+
+
+def test_promote_arithmetic_and_fleet_hook(calib_file, cpu_kind):
+    clock = FakeClock()
+    store = _store(clock)
+    _feed(store, "plan:off", [0.015] * 4)
+    ctl, deployed, promoted, _ = _controller(store, clock, explore_c=0.0)
+    assert ctl.tick() == "propose"  # explore the unmeasured candidate
+    # the canary serves: outcomes clear the gate's promote window while
+    # dispatch observations accumulate under the candidate arm
+    for _ in range(4):
+        ctl.gate.record("canary", True)
+    assert ctl.gate.state == fabric_canary.PROMOTED
+    _feed(store, "plan:fused", [0.010] * 4)
+    assert ctl.tick() == "promote"
+    assert promoted == [{"argv": ["--plan", "fused"]}]
+    assert ctl.current_arm == "plan:fused"
+    assert ctl.gate.state == fabric_canary.IDLE  # reset for the next flip
+    # the promotion is in the store for resolve_plan_mode to see
+    ent = store.promoted_entry(FP, device_kind="cpu")
+    assert ent["choice"] == "fused" and ent["width"] == 512
+
+
+def test_gate_passed_but_slower_reverts_without_quarantine(
+    calib_file, cpu_kind
+):
+    clock = FakeClock()
+    store = _store(clock)
+    _feed(store, "plan:off", [0.010] * 4)
+    ctl, _, promoted, reverted = _controller(store, clock, explore_c=0.0)
+    assert ctl.tick() == "propose"
+    for _ in range(4):
+        ctl.gate.record("canary", True)
+    _feed(store, "plan:fused", [0.011] * 4)  # safe, but a loss
+    assert ctl.tick() == "rollback"
+    assert promoted == [] and len(reverted) == 1
+    assert not store.is_quarantined(FP, "plan:fused")  # decay may flip it
+    assert ctl.current_arm == "plan:off"
+
+
+def test_flip_timeout_reverts(calib_file, cpu_kind):
+    clock = FakeClock()
+    store = _store(clock)
+    _feed(store, "plan:off", [0.010] * 4)
+    ctl, _, _, reverted = _controller(
+        store, clock, explore_c=0.0, flip_timeout_s=30
+    )
+    assert ctl.tick() == "propose"
+    for _ in range(4):
+        ctl.gate.record("canary", True)  # gate happy, but no measurements
+    assert ctl.tick() == "hold"  # inside the timeout: wait
+    clock.advance(31.0)
+    assert ctl.tick() == "rollback"
+    assert len(reverted) == 1
+
+
+def test_breach_quarantines_and_never_reproposes(calib_file, cpu_kind):
+    clock = FakeClock()
+    store = _store(clock)
+    _feed(store, "plan:off", [0.015] * 4)
+    _feed(store, "plan:fused", [0.010] * 4)
+    ctl, deployed, _, _ = _controller(store, clock, explore_c=0.0)
+    assert ctl.tick() == "propose"
+    # one shadow digest mismatch = instant rollback, no grace
+    assert ctl.gate.record_shadow(False) == fabric_canary.ROLLED_BACK
+    assert ctl.tick() == "rollback"
+    assert store.is_quarantined(FP, "plan:fused")
+    # the measured 1.5x win no longer matters: quarantine is a ban
+    assert ctl.tick() == "hold"
+    assert len(deployed) == 1
+
+
+def test_poisoned_candidate_deploys_corrupting_flip(calib_file, cpu_kind):
+    """The tune.candidate failpoint swaps the proposed flip for a
+    pixel-corrupting ops override — the CI drill proving the shadow
+    digest catches a wrong-pixels flip (the gate side is exercised by
+    tools/tune_smoke.py against real replicas)."""
+    clock = FakeClock()
+    store = _store(clock)
+    _feed(store, "plan:off", [0.015] * 4)
+    _feed(store, "plan:fused", [0.010] * 4)
+    ctl, deployed, _, _ = _controller(store, clock, explore_c=0.0)
+    failpoints.configure("tune.candidate=always")
+    try:
+        assert ctl.tick() == "propose"
+    finally:
+        failpoints.clear()
+    assert deployed == [{"argv": ["--ops", "invert"]}]
+
+
+def test_every_decision_lands_in_audit_trail(calib_file, cpu_kind):
+    clock = FakeClock()
+    store = _store(clock)
+    ctl, _, _, _ = _controller(store, clock)
+    ctl.tick()
+    _feed(store, "plan:off", [0.010] * 4)
+    ctl.tick()
+    trail = store.audit_trail()
+    assert [e["decision"] for e in trail] == [
+        "insufficient_data",
+        "propose",
+    ]
+    assert all(d in DECISIONS for d in (e["decision"] for e in trail))
+
+
+def test_status_payload_shape(calib_file, cpu_kind):
+    clock = FakeClock()
+    store = _store(clock)
+    ctl, _, _, _ = _controller(store, clock)
+    ctl.tick()
+    s = ctl.status()
+    assert s["current_arm"] == "plan:off"
+    assert s["last_decision"] == "insufficient_data"
+    assert s["events"][-1]["decision"] == "insufficient_data"

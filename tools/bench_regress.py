@@ -63,6 +63,13 @@ METRIC_FIELDS: dict[str, list[tuple[str, bool]]] = {
     # client deadline must not sag, and the hedged tail must not creep
     # back toward the brownout floor
     "chaos_loadgen": [("goodput_rps", True), ("e2e_p99_ms", False)],
+    # the autotune-convergence lane (tune/): the loop must not get
+    # slower to converge, and the throughput it converges ONTO must not
+    # sag (the banked payoff is the whole point of the loop)
+    "tune_convergence": [
+        ("converge_s", False),
+        ("tuned_mp_per_s_per_chip", True),
+    ],
 }
 _DEFAULT_FIELDS: list[tuple[str, bool]] = [
     ("mp_per_s_per_chip", True),

@@ -1,0 +1,60 @@
+#!/bin/bash
+# Continuous autotuning on silicon (round 7, ISSUE 19): the closed
+# control loop — online dispatch observations -> UCB candidate ranking
+# -> canary-gated actuation — measured where the arms are real
+# (fused-pallas is a candidate only on TPU; is_tpu_backend gates it).
+#
+# One script, two deliverables:
+#
+#   tune_convergence  the bench lane appended to BENCH_HISTORY.jsonl:
+#                     wall time + dispatch count from "pinned to the
+#                     slow plan, empty store" until the controller has
+#                     explored the fast arm through the canary gate
+#                     (real shadow comparisons) and promoted it, plus
+#                     the tuned-vs-pinned MP/s payoff. On TPU the open
+#                     question is whether the loop finds fused-pallas
+#                     (the megakernel's win is real on chip, interpret
+#                     elsewhere) — set MCIM_TUNE_ARMS to widen the arm
+#                     set once 31_burndown's plan records exist.
+#                     tools/bench_regress.py tracks converge_s down and
+#                     tuned_mp_per_s_per_chip up.
+#   tune smoke        the multi-process proof against a REAL pod:
+#                     2 replicas pinned slow converge under offered
+#                     load with zero unavailable responses, a poisoned
+#                     candidate (tune.candidate failpoint) is caught by
+#                     the FIRST shadow digest and quarantined, and the
+#                     federated mcim_tune_* exposition parses.
+#
+# Knobs: MCIM_TUNE_CONV_OPS / _HEIGHT / _WIDTH (lane shape),
+# MCIM_TUNE_ARMS (candidate set), MCIM_TUNE_MIN_GAIN.
+# Budget: ~6-10 min.
+set -u
+cd "$(dirname "$0")/../.."
+. tools/tpu_queue/_lib.sh
+out=artifacts/tune_r07.out
+: > "$out"
+timeout 900 python -m mpi_cuda_imagemanipulation_tpu.bench_suite \
+  --config tune_convergence \
+  --json-metrics artifacts/tune_convergence_r07.json >> "$out" 2>&1 || true
+# promote the lane record into the history (the bench_regress input)
+python - >> "$out" 2>&1 <<'EOF' || true
+import datetime, json, subprocess
+rec = json.load(open("artifacts/tune_convergence_r07.json"))
+sha = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                     capture_output=True, text=True).stdout.strip()
+line = {"ts": datetime.datetime.now(datetime.timezone.utc)
+        .strftime("%Y-%m-%dT%H:%M:%SZ"),
+        "records": [rec],
+        "note": "tune_convergence on silicon (round 7): the control "
+                "loop converging on real chip timings",
+        "git_sha": sha}
+with open("BENCH_HISTORY.jsonl", "a") as f:
+    f.write(json.dumps(line) + "\n")
+EOF
+timeout 900 python tools/tune_smoke.py \
+  artifacts/tune_metrics_r07.prom \
+  artifacts/tune_smoke_r07.json >> "$out" 2>&1 || true
+commit_artifacts "TPU window: autotune convergence + tune smoke (round 7)" \
+  "$out" BENCH_HISTORY.jsonl artifacts/tune_convergence_r07.json \
+  artifacts/tune_metrics_r07.prom artifacts/tune_smoke_r07.json
+exit 0

@@ -1,0 +1,298 @@
+#!/usr/bin/env python
+"""Continuous-autotuning CI smoke: a real 2-replica pod on CPU, pinned
+to the SLOW plan, converges to the fast one with no human in the loop —
+then a poisoned candidate proves the bit-exactness tripwire.
+
+    python tools/tune_smoke.py METRICS_OUT SUMMARY_OUT
+
+Asserts, against a REAL pod (replica worker processes, real HTTP):
+
+  1. CONVERGENCE: the pod starts with `--plan off` (measured ~1.5x
+     slower than fused on the headline chain — BENCH_HISTORY plan_ab).
+     Under offered load the serve path streams dispatch timings into
+     the online calibration store, the tune controller explores the
+     unmeasured `plan:fused` arm through the canary gate, the canary's
+     own measurements beat the incumbent, and the whole fleet is
+     respawned onto the flip: `/control/tune` reports
+     current_arm=plan:fused and both replicas serve the fused plan.
+     Zero responses count unavailable; stable-lane responses stay
+     bit-exact against the golden pipeline throughout.
+  2. POISONED FLIP: with the `tune.candidate` failpoint armed in the
+     router process, the controller's next proposal is swapped for a
+     pixel-corrupting ops override. The FIRST shadow digest spot-check
+     catches it: the gate rolls back instantly, the Fabric respawns the
+     stable config, a `canary_rollback` recorder dump carries
+     shadow.mismatch >= 1, and the arm is quarantined in the store so
+     it is never proposed again.
+  3. EXPOSITION: the router's federated /metrics parses
+     (`obs.metrics.parse_exposition`) and carries the `mcim_tune_*`
+     families from BOTH processes: controller decisions from the router
+     registry, dispatch observations federated up from the replicas.
+
+METRICS_OUT gets the final federated exposition; SUMMARY_OUT a JSON
+record (convergence latency, decision counts) for CI artifacts.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# the headline chain: pointwise-heavy, where the fused plan's single
+# HBM pass is a measured ~1.5x over per-op dispatch on CPU
+OPS = "grayscale,contrast:3.5,gaussian:5,quantize:6"
+BUCKETS = "384"
+
+
+def _build_cfg(tmp: str):
+    from mpi_cuda_imagemanipulation_tpu.fabric.canary import CanaryConfig
+    from mpi_cuda_imagemanipulation_tpu.fabric.router import RouterConfig
+    from mpi_cuda_imagemanipulation_tpu.fabric.supervisor import FabricConfig
+    from mpi_cuda_imagemanipulation_tpu.serve.bucketing import parse_buckets
+    from mpi_cuda_imagemanipulation_tpu.tune.controller import TuneConfig
+
+    return FabricConfig(
+        replicas=2,
+        ops=OPS,
+        buckets=BUCKETS,
+        channels="3",
+        max_batch=4,
+        max_delay_ms=4.0,
+        queue_depth=32,
+        heartbeat_s=0.2,
+        plan="off",  # pinned SLOW: convergence must be earned
+        tune=True,
+        tune_arms="plan:off,plan:fused",
+        tune_config=TuneConfig(
+            tick_s=0.25,
+            min_samples=6,
+            explore_c=0.35,
+            min_gain=1.02,
+            flip_timeout_s=120.0,
+            canary_frac=0.25,
+        ),
+        router=RouterConfig(
+            buckets=parse_buckets(BUCKETS),
+            stale_s=0.8,
+            forward_attempts=3,
+            canary=CanaryConfig(
+                frac=0.25, shadow_every=2, min_requests=8,
+                promote_requests=20,
+            ),
+        ),
+    )
+
+
+def main(metrics_out: str, summary_out: str) -> int:
+    tmp = tempfile.mkdtemp(prefix="tune_smoke_")
+    rec_dir = os.path.join(tmp, "recorder")
+    os.environ["MCIM_RECORDER_DIR"] = rec_dir
+    os.environ["MCIM_RECORDER_MIN_INTERVAL_S"] = "0"
+    # the shared measurement bus: replicas flush dispatch observations
+    # here, the router-process controller ranks from it
+    os.environ["MCIM_CALIB_FILE"] = os.path.join(tmp, "calib.json")
+    os.environ.pop("MCIM_NO_CALIB", None)
+    os.environ["MCIM_TUNE"] = "1"
+    os.environ["MCIM_TUNE_FLUSH_S"] = "0.25"
+
+    from mpi_cuda_imagemanipulation_tpu.fabric.supervisor import Fabric
+    from mpi_cuda_imagemanipulation_tpu.io.image import (
+        decode_image_bytes,
+        synthetic_image,
+    )
+    from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline
+    from mpi_cuda_imagemanipulation_tpu.obs.metrics import parse_exposition
+    from mpi_cuda_imagemanipulation_tpu.ops.registry import make_pipeline_ops
+    from mpi_cuda_imagemanipulation_tpu.plan.ir import pipeline_fingerprint
+    from mpi_cuda_imagemanipulation_tpu.resilience import failpoints
+    from mpi_cuda_imagemanipulation_tpu.serve import loadgen
+    from mpi_cuda_imagemanipulation_tpu.tune.store import online_store
+
+    pipe = Pipeline.parse(OPS)
+    pipe_fp = pipeline_fingerprint(make_pipeline_ops(OPS))
+    imgs = [
+        synthetic_image(300 + 7 * i, 340 + 5 * i, channels=3, seed=40 + i)
+        for i in range(4)
+    ]
+    blobs = [loadgen.encode_blob(im) for im in imgs]
+    golden = [np.asarray(pipe.jit()(im)) for im in imgs]
+    summary: dict = {"ops": OPS, "buckets": BUCKETS, "pipe_fp": pipe_fp}
+
+    def check_bit_exact(results) -> int:
+        n = 0
+        for k, r in results:
+            if r["code"] != 200:
+                continue
+            np.testing.assert_array_equal(
+                decode_image_bytes(r["body"]), golden[k % len(golden)]
+            )
+            n += 1
+        return n
+
+    def run_load(fab, stop, recs):
+        while not stop.is_set():
+            recs.append(
+                loadgen.http_run_offered_load(
+                    fab.url, blobs, 40.0, 1.0, max_workers=32,
+                    timeout_s=30.0,
+                )
+            )
+
+    # ---- 1. convergence: pinned slow -> promoted fast ---------------------
+    t0 = time.monotonic()
+    stop, recs = threading.Event(), []
+    with Fabric(_build_cfg(tmp)).start() as fab:
+        assert fab.tuner is not None, "fabric --tune did not start a tuner"
+        loader = threading.Thread(
+            target=run_load, args=(fab, stop, recs), daemon=True
+        )
+        loader.start()
+        deadline = time.monotonic() + 240.0
+        while time.monotonic() < deadline:
+            if fab.tuner.current_arm == "plan:fused":
+                break
+            time.sleep(0.2)
+        converge_s = time.monotonic() - t0
+        st = fab.http_stats()["tune"]
+        assert st is not None and st["current_arm"] == "plan:fused", (
+            f"pod never converged to plan:fused: {fab.tuner.status()}"
+        )
+        decisions = [e["decision"] for e in fab.tuner.events]
+        assert "propose" in decisions and "promote" in decisions, decisions
+        print(
+            f"smoke: converged plan:off -> plan:fused in {converge_s:.1f}s "
+            f"(decisions: {decisions})"
+        )
+        # the promotion is durable: a fresh process would resolve fused
+        ent = online_store.promoted_entry(pipe_fp)
+        assert ent is not None and ent["choice"] == "fused", ent
+        # ... and the FLEET runs it: every replica was respawned with the
+        # flip argv (argparse last-wins over the pinned --plan off)
+        for rid in fab.supervisor.replica_ids():
+            argv = fab.supervisor.spec_of(rid).argv
+            assert argv[-2:] == ["--plan", "fused"], (rid, argv)
+        stop.set()
+        loader.join(timeout=60.0)
+        unavailable = sum(r["unavailable"] for r in recs)
+        assert unavailable == 0, (
+            f"{unavailable} responses went dark during autotuning — the "
+            "control loop must be invisible to clients"
+        )
+        checked = check_bit_exact(
+            [kv for rec in recs[:2] for kv in rec["results"]]
+        )
+        print(
+            f"smoke: load clean ({len(recs)} windows, unavailable 0, "
+            f"{checked} pre-flip responses bit-exact)"
+        )
+        summary.update(
+            converge_s=round(converge_s, 2),
+            load_windows=len(recs),
+            shed=sum(r["shed"] for r in recs),
+            decisions=decisions,
+        )
+
+        # ---- 2. poisoned candidate: shadow digest -> rollback ------------
+        # re-arm the drill IN THE SAME POD: force the bookkept incumbent
+        # back to off so the controller must re-propose the (measured
+        # faster) fused arm — but this time the failpoint swaps the flip
+        # for a pixel-corrupting one before it reaches the gate
+        fab.tuner.stop()
+        fab.tuner.current_arm = "plan:off"
+        failpoints.configure("tune.candidate=always")
+        try:
+            stop2, recs2 = threading.Event(), []
+            loader2 = threading.Thread(
+                target=run_load, args=(fab, stop2, recs2), daemon=True
+            )
+            loader2.start()
+            fab.tuner.start()
+            deadline = time.monotonic() + 240.0
+            while time.monotonic() < deadline:
+                if online_store.is_quarantined(pipe_fp, "plan:fused"):
+                    break
+                time.sleep(0.2)
+            stop2.set()
+            loader2.join(timeout=60.0)
+            assert online_store.is_quarantined(pipe_fp, "plan:fused"), (
+                f"poisoned flip never quarantined: {fab.tuner.status()}"
+            )
+        finally:
+            failpoints.clear()
+        fab.tuner.stop()
+        assert "rollback" in [
+            e["decision"] for e in fab.tuner.events
+        ], fab.tuner.status()
+        assert fab.tuner.current_arm == "plan:off"
+        dumps = sorted(
+            p for p in os.listdir(rec_dir)
+            if p.startswith("recorder_canary_rollback")
+        )
+        assert dumps, f"no canary_rollback dump in {rec_dir}"
+        with open(os.path.join(rec_dir, dumps[-1])) as f:
+            dump = json.load(f)
+        assert dump["extra"]["shadow"]["mismatch"] >= 1, dump["extra"]
+        print(
+            f"smoke: poisoned flip rolled back on shadow digest "
+            f"({dump['extra']['reason']!r}) and quarantined; dump "
+            f"{dumps[-1]}"
+        )
+        # quarantine means BANNED: ticks settle on hold, never re-propose
+        for _ in range(3):
+            d = fab.tuner.tick()
+            assert d in ("hold", "insufficient_data"), d
+        # the pod serves bit-exact stable traffic again after the revert
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            if fab.router.canary.status()["state"] == "idle":
+                break
+            time.sleep(0.2)
+        r = loadgen.http_post_image(fab.url, blobs[0])
+        assert r["code"] == 200
+        np.testing.assert_array_equal(
+            decode_image_bytes(r["body"]), golden[0]
+        )
+        summary.update(
+            poison_rollback_reason=dump["extra"]["reason"],
+            quarantined=True,
+        )
+
+        # ---- 3. federated mcim_tune_* exposition parses -------------------
+        text = fab.scrape()
+    families = parse_exposition(text)  # raises on malformed lines
+    tune_fams = sorted(f for f in families if f.startswith("mcim_tune_"))
+    assert "mcim_tune_decisions_total" in tune_fams, tune_fams
+    assert "mcim_tune_observations_total" in tune_fams, (
+        "replica dispatch observations did not federate up: "
+        f"{tune_fams}"
+    )
+    decided = {
+        labels: v
+        for (name, labels), v in
+        families["mcim_tune_decisions_total"]["samples"].items()
+    }
+    assert any("promote" in k for k in decided), decided
+    assert any("rollback" in k for k in decided), decided
+    with open(metrics_out, "w") as f:
+        f.write(text)
+    summary["tune_families"] = tune_fams
+    with open(summary_out, "w") as f:
+        json.dump(summary, f, indent=2, sort_keys=True)
+    print(
+        f"smoke: federated exposition parses ({len(tune_fams)} mcim_tune_* "
+        f"families) -> {metrics_out}; summary -> {summary_out}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) != 3:
+        print(__doc__)
+        sys.exit(2)
+    sys.exit(main(sys.argv[1], sys.argv[2]))
