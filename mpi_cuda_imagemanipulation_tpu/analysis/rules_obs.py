@@ -104,6 +104,26 @@ rule(
     "when every fallback site names its reason as a string literal.",
 )
 rule(
+    "obs-mxu-stage-fallback-unknown", "obs",
+    "count_stage_fallback() names a reason missing from "
+    "STAGE_FALLBACK_REASONS in ops/mxu_kernels.py (the typo'd reason "
+    "would raise at count time — on the VPU landing that exists to "
+    "never wrong a pixel).",
+)
+rule(
+    "obs-mxu-stage-fallback-unused", "obs",
+    "A STAGE_FALLBACK_REASONS entry has no count_stage_fallback() "
+    "caller anywhere — an in-stage ineligibility lane the metrics "
+    "cannot see (the silent-ineligibility gap this vocabulary closes).",
+)
+rule(
+    "obs-mxu-stage-fallback-dynamic", "obs",
+    "count_stage_fallback() called with a non-literal reason in package "
+    "code — the closed STAGE_FALLBACK_REASONS vocabulary is only "
+    "machine-checkable when every fallback site names its reason as a "
+    "string literal.",
+)
+rule(
     "obs-fed-reroute-unknown", "obs",
     "count_reroute() names a reason missing from REROUTE_REASONS in "
     "federation/frontdoor.py (the typo'd reason would raise at count "
@@ -227,6 +247,7 @@ def check_obs(repo: Repo):
     findings.extend(_check_exemplars(repo))
     findings.extend(_check_recorder_triggers(repo))
     findings.extend(_check_systolic_fallbacks(repo))
+    findings.extend(_check_mxu_stage_fallbacks(repo))
     findings.extend(_check_fed_reroutes(repo))
     findings.extend(_check_deadline_vocab(repo))
     findings.extend(_check_graph_taxonomy(repo))
@@ -692,6 +713,95 @@ def _check_systolic_fallbacks(repo: Repo) -> list:
                 f"{PACKAGE}/graph/systolic.py", reg_line,
                 f"FALLBACK_REASONS entry {reason!r} has no "
                 "count_fallback() caller anywhere in the repo",
+            )
+        )
+    return findings
+
+
+# -- mxu in-stage fallback reasons (ops/mxu_kernels.py) -----------------------
+
+
+def _known_stage_fallback_reasons(repo: Repo) -> tuple[set[str], int]:
+    sf = repo.by_rel.get(f"{PACKAGE}/ops/mxu_kernels.py")
+    if sf is None:
+        return set(), 0
+    for node in sf.tree.body:
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if (
+                    isinstance(tgt, ast.Name)
+                    and tgt.id == "STAGE_FALLBACK_REASONS"
+                ):
+                    vals = {
+                        e.value
+                        for e in ast.walk(node.value)
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)
+                    }
+                    return vals, node.lineno
+    return set(), 0
+
+
+def _is_count_stage_fallback(node: ast.Call) -> bool:
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr == "count_stage_fallback"
+    return isinstance(fn, ast.Name) and fn.id == "count_stage_fallback"
+
+
+def _check_mxu_stage_fallbacks(repo: Repo) -> list:
+    """The mxu-in-stage fallback vocabulary is closed exactly like the
+    systolic one: every count_stage_fallback(counter, reason) site must
+    name a STAGE_FALLBACK_REASONS literal, and every entry must have a
+    caller. Unlike the systolic checker, the DEFINING file is scanned
+    too — the arm resolver (stage_arm_for) lives next to the vocabulary,
+    so its count sites are the primary callers."""
+    findings = []
+    known, reg_line = _known_stage_fallback_reasons(repo)
+    if not known:
+        return findings
+    used: set[str] = set()
+    for sf in repo.files:
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Call) and len(node.args) >= 2):
+                continue
+            if not _is_count_stage_fallback(node):
+                continue
+            a1 = node.args[1]
+            if isinstance(a1, ast.Constant) and isinstance(a1.value, str):
+                reason = a1.value
+                used.add(reason)
+                if reason not in known and sf.rel.startswith(
+                    (PACKAGE + "/", "tools/")
+                ):
+                    # tests may pass an out-of-vocabulary reason on
+                    # purpose — asserting the ValueError guard fires
+                    findings.append(
+                        make_finding(
+                            "obs-mxu-stage-fallback-unknown", sf.rel,
+                            node.lineno,
+                            f"mxu-in-stage fallback reason {reason!r} is "
+                            "not in STAGE_FALLBACK_REASONS "
+                            "(ops/mxu_kernels.py)",
+                        )
+                    )
+            elif sf.rel.startswith(PACKAGE + "/"):
+                findings.append(
+                    make_finding(
+                        "obs-mxu-stage-fallback-dynamic", sf.rel,
+                        node.lineno,
+                        "count_stage_fallback() reason is not a string "
+                        "literal — name one of STAGE_FALLBACK_REASONS "
+                        "directly",
+                    )
+                )
+    for reason in sorted(known - used):
+        findings.append(
+            make_finding(
+                "obs-mxu-stage-fallback-unused",
+                f"{PACKAGE}/ops/mxu_kernels.py", reg_line,
+                f"STAGE_FALLBACK_REASONS entry {reason!r} has no "
+                "count_stage_fallback() caller anywhere in the repo",
             )
         )
     return findings

@@ -350,6 +350,7 @@ FABRIC_LOADGEN = "fabric_loadgen"
 STREAM_AB = "stream_ab"
 PLAN_AB = "plan_ab"
 MEGAKERNEL_AB = "megakernel_ab"
+MXU_FUSED_AB = "mxu_fused_ab"
 GRAPH_LOADGEN = "graph_loadgen"
 SYSTOLIC_AB = "systolic_ab"
 FEDERATION_LOADGEN = "federation_loadgen"
@@ -2061,6 +2062,205 @@ def run_megakernel_ab(
     return rec
 
 
+def mxu_fused_ab_params() -> dict:
+    """The in-stage-MXU A/B knobs: a three-stencil chain mixing a
+    separable Gaussian, a dense 3x3 and a wide box — every op int8-
+    provable, so the int8 arm covers the whole stage — at 8K on real
+    hardware, a CPU-sized shape otherwise. Env overrides for
+    tools/tpu_queue and tests: MCIM_MXU_FUSED_AB_OPS/_HEIGHT/_WIDTH."""
+    on_tpu = is_tpu_backend()
+    params = {
+        "ops": "gaussian:5,sharpen,box:5",
+        "height": 4320 if on_tpu else 256,
+        "width": 7680 if on_tpu else 384,
+    }
+    for env, key, cast in (
+        ("MCIM_MXU_FUSED_AB_OPS", "ops", str),
+        ("MCIM_MXU_FUSED_AB_HEIGHT", "height", int),
+        ("MCIM_MXU_FUSED_AB_WIDTH", "width", int),
+    ):
+        raw = env_registry.get(env)
+        if raw:
+            params[key] = cast(raw)
+    return params
+
+
+def run_mxu_fused_ab(
+    *,
+    json_path: str | None = None,
+    printer: Callable[[str], None] = print,
+) -> dict:
+    """The MXU-inside-the-megakernel bench lane (round 8): one fused
+    stage, four executions of the same chain —
+
+      * off            — `--plan off`, the per-op golden reference;
+      * fused_vpu      — the megakernel with every in-stage op on the
+                         VPU shift-multiply walk (MCIM_MXU_STAGE=off;
+                         the incumbent the new arms must beat);
+      * fused_mxu      — the megakernel with every eligible op as a
+                         bf16 `lax.dot_general` contraction INSIDE the
+                         pallas_call body (mxu_stage='f32');
+      * fused_mxu_int8 — the int8-accumulation variant where
+                         mxu_int8_ok proves exactness (mxu_stage='int8');
+      * mxu_whole_op   — the PR-13 whole-op banded backend (one XLA
+                         launch per op, HBM round trip between ops) —
+                         the baseline that isolates what VMEM residency
+                         adds ON TOP of MXU throughput.
+
+    All lanes are gated bit-identical to the golden per-op chain on
+    three odd shapes BEFORE any timing. Off-TPU the fused lanes time the
+    Pallas INTERPRETER, where the banded dot's ~(B+2h)/kw arithmetic
+    inflation is paid at VPU-equivalent FLOPs — the committed CPU record
+    is the gate + regression anchor, never a perf claim (the dot only
+    wins where a real MXU makes its FLOPs free);
+    tools/tpu_queue/36_mxu_fused_r08.sh carries the on-chip A/B against
+    the BASELINE.md pre-registered targets. The record reports the
+    resolved per-op arms so a silently-ineligible run is visible."""
+    import numpy as np
+
+    from mpi_cuda_imagemanipulation_tpu.ops.mxu_kernels import (
+        mxu_int8_ok,
+        pipeline_mxu,
+        stage_arm_for,
+    )
+    from mpi_cuda_imagemanipulation_tpu.plan import build_plan
+    from mpi_cuda_imagemanipulation_tpu.plan.pallas_exec import (
+        plan_callable_pallas,
+    )
+
+    p = mxu_fused_ab_params()
+    pipe = Pipeline.parse(p["ops"])
+    plan_vpu = build_plan(pipe.ops, "fused-pallas")
+    plan_mxu = build_plan(pipe.ops, "fused-pallas-mxu")
+    lanes: dict[str, Callable] = {
+        "off": pipe.jit(plan="off"),
+        "fused_vpu": jax.jit(
+            plan_callable_pallas(plan_vpu, mxu_stage="off")
+        ),
+        "fused_mxu": jax.jit(
+            plan_callable_pallas(plan_mxu, mxu_stage="f32")
+        ),
+        "fused_mxu_int8": jax.jit(
+            plan_callable_pallas(plan_mxu, mxu_stage="int8")
+        ),
+        "mxu_whole_op": jax.jit(lambda x: pipeline_mxu(pipe.ops, x)),
+    }
+
+    # -- bit-exactness gate before any timing (vs the golden chain) --------
+    for th, tw, seed in ((48, 64, 1), (37, 200, 2), (130, 384, 3)):
+        timg = jnp.asarray(synthetic_image(th, tw, channels=1, seed=seed))
+        golden = np.asarray(pipe(timg))
+        for lane, fn in lanes.items():
+            got = np.asarray(fn(timg))
+            if not np.array_equal(got, golden):
+                raise AssertionError(
+                    f"mxu_fused_ab gate: lane {lane!r} mismatches golden "
+                    f"at {th}x{tw}"
+                )
+
+    img = jnp.asarray(
+        synthetic_image(p["height"], p["width"], channels=1, seed=99)
+    )
+    mp = p["height"] * p["width"] / 1e6
+    hbm_bytes = 2 * p["height"] * p["width"]  # one u8 read + one u8 write
+    on_tpu = is_tpu_backend()
+    gen = _tpu_gen() if on_tpu else None
+    arms = {
+        op.name: {
+            "arm": stage_arm_for(op, width=p["width"], setting="on"),
+            "int8_proven": mxu_int8_ok(op),
+        }
+        for op in pipe.ops
+    }
+    lane_recs: dict[str, dict] = {}
+    for lane, fn in lanes.items():
+        try:
+            sec = device_throughput(fn, [img])
+        except Exception as e:  # one lane failing must not kill the A/B
+            lane_recs[lane] = {"error": str(e)[:200]}
+            continue
+        lr = {
+            "ms_per_iter": sec * 1e3,
+            "mp_per_s_per_chip": mp / sec,
+            "hbm_gb_s_model": hbm_bytes / sec / 1e9,
+        }
+        if on_tpu:
+            lr["roofline_frac"] = lr["hbm_gb_s_model"] / HBM_GB_S.get(
+                gen, HBM_GB_S["v5e"]
+            )
+        lane_recs[lane] = lr
+    ok = {k: v for k, v in lane_recs.items() if "error" not in v}
+
+    def _speedup(a: str, b: str):  # lane a over lane b (>1: a faster)
+        if a in ok and b in ok:
+            return ok[b]["ms_per_iter"] / ok[a]["ms_per_iter"]
+        return None
+
+    mxu_lanes = [k for k in ("fused_mxu", "fused_mxu_int8") if k in ok]
+    best_mxu = (
+        min(mxu_lanes, key=lambda k: ok[k]["ms_per_iter"])
+        if mxu_lanes else None
+    )
+    rec = {
+        "config": MXU_FUSED_AB,
+        "pipeline": p["ops"],
+        "impl": "mxu_fused_ab",
+        "platform": jax.default_backend(),
+        "interpret_mode": not on_tpu,
+        "height": p["height"],
+        "width": p["width"],
+        "bit_exact_gate": "passed (3 shapes x 5 lanes vs golden)",
+        "lanes": lane_recs,
+        "stage_arms": arms,
+        "best_mxu_lane": best_mxu,
+        "speedup_fused_mxu_vs_fused_vpu": (
+            _speedup(best_mxu, "fused_vpu") if best_mxu else None
+        ),
+        "speedup_fused_mxu_f32_vs_fused_vpu": _speedup(
+            "fused_mxu", "fused_vpu"
+        ),
+        "speedup_fused_mxu_int8_vs_f32": _speedup(
+            "fused_mxu_int8", "fused_mxu"
+        ),
+        "speedup_fused_mxu_vs_whole_op": (
+            _speedup(best_mxu, "mxu_whole_op") if best_mxu else None
+        ),
+    }
+    if on_tpu:
+        rec["tpu_gen"] = gen
+    printer(
+        f"{'lane':15s} {'ms/iter':>9s} {'MP/s/chip':>11s} {'roofline':>9s}"
+    )
+    for lane, lr in lane_recs.items():
+        if "error" in lr:
+            printer(f"{lane:15s} ERROR {lr['error'][:80]}")
+            continue
+        rl = (
+            f"{lr['roofline_frac'] * 100:8.1f}%"
+            if "roofline_frac" in lr
+            else f"{'-':>9s}"
+        )
+        printer(
+            f"{lane:15s} {lr['ms_per_iter']:9.3f} "
+            f"{lr['mp_per_s_per_chip']:11.0f} {rl}"
+        )
+    for name, a in arms.items():
+        printer(
+            f"  op {name}: arm={a['arm']}"
+            + (" (int8 proven)" if a["int8_proven"] else "")
+        )
+    sp = rec["speedup_fused_mxu_vs_fused_vpu"]
+    if sp is not None:
+        printer(
+            f"fused-mxu ({best_mxu}) {sp:.2f}x vs fused-vpu"
+            + (" (INTERPRET mode — gate record, not a perf claim)"
+               if rec["interpret_mode"] else "")
+        )
+    if json_path:
+        emit_json_metrics(rec, None if json_path == "-" else json_path)
+    return rec
+
+
 def tune_convergence_params() -> dict:
     """The autotune-convergence lane knobs: the pointwise-heavy headline
     chain (where fused-vs-off is a measured ~1.5x on CPU — the spread
@@ -2916,6 +3116,16 @@ def run_suite(
         )
         if not names:
             return records
+    if names and MXU_FUSED_AB in names:
+        # the in-stage-MXU lane compares execution ARMS of one megakernel
+        # stage (VPU walk vs f32/int8 dot contraction) plus the whole-op
+        # MXU baseline, so it owns its own lane axis like megakernel_ab
+        names = [n for n in names if n != MXU_FUSED_AB]
+        records.append(
+            run_mxu_fused_ab(json_path=json_path, printer=printer)
+        )
+        if not names:
+            return records
     if names and GRAPH_LOADGEN in names:
         # the pipeline-service lane measures the graph door vs the chain
         # door of one serving stack (plus the multi-tenant mix), not one
@@ -2959,7 +3169,7 @@ def run_suite(
         if unknown:
             raise ValueError(
                 f"unknown bench config(s) {unknown}; known: "
-                f"{sorted(CONFIGS) + [ENGINE_AB, FABRIC_LOADGEN, FEDERATION_LOADGEN, GRAPH_LOADGEN, MEGAKERNEL_AB, MXU_AB, PLAN_AB, SERVE_LOADGEN, STREAM_AB, SYSTOLIC_AB, TUNE_CONVERGENCE]}"
+                f"{sorted(CONFIGS) + [ENGINE_AB, FABRIC_LOADGEN, FEDERATION_LOADGEN, GRAPH_LOADGEN, MEGAKERNEL_AB, MXU_AB, MXU_FUSED_AB, PLAN_AB, SERVE_LOADGEN, STREAM_AB, SYSTOLIC_AB, TUNE_CONVERGENCE]}"
             )
         selected = [CONFIGS[n] for n in names]
     else:
@@ -3058,7 +3268,7 @@ def main(argv: Sequence[str] | None = None) -> int:
         required=True,
         choices=sorted(CONFIGS)
         + [ENGINE_AB, FABRIC_LOADGEN, GRAPH_LOADGEN, MEGAKERNEL_AB, MXU_AB,
-           PLAN_AB, SERVE_LOADGEN, STREAM_AB, SYSTOLIC_AB,
+           MXU_FUSED_AB, PLAN_AB, SERVE_LOADGEN, STREAM_AB, SYSTOLIC_AB,
            TUNE_CONVERGENCE],
     )
     ap.add_argument(
@@ -3144,6 +3354,8 @@ def main(argv: Sequence[str] | None = None) -> int:
         rec = run_plan_ab(printer=lambda s: None)
     elif args.config == MEGAKERNEL_AB:
         rec = run_megakernel_ab(printer=lambda s: None)
+    elif args.config == MXU_FUSED_AB:
+        rec = run_mxu_fused_ab(printer=lambda s: None)
     elif args.config == GRAPH_LOADGEN:
         rec = run_graph_loadgen(
             printer=lambda s: None, tenants=args.tenants

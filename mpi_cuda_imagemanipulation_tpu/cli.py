@@ -80,7 +80,8 @@ def _add_trace_flags(sp: argparse.ArgumentParser) -> None:
 def _add_plan_flag(sp: argparse.ArgumentParser) -> None:
     sp.add_argument(
         "--plan",
-        choices=("auto", "off", "pointwise", "fused", "fused-pallas"),
+        choices=("auto", "off", "pointwise", "fused", "fused-pallas",
+                 "fused-pallas-mxu"),
         default="auto",
         help="fusion-planner execution structure (plan/): 'off' runs "
         "op-by-op (the golden reference — one HBM pass and, sharded, one "
@@ -89,7 +90,10 @@ def _add_plan_flag(sp: argparse.ArgumentParser) -> None:
         "temporally blocks consecutive stencils behind ONE grown-halo "
         "exchange per stage; 'fused-pallas' lowers each eligible fused "
         "stage into ONE VMEM-resident Pallas megakernel (one HBM read + "
-        "one write per stage; per-op fallback otherwise); 'auto' "
+        "one write per stage; per-op fallback otherwise); "
+        "'fused-pallas-mxu' additionally forces eligible stencils inside "
+        "each megakernel onto MXU dot contractions (per-op-within-stage "
+        "arms; ops/mxu_kernels); 'auto' "
         "consults the calibration store (`autotune --dimension plan`), "
         "then the backend default. Bit-identical output in every mode",
     )
@@ -2326,6 +2330,12 @@ def _autotune_info(args: argparse.Namespace) -> int:
     except Exception:
         print("error: no live backend to resolve the device kind")
         return 1
+    from mpi_cuda_imagemanipulation_tpu.ops.mxu_kernels import (
+        STAGE_FALLBACK_REASONS,
+        STAGE_ARMS,
+    )
+    from mpi_cuda_imagemanipulation_tpu.plan.metrics import plan_metrics
+
     offline = calibration.plan_entry(fp, device_kind=kind)
     report: dict = {
         "store": calibration.calib_path(),
@@ -2333,6 +2343,22 @@ def _autotune_info(args: argparse.Namespace) -> int:
         "ops": args.ops,
         "pipeline_fingerprint": fp,
         "offline": {"plan_choice": offline},
+        # the per-op-within-stage MXU dimension (round 8): the calibrated
+        # stage_arm table plus this process's counted arm landings and
+        # closed-vocabulary fallback reasons — a silently-ineligible
+        # fleet shows up here, not in a debugger
+        "mxu_in_stage": {
+            "stage_arms": calibration.stage_arm_entries(kind),
+            "ops_by_arm": {
+                a: int(plan_metrics.mxu_stage_ops.value(arm=a))
+                for a in STAGE_ARMS
+                if a != "vpu"
+            },
+            "fallbacks_by_reason": {
+                r: int(plan_metrics.mxu_stage_fallbacks.value(reason=r))
+                for r in STAGE_FALLBACK_REASONS
+            },
+        },
     }
     if args.online:
         windows = online_store.windows(fp, device_kind=kind)
@@ -2708,6 +2734,7 @@ def _autotune_plan(args: argparse.Namespace, ops) -> int:
     # interpret-mode timing must never win a plan record
     if is_tpu_backend() or args.allow_interpret:
         modes.append("fused-pallas")
+        modes.append("fused-pallas-mxu")
     else:
         print(
             "fused-pallas lane skipped off-TPU (interpret-mode timings "

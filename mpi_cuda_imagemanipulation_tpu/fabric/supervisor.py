@@ -648,8 +648,9 @@ class Fabric:
 
                 if is_tpu_backend():
                     # the megakernel is a candidate only where it is real
-                    # (interpret mode would "win" nothing off-TPU)
-                    arms += ("plan:fused-pallas",)
+                    # (interpret mode would "win" nothing off-TPU); same
+                    # for its forced-MXU-arms variant (round 8)
+                    arms += ("plan:fused-pallas", "plan:fused-pallas-mxu")
             except Exception:
                 pass
         if current_arm not in arms:

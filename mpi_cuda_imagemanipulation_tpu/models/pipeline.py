@@ -35,10 +35,14 @@ BACKENDS = ("xla", "pallas", "swar", "mxu", "auto")
 # blocks consecutive stencils (one grown halo per stage); 'fused-pallas'
 # executes each eligible fused stage as ONE VMEM-resident Pallas
 # megakernel (plan/pallas_exec.py — intermediates never touch HBM);
+# 'fused-pallas-mxu' is the megakernel with the per-op in-stage MXU dot
+# contractions forced on (ops/mxu_kernels.stage_arm_for — the tuner's
+# arm for "VMEM residency AND matrix-unit throughput at once");
 # 'auto' resolves per (pipeline, backend, device kind, width) through the
 # calibration store — `autotune --dimension plan` records the measured
 # winner, and fused-pallas enters auto routing only behind such a win
-PLAN_MODES = ("auto", "off", "pointwise", "fused", "fused-pallas")
+PLAN_MODES = ("auto", "off", "pointwise", "fused", "fused-pallas",
+              "fused-pallas-mxu")
 
 def _silence_unused_donation_warning() -> None:
     """Donation here is opportunistic: shape-changing pipelines (e.g.
@@ -102,12 +106,15 @@ class Pipeline:
         if mode == "off":
             return None, None
         built = build_plan(self.ops, mode)
-        if mode == "fused-pallas":
+        if mode in ("fused-pallas", "fused-pallas-mxu"):
             from mpi_cuda_imagemanipulation_tpu.plan.pallas_exec import (
                 plan_callable_pallas,
             )
 
-            return plan_callable_pallas(built, impl=backend), built
+            return plan_callable_pallas(
+                built, impl=backend,
+                mxu_stage="on" if mode == "fused-pallas-mxu" else None,
+            ), built
         return plan_callable(built, impl=backend), built
 
     def _callable(

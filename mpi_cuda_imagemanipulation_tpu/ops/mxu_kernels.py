@@ -78,12 +78,52 @@ calibration store records a measured per-device-kind win for the family
 (``mcim-tpu autotune --dimension backend``; utils/calibration.py) — or
 the MCIM_PREFER_MXU=1 A/B switch is set (TPU-only, like
 MCIM_PREFER_SWAR).
+
+**In-stage contraction (``stage_valid_mxu``, round 8).** The whole-op
+route above and the fused-pallas megakernel (ops/pallas_kernels.py)
+were mutually exclusive: an MXU-eligible stencil inside a fused stage
+ran on the VPU inside the ``pallas_call``. ``stage_valid_mxu`` is the
+same banded contraction emitted INSIDE the stage kernel body — a 2-D
+``lax.dot_general`` per 128-wide block, kh row-shifted views stacked on
+the contracting axis so one dot covers the whole (row offset, band
+position) reduction. The carry planes between in-stage ops are exact
+u8-integer-valued f32 (every pointwise core maps exact integers to
+exact integers and each stencil re-quantizes), so the whole-op
+exactness argument transfers verbatim. Backend choice becomes
+per-op-WITHIN-stage (``stage_arm_for``): 'vpu' (the golden walk),
+'mxu' (bf16 operands, f32 accumulation) or 'mxu-int8' (operands shifted
+by -128 into int8, int32 accumulation, the +128*sum(w) correction
+re-added in f32 — exact because every intermediate is an integer below
+2^24; ``mxu_int8_ok`` proves the |w| <= 127 operand bound). Arms key
+the calibration store's ``stage_arm`` table; every MXU-capable op that
+lands on the VPU inside a fused stage is counted under a closed reason
+vocabulary (``count_stage_fallback`` ->
+mcim_plan_mxu_in_stage_fallback_total) instead of dropping the signal.
+
+**Morphology widening (SparStencil retargeting, round 8).** erode /
+dilate (``reduce`` 'min'/'max' over a square all-ones structuring
+element) gain a whole-op MXU identity via threshold decomposition:
+y = sum_t [window_reduce(x) > t] for t in 0..254, and [max > t] ==
+[windowsum([x > t]) >= 1], [min > t] == [windowsum([x > t]) == K^2] —
+the rank reduce becomes counted ones-windowsums, i.e. banded matmuls
+with all-ones taps (the structured-sparsity max-plus retargeting of
+arxiv 2506.22969 made exact by counting). Indicator planes for m
+thresholds pack base M = K^2 + 1 into one f32 plane (digits never
+carry: a window holds at most K^2 ones), m chosen so the packed
+windowsum M^m - 1 < 2^24 keeps every f32 intermediate exact; digits
+extract in int32. Packed values exceed 256, so BOTH banded passes stay
+f32 (never bf16). ~ceil(255/m) rounds make this an honest
+calibration-gated candidate (it will lose at small K on most chips) —
+but forced ``impl='mxu'`` now covers the family bit-exactly instead of
+falling back, and the eligibility gate finally matches the paper's
+coverage claim.
 """
 
 from __future__ import annotations
 
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from mpi_cuda_imagemanipulation_tpu.ops.spec import (
     F32,
@@ -191,6 +231,23 @@ def _sep_taps(op: StencilOp) -> tuple[float, ...] | None:
     return tuple(float(v) for v in ta)
 
 
+def _morph_ok(op: StencilOp) -> bool:
+    """Whether the threshold-decomposition morphology identity (module
+    docstring) applies: min/max reduce over a square all-ones structuring
+    element — exactly what make_morph builds."""
+    if op.reduce not in ("min", "max"):
+        return False
+    if op.combine != "single":
+        return False
+    if 2 * op.halo >= B:
+        return False
+    k = 2 * op.halo + 1
+    return all(
+        tuple(kk.shape) == (k, k) and np.array_equal(np.asarray(kk), np.ones((k, k)))
+        for kk in op.kernels
+    )
+
+
 def mxu_eligible(op: Op) -> bool:
     """True iff `op` has a proven MXU banded-matmul identity (module
     docstring). This is the registry/spec-level gate every router
@@ -198,6 +255,10 @@ def mxu_eligible(op: Op) -> bool:
     select the MXU for an op family outside it."""
     if not isinstance(op, StencilOp):
         return False
+    if op.reduce in ("min", "max"):
+        # erode/dilate via threshold decomposition (round 8); median has
+        # no linear identity and stays VPU-only
+        return _morph_ok(op)
     if op.reduce != "corr":
         return False
     if op.combine not in ("single", "magnitude"):
@@ -215,15 +276,38 @@ def mxu_eligible(op: Op) -> bool:
 def mxu_family(op: Op) -> str | None:
     """Calibration key for the op's MXU formulation class: 'sepK' (banded
     separable, K taps), 'gradKxK' (magnitude combine), 'corrKxK' (one-shot
-    2-D einsum). None for ineligible ops."""
+    2-D einsum), 'morphKxK' (threshold-decomposition erode/dilate). None
+    for ineligible ops."""
     if not mxu_eligible(op):
         return None
     k = int(op.kernels[0].shape[0])
+    if op.reduce in ("min", "max"):
+        return f"morph{k}x{k}"
     if op.combine == "magnitude":
         return f"grad{k}x{k}"
     if _sep_taps(op) is not None:
         return f"sep{k}"
     return f"corr{k}x{k}"
+
+
+def mxu_int8_ok(op: Op) -> bool:
+    """Whether the int8-accumulation in-stage variant is PROVEN exact for
+    `op`: MXU-eligible corr reduce with every kernel weight an integer in
+    [-127, 127] (the int8 operand bound; symmetric so the banded matrix
+    negates safely). The accumulator bound is already implied by
+    eligibility — ``_int_kernels_ok`` requires 255 * sum|w| < 2^24, so
+    the shifted contraction sum(w * (x - 128)), its +128*sum(w)
+    correction, and their f32 recombination are all exact integers below
+    2^24 (module docstring). Ops outside the operand bound downgrade to
+    the f32-accumulation 'mxu' arm, never to wrong pixels."""
+    if not isinstance(op, StencilOp) or op.reduce != "corr":
+        return False
+    if not mxu_eligible(op):
+        return False
+    for k in op.kernels:
+        if float(np.abs(np.asarray(k, np.float64)).max()) > 127:
+            return False
+    return True
 
 
 # --------------------------------------------------------------------------
@@ -357,6 +441,69 @@ def _corr2d_valid_mxu(xpad: jnp.ndarray, w2d: np.ndarray, h: int) -> jnp.ndarray
     return out.reshape(hh, -1)[:, :ww]
 
 
+def _morph_digits(M: int) -> int:
+    """Digits per packed plane: the largest m with M^m - 1 < 2^24, so the
+    packed ones-windowsum (whose base-M digits are window counts <= K^2 =
+    M - 1, hence never carry) stays an exact f32 integer."""
+    m = 1
+    while M ** (m + 1) - 1 < _F32_EXACT:
+        m += 1
+    return m
+
+
+def _ones_windowsum_f32(xp: jnp.ndarray, K: int, h: int) -> jnp.ndarray:
+    """(R + 2h, C + 2h) exact-integer f32 plane -> (R, C) K x K window
+    sums via two all-ones banded f32 einsums. Packed digit planes exceed
+    256, so the bf16 row pass is NOT exact here — both passes stay f32
+    (every partial sum is an integer bounded by the packed windowsum
+    bound M^m - 1 < 2^24, so f32 accumulation is exact)."""
+    hh = xp.shape[0] - 2 * h
+    ww = xp.shape[1] - 2 * h
+    taps = (1.0,) * K
+    wpad = (-ww) % B
+    core = xp if wpad == 0 else jnp.pad(xp, ((0, 0), (0, wpad)))
+    C = jnp.asarray(_band_np(taps, h), F32)
+    ext = _band_blocks(core, 1, h)  # (nb, R + 2h, B + 2h)
+    tmp = jnp.einsum("jrk,kn->rjn", ext, C, preferred_element_type=F32)
+    tmp = tmp.reshape(tmp.shape[0], -1)
+    hpad = (-hh) % B
+    if hpad:
+        tmp = jnp.pad(tmp, ((0, hpad), (0, 0)))
+    out = _col_pass_banded(tmp, taps, h, "f32")
+    return out[:hh, :ww]
+
+
+def _morph_valid_mxu(op: StencilOp, xpad: jnp.ndarray) -> jnp.ndarray:
+    """Valid-mode erode/dilate via threshold decomposition on the MXU
+    (module docstring): for each threshold t, the 0/1 indicator [x > t]
+    windowsums on the matrix unit; dilate counts windows with >= 1 hit,
+    erode counts all-K^2 windows; the rank result is the count over
+    t = 0..254. m indicator planes pack base M = K^2 + 1 per round (a
+    window holds at most K^2 ones, so digits never carry) and extract in
+    int32 — every f32 intermediate is an exact integer < 2^24."""
+    K = 2 * op.halo + 1
+    h = op.halo
+    hh = xpad.shape[0] - 2 * h
+    ww = xpad.shape[1] - 2 * h
+    xf = exact_f32(xpad)
+    M = K * K + 1
+    m = _morph_digits(M)
+    full = K * K
+    acc = jnp.zeros((hh, ww), F32)
+    for t0 in range(0, 255, m):
+        ts = range(t0, min(t0 + m, 255))
+        packed = jnp.zeros_like(xf)
+        for i, t in enumerate(ts):
+            bit = (xf > np.float32(t)).astype(F32)
+            packed = packed + bit * np.float32(M**i)
+        si = _ones_windowsum_f32(packed, K, h).astype(jnp.int32)
+        for i, _t in enumerate(ts):
+            d = (si // (M**i)) % M
+            hit = (d >= 1) if op.reduce == "max" else (d == full)
+            acc = acc + hit.astype(F32)
+    return acc
+
+
 def mxu_valid(
     op: StencilOp,
     xpad: jnp.ndarray,
@@ -373,6 +520,10 @@ def mxu_valid(
     so the edge-extension machinery is never duplicated."""
     if not mxu_eligible(op):
         raise ValueError(f"op {op.name!r} has no MXU formulation")
+    if op.reduce in ("min", "max"):
+        # morphology: threshold decomposition (no combine/scale replay —
+        # make_morph builds single-combine, scale-1 ops by construction)
+        return _morph_valid_mxu(op, xpad)
     mode = mode or mxu_mode()
     col_variant = col_variant or mxu_col_variant()
     h = op.halo
@@ -485,3 +636,242 @@ def use_mxu_for_stencil(op: Op, width: int | None = None) -> str | None:
     if choice == "hybrid":
         return "hybrid"
     return None
+
+
+# --------------------------------------------------------------------------
+# In-stage contraction (inside the fused-pallas megakernel)
+# --------------------------------------------------------------------------
+
+STAGE_ARMS = ("vpu", "mxu", "mxu-int8")
+MXU_STAGE_SETTINGS = ("auto", "off", "on", "f32", "int8")
+
+# Closed vocabulary for the silent-ineligibility counter: why an op with
+# an MXU identity (mxu_family is not None) landed on the VPU inside a
+# fused-pallas stage. Advances once per stage (re)trace, like
+# mcim_plan_pallas_stages_total — a steady-state serving process shows
+# the arms its executables were BUILT with.
+#
+#   off            MCIM_MXU_STAGE=off — the operator disabled the arm
+#   family         the identity is whole-op only (morphology: threshold
+#                  decomposition needs its own pass structure, which the
+#                  in-stage valid-mode contraction point cannot host)
+#   not-tpu        auto setting off-TPU — interpret-mode dots win nothing
+#   no-calibration auto setting with no measured stage_arm record for
+#                  (family, device kind, width window)
+STAGE_FALLBACK_REASONS = ("off", "family", "not-tpu", "no-calibration")
+
+
+def count_stage_fallback(counter, reason: str) -> None:
+    """The single choke point for mxu-in-stage fallback accounting
+    (mirrors graph/systolic.count_fallback): every VPU landing of an
+    MXU-capable op inside a fused stage passes through here, so the
+    reason vocabulary above is enforced at runtime and the analysis
+    suite can statically prove no call site invents reasons
+    (analysis/rules_obs.py obs-mxu-stage-fallback-*)."""
+    if reason not in STAGE_FALLBACK_REASONS:
+        raise ValueError(
+            f"unknown mxu-in-stage fallback reason {reason!r}; "
+            f"known: {STAGE_FALLBACK_REASONS}"
+        )
+    counter.inc(reason=reason)
+
+
+def mxu_stage_setting() -> str:
+    """The MCIM_MXU_STAGE knob: 'auto' (default — a real MXU plus a
+    measured stage_arm calibration win), 'off', 'on' (force the MXU arm
+    on every eligible op, int8 where proven — works off-TPU too, the
+    interpret-mode test/bench switch), 'f32' (force the plain bf16/f32
+    arm, never int8 — the A/B control), 'int8' (force int8 where proven,
+    f32 otherwise)."""
+    v = env_registry.get("MCIM_MXU_STAGE") or "auto"
+    if v not in MXU_STAGE_SETTINGS:
+        raise ValueError(
+            f"MCIM_MXU_STAGE={v!r}; known: {MXU_STAGE_SETTINGS}"
+        )
+    return v
+
+
+def _stage_metrics():
+    # plan.metrics imports nothing from ops/, but keep the edge lazy so
+    # the ops layer stays importable without the plan package
+    from mpi_cuda_imagemanipulation_tpu.plan.metrics import plan_metrics
+
+    return plan_metrics
+
+
+def stage_arm_for(
+    op: Op, width: int | None = None, setting: str | None = None
+) -> str:
+    """The in-stage execution arm for one op inside a fused-pallas stage:
+    'vpu', 'mxu' or 'mxu-int8' (STAGE_ARMS). Resolved HOST-SIDE at stage
+    build/trace time — the kernel body branches statically, so the
+    lowered Mosaic program contains either the dot contraction or the
+    shift-multiply walk, never both.
+
+    `setting` overrides MCIM_MXU_STAGE (the plan mode 'fused-pallas-mxu'
+    forces 'on'). Every op with an MXU identity that lands on 'vpu' is
+    counted through count_stage_fallback; ops with no identity at all
+    (pointwise, median, float kernels) are not a lost signal and stay
+    uncounted. A calibrated 'vpu' record is a measured decision, also
+    uncounted. int8 is auto-selected only where mxu_int8_ok PROVES the
+    operand bound; otherwise the choice downgrades to 'mxu'."""
+    if not isinstance(op, StencilOp):
+        return "vpu"
+    fam = mxu_family(op)
+    if fam is None:
+        return "vpu"
+    setting = setting or mxu_stage_setting()
+    metrics = _stage_metrics()
+    if setting == "off":
+        count_stage_fallback(metrics.mxu_stage_fallbacks, "off")
+        return "vpu"
+    if op.reduce != "corr":
+        # whole-op identity only (morphology) — the in-stage valid-mode
+        # contraction point cannot host the threshold-decomposition pass
+        count_stage_fallback(metrics.mxu_stage_fallbacks, "family")
+        return "vpu"
+    if setting in ("on", "int8"):
+        arm = "mxu-int8" if mxu_int8_ok(op) else "mxu"
+    elif setting == "f32":
+        arm = "mxu"
+    else:  # auto
+        if not is_tpu_backend():
+            count_stage_fallback(metrics.mxu_stage_fallbacks, "not-tpu")
+            return "vpu"
+        choice = calibration.lookup_stage_arm(fam, width=width)
+        if choice is None:
+            count_stage_fallback(
+                metrics.mxu_stage_fallbacks, "no-calibration"
+            )
+            return "vpu"
+        if choice == "vpu":  # a measured VPU win — chosen, not fallen back
+            return "vpu"
+        arm = choice if choice != "mxu-int8" or mxu_int8_ok(op) else "mxu"
+    metrics.mxu_stage_ops.inc(arm=arm)
+    return arm
+
+
+def _stage_blocked(xe: jnp.ndarray, h: int) -> tuple[jnp.ndarray, int, int]:
+    """Zero-pad the width-extended carry (rows, W + 2h) to a whole number
+    of B-blocks plus halo; returns (padded, W, out_rows). Pad columns
+    only reach output columns >= W (sliced away): output column j reads
+    input columns j..j+2h <= W - 1 + 2h, all real."""
+    rows, we = xe.shape
+    W = we - 2 * h
+    nbw = -(-W // B)
+    need = nbw * B + 2 * h
+    if need > we:
+        xe = jnp.concatenate(
+            [xe, jnp.zeros((rows, need - we), xe.dtype)], axis=1
+        )
+    return xe, W, rows - 2 * h
+
+
+def _band2_traced(w2d: np.ndarray, h: int, dtype) -> jnp.ndarray:
+    """Traced ``(kh * (B + 2h), B)`` stacked banded matrices — the
+    reshaped `_band2_np` layout, but built INSIDE the traced kernel from
+    scalar weights and iota masks: a pallas kernel body may not close
+    over materialised array constants, so the band matrix is
+    reconstructed from scalars at trace time (Mosaic constant-folds the
+    masks). Weights stay exactly representable in `dtype` — bf16 holds
+    the eligibility-gated integer taps exactly, int8 holds |w| <= 127."""
+    wa = np.asarray(w2d, np.float32)
+    kh, kw = wa.shape
+    r = lax.broadcasted_iota(jnp.int32, (B + 2 * h, B), 0)
+    c = lax.broadcasted_iota(jnp.int32, (B + 2 * h, B), 1)
+    slabs = []
+    for d in range(kh):
+        slab = jnp.zeros((B + 2 * h, B), F32)
+        for i in range(kw):
+            slab = jnp.where(r == c + i, np.float32(wa[d, i]), slab)
+        slabs.append(slab)
+    out = slabs[0] if kh == 1 else jnp.concatenate(slabs, axis=0)
+    return out.astype(dtype)
+
+
+def _stage_corr2d(xe: jnp.ndarray, w2d: np.ndarray, h: int) -> jnp.ndarray:
+    """In-kernel valid 2-D correlation: (rows, W + 2h) exact u8-integer
+    f32 carry -> (rows - 2h, W) f32 accumulation, as ONE
+    ``lax.dot_general`` per 128-wide block — kh row-shifted views
+    concatenate on the contracting axis against the stacked banded
+    matrices, so K = kh * (B + 2h) and N = B = 128: real MXU shapes
+    inside the Mosaic kernel. bf16 operands are exact (u8 values and
+    eligibility-gated integer taps), f32 accumulation of integer partial
+    sums bounded by 255 * sum|w| < 2^24 is exact — bit-identical to the
+    golden op.valid on the same carry."""
+    kh, _kw = w2d.shape
+    xe, W, out_rows = _stage_blocked(xe, h)
+    xb = xe.astype(jnp.bfloat16)
+    C = _band2_traced(w2d, h, jnp.bfloat16)
+    nbw = (xe.shape[1] - 2 * h) // B
+    cols = []
+    for n in range(nbw):
+        blk = xb[:, n * B : n * B + B + 2 * h]
+        a = jnp.concatenate(
+            [blk[d : d + out_rows] for d in range(kh)], axis=1
+        )
+        cols.append(
+            lax.dot_general(
+                a, C, (((1,), (0,)), ((), ())), preferred_element_type=F32
+            )
+        )
+    out = cols[0] if nbw == 1 else jnp.concatenate(cols, axis=1)
+    return out[:, :W]
+
+
+def _stage_corr2d_int8(
+    xe: jnp.ndarray, w2d: np.ndarray, h: int
+) -> jnp.ndarray:
+    """The int8-accumulation variant: operands shift by -128 into
+    [-128, 127] (exact int8), taps are eligibility-proven integers in
+    [-127, 127], the dot accumulates in int32 (|sum| <= 128 * sum|w| <
+    2^23 — no overflow), and the constant +128 * sum(w) correction
+    re-adds in f32: sum(w * (x - 128)) + 128 * sum(w) = sum(w * x), every
+    term an exact integer below 2^24, so the f32 result is bit-identical
+    to the f32 arm (mxu_int8_ok is the proof obligation)."""
+    kh, _kw = w2d.shape
+    xe, W, out_rows = _stage_blocked(xe, h)
+    xs = (xe - np.float32(128.0)).astype(jnp.int32).astype(jnp.int8)
+    C = _band2_traced(w2d, h, jnp.int8)
+    corr = np.float32(128.0 * float(np.asarray(w2d, np.float64).sum()))
+    nbw = (xe.shape[1] - 2 * h) // B
+    cols = []
+    for n in range(nbw):
+        blk = xs[:, n * B : n * B + B + 2 * h]
+        a = jnp.concatenate(
+            [blk[d : d + out_rows] for d in range(kh)], axis=1
+        )
+        s = lax.dot_general(
+            a, C, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+        cols.append(s.astype(F32) + corr)
+    out = cols[0] if nbw == 1 else jnp.concatenate(cols, axis=1)
+    return out[:, :W]
+
+
+def stage_valid_mxu(
+    op: StencilOp, xe: jnp.ndarray, *, arm: str
+) -> jnp.ndarray:
+    """Drop-in for ``op.valid`` at the megakernel's per-op contraction
+    point (ops/pallas_kernels._stage_kernel): the width-extended carry
+    (rows, W + 2h) -> (rows - 2h, W) accumulation on the chosen MXU arm.
+    Separable ops contract their 2-D outer-product kernel — the one-shot
+    form computes the same exact integers as the two-pass walk, so it is
+    uniformly bit-exact; magnitude combine and post-scale replay the
+    golden float ops on the exact accumulations (whole-op mxu_valid's
+    argument)."""
+    if arm not in ("mxu", "mxu-int8"):
+        raise ValueError(f"not an MXU stage arm: {arm!r}")
+    h = op.halo
+    fn = _stage_corr2d_int8 if arm == "mxu-int8" else _stage_corr2d
+    accs = [fn(xe, np.asarray(k, np.float32), h) for k in op.kernels]
+    if op.combine == "single":
+        acc = accs[0]
+    elif op.combine == "magnitude":
+        acc = jnp.sqrt(accs[0] * accs[0] + accs[1] * accs[1])
+    else:  # pragma: no cover - mxu_eligible rejects other combines
+        raise ValueError(f"unknown combine {op.combine!r}")
+    if op.scale != 1.0:
+        acc = acc * np.float32(op.scale)
+    return acc

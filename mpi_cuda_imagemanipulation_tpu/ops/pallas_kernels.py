@@ -43,6 +43,10 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from mpi_cuda_imagemanipulation_tpu.ops.mxu_kernels import (
+    stage_arm_for,
+    stage_valid_mxu,
+)
 from mpi_cuda_imagemanipulation_tpu.ops.spec import (
     F32,
     U8,
@@ -989,6 +993,7 @@ def _fix_bottom_edge(cur: jnp.ndarray, op: StencilOp, r_last: int, cond):
 def _stage_kernel(
     *refs,
     stage_ops,
+    stage_arms,
     n_in: int,
     n_out: int,
     block_h: int,
@@ -1050,7 +1055,7 @@ def _stage_kernel(
         planes.append(exact_f32(ext))
 
     off = 0
-    for op in stage_ops:
+    for op, arm in zip(stage_ops, stage_arms):
         if not isinstance(op, StencilOp):
             planes = _apply_pointwise_planes(op, planes)
             continue
@@ -1070,7 +1075,16 @@ def _stage_kernel(
                     r_last = r_last_of(j, off)
                     if 0 <= r_last < rows - 1:
                         p = _fix_bottom_edge(p, op, r_last, (i == j) & is_bot)
-            acc = op.valid(_row_identity_ext(p, h, op.edge_mode))
+            xe = _row_identity_ext(p, h, op.edge_mode)
+            if arm == "vpu":
+                acc = op.valid(xe)
+            else:
+                # the per-op MXU arm, resolved host-side by the caller:
+                # the same exact integers as op.valid, contracted as
+                # dot_generals inside this kernel body (mxu_kernels
+                # stage_valid_mxu — bit-exact by the same argument as
+                # the whole-op route)
+                acc = stage_valid_mxu(op, xe, arm=arm)
             orig = p[h : rows - h] if h else p
             y0 = y_base + i * block_h - n_above + h
             new_planes.append(
@@ -1129,6 +1143,7 @@ def fused_stage_call(
     y0=None,
     image_h: int | None = None,
     image_w: int | None = None,
+    mxu_stage: str | None = None,
 ) -> list[jnp.ndarray]:
     """Execute one fused plan stage as a single streaming pallas_call.
 
@@ -1138,9 +1153,20 @@ def fused_stage_call(
     tile's traced global row offset and `image_h`/`image_w` the true
     image dims; returns (local_h, W) planes. Eligibility (edge-synthesis
     feasibility, VMEM budget, kernel-safe members) is the CALLER's
-    contract — plan/pallas_exec.stage_pallas_reject gates it."""
+    contract — plan/pallas_exec.stage_pallas_reject gates it.
+
+    `mxu_stage` overrides the MCIM_MXU_STAGE setting for the per-op
+    in-stage MXU arm resolution ('on' under plan=fused-pallas-mxu; None
+    = env/calibration auto). Arms resolve HERE, host-side, once per
+    (re)trace — every consumer (full mode, ghost mode, sharded, serving)
+    gets the same per-op-within-stage choice and the same counted
+    fallback accounting for free."""
     H = halo
     height, width = planes[0].shape
+    stage_arms = tuple(
+        stage_arm_for(op, width=image_w or width, setting=mxu_stage)
+        for op in stage_ops
+    )
     n_in = len(planes)
     n_out = _channels_after(
         [op for op in stage_ops if not isinstance(op, StencilOp)], n_in
@@ -1218,6 +1244,7 @@ def fused_stage_call(
     kernel = partial(
         _stage_kernel,
         stage_ops=tuple(stage_ops),
+        stage_arms=stage_arms,
         n_in=n_in,
         n_out=n_out,
         block_h=bh,

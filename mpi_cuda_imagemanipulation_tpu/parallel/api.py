@@ -579,7 +579,9 @@ def _apply_stage_overlap(stage, tile, y0, global_h, global_w, n, impl, si):
     return jnp.concatenate([top_out, interior, bottom_out], axis=0)
 
 
-def _apply_stage_megakernel(stage, tile, y0, global_h, global_w, n, si):
+def _apply_stage_megakernel(
+    stage, tile, y0, global_h, global_w, n, si, mxu_stage=None
+):
     """Fused-pallas execution of one stage on a shard: the stage's ONE
     ppermute ghost-strip pair (identical wire structure to
     _apply_stage_serial — the HLO test counts the same
@@ -599,13 +601,14 @@ def _apply_stage_megakernel(stage, tile, y0, global_h, global_w, n, si):
     ext = jnp.concatenate([top, tile, bottom], axis=0)
     with jax.named_scope(f"plan_stage_pallas_s{si}"):
         return run_stage_pallas_ext(
-            stage, ext, y0=y0, image_h=global_h, image_w=global_w
+            stage, ext, y0=y0, image_h=global_h, image_w=global_w,
+            mxu_stage=mxu_stage,
         )
 
 
 def _run_segment_planned(
     plan, mesh, impl: str, img: jnp.ndarray, halo_mode: str,
-    mega: bool = False,
+    mega: bool = False, mxu_stage: str | None = None,
 ):
     """One shard_map region executed stage-by-stage from a fused plan.
     Stages the decomposition gate rejects (pad rows in the tile,
@@ -677,7 +680,8 @@ def _run_segment_planned(
                 tile = op.apply(tile, stats)
             elif si in mega_stages:
                 tile = _apply_stage_megakernel(
-                    stage, tile, y0, global_h, global_w, n, si
+                    stage, tile, y0, global_h, global_w, n, si,
+                    mxu_stage=mxu_stage,
                 )
             elif _plan_stage_fused_ok(stage, n, local_h, global_h, overlap):
                 if overlap and stage.halo >= 1:
@@ -1001,7 +1005,8 @@ def sharded_pipeline(
             for kind, ops in segments
         ]
         impl = backend  # 'xla' | 'mxu' | 'auto' (resolver guarantees)
-        mega = plan_mode == "fused-pallas"
+        mega = plan_mode in ("fused-pallas", "fused-pallas-mxu")
+        mxu_stage = "on" if plan_mode == "fused-pallas-mxu" else None
 
         def run_planned(img: jnp.ndarray) -> jnp.ndarray:
             from jax.sharding import NamedSharding
@@ -1017,7 +1022,8 @@ def sharded_pipeline(
                     )
                 else:
                     img = _run_segment_planned(
-                        seg_plan, mesh, impl, img, halo_mode, mega=mega
+                        seg_plan, mesh, impl, img, halo_mode, mega=mega,
+                        mxu_stage=mxu_stage,
                     )
             return img
 

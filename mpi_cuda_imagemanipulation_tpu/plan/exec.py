@@ -52,11 +52,18 @@ PLAN_IMPLS = ("xla", "mxu", "auto")
 
 def stencil_acc_fn(op: StencilOp, impl: str, width: int | None):
     """The valid-region accumulator for one stencil under `impl`: the
-    golden VPU path (`op.valid`), the forced MXU banded contraction, or —
-    for 'auto' — the calibration-gated routing decision, made ONCE at
-    build time (ops/mxu_kernels.use_mxu_for_stencil), never inside the
-    trace. Shared by the plan executors and the streaming tile engine so
-    per-stencil backend routing cannot drift between them."""
+    golden VPU path (`op.valid`), the forced MXU formulation (banded
+    contraction for corr ops; threshold-decomposition morphology since
+    erode/dilate joined `mxu_eligible`), or — for 'auto' — the
+    calibration-gated routing decision, made ONCE at build time
+    (ops/mxu_kernels.use_mxu_for_stencil), never inside the trace.
+    Shared by the plan executors and the streaming tile engine so
+    per-stencil backend routing cannot drift between them. The in-stage
+    contraction point inside the fused-pallas megakernel resolves its
+    own arms (ops/mxu_kernels.stage_arm_for); a stage the megakernel
+    rejects re-enters here under the pipeline's backend impl, so under
+    'mxu'/'auto' a counted megakernel rejection does not also forfeit
+    the whole-op MXU formulation."""
     if impl == "xla":
         return op.valid
     from mpi_cuda_imagemanipulation_tpu.ops.mxu_kernels import (

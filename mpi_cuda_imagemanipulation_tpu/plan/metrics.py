@@ -58,6 +58,22 @@ class PlanMetrics:
             "image-too-small/vmem-budget/barrier).",
             labels=("reason",),
         )
+        # per-op-within-stage MXU arm accounting (ops/mxu_kernels
+        # stage_arm_for): resolved host-side per stage (re)trace, like
+        # pallas_stages — the silent-ineligibility gap closed in round 8
+        self.mxu_stage_ops = r.counter(
+            "mcim_plan_mxu_in_stage_total",
+            "Stencil ops lowered as MXU dot contractions inside a "
+            "fused-pallas stage body, by arm (mxu/mxu-int8).",
+            labels=("arm",),
+        )
+        self.mxu_stage_fallbacks = r.counter(
+            "mcim_plan_mxu_in_stage_fallback_total",
+            "MXU-capable stencil ops that landed on the VPU inside a "
+            "fused-pallas stage, by closed reason (off/family/not-tpu/"
+            "no-calibration; ops/mxu_kernels.STAGE_FALLBACK_REASONS).",
+            labels=("reason",),
+        )
 
     def on_build(self, plan) -> None:
         self.builds.inc(mode=plan.mode)
@@ -74,10 +90,17 @@ class PlanMetrics:
             "builds_fused_pallas": int(
                 self.builds.value(mode="fused-pallas")
             ),
+            "builds_fused_pallas_mxu": int(
+                self.builds.value(mode="fused-pallas-mxu")
+            ),
             "stages_fused": int(self.stages.value(kind="fused")),
             "fused_ops": int(self.fused_ops.value()),
             "hbm_passes_saved": int(self.passes_saved.value()),
             "pallas_stages": int(self.pallas_stages.value()),
+            "mxu_stage_ops": int(
+                self.mxu_stage_ops.value(arm="mxu")
+                + self.mxu_stage_ops.value(arm="mxu-int8")
+            ),
         }
 
 

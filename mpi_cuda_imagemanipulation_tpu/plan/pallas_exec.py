@@ -137,10 +137,12 @@ def run_stage_pallas(
     interpret: bool | None = None,
     block_h: int | None = None,
     io_scale: float | None = None,
+    mxu_stage: str | None = None,
 ) -> jnp.ndarray:
     """One eligible fused stage over a whole u8 image as one megakernel
     launch (planar channel decomposition at the stage boundary, like
-    every Pallas path)."""
+    every Pallas path). `mxu_stage` overrides MCIM_MXU_STAGE for the
+    per-op in-stage MXU arm resolution."""
     from mpi_cuda_imagemanipulation_tpu.ops.pallas_kernels import (
         fused_stage_call,
     )
@@ -152,6 +154,7 @@ def run_stage_pallas(
     outs = fused_stage_call(
         stage.ops, planes, halo=stage.halo,
         interpret=interpret, block_h=block_h, io_scale=io_scale,
+        mxu_stage=mxu_stage,
     )
     return outs[0] if len(outs) == 1 else jnp.stack(outs, axis=-1)
 
@@ -165,6 +168,7 @@ def run_stage_pallas_ext(
     image_w: int,
     interpret: bool | None = None,
     block_h: int | None = None,
+    mxu_stage: str | None = None,
 ) -> jnp.ndarray:
     """Ghost-mode megakernel over a (local_h + 2*Stage.halo, W[, C]) tile
     whose context rows were materialised by the stage's single ppermute
@@ -181,6 +185,7 @@ def run_stage_pallas_ext(
         stage.ops, planes, halo=stage.halo,
         interpret=interpret, block_h=block_h,
         ghosts=True, y0=y0, image_h=image_h, image_w=image_w,
+        mxu_stage=mxu_stage,
     )
     return outs[0] if len(outs) == 1 else jnp.stack(outs, axis=-1)
 
@@ -191,12 +196,15 @@ def plan_callable_pallas(
     impl: str = "xla",
     interpret: bool | None = None,
     block_h: int | None = None,
+    mxu_stage: str | None = None,
 ):
     """The full-image fused-pallas executor: an image -> image function
     (jit/vmap it like any backend callable). Eligible fused stages run
     as megakernels; rejected stages fall back to the shared XLA stage
     walker (plan/exec.run_stage_full, `impl` = its accumulator routing);
-    barrier stages run their golden op. Eligibility is re-judged per
+    barrier stages run their golden op. `mxu_stage` forces the per-op
+    in-stage MXU arm setting ('on' under plan=fused-pallas-mxu; None =
+    MCIM_MXU_STAGE / calibration auto). Eligibility is re-judged per
     traced shape — the same chain can megakernel an 8K frame and walk a
     thumbnail — and every decision is counted (mcim_plan_pallas_*)."""
     from mpi_cuda_imagemanipulation_tpu.plan.exec import (
@@ -223,6 +231,7 @@ def plan_callable_pallas(
                     img = run_stage_pallas(
                         stage, img, interpret=interpret, block_h=block_h,
                         io_scale=stage_io_scale(plan, i),
+                        mxu_stage=mxu_stage,
                     )
             else:
                 plan_metrics.pallas_fallbacks.inc(reason=reason)

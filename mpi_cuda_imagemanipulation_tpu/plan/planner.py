@@ -39,9 +39,18 @@ from mpi_cuda_imagemanipulation_tpu.utils import env as env_registry
 # exactly like 'fused' but executes each eligible stage as ONE
 # VMEM-resident megakernel (plan/pallas_exec.py) — a distinct build mode
 # so Plan.fingerprint (the serving compile-cache key) distinguishes the
-# two executions.
-PLAN_MODES = ("auto", "off", "pointwise", "fused", "fused-pallas")
-BUILD_MODES = ("off", "pointwise", "fused", "fused-pallas")
+# two executions. 'fused-pallas-mxu' is the same megakernel with the
+# per-op in-stage MXU arms FORCED on (ops/mxu_kernels.stage_arm_for
+# setting 'on'): eligible stencils contract as dot_generals inside the
+# pallas_call body instead of walking the VPU — again a distinct build
+# mode, so the tune controller can propose it as an arm and the compile
+# cache rebuilds on a flip. Under plain 'fused-pallas' the arms still
+# resolve per op via MCIM_MXU_STAGE / the stage_arm calibration table —
+# the forced mode exists for A/Bs and for the tuner's arm vocabulary.
+PLAN_MODES = ("auto", "off", "pointwise", "fused", "fused-pallas",
+              "fused-pallas-mxu")
+BUILD_MODES = ("off", "pointwise", "fused", "fused-pallas",
+               "fused-pallas-mxu")
 
 # geometric ops that are pure pixel permutations with unchanged (H, W):
 # a per-pixel (pointwise) op commutes with them exactly —

@@ -185,7 +185,18 @@ class OnlineStore:
 
     def promote(self, pipe_fp: str, width: int, choice: str) -> None:
         """Record a fleet-wide promotion (the controller's promote
-        decision) — the online side of the newest-wins precedence pair."""
+        decision) — the online side of the newest-wins precedence pair.
+
+        `choice` is the closed plan vocabulary (`promoted_entry` already
+        gates reads on it; raising at the write catches the typo'd arm
+        at the choke point instead of silently banking a promotion no
+        resolver will ever honour — fused-pallas-mxu joins the set via
+        calibration.PLAN_CHOICES, nothing store-side to widen)."""
+        if choice not in calibration.PLAN_CHOICES:
+            raise ValueError(
+                f"unknown plan choice {choice!r}; known: "
+                f"{calibration.PLAN_CHOICES}"
+            )
         kind = self._resolve_kind()
         if kind is None:
             return

@@ -234,6 +234,91 @@ def record_backend_choice(
 
 
 # --------------------------------------------------------------------------
+# In-stage MXU arm calibration (the per-op-WITHIN-stage dimension)
+#
+# The fused-pallas megakernel resolves an execution arm per stencil op
+# inside each stage (ops/mxu_kernels.stage_arm_for): 'vpu' (the golden
+# shift-multiply walk), 'mxu' (bf16/f32 dot contraction) or 'mxu-int8'
+# (int8/int32 dot). Keyed by MXU FAMILY (sepK/gradKxK/corrKxK — the
+# same keys as backend_choice, the granularity the identity varies at),
+# device kind and the factor-of-two width window. 'auto'
+# (MCIM_MXU_STAGE unset) routes to an MXU arm only behind a record here,
+# the same measured-win discipline as every other dimension.
+# --------------------------------------------------------------------------
+
+_STAGE_KEY = "stage_arm"
+STAGE_ARM_CHOICES = ("vpu", "mxu", "mxu-int8")
+
+
+def lookup_stage_arm(
+    family: str | None,
+    device_kind: str | None = None,
+    width: int | None = None,
+) -> str | None:
+    """Calibrated in-stage arm for (MXU family, device kind), if any.
+    None when no (valid, width-compatible) entry exists or MCIM_NO_CALIB
+    is set — the megakernel then keeps its default (VPU) walk."""
+    if family is None or env_registry.get(_ENV_DISABLE):
+        return None
+    if device_kind is None:
+        try:
+            device_kind = current_device_kind()
+        except Exception:
+            return None
+    rec = entries().get(device_kind)
+    if not isinstance(rec, dict):
+        return None
+    table = rec.get(_STAGE_KEY)
+    if not isinstance(table, dict):
+        return None
+    ent = table.get(family)
+    if not isinstance(ent, dict):
+        return None
+    rec_w = ent.get("width")
+    if (
+        width is not None
+        and isinstance(rec_w, (int, float))
+        and rec_w > 0
+        and not (rec_w / 2 <= width <= rec_w * 2)
+    ):
+        return None
+    choice = ent.get("choice")
+    return choice if choice in STAGE_ARM_CHOICES else None
+
+
+def record_stage_arm(
+    device_kind: str, family: str, choice: str, **extra
+) -> str:
+    """Write/replace the (device kind, MXU family) in-stage arm; returns
+    the store path. Same atomic-write contract as record_block_h."""
+    if choice not in STAGE_ARM_CHOICES:
+        raise ValueError(
+            f"unknown stage arm {choice!r}; known: {STAGE_ARM_CHOICES}"
+        )
+    data, kind_rec = _kind_record(device_kind)
+    table = kind_rec.setdefault(_STAGE_KEY, {})
+    if not isinstance(table, dict):  # legacy/corrupt entry: replace
+        table = kind_rec[_STAGE_KEY] = {}
+    table[family] = {"choice": choice, **extra}
+    return _write_store(data)
+
+
+def stage_arm_entries(device_kind: str | None = None) -> dict:
+    """The device kind's whole stage_arm table (family -> entry), for
+    `mcim-tpu autotune info` — {} when absent."""
+    if device_kind is None:
+        try:
+            device_kind = current_device_kind()
+        except Exception:
+            return {}
+    rec = entries().get(device_kind)
+    if not isinstance(rec, dict):
+        return {}
+    table = rec.get(_STAGE_KEY)
+    return table if isinstance(table, dict) else {}
+
+
+# --------------------------------------------------------------------------
 # Plan-choice calibration (the fused-plan autotune dimension)
 #
 # `mcim-tpu autotune --dimension plan` measures the per-op ('off'),
@@ -250,7 +335,7 @@ def record_backend_choice(
 # --------------------------------------------------------------------------
 
 _PLAN_KEY = "plan_choice"
-PLAN_CHOICES = ("off", "pointwise", "fused", "fused-pallas")
+PLAN_CHOICES = ("off", "pointwise", "fused", "fused-pallas", "fused-pallas-mxu")
 
 
 def lookup_plan_choice(

@@ -145,6 +145,10 @@ _VARS = (
     EnvVar("MCIM_MXU_COL", "bf16split", "ops/mxu_kernels.py",
            "MXU column-pass arithmetic: bf16split (the proven 64a+b "
            "split) or f32 (direct einsum, A/B lane)."),
+    EnvVar("MCIM_MXU_STAGE", "auto", "ops/mxu_kernels.py",
+           "Per-op MXU arm INSIDE fused-pallas stages: auto (TPU + "
+           "calibrated stage_arm win), off, on (force, int8 where "
+           "proven — the interpret A/B switch), f32, int8."),
     # -- bench lanes (bench_suite.py) ----------------------------------------
     EnvVar("MCIM_HALO_AB", None, "bench_suite.py",
            "=1 forces the sharded serial-vs-overlap halo A/B on, =0 off; "
@@ -158,6 +162,16 @@ _VARS = (
     EnvVar("MCIM_MXU_AB_JSON", None, "tests/test_mxu_backend.py",
            "CI: write the mxu_ab lane record to this path (uploaded as an "
            "artifact)."),
+    EnvVar("MCIM_MXU_FUSED_AB_OPS", None, "bench_suite.py",
+           "mxu_fused_ab lane: pipeline override (default "
+           "gaussian:5,sharpen,box:5)."),
+    EnvVar("MCIM_MXU_FUSED_AB_HEIGHT", None, "bench_suite.py",
+           "mxu_fused_ab lane: image height override."),
+    EnvVar("MCIM_MXU_FUSED_AB_WIDTH", None, "bench_suite.py",
+           "mxu_fused_ab lane: image width override."),
+    EnvVar("MCIM_MXU_FUSED_AB_JSON", None, "tests/test_mxu_backend.py",
+           "CI: write the mxu_fused_ab lane record to this path (uploaded "
+           "as an artifact)."),
     EnvVar("MCIM_ENGINE_AB_IMAGES", None, "bench_suite.py",
            "engine_ab lane: synthetic corpus size override."),
     EnvVar("MCIM_ENGINE_AB_DECODE_MS", None, "bench_suite.py",

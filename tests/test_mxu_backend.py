@@ -26,8 +26,11 @@ from mpi_cuda_imagemanipulation_tpu.ops import mxu_kernels
 from mpi_cuda_imagemanipulation_tpu.ops.mxu_kernels import (
     mxu_eligible,
     mxu_family,
+    mxu_int8_ok,
     mxu_valid,
     pipeline_mxu,
+    stage_arm_for,
+    stage_valid_mxu,
     use_mxu_for_stencil,
 )
 from mpi_cuda_imagemanipulation_tpu.ops.registry import (
@@ -76,14 +79,32 @@ def test_eligible_families(spec, family):
     assert mxu_family(op) == family
 
 
-@pytest.mark.parametrize(
-    "spec", ["median:3", "median:5", "erode:5", "dilate:3"]
-)
-def test_rank_morphology_ineligible(spec):
-    """No linear identity — these must never reach the MXU path."""
+@pytest.mark.parametrize("spec", ["median:3", "median:5"])
+def test_rank_median_ineligible(spec):
+    """No linear identity and no threshold decomposition with a bounded
+    digit alphabet — median must never reach the MXU path."""
     op = make_op(spec)
     assert not mxu_eligible(op)
     assert mxu_family(op) is None
+
+
+@pytest.mark.parametrize(
+    "spec,family",
+    [
+        ("erode:3", "morph3x3"),
+        ("erode:5", "morph5x5"),
+        ("dilate:3", "morph3x3"),
+        ("dilate:5", "morph5x5"),
+    ],
+)
+def test_morphology_eligible_via_threshold_decomposition(spec, family):
+    """Round 8 widening: erode/dilate ARE eligible — the threshold
+    decomposition turns the rank reduce into packed ones-windowsums the
+    banded path contracts exactly (whole-op only; never int8)."""
+    op = make_op(spec)
+    assert mxu_eligible(op)
+    assert mxu_family(op) == family
+    assert not mxu_int8_ok(op)
 
 
 def test_non_stencils_ineligible():
@@ -220,8 +241,12 @@ def test_auto_never_routes_off_tpu(monkeypatch):
 def test_auto_never_routes_ineligible_family(monkeypatch):
     monkeypatch.setenv("MCIM_PREFER_MXU", "1")
     monkeypatch.setattr(mxu_kernels, "is_tpu_backend", lambda: True)
-    for spec in ("median:3", "erode:5", "dilate:3"):
-        assert use_mxu_for_stencil(make_op(spec), 384) is None
+    # median has no linear identity and must never route; erode/dilate
+    # joined mxu_family via threshold decomposition (round 8) and now
+    # route under the same forced conditions
+    assert use_mxu_for_stencil(make_op("median:3"), 384) is None
+    for spec in ("erode:5", "dilate:3"):
+        assert use_mxu_for_stencil(make_op(spec), 384) is not None
     # eligible family routes under the same conditions
     assert use_mxu_for_stencil(make_op("gaussian:5"), 384) == "banded"
 
@@ -547,3 +572,240 @@ def test_autotune_backend_dimension(tmp_path, monkeypatch, capsys):
          "--calib-file", str(calib), "--allow-interpret"]
     )
     assert rc == 2
+
+
+# --------------------------------------------------------------------------
+# In-stage contraction arms (round 8: stage_valid_mxu / stage_arm_for)
+# --------------------------------------------------------------------------
+
+
+def _carry(op, height, width, seed):
+    """A width-extended exact-u8 f32 carry (rows, W + 2h), the invariant
+    stage_valid_mxu consumes at the megakernel's contraction point."""
+    h = op.halo
+    img = synthetic_image(height + 2 * h, width + 2 * h, channels=1,
+                          seed=seed)
+    return jnp.asarray(np.asarray(img, np.float32))
+
+
+@pytest.mark.parametrize(
+    "spec",
+    ["gaussian:3", "gaussian:5", "gaussian:7", "box:3", "box:5", "box:7",
+     "sharpen", "emboss:3", "emboss:5", "emboss101:5", "unsharp",
+     "laplacian:8", "sobel", "prewitt", "scharr"],
+)
+@pytest.mark.parametrize("width", [64, 67, 128, 200, 384, 131])
+def test_stage_valid_mxu_matches_op_valid(spec, width):
+    """The in-stage dot contraction is bit-identical to the golden
+    ``op.valid`` walk on the SAME carry, across odd widths (ragged last
+    128-block, single-block, multi-block) and both arms where proven."""
+    op = make_op(spec)
+    xe = _carry(op, 40, width, seed=width)
+    golden = np.asarray(op.valid(xe))
+    got = np.asarray(stage_valid_mxu(op, xe, arm="mxu"))
+    np.testing.assert_array_equal(got, golden)
+    if mxu_int8_ok(op):
+        got8 = np.asarray(stage_valid_mxu(op, xe, arm="mxu-int8"))
+        np.testing.assert_array_equal(got8, golden)
+
+
+@pytest.mark.parametrize("spec", ["erode:3", "erode:5", "dilate:3",
+                                  "dilate:5"])
+@pytest.mark.parametrize("shape", [(48, 64), (37, 131), (67, 200)])
+def test_morphology_whole_op_bitexact(spec, shape):
+    """The widened whole-op morphology identity (threshold decomposition
+    + digit-packed ones-windowsums) against the golden rank walk, odd
+    shapes included."""
+    from mpi_cuda_imagemanipulation_tpu.ops.mxu_kernels import mxu_stencil
+
+    op = make_op(spec)
+    img = jnp.asarray(synthetic_image(*shape, channels=1, seed=sum(shape)))
+    golden = np.asarray(Pipeline.parse(spec)(img))
+    got = np.asarray(jax.jit(lambda x: mxu_stencil(op, x))(img))
+    np.testing.assert_array_equal(got, golden)
+
+
+def test_morphology_through_plan_walker_impl_mxu():
+    """The widened eligibility reaches the shared XLA stage walker:
+    `plan_callable(..., impl='mxu')` now routes erode/dilate through the
+    threshold-decomposition identity (plan/exec.stencil_acc_fn ->
+    mxu_valid) inside a fused stage, bit-exact — median in the same
+    chain stays on its golden rank walk."""
+    from mpi_cuda_imagemanipulation_tpu.ops.registry import make_pipeline_ops
+    from mpi_cuda_imagemanipulation_tpu.plan import build_plan
+    from mpi_cuda_imagemanipulation_tpu.plan.exec import plan_callable
+
+    spec = "gaussian:3,erode:3,dilate:5,median:3"
+    ops = make_pipeline_ops(spec)
+    img = jnp.asarray(synthetic_image(59, 77, channels=1, seed=9))
+    golden = np.asarray(Pipeline.parse(spec)(img))
+    got = np.asarray(plan_callable(build_plan(ops, "fused"), impl="mxu")(img))
+    np.testing.assert_array_equal(got, golden)
+
+
+def _filter_spec(weights, scale=1.0):
+    return "filter:" + "/".join(str(w) for w in weights) + f":{scale}"
+
+
+def test_int8_boundary_just_under_and_over_2_24():
+    """The exactness frontier, hit exactly:
+
+      * sum|w| = 65793 -> 255 * sum|w| = 2^24 - 1: eligible, and the
+        in-stage f32 dot is bit-exact at the largest representable
+        accumulation;
+      * sum|w| = 65794 -> 255 * sum|w| = 2^24 + 254: INELIGIBLE — the
+        op must fall off the MXU entirely (VPU f32 walk), never produce
+        wrong pixels;
+      * both are int8-unprovable (|w| > 127), so the forced int8 setting
+        must downgrade the eligible one to the f32 arm, not miscompute.
+    """
+    under = make_op(_filter_spec([65280, 512, 1, 0, 0, 0, 0, 0, 0]))
+    over = make_op(_filter_spec([65280, 512, 2, 0, 0, 0, 0, 0, 0]))
+    assert mxu_eligible(under) and mxu_family(under) == "corr3x3"
+    assert not mxu_eligible(over) and mxu_family(over) is None
+    assert not mxu_int8_ok(under)
+    # forced settings: under -> f32 dot (downgrade from int8), over -> vpu
+    assert stage_arm_for(under, setting="int8") == "mxu"
+    assert stage_arm_for(under, setting="on") == "mxu"
+    assert stage_arm_for(over, setting="on") == "vpu"
+    xe = _carry(under, 32, 96, seed=4)
+    np.testing.assert_array_equal(
+        np.asarray(stage_valid_mxu(under, xe, arm="mxu")),
+        np.asarray(under.valid(xe)),
+    )
+
+
+def test_int8_operand_bound_127_vs_128():
+    """|w| = 127 is int8-provable; |w| = 128 is not (symmetric operand
+    bound) — the auto-int8 selection must downgrade, and both arms stay
+    bit-exact on the same carry."""
+    ok127 = make_op(_filter_spec([127, 1, 0, 0, 0, 0, 0, 0, 0]))
+    no128 = make_op(_filter_spec([128, 1, 0, 0, 0, 0, 0, 0, 0]))
+    assert mxu_int8_ok(ok127)
+    assert not mxu_int8_ok(no128)
+    assert stage_arm_for(ok127, setting="on") == "mxu-int8"
+    assert stage_arm_for(no128, setting="on") == "mxu"
+    for op in (ok127, no128):
+        xe = _carry(op, 24, 150, seed=5)
+        np.testing.assert_array_equal(
+            np.asarray(stage_valid_mxu(op, xe, arm="mxu")),
+            np.asarray(op.valid(xe)),
+        )
+    xe = _carry(ok127, 24, 150, seed=6)
+    np.testing.assert_array_equal(
+        np.asarray(stage_valid_mxu(ok127, xe, arm="mxu-int8")),
+        np.asarray(ok127.valid(xe)),
+    )
+
+
+def test_stage_fallback_reasons_closed_vocabulary(monkeypatch):
+    """count_stage_fallback is the enforced choke point: unknown reasons
+    raise, and every ineligibility path lands on a counted reason."""
+    from mpi_cuda_imagemanipulation_tpu.obs.metrics import Registry
+    from mpi_cuda_imagemanipulation_tpu.ops.mxu_kernels import (
+        count_stage_fallback,
+    )
+    from mpi_cuda_imagemanipulation_tpu.plan.metrics import plan_metrics
+
+    reg = Registry()
+    c = reg.counter("t_total", "t", labels=("reason",))
+    with pytest.raises(ValueError, match="unknown mxu-in-stage"):
+        count_stage_fallback(c, "typo-reason")
+    count_stage_fallback(c, "off")
+    assert c.value(reason="off") == 1
+
+    def fall(reason):
+        return plan_metrics.mxu_stage_fallbacks.value(reason=reason)
+
+    gauss = make_op("gaussian:5")
+    base_off = fall("off")
+    assert stage_arm_for(gauss, setting="off") == "vpu"
+    assert fall("off") == base_off + 1
+    # morphology has a whole-op identity only -> counted 'family'
+    base_fam = fall("family")
+    assert stage_arm_for(make_op("erode:3"), setting="on") == "vpu"
+    assert fall("family") == base_fam + 1
+    # auto off-TPU -> counted 'not-tpu'
+    monkeypatch.setattr(mxu_kernels, "is_tpu_backend", lambda: False)
+    base_tpu = fall("not-tpu")
+    assert stage_arm_for(gauss, setting="auto") == "vpu"
+    assert fall("not-tpu") == base_tpu + 1
+    # auto on-TPU without a stage_arm record -> counted 'no-calibration'
+    monkeypatch.setattr(mxu_kernels, "is_tpu_backend", lambda: True)
+    monkeypatch.setenv("MCIM_NO_CALIB", "1")
+    base_cal = fall("no-calibration")
+    assert stage_arm_for(gauss, setting="auto") == "vpu"
+    assert fall("no-calibration") == base_cal + 1
+    # ops with no MXU identity at all are NOT a lost signal: uncounted
+    before = {r: fall(r) for r in ("off", "family", "not-tpu",
+                                   "no-calibration")}
+    assert stage_arm_for(make_op("median:3"), setting="on") == "vpu"
+    assert stage_arm_for(make_op("invert"), setting="on") == "vpu"
+    assert before == {r: fall(r) for r in before}
+
+
+def test_stage_arm_calibration_roundtrip(tmp_path, monkeypatch):
+    """The stage_arm calibration dimension: record -> width-window
+    lookup -> deterministic auto-arm resolution on a (mocked) TPU."""
+    monkeypatch.setenv("MCIM_CALIB_FILE", str(tmp_path / "c.json"))
+    monkeypatch.delenv("MCIM_NO_CALIB", raising=False)
+    calibration.record_stage_arm("TPU v5 lite", "sep5", "mxu-int8",
+                                 width=7680)
+    calibration.record_stage_arm("TPU v5 lite", "corr3x3", "vpu",
+                                 width=7680)
+    assert calibration.lookup_stage_arm(
+        "sep5", "TPU v5 lite", width=7680
+    ) == "mxu-int8"
+    # factor-of-two width window
+    assert calibration.lookup_stage_arm(
+        "sep5", "TPU v5 lite", width=256
+    ) is None
+    assert calibration.lookup_stage_arm(
+        "sep5", "unknown kind", width=7680
+    ) is None
+    ents = calibration.stage_arm_entries("TPU v5 lite")
+    assert ents["sep5"]["choice"] == "mxu-int8"
+    # the auto path is a pure function of the pinned store
+    monkeypatch.setattr(mxu_kernels, "is_tpu_backend", lambda: True)
+    monkeypatch.setattr(
+        calibration, "current_device_kind", lambda: "TPU v5 lite"
+    )
+    assert stage_arm_for(
+        make_op("gaussian:5"), width=7680, setting="auto"
+    ) == "mxu-int8"
+    # a calibrated VPU win is a measured decision, not a fallback
+    base = mxu_kernels._stage_metrics().mxu_stage_fallbacks.value(
+        reason="no-calibration"
+    )
+    assert stage_arm_for(
+        make_op("sharpen"), width=7680, setting="auto"
+    ) == "vpu"
+    assert mxu_kernels._stage_metrics().mxu_stage_fallbacks.value(
+        reason="no-calibration"
+    ) == base
+
+
+def test_mxu_fused_ab_lane_runs_and_gates(monkeypatch, tmp_path):
+    """The mxu_fused_ab bench lane: bit-exactness gate passes on all
+    five lanes, the per-op arms are reported, and the JSON artifact
+    lands (the CI-uploaded evidence file)."""
+    from mpi_cuda_imagemanipulation_tpu.bench_suite import run_mxu_fused_ab
+
+    monkeypatch.setenv("MCIM_MXU_FUSED_AB_HEIGHT", "72")
+    monkeypatch.setenv("MCIM_MXU_FUSED_AB_WIDTH", "128")
+    out = tmp_path / "mxu_fused_ab.json"
+    ci_path = os.environ.get("MCIM_MXU_FUSED_AB_JSON")
+    if ci_path:
+        run_mxu_fused_ab(json_path=ci_path, printer=lambda s: None)
+    rec = run_mxu_fused_ab(json_path=str(out), printer=lambda s: None)
+    assert rec["config"] == "mxu_fused_ab"
+    assert set(rec["lanes"]) == {
+        "off", "fused_vpu", "fused_mxu", "fused_mxu_int8", "mxu_whole_op"
+    }
+    for lane in rec["lanes"].values():
+        assert "mp_per_s_per_chip" in lane
+    assert rec["best_mxu_lane"] in ("fused_mxu", "fused_mxu_int8")
+    assert rec["speedup_fused_mxu_vs_fused_vpu"] is not None
+    arms = rec["stage_arms"]
+    assert all(a["arm"] == "mxu-int8" for a in arms.values())
+    assert json.loads(out.read_text())["config"] == "mxu_fused_ab"

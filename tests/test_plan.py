@@ -843,6 +843,7 @@ def test_plan_metrics_count_builds_and_savings():
 def test_plan_modes_surface():
     assert PLAN_MODES == (
         "auto", "off", "pointwise", "fused", "fused-pallas",
+        "fused-pallas-mxu",
     )
 
 
@@ -896,3 +897,81 @@ def test_unfused_callables_chain_matches_golden():
     assert np.array_equal(
         np.asarray(run_unfused(fns, img)), golden(ops, img)
     )
+
+
+# --------------------------------------------------------------------------
+# fused-pallas-mxu plan mode (round 8: MXU inside the megakernel)
+# --------------------------------------------------------------------------
+
+
+def test_fused_pallas_mxu_resolution_and_auto_gating(calib_file):
+    """The forced-MXU megakernel mode resolves like every explicit mode;
+    'auto' reaches it only behind a recorded plan-choice win (the
+    standard new-backend discipline), and self-fusing kernel backends
+    ignore it."""
+    ops = make_pipeline_ops(MIXED)
+    assert resolve_plan_mode(ops, "fused-pallas-mxu", backend="xla") == (
+        "fused-pallas-mxu"
+    )
+    assert resolve_plan_mode(
+        ops, "fused-pallas-mxu", backend="pallas"
+    ) == "off"
+    assert resolve_plan_mode(ops, "auto", backend="xla") == "fused"
+    calibration.record_plan_choice(
+        calibration.current_device_kind(),
+        pipeline_fingerprint(ops), "fused-pallas-mxu", width=512,
+    )
+    calibration._cache["key"] = None
+    assert (
+        resolve_plan_mode(ops, "auto", backend="xla", width=512)
+        == "fused-pallas-mxu"
+    )
+
+
+def test_fused_pallas_mxu_fingerprint_is_distinct():
+    ops = make_pipeline_ops(MIXED)
+    mega = build_plan(ops, "fused-pallas")
+    mxu = build_plan(ops, "fused-pallas-mxu")
+    # same stage partition, distinct execution identity: a tuner flip
+    # between the VPU-walk and forced-MXU megakernels must rebuild
+    assert [s.names for s in mega.stages] == [s.names for s in mxu.stages]
+    assert mega.fingerprint != mxu.fingerprint
+
+
+def test_fused_pallas_mxu_bitexact_vs_off():
+    """`--plan off` stays golden: the forced-MXU megakernel pipeline
+    equals the per-op reference through the public Pipeline door."""
+    pipe = Pipeline.parse("invert,gaussian:5,sharpen,quantize:6")
+    img = jnp.asarray(synthetic_image(97, 131, channels=1, seed=70))
+    golden = np.asarray(pipe.jit(plan="off")(img))
+    got = np.asarray(pipe.jit(plan="fused-pallas-mxu")(img))
+    np.testing.assert_array_equal(got, golden)
+
+
+def test_tune_store_accepts_fused_pallas_mxu_arm(calib_file):
+    """The PR-19 online tune store promotes 'plan:fused-pallas-mxu'
+    with no tune-code change: the choice round-trips through
+    promoted_entry's PLAN_CHOICES gate and wins effective_plan_choice."""
+    from mpi_cuda_imagemanipulation_tpu.tune.store import (
+        effective_plan_choice,
+        online_store,
+    )
+
+    ops = make_pipeline_ops(MIXED)
+    fp = pipeline_fingerprint(ops)
+    kind = calibration.current_device_kind()
+    online_store.reset()
+    try:
+        online_store.promote(fp, 512, "fused-pallas-mxu")
+        assert (
+            online_store.promoted_entry(fp, device_kind=kind, width=512)[
+                "choice"
+            ]
+            == "fused-pallas-mxu"
+        )
+        assert (
+            effective_plan_choice(fp, device_kind=kind, width=512)
+            == "fused-pallas-mxu"
+        )
+    finally:
+        online_store.reset()

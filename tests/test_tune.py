@@ -194,6 +194,21 @@ def test_effective_plan_choice_newest_wins(calib_file, cpu_kind):
         store._clock = tune_store._now
 
 
+def test_promote_rejects_unknown_choice_at_the_write(calib_file, cpu_kind):
+    # the closed-vocabulary raise at the choke point: a typo'd arm must
+    # fail the promote, not bank an entry no resolver will ever honour
+    clock = FakeClock()
+    store = _store(clock)
+    with pytest.raises(ValueError, match="unknown plan choice"):
+        store.promote(FP, 512, "fused-palas-mxu")
+    assert store.promoted_entry(FP, device_kind="cpu") is None
+    # every current plan arm — including fused-pallas-mxu — is accepted
+    for choice in calibration.PLAN_CHOICES:
+        store.promote(FP, 512, choice)
+    ent = store.promoted_entry(FP, device_kind="cpu")
+    assert ent["choice"] == calibration.PLAN_CHOICES[-1]
+
+
 def test_record_plan_choice_stamps_recorded_at(calib_file):
     calibration.record_plan_choice("cpu", FP, "fused", width=512)
     ent = calibration.plan_entry(FP, device_kind="cpu")

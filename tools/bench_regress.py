@@ -55,6 +55,14 @@ REL_TOL_SINGLE = 0.40
 # fall back to _DEFAULT_FIELDS (first present wins).
 METRIC_FIELDS: dict[str, list[tuple[str, bool]]] = {
     "plan_ab": [("speedup_fused_vs_off", True)],
+    "megakernel_ab": [("speedup_pallas_vs_fused", True)],
+    # the in-stage-MXU lane: the best dot arm vs the VPU walk is the
+    # headline (on CPU an interpret-mode gate anchor, on TPU the perf
+    # claim), and the int8-vs-f32 ratio guards the cheaper accumulator
+    "mxu_fused_ab": [
+        ("speedup_fused_mxu_vs_fused_vpu", True),
+        ("speedup_fused_mxu_int8_vs_f32", True),
+    ],
     "stream_ab": [("speedup", True), ("memory_ratio", True)],
     "engine_ab": [("speedup", True)],
     "halo_ab": [("comms_hidden_frac", True)],

@@ -1,0 +1,256 @@
+#!/usr/bin/env python
+"""CI in-stage-MXU smoke (tier1.yml): the fused-mxu arms end to end, in
+interpret mode on CPU.
+
+One process proves:
+
+  1. **bit-exactness** — `plan=fused-pallas-mxu` and every forced
+     `MCIM_MXU_STAGE` setting (f32, int8, on) reproduce the per-op
+     golden chain (`--plan off`) on odd shapes, through chains that
+     exercise the eligible family (separable + dense stencils), the
+     in-stage `family` fallback (morphology) and an ineligible member
+     (median);
+  2. **structure** — the lowered HLO of the mxu lowering contains a
+     `dot_general` contraction and the VPU control does NOT (the MXU is
+     structurally engaged inside the `pallas_call`, not inferred from
+     timing);
+  3. **fallback accounting** — forced-off and family rejections land on
+     `mcim_plan_mxu_in_stage_fallback_total` with closed-vocabulary
+     reasons, chosen arms on `mcim_plan_mxu_in_stage_total`, and both
+     families render as parseable exposition;
+  4. **the control loop** — a real TuneController + CanaryGate
+     propose/promote `plan:fused-pallas-mxu` end to end with REAL
+     shadow digests: every canary-lane output is the actual
+     fused-pallas-mxu pipeline result, digest-compared against the
+     stable `--plan off` output. Zero mismatches is the gate's promote
+     condition, so the promotion itself certifies the new arm's
+     bit-exactness. (Dispatch timings fed to the store are synthetic —
+     interpret-mode wall time is meaningless off-chip, the repo-wide
+     rule — the gate's digests are not.) The promotion must be durable:
+     `promoted_entry` resolves to `fused-pallas-mxu`.
+  5. **the lane** — the mxu_fused_ab bench lane runs (its pre-timing
+     bit-exactness gate over three odd shapes must pass) and its record
+     lands at argv[1]. Interpret-mode timings are never asserted; the
+     committed BENCH_HISTORY record is the gate anchor, the TPU window
+     script (tools/tpu_queue/36_mxu_fused_r08.sh) carries the perf
+     claim.
+
+Usage: python tools/mxu_fused_smoke.py /tmp/mxu_fused_ab.json
+"""
+
+import hashlib
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+H, W = 97, 131
+OPS = "gaussian:5,sharpen,box:5"
+
+
+class _Clock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from mpi_cuda_imagemanipulation_tpu.io.image import synthetic_image
+    from mpi_cuda_imagemanipulation_tpu.models.pipeline import Pipeline
+    from mpi_cuda_imagemanipulation_tpu.obs.metrics import (
+        Registry,
+        parse_exposition,
+    )
+    from mpi_cuda_imagemanipulation_tpu.ops import mxu_kernels
+    from mpi_cuda_imagemanipulation_tpu.plan import build_plan, plan_metrics
+    from mpi_cuda_imagemanipulation_tpu.plan.pallas_exec import (
+        plan_callable_pallas,
+    )
+
+    # -- 1. bit-exactness: fused-pallas-mxu + every forced setting ----------
+    chains = (
+        OPS,                                   # all members eligible
+        "grayscale,contrast:3.5,gaussian:5,sharpen,quantize:6",
+        "gaussian:5,erode:3,sharpen",          # morphology: family fallback
+        "median:3,gaussian:3",                 # median: never a candidate
+    )
+    for spec in chains:
+        pipe = Pipeline.parse(spec)
+        ch = 3 if spec.startswith("grayscale") else 1
+        img = jnp.asarray(synthetic_image(H, W, channels=ch, seed=31))
+        golden = np.asarray(pipe.apply(img))
+        got = np.asarray(pipe.jit(plan="fused-pallas-mxu")(img))
+        assert np.array_equal(got, golden), f"fused-pallas-mxu: {spec}"
+        plan = build_plan(pipe.ops, "fused-pallas")
+        for setting in ("f32", "int8", "on"):
+            got = np.asarray(
+                plan_callable_pallas(plan, mxu_stage=setting)(img)
+            )
+            assert np.array_equal(got, golden), f"{setting}: {spec}"
+    print(f"bit-exact: {len(chains)} chains x (plan arm + f32/int8/on)")
+
+    # -- 2. structure: dot_general inside the lowered megakernel ------------
+    pipe = Pipeline.parse(OPS)
+    img = jnp.asarray(synthetic_image(H, W, channels=1, seed=32))
+    plan = build_plan(pipe.ops, "fused-pallas")
+    mxu_txt = (
+        jax.jit(plan_callable_pallas(plan, mxu_stage="on"))
+        .lower(img).as_text()
+    )
+    vpu_txt = (
+        jax.jit(plan_callable_pallas(plan, mxu_stage="off"))
+        .lower(img).as_text()
+    )
+    assert "dot_general" in mxu_txt, "no contraction in the mxu lowering"
+    assert "dot_general" not in vpu_txt, "VPU control contains a contraction"
+    print("structure: dot_general in the mxu lowering, absent from the VPU")
+
+    # -- 3. fallback accounting + exposition --------------------------------
+    before_off = int(
+        plan_metrics.mxu_stage_fallbacks.value(reason="off")
+    )
+    before_fam = int(
+        plan_metrics.mxu_stage_fallbacks.value(reason="family")
+    )
+    for op in Pipeline.parse(OPS).ops:
+        mxu_kernels.stage_arm_for(op, W, setting="off")
+    mxu_kernels.stage_arm_for(
+        Pipeline.parse("erode:3").ops[0], W, setting="on"
+    )
+    assert (
+        int(plan_metrics.mxu_stage_fallbacks.value(reason="off"))
+        == before_off + 3
+    )
+    assert (
+        int(plan_metrics.mxu_stage_fallbacks.value(reason="family"))
+        == before_fam + 1
+    )
+    fams = parse_exposition(plan_metrics.registry.render())
+    for fam in (
+        "mcim_plan_mxu_in_stage_total",
+        "mcim_plan_mxu_in_stage_fallback_total",
+    ):
+        assert fam in fams, f"missing metric family {fam}"
+    print(f"fallbacks: off/family counted; {len(fams)} families parse")
+
+    # -- 4. the control loop promotes the arm on real shadow digests --------
+    from mpi_cuda_imagemanipulation_tpu.fabric.canary import (
+        PROMOTED,
+        CanaryConfig,
+        CanaryGate,
+    )
+    from mpi_cuda_imagemanipulation_tpu.ops.registry import make_pipeline_ops
+    from mpi_cuda_imagemanipulation_tpu.plan.ir import pipeline_fingerprint
+    from mpi_cuda_imagemanipulation_tpu.tune import store as tune_store
+    from mpi_cuda_imagemanipulation_tpu.tune.controller import (
+        TuneConfig,
+        TuneController,
+    )
+
+    tmp = tempfile.mkdtemp(prefix="mxu_fused_smoke_")
+    os.environ["MCIM_CALIB_FILE"] = os.path.join(tmp, "calib.json")
+    os.environ.pop("MCIM_NO_CALIB", None)
+    clock = _Clock()
+    store = tune_store.OnlineStore(clock=clock)
+    pipe = Pipeline.parse(OPS)
+    pipe_fp = pipeline_fingerprint(make_pipeline_ops(OPS))
+    imgs = [
+        jnp.asarray(synthetic_image(H + 2 * i, W + 3 * i, channels=1,
+                                    seed=50 + i))
+        for i in range(4)
+    ]
+    stable = pipe.jit(plan="off")
+    candidate = pipe.jit(plan="fused-pallas-mxu")
+
+    gate = CanaryGate(
+        CanaryConfig(frac=0.5, min_requests=2, shadow_every=1,
+                     bad_frac=0.5, burn_ratio=2.0, promote_requests=4),
+        clock=clock,
+    )
+    deployed: list = []
+    promoted: list = []
+
+    def deploy(flip):
+        deployed.append(flip)
+        gate.start("r1", flip)
+
+    ctl = TuneController(
+        gate=gate,
+        deploy=deploy,
+        pipe_fp=pipe_fp,
+        current_arm="plan:off",
+        arms=("plan:off", "plan:fused-pallas-mxu"),
+        registry=Registry(),
+        on_promote=promoted.append,
+        on_revert=lambda flip: (_ for _ in ()).throw(
+            AssertionError(f"unexpected revert: {flip}")
+        ),
+        store=store,
+        config=TuneConfig(tick_s=0.01, min_samples=3, explore_c=0.0,
+                          min_gain=1.05, flip_timeout_s=600),
+        clock=clock,
+    )
+    # incumbent measured -> the unmeasured candidate is proposed
+    for v in (0.015, 0.015, 0.016, 0.015):
+        store.record_dispatch(pipe_fp, W, "plan:off", v)
+    assert ctl.tick() == "propose", ctl.status()
+    assert deployed[0] == {"argv": ["--plan", "fused-pallas-mxu"]}
+    # the canary serves: every lane output is the REAL fused-pallas-mxu
+    # result, shadow-digested against the REAL stable output
+    for im in imgs:
+        got = np.asarray(candidate(im))
+        want = np.asarray(stable(im))
+        match = (
+            hashlib.sha256(got.tobytes()).hexdigest()
+            == hashlib.sha256(want.tobytes()).hexdigest()
+        )
+        gate.record("canary", True)
+        gate.record_shadow(match)
+    assert gate.shadow_mismatch == 0, "fused-pallas-mxu diverged in shadow"
+    assert gate.state == PROMOTED, gate.status()
+    # promote arithmetic: the candidate must be measured faster. The
+    # timings are synthetic (interpret wall time proves nothing); the
+    # digests above are the real acceptance.
+    for v in (0.010, 0.010, 0.011, 0.010):
+        store.record_dispatch(pipe_fp, W, "plan:fused-pallas-mxu", v)
+    assert ctl.tick() == "promote", ctl.status()
+    assert promoted == [{"argv": ["--plan", "fused-pallas-mxu"]}]
+    assert ctl.current_arm == "plan:fused-pallas-mxu"
+    ent = store.promoted_entry(pipe_fp)
+    assert ent is not None and ent["choice"] == "fused-pallas-mxu", ent
+    print(
+        f"control loop: plan:fused-pallas-mxu proposed + promoted, "
+        f"{gate.shadow_match} real shadow digests matched, 0 mismatches, "
+        f"promotion durable in the store"
+    )
+
+    # -- 5. the mxu_fused_ab lane (record -> CI artifact) -------------------
+    out = sys.argv[1] if len(sys.argv) > 1 else None
+    os.environ.setdefault("MCIM_MXU_FUSED_AB_HEIGHT", "96")
+    os.environ.setdefault("MCIM_MXU_FUSED_AB_WIDTH", "160")
+    from mpi_cuda_imagemanipulation_tpu.bench_suite import run_mxu_fused_ab
+
+    rec = run_mxu_fused_ab(json_path=out, printer=lambda s: None)
+    assert rec["bit_exact_gate"].startswith("passed"), rec["bit_exact_gate"]
+    assert rec["interpret_mode"] is True
+    print(
+        f"mxu_fused_ab: gate passed, best arm {rec['best_mxu_lane']}, "
+        f"stage arms {rec['stage_arms']} (interpret mode — gate record "
+        "only)" + (f" -> {out}" if out else "")
+    )
+    print("mxu-fused smoke: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,56 @@
+#!/bin/bash
+# MXU inside the megakernel (round 8, ISSUE 20): the first silicon
+# measurement of the in-stage dot arms. One fused halo-6 stage
+# (gaussian:5 -> sharpen -> box:5) timed FIVE ways on the 8K frame:
+#
+#   off             the unfused baseline (`--plan off`)
+#   fused_vpu       the megakernel, every op on the VPU shift walk
+#   fused_mxu       the megakernel, eligible ops as in-kernel banded
+#                   dot_general contractions (f32/bf16 accumulate)
+#   fused_mxu_int8  same contraction, int8 operands + int32 accumulate
+#                   (only arms whose exactness is proven under 2^24)
+#   mxu_whole_op    the existing whole-op MXU backend (PR 23's path) —
+#                   the "is fusion + MXU better than MXU alone" control
+#
+# All five lanes are bit-exactness-gated against `--plan off` on three
+# odd shapes BEFORE any timing; a gate failure aborts the record.
+# Predictions are pre-registered in BASELINE.md ("MXU-in-stage arms"):
+# fused_mxu 1.15-1.6x over fused_vpu (roofline_frac 0.65-0.85),
+# int8 1.0-1.25x over f32, fused_mxu >= 1.8x over mxu_whole_op.
+# roofline_frac < 0.60 or int8 < f32 refutes the design — see the
+# promote/hold/shelve decision procedure there. The committed CPU
+# record is an interpret-mode gate anchor, NOT a perf claim (the
+# banded dot does ~26x the arithmetic of the walk off-chip).
+#
+# Knobs: MCIM_MXU_FUSED_AB_OPS / _HEIGHT / _WIDTH (lane shape).
+# Budget: ~4-6 min.
+set -u
+cd "$(dirname "$0")/../.."
+. tools/tpu_queue/_lib.sh
+out=artifacts/mxu_fused_r08.out
+: > "$out"
+timeout 600 python -m mpi_cuda_imagemanipulation_tpu.bench_suite \
+  --config mxu_fused_ab \
+  --json-metrics artifacts/mxu_fused_ab_r08.json >> "$out" 2>&1 || true
+# promote the lane record into the history (the bench_regress input)
+python - >> "$out" 2>&1 <<'EOF' || true
+import datetime, json, subprocess
+rec = json.load(open("artifacts/mxu_fused_ab_r08.json"))
+sha = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                     capture_output=True, text=True).stdout.strip()
+line = {"ts": datetime.datetime.now(datetime.timezone.utc)
+        .strftime("%Y-%m-%dT%H:%M:%SZ"),
+        "records": [rec],
+        "note": "mxu_fused_ab on silicon (round 8): in-stage dot arms "
+                "vs the VPU walk vs whole-op MXU, scored against the "
+                "BASELINE.md pre-registered targets",
+        "git_sha": sha}
+with open("BENCH_HISTORY.jsonl", "a") as f:
+    f.write(json.dumps(line) + "\n")
+EOF
+# pre-merge sentinel: the fresh record vs the committed trajectory
+timeout 120 python tools/bench_regress.py \
+  --candidate artifacts/mxu_fused_ab_r08.json >> "$out" 2>&1 || true
+commit_artifacts "TPU window: in-stage MXU fused A/B (round 8)" \
+  "$out" BENCH_HISTORY.jsonl artifacts/mxu_fused_ab_r08.json
+exit 0
